@@ -1,0 +1,195 @@
+"""Token sequences and content-addressed KV blocks.
+
+Every KV-cache block in the framework is identified by two hashes:
+
+- ``block_hash``: a salted xxh3-64 over the block's token ids. Identical token
+  contents produce identical block hashes regardless of position.
+- ``sequence_hash``: a chained hash ``H(parent_sequence_hash, block_hash)``
+  that identifies the block *in context* — i.e. the whole prefix ending at
+  this block. Two requests share a KV prefix iff their sequence hashes match.
+
+This mirrors the semantics of the reference implementation's token-hash crate
+(reference: lib/tokens/src/lib.rs:16-120 and lib/llm/src/tokens.rs:21-417 —
+salted BlockHash, parent-chained SequenceHash), re-designed as a single Python
+module (the reference kept two divergent copies). The radix-tree KV indexer
+(dynamo_tpu/kv_router/indexer.py) and the block manager key off
+``sequence_hash``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import xxhash
+
+# Seed matching the reference's router-side block hasher
+# (reference: lib/llm/src/kv_router/indexer.rs:64 — xxh3 seed 1337).
+DEFAULT_SALT = b"dynamo-tpu"
+ROUTER_SEED = 1337
+
+
+def salt_hash(salt: bytes = DEFAULT_SALT) -> int:
+    """Hash a salt into a 64-bit seed for block hashing.
+
+    Deployments that must not share hash namespaces pass
+    ``TokenSequence(..., salt=...)`` (or ``seed=salt_hash(salt)`` to the
+    free functions) so identical token content hashes differently per salt.
+    """
+    return xxhash.xxh3_64_intdigest(salt)
+
+
+def _tokens_to_bytes(token_ids: Sequence[int]) -> bytes:
+    return np.asarray(token_ids, dtype=np.uint32).tobytes()
+
+
+def compute_block_hash(token_ids: Sequence[int], seed: int = ROUTER_SEED) -> int:
+    """Salted content hash of one block's token ids (position-independent)."""
+    return xxhash.xxh3_64_intdigest(_tokens_to_bytes(token_ids), seed=seed)
+
+
+def chain_hash(parent_sequence_hash: Optional[int], block_hash: int) -> int:
+    """Chained prefix hash: identifies the whole sequence ending at this block."""
+    if parent_sequence_hash is None:
+        return block_hash
+    buf = np.asarray([parent_sequence_hash, block_hash], dtype=np.uint64).tobytes()
+    return xxhash.xxh3_64_intdigest(buf)
+
+
+def compute_block_hashes(
+    token_ids: Sequence[int], block_size: int, seed: int = ROUTER_SEED
+) -> List[int]:
+    """Sequence hashes for each *complete* block of ``token_ids``.
+
+    This is the hot path used by the KV router on every scheduling decision
+    (reference: lib/llm/src/kv_router/indexer.rs:123 compute_block_hash_for_seq):
+    only full blocks are hashed; the ragged tail is ignored.
+    """
+    n_full = len(token_ids) // block_size
+    out: List[int] = []
+    parent: Optional[int] = None
+    arr = np.asarray(token_ids[: n_full * block_size], dtype=np.uint32)
+    for i in range(n_full):
+        bh = xxhash.xxh3_64_intdigest(
+            arr[i * block_size : (i + 1) * block_size].tobytes(), seed=seed
+        )
+        parent = chain_hash(parent, bh)
+        out.append(parent)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, completely-filled block of tokens.
+
+    ``sequence_hash`` = chain(parent_sequence_hash, block_hash) uniquely names
+    the prefix [0, position*block_size + len(tokens)) of the owning sequence.
+    """
+
+    tokens: Tuple[int, ...]
+    block_hash: int
+    sequence_hash: int
+    parent_sequence_hash: Optional[int]
+    position: int  # block index within the sequence
+
+    @property
+    def block_size(self) -> int:
+        return len(self.tokens)
+
+
+class PartialTokenBlock:
+    """Mutable tail block of a growing sequence; freezes into a TokenBlock."""
+
+    def __init__(
+        self,
+        block_size: int,
+        position: int,
+        parent_sequence_hash: Optional[int],
+        seed: int,
+    ):
+        self.block_size = block_size
+        self.position = position
+        self.parent_sequence_hash = parent_sequence_hash
+        self.seed = seed
+        self.tokens: List[int] = []
+
+    def push(self, token_id: int) -> Optional[TokenBlock]:
+        """Append one token. Returns the frozen block when it fills up."""
+        self.tokens.append(int(token_id))
+        if len(self.tokens) == self.block_size:
+            return self.freeze()
+        return None
+
+    def freeze(self) -> TokenBlock:
+        bh = compute_block_hash(self.tokens, self.seed)
+        sh = chain_hash(self.parent_sequence_hash, bh)
+        return TokenBlock(
+            tokens=tuple(self.tokens),
+            block_hash=bh,
+            sequence_hash=sh,
+            parent_sequence_hash=self.parent_sequence_hash,
+            position=self.position,
+        )
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+class TokenSequence:
+    """A token sequence chunked into hash-chained blocks.
+
+    Used by the engine's block allocator to track which KV blocks are
+    complete (shareable / publishable as KV events) vs. the in-flight tail.
+    """
+
+    def __init__(
+        self,
+        token_ids: Iterable[int] = (),
+        block_size: int = 16,
+        seed: int = ROUTER_SEED,
+        salt: Optional[bytes] = None,
+    ):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self.block_size = block_size
+        self.seed = salt_hash(salt) if salt is not None else seed
+        self.blocks: List[TokenBlock] = []
+        self._tail = PartialTokenBlock(block_size, 0, None, seed)
+        self.extend(token_ids)
+
+    def extend(self, token_ids: Iterable[int]) -> List[TokenBlock]:
+        """Append tokens; returns any blocks completed by this extension."""
+        completed: List[TokenBlock] = []
+        for t in token_ids:
+            blk = self.push(t)
+            if blk is not None:
+                completed.append(blk)
+        return completed
+
+    def push(self, token_id: int) -> Optional[TokenBlock]:
+        blk = self._tail.push(token_id)
+        if blk is not None:
+            self.blocks.append(blk)
+            self._tail = PartialTokenBlock(
+                self.block_size, blk.position + 1, blk.sequence_hash, self.seed
+            )
+        return blk
+
+    @property
+    def tail(self) -> PartialTokenBlock:
+        return self._tail
+
+    @property
+    def token_ids(self) -> List[int]:
+        out: List[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self._tail.tokens)
+        return out
+
+    def sequence_hashes(self) -> List[int]:
+        return [b.sequence_hash for b in self.blocks]
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self._tail)

@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+Mirrors the reference's GPU-free CI strategy (SURVEY.md §4): all tests run
+without TPU hardware; sharding/mesh logic is exercised on a virtual 8-device
+CPU mesh. Real-TPU tests are opt-in via the ``tpu`` marker.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: requires real TPU hardware (opt-in)")
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("DYN_TPU_TESTS"):
+        return
+    skip_tpu = pytest.mark.skip(reason="TPU tests disabled (set DYN_TPU_TESTS=1)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)

@@ -1,0 +1,67 @@
+"""Tests for token block hashing (dynamo_tpu/tokens.py)."""
+
+from dynamo_tpu.tokens import (
+    TokenSequence,
+    chain_hash,
+    compute_block_hash,
+    compute_block_hashes,
+)
+
+
+def test_block_hash_content_addressed():
+    assert compute_block_hash([1, 2, 3]) == compute_block_hash([1, 2, 3])
+    assert compute_block_hash([1, 2, 3]) != compute_block_hash([1, 2, 4])
+    # seed changes the hash
+    assert compute_block_hash([1, 2, 3], seed=1) != compute_block_hash([1, 2, 3], seed=2)
+
+
+def test_sequence_hash_is_position_dependent():
+    # same block content at different prefix positions → different sequence hash
+    hashes = compute_block_hashes([5, 5, 5, 5, 5, 5, 5, 5], block_size=4)
+    assert len(hashes) == 2
+    assert hashes[0] != hashes[1]
+    # but the chained construction is deterministic
+    bh = compute_block_hash([5, 5, 5, 5])
+    assert hashes[0] == bh
+    assert hashes[1] == chain_hash(hashes[0], bh)
+
+
+def test_compute_block_hashes_ignores_partial_tail():
+    full = compute_block_hashes(list(range(8)), block_size=4)
+    ragged = compute_block_hashes(list(range(10)), block_size=4)
+    assert full == ragged
+
+
+def test_shared_prefix_shares_hashes():
+    a = compute_block_hashes(list(range(16)) + [99] * 4, block_size=4)
+    b = compute_block_hashes(list(range(16)) + [42] * 4, block_size=4)
+    assert a[:4] == b[:4]
+    assert a[4] != b[4]
+
+
+def test_token_sequence_incremental_matches_batch():
+    ids = list(range(37))
+    seq = TokenSequence(block_size=4)
+    for t in ids:
+        seq.push(t)
+    assert seq.token_ids == ids
+    assert len(seq) == 37
+    assert len(seq.blocks) == 9
+    assert len(seq.tail) == 1
+    assert seq.sequence_hashes() == compute_block_hashes(ids, block_size=4)
+
+
+def test_token_sequence_extend_returns_completed():
+    seq = TokenSequence(block_size=4)
+    assert seq.extend([1, 2, 3]) == []
+    done = seq.extend([4, 5])
+    assert len(done) == 1
+    assert done[0].tokens == (1, 2, 3, 4)
+    assert done[0].position == 0
+    assert done[0].parent_sequence_hash is None
+
+
+def test_token_sequence_init_with_tokens():
+    seq = TokenSequence(list(range(10)), block_size=4)
+    assert len(seq.blocks) == 2
+    assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
