@@ -1,0 +1,34 @@
+"""Distributed runtime core (see SURVEY.md §2.1 for the reference analog)."""
+
+from .client import Client, NoInstancesError, RouterMode
+from .component import Component, DistributedRuntime, Endpoint, Namespace, Runtime
+from .discovery import DiscoveryClient, Lease, WatchEvent, WatchEventType
+from .engine import AsyncEngine, AsyncEngineContext, Context, EngineError
+from .messaging import Message, MessagingClient, WorkItem
+from .network import ResponseStreamError
+from .pipeline import Operator, build_pipeline
+
+__all__ = [
+    "AsyncEngine",
+    "AsyncEngineContext",
+    "Client",
+    "Component",
+    "Context",
+    "DiscoveryClient",
+    "DistributedRuntime",
+    "Endpoint",
+    "EngineError",
+    "Lease",
+    "Message",
+    "MessagingClient",
+    "Namespace",
+    "NoInstancesError",
+    "Operator",
+    "ResponseStreamError",
+    "RouterMode",
+    "Runtime",
+    "WatchEvent",
+    "WatchEventType",
+    "WorkItem",
+    "build_pipeline",
+]

@@ -1,0 +1,182 @@
+"""Endpoint client: live instance tracking + routed streaming requests.
+
+Watches the endpoint's discovery prefix into a live instance map and routes
+each request per ``RouterMode`` (reference:
+lib/runtime/src/component/client.rs:95-319 — watch-backed endpoint set,
+random/round_robin/direct/static modes, AsyncEngine impl on the client).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import itertools
+import logging
+import random
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+import msgpack
+
+from .component import Endpoint
+from .discovery import WatchEventType
+from .engine import AsyncEngine, Context
+from .network import ResponseReceiver, open_response_stream
+
+logger = logging.getLogger(__name__)
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    STATIC = "static"
+    KV = "kv"  # resolved by an external KV-aware router, then DIRECT
+
+
+class NoInstancesError(ConnectionError):
+    pass
+
+
+class Client(AsyncEngine):
+    """Streaming client for one endpoint."""
+
+    def __init__(self, endpoint: Endpoint, mode: RouterMode = RouterMode.ROUND_ROBIN):
+        self.endpoint = endpoint
+        self.mode = mode
+        self.instances: Dict[str, dict] = {}
+        self._rr = itertools.count()
+        self._watch_task: Optional[asyncio.Task] = None
+        self._watcher = None
+        self._started = False
+        self._instances_changed = asyncio.Event()
+
+    async def start(self) -> "Client":
+        """Begin watching the discovery prefix (no-op in static mode)."""
+        if self._started:
+            return self
+        self._started = True
+        if self.mode == RouterMode.STATIC:
+            return self
+        drt = self.endpoint.drt
+        prefix = f"{self.endpoint.component.etcd_prefix()}{self.endpoint.name}:"
+        snapshot, watcher = await drt.discovery.watch_prefix(prefix)
+        for key, value in snapshot.items():
+            self._add(key, value)
+        self._watcher = watcher
+        self._watch_task = drt.runtime.spawn(self._watch_loop(watcher))
+        return self
+
+    def _add(self, key: str, value: bytes) -> None:
+        try:
+            info = msgpack.unpackb(value, raw=False)
+        except Exception:
+            logger.warning("bad endpoint info at %s", key)
+            return
+        self.instances[info["instance_id"]] = info
+        self._instances_changed.set()
+
+    async def _watch_loop(self, watcher) -> None:
+        async for ev in watcher:
+            if ev.type == WatchEventType.PUT:
+                self._add(ev.key, ev.value)
+            else:
+                instance_id = ev.key.rsplit(":", 1)[-1]
+                self.instances.pop(instance_id, None)
+                self._instances_changed.set()
+
+    def instance_ids(self) -> list:
+        return sorted(self.instances)
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
+        async def _wait():
+            while len(self.instances) < n:
+                self._instances_changed.clear()
+                await self._instances_changed.wait()
+
+        await asyncio.wait_for(_wait(), timeout)
+
+    # --- routing ---
+
+    def _pick(self, instance_id: Optional[str]) -> str:
+        if self.mode == RouterMode.STATIC:
+            return "static"
+        if instance_id is not None:
+            if instance_id not in self.instances:
+                raise NoInstancesError(
+                    f"instance {instance_id} not found for {self.endpoint.path()}"
+                )
+            return instance_id
+        ids = self.instance_ids()
+        if not ids:
+            raise NoInstancesError(f"no instances for {self.endpoint.path()}")
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(ids)
+        return ids[next(self._rr) % len(ids)]
+
+    async def open_stream(
+        self, payload: Any, instance_id: Optional[str] = None
+    ) -> ResponseReceiver:
+        """Route, push the request, return the dialed-back response stream."""
+        if not self._started:
+            await self.start()
+        target = self._pick(instance_id)
+        drt = self.endpoint.drt
+        conn, receiver = await open_response_stream(drt.stream_server, drt.local)
+        req_id = uuid.uuid4().hex
+        two_part = {"header": {"req_id": req_id, "conn": conn}, "payload": payload}
+        await drt.messaging.publish(
+            self.endpoint.subject(target), msgpack.packb(two_part, use_bin_type=True)
+        )
+        return receiver
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        """AsyncEngine over the network: request context controls propagate."""
+        instance_id = request.baggage.get("instance_id")
+        receiver = await self.open_stream(request.payload, instance_id)
+        await receiver.wait_prologue()
+
+        # propagate caller-side cancellation to the worker
+        async def relay_cancel():
+            await request.context.wait_stopped()
+            if request.context.is_killed:
+                receiver.kill()
+            else:
+                receiver.stop_generating()
+
+        relay = asyncio.create_task(relay_cancel())
+        try:
+            async for item in receiver:
+                yield item
+        finally:
+            relay.cancel()
+
+    async def direct(self, payload: Any, instance_id: str) -> ResponseReceiver:
+        receiver = await self.open_stream(payload, instance_id)
+        await receiver.wait_prologue()
+        return receiver
+
+    # --- stats scrape (reference: NATS $SRV.STATS service scrape) ---
+
+    async def scrape_stats(self, timeout: float = 0.5) -> Dict[str, dict]:
+        """Ask every live instance for its stats; missing answers are dropped."""
+        drt = self.endpoint.drt
+        out: Dict[str, dict] = {}
+
+        async def one(iid: str):
+            try:
+                raw = await drt.messaging.request(
+                    f"_stats.{self.endpoint.subject(iid)}", b"", timeout=timeout
+                )
+                out[iid] = msgpack.unpackb(raw, raw=False)
+            except Exception:
+                pass
+
+        await asyncio.gather(*(one(i) for i in self.instance_ids()))
+        return out
+
+    async def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.cancel()
+        if self._watch_task is not None:
+            self._watch_task.cancel()

@@ -1,0 +1,98 @@
+"""Core streaming-engine abstractions.
+
+``AsyncEngine`` is THE central trait of the framework: everything that turns a
+request into a stream of responses — the HTTP frontend's model handles, the
+preprocessor/backend pipeline operators, network clients, and the JAX engine
+itself — implements it. Mirrors the reference's engine trait surface
+(reference: lib/runtime/src/engine.rs:47-145 — AsyncEngine::generate,
+AsyncEngineContext id/stop/kill, ResponseStream), re-designed on asyncio.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Dict, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class AsyncEngineContext:
+    """Per-request control handle: identity plus cooperative cancellation.
+
+    ``stop_generating`` asks the producer to finish early but still emit any
+    buffered output; ``kill`` demands immediate termination. Both are sticky.
+    """
+
+    def __init__(self, request_id: Optional[str] = None):
+        self.id: str = request_id or uuid.uuid4().hex
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+
+    def stop_generating(self) -> None:
+        self._stopped.set()
+
+    def kill(self) -> None:
+        self._stopped.set()
+        self._killed.set()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+
+class Context(Generic[T]):
+    """A request travelling through a pipeline: payload + control + baggage.
+
+    ``baggage`` is a typed-map analog of the reference's per-request Context
+    (reference: lib/runtime/src/pipeline/context.rs:33-150) used by operators
+    to pass side-channel data (e.g. the preprocessor stashes the tokenized
+    prompt for the response path).
+    """
+
+    def __init__(
+        self,
+        payload: T,
+        context: Optional[AsyncEngineContext] = None,
+        baggage: Optional[Dict[str, Any]] = None,
+    ):
+        self.payload = payload
+        self.context = context or AsyncEngineContext()
+        self.baggage: Dict[str, Any] = baggage or {}
+
+    @property
+    def id(self) -> str:
+        return self.context.id
+
+    def map(self, new_payload: Any) -> "Context[Any]":
+        """New payload, same identity/control/baggage."""
+        return Context(new_payload, self.context, self.baggage)
+
+
+class AsyncEngine(abc.ABC):
+    """request → async stream of responses. Streaming-first, single method."""
+
+    @abc.abstractmethod
+    def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        """Returns an async iterator of responses for this request."""
+        raise NotImplementedError
+
+    async def close(self) -> None:  # optional lifecycle hook
+        pass
+
+
+class EngineError(Exception):
+    """Engine could not be created / request rejected before streaming began.
+
+    The network layer maps this onto the response-stream prologue so callers
+    get a clean error instead of an empty stream (reference:
+    lib/runtime/src/pipeline/network/egress/push.rs ResponseStreamPrologue).
+    """

@@ -1,0 +1,577 @@
+"""dynstore: the framework's control+message plane server and client.
+
+One asyncio TCP server provides both planes the reference gets from two
+external services (reference: etcd for lease-KV-watch discovery,
+lib/runtime/src/transports/etcd.rs; NATS for subject pub/sub, queue-group
+request push and the JetStream prefill work queue,
+lib/runtime/src/transports/nats.rs, examples/llm/utils/nats_queue.py).
+The environment ships no etcd or NATS, so the framework carries its own:
+semantics match (transactional create, prefix watch with Put/Delete, lease
+TTL liveness, queue groups, ack/visibility work queues), implementation is
+ours.
+
+Wire protocol: 4-byte big-endian length, then a msgpack map. Requests carry
+``id`` for RPC correlation; server pushes carry ``push`` with a watcher /
+subscription id. One TCP connection per client, multiplexed.
+
+Run standalone:  python -m dynamo_tpu.runtime.transports.dynstore --port 4871
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import logging
+import time
+from typing import Dict, Optional, Set, Tuple
+
+import msgpack
+
+from ..discovery import (
+    DiscoveryClient,
+    Lease,
+    PrefixWatcher,
+    WatchEvent,
+    WatchEventType,
+)
+from ..messaging import Message, MessagingClient, Subscription, WorkItem, subject_matches
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 4871
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    length = int.from_bytes(header, "big")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    try:
+        return msgpack.unpackb(body, raw=False)
+    except Exception:
+        logger.warning("dropping undecodable %d-byte frame", length)
+        return None
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    writer.write(len(body).to_bytes(4, "big") + body)
+
+
+class _ServerConn:
+    """Per-connection server state."""
+
+    def __init__(self, server: "DynStoreServer", writer: asyncio.StreamWriter):
+        self.server = server
+        self.writer = writer
+        self.leases: Set[int] = set()
+        self.watch_ids: Set[int] = set()
+        self.sub_ids: Set[int] = set()
+        self.send_lock = asyncio.Lock()
+        self.closed = False
+
+    async def send(self, obj: dict) -> None:
+        if self.closed:
+            return
+        try:
+            async with self.send_lock:
+                write_frame(self.writer, obj)
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, RuntimeError):
+            self.closed = True
+
+
+class DynStoreServer:
+    """The broker process: lease-KV-watch + pub/sub + work queues."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        # kv: key -> (value, lease_id)
+        self.kv: Dict[str, Tuple[bytes, Optional[int]]] = {}
+        # leases: id -> (expiry_time, ttl, keys)
+        self.leases: Dict[int, Tuple[float, float, Set[str]]] = {}
+        # watches: wid -> (prefix, conn)
+        self.watches: Dict[int, Tuple[str, _ServerConn]] = {}
+        # subs: sid -> (pattern, group | None, conn)
+        self.subs: Dict[int, Tuple[str, Optional[str], _ServerConn]] = {}
+        self._group_rr: Dict[Tuple[str, str], int] = {}
+        self.queues: Dict[str, asyncio.Queue] = {}
+        self.inflight: Dict[str, Dict[int, bytes]] = {}
+        self._ids = itertools.count(1)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._reaper_task: Optional[asyncio.Task] = None
+        self._conns: set = set()
+        self._op_tasks: set = set()
+
+    # --- lifecycle ---
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._reaper_task = asyncio.create_task(self._reap_leases())
+        logger.info("dynstore listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._reaper_task:
+            self._reaper_task.cancel()
+        # drop live client connections first: Server.wait_closed() (py3.12)
+        # otherwise blocks until every connected client hangs up on its own
+        for conn in list(self._conns):
+            conn.closed = True
+            conn.writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    # --- lease liveness ---
+
+    async def _reap_leases(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            now = time.monotonic()
+            expired = [lid for lid, (exp, _, _) in self.leases.items() if exp < now]
+            for lid in expired:
+                await self._expire_lease(lid)
+
+    async def _expire_lease(self, lease_id: int) -> None:
+        entry = self.leases.pop(lease_id, None)
+        if entry is None:
+            return
+        _, _, keys = entry
+        for key in sorted(keys):
+            await self._delete_key(key)
+
+    async def _delete_key(self, key: str) -> None:
+        entry = self.kv.pop(key, None)
+        if entry is None:
+            return
+        value, lease_id = entry
+        if lease_id is not None and lease_id in self.leases:
+            self.leases[lease_id][2].discard(key)
+        await self._emit_watch(WatchEventType.DELETE, key, value)
+
+    async def _emit_watch(self, ev_type: WatchEventType, key: str, value: bytes) -> None:
+        for wid, (prefix, conn) in list(self.watches.items()):
+            if key.startswith(prefix):
+                await conn.send(
+                    {"push": "watch", "wid": wid, "type": ev_type.value, "key": key, "value": value}
+                )
+
+    # --- connection handling ---
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = _ServerConn(self, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                req = await read_frame(reader)
+                if req is None:
+                    break
+                # each op handled concurrently so a blocking queue_pop doesn't
+                # stall keepalives on the same connection; keep a strong ref
+                # (bare create_task results are GC-able mid-flight)
+                task = asyncio.create_task(self._dispatch(conn, req))
+                self._op_tasks.add(task)
+                task.add_done_callback(self._op_tasks.discard)
+        finally:
+            conn.closed = True
+            self._conns.discard(conn)
+            await self._cleanup_conn(conn)
+            writer.close()
+
+    async def _cleanup_conn(self, conn: _ServerConn) -> None:
+        """Connection death == worker death: expire its leases immediately."""
+        for wid in list(conn.watch_ids):
+            self.watches.pop(wid, None)
+        for sid in list(conn.sub_ids):
+            self.subs.pop(sid, None)
+        for lid in list(conn.leases):
+            await self._expire_lease(lid)
+
+    async def _dispatch(self, conn: _ServerConn, req: dict) -> None:
+        op = req.get("op")
+        rid = req.get("id")
+        try:
+            result = await self._execute(conn, op, req)
+            if rid is not None:
+                await conn.send({"id": rid, "ok": True, **(result or {})})
+        except Exception as e:  # report, don't kill the connection
+            logger.exception("dynstore op %s failed", op)
+            if rid is not None:
+                await conn.send({"id": rid, "ok": False, "error": str(e)})
+
+    async def _execute(self, conn: _ServerConn, op: str, req: dict) -> Optional[dict]:
+        if op == "lease_grant":
+            lid = next(self._ids)
+            ttl = float(req.get("ttl", 10.0))
+            self.leases[lid] = (time.monotonic() + ttl, ttl, set())
+            conn.leases.add(lid)
+            return {"lease": lid, "ttl": ttl}
+        if op == "lease_keepalive":
+            lid = req["lease"]
+            if lid in self.leases:
+                _, ttl, keys = self.leases[lid]
+                self.leases[lid] = (time.monotonic() + ttl, ttl, keys)
+                return {"alive": True}
+            return {"alive": False}
+        if op == "lease_revoke":
+            await self._expire_lease(req["lease"])
+            conn.leases.discard(req["lease"])
+            return {}
+        if op == "kv_create":
+            if req["key"] in self.kv:
+                return {"created": False}
+            await self._kv_put(req["key"], req["value"], req.get("lease"))
+            return {"created": True}
+        if op == "kv_put":
+            await self._kv_put(req["key"], req["value"], req.get("lease"))
+            return {}
+        if op == "kv_get":
+            entry = self.kv.get(req["key"])
+            return {"value": entry[0] if entry else None}
+        if op == "kv_get_prefix":
+            pfx = req["prefix"]
+            return {"kvs": {k: v for k, (v, _) in self.kv.items() if k.startswith(pfx)}}
+        if op == "kv_delete":
+            await self._delete_key(req["key"])
+            return {}
+        if op == "watch":
+            wid = next(self._ids)
+            self.watches[wid] = (req["prefix"], conn)
+            conn.watch_ids.add(wid)
+            pfx = req["prefix"]
+            return {"wid": wid, "kvs": {k: v for k, (v, _) in self.kv.items() if k.startswith(pfx)}}
+        if op == "unwatch":
+            self.watches.pop(req["wid"], None)
+            conn.watch_ids.discard(req["wid"])
+            return {}
+        if op == "sub":
+            sid = next(self._ids)
+            self.subs[sid] = (req["subject"], req.get("group"), conn)
+            conn.sub_ids.add(sid)
+            return {"sid": sid}
+        if op == "unsub":
+            self.subs.pop(req["sid"], None)
+            conn.sub_ids.discard(req["sid"])
+            return {}
+        if op == "pub":
+            delivered = await self._publish(req["subject"], req["payload"], req.get("reply"))
+            return {"delivered": delivered}
+        if op == "queue_push":
+            self._queue(req["queue"]).put_nowait(req["payload"])
+            return {}
+        if op == "queue_pop":
+            return await self._queue_pop(req)
+        if op == "queue_ack":
+            self.inflight.get(req["queue"], {}).pop(req["item"], None)
+            return {}
+        if op == "queue_depth":
+            return {"depth": self._queue(req["queue"]).qsize()}
+        if op == "ping":
+            return {"pong": True}
+        raise ValueError(f"unknown op {op!r}")
+
+    async def _kv_put(self, key: str, value: bytes, lease_id: Optional[int]) -> None:
+        if lease_id is not None and lease_id not in self.leases:
+            raise ValueError(f"lease {lease_id} does not exist")
+        self.kv[key] = (value, lease_id)
+        if lease_id is not None:
+            self.leases[lease_id][2].add(key)
+        await self._emit_watch(WatchEventType.PUT, key, value)
+
+    async def _publish(self, subject: str, payload: bytes, reply: Optional[str]) -> int:
+        delivered = 0
+        groups_seen: Dict[Tuple[str, str], list] = {}
+        for sid, (pattern, group, conn) in list(self.subs.items()):
+            if conn.closed or not subject_matches(pattern, subject):
+                continue
+            if group is None:
+                await conn.send(
+                    {"push": "msg", "sid": sid, "subject": subject, "payload": payload, "reply": reply}
+                )
+                delivered += 1
+            else:
+                groups_seen.setdefault((pattern, group), []).append((sid, conn))
+        for key, members in groups_seen.items():
+            idx = self._group_rr.get(key, 0) % len(members)
+            self._group_rr[key] = idx + 1
+            sid, conn = members[idx]
+            await conn.send(
+                {"push": "msg", "sid": sid, "subject": subject, "payload": payload, "reply": reply}
+            )
+            delivered += 1
+        return delivered
+
+    def _queue(self, name: str) -> asyncio.Queue:
+        if name not in self.queues:
+            self.queues[name] = asyncio.Queue()
+            self.inflight[name] = {}
+        return self.queues[name]
+
+    async def _queue_pop(self, req: dict) -> dict:
+        q = self._queue(req["queue"])
+        timeout = req.get("timeout")
+        try:
+            if timeout is None:
+                payload = await q.get()
+            else:
+                payload = await asyncio.wait_for(q.get(), timeout)
+        except asyncio.TimeoutError:
+            return {"payload": None}
+        item_id = next(self._ids)
+        qname = req["queue"]
+        self.inflight[qname][item_id] = payload
+        visibility = float(req.get("visibility", 60.0))
+        loop = asyncio.get_running_loop()
+
+        def _redeliver():
+            pending = self.inflight[qname].pop(item_id, None)
+            if pending is not None:
+                q.put_nowait(pending)
+
+        loop.call_later(visibility, _redeliver)
+        return {"payload": payload, "item": item_id}
+
+
+class DynStoreClient(DiscoveryClient, MessagingClient):
+    """One client implementing both planes over a single multiplexed TCP conn."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watchers: Dict[int, PrefixWatcher] = {}
+        self._subs: Dict[int, Subscription] = {}
+        self._ids = itertools.count(1)
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: Dict[int, asyncio.Task] = {}
+        self._send_lock = asyncio.Lock()
+        self._primary_lease: Optional[Lease] = None
+        self._closed = False
+        self._bg_tasks: set = set()
+
+    def _spawn_bg(self, coro) -> None:
+        """Fire-and-forget RPC with a strong task reference (GC-safe)."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+
+        def _done(t):
+            self._bg_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                logger.debug("background rpc failed: %s", t.exception())
+
+        task.add_done_callback(_done)
+
+    async def connect(self) -> "DynStoreClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            frame = await read_frame(self._reader)
+            if frame is None:
+                break
+            if "push" in frame:
+                self._handle_push(frame)
+            else:
+                fut = self._pending.pop(frame.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(frame)
+        # connection lost: fail all pending RPCs
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("dynstore connection lost"))
+        self._pending.clear()
+        for w in self._watchers.values():
+            w.cancel()
+        for s in self._subs.values():
+            s.cancel()
+
+    def _handle_push(self, frame: dict) -> None:
+        kind = frame["push"]
+        if kind == "watch":
+            watcher = self._watchers.get(frame["wid"])
+            if watcher is not None:
+                watcher._emit(
+                    WatchEvent(WatchEventType(frame["type"]), frame["key"], frame["value"])
+                )
+        elif kind == "msg":
+            sub = self._subs.get(frame["sid"])
+            if sub is not None:
+                sub._emit(
+                    Message(
+                        subject=frame["subject"],
+                        payload=frame["payload"],
+                        reply=frame.get("reply"),
+                    )
+                )
+
+    async def _rpc(self, op: str, rpc_timeout: Optional[float] = 30.0, **kwargs) -> dict:
+        if self._writer is None:
+            raise ConnectionError("client not connected")
+        rid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._send_lock:
+            write_frame(self._writer, {"op": op, "id": rid, **kwargs})
+            await self._writer.drain()
+        resp = await asyncio.wait_for(fut, rpc_timeout)
+        if not resp.get("ok"):
+            raise RuntimeError(f"dynstore {op} failed: {resp.get('error')}")
+        return resp
+
+    # --- DiscoveryClient ---
+
+    async def grant_lease(self, ttl: float = 10.0) -> Lease:
+        resp = await self._rpc("lease_grant", ttl=ttl)
+        lease = Lease(id=resp["lease"], ttl=resp["ttl"])
+        self._keepalive_tasks[lease.id] = asyncio.create_task(self._keepalive(lease))
+        return lease
+
+    async def _keepalive(self, lease: Lease) -> None:
+        while not self._closed:
+            await asyncio.sleep(lease.ttl / 3.0)
+            try:
+                resp = await self._rpc("lease_keepalive", lease=lease.id)
+                if not resp.get("alive"):
+                    logger.warning("lease %d no longer alive", lease.id)
+                    return
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
+                return
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        task = self._keepalive_tasks.pop(lease_id, None)
+        if task:
+            task.cancel()
+        await self._rpc("lease_revoke", lease=lease_id)
+
+    async def kv_create(self, key: str, value: bytes, lease_id: Optional[int] = None) -> bool:
+        resp = await self._rpc("kv_create", key=key, value=value, lease=lease_id)
+        return resp["created"]
+
+    async def kv_put(self, key: str, value: bytes, lease_id: Optional[int] = None) -> None:
+        await self._rpc("kv_put", key=key, value=value, lease=lease_id)
+
+    async def kv_get(self, key: str) -> Optional[bytes]:
+        return (await self._rpc("kv_get", key=key))["value"]
+
+    async def kv_get_prefix(self, prefix: str) -> Dict[str, bytes]:
+        return (await self._rpc("kv_get_prefix", prefix=prefix))["kvs"]
+
+    async def kv_delete(self, key: str) -> None:
+        await self._rpc("kv_delete", key=key)
+
+    async def watch_prefix(self, prefix: str):
+        resp = await self._rpc("watch", prefix=prefix)
+        wid = resp["wid"]
+
+        def on_cancel():
+            self._watchers.pop(wid, None)
+            if not self._closed:
+                self._spawn_bg(self._rpc("unwatch", wid=wid))
+
+        watcher = PrefixWatcher(on_cancel=on_cancel)
+        self._watchers[wid] = watcher
+        return resp["kvs"], watcher
+
+    # --- MessagingClient ---
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._rpc("pub", subject=subject, payload=payload)
+
+    def _make_sub(self, sid: int) -> Subscription:
+        def on_cancel():
+            self._subs.pop(sid, None)
+            if not self._closed:
+                self._spawn_bg(self._rpc("unsub", sid=sid))
+
+        sub = Subscription(on_cancel=on_cancel)
+        self._subs[sid] = sub
+        return sub
+
+    async def subscribe(self, subject: str) -> Subscription:
+        resp = await self._rpc("sub", subject=subject)
+        return self._make_sub(resp["sid"])
+
+    async def service_subscribe(self, subject: str, queue_group: str) -> Subscription:
+        resp = await self._rpc("sub", subject=subject, group=queue_group)
+        return self._make_sub(resp["sid"])
+
+    async def request(self, subject: str, payload: bytes, timeout: float = 30.0) -> bytes:
+        reply_subject = f"_inbox.{id(self)}.{next(self._ids)}"
+        reply_sub = await self.subscribe(reply_subject)
+        try:
+            resp = await self._rpc("pub", subject=subject, payload=payload, reply=reply_subject)
+            if resp.get("delivered", 0) == 0:
+                raise ConnectionError(f"no responders on subject {subject!r}")
+            msg = await asyncio.wait_for(reply_sub.__anext__(), timeout)
+            return msg.payload
+        finally:
+            reply_sub.cancel()
+
+    async def queue_push(self, queue: str, payload: bytes) -> None:
+        await self._rpc("queue_push", queue=queue, payload=payload)
+
+    async def queue_pop(
+        self, queue: str, timeout: Optional[float] = None, visibility: float = 60.0
+    ) -> Optional[WorkItem]:
+        resp = await self._rpc(
+            "queue_pop",
+            rpc_timeout=None if timeout is None else timeout + 5.0,
+            queue=queue,
+            **({"timeout": timeout} if timeout is not None else {}),
+            visibility=visibility,
+        )
+        if resp["payload"] is None:
+            return None
+        item_id = resp["item"]
+
+        def ack():
+            self._spawn_bg(self._rpc("queue_ack", queue=queue, item=item_id))
+
+        return WorkItem(payload=resp["payload"], ack=ack)
+
+    async def queue_depth(self, queue: str) -> int:
+        return (await self._rpc("queue_depth", queue=queue))["depth"]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu control/message plane server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    server = DynStoreServer(args.host, args.port)
+    asyncio.run(server.serve_forever())
+
+
+if __name__ == "__main__":
+    main()

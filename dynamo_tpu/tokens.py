@@ -155,7 +155,7 @@ class TokenSequence:
         self.block_size = block_size
         self.seed = salt_hash(salt) if salt is not None else seed
         self.blocks: List[TokenBlock] = []
-        self._tail = PartialTokenBlock(block_size, 0, None, seed)
+        self._tail = PartialTokenBlock(block_size, 0, None, self.seed)
         self.extend(token_ids)
 
     def extend(self, token_ids: Iterable[int]) -> List[TokenBlock]:

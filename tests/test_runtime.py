@@ -1,0 +1,253 @@
+"""Distributed runtime tests: in-memory hub and real dynstore TCP server."""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    EngineError,
+    NoInstancesError,
+    ResponseStreamError,
+    RouterMode,
+)
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+from dynamo_tpu.runtime.transports.dynstore import DynStoreServer
+
+
+async def echo_handler(payload, ctx):
+    for tok in payload["text"].split():
+        yield {"tok": tok}
+
+
+async def slow_handler(payload, ctx):
+    for i in range(1000):
+        if ctx.is_stopped:
+            yield {"done": "stopped"}
+            return
+        yield {"i": i}
+        await asyncio.sleep(0.005)
+
+
+async def failing_handler(payload, ctx):
+    raise EngineError("model not loaded")
+    yield  # pragma: no cover
+
+
+def make_drt():
+    return DistributedRuntime.in_process(MemoryHub())
+
+
+@pytest.mark.asyncio
+async def test_roundtrip_in_memory():
+    drt = make_drt()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+    serving = await ep.serve(echo_handler)
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+
+    out = []
+    async for item in client.generate(Context({"text": "hello tpu world"})):
+        out.append(item["tok"])
+    assert out == ["hello", "tpu", "world"]
+    await serving.stop()
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_round_robin_across_instances():
+    drt = make_drt()
+    ns = drt.namespace("test")
+    ep = ns.component("worker").endpoint("gen")
+
+    hits = {"a": 0, "b": 0}
+
+    def make(name):
+        async def h(payload, ctx):
+            hits[name] += 1
+            yield {"from": name}
+        return h
+
+    s1 = await ep.serve(make("a"), instance_id="ia")
+    s2 = await ep.serve(make("b"), instance_id="ib")
+    client = await Client(ep, RouterMode.ROUND_ROBIN).start()
+    await client.wait_for_instances(2)
+
+    for _ in range(6):
+        async for _item in client.generate(Context({})):
+            pass
+    assert hits == {"a": 3, "b": 3}
+
+    # direct routing
+    rec = await client.direct({}, "ia")
+    async for _ in rec:
+        pass
+    assert hits["a"] == 4
+    await s1.stop(); await s2.stop(); await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_no_instances_error():
+    drt = make_drt()
+    ep = drt.namespace("t").component("c").endpoint("e")
+    client = await Client(ep).start()
+    with pytest.raises(NoInstancesError):
+        async for _ in client.generate(Context({})):
+            pass
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_engine_error_surfaces_in_prologue():
+    drt = make_drt()
+    ep = drt.namespace("t").component("c").endpoint("e")
+    serving = await ep.serve(failing_handler)
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+    with pytest.raises(ResponseStreamError, match="model not loaded"):
+        async for _ in client.generate(Context({})):
+            pass
+    await serving.stop(); await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_stop_generating_propagates():
+    drt = make_drt()
+    ep = drt.namespace("t").component("c").endpoint("e")
+    serving = await ep.serve(slow_handler)
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+
+    ctx_req = Context({})
+    received = []
+    async for item in client.generate(ctx_req):
+        received.append(item)
+        if len(received) == 3:
+            ctx_req.context.stop_generating()
+    assert received[-1] == {"done": "stopped"}
+    assert len(received) < 100
+    await serving.stop(); await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_lease_expiry_removes_instance():
+    hub = MemoryHub()
+    drt = DistributedRuntime.in_process(hub)
+    ep = drt.namespace("t").component("c").endpoint("e")
+    serving = await ep.serve(echo_handler)
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+    assert len(client.instances) == 1
+
+    lease = await drt.discovery.primary_lease()
+    hub.expire_lease(lease.id)  # simulate worker death
+    await asyncio.sleep(0.01)
+    assert len(client.instances) == 0
+    await serving.stop(); await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_work_queue_ack_and_redelivery():
+    drt = make_drt()
+    m = drt.messaging
+    await m.queue_push("q", b"job1")
+    item = await m.queue_pop("q", timeout=1.0, visibility=0.05)
+    assert item.payload == b"job1"
+    # no ack → redelivered after visibility timeout
+    await asyncio.sleep(0.1)
+    item2 = await m.queue_pop("q", timeout=1.0, visibility=0.05)
+    assert item2.payload == b"job1"
+    item2.ack()
+    await asyncio.sleep(0.1)
+    assert await m.queue_depth("q") == 0
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_stats_scrape():
+    drt = make_drt()
+    ep = drt.namespace("t").component("c").endpoint("e")
+    serving = await ep.serve(echo_handler, stats_handler=lambda: {"load": 0.5})
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+    async for _ in client.generate(Context({"text": "x"})):
+        pass
+    stats = await client.scrape_stats()
+    assert len(stats) == 1
+    info = next(iter(stats.values()))
+    assert info["requests_total"] == 1
+    assert info["data"] == {"load": 0.5}
+    await serving.stop(); await drt.close()
+
+
+# ---------- dynstore: real TCP server, multi-"process" style clients ----------
+
+
+@pytest.mark.asyncio
+async def test_dynstore_end_to_end():
+    server = DynStoreServer(port=0)
+    await server.start()
+    try:
+        worker_drt = await DistributedRuntime.connect(port=server.port)
+        client_drt = await DistributedRuntime.connect(port=server.port)
+
+        ep_w = worker_drt.namespace("prod").component("w").endpoint("gen")
+        serving = await ep_w.serve(echo_handler)
+
+        ep_c = client_drt.namespace("prod").component("w").endpoint("gen")
+        client = await Client(ep_c).start()
+        await client.wait_for_instances(1)
+
+        out = []
+        async for item in client.generate(Context({"text": "over real tcp"})):
+            out.append(item["tok"])
+        assert out == ["over", "real", "tcp"]
+
+        # kv + watch
+        await client_drt.discovery.kv_put("cfg/threshold", b"123")
+        assert await worker_drt.discovery.kv_get("cfg/threshold") == b"123"
+
+        # pub/sub across clients
+        sub = await worker_drt.messaging.subscribe("events.kv")
+        await client_drt.messaging.publish("events.kv", b"stored")
+        msg = await asyncio.wait_for(sub.__anext__(), 2.0)
+        assert msg.payload == b"stored"
+
+        # work queue across clients
+        await client_drt.messaging.queue_push("prefill", b"req-1")
+        item = await worker_drt.messaging.queue_pop("prefill", timeout=2.0)
+        assert item.payload == b"req-1"
+        item.ack()
+
+        await serving.stop()
+        await worker_drt.close()
+        await client_drt.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_dynstore_conn_drop_expires_lease():
+    server = DynStoreServer(port=0)
+    await server.start()
+    try:
+        worker_drt = await DistributedRuntime.connect(port=server.port)
+        watcher_drt = await DistributedRuntime.connect(port=server.port)
+
+        ep = worker_drt.namespace("p").component("w").endpoint("g")
+        await ep.serve(echo_handler)
+
+        ep2 = watcher_drt.namespace("p").component("w").endpoint("g")
+        client = await Client(ep2).start()
+        await client.wait_for_instances(1)
+
+        # hard-kill the worker's connection (process death)
+        worker_drt.discovery._writer.close()
+        await asyncio.sleep(0.3)
+        assert len(client.instances) == 0
+        await watcher_drt.close()
+    finally:
+        await server.stop()
