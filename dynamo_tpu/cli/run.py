@@ -1,0 +1,206 @@
+"""serve CLI: ``python -m dynamo_tpu.cli.run in=<src> out=<engine> [flags]``.
+
+The dynamo-run analog (reference: launch/dynamo-run/src/{main,lib}.rs —
+in={http,text,stdin,batch:,dyn://} × out={echo_full,echo_core,engines...}).
+Wires the local pipeline frontend → preprocessor → backend → engine and
+serves it over the chosen input.
+
+Examples:
+  python -m dynamo_tpu.cli.run in=http out=echo_full --http-port 8080
+  python -m dynamo_tpu.cli.run in=http out=echo_core --model-path /path/to/model
+  python -m dynamo_tpu.cli.run in=http out=jax --model-path /path/to/model
+  python -m dynamo_tpu.cli.run in=dyn://ns.comp.ep out=jax --model-path ... \
+      --store-port 4871 --model-name my-model
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def parse_io(args: List[str]):
+    src, engine = "http", "echo_full"
+    rest = []
+    for a in args:
+        if a.startswith("in="):
+            src = a[3:]
+        elif a.startswith("out="):
+            engine = a[4:]
+        else:
+            rest.append(a)
+    return src, engine, rest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo-tpu run", add_help=True)
+    p.add_argument("--model-path", default=None, help="HF snapshot dir")
+    p.add_argument("--model-name", default=None, help="served model name")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--store-host", default="127.0.0.1", help="dynstore host")
+    p.add_argument("--store-port", type=int, default=None, help="dynstore port (distributed mode)")
+    p.add_argument("--namespace", default="public")
+    p.add_argument("--router-mode", default="round_robin",
+                   choices=["random", "round_robin", "kv"])
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+async def build_engine(engine_spec: str, flags, drt=None):
+    """Returns (openai_engine, mdc_or_None). The engine accepts
+    ChatCompletionRequest contexts and yields chat chunks."""
+    from ..llm.engines.echo import EchoEngineCore, EchoEngineFull
+
+    if engine_spec == "echo_full":
+        return EchoEngineFull(), None
+
+    if engine_spec in ("echo_core", "jax"):
+        if not flags.model_path:
+            raise SystemExit(f"out={engine_spec} requires --model-path")
+        from ..llm.backend import Backend
+        from ..llm.model_card import ModelDeploymentCard
+        from ..llm.preprocessor import OpenAIPreprocessor
+        from ..llm.tokenizer import HFTokenizer
+        from ..runtime.pipeline import build_pipeline
+
+        mdc = ModelDeploymentCard.from_local_path(
+            flags.model_path, flags.model_name, kv_block_size=flags.kv_block_size
+        )
+        tokenizer = HFTokenizer.from_pretrained_dir(flags.model_path)
+        pre = OpenAIPreprocessor(mdc, tokenizer)
+        backend = Backend(tokenizer)
+        if engine_spec == "echo_core":
+            core = EchoEngineCore()
+        else:
+            from ..engine.serving import JaxServingEngine
+
+            core = await JaxServingEngine.create(mdc, flags)
+        return build_pipeline([pre, backend], core), mdc
+
+    raise SystemExit(f"unknown engine {engine_spec!r}")
+
+
+async def run_http(flags, engine, mdc) -> None:
+    from ..http.service import HttpService, ModelManager, ModelWatcher
+
+    manager = ModelManager()
+    name = flags.model_name or (mdc.display_name if mdc else "echo")
+    manager.add_chat_model(name, engine)
+    manager.add_completion_model(name, engine)
+    service = HttpService(manager, flags.http_host, flags.http_port)
+
+    watcher = None
+    if flags.store_port is not None:
+        from ..runtime.component import DistributedRuntime
+        from ..runtime.client import RouterMode
+
+        drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
+        watcher = ModelWatcher(
+            drt, manager, flags.namespace, RouterMode(flags.router_mode)
+        )
+        await watcher.start()
+
+    await service.start()
+    print(f"listening on http://{flags.http_host}:{service.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        if watcher:
+            await watcher.stop()
+        await service.stop()
+
+
+async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
+    from ..protocols.openai import ChatCompletionRequest
+    from ..runtime.engine import Context
+
+    name = flags.model_name or (mdc.display_name if mdc else "echo")
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, lambda: input("> "))
+        except (EOFError, KeyboardInterrupt):
+            return
+        if not line.strip():
+            continue
+        req = ChatCompletionRequest(
+            model=name, messages=[{"role": "user", "content": line}], stream=True
+        )
+        async for chunk in engine.generate(Context(req)):
+            d = chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+            for choice in d.get("choices", []):
+                content = (choice.get("delta") or {}).get("content")
+                if content:
+                    print(content, end="", flush=True)
+        print()
+
+
+async def run_endpoint(flags, engine, mdc, path: str) -> None:
+    """Serve the pipeline as a distributed endpoint worker (in=dyn://...)."""
+    from ..http.service import parse_endpoint_path, register_model
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.engine import Context
+
+    if flags.store_port is None:
+        raise SystemExit("in=dyn:// requires --store-port")
+    ns_name, comp, ep_name = parse_endpoint_path(path)
+    drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
+    endpoint = drt.namespace(ns_name).component(comp).endpoint(ep_name)
+
+    async def handler(payload, ctx):
+        from ..protocols.openai import ChatCompletionRequest
+
+        req = ChatCompletionRequest.model_validate(payload)
+        async for chunk in engine.generate(Context(req, ctx)):
+            yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+
+    serving = await endpoint.serve(handler)
+    name = flags.model_name or (mdc.display_name if mdc else "echo")
+    await register_model(drt, flags.namespace, name, path, model_type="both")
+    print(f"worker serving {path} (model={name})", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await serving.stop()
+
+
+async def amain(argv: List[str]) -> None:
+    src, engine_spec, rest = parse_io(argv)
+    flags = build_parser().parse_args(rest)
+    logging.basicConfig(level=logging.DEBUG if flags.verbose else logging.INFO)
+
+    engine, mdc = await build_engine(engine_spec, flags)
+    if src == "http":
+        await run_http(flags, engine, mdc)
+    elif src in ("text", "stdin"):
+        await run_text(flags, engine, mdc)
+    elif src.startswith("dyn://"):
+        await run_endpoint(flags, engine, mdc, src)
+    elif src.startswith("batch:"):
+        from .batch import run_batch
+
+        await run_batch(flags, engine, mdc, src[len("batch:"):])
+    else:
+        raise SystemExit(f"unknown input {src!r}")
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain(sys.argv[1:]))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

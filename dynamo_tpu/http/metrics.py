@@ -1,0 +1,141 @@
+"""Prometheus metrics for the HTTP service (no client lib in env — the
+text exposition format is simple enough to emit directly).
+
+Reference analog: lib/llm/src/http/service/metrics.rs:37-130 —
+``{prefix}_http_service_requests_total`` / ``_inflight_requests`` /
+``_request_duration_seconds`` labelled by model and status.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        self.values[key] = self.values.get(key, 0.0) + amount
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, val in sorted(self.values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        self.values[tuple(sorted(labels.items()))] = value
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, val in sorted(self.values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self.counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self.sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self.totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        if key not in self.counts:
+            self.counts[key] = [0] * len(self.buckets)
+            self.sums[key] = 0.0
+            self.totals[key] = 0
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[key][i] += 1
+        self.sums[key] += value
+        self.totals[key] += 1
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self.counts):
+            labels = dict(key)
+            for i, b in enumerate(self.buckets):
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': str(b)})} {self.counts[key][i]}"
+                )
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {self.totals[key]}"
+            )
+            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self.sums[key]}")
+            lines.append(f"{self.name}_count{_fmt_labels(labels)} {self.totals[key]}")
+        return lines
+
+
+class ServiceMetrics:
+    """The HTTP service's metric set + request timing helper."""
+
+    def __init__(self, prefix: str = "dynamo"):
+        self.requests_total = Counter(
+            f"{prefix}_http_service_requests_total", "Total HTTP requests by model/status"
+        )
+        self.inflight = Gauge(
+            f"{prefix}_http_service_inflight_requests", "In-flight requests by model"
+        )
+        self.duration = Histogram(
+            f"{prefix}_http_service_request_duration_seconds",
+            "Request duration by model",
+        )
+        self.ttft = Histogram(
+            f"{prefix}_http_service_time_to_first_token_seconds",
+            "Time to first streamed token by model",
+        )
+        self._extra = []
+
+    def register(self, metric) -> None:
+        self._extra.append(metric)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in (self.requests_total, self.inflight, self.duration, self.ttft, *self._extra):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    class _Timer:
+        def __init__(self, metrics: "ServiceMetrics", model: str):
+            self.metrics = metrics
+            self.model = model
+            self.start = time.monotonic()
+            self.status = "success"
+            self.first_token_seen = False
+
+        def first_token(self) -> None:
+            if not self.first_token_seen:
+                self.first_token_seen = True
+                self.metrics.ttft.observe(time.monotonic() - self.start, model=self.model)
+
+        def finish(self, status: str = "success") -> None:
+            self.metrics.inflight.dec(model=self.model)
+            self.metrics.requests_total.inc(model=self.model, status=status)
+            self.metrics.duration.observe(time.monotonic() - self.start, model=self.model)
+
+    def track(self, model: str) -> "ServiceMetrics._Timer":
+        self.inflight.inc(model=model)
+        return self._Timer(self, model)

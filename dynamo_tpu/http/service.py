@@ -1,0 +1,354 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
+GET /metrics, GET /health. SSE streaming with a client-disconnect monitor
+that stops generation; non-streaming requests aggregate the chunk stream.
+
+Reference analog: lib/llm/src/http/service/openai.rs:132-539 (axum routes +
+disconnect monitor), service.rs ModelManager, service_v2 builder, and the
+model discovery watcher (http/service/discovery.rs:37-171) that hot-adds
+remote models registered in the discovery plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+import msgpack
+from aiohttp import web
+
+from ..protocols import sse
+from ..protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    CompletionRequest,
+    CompletionResponse,
+    ModelInfo,
+    ModelList,
+    aggregate_chat_stream,
+    aggregate_completion_stream,
+)
+from ..runtime.client import Client, NoInstancesError, RouterMode
+from ..runtime.component import DistributedRuntime
+from ..runtime.discovery import WatchEventType
+from ..runtime.engine import AsyncEngine, Context, EngineError
+from ..runtime.network import ResponseStreamError
+from .metrics import ServiceMetrics
+
+logger = logging.getLogger(__name__)
+
+MODEL_REGISTRY_PREFIX = "models/"  # under the http namespace
+
+
+class ModelManager:
+    """name → engine maps for chat and completion models."""
+
+    def __init__(self) -> None:
+        self.chat_engines: Dict[str, AsyncEngine] = {}
+        self.completion_engines: Dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self.chat_engines[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self.completion_engines[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self.chat_engines.pop(name, None)
+        self.completion_engines.pop(name, None)
+
+    def model_names(self) -> list:
+        return sorted(set(self.chat_engines) | set(self.completion_engines))
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics_prefix: str = "dynamo",
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = ServiceMetrics(metrics_prefix)
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self.handle_chat)
+        self.app.router.add_post("/v1/completions", self.handle_completions)
+        self.app.router.add_get("/v1/models", self.handle_models)
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/health", self.handle_health)
+        self._runner: Optional[web.AppRunner] = None
+
+    # ---------- lifecycle ----------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        logger.info("http service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ---------- helpers ----------
+
+    @staticmethod
+    def _error(status: int, message: str, err_type: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": message, "type": err_type, "code": status}},
+            status=status,
+        )
+
+    async def _stream_sse(
+        self,
+        request: web.Request,
+        ctx: Context,
+        chunks: AsyncIterator[Any],
+        timer,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in chunks:
+                timer.first_token()
+                await resp.write(sse.encode_event(_as_dict(chunk)))
+            await resp.write(sse.encode_done())
+            timer.finish("success")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away — stop generation upstream
+            ctx.context.stop_generating()
+            timer.finish("disconnect")
+            raise
+        except (EngineError, ResponseStreamError, NoInstancesError) as e:
+            # mid-stream failure: emit an error event, then end the stream
+            await resp.write(sse.encode_event({"error": {"message": str(e)}}))
+            await resp.write(sse.encode_done())
+            timer.finish("error")
+        await resp.write_eof()
+        return resp
+
+    # ---------- routes ----------
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            chat_req = ChatCompletionRequest.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._error(400, f"invalid request: {e}")
+
+        engine = self.manager.chat_engines.get(chat_req.model)
+        if engine is None:
+            return self._error(404, f"model '{chat_req.model}' not found", "model_not_found")
+
+        timer = self.metrics.track(chat_req.model)
+        ctx = Context(chat_req)
+        try:
+            stream = engine.generate(ctx)
+            if chat_req.stream:
+                return await self._stream_sse(request, ctx, stream, timer)
+            chunks = []
+            async for chunk in stream:
+                timer.first_token()
+                chunks.append(ChatCompletionChunk.model_validate(_as_dict(chunk)))
+            timer.finish("success")
+            return web.json_response(
+                aggregate_chat_stream(chunks).model_dump(exclude_none=True)
+            )
+        except (EngineError, ValueError) as e:
+            timer.finish("error")
+            return self._error(400, str(e))
+        except NoInstancesError as e:
+            timer.finish("error")
+            return self._error(503, str(e), "service_unavailable")
+        except ResponseStreamError as e:
+            timer.finish("error")
+            return self._error(502, str(e), "engine_error")
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            comp_req = CompletionRequest.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._error(400, f"invalid request: {e}")
+
+        engine = self.manager.completion_engines.get(comp_req.model)
+        if engine is None:
+            return self._error(404, f"model '{comp_req.model}' not found", "model_not_found")
+
+        timer = self.metrics.track(comp_req.model)
+        ctx = Context(comp_req)
+        try:
+            stream = engine.generate(ctx)
+            if comp_req.stream:
+                return await self._stream_sse(request, ctx, stream, timer)
+            chunks = []
+            async for chunk in stream:
+                timer.first_token()
+                chunks.append(CompletionResponse.model_validate(_as_dict(chunk)))
+            timer.finish("success")
+            return web.json_response(
+                aggregate_completion_stream(chunks).model_dump(exclude_none=True)
+            )
+        except (EngineError, ValueError) as e:
+            timer.finish("error")
+            return self._error(400, str(e))
+        except NoInstancesError as e:
+            timer.finish("error")
+            return self._error(503, str(e), "service_unavailable")
+        except ResponseStreamError as e:
+            timer.finish("error")
+            return self._error(502, str(e), "engine_error")
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            ModelList(
+                data=[ModelInfo(id=name) for name in self.manager.model_names()]
+            ).model_dump()
+        )
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "models": self.manager.model_names()})
+
+
+def _as_dict(chunk: Any) -> Any:
+    if hasattr(chunk, "model_dump"):
+        return chunk.model_dump(exclude_none=True)
+    return chunk
+
+
+# ---------- model registry + discovery watcher ----------
+
+
+def model_registry_key(namespace: str, model_type: str, name: str) -> str:
+    return f"{namespace}/{MODEL_REGISTRY_PREFIX}{model_type}/{name}"
+
+
+async def register_model(
+    drt: DistributedRuntime,
+    namespace: str,
+    name: str,
+    endpoint_path: str,
+    model_type: str = "chat",
+    mdc: Optional[dict] = None,
+    lease_scoped: bool = True,
+) -> None:
+    """Register a served model in the discovery plane (llmctl analog).
+
+    ``endpoint_path`` is a dyn://ns.comp.ep address whose workers accept
+    OpenAI-level requests (preprocessing is worker-side, as in the
+    reference's v0.1.1 layout).
+    """
+    entry = {"name": name, "endpoint": endpoint_path, "model_type": model_type}
+    if mdc:
+        entry["mdc"] = mdc
+    lease = await drt.discovery.primary_lease() if lease_scoped else None
+    await drt.discovery.kv_put(
+        model_registry_key(namespace, model_type, name),
+        msgpack.packb(entry, use_bin_type=True),
+        lease_id=lease.id if lease else None,
+    )
+
+
+async def unregister_model(
+    drt: DistributedRuntime, namespace: str, name: str, model_type: str = "chat"
+) -> None:
+    await drt.discovery.kv_delete(model_registry_key(namespace, model_type, name))
+
+
+async def list_models(drt: DistributedRuntime, namespace: str) -> list:
+    kvs = await drt.discovery.kv_get_prefix(f"{namespace}/{MODEL_REGISTRY_PREFIX}")
+    return [msgpack.unpackb(v, raw=False) for v in kvs.values()]
+
+
+def parse_endpoint_path(path: str):
+    """'dyn://ns.comp.ep' → (ns, comp, ep)."""
+    body = path[len("dyn://"):] if path.startswith("dyn://") else path
+    parts = body.split(".")
+    if len(parts) != 3:
+        raise ValueError(f"bad endpoint path {path!r}; want dyn://ns.comp.ep")
+    return parts[0], parts[1], parts[2]
+
+
+class ModelWatcher:
+    """Hot-add/remove models from discovery-plane registrations."""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        manager: ModelManager,
+        namespace: str = "public",
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    ):
+        self.drt = drt
+        self.manager = manager
+        self.namespace = namespace
+        self.router_mode = router_mode
+        self._clients: Dict[str, Client] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._watcher = None
+
+    async def start(self) -> None:
+        prefix = f"{self.namespace}/{MODEL_REGISTRY_PREFIX}"
+        snapshot, watcher = await self.drt.discovery.watch_prefix(prefix)
+        self._watcher = watcher
+        for key, value in snapshot.items():
+            await self._handle_put(key, value)
+        self._task = self.drt.runtime.spawn(self._loop(watcher))
+
+    async def _loop(self, watcher) -> None:
+        async for ev in watcher:
+            try:
+                if ev.type == WatchEventType.PUT:
+                    await self._handle_put(ev.key, ev.value)
+                else:
+                    self._handle_delete(ev.key)
+            except Exception:
+                logger.exception("model watcher failed on %s", ev.key)
+
+    async def _handle_put(self, key: str, value: bytes) -> None:
+        entry = msgpack.unpackb(value, raw=False)
+        name = entry["name"]
+        ns, comp, ep = parse_endpoint_path(entry["endpoint"])
+        endpoint = self.drt.namespace(ns).component(comp).endpoint(ep)
+        client = await Client(endpoint, self.router_mode).start()
+        self._clients[name] = client
+        model_type = entry.get("model_type", "chat")
+        if model_type in ("chat", "both"):
+            self.manager.add_chat_model(name, client)
+        if model_type in ("completions", "both"):
+            self.manager.add_completion_model(name, client)
+        logger.info("model %s → %s registered (%s)", name, entry["endpoint"], model_type)
+
+    def _handle_delete(self, key: str) -> None:
+        name = key.rsplit("/", 1)[-1]
+        self.manager.remove_model(name)
+        client = self._clients.pop(name, None)
+        if client is not None:
+            asyncio.ensure_future(client.close())
+        logger.info("model %s removed", name)
+
+    async def stop(self) -> None:
+        if self._watcher is not None:
+            self._watcher.cancel()
+        if self._task is not None:
+            self._task.cancel()
+        for client in self._clients.values():
+            await client.close()

@@ -1,0 +1,306 @@
+"""OpenAI ↔ internal translation: prompt templating, tokenization, deltas.
+
+Forward: render the model's chat template (jinja2), tokenize, merge model
+defaults into sampling/stop options → ``PreprocessedRequest``.
+Backward: wrap ``BackendOutput`` text deltas into OpenAI chat-completion
+chunks / completion chunks (SSE payloads).
+
+Reference analog: lib/llm/src/preprocessor.rs:63-359 (OpenAIPreprocessor +
+bidirectional Operator + DeltaGenerator) and preprocessor/prompt/template/*
+(minijinja chat-template rendering).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, List, Optional, Union
+
+import jinja2
+
+from ..protocols.common import (
+    BackendOutput,
+    FinishReason,
+    OutputOptions,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..protocols.openai import (
+    ChatChoiceDelta,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatStreamChoice,
+    ChoiceLogprobs,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    LogprobEntry,
+    Usage,
+    new_request_id,
+)
+from ..runtime.engine import AsyncEngine, Context, EngineError
+from ..runtime.pipeline import Operator
+from .model_card import ModelDeploymentCard
+from .tokenizer import HFTokenizer
+
+logger = logging.getLogger(__name__)
+
+FALLBACK_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ message.role }}: {{ message.content }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}assistant: {% endif %}"
+)
+
+
+class PromptFormatter:
+    """Jinja2 chat-template renderer (HF tokenizer_config semantics)."""
+
+    def __init__(self, template: Optional[str], bos_token: str = "", eos_token: str = ""):
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(), keep_trailing_newline=True
+        )
+        env.globals["raise_exception"] = self._raise
+        env.filters.setdefault("tojson", lambda v, **kw: jinja2.utils.htmlsafe_json_dumps(v))
+        self.template = env.from_string(template or FALLBACK_CHAT_TEMPLATE)
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+
+    @staticmethod
+    def _raise(msg):
+        raise EngineError(f"chat template error: {msg}")
+
+    def render(self, messages: List[dict], add_generation_prompt: bool = True, **extra) -> str:
+        return self.template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self.bos_token,
+            eos_token=self.eos_token,
+            **extra,
+        )
+
+
+class OpenAIPreprocessor(Operator):
+    """Bidirectional operator: OpenAI request in, OpenAI chunks out."""
+
+    def __init__(self, mdc: ModelDeploymentCard, tokenizer: Optional[HFTokenizer] = None):
+        self.mdc = mdc
+        self.tokenizer = tokenizer or (
+            HFTokenizer.from_pretrained_dir(mdc.model_path) if mdc.model_path else None
+        )
+        self.formatter = PromptFormatter(
+            mdc.chat_template, mdc.bos_token or "", mdc.eos_token or ""
+        )
+
+    # ---------- forward: request translation ----------
+
+    def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
+        if use_raw and req.messages:
+            prompt = "".join(m.text_content() for m in req.messages)
+        else:
+            prompt = self.formatter.render(
+                [m.model_dump(exclude_none=True) for m in req.messages],
+                add_generation_prompt=True,
+                tools=req.tools,
+            )
+        token_ids = self._tokenize(prompt)
+        return self._build(req, token_ids, prompt, max_tokens=req.effective_max_tokens())
+
+    def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        prompt = req.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)
+            prompt_text = None
+        elif isinstance(prompt, str):
+            token_ids = self._tokenize(prompt)
+            prompt_text = prompt
+        else:
+            raise EngineError("batch prompts must be dispatched one at a time")
+        return self._build(req, token_ids, prompt_text, max_tokens=req.max_tokens)
+
+    def _tokenize(self, prompt: str) -> List[int]:
+        if self.tokenizer is None:
+            raise EngineError(f"no tokenizer available for {self.mdc.display_name}")
+        return self.tokenizer.encode(prompt)
+
+    def _build(
+        self,
+        req: Union[ChatCompletionRequest, CompletionRequest],
+        token_ids: List[int],
+        prompt_text: Optional[str],
+        max_tokens: Optional[int],
+    ) -> PreprocessedRequest:
+        if len(token_ids) >= self.mdc.context_length:
+            raise EngineError(
+                f"prompt length {len(token_ids)} exceeds context window "
+                f"{self.mdc.context_length}"
+            )
+        ignore_eos = bool(req.ignore_eos or (req.nvext and req.nvext.ignore_eos))
+        budget = self.mdc.context_length - len(token_ids)
+        out = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=StopConditions(
+                max_tokens=min(max_tokens, budget) if max_tokens else budget,
+                min_tokens=req.min_tokens,
+                stop=req.stop_list() or None,
+                ignore_eos=ignore_eos,
+            ),
+            sampling_options=SamplingOptions(
+                n=req.n,
+                temperature=req.temperature,
+                top_p=req.top_p,
+                top_k=req.top_k,
+                min_p=req.min_p,
+                frequency_penalty=req.frequency_penalty,
+                presence_penalty=req.presence_penalty,
+                repetition_penalty=req.repetition_penalty,
+                seed=req.seed,
+            ),
+            output_options=OutputOptions(
+                logprobs=(
+                    (req.top_logprobs or 1)
+                    if isinstance(getattr(req, "logprobs", None), bool) and req.logprobs
+                    else (req.logprobs if isinstance(getattr(req, "logprobs", None), int) else None)
+                ),
+            ),
+            eos_token_ids=list(self.mdc.eos_token_ids),
+            model=req.model,
+            mdc_checksum=self.mdc.checksum,
+            annotations=list((req.nvext and req.nvext.annotations) or []),
+        )
+        return out
+
+    # ---------- backward: response translation ----------
+
+    async def chat_stream(
+        self,
+        request_id: str,
+        model: str,
+        backend_stream: AsyncIterator[BackendOutput],
+        prompt_tokens: int,
+        include_usage: bool = False,
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """BackendOutput deltas → OpenAI chat chunks (role chunk first)."""
+        yield ChatCompletionChunk(
+            id=request_id,
+            model=model,
+            choices=[ChatStreamChoice(delta=ChatChoiceDelta(role="assistant"))],
+        )
+        completion_tokens = 0
+        finish: Optional[FinishReason] = None
+        async for out in backend_stream:
+            completion_tokens = max(completion_tokens, out.cum_tokens)
+            finish = out.finish_reason
+            if out.text or out.finish_reason:
+                yield ChatCompletionChunk(
+                    id=request_id,
+                    model=model,
+                    choices=[
+                        ChatStreamChoice(
+                            delta=ChatChoiceDelta(content=out.text),
+                            finish_reason=out.finish_reason.to_openai()
+                            if out.finish_reason
+                            else None,
+                            logprobs=self._logprobs(out),
+                        )
+                    ],
+                )
+        if include_usage:
+            yield ChatCompletionChunk(
+                id=request_id,
+                model=model,
+                choices=[],
+                usage=Usage(
+                    prompt_tokens=prompt_tokens,
+                    completion_tokens=completion_tokens,
+                    total_tokens=prompt_tokens + completion_tokens,
+                ),
+            )
+
+    def _logprobs(self, out: BackendOutput) -> Optional[ChoiceLogprobs]:
+        if not out.logprobs:
+            return None
+        entries = []
+        for lp in out.logprobs:
+            token_str = (
+                self.tokenizer.id_to_token(lp.token_id) if self.tokenizer else str(lp.token_id)
+            ) or str(lp.token_id)
+            entries.append(
+                LogprobEntry(
+                    token=token_str,
+                    logprob=lp.logprob,
+                    top_logprobs=[
+                        {
+                            "token": (self.tokenizer.id_to_token(t) if self.tokenizer else str(t))
+                            or str(t),
+                            "logprob": p,
+                        }
+                        for t, p in (lp.top or {}).items()
+                    ],
+                )
+            )
+        return ChoiceLogprobs(content=entries)
+
+    async def completion_stream(
+        self,
+        request_id: str,
+        model: str,
+        backend_stream: AsyncIterator[BackendOutput],
+        prompt_tokens: int,
+        include_usage: bool = False,
+    ) -> AsyncIterator[CompletionResponse]:
+        completion_tokens = 0
+        async for out in backend_stream:
+            completion_tokens = max(completion_tokens, out.cum_tokens)
+            if out.text or out.finish_reason:
+                yield CompletionResponse(
+                    id=request_id,
+                    model=model,
+                    choices=[
+                        CompletionChoice(
+                            text=out.text or "",
+                            finish_reason=out.finish_reason.to_openai()
+                            if out.finish_reason
+                            else None,
+                        )
+                    ],
+                )
+        if include_usage:
+            yield CompletionResponse(
+                id=request_id,
+                model=model,
+                choices=[],
+                usage=Usage(
+                    prompt_tokens=prompt_tokens,
+                    completion_tokens=completion_tokens,
+                    total_tokens=prompt_tokens + completion_tokens,
+                ),
+            )
+
+    # ---------- Operator impl (dispatches on request type) ----------
+
+    async def generate(
+        self,
+        request: Context[Union[ChatCompletionRequest, CompletionRequest]],
+        next_engine: AsyncEngine,
+    ) -> AsyncIterator[Any]:
+        req = request.payload
+        is_chat = isinstance(req, ChatCompletionRequest)
+        if is_chat:
+            preprocessed = self.preprocess_chat(req)
+            request_id = new_request_id()
+        else:
+            preprocessed = self.preprocess_completion(req)
+            request_id = new_request_id("cmpl")
+        backend_stream = next_engine.generate(request.map(preprocessed))
+        include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        translate = self.chat_stream if is_chat else self.completion_stream
+        async for chunk in translate(
+            request_id,
+            req.model,
+            backend_stream,
+            prompt_tokens=len(preprocessed.token_ids),
+            include_usage=include_usage,
+        ):
+            yield chunk

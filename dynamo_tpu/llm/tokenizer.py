@@ -1,0 +1,89 @@
+"""Tokenizer wrapper + incremental detokenization.
+
+Wraps HuggingFace ``tokenizers`` (reference analog:
+lib/llm/src/tokenizers.rs — HuggingFaceTokenizer + DecodeStream). The
+``DecodeStream`` here implements offset-based incremental decoding: decode
+a sliding window of recent ids and emit only the stable new suffix, so
+multi-byte characters that span tokens are never emitted half-finished.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from tokenizers import Tokenizer
+
+REPLACEMENT_CHAR = "�"
+
+
+class HFTokenizer:
+    """Thin wrapper over ``tokenizers.Tokenizer`` with the framework surface."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+
+    @classmethod
+    def from_file(cls, path: str) -> "HFTokenizer":
+        return cls(Tokenizer.from_file(path))
+
+    @classmethod
+    def from_pretrained_dir(cls, model_dir: str) -> "HFTokenizer":
+        path = os.path.join(model_dir, "tokenizer.json")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no tokenizer.json under {model_dir}")
+        return cls.from_file(path)
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: List[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+    def id_to_token(self, token_id: int) -> Optional[str]:
+        return self._tok.id_to_token(token_id)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed ids one at a time, get text deltas.
+
+    Keeps ``prefix_offset``/``read_offset`` into the id history; each step
+    decodes ``ids[prefix:]`` and emits the stable suffix beyond the last
+    emitted text. Returns None while the tail is an incomplete UTF-8
+    sequence (e.g. the first half of a multi-token emoji).
+    """
+
+    def __init__(self, tokenizer: HFTokenizer, skip_special_tokens: bool = True):
+        self.tokenizer = tokenizer
+        self.skip_special_tokens = skip_special_tokens
+        self.ids: List[int] = []
+        self.prefix_offset = 0
+        self.read_offset = 0
+
+    def step(self, token_id: int) -> Optional[str]:
+        self.ids.append(int(token_id))
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset : self.read_offset], self.skip_special_tokens
+        )
+        new_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset :], self.skip_special_tokens
+        )
+        if new_text.endswith(REPLACEMENT_CHAR):
+            # incomplete multi-byte sequence — wait for more tokens
+            return None
+        if len(new_text) <= len(prefix_text):
+            return None
+        delta = new_text[len(prefix_text) :]
+        self.prefix_offset = self.read_offset
+        self.read_offset = len(self.ids)
+        return delta

@@ -1,0 +1,184 @@
+"""Internal engine-facing protocol: the framework's lingua franca.
+
+Every request, after preprocessing, becomes a ``PreprocessedRequest`` of
+token ids + sampling/stop options; every engine emits ``EngineOutput``
+deltas of token ids. The HTTP protocol layer translates both ways.
+Field semantics follow the reference's common protocol (reference:
+lib/llm/src/protocols/common.rs:205-341 — StopConditions, SamplingOptions,
+OutputOptions; common/llm_backend.rs — BackendInput/LLMEngineOutput),
+re-designed as msgpack-friendly dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class FinishReason(str, enum.Enum):
+    EOS = "eos"          # hit the model's end-of-sequence token
+    STOP = "stop"        # hit a user/model stop sequence or stop token id
+    LENGTH = "length"    # hit max_tokens / context limit
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def to_openai(self) -> str:
+        if self in (FinishReason.EOS, FinishReason.STOP):
+            return "stop"
+        if self is FinishReason.LENGTH:
+            return "length"
+        return "stop" if self is FinishReason.CANCELLED else "error"
+
+
+@dataclasses.dataclass
+class StopConditions:
+    max_tokens: Optional[int] = None
+    min_tokens: Optional[int] = None
+    stop: Optional[List[str]] = None                 # visible stop strings
+    stop_token_ids_hidden: Optional[List[int]] = None  # never surfaced in text
+    ignore_eos: bool = False
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "StopConditions":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class SamplingOptions:
+    n: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "SamplingOptions":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class OutputOptions:
+    logprobs: Optional[int] = None          # top-k logprobs per sampled token
+    prompt_logprobs: Optional[int] = None
+    skip_special_tokens: bool = True
+    echo_prompt: bool = False
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "OutputOptions":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class PreprocessedRequest:
+    """Token-level request handed to an engine (or shipped to a worker)."""
+
+    token_ids: List[int]
+    stop_conditions: StopConditions = dataclasses.field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = dataclasses.field(default_factory=SamplingOptions)
+    output_options: OutputOptions = dataclasses.field(default_factory=OutputOptions)
+    eos_token_ids: List[int] = dataclasses.field(default_factory=list)
+    model: Optional[str] = None
+    mdc_checksum: Optional[str] = None
+    annotations: List[str] = dataclasses.field(default_factory=list)
+
+    def to_wire(self) -> dict:
+        return {
+            "token_ids": list(self.token_ids),
+            "stop_conditions": self.stop_conditions.to_wire(),
+            "sampling_options": self.sampling_options.to_wire(),
+            "output_options": self.output_options.to_wire(),
+            "eos_token_ids": list(self.eos_token_ids),
+            "model": self.model,
+            "mdc_checksum": self.mdc_checksum,
+            "annotations": list(self.annotations),
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d["token_ids"]),
+            stop_conditions=StopConditions.from_wire(d.get("stop_conditions", {})),
+            sampling_options=SamplingOptions.from_wire(d.get("sampling_options", {})),
+            output_options=OutputOptions.from_wire(d.get("output_options", {})),
+            eos_token_ids=list(d.get("eos_token_ids", [])),
+            model=d.get("model"),
+            mdc_checksum=d.get("mdc_checksum"),
+            annotations=list(d.get("annotations", [])),
+        )
+
+
+@dataclasses.dataclass
+class TokenLogprob:
+    token_id: int
+    logprob: float
+    top: Optional[Dict[int, float]] = None  # token_id -> logprob
+
+
+@dataclasses.dataclass
+class EngineOutput:
+    """One streamed delta from an engine: newly generated token ids."""
+
+    token_ids: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[FinishReason] = None
+    logprobs: Optional[List[TokenLogprob]] = None
+    # engine-side detokenized text, if the engine chooses to provide it
+    text: Optional[str] = None
+    # KV/scheduling telemetry piggybacked on outputs (optional)
+    kv_transfer_params: Optional[dict] = None
+
+    def to_wire(self) -> dict:
+        d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason.value
+        if self.text is not None:
+            d["text"] = self.text
+        if self.logprobs is not None:
+            d["logprobs"] = [
+                {"token_id": lp.token_id, "logprob": lp.logprob, "top": lp.top}
+                for lp in self.logprobs
+            ]
+        if self.kv_transfer_params is not None:
+            d["kv_transfer_params"] = self.kv_transfer_params
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "EngineOutput":
+        fr = d.get("finish_reason")
+        lps = d.get("logprobs")
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=FinishReason(fr) if fr else None,
+            text=d.get("text"),
+            logprobs=[
+                TokenLogprob(lp["token_id"], lp["logprob"], lp.get("top"))
+                for lp in lps
+            ]
+            if lps
+            else None,
+            kv_transfer_params=d.get("kv_transfer_params"),
+        )
+
+
+@dataclasses.dataclass
+class BackendOutput:
+    """EngineOutput after the detokenizer stage: adds clean text deltas."""
+
+    token_ids: List[int]
+    text: Optional[str]
+    finish_reason: Optional[FinishReason] = None
+    logprobs: Optional[List[TokenLogprob]] = None
+    cum_tokens: int = 0

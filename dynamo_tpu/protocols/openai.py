@@ -1,0 +1,283 @@
+"""OpenAI-compatible API types (chat completions + completions).
+
+Pydantic models for the HTTP boundary, mirroring the surface the reference
+wraps from async-openai (reference: lib/llm/src/protocols/openai/* — chat,
+completions, nvext extension). The ``nvext`` extension field is kept
+name-compatible so clients written against the reference work unchanged
+(use_raw_prompt, annotations, ignore_eos).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .common import FinishReason
+
+
+class NvExt(BaseModel):
+    """Extension block: non-standard knobs (name-compatible with reference)."""
+
+    model_config = ConfigDict(extra="allow")
+    use_raw_prompt: Optional[bool] = None
+    ignore_eos: Optional[bool] = None
+    annotations: Optional[List[str]] = None
+    greed_sampling: Optional[bool] = None
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+    def text_content(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if isinstance(self.content, list):
+            return "".join(
+                part.get("text", "") for part in self.content if part.get("type") == "text"
+            )
+        return ""
+
+
+class StreamOptions(BaseModel):
+    include_usage: Optional[bool] = None
+
+
+class ChatCompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    messages: List[ChatMessage]
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None  # common extension
+    min_p: Optional[float] = None
+    n: Optional[int] = None
+    stop: Optional[Union[str, List[str]]] = None
+    stream: Optional[bool] = None
+    stream_options: Optional[StreamOptions] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    min_tokens: Optional[int] = None
+    ignore_eos: Optional[bool] = None
+    user: Optional[str] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    response_format: Optional[Dict[str, Any]] = None
+    nvext: Optional[NvExt] = None
+
+    def effective_max_tokens(self) -> Optional[int]:
+        return self.max_completion_tokens or self.max_tokens
+
+    def stop_list(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class CompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    n: Optional[int] = None
+    stop: Optional[Union[str, List[str]]] = None
+    stream: Optional[bool] = None
+    stream_options: Optional[StreamOptions] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    logprobs: Optional[int] = None
+    echo: Optional[bool] = None
+    min_tokens: Optional[int] = None
+    ignore_eos: Optional[bool] = None
+    user: Optional[str] = None
+    nvext: Optional[NvExt] = None
+
+    def stop_list(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatChoiceDelta(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+
+class LogprobEntry(BaseModel):
+    token: str
+    logprob: float
+    bytes: Optional[List[int]] = None
+    top_logprobs: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class ChoiceLogprobs(BaseModel):
+    content: Optional[List[LogprobEntry]] = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta = Field(default_factory=ChatChoiceDelta)
+    finish_reason: Optional[str] = None
+    logprobs: Optional[ChoiceLogprobs] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatStreamChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: Optional[str] = None
+    logprobs: Optional[ChoiceLogprobs] = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "dynamo-tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: List[ModelInfo] = Field(default_factory=list)
+
+
+def new_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def aggregate_chat_stream(
+    chunks: List[ChatCompletionChunk],
+) -> ChatCompletionResponse:
+    """Fold a chunk stream into a full response (non-streaming requests).
+
+    Reference analog: the stream→full aggregators in
+    lib/llm/src/protocols/openai/chat_completions/aggregator.rs.
+    """
+    content: Dict[int, List[str]] = {}
+    finish: Dict[int, Optional[str]] = {}
+    logprobs: Dict[int, List[LogprobEntry]] = {}
+    role: Dict[int, str] = {}
+    usage: Optional[Usage] = None
+    rid, model, created = "", "", int(time.time())
+    for chunk in chunks:
+        rid = chunk.id or rid
+        model = chunk.model or model
+        created = chunk.created
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for choice in chunk.choices:
+            idx = choice.index
+            if choice.delta.role:
+                role[idx] = choice.delta.role
+            if choice.delta.content:
+                content.setdefault(idx, []).append(choice.delta.content)
+            if choice.finish_reason is not None:
+                finish[idx] = choice.finish_reason
+            if choice.logprobs and choice.logprobs.content:
+                logprobs.setdefault(idx, []).extend(choice.logprobs.content)
+    indices = sorted(set(content) | set(finish) | set(role)) or [0]
+    return ChatCompletionResponse(
+        id=rid,
+        model=model,
+        created=created,
+        choices=[
+            ChatChoice(
+                index=i,
+                message=ChatMessage(
+                    role=role.get(i, "assistant"), content="".join(content.get(i, []))
+                ),
+                finish_reason=finish.get(i),
+                logprobs=ChoiceLogprobs(content=logprobs[i]) if i in logprobs else None,
+            )
+            for i in indices
+        ],
+        usage=usage,
+    )
+
+
+def aggregate_completion_stream(chunks: List[CompletionResponse]) -> CompletionResponse:
+    text: Dict[int, List[str]] = {}
+    finish: Dict[int, Optional[str]] = {}
+    usage: Optional[Usage] = None
+    rid, model, created = "", "", int(time.time())
+    for chunk in chunks:
+        rid = chunk.id or rid
+        model = chunk.model or model
+        created = chunk.created
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for choice in chunk.choices:
+            if choice.text:
+                text.setdefault(choice.index, []).append(choice.text)
+            if choice.finish_reason is not None:
+                finish[choice.index] = choice.finish_reason
+    indices = sorted(set(text) | set(finish)) or [0]
+    return CompletionResponse(
+        id=rid,
+        model=model,
+        created=created,
+        choices=[
+            CompletionChoice(index=i, text="".join(text.get(i, [])), finish_reason=finish.get(i))
+            for i in indices
+        ],
+        usage=usage,
+    )

@@ -124,6 +124,12 @@ class Client(AsyncEngine):
         drt = self.endpoint.drt
         conn, receiver = await open_response_stream(drt.stream_server, drt.local)
         req_id = uuid.uuid4().hex
+        # wire-serialize rich payloads (pydantic models, protocol dataclasses);
+        # mode="json" coerces enums/datetimes into msgpack-able primitives
+        if hasattr(payload, "model_dump"):
+            payload = payload.model_dump(mode="json", exclude_none=True)
+        elif hasattr(payload, "to_wire"):
+            payload = payload.to_wire()
         two_part = {"header": {"req_id": req_id, "conn": conn}, "payload": payload}
         await drt.messaging.publish(
             self.endpoint.subject(target), msgpack.packb(two_part, use_bin_type=True)
@@ -145,11 +151,17 @@ class Client(AsyncEngine):
                 receiver.stop_generating()
 
         relay = asyncio.create_task(relay_cancel())
+        exhausted = False
         try:
             async for item in receiver:
                 yield item
+            exhausted = True
         finally:
             relay.cancel()
+            if not exhausted and not request.context.is_stopped:
+                # caller abandoned the stream (early break / GC) — tell the
+                # worker to stop instead of generating into a dead queue
+                receiver.kill()
 
     async def direct(self, payload: Any, instance_id: str) -> ResponseReceiver:
         receiver = await self.open_stream(payload, instance_id)

@@ -116,7 +116,8 @@ def subject_matches(pattern: str, subject: str) -> bool:
     s_parts = subject.split(".")
     for i, p in enumerate(p_parts):
         if p == ">":
-            return True
+            # NATS semantics: '>' requires at least one more subject token
+            return i < len(s_parts)
         if i >= len(s_parts):
             return False
         if p == "*":

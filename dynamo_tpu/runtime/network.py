@@ -131,7 +131,9 @@ async def respond_to(
         if stream is None:
             logger.warning("local stream %s vanished", conn_info.get("stream"))
             return
-        send = stream.to_requester.put_nowait
+
+        async def send(frame: dict) -> None:
+            stream.to_requester.put_nowait(frame)
 
         async def control_loop():
             while True:
@@ -167,12 +169,14 @@ async def respond_to(
 
         ctrl_task = asyncio.create_task(control_loop())
 
-        def send(frame: dict) -> None:
+        async def send(frame: dict) -> None:
+            # drain per frame: backpressure from a slow requester propagates
+            # into the generator instead of ballooning the send buffer
             write_frame(writer, frame)
+            await writer.drain()
 
         try:
             await _pump(stream_fn, ctx, send)
-            await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             ctx.kill()
         finally:
@@ -194,23 +198,36 @@ def _apply_control(frame: dict, ctx: AsyncEngineContext) -> None:
 async def _pump(
     stream_fn: Callable[[AsyncEngineContext], AsyncIterator[Any]],
     ctx: AsyncEngineContext,
-    send: Callable[[dict], None],
+    send,
 ) -> None:
+    # Prime the first item BEFORE the prologue: async generators don't run
+    # their body until first iteration, so engine-creation errors (EngineError)
+    # only surface here — this is what makes the error-prologue contract real.
     try:
-        stream = stream_fn(ctx)
+        stream = stream_fn(ctx).__aiter__()
+        first: Any = await stream.__anext__()
+        have_first = True
     except EngineError as e:
-        send({"t": "prologue", "ok": False, "error": str(e)})
+        await send({"t": "prologue", "ok": False, "error": str(e)})
         return
-    send({"t": "prologue", "ok": True})
+    except StopAsyncIteration:
+        have_first = False
+    except Exception as e:
+        logger.exception("engine failed before first response %s", ctx.id)
+        await send({"t": "prologue", "ok": False, "error": f"{type(e).__name__}: {e}"})
+        return
+    await send({"t": "prologue", "ok": True})
     try:
-        async for item in stream:
-            if ctx.is_killed:
-                break
-            send({"t": "data", "payload": item})
-        send({"t": "end"})
+        if have_first and not ctx.is_killed:
+            await send({"t": "data", "payload": first})
+            async for item in stream:
+                if ctx.is_killed:
+                    break
+                await send({"t": "data", "payload": item})
+        await send({"t": "end"})
     except Exception as e:  # stream died mid-flight: tell the requester
         logger.exception("response stream %s failed", ctx.id)
-        send({"t": "err", "error": f"{type(e).__name__}: {e}"})
+        await send({"t": "err", "error": f"{type(e).__name__}: {e}"})
 
 
 class ResponseReceiver:

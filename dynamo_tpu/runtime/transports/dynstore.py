@@ -275,7 +275,7 @@ class DynStoreServer:
             self._queue(req["queue"]).put_nowait(req["payload"])
             return {}
         if op == "queue_pop":
-            return await self._queue_pop(req)
+            return await self._queue_pop(conn, req)
         if op == "queue_ack":
             self.inflight.get(req["queue"], {}).pop(req["item"], None)
             return {}
@@ -322,7 +322,7 @@ class DynStoreServer:
             self.inflight[name] = {}
         return self.queues[name]
 
-    async def _queue_pop(self, req: dict) -> dict:
+    async def _queue_pop(self, conn: _ServerConn, req: dict) -> dict:
         q = self._queue(req["queue"])
         timeout = req.get("timeout")
         try:
@@ -331,6 +331,11 @@ class DynStoreServer:
             else:
                 payload = await asyncio.wait_for(q.get(), timeout)
         except asyncio.TimeoutError:
+            return {"payload": None}
+        if conn.closed:
+            # popper died while blocked — hand the job straight back instead
+            # of parking it invisible for the full visibility window
+            q.put_nowait(payload)
             return {"payload": None}
         item_id = next(self._ids)
         qname = req["queue"]
@@ -358,6 +363,9 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
         self._pending: Dict[int, asyncio.Future] = {}
         self._watchers: Dict[int, PrefixWatcher] = {}
         self._subs: Dict[int, Subscription] = {}
+        # pushes that arrive between the watch/sub RPC response frame and the
+        # awaiting coroutine registering its watcher/subscription object
+        self._early_pushes: Dict[int, list] = {}
         self._ids = itertools.count(1)
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
@@ -422,6 +430,8 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
                 watcher._emit(
                     WatchEvent(WatchEventType(frame["type"]), frame["key"], frame["value"])
                 )
+            else:
+                self._buffer_early(frame["wid"], frame)
         elif kind == "msg":
             sub = self._subs.get(frame["sid"])
             if sub is not None:
@@ -432,6 +442,17 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
                         reply=frame.get("reply"),
                     )
                 )
+            else:
+                self._buffer_early(frame["sid"], frame)
+
+    def _buffer_early(self, rid: int, frame: dict) -> None:
+        buf = self._early_pushes.setdefault(rid, [])
+        if len(buf) < 4096:
+            buf.append(frame)
+
+    def _drain_early(self, rid: int) -> None:
+        for frame in self._early_pushes.pop(rid, []):
+            self._handle_push(frame)
 
     async def _rpc(self, op: str, rpc_timeout: Optional[float] = 30.0, **kwargs) -> dict:
         if self._writer is None:
@@ -499,6 +520,7 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
 
         watcher = PrefixWatcher(on_cancel=on_cancel)
         self._watchers[wid] = watcher
+        self._drain_early(wid)
         return resp["kvs"], watcher
 
     # --- MessagingClient ---
@@ -514,6 +536,7 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
 
         sub = Subscription(on_cancel=on_cancel)
         self._subs[sid] = sub
+        self._drain_early(sid)
         return sub
 
     async def subscribe(self, subject: str) -> Subscription:

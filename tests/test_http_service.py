@@ -1,0 +1,217 @@
+"""HTTP frontend tests: real aiohttp server + aiohttp client, SSE + metrics."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.http.service import (
+    HttpService,
+    ModelManager,
+    ModelWatcher,
+    register_model,
+    unregister_model,
+)
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines.echo import EchoEngineCore, EchoEngineFull
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import HFTokenizer
+from dynamo_tpu.protocols import sse
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.pipeline import build_pipeline
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+from fixtures import make_model_dir
+
+
+async def start_echo_service():
+    manager = ModelManager()
+    manager.add_chat_model("echo", EchoEngineFull())
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service
+
+
+@pytest.mark.asyncio
+async def test_models_and_health():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{service.port}/v1/models") as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["data"][0]["id"] == "echo"
+            async with s.get(f"http://127.0.0.1:{service.port}/health") as r:
+                assert (await r.json())["status"] == "ok"
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_chat_streaming_sse():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "one two three"}],
+                    "stream": True,
+                },
+            ) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                raw = await r.read()
+        payloads = list(sse.parse_stream(raw))
+        text = "".join(
+            c["choices"][0].get("delta", {}).get("content") or ""
+            for c in payloads if c.get("choices")
+        )
+        assert text.strip() == "one two three"
+        assert raw.decode().strip().endswith("data: [DONE]")
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_chat_non_streaming_aggregates():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "hello there"}],
+                },
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["content"].strip() == "hello there"
+        assert body["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_unknown_model_404_and_bad_body_400():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 404
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                data=b"not json",
+            ) as r:
+                assert r.status == 400
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "echo"},  # missing messages
+            ) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_exposed():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            await s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "echo", "messages": [{"role": "user", "content": "x"}]},
+            )
+            async with s.get(f"http://127.0.0.1:{service.port}/metrics") as r:
+                text = await r.text()
+        assert 'dynamo_http_service_requests_total{model="echo",status="success"} 1' in text
+        assert "dynamo_http_service_request_duration_seconds_bucket" in text
+        assert "dynamo_http_service_time_to_first_token_seconds" in text
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_full_pipeline_over_http(tmp_path):
+    """Tokenizing pipeline (preprocessor→backend→echo_core) behind HTTP."""
+    model_dir = make_model_dir(tmp_path)
+    mdc = ModelDeploymentCard.from_local_path(model_dir, "tiny")
+    tok = HFTokenizer.from_pretrained_dir(model_dir)
+    engine = build_pipeline([OpenAIPreprocessor(mdc, tok), Backend(tok)], EchoEngineCore())
+    manager = ModelManager()
+    manager.add_chat_model("tiny", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "the quick brown fox"}],
+                    "max_tokens": 64,
+                },
+            ) as r:
+                body = await r.json()
+        assert "the quick brown fox" in body["choices"][0]["message"]["content"]
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_model_watcher_hot_add_remove():
+    """Worker registers a model in discovery → frontend hot-adds it."""
+    hub = MemoryHub()
+    worker_drt = DistributedRuntime.in_process(hub)
+    front_drt = DistributedRuntime.in_process(hub)
+
+    # worker serving OpenAI-level requests
+    ep = worker_drt.namespace("prod").component("worker").endpoint("generate")
+
+    async def handler(payload, ctx):
+        from dynamo_tpu.runtime.engine import Context
+
+        async for chunk in EchoEngineFull().generate(Context(payload, ctx)):
+            yield chunk
+
+    serving = await ep.serve(handler)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(front_drt, manager, namespace="public")
+    await watcher.start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        await register_model(
+            worker_drt, "public", "remote-echo", "dyn://prod.worker.generate"
+        )
+        await asyncio.sleep(0.05)
+        assert "remote-echo" in manager.model_names()
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "remote-echo",
+                    "messages": [{"role": "user", "content": "routed hello"}],
+                },
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+        assert body["choices"][0]["message"]["content"].strip() == "routed hello"
+
+        await unregister_model(worker_drt, "public", "remote-echo")
+        await asyncio.sleep(0.05)
+        assert "remote-echo" not in manager.model_names()
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await serving.stop()

@@ -1,0 +1,185 @@
+"""LLM protocol layer: model card, preprocessor, detokenizer, echo pipeline."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, Decoder
+from dynamo_tpu.llm.engines.echo import EchoEngineCore
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import HFTokenizer
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    aggregate_chat_stream,
+)
+from dynamo_tpu.runtime.engine import Context, EngineError
+from dynamo_tpu.runtime.pipeline import build_pipeline
+
+from fixtures import make_model_dir
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return make_model_dir(tmp_path_factory.mktemp("model"))
+
+
+@pytest.fixture(scope="module")
+def mdc(model_dir):
+    return ModelDeploymentCard.from_local_path(model_dir, display_name="tiny-llama")
+
+
+@pytest.fixture(scope="module")
+def tokenizer(model_dir):
+    return HFTokenizer.from_pretrained_dir(model_dir)
+
+
+def test_mdc_from_local_path(mdc):
+    assert mdc.display_name == "tiny-llama"
+    assert mdc.slug == "tiny-llama"
+    assert mdc.context_length == 256
+    assert mdc.eos_token_ids and isinstance(mdc.eos_token_ids[0], int)
+    assert "<|assistant|>" in mdc.chat_template
+    assert mdc.checksum
+    # wire round-trip preserves checksum
+    assert ModelDeploymentCard.from_wire(mdc.to_wire()).checksum == mdc.checksum
+
+
+def test_preprocess_chat_applies_template(mdc, tokenizer):
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "hello world"}],
+        max_tokens=10,
+        temperature=0.5,
+        stop=["STOP"],
+    )
+    out = pre.preprocess_chat(req)
+    rendered = tokenizer.decode(out.token_ids, skip_special_tokens=False)
+    assert "<|user|>" in rendered and "<|assistant|>" in rendered
+    assert out.stop_conditions.max_tokens == 10
+    assert out.stop_conditions.stop == ["STOP"]
+    assert out.sampling_options.temperature == 0.5
+    assert out.eos_token_ids == mdc.eos_token_ids
+    assert out.mdc_checksum == mdc.checksum
+
+
+def test_preprocess_rejects_oversized_prompt(mdc, tokenizer):
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "word " * 400}]
+    )
+    with pytest.raises(EngineError, match="exceeds context"):
+        pre.preprocess_chat(req)
+
+
+def test_decode_stream_matches_batch(tokenizer):
+    text = "the quick brown fox jumps émojis ünïcode ✓ 中文"
+    ids = tokenizer.encode(text)
+    stream = tokenizer.decode_stream()
+    out = []
+    for tid in ids:
+        delta = stream.step(tid)
+        if delta:
+            out.append(delta)
+    assert "".join(out) == tokenizer.decode(ids)
+
+
+def test_decoder_stop_string_jail(tokenizer):
+    # "STOP" must never be surfaced, even partially, even if split over tokens
+    text = "paris STOP extra"
+    ids = tokenizer.encode(text)
+    dec = Decoder(tokenizer, stop_strings=["STOP"])
+    emitted = []
+    finish = None
+    for tid in ids:
+        t, f = dec.step(tid)
+        if t:
+            emitted.append(t)
+        if f:
+            finish = f
+            break
+    full = "".join(emitted)
+    assert finish == FinishReason.STOP
+    assert "STOP" not in full
+    assert "extra" not in full
+    assert full.startswith("paris")
+
+
+def test_decoder_partial_match_released(tokenizer):
+    # a prefix of the stop string that never completes must be emitted
+    dec = Decoder(tokenizer, stop_strings=["STOPXYZ"])
+    ids = tokenizer.encode("go STOP go")
+    emitted = []
+    finish = None
+    for tid in ids:
+        t, f = dec.step(tid)
+        if t:
+            emitted.append(t)
+        finish = f
+    emitted.append(dec.flush() or "")
+    assert finish is None
+    assert "".join(emitted) == tokenizer.decode(ids)
+
+
+def test_decoder_eos(tokenizer, mdc):
+    eos = mdc.eos_token_ids[0]
+    dec = Decoder(tokenizer, eos_token_ids=[eos])
+    t, f = dec.step(eos)
+    assert f == FinishReason.EOS and t is None
+    # with ignore_eos, generation continues
+    dec2 = Decoder(tokenizer, eos_token_ids=[eos], ignore_eos=True)
+    _, f2 = dec2.step(eos)
+    assert f2 is None
+
+
+def test_decoder_hidden_stop_ids(tokenizer):
+    dec = Decoder(tokenizer, hidden_stop_ids=[42])
+    _, f = dec.step(42)
+    assert f == FinishReason.STOP
+
+
+@pytest.mark.asyncio
+async def test_full_echo_pipeline(mdc, tokenizer):
+    """OpenAI request → preprocessor → backend → echo engine → chunks."""
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    backend = Backend(tokenizer)
+    engine = build_pipeline([pre, backend], EchoEngineCore())
+
+    req = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "hello world"}],
+        max_tokens=64,
+    )
+    chunks = []
+    async for chunk in engine.generate(Context(req)):
+        chunks.append(ChatCompletionChunk.model_validate(chunk.model_dump()))
+    assert chunks[0].choices[0].delta.role == "assistant"
+    final = aggregate_chat_stream(chunks)
+    # echo returns the templated prompt text
+    assert "hello world" in (final.choices[0].message.content or "")
+    assert final.choices[0].finish_reason in ("length", "stop")
+
+
+@pytest.mark.asyncio
+async def test_pipeline_respects_max_tokens(mdc, tokenizer):
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    backend = Backend(tokenizer)
+    engine = build_pipeline([pre, backend], EchoEngineCore())
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[{"role": "user", "content": "a b c d e f g h i j"}],
+        max_tokens=3,
+    )
+    total_tokens = 0
+    async for chunk in engine.generate(Context(req)):
+        pass  # just drain; count via usage below
+    req2 = ChatCompletionRequest(
+        model="m",
+        messages=[{"role": "user", "content": "a b c d e f g h i j"}],
+        max_tokens=3,
+        stream_options={"include_usage": True},
+    )
+    chunks = [c async for c in engine.generate(Context(req2))]
+    usage = [c for c in chunks if c.usage is not None]
+    assert usage and usage[-1].usage.completion_tokens == 3
