@@ -97,7 +97,8 @@ async def run_http(flags, engine, mdc) -> None:
     manager = ModelManager()
     name = flags.model_name or (mdc.display_name if mdc else "echo")
     manager.add_chat_model(name, engine)
-    manager.add_completion_model(name, engine)
+    if mdc is not None:  # pipeline engines dispatch chat AND completions
+        manager.add_completion_model(name, engine)
     service = HttpService(manager, flags.http_host, flags.http_port)
 
     watcher = None
@@ -159,15 +160,17 @@ async def run_endpoint(flags, engine, mdc, path: str) -> None:
     endpoint = drt.namespace(ns_name).component(comp).endpoint(ep_name)
 
     async def handler(payload, ctx):
-        from ..protocols.openai import ChatCompletionRequest
+        from ..protocols.openai import ChatCompletionRequest, CompletionRequest
 
-        req = ChatCompletionRequest.model_validate(payload)
+        cls = ChatCompletionRequest if "messages" in payload else CompletionRequest
+        req = cls.model_validate(payload)
         async for chunk in engine.generate(Context(req, ctx)):
             yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
 
     serving = await endpoint.serve(handler)
     name = flags.model_name or (mdc.display_name if mdc else "echo")
-    await register_model(drt, flags.namespace, name, path, model_type="both")
+    model_type = "both" if mdc is not None else "chat"
+    await register_model(drt, flags.namespace, name, path, model_type=model_type)
     print(f"worker serving {path} (model={name})", flush=True)
     try:
         await asyncio.Event().wait()

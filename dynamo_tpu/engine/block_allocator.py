@@ -1,0 +1,164 @@
+"""Paged KV block allocator with prefix-cache reuse and KV event hooks.
+
+Semantics follow the reference's block-manager design (SURVEY.md §2.2,
+reference: lib/llm/src/kv/{manager,reuse}.rs — match-then-allocate with a
+reuse pool of refcount-0 hashed blocks, LRU eviction) re-designed around
+the engine's flat block-id space:
+
+- ``allocate_prompt`` first matches the prompt's chained block hashes
+  against cached blocks (prefix-cache hit → those tokens skip prefill),
+  then takes free blocks, then evicts LRU reusable blocks.
+- Completed blocks (prompt or generated) are registered by sequence hash
+  and announced via the ``events`` callback — the same stream the KV-aware
+  router indexes (kv_router/publisher.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..tokens import compute_block_hashes
+
+
+@dataclasses.dataclass
+class KvEventSink:
+    """Engine-side KV event hooks (no-op by default)."""
+
+    on_stored: Callable[[List[int], Optional[int]], None] = lambda hashes, parent: None
+    on_removed: Callable[[List[int]], None] = lambda hashes: None
+
+
+class BlockAllocator:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+        events: Optional[KvEventSink] = None,
+    ):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.events = events or KvEventSink()
+        self.free: List[int] = list(range(num_blocks - 1, -1, -1))  # pop() → block 0 first
+        # sequence_hash → block id (cached, complete blocks)
+        self.by_hash: Dict[int, int] = {}
+        self.block_hash: Dict[int, int] = {}   # block id → sequence hash
+        self.refcount: Dict[int, int] = {}
+        # refcount-0 cached blocks, LRU order (oldest first) — evictable
+        self.reusable: "OrderedDict[int, None]" = OrderedDict()
+
+    # ---------- accounting ----------
+
+    @property
+    def available(self) -> int:
+        return len(self.free) + len(self.reusable)
+
+    @property
+    def used(self) -> int:
+        return self.num_blocks - self.available
+
+    # ---------- core ops ----------
+
+    def _take_block(self) -> int:
+        if self.free:
+            return self.free.pop()
+        if self.reusable:
+            bid, _ = self.reusable.popitem(last=False)  # LRU
+            h = self.block_hash.pop(bid, None)
+            if h is not None:
+                self.by_hash.pop(h, None)
+                self.events.on_removed([h])
+            return bid
+        raise MemoryError("KV cache exhausted")
+
+    def match_prefix(self, token_ids: List[int]) -> Tuple[List[int], List[int]]:
+        """Longest cached prefix of complete blocks.
+        Returns (block_ids, their sequence hashes)."""
+        if not self.enable_prefix_caching:
+            return [], []
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        blocks: List[int] = []
+        matched: List[int] = []
+        for h in hashes:
+            bid = self.by_hash.get(h)
+            if bid is None:
+                break
+            blocks.append(bid)
+            matched.append(h)
+        return blocks, matched
+
+    def allocate_prompt(self, token_ids: List[int]) -> Tuple[List[int], int]:
+        """Allocate blocks for a prompt; reuse cached prefix blocks.
+
+        Returns (block_ids covering ceil(len/bs) blocks, num_cached_tokens).
+        Raises MemoryError if the demand cannot be met (caller queues).
+        """
+        n_needed = max(1, -(-len(token_ids) // self.block_size))
+        cached_blocks, _ = self.match_prefix(token_ids)
+        # a full-prompt hit still needs the last block re-filled only if the
+        # prompt ends mid-block; always recompute at least one token so the
+        # engine has logits to sample from
+        if len(cached_blocks) * self.block_size >= len(token_ids):
+            cached_blocks = cached_blocks[:-1]
+        n_new = n_needed - len(cached_blocks)
+        if n_new > self.available:
+            raise MemoryError(
+                f"need {n_new} blocks, {self.available} available"
+            )
+        for bid in cached_blocks:
+            self._ref(bid)
+        new_blocks = [self._take_block() for _ in range(n_new)]
+        for bid in new_blocks:
+            self.refcount[bid] = self.refcount.get(bid, 0) + 1
+        return cached_blocks + new_blocks, len(cached_blocks) * self.block_size
+
+    def allocate_block(self) -> int:
+        """One more block for a growing (decoding) sequence."""
+        bid = self._take_block()
+        self.refcount[bid] = self.refcount.get(bid, 0) + 1
+        return bid
+
+    def _ref(self, bid: int) -> None:
+        self.refcount[bid] = self.refcount.get(bid, 0) + 1
+        self.reusable.pop(bid, None)  # no longer evictable
+
+    def register_complete(
+        self, bid: int, sequence_hash: int, parent_hash: Optional[int]
+    ) -> None:
+        """A block is now full with known content — make it matchable."""
+        if not self.enable_prefix_caching:
+            return
+        existing = self.by_hash.get(sequence_hash)
+        if existing is not None and existing != bid:
+            return  # identical content already cached under another block
+        self.by_hash[sequence_hash] = bid
+        self.block_hash[bid] = sequence_hash
+        self.events.on_stored([sequence_hash], parent_hash)
+
+    def free_blocks(self, block_ids: List[int]) -> None:
+        """Release a sequence's references. Hashed blocks become reusable
+        (still matchable until evicted); anonymous blocks go to the free list."""
+        removed_hashes: List[int] = []
+        for bid in block_ids:
+            rc = self.refcount.get(bid, 0) - 1
+            if rc > 0:
+                self.refcount[bid] = rc
+                continue
+            self.refcount.pop(bid, None)
+            if bid in self.block_hash and self.enable_prefix_caching:
+                self.reusable[bid] = None
+                self.reusable.move_to_end(bid)
+            else:
+                h = self.block_hash.pop(bid, None)
+                if h is not None:
+                    self.by_hash.pop(h, None)
+                    removed_hashes.append(h)
+                self.free.append(bid)
+        if removed_hashes:
+            self.events.on_removed(removed_hashes)
+
+    def usage(self) -> float:
+        return self.used / self.num_blocks if self.num_blocks else 0.0

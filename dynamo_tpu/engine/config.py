@@ -1,0 +1,114 @@
+"""Engine configuration: model architecture + serving shapes + mesh layout.
+
+Everything that determines compiled-program shapes lives here, because under
+jit every distinct shape is a recompile: decode batch is fixed at
+``max_batch_size`` (inactive slots masked), prefill lengths are bucketed,
+block tables are fixed-width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Llama-family architecture description (HF config.json compatible)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    # MoE (Mixtral-class); num_experts == 0 means dense
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # MLA (DeepSeek-class); kv_lora_rank > 0 enables MLA attention
+    kv_lora_rank: int = 0
+    q_lora_rank: int = 0
+    qk_rope_head_dim: int = 0
+    qk_nope_head_dim: int = 0
+    v_head_dim: int = 0
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, config: dict) -> "ModelConfig":
+        return cls(
+            vocab_size=config.get("vocab_size", 32000),
+            hidden_size=config.get("hidden_size", 2048),
+            intermediate_size=config.get("intermediate_size", 5632),
+            num_layers=config.get("num_hidden_layers", 16),
+            num_heads=config.get("num_attention_heads", 16),
+            num_kv_heads=config.get(
+                "num_key_value_heads", config.get("num_attention_heads", 16)
+            ),
+            head_dim=config.get("head_dim"),
+            rope_theta=config.get("rope_theta", 10000.0),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            tie_word_embeddings=config.get("tie_word_embeddings", False),
+            num_experts=config.get("num_local_experts", 0) or 0,
+            num_experts_per_tok=config.get("num_experts_per_tok", 2),
+        )
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "ModelConfig":
+        with open(os.path.join(model_dir, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+
+def default_prefill_buckets(max_len: int) -> List[int]:
+    """Powers of two up to max_len — each bucket is one compiled program."""
+    buckets = []
+    b = 64
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: ModelConfig
+    max_batch_size: int = 8          # concurrent decode slots
+    max_model_len: int = 4096        # max tokens per sequence (prompt+gen)
+    kv_block_size: int = 16
+    num_kv_blocks: int = 2048        # HBM budget for the paged cache
+    prefill_buckets: Optional[List[int]] = None
+    dtype: str = "bfloat16"
+    # mesh axes: data-parallel replicas x tensor-parallel shards
+    dp_size: int = 1
+    tp_size: int = 1
+    seed: int = 0
+    # scheduler knobs
+    max_prefill_tokens_per_step: int = 8192
+    enable_prefix_caching: bool = True
+
+    def __post_init__(self):
+        if self.prefill_buckets is None:
+            self.prefill_buckets = default_prefill_buckets(self.max_model_len)
+        self.prefill_buckets = sorted(self.prefill_buckets)
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return math.ceil(self.max_model_len / self.kv_block_size)
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(f"prompt length {length} exceeds max bucket {self.prefill_buckets[-1]}")

@@ -1,0 +1,191 @@
+"""Jitted prefill/decode step programs + mesh sharding.
+
+Two compiled programs per prefill bucket plus one decode program, all with
+static shapes (SURVEY.md §7 hard-part #1: dynamic batch membership without
+recompiles). The KV cache is donated through every call so XLA updates it
+in place in HBM.
+
+Sharding (TPU-first): mesh axes ("dp", "tp"). Attention heads, KV heads,
+MLP intermediate, and the vocab dim of lm_head shard over "tp" (Megatron
+layout — XLA inserts the all-reduces after wo / w_down); the batch dim of
+activations shards over "dp". Single-device collapses to a trivial mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from .config import EngineConfig
+from .sampling import SamplingParams, logprobs_for, sample
+
+logger = logging.getLogger(__name__)
+
+
+def build_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devices)}")
+    arr = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))
+
+
+def param_specs(params) -> Dict:
+    """PartitionSpecs mirroring the param pytree (Megatron TP layout)."""
+    layer_specs = {
+        "ln1": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "ln2": P(),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    specs = {
+        "embed": P(),
+        "layers": {k: layer_specs[k] for k in params["layers"]},
+        "final_norm": P(),
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+CACHE_SPEC = P(None, None, None, "tp", None)  # [L, N, bs, KVH, D] — KV heads over tp
+
+
+class ModelRunner:
+    """Owns params + cache on device and the compiled step programs."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        params=None,
+        mesh: Optional[Mesh] = None,
+        model_dir: Optional[str] = None,
+    ):
+        self.config = config
+        cfg = config.model
+        self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self.mesh = mesh or build_mesh(config.dp_size, config.tp_size)
+
+        if cfg.num_kv_heads % config.tp_size != 0:
+            raise ValueError(
+                f"num_kv_heads {cfg.num_kv_heads} not divisible by tp {config.tp_size}"
+            )
+
+        if params is None:
+            if model_dir is not None:
+                from ..models.loader import has_checkpoint, load_llama_params
+
+                if has_checkpoint(model_dir):
+                    params = load_llama_params(model_dir, cfg, self.dtype)
+                else:
+                    logger.warning("no checkpoint in %s — random init", model_dir)
+            if params is None:
+                params = llama.init_params(cfg, jax.random.PRNGKey(config.seed), self.dtype)
+
+        pspecs = param_specs(params)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, pspecs
+        )
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), pspecs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+        cache = llama.init_kv_cache(
+            cfg, config.num_kv_blocks, config.kv_block_size, self.dtype
+        )
+        self.cache_sharding = NamedSharding(self.mesh, CACHE_SPEC)
+        self.kv_cache = tuple(jax.device_put(c, self.cache_sharding) for c in cache)
+
+        self._step_compiled = {}
+        self._build_step()
+
+    # ---------- the unified step program ----------
+
+    def _build_step(self):
+        cfg = self.config.model
+        mesh = self.mesh
+        batch_spec = NamedSharding(mesh, P("dp"))
+        batch2_spec = NamedSharding(mesh, P("dp", None))
+        repl = NamedSharding(mesh, P())
+
+        def step(params, k_cache, v_cache, tokens, positions, block_tables,
+                 slot_mapping, context_lens, last_idx, temperature, top_k, top_p, key):
+            logits, (k_cache, v_cache) = llama.forward(
+                params, cfg, tokens, positions, (k_cache, v_cache),
+                block_tables, slot_mapping, context_lens,
+            )
+            b = tokens.shape[0]
+            last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
+            samp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p)
+            next_tokens = sample(last_logits, samp, key)
+            lps = logprobs_for(last_logits, next_tokens)
+            return next_tokens, lps, k_cache, v_cache
+
+        self._step = jax.jit(
+            step,
+            donate_argnums=(1, 2),
+            in_shardings=(
+                self.param_shardings,        # params
+                self.cache_sharding,         # k
+                self.cache_sharding,         # v
+                batch2_spec,                 # tokens [B, S]
+                batch2_spec,                 # positions
+                batch2_spec,                 # block_tables
+                batch2_spec,                 # slot_mapping
+                batch_spec,                  # context_lens
+                batch_spec,                  # last_idx
+                batch_spec, batch_spec, batch_spec,  # sampling params
+                repl,                        # key
+            ),
+            out_shardings=(batch_spec, batch_spec, self.cache_sharding, self.cache_sharding),
+        )
+
+    def step(
+        self,
+        tokens: np.ndarray,        # [B, S]
+        positions: np.ndarray,     # [B, S]
+        block_tables: np.ndarray,  # [B, W]
+        slot_mapping: np.ndarray,  # [B, S]
+        context_lens: np.ndarray,  # [B]
+        last_idx: np.ndarray,      # [B] index of the position to sample from
+        temperature: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        key: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Run one compiled step; returns (next_tokens, logprobs) device arrays."""
+        next_tokens, lps, k, v = self._step(
+            self.params, self.kv_cache[0], self.kv_cache[1],
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32), jnp.asarray(slot_mapping, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
+            jnp.asarray(temperature, jnp.float32), jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32), key,
+        )
+        self.kv_cache = (k, v)
+        return next_tokens, lps
+
+    def warmup(self, decode_batch: Optional[int] = None) -> None:
+        """Compile the decode-shape program up front."""
+        b = decode_batch or self.config.max_batch_size
+        w = self.config.blocks_per_seq
+        zeros2 = np.zeros((b, 1), np.int32)
+        self.step(
+            zeros2, zeros2, np.zeros((b, w), np.int32), np.full((b, 1), -1, np.int32),
+            np.ones(b, np.int32), np.zeros(b, np.int32),
+            np.zeros(b, np.float32), np.zeros(b, np.int32), np.ones(b, np.float32),
+            jax.random.PRNGKey(0),
+        )

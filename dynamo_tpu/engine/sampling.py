@@ -1,0 +1,89 @@
+"""Batched in-jit sampling: greedy / temperature / top-k / top-p per slot.
+
+All parameters are per-request arrays so one compiled program serves every
+sampling configuration in the batch (no recompiles when requests differ).
+temperature == 0 means greedy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..protocols.common import SamplingOptions
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-slot device arrays; batch dimension leads."""
+
+    temperature: jax.Array  # [B] f32; 0 → greedy
+    top_k: jax.Array        # [B] i32; 0 → disabled
+    top_p: jax.Array        # [B] f32; 1.0 → disabled
+
+    @classmethod
+    def zeros(cls, batch: int) -> "SamplingParams":
+        return cls(
+            temperature=jnp.zeros(batch, jnp.float32),
+            top_k=jnp.zeros(batch, jnp.int32),
+            top_p=jnp.ones(batch, jnp.float32),
+        )
+
+
+def host_row(opts: SamplingOptions):
+    """One request's SamplingOptions → (temperature, top_k, top_p) scalars."""
+    temp = opts.temperature if opts.temperature is not None else 1.0
+    return (
+        float(temp),
+        int(opts.top_k) if opts.top_k and opts.top_k > 0 else 0,
+        float(opts.top_p) if opts.top_p is not None else 1.0,
+    )
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    params: SamplingParams,
+    key: jax.Array,
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature scaling (guard against 0 for the sampled branch)
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest (k=0 → no-op)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+    k_idx = jnp.clip(params.top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=1)
+    topk_mask = (params.top_k[:, None] > 0) & (scaled < kth)
+    scaled = jnp.where(topk_mask, -jnp.inf, scaled)
+
+    # top-p (nucleus): mask the tail whose cumulative prob exceeds p
+    sort_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_scaled = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < params.top_p[:, None]  # always keep the top token
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(b)[:, None], sort_idx
+    ].set(keep_sorted)
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def logprobs_for(
+    logits: jax.Array,   # [B, V]
+    token_ids: jax.Array,  # [B]
+) -> jax.Array:
+    """Log-probability of the chosen tokens (for OutputOptions.logprobs)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]

@@ -1,0 +1,374 @@
+"""Continuous-batching scheduler: the engine's beating heart.
+
+An asyncio loop interleaving bucketed prefills with batched decode steps
+over a fixed set of slots (static shapes → no recompiles as membership
+changes). Per-request state tracks paged blocks, chained block hashes (for
+prefix cache + KV events), and cooperative cancellation.
+
+The reference outsourced all of this to vLLM/SGLang (SURVEY.md §7
+"the JAX serving engine itself" is hard-part #1) — this is the native
+replacement: admission → prefill (prefix-cache aware) → decode loop →
+finish/free, with ForwardPassMetrics-style telemetry for the KV router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..protocols.common import (
+    EngineOutput,
+    FinishReason,
+    PreprocessedRequest,
+    TokenLogprob,
+)
+from ..runtime.engine import AsyncEngineContext
+from ..tokens import TokenSequence
+from .block_allocator import BlockAllocator, KvEventSink
+from .config import EngineConfig
+from .model_runner import ModelRunner
+from .sampling import host_row
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    request_id: str
+    prompt: List[int]
+    req: PreprocessedRequest
+    ctx: AsyncEngineContext
+    out_queue: asyncio.Queue
+    # sampling scalars
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    want_logprobs: bool = False
+    # runtime state
+    slot: int = -1
+    block_ids: List[int] = dataclasses.field(default_factory=list)
+    num_cached: int = 0
+    context_len: int = 0          # tokens whose KV is (being) written
+    pending_token: int = -1       # sampled but KV not yet written
+    generated: int = 0
+    seq: Optional[TokenSequence] = None
+    registered_blocks: int = 0
+    finish: Optional[FinishReason] = None
+
+    @property
+    def max_new(self) -> int:
+        return self.req.stop_conditions.max_tokens or 16384
+
+    @property
+    def min_new(self) -> int:
+        return self.req.stop_conditions.min_tokens or 0
+
+
+class Scheduler:
+    def __init__(
+        self,
+        runner: ModelRunner,
+        config: EngineConfig,
+        events: Optional[KvEventSink] = None,
+    ):
+        self.runner = runner
+        self.config = config
+        self.allocator = BlockAllocator(
+            config.num_kv_blocks, config.kv_block_size,
+            config.enable_prefix_caching, events,
+        )
+        self.waiting: deque = deque()
+        self.slots: List[Optional[EngineRequest]] = [None] * config.max_batch_size
+        self.wake = asyncio.Event()
+        self.key = jax.random.PRNGKey(config.seed)
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        # telemetry (ForwardPassMetrics analog, SURVEY.md §2.2 KV metrics)
+        self.prefix_hit_tokens = 0
+        self.prefix_total_tokens = 0
+        self.steps = 0
+
+    # ---------- public API ----------
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self.wake.set()
+        if self._task:
+            await self._task
+
+    def add_request(self, er: EngineRequest) -> None:
+        (er.temperature, er.top_k, er.top_p) = host_row(er.req.sampling_options)
+        if er.req.sampling_options.seed is not None:
+            # fold per-request seed into the stream for reproducibility
+            er_seed = int(er.req.sampling_options.seed)
+            self.key = jax.random.fold_in(self.key, er_seed)
+        er.want_logprobs = bool(er.req.output_options.logprobs)
+        self.waiting.append(er)
+        self.wake.set()
+
+    def metrics(self) -> dict:
+        active = sum(1 for s in self.slots if s is not None)
+        return {
+            "request_active_slots": active,
+            "request_total_slots": self.config.max_batch_size,
+            "kv_active_blocks": self.allocator.used,
+            "kv_total_blocks": self.allocator.num_blocks,
+            "num_requests_waiting": len(self.waiting),
+            "gpu_cache_usage_perc": self.allocator.usage(),
+            "gpu_prefix_cache_hit_rate": (
+                self.prefix_hit_tokens / self.prefix_total_tokens
+                if self.prefix_total_tokens else 0.0
+            ),
+        }
+
+    # ---------- helpers ----------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _emit(self, er: EngineRequest, token: int, logprob: Optional[float]) -> None:
+        out = EngineOutput(
+            token_ids=[token],
+            finish_reason=er.finish,
+            logprobs=[TokenLogprob(token, logprob)] if logprob is not None else None,
+        )
+        er.out_queue.put_nowait(out)
+
+    def _finish(self, er: EngineRequest, reason: FinishReason, emit: bool = True) -> None:
+        er.finish = reason
+        if emit:
+            er.out_queue.put_nowait(EngineOutput(token_ids=[], finish_reason=reason))
+        er.out_queue.put_nowait(None)  # stream end sentinel
+        if er.slot >= 0:
+            self.slots[er.slot] = None
+        self.allocator.free_blocks(er.block_ids)
+        er.block_ids = []
+
+    def _ensure_block_for(self, er: EngineRequest, position: int) -> bool:
+        """Make sure a block exists covering ``position``."""
+        bs = self.config.kv_block_size
+        needed = position // bs + 1
+        while len(er.block_ids) < needed:
+            try:
+                er.block_ids.append(self.allocator.allocate_block())
+            except MemoryError:
+                return False
+        return True
+
+    def _register_completed_blocks(self, er: EngineRequest) -> None:
+        """Hash-register blocks whose KV is complete (matchable + KV events).
+
+        ``er.seq`` mirrors exactly the tokens whose KV sits in cache, so its
+        frozen blocks line up 1:1 with ``er.block_ids``."""
+        n_complete = min(er.context_len // self.config.kv_block_size, len(er.seq.blocks))
+        for i in range(er.registered_blocks, n_complete):
+            blk = er.seq.blocks[i]
+            self.allocator.register_complete(
+                er.block_ids[i], blk.sequence_hash, blk.parent_sequence_hash
+            )
+        er.registered_blocks = max(er.registered_blocks, n_complete)
+
+    # ---------- the loop ----------
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopping:
+            progressed = False
+
+            # drop cancelled requests (client disconnects / kills)
+            for er in list(self.waiting):
+                if er.ctx.is_stopped:
+                    self.waiting.remove(er)
+                    self._finish(er, FinishReason.CANCELLED)
+            for er in [s for s in self.slots if s is not None]:
+                if er.ctx.is_stopped:
+                    self._finish(er, FinishReason.CANCELLED)
+
+            # admission: prefill while there's a free slot and memory
+            while self.waiting and self._free_slot() is not None:
+                er = self.waiting[0]
+                try:
+                    ok = await self._prefill(loop, er)
+                except MemoryError:
+                    break  # no memory — wait for a sequence to finish
+                if not ok:
+                    break
+                self.waiting.popleft()
+                progressed = True
+
+            # decode one token for every active slot
+            active = [s for s in self.slots if s is not None]
+            if active:
+                await self._decode(loop, active)
+                progressed = True
+
+            if not progressed:
+                self.wake.clear()
+                if not self.waiting and not any(self.slots):
+                    await self.wake.wait()
+                else:
+                    await asyncio.sleep(0.001)
+            else:
+                await asyncio.sleep(0)  # let I/O run between steps
+
+    async def _prefill(self, loop, er: EngineRequest) -> bool:
+        cfg = self.config
+        slot = self._free_slot()
+        if slot is None:
+            return False
+
+        er.block_ids, er.num_cached = self.allocator.allocate_prompt(er.prompt)
+        self.prefix_hit_tokens += er.num_cached
+        self.prefix_total_tokens += len(er.prompt)
+
+        suffix = er.prompt[er.num_cached:]
+        bucket = cfg.bucket_for(len(suffix))
+        w = cfg.blocks_per_seq
+        bs = cfg.kv_block_size
+
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        positions = np.full((1, bucket), er.num_cached + len(suffix) - 1, np.int32)
+        positions[0, : len(suffix)] = np.arange(er.num_cached, len(er.prompt))
+        slot_map = np.full((1, bucket), -1, np.int32)
+        for i, pos in enumerate(range(er.num_cached, len(er.prompt))):
+            slot_map[0, i] = er.block_ids[pos // bs] * bs + pos % bs
+        btab = np.zeros((1, w), np.int32)
+        btab[0, : len(er.block_ids)] = er.block_ids
+        ctx_lens = np.asarray([len(er.prompt)], np.int32)
+        last_idx = np.asarray([len(suffix) - 1], np.int32)
+
+        self.key, step_key = jax.random.split(self.key)
+        t0 = time.monotonic()
+        next_tokens, lps = self.runner.step(
+            tokens, positions, btab, slot_map, ctx_lens, last_idx,
+            np.asarray([er.temperature], np.float32),
+            np.asarray([er.top_k], np.int32),
+            np.asarray([er.top_p], np.float32),
+            step_key,
+        )
+        token, lp = await loop.run_in_executor(
+            None, lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]))
+        )
+        self.steps += 1
+        logger.debug("prefill %s len=%d bucket=%d %.1fms", er.request_id,
+                     len(suffix), bucket, 1e3 * (time.monotonic() - t0))
+
+        er.slot = slot
+        self.slots[slot] = er
+        er.context_len = len(er.prompt)
+        er.pending_token = token
+        er.generated = 1
+        er.seq = TokenSequence(er.prompt, block_size=bs)
+        self._register_completed_blocks(er)
+
+        er.finish = self._check_finish(er, token)
+        self._emit(er, token, lp if er.want_logprobs else None)
+        if er.finish is not None:
+            self._finish(er, er.finish, emit=False)
+        return True
+
+    async def _decode(self, loop, active: List[EngineRequest]) -> None:
+        cfg = self.config
+        b = cfg.max_batch_size
+        w = cfg.blocks_per_seq
+        bs = cfg.kv_block_size
+
+        # make sure each active sequence has a block for its next position
+        for er in list(active):
+            if not self._ensure_block_for(er, er.context_len):
+                # out of memory: evict the youngest request back to waiting
+                # (simple preemption — recompute later)
+                logger.warning("KV OOM: preempting %s", er.request_id)
+                self._preempt(er)
+                active.remove(er)
+        if not active:
+            return
+
+        tokens = np.zeros((b, 1), np.int32)
+        positions = np.zeros((b, 1), np.int32)
+        slot_map = np.full((b, 1), -1, np.int32)
+        btab = np.zeros((b, w), np.int32)
+        ctx_lens = np.ones(b, np.int32)
+        last_idx = np.zeros(b, np.int32)
+        temp = np.zeros(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+
+        for er in active:
+            i = er.slot
+            pos = er.context_len
+            tokens[i, 0] = er.pending_token
+            positions[i, 0] = pos
+            slot_map[i, 0] = er.block_ids[pos // bs] * bs + pos % bs
+            btab[i, : len(er.block_ids)] = er.block_ids
+            ctx_lens[i] = pos + 1
+            temp[i], top_k[i], top_p[i] = er.temperature, er.top_k, er.top_p
+
+        self.key, step_key = jax.random.split(self.key)
+        next_tokens, lps = self.runner.step(
+            tokens, positions, btab, slot_map, ctx_lens, last_idx,
+            temp, top_k, top_p, step_key,
+        )
+        toks, lpn = await loop.run_in_executor(
+            None, lambda: (np.asarray(next_tokens), np.asarray(lps))
+        )
+        self.steps += 1
+
+        for er in active:
+            if er.finish is not None:
+                continue
+            token = int(toks[er.slot])
+            # the pending token's KV is now written
+            er.seq.push(er.pending_token)
+            er.context_len += 1
+            self._register_completed_blocks(er)
+            er.pending_token = token
+            er.generated += 1
+            er.finish = self._check_finish(er, token)
+            self._emit(er, token, float(lpn[er.slot]) if er.want_logprobs else None)
+            if er.finish is not None:
+                self._finish(er, er.finish, emit=False)
+
+    def _preempt(self, er: EngineRequest) -> None:
+        """Return a request to the waiting queue, releasing its blocks."""
+        if er.slot >= 0:
+            self.slots[er.slot] = None
+            er.slot = -1
+        self.allocator.free_blocks(er.block_ids)
+        er.block_ids = []
+        er.context_len = 0
+        er.num_cached = 0
+        er.generated = 0
+        er.pending_token = -1
+        er.seq = None
+        er.registered_blocks = 0
+        self.waiting.appendleft(er)
+
+    def _check_finish(self, er: EngineRequest, token: int) -> Optional[FinishReason]:
+        sc = er.req.stop_conditions
+        if er.generated < er.min_new:
+            pass  # eos/stops suppressed below min_tokens
+        else:
+            if not sc.ignore_eos and token in (er.req.eos_token_ids or []):
+                return FinishReason.EOS
+            if sc.stop_token_ids_hidden and token in sc.stop_token_ids_hidden:
+                return FinishReason.STOP
+        if er.generated >= er.max_new:
+            return FinishReason.LENGTH
+        if er.context_len + 1 >= self.config.max_model_len:
+            return FinishReason.LENGTH
+        return None

@@ -1,0 +1,102 @@
+"""JaxServingEngine: the AsyncEngine facade over runner + scheduler.
+
+The token-level engine that slots into the pipeline where the reference
+plugged vLLM/SGLang (reference: lib/llm/src/engines.rs ExecutionContext —
+PreprocessedRequest in, streamed EngineOutput deltas out).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, AsyncIterator, Optional
+
+from ..protocols.common import EngineOutput, PreprocessedRequest
+from ..runtime.engine import AsyncEngine, Context, EngineError
+from .block_allocator import KvEventSink
+from .config import EngineConfig, ModelConfig
+from .model_runner import ModelRunner
+from .scheduler import EngineRequest, Scheduler
+
+logger = logging.getLogger(__name__)
+
+
+class JaxServingEngine(AsyncEngine):
+    def __init__(self, runner: ModelRunner, scheduler: Scheduler, config: EngineConfig):
+        self.runner = runner
+        self.scheduler = scheduler
+        self.config = config
+
+    @classmethod
+    async def create(
+        cls,
+        mdc,
+        flags=None,
+        engine_config: Optional[EngineConfig] = None,
+        params=None,
+        events: Optional[KvEventSink] = None,
+        mesh=None,
+        warmup: bool = True,
+    ) -> "JaxServingEngine":
+        """Build from a ModelDeploymentCard (+CLI flags or explicit config)."""
+        if engine_config is None:
+            model_cfg = ModelConfig.from_hf_config(mdc.config) if mdc.config else ModelConfig()
+            engine_config = EngineConfig(
+                model=model_cfg,
+                max_batch_size=getattr(flags, "max_batch_size", 8),
+                max_model_len=getattr(flags, "max_model_len", None)
+                or min(mdc.context_length, model_cfg.max_position_embeddings),
+                kv_block_size=mdc.kv_block_size,
+                tp_size=getattr(flags, "tensor_parallel_size", 1),
+            )
+        loop = asyncio.get_running_loop()
+        runner = await loop.run_in_executor(
+            None,
+            lambda: ModelRunner(engine_config, params=params, mesh=mesh,
+                                model_dir=mdc.model_path),
+        )
+        scheduler = Scheduler(runner, engine_config, events)
+        engine = cls(runner, scheduler, engine_config)
+        if warmup:
+            await loop.run_in_executor(None, runner.warmup)
+        scheduler.start()
+        return engine
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[dict]:
+        payload = request.payload
+        req = (
+            payload
+            if isinstance(payload, PreprocessedRequest)
+            else PreprocessedRequest.from_wire(payload)
+        )
+        if not req.token_ids:
+            raise EngineError("empty prompt")
+        if len(req.token_ids) >= self.config.max_model_len:
+            raise EngineError(
+                f"prompt length {len(req.token_ids)} exceeds engine max_model_len "
+                f"{self.config.max_model_len}"
+            )
+        er = EngineRequest(
+            request_id=request.id or uuid.uuid4().hex,
+            prompt=list(req.token_ids),
+            req=req,
+            ctx=request.context,
+            out_queue=asyncio.Queue(),
+        )
+        self.scheduler.add_request(er)
+        try:
+            while True:
+                out = await er.out_queue.get()
+                if out is None:
+                    return
+                yield out.to_wire()
+        finally:
+            # consumer went away (stop/kill/break) — scheduler will reap it
+            request.context.stop_generating()
+
+    def metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    async def close(self) -> None:
+        await self.scheduler.stop()

@@ -108,13 +108,69 @@ class HttpService:
             status=status,
         )
 
+    async def _handle_inference(
+        self, request: web.Request, request_cls, engines: Dict[str, AsyncEngine],
+        chunk_cls, aggregate,
+    ) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            api_req = request_cls.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._error(400, f"invalid request: {e}")
+
+        engine = engines.get(api_req.model)
+        if engine is None:
+            return self._error(404, f"model '{api_req.model}' not found", "model_not_found")
+
+        timer = self.metrics.track(api_req.model)
+        status = "error"
+        ctx = Context(api_req)
+        try:
+            stream = engine.generate(ctx).__aiter__()
+            # prime the first chunk BEFORE committing a status line so
+            # request-validation errors (raised on first iteration of the
+            # pipeline generator) still map to proper HTTP codes
+            try:
+                first = await stream.__anext__()
+            except StopAsyncIteration:
+                first = None
+            if api_req.stream:
+                resp, status = await self._stream_sse(request, ctx, first, stream, timer)
+                return resp
+            chunks = []
+            if first is not None:
+                chunks.append(chunk_cls.model_validate(_as_dict(first)))
+            async for chunk in stream:
+                if _has_payload(_as_dict(chunk)):
+                    timer.first_token()
+                chunks.append(chunk_cls.model_validate(_as_dict(chunk)))
+            status = "success"
+            return web.json_response(aggregate(chunks).model_dump(exclude_none=True))
+        except (EngineError, ValueError) as e:
+            return self._error(400, str(e))
+        except NoInstancesError as e:
+            return self._error(503, str(e), "service_unavailable")
+        except (ResponseStreamError, asyncio.TimeoutError) as e:
+            return self._error(502, str(e), "engine_error")
+        except _StreamDisconnect:
+            status = "disconnect"
+            raise ConnectionResetError("client disconnected")
+        except asyncio.CancelledError:
+            ctx.context.stop_generating()
+            status = "disconnect"
+            raise
+        finally:
+            ctx.context.stop_generating()
+            timer.finish(status)
+
     async def _stream_sse(
         self,
         request: web.Request,
         ctx: Context,
+        first: Any,
         chunks: AsyncIterator[Any],
         timer,
-    ) -> web.StreamResponse:
+    ):
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -124,95 +180,43 @@ class HttpService:
         )
         await resp.prepare(request)
         try:
+            if first is not None:
+                d = _as_dict(first)
+                if _has_payload(d):
+                    timer.first_token()
+                await resp.write(sse.encode_event(d))
             async for chunk in chunks:
-                timer.first_token()
-                await resp.write(sse.encode_event(_as_dict(chunk)))
+                d = _as_dict(chunk)
+                if _has_payload(d):
+                    timer.first_token()
+                await resp.write(sse.encode_event(d))
             await resp.write(sse.encode_done())
-            timer.finish("success")
+            await resp.write_eof()
+            return resp, "success"
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away — stop generation upstream
             ctx.context.stop_generating()
-            timer.finish("disconnect")
-            raise
+            raise _StreamDisconnect()
         except (EngineError, ResponseStreamError, NoInstancesError) as e:
             # mid-stream failure: emit an error event, then end the stream
             await resp.write(sse.encode_event({"error": {"message": str(e)}}))
             await resp.write(sse.encode_done())
-            timer.finish("error")
-        await resp.write_eof()
-        return resp
+            await resp.write_eof()
+            return resp, "error"
 
     # ---------- routes ----------
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = await request.json()
-            chat_req = ChatCompletionRequest.model_validate(body)
-        except (json.JSONDecodeError, ValueError) as e:
-            return self._error(400, f"invalid request: {e}")
-
-        engine = self.manager.chat_engines.get(chat_req.model)
-        if engine is None:
-            return self._error(404, f"model '{chat_req.model}' not found", "model_not_found")
-
-        timer = self.metrics.track(chat_req.model)
-        ctx = Context(chat_req)
-        try:
-            stream = engine.generate(ctx)
-            if chat_req.stream:
-                return await self._stream_sse(request, ctx, stream, timer)
-            chunks = []
-            async for chunk in stream:
-                timer.first_token()
-                chunks.append(ChatCompletionChunk.model_validate(_as_dict(chunk)))
-            timer.finish("success")
-            return web.json_response(
-                aggregate_chat_stream(chunks).model_dump(exclude_none=True)
-            )
-        except (EngineError, ValueError) as e:
-            timer.finish("error")
-            return self._error(400, str(e))
-        except NoInstancesError as e:
-            timer.finish("error")
-            return self._error(503, str(e), "service_unavailable")
-        except ResponseStreamError as e:
-            timer.finish("error")
-            return self._error(502, str(e), "engine_error")
+        return await self._handle_inference(
+            request, ChatCompletionRequest, self.manager.chat_engines,
+            ChatCompletionChunk, aggregate_chat_stream,
+        )
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = await request.json()
-            comp_req = CompletionRequest.model_validate(body)
-        except (json.JSONDecodeError, ValueError) as e:
-            return self._error(400, f"invalid request: {e}")
-
-        engine = self.manager.completion_engines.get(comp_req.model)
-        if engine is None:
-            return self._error(404, f"model '{comp_req.model}' not found", "model_not_found")
-
-        timer = self.metrics.track(comp_req.model)
-        ctx = Context(comp_req)
-        try:
-            stream = engine.generate(ctx)
-            if comp_req.stream:
-                return await self._stream_sse(request, ctx, stream, timer)
-            chunks = []
-            async for chunk in stream:
-                timer.first_token()
-                chunks.append(CompletionResponse.model_validate(_as_dict(chunk)))
-            timer.finish("success")
-            return web.json_response(
-                aggregate_completion_stream(chunks).model_dump(exclude_none=True)
-            )
-        except (EngineError, ValueError) as e:
-            timer.finish("error")
-            return self._error(400, str(e))
-        except NoInstancesError as e:
-            timer.finish("error")
-            return self._error(503, str(e), "service_unavailable")
-        except ResponseStreamError as e:
-            timer.finish("error")
-            return self._error(502, str(e), "engine_error")
+        return await self._handle_inference(
+            request, CompletionRequest, self.manager.completion_engines,
+            CompletionResponse, aggregate_completion_stream,
+        )
 
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -228,10 +232,24 @@ class HttpService:
         return web.json_response({"status": "ok", "models": self.manager.model_names()})
 
 
+class _StreamDisconnect(Exception):
+    """Internal: SSE client went away mid-stream."""
+
+
 def _as_dict(chunk: Any) -> Any:
     if hasattr(chunk, "model_dump"):
         return chunk.model_dump(exclude_none=True)
     return chunk
+
+
+def _has_payload(chunk: Any) -> bool:
+    """True if the chunk carries generated content (TTFT should fire)."""
+    if not isinstance(chunk, dict):
+        return True
+    for choice in chunk.get("choices", []):
+        if (choice.get("delta") or {}).get("content") or choice.get("text"):
+            return True
+    return False
 
 
 # ---------- model registry + discovery watcher ----------

@@ -64,9 +64,11 @@ class Decoder:
     def step(self, token_id: int) -> Tuple[Optional[str], Optional[FinishReason]]:
         self.generated += 1
         if token_id in self.hidden_stop_ids:
-            return None, FinishReason.STOP
+            # token-level stop: jailed text is legitimate output, release it
+            # (only a completed stop-STRING match justifies discarding it)
+            return self.flush(), FinishReason.STOP
         if not self.ignore_eos and token_id in self.eos_token_ids:
-            return None, FinishReason.EOS
+            return self.flush(), FinishReason.EOS
 
         if self.stream is None:
             return None, None

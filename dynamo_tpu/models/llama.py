@@ -1,0 +1,149 @@
+"""Llama-family decoder, functional JAX, paged-KV, scan-over-layers.
+
+Design notes (TPU-first):
+- Pure functions over a params pytree; layer weights are stacked on a
+  leading L axis and the transformer body is one ``lax.scan`` whose carry
+  holds (hidden, kv_cache) — compile time is O(1) in depth and the donated
+  cache updates in place.
+- The same ``forward`` serves bucketed prefill (S>1) and decode (S=1):
+  new K/V are scattered into the paged cache, then attention runs over
+  gathered cache blocks (ops/attention.py). ``kv_width`` bounds how many
+  blocks are gathered so prefill doesn't pay full-context gathers.
+- GQA, RoPE, RMSNorm, SwiGLU per the Llama architecture. Weights load from
+  HF safetensors via models/loader.py.
+
+This module is the engine the reference never had natively (it delegated
+GPU work to vLLM/SGLang — SURVEY.md §2.4); here the model IS part of the
+framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.config import ModelConfig
+from ..ops.attention import paged_attention, scatter_kv
+
+Params = Dict[str, Any]
+KVCache = Tuple[jax.Array, jax.Array]  # k, v: [L, N_blocks, bs, KVH, D]
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    norm = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, D]; positions: [B, S]. HF-style half-rotation RoPE."""
+    d = x.shape[-1]
+    inv_freq = rope_frequencies(d, theta)                       # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                        # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params with the right shapes/layout (tests, benchmarks)."""
+    l, d_model = cfg.num_layers, cfg.hidden_size
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    inter = cfg.intermediate_size
+    keys = jax.random.split(key, 10)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
+        "layers": {
+            "ln1": jnp.ones((l, d_model), dtype),
+            "wq": w(keys[1], (l, d_model, h * hd), d_model),
+            "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
+            "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
+            "wo": w(keys[4], (l, h * hd, d_model), h * hd),
+            "ln2": jnp.ones((l, d_model), dtype),
+            "w_gate": w(keys[5], (l, d_model, inter), d_model),
+            "w_up": w(keys[6], (l, d_model, inter), d_model),
+            "w_down": w(keys[7], (l, inter, d_model), inter),
+        },
+        "final_norm": jnp.ones((d_model,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[8], (d_model, cfg.vocab_size), d_model)
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S] absolute positions (pad → repeat last)
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, W] (W = kv_width blocks)
+    slot_mapping: jax.Array,  # [B, S] flat cache slot per token; -1 drops
+    context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
+) -> Tuple[jax.Array, KVCache]:
+    """Returns (logits [B, S, V], updated kv_cache)."""
+    h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    b, s = tokens.shape
+
+    hidden = params["embed"][tokens]  # [B, S, D]
+
+    k_all, v_all = kv_cache
+
+    def layer_step(carry, layer_params):
+        hidden, k_all, v_all, li = carry
+
+        x = rms_norm(hidden, layer_params["ln1"], cfg.rms_norm_eps)
+        q = (x @ layer_params["wq"]).reshape(b, s, h_heads, hd)
+        k = (x @ layer_params["wk"]).reshape(b, s, kvh, hd)
+        v = (x @ layer_params["wv"]).reshape(b, s, kvh, hd)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+        k_layer = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        k_layer, v_layer = scatter_kv(k_layer, v_layer, k, v, slot_mapping)
+        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_layer, li, 0)
+        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_layer, li, 0)
+
+        attn = paged_attention(
+            q, k_layer, v_layer, block_tables, positions, context_lens
+        )
+        hidden = hidden + attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
+
+        x = rms_norm(hidden, layer_params["ln2"], cfg.rms_norm_eps)
+        gate = jax.nn.silu(x @ layer_params["w_gate"])
+        hidden = hidden + (gate * (x @ layer_params["w_up"])) @ layer_params["w_down"]
+        return (hidden, k_all, v_all, li + 1), None
+
+    (hidden, k_all, v_all, _), _ = jax.lax.scan(
+        layer_step, (hidden, k_all, v_all, jnp.int32(0)), params["layers"]
+    )
+
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = hidden @ params["embed"].T
+    else:
+        logits = hidden @ lm_head
+    return logits, (k_all, v_all)

@@ -1,0 +1,105 @@
+"""Load HF checkpoint weights into the engine's stacked-layer param layout.
+
+HF stores one tensor per layer per projection ([out, in] torch layout);
+the engine wants [L, in, out] stacks for lax.scan. Streams tensors from
+safetensors shards without loading the whole checkpoint at once.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _iter_safetensors(model_dir: str):
+    from safetensors import safe_open
+
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {model_dir}")
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF Llama/Mistral/Qwen-style checkpoint → stacked param pytree."""
+    l = cfg.num_layers
+    staging: Dict[str, Dict[int, np.ndarray]] = {
+        k: {} for k in ("ln1", "wq", "wk", "wv", "wo", "ln2", "w_gate", "w_up", "w_down")
+    }
+    top: Dict[str, np.ndarray] = {}
+
+    def to_np(t):
+        if t.dtype == np.dtype("uint16"):  # bfloat16 raw view
+            import jax
+
+            return jnp.asarray(t.view(jnp.bfloat16))
+        return t
+
+    mapping = {
+        "input_layernorm.weight": ("ln1", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("ln2", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+
+    for name, tensor in _iter_safetensors(model_dir):
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            top["embed"] = tensor
+        elif name == "norm.weight":
+            top["final_norm"] = tensor
+        elif name == "lm_head.weight":
+            top["lm_head"] = tensor.T  # [V, D] → [D, V]
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            if rest in mapping:
+                key, transpose = mapping[rest]
+                staging[key][int(idx)] = tensor.T if transpose else tensor
+            else:
+                logger.debug("skipping unmapped tensor %s", name)
+
+    missing = [k for k, v in staging.items() if len(v) != l]
+    if missing:
+        raise ValueError(
+            f"incomplete checkpoint: {missing} have "
+            f"{[len(staging[k]) for k in missing]} of {l} layers"
+        )
+
+    def stack(key):
+        return jnp.asarray(
+            np.stack([staging[key][i] for i in range(l)]), dtype=dtype
+        )
+
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "layers": {k: stack(k) for k in staging},
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    elif not cfg.tie_word_embeddings:
+        # tied but config didn't say so — fall back to tied
+        logger.info("no lm_head tensor; using tied embeddings")
+    return params
+
+
+def has_checkpoint(model_dir: str) -> bool:
+    return bool(glob.glob(os.path.join(model_dir, "*.safetensors")))

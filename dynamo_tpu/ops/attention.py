@@ -1,0 +1,107 @@
+"""Attention over the paged KV cache.
+
+Unified design: new K/V are always scattered into the cache first, then
+queries attend over gathered cache blocks — the same code path serves
+bucketed prefill (S>1, narrow KV width) and single-token decode (S=1, full
+width). The XLA path below is the reference implementation; the Pallas
+flash/paged kernel (ops/pallas_attention.py) replaces it on TPU where the
+gather would otherwise materialize B×W×bs keys in HBM.
+
+Replaces the role of the reference's GPU engines' paged attention (the
+reference delegated to vLLM; SURVEY.md §7 "hard parts" #1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def scatter_kv(
+    k_cache: jax.Array,  # [N_blocks, block_size, KVH, D] (one layer)
+    v_cache: jax.Array,
+    new_k: jax.Array,    # [B, S, KVH, D]
+    new_v: jax.Array,
+    slot_mapping: jax.Array,  # [B, S] flat slot index (block*bs + off); -1 → drop
+) -> Tuple[jax.Array, jax.Array]:
+    """Write new K/V into cache slots. Out-of-range (-1) slots are dropped."""
+    n_blocks, block_size, kvh, d = k_cache.shape
+    flat_k = k_cache.reshape(n_blocks * block_size, kvh, d)
+    flat_v = v_cache.reshape(n_blocks * block_size, kvh, d)
+    idx = slot_mapping.reshape(-1)
+    flat_k = flat_k.at[idx].set(new_k.reshape(-1, kvh, d), mode="drop")
+    flat_v = flat_v.at[idx].set(new_v.reshape(-1, kvh, d), mode="drop")
+    return (
+        flat_k.reshape(n_blocks, block_size, kvh, d),
+        flat_v.reshape(n_blocks, block_size, kvh, d),
+    )
+
+
+def paged_attention(
+    q: jax.Array,            # [B, S, H, D] (post-RoPE)
+    k_cache: jax.Array,      # [N_blocks, block_size, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, W] block ids for each sequence
+    q_positions: jax.Array,  # [B, S] absolute position of each query token
+    context_lens: jax.Array, # [B] total valid tokens (incl. current) per seq
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference paged attention: gather → masked softmax → weighted sum.
+
+    Causal semantics: query at absolute position p attends cache positions
+    j where j <= p and j < context_len. Cache position of slot s in the
+    gathered layout is exactly its sequence position (block_tables are in
+    sequence order).
+    """
+    b, s, h, d = q.shape
+    _, block_size, kvh, _ = k_cache.shape
+    w = block_tables.shape[1]
+    groups = h // kvh
+    if scale is None:
+        scale = d ** -0.5
+
+    # gather: [B, W, bs, KVH, D] → [B, W*bs, KVH, D]
+    k = k_cache[block_tables].reshape(b, w * block_size, kvh, d)
+    v = v_cache[block_tables].reshape(b, w * block_size, kvh, d)
+
+    # [B, S, H, D] x [B, T, KVH, D] with GQA: fold H → (KVH, G)
+    qg = q.reshape(b, s, kvh, groups, d)
+    logits = jnp.einsum("bskgd,btkd->bskgt", qg * scale, k)
+
+    key_pos = jnp.arange(w * block_size)[None, None, :]          # [1, 1, T]
+    causal = key_pos <= q_positions[:, :, None]                   # [B, S, T]
+    valid = key_pos < context_lens[:, None, None]                 # [B, 1→S, T]
+    mask = (causal & valid)[:, :, None, None, :]                  # [B, S, 1, 1, T]
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bskgt,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def prefill_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, KVH, D]
+    v: jax.Array,
+    valid_lens: jax.Array,  # [B] number of real (non-pad) tokens
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense causal self-attention for prefill without cache reads (used when
+    the whole context is the in-flight prompt — no prefix-cache hit)."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(b, s, kvh, groups, d)
+    logits = jnp.einsum("bskgd,btkd->bskgt", qg * scale, k)
+    q_pos = jnp.arange(s)[None, :, None]
+    k_pos = jnp.arange(s)[None, None, :]
+    mask = (k_pos <= q_pos) & (k_pos < valid_lens[:, None, None])
+    logits = jnp.where(mask[:, :, None, None, :], logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bskgt,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)

@@ -252,7 +252,9 @@ class ResponseReceiver:
         if self._send_control:
             self._send_control({"t": "kill"})
 
-    async def wait_prologue(self, timeout: float = 30.0) -> None:
+    async def wait_prologue(self, timeout: float = 600.0) -> None:
+        # generous default: the prologue follows the FIRST response item, so
+        # it legitimately waits through cold-start XLA compilation
         """Raises ResponseStreamError if the worker rejected the request."""
         await asyncio.wait_for(asyncio.shield(self._prologue), timeout)
         err = self._prologue.result()

@@ -366,6 +366,7 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
         # pushes that arrive between the watch/sub RPC response frame and the
         # awaiting coroutine registering its watcher/subscription object
         self._early_pushes: Dict[int, list] = {}
+        self._dead_ids: set = set()  # cancelled wids/sids — never buffer these
         self._ids = itertools.count(1)
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
@@ -446,6 +447,10 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
                 self._buffer_early(frame["sid"], frame)
 
     def _buffer_early(self, rid: int, frame: dict) -> None:
+        if rid in self._dead_ids:
+            return  # push racing a cancellation — drop, don't accumulate
+        if len(self._early_pushes) >= 256 and rid not in self._early_pushes:
+            return  # cap distinct ids; genuinely-early windows are tiny
         buf = self._early_pushes.setdefault(rid, [])
         if len(buf) < 4096:
             buf.append(frame)
@@ -515,6 +520,8 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
 
         def on_cancel():
             self._watchers.pop(wid, None)
+            self._early_pushes.pop(wid, None)
+            self._dead_ids.add(wid)
             if not self._closed:
                 self._spawn_bg(self._rpc("unwatch", wid=wid))
 
@@ -531,6 +538,8 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
     def _make_sub(self, sid: int) -> Subscription:
         def on_cancel():
             self._subs.pop(sid, None)
+            self._early_pushes.pop(sid, None)
+            self._dead_ids.add(sid)
             if not self._closed:
                 self._spawn_bg(self._rpc("unsub", sid=sid))
 

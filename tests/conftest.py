@@ -8,12 +8,23 @@ CPU mesh. Real-TPU tests are opt-in via the ``tpu`` marker.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DYN_TPU_TESTS=1 opts into real-TPU tests; otherwise everything is pinned
+# to the virtual 8-device CPU platform.
+if not os.environ.get("DYN_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # The environment's site hook (PYTHONPATH sitecustomize) imports jax at
+    # interpreter startup with JAX_PLATFORMS=axon (the real TPU), so env vars
+    # set here are too late — jax's config already snapshotted them. Update
+    # the live config instead, before any backend is initialized.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

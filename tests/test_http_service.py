@@ -122,6 +122,34 @@ async def test_unknown_model_404_and_bad_body_400():
 
 
 @pytest.mark.asyncio
+async def test_streaming_validation_error_is_http_400(tmp_path):
+    """Oversized prompt with stream=true must get a 400, not a 200-SSE-error."""
+    model_dir = make_model_dir(tmp_path)
+    mdc = ModelDeploymentCard.from_local_path(model_dir, "tiny")
+    tok = HFTokenizer.from_pretrained_dir(model_dir)
+    engine = build_pipeline([OpenAIPreprocessor(mdc, tok), Backend(tok)], EchoEngineCore())
+    manager = ModelManager()
+    manager.add_chat_model("tiny", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "word " * 600}],
+                    "stream": True,
+                },
+            ) as r:
+                assert r.status == 400
+                body = await r.json()
+                assert "exceeds context" in body["error"]["message"]
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
 async def test_metrics_exposed():
     service = await start_echo_service()
     try:
