@@ -1,0 +1,107 @@
+"""Benchmark: decode throughput of the native JAX engine on one TPU chip.
+
+Runs the flagship Llama-3.2-1B-class config (bf16, paged KV cache) and
+measures steady-state batched decode throughput. Prints ONE JSON line.
+
+``vs_baseline`` is measured tokens/sec divided by the single-chip
+HBM-roofline estimate for the same model/batch (decode is bandwidth-bound:
+every step must stream all weights + the batch's KV context from HBM).
+v5e: ~819 GB/s HBM. A value near 1.0 means the engine is at roofline;
+the reference's engines (vLLM-class) typically sit at 0.5-0.7 of roofline
+on their hardware (no absolute numbers are published in the reference —
+BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V5E_HBM_GBPS = 819e9
+
+
+def main() -> None:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import FLAGSHIP
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.models import llama
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))  # tiny shapes: logic check only
+    mcfg = ModelConfig(**(dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2,
+    ) if smoke else FLAGSHIP))
+    cfg = EngineConfig(
+        model=mcfg, max_batch_size=8, max_model_len=2048, kv_block_size=16,
+        num_kv_blocks=1024, dtype="float32" if smoke else "bfloat16",
+    )
+    b, w, bs = cfg.max_batch_size, cfg.blocks_per_seq, cfg.kv_block_size
+    ctx = 512  # steady-state context per sequence
+
+    dtype = jnp.float32 if smoke else jnp.bfloat16
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0), dtype)
+    k_cache, v_cache = llama.init_kv_cache(
+        mcfg, cfg.num_kv_blocks, cfg.kv_block_size, dtype
+    )
+
+    block_tables = jnp.asarray(
+        np.arange(b * w, dtype=np.int32).reshape(b, w) % cfg.num_kv_blocks
+    )
+
+    def decode_step(params, k_cache, v_cache, tokens, positions,
+                    slot_mapping, context_lens):
+        logits, (k_cache, v_cache) = llama.forward(
+            params, mcfg, tokens, positions, (k_cache, v_cache),
+            block_tables, slot_mapping, context_lens,
+        )
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), k_cache, v_cache
+
+    step = jax.jit(decode_step, donate_argnums=(1, 2))
+
+    tokens = jnp.zeros((b, 1), jnp.int32)
+    positions = jnp.full((b, 1), ctx, jnp.int32)
+    slot_mapping = (block_tables[:, ctx // bs] * bs + ctx % bs)[:, None]
+    context_lens = jnp.full((b,), ctx + 1, jnp.int32)
+
+    # warmup / compile
+    out, k_cache, v_cache = step(
+        params, k_cache, v_cache, tokens, positions, slot_mapping, context_lens
+    )
+    out.block_until_ready()
+
+    n_steps = 4 if smoke else 64
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out, k_cache, v_cache = step(
+            params, k_cache, v_cache, out[:, None], positions, slot_mapping, context_lens
+        )
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = b * n_steps / dt
+
+    # HBM roofline: per decode step, stream weights once + per-seq KV(ctx)
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    kv_bytes_per_seq = (
+        2 * mcfg.num_layers * ctx * mcfg.num_kv_heads * mcfg.head_dim * 2
+    )
+    step_bytes = param_bytes + b * kv_bytes_per_seq
+    roofline_steps = V5E_HBM_GBPS / step_bytes
+    roofline_toks = roofline_steps * b
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(toks_per_sec / roofline_toks, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

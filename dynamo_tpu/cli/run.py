@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--router-mode", default="round_robin",
                    choices=["random", "round_robin", "kv"])
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--token-level", action="store_true",
+                   help="serve PreprocessedRequests (engine worker behind a processor)")
+    p.add_argument("--worker-endpoint", default=None,
+                   help="dyn://ns.comp.ep of token-level workers (processor role)")
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=None)
@@ -57,36 +61,53 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-async def build_engine(engine_spec: str, flags, drt=None):
-    """Returns (openai_engine, mdc_or_None). The engine accepts
-    ChatCompletionRequest contexts and yields chat chunks."""
-    from ..llm.engines.echo import EchoEngineCore, EchoEngineFull
+def load_mdc(flags):
+    from ..llm.model_card import ModelDeploymentCard
 
+    if not flags.model_path:
+        raise SystemExit("this mode requires --model-path")
+    return ModelDeploymentCard.from_local_path(
+        flags.model_path, flags.model_name, kv_block_size=flags.kv_block_size
+    )
+
+
+async def build_core_engine(engine_spec: str, flags, mdc, events=None):
+    """Token-level engine (PreprocessedRequest → EngineOutput stream)."""
+    from ..llm.engines.echo import EchoEngineCore
+
+    if engine_spec == "echo_core":
+        return EchoEngineCore()
+    if engine_spec == "jax":
+        from ..engine.serving import JaxServingEngine
+
+        return await JaxServingEngine.create(mdc, flags, events=events)
+    raise SystemExit(f"unknown core engine {engine_spec!r}")
+
+
+async def build_engine(engine_spec: str, flags, drt=None, events=None):
+    """Returns (openai_engine, mdc_or_None). The engine accepts
+    ChatCompletionRequest/CompletionRequest contexts and yields chunks."""
+    from ..llm.engines.echo import EchoEngineFull
+
+    if engine_spec == "none":
+        # pure frontend: models come exclusively from the discovery watcher
+        return None, None
     if engine_spec == "echo_full":
         return EchoEngineFull(), None
 
     if engine_spec in ("echo_core", "jax"):
-        if not flags.model_path:
-            raise SystemExit(f"out={engine_spec} requires --model-path")
         from ..llm.backend import Backend
-        from ..llm.model_card import ModelDeploymentCard
         from ..llm.preprocessor import OpenAIPreprocessor
         from ..llm.tokenizer import HFTokenizer
         from ..runtime.pipeline import build_pipeline
 
-        mdc = ModelDeploymentCard.from_local_path(
-            flags.model_path, flags.model_name, kv_block_size=flags.kv_block_size
-        )
+        mdc = load_mdc(flags)
         tokenizer = HFTokenizer.from_pretrained_dir(flags.model_path)
-        pre = OpenAIPreprocessor(mdc, tokenizer)
-        backend = Backend(tokenizer)
-        if engine_spec == "echo_core":
-            core = EchoEngineCore()
-        else:
-            from ..engine.serving import JaxServingEngine
-
-            core = await JaxServingEngine.create(mdc, flags)
-        return build_pipeline([pre, backend], core), mdc
+        core = await build_core_engine(engine_spec, flags, mdc, events)
+        return (
+            build_pipeline([OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core),
+            mdc,
+        )
 
     raise SystemExit(f"unknown engine {engine_spec!r}")
 
@@ -95,10 +116,11 @@ async def run_http(flags, engine, mdc) -> None:
     from ..http.service import HttpService, ModelManager, ModelWatcher
 
     manager = ModelManager()
-    name = flags.model_name or (mdc.display_name if mdc else "echo")
-    manager.add_chat_model(name, engine)
-    if mdc is not None:  # pipeline engines dispatch chat AND completions
-        manager.add_completion_model(name, engine)
+    if engine is not None:
+        name = flags.model_name or (mdc.display_name if mdc else "echo")
+        manager.add_chat_model(name, engine)
+        if mdc is not None:  # pipeline engines dispatch chat AND completions
+            manager.add_completion_model(name, engine)
     service = HttpService(manager, flags.http_host, flags.http_port)
 
     watcher = None
@@ -147,31 +169,95 @@ async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
         print()
 
 
-async def run_endpoint(flags, engine, mdc, path: str) -> None:
-    """Serve the pipeline as a distributed endpoint worker (in=dyn://...)."""
+async def run_worker(flags, engine_spec: str, path: str) -> None:
+    """Distributed worker roles (in=dyn://ns.comp.ep):
+
+    - default: full OpenAI-level worker (preprocess+engine+detokenize here)
+    - --token-level: engine worker serving PreprocessedRequests, publishing
+      KV events + ForwardPassMetrics for KV-aware routers
+    - out=processor: preprocess + KV-route to --worker-endpoint workers
+    """
+    import uuid
+
     from ..http.service import parse_endpoint_path, register_model
     from ..runtime.component import DistributedRuntime
     from ..runtime.engine import Context
 
     if flags.store_port is None:
         raise SystemExit("in=dyn:// requires --store-port")
+    if engine_spec == "none":
+        raise SystemExit("out=none is only valid with in=http (pure frontend)")
     ns_name, comp, ep_name = parse_endpoint_path(path)
     drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
     endpoint = drt.namespace(ns_name).component(comp).endpoint(ep_name)
 
-    async def handler(payload, ctx):
-        from ..protocols.openai import ChatCompletionRequest, CompletionRequest
+    def make_openai_handler(engine):
+        async def handler(payload, ctx):
+            from ..protocols.openai import ChatCompletionRequest, CompletionRequest
 
-        cls = ChatCompletionRequest if "messages" in payload else CompletionRequest
-        req = cls.model_validate(payload)
-        async for chunk in engine.generate(Context(req, ctx)):
-            yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+            cls = ChatCompletionRequest if "messages" in payload else CompletionRequest
+            async for chunk in engine.generate(Context(cls.model_validate(payload), ctx)):
+                yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
 
-    serving = await endpoint.serve(handler)
-    name = flags.model_name or (mdc.display_name if mdc else "echo")
-    model_type = "both" if mdc is not None else "chat"
-    await register_model(drt, flags.namespace, name, path, model_type=model_type)
-    print(f"worker serving {path} (model={name})", flush=True)
+        return handler
+
+    if engine_spec == "processor":
+        from ..kv_router.router import KvRouter
+        from ..llm.processor import build_processor_pipeline
+        from ..runtime.client import Client, RouterMode
+
+        if not flags.worker_endpoint:
+            raise SystemExit("out=processor requires --worker-endpoint")
+        mdc = load_mdc(flags)
+        wns, wcomp, wep = parse_endpoint_path(flags.worker_endpoint)
+        w_endpoint = drt.namespace(wns).component(wcomp).endpoint(wep)
+        client = Client(
+            w_endpoint,
+            RouterMode.ROUND_ROBIN if flags.router_mode == "kv"
+            else RouterMode(flags.router_mode),
+        )
+        router = None
+        if flags.router_mode == "kv":
+            router = await KvRouter(
+                w_endpoint.component, client, block_size=flags.kv_block_size
+            ).start()
+        else:
+            await client.start()
+        engine = build_processor_pipeline(mdc, client, router)
+        serving = await endpoint.serve(make_openai_handler(engine))
+        name = flags.model_name or mdc.display_name
+        await register_model(drt, flags.namespace, name, path, model_type="both")
+        print(f"processor serving {path} (model={name} → {flags.worker_endpoint})", flush=True)
+
+    elif flags.token_level:
+        from ..kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+
+        mdc = load_mdc(flags)
+        instance_id = f"w-{uuid.uuid4().hex[:12]}"
+        publisher = KvEventPublisher(endpoint.component, instance_id)
+        publisher.start()
+        core = await build_core_engine(engine_spec, flags, mdc, events=publisher.as_sink())
+
+        async def handler(payload, ctx):
+            async for out in core.generate(Context(payload, ctx)):
+                yield out
+
+        metrics_fn = core.metrics if hasattr(core, "metrics") else dict
+        serving = await endpoint.serve(
+            handler,
+            instance_id=instance_id,
+            stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
+        )
+        print(f"token-level worker {instance_id} serving {path}", flush=True)
+
+    else:
+        engine, mdc = await build_engine(engine_spec, flags)
+        serving = await endpoint.serve(make_openai_handler(engine))
+        name = flags.model_name or (mdc.display_name if mdc else "echo")
+        model_type = "both" if mdc is not None else "chat"
+        await register_model(drt, flags.namespace, name, path, model_type=model_type)
+        print(f"worker serving {path} (model={name})", flush=True)
+
     try:
         await asyncio.Event().wait()
     finally:
@@ -183,13 +269,15 @@ async def amain(argv: List[str]) -> None:
     flags = build_parser().parse_args(rest)
     logging.basicConfig(level=logging.DEBUG if flags.verbose else logging.INFO)
 
+    if src.startswith("dyn://"):
+        await run_worker(flags, engine_spec, src)
+        return
+
     engine, mdc = await build_engine(engine_spec, flags)
     if src == "http":
         await run_http(flags, engine, mdc)
     elif src in ("text", "stdin"):
         await run_text(flags, engine, mdc)
-    elif src.startswith("dyn://"):
-        await run_endpoint(flags, engine, mdc, src)
     elif src.startswith("batch:"):
         from .batch import run_batch
 

@@ -1,0 +1,84 @@
+"""Worker-side KV plumbing: event publisher + metrics publisher.
+
+Reference analog: lib/llm/src/kv_router/publisher.rs — KvEventPublisher
+(engine block events → broker subject) and KvMetricsPublisher
+(ForwardPassMetrics served via the endpoint stats handler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Callable, List, Optional
+
+from ..engine.block_allocator import KvEventSink
+from ..runtime.component import Component
+from .protocols import KV_EVENT_SUBJECT, ForwardPassMetrics, KvCacheRemoved, KvCacheStored, RouterEvent
+
+logger = logging.getLogger(__name__)
+
+
+class KvEventPublisher:
+    """Queue-decoupled publisher: engine hooks are sync, broker IO is async."""
+
+    def __init__(self, component: Component, worker_id: str):
+        self.component = component
+        self.worker_id = worker_id
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._ids = itertools.count(1)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = self.component.drt.runtime.spawn(self._pump())
+
+    async def _pump(self) -> None:
+        while True:
+            event: RouterEvent = await self._queue.get()
+            try:
+                await self.component.publish_event(KV_EVENT_SUBJECT, event.to_wire())
+            except Exception:
+                logger.exception("kv event publish failed")
+
+    def publish_stored(self, block_hashes: List[int], parent_hash: Optional[int]) -> None:
+        self._queue.put_nowait(
+            RouterEvent(
+                worker_id=self.worker_id,
+                stored=KvCacheStored(block_hashes=list(block_hashes), parent_hash=parent_hash),
+                event_id=next(self._ids),
+            )
+        )
+
+    def publish_removed(self, block_hashes: List[int]) -> None:
+        self._queue.put_nowait(
+            RouterEvent(
+                worker_id=self.worker_id,
+                removed=KvCacheRemoved(block_hashes=list(block_hashes)),
+                event_id=next(self._ids),
+            )
+        )
+
+    def as_sink(self) -> KvEventSink:
+        """Adapter plugged into the engine's BlockAllocator."""
+        return KvEventSink(
+            on_stored=self.publish_stored,
+            on_removed=self.publish_removed,
+        )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+
+class KvMetricsPublisher:
+    """Exposes ForwardPassMetrics through the endpoint stats scrape.
+
+    ``stats_handler()`` goes into Endpoint.serve(stats_handler=...); callers
+    (KvMetricsAggregator) see it under the ``data`` key of scraped stats.
+    """
+
+    def __init__(self, metrics_fn: Callable[[], dict]):
+        self.metrics_fn = metrics_fn
+
+    def stats_handler(self) -> dict:
+        return ForwardPassMetrics.from_wire(self.metrics_fn()).to_wire()

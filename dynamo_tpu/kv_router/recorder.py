@@ -1,0 +1,99 @@
+"""RouterEvent recorder/replay: offline router-policy evaluation.
+
+Capture production KV events to JSONL, replay them later into a fresh
+indexer to evaluate routing policies without a cluster. Reference analog:
+lib/llm/src/recorder.rs + kv_router/recorder.rs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Iterator, Optional, Union
+
+import msgpack
+
+from ..runtime.component import Component
+from .indexer import KvIndexer, ShardedKvIndexer
+from .protocols import KV_EVENT_SUBJECT, RouterEvent
+
+logger = logging.getLogger(__name__)
+
+
+class KvRecorder:
+    """Subscribes to an endpoint's kv_events and appends them to JSONL."""
+
+    def __init__(
+        self,
+        component: Component,
+        path: str,
+        max_bytes: Optional[int] = None,
+    ):
+        self.component = component
+        self.path = path
+        self.max_bytes = max_bytes
+        self.count = 0
+        self._task = None
+        self._sub = None
+        self._fh = None
+
+    async def start(self) -> "KvRecorder":
+        self._fh = open(self.path, "a")
+        self._sub = await self.component.subscribe_event(KV_EVENT_SUBJECT)
+        self._task = self.component.drt.runtime.spawn(self._consume())
+        return self
+
+    async def _consume(self) -> None:
+        async for msg in self._sub:
+            try:
+                event = msgpack.unpackb(msg.payload, raw=False)
+                self._fh.write(json.dumps({"ts": time.time(), "event": event}) + "\n")
+                self._fh.flush()
+                self.count += 1
+                if self.max_bytes and self._fh.tell() > self.max_bytes:
+                    self._rotate()
+            except Exception:
+                logger.exception("record failed")
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        os.rename(self.path, f"{self.path}.{int(time.time())}")
+        self._fh = open(self.path, "a")
+
+    async def stop(self) -> None:
+        if self._sub:
+            self._sub.cancel()
+        if self._task:
+            self._task.cancel()
+        if self._fh:
+            self._fh.close()
+
+
+def iter_recorded_events(path: str) -> Iterator[RouterEvent]:
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                yield RouterEvent.from_wire(json.loads(line)["event"])
+
+
+def replay_events(
+    path: str,
+    indexer: Union[KvIndexer, ShardedKvIndexer],
+    timed: bool = False,
+) -> int:
+    """Feed recorded events into an indexer; returns the event count."""
+    n = 0
+    last_ts = None
+    for line in open(path):
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        if timed and last_ts is not None:
+            time.sleep(max(0.0, min(1.0, rec["ts"] - last_ts)))
+        last_ts = rec["ts"]
+        indexer.apply_event(RouterEvent.from_wire(rec["event"]))
+        n += 1
+    return n

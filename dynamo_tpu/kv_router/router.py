@@ -1,0 +1,102 @@
+"""KvRouter: event-indexed, load-aware worker selection for one endpoint.
+
+Ties together the indexer (fed by the workers' kv_events), the metrics
+aggregator (stats scrape), and the scheduler cost function; publishes
+KVHitRateEvents so observability tooling can track routing quality.
+
+Reference analog: lib/llm/src/kv_router.rs:66-169.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Union
+
+import msgpack
+
+from ..runtime.client import Client
+from ..runtime.component import Component
+from ..tokens import compute_block_hashes
+from .indexer import KvIndexer, ShardedKvIndexer
+from .metrics_aggregator import KvMetricsAggregator
+from .protocols import KV_EVENT_SUBJECT, KV_HIT_RATE_EVENT, RouterEvent
+from .scheduler import KvScheduler, SchedulingDecision
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    def __init__(
+        self,
+        component: Component,
+        client: Client,
+        block_size: int = 16,
+        num_shards: int = 1,
+        poll_interval: float = 0.1,
+    ):
+        self.component = component
+        self.client = client
+        self.block_size = block_size
+        self.indexer: Union[KvIndexer, ShardedKvIndexer] = (
+            KvIndexer(block_size) if num_shards <= 1 else ShardedKvIndexer(num_shards, block_size)
+        )
+        self.scheduler = KvScheduler(block_size)
+        self.aggregator = KvMetricsAggregator(
+            client,
+            poll_interval=poll_interval,
+            on_update=self.scheduler.update_metrics,
+            on_remove=self._on_worker_gone,
+            on_sync=self._sync_live_workers,
+        )
+        self._event_task: Optional[asyncio.Task] = None
+        self._event_sub = None
+
+    def _on_worker_gone(self, worker_id: str) -> None:
+        self.scheduler.remove_worker(worker_id)
+        self.indexer.remove_worker(worker_id)
+
+    def _sync_live_workers(self, live: set) -> None:
+        """Purge index entries for workers that died before ever scraping."""
+        for wid in set(self.indexer.worker_ids) - live:
+            self.indexer.remove_worker(wid)
+
+    async def start(self) -> "KvRouter":
+        await self.client.start()
+        self._event_sub = await self.component.subscribe_event(KV_EVENT_SUBJECT)
+        self._event_task = self.component.drt.runtime.spawn(self._consume_events())
+        self.aggregator.start()
+        return self
+
+    async def _consume_events(self) -> None:
+        async for msg in self._event_sub:
+            try:
+                event = RouterEvent.from_wire(msgpack.unpackb(msg.payload, raw=False))
+                self.indexer.apply_event(event)
+            except Exception:
+                logger.exception("bad kv event")
+
+    async def schedule(self, token_ids) -> SchedulingDecision:
+        """token ids → chosen worker instance id (+hit telemetry)."""
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        overlap = self.indexer.find_matches(hashes)
+        decision = self.scheduler.schedule(len(token_ids), overlap)
+        try:
+            await self.component.namespace.publish_event(
+                KV_HIT_RATE_EVENT,
+                {
+                    "worker_id": decision.worker_id,
+                    "isl_blocks": -(-len(token_ids) // self.block_size),
+                    "overlap_blocks": decision.matched_blocks,
+                },
+            )
+        except Exception:
+            logger.debug("hit-rate event publish failed", exc_info=True)
+        return decision
+
+    async def stop(self) -> None:
+        if self._event_sub is not None:
+            self._event_sub.cancel()
+        if self._event_task is not None:
+            self._event_task.cancel()
+        self.aggregator.stop()

@@ -1,0 +1,127 @@
+"""KV-aware worker selection.
+
+Cost function and predicted-state updates mirror the reference's default
+selector (reference: lib/llm/src/kv_router/scheduler.rs:238-340):
+
+    logit = 2 * overlap_ratio - gpu_cache_usage - normalized_active_slots
+
+Highest logit wins; ties break randomly. After each decision the chosen
+worker's predicted load is bumped (active slots +1, kv blocks += newly
+needed) so a burst of requests doesn't pile onto one worker between
+metrics refreshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import random
+from typing import Dict, List, Optional
+
+from .indexer import OverlapScores
+from .protocols import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class AllWorkersBusy(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class WorkerState:
+    worker_id: str
+    metrics: ForwardPassMetrics
+    # predicted deltas since the last metrics refresh
+    predicted_active: int = 0
+    predicted_blocks: int = 0
+
+    def cache_usage(self, block_size: int) -> float:
+        total = self.metrics.kv_total_blocks or 1
+        return min(
+            1.0,
+            (self.metrics.kv_active_blocks + self.predicted_blocks) / total,
+        )
+
+    def normalized_active(self) -> float:
+        total = self.metrics.request_total_slots or 1
+        return (self.metrics.request_active_slots + self.predicted_active) / total
+
+
+class KvScheduler:
+    def __init__(self, block_size: int = 16, require_free_slot: bool = False):
+        self.block_size = block_size
+        self.require_free_slot = require_free_slot
+        self.workers: Dict[str, WorkerState] = {}
+
+    def update_metrics(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        state = self.workers.get(worker_id)
+        if state is None:
+            self.workers[worker_id] = WorkerState(worker_id, metrics)
+        else:
+            state.metrics = metrics
+            state.predicted_active = 0
+            state.predicted_blocks = 0
+
+    def remove_worker(self, worker_id: str) -> None:
+        self.workers.pop(worker_id, None)
+
+    def schedule(
+        self, isl_tokens: int, overlap: OverlapScores
+    ) -> "SchedulingDecision":
+        """Pick a worker for a request with ``isl_tokens`` prompt tokens."""
+        if not self.workers:
+            raise AllWorkersBusy("no workers with metrics")
+        total_blocks_needed = math.ceil(isl_tokens / self.block_size)
+
+        best: List[str] = []
+        best_logit = -float("inf")
+        details = {}
+        for wid, state in self.workers.items():
+            if self.require_free_slot and (
+                state.metrics.request_active_slots + state.predicted_active
+                >= (state.metrics.request_total_slots or 1)
+            ):
+                continue
+            matched = overlap.scores.get(wid, 0)
+            overlap_ratio = (
+                matched * self.block_size / isl_tokens if isl_tokens else 0.0
+            )
+            logit = (
+                2.0 * overlap_ratio
+                - state.cache_usage(self.block_size)
+                - state.normalized_active()
+            )
+            details[wid] = (logit, matched)
+            if logit > best_logit + 1e-9:
+                best, best_logit = [wid], logit
+            elif abs(logit - best_logit) <= 1e-9:
+                best.append(wid)
+        if not best:
+            raise AllWorkersBusy("all workers at slot capacity")
+        chosen = random.choice(best)
+        matched = overlap.scores.get(chosen, 0)
+        # predicted-state update (process_worker_selection analog)
+        state = self.workers[chosen]
+        state.predicted_active += 1
+        state.predicted_blocks += max(0, total_blocks_needed - matched)
+        logger.debug("kv schedule: %s logit=%.3f matched=%d", chosen, best_logit, matched)
+        return SchedulingDecision(
+            worker_id=chosen,
+            matched_blocks=matched,
+            prefix_hit_tokens=matched * self.block_size,
+            isl_tokens=isl_tokens,
+        )
+
+
+@dataclasses.dataclass
+class SchedulingDecision:
+    worker_id: str
+    matched_blocks: int
+    prefix_hit_tokens: int
+    isl_tokens: int
+
+    @property
+    def overlap_ratio(self) -> float:
+        return self.prefix_hit_tokens / self.isl_tokens if self.isl_tokens else 0.0

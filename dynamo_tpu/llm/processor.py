@@ -1,0 +1,89 @@
+"""Processor: the OpenAI↔token-level bridge with KV-aware routing.
+
+The distributed serving shape (reference flagship graph, SURVEY.md §3.2:
+Frontend → Processor → Router → Worker):
+
+  frontend (OpenAI passthrough) → THIS component:
+    preprocess (template+tokenize) → KvRouter.schedule(token_ids) →
+    direct() the PreprocessedRequest to the chosen token-level worker →
+    detokenize the EngineOutput stream back into OpenAI chunks.
+
+``KvRoutedClient`` is the terminal engine of that pipeline: it owns the
+routing decision (KV-aware when a router is attached, else the client's
+round-robin/random mode).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from ..kv_router.router import KvRouter
+from ..protocols.common import PreprocessedRequest
+from ..runtime.client import Client
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import build_pipeline
+from .backend import Backend
+from .model_card import ModelDeploymentCard
+from .preprocessor import OpenAIPreprocessor
+from .tokenizer import HFTokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class KvRoutedClient(AsyncEngine):
+    """Routes token-level requests to workers, KV-aware when possible."""
+
+    def __init__(self, client: Client, router: Optional[KvRouter] = None):
+        self.client = client
+        self.router = router
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        from ..runtime.client import NoInstancesError
+
+        req = request.payload
+        token_ids = (
+            req.token_ids if isinstance(req, PreprocessedRequest) else req["token_ids"]
+        )
+        if self.router is not None:
+            try:
+                decision = await self.router.schedule(token_ids)
+                request.baggage["instance_id"] = decision.worker_id
+                request.baggage["prefix_hit_tokens"] = decision.prefix_hit_tokens
+            except Exception:
+                logger.warning("kv scheduling failed; falling back", exc_info=True)
+        try:
+            async for item in self.client.generate(request):
+                yield item
+            return
+        except NoInstancesError:
+            # the KV-chosen worker died between metrics poll and dispatch —
+            # retry once, letting the client's own mode pick a live instance
+            if "instance_id" not in request.baggage:
+                raise
+            logger.warning(
+                "kv-chosen worker %s gone; re-routing", request.baggage.pop("instance_id")
+            )
+        async for item in self.client.generate(request):
+            yield item
+
+    async def close(self) -> None:
+        if self.router is not None:
+            await self.router.stop()
+        await self.client.close()
+
+
+def build_processor_pipeline(
+    mdc: ModelDeploymentCard,
+    worker_client: Client,
+    router: Optional[KvRouter] = None,
+    tokenizer: Optional[HFTokenizer] = None,
+) -> AsyncEngine:
+    """OpenAI-level engine: preprocess → route → worker → detokenize."""
+    tokenizer = tokenizer or (
+        HFTokenizer.from_pretrained_dir(mdc.model_path) if mdc.model_path else None
+    )
+    return build_pipeline(
+        [OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)],
+        KvRoutedClient(worker_client, router),
+    )
