@@ -33,6 +33,9 @@ class ModelConfig:
     # MoE (Mixtral-class); num_experts == 0 means dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # attention implementation: "auto" (pallas on TPU, xla elsewhere),
+    # "xla", or "pallas"
+    attention_impl: str = "auto"
     # MLA (DeepSeek-class); kv_lora_rank > 0 enables MLA attention
     kv_lora_rank: int = 0
     q_lora_rank: int = 0

@@ -126,6 +126,7 @@ class ModelRunner:
             logits, (k_cache, v_cache) = llama.forward(
                 params, cfg, tokens, positions, (k_cache, v_cache),
                 block_tables, slot_mapping, context_lens,
+                mesh=mesh,
             )
             b = tokens.shape[0]
             last_logits = logits[jnp.arange(b), last_idx]  # [B, V]

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.config import ModelConfig
-from ..ops.attention import paged_attention, scatter_kv
+from ..ops.attention import attention, scatter_kv
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]  # k, v: [L, N_blocks, bs, KVH, D]
@@ -101,6 +101,7 @@ def forward(
     block_tables: jax.Array,  # [B, W] (W = kv_width blocks)
     slot_mapping: jax.Array,  # [B, S] flat cache slot per token; -1 drops
     context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
+    mesh=None,                # multi-device mesh for the pallas shard_map path
 ) -> Tuple[jax.Array, KVCache]:
     """Returns (logits [B, S, V], updated kv_cache)."""
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -126,8 +127,9 @@ def forward(
         k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_layer, li, 0)
         v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_layer, li, 0)
 
-        attn = paged_attention(
-            q, k_layer, v_layer, block_tables, positions, context_lens
+        attn = attention(
+            q, k_layer, v_layer, block_tables, positions, context_lens,
+            impl=cfg.attention_impl, mesh=mesh,
         )
         hidden = hidden + attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
 

@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 def scatter_kv(
@@ -80,6 +81,64 @@ def paged_attention(
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bskgt,btkd->bskgd", probs, v)
     return out.reshape(b, s, h, d)
+
+
+def resolve_attention_impl(impl: str) -> str:
+    """'auto' → pallas on TPU, xla elsewhere (pallas still testable on CPU
+    via interpret=True)."""
+    if impl in ("xla", "pallas"):
+        return impl
+    if impl != "auto":
+        raise ValueError(f"unknown attention impl {impl!r}; use auto|xla|pallas")
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def attention(
+    q: jax.Array,            # [B, S, H, D]
+    k_cache: jax.Array,      # [N_blocks, bs, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, W]
+    positions: jax.Array,    # [B, S] absolute query positions
+    context_lens: jax.Array, # [B]
+    impl: str = "auto",
+    mesh=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged-attention dispatch: XLA gather path or the Pallas kernel.
+
+    The Pallas path assumes affine query positions (positions[:, s] ==
+    positions[:, 0] + s for real tokens) — the scheduler's layout. With a
+    multi-device mesh it runs under shard_map: batch over "dp", KV heads
+    over "tp" (no collectives — attention is head/batch parallel).
+    """
+    if resolve_attention_impl(impl) == "xla":
+        return paged_attention(q, k_cache, v_cache, block_tables, positions,
+                               context_lens)
+
+    from .pallas_attention import paged_flash_attention
+
+    fn = functools.partial(paged_flash_attention, interpret=interpret)
+    base_pos = positions[:, 0].astype(jnp.int32)
+    if mesh is not None and mesh.size > 1:
+        # batch shards over dp only when divisible — the scheduler prefills
+        # with B=1, which each dp group then computes redundantly (decode,
+        # where B = max_batch_size, shards)
+        dp = "dp" if q.shape[0] % mesh.shape.get("dp", 1) == 0 else None
+        fn = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(
+                P(dp, None, "tp", None),     # q [B, S, H, D]
+                P(None, None, "tp", None),   # k_cache
+                P(None, None, "tp", None),   # v_cache
+                P(dp, None),                 # block_tables
+                P(dp),                       # base_pos
+                P(dp),                       # context_lens
+            ),
+            out_specs=P(dp, None, "tp", None),
+            check_vma=False,  # pallas out_shape carries no vma annotation
+        )
+    return fn(q, k_cache, v_cache, block_tables, base_pos, context_lens)
 
 
 def prefill_attention(

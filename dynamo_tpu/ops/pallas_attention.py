@@ -1,0 +1,199 @@
+"""Pallas TPU paged flash attention over the block-paged KV cache.
+
+Replaces ops/attention.py's XLA gather path on TPU: instead of
+materializing the gathered [B, W*bs, KVH, D] keys in HBM, the kernel
+streams cache pages HBM→VMEM through the Pallas pipeline (the page
+index_map reads the scalar-prefetched block table, so the gather IS the
+pipeline's double-buffered DMA) and runs an online-softmax (flash)
+accumulation in VMEM scratch. One grid step = one cache page for one
+(batch row, query chunk): all KV heads of that page are processed so the
+page DMA is one contiguous [bs, KVH, D] burst.
+
+Reference analog: the vLLM/SGLang GPU paged-attention kernels the
+reference delegated to (SURVEY.md §2.4, §7 hard-part #1).
+
+API contract (matches the engine's scheduler): query positions of a step
+are affine — token s of the q block sits at absolute position
+``base_pos + s``. Pad rows past the true suffix produce garbage rows the
+caller discards (their causal mask is wider but bounded by context_lens).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -1e30
+
+
+def _kernel(
+    bt_ref,     # scalar prefetch: block tables [B, W]
+    ctx_ref,    # scalar prefetch: context lens [B]
+    base_ref,   # scalar prefetch: base query position [B]
+    q_ref,      # [1, Sc, KVH, G, D] (VMEM block)
+    k_ref,      # [1, bs, KVH, D] — one cache page
+    v_ref,
+    o_ref,      # [1, Sc, KVH, G, D]
+    m_scr,      # [KVH * Sc * G, 128] f32 running max
+    l_scr,      # [KVH * Sc * G, 128] f32 running denominator
+    acc_scr,    # [KVH * Sc * G, D] f32 running numerator
+    *,
+    scale: float,
+    block_size: int,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    w = pl.program_id(2)
+    num_w = pl.num_programs(2)
+
+    _, sc, kvh, g, d = q_ref.shape
+    rows = sc * g
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, MASK_VALUE)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = ctx_ref[b]
+    base = base_ref[b]
+    page_start = w * block_size
+    chunk_base = base + c * sc  # absolute position of this chunk's row 0
+
+    # page live iff it holds context AND is causally visible to the chunk
+    live = jnp.logical_and(page_start < ctx, page_start <= chunk_base + sc - 1)
+
+    @pl.when(live)
+    def _compute():
+        # lanes = key slot in page; sublanes = (s_local, group) query row
+        key_pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1
+        )
+        qpos = chunk_base + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 0
+        ) // g
+        mask = jnp.logical_and(key_pos <= qpos, key_pos < ctx)
+
+        for h in range(kvh):
+            lo = h * rows
+            q = q_ref[0, :, h, :, :].reshape(rows, d)          # [rows, D]
+            k = k_ref[0, :, h, :]                               # [bs, D]
+            v = v_ref[0, :, h, :]
+
+            s_log = jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                           # [rows, bs]
+            s_log = jnp.where(mask, s_log, MASK_VALUE)
+
+            m_prev = m_scr[lo : lo + rows, 0:1]                 # [rows, 1]
+            l_prev = l_scr[lo : lo + rows, 0:1]
+            m_cur = jnp.max(s_log, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s_log - m_new)                          # [rows, bs]
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                   # [rows, D]
+            acc_scr[lo : lo + rows, :] = acc_scr[lo : lo + rows, :] * alpha + pv
+            m_scr[lo : lo + rows, :] = jnp.broadcast_to(m_new, (rows, 128))
+            l_scr[lo : lo + rows, :] = jnp.broadcast_to(l_new, (rows, 128))
+
+    @pl.when(w == num_w - 1)
+    def _finalize():
+        for h in range(kvh):
+            lo = h * rows
+            l = l_scr[lo : lo + rows, 0:1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            out = (acc_scr[lo : lo + rows, :] / l).astype(o_ref.dtype)
+            o_ref[0, :, h, :, :] = out.reshape(sc, g, d)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "q_chunk", "interpret")
+)
+def paged_flash_attention(
+    q: jax.Array,            # [B, S, H, D] (post-RoPE)
+    k_cache: jax.Array,      # [N_blocks, bs, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, W] int32
+    base_pos: jax.Array,     # [B] int32 — absolute position of q[:, 0]
+    context_lens: jax.Array, # [B] int32
+    scale: Optional[float] = None,
+    q_chunk: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    b, s, h, d = q.shape
+    n_blocks, block_size, kvh, _ = k_cache.shape
+    w = block_tables.shape[1]
+    g = h // kvh
+    if scale is None:
+        scale = d ** -0.5
+
+    # largest divisor of S that fits the chunk budget (buckets are usually
+    # powers of two, giving sc == q_chunk; odd max_model_len still works)
+    sc = next(c for c in range(min(s, q_chunk), 0, -1) if s % c == 0)
+    num_chunks = s // sc
+
+    qg = q.reshape(b, num_chunks, sc, kvh, g, d)  # chunk dim explicit
+    # re-flatten chunks into the grid: block index_map picks (b, c)
+    qg = qg.reshape(b * num_chunks, sc, kvh, g, d)
+
+    def last_needed_page(b_idx, c, ctx_ref, base_ref):
+        # furthest page this (b, chunk) can touch — clamping the page grid
+        # index to it makes trailing steps re-request the same page, which
+        # the pipeline skips (no DMA) and the kernel skips (not live).
+        by_ctx = jnp.maximum(ctx_ref[b_idx] - 1, 0) // block_size
+        by_causal = jnp.maximum(base_ref[b_idx] + (c + 1) * sc - 1, 0) // block_size
+        return jnp.minimum(by_ctx, by_causal)
+
+    def q_map(i, c, wi, bt, ctx, base):
+        return (i * num_chunks + c, 0, 0, 0, 0)
+
+    def kv_map(i, c, wi, bt, ctx, base):
+        wi = jnp.minimum(wi, last_needed_page(i, c, ctx, base))
+        return (bt[i, wi], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, num_chunks, w),
+        in_specs=[
+            pl.BlockSpec((1, sc, kvh, g, d), q_map),
+            pl.BlockSpec((1, block_size, kvh, d), kv_map),
+            pl.BlockSpec((1, block_size, kvh, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, sc, kvh, g, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((kvh * sc * g, 128), jnp.float32),
+            pltpu.VMEM((kvh * sc * g, 128), jnp.float32),
+            pltpu.VMEM((kvh * sc * g, d), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, block_size=block_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * num_chunks, sc, kvh, g, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        base_pos.astype(jnp.int32),
+        qg,
+        k_cache,
+        v_cache,
+    )
+    return out.reshape(b, s, h, d)
