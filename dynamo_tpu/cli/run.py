@@ -57,6 +57,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=None)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    # disaggregated prefill/decode (xPyD)
+    p.add_argument("--remote-prefill", action="store_true",
+                   help="decode worker: offload long prefills to the prefill queue")
+    p.add_argument("--max-local-prefill-length", type=int, default=1000,
+                   help="un-cached prompt tokens above this go remote")
+    p.add_argument("--max-prefill-queue-size", type=int, default=2,
+                   help="skip remote prefill when the queue is this deep")
+    p.add_argument("--advertise-host", default="127.0.0.1",
+                   help="host other workers use to reach this worker's KV transfer server")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -71,7 +80,7 @@ def load_mdc(flags):
     )
 
 
-async def build_core_engine(engine_spec: str, flags, mdc, events=None):
+async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None):
     """Token-level engine (PreprocessedRequest → EngineOutput stream)."""
     from ..llm.engines.echo import EchoEngineCore
 
@@ -80,7 +89,28 @@ async def build_core_engine(engine_spec: str, flags, mdc, events=None):
     if engine_spec == "jax":
         from ..engine.serving import JaxServingEngine
 
-        return await JaxServingEngine.create(mdc, flags, events=events)
+        disagg_factory = None
+        if getattr(flags, "remote_prefill", False):
+            if drt is None:
+                raise SystemExit("--remote-prefill requires distributed mode (in=dyn://)")
+
+            async def disagg_factory(runner):
+                from ..disagg import DisaggRouter, RemotePrefillCoordinator
+
+                router = DisaggRouter(
+                    max_local_prefill_length=flags.max_local_prefill_length,
+                    max_prefill_queue_size=flags.max_prefill_queue_size,
+                    model_name=flags.model_name,
+                    namespace=flags.namespace,
+                )
+                return await RemotePrefillCoordinator(
+                    drt, runner, namespace=flags.namespace,
+                    router=router, advertise_host=flags.advertise_host,
+                ).start()
+
+        return await JaxServingEngine.create(
+            mdc, flags, events=events, disagg_factory=disagg_factory
+        )
     raise SystemExit(f"unknown core engine {engine_spec!r}")
 
 
@@ -103,7 +133,7 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
 
         mdc = load_mdc(flags)
         tokenizer = HFTokenizer.from_pretrained_dir(flags.model_path)
-        core = await build_core_engine(engine_spec, flags, mdc, events)
+        core = await build_core_engine(engine_spec, flags, mdc, events, drt=drt)
         return (
             build_pipeline([OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core),
             mdc,
@@ -236,7 +266,9 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         instance_id = f"w-{uuid.uuid4().hex[:12]}"
         publisher = KvEventPublisher(endpoint.component, instance_id)
         publisher.start()
-        core = await build_core_engine(engine_spec, flags, mdc, events=publisher.as_sink())
+        core = await build_core_engine(
+            engine_spec, flags, mdc, events=publisher.as_sink(), drt=drt
+        )
 
         async def handler(payload, ctx):
             async for out in core.generate(Context(payload, ctx)):
@@ -251,7 +283,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         print(f"token-level worker {instance_id} serving {path}", flush=True)
 
     else:
-        engine, mdc = await build_engine(engine_spec, flags)
+        engine, mdc = await build_engine(engine_spec, flags, drt=drt)
         serving = await endpoint.serve(make_openai_handler(engine))
         name = flags.model_name or (mdc.display_name if mdc else "echo")
         model_type = "both" if mdc is not None else "chat"
@@ -264,11 +296,42 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         await serving.stop()
 
 
+async def run_prefill(flags) -> None:
+    """Dedicated prefill worker: consumes the namespace prefill queue.
+
+    The prefill_worker role of the disagg graph (reference:
+    examples/llm/components/prefill_worker.py poll loop)."""
+    from ..disagg import PrefillWorker
+    from ..engine.model_runner import ModelRunner
+    from ..engine.serving import engine_config_from_mdc
+    from ..runtime.component import DistributedRuntime
+
+    if flags.store_port is None:
+        raise SystemExit("in=prefill requires --store-port")
+    mdc = load_mdc(flags)
+    engine_config = engine_config_from_mdc(mdc, flags)
+    drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
+    loop = asyncio.get_running_loop()
+    runner = await loop.run_in_executor(
+        None, lambda: ModelRunner(engine_config, model_dir=mdc.model_path)
+    )
+    worker = PrefillWorker(drt, runner, engine_config, namespace=flags.namespace)
+    print(f"prefill worker consuming {worker.queue.name}", flush=True)
+    try:
+        await worker.run()
+    finally:
+        await worker.close()
+        await drt.close()
+
+
 async def amain(argv: List[str]) -> None:
     src, engine_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
     logging.basicConfig(level=logging.DEBUG if flags.verbose else logging.INFO)
 
+    if src == "prefill":
+        await run_prefill(flags)
+        return
     if src.startswith("dyn://"):
         await run_worker(flags, engine_spec, src)
         return

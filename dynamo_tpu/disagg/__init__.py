@@ -1,0 +1,29 @@
+"""Disaggregated prefill/decode serving ("xPyD").
+
+The flagship capability of the reference (reference: docs/disagg_serving.md,
+examples/llm/components/{worker,prefill_worker}.py): decode workers decide
+per-request whether to prefill locally or enqueue the prompt on a shared
+work queue; dedicated prefill workers pop the queue, compute the KV cache,
+and push the blocks directly into the decode worker's device memory.
+
+TPU mapping (SURVEY.md §7.6): NATS JetStream → the dynstore work queue
+(ack + visibility-timeout redelivery); NIXL RDMA writes → the KV transfer
+plane (`transfer.py`) moving paged blocks HBM→HBM with a host bounce,
+descriptors registered in the discovery plane exactly like NIXL metadata.
+"""
+
+from .protocols import RemotePrefillRequest, PrefillQueue
+from .router import DisaggRouter
+from .transfer import KvTransferServer, KvTransferClient
+from .coordinator import RemotePrefillCoordinator
+from .prefill_worker import PrefillWorker
+
+__all__ = [
+    "RemotePrefillRequest",
+    "PrefillQueue",
+    "DisaggRouter",
+    "KvTransferServer",
+    "KvTransferClient",
+    "RemotePrefillCoordinator",
+    "PrefillWorker",
+]

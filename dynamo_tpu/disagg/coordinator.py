@@ -1,0 +1,160 @@
+"""Decode-side remote-prefill coordination.
+
+Owns what the reference's VllmWorker did around its engine (reference:
+examples/llm/components/worker.py:176-225): decide local-vs-remote per
+request (conditional disagg + queue-depth feedback), allocate the KV blocks,
+enqueue a RemotePrefillRequest, and hand the scheduler a future that
+resolves when the prefill worker has pushed the blocks and committed the
+first token.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Dict, Optional, Sequence
+
+import msgpack
+import numpy as np
+
+from .protocols import PrefillQueue, RemotePrefillRequest
+from .router import DisaggRouter
+from .transfer import KvTransferServer, transfer_key
+
+logger = logging.getLogger(__name__)
+
+
+class RemotePrefillCoordinator:
+    """Lives inside a decode worker; one per engine."""
+
+    def __init__(
+        self,
+        drt,
+        runner,
+        namespace: str = "public",
+        component: str = "backend",
+        router: Optional[DisaggRouter] = None,
+        engine_id: Optional[str] = None,
+        advertise_host: str = "127.0.0.1",
+        depth_refresh_s: float = 0.25,
+        prefill_timeout_s: float = 120.0,
+    ):
+        self.drt = drt
+        self.runner = runner
+        self.namespace = namespace
+        self.component = component
+        self.router = router or DisaggRouter(namespace=namespace)
+        self.engine_id = engine_id or f"eng-{uuid.uuid4().hex[:12]}"
+        self.queue = PrefillQueue(drt.messaging, namespace)
+        self.prefill_timeout_s = prefill_timeout_s
+        self._server = KvTransferServer(
+            scatter=self._scatter,
+            on_commit=self._commit,
+            authorize=self._authorize,
+            host=advertise_host,
+        )
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._queue_depth = 0
+        self._depth_refresh_s = depth_refresh_s
+        self._depth_task: Optional[asyncio.Task] = None
+        # telemetry
+        self.remote_submitted = 0
+        self.remote_completed = 0
+
+    # ---------- lifecycle ----------
+
+    async def start(self) -> "RemotePrefillCoordinator":
+        await self._server.start()
+        await self.router.start(self.drt.discovery, self.drt.runtime)
+        lease = await self.drt.discovery.primary_lease()
+        await self.drt.discovery.kv_put(
+            transfer_key(self.namespace, self.component, self.engine_id),
+            msgpack.packb(self._server.descriptor, use_bin_type=True),
+            lease_id=lease.id,
+        )
+        self._depth_task = self.drt.runtime.spawn(self._depth_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._depth_task:
+            self._depth_task.cancel()
+        await self.router.stop()
+        await self._server.close()
+
+    async def _depth_loop(self) -> None:
+        while True:
+            try:
+                self._queue_depth = await self.queue.depth()
+            except Exception:
+                logger.debug("queue depth refresh failed", exc_info=True)
+            await asyncio.sleep(self._depth_refresh_s)
+
+    # ---------- scheduler-facing API ----------
+
+    def decide(self, prompt_len: int, prefix_hit_len: int) -> bool:
+        """Should this prompt's prefill go remote? (sync; cached depth)"""
+        return self.router.prefill_remote(
+            prompt_len, prefix_hit_len, self._queue_depth
+        )
+
+    async def submit(self, request_id: str, token_ids: Sequence[int],
+                     block_ids: Sequence[int], num_cached: int,
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 1.0, seed: Optional[int] = None,
+                     want_logprobs: bool = False) -> asyncio.Future:
+        """Enqueue the prompt; returns a future → (first_token, logprob)."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        await self.queue.push(RemotePrefillRequest(
+            request_id=request_id,
+            engine_id=self.engine_id,
+            token_ids=list(map(int, token_ids)),
+            block_ids=list(map(int, block_ids)),
+            num_cached=num_cached,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            want_logprobs=want_logprobs,
+        ))
+        self.remote_submitted += 1
+        self._queue_depth += 1  # optimistic until the next refresh
+        return fut
+
+    def cancel(self, request_id: str) -> None:
+        """Stop accepting frames for a request (cancel / timeout fallback)."""
+        fut = self._pending.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+    # ---------- transfer-server callbacks ----------
+
+    def _authorize(self, request_id: str, block_ids) -> bool:
+        return request_id in self._pending
+
+    async def _scatter(self, block_ids, k: np.ndarray, v: np.ndarray) -> None:
+        # Stage the host→device copy in a worker thread (thread-safe, touches
+        # no shared state); the cache-mutating scatter dispatch stays on the
+        # event loop so it serializes with the scheduler's step calls.
+        import jax
+
+        loop = asyncio.get_running_loop()
+        k_dev, v_dev = await loop.run_in_executor(
+            None, lambda: (jax.device_put(k), jax.device_put(v))
+        )
+        self.runner.scatter_blocks(block_ids, k_dev, v_dev)
+
+    def _commit(self, request_id: str, first_token: int,
+                logprob: Optional[float]) -> None:
+        fut = self._pending.pop(request_id, None)
+        if fut is None or fut.done():
+            logger.warning("commit for unknown request %s", request_id)
+            return
+        self.remote_completed += 1
+        fut.set_result((first_token, logprob))
+
+    def metrics(self) -> dict:
+        return {
+            "remote_prefill_submitted": self.remote_submitted,
+            "remote_prefill_completed": self.remote_completed,
+            "remote_prefill_pending": len(self._pending),
+            "prefill_queue_depth": self._queue_depth,
+        }

@@ -1,0 +1,173 @@
+"""Prefill worker: pops the queue, computes KV, pushes it to decode workers.
+
+The reference's PrefillWorker (reference:
+examples/llm/components/prefill_worker.py:50-181 — poll loop over the NATS
+JetStream queue, NIXL metadata lookup in etcd, prefill with max_tokens=1,
+RDMA write into the decode worker's blocks). Here: pop the dynstore work
+queue, resolve the decode engine's transfer descriptor from discovery, run
+one bucketed prefill step on the local runner (using the worker's *own*
+prefix cache to skip recomputation), gather the needed blocks from HBM and
+stream them to the decode engine, then commit the sampled first token.
+The queue item is acked only after the commit is acknowledged — a crash
+anywhere earlier redelivers the work to another prefill worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+import jax
+import msgpack
+import numpy as np
+
+from ..engine.block_allocator import BlockAllocator
+from ..engine.scheduler import build_prefill_arrays
+from ..tokens import compute_block_hashes
+from .protocols import PrefillQueue, RemotePrefillRequest
+from .transfer import KvTransferClient, transfer_key
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillWorker:
+    def __init__(
+        self,
+        drt,
+        runner,
+        config,
+        namespace: str = "public",
+        component: str = "backend",
+        transfer_chunk_blocks: int = 16,
+    ):
+        self.drt = drt
+        self.runner = runner
+        self.config = config
+        self.namespace = namespace
+        self.component = component
+        self.transfer_chunk_blocks = transfer_chunk_blocks
+        self.queue = PrefillQueue(drt.messaging, namespace)
+        self.allocator = BlockAllocator(
+            config.num_kv_blocks, config.kv_block_size,
+            config.enable_prefix_caching,
+        )
+        self.key = jax.random.PRNGKey(config.seed)
+        self._clients: Dict[str, KvTransferClient] = {}
+        self._stopping = False
+        # telemetry
+        self.prefills = 0
+        self.prefill_tokens = 0
+        self.transfer_bytes = 0
+
+    # ---------- main loop ----------
+
+    async def run(self) -> None:
+        while not self._stopping:
+            if not await self.serve_one(timeout=1.0):
+                continue
+
+    def stop(self) -> None:
+        self._stopping = True
+
+    async def serve_one(self, timeout: Optional[float] = None) -> bool:
+        """Pop and fully process one queue item. Returns False on timeout."""
+        popped = await self.queue.pop(timeout=timeout)
+        if popped is None:
+            return False
+        rpr, ack = popped
+        try:
+            await self._handle(rpr)
+        except Exception:
+            # no ack — the visibility window redelivers this item
+            logger.exception("prefill of %s failed; leaving for redelivery",
+                             rpr.request_id)
+            stale = self._clients.pop(rpr.engine_id, None)
+            if stale is not None:
+                await stale.close()
+            return True
+        ack()
+        return True
+
+    # ---------- the work ----------
+
+    async def _handle(self, rpr: RemotePrefillRequest) -> None:
+        cfg = self.config
+        bs = cfg.kv_block_size
+        prompt = rpr.token_ids
+        loop = asyncio.get_running_loop()
+
+        block_ids, num_cached = self.allocator.allocate_prompt(prompt)
+        try:
+            arrays = build_prefill_arrays(cfg, prompt, num_cached, block_ids)
+            if rpr.seed is not None:
+                self.key = jax.random.fold_in(self.key, int(rpr.seed))
+            self.key, step_key = jax.random.split(self.key)
+            next_tokens, lps = self.runner.step(
+                *arrays,
+                np.asarray([rpr.temperature], np.float32),
+                np.asarray([rpr.top_k], np.int32),
+                np.asarray([rpr.top_p], np.float32),
+                step_key,
+            )
+            token, lp = await loop.run_in_executor(
+                None,
+                lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0])),
+            )
+
+            # feed the local prefix cache so future prompts skip this work
+            hashes = compute_block_hashes(prompt, bs)
+            parent = None
+            for i, h in enumerate(hashes):
+                self.allocator.register_complete(block_ids[i], h, parent)
+                parent = h
+
+            # gather + push the blocks the decode side doesn't already have
+            first_block = rpr.num_cached // bs
+            src_ids = block_ids[first_block:]
+            dst_ids = rpr.block_ids[first_block : len(block_ids)]
+            k, v = await loop.run_in_executor(
+                None, lambda: self.runner.gather_blocks(src_ids)
+            )
+            client = await self._client(rpr.engine_id)
+            await client.send_blocks(
+                rpr.request_id, dst_ids, k, v,
+                chunk_blocks=self.transfer_chunk_blocks,
+            )
+            await client.send_commit(
+                rpr.request_id, token, lp if rpr.want_logprobs else None
+            )
+            self.prefills += 1
+            self.prefill_tokens += len(prompt) - num_cached
+            self.transfer_bytes += k.nbytes + v.nbytes
+        finally:
+            self.allocator.free_blocks(block_ids)
+
+    async def _client(self, engine_id: str) -> KvTransferClient:
+        client = self._clients.get(engine_id)
+        if client is not None:
+            return client
+        raw = await self.drt.discovery.kv_get(
+            transfer_key(self.namespace, self.component, engine_id)
+        )
+        if raw is None:
+            raise ConnectionError(f"no kv transfer descriptor for {engine_id}")
+        desc = msgpack.unpackb(raw, raw=False)
+        client = await KvTransferClient(desc["host"], desc["port"]).connect()
+        self._clients[engine_id] = client
+        return client
+
+    def metrics(self) -> dict:
+        return {
+            "prefills_total": self.prefills,
+            "prefill_tokens_total": self.prefill_tokens,
+            "transfer_bytes_total": self.transfer_bytes,
+            "kv_active_blocks": self.allocator.used,
+            "kv_total_blocks": self.allocator.num_blocks,
+        }
+
+    async def close(self) -> None:
+        self.stop()
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()

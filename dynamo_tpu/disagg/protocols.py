@@ -1,0 +1,81 @@
+"""Remote-prefill wire protocol + the prefill work queue.
+
+Mirrors the reference's RemotePrefillRequest flow (reference:
+examples/llm/components/worker.py:165-174 enqueue of block ids + engine id;
+examples/llm/utils/nats_queue.py:27-155 JetStream work queue with one
+consumer group) on top of the runtime's work-queue primitive, which gives
+ack + visibility-timeout redelivery — a crashed prefill worker's items are
+handed to another worker automatically (elastic recovery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import msgpack
+
+
+@dataclasses.dataclass
+class RemotePrefillRequest:
+    """A prompt whose KV should be computed remotely and pushed back.
+
+    ``block_ids`` are the *decode worker's* cache slots covering the prompt;
+    the prefill worker writes the suffix after ``num_cached`` tokens (the
+    decode worker's local prefix-cache hit) into them via the transfer plane.
+    """
+
+    request_id: str
+    engine_id: str            # decode engine that owns the blocks
+    token_ids: List[int]
+    block_ids: List[int]
+    num_cached: int = 0       # decode-side prefix-hit tokens (block multiple)
+    # sampling for the single prefill-sampled token (max_tokens=1 semantics)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    want_logprobs: bool = False
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(dataclasses.asdict(self), use_bin_type=True)
+
+    @classmethod
+    def from_wire(cls, data: bytes) -> "RemotePrefillRequest":
+        return cls(**msgpack.unpackb(data, raw=False))
+
+
+class PrefillQueue:
+    """The shared prefill work queue, one per namespace.
+
+    Decode workers push; prefill workers pop with a visibility window and
+    ack only after the KV transfer has been committed, so worker death
+    mid-prefill redelivers the item.
+    """
+
+    # Redelivery window. Kept >= the decode side's default prefill timeout
+    # (RemotePrefillCoordinator.prefill_timeout_s = 120 s) so a slow-but-alive
+    # prefill (e.g. cold-compile of a large bucket) isn't duplicated onto a
+    # second worker while the first is still going to deliver.
+    DEFAULT_VISIBILITY = 120.0
+
+    def __init__(self, messaging, namespace: str = "public",
+                 visibility: float = DEFAULT_VISIBILITY):
+        self.messaging = messaging
+        self.name = f"{namespace}.prefill_queue"
+        self.visibility = visibility
+
+    async def push(self, req: RemotePrefillRequest) -> None:
+        await self.messaging.queue_push(self.name, req.to_wire())
+
+    async def pop(self, timeout: Optional[float] = None):
+        """Returns (RemotePrefillRequest, ack_fn) or None on timeout."""
+        item = await self.messaging.queue_pop(
+            self.name, timeout=timeout, visibility=self.visibility
+        )
+        if item is None:
+            return None
+        return RemotePrefillRequest.from_wire(item.payload), item.ack
+
+    async def depth(self) -> int:
+        return await self.messaging.queue_depth(self.name)

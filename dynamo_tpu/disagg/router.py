@@ -1,0 +1,102 @@
+"""Conditional disaggregation router: local vs. remote prefill decision.
+
+Reference semantics (reference: lib/llm/src/disagg_router.rs:24-262 and the
+Python port examples/llm/components/disagg_router.py): prefill goes remote
+iff the un-cached prompt length exceeds a threshold AND the prefill queue
+is not backed up. The threshold is *live-updatable* through a watched
+discovery key, so operators can retune a running deployment — the analog
+of the reference's etcd watch at
+``public/components/disagg_router/models/chat/<model>``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+
+class DisaggRouter:
+    def __init__(
+        self,
+        max_local_prefill_length: int = 1000,
+        max_prefill_queue_size: int = 2,
+        model_name: Optional[str] = None,
+        namespace: str = "public",
+    ):
+        self.max_local_prefill_length = max_local_prefill_length
+        self.max_prefill_queue_size = max_prefill_queue_size
+        self.model_name = model_name
+        self.namespace = namespace
+        self._watch_task = None
+        self._watcher = None
+
+    def config_key(self) -> str:
+        return (
+            f"{self.namespace}/components/disagg_router/models/"
+            f"{self.model_name or '_default'}"
+        )
+
+    def prefill_remote(self, prefill_len: int, prefix_hit_len: int,
+                       queue_depth: int) -> bool:
+        """True → enqueue for remote prefill; False → prefill locally."""
+        return (
+            prefill_len - prefix_hit_len > self.max_local_prefill_length
+            and queue_depth < self.max_prefill_queue_size
+        )
+
+    # ---------- dynamic config ----------
+
+    def _apply(self, value: bytes) -> None:
+        try:
+            cfg = msgpack.unpackb(value, raw=False)
+        except Exception:
+            logger.warning("malformed disagg config update ignored")
+            return
+        if "max_local_prefill_length" in cfg:
+            self.max_local_prefill_length = int(cfg["max_local_prefill_length"])
+        if "max_prefill_queue_size" in cfg:
+            self.max_prefill_queue_size = int(cfg["max_prefill_queue_size"])
+        logger.info(
+            "disagg router config: max_local_prefill_length=%d max_prefill_queue_size=%d",
+            self.max_local_prefill_length, self.max_prefill_queue_size,
+        )
+
+    async def start(self, discovery, runtime=None) -> "DisaggRouter":
+        """Load current config and watch for live updates."""
+        snapshot, watcher = await discovery.watch_prefix(self.config_key())
+        for value in snapshot.values():
+            self._apply(value)
+        self._watcher = watcher
+
+        async def _watch():
+            async for ev in watcher:
+                if ev.type.value == "put":
+                    self._apply(ev.value)
+
+        import asyncio
+
+        spawn = runtime.spawn if runtime is not None else asyncio.create_task
+        self._watch_task = spawn(_watch())
+        return self
+
+    async def stop(self) -> None:
+        if self._watcher is not None:
+            self._watcher.cancel()
+
+    @staticmethod
+    async def publish_config(
+        discovery, namespace: str, model_name: Optional[str],
+        max_local_prefill_length: int, max_prefill_queue_size: int,
+    ) -> None:
+        """Operator-side: push a new threshold to all live routers."""
+        key = (
+            f"{namespace}/components/disagg_router/models/{model_name or '_default'}"
+        )
+        await discovery.kv_put(key, msgpack.packb({
+            "max_local_prefill_length": max_local_prefill_length,
+            "max_prefill_queue_size": max_prefill_queue_size,
+        }, use_bin_type=True))

@@ -1,0 +1,197 @@
+"""KV transfer plane: push paged KV blocks into a remote engine's cache.
+
+The TPU-native stand-in for NIXL RDMA writes (reference:
+docs/disagg_serving.md:60-100, examples/llm/utils/nixl.py:59-109 — prefill
+worker loads the decode worker's memory descriptors from etcd and writes
+computed KV straight into its GPU blocks). Here each decode engine runs a
+``KvTransferServer``; its (host, port, engine_id) descriptor is registered
+in the discovery plane under the component, and prefill workers dial it and
+stream block frames. Device↔host movement uses the runner's jitted
+gather/scatter programs (XLA's fused gather/scatter is the analog of the
+reference's CUDA copy kernel, block_copy.cu:40-758); frames are chunked so
+the receive side overlaps scatter with the next frame's network read —
+mirroring CopyStream::trigger_layer per-layer overlap semantics.
+
+Wire format, length-prefixed msgpack header + raw payloads:
+
+  {type: "blocks", request_id, block_ids, shape, dtype, k_bytes, v_bytes}
+  <k raw bytes> <v raw bytes>
+  {type: "commit", request_id, first_token, logprob, generated}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import struct
+from typing import Callable, Dict, List, Optional, Sequence
+
+import msgpack
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAX_HEADER = 1 << 20
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def transfer_key(namespace: str, component: str, engine_id: str) -> str:
+    return f"{namespace}/components/{component}/kv_transfer/{engine_id}"
+
+
+async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    return await reader.readexactly(n)
+
+
+class KvTransferServer:
+    """Receives block frames and scatters them into the local paged cache."""
+
+    def __init__(
+        self,
+        scatter: Callable[[Sequence[int], np.ndarray, np.ndarray], None],
+        on_commit: Callable[[str, int, Optional[float]], None],
+        authorize: Optional[Callable[[str, Sequence[int]], bool]] = None,
+        host: str = "127.0.0.1",
+    ):
+        self.scatter = scatter
+        self.on_commit = on_commit
+        # guards against late frames for cancelled/unknown requests writing
+        # into reallocated blocks
+        self.authorize = authorize or (lambda request_id, ids: True)
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "KvTransferServer":
+        self._server = await asyncio.start_server(self._handle, self.host, 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def descriptor(self) -> dict:
+        return {"host": self.host, "port": self.port}
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    raw_len = await _read_exact(reader, 4)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                (hlen,) = struct.unpack(">I", raw_len)
+                if hlen > MAX_HEADER:
+                    logger.error("transfer header too large: %d", hlen)
+                    return
+                header = msgpack.unpackb(await _read_exact(reader, hlen), raw=False)
+                mtype = header.get("type")
+                if mtype == "blocks":
+                    k_raw = await _read_exact(reader, header["k_bytes"])
+                    v_raw = await _read_exact(reader, header["v_bytes"])
+                    if not self.authorize(header["request_id"], header["block_ids"]):
+                        continue  # request gone — drop the frame
+                    dtype = _np_dtype(header["dtype"])
+                    shape = tuple(header["shape"])
+                    k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
+                    v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
+                    # scatter may be a coroutine that stages the host→device
+                    # copy off-loop so decode streaming isn't stalled
+                    result = self.scatter(header["block_ids"], k, v)
+                    if inspect.isawaitable(result):
+                        await result
+                elif mtype == "commit":
+                    self.on_commit(
+                        header["request_id"], header["first_token"],
+                        header.get("logprob"),
+                    )
+                    # ack the commit so the sender can safely release blocks
+                    writer.write(struct.pack(">I", 1) + b"\x01")
+                    await writer.drain()
+                else:
+                    logger.error("unknown transfer frame type %r", mtype)
+                    return
+        except Exception:
+            logger.exception("kv transfer connection failed")
+        finally:
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class KvTransferClient:
+    """Prefill-side connection pushing block frames to one decode engine."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> "KvTransferClient":
+        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    def _send_header(self, header: dict) -> None:
+        data = msgpack.packb(header, use_bin_type=True)
+        self.writer.write(struct.pack(">I", len(data)) + data)
+
+    async def send_blocks(
+        self,
+        request_id: str,
+        block_ids: List[int],
+        k_blocks: np.ndarray,   # [L, n, bs, KVH, D]
+        v_blocks: np.ndarray,
+        chunk_blocks: int = 16,
+    ) -> None:
+        """Stream blocks in chunks so the receiver overlaps scatter w/ reads."""
+        n = len(block_ids)
+        assert k_blocks.shape[1] == n
+        for i in range(0, n, chunk_blocks):
+            ids = block_ids[i : i + chunk_blocks]
+            k = np.ascontiguousarray(k_blocks[:, i : i + len(ids)])
+            v = np.ascontiguousarray(v_blocks[:, i : i + len(ids)])
+            kb, vb = k.tobytes(), v.tobytes()
+            self._send_header({
+                "type": "blocks",
+                "request_id": request_id,
+                "block_ids": list(map(int, ids)),
+                "shape": list(k.shape),
+                "dtype": k.dtype.name,
+                "k_bytes": len(kb),
+                "v_bytes": len(vb),
+            })
+            self.writer.write(kb)
+            self.writer.write(vb)
+            await self.writer.drain()
+
+    async def send_commit(self, request_id: str, first_token: int,
+                          logprob: Optional[float] = None) -> None:
+        self._send_header({
+            "type": "commit",
+            "request_id": request_id,
+            "first_token": int(first_token),
+            "logprob": None if logprob is None else float(logprob),
+        })
+        await self.writer.drain()
+        # wait for the receiver's ack — after this the decode side owns the KV
+        await _read_exact(self.reader, 5)
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except Exception:
+                pass

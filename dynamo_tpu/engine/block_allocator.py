@@ -90,14 +90,22 @@ class BlockAllocator:
             matched.append(h)
         return blocks, matched
 
-    def allocate_prompt(self, token_ids: List[int]) -> Tuple[List[int], int]:
+    def allocate_prompt(
+        self, token_ids: List[int], cached_blocks: Optional[List[int]] = None
+    ) -> Tuple[List[int], int]:
         """Allocate blocks for a prompt; reuse cached prefix blocks.
 
+        ``cached_blocks`` may carry a just-computed ``match_prefix`` result so
+        hot callers don't hash the prompt twice (valid only if no allocator
+        mutation happened in between).
         Returns (block_ids covering ceil(len/bs) blocks, num_cached_tokens).
         Raises MemoryError if the demand cannot be met (caller queues).
         """
         n_needed = max(1, -(-len(token_ids) // self.block_size))
-        cached_blocks, _ = self.match_prefix(token_ids)
+        if cached_blocks is None:
+            cached_blocks, _ = self.match_prefix(token_ids)
+        else:
+            cached_blocks = list(cached_blocks)
         # a full-prompt hit still needs the last block re-filled only if the
         # prompt ends mid-block; always recompute at least one token so the
         # engine has logits to sample from

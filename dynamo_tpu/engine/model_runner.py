@@ -111,6 +111,7 @@ class ModelRunner:
 
         self._step_compiled = {}
         self._build_step()
+        self._build_block_ops()
 
     # ---------- the unified step program ----------
 
@@ -178,6 +179,97 @@ class ModelRunner:
         )
         self.kv_cache = (k, v)
         return next_tokens, lps
+
+    # ---------- paged-block gather / scatter ----------
+    #
+    # The KV data-movement primitive behind disaggregated prefill→decode
+    # transfer and host-memory offload — the TPU-native role of the
+    # reference's CUDA block-copy kernel + NIXL RDMA path (reference:
+    # lib/llm/src/kernels/block_copy.cu:40-758, lib/llm/src/kv/layer.rs
+    # CopyStream). XLA compiles the gather/scatter over the [L, N, bs, H, D]
+    # cache; block counts are bucketed so each bucket compiles once.
+
+    BLOCK_OP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+    def _build_block_ops(self):
+        repl = NamedSharding(self.mesh, P())
+
+        def gather(k_cache, v_cache, ids):
+            return k_cache[:, ids], v_cache[:, ids]
+
+        self._gather_jit = jax.jit(
+            gather,
+            in_shardings=(self.cache_sharding, self.cache_sharding, repl),
+            out_shardings=(repl, repl),
+        )
+
+        def scatter(k_cache, v_cache, ids, k_blocks, v_blocks):
+            return (
+                k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype)),
+                v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype)),
+            )
+
+        self._scatter_jit = jax.jit(
+            scatter,
+            donate_argnums=(0, 1),
+            in_shardings=(self.cache_sharding, self.cache_sharding, repl, repl, repl),
+            out_shardings=(self.cache_sharding, self.cache_sharding),
+        )
+
+    def _bucket_ids(self, n: int) -> int:
+        for b in self.BLOCK_OP_BUCKETS:
+            if n <= b:
+                return b
+        return self.BLOCK_OP_BUCKETS[-1]
+
+    def gather_blocks(self, block_ids) -> Tuple[np.ndarray, np.ndarray]:
+        """Read KV blocks out of HBM → host arrays [L, n, bs, KVH, D] ×2."""
+        ids = list(block_ids)
+        k_parts, v_parts = [], []
+        i = 0
+        while i < len(ids):
+            chunk = ids[i : i + self.BLOCK_OP_BUCKETS[-1]]
+            bucket = self._bucket_ids(len(chunk))
+            padded = chunk + [chunk[-1]] * (bucket - len(chunk))
+            k, v = self._gather_jit(
+                self.kv_cache[0], self.kv_cache[1], jnp.asarray(padded, jnp.int32)
+            )
+            k_parts.append(np.asarray(jax.device_get(k))[:, : len(chunk)])
+            v_parts.append(np.asarray(jax.device_get(v))[:, : len(chunk)])
+            i += len(chunk)
+        if len(k_parts) == 1:
+            return k_parts[0], v_parts[0]
+        return np.concatenate(k_parts, axis=1), np.concatenate(v_parts, axis=1)
+
+    def scatter_blocks(self, block_ids, k_blocks, v_blocks) -> None:
+        """Write KV block data [L, n, bs, KVH, D] into HBM cache slots.
+
+        Accepts numpy OR already-device-resident jax arrays (callers that
+        must not block the event loop stage with ``jax.device_put`` first).
+        """
+        ids = list(block_ids)
+        assert k_blocks.shape[1] == len(ids), (k_blocks.shape, len(ids))
+        kb_all = jnp.asarray(k_blocks)
+        vb_all = jnp.asarray(v_blocks)
+        i = 0
+        while i < len(ids):
+            chunk = ids[i : i + self.BLOCK_OP_BUCKETS[-1]]
+            bucket = self._bucket_ids(len(chunk))
+            pad = bucket - len(chunk)
+            padded_ids = chunk + [chunk[-1]] * pad
+            kb = kb_all[:, i : i + len(chunk)]
+            vb = vb_all[:, i : i + len(chunk)]
+            if pad:
+                # duplicate the last block's data for the repeated pad ids —
+                # identical values land on the same slot, so order is benign
+                kb = jnp.concatenate([kb, jnp.repeat(kb[:, -1:], pad, axis=1)], axis=1)
+                vb = jnp.concatenate([vb, jnp.repeat(vb[:, -1:], pad, axis=1)], axis=1)
+            k, v = self._scatter_jit(
+                self.kv_cache[0], self.kv_cache[1],
+                jnp.asarray(padded_ids, jnp.int32), kb, vb,
+            )
+            self.kv_cache = (k, v)
+            i += len(chunk)
 
     def warmup(self, decode_batch: Optional[int] = None) -> None:
         """Compile the decode-shape program up front."""

@@ -39,6 +39,33 @@ from .sampling import host_row
 logger = logging.getLogger(__name__)
 
 
+def build_prefill_arrays(cfg: EngineConfig, prompt: List[int], num_cached: int,
+                         block_ids: List[int]):
+    """Batch-of-1 arrays for one bucketed prefill step.
+
+    Shared by the scheduler's local prefill and the disagg prefill worker.
+    Returns (tokens, positions, block_tables, slot_mapping, context_lens,
+    last_idx) — the leading arguments of ``ModelRunner.step``.
+    """
+    suffix = prompt[num_cached:]
+    bucket = cfg.bucket_for(len(suffix))
+    w = cfg.blocks_per_seq
+    bs = cfg.kv_block_size
+
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, : len(suffix)] = suffix
+    positions = np.full((1, bucket), num_cached + len(suffix) - 1, np.int32)
+    positions[0, : len(suffix)] = np.arange(num_cached, len(prompt))
+    slot_map = np.full((1, bucket), -1, np.int32)
+    for i, pos in enumerate(range(num_cached, len(prompt))):
+        slot_map[0, i] = block_ids[pos // bs] * bs + pos % bs
+    btab = np.zeros((1, w), np.int32)
+    btab[0, : len(block_ids)] = block_ids
+    ctx_lens = np.asarray([len(prompt)], np.int32)
+    last_idx = np.asarray([len(suffix) - 1], np.int32)
+    return tokens, positions, btab, slot_map, ctx_lens, last_idx
+
+
 @dataclasses.dataclass
 class EngineRequest:
     request_id: str
@@ -61,6 +88,10 @@ class EngineRequest:
     seq: Optional[TokenSequence] = None
     registered_blocks: int = 0
     finish: Optional[FinishReason] = None
+    # disaggregated prefill state
+    remote_future: Optional[asyncio.Future] = None
+    remote_deadline: float = 0.0
+    remote_attempted: bool = False
 
     @property
     def max_new(self) -> int:
@@ -77,14 +108,17 @@ class Scheduler:
         runner: ModelRunner,
         config: EngineConfig,
         events: Optional[KvEventSink] = None,
+        disagg=None,  # Optional[RemotePrefillCoordinator]
     ):
         self.runner = runner
         self.config = config
+        self.disagg = disagg
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
             config.enable_prefix_caching, events,
         )
         self.waiting: deque = deque()
+        self.pending_remote: List[EngineRequest] = []
         self.slots: List[Optional[EngineRequest]] = [None] * config.max_batch_size
         self.wake = asyncio.Event()
         self.key = jax.random.PRNGKey(config.seed)
@@ -105,6 +139,13 @@ class Scheduler:
         self.wake.set()
         if self._task:
             await self._task
+        for er in self.pending_remote:
+            if self.disagg is not None:
+                self.disagg.cancel(er.request_id)
+            self._finish(er, FinishReason.CANCELLED)
+        self.pending_remote.clear()
+        if self.disagg is not None:
+            await self.disagg.close()
 
     def add_request(self, er: EngineRequest) -> None:
         (er.temperature, er.top_k, er.top_p) = host_row(er.req.sampling_options)
@@ -118,18 +159,21 @@ class Scheduler:
 
     def metrics(self) -> dict:
         active = sum(1 for s in self.slots if s is not None)
-        return {
+        out = {
             "request_active_slots": active,
             "request_total_slots": self.config.max_batch_size,
             "kv_active_blocks": self.allocator.used,
             "kv_total_blocks": self.allocator.num_blocks,
-            "num_requests_waiting": len(self.waiting),
+            "num_requests_waiting": len(self.waiting) + len(self.pending_remote),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": (
                 self.prefix_hit_tokens / self.prefix_total_tokens
                 if self.prefix_total_tokens else 0.0
             ),
         }
+        if self.disagg is not None:
+            out.update(self.disagg.metrics())
+        return out
 
     # ---------- helpers ----------
 
@@ -197,9 +241,17 @@ class Scheduler:
                 if er.ctx.is_stopped:
                     self._finish(er, FinishReason.CANCELLED)
 
+            # remote prefill completions / cancellations / timeouts
+            if self.pending_remote:
+                progressed |= self._reap_remote()
+
             # admission: prefill while there's a free slot and memory
             while self.waiting and self._free_slot() is not None:
                 er = self.waiting[0]
+                if self.disagg is not None and await self._try_submit_remote(er):
+                    self.waiting.popleft()
+                    progressed = True
+                    continue
                 try:
                     ok = await self._prefill(loop, er)
                 except MemoryError:
@@ -218,11 +270,115 @@ class Scheduler:
             if not progressed:
                 self.wake.clear()
                 if not self.waiting and not any(self.slots):
-                    await self.wake.wait()
+                    if self.pending_remote:
+                        # sleep but wake on remote completion or timeout check
+                        try:
+                            await asyncio.wait_for(self.wake.wait(), timeout=0.5)
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        await self.wake.wait()
                 else:
                     await asyncio.sleep(0.001)
             else:
                 await asyncio.sleep(0)  # let I/O run between steps
+
+    # ---------- disaggregated prefill (decode side) ----------
+
+    async def _try_submit_remote(self, er: EngineRequest) -> bool:
+        """Conditional disagg: enqueue this prompt for remote prefill?
+
+        Mirrors the decode worker's decision point (reference:
+        examples/llm/components/worker.py:180-195 — disagg router verdict
+        from prompt length, prefix-hit length, and prefill queue depth).
+        """
+        if er.remote_attempted:
+            return False  # already tried remote once — prefill locally
+        cached_blocks, _ = self.allocator.match_prefix(er.prompt)
+        prefix_hit = len(cached_blocks) * self.config.kv_block_size
+        if not self.disagg.decide(len(er.prompt), prefix_hit):
+            return False
+        er.remote_attempted = True
+        try:
+            er.block_ids, er.num_cached = self.allocator.allocate_prompt(
+                er.prompt, cached_blocks=cached_blocks
+            )
+        except MemoryError:
+            return False
+        try:
+            er.remote_future = await self.disagg.submit(
+                er.request_id, er.prompt, er.block_ids, er.num_cached,
+                temperature=er.temperature, top_k=er.top_k, top_p=er.top_p,
+                seed=er.req.sampling_options.seed,
+                want_logprobs=er.want_logprobs,
+            )
+        except Exception:
+            # queue unreachable — release and let the local path take it
+            logger.exception("remote prefill submit failed for %s; going local",
+                             er.request_id)
+            self.allocator.free_blocks(er.block_ids)
+            er.block_ids = []
+            er.num_cached = 0
+            return False
+        self.prefix_hit_tokens += er.num_cached
+        self.prefix_total_tokens += len(er.prompt)
+        er.remote_deadline = time.monotonic() + self.disagg.prefill_timeout_s
+        er.remote_future.add_done_callback(lambda _f: self.wake.set())
+        self.pending_remote.append(er)
+        return True
+
+    def _reap_remote(self) -> bool:
+        """Install completed remote prefills; handle cancels and timeouts."""
+        progressed = False
+        now = time.monotonic()
+        for er in list(self.pending_remote):
+            if er.ctx.is_stopped:
+                self.pending_remote.remove(er)
+                self.disagg.cancel(er.request_id)
+                self._finish(er, FinishReason.CANCELLED)
+                progressed = True
+                continue
+            fut = er.remote_future
+            if fut.done() and not fut.cancelled():
+                slot = self._free_slot()
+                if slot is None:
+                    break  # keep completion ordering; wait for a slot
+                self.pending_remote.remove(er)
+                self._install_remote(er, slot)
+                progressed = True
+            elif now > er.remote_deadline:
+                # prefill worker lost / queue starved — fall back to local
+                logger.warning("remote prefill timeout for %s; local fallback",
+                               er.request_id)
+                self.pending_remote.remove(er)
+                self.disagg.cancel(er.request_id)
+                self.allocator.free_blocks(er.block_ids)
+                er.block_ids = []
+                er.num_cached = 0
+                er.remote_future = None
+                self.waiting.appendleft(er)
+                progressed = True
+        return progressed
+
+    def _install_remote(self, er: EngineRequest, slot: int) -> None:
+        """A remote prefill committed — enter the decode loop.
+
+        The prefill worker already wrote the KV blocks into our cache and
+        sampled the first token (max_tokens=1 semantics, reference:
+        examples/llm/components/prefill_worker.py:148-178)."""
+        token, lp = er.remote_future.result()
+        er.remote_future = None
+        er.slot = slot
+        self.slots[slot] = er
+        er.context_len = len(er.prompt)
+        er.pending_token = token
+        er.generated = 1
+        er.seq = TokenSequence(er.prompt, block_size=self.config.kv_block_size)
+        self._register_completed_blocks(er)
+        er.finish = self._check_finish(er, token)
+        self._emit(er, token, lp if er.want_logprobs else None)
+        if er.finish is not None:
+            self._finish(er, er.finish, emit=False)
 
     async def _prefill(self, loop, er: EngineRequest) -> bool:
         cfg = self.config
@@ -231,30 +387,15 @@ class Scheduler:
             return False
 
         er.block_ids, er.num_cached = self.allocator.allocate_prompt(er.prompt)
-        self.prefix_hit_tokens += er.num_cached
-        self.prefix_total_tokens += len(er.prompt)
+        if not er.remote_attempted:  # remote fallback already counted itself
+            self.prefix_hit_tokens += er.num_cached
+            self.prefix_total_tokens += len(er.prompt)
 
-        suffix = er.prompt[er.num_cached:]
-        bucket = cfg.bucket_for(len(suffix))
-        w = cfg.blocks_per_seq
-        bs = cfg.kv_block_size
-
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(suffix)] = suffix
-        positions = np.full((1, bucket), er.num_cached + len(suffix) - 1, np.int32)
-        positions[0, : len(suffix)] = np.arange(er.num_cached, len(er.prompt))
-        slot_map = np.full((1, bucket), -1, np.int32)
-        for i, pos in enumerate(range(er.num_cached, len(er.prompt))):
-            slot_map[0, i] = er.block_ids[pos // bs] * bs + pos % bs
-        btab = np.zeros((1, w), np.int32)
-        btab[0, : len(er.block_ids)] = er.block_ids
-        ctx_lens = np.asarray([len(er.prompt)], np.int32)
-        last_idx = np.asarray([len(suffix) - 1], np.int32)
-
+        arrays = build_prefill_arrays(cfg, er.prompt, er.num_cached, er.block_ids)
         self.key, step_key = jax.random.split(self.key)
         t0 = time.monotonic()
         next_tokens, lps = self.runner.step(
-            tokens, positions, btab, slot_map, ctx_lens, last_idx,
+            *arrays,
             np.asarray([er.temperature], np.float32),
             np.asarray([er.top_k], np.int32),
             np.asarray([er.top_p], np.float32),
@@ -264,15 +405,15 @@ class Scheduler:
             None, lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]))
         )
         self.steps += 1
-        logger.debug("prefill %s len=%d bucket=%d %.1fms", er.request_id,
-                     len(suffix), bucket, 1e3 * (time.monotonic() - t0))
+        logger.debug("prefill %s len=%d %.1fms", er.request_id,
+                     len(er.prompt) - er.num_cached, 1e3 * (time.monotonic() - t0))
 
         er.slot = slot
         self.slots[slot] = er
         er.context_len = len(er.prompt)
         er.pending_token = token
         er.generated = 1
-        er.seq = TokenSequence(er.prompt, block_size=bs)
+        er.seq = TokenSequence(er.prompt, block_size=cfg.kv_block_size)
         self._register_completed_blocks(er)
 
         er.finish = self._check_finish(er, token)

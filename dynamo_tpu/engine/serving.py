@@ -22,6 +22,23 @@ from .scheduler import EngineRequest, Scheduler
 logger = logging.getLogger(__name__)
 
 
+def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
+    """The one place MDC + CLI flags become an EngineConfig.
+
+    Shared by decode engines and prefill workers — block geometry MUST match
+    across disaggregated workers or transferred KV lands in the wrong slots.
+    """
+    model_cfg = ModelConfig.from_hf_config(mdc.config) if mdc.config else ModelConfig()
+    return EngineConfig(
+        model=model_cfg,
+        max_batch_size=getattr(flags, "max_batch_size", 8),
+        max_model_len=getattr(flags, "max_model_len", None)
+        or min(mdc.context_length, model_cfg.max_position_embeddings),
+        kv_block_size=mdc.kv_block_size,
+        tp_size=getattr(flags, "tensor_parallel_size", 1),
+    )
+
+
 class JaxServingEngine(AsyncEngine):
     def __init__(self, runner: ModelRunner, scheduler: Scheduler, config: EngineConfig):
         self.runner = runner
@@ -38,25 +55,25 @@ class JaxServingEngine(AsyncEngine):
         events: Optional[KvEventSink] = None,
         mesh=None,
         warmup: bool = True,
+        disagg_factory=None,
     ) -> "JaxServingEngine":
-        """Build from a ModelDeploymentCard (+CLI flags or explicit config)."""
+        """Build from a ModelDeploymentCard (+CLI flags or explicit config).
+
+        ``disagg_factory(runner) -> RemotePrefillCoordinator`` enables
+        conditional remote prefill (disaggregated serving) on this engine.
+        """
         if engine_config is None:
-            model_cfg = ModelConfig.from_hf_config(mdc.config) if mdc.config else ModelConfig()
-            engine_config = EngineConfig(
-                model=model_cfg,
-                max_batch_size=getattr(flags, "max_batch_size", 8),
-                max_model_len=getattr(flags, "max_model_len", None)
-                or min(mdc.context_length, model_cfg.max_position_embeddings),
-                kv_block_size=mdc.kv_block_size,
-                tp_size=getattr(flags, "tensor_parallel_size", 1),
-            )
+            engine_config = engine_config_from_mdc(mdc, flags)
         loop = asyncio.get_running_loop()
         runner = await loop.run_in_executor(
             None,
             lambda: ModelRunner(engine_config, params=params, mesh=mesh,
                                 model_dir=mdc.model_path),
         )
-        scheduler = Scheduler(runner, engine_config, events)
+        disagg = None
+        if disagg_factory is not None:
+            disagg = await disagg_factory(runner)
+        scheduler = Scheduler(runner, engine_config, events, disagg=disagg)
         engine = cls(runner, scheduler, engine_config)
         if warmup:
             await loop.run_in_executor(None, runner.warmup)

@@ -81,4 +81,10 @@ class KvMetricsPublisher:
         self.metrics_fn = metrics_fn
 
     def stats_handler(self) -> dict:
-        return ForwardPassMetrics.from_wire(self.metrics_fn()).to_wire()
+        raw = self.metrics_fn()
+        out = ForwardPassMetrics.from_wire(raw).to_wire()
+        # engine-specific extras (e.g. disagg remote-prefill counters) ride
+        # along; consumers key off the ForwardPassMetrics fields they know
+        for key, value in raw.items():
+            out.setdefault(key, value)
+        return out

@@ -1,0 +1,286 @@
+"""Disaggregated prefill/decode: block transfer, conditional routing,
+remote-prefill end-to-end equivalence with local generation."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.disagg import (
+    DisaggRouter,
+    KvTransferClient,
+    KvTransferServer,
+    PrefillWorker,
+    RemotePrefillCoordinator,
+)
+from dynamo_tpu.disagg.protocols import PrefillQueue, RemotePrefillRequest
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler
+from dynamo_tpu.models.loader import load_llama_params
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+from test_jax_engine import hf_model_dir, hf_logits, TINY  # noqa: F401
+
+
+def _make_runner(hf_model_dir, **overrides):
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=64, dtype="float32", **overrides,
+    )
+    params = load_llama_params(hf_model_dir, cfg, jnp.float32)
+    return ModelRunner(econfig, params=params), econfig
+
+
+def _greedy_request(request_id, prompt, max_tokens=8):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling_options=SamplingOptions(temperature=0.0),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    return EngineRequest(
+        request_id=request_id,
+        prompt=list(prompt),
+        req=req,
+        ctx=Context(req).context,
+        out_queue=asyncio.Queue(),
+    )
+
+
+async def _collect(er):
+    tokens = []
+    while True:
+        out = await asyncio.wait_for(er.out_queue.get(), timeout=60)
+        if out is None:
+            return tokens
+        tokens.extend(out.token_ids)
+
+
+# ---------------------------------------------------------------- block ops
+
+
+def test_gather_scatter_roundtrip(hf_model_dir):
+    runner, econfig = _make_runner(hf_model_dir)
+    cfg = econfig.model
+    bs = econfig.kv_block_size
+    ids = [3, 7, 11, 12, 40]
+    shape = (cfg.num_layers, len(ids), bs, cfg.num_kv_heads, cfg.head_dim)
+    k = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+    v = np.random.default_rng(1).normal(size=shape).astype(np.float32)
+    runner.scatter_blocks(ids, k, v)
+    k2, v2 = runner.gather_blocks(ids)
+    np.testing.assert_allclose(k2, k, rtol=1e-6)
+    np.testing.assert_allclose(v2, v, rtol=1e-6)
+    # untouched blocks remain zero
+    kz, _ = runner.gather_blocks([0])
+    assert np.all(kz == 0)
+
+
+async def test_transfer_server_roundtrip(hf_model_dir):
+    """Blocks pushed over real TCP land in the destination runner's cache."""
+    runner_a, econfig = _make_runner(hf_model_dir)
+    runner_b, _ = _make_runner(hf_model_dir)
+    cfg = econfig.model
+    bs = econfig.kv_block_size
+
+    commits = []
+    server = KvTransferServer(
+        scatter=runner_b.scatter_blocks,
+        on_commit=lambda rid, tok, lp: commits.append((rid, tok, lp)),
+    )
+    await server.start()
+    try:
+        src_ids = [2, 5, 9]
+        dst_ids = [10, 20, 30]
+        shape = (cfg.num_layers, len(src_ids), bs, cfg.num_kv_heads, cfg.head_dim)
+        k = np.random.default_rng(2).normal(size=shape).astype(np.float32)
+        v = np.random.default_rng(3).normal(size=shape).astype(np.float32)
+        runner_a.scatter_blocks(src_ids, k, v)
+
+        kk, vv = runner_a.gather_blocks(src_ids)
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_blocks("r1", dst_ids, kk, vv, chunk_blocks=2)
+        await client.send_commit("r1", 42, 0.5)
+        await client.close()
+
+        assert commits == [("r1", 42, 0.5)]
+        k2, v2 = runner_b.gather_blocks(dst_ids)
+        np.testing.assert_allclose(k2, k, rtol=1e-6)
+        np.testing.assert_allclose(v2, v, rtol=1e-6)
+    finally:
+        await server.close()
+
+
+async def test_transfer_drops_unauthorized_frames(hf_model_dir):
+    runner, econfig = _make_runner(hf_model_dir)
+    cfg = econfig.model
+    bs = econfig.kv_block_size
+    server = KvTransferServer(
+        scatter=runner.scatter_blocks,
+        on_commit=lambda *a: None,
+        authorize=lambda rid, ids: False,  # e.g. request was cancelled
+    )
+    await server.start()
+    try:
+        shape = (cfg.num_layers, 1, bs, cfg.num_kv_heads, cfg.head_dim)
+        k = np.ones(shape, np.float32)
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_blocks("ghost", [4], k, k)
+        await client.send_commit("ghost", 1, None)
+        await client.close()
+        kz, _ = runner.gather_blocks([4])
+        assert np.all(kz == 0)  # frame was dropped, cache untouched
+    finally:
+        await server.close()
+
+
+# ---------------------------------------------------------------- router
+
+
+def test_disagg_router_decision():
+    r = DisaggRouter(max_local_prefill_length=100, max_prefill_queue_size=2)
+    assert not r.prefill_remote(100, 0, 0)        # at threshold → local
+    assert r.prefill_remote(101, 0, 0)            # above → remote
+    assert not r.prefill_remote(300, 250, 0)      # prefix hit absorbs it
+    assert not r.prefill_remote(500, 0, 2)        # queue full → local
+    assert r.prefill_remote(500, 0, 1)
+
+
+async def test_disagg_router_dynamic_config():
+    hub = MemoryHub()
+    drt = DistributedRuntime.in_process(hub)
+    r = DisaggRouter(max_local_prefill_length=100, model_name="m")
+    await r.start(drt.discovery, drt.runtime)
+    assert r.prefill_remote(200, 0, 0)
+    await DisaggRouter.publish_config(drt.discovery, "public", "m",
+                                      max_local_prefill_length=1000,
+                                      max_prefill_queue_size=5)
+    await asyncio.sleep(0.05)
+    assert not r.prefill_remote(200, 0, 0)  # threshold raised live
+    assert r.max_prefill_queue_size == 5
+    await r.stop()
+    await drt.close()
+
+
+async def test_prefill_queue_ack_and_redelivery():
+    hub = MemoryHub()
+    drt = DistributedRuntime.in_process(hub)
+    q = PrefillQueue(drt.messaging, "ns", visibility=0.2)
+    rpr = RemotePrefillRequest("r1", "e1", [1, 2, 3], [0], 0)
+    await q.push(rpr)
+    got, ack = await q.pop(timeout=1)
+    assert got.request_id == "r1" and got.token_ids == [1, 2, 3]
+    # no ack → redelivered after the visibility window
+    await asyncio.sleep(0.3)
+    got2, ack2 = await q.pop(timeout=1)
+    assert got2.request_id == "r1"
+    ack2()
+    await asyncio.sleep(0.3)
+    assert await q.depth() == 0
+    await drt.close()
+
+
+# ---------------------------------------------------------------- e2e
+
+
+async def _decode_engine_with_disagg(hf_model_dir, hub, **router_kw):
+    runner, econfig = _make_runner(hf_model_dir)
+    drt = DistributedRuntime.in_process(hub)
+    timeout = router_kw.pop("timeout", 60.0)
+    router = DisaggRouter(**router_kw)
+    coord = RemotePrefillCoordinator(
+        drt, runner, router=router, depth_refresh_s=0.05,
+        prefill_timeout_s=timeout,
+    )
+    await coord.start()
+    sched = Scheduler(runner, econfig, disagg=coord)
+    sched.start()
+    return sched, coord, drt, econfig
+
+
+async def test_remote_prefill_matches_local(hf_model_dir):
+    """Greedy decode after remote prefill == pure local generation."""
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21]
+
+    # baseline: local-only engine
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+    assert len(baseline) == 8
+
+    # disagg: decode engine + separate prefill worker, threshold 0 → all remote
+    hub = MemoryHub()
+    sched, coord, drt_d, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0, max_prefill_queue_size=100,
+    )
+    runner_p, pconfig = _make_runner(hf_model_dir)
+    drt_p = DistributedRuntime.in_process(hub)
+    worker = PrefillWorker(drt_p, runner_p, pconfig)
+    worker_task = asyncio.create_task(worker.run())
+    try:
+        er1 = _greedy_request("r1", prompt)
+        sched.add_request(er1)
+        out1 = await _collect(er1)
+        assert out1 == baseline
+
+        # second identical prompt: decode-side prefix hit → suffix-only transfer
+        er2 = _greedy_request("r2", prompt)
+        sched.add_request(er2)
+        out2 = await _collect(er2)
+        assert out2 == baseline
+
+        assert coord.remote_completed == 2
+        assert worker.prefills == 2
+        # second prefill skipped the cached prefix on both sides
+        assert worker.prefill_tokens < 2 * len(prompt)
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await sched.stop()
+        await drt_p.close()
+        await drt_d.close()
+
+
+async def test_remote_prefill_timeout_falls_back_local(hf_model_dir):
+    """No prefill worker alive → decode worker recovers by prefilling locally."""
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21]
+
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+
+    hub = MemoryHub()
+    sched, coord, drt, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0, max_prefill_queue_size=100,
+        timeout=0.4,
+    )
+    coord.prefill_timeout_s = 0.4
+    try:
+        er1 = _greedy_request("r1", prompt)
+        sched.add_request(er1)
+        out = await _collect(er1)
+        assert out == baseline
+        assert coord.remote_submitted == 1
+        assert coord.remote_completed == 0
+    finally:
+        await sched.stop()
+        await drt.close()
