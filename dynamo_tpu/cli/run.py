@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=None)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    p.add_argument("--host-kv-blocks", type=int, default=0,
+                   help="host-RAM KV offload tier capacity in blocks (0 = off)")
+    p.add_argument("--num-kv-blocks", type=int, default=2048,
+                   help="HBM paged-cache capacity in blocks")
     # disaggregated prefill/decode (xPyD)
     p.add_argument("--remote-prefill", action="store_true",
                    help="decode worker: offload long prefills to the prefill queue")

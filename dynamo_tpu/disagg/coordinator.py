@@ -106,15 +106,21 @@ class RemotePrefillCoordinator:
         """Enqueue the prompt; returns a future → (first_token, logprob)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
-        await self.queue.push(RemotePrefillRequest(
-            request_id=request_id,
-            engine_id=self.engine_id,
-            token_ids=list(map(int, token_ids)),
-            block_ids=list(map(int, block_ids)),
-            num_cached=num_cached,
-            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
-            want_logprobs=want_logprobs,
-        ))
+        try:
+            await self.queue.push(RemotePrefillRequest(
+                request_id=request_id,
+                engine_id=self.engine_id,
+                token_ids=list(map(int, token_ids)),
+                block_ids=list(map(int, block_ids)),
+                num_cached=num_cached,
+                temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+                want_logprobs=want_logprobs,
+            ))
+        except Exception:
+            # push failed — nothing is coming; don't leak the pending entry
+            # (it would also keep authorizing frames for a dead request id)
+            self._pending.pop(request_id, None)
+            raise
         self.remote_submitted += 1
         self._queue_depth += 1  # optimistic until the next refresh
         return fut
@@ -130,7 +136,8 @@ class RemotePrefillCoordinator:
     def _authorize(self, request_id: str, block_ids) -> bool:
         return request_id in self._pending
 
-    async def _scatter(self, block_ids, k: np.ndarray, v: np.ndarray) -> None:
+    async def _scatter(self, request_id: str, block_ids,
+                       k: np.ndarray, v: np.ndarray) -> None:
         # Stage the host→device copy in a worker thread (thread-safe, touches
         # no shared state); the cache-mutating scatter dispatch stays on the
         # event loop so it serializes with the scheduler's step calls.
@@ -140,6 +147,12 @@ class RemotePrefillCoordinator:
         k_dev, v_dev = await loop.run_in_executor(
             None, lambda: (jax.device_put(k), jax.device_put(v))
         )
+        # the request may have been cancelled/timed out DURING the await —
+        # its blocks could already be freed and reallocated to another
+        # sequence; writing now would corrupt that sequence's KV
+        if request_id not in self._pending:
+            logger.info("dropping late KV frame for %s", request_id)
+            return
         self.runner.scatter_blocks(block_ids, k_dev, v_dev)
 
     def _commit(self, request_id: str, first_token: int,

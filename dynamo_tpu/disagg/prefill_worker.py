@@ -72,7 +72,13 @@ class PrefillWorker:
 
     async def serve_one(self, timeout: Optional[float] = None) -> bool:
         """Pop and fully process one queue item. Returns False on timeout."""
-        popped = await self.queue.pop(timeout=timeout)
+        try:
+            popped = await self.queue.pop(timeout=timeout)
+        except Exception:
+            # transient broker failure — back off; never crash run()
+            logger.exception("prefill queue pop failed")
+            await asyncio.sleep(1.0)
+            return False
         if popped is None:
             return False
         rpr, ack = popped

@@ -75,7 +75,19 @@ class PrefillQueue:
         )
         if item is None:
             return None
-        return RemotePrefillRequest.from_wire(item.payload), item.ack
+        try:
+            req = RemotePrefillRequest.from_wire(item.payload)
+        except Exception:
+            # poison message: it will never parse for any worker — ack it
+            # away instead of crash-looping the whole prefill fleet
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "dropping malformed prefill queue item"
+            )
+            item.ack()
+            return None
+        return req, item.ack
 
     async def depth(self) -> int:
         return await self.messaging.queue_depth(self.name)

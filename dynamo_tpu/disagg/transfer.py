@@ -57,11 +57,14 @@ class KvTransferServer:
 
     def __init__(
         self,
-        scatter: Callable[[Sequence[int], np.ndarray, np.ndarray], None],
+        scatter: Callable[[str, Sequence[int], np.ndarray, np.ndarray], None],
         on_commit: Callable[[str, int, Optional[float]], None],
         authorize: Optional[Callable[[str, Sequence[int]], bool]] = None,
         host: str = "127.0.0.1",
     ):
+        # scatter(request_id, block_ids, k, v) — may return an awaitable; an
+        # async scatter MUST re-validate the request id after any await (the
+        # request can be cancelled mid-flight and its blocks reallocated)
         self.scatter = scatter
         self.on_commit = on_commit
         # guards against late frames for cancelled/unknown requests writing
@@ -105,7 +108,7 @@ class KvTransferServer:
                     v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
                     # scatter may be a coroutine that stages the host→device
                     # copy off-loop so decode streaming isn't stalled
-                    result = self.scatter(header["block_ids"], k, v)
+                    result = self.scatter(header["request_id"], header["block_ids"], k, v)
                     if inspect.isawaitable(result):
                         await result
                 elif mtype == "commit":

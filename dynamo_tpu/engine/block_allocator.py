@@ -37,11 +37,16 @@ class BlockAllocator:
         block_size: int,
         enable_prefix_caching: bool = True,
         events: Optional[KvEventSink] = None,
+        tier2=None,  # Optional[KvHostTier] — host-RAM offload tier
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
         self.events = events or KvEventSink()
+        self.tier2 = tier2
+        # evictions collected during one allocation; offloaded in a single
+        # batched gather (one device round-trip) by _flush_offload
+        self._pending_offload: List[Tuple[int, int]] = []
         self.free: List[int] = list(range(num_blocks - 1, -1, -1))  # pop() → block 0 first
         # sequence_hash → block id (cached, complete blocks)
         self.by_hash: Dict[int, int] = {}
@@ -70,12 +75,21 @@ class BlockAllocator:
             h = self.block_hash.pop(bid, None)
             if h is not None:
                 self.by_hash.pop(h, None)
+                if self.tier2 is not None:
+                    # KV is still intact in the slot — queue it for host
+                    # offload; flushed (batched) before the slot is written
+                    self._pending_offload.append((h, bid))
                 self.events.on_removed([h])
             return bid
         raise MemoryError("KV cache exhausted")
 
+    def _flush_offload(self) -> None:
+        if self._pending_offload:
+            pending, self._pending_offload = self._pending_offload, []
+            self.tier2.offload_batch(pending)
+
     def match_prefix(self, token_ids: List[int]) -> Tuple[List[int], List[int]]:
-        """Longest cached prefix of complete blocks.
+        """Longest HBM-cached prefix of complete blocks.
         Returns (block_ids, their sequence hashes)."""
         if not self.enable_prefix_caching:
             return [], []
@@ -90,27 +104,58 @@ class BlockAllocator:
             matched.append(h)
         return blocks, matched
 
-    def allocate_prompt(
-        self, token_ids: List[int], cached_blocks: Optional[List[int]] = None
-    ) -> Tuple[List[int], int]:
-        """Allocate blocks for a prompt; reuse cached prefix blocks.
+    def probe_prefix(self, token_ids: List[int]):
+        """One hashing pass over both tiers.
 
-        ``cached_blocks`` may carry a just-computed ``match_prefix`` result so
-        hot callers don't hash the prompt twice (valid only if no allocator
-        mutation happened in between).
+        Returns (hashes, hbm_blocks, host_hashes): the HBM-resident prefix
+        blocks, then the host-tier run extending it. Feed the result into
+        ``allocate_prompt(probe=...)`` so hot callers hash the prompt once.
+        ``cached_tokens(probe)`` gives the restorable-token count for
+        scheduling decisions (e.g. the disagg local-vs-remote verdict).
+        """
+        if not self.enable_prefix_caching:
+            return [], [], []
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        blocks: List[int] = []
+        for h in hashes:
+            bid = self.by_hash.get(h)
+            if bid is None:
+                break
+            blocks.append(bid)
+        host_hashes: List[int] = []
+        if self.tier2 is not None:
+            host_hashes = self.tier2.match_extension(hashes, len(blocks))
+        return hashes, blocks, host_hashes
+
+    def cached_tokens(self, probe) -> int:
+        _hashes, blocks, host_hashes = probe
+        return (len(blocks) + len(host_hashes)) * self.block_size
+
+    def allocate_prompt(
+        self, token_ids: List[int], probe=None
+    ) -> Tuple[List[int], int]:
+        """Allocate blocks for a prompt; reuse cached prefix blocks from HBM
+        and restore host-tier blocks into fresh slots.
+
+        ``probe`` may carry a just-computed ``probe_prefix`` result (valid
+        only if no allocator mutation happened in between).
         Returns (block_ids covering ceil(len/bs) blocks, num_cached_tokens).
         Raises MemoryError if the demand cannot be met (caller queues).
         """
         n_needed = max(1, -(-len(token_ids) // self.block_size))
-        if cached_blocks is None:
-            cached_blocks, _ = self.match_prefix(token_ids)
-        else:
-            cached_blocks = list(cached_blocks)
+        hashes, cached_blocks, host_hashes = (
+            probe if probe is not None else self.probe_prefix(token_ids)
+        )
+        cached_blocks = list(cached_blocks)
+        host_hashes = list(host_hashes)
         # a full-prompt hit still needs the last block re-filled only if the
         # prompt ends mid-block; always recompute at least one token so the
         # engine has logits to sample from
-        if len(cached_blocks) * self.block_size >= len(token_ids):
-            cached_blocks = cached_blocks[:-1]
+        if (len(cached_blocks) + len(host_hashes)) * self.block_size >= len(token_ids):
+            if host_hashes:
+                host_hashes.pop()
+            else:
+                cached_blocks = cached_blocks[:-1]
         n_new = n_needed - len(cached_blocks)
         if n_new > self.available:
             raise MemoryError(
@@ -121,11 +166,32 @@ class BlockAllocator:
         new_blocks = [self._take_block() for _ in range(n_new)]
         for bid in new_blocks:
             self.refcount[bid] = self.refcount.get(bid, 0) + 1
-        return cached_blocks + new_blocks, len(cached_blocks) * self.block_size
+        # offload evicted blocks (one batched gather) BEFORE restore may
+        # write new data into any of those same slots
+        self._flush_offload()
+
+        if host_hashes:
+            # taking blocks above may itself have evicted host-tier entries
+            # (capacity pressure) — keep only the still-resident prefix run
+            keep = 0
+            while keep < len(host_hashes) and self.tier2.has(host_hashes[keep]):
+                keep += 1
+            host_hashes = host_hashes[:keep]
+        if host_hashes:
+            restore_bids = new_blocks[: len(host_hashes)]
+            self.tier2.restore(host_hashes, restore_bids)
+            for i, h in enumerate(host_hashes):
+                idx = len(cached_blocks) + i
+                parent = hashes[idx - 1] if idx > 0 else None
+                self.register_complete(restore_bids[i], h, parent)
+
+        num_cached = (len(cached_blocks) + len(host_hashes)) * self.block_size
+        return cached_blocks + new_blocks, num_cached
 
     def allocate_block(self) -> int:
         """One more block for a growing (decoding) sequence."""
         bid = self._take_block()
+        self._flush_offload()
         self.refcount[bid] = self.refcount.get(bid, 0) + 1
         return bid
 

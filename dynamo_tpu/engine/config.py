@@ -100,6 +100,9 @@ class EngineConfig:
     # scheduler knobs
     max_prefill_tokens_per_step: int = 8192
     enable_prefix_caching: bool = True
+    # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
+    # restored on later prefix hits instead of recomputed. 0 disables.
+    host_kv_blocks: int = 0
 
     def __post_init__(self):
         if self.prefill_buckets is None:

@@ -113,9 +113,17 @@ class Scheduler:
         self.runner = runner
         self.config = config
         self.disagg = disagg
+        tier2 = None
+        if config.host_kv_blocks > 0:
+            from ..kv import KvHostTier
+
+            tier2 = KvHostTier(
+                runner.gather_blocks, runner.scatter_blocks,
+                config.host_kv_blocks,
+            )
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
-            config.enable_prefix_caching, events,
+            config.enable_prefix_caching, events, tier2=tier2,
         )
         self.waiting: deque = deque()
         self.pending_remote: List[EngineRequest] = []
@@ -171,6 +179,8 @@ class Scheduler:
                 if self.prefix_total_tokens else 0.0
             ),
         }
+        if self.allocator.tier2 is not None:
+            out.update(self.allocator.tier2.metrics())
         if self.disagg is not None:
             out.update(self.disagg.metrics())
         return out
@@ -294,14 +304,16 @@ class Scheduler:
         """
         if er.remote_attempted:
             return False  # already tried remote once — prefill locally
-        cached_blocks, _ = self.allocator.match_prefix(er.prompt)
-        prefix_hit = len(cached_blocks) * self.config.kv_block_size
+        probe = self.allocator.probe_prefix(er.prompt)
+        # host-tier blocks count as hit: restoring them locally is far
+        # cheaper than a remote prefill round-trip
+        prefix_hit = self.allocator.cached_tokens(probe)
         if not self.disagg.decide(len(er.prompt), prefix_hit):
             return False
         er.remote_attempted = True
         try:
             er.block_ids, er.num_cached = self.allocator.allocate_prompt(
-                er.prompt, cached_blocks=cached_blocks
+                er.prompt, probe=probe
             )
         except MemoryError:
             return False

@@ -1,0 +1,5 @@
+"""Multi-tier KV cache management (HBM + host RAM offload tier)."""
+
+from .host_tier import KvHostTier
+
+__all__ = ["KvHostTier"]

@@ -1,0 +1,112 @@
+"""Host-memory KV tier: offload evicted HBM blocks, restore on prefix hit.
+
+The TPU analog of the reference's multi-tier KV block manager (reference:
+lib/llm/src/kv/storage.rs StorageType::{Device,Pinned,System} slabs,
+lib/llm/src/kv/reuse.rs priority reuse/eviction, and the CUDA
+scatter/gather copy kernel lib/llm/src/kernels/block_copy.cu) — the
+subsystem behind the reference's "+40% TTFT from KV offload to system
+memory" headline (docs/architecture.md:91). Here the device↔host movement
+is the runner's jitted XLA gather/scatter over the paged cache plus
+``jax.device_get``/``device_put`` host staging.
+
+A block is offloaded *at HBM eviction time*: when the allocator pops an
+LRU reusable block to hand its slot to new data, the block's KV is still
+intact, so it is read out to host RAM first, keyed by its chained sequence
+hash. On a later prompt whose prefix extends past the HBM-cached blocks,
+host-resident blocks are restored into freshly allocated slots instead of
+being recomputed — turning a prefill recompute into one H2D copy.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class KvHostTier:
+    """LRU store of KV blocks in host RAM, keyed by sequence hash."""
+
+    def __init__(
+        self,
+        gather_fn: Callable[[Sequence[int]], Tuple[np.ndarray, np.ndarray]],
+        scatter_fn: Callable[[Sequence[int], np.ndarray, np.ndarray], None],
+        capacity_blocks: int,
+    ):
+        self.gather_fn = gather_fn
+        self.scatter_fn = scatter_fn
+        self.capacity_blocks = capacity_blocks
+        # sequence_hash → (k [L,1,bs,KVH,D], v) host arrays; LRU order
+        self.store: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        # telemetry
+        self.offloaded_total = 0
+        self.restored_total = 0
+        self.evicted_total = 0
+
+    def __len__(self) -> int:
+        return len(self.store)
+
+    def has(self, sequence_hash: int) -> bool:
+        return sequence_hash in self.store
+
+    def offload(self, sequence_hash: int, block_id: int) -> None:
+        """Read one HBM block out to host before its slot is reused."""
+        self.offload_batch([(sequence_hash, block_id)])
+
+    def offload_batch(self, pairs: Sequence[Tuple[int, int]]) -> None:
+        """Offload many evicted blocks with ONE bucketed device gather.
+
+        Callers evicting several blocks in a burst (a long prompt's
+        allocation) batch here so the device round-trip is paid once, not
+        per block.
+        """
+        fresh = []
+        for h, bid in pairs:
+            if h in self.store:
+                self.store.move_to_end(h)
+            else:
+                fresh.append((h, bid))
+        if not fresh:
+            return
+        k, v = self.gather_fn([bid for _h, bid in fresh])
+        for i, (h, _bid) in enumerate(fresh):
+            self.store[h] = (k[:, i : i + 1], v[:, i : i + 1])
+        self.offloaded_total += len(fresh)
+        while len(self.store) > self.capacity_blocks:
+            self.store.popitem(last=False)
+            self.evicted_total += 1
+
+    def restore(self, hashes: Sequence[int], block_ids: Sequence[int]) -> None:
+        """Write host-resident blocks back into freshly allocated HBM slots."""
+        assert len(hashes) == len(block_ids)
+        if not hashes:
+            return
+        ks, vs = zip(*(self.store[h] for h in hashes))
+        k = np.concatenate(ks, axis=1)
+        v = np.concatenate(vs, axis=1)
+        self.scatter_fn(list(block_ids), k, v)
+        for h in hashes:
+            self.store.move_to_end(h)
+        self.restored_total += len(hashes)
+
+    def match_extension(self, hashes: Sequence[int], start: int) -> List[int]:
+        """Longest host-resident run of ``hashes`` starting at index ``start``."""
+        out: List[int] = []
+        for h in hashes[start:]:
+            if h not in self.store:
+                break
+            out.append(h)
+        return out
+
+    def metrics(self) -> dict:
+        return {
+            "host_kv_blocks": len(self.store),
+            "host_kv_capacity": self.capacity_blocks,
+            "host_kv_offloaded_total": self.offloaded_total,
+            "host_kv_restored_total": self.restored_total,
+            "host_kv_evicted_total": self.evicted_total,
+        }

@@ -96,7 +96,7 @@ async def test_transfer_server_roundtrip(hf_model_dir):
 
     commits = []
     server = KvTransferServer(
-        scatter=runner_b.scatter_blocks,
+        scatter=lambda rid, ids, k, v: runner_b.scatter_blocks(ids, k, v),
         on_commit=lambda rid, tok, lp: commits.append((rid, tok, lp)),
     )
     await server.start()
@@ -127,7 +127,7 @@ async def test_transfer_drops_unauthorized_frames(hf_model_dir):
     cfg = econfig.model
     bs = econfig.kv_block_size
     server = KvTransferServer(
-        scatter=runner.scatter_blocks,
+        scatter=lambda rid, ids, k, v: runner.scatter_blocks(ids, k, v),
         on_commit=lambda *a: None,
         authorize=lambda rid, ids: False,  # e.g. request was cancelled
     )
