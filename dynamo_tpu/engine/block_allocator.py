@@ -45,7 +45,7 @@ class BlockAllocator:
         self.events = events or KvEventSink()
         self.tier2 = tier2
         # evictions collected during one allocation; offloaded in a single
-        # batched gather (one device round-trip) by _flush_offload
+        # batched gather (one device round-trip) by flush_offload
         self._pending_offload: List[Tuple[int, int]] = []
         self.free: List[int] = list(range(num_blocks - 1, -1, -1))  # pop() → block 0 first
         # sequence_hash → block id (cached, complete blocks)
@@ -83,7 +83,12 @@ class BlockAllocator:
             return bid
         raise MemoryError("KV cache exhausted")
 
-    def _flush_offload(self) -> None:
+    def flush_offload(self) -> None:
+        """Offload all queued evictions in one batched device gather.
+
+        Must run before the evicted slots are overwritten; callers that
+        allocate with ``flush=False`` own that ordering.
+        """
         if self._pending_offload:
             pending, self._pending_offload = self._pending_offload, []
             self.tier2.offload_batch(pending)
@@ -91,18 +96,8 @@ class BlockAllocator:
     def match_prefix(self, token_ids: List[int]) -> Tuple[List[int], List[int]]:
         """Longest HBM-cached prefix of complete blocks.
         Returns (block_ids, their sequence hashes)."""
-        if not self.enable_prefix_caching:
-            return [], []
-        hashes = compute_block_hashes(token_ids, self.block_size)
-        blocks: List[int] = []
-        matched: List[int] = []
-        for h in hashes:
-            bid = self.by_hash.get(h)
-            if bid is None:
-                break
-            blocks.append(bid)
-            matched.append(h)
-        return blocks, matched
+        hashes, blocks, _host = self.probe_prefix(token_ids)
+        return blocks, hashes[: len(blocks)]
 
     def probe_prefix(self, token_ids: List[int]):
         """One hashing pass over both tiers.
@@ -157,9 +152,13 @@ class BlockAllocator:
             else:
                 cached_blocks = cached_blocks[:-1]
         n_new = n_needed - len(cached_blocks)
-        if n_new > self.available:
+        # pinning the matched prefix removes its refcount-0 blocks from the
+        # evictable pool, so subtract them — otherwise _take_block could
+        # exhaust mid-allocation after state was already mutated
+        pinned = sum(1 for bid in cached_blocks if bid in self.reusable)
+        if n_new > self.available - pinned:
             raise MemoryError(
-                f"need {n_new} blocks, {self.available} available"
+                f"need {n_new} blocks, {self.available - pinned} available"
             )
         for bid in cached_blocks:
             self._ref(bid)
@@ -168,7 +167,7 @@ class BlockAllocator:
             self.refcount[bid] = self.refcount.get(bid, 0) + 1
         # offload evicted blocks (one batched gather) BEFORE restore may
         # write new data into any of those same slots
-        self._flush_offload()
+        self.flush_offload()
 
         if host_hashes:
             # taking blocks above may itself have evicted host-tier entries
@@ -188,10 +187,16 @@ class BlockAllocator:
         num_cached = (len(cached_blocks) + len(host_hashes)) * self.block_size
         return cached_blocks + new_blocks, num_cached
 
-    def allocate_block(self) -> int:
-        """One more block for a growing (decoding) sequence."""
+    def allocate_block(self, flush: bool = True) -> int:
+        """One more block for a growing (decoding) sequence.
+
+        ``flush=False`` defers the host-offload gather so a caller growing
+        many sequences in one step pays one batched device round-trip; it
+        must call ``flush_offload()`` before the evicted slots are written.
+        """
         bid = self._take_block()
-        self._flush_offload()
+        if flush:
+            self.flush_offload()
         self.refcount[bid] = self.refcount.get(bid, 0) + 1
         return bid
 

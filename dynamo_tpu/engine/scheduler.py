@@ -217,7 +217,9 @@ class Scheduler:
         needed = position // bs + 1
         while len(er.block_ids) < needed:
             try:
-                er.block_ids.append(self.allocator.allocate_block())
+                # flush deferred: the decode loop grows many sequences per
+                # step and batches the eviction-offload gather afterwards
+                er.block_ids.append(self.allocator.allocate_block(flush=False))
             except MemoryError:
                 return False
         return True
@@ -448,6 +450,9 @@ class Scheduler:
                 logger.warning("KV OOM: preempting %s", er.request_id)
                 self._preempt(er)
                 active.remove(er)
+        # one batched host-offload gather for every eviction this step,
+        # before the step below overwrites the evicted slots
+        self.allocator.flush_offload()
         if not active:
             return
 

@@ -74,7 +74,12 @@ class KvHostTier:
             return
         k, v = self.gather_fn([bid for _h, bid in fresh])
         for i, (h, _bid) in enumerate(fresh):
-            self.store[h] = (k[:, i : i + 1], v[:, i : i + 1])
+            # copy: a slice view would pin the whole (bucket-padded) gather
+            # buffer, breaking the capacity_blocks accounting
+            self.store[h] = (
+                np.ascontiguousarray(k[:, i : i + 1]),
+                np.ascontiguousarray(v[:, i : i + 1]),
+            )
         self.offloaded_total += len(fresh)
         while len(self.store) > self.capacity_blocks:
             self.store.popitem(last=False)

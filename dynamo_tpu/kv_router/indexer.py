@@ -160,12 +160,77 @@ class RadixTree:
         return len(self.lookup)
 
 
-class KvIndexer:
-    """Event-consuming index (the actor surface of the reference)."""
+class _NativeTreeAdapter:
+    """Presents the RadixTree surface over the C++ tree (dynamo_tpu/native).
 
-    def __init__(self, block_size: int = 16, expiration_s: Optional[float] = None):
+    The native tree is the production path — prefix matching is on every
+    scheduling decision (reference runs it on a dedicated Rust actor thread,
+    indexer.rs:499-663); the Python RadixTree above is the always-available
+    fallback and the executable spec the native side is tested against.
+    """
+
+    def __init__(self, native_mod, expiration_s: Optional[float]):
+        self._tree = native_mod.NativeRadixTree(expiration_s)
+
+    def apply_event(self, event: RouterEvent) -> None:
+        if event.stored is not None:
+            self._tree.apply_stored(
+                event.worker_id, event.stored.parent_hash, event.stored.block_hashes
+            )
+        if event.removed is not None:
+            self._tree.apply_removed(event.worker_id, event.removed.block_hashes)
+
+    def find_matches(
+        self, block_hashes: List[int], early_exit: bool = False
+    ) -> OverlapScores:
+        scores, freqs = self._tree.find_matches(block_hashes, early_exit)
+        return OverlapScores(scores=scores, frequencies=freqs)
+
+    def remove_worker(self, worker_id: str) -> None:
+        self._tree.remove_worker(worker_id)
+
+    def clear_expired(self) -> int:
+        return self._tree.clear_expired()
+
+    def __len__(self) -> int:
+        return len(self._tree)
+
+
+def _make_tree(expiration_s: Optional[float], use_native: Optional[bool]):
+    import os
+
+    if use_native is None and os.environ.get(
+        "DYNAMO_TPU_NATIVE", "1"
+    ).lower() in ("0", "false"):
+        use_native = False  # operator kill-switch (explicit True overrides)
+    if use_native is False:
+        return RadixTree(expiration_s)
+    try:
+        from .. import native
+    except Exception:
+        native = None
+    if native is not None and native.available():
+        return _NativeTreeAdapter(native, expiration_s)
+    if use_native:
+        raise RuntimeError("native indexer requested but C++ core unavailable")
+    return RadixTree(expiration_s)
+
+
+class KvIndexer:
+    """Event-consuming index (the actor surface of the reference).
+
+    ``use_native``: None (default) auto-selects the C++ tree when built,
+    True requires it, False forces the pure-Python tree.
+    """
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        expiration_s: Optional[float] = None,
+        use_native: Optional[bool] = None,
+    ):
         self.block_size = block_size
-        self.tree = RadixTree(expiration_s)
+        self.tree = _make_tree(expiration_s, use_native)
         self.events_applied = 0
         self.worker_ids: set = set()  # every worker ever seen in events
 

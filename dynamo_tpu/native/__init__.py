@@ -1,0 +1,296 @@
+"""ctypes bindings over the native C++ core (hashing, radix indexer, C ABI).
+
+Loads ``libdynamo_native.so`` (built on demand by build.py) and exposes:
+
+- ``compute_block_hashes(tokens, block_size, seed)`` — batched chained
+  block hashing, bit-identical to the pure-Python path in
+  dynamo_tpu/tokens.py (both are XXH64; the native side is validated
+  against python-xxhash in tests).
+- ``NativeRadixTree`` — drop-in for kv_router.indexer.RadixTree's hot
+  surface (apply_event / find_matches / remove_worker).
+- ``CApi`` — the external-engine KV event ABI (reference analog:
+  lib/bindings/c/src/lib.rs), with a Python sink callback.
+
+Everything degrades gracefully: ``available()`` is False when no C++
+toolchain exists and callers fall back to pure Python. Set
+``DYNAMO_TPU_NATIVE=0`` to force pure Python everywhere. The first use per
+source digest compiles on demand (can take tens of seconds); run
+``python -m dynamo_tpu.native.build`` at deploy time to prebuild so worker
+startup never pays it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import build as _build
+
+_lib = None
+_lib_err: Optional[str] = None
+_load_lock = threading.Lock()
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    lib.dt_xxh64.restype = ctypes.c_uint64
+    lib.dt_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint64]
+
+    lib.dt_compute_block_hashes.restype = ctypes.c_size_t
+    lib.dt_compute_block_hashes.argtypes = [
+        u32p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_uint64, u64p,
+    ]
+
+    lib.dt_tree_new.restype = ctypes.c_void_p
+    lib.dt_tree_new.argtypes = [ctypes.c_double]
+    lib.dt_tree_free.argtypes = [ctypes.c_void_p]
+    lib.dt_tree_apply_stored.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+        u64p, ctypes.c_size_t,
+    ]
+    lib.dt_tree_apply_removed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, u64p, ctypes.c_size_t,
+    ]
+    lib.dt_tree_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dt_tree_size.restype = ctypes.c_size_t
+    lib.dt_tree_size.argtypes = [ctypes.c_void_p]
+    lib.dt_tree_clear_expired.restype = ctypes.c_size_t
+    lib.dt_tree_clear_expired.argtypes = [ctypes.c_void_p]
+    lib.dt_tree_find_matches.restype = ctypes.c_void_p
+    lib.dt_tree_find_matches.argtypes = [
+        ctypes.c_void_p, u64p, ctypes.c_size_t, ctypes.c_int,
+    ]
+    lib.dt_result_num_workers.restype = ctypes.c_size_t
+    lib.dt_result_num_workers.argtypes = [ctypes.c_void_p]
+    lib.dt_result_worker.restype = ctypes.c_char_p
+    lib.dt_result_worker.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.dt_result_score.restype = ctypes.c_uint32
+    lib.dt_result_score.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.dt_result_num_freqs.restype = ctypes.c_size_t
+    lib.dt_result_num_freqs.argtypes = [ctypes.c_void_p]
+    lib.dt_result_freq.restype = ctypes.c_uint32
+    lib.dt_result_freq.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.dt_result_free.argtypes = [ctypes.c_void_p]
+
+    lib.dt_capi_init.restype = ctypes.c_int
+    lib.dt_capi_init.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.c_uint64,
+    ]
+    lib.dt_capi_shutdown.restype = ctypes.c_int
+    lib.dt_capi_set_sink.argtypes = [_SINK_CFUNC, ctypes.c_void_p]
+    lib.dt_kv_event_publish_stored.restype = ctypes.c_int
+    lib.dt_kv_event_publish_stored.argtypes = [
+        ctypes.c_uint64, u32p, ctypes.c_size_t, u64p,
+    ]
+    lib.dt_kv_event_publish_removed.restype = ctypes.c_int
+    lib.dt_kv_event_publish_removed.argtypes = [
+        ctypes.c_uint64, u64p, ctypes.c_size_t,
+    ]
+    lib.dt_capi_drain.restype = ctypes.c_long
+    lib.dt_capi_drain.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.dt_capi_dropped_events.restype = ctypes.c_uint64
+
+
+_SINK_CFUNC = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p)
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _load_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        path = _build.build()
+        if path is None:
+            _lib_err = "native build unavailable (no C++ toolchain?)"
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+            _declare(lib)
+        except OSError as e:  # pragma: no cover
+            _lib_err = str(e)
+            return None
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(lib.dt_xxh64(data, len(data), ctypes.c_uint64(seed)))
+
+
+def _as_u64_array(hashes: Sequence[int]) -> np.ndarray:
+    return np.asarray(hashes, dtype=np.uint64)
+
+
+def compute_block_hashes(
+    token_ids: Sequence[int], block_size: int, seed: int
+) -> List[int]:
+    """Chained sequence hashes of complete blocks — native hot path."""
+    lib = _load()
+    assert lib is not None
+    tokens = np.ascontiguousarray(token_ids, dtype=np.uint32)
+    n_full = len(tokens) // block_size if block_size > 0 else 0
+    out = np.empty(n_full, dtype=np.uint64)
+    n = lib.dt_compute_block_hashes(
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(tokens), block_size, ctypes.c_uint64(seed),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return [int(h) for h in out[:n]]
+
+
+class NativeRadixTree:
+    """C++ radix tree with the RadixTree hot surface.
+
+    find_matches returns ``(scores: dict[str, int], frequencies: list[int])``;
+    kv_router.indexer wraps it into OverlapScores.
+    """
+
+    def __init__(self, expiration_s: Optional[float] = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(_lib_err or "native core unavailable")
+        self._lib = lib
+        self._ptr = lib.dt_tree_new(
+            ctypes.c_double(-1.0 if expiration_s is None else expiration_s)
+        )
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr and getattr(self, "_lib", None) is not None:
+            self._lib.dt_tree_free(ptr)
+
+    def apply_stored(
+        self, worker_id: str, parent_hash: Optional[int], block_hashes: Sequence[int]
+    ) -> None:
+        arr = _as_u64_array(block_hashes)
+        self._lib.dt_tree_apply_stored(
+            self._ptr, worker_id.encode(),
+            0 if parent_hash is None else 1,
+            ctypes.c_uint64(parent_hash or 0),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(arr),
+        )
+
+    def apply_removed(self, worker_id: str, block_hashes: Sequence[int]) -> None:
+        arr = _as_u64_array(block_hashes)
+        self._lib.dt_tree_apply_removed(
+            self._ptr, worker_id.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(arr),
+        )
+
+    def remove_worker(self, worker_id: str) -> None:
+        self._lib.dt_tree_remove_worker(self._ptr, worker_id.encode())
+
+    def clear_expired(self) -> int:
+        return int(self._lib.dt_tree_clear_expired(self._ptr))
+
+    def find_matches(self, block_hashes: Sequence[int], early_exit: bool = False):
+        arr = _as_u64_array(block_hashes)
+        res = self._lib.dt_tree_find_matches(
+            self._ptr,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(arr), 1 if early_exit else 0,
+        )
+        try:
+            scores = {
+                self._lib.dt_result_worker(res, i).decode():
+                    int(self._lib.dt_result_score(res, i))
+                for i in range(self._lib.dt_result_num_workers(res))
+            }
+            freqs = [
+                int(self._lib.dt_result_freq(res, i))
+                for i in range(self._lib.dt_result_num_freqs(res))
+            ]
+        finally:
+            self._lib.dt_result_free(res)
+        return scores, freqs
+
+    def __len__(self) -> int:
+        return int(self._lib.dt_tree_size(self._ptr))
+
+
+class CApi:
+    """External-engine KV event ABI (reference: lib/bindings/c).
+
+    Usage from Python (tests / in-process engines):
+        capi = CApi(); capi.init("ns", "comp", "worker-0", kv_block_size=16)
+        capi.set_sink(lambda event_dict: ...)
+        capi.publish_stored(1, token_ids)
+    A C/C++ engine calls the same dt_* symbols directly.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(_lib_err or "native core unavailable")
+        self._lib = lib
+        self._sink_ref = None  # keep the ctypes callback alive
+
+    def init(self, namespace: str, component: str, worker_id: str,
+             kv_block_size: int = 16, hash_seed: int = 1337) -> int:
+        return int(self._lib.dt_capi_init(
+            namespace.encode(), component.encode(), worker_id.encode(),
+            kv_block_size, ctypes.c_uint64(hash_seed),
+        ))
+
+    def shutdown(self) -> int:
+        self._sink_ref = None
+        return int(self._lib.dt_capi_shutdown())
+
+    def set_sink(self, fn: Optional[Callable[[dict], None]]) -> None:
+        if fn is None:
+            self._sink_ref = _SINK_CFUNC(0)
+        else:
+            def trampoline(raw: bytes, _user):
+                fn(json.loads(raw.decode()))
+            self._sink_ref = _SINK_CFUNC(trampoline)
+        self._lib.dt_capi_set_sink(self._sink_ref, None)
+
+    def publish_stored(self, event_id: int, token_ids: Sequence[int],
+                       parent_hash: Optional[int] = None) -> int:
+        tokens = np.ascontiguousarray(token_ids, dtype=np.uint32)
+        parent = (
+            None if parent_hash is None
+            else ctypes.pointer(ctypes.c_uint64(parent_hash))
+        )
+        return int(self._lib.dt_kv_event_publish_stored(
+            ctypes.c_uint64(event_id),
+            tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(tokens), parent,
+        ))
+
+    def publish_removed(self, event_id: int, block_hashes: Sequence[int]) -> int:
+        arr = _as_u64_array(block_hashes)
+        return int(self._lib.dt_kv_event_publish_removed(
+            ctypes.c_uint64(event_id),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(arr),
+        ))
+
+    def drain(self, cap: int = 1 << 20) -> Optional[dict]:
+        # -1 = head event bigger than cap (stays queued) — grow and retry
+        # so one oversized event can't wedge the queue
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.dt_capi_drain(buf, cap)
+            if n == 0:
+                return None
+            if n > 0:
+                return json.loads(buf.value.decode())
+            cap *= 2
+
+    def dropped_events(self) -> int:
+        return int(self._lib.dt_capi_dropped_events())

@@ -1,0 +1,84 @@
+"""On-demand build of the native C++ core.
+
+Compiles ``src/*.cc`` into ``_build/libdynamo_native.so`` with the system
+g++ the first time the package is imported (and whenever a source file
+changes — staleness is a content hash over the sources baked into the
+output filename). No pip/cmake dependency; plain ``g++ -O2 -shared``.
+
+The reference ships its native core prebuilt by cargo (reference:
+lib/runtime, lib/llm Rust crates); here the toolchain contract is just a
+C++17 compiler, and every consumer degrades to the pure-Python fallbacks
+when none is present.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_SOURCES = ("indexer.cc", "capi.cc")
+_HEADERS = ("xxhash64.h",)
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES + _HEADERS:
+        h.update((_SRC_DIR / name).read_bytes())
+    return h.hexdigest()[:16]
+
+
+def lib_path() -> Path:
+    return _BUILD_DIR / f"libdynamo_native-{_source_digest()}.so"
+
+
+def build(verbose: bool = False) -> Optional[Path]:
+    """Compile if stale; returns the .so path or None when no compiler."""
+    try:
+        out = lib_path()
+        if out.exists():
+            return out
+        _BUILD_DIR.mkdir(exist_ok=True)
+    except OSError:
+        # read-only install / unreadable sources — degrade to pure Python
+        return None
+    cxx = os.environ.get("CXX", "g++")
+    # compile to a process-unique temp name, then atomically rename: several
+    # workers may race the first build of the same digest at import time
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = [
+        cxx, "-std=c++17", "-O2", "-fPIC", "-shared",
+        "-Wall", "-Wextra",
+        *(str(_SRC_DIR / s) for s in _SOURCES),
+        "-I", str(_SRC_DIR),
+        "-o", str(tmp),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            if verbose:
+                print(proc.stderr)
+            return None
+        os.replace(tmp, out)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        tmp.unlink(missing_ok=True)
+    # drop stale builds (and orphaned .tmp* from crashed compiles)
+    for old in _BUILD_DIR.glob("libdynamo_native-*"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    path = build(verbose=True)
+    print(path if path else "build failed")
+    raise SystemExit(0 if path else 1)

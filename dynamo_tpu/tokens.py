@@ -2,7 +2,7 @@
 
 Every KV-cache block in the framework is identified by two hashes:
 
-- ``block_hash``: a salted xxh3-64 over the block's token ids. Identical token
+- ``block_hash``: a salted xxh64 over the block's token ids. Identical token
   contents produce identical block hashes regardless of position.
 - ``sequence_hash``: a chained hash ``H(parent_sequence_hash, block_hash)``
   that identifies the block *in context* — i.e. the whole prefix ending at
@@ -14,6 +14,13 @@ salted BlockHash, parent-chained SequenceHash), re-designed as a single Python
 module (the reference kept two divergent copies). The radix-tree KV indexer
 (dynamo_tpu/kv_router/indexer.py) and the block manager key off
 ``sequence_hash``.
+
+Hash function: XXH64 (not the reference's xxh3), because the framework keeps
+two interoperable implementations — this pure-Python path and the native C++
+hot path in dynamo_tpu/native — and XXH64 is simple enough to guarantee
+bit-exact parity between them (asserted in tests/test_native.py). The salted
+seed scheme is the reference's (indexer.rs:64, seed 1337). The batched
+``compute_block_hashes`` dispatches to the C++ implementation when built.
 """
 
 from __future__ import annotations
@@ -25,7 +32,7 @@ import numpy as np
 import xxhash
 
 # Seed matching the reference's router-side block hasher
-# (reference: lib/llm/src/kv_router/indexer.rs:64 — xxh3 seed 1337).
+# (reference: lib/llm/src/kv_router/indexer.rs:64 — seed 1337).
 DEFAULT_SALT = b"dynamo-tpu"
 ROUTER_SEED = 1337
 
@@ -37,7 +44,7 @@ def salt_hash(salt: bytes = DEFAULT_SALT) -> int:
     ``TokenSequence(..., salt=...)`` (or ``seed=salt_hash(salt)`` to the
     free functions) so identical token content hashes differently per salt.
     """
-    return xxhash.xxh3_64_intdigest(salt)
+    return xxhash.xxh64_intdigest(salt)
 
 
 def _tokens_to_bytes(token_ids: Sequence[int]) -> bytes:
@@ -46,7 +53,7 @@ def _tokens_to_bytes(token_ids: Sequence[int]) -> bytes:
 
 def compute_block_hash(token_ids: Sequence[int], seed: int = ROUTER_SEED) -> int:
     """Salted content hash of one block's token ids (position-independent)."""
-    return xxhash.xxh3_64_intdigest(_tokens_to_bytes(token_ids), seed=seed)
+    return xxhash.xxh64_intdigest(_tokens_to_bytes(token_ids), seed=seed)
 
 
 def chain_hash(parent_sequence_hash: Optional[int], block_hash: int) -> int:
@@ -54,7 +61,7 @@ def chain_hash(parent_sequence_hash: Optional[int], block_hash: int) -> int:
     if parent_sequence_hash is None:
         return block_hash
     buf = np.asarray([parent_sequence_hash, block_hash], dtype=np.uint64).tobytes()
-    return xxhash.xxh3_64_intdigest(buf)
+    return xxhash.xxh64_intdigest(buf)
 
 
 def compute_block_hashes(
@@ -64,19 +71,47 @@ def compute_block_hashes(
 
     This is the hot path used by the KV router on every scheduling decision
     (reference: lib/llm/src/kv_router/indexer.rs:123 compute_block_hash_for_seq):
-    only full blocks are hashed; the ragged tail is ignored.
+    only full blocks are hashed; the ragged tail is ignored. Dispatches to the
+    native C++ implementation (dynamo_tpu/native) when built; set
+    ``DYNAMO_TPU_NATIVE=0`` to force pure Python.
     """
+    fn = _get_native()
+    if fn is not None:
+        return fn(token_ids, block_size, seed)
     n_full = len(token_ids) // block_size
     out: List[int] = []
     parent: Optional[int] = None
     arr = np.asarray(token_ids[: n_full * block_size], dtype=np.uint32)
     for i in range(n_full):
-        bh = xxhash.xxh3_64_intdigest(
+        bh = xxhash.xxh64_intdigest(
             arr[i * block_size : (i + 1) * block_size].tobytes(), seed=seed
         )
         parent = chain_hash(parent, bh)
         out.append(parent)
     return out
+
+
+# native dispatch is lazy: the first hashing call (not package import) pays
+# the one-time C++ build check, and DYNAMO_TPU_NATIVE=0 opts out entirely
+_native_hashes = None
+_native_checked = False
+
+
+def _get_native():
+    global _native_hashes, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        import os
+
+        if os.environ.get("DYNAMO_TPU_NATIVE", "1").lower() not in ("0", "false"):
+            try:
+                from . import native
+
+                if native.available():
+                    _native_hashes = native.compute_block_hashes
+            except Exception:  # pragma: no cover - broken toolchain
+                pass
+    return _native_hashes
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,232 @@
+"""Native C++ core: hash parity, differential radix-tree testing, C ABI.
+
+The pure-Python implementations (tokens.py, kv_router/indexer.py) are the
+executable spec; the C++ hot paths must match them bit-for-bit.
+"""
+
+import random
+
+import pytest
+import xxhash
+
+from dynamo_tpu import native
+from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.protocols import KvCacheRemoved, KvCacheStored, RouterEvent
+from dynamo_tpu.tokens import chain_hash, compute_block_hash, compute_block_hashes
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native C++ core not built (no toolchain?)"
+)
+
+
+def test_xxh64_matches_python_xxhash():
+    rng = random.Random(42)
+    for _ in range(200):
+        data = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300)))
+        seed = rng.randrange(2**64)
+        assert native.xxh64(data, seed) == xxhash.xxh64_intdigest(data, seed=seed)
+
+
+def test_native_block_hashes_match_python():
+    rng = random.Random(7)
+    for _ in range(50):
+        n = rng.randrange(0, 100)
+        tokens = [rng.randrange(2**31) for _ in range(n)]
+        bs = rng.choice([1, 4, 16, 32])
+        seed = rng.randrange(2**63)
+        got = native.compute_block_hashes(tokens, bs, seed)
+        # hand-rolled python chain (avoid the dispatching wrapper)
+        expect = []
+        parent = None
+        for i in range(n // bs):
+            bh = compute_block_hash(tokens[i * bs : (i + 1) * bs], seed)
+            parent = chain_hash(parent, bh)
+            expect.append(parent)
+        assert got == expect
+
+
+def test_tokens_module_dispatches_to_native():
+    # the public API must give the same answer regardless of dispatch
+    tokens = list(range(64))
+    from dynamo_tpu import tokens as tokmod
+
+    via_module = compute_block_hashes(tokens, 16)
+    saved = tokmod._native_hashes
+    try:
+        tokmod._native_hashes = None
+        via_python = compute_block_hashes(tokens, 16)
+    finally:
+        tokmod._native_hashes = saved
+    assert via_module == via_python
+
+
+def _random_events(rng, n_workers=4, n_events=300, block_size=4):
+    """Random stored/removed event stream + chains for querying."""
+    chains = []  # list of hash-chains built from random token seqs
+    for _ in range(12):
+        toks = [rng.randrange(1000) for _ in range(block_size * rng.randrange(1, 9))]
+        chains.append(compute_block_hashes(toks, block_size))
+    events = []
+    for eid in range(n_events):
+        worker = f"w{rng.randrange(n_workers)}"
+        chain = rng.choice(chains)
+        if rng.random() < 0.7:
+            # store a prefix or suffix segment of a chain
+            start = rng.randrange(len(chain))
+            end = rng.randrange(start, len(chain)) + 1
+            parent = chain[start - 1] if start > 0 else None
+            events.append(RouterEvent(
+                worker_id=worker, event_id=eid,
+                stored=KvCacheStored(block_hashes=chain[start:end], parent_hash=parent),
+            ))
+        else:
+            k = rng.randrange(1, len(chain) + 1)
+            events.append(RouterEvent(
+                worker_id=worker, event_id=eid,
+                removed=KvCacheRemoved(block_hashes=rng.sample(chain, k)),
+            ))
+    return chains, events
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_native_tree_differential(seed):
+    rng = random.Random(seed)
+    chains, events = _random_events(rng)
+    py = RadixTree()
+    cpp = native.NativeRadixTree()
+    for ev in events:
+        py.apply_event(ev)
+        if ev.stored is not None:
+            cpp.apply_stored(ev.worker_id, ev.stored.parent_hash, ev.stored.block_hashes)
+        if ev.removed is not None:
+            cpp.apply_removed(ev.worker_id, ev.removed.block_hashes)
+    assert len(py) == len(cpp)
+    for chain in chains:
+        for qlen in (1, len(chain) // 2 + 1, len(chain)):
+            for early in (False, True):
+                expect = py.find_matches(chain[:qlen], early_exit=early)
+                scores, freqs = cpp.find_matches(chain[:qlen], early_exit=early)
+                assert scores == expect.scores, (qlen, early)
+                assert freqs == expect.frequencies, (qlen, early)
+    # worker removal must also agree
+    py.remove_worker("w0")
+    cpp.remove_worker("w0")
+    assert len(py) == len(cpp)
+    for chain in chains:
+        expect = py.find_matches(chain)
+        scores, _ = cpp.find_matches(chain)
+        assert scores == expect.scores
+
+
+def test_native_tree_expiration_parity():
+    import time
+
+    chain = compute_block_hashes(list(range(16)), 4)
+    py = RadixTree(expiration_s=0.05)
+    cpp = native.NativeRadixTree(expiration_s=0.05)
+    py.apply_event(RouterEvent(worker_id="w", stored=KvCacheStored(chain)))
+    cpp.apply_stored("w", None, chain)
+    assert py.find_matches(chain).scores == {"w": 4}
+    assert cpp.find_matches(chain)[0] == {"w": 4}
+    time.sleep(0.1)
+    assert py.find_matches(chain).scores == {}
+    assert cpp.find_matches(chain)[0] == {}
+    # clear_expired prunes leaf-first the same way
+    assert py.clear_expired() == cpp.clear_expired()
+    assert len(py) == len(cpp)
+
+
+def test_native_tree_early_exit_extends_single_holder():
+    chain = compute_block_hashes(list(range(32)), 4)
+    cpp = native.NativeRadixTree()
+    cpp.apply_stored("solo", None, chain)
+    scores, freqs = cpp.find_matches(chain, early_exit=True)
+    assert scores == {"solo": len(chain)}
+    assert len(freqs) == len(chain)
+
+
+def test_kv_indexer_uses_native_by_default():
+    idx = KvIndexer(block_size=4)
+    from dynamo_tpu.kv_router.indexer import _NativeTreeAdapter
+
+    assert isinstance(idx.tree, _NativeTreeAdapter)
+    chain = compute_block_hashes(list(range(16)), 4)
+    idx.apply_event(RouterEvent(worker_id="a", stored=KvCacheStored(chain)))
+    out = idx.find_matches_for_request(list(range(16)))
+    assert isinstance(out, OverlapScores)
+    assert out.scores == {"a": 4}
+    # forced python still works
+    py_idx = KvIndexer(block_size=4, use_native=False)
+    py_idx.apply_event(RouterEvent(worker_id="a", stored=KvCacheStored(chain)))
+    assert py_idx.find_matches_for_request(list(range(16))).scores == {"a": 4}
+
+
+class TestCApi:
+    def test_publish_roundtrip(self):
+        capi = native.CApi()
+        assert capi.init("ns", "comp", "worker-7", kv_block_size=4) == 0
+        try:
+            got = []
+            capi.set_sink(got.append)
+            tokens = list(range(12))
+            assert capi.publish_stored(1, tokens) == 0
+            assert len(got) == 1
+            ev = RouterEvent.from_wire(got[0])
+            assert ev.worker_id == "worker-7"
+            assert ev.event_id == 1
+            # hashes computed inside the C ABI must match the Python scheme
+            assert ev.stored.block_hashes == compute_block_hashes(tokens, 4)
+            assert ev.stored.parent_hash is None
+
+            # chained publish from an explicit parent
+            parent = ev.stored.block_hashes[-1]
+            assert capi.publish_stored(2, list(range(12, 16)), parent_hash=parent) == 0
+            ev2 = RouterEvent.from_wire(got[1])
+            full = compute_block_hashes(list(range(16)), 4)
+            assert ev2.stored.block_hashes == [full[-1]]
+            assert ev2.stored.parent_hash == parent
+
+            assert capi.publish_removed(3, [1, 2, 3]) == 0
+            ev3 = RouterEvent.from_wire(got[2])
+            assert ev3.removed.block_hashes == [1, 2, 3]
+        finally:
+            capi.shutdown()
+
+    def test_worker_id_json_escaped(self):
+        capi = native.CApi()
+        assert capi.init("ns", "comp", 'w"\\evil\n', kv_block_size=4) == 0
+        try:
+            got = []
+            capi.set_sink(got.append)
+            assert capi.publish_removed(1, [7]) == 0
+            assert got[0]["worker_id"] == 'w"\\evil\n'
+        finally:
+            capi.shutdown()
+
+    def test_drain_grows_buffer_for_oversized_events(self):
+        capi = native.CApi()
+        assert capi.init("ns", "comp", "w0", kv_block_size=4) == 0
+        try:
+            big = list(range(5000))
+            assert capi.publish_removed(1, big) == 0
+            ev = capi.drain(cap=64)  # far smaller than the event
+            assert ev is not None and ev["removed"]["block_hashes"] == big
+            assert capi.drain(cap=64) is None
+        finally:
+            capi.shutdown()
+
+    def test_drain_mode_and_errors(self):
+        capi = native.CApi()
+        # not initialized → status 1
+        assert capi.publish_removed(1, [5]) == 1
+        assert capi.init("ns", "comp", "w0", kv_block_size=4) == 0
+        try:
+            assert capi.init("ns", "comp", "w0", kv_block_size=4) == 1  # double init
+            assert capi.publish_stored(9, list(range(8))) == 0
+            ev = capi.drain()
+            assert ev is not None and ev["event_id"] == 9
+            assert capi.drain() is None
+            # partial blocks only → bad args
+            assert capi.publish_stored(10, [1, 2]) == 2
+        finally:
+            capi.shutdown()
