@@ -197,18 +197,14 @@ class _NativeTreeAdapter:
 
 
 def _make_tree(expiration_s: Optional[float], use_native: Optional[bool]):
-    import os
-
-    if use_native is None and os.environ.get(
-        "DYNAMO_TPU_NATIVE", "1"
-    ).lower() in ("0", "false"):
-        use_native = False  # operator kill-switch (explicit True overrides)
-    if use_native is False:
-        return RadixTree(expiration_s)
     try:
         from .. import native
     except Exception:
         native = None
+    if use_native is None and native is not None and native.disabled_by_env():
+        use_native = False  # operator kill-switch (explicit True overrides)
+    if use_native is False:
+        return RadixTree(expiration_s)
     if native is not None and native.available():
         return _NativeTreeAdapter(native, expiration_s)
     if use_native:

@@ -29,6 +29,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from . import build as _build
+from .build import disabled_by_env
 
 _lib = None
 _lib_err: Optional[str] = None
@@ -240,6 +241,15 @@ class CApi:
         self._lib = lib
         self._sink_ref = None  # keep the ctypes callback alive
 
+    def __del__(self):
+        # the C global must not outlive the ctypes trampoline this object
+        # holds — clear it so no dangling function pointer remains
+        if getattr(self, "_sink_ref", None) is not None:
+            try:
+                self._lib.dt_capi_set_sink(None, None)
+            except Exception:  # pragma: no cover - interpreter shutdown
+                pass
+
     def init(self, namespace: str, component: str, worker_id: str,
              kv_block_size: int = 16, hash_seed: int = 1337) -> int:
         return int(self._lib.dt_capi_init(
@@ -253,11 +263,14 @@ class CApi:
 
     def set_sink(self, fn: Optional[Callable[[dict], None]]) -> None:
         if fn is None:
-            self._sink_ref = _SINK_CFUNC(0)
-        else:
-            def trampoline(raw: bytes, _user):
-                fn(json.loads(raw.decode()))
-            self._sink_ref = _SINK_CFUNC(trampoline)
+            self._sink_ref = None
+            self._lib.dt_capi_set_sink(None, None)
+            return
+
+        def trampoline(raw: bytes, _user):
+            fn(json.loads(raw.decode()))
+
+        self._sink_ref = _SINK_CFUNC(trampoline)
         self._lib.dt_capi_set_sink(self._sink_ref, None)
 
     def publish_stored(self, event_id: int, token_ids: Sequence[int],
@@ -283,6 +296,7 @@ class CApi:
     def drain(self, cap: int = 1 << 20) -> Optional[dict]:
         # -1 = head event bigger than cap (stays queued) — grow and retry
         # so one oversized event can't wedge the queue
+        cap = max(int(cap), 64)
         while True:
             buf = ctypes.create_string_buffer(cap)
             n = self._lib.dt_capi_drain(buf, cap)

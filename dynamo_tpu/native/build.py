@@ -19,6 +19,15 @@ import subprocess
 from pathlib import Path
 from typing import Optional
 
+def disabled_by_env() -> bool:
+    """Operator kill-switch: DYNAMO_TPU_NATIVE=0 forces pure Python
+    everywhere (hashing AND indexer — single source of truth for both
+    dispatch sites)."""
+    return os.environ.get("DYNAMO_TPU_NATIVE", "1").lower() in (
+        "0", "false", "off", "no",
+    )
+
+
 _SRC_DIR = Path(__file__).parent / "src"
 _BUILD_DIR = Path(__file__).parent / "_build"
 _SOURCES = ("indexer.cc", "capi.cc")
@@ -68,8 +77,9 @@ def build(verbose: bool = False) -> Optional[Path]:
         return None
     finally:
         tmp.unlink(missing_ok=True)
-    # drop stale builds (and orphaned .tmp* from crashed compiles)
-    for old in _BUILD_DIR.glob("libdynamo_native-*"):
+    # drop stale builds — only finished .so files; .tmp<pid> may be another
+    # process's in-progress compile (crash leftovers are tiny and harmless)
+    for old in _BUILD_DIR.glob("libdynamo_native-*.so"):
         if old != out:
             try:
                 old.unlink()

@@ -1,0 +1,22 @@
+"""Parallelism toolkit: meshes, multi-host bring-up, sequence parallelism.
+
+See mesh.py for the axis vocabulary (dp/tp/sp/ep/pp) and
+ring_attention.py / sequence.py for long-context attention.
+"""
+
+from .mesh import AXES, MultiHostConfig, initialize_multihost, make_mesh, mesh_shape
+from .ring_attention import dense_reference, ring_attention, ulysses_attention
+from .sequence import choose_strategy, sp_prefill_attention
+
+__all__ = [
+    "AXES",
+    "MultiHostConfig",
+    "initialize_multihost",
+    "make_mesh",
+    "mesh_shape",
+    "dense_reference",
+    "ring_attention",
+    "ulysses_attention",
+    "choose_strategy",
+    "sp_prefill_attention",
+]

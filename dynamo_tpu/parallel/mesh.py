@@ -1,0 +1,94 @@
+"""Device-mesh construction for every parallelism axis the framework uses.
+
+One mesh, named axes, shardings annotated per-array — XLA inserts the
+collectives (scaling-book recipe). Axes:
+
+- ``dp``: data parallel / replica scaling (reference analog: worker
+  replica sets, lib/runtime/src/component/client.rs:220-293)
+- ``tp``: tensor parallel (reference: --tensor-parallel-size pass-through,
+  launch/dynamo-run/src/flags.rs:62 — here native Megatron sharding)
+- ``sp``: sequence/context parallel for long-context prefill (ring or
+  all-to-all attention; absent in the reference — SURVEY.md §2.12)
+- ``ep``: expert parallel for MoE (reference: TRT-LLM
+  moe_expert_parallel_size pass-through only)
+- ``pp``: pipeline stages (reference: vllm0_7 Ray-based PP)
+
+Multi-host bring-up mirrors the reference's MultiNodeConfig
+{num_nodes, node_rank, leader_addr} (reference: lib/llm/src/engines.rs:39-57,
+Ray leader/follower in lib/engines/vllm0_7/src/ray.rs:66-230): JAX's
+coordinator plays the leader, ICI carries intra-slice traffic, DCN
+cross-slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+AXES = ("dp", "pp", "sp", "ep", "tp")  # canonical order, tp innermost (ICI)
+
+
+def make_mesh(
+    axes: Mapping[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Mesh over ``axes`` ({name: size}); tp placed innermost so its
+    collectives ride the fastest ICI links. Axes of size 1 are kept (specs
+    may name them; XLA drops trivial collectives)."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.devices()
+    names = tuple(a for a in AXES if a in axes)
+    extra = set(axes) - set(names)
+    if extra:
+        raise ValueError(f"unknown mesh axes {sorted(extra)}; valid: {AXES}")
+    sizes = tuple(int(axes[a]) for a in names)
+    total = int(np.prod(sizes)) if sizes else 1
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(axes)} needs {total} devices, have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes or (1,))
+    return Mesh(arr, names or ("dp",))
+
+
+@dataclasses.dataclass
+class MultiHostConfig:
+    """Analog of the reference's MultiNodeConfig (engines.rs:39-57)."""
+
+    leader_addr: str = ""     # "host:port" of the coordinator (node 0)
+    num_nodes: int = 1
+    node_rank: int = 0
+    local_device_ids: Optional[Sequence[int]] = None
+
+
+def initialize_multihost(cfg: MultiHostConfig) -> None:
+    """Join this process to the multi-host JAX runtime.
+
+    After this, ``jax.devices()`` is global across hosts and a mesh built
+    from it spans slices (ICI within a slice, DCN across). No-op for a
+    single node.
+    """
+    if cfg.num_nodes <= 1:
+        return
+    import jax
+
+    if not cfg.leader_addr:
+        raise ValueError("multi-host run needs leader_addr (coordinator host:port)")
+    logger.info(
+        "joining multihost runtime: leader=%s rank=%d/%d",
+        cfg.leader_addr, cfg.node_rank, cfg.num_nodes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.leader_addr,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank,
+        local_device_ids=cfg.local_device_ids,
+    )
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[Tuple[str, int], ...]:
+    return tuple((name, size) for name, size in mesh.shape.items())

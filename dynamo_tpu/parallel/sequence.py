@@ -1,0 +1,67 @@
+"""Engine-facing sequence-parallel prefill attention.
+
+``sp_prefill_attention`` is the drop-in long-context replacement for
+ops/attention.py::prefill_attention: same [B, S, ...] interface, but the
+sequence dim is sharded over the mesh's ``sp`` axis so a prompt far larger
+than one chip's attention memory prefills across the slice. Handles
+padding to the axis size and strategy selection (ring for very long S,
+all-to-all when heads divide nicely).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from .ring_attention import ring_attention, ulysses_attention
+
+
+def choose_strategy(seq_len: int, num_kv_heads: int, sp: int) -> str:
+    """ring: communication scales with S and works for any head count;
+    ulysses: lower latency at moderate S but needs KVH % sp == 0."""
+    if num_kv_heads % sp == 0 and seq_len <= 32768:
+        return "ulysses"
+    return "ring"
+
+
+def sp_prefill_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, KVH, D]
+    v: jax.Array,
+    valid_lens: jax.Array,  # [B]
+    mesh: Mesh,
+    axis: str = "sp",
+    strategy: str = "auto",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal self-attention over the full prompt, sequence-sharded.
+
+    Pads S up to a multiple of the sp axis size (padded positions are
+    masked via position id -1) and strips the padding from the output.
+    """
+    sp = mesh.shape[axis]
+    b, s, _h, _d = q.shape
+    pad = (-s) % sp
+    if pad:
+        zeros_q = jnp.zeros((b, pad) + q.shape[2:], q.dtype)
+        zeros_kv = jnp.zeros((b, pad) + k.shape[2:], k.dtype)
+        q = jnp.concatenate([q, zeros_q], axis=1)
+        k = jnp.concatenate([k, zeros_kv], axis=1)
+        v = jnp.concatenate([v, zeros_kv], axis=1)
+    s_padded = s + pad
+    # global positions; everything at/after a row's valid_len is padding
+    pos = jnp.arange(s_padded, dtype=jnp.int32)[None, :].repeat(b, axis=0)
+    pos = jnp.where(pos < valid_lens[:, None], pos, -1)
+
+    if strategy == "auto":
+        strategy = choose_strategy(s_padded, k.shape[2], sp)
+    if strategy == "ring":
+        out = ring_attention(q, k, v, pos, pos, mesh, axis=axis, scale=scale)
+    elif strategy == "ulysses":
+        out = ulysses_attention(q, k, v, pos, pos, mesh, axis=axis, scale=scale)
+    else:
+        raise ValueError(f"unknown sp strategy {strategy!r}; use auto|ring|ulysses")
+    return out[:, :s]

@@ -101,16 +101,13 @@ def _get_native():
     global _native_hashes, _native_checked
     if not _native_checked:
         _native_checked = True
-        import os
+        try:
+            from . import native
 
-        if os.environ.get("DYNAMO_TPU_NATIVE", "1").lower() not in ("0", "false"):
-            try:
-                from . import native
-
-                if native.available():
-                    _native_hashes = native.compute_block_hashes
-            except Exception:  # pragma: no cover - broken toolchain
-                pass
+            if not native.disabled_by_env() and native.available():
+                _native_hashes = native.compute_block_hashes
+        except Exception:  # pragma: no cover - broken toolchain
+            pass
     return _native_hashes
 
 
