@@ -33,6 +33,7 @@ class ModelConfig:
     # MoE (Mixtral-class); num_experts == 0 means dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 2.0  # headroom over perfectly-balanced routing
     # attention implementation: "auto" (pallas on TPU, xla elsewhere),
     # "xla", or "pallas"
     attention_impl: str = "auto"
@@ -93,8 +94,9 @@ class EngineConfig:
     num_kv_blocks: int = 2048        # HBM budget for the paged cache
     prefill_buckets: Optional[List[int]] = None
     dtype: str = "bfloat16"
-    # mesh axes: data-parallel replicas x tensor-parallel shards
+    # mesh axes: data-parallel replicas x expert-parallel x tensor-parallel
     dp_size: int = 1
+    ep_size: int = 1
     tp_size: int = 1
     seed: int = 0
     # scheduler knobs

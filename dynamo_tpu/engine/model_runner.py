@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import models
 from ..models import llama
 from .config import EngineConfig
 from .sampling import SamplingParams, logprobs_for, sample
@@ -29,35 +30,20 @@ from .sampling import SamplingParams, logprobs_for, sample
 logger = logging.getLogger(__name__)
 
 
-def build_mesh(dp: int, tp: int, devices=None) -> Mesh:
+def build_mesh(dp: int, tp: int, devices=None, ep: int = 1) -> Mesh:
+    """(dp, ep, tp) mesh; tp innermost so its collectives ride fastest ICI.
+    ep=1 keeps the axis present (specs may name it) but trivial."""
     devices = devices if devices is not None else jax.devices()
-    if dp * tp > len(devices):
-        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devices)}")
-    arr = np.asarray(devices[: dp * tp]).reshape(dp, tp)
-    return Mesh(arr, ("dp", "tp"))
+    n = dp * ep * tp
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{ep}x{tp} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, ep, tp)
+    return Mesh(arr, ("dp", "ep", "tp"))
 
 
 def param_specs(params) -> Dict:
-    """PartitionSpecs mirroring the param pytree (Megatron TP layout)."""
-    layer_specs = {
-        "ln1": P(),
-        "wq": P(None, None, "tp"),
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
-        "wo": P(None, "tp", None),
-        "ln2": P(),
-        "w_gate": P(None, None, "tp"),
-        "w_up": P(None, None, "tp"),
-        "w_down": P(None, "tp", None),
-    }
-    specs = {
-        "embed": P(),
-        "layers": {k: layer_specs[k] for k in params["layers"]},
-        "final_norm": P(),
-    }
-    if "lm_head" in params:
-        specs["lm_head"] = P(None, "tp")
-    return specs
+    """Llama param specs (kept for back-compat; models now own their specs)."""
+    return llama.param_specs(params)
 
 
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, N, bs, KVH, D] — KV heads over tp
@@ -75,26 +61,41 @@ class ModelRunner:
     ):
         self.config = config
         cfg = config.model
+        self.arch = models.resolve(cfg)
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-        self.mesh = mesh or build_mesh(config.dp_size, config.tp_size)
+        self.mesh = mesh or build_mesh(
+            config.dp_size, config.tp_size, ep=config.ep_size
+        )
 
         if cfg.num_kv_heads % config.tp_size != 0:
             raise ValueError(
                 f"num_kv_heads {cfg.num_kv_heads} not divisible by tp {config.tp_size}"
             )
+        if cfg.num_experts and cfg.num_experts % config.ep_size != 0:
+            raise ValueError(
+                f"num_experts {cfg.num_experts} not divisible by ep {config.ep_size}"
+            )
 
         if params is None:
             if model_dir is not None:
-                from ..models.loader import has_checkpoint, load_llama_params
+                if self.arch is llama:
+                    from ..models.loader import has_checkpoint, load_llama_params
 
-                if has_checkpoint(model_dir):
-                    params = load_llama_params(model_dir, cfg, self.dtype)
+                    if has_checkpoint(model_dir):
+                        params = load_llama_params(model_dir, cfg, self.dtype)
+                    else:
+                        logger.warning("no checkpoint in %s — random init", model_dir)
                 else:
-                    logger.warning("no checkpoint in %s — random init", model_dir)
+                    logger.warning(
+                        "no weight loader for %s yet — IGNORING checkpoint %s, "
+                        "serving random init", self.arch.__name__, model_dir,
+                    )
             if params is None:
-                params = llama.init_params(cfg, jax.random.PRNGKey(config.seed), self.dtype)
+                params = self.arch.init_params(
+                    cfg, jax.random.PRNGKey(config.seed), self.dtype
+                )
 
-        pspecs = param_specs(params)
+        pspecs = self.arch.param_specs(params)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, pspecs
         )
@@ -103,7 +104,7 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, P),
         )
 
-        cache = llama.init_kv_cache(
+        cache = self.arch.init_kv_cache(
             cfg, config.num_kv_blocks, config.kv_block_size, self.dtype
         )
         self.cache_sharding = NamedSharding(self.mesh, CACHE_SPEC)
@@ -118,13 +119,14 @@ class ModelRunner:
     def _build_step(self):
         cfg = self.config.model
         mesh = self.mesh
+        arch = self.arch
         batch_spec = NamedSharding(mesh, P("dp"))
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
 
         def step(params, k_cache, v_cache, tokens, positions, block_tables,
                  slot_mapping, context_lens, last_idx, temperature, top_k, top_p, key):
-            logits, (k_cache, v_cache) = llama.forward(
+            logits, (k_cache, v_cache) = arch.forward(
                 params, cfg, tokens, positions, (k_cache, v_cache),
                 block_tables, slot_mapping, context_lens,
                 mesh=mesh,

@@ -1,0 +1,33 @@
+"""Model registry: architecture config → implementing module.
+
+Each model module exposes the same functional surface —
+``init_params(cfg, key, dtype)``, ``init_kv_cache(cfg, n, bs, dtype)``,
+``forward(params, cfg, ...)`` and ``param_specs(params)`` — so the engine
+(engine/model_runner.py) is architecture-agnostic. The reference's
+equivalent "model family" axis lived inside its delegated GPU engines
+(vLLM/SGLang model zoos, SURVEY.md §2.4); here the zoo is native.
+"""
+
+from __future__ import annotations
+
+from ..engine.config import ModelConfig
+
+
+def resolve(cfg: ModelConfig):
+    """Pick the implementing module for an architecture config."""
+    if cfg.kv_lora_rank > 0:
+        try:
+            from . import deepseek
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                "kv_lora_rank > 0 selects MLA attention (DeepSeek-class), "
+                "which requires dynamo_tpu/models/deepseek.py"
+            ) from e
+        return deepseek
+    if cfg.num_experts > 0:
+        from . import mixtral
+
+        return mixtral
+    from . import llama
+
+    return llama

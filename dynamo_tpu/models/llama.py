@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
 from ..ops.attention import attention, scatter_kv
@@ -85,6 +86,38 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     return params
 
 
+# attention-trunk specs shared by every family using decoder_forward
+ATTN_LAYER_SPECS = {
+    "ln1": P(),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "ln2": P(),
+}
+
+
+def base_specs(params: Params) -> Dict:
+    """Specs for the non-layer params (embed / final_norm / lm_head)."""
+    specs: Dict = {"embed": P(), "final_norm": P()}
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def param_specs(params: Params) -> Dict:
+    """PartitionSpecs mirroring the param pytree (Megatron TP layout)."""
+    layer_specs = {
+        **ATTN_LAYER_SPECS,
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    specs = base_specs(params)
+    specs["layers"] = {k: layer_specs[k] for k in params["layers"]}
+    return specs
+
+
 def init_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ) -> KVCache:
@@ -92,7 +125,12 @@ def init_kv_cache(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def forward(
+def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
+    gate = jax.nn.silu(x @ layer_params["w_gate"])
+    return (gate * (x @ layer_params["w_up"])) @ layer_params["w_down"]
+
+
+def decoder_forward(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,        # [B, S]
@@ -102,8 +140,15 @@ def forward(
     slot_mapping: jax.Array,  # [B, S] flat cache slot per token; -1 drops
     context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
     mesh=None,                # multi-device mesh for the pallas shard_map path
+    mlp_fn=_swiglu_mlp,       # (normed_x [B,S,D], layer_params) -> [B,S,D]
 ) -> Tuple[jax.Array, KVCache]:
-    """Returns (logits [B, S, V], updated kv_cache)."""
+    """Shared decoder trunk: embed → scan(attention + mlp_fn) → logits.
+
+    The attention block (RoPE, paged-KV scatter, GQA attention) is common
+    to every model family; ``mlp_fn`` is the per-family feed-forward —
+    dense SwiGLU here, routed experts in models/mixtral.py.
+    Returns (logits [B, S, V], updated kv_cache).
+    """
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     b, s = tokens.shape
 
@@ -134,8 +179,7 @@ def forward(
         hidden = hidden + attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
 
         x = rms_norm(hidden, layer_params["ln2"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(x @ layer_params["w_gate"])
-        hidden = hidden + (gate * (x @ layer_params["w_up"])) @ layer_params["w_down"]
+        hidden = hidden + mlp_fn(x, layer_params)
         return (hidden, k_all, v_all, li + 1), None
 
     (hidden, k_all, v_all, _), _ = jax.lax.scan(
@@ -149,3 +193,21 @@ def forward(
     else:
         logits = hidden @ lm_head
     return logits, (k_all, v_all)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    kv_cache: KVCache,
+    block_tables: jax.Array,
+    slot_mapping: jax.Array,
+    context_lens: jax.Array,
+    mesh=None,
+) -> Tuple[jax.Array, KVCache]:
+    """Llama forward = shared trunk with the dense SwiGLU MLP."""
+    return decoder_forward(
+        params, cfg, tokens, positions, kv_cache, block_tables,
+        slot_mapping, context_lens, mesh=mesh, mlp_fn=_swiglu_mlp,
+    )

@@ -1,0 +1,178 @@
+"""Mixtral-family sparse-MoE decoder with expert parallelism.
+
+Same attention trunk as models/llama.py (GQA + RoPE + paged KV, one
+lax.scan over stacked layer weights); the dense SwiGLU MLP is replaced by
+a top-k routed mixture of experts.
+
+TPU-first dispatch (GShard/Switch dense formulation, not the reference's
+approach — the reference only passes moe_expert_parallel_size through to
+TRT-LLM, SURVEY.md §2.12): routing produces a 0/1 dispatch tensor
+[T, E, C] (token → expert slot with capacity C), expert compute is three
+batched einsums over [E, C, D] — static shapes, MXU-shaped matmuls, no
+scatter/gather — and the expert (E) dimension shards over the mesh's
+``ep`` axis while expert intermediates shard over ``tp``. XLA inserts the
+token all-to-alls implied by resharding [T, E, C] against [E, ...].
+
+Tokens beyond an expert's capacity are dropped for that expert (their
+residual stream still flows); capacity_factor sizes C.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from typing import Optional
+
+from ..engine.config import ModelConfig
+from .llama import (  # shared trunk + specs
+    ATTN_LAYER_SPECS,
+    base_specs,
+    decoder_forward,
+    init_kv_cache,
+)
+
+Params = Dict[str, Any]
+KVCache = Tuple[jax.Array, jax.Array]
+
+__all__ = [
+    "init_params", "init_kv_cache", "forward", "param_specs", "moe_mlp",
+    "expert_capacity",
+]
+
+
+def expert_capacity(
+    num_tokens: int, num_experts: int, top_k: int, capacity_factor: float = 2.0
+) -> int:
+    """Per-expert slot count C. At factor 1.0 a perfectly balanced router
+    drops nothing; headroom absorbs imbalance."""
+    return max(1, int(num_tokens * top_k * capacity_factor / num_experts))
+
+
+def moe_mlp(
+    x: jax.Array,         # [T, D] flattened tokens
+    router_w: jax.Array,  # [D, E]
+    w_gate: jax.Array,    # [E, D, I]
+    w_up: jax.Array,      # [E, D, I]
+    w_down: jax.Array,    # [E, I, D]
+    top_k: int,
+    capacity: int,
+    valid: Optional[jax.Array] = None,  # [T] 1.0 = real token, 0.0 = pad
+) -> jax.Array:
+    """Top-k routed SwiGLU experts via dense one-hot dispatch.
+
+    Pad tokens (``valid == 0``) claim no expert slots and contribute
+    nothing — otherwise bucket padding would displace real tokens from
+    capacity-bounded experts.
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+
+    probs = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)  # [T, E]
+    gate_vals, gate_idx = lax.top_k(probs, top_k)                        # [T, K]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9
+    )
+
+    # slot assignment: token-major priority over the flattened (T, K) choices
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [T, K, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None, None]
+        gate_vals = gate_vals * valid[:, None]
+    flat = onehot.reshape(t * top_k, e)
+    pos = jnp.cumsum(flat, axis=0) - flat                    # queue position
+    keep = (pos < capacity).astype(jnp.float32) * flat       # [T*K, E]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
+    dispatch = slot.sum(axis=1)                              # [T, E, C] 0/1
+    combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)  # [T, E, C]
+
+    x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)   # [E, C, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edi->eci", x_e, w_gate))
+    h = h * jnp.einsum("ecd,edi->eci", x_e, w_up)
+    y_e = jnp.einsum("eci,eid->ecd", h, w_down)                    # [E, C, D]
+    return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y_e)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    l, d_model = cfg.num_layers, cfg.hidden_size
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    inter, e = cfg.intermediate_size, cfg.num_experts
+    keys = jax.random.split(key, 12)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
+        "layers": {
+            "ln1": jnp.ones((l, d_model), dtype),
+            "wq": w(keys[1], (l, d_model, h * hd), d_model),
+            "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
+            "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
+            "wo": w(keys[4], (l, h * hd, d_model), h * hd),
+            "ln2": jnp.ones((l, d_model), dtype),
+            "router": w(keys[5], (l, d_model, e), d_model),
+            "w_gate": w(keys[6], (l, e, d_model, inter), d_model),
+            "w_up": w(keys[7], (l, e, d_model, inter), d_model),
+            "w_down": w(keys[8], (l, e, inter, d_model), inter),
+        },
+        "final_norm": jnp.ones((d_model,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[9], (d_model, cfg.vocab_size), d_model)
+    return params
+
+
+def param_specs(params: Params) -> Dict:
+    """Megatron TP on attention; experts over ep, expert intermediates over
+    tp (so one expert's matmuls still tensor-parallelize within its group)."""
+    layer_specs = {
+        **ATTN_LAYER_SPECS,
+        "router": P(),
+        "w_gate": P(None, "ep", None, "tp"),
+        "w_up": P(None, "ep", None, "tp"),
+        "w_down": P(None, "ep", "tp", None),
+    }
+    specs = base_specs(params)
+    specs["layers"] = {k: layer_specs[k] for k in params["layers"]}
+    return specs
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S]
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, W]
+    slot_mapping: jax.Array,  # [B, S]
+    context_lens: jax.Array,  # [B]
+    mesh=None,
+) -> Tuple[jax.Array, KVCache]:
+    """Returns (logits [B, S, V], updated kv_cache): the shared decoder
+    trunk (models/llama.py decoder_forward) with the routed-experts MLP.
+    Bucket-padding tokens (slot_mapping < 0) are masked out of routing."""
+    b, s = tokens.shape
+    capacity = expert_capacity(
+        b * s, cfg.num_experts, cfg.num_experts_per_tok, cfg.moe_capacity_factor
+    )
+    valid = (slot_mapping.reshape(b * s) >= 0).astype(jnp.float32)
+
+    def mlp(x, layer_params):
+        y = moe_mlp(
+            x.reshape(b * s, -1),
+            layer_params["router"],
+            layer_params["w_gate"], layer_params["w_up"], layer_params["w_down"],
+            cfg.num_experts_per_tok, capacity, valid=valid,
+        )
+        return y.reshape(b, s, -1)
+
+    return decoder_forward(
+        params, cfg, tokens, positions, kv_cache, block_tables,
+        slot_mapping, context_lens, mesh=mesh, mlp_fn=mlp,
+    )
