@@ -34,6 +34,9 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 2.0  # headroom over perfectly-balanced routing
+    moe_intermediate_size: int = 0    # per-expert width; 0 → intermediate_size
+    n_shared_experts: int = 0         # DeepSeek always-on shared expert count
+    first_k_dense_replace: int = 0    # DeepSeek: first k layers use dense MLP
     # attention implementation: "auto" (pallas on TPU, xla elsewhere),
     # "xla", or "pallas"
     attention_impl: str = "auto"
@@ -47,6 +50,17 @@ class ModelConfig:
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
+        if self.kv_lora_rank > 0:
+            missing = [
+                name for name in
+                ("qk_nope_head_dim", "qk_rope_head_dim", "v_head_dim")
+                if getattr(self, name) <= 0
+            ]
+            if missing:
+                raise ValueError(
+                    f"kv_lora_rank={self.kv_lora_rank} selects MLA attention, "
+                    f"which also requires {', '.join(missing)} > 0"
+                )
 
     @classmethod
     def from_hf_config(cls, config: dict) -> "ModelConfig":
@@ -64,8 +78,19 @@ class ModelConfig:
             rms_norm_eps=config.get("rms_norm_eps", 1e-5),
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
-            num_experts=config.get("num_local_experts", 0) or 0,
+            num_experts=config.get("num_local_experts", 0)
+            or config.get("n_routed_experts", 0)
+            or 0,
             num_experts_per_tok=config.get("num_experts_per_tok", 2),
+            moe_intermediate_size=config.get("moe_intermediate_size", 0) or 0,
+            n_shared_experts=config.get("n_shared_experts", 0) or 0,
+            first_k_dense_replace=config.get("first_k_dense_replace", 0) or 0,
+            # MLA (DeepSeek config.json keys)
+            kv_lora_rank=config.get("kv_lora_rank", 0) or 0,
+            q_lora_rank=config.get("q_lora_rank", 0) or 0,
+            qk_rope_head_dim=config.get("qk_rope_head_dim", 0) or 0,
+            qk_nope_head_dim=config.get("qk_nope_head_dim", 0) or 0,
+            v_head_dim=config.get("v_head_dim", 0) or 0,
         )
 
     @classmethod

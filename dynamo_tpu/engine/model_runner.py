@@ -67,9 +67,14 @@ class ModelRunner:
             config.dp_size, config.tp_size, ep=config.ep_size
         )
 
-        if cfg.num_kv_heads % config.tp_size != 0:
+        if cfg.kv_lora_rank == 0 and cfg.num_kv_heads % config.tp_size != 0:
+            # (MLA caches a per-token latent, no KV head dim to shard)
             raise ValueError(
                 f"num_kv_heads {cfg.num_kv_heads} not divisible by tp {config.tp_size}"
+            )
+        if cfg.num_heads % config.tp_size != 0:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by tp {config.tp_size}"
             )
         if cfg.num_experts and cfg.num_experts % config.ep_size != 0:
             raise ValueError(
@@ -107,7 +112,8 @@ class ModelRunner:
         cache = self.arch.init_kv_cache(
             cfg, config.num_kv_blocks, config.kv_block_size, self.dtype
         )
-        self.cache_sharding = NamedSharding(self.mesh, CACHE_SPEC)
+        cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
+        self.cache_sharding = NamedSharding(self.mesh, cache_spec)
         self.kv_cache = tuple(jax.device_put(c, self.cache_sharding) for c in cache)
 
         self._step_compiled = {}

@@ -1,0 +1,305 @@
+"""DeepSeek-class decoder with Multi-head Latent Attention (MLA).
+
+MLA compresses each token's KV state to a low-rank latent ``c_kv``
+(kv_lora_rank wide) plus one shared RoPE key (qk_rope_head_dim wide) —
+the paged cache stores ONLY those two vectors per token, cutting KV
+memory by ~an order of magnitude vs per-head K/V and letting far more
+sequences fit in HBM (the reference serves DeepSeek-R1 only by delegating
+to engines that implement MLA; SURVEY.md §7 step 8 names MLA a scale-out
+milestone for this framework).
+
+TPU-first formulation — the *absorbed* form runs everywhere (prefill and
+decode) so attention reads the compressed cache directly:
+
+    score(q, t) = (q_nope W_uk) · c_kv[t] + q_rope · k_rope[t]
+    out_latent  = softmax(score) @ c_kv        ->  o = out_latent W_uv W_o
+
+i.e. W_uk is folded into the query and W_uv applied after attention, so
+the per-token cache line stays [kv_lora_rank + qk_rope_head_dim] and the
+big einsums stay MXU-shaped. TP shards query/output heads; the latent
+cache is replicated over tp (it is tiny and per-token, not per-head).
+
+Full DeepSeek-V2/V3 MLP topology: the first ``first_k_dense_replace``
+layers use a dense SwiGLU at ``intermediate_size``; the remaining layers
+are MoE with experts at ``moe_intermediate_size`` plus ``n_shared_experts``
+always-on shared experts. All of it reuses the shared trunk pieces:
+llama.run_layers scans each layer group, mixtral.make_moe_mlp_fn routes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from ..ops.attention import scatter_kv
+from .llama import _swiglu_mlp, apply_rope, base_specs, lm_logits, rms_norm, run_layers
+from .mixtral import make_moe_mlp_fn
+
+Params = Dict[str, Any]
+KVCache = Tuple[jax.Array, jax.Array]  # (latent c_kv, shared k_rope) caches
+
+# the latent cache is replicated across tp (no head dim to shard)
+CACHE_SPEC = P()
+
+_warned_pallas = False
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> KVCache:
+    """Compressed cache: c_kv [L,N,bs,1,r] + k_rope [L,N,bs,1,rd]."""
+    c = jnp.zeros(
+        (cfg.num_layers, num_blocks, block_size, 1, cfg.kv_lora_rank), dtype
+    )
+    kr = jnp.zeros(
+        (cfg.num_layers, num_blocks, block_size, 1, cfg.qk_rope_head_dim), dtype
+    )
+    return c, kr
+
+
+def _split_layer_counts(cfg: ModelConfig) -> Tuple[int, int]:
+    """(dense-prefix layers, MoE layers)."""
+    if cfg.num_experts <= 0:
+        return cfg.num_layers, 0
+    k = min(cfg.first_k_dense_replace, cfg.num_layers)
+    return k, cfg.num_layers - k
+
+
+def _attn_params(cfg: ModelConfig, n_layers: int, key, w, dtype) -> Dict:
+    d_model, h = cfg.hidden_size, cfg.num_heads
+    r, qr = cfg.kv_lora_rank, cfg.q_lora_rank
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    vd = cfg.v_head_dim
+    l = n_layers
+    keys = jax.random.split(key, 8)
+    out: Dict[str, jax.Array] = {
+        "ln1": jnp.ones((l, d_model), dtype),
+        "w_dkv": w(keys[0], (l, d_model, r), d_model),
+        "ln_kv": jnp.ones((l, r), dtype),
+        "w_kr": w(keys[1], (l, d_model, rope), d_model),
+        "w_uk": w(keys[2], (l, r, h, nope), r),
+        "w_uv": w(keys[3], (l, r, h, vd), r),
+        "wo": w(keys[4], (l, h * vd, d_model), h * vd),
+        "ln2": jnp.ones((l, d_model), dtype),
+    }
+    if qr > 0:
+        out["w_dq"] = w(keys[5], (l, d_model, qr), d_model)
+        out["ln_q"] = jnp.ones((l, qr), dtype)
+        out["w_uq"] = w(keys[6], (l, qr, h * (nope + rope)), qr)
+    else:
+        out["wq"] = w(keys[5], (l, d_model, h * (nope + rope)), d_model)
+    return out
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    d_model = cfg.hidden_size
+    inter = cfg.intermediate_size
+    moe_inter = cfg.moe_intermediate_size or inter
+    e = cfg.num_experts
+    n_dense, n_moe = _split_layer_counts(cfg)
+    keys = jax.random.split(key, 12)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
+        "final_norm": jnp.ones((d_model,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[1], (d_model, cfg.vocab_size), d_model)
+
+    if n_dense > 0:
+        dense = _attn_params(cfg, n_dense, keys[2], w, dtype)
+        dense["w_gate"] = w(keys[3], (n_dense, d_model, inter), d_model)
+        dense["w_up"] = w(keys[4], (n_dense, d_model, inter), d_model)
+        dense["w_down"] = w(keys[5], (n_dense, inter, d_model), inter)
+        params["dense_layers"] = dense
+
+    if n_moe > 0:
+        moe = _attn_params(cfg, n_moe, keys[6], w, dtype)
+        moe["router"] = w(keys[7], (n_moe, d_model, e), d_model)
+        moe["w_gate"] = w(keys[8], (n_moe, e, d_model, moe_inter), d_model)
+        moe["w_up"] = w(keys[9], (n_moe, e, d_model, moe_inter), d_model)
+        moe["w_down"] = w(keys[10], (n_moe, e, moe_inter, d_model), moe_inter)
+        if cfg.n_shared_experts > 0:
+            sh = cfg.n_shared_experts * moe_inter
+            sk = jax.random.split(keys[11], 3)
+            moe["w_sh_gate"] = w(sk[0], (n_moe, d_model, sh), d_model)
+            moe["w_sh_up"] = w(sk[1], (n_moe, d_model, sh), d_model)
+            moe["w_sh_down"] = w(sk[2], (n_moe, sh, d_model), sh)
+        params["layers"] = moe
+    return params
+
+
+_MLA_ATTN_SPECS = {
+    "ln1": P(), "ln2": P(), "ln_kv": P(),
+    "w_dkv": P(), "w_kr": P(),
+    "w_uk": P(None, None, "tp", None),
+    "w_uv": P(None, None, "tp", None),
+    "wo": P(None, "tp", None),
+    "wq": P(None, None, "tp"),
+    "w_dq": P(), "ln_q": P(), "w_uq": P(None, None, "tp"),
+}
+
+
+def param_specs(params: Params) -> Dict:
+    """Heads shard over tp; latent down-projections + cache replicate;
+    experts (if MoE) over ep like models/mixtral.py."""
+    dense_specs = {
+        **_MLA_ATTN_SPECS,
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    moe_specs = {
+        **_MLA_ATTN_SPECS,
+        "router": P(),
+        "w_gate": P(None, "ep", None, "tp"),
+        "w_up": P(None, "ep", None, "tp"),
+        "w_down": P(None, "ep", "tp", None),
+        "w_sh_gate": P(None, None, "tp"),
+        "w_sh_up": P(None, None, "tp"),
+        "w_sh_down": P(None, "tp", None),
+    }
+    specs = base_specs(params)
+    if "dense_layers" in params:
+        specs["dense_layers"] = {
+            k: dense_specs[k] for k in params["dense_layers"]
+        }
+    if "layers" in params:
+        has_router = "router" in params["layers"]
+        table = moe_specs if has_router else dense_specs
+        specs["layers"] = {k: table[k] for k in params["layers"]}
+    return specs
+
+
+def mla_paged_attention(
+    q_lat: jax.Array,      # [B, S, H, r] — queries absorbed into latent space
+    q_rope: jax.Array,     # [B, S, H, rd] — post-RoPE decoupled queries
+    c_cache: jax.Array,    # [N, bs, 1, r]
+    kr_cache: jax.Array,   # [N, bs, 1, rd]
+    block_tables: jax.Array,  # [B, W]
+    q_positions: jax.Array,   # [B, S]
+    context_lens: jax.Array,  # [B]
+    scale: float,
+) -> jax.Array:
+    """Attention over the compressed cache; returns latent output [B,S,H,r]."""
+    b, s, h, r = q_lat.shape
+    _, block_size, _, rd = kr_cache.shape
+    w = block_tables.shape[1]
+    t = w * block_size
+
+    c = c_cache[block_tables].reshape(b, t, r)
+    kr = kr_cache[block_tables].reshape(b, t, rd)
+
+    scores = (
+        jnp.einsum("bshr,btr->bsht", q_lat, c)
+        + jnp.einsum("bshd,btd->bsht", q_rope, kr)
+    ) * scale
+    key_pos = jnp.arange(t)[None, None, :]
+    mask = (key_pos <= q_positions[:, :, None]) & (
+        key_pos < context_lens[:, None, None]
+    )
+    scores = jnp.where(mask[:, :, None, :], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q_lat.dtype)
+    return jnp.einsum("bsht,btr->bshr", probs, c)
+
+
+def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                     context_lens):
+    """MLA attention block for llama.run_layers."""
+    h = cfg.num_heads
+    nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    scale = (nope + rope_d) ** -0.5
+
+    def attn_fn(x, lp, c_all, kr_all, li):
+        # queries (optionally through the q low-rank bottleneck)
+        if "w_uq" in lp:
+            cq = rms_norm(x @ lp["w_dq"], lp["ln_q"], cfg.rms_norm_eps)
+            qfull = (cq @ lp["w_uq"]).reshape(b, s, h, nope + rope_d)
+        else:
+            qfull = (x @ lp["wq"]).reshape(b, s, h, nope + rope_d)
+        q_nope, q_rope = qfull[..., :nope], qfull[..., nope:]
+        q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
+
+        # compressed KV state for the new tokens
+        c_kv = rms_norm(x @ lp["w_dkv"], lp["ln_kv"], cfg.rms_norm_eps)
+        kr = apply_rope(
+            (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta
+        )  # [B, S, 1, rd]
+
+        c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
+        kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
+        c_layer, kr_layer = scatter_kv(
+            c_layer, kr_layer, c_kv[:, :, None, :], kr, slot_mapping
+        )
+        c_all = jax.lax.dynamic_update_index_in_dim(c_all, c_layer, li, 0)
+        kr_all = jax.lax.dynamic_update_index_in_dim(kr_all, kr_layer, li, 0)
+
+        # absorb W_uk into the query, attend over the latent cache
+        q_lat = jnp.einsum("bshn,rhn->bshr", q_nope, lp["w_uk"])
+        o_lat = mla_paged_attention(
+            q_lat, q_rope, c_layer, kr_layer, block_tables, positions,
+            context_lens, scale,
+        )
+        o = jnp.einsum("bshr,rhv->bshv", o_lat, lp["w_uv"])
+        delta = o.reshape(b, s, -1) @ lp["wo"]
+        return delta, c_all, kr_all
+
+    return attn_fn
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S]
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, W]
+    slot_mapping: jax.Array,  # [B, S]
+    context_lens: jax.Array,  # [B]
+    mesh=None,
+) -> Tuple[jax.Array, KVCache]:
+    """Returns (logits [B, S, V], updated (c_kv, k_rope) caches). Dense
+    prefix layers then MoE layers, chained through one contiguous cache.
+
+    MLA attention always runs the XLA gather path; a Pallas MLA kernel
+    does not exist yet, so ``attention_impl``/``mesh`` are accepted for
+    interface parity but the impl setting is ignored (warned once)."""
+    from ..ops.attention import resolve_attention_impl
+
+    if resolve_attention_impl(cfg.attention_impl) == "pallas":
+        global _warned_pallas
+        if not _warned_pallas:
+            _warned_pallas = True
+            logging.getLogger(__name__).warning(
+                "attention_impl resolves to 'pallas' but MLA has no Pallas "
+                "kernel yet — using the XLA gather path"
+            )
+    b, s = tokens.shape
+    hidden = params["embed"][tokens]
+    attn_fn = make_mla_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens
+    )
+
+    li = 0
+    if "dense_layers" in params:
+        hidden, kv_cache, li = run_layers(
+            hidden, kv_cache, params["dense_layers"], cfg, attn_fn,
+            _swiglu_mlp, li0=li,
+        )
+    if "layers" in params:
+        moe = "router" in params["layers"]
+        mlp_fn = (
+            make_moe_mlp_fn(cfg, b, s, slot_mapping) if moe else _swiglu_mlp
+        )
+        hidden, kv_cache, li = run_layers(
+            hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn, li0=li,
+        )
+    return lm_logits(hidden, params, cfg), kv_cache

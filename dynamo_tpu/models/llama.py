@@ -130,36 +130,14 @@ def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
     return (gate * (x @ layer_params["w_up"])) @ layer_params["w_down"]
 
 
-def decoder_forward(
-    params: Params,
-    cfg: ModelConfig,
-    tokens: jax.Array,        # [B, S]
-    positions: jax.Array,     # [B, S] absolute positions (pad → repeat last)
-    kv_cache: KVCache,
-    block_tables: jax.Array,  # [B, W] (W = kv_width blocks)
-    slot_mapping: jax.Array,  # [B, S] flat cache slot per token; -1 drops
-    context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
-    mesh=None,                # multi-device mesh for the pallas shard_map path
-    mlp_fn=_swiglu_mlp,       # (normed_x [B,S,D], layer_params) -> [B,S,D]
-) -> Tuple[jax.Array, KVCache]:
-    """Shared decoder trunk: embed → scan(attention + mlp_fn) → logits.
-
-    The attention block (RoPE, paged-KV scatter, GQA attention) is common
-    to every model family; ``mlp_fn`` is the per-family feed-forward —
-    dense SwiGLU here, routed experts in models/mixtral.py.
-    Returns (logits [B, S, V], updated kv_cache).
-    """
+def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                     context_lens, mesh):
+    """The standard attention block: QKV + RoPE, paged-KV scatter, GQA
+    attention, output projection. Families with different attention (MLA,
+    models/deepseek.py) plug their own via run_layers' attn_fn."""
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    b, s = tokens.shape
 
-    hidden = params["embed"][tokens]  # [B, S, D]
-
-    k_all, v_all = kv_cache
-
-    def layer_step(carry, layer_params):
-        hidden, k_all, v_all, li = carry
-
-        x = rms_norm(hidden, layer_params["ln1"], cfg.rms_norm_eps)
+    def attn_fn(x, layer_params, k_all, v_all, li):
         q = (x @ layer_params["wq"]).reshape(b, s, h_heads, hd)
         k = (x @ layer_params["wk"]).reshape(b, s, kvh, hd)
         v = (x @ layer_params["wv"]).reshape(b, s, kvh, hd)
@@ -176,23 +154,78 @@ def decoder_forward(
             q, k_layer, v_layer, block_tables, positions, context_lens,
             impl=cfg.attention_impl, mesh=mesh,
         )
-        hidden = hidden + attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
+        delta = attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
+        return delta, k_all, v_all
 
+    return attn_fn
+
+
+def run_layers(
+    hidden: jax.Array,
+    kv_cache: KVCache,
+    layers,                   # stacked layer pytree (leading L axis)
+    cfg: ModelConfig,
+    attn_fn,                  # (x, lp, k_all, v_all, li) -> (delta, k_all, v_all)
+    mlp_fn,                   # (x, lp) -> [B, S, D]
+    li0: int = 0,             # first layer's index into the KV cache
+):
+    """One lax.scan over a stacked group of decoder layers.
+
+    Families mix groups with different weights (DeepSeek: k dense layers
+    then MoE layers) by chaining calls — ``li0`` keeps cache layer indices
+    contiguous across groups. Returns (hidden, kv_cache, next_li).
+    """
+    k_all, v_all = kv_cache
+
+    def layer_step(carry, layer_params):
+        hidden, k_all, v_all, li = carry
+        x = rms_norm(hidden, layer_params["ln1"], cfg.rms_norm_eps)
+        delta, k_all, v_all = attn_fn(x, layer_params, k_all, v_all, li)
+        hidden = hidden + delta
         x = rms_norm(hidden, layer_params["ln2"], cfg.rms_norm_eps)
         hidden = hidden + mlp_fn(x, layer_params)
         return (hidden, k_all, v_all, li + 1), None
 
-    (hidden, k_all, v_all, _), _ = jax.lax.scan(
-        layer_step, (hidden, k_all, v_all, jnp.int32(0)), params["layers"]
+    (hidden, k_all, v_all, li), _ = jax.lax.scan(
+        layer_step, (hidden, k_all, v_all, jnp.int32(li0)), layers
     )
+    return hidden, (k_all, v_all), li
 
+
+def lm_logits(hidden: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")
-    if lm_head is None:
-        logits = hidden @ params["embed"].T
-    else:
-        logits = hidden @ lm_head
-    return logits, (k_all, v_all)
+    return hidden @ (params["embed"].T if lm_head is None else lm_head)
+
+
+def decoder_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S] absolute positions (pad → repeat last)
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, W] (W = kv_width blocks)
+    slot_mapping: jax.Array,  # [B, S] flat cache slot per token; -1 drops
+    context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
+    mesh=None,                # multi-device mesh for the pallas shard_map path
+    mlp_fn=_swiglu_mlp,       # (normed_x [B,S,D], layer_params) -> [B,S,D]
+) -> Tuple[jax.Array, KVCache]:
+    """Shared decoder trunk: embed → scan(attention + mlp_fn) → logits.
+
+    The attention block (RoPE, paged-KV scatter, GQA attention) is common
+    to GQA families; ``mlp_fn`` is the per-family feed-forward — dense
+    SwiGLU here, routed experts in models/mixtral.py.
+    Returns (logits [B, S, V], updated kv_cache).
+    """
+    b, s = tokens.shape
+    hidden = params["embed"][tokens]  # [B, S, D]
+    attn_fn = make_gqa_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens, mesh
+    )
+    hidden, kv_cache, _ = run_layers(
+        hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn
+    )
+    return lm_logits(hidden, params, cfg), kv_cache
 
 
 def forward(

@@ -41,7 +41,7 @@ KVCache = Tuple[jax.Array, jax.Array]
 
 __all__ = [
     "init_params", "init_kv_cache", "forward", "param_specs", "moe_mlp",
-    "expert_capacity",
+    "make_moe_mlp_fn", "expert_capacity",
 ]
 
 
@@ -101,7 +101,8 @@ def moe_mlp(
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     l, d_model = cfg.num_layers, cfg.hidden_size
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    inter, e = cfg.intermediate_size, cfg.num_experts
+    inter = cfg.moe_intermediate_size or cfg.intermediate_size
+    e = cfg.num_experts
     keys = jax.random.split(key, 12)
 
     def w(key, shape, fan_in):
@@ -158,6 +159,16 @@ def forward(
     trunk (models/llama.py decoder_forward) with the routed-experts MLP.
     Bucket-padding tokens (slot_mapping < 0) are masked out of routing."""
     b, s = tokens.shape
+    return decoder_forward(
+        params, cfg, tokens, positions, kv_cache, block_tables,
+        slot_mapping, context_lens, mesh=mesh,
+        mlp_fn=make_moe_mlp_fn(cfg, b, s, slot_mapping),
+    )
+
+
+def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
+    """Routed-experts mlp_fn for run_layers/decoder_forward; shared with
+    models/deepseek.py (DeepSeek MoE layers, incl. its shared expert)."""
     capacity = expert_capacity(
         b * s, cfg.num_experts, cfg.num_experts_per_tok, cfg.moe_capacity_factor
     )
@@ -170,9 +181,11 @@ def forward(
             layer_params["w_gate"], layer_params["w_up"], layer_params["w_down"],
             cfg.num_experts_per_tok, capacity, valid=valid,
         )
-        return y.reshape(b, s, -1)
+        y = y.reshape(b, s, -1)
+        if "w_sh_gate" in layer_params:
+            # always-on shared expert(s) alongside the routed ones
+            gate = jax.nn.silu(x @ layer_params["w_sh_gate"])
+            y = y + (gate * (x @ layer_params["w_sh_up"])) @ layer_params["w_sh_down"]
+        return y
 
-    return decoder_forward(
-        params, cfg, tokens, positions, kv_cache, block_tables,
-        slot_mapping, context_lens, mesh=mesh, mlp_fn=mlp,
-    )
+    return mlp

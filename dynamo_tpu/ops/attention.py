@@ -28,16 +28,20 @@ def scatter_kv(
     new_v: jax.Array,
     slot_mapping: jax.Array,  # [B, S] flat slot index (block*bs + off); -1 → drop
 ) -> Tuple[jax.Array, jax.Array]:
-    """Write new K/V into cache slots. Out-of-range (-1) slots are dropped."""
-    n_blocks, block_size, kvh, d = k_cache.shape
-    flat_k = k_cache.reshape(n_blocks * block_size, kvh, d)
-    flat_v = v_cache.reshape(n_blocks * block_size, kvh, d)
+    """Write new K/V into cache slots. Out-of-range (-1) slots are dropped.
+
+    The two caches may have different trailing (heads, dim) — MLA stores a
+    latent in "k" and the shared rope key in "v" (models/deepseek.py)."""
+    n_blocks, block_size, kvh, dk = k_cache.shape
+    vh, dv = v_cache.shape[-2:]
+    flat_k = k_cache.reshape(n_blocks * block_size, kvh, dk)
+    flat_v = v_cache.reshape(n_blocks * block_size, vh, dv)
     idx = slot_mapping.reshape(-1)
-    flat_k = flat_k.at[idx].set(new_k.reshape(-1, kvh, d), mode="drop")
-    flat_v = flat_v.at[idx].set(new_v.reshape(-1, kvh, d), mode="drop")
+    flat_k = flat_k.at[idx].set(new_k.reshape(-1, kvh, dk), mode="drop")
+    flat_v = flat_v.at[idx].set(new_v.reshape(-1, vh, dv), mode="drop")
     return (
-        flat_k.reshape(n_blocks, block_size, kvh, d),
-        flat_v.reshape(n_blocks, block_size, kvh, d),
+        flat_k.reshape(n_blocks, block_size, kvh, dk),
+        flat_v.reshape(n_blocks, block_size, vh, dv),
     )
 
 

@@ -1,0 +1,195 @@
+"""DeepSeek-class MLA: absorption math, cache compression, engine integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.models import deepseek, resolve
+from dynamo_tpu.models.llama import apply_rope, rms_norm
+
+MLA_CFG = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=96, num_layers=2,
+    num_heads=4, num_kv_heads=4, head_dim=16,
+    kv_lora_rank=16, qk_rope_head_dim=8, qk_nope_head_dim=12, v_head_dim=12,
+)
+
+
+def test_registry_resolves_mla():
+    assert resolve(ModelConfig(**MLA_CFG)) is deepseek
+
+
+def test_partial_mla_config_rejected():
+    with pytest.raises(ValueError, match="v_head_dim"):
+        ModelConfig(kv_lora_rank=8)
+    with pytest.raises(ValueError, match="qk_rope_head_dim"):
+        ModelConfig(kv_lora_rank=8, qk_nope_head_dim=8, v_head_dim=8)
+
+
+def test_cache_is_compressed():
+    """Per-token cache line is r + rope_dim, independent of heads."""
+    cfg = ModelConfig(**MLA_CFG)
+    c, kr = deepseek.init_kv_cache(cfg, num_blocks=8, block_size=4)
+    assert c.shape == (2, 8, 4, 1, 16)    # kv_lora_rank
+    assert kr.shape == (2, 8, 4, 1, 8)    # qk_rope_head_dim
+    # vs a GQA cache of the same config: 2 * kvh * head_dim per token
+    mla_line = 16 + 8
+    gqa_line = 2 * 4 * 16
+    assert mla_line < gqa_line / 5
+
+
+def test_absorbed_attention_matches_explicit():
+    """score = (q W_uk)·c + q_r·k_r must equal attention with materialized
+    per-head K/V (k = c W_uk, v = c W_uv) — the absorption identity."""
+    key = jax.random.PRNGKey(0)
+    b, s, h, r, nope, rd, vd = 1, 6, 3, 8, 5, 4, 7
+    ks = jax.random.split(key, 6)
+    q_nope = jax.random.normal(ks[0], (b, s, h, nope))
+    q_rope = jax.random.normal(ks[1], (b, s, h, rd))
+    c = jax.random.normal(ks[2], (b, s, r))          # latent per token
+    kr = jax.random.normal(ks[3], (b, s, rd))        # shared rope key
+    w_uk = jax.random.normal(ks[4], (r, h, nope))
+    w_uv = jax.random.normal(ks[5], (r, h, vd))
+    scale = (nope + rd) ** -0.5
+
+    # absorbed path, via the paged kernel (one block holding the whole seq)
+    c_cache = c.reshape(1, s, 1, r)
+    kr_cache = kr.reshape(1, s, 1, rd)
+    btab = jnp.zeros((b, 1), jnp.int32)
+    pos = jnp.arange(s)[None, :]
+    q_lat = jnp.einsum("bshn,rhn->bshr", q_nope, w_uk)
+    o_lat = deepseek.mla_paged_attention(
+        q_lat, q_rope, c_cache, kr_cache, btab, pos, jnp.asarray([s]), scale
+    )
+    got = jnp.einsum("bshr,rhv->bshv", o_lat, w_uv)
+
+    # explicit path: materialize k/v per head
+    k_nope = jnp.einsum("btr,rhn->bthn", c, w_uk)
+    v = jnp.einsum("btr,rhv->bthv", c, w_uv)
+    scores = (
+        jnp.einsum("bshn,bthn->bsht", q_nope, k_nope)
+        + jnp.einsum("bshd,btd->bsht", q_rope, kr)
+    ) * scale
+    mask = jnp.arange(s)[None, None, :] <= pos[:, :, None]
+    scores = jnp.where(mask[:, :, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    want = jnp.einsum("bsht,bthv->bshv", probs, v)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("q_lora_rank", [0, 12])
+def test_mla_forward_prefill_decode_consistency(q_lora_rank):
+    cfg = ModelConfig(**{**MLA_CFG, "q_lora_rank": q_lora_rank})
+    params = deepseek.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    cache = deepseek.init_kv_cache(cfg, 16, 4, jnp.float32)
+
+    s = 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, s), 0, 256)
+    pos = jnp.arange(s)[None, :]
+    btab = jnp.arange(4)[None, :]
+    slot = pos
+    logits_all, _ = deepseek.forward(
+        params, cfg, tokens, pos, cache, btab, slot, jnp.asarray([s])
+    )
+    logits_pre, cache2 = deepseek.forward(
+        params, cfg, tokens[:, : s - 1], pos[:, : s - 1], cache, btab,
+        slot[:, : s - 1], jnp.asarray([s - 1]),
+    )
+    logits_dec, _ = deepseek.forward(
+        params, cfg, tokens[:, s - 1 :], pos[:, s - 1 :], cache2, btab,
+        slot[:, s - 1 :], jnp.asarray([s]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_all[0, -1]), np.asarray(logits_dec[0, -1]),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_mla_moe_combination():
+    """DeepSeek-V2/V3 shape: MLA attention + routed experts."""
+    cfg = ModelConfig(**{**MLA_CFG, "num_experts": 4, "num_experts_per_tok": 2})
+    assert resolve(cfg) is deepseek
+    params = deepseek.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    assert "router" in params["layers"]
+    cache = deepseek.init_kv_cache(cfg, 8, 4, jnp.float32)
+    tokens = jnp.asarray([[1, 2, 3, 4]])
+    pos = jnp.arange(4)[None, :]
+    logits, _ = deepseek.forward(
+        params, cfg, tokens, pos, cache, jnp.asarray([[0, 1]]), pos,
+        jnp.asarray([4]),
+    )
+    assert logits.shape == (1, 4, 256)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_model_runner_mla_tp(tp):
+    """Engine step with MLA: heads shard over tp, latent cache replicated."""
+    from dynamo_tpu.engine.model_runner import ModelRunner, build_mesh
+
+    mcfg = ModelConfig(**MLA_CFG)
+    cfg = EngineConfig(
+        model=mcfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", dp_size=1, tp_size=tp,
+        prefill_buckets=[64],
+    )
+    runner = ModelRunner(cfg, mesh=build_mesh(1, tp, jax.devices()[:tp]))
+    b, w, bs = cfg.max_batch_size, cfg.blocks_per_seq, cfg.kv_block_size
+    s = 8
+    tokens = np.random.RandomState(0).randint(0, 256, (b, s)).astype(np.int32)
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    btab = np.zeros((b, w), np.int32)
+    for i in range(b):
+        btab[i, 0] = i
+    slot_map = btab[:, :1] * bs + positions
+    next_tokens, _ = runner.step(
+        tokens, positions, btab, slot_map, np.full(b, s, np.int32),
+        np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+        np.zeros(b, np.int32), np.ones(b, np.float32), jax.random.PRNGKey(0),
+    )
+    assert np.asarray(next_tokens).shape == (b,)
+
+
+def test_hf_config_mla_mapping():
+    cfg = ModelConfig.from_hf_config({
+        "hidden_size": 128, "kv_lora_rank": 64, "q_lora_rank": 32,
+        "qk_rope_head_dim": 16, "qk_nope_head_dim": 32, "v_head_dim": 32,
+        "n_routed_experts": 8, "moe_intermediate_size": 48,
+        "n_shared_experts": 2, "first_k_dense_replace": 1,
+    })
+    assert cfg.kv_lora_rank == 64 and cfg.q_lora_rank == 32
+    assert cfg.num_experts == 8
+    assert cfg.moe_intermediate_size == 48
+    assert cfg.n_shared_experts == 2
+    assert cfg.first_k_dense_replace == 1
+    assert resolve(cfg) is deepseek
+
+
+def test_deepseek_v2_topology():
+    """first_k dense layers, MoE layers with shared experts at moe width."""
+    cfg = ModelConfig(**{
+        **MLA_CFG, "num_layers": 3, "num_experts": 4,
+        "moe_intermediate_size": 32, "n_shared_experts": 1,
+        "first_k_dense_replace": 1,
+    })
+    params = deepseek.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    # 1 dense layer at full width, 2 MoE layers at moe width
+    assert params["dense_layers"]["w_gate"].shape == (1, 64, 96)
+    assert params["layers"]["w_gate"].shape == (2, 4, 64, 32)
+    assert params["layers"]["w_sh_gate"].shape == (2, 64, 32)
+
+    cache = deepseek.init_kv_cache(cfg, 8, 4, jnp.float32)
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6]])
+    pos = jnp.arange(6)[None, :]
+    logits, _ = deepseek.forward(
+        params, cfg, tokens, pos, cache, jnp.asarray([[0, 1]]), pos,
+        jnp.asarray([6]),
+    )
+    assert logits.shape == (1, 6, 256)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    # specs cover every param
+    specs = deepseek.param_specs(params)
+    jax.tree.map(lambda a, s: None, params, specs,
+                 is_leaf=lambda x: x is None)

@@ -78,11 +78,6 @@ def test_pad_tokens_do_not_steal_capacity():
     np.testing.assert_allclose(got[4:], want, rtol=1e-5, atol=1e-5)
 
 
-def test_mla_config_raises_until_deepseek_lands():
-    with pytest.raises((NotImplementedError, ModuleNotFoundError)):
-        resolve(ModelConfig(kv_lora_rank=8))
-
-
 def test_expert_capacity_sizing():
     assert expert_capacity(64, 8, 2, capacity_factor=1.0) == 16
     assert expert_capacity(1, 8, 2, capacity_factor=1.0) == 1  # never 0
