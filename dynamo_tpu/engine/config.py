@@ -37,6 +37,11 @@ class ModelConfig:
     moe_intermediate_size: int = 0    # per-expert width; 0 → intermediate_size
     n_shared_experts: int = 0         # DeepSeek always-on shared expert count
     first_k_dense_replace: int = 0    # DeepSeek: first k layers use dense MLP
+    # routing semantics (DeepSeek): gate score fn, top-k weight normalization,
+    # and the scaling applied to the routed (non-shared) output
+    moe_scoring_func: str = "softmax"  # "softmax" | "sigmoid"
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
     # attention implementation: "auto" (pallas on TPU, xla elsewhere),
     # "xla", or "pallas"
     attention_impl: str = "auto"
@@ -64,6 +69,13 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, config: dict) -> "ModelConfig":
+        if (config.get("n_group") or 1) > 1:
+            # V3's device/group-limited top-k is a routing *restriction*;
+            # silently ignoring it would route differently than the
+            # checkpoint was trained for
+            raise NotImplementedError(
+                "group-limited expert routing (n_group > 1) is not supported yet"
+            )
         return cls(
             vocab_size=config.get("vocab_size", 32000),
             hidden_size=config.get("hidden_size", 2048),
@@ -85,6 +97,9 @@ class ModelConfig:
             moe_intermediate_size=config.get("moe_intermediate_size", 0) or 0,
             n_shared_experts=config.get("n_shared_experts", 0) or 0,
             first_k_dense_replace=config.get("first_k_dense_replace", 0) or 0,
+            moe_scoring_func=config.get("scoring_func", "softmax"),
+            norm_topk_prob=config.get("norm_topk_prob", True),
+            routed_scaling_factor=config.get("routed_scaling_factor", 1.0) or 1.0,
             # MLA (DeepSeek config.json keys)
             kv_lora_rank=config.get("kv_lora_rank", 0) or 0,
             q_lora_rank=config.get("q_lora_rank", 0) or 0,

@@ -172,10 +172,8 @@ def param_specs(params: Params) -> Dict:
         specs["dense_layers"] = {
             k: dense_specs[k] for k in params["dense_layers"]
         }
-    if "layers" in params:
-        has_router = "router" in params["layers"]
-        table = moe_specs if has_router else dense_specs
-        specs["layers"] = {k: table[k] for k in params["layers"]}
+    if "layers" in params:  # present iff the config is MoE
+        specs["layers"] = {k: moe_specs[k] for k in params["layers"]}
     return specs
 
 
@@ -294,12 +292,9 @@ def forward(
             hidden, kv_cache, params["dense_layers"], cfg, attn_fn,
             _swiglu_mlp, li0=li,
         )
-    if "layers" in params:
-        moe = "router" in params["layers"]
-        mlp_fn = (
-            make_moe_mlp_fn(cfg, b, s, slot_mapping) if moe else _swiglu_mlp
-        )
+    if "layers" in params:  # present iff the config is MoE
         hidden, kv_cache, li = run_layers(
-            hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn, li0=li,
+            hidden, kv_cache, params["layers"], cfg, attn_fn,
+            make_moe_mlp_fn(cfg, b, s, slot_mapping), li0=li,
         )
     return lm_logits(hidden, params, cfg), kv_cache

@@ -62,21 +62,35 @@ def moe_mlp(
     top_k: int,
     capacity: int,
     valid: Optional[jax.Array] = None,  # [T] 1.0 = real token, 0.0 = pad
+    scoring: str = "softmax",           # "softmax" (Mixtral/V2) | "sigmoid" (V3)
+    norm_topk: bool = True,             # renormalize top-k gate weights
+    routed_scaling: float = 1.0,        # DeepSeek routed_scaling_factor
 ) -> jax.Array:
     """Top-k routed SwiGLU experts via dense one-hot dispatch.
 
     Pad tokens (``valid == 0``) claim no expert slots and contribute
     nothing — otherwise bucket padding would displace real tokens from
-    capacity-bounded experts.
+    capacity-bounded experts. Routing semantics are configurable to match
+    the checkpoint family: Mixtral = softmax scores + renormalized top-k;
+    DeepSeek-V2 = softmax, norm_topk_prob=False, scaled routed output;
+    DeepSeek-V3 = sigmoid scores.
     """
     t, d = x.shape
     e = router_w.shape[1]
 
-    probs = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)  # [T, E]
+    logits = (x @ router_w).astype(jnp.float32)                          # [T, E]
+    if scoring == "sigmoid":
+        probs = jax.nn.sigmoid(logits)
+    elif scoring == "softmax":
+        probs = jax.nn.softmax(logits, axis=-1)
+    else:
+        raise ValueError(f"unknown moe scoring {scoring!r}")
     gate_vals, gate_idx = lax.top_k(probs, top_k)                        # [T, K]
-    gate_vals = gate_vals / jnp.maximum(
-        gate_vals.sum(axis=-1, keepdims=True), 1e-9
-    )
+    if norm_topk:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9
+        )
+    gate_vals = gate_vals * routed_scaling
 
     # slot assignment: token-major priority over the flattened (T, K) choices
     onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [T, K, E]
@@ -180,6 +194,8 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
             layer_params["router"],
             layer_params["w_gate"], layer_params["w_up"], layer_params["w_down"],
             cfg.num_experts_per_tok, capacity, valid=valid,
+            scoring=cfg.moe_scoring_func, norm_topk=cfg.norm_topk_prob,
+            routed_scaling=cfg.routed_scaling_factor,
         )
         y = y.reshape(b, s, -1)
         if "w_sh_gate" in layer_params:

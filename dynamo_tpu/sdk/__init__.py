@@ -1,0 +1,61 @@
+"""Python SDK: declare inference graphs, serve them locally or distributed.
+
+Surface parity with the reference SDK (reference: deploy/dynamo/sdk —
+@service / @dynamo_endpoint / depends / ServiceConfig / dynamo serve):
+
+    from dynamo_tpu.sdk import service, dynamo_endpoint, depends
+
+    @service(dynamo={"namespace": "public"}, resources={"tpu": 1})
+    class Worker:
+        @dynamo_endpoint
+        async def generate(self, request):
+            yield {"text": "..."}
+
+    @service(workers=2)
+    class Frontend:
+        worker = depends(Worker)
+        @dynamo_endpoint
+        async def chat(self, request):
+            async for out in self.worker.generate(request):
+                yield out
+
+    Frontend.link(Worker)   # graph edge, reference-style chaining
+
+Serve in one process (tests / single host) with
+serving.serve_graph_inprocess, or one process per worker with
+serving.GraphSupervisor (TPU chips assigned per worker by
+allocator.TpuAllocator).
+"""
+
+from .allocator import AllocationError, TpuAllocator
+from .config import ServiceConfig
+from .service import (
+    Dependency,
+    DynamoClient,
+    ServiceDefinition,
+    async_on_start,
+    depends,
+    dynamo_endpoint,
+    graph_services,
+    service,
+)
+from .serving import GraphSupervisor, serve_graph_inprocess, stop_graph
+from .worker import serve_service
+
+__all__ = [
+    "AllocationError",
+    "TpuAllocator",
+    "ServiceConfig",
+    "Dependency",
+    "DynamoClient",
+    "ServiceDefinition",
+    "async_on_start",
+    "depends",
+    "dynamo_endpoint",
+    "graph_services",
+    "service",
+    "GraphSupervisor",
+    "serve_graph_inprocess",
+    "stop_graph",
+    "serve_service",
+]

@@ -1,0 +1,63 @@
+"""TPU chip allocator for the serve supervisor.
+
+The analog of the reference SDK's GPU allocator (reference:
+deploy/dynamo/sdk/src/dynamo/sdk/cli/allocator.py:35-136 —
+ResourceAllocator.assign_gpus setting CUDA_VISIBLE_DEVICES per worker):
+each spawned worker gets a disjoint set of local TPU chips via
+TPU_VISIBLE_CHIPS (honored by libtpu), plus JAX flags so CPU-only services
+don't initialize the TPU at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+class TpuAllocator:
+    def __init__(self, total_chips: Optional[int] = None):
+        if total_chips is None:
+            env = os.environ.get("DYNAMO_TPU_NUM_CHIPS")
+            total_chips = int(env) if env else self._detect()
+        self.total_chips = total_chips
+        self._next = 0
+
+    @staticmethod
+    def _detect() -> int:
+        """Best-effort local chip count without initializing JAX."""
+        visible = os.environ.get("TPU_VISIBLE_CHIPS")
+        if visible:
+            return len([c for c in visible.split(",") if c.strip()])
+        # /dev/accel* is how libtpu exposes local chips
+        try:
+            return len([d for d in os.listdir("/dev") if d.startswith("accel")]) or 0
+        except OSError:
+            return 0
+
+    @property
+    def available(self) -> int:
+        return self.total_chips - self._next
+
+    def assign(self, count: int) -> List[int]:
+        """Take ``count`` chips; raises when over-subscribed."""
+        if count > self.available:
+            raise AllocationError(
+                f"need {count} TPU chips, {self.available} of {self.total_chips} left"
+            )
+        chips = list(range(self._next, self._next + count))
+        self._next += count
+        return chips
+
+    def env_for(self, resources: Dict) -> Dict[str, str]:
+        """Environment for one worker given its resource request
+        ({'tpu': N} or none for CPU-only services)."""
+        n = int(resources.get("tpu", 0))
+        if n <= 0:
+            # CPU-only service: keep JAX (if imported at all) off the TPU
+            return {"JAX_PLATFORMS": "cpu"}
+        chips = self.assign(n)
+        return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips)}

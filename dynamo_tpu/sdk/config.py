@@ -1,0 +1,82 @@
+"""Service configuration: YAML/JSON sections per service + Common opt-in.
+
+Mirrors the reference SDK's ServiceConfig behavior (reference:
+deploy/dynamo/sdk/src/dynamo/sdk/lib/config.py, semantics pinned by
+tests/test_config.py): the config document maps service name → options; a
+``Common`` section holds shared values; a service pulls specific Common
+keys by listing them under ``common-configs``. ``as_args`` renders a
+service's merged options as CLI flags for its worker process.
+
+Sources (first match wins): explicit path/dict, the
+``DYNAMO_TPU_SERVICE_CONFIG`` environment variable (JSON or YAML text).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+COMMON_SECTION = "Common"
+COMMON_KEY = "common-configs"
+ENV_VAR = "DYNAMO_TPU_SERVICE_CONFIG"
+
+
+def _load_text(text: str) -> dict:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        return yaml.safe_load(text)
+
+
+class ServiceConfig:
+    _instance: Optional["ServiceConfig"] = None
+
+    def __init__(self, data: Optional[dict] = None):
+        self.data: dict = data or {}
+
+    @classmethod
+    def get_instance(cls) -> "ServiceConfig":
+        if cls._instance is None:
+            text = os.environ.get(ENV_VAR)
+            cls._instance = cls(_load_text(text) if text else {})
+        return cls._instance
+
+    @classmethod
+    def from_file(cls, path: str) -> "ServiceConfig":
+        with open(path) as f:
+            return cls(_load_text(f.read()) or {})
+
+    def get(self, service: str) -> Dict[str, Any]:
+        """Service options merged with its opted-in Common keys.
+
+        Explicit service values win over Common values for the same key.
+        Unknown opted-in keys are ignored (a service may opt into keys only
+        some deployments define).
+        """
+        section = dict(self.data.get(service, {}))
+        wanted = section.pop(COMMON_KEY, [])
+        common = self.data.get(COMMON_SECTION, {})
+        merged: Dict[str, Any] = {
+            k: common[k] for k in wanted if k in common
+        }
+        merged.update(section)
+        return merged
+
+    def as_args(self, service: str) -> List[str]:
+        """Render options as CLI flags: bools become bare flags (False →
+        omitted), everything else ``--key value``."""
+        args: List[str] = []
+        for key, value in self.get(service).items():
+            flag = f"--{key}"
+            if isinstance(value, bool):
+                if value:
+                    args.append(flag)
+            elif isinstance(value, (list, tuple)):
+                for item in value:
+                    args.extend([flag, str(item)])
+            else:
+                args.extend([flag, str(value)])
+        return args

@@ -1,0 +1,134 @@
+"""Per-worker service entrypoint: bind a @service class to the runtime.
+
+The serve_dynamo analog (reference: deploy/dynamo/sdk/src/dynamo/sdk/cli/
+serve_dynamo.py:38-184 — create DRT, create_service, bind endpoints, run
+async_on_start hooks, serve). The supervisor (sdk/serving.py) execs this
+module once per worker:
+
+    python -m dynamo_tpu.sdk.worker graphs.agg:Frontend --service Processor \
+        --store-port 4871
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import inspect
+import logging
+import signal
+import sys
+from typing import List, Optional
+
+from ..runtime.component import DistributedRuntime
+from .config import ServiceConfig
+from .service import DynamoClient, ServiceDefinition, graph_services
+
+logger = logging.getLogger(__name__)
+
+
+def load_graph_root(spec: str) -> ServiceDefinition:
+    """'pkg.module:Attr' → the ServiceDefinition bound to Attr."""
+    module_name, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(f"graph spec {spec!r} must be module:Attr")
+    module = importlib.import_module(module_name)
+    root = getattr(module, attr)
+    if not isinstance(root, ServiceDefinition):
+        raise TypeError(f"{spec} is not a @service (got {type(root)})")
+    return root
+
+
+def find_service(root: ServiceDefinition, name: Optional[str]) -> ServiceDefinition:
+    if name is None:
+        return root
+    for svc in graph_services(root):
+        if svc.name == name:
+            return svc
+    raise LookupError(f"service {name!r} not in graph of {root.name}")
+
+
+async def serve_service(
+    svc: ServiceDefinition,
+    drt: DistributedRuntime,
+    config: Optional[ServiceConfig] = None,
+):
+    """Instantiate the service class, resolve depends(), run hooks, serve
+    every endpoint. Returns (instance, [ServingEndpoint])."""
+    obj = svc.cls()
+    obj.service_config = (config or ServiceConfig.get_instance()).get(svc.name)
+    obj.drt = drt
+
+    for attr, dep in svc.dependencies.items():
+        client = DynamoClient(dep.target, drt)
+        await client.start()
+        setattr(obj, attr, client)
+
+    for method_name in svc.on_start:
+        await getattr(obj, method_name)()
+
+    comp = drt.namespace(svc.spec.namespace).component(svc.name)
+    handles = []
+    for ep_name, method_name in svc.endpoints.items():
+        method = getattr(obj, method_name)
+
+        def make_handler(m):
+            # endpoints may take (request) or (request, ctx) — pass the
+            # engine context through so cooperative stop reaches user code
+            wants_ctx = len(inspect.signature(m).parameters) >= 2
+
+            async def handler(payload, ctx):
+                agen = m(payload, ctx) if wants_ctx else m(payload)
+                async for item in agen:
+                    if ctx.is_stopped:
+                        break
+                    yield item
+
+            return handler
+
+        serving = await comp.endpoint(ep_name).serve(make_handler(method))
+        handles.append(serving)
+        logger.info("serving %s", svc.endpoint_path(ep_name))
+    return obj, handles
+
+
+async def amain(argv: List[str]) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu sdk worker")
+    p.add_argument("graph", help="module:Attr of the graph root @service")
+    p.add_argument("--service", default=None, help="service name (default: root)")
+    p.add_argument("--store-host", default="127.0.0.1")
+    p.add_argument("--store-port", type=int, required=True)
+    p.add_argument("--config-file", default=None)
+    args = p.parse_args(argv)
+
+    root = load_graph_root(args.graph)
+    svc = find_service(root, args.service)
+    config = (
+        ServiceConfig.from_file(args.config_file)
+        if args.config_file
+        else ServiceConfig.get_instance()
+    )
+
+    drt = await DistributedRuntime.connect(args.store_host, args.store_port)
+    # SIGTERM/SIGINT (the supervisor's stop signal) triggers the graceful
+    # path below: deregister endpoints, then close the runtime
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, drt.runtime.shutdown)
+
+    _obj, handles = await serve_service(svc, drt, config)
+    try:
+        await drt.runtime.wait_shutdown()
+    finally:
+        for h in handles:
+            await h.stop()
+        await drt.close()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Minimal SDK pipeline: Frontend -> Middle -> Backend.
+
+Parity example with the reference's hello_world (reference:
+examples/hello_world/hello_world.py — a three-service SDK graph that
+upper-cases and decorates a prompt, no model involved). Serve it:
+
+    python -m dynamo_tpu.runtime.transports.dynstore --port 4871 &
+    python -m dynamo_tpu.sdk.worker examples.hello_world.hello_world:Frontend \
+        --service Backend --store-port 4871 &
+    ... (or GraphSupervisor to spawn all three)
+"""
+
+from dynamo_tpu.sdk import depends, dynamo_endpoint, service
+
+
+@service(dynamo={"namespace": "hello"})
+class Backend:
+    @dynamo_endpoint
+    async def generate(self, request):
+        for word in request["text"].split(","):
+            yield {"text": f"back-{word.strip()}"}
+
+
+@service(dynamo={"namespace": "hello"})
+class Middle:
+    backend = depends(Backend)
+
+    @dynamo_endpoint
+    async def generate(self, request):
+        async for item in self.backend.generate(request):
+            yield {"text": f"mid-{item['text']}"}
+
+
+@service(dynamo={"namespace": "hello"})
+class Frontend:
+    middle = depends(Middle)
+
+    @dynamo_endpoint
+    async def generate(self, request):
+        async for item in self.middle.generate(request):
+            yield {"text": f"front-{item['text']}"}
+
+
+Frontend.link(Middle).link(Backend)

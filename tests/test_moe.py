@@ -78,6 +78,34 @@ def test_pad_tokens_do_not_steal_capacity():
     np.testing.assert_allclose(got[4:], want, rtol=1e-5, atol=1e-5)
 
 
+def test_routing_semantics_variants():
+    """DeepSeek knobs: no-topk-norm, routed scaling, sigmoid scoring."""
+    t, d, i, e, k = 12, 16, 32, 4, 2
+    x = jax.random.normal(jax.random.PRNGKey(6), (t, d), jnp.float32)
+    rw, wg, wu, wd = _weights(jax.random.PRNGKey(7), d, i, e)
+    base = np.asarray(moe_mlp(x, rw, wg, wu, wd, top_k=k, capacity=t))
+    # routed_scaling multiplies the whole routed output
+    scaled = np.asarray(moe_mlp(x, rw, wg, wu, wd, top_k=k, capacity=t,
+                                routed_scaling=16.0))
+    np.testing.assert_allclose(scaled, base * 16.0, rtol=1e-4)
+    # norm_topk=False uses raw softmax probabilities (sum < 1) as gates
+    unnorm = np.asarray(moe_mlp(x, rw, wg, wu, wd, top_k=k, capacity=t,
+                                norm_topk=False))
+    assert np.all(np.abs(unnorm) <= np.abs(base) + 1e-5)
+    assert not np.allclose(unnorm, base)
+    # sigmoid scoring is a different distribution but still finite/valid
+    sig = np.asarray(moe_mlp(x, rw, wg, wu, wd, top_k=k, capacity=t,
+                             scoring="sigmoid", norm_topk=True))
+    assert np.all(np.isfinite(sig))
+    with pytest.raises(ValueError, match="scoring"):
+        moe_mlp(x, rw, wg, wu, wd, top_k=k, capacity=t, scoring="banana")
+
+
+def test_group_limited_routing_rejected():
+    with pytest.raises(NotImplementedError, match="n_group"):
+        ModelConfig.from_hf_config({"n_group": 4, "topk_group": 2})
+
+
 def test_expert_capacity_sizing():
     assert expert_capacity(64, 8, 2, capacity_factor=1.0) == 16
     assert expert_capacity(1, 8, 2, capacity_factor=1.0) == 1  # never 0

@@ -1,0 +1,220 @@
+"""SDK: decorators, graph composition, config inheritance, allocator, e2e.
+
+Mirrors the reference SDK's test strategy (reference: deploy/dynamo/sdk/
+src/dynamo/sdk/tests/{test_config,test_link,test_e2e}.py)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+from dynamo_tpu.sdk import (
+    AllocationError,
+    ServiceConfig,
+    TpuAllocator,
+    async_on_start,
+    depends,
+    dynamo_endpoint,
+    graph_services,
+    serve_graph_inprocess,
+    service,
+    stop_graph,
+)
+
+
+# ---- a tiny two-service graph used across tests ----
+
+@service(dynamo={"namespace": "testns"}, resources={"tpu": 1}, workers=2)
+class Backend:
+    @dynamo_endpoint
+    async def generate(self, request):
+        for tok in request["prompt"].split():
+            yield {"token": tok.upper()}
+
+    @dynamo_endpoint(name="ping")
+    async def ping_handler(self, request):
+        yield {"pong": True}
+
+
+@service(dynamo={"namespace": "testns"})
+class Middle:
+    backend = depends(Backend)
+
+    @async_on_start
+    async def setup(self):
+        self.started = True
+
+    @dynamo_endpoint
+    async def chat(self, request):
+        assert self.started
+        async for item in self.backend.generate(request):
+            yield {"echo": item["token"]}
+
+
+class TestDecorators:
+    def test_service_metadata(self):
+        assert Backend.name == "Backend"
+        assert Backend.spec.namespace == "testns"
+        assert Backend.spec.resources == {"tpu": 1}
+        assert Backend.spec.workers == 2
+        assert set(Backend.endpoints) == {"generate", "ping"}
+        assert Backend.endpoints["ping"] == "ping_handler"
+        assert Backend.endpoint_path("generate") == "dyn://testns.Backend.generate"
+
+    def test_dependencies_and_hooks(self):
+        assert "backend" in Middle.dependencies
+        assert Middle.dependencies["backend"].target is Backend
+        assert Middle.on_start == ["setup"]
+
+    def test_depends_rejects_plain_class(self):
+        with pytest.raises(TypeError):
+            depends(object)
+
+    def test_link_chain_and_graph(self):
+        @service
+        class A:
+            pass
+
+        @service
+        class B:
+            pass
+
+        @service
+        class C:
+            pass
+
+        # reference-style chain: A -> B -> C
+        A.link(B).link(C)
+        names = [s.name for s in graph_services(A)]
+        assert names == ["A", "B", "C"]
+        # Middle's graph pulls Backend through depends()
+        assert [s.name for s in graph_services(Middle)] == ["Middle", "Backend"]
+
+
+class TestServiceConfig:
+    def test_common_opt_in(self):
+        cfg = ServiceConfig({
+            "Common": {"model": "m8b", "block-size": 64, "max-model-len": 16384},
+            "Worker": {"enforce-eager": True,
+                       "common-configs": ["model", "block-size"]},
+        })
+        merged = cfg.get("Worker")
+        assert merged == {"enforce-eager": True, "model": "m8b", "block-size": 64}
+        args = cfg.as_args("Worker")
+        assert "--enforce-eager" in args
+        assert args[args.index("--model") + 1] == "m8b"
+        assert "--max-model-len" not in args
+
+    def test_no_opt_in_no_common(self):
+        cfg = ServiceConfig({
+            "Common": {"model": "m8b"},
+            "Worker": {"enforce-eager": True},
+        })
+        assert "model" not in cfg.get("Worker")
+
+    def test_service_values_beat_common(self):
+        cfg = ServiceConfig({
+            "Common": {"model": "common-model"},
+            "Worker": {"model": "mine", "common-configs": ["model"]},
+        })
+        assert cfg.get("Worker")["model"] == "mine"
+
+    def test_false_bool_and_list_args(self):
+        cfg = ServiceConfig({"W": {"flag-off": False, "multi": [1, 2]}})
+        args = cfg.as_args("W")
+        assert "--flag-off" not in args
+        assert args.count("--multi") == 2
+
+
+class TestAllocator:
+    def test_assign_and_exhaust(self):
+        alloc = TpuAllocator(total_chips=4)
+        assert alloc.env_for({"tpu": 2}) == {"TPU_VISIBLE_CHIPS": "0,1"}
+        assert alloc.env_for({"tpu": 2}) == {"TPU_VISIBLE_CHIPS": "2,3"}
+        with pytest.raises(AllocationError):
+            alloc.env_for({"tpu": 1})
+
+    def test_cpu_only_service(self):
+        alloc = TpuAllocator(total_chips=1)
+        assert alloc.env_for({}) == {"JAX_PLATFORMS": "cpu"}
+        assert alloc.available == 1
+
+
+async def test_e2e_graph_inprocess():
+    """Full depends() round-trip: Middle.chat -> network -> Backend.generate."""
+    drt = DistributedRuntime.in_process(MemoryHub())
+    drt2, handles = await serve_graph_inprocess(Middle, drt)
+    try:
+        from dynamo_tpu.sdk import DynamoClient
+
+        client = DynamoClient(Middle, drt)
+        await client.start()
+        await client.wait_ready(timeout=5.0)
+        out = [item async for item in client.chat({"prompt": "hello tpu world"})]
+        assert out == [{"echo": "HELLO"}, {"echo": "TPU"}, {"echo": "WORLD"}]
+    finally:
+        await stop_graph(drt2, handles)
+
+
+def test_inherited_endpoints_are_discovered():
+    class BaseWorker:
+        @dynamo_endpoint
+        async def generate(self, request):
+            yield {"base": True}
+
+    @service
+    class Derived(BaseWorker):
+        @dynamo_endpoint
+        async def extra(self, request):
+            yield {}
+
+    assert set(Derived.endpoints) == {"generate", "extra"}
+
+
+async def test_endpoint_receives_ctx_and_stops():
+    """(request, ctx) endpoints get the engine context; stop is cooperative."""
+
+    @service(dynamo={"namespace": "ctxns"})
+    class Stoppable:
+        @dynamo_endpoint
+        async def stream(self, request, ctx):
+            for i in range(1000):
+                if ctx.is_stopped:
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0)
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    drt2, handles = await serve_graph_inprocess(Stoppable, drt)
+    try:
+        from dynamo_tpu.runtime.client import Client
+        from dynamo_tpu.runtime.engine import Context
+
+        client = Client(
+            drt.namespace("ctxns").component("Stoppable").endpoint("stream")
+        )
+        await client.start()
+        await client.wait_for_instances(timeout=5.0)
+        request = Context({"x": 1})
+        seen = 0
+        async for _item in client.generate(request):
+            seen += 1
+            if seen == 3:
+                request.context.stop_generating()
+        assert seen < 1000  # stopped early, not fully drained
+    finally:
+        await stop_graph(drt2, handles)
+
+
+async def test_e2e_unknown_endpoint_raises():
+    drt = DistributedRuntime.in_process(MemoryHub())
+    drt2, handles = await serve_graph_inprocess(Backend, drt)
+    try:
+        from dynamo_tpu.sdk import DynamoClient
+
+        client = DynamoClient(Backend, drt)
+        with pytest.raises(AttributeError, match="no endpoint"):
+            client.nope
+    finally:
+        await stop_graph(drt2, handles)
