@@ -1,0 +1,106 @@
+"""llmctl: manage model registrations in the discovery plane.
+
+Reference analog: launch/llmctl (reference: launch/llmctl/src/main.rs:105-452
+— ``llmctl http add chat-models <name> dyn://ns.comp.ep`` writing
+ModelEntry records the HTTP frontend's model watcher picks up).
+
+    python -m dynamo_tpu.cli.llmctl --store-port 4871 http add chat-models m8b dyn://public.backend.generate
+    python -m dynamo_tpu.cli.llmctl --store-port 4871 http list
+    python -m dynamo_tpu.cli.llmctl --store-port 4871 http remove chat-models m8b
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import List
+
+from ..http.service import (
+    list_models,
+    parse_endpoint_path,
+    register_model,
+    unregister_model,
+)
+from ..runtime.component import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+# CLI model-kind words → registry model_type
+KINDS = {
+    "chat-models": "chat",
+    "completion-models": "completions",
+    "models": "both",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl")
+    p.add_argument("--store-host", default="127.0.0.1")
+    p.add_argument("--store-port", type=int, required=True)
+    p.add_argument("--namespace", default="public")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http", help="manage the HTTP frontend's models")
+    hsub = http.add_subparsers(dest="action", required=True)
+
+    add = hsub.add_parser("add")
+    add.add_argument("kind", choices=sorted(KINDS))
+    add.add_argument("name")
+    add.add_argument("endpoint", help="dyn://ns.comp.ep")
+
+    rm = hsub.add_parser("remove")
+    rm.add_argument("kind", choices=sorted(KINDS))
+    rm.add_argument("name")
+
+    hsub.add_parser("list")
+    return p
+
+
+async def run(args, drt: DistributedRuntime) -> int:
+    if args.action == "add":
+        try:
+            # strict parse — the frontend's model watcher parses the same
+            # way, so a malformed address must fail HERE, not there
+            parse_endpoint_path(args.endpoint)
+        except ValueError as e:
+            print(f"bad endpoint {args.endpoint!r}: {e}")
+            return 2
+        await register_model(
+            drt, args.namespace, args.name, args.endpoint,
+            model_type=KINDS[args.kind],
+            # registrations from a short-lived CLI must outlive it
+            lease_scoped=False,
+        )
+        print(f"added {KINDS[args.kind]} model {args.name} -> {args.endpoint}")
+        return 0
+    if args.action == "remove":
+        await unregister_model(drt, args.namespace, args.name, KINDS[args.kind])
+        print(f"removed {KINDS[args.kind]} model {args.name}")
+        return 0
+    if args.action == "list":
+        models = await list_models(drt, args.namespace)
+        if not models:
+            print("(no models registered)")
+        for m in models:
+            print(f"{m.get('model_type', '?'):12s} {m['name']:30s} {m['endpoint']}")
+        return 0
+    return 2
+
+
+async def amain(argv: List[str]) -> int:
+    args = build_parser().parse_args(argv)
+    drt = await DistributedRuntime.connect(args.store_host, args.store_port)
+    try:
+        return await run(args, drt)
+    finally:
+        await drt.close()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.WARNING)
+    raise SystemExit(asyncio.run(amain(sys.argv[1:])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""Namespace metrics aggregator: scrape worker stats → Prometheus.
+
+Reference analog: components/metrics (reference: components/metrics/src/
+{main,lib}.rs — standalone binary that scrapes a target endpoint's
+service stats, subscribes to namespace kv-hit-rate events, and exposes
+namespace-level Prometheus). Here the scrape rides the ``_stats.*``
+RPC every serving endpoint answers (runtime/component.py), whose ``data``
+field carries the worker's ForwardPassMetrics.
+
+    python -m dynamo_tpu.cli.metrics --store-port 4871 \
+        --endpoint dyn://public.backend.generate --metrics-port 9091
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import List
+
+from aiohttp import web
+
+from ..http.metrics import Counter, Gauge
+from ..http.service import parse_endpoint_path
+from ..kv_router.protocols import KV_HIT_RATE_EVENT, ForwardPassMetrics
+from ..runtime.client import Client
+from ..runtime.component import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+# which ForwardPassMetrics fields become gauges (labelled by instance)
+_FPM_FIELDS = (
+    "request_active_slots", "request_total_slots", "kv_active_blocks",
+    "kv_total_blocks", "num_requests_waiting", "gpu_cache_usage_perc",
+    "gpu_prefix_cache_hit_rate",
+)
+
+
+class MetricsAggregator:
+    def __init__(self, drt: DistributedRuntime, endpoint_path: str,
+                 prefix: str = "dynamo", poll_interval: float = 1.0):
+        ns, comp, ep = parse_endpoint_path(endpoint_path)
+        self.namespace = ns
+        self.client = Client(drt.namespace(ns).component(comp).endpoint(ep))
+        self.drt = drt
+        self.poll_interval = poll_interval
+        self.gauges = {
+            f: Gauge(f"{prefix}_worker_{f}", f"worker {f} (scraped)")
+            for f in _FPM_FIELDS
+        }
+        self.inflight = Gauge(f"{prefix}_worker_inflight", "in-flight requests")
+        self.requests_total = Gauge(
+            f"{prefix}_worker_requests_total", "requests handled (scraped)"
+        )
+        self.kv_hit_events = Counter(
+            f"{prefix}_kv_hit_rate_events_total", "KVHitRateEvents by worker"
+        )
+        self.kv_hit_blocks = Counter(
+            f"{prefix}_kv_hit_overlap_blocks_total", "overlap blocks in hit events"
+        )
+        self._tasks: List[asyncio.Task] = []
+
+    async def start(self) -> None:
+        await self.client.start()
+        self._tasks.append(self.drt.runtime.spawn(self._poll_loop()))
+        sub = await self.drt.namespace(self.namespace).subscribe_event(
+            KV_HIT_RATE_EVENT
+        )
+        self._tasks.append(self.drt.runtime.spawn(self._consume_hit_events(sub)))
+
+    async def collect_once(self) -> int:
+        """One scrape pass; returns the number of instances that answered."""
+        stats = await self.client.scrape_stats()
+        for iid, s in stats.items():
+            self.inflight.set(float(s.get("inflight", 0)), instance=iid)
+            self.requests_total.set(float(s.get("requests_total", 0)), instance=iid)
+            data = s.get("data")
+            if data:
+                fpm = ForwardPassMetrics.from_wire(data)
+                for f in _FPM_FIELDS:
+                    self.gauges[f].set(float(getattr(fpm, f)), instance=iid)
+        return len(stats)
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                await self.collect_once()
+            except Exception:
+                logger.exception("scrape failed")
+            await asyncio.sleep(self.poll_interval)
+
+    async def _consume_hit_events(self, sub) -> None:
+        import msgpack
+
+        async for msg in sub:
+            try:
+                ev = msgpack.unpackb(msg.payload, raw=False)
+                wid = str(ev.get("worker_id"))
+                self.kv_hit_events.inc(worker=wid)
+                self.kv_hit_blocks.inc(ev.get("overlap_blocks", 0), worker=wid)
+            except Exception:
+                logger.exception("bad kv-hit-rate event")
+
+    def render(self) -> str:
+        metrics = [
+            self.inflight, self.requests_total, self.kv_hit_events,
+            self.kv_hit_blocks, *self.gauges.values(),
+        ]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+
+async def amain(argv: List[str]) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu metrics")
+    p.add_argument("--store-host", default="127.0.0.1")
+    p.add_argument("--store-port", type=int, required=True)
+    p.add_argument("--endpoint", required=True, help="dyn://ns.comp.ep to scrape")
+    p.add_argument("--metrics-host", default="0.0.0.0")
+    p.add_argument("--metrics-port", type=int, default=9091)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    drt = await DistributedRuntime.connect(args.store_host, args.store_port)
+    agg = MetricsAggregator(drt, args.endpoint, poll_interval=args.poll_interval)
+    await agg.start()
+
+    async def metrics_handler(_request):
+        return web.Response(text=agg.render(), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics_handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, args.metrics_host, args.metrics_port)
+    await site.start()
+    logger.info("metrics on http://%s:%d/metrics", args.metrics_host, args.metrics_port)
+    try:
+        await drt.runtime.wait_shutdown()
+    finally:
+        agg.stop()
+        await runner.cleanup()
+        await drt.close()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""GGUF metadata reader: model cards + architecture configs from .gguf files.
+
+Pure-Python parser for the GGUF container format (v2/v3) — header,
+metadata key/values, and tensor descriptors (names/shapes/types only;
+tensor data is not loaded or dequantized here). Enough to build a
+ModelDeploymentCard and a ModelConfig from a GGUF checkpoint, mirroring
+the reference's GGUF support (reference: lib/llm/src/gguf/* — metadata
+parse + model-card creation via ModelDeploymentCard::from_gguf,
+lib/llm/src/model_card/create.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types (gguf spec)
+_T_UINT8, _T_INT8, _T_UINT16, _T_INT16 = 0, 1, 2, 3
+_T_UINT32, _T_INT32, _T_FLOAT32, _T_BOOL = 4, 5, 6, 7
+_T_STRING, _T_ARRAY, _T_UINT64, _T_INT64, _T_FLOAT64 = 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    _T_UINT8: "<B", _T_INT8: "<b", _T_UINT16: "<H", _T_INT16: "<h",
+    _T_UINT32: "<I", _T_INT32: "<i", _T_FLOAT32: "<f",
+    _T_UINT64: "<Q", _T_INT64: "<q", _T_FLOAT64: "<d",
+}
+
+# tensor ggml dtypes we can name (id → name); quantized types included so
+# descriptors are informative even when we never load the data
+GGML_TYPE_NAMES = {
+    0: "f32", 1: "f16", 2: "q4_0", 3: "q4_1", 6: "q5_0", 7: "q5_1",
+    8: "q8_0", 9: "q8_1", 10: "q2_k", 11: "q3_k", 12: "q4_k", 13: "q5_k",
+    14: "q6_k", 15: "q8_k", 16: "iq2_xxs", 17: "iq2_xs", 18: "iq3_xxs",
+    24: "i8", 25: "i16", 26: "i32", 27: "i64", 28: "f64", 30: "bf16",
+}
+
+
+class GgufError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class GgufTensorInfo:
+    name: str
+    shape: Tuple[int, ...]
+    ggml_type: int
+    offset: int
+
+    @property
+    def type_name(self) -> str:
+        return GGML_TYPE_NAMES.get(self.ggml_type, f"unknown({self.ggml_type})")
+
+
+@dataclasses.dataclass
+class GgufFile:
+    version: int
+    metadata: Dict[str, Any]
+    tensors: List[GgufTensorInfo]
+
+    @property
+    def architecture(self) -> Optional[str]:
+        return self.metadata.get("general.architecture")
+
+    def arch_key(self, suffix: str, default=None):
+        """Lookup '{arch}.{suffix}' (e.g. llama.context_length)."""
+        arch = self.architecture
+        if arch is None:
+            return default
+        return self.metadata.get(f"{arch}.{suffix}", default)
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    data = f.read(size)
+    if len(data) != size:
+        raise GgufError("truncated GGUF file")
+    return struct.unpack(fmt, data)[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    data = f.read(n)
+    if len(data) != n:
+        raise GgufError("truncated GGUF string")
+    return data.decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int, depth: int = 0) -> Any:
+    if vtype in _SCALAR_FMT:
+        return _read(f, _SCALAR_FMT[vtype])
+    if vtype == _T_BOOL:
+        return bool(_read(f, "<B"))
+    if vtype == _T_STRING:
+        return _read_string(f)
+    if vtype == _T_ARRAY:
+        if depth > 4:
+            raise GgufError("GGUF array nesting too deep")
+        item_type = _read(f, "<I")
+        count = _read(f, "<Q")
+        return [_read_value(f, item_type, depth + 1) for _ in range(count)]
+    raise GgufError(f"unknown GGUF metadata type {vtype}")
+
+
+def read_gguf(path: str, max_tensors: int = 100_000) -> GgufFile:
+    """Parse header + metadata + tensor descriptors (no tensor data)."""
+    with open(path, "rb") as f:
+        if f.read(4) != GGUF_MAGIC:
+            raise GgufError(f"{path} is not a GGUF file")
+        version = _read(f, "<I")
+        if version not in (2, 3):
+            raise GgufError(f"unsupported GGUF version {version} (need 2 or 3)")
+        tensor_count = _read(f, "<Q")
+        kv_count = _read(f, "<Q")
+        if tensor_count > max_tensors:
+            raise GgufError(f"implausible tensor count {tensor_count}")
+
+        metadata: Dict[str, Any] = {}
+        for _ in range(kv_count):
+            key = _read_string(f)
+            vtype = _read(f, "<I")
+            metadata[key] = _read_value(f, vtype)
+
+        tensors: List[GgufTensorInfo] = []
+        for _ in range(tensor_count):
+            name = _read_string(f)
+            n_dims = _read(f, "<I")
+            if n_dims > 8:
+                raise GgufError(f"implausible tensor rank {n_dims}")
+            shape = tuple(_read(f, "<Q") for _ in range(n_dims))
+            ggml_type = _read(f, "<I")
+            offset = _read(f, "<Q")
+            tensors.append(GgufTensorInfo(name, shape, ggml_type, offset))
+    return GgufFile(version=version, metadata=metadata, tensors=tensors)
+
+
+def model_config_from_gguf(g: GgufFile):
+    """Architecture config from GGUF metadata (llama-family keys)."""
+    from ..engine.config import ModelConfig
+
+    tokens = g.metadata.get("tokenizer.ggml.tokens")
+    vocab = len(tokens) if tokens else g.arch_key("vocab_size", 32000)
+    heads = g.arch_key("attention.head_count", 32)
+    return ModelConfig(
+        vocab_size=vocab,
+        hidden_size=g.arch_key("embedding_length", 4096),
+        intermediate_size=g.arch_key("feed_forward_length", 11008),
+        num_layers=g.arch_key("block_count", 32),
+        num_heads=heads,
+        num_kv_heads=g.arch_key("attention.head_count_kv", heads),
+        rope_theta=float(g.arch_key("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(
+            g.arch_key("attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        max_position_embeddings=g.arch_key("context_length", 4096),
+        num_experts=g.arch_key("expert_count", 0) or 0,
+        num_experts_per_tok=g.arch_key("expert_used_count", 2) or 2,
+    )
+
+
+def mdc_from_gguf(path: str, display_name: Optional[str] = None,
+                  kv_block_size: int = 16):
+    """ModelDeploymentCard from a .gguf file (reference:
+    model_card/create.rs from_gguf)."""
+    from .model_card import ModelDeploymentCard, slugify
+
+    g = read_gguf(path)
+    name = display_name or g.metadata.get("general.name") or path
+    eos = g.metadata.get("tokenizer.ggml.eos_token_id")
+    return ModelDeploymentCard(
+        display_name=name,
+        slug=slugify(str(name)),
+        model_path=path,
+        context_length=g.arch_key("context_length", 4096),
+        kv_block_size=kv_block_size,
+        chat_template=g.metadata.get("tokenizer.chat_template"),
+        bos_token_id=g.metadata.get("tokenizer.ggml.bos_token_id"),
+        eos_token_ids=[eos] if eos is not None else [],
+        config={"architecture": g.architecture, "gguf_version": g.version},
+    )

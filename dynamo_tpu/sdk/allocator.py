@@ -24,7 +24,7 @@ class TpuAllocator:
             env = os.environ.get("DYNAMO_TPU_NUM_CHIPS")
             total_chips = int(env) if env else self._detect()
         self.total_chips = total_chips
-        self._next = 0
+        self._free: List[int] = list(range(total_chips))
 
     @staticmethod
     def _detect() -> int:
@@ -40,7 +40,7 @@ class TpuAllocator:
 
     @property
     def available(self) -> int:
-        return self.total_chips - self._next
+        return len(self._free)
 
     def assign(self, count: int) -> List[int]:
         """Take ``count`` chips; raises when over-subscribed."""
@@ -48,16 +48,20 @@ class TpuAllocator:
             raise AllocationError(
                 f"need {count} TPU chips, {self.available} of {self.total_chips} left"
             )
-        chips = list(range(self._next, self._next + count))
-        self._next += count
+        chips, self._free = self._free[:count], self._free[count:]
         return chips
 
-    def env_for(self, resources: Dict) -> Dict[str, str]:
-        """Environment for one worker given its resource request
-        ({'tpu': N} or none for CPU-only services)."""
+    def release(self, chips: List[int]) -> None:
+        """Return chips (e.g. their worker exited) for reassignment."""
+        self._free = sorted(set(self._free) | set(chips))
+
+    def env_for(self, resources: Dict):
+        """(env, chips) for one worker given its resource request
+        ({'tpu': N} or none for CPU-only services). The caller owns the
+        returned chips and should ``release`` them when the worker exits."""
         n = int(resources.get("tpu", 0))
         if n <= 0:
             # CPU-only service: keep JAX (if imported at all) off the TPU
-            return {"JAX_PLATFORMS": "cpu"}
+            return {"JAX_PLATFORMS": "cpu"}, []
         chips = self.assign(n)
-        return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips)}
+        return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips)}, chips

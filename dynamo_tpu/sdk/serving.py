@@ -47,6 +47,7 @@ class GraphSupervisor:
         self.config_file = config_file
         self.allocator = allocator or TpuAllocator()
         self.procs: List[subprocess.Popen] = []
+        self._proc_chips: Dict[int, List[int]] = {}  # pid → assigned chips
 
     def start(self) -> None:
         try:
@@ -55,7 +56,8 @@ class GraphSupervisor:
                     continue
                 for worker_idx in range(svc.spec.workers):
                     env = dict(os.environ)
-                    env.update(self.allocator.env_for(svc.spec.resources))
+                    extra, chips = self.allocator.env_for(svc.spec.resources)
+                    env.update(extra)
                     cmd = [
                         sys.executable, "-m", "dynamo_tpu.sdk.worker",
                         self.graph_spec, "--service", svc.name,
@@ -69,6 +71,7 @@ class GraphSupervisor:
                         "started %s worker %d (pid %d)", svc.name, worker_idx, proc.pid
                     )
                     self.procs.append(proc)
+                    self._proc_chips[proc.pid] = chips
         except Exception:
             # e.g. AllocationError mid-graph: don't leave earlier workers
             # running with chips held
@@ -88,6 +91,7 @@ class GraphSupervisor:
                 p.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 p.kill()
+            self.allocator.release(self._proc_chips.pop(p.pid, []))
         self.procs.clear()
 
 

@@ -74,8 +74,15 @@ async def serve_service(
 
         def make_handler(m):
             # endpoints may take (request) or (request, ctx) — pass the
-            # engine context through so cooperative stop reaches user code
-            wants_ctx = len(inspect.signature(m).parameters) >= 2
+            # engine context through so cooperative stop reaches user code.
+            # Only REQUIRED positional params count: an optional second
+            # param (e.g. temperature=0.7) must not receive the Context.
+            required = [
+                p for p in inspect.signature(m).parameters.values()
+                if p.default is p.empty
+                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]
+            wants_ctx = len(required) >= 2
 
             async def handler(payload, ctx):
                 agen = m(payload, ctx) if wants_ctx else m(payload)

@@ -1,0 +1,120 @@
+"""llmctl registry management + the namespace metrics aggregator."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.cli.llmctl import KINDS, build_parser, run as llmctl_run
+from dynamo_tpu.cli.metrics import MetricsAggregator
+from dynamo_tpu.http.service import list_models
+from dynamo_tpu.kv_router.protocols import KV_HIT_RATE_EVENT, ForwardPassMetrics
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+
+def _drt():
+    return DistributedRuntime.in_process(MemoryHub())
+
+
+class TestLlmctl:
+    def _args(self, *argv):
+        return build_parser().parse_args(["--store-port", "1", *argv])
+
+    async def test_add_list_remove(self, capsys):
+        drt = _drt()
+        try:
+            rc = await llmctl_run(
+                self._args("http", "add", "chat-models", "m8b",
+                           "dyn://public.backend.generate"), drt)
+            assert rc == 0
+            rc = await llmctl_run(
+                self._args("http", "add", "completion-models", "c1",
+                           "dyn://public.backend.generate"), drt)
+            assert rc == 0
+
+            models = await list_models(drt, "public")
+            by_name = {m["name"]: m for m in models}
+            assert by_name["m8b"]["model_type"] == "chat"
+            assert by_name["c1"]["model_type"] == "completions"
+
+            rc = await llmctl_run(self._args("http", "list"), drt)
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "m8b" in out and "dyn://public.backend.generate" in out
+
+            rc = await llmctl_run(
+                self._args("http", "remove", "chat-models", "m8b"), drt)
+            assert rc == 0
+            models = await list_models(drt, "public")
+            assert [m["name"] for m in models] == ["c1"]
+        finally:
+            await drt.close()
+
+    async def test_add_rejects_bad_endpoint(self):
+        drt = _drt()
+        try:
+            rc = await llmctl_run(
+                self._args("http", "add", "models", "x", "http://nope"), drt)
+            assert rc == 2
+            # structurally short dyn:// paths must fail too (the frontend's
+            # watcher parses strictly)
+            rc = await llmctl_run(
+                self._args("http", "add", "models", "x", "dyn://ns.comp"), drt)
+            assert rc == 2
+            assert await list_models(drt, "public") == []
+        finally:
+            await drt.close()
+
+    def test_kind_mapping(self):
+        assert KINDS == {
+            "chat-models": "chat",
+            "completion-models": "completions",
+            "models": "both",
+        }
+
+
+async def test_metrics_aggregator_scrape_and_events():
+    drt = _drt()
+    try:
+        # a worker endpoint with a ForwardPassMetrics stats handler
+        fpm = ForwardPassMetrics(
+            request_active_slots=3, request_total_slots=8,
+            kv_active_blocks=100, kv_total_blocks=256,
+            gpu_cache_usage_perc=0.39,
+        )
+
+        async def handler(payload, ctx):
+            yield {"ok": True}
+
+        comp = drt.namespace("public").component("backend")
+        serving = await comp.endpoint("generate").serve(
+            handler, stats_handler=fpm.to_wire
+        )
+
+        agg = MetricsAggregator(drt, "dyn://public.backend.generate")
+        await agg.start()
+        try:
+            # scrape pass picks up the worker's stats
+            for _ in range(20):
+                if await agg.collect_once() > 0:
+                    break
+                await asyncio.sleep(0.05)
+            text = agg.render()
+            assert "dynamo_worker_request_active_slots" in text
+            assert "3.0" in text
+            assert "dynamo_worker_kv_total_blocks" in text
+
+            # kv-hit-rate events land in counters
+            await drt.namespace("public").publish_event(
+                KV_HIT_RATE_EVENT,
+                {"worker_id": "w1", "isl_blocks": 10, "overlap_blocks": 7},
+            )
+            await asyncio.sleep(0.1)
+            text = agg.render()
+            assert 'dynamo_kv_hit_rate_events_total{worker="w1"} 1.0' in text
+            assert 'dynamo_kv_hit_overlap_blocks_total{worker="w1"} 7.0' in text
+        finally:
+            agg.stop()
+            await serving.stop()
+    finally:
+        await drt.close()

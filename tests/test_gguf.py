@@ -1,0 +1,129 @@
+"""GGUF parsing: round-trip against an in-test writer, MDC/config mapping."""
+
+import struct
+
+import pytest
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.llm.gguf import (
+    GgufError,
+    mdc_from_gguf,
+    model_config_from_gguf,
+    read_gguf,
+)
+
+T_UINT32, T_FLOAT32, T_BOOL, T_STRING, T_ARRAY, T_UINT64 = 4, 6, 7, 8, 9, 10
+
+
+def _s(text: str) -> bytes:
+    raw = text.encode()
+    return struct.pack("<Q", len(raw)) + raw
+
+
+def _kv(key: str, vtype: int, payload: bytes) -> bytes:
+    return _s(key) + struct.pack("<I", vtype) + payload
+
+
+def write_gguf(path, metadata_blobs, tensors=(), version=3):
+    """Minimal GGUF writer (header + metadata + tensor descriptors)."""
+    with open(path, "wb") as f:
+        f.write(b"GGUF")
+        f.write(struct.pack("<I", version))
+        f.write(struct.pack("<Q", len(tensors)))
+        f.write(struct.pack("<Q", len(metadata_blobs)))
+        for blob in metadata_blobs:
+            f.write(blob)
+        for name, shape, ggml_type, offset in tensors:
+            f.write(_s(name))
+            f.write(struct.pack("<I", len(shape)))
+            for d in shape:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<I", ggml_type))
+            f.write(struct.pack("<Q", offset))
+
+
+@pytest.fixture
+def gguf_path(tmp_path):
+    path = tmp_path / "tiny.gguf"
+    tokens = [_s(t) for t in ("<s>", "</s>", "hello", "world")]
+    meta = [
+        _kv("general.architecture", T_STRING, _s("llama")),
+        _kv("general.name", T_STRING, _s("tiny-llama")),
+        _kv("llama.context_length", T_UINT32, struct.pack("<I", 2048)),
+        _kv("llama.embedding_length", T_UINT32, struct.pack("<I", 64)),
+        _kv("llama.block_count", T_UINT32, struct.pack("<I", 2)),
+        _kv("llama.feed_forward_length", T_UINT32, struct.pack("<I", 128)),
+        _kv("llama.attention.head_count", T_UINT32, struct.pack("<I", 8)),
+        _kv("llama.attention.head_count_kv", T_UINT32, struct.pack("<I", 4)),
+        _kv("llama.rope.freq_base", T_FLOAT32, struct.pack("<f", 500000.0)),
+        _kv("tokenizer.ggml.bos_token_id", T_UINT32, struct.pack("<I", 0)),
+        _kv("tokenizer.ggml.eos_token_id", T_UINT32, struct.pack("<I", 1)),
+        _kv("tokenizer.chat_template", T_STRING, _s("{{ messages }}")),
+        _kv("tokenizer.ggml.tokens", T_ARRAY,
+            struct.pack("<I", T_STRING) + struct.pack("<Q", len(tokens)) + b"".join(tokens)),
+        _kv("some.flag", T_BOOL, struct.pack("<B", 1)),
+        _kv("some.big", T_UINT64, struct.pack("<Q", 1 << 40)),
+    ]
+    tensors = [
+        ("token_embd.weight", (64, 4), 0, 0),
+        ("blk.0.attn_q.weight", (64, 64), 30, 1024),  # bf16
+    ]
+    write_gguf(path, meta, tensors)
+    return str(path)
+
+
+def test_read_gguf_roundtrip(gguf_path):
+    g = read_gguf(gguf_path)
+    assert g.version == 3
+    assert g.architecture == "llama"
+    assert g.metadata["llama.context_length"] == 2048
+    assert g.metadata["some.flag"] is True
+    assert g.metadata["some.big"] == 1 << 40
+    assert g.metadata["tokenizer.ggml.tokens"] == ["<s>", "</s>", "hello", "world"]
+    assert g.arch_key("embedding_length") == 64
+    assert [t.name for t in g.tensors] == ["token_embd.weight", "blk.0.attn_q.weight"]
+    assert g.tensors[1].type_name == "bf16"
+    assert g.tensors[0].shape == (64, 4)
+
+
+def test_model_config_from_gguf(gguf_path):
+    cfg = model_config_from_gguf(read_gguf(gguf_path))
+    assert isinstance(cfg, ModelConfig)
+    assert cfg.vocab_size == 4          # from token list length
+    assert cfg.hidden_size == 64
+    assert cfg.num_layers == 2
+    assert cfg.num_heads == 8 and cfg.num_kv_heads == 4
+    assert cfg.rope_theta == 500000.0
+    assert cfg.max_position_embeddings == 2048
+
+
+def test_mdc_from_gguf(gguf_path):
+    mdc = mdc_from_gguf(gguf_path)
+    assert mdc.display_name == "tiny-llama"
+    assert mdc.context_length == 2048
+    assert mdc.bos_token_id == 0
+    assert mdc.eos_token_ids == [1]
+    assert mdc.chat_template == "{{ messages }}"
+    assert mdc.config["architecture"] == "llama"
+
+
+def test_rejects_non_gguf(tmp_path):
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(GgufError, match="not a GGUF"):
+        read_gguf(str(bad))
+
+
+def test_rejects_v1(tmp_path):
+    path = tmp_path / "v1.gguf"
+    path.write_bytes(b"GGUF" + struct.pack("<I", 1) + b"\x00" * 16)
+    with pytest.raises(GgufError, match="version 1"):
+        read_gguf(str(path))
+
+
+def test_truncated_file(tmp_path):
+    path = tmp_path / "trunc.gguf"
+    path.write_bytes(b"GGUF" + struct.pack("<I", 3) + struct.pack("<Q", 0)
+                     + struct.pack("<Q", 5))  # promises 5 kvs, has none
+    with pytest.raises(GgufError, match="truncated"):
+        read_gguf(str(path))

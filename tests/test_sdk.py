@@ -130,14 +130,26 @@ class TestServiceConfig:
 class TestAllocator:
     def test_assign_and_exhaust(self):
         alloc = TpuAllocator(total_chips=4)
-        assert alloc.env_for({"tpu": 2}) == {"TPU_VISIBLE_CHIPS": "0,1"}
-        assert alloc.env_for({"tpu": 2}) == {"TPU_VISIBLE_CHIPS": "2,3"}
+        env, chips = alloc.env_for({"tpu": 2})
+        assert env == {"TPU_VISIBLE_CHIPS": "0,1"} and chips == [0, 1]
+        env, chips2 = alloc.env_for({"tpu": 2})
+        assert env == {"TPU_VISIBLE_CHIPS": "2,3"}
         with pytest.raises(AllocationError):
             alloc.env_for({"tpu": 1})
 
+    def test_release_makes_chips_reusable(self):
+        alloc = TpuAllocator(total_chips=2)
+        _env, chips = alloc.env_for({"tpu": 2})
+        assert alloc.available == 0
+        alloc.release(chips)
+        assert alloc.available == 2
+        _env, again = alloc.env_for({"tpu": 2})
+        assert again == [0, 1]
+
     def test_cpu_only_service(self):
         alloc = TpuAllocator(total_chips=1)
-        assert alloc.env_for({}) == {"JAX_PLATFORMS": "cpu"}
+        env, chips = alloc.env_for({})
+        assert env == {"JAX_PLATFORMS": "cpu"} and chips == []
         assert alloc.available == 1
 
 
@@ -153,6 +165,29 @@ async def test_e2e_graph_inprocess():
         await client.wait_ready(timeout=5.0)
         out = [item async for item in client.chat({"prompt": "hello tpu world"})]
         assert out == [{"echo": "HELLO"}, {"echo": "TPU"}, {"echo": "WORLD"}]
+    finally:
+        await stop_graph(drt2, handles)
+
+
+async def test_optional_second_param_is_not_ctx():
+    """generate(self, request, temperature=0.7) must NOT receive the ctx."""
+
+    @service(dynamo={"namespace": "optns"})
+    class Sampler:
+        @dynamo_endpoint
+        async def generate(self, request, temperature=0.7):
+            yield {"temperature": temperature}
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    drt2, handles = await serve_graph_inprocess(Sampler, drt)
+    try:
+        from dynamo_tpu.sdk import DynamoClient
+
+        client = DynamoClient(Sampler, drt)
+        await client.start()
+        await client.wait_ready(timeout=5.0)
+        out = [i async for i in client.generate({})]
+        assert out == [{"temperature": 0.7}]
     finally:
         await stop_graph(drt2, handles)
 
