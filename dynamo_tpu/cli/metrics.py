@@ -70,8 +70,17 @@ class MetricsAggregator:
         self._tasks.append(self.drt.runtime.spawn(self._consume_hit_events(sub)))
 
     async def collect_once(self) -> int:
-        """One scrape pass; returns the number of instances that answered."""
+        """One scrape pass; returns the number of instances that answered.
+
+        Series for instances that stopped answering are dropped so dead or
+        restarted workers don't export phantom capacity forever."""
         stats = await self.client.scrape_stats()
+        live = set(stats)
+        for g in (self.inflight, self.requests_total, *self.gauges.values()):
+            g.values = {
+                k: v for k, v in g.values.items()
+                if dict(k).get("instance") in live
+            }
         for iid, s in stats.items():
             self.inflight.set(float(s.get("inflight", 0)), instance=iid)
             self.requests_total.set(float(s.get("requests_total", 0)), instance=iid)

@@ -84,12 +84,30 @@ def load_mdc(flags):
     )
 
 
+def _engine_args(flags) -> dict:
+    """--extra-engine-args <file.json> → kwargs for the engine
+    (reference: dynamo-run's JSON passthrough, flags.rs:175)."""
+    path = getattr(flags, "extra_engine_args", None)
+    if not path:
+        return {}
+    import json
+
+    with open(path) as f:
+        return json.load(f)
+
+
 async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None):
     """Token-level engine (PreprocessedRequest → EngineOutput stream)."""
     from ..llm.engines.echo import EchoEngineCore
 
     if engine_spec == "echo_core":
         return EchoEngineCore()
+    if engine_spec.startswith("pytok:"):
+        from ..llm.engines.python_file import PythonFileEngine
+
+        return await PythonFileEngine.load(
+            engine_spec[len("pytok:"):], _engine_args(flags)
+        )
     if engine_spec == "jax":
         from ..engine.serving import JaxServingEngine
 
@@ -128,8 +146,16 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         return None, None
     if engine_spec == "echo_full":
         return EchoEngineFull(), None
+    if engine_spec.startswith("pystr:"):
+        # bring-your-own OpenAI-level engine (reference: out=pystr:<file>)
+        from ..llm.engines.python_file import PythonFileEngine
 
-    if engine_spec in ("echo_core", "jax"):
+        engine = await PythonFileEngine.load(
+            engine_spec[len("pystr:"):], _engine_args(flags)
+        )
+        return engine, None
+
+    if engine_spec in ("echo_core", "jax") or engine_spec.startswith("pytok:"):
         from ..llm.backend import Backend
         from ..llm.preprocessor import OpenAIPreprocessor
         from ..llm.tokenizer import HFTokenizer

@@ -1,0 +1,67 @@
+"""Bring-your-own Python engine: ``out=pystr:<file.py>`` / ``out=pytok:<file.py>``.
+
+Reference analog: lib/engines/python (reference: lib/engines/python/src/
+lib.rs:43-382 — imports a user file via runpy and streams from its
+``generate`` async generator; pystr = full OpenAI level, pytok = token
+level behind the preprocessor/backend pipeline).
+
+User file contract:
+
+    async def generate(request: dict):        # REQUIRED async generator
+        yield {...}                           # response chunks (dicts)
+
+    async def initialize(engine_args: dict):  # optional, awaited once
+
+pystr requests are OpenAI request dicts and chunks are OpenAI chunk
+dicts; pytok requests are PreprocessedRequest wire dicts and chunks are
+EngineOutput wire dicts (dynamo_tpu/protocols/common.py).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import inspect
+import os
+from typing import Any, AsyncIterator, Optional
+
+from ...runtime.engine import AsyncEngine, Context
+
+
+class PythonFileEngine(AsyncEngine):
+    def __init__(self, path: str, generate_fn):
+        self.path = path
+        self._generate = generate_fn
+
+    @classmethod
+    async def load(
+        cls, path: str, engine_args: Optional[dict] = None
+    ) -> "PythonFileEngine":
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"python engine file not found: {path}")
+        spec = importlib.util.spec_from_file_location(
+            f"dynamo_pyengine_{abs(hash(path))}", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+
+        gen = getattr(module, "generate", None)
+        if gen is None or not inspect.isasyncgenfunction(gen):
+            raise TypeError(
+                f"{path} must define `async def generate(request)` as an "
+                "async generator"
+            )
+        init = getattr(module, "initialize", None)
+        if init is not None:
+            await init(engine_args or {})
+        return cls(path, gen)
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        payload = request.payload
+        if hasattr(payload, "model_dump"):
+            payload = payload.model_dump(exclude_none=True)
+        elif hasattr(payload, "to_wire"):
+            payload = payload.to_wire()
+        async for chunk in self._generate(payload):
+            if request.context.is_stopped:
+                return
+            yield chunk

@@ -94,6 +94,12 @@ class ServiceDefinition:
             self.links.append(other)
         return other
 
+    def unlink_all(self) -> None:
+        """Drop this service's graph edges (link state is process-global —
+        one graph per process in production; tests composing several graphs
+        over the same services reset between them)."""
+        self.links.clear()
+
     def endpoint_path(self, endpoint: str) -> str:
         return f"dyn://{self.spec.namespace}.{self.name}.{endpoint}"
 

@@ -66,7 +66,13 @@ class GraphSupervisor:
                     ]
                     if self.config_file:
                         cmd += ["--config-file", self.config_file]
-                    proc = subprocess.Popen(cmd, env=env)
+                    try:
+                        proc = subprocess.Popen(cmd, env=env)
+                    except Exception:
+                        # chips were assigned for this worker but no process
+                        # will ever own them — give them back before unwinding
+                        self.allocator.release(chips)
+                        raise
                     logger.info(
                         "started %s worker %d (pid %d)", svc.name, worker_idx, proc.pid
                     )
@@ -103,18 +109,21 @@ async def serve_graph_inprocess(
     """Bind every service in ``root``'s graph in this process.
 
     Services are started leaves-first so depends() targets are discoverable
-    before their consumers resolve clients. Returns (drt, handles) —
-    caller owns shutdown via ``stop_graph``.
+    before their consumers resolve clients. Returns (drt, handles, objects)
+    — ``objects`` maps service name → live instance (e.g. to reach the
+    Frontend's bound HTTP port); caller owns shutdown via ``stop_graph``.
     """
     drt = drt or DistributedRuntime.in_process()
     services = list(reversed(graph_services(root)))  # leaves first
     all_handles = []
+    objects: Dict[str, object] = {}
     for svc in services:
         if not svc.spec.enabled:
             continue
-        _obj, handles = await serve_service(svc, drt, config)
+        obj, handles = await serve_service(svc, drt, config)
+        objects[svc.name] = obj
         all_handles.extend(handles)
-    return drt, all_handles
+    return drt, all_handles, objects
 
 
 async def stop_graph(drt: DistributedRuntime, handles) -> None:

@@ -69,6 +69,11 @@ async def serve_service(
 
     comp = drt.namespace(svc.spec.namespace).component(svc.name)
     handles = []
+    # services may expose worker-style plumbing: a stats RPC payload
+    # (ForwardPassMetrics for KV-aware routers) and a pinned instance id
+    # matching their KV event publisher (see examples/llm/components.py)
+    stats_handler = getattr(obj, "stats_handler", None)
+    instance_id = getattr(obj, "instance_id", None)
     for ep_name, method_name in svc.endpoints.items():
         method = getattr(obj, method_name)
 
@@ -93,7 +98,11 @@ async def serve_service(
 
             return handler
 
-        serving = await comp.endpoint(ep_name).serve(make_handler(method))
+        serving = await comp.endpoint(ep_name).serve(
+            make_handler(method),
+            instance_id=instance_id,
+            stats_handler=stats_handler,
+        )
         handles.append(serving)
         logger.info("serving %s", svc.endpoint_path(ep_name))
     return obj, handles

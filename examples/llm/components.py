@@ -1,0 +1,253 @@
+"""The canonical LLM serving graph, SDK edition.
+
+Parity with the reference's flagship example (reference: examples/llm/
+components/{frontend,processor,kv_router,worker,prefill_worker}.py and
+graphs/{agg,agg_router,disagg,disagg_router}.py):
+
+- ``Frontend``   — OpenAI HTTP server + model watcher (reference launches
+  the Rust http binary; here the native HTTP service starts in-process).
+- ``Processor``  — tokenize/detokenize, route to workers (round-robin or
+  via the Router service's KV-aware decision), stream deltas back.
+- ``Router``     — KV-aware scheduling service: token ids in, chosen
+  worker instance out (reference components/kv_router.py).
+- ``Worker``     — token-level engine worker (echo engine by default so
+  the graph runs on any machine; ``engine: jax`` + ``model-path`` serves
+  a real model) publishing KV events + ForwardPassMetrics.
+- ``PrefillWorker`` — consumes the namespace prefill queue for
+  disaggregated serving.
+
+Each service reads its options from ServiceConfig (configs/*.yaml).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+NAMESPACE = "public"
+
+
+def _opt(obj, key: str, default=None):
+    return obj.service_config.get(key, default)
+
+
+# --------------------------------------------------------------------------
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Worker:
+    """Token-level engine worker (reference: components/worker.py)."""
+
+    @async_on_start
+    async def setup(self):
+        from dynamo_tpu.cli.run import build_core_engine, load_mdc
+        from dynamo_tpu.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+
+        flags = _WorkerFlags(self.service_config)
+        self.instance_id = f"w-{uuid.uuid4().hex[:12]}"
+        comp = self.drt.namespace(NAMESPACE).component("Worker")
+        self.publisher = KvEventPublisher(comp, self.instance_id)
+        self.publisher.start()
+        mdc = load_mdc(flags) if flags.model_path else None
+        self.engine = await build_core_engine(
+            _opt(self, "engine", "echo_core"), flags, mdc,
+            events=self.publisher.as_sink(), drt=self.drt,
+        )
+        metrics_fn = getattr(self.engine, "metrics", dict)
+        self.stats_handler = KvMetricsPublisher(metrics_fn).stats_handler
+
+    @dynamo_endpoint
+    async def generate(self, request, ctx) -> AsyncIterator[dict]:
+        async for out in self.engine.generate(Context(request, ctx)):
+            yield out
+
+
+class _WorkerFlags:
+    """service_config dict → the flags namespace cli.run helpers expect."""
+
+    def __init__(self, cfg: dict):
+        self.model_path = cfg.get("model-path")
+        self.model_name = cfg.get("model-name")
+        self.kv_block_size = int(cfg.get("kv-block-size", 16))
+        self.max_batch_size = int(cfg.get("max-batch-size", 8))
+        self.max_model_len = cfg.get("max-model-len")
+        self.tensor_parallel_size = int(cfg.get("tensor-parallel-size", 1))
+        self.host_kv_blocks = int(cfg.get("host-kv-blocks", 0))
+        self.extra_engine_args = cfg.get("extra-engine-args")
+        self.remote_prefill = bool(cfg.get("remote-prefill", False))
+        self.max_local_prefill_length = int(cfg.get("max-local-prefill-length", 512))
+        self.max_prefill_queue_size = int(cfg.get("max-prefill-queue-size", 16))
+        self.namespace = NAMESPACE
+        self.advertise_host = cfg.get("advertise-host", "127.0.0.1")
+        if self.max_model_len is not None:
+            self.max_model_len = int(self.max_model_len)
+
+
+# --------------------------------------------------------------------------
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Router:
+    """KV-aware worker selection as a service (reference:
+    components/kv_router.py + components/router binary)."""
+
+    @async_on_start
+    async def setup(self):
+        from dynamo_tpu.kv_router.router import KvRouter
+        from dynamo_tpu.runtime.client import Client
+
+        block_size = int(_opt(self, "block-size", 16))
+        comp = self.drt.namespace(NAMESPACE).component("Worker")
+        self.router = await KvRouter(
+            comp, Client(comp.endpoint("generate")), block_size=block_size
+        ).start()
+
+    @dynamo_endpoint
+    async def generate(self, request) -> AsyncIterator[dict]:
+        decision = await self.router.schedule(request["token_ids"])
+        yield {
+            "worker_id": decision.worker_id,
+            "prefix_hit_blocks": decision.matched_blocks,
+        }
+
+
+class _RemoteRoutedClient(AsyncEngine):
+    """Processor-side client: ask the Router service for a worker, then
+    direct-route the preprocessed request to it."""
+
+    def __init__(self, worker_client, router_call):
+        self.worker_client = worker_client
+        self.router_call = router_call
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        payload = request.payload
+        token_ids = (
+            payload.token_ids if hasattr(payload, "token_ids")
+            else payload.get("token_ids", [])
+        )
+        try:
+            async for decision in self.router_call({"token_ids": list(token_ids)}):
+                request.baggage["instance_id"] = decision["worker_id"]
+                break
+        except Exception:
+            pass  # router down → fall back to the client's own routing
+        async for item in self.worker_client.generate(request):
+            yield item
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Processor:
+    """OpenAI <-> token translation + routing (reference:
+    components/processor.py).
+
+    The Router service is NOT a declared dependency — agg graphs run
+    without one; ``router-mode: kv`` builds a client to it lazily (the
+    router graphs link it in so the supervisor spawns it)."""
+
+    @async_on_start
+    async def setup(self):
+        from dynamo_tpu.http.service import register_model
+        from dynamo_tpu.llm.backend import Backend
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.llm.tokenizer import HFTokenizer
+        from dynamo_tpu.runtime.client import Client, RouterMode
+        from dynamo_tpu.runtime.pipeline import build_pipeline
+
+        model_path = _opt(self, "model-path")
+        if model_path is None:
+            raise ValueError("Processor requires model-path in its config")
+        mdc = ModelDeploymentCard.from_local_path(model_path)
+        tokenizer = HFTokenizer.from_pretrained_dir(model_path)
+
+        comp = self.drt.namespace(NAMESPACE).component("Worker")
+        mode = _opt(self, "router-mode", "round_robin")
+        client = Client(
+            comp.endpoint("generate"),
+            RouterMode.ROUND_ROBIN if mode == "kv" else RouterMode(mode),
+        )
+        await client.start()
+        engine_tail: AsyncEngine = client
+        if mode == "kv":
+            from dynamo_tpu.sdk import DynamoClient
+
+            router = await DynamoClient(Router, self.drt).start()
+            engine_tail = _RemoteRoutedClient(client, router.generate)
+        self.engine = build_pipeline(
+            [OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], engine_tail
+        )
+        name = _opt(self, "model-name", mdc.display_name)
+        await register_model(
+            self.drt, NAMESPACE, name, f"dyn://{NAMESPACE}.Processor.chat",
+            model_type="both",
+        )
+
+    @dynamo_endpoint
+    async def chat(self, request, ctx) -> AsyncIterator[dict]:
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+
+        cls = ChatCompletionRequest if "messages" in request else CompletionRequest
+        async for chunk in self.engine.generate(Context(cls.model_validate(request), ctx)):
+            yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+
+
+# --------------------------------------------------------------------------
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Frontend:
+    """OpenAI HTTP frontend + discovery-plane model watcher (reference:
+    components/frontend.py + components/http binary)."""
+
+    processor = depends(Processor)
+
+    @async_on_start
+    async def setup(self):
+        from dynamo_tpu.http.service import HttpService, ModelManager, ModelWatcher
+        from dynamo_tpu.runtime.client import RouterMode
+
+        manager = ModelManager()
+        self.http = HttpService(
+            manager,
+            _opt(self, "http-host", "0.0.0.0"),
+            int(_opt(self, "http-port", 8080)),
+        )
+        self.watcher = ModelWatcher(
+            self.drt, manager, NAMESPACE, RouterMode.ROUND_ROBIN
+        )
+        await self.watcher.start()
+        await self.http.start()
+
+
+# --------------------------------------------------------------------------
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class PrefillWorker:
+    """Dedicated prefill worker consuming the namespace prefill queue
+    (reference: components/prefill_worker.py)."""
+
+    @async_on_start
+    async def setup(self):
+        from dynamo_tpu.cli.run import load_mdc
+        from dynamo_tpu.disagg import PrefillWorker as PrefillLoop
+        from dynamo_tpu.engine.model_runner import ModelRunner
+        from dynamo_tpu.engine.serving import engine_config_from_mdc
+
+        flags = _WorkerFlags(self.service_config)
+        if flags.model_path is None:
+            raise ValueError("PrefillWorker requires model-path in its config")
+        mdc = load_mdc(flags)
+        engine_config = engine_config_from_mdc(mdc, flags)
+        loop = asyncio.get_running_loop()
+        runner = await loop.run_in_executor(
+            None, lambda: ModelRunner(engine_config, model_dir=mdc.model_path)
+        )
+        self.worker = PrefillLoop(
+            self.drt, runner, engine_config, namespace=NAMESPACE
+        )
+        self._task = self.drt.runtime.spawn(self.worker.run())

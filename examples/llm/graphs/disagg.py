@@ -1,0 +1,7 @@
+"""Disaggregated prefill/decode: decode Workers offload long prefills to
+PrefillWorkers over the namespace queue (reference:
+examples/llm/graphs/disagg.py)."""
+
+from ..components import Frontend, PrefillWorker, Processor, Worker
+
+Frontend.link(Processor).link(Worker).link(PrefillWorker)

@@ -113,6 +113,13 @@ async def test_metrics_aggregator_scrape_and_events():
             text = agg.render()
             assert 'dynamo_kv_hit_rate_events_total{worker="w1"} 1.0' in text
             assert 'dynamo_kv_hit_overlap_blocks_total{worker="w1"} 7.0' in text
+
+            # dead instances stop exporting: after the worker goes away,
+            # its gauge series are pruned on the next scrape
+            await serving.stop()
+            await asyncio.sleep(0.05)
+            assert await agg.collect_once() == 0
+            assert "request_active_slots{instance=" not in agg.render()
         finally:
             agg.stop()
             await serving.stop()

@@ -1,0 +1,74 @@
+"""Bring-your-own Python engines (out=pystr:/pytok:, reference
+lib/engines/python)."""
+
+import pytest
+
+from dynamo_tpu.llm.engines.python_file import PythonFileEngine
+from dynamo_tpu.runtime.engine import Context
+
+PYSTR_ENGINE = '''
+INIT_ARGS = {}
+
+async def initialize(engine_args):
+    INIT_ARGS.update(engine_args)
+
+async def generate(request):
+    text = request["messages"][-1]["content"]
+    for word in text.split():
+        yield {"choices": [{"delta": {"content": word.upper()},
+                            "index": 0}], "init": INIT_ARGS}
+'''
+
+PYTOK_ENGINE = '''
+async def generate(request):
+    for tid in request["token_ids"]:
+        yield {"token_ids": [tid * 2]}
+'''
+
+NOT_A_GENERATOR = '''
+async def generate(request):
+    return [1, 2, 3]
+'''
+
+
+async def test_pystr_engine_streams(tmp_path):
+    path = tmp_path / "engine.py"
+    path.write_text(PYSTR_ENGINE)
+    engine = await PythonFileEngine.load(str(path), {"temperature": 0.5})
+    req = {"messages": [{"role": "user", "content": "hello tpu"}]}
+    out = [c async for c in engine.generate(Context(req))]
+    assert [c["choices"][0]["delta"]["content"] for c in out] == ["HELLO", "TPU"]
+    assert out[0]["init"] == {"temperature": 0.5}  # initialize() ran
+
+
+async def test_pytok_engine_token_level(tmp_path):
+    path = tmp_path / "tok.py"
+    path.write_text(PYTOK_ENGINE)
+    engine = await PythonFileEngine.load(str(path))
+    out = [c async for c in engine.generate(Context({"token_ids": [1, 2, 3]}))]
+    assert [c["token_ids"] for c in out] == [[2], [4], [6]]
+
+
+async def test_cooperative_stop(tmp_path):
+    path = tmp_path / "tok.py"
+    path.write_text(PYTOK_ENGINE)
+    engine = await PythonFileEngine.load(str(path))
+    ctx = Context({"token_ids": list(range(100))})
+    seen = []
+    async for c in engine.generate(ctx):
+        seen.append(c)
+        if len(seen) == 2:
+            ctx.context.stop_generating()
+    assert len(seen) == 2
+
+
+async def test_rejects_non_generator(tmp_path):
+    path = tmp_path / "bad.py"
+    path.write_text(NOT_A_GENERATOR)
+    with pytest.raises(TypeError, match="async generator"):
+        await PythonFileEngine.load(str(path))
+
+
+async def test_missing_file():
+    with pytest.raises(FileNotFoundError):
+        await PythonFileEngine.load("/nonexistent/engine.py")

@@ -156,7 +156,7 @@ class TestAllocator:
 async def test_e2e_graph_inprocess():
     """Full depends() round-trip: Middle.chat -> network -> Backend.generate."""
     drt = DistributedRuntime.in_process(MemoryHub())
-    drt2, handles = await serve_graph_inprocess(Middle, drt)
+    drt2, handles, _objs = await serve_graph_inprocess(Middle, drt)
     try:
         from dynamo_tpu.sdk import DynamoClient
 
@@ -179,7 +179,7 @@ async def test_optional_second_param_is_not_ctx():
             yield {"temperature": temperature}
 
     drt = DistributedRuntime.in_process(MemoryHub())
-    drt2, handles = await serve_graph_inprocess(Sampler, drt)
+    drt2, handles, _objs = await serve_graph_inprocess(Sampler, drt)
     try:
         from dynamo_tpu.sdk import DynamoClient
 
@@ -221,7 +221,7 @@ async def test_endpoint_receives_ctx_and_stops():
                 await asyncio.sleep(0)
 
     drt = DistributedRuntime.in_process(MemoryHub())
-    drt2, handles = await serve_graph_inprocess(Stoppable, drt)
+    drt2, handles, _objs = await serve_graph_inprocess(Stoppable, drt)
     try:
         from dynamo_tpu.runtime.client import Client
         from dynamo_tpu.runtime.engine import Context
@@ -244,7 +244,7 @@ async def test_endpoint_receives_ctx_and_stops():
 
 async def test_e2e_unknown_endpoint_raises():
     drt = DistributedRuntime.in_process(MemoryHub())
-    drt2, handles = await serve_graph_inprocess(Backend, drt)
+    drt2, handles, _objs = await serve_graph_inprocess(Backend, drt)
     try:
         from dynamo_tpu.sdk import DynamoClient
 
