@@ -26,7 +26,7 @@ import uuid
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
-from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+from dynamo_tpu.sdk import async_on_start, depends, dynamo_endpoint, service
 
 NAMESPACE = "public"
 
