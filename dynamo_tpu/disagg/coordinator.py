@@ -101,7 +101,11 @@ class RemotePrefillCoordinator:
     async def submit(self, request_id: str, token_ids: Sequence[int],
                      block_ids: Sequence[int], num_cached: int,
                      temperature: float = 0.0, top_k: int = 0,
-                     top_p: float = 1.0, seed: Optional[int] = None,
+                     top_p: float = 1.0, min_p: float = 0.0,
+                     presence_penalty: float = 0.0,
+                     frequency_penalty: float = 0.0,
+                     repetition_penalty: float = 1.0,
+                     seed: Optional[int] = None,
                      want_logprobs: bool = False) -> asyncio.Future:
         """Enqueue the prompt; returns a future → (first_token, logprob)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -113,7 +117,10 @@ class RemotePrefillCoordinator:
                 token_ids=list(map(int, token_ids)),
                 block_ids=list(map(int, block_ids)),
                 num_cached=num_cached,
-                temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                min_p=min_p, presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+                repetition_penalty=repetition_penalty, seed=seed,
                 want_logprobs=want_logprobs,
             ))
         except Exception:

@@ -23,6 +23,7 @@ import msgpack
 import numpy as np
 
 from ..engine.block_allocator import BlockAllocator
+from ..engine.sampling import seed_to_key
 from ..engine.scheduler import build_prefill_arrays
 from ..tokens import compute_block_hashes
 from .protocols import PrefillQueue, RemotePrefillRequest
@@ -107,14 +108,28 @@ class PrefillWorker:
         try:
             arrays = build_prefill_arrays(cfg, prompt, num_cached, block_ids)
             if rpr.seed is not None:
-                self.key = jax.random.fold_in(self.key, int(rpr.seed))
-            self.key, step_key = jax.random.split(self.key)
+                # same key derivation as the decode scheduler's local path:
+                # fold_in(seed_key, generated=0) — bit-identical first token
+                seed_keys = seed_to_key(int(rpr.seed))[None, :]
+            else:
+                self.key, step_key = jax.random.split(self.key)
+                seed_keys = np.asarray(
+                    jax.random.key_data(step_key), np.uint32)[None, :]
+            # penalty state: prompt presence for repetition penalty on the
+            # one sampled token (slot 0 of this worker's runner)
+            self.runner.set_sample_row(0, prompt, [])
             next_tokens, lps = self.runner.step(
                 *arrays,
                 np.asarray([rpr.temperature], np.float32),
                 np.asarray([rpr.top_k], np.int32),
                 np.asarray([rpr.top_p], np.float32),
-                step_key,
+                min_p=np.asarray([rpr.min_p], np.float32),
+                presence_penalty=np.asarray([rpr.presence_penalty], np.float32),
+                frequency_penalty=np.asarray([rpr.frequency_penalty], np.float32),
+                repetition_penalty=np.asarray([rpr.repetition_penalty], np.float32),
+                seed_keys=seed_keys,
+                counters=np.zeros(1, np.int32),
+                sample_slots=np.zeros(1, np.int32),
             )
             token, lp = await loop.run_in_executor(
                 None,

@@ -34,6 +34,10 @@ class RemotePrefillRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    min_p: float = 0.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
     seed: Optional[int] = None
     want_logprobs: bool = False
 

@@ -116,9 +116,19 @@ class ModelRunner:
         self.cache_sharding = NamedSharding(self.mesh, cache_spec)
         self.kv_cache = tuple(jax.device_put(c, self.cache_sharding) for c in cache)
 
+        # per-slot sampling-penalty state: generated-token counts + prompt
+        # presence, [num_slots, vocab] on device (see engine/sampling.py)
+        self.state_sharding = NamedSharding(self.mesh, P("dp", None))
+        b, v = config.max_batch_size, cfg.vocab_size
+        self.sample_state = (
+            jax.device_put(jnp.zeros((b, v), jnp.int32), self.state_sharding),
+            jax.device_put(jnp.zeros((b, v), jnp.bool_), self.state_sharding),
+        )
+
         self._step_compiled = {}
         self._build_step()
         self._build_block_ops()
+        self._build_sample_row()
 
     # ---------- the unified step program ----------
 
@@ -130,8 +140,9 @@ class ModelRunner:
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
 
-        def step(params, k_cache, v_cache, tokens, positions, block_tables,
-                 slot_mapping, context_lens, last_idx, temperature, top_k, top_p, key):
+        def step(params, k_cache, v_cache, counts, seen, tokens, positions,
+                 block_tables, slot_mapping, context_lens, last_idx,
+                 samp, sample_slots, commit):
             logits, (k_cache, v_cache) = arch.forward(
                 params, cfg, tokens, positions, (k_cache, v_cache),
                 block_tables, slot_mapping, context_lens,
@@ -139,28 +150,46 @@ class ModelRunner:
             )
             b = tokens.shape[0]
             last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
-            samp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p)
-            next_tokens = sample(last_logits, samp, key)
+            row_counts = counts[sample_slots]              # [b, V]
+            row_seen = seen[sample_slots]
+            next_tokens = sample(last_logits, samp, row_counts, row_seen)
             lps = logprobs_for(last_logits, next_tokens)
-            return next_tokens, lps, k_cache, v_cache
+            # count the sampled token as generated for its slot — but only
+            # for rows whose sample the scheduler will keep (``commit``;
+            # intermediate prefill-chunk samples are discarded)
+            counts = counts.at[sample_slots, next_tokens].add(
+                commit.astype(jnp.int32)
+            )
+            return next_tokens, lps, k_cache, v_cache, counts, seen
 
+        samp_spec = SamplingParams(
+            temperature=batch_spec, top_k=batch_spec, top_p=batch_spec,
+            min_p=batch_spec, presence_penalty=batch_spec,
+            frequency_penalty=batch_spec, repetition_penalty=batch_spec,
+            keys=batch2_spec, counters=batch_spec,
+        )
         self._step = jax.jit(
             step,
-            donate_argnums=(1, 2),
+            donate_argnums=(1, 2, 3, 4),
             in_shardings=(
                 self.param_shardings,        # params
                 self.cache_sharding,         # k
                 self.cache_sharding,         # v
+                self.state_sharding,         # counts
+                self.state_sharding,         # seen
                 batch2_spec,                 # tokens [B, S]
                 batch2_spec,                 # positions
                 batch2_spec,                 # block_tables
                 batch2_spec,                 # slot_mapping
                 batch_spec,                  # context_lens
                 batch_spec,                  # last_idx
-                batch_spec, batch_spec, batch_spec,  # sampling params
-                repl,                        # key
+                samp_spec,                   # SamplingParams pytree
+                batch_spec,                  # sample_slots
+                batch_spec,                  # commit
             ),
-            out_shardings=(batch_spec, batch_spec, self.cache_sharding, self.cache_sharding),
+            out_shardings=(batch_spec, batch_spec, self.cache_sharding,
+                           self.cache_sharding, self.state_sharding,
+                           self.state_sharding),
         )
 
     def step(
@@ -174,19 +203,82 @@ class ModelRunner:
         temperature: np.ndarray,
         top_k: np.ndarray,
         top_p: np.ndarray,
-        key: jax.Array,
+        key: Optional[jax.Array] = None,
+        *,
+        min_p: Optional[np.ndarray] = None,
+        presence_penalty: Optional[np.ndarray] = None,
+        frequency_penalty: Optional[np.ndarray] = None,
+        repetition_penalty: Optional[np.ndarray] = None,
+        seed_keys: Optional[np.ndarray] = None,   # [B, 2] u32 per-row keys
+        counters: Optional[np.ndarray] = None,    # [B] i32 fold-in counters
+        sample_slots: Optional[np.ndarray] = None,  # [B] i32 state-row per batch row
+        commit: Optional[np.ndarray] = None,      # [B] bool count sampled token
     ) -> Tuple[jax.Array, jax.Array]:
-        """Run one compiled step; returns (next_tokens, logprobs) device arrays."""
-        next_tokens, lps, k, v = self._step(
+        """Run one compiled step; returns (next_tokens, logprobs) device arrays.
+
+        Legacy callers pass a single ``key`` (tests, warmup, dry runs): it is
+        broadcast into per-row keys with the row index as fold-in counter.
+        The scheduler passes per-request ``seed_keys``/``counters`` instead.
+        """
+        b = tokens.shape[0]
+        if seed_keys is None:
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            seed_keys = np.tile(
+                np.asarray(jax.random.key_data(key), np.uint32)[None, :], (b, 1)
+            )
+        if counters is None:
+            counters = np.arange(b, dtype=np.int32)
+        samp = SamplingParams(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+            min_p=jnp.asarray(
+                min_p if min_p is not None else np.zeros(b), jnp.float32),
+            presence_penalty=jnp.asarray(
+                presence_penalty if presence_penalty is not None else np.zeros(b),
+                jnp.float32),
+            frequency_penalty=jnp.asarray(
+                frequency_penalty if frequency_penalty is not None else np.zeros(b),
+                jnp.float32),
+            repetition_penalty=jnp.asarray(
+                repetition_penalty if repetition_penalty is not None else np.ones(b),
+                jnp.float32),
+            keys=jnp.asarray(seed_keys, jnp.uint32),
+            counters=jnp.asarray(counters, jnp.int32),
+        )
+        if sample_slots is None:
+            sample_slots = np.arange(b, dtype=np.int32)
+        if commit is None:
+            commit = np.zeros(b, bool)
+        next_tokens, lps, k, v, counts, seen = self._step(
             self.params, self.kv_cache[0], self.kv_cache[1],
+            self.sample_state[0], self.sample_state[1],
             jnp.asarray(tokens, jnp.int32), jnp.asarray(positions, jnp.int32),
             jnp.asarray(block_tables, jnp.int32), jnp.asarray(slot_mapping, jnp.int32),
             jnp.asarray(context_lens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
-            jnp.asarray(temperature, jnp.float32), jnp.asarray(top_k, jnp.int32),
-            jnp.asarray(top_p, jnp.float32), key,
+            samp,
+            jnp.asarray(sample_slots, jnp.int32), jnp.asarray(commit, jnp.bool_),
         )
         self.kv_cache = (k, v)
+        self.sample_state = (counts, seen)
         return next_tokens, lps
+
+    def set_sample_row(self, slot: int, prompt_ids, generated_ids=()) -> None:
+        """Install penalty state for a slot at admission: prompt presence +
+        generated-token counts (non-empty when resuming a preempted stream)."""
+        v = self.config.model.vocab_size
+        seen_row = np.zeros(v, bool)
+        if len(prompt_ids):
+            seen_row[np.asarray(prompt_ids, np.int64)] = True
+        counts_row = np.zeros(v, np.int32)
+        if len(generated_ids):
+            np.add.at(counts_row, np.asarray(generated_ids, np.int64), 1)
+        self.sample_state = self._set_row_jit(
+            self.sample_state[0], self.sample_state[1],
+            jnp.asarray(slot, jnp.int32), jnp.asarray(counts_row),
+            jnp.asarray(seen_row),
+        )
 
     # ---------- paged-block gather / scatter ----------
     #
@@ -196,6 +288,23 @@ class ModelRunner:
     # lib/llm/src/kernels/block_copy.cu:40-758, lib/llm/src/kv/layer.rs
     # CopyStream). XLA compiles the gather/scatter over the [L, N, bs, H, D]
     # cache; block counts are bucketed so each bucket compiles once.
+
+    def _build_sample_row(self):
+        repl = NamedSharding(self.mesh, P())
+
+        def set_row(counts, seen, slot, counts_row, seen_row):
+            return (
+                counts.at[slot].set(counts_row),
+                seen.at[slot].set(seen_row),
+            )
+
+        self._set_row_jit = jax.jit(
+            set_row,
+            donate_argnums=(0, 1),
+            in_shardings=(self.state_sharding, self.state_sharding,
+                          repl, repl, repl),
+            out_shardings=(self.state_sharding, self.state_sharding),
+        )
 
     BLOCK_OP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 

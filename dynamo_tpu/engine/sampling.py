@@ -1,8 +1,22 @@
-"""Batched in-jit sampling: greedy / temperature / top-k / top-p per slot.
+"""Batched in-jit sampling: greedy / temperature / top-k / top-p / min-p plus
+presence, frequency and repetition penalties — all per slot.
 
 All parameters are per-request arrays so one compiled program serves every
 sampling configuration in the batch (no recompiles when requests differ).
-temperature == 0 means greedy.
+temperature == 0 means greedy. Every request samples from its own PRNG key
+(seeded requests are bit-reproducible and isolated from their batchmates —
+reference surface: lib/llm/src/protocols/common.rs:248-316 SamplingOptions).
+
+Penalty state lives on device as two [num_slots, vocab] buffers owned by the
+ModelRunner: ``counts`` (how often each token was *generated*) and ``seen``
+(tokens present in the prompt). Penalty semantics follow the de-facto
+standard the reference's engines implement (vLLM):
+
+- repetition_penalty r: for tokens in prompt or output, positive logits are
+  divided by r, negative multiplied (r == 1 disables).
+- presence_penalty: subtracted once from every token that has been generated.
+- frequency_penalty: subtracted per occurrence of a generated token.
+- min_p: after temperature scaling, tokens with prob < min_p * max_prob drop.
 """
 
 from __future__ import annotations
@@ -12,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..protocols.common import SamplingOptions
 
@@ -20,9 +35,15 @@ from ..protocols.common import SamplingOptions
 class SamplingParams:
     """Per-slot device arrays; batch dimension leads."""
 
-    temperature: jax.Array  # [B] f32; 0 → greedy
-    top_k: jax.Array        # [B] i32; 0 → disabled
-    top_p: jax.Array        # [B] f32; 1.0 → disabled
+    temperature: jax.Array          # [B] f32; 0 → greedy
+    top_k: jax.Array                # [B] i32; 0 → disabled
+    top_p: jax.Array                # [B] f32; 1.0 → disabled
+    min_p: jax.Array                # [B] f32; 0.0 → disabled
+    presence_penalty: jax.Array     # [B] f32; 0.0 → disabled
+    frequency_penalty: jax.Array    # [B] f32; 0.0 → disabled
+    repetition_penalty: jax.Array   # [B] f32; 1.0 → disabled
+    keys: jax.Array                 # [B, 2] u32 per-request base PRNG keys
+    counters: jax.Array             # [B] i32 fold-in step counters
 
     @classmethod
     def zeros(cls, batch: int) -> "SamplingParams":
@@ -30,27 +51,74 @@ class SamplingParams:
             temperature=jnp.zeros(batch, jnp.float32),
             top_k=jnp.zeros(batch, jnp.int32),
             top_p=jnp.ones(batch, jnp.float32),
+            min_p=jnp.zeros(batch, jnp.float32),
+            presence_penalty=jnp.zeros(batch, jnp.float32),
+            frequency_penalty=jnp.zeros(batch, jnp.float32),
+            repetition_penalty=jnp.ones(batch, jnp.float32),
+            keys=jnp.zeros((batch, 2), jnp.uint32),
+            counters=jnp.arange(batch, dtype=jnp.int32),
         )
 
 
+jax.tree_util.register_dataclass(
+    SamplingParams,
+    data_fields=[f.name for f in dataclasses.fields(SamplingParams)],
+    meta_fields=[],
+)
+
+
 def host_row(opts: SamplingOptions):
-    """One request's SamplingOptions → (temperature, top_k, top_p) scalars."""
+    """One request's SamplingOptions → the per-slot host scalars
+    (temperature, top_k, top_p, min_p, presence, frequency, repetition)."""
     temp = opts.temperature if opts.temperature is not None else 1.0
     return (
         float(temp),
         int(opts.top_k) if opts.top_k and opts.top_k > 0 else 0,
         float(opts.top_p) if opts.top_p is not None else 1.0,
+        float(opts.min_p) if opts.min_p else 0.0,
+        float(opts.presence_penalty) if opts.presence_penalty else 0.0,
+        float(opts.frequency_penalty) if opts.frequency_penalty else 0.0,
+        float(opts.repetition_penalty) if opts.repetition_penalty else 1.0,
     )
+
+
+def seed_to_key(seed: int) -> np.ndarray:
+    """A per-request base key from an explicit user seed (uint32[2])."""
+    seed = int(seed)
+    return np.asarray(
+        [(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32
+    )
+
+
+def _row_keys(params: SamplingParams) -> jax.Array:
+    """Fold each row's step counter into its base key (typed key array)."""
+    def fold(kdata, c):
+        return jax.random.fold_in(
+            jax.random.wrap_key_data(kdata, impl="threefry2x32"), c
+        )
+    return jax.vmap(fold)(params.keys, params.counters)
 
 
 def sample(
     logits: jax.Array,  # [B, V] f32
     params: SamplingParams,
-    key: jax.Array,
+    counts: Optional[jax.Array] = None,   # [B, V] i32 generated-token counts
+    seen: Optional[jax.Array] = None,     # [B, V] bool prompt-token presence
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
+
+    # ---- penalties (on raw logits, before temperature) ----
+    if counts is not None:
+        generated = counts > 0
+        ever = generated if seen is None else (generated | seen)
+        rp = params.repetition_penalty[:, None]
+        logits = jnp.where(
+            ever, jnp.where(logits > 0, logits / rp, logits * rp), logits
+        )
+        logits = logits - params.frequency_penalty[:, None] * counts.astype(jnp.float32)
+        logits = logits - params.presence_penalty[:, None] * generated.astype(jnp.float32)
 
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -65,6 +133,12 @@ def sample(
     topk_mask = (params.top_k[:, None] > 0) & (scaled < kth)
     scaled = jnp.where(topk_mask, -jnp.inf, scaled)
 
+    # min-p: drop tokens whose prob is below min_p * max_prob. Computed on
+    # the already-top-k-masked logits, like the engines the reference wraps.
+    probs_all = jax.nn.softmax(scaled, axis=-1)
+    minp_mask = probs_all < params.min_p[:, None] * probs_all.max(axis=-1, keepdims=True)
+    scaled = jnp.where(minp_mask, -jnp.inf, scaled)
+
     # top-p (nucleus): mask the tail whose cumulative prob exceeds p
     sort_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
     sorted_scaled = jnp.take_along_axis(scaled, sort_idx, axis=-1)
@@ -76,7 +150,8 @@ def sample(
     ].set(keep_sorted)
     scaled = jnp.where(keep, scaled, -jnp.inf)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    row_keys = _row_keys(params)
+    sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(row_keys, scaled)
     return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 

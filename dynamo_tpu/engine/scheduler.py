@@ -20,7 +20,6 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-import jax
 import numpy as np
 
 from ..protocols.common import (
@@ -34,7 +33,7 @@ from ..tokens import TokenSequence
 from .block_allocator import BlockAllocator, KvEventSink
 from .config import EngineConfig
 from .model_runner import ModelRunner
-from .sampling import host_row
+from .sampling import host_row, seed_to_key
 
 logger = logging.getLogger(__name__)
 
@@ -73,10 +72,15 @@ class EngineRequest:
     req: PreprocessedRequest
     ctx: AsyncEngineContext
     out_queue: asyncio.Queue
-    # sampling scalars
+    # sampling scalars (one slot row each; see engine/sampling.py)
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    min_p: float = 0.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    base_key: Optional[np.ndarray] = None  # uint32[2] per-request PRNG key
     want_logprobs: bool = False
     # runtime state
     slot: int = -1
@@ -88,6 +92,12 @@ class EngineRequest:
     seq: Optional[TokenSequence] = None
     registered_blocks: int = 0
     finish: Optional[FinishReason] = None
+    # chunked-prefill progress (tokens of prefill_tokens with KV written)
+    prefill_tokens: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0
+    # preemption-resume: generated tokens already emitted before preemption;
+    # re-prefilled (prompt + resume_tokens) so the stream CONTINUES
+    resume_tokens: List[int] = dataclasses.field(default_factory=list)
     # disaggregated prefill state
     remote_future: Optional[asyncio.Future] = None
     remote_deadline: float = 0.0
@@ -128,8 +138,9 @@ class Scheduler:
         self.waiting: deque = deque()
         self.pending_remote: List[EngineRequest] = []
         self.slots: List[Optional[EngineRequest]] = [None] * config.max_batch_size
+        self.prefilling: Optional[EngineRequest] = None
         self.wake = asyncio.Event()
-        self.key = jax.random.PRNGKey(config.seed)
+        self._rng = np.random.default_rng(config.seed)
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
         # telemetry (ForwardPassMetrics analog, SURVEY.md §2.2 KV metrics)
@@ -156,11 +167,17 @@ class Scheduler:
             await self.disagg.close()
 
     def add_request(self, er: EngineRequest) -> None:
-        (er.temperature, er.top_k, er.top_p) = host_row(er.req.sampling_options)
-        if er.req.sampling_options.seed is not None:
-            # fold per-request seed into the stream for reproducibility
-            er_seed = int(er.req.sampling_options.seed)
-            self.key = jax.random.fold_in(self.key, er_seed)
+        so = er.req.sampling_options
+        (er.temperature, er.top_k, er.top_p, er.min_p, er.presence_penalty,
+         er.frequency_penalty, er.repetition_penalty) = host_row(so)
+        if so.seed is not None:
+            # per-request key: seeded sampling is reproducible AND isolated
+            # from batchmates (each slot samples from its own PRNG stream)
+            er.base_key = seed_to_key(int(so.seed))
+        else:
+            er.base_key = self._rng.integers(
+                0, 2**32, size=2, dtype=np.uint32
+            )
         er.want_logprobs = bool(er.req.output_options.logprobs)
         self.waiting.append(er)
         self.wake.set()
@@ -251,30 +268,52 @@ class Scheduler:
                     self._finish(er, FinishReason.CANCELLED)
             for er in [s for s in self.slots if s is not None]:
                 if er.ctx.is_stopped:
+                    if er is self.prefilling:
+                        self.prefilling = None
                     self._finish(er, FinishReason.CANCELLED)
 
             # remote prefill completions / cancellations / timeouts
             if self.pending_remote:
                 progressed |= self._reap_remote()
 
-            # admission: prefill while there's a free slot and memory
-            while self.waiting and self._free_slot() is not None:
+            # admission, remote first: a remote-prefill submit is only a
+            # queue push + block reservation (no local compute), so it
+            # proceeds even while a local chunked prefill occupies the
+            # runner; the pending window bounds block reservations
+            if self.disagg is not None:
+                for er in list(self.waiting):
+                    if (len(self.pending_remote)
+                            >= self.config.max_batch_size):
+                        break
+                    if await self._try_submit_remote(er):
+                        self.waiting.remove(er)
+                        progressed = True
+
+            # local admission: claim a slot + blocks, begin a chunked prefill
+            while (self.waiting and self.prefilling is None
+                   and self._free_slot() is not None):
                 er = self.waiting[0]
-                if self.disagg is not None and await self._try_submit_remote(er):
-                    self.waiting.popleft()
-                    progressed = True
-                    continue
                 try:
-                    ok = await self._prefill(loop, er)
+                    self._start_prefill(er)
                 except MemoryError:
                     break  # no memory — wait for a sequence to finish
-                if not ok:
-                    break
                 self.waiting.popleft()
                 progressed = True
 
+            # one prefill chunk (≤ max_prefill_tokens_per_step tokens) per
+            # loop pass, interleaved with the decode step below so active
+            # streams keep a bounded ITL while a long prompt prefills
+            # (reference analog: chunked-prefill toggles,
+            # examples/llm/components/worker.py:72-74)
+            if self.prefilling is not None:
+                await self._prefill_chunk(loop, self.prefilling)
+                progressed = True
+
             # decode one token for every active slot
-            active = [s for s in self.slots if s is not None]
+            active = [
+                s for s in self.slots
+                if s is not None and s is not self.prefilling
+            ]
             if active:
                 await self._decode(loop, active)
                 progressed = True
@@ -306,6 +345,11 @@ class Scheduler:
         """
         if er.remote_attempted:
             return False  # already tried remote once — prefill locally
+        if er.resume_tokens:
+            # preempted stream: only the local path knows to re-prefill
+            # prompt + resume_tokens; the remote path would restart the
+            # stream from the prompt alone
+            return False
         probe = self.allocator.probe_prefix(er.prompt)
         # host-tier blocks count as hit: restoring them locally is far
         # cheaper than a remote prefill round-trip
@@ -323,6 +367,9 @@ class Scheduler:
             er.remote_future = await self.disagg.submit(
                 er.request_id, er.prompt, er.block_ids, er.num_cached,
                 temperature=er.temperature, top_k=er.top_k, top_p=er.top_p,
+                min_p=er.min_p, presence_penalty=er.presence_penalty,
+                frequency_penalty=er.frequency_penalty,
+                repetition_penalty=er.repetition_penalty,
                 seed=er.req.sampling_options.seed,
                 want_logprobs=er.want_logprobs,
             )
@@ -387,6 +434,8 @@ class Scheduler:
         er.context_len = len(er.prompt)
         er.pending_token = token
         er.generated = 1
+        # penalty/PRNG state for the decode steps this slot is entering
+        self.runner.set_sample_row(slot, er.prompt, [token])
         er.seq = TokenSequence(er.prompt, block_size=self.config.kv_block_size)
         self._register_completed_blocks(er)
         er.finish = self._check_finish(er, token)
@@ -394,47 +443,80 @@ class Scheduler:
         if er.finish is not None:
             self._finish(er, er.finish, emit=False)
 
-    async def _prefill(self, loop, er: EngineRequest) -> bool:
-        cfg = self.config
-        slot = self._free_slot()
-        if slot is None:
-            return False
+    def _start_prefill(self, er: EngineRequest) -> None:
+        """Claim a slot + KV blocks and enter the chunked-prefill state.
 
-        er.block_ids, er.num_cached = self.allocator.allocate_prompt(er.prompt)
+        A preempted request resumes here: ``prompt + resume_tokens`` is
+        re-prefilled so the emitted stream *continues* from where it left
+        off instead of restarting (vLLM recompute-preemption semantics)."""
+        slot = self._free_slot()
+        assert slot is not None
+        tokens_all = er.prompt + er.resume_tokens
+        er.block_ids, er.num_cached = self.allocator.allocate_prompt(tokens_all)
         if not er.remote_attempted:  # remote fallback already counted itself
             self.prefix_hit_tokens += er.num_cached
-            self.prefix_total_tokens += len(er.prompt)
+            self.prefix_total_tokens += len(tokens_all)
+        er.prefill_tokens = tokens_all
+        er.prefill_pos = er.num_cached
+        er.context_len = er.num_cached
+        er.slot = slot
+        self.slots[slot] = er
+        er.seq = TokenSequence(tokens_all, block_size=self.config.kv_block_size)
+        er.registered_blocks = 0
+        # penalty state for the slot: prompt presence + (on resume) counts
+        # of the already-generated tokens
+        self.runner.set_sample_row(slot, er.prompt, er.resume_tokens)
+        self.prefilling = er
 
-        arrays = build_prefill_arrays(cfg, er.prompt, er.num_cached, er.block_ids)
-        self.key, step_key = jax.random.split(self.key)
+    async def _prefill_chunk(self, loop, er: EngineRequest) -> None:
+        """Run ONE bucketed prefill chunk; on the final chunk, sample/emit."""
+        cfg = self.config
+        total = len(er.prefill_tokens)
+        budget = cfg.max_prefill_tokens_per_step or total
+        take = min(total - er.prefill_pos, budget)
+        end = er.prefill_pos + take
+        final = end >= total
+
+        arrays = build_prefill_arrays(
+            cfg, er.prefill_tokens[:end], er.prefill_pos, er.block_ids
+        )
         t0 = time.monotonic()
         next_tokens, lps = self.runner.step(
             *arrays,
             np.asarray([er.temperature], np.float32),
             np.asarray([er.top_k], np.int32),
             np.asarray([er.top_p], np.float32),
-            step_key,
+            min_p=np.asarray([er.min_p], np.float32),
+            presence_penalty=np.asarray([er.presence_penalty], np.float32),
+            frequency_penalty=np.asarray([er.frequency_penalty], np.float32),
+            repetition_penalty=np.asarray([er.repetition_penalty], np.float32),
+            seed_keys=er.base_key[None, :],
+            counters=np.asarray([er.generated], np.int32),
+            sample_slots=np.asarray([er.slot], np.int32),
+            commit=np.asarray([final], bool),
         )
+        self.steps += 1
+        er.prefill_pos = end
+        er.context_len = end
+        # prefix blocks become matchable (and KV events publish) as soon as
+        # each chunk's KV is scheduled — device ordering guarantees the
+        # write lands before any later step reads it
+        self._register_completed_blocks(er)
+        logger.debug("prefill chunk %s [%d:%d)/%d %.1fms", er.request_id,
+                     end - take, end, total, 1e3 * (time.monotonic() - t0))
+        if not final:
+            return
+
         token, lp = await loop.run_in_executor(
             None, lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]))
         )
-        self.steps += 1
-        logger.debug("prefill %s len=%d %.1fms", er.request_id,
-                     len(er.prompt) - er.num_cached, 1e3 * (time.monotonic() - t0))
-
-        er.slot = slot
-        self.slots[slot] = er
-        er.context_len = len(er.prompt)
+        self.prefilling = None
         er.pending_token = token
-        er.generated = 1
-        er.seq = TokenSequence(er.prompt, block_size=cfg.kv_block_size)
-        self._register_completed_blocks(er)
-
+        er.generated += 1  # += not =: resumed requests keep their count
         er.finish = self._check_finish(er, token)
         self._emit(er, token, lp if er.want_logprobs else None)
         if er.finish is not None:
             self._finish(er, er.finish, emit=False)
-        return True
 
     async def _decode(self, loop, active: List[EngineRequest]) -> None:
         cfg = self.config
@@ -465,6 +547,13 @@ class Scheduler:
         temp = np.zeros(b, np.float32)
         top_k = np.zeros(b, np.int32)
         top_p = np.ones(b, np.float32)
+        min_p = np.zeros(b, np.float32)
+        pres = np.zeros(b, np.float32)
+        freq = np.zeros(b, np.float32)
+        rep = np.ones(b, np.float32)
+        keys = np.zeros((b, 2), np.uint32)
+        ctrs = np.zeros(b, np.int32)
+        commit = np.zeros(b, bool)
 
         for er in active:
             i = er.slot
@@ -475,11 +564,18 @@ class Scheduler:
             btab[i, : len(er.block_ids)] = er.block_ids
             ctx_lens[i] = pos + 1
             temp[i], top_k[i], top_p[i] = er.temperature, er.top_k, er.top_p
+            min_p[i], pres[i], freq[i] = er.min_p, er.presence_penalty, er.frequency_penalty
+            rep[i] = er.repetition_penalty
+            keys[i] = er.base_key
+            ctrs[i] = er.generated
+            commit[i] = True
 
-        self.key, step_key = jax.random.split(self.key)
         next_tokens, lps = self.runner.step(
             tokens, positions, btab, slot_map, ctx_lens, last_idx,
-            temp, top_k, top_p, step_key,
+            temp, top_k, top_p,
+            min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
+            repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+            sample_slots=np.arange(b, dtype=np.int32), commit=commit,
         )
         toks, lpn = await loop.run_in_executor(
             None, lambda: (np.asarray(next_tokens), np.asarray(lps))
@@ -502,18 +598,32 @@ class Scheduler:
                 self._finish(er, er.finish, emit=False)
 
     def _preempt(self, er: EngineRequest) -> None:
-        """Return a request to the waiting queue, releasing its blocks."""
+        """Return a request to the waiting queue, releasing its blocks.
+
+        Tokens already emitted to the client are PRESERVED: on re-admission
+        the request re-prefills ``prompt + resume_tokens`` and the stream
+        continues where it stopped (never restarts or diverges)."""
         if er.slot >= 0:
             self.slots[er.slot] = None
             er.slot = -1
         self.allocator.free_blocks(er.block_ids)
         er.block_ids = []
+        # seq mirrors tokens whose KV was written; everything past the
+        # original prompt is generated output, plus the not-yet-written
+        # pending token — all already emitted to the client
+        gen = er.seq.token_ids[len(er.prompt):] if er.seq is not None else []
+        if er.pending_token >= 0:
+            gen = gen + [er.pending_token]
+        er.resume_tokens = list(gen)
         er.context_len = 0
         er.num_cached = 0
-        er.generated = 0
         er.pending_token = -1
         er.seq = None
         er.registered_blocks = 0
+        er.prefill_tokens = []
+        er.prefill_pos = 0
+        # er.generated keeps its value: max_tokens accounting + PRNG
+        # fold-in counters continue, not restart
         self.waiting.appendleft(er)
 
     def _check_finish(self, er: EngineRequest, token: int) -> Optional[FinishReason]:

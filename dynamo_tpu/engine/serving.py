@@ -96,6 +96,12 @@ class JaxServingEngine(AsyncEngine):
                 f"prompt length {len(req.token_ids)} exceeds engine max_model_len "
                 f"{self.config.max_model_len}"
             )
+        n = req.sampling_options.n
+        if n is not None and n > 1:
+            # reject rather than silently sample one choice (parity:
+            # reference SamplingOptions carries n/best_of to engines that
+            # implement them — lib/llm/src/protocols/common.rs:248-316)
+            raise EngineError("n > 1 is not supported by this engine")
         er = EngineRequest(
             request_id=request.id or uuid.uuid4().hex,
             prompt=list(req.token_ids),
