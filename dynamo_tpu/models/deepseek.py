@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from ..ops.attention import scatter_kv
+from ..ops.attention import scatter_kv_stacked
 from .llama import _swiglu_mlp, apply_rope, base_specs, lm_logits, rms_norm, run_layers
 from .mixtral import make_moe_mlp_fn
 
@@ -232,13 +232,13 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta
         )  # [B, S, 1, rd]
 
+        # in-place scatter into the stacked caches; the read side below
+        # still gathers the layer (the MLA attention is the XLA path)
+        c_all, kr_all = scatter_kv_stacked(
+            c_all, kr_all, c_kv[:, :, None, :], kr, slot_mapping, li
+        )
         c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
         kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
-        c_layer, kr_layer = scatter_kv(
-            c_layer, kr_layer, c_kv[:, :, None, :], kr, slot_mapping
-        )
-        c_all = jax.lax.dynamic_update_index_in_dim(c_all, c_layer, li, 0)
-        kr_all = jax.lax.dynamic_update_index_in_dim(kr_all, kr_layer, li, 0)
 
         # absorb W_uk into the query, attend over the latent cache
         q_lat = jnp.einsum("bshn,rhn->bshr", q_nope, lp["w_uk"])

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from ..ops.attention import attention, scatter_kv
+from ..ops.attention import attention, scatter_kv_stacked
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]  # k, v: [L, N_blocks, bs, KVH, D]
@@ -144,15 +144,12 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
-        k_layer = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
-        v_layer = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
-        k_layer, v_layer = scatter_kv(k_layer, v_layer, k, v, slot_mapping)
-        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_layer, li, 0)
-        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_layer, li, 0)
-
+        # in-place scatter into the stacked cache + layer-indexed kernels:
+        # no per-layer cache slice is ever materialized inside the scan
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
         attn = attention(
-            q, k_layer, v_layer, block_tables, positions, context_lens,
-            impl=cfg.attention_impl, mesh=mesh,
+            q, k_all, v_all, block_tables, positions, context_lens,
+            impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
         )
         delta = attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
         return delta, k_all, v_all

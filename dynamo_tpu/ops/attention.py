@@ -37,12 +37,48 @@ def scatter_kv(
     flat_k = k_cache.reshape(n_blocks * block_size, kvh, dk)
     flat_v = v_cache.reshape(n_blocks * block_size, vh, dv)
     idx = slot_mapping.reshape(-1)
+    # jax wraps negative scatter indices (-1 == last slot), so map the drop
+    # sentinel to a genuinely out-of-range index for mode="drop" to act on
+    idx = jnp.where(idx < 0, n_blocks * block_size, idx)
     flat_k = flat_k.at[idx].set(new_k.reshape(-1, kvh, dk), mode="drop")
     flat_v = flat_v.at[idx].set(new_v.reshape(-1, vh, dv), mode="drop")
     return (
         flat_k.reshape(n_blocks, block_size, kvh, dk),
         flat_v.reshape(n_blocks, block_size, vh, dv),
     )
+
+
+def scatter_kv_stacked(
+    k_all: jax.Array,  # [L, N_blocks, block_size, KVH, Dk] (stacked layers)
+    v_all: jax.Array,  # [L, N_blocks, block_size, VH, Dv]
+    new_k: jax.Array,  # [B, S, KVH, Dk]
+    new_v: jax.Array,  # [B, S, VH, Dv]
+    slot_mapping: jax.Array,  # [B, S] flat slot index (block*bs + off); -1 → drop
+    layer_idx: jax.Array,     # scalar int32
+) -> Tuple[jax.Array, jax.Array]:
+    """Write new K/V into one layer of the *stacked* cache, in place.
+
+    The per-layer scan used to slice the layer out (a whole-layer copy),
+    scatter, and splice it back (another copy) — ~0.5 ms/layer of pure
+    HBM traffic on the 1B flagship. Scattering at ``layer*N*bs + slot``
+    into a flat view keeps XLA's in-place scatter on the donated carry.
+    """
+    l, n_blocks, block_size, kvh, dk = k_all.shape
+    vh, dv = v_all.shape[-2:]
+    idx = slot_mapping.reshape(-1)
+    # drop sentinel AND per-layer overflow → past-the-end: a negative index
+    # would wrap (see scatter_kv), and a positive out-of-range one would land
+    # in the next layer's slab after the layer offset — both must drop
+    per_layer = n_blocks * block_size
+    total = l * per_layer
+    flat_idx = jnp.where(
+        (idx < 0) | (idx >= per_layer), total, layer_idx * per_layer + idx
+    )
+    flat_k = k_all.reshape(l * n_blocks * block_size, kvh, dk)
+    flat_v = v_all.reshape(l * n_blocks * block_size, vh, dv)
+    flat_k = flat_k.at[flat_idx].set(new_k.reshape(-1, kvh, dk), mode="drop")
+    flat_v = flat_v.at[flat_idx].set(new_v.reshape(-1, vh, dv), mode="drop")
+    return flat_k.reshape(k_all.shape), flat_v.reshape(v_all.shape)
 
 
 def paged_attention(
@@ -99,7 +135,7 @@ def resolve_attention_impl(impl: str) -> str:
 
 def attention(
     q: jax.Array,            # [B, S, H, D]
-    k_cache: jax.Array,      # [N_blocks, bs, KVH, D]
+    k_cache: jax.Array,      # [N_blocks, bs, KVH, D] or stacked [L, N, bs, KVH, D]
     v_cache: jax.Array,
     block_tables: jax.Array, # [B, W]
     positions: jax.Array,    # [B, S] absolute query positions
@@ -107,42 +143,64 @@ def attention(
     impl: str = "auto",
     mesh=None,
     interpret: bool = False,
+    layer_idx=None,          # required when the cache is stacked (5-D)
 ) -> jax.Array:
-    """Paged-attention dispatch: XLA gather path or the Pallas kernel.
+    """Paged-attention dispatch: XLA gather path or the Pallas kernels.
 
-    The Pallas path assumes affine query positions (positions[:, s] ==
-    positions[:, 0] + s for real tokens) — the scheduler's layout. With a
-    multi-device mesh it runs under shard_map: batch over "dp", KV heads
-    over "tp" (no collectives — attention is head/batch parallel).
+    Accepts the engine's full stacked-by-layer cache plus a runtime
+    ``layer_idx`` — the Pallas kernels index the layer inside HBM, so the
+    per-layer scan never materializes a layer copy. Decode (S == 1) takes
+    the latency-tuned kernel (pallas_decode.py); prefill takes the
+    flash-pipeline kernel (pallas_attention.py), which assumes affine
+    query positions (positions[:, s] == positions[:, 0] + s) — the
+    scheduler's layout. With a multi-device mesh it runs under shard_map:
+    batch over "dp", KV heads over "tp" (no collectives — attention is
+    head/batch parallel).
     """
+    stacked = k_cache.ndim == 5
+    li = jnp.asarray(0 if layer_idx is None else layer_idx, jnp.int32)
     if resolve_attention_impl(impl) == "xla":
+        if stacked:
+            k_cache = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
                                context_lens)
 
     from .pallas_attention import paged_flash_attention
+    from .pallas_decode import paged_decode_attention
 
-    fn = functools.partial(paged_flash_attention, interpret=interpret)
-    base_pos = positions[:, 0].astype(jnp.int32)
+    if not stacked:
+        k_cache, v_cache = k_cache[None], v_cache[None]
+    decode = q.shape[1] == 1
+    if decode:
+        fn = functools.partial(paged_decode_attention, interpret=interpret)
+        args = (q, k_cache, v_cache, block_tables, context_lens, li)
+    else:
+        fn = functools.partial(paged_flash_attention, interpret=interpret)
+        base_pos = positions[:, 0].astype(jnp.int32)
+        args = (q, k_cache, v_cache, block_tables, base_pos, context_lens, li)
     if mesh is not None and mesh.size > 1:
         # batch shards over dp only when divisible — the scheduler prefills
         # with B=1, which each dp group then computes redundantly (decode,
         # where B = max_batch_size, shards)
         dp = "dp" if q.shape[0] % mesh.shape.get("dp", 1) == 0 else None
+        in_specs = [
+            P(dp, None, "tp", None),           # q [B, S, H, D]
+            P(None, None, None, "tp", None),   # k_cache [L, N, bs, KVH, D]
+            P(None, None, None, "tp", None),   # v_cache
+            P(dp, None),                       # block_tables
+        ]
+        if not decode:
+            in_specs.append(P(dp))             # base_pos
+        in_specs.extend([P(dp), P()])          # context_lens, layer_idx
         fn = jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(
-                P(dp, None, "tp", None),     # q [B, S, H, D]
-                P(None, None, "tp", None),   # k_cache
-                P(None, None, "tp", None),   # v_cache
-                P(dp, None),                 # block_tables
-                P(dp),                       # base_pos
-                P(dp),                       # context_lens
-            ),
+            in_specs=tuple(in_specs),
             out_specs=P(dp, None, "tp", None),
             check_vma=False,  # pallas out_shape carries no vma annotation
         )
-    return fn(q, k_cache, v_cache, block_tables, base_pos, context_lens)
+    return fn(*args)
 
 
 def prefill_attention(

@@ -35,8 +35,9 @@ def _kernel(
     bt_ref,     # scalar prefetch: block tables [B, W]
     ctx_ref,    # scalar prefetch: context lens [B]
     base_ref,   # scalar prefetch: base query position [B]
+    li_ref,     # scalar prefetch: layer index [1] (consumed by index_maps)
     q_ref,      # [1, Sc, KVH, G, D] (VMEM block)
-    k_ref,      # [1, bs, KVH, D] — one cache page
+    k_ref,      # [1, 1, bs, KVH, D] — one cache page of one layer
     v_ref,
     o_ref,      # [1, Sc, KVH, G, D]
     m_scr,      # [KVH * Sc * G, 128] f32 running max
@@ -82,8 +83,8 @@ def _kernel(
         for h in range(kvh):
             lo = h * rows
             q = q_ref[0, :, h, :, :].reshape(rows, d)          # [rows, D]
-            k = k_ref[0, :, h, :]                               # [bs, D]
-            v = v_ref[0, :, h, :]
+            k = k_ref[0, 0, :, h, :]                            # [bs, D]
+            v = v_ref[0, 0, :, h, :]
 
             s_log = jax.lax.dot_general(
                 q, k,
@@ -124,17 +125,25 @@ def _kernel(
 )
 def paged_flash_attention(
     q: jax.Array,            # [B, S, H, D] (post-RoPE)
-    k_cache: jax.Array,      # [N_blocks, bs, KVH, D]
+    k_cache: jax.Array,      # [N_blocks, bs, KVH, D] or stacked [L, N, bs, KVH, D]
     v_cache: jax.Array,
     block_tables: jax.Array, # [B, W] int32
     base_pos: jax.Array,     # [B] int32 — absolute position of q[:, 0]
     context_lens: jax.Array, # [B] int32
+    layer_idx=None,          # scalar int32 into L (default 0)
     scale: Optional[float] = None,
     q_chunk: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
     b, s, h, d = q.shape
-    n_blocks, block_size, kvh, _ = k_cache.shape
+    if k_cache.ndim == 4:
+        k_cache, v_cache = k_cache[None], v_cache[None]
+    _, n_blocks, block_size, kvh, _ = k_cache.shape
+    li = (
+        jnp.zeros((1,), jnp.int32)
+        if layer_idx is None
+        else jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    )
     w = block_tables.shape[1]
     g = h // kvh
     if scale is None:
@@ -157,20 +166,20 @@ def paged_flash_attention(
         by_causal = jnp.maximum(base_ref[b_idx] + (c + 1) * sc - 1, 0) // block_size
         return jnp.minimum(by_ctx, by_causal)
 
-    def q_map(i, c, wi, bt, ctx, base):
+    def q_map(i, c, wi, bt, ctx, base, li):
         return (i * num_chunks + c, 0, 0, 0, 0)
 
-    def kv_map(i, c, wi, bt, ctx, base):
+    def kv_map(i, c, wi, bt, ctx, base, li):
         wi = jnp.minimum(wi, last_needed_page(i, c, ctx, base))
-        return (bt[i, wi], 0, 0, 0)
+        return (li[0], bt[i, wi], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b, num_chunks, w),
         in_specs=[
             pl.BlockSpec((1, sc, kvh, g, d), q_map),
-            pl.BlockSpec((1, block_size, kvh, d), kv_map),
-            pl.BlockSpec((1, block_size, kvh, d), kv_map),
+            pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
+            pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
         ],
         out_specs=pl.BlockSpec((1, sc, kvh, g, d), q_map),
         scratch_shapes=[
@@ -192,6 +201,7 @@ def paged_flash_attention(
         block_tables.astype(jnp.int32),
         context_lens.astype(jnp.int32),
         base_pos.astype(jnp.int32),
+        li,
         qg,
         k_cache,
         v_cache,

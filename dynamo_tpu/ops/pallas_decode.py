@@ -1,0 +1,221 @@
+"""Pallas TPU decode-specialized paged attention (S == 1).
+
+Why a second kernel: decode dominates serving time and has a degenerate
+shape — one query token per sequence attending to the whole paged
+context. The general kernel (ops/pallas_attention.py) drives its page
+walk with a grid dimension sized to the block-table *capacity* W, so a
+sequence with 32 live pages still pays W=128 grid steps of machinery per
+layer (profiled at ~0.9 ms/layer on v5e for the 1B flagship — 40x the
+bandwidth bound). Here the page walk is a data-dependent ``fori_loop``
+bounded by ``ceil(context_len / page)`` inside a grid of just B steps:
+work is proportional to *live* context, not capacity.
+
+Mechanics: the paged KV cache stays in HBM (``memory_space=ANY``); the
+kernel pulls pages VMEM-ward itself with double-buffered async copies
+(``pltpu.make_async_copy``) — page indices come from the scalar-prefetched
+block table, so the indirection rides the DMA engine, and compute on
+chunk c overlaps the fetch of chunk c+1. The cache may be the engine's
+full stacked-by-layer array ([L, N, page, KVH, D]); the layer to read is
+a runtime index (``layer_idx``) so the per-layer ``lax.scan`` over the
+transformer trunk needs no per-layer cache slicing (which XLA
+materializes as a copy of the whole layer).
+
+Reference analog: the decode-path paged-attention kernels of the GPU
+engines the reference delegates to (SURVEY.md §2.4); same role as
+vLLM's paged_attention_v2 CUDA kernel, reimagined for the TPU memory
+system (explicit HBM→VMEM pipeline instead of SM shared memory).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -1e30
+
+
+def _decode_kernel(
+    bt_ref,    # scalar prefetch: block tables [B, W] (SMEM)
+    ctx_ref,   # scalar prefetch: context lens [B]
+    li_ref,    # scalar prefetch: layer index [1]
+    q_ref,     # [1, KVH, G, D] VMEM block
+    k_hbm,     # [L, N, page, KVH, D] in HBM (ANY)
+    v_hbm,
+    o_ref,     # [1, KVH, G, D]
+    k_buf,     # VMEM scratch [2, P, page, KVH, D]
+    v_buf,
+    sem,       # DMA semaphores [2]
+    *,
+    scale: float,
+    block_size: int,
+    pages_per_chunk: int,
+):
+    b = pl.program_id(0)
+    ctx = ctx_ref[b]
+    li = li_ref[0]
+    npages = pl.cdiv(ctx, block_size)          # live pages (ctx >= 1 in decode)
+    nchunks = pl.cdiv(npages, pages_per_chunk)
+
+    _, kvh, g, d = q_ref.shape
+    rows = kvh * g
+    chunk_t = pages_per_chunk * block_size
+
+    def page_copy(chunk, slot, i, hbm, buf):
+        # pages past the live range duplicate the last live page — their
+        # key positions land >= ctx and the mask kills them.
+        p = jnp.minimum(chunk * pages_per_chunk + i, npages - 1)
+        return pltpu.make_async_copy(
+            hbm.at[li, bt_ref[b, p]], buf.at[slot, i], sem.at[slot]
+        )
+
+    def start(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, k_hbm, k_buf).start()
+            page_copy(chunk, slot, i, v_hbm, v_buf).start()
+
+    def wait(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, k_hbm, k_buf).wait()
+            page_copy(chunk, slot, i, v_hbm, v_buf).wait()
+
+    start(0, 0)
+    q = q_ref[0].reshape(rows, d)  # [KVH*G, D]
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nchunks)
+        def _prefetch():
+            start(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+        k = k_buf[slot].reshape(chunk_t, kvh, d)
+        v = v_buf[slot].reshape(chunk_t, kvh, d)
+
+        # decode causality: the query is the newest token, so every key
+        # with position < ctx is visible — a pure validity mask.
+        key_pos = c * chunk_t + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_t), 1
+        )
+        valid = key_pos < ctx                             # [1, chunk_t]
+
+        ms, ls, accs = [], [], []
+        for h in range(kvh):
+            s_log = jax.lax.dot_general(
+                q[h * g : (h + 1) * g], k[:, h, :],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                     # [G, chunk_t]
+            s_log = jnp.where(valid, s_log, MASK_VALUE)
+
+            m_h = m[h * g : (h + 1) * g]
+            m_new = jnp.maximum(m_h, jnp.max(s_log, -1, keepdims=True))
+            alpha = jnp.exp(m_h - m_new)
+            p_unn = jnp.exp(s_log - m_new)
+            l_new = alpha * l[h * g : (h + 1) * g] + jnp.sum(
+                p_unn, -1, keepdims=True
+            )
+            pv = jax.lax.dot_general(
+                p_unn.astype(v.dtype), v[:, h, :],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                             # [G, D]
+            ms.append(m_new)
+            ls.append(l_new)
+            accs.append(acc[h * g : (h + 1) * g] * alpha + pv)
+        return (
+            jnp.concatenate(ms, 0),
+            jnp.concatenate(ls, 0),
+            jnp.concatenate(accs, 0),
+        )
+
+    m0 = jnp.full((rows, 1), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((rows, 1), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nchunks, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype).reshape(kvh, g, d)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+)
+def paged_decode_attention(
+    q: jax.Array,            # [B, 1, H, D] (post-RoPE)
+    k_cache: jax.Array,      # [L, N, page, KVH, D] stacked (or [N, page, KVH, D])
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, W] int32
+    context_lens: jax.Array, # [B] int32
+    layer_idx: Optional[jax.Array] = None,  # scalar int32 into L (default 0)
+    scale: Optional[float] = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-token paged attention; returns [B, 1, H, D]."""
+    b, s, h, d = q.shape
+    assert s == 1, "decode kernel is specialized to one query token"
+    if k_cache.ndim == 4:
+        k_cache, v_cache = k_cache[None], v_cache[None]
+    _, _, block_size, kvh, _ = k_cache.shape
+    g = h // kvh
+    if scale is None:
+        scale = d ** -0.5
+    li = (
+        jnp.zeros((1,), jnp.int32)
+        if layer_idx is None
+        else jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    )
+    # fewer in-flight copies than pages in a short context wastes nothing;
+    # more than the table width would index past it
+    pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
+
+    qs = q.reshape(b, kvh, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, kvh, d), k_cache.dtype
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, kvh, d), v_cache.dtype
+            ),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            scale=scale,
+            block_size=block_size,
+            pages_per_chunk=pages_per_chunk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        li,
+        qs,
+        k_cache,
+        v_cache,
+    )
+    return out.reshape(b, 1, h, d)
