@@ -76,9 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def load_mdc(flags):
     from ..llm.model_card import ModelDeploymentCard
+    from ..models.hub import resolve_model_path
 
     if not flags.model_path:
         raise SystemExit("this mode requires --model-path")
+    # accept a HF repo id anywhere a path is accepted (reference:
+    # launch/dynamo-run/src/hub.rs) — local dirs pass through untouched
+    flags.model_path = resolve_model_path(flags.model_path)
     return ModelDeploymentCard.from_local_path(
         flags.model_path, flags.model_name, kv_block_size=flags.kv_block_size
     )

@@ -139,6 +139,10 @@ class EngineConfig:
     ep_size: int = 1
     tp_size: int = 1
     seed: int = 0
+    # serve random-init weights when model_dir has no checkpoint (tests,
+    # topology dry runs); off by default so a misnamed checkpoint dir
+    # fails loudly instead of serving plausible-looking garbage
+    allow_random_weights: bool = False
     # scheduler knobs
     max_prefill_tokens_per_step: int = 8192
     enable_prefix_caching: bool = True

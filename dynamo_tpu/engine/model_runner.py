@@ -83,17 +83,21 @@ class ModelRunner:
 
         if params is None:
             if model_dir is not None:
-                if self.arch is llama:
-                    from ..models.loader import has_checkpoint, load_llama_params
+                from ..models.loader import has_checkpoint, load_checkpoint_params
 
-                    if has_checkpoint(model_dir):
-                        params = load_llama_params(model_dir, cfg, self.dtype)
-                    else:
-                        logger.warning("no checkpoint in %s — random init", model_dir)
+                if has_checkpoint(model_dir):
+                    # raises for architectures without a loader — never
+                    # silently serve random weights against a checkpoint
+                    params = load_checkpoint_params(
+                        model_dir, cfg, self.arch, self.dtype
+                    )
+                elif config.allow_random_weights:
+                    logger.warning("no checkpoint in %s — random init", model_dir)
                 else:
-                    logger.warning(
-                        "no weight loader for %s yet — IGNORING checkpoint %s, "
-                        "serving random init", self.arch.__name__, model_dir,
+                    raise FileNotFoundError(
+                        f"no *.safetensors under {model_dir}; the engine will "
+                        "not silently serve random weights — provide a "
+                        "safetensors checkpoint or set allow_random_weights"
                     )
             if params is None:
                 params = self.arch.init_params(

@@ -160,6 +160,7 @@ def param_specs(params: Params) -> Dict:
     moe_specs = {
         **_MLA_ATTN_SPECS,
         "router": P(),
+        "router_bias": P(),
         "w_gate": P(None, "ep", None, "tp"),
         "w_up": P(None, "ep", None, "tp"),
         "w_down": P(None, "ep", "tp", None),

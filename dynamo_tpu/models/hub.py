@@ -1,0 +1,50 @@
+"""Resolve a model reference (local dir or HF hub id) to a local snapshot.
+
+Reference analog: launch/dynamo-run/src/hub.rs — the reference accepts
+either a filesystem path or a HuggingFace repo id everywhere a model is
+named and downloads the snapshot on demand. Same contract here: local
+paths win; otherwise ``huggingface_hub`` fetches (or reuses its cache —
+``HF_HUB_OFFLINE=1`` serves cache-only, the right mode for air-gapped
+TPU pods).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+# weights + tokenizer + metadata; skip consolidated/original torch bins
+_SNAPSHOT_PATTERNS = [
+    "*.safetensors", "*.json", "*.model", "*.txt", "*.jinja",
+]
+
+
+def resolve_model_path(name_or_path: str, revision: str | None = None) -> str:
+    """Local directory → itself; anything else → HF snapshot download.
+
+    Raises a clear error (rather than a deep stack) when the id is not a
+    directory and the hub is unreachable and the cache has no copy.
+    """
+    if os.path.isdir(name_or_path):
+        return name_or_path
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - hub ships in the image
+        raise FileNotFoundError(
+            f"{name_or_path!r} is not a local directory and huggingface_hub "
+            "is unavailable to fetch it"
+        ) from e
+    try:
+        path = snapshot_download(
+            name_or_path, revision=revision, allow_patterns=_SNAPSHOT_PATTERNS
+        )
+        logger.info("resolved %s -> %s", name_or_path, path)
+        return path
+    except Exception as e:
+        raise FileNotFoundError(
+            f"cannot resolve model {name_or_path!r}: not a local directory, "
+            f"and hub fetch failed ({type(e).__name__}: {e}). For air-gapped "
+            "hosts pre-populate the HF cache and set HF_HUB_OFFLINE=1."
+        ) from e

@@ -22,15 +22,32 @@ logger = logging.getLogger(__name__)
 
 
 def _iter_safetensors(model_dir: str):
+    """Stream (name, np.ndarray) from all shards. Goes through the torch
+    framework because safetensors' numpy framework cannot represent
+    bfloat16 (the dtype real Llama-class checkpoints ship in); bf16 stays
+    2 bytes/element via an ml_dtypes view so staging a large checkpoint
+    doesn't double host RAM."""
+    import ml_dtypes
+    import torch
     from safetensors import safe_open
 
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no .safetensors under {model_dir}")
     for path in files:
-        with safe_open(path, framework="np") as f:
+        with safe_open(path, framework="pt") as f:
             for name in f.keys():
-                yield name, f.get_tensor(name)
+                t = f.get_tensor(name)
+                if t.dtype == torch.bfloat16:
+                    arr = t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+                elif "float8" in str(t.dtype):
+                    raise NotImplementedError(
+                        f"{name} is {t.dtype}: quantized (FP8) checkpoints "
+                        "are not supported — provide a bf16/fp16 export"
+                    )
+                else:
+                    arr = t.numpy()
+                yield name, arr
 
 
 def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
@@ -40,13 +57,6 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
         k: {} for k in ("ln1", "wq", "wk", "wv", "wo", "ln2", "w_gate", "w_up", "w_down")
     }
     top: Dict[str, np.ndarray] = {}
-
-    def to_np(t):
-        if t.dtype == np.dtype("uint16"):  # bfloat16 raw view
-            import jax
-
-            return jnp.asarray(t.view(jnp.bfloat16))
-        return t
 
     mapping = {
         "input_layernorm.weight": ("ln1", False),
@@ -99,6 +109,238 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
         # tied but config didn't say so — fall back to tied
         logger.info("no lm_head tensor; using tied embeddings")
     return params
+
+
+def _stack_group(
+    staging: Dict[str, Dict], n_layers: int, n_experts: int, dtype, label: str
+) -> Dict:
+    """Stack a staged layer group into [L, ...] (or [L, E, ...] for keys
+    indexed by (layer, expert) tuples), validating completeness."""
+    out = {}
+    for key, by_idx in staging.items():
+        per_expert = isinstance(next(iter(by_idx)), tuple)
+        want = n_layers * n_experts if per_expert else n_layers
+        if len(by_idx) != want:
+            raise ValueError(
+                f"incomplete checkpoint: {label}.{key} has "
+                f"{len(by_idx)}/{want} tensors"
+            )
+        if per_expert:
+            arr = np.stack([
+                np.stack([by_idx[(i, j)] for j in range(n_experts)])
+                for i in range(n_layers)
+            ])
+        else:
+            arr = np.stack([by_idx[i] for i in range(n_layers)])
+        out[key] = jnp.asarray(arr, dtype=dtype)
+    return out
+
+
+def load_mixtral_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF Mixtral-style MoE checkpoint → stacked param pytree.
+
+    HF stores one tensor per (layer, expert) projection; the engine wants
+    [L, E, in, out] stacks so the routed-experts einsums (models/mixtral.py
+    moe_mlp) see every expert as one MXU-shaped batched matmul.
+    Reference analog: the reference loads MoE checkpoints through its GPU
+    engines' HF loaders (launch/dynamo-run/src/lib.rs:131).
+    """
+    l, e = cfg.num_layers, cfg.num_experts
+    staging: Dict[str, Dict] = {}
+    top: Dict[str, np.ndarray] = {}
+
+    attn_map = {
+        "input_layernorm.weight": ("ln1", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("ln2", False),
+        "block_sparse_moe.gate.weight": ("router", True),
+    }
+    expert_map = {"w1": "w_gate", "w2": "w_down", "w3": "w_up"}
+
+    for name, tensor in _iter_safetensors(model_dir):
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            top["embed"] = tensor
+        elif name == "norm.weight":
+            top["final_norm"] = tensor
+        elif name == "lm_head.weight":
+            top["lm_head"] = tensor.T
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            idx = int(idx)
+            if rest in attn_map:
+                key, transpose = attn_map[rest]
+                staging.setdefault(key, {})[idx] = (
+                    tensor.T if transpose else tensor
+                )
+            elif rest.startswith("block_sparse_moe.experts."):
+                _, _, ei, proj, _ = rest.split(".")
+                staging.setdefault(expert_map[proj], {})[(idx, int(ei))] = tensor.T
+            else:
+                logger.debug("skipping unmapped tensor %s", name)
+
+    layers = _stack_group(staging, l, e, dtype, "layers")
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    return params
+
+
+def _rope_deinterleave(n: int) -> np.ndarray:
+    """Permutation mapping HF DeepSeek's interleaved rope pairs
+    (x[2j], x[2j+1]) to this repo's half-rotation layout (x[j], x[j+n/2]).
+
+    Folding it into the projection weights makes models/llama.apply_rope
+    numerically exact vs. HF's complex-multiply rope (the permutation is
+    applied to BOTH q_rope and k_rope, so their dot product is invariant).
+    """
+    return np.concatenate([np.arange(0, n, 2), np.arange(1, n, 2)])
+
+
+def load_deepseek_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF DeepSeek-V2/V3 MLA (+ optional MoE) checkpoint → param pytree.
+
+    Layout transforms, all checked against transformers'
+    modeling_deepseek_v2.py semantics:
+    - ``kv_a_proj_with_mqa`` [r+rope, D] splits into ``w_dkv`` [D, r] and the
+      shared rope key projection ``w_kr`` [D, rope];
+    - ``kv_b_proj`` [H*(nope+v), r] splits per head into the absorbed
+      up-projections ``w_uk`` [r, H, nope] / ``w_uv`` [r, H, v];
+    - rope columns of the q projection and ``w_kr`` are de-interleaved
+      (see _rope_deinterleave);
+    - MoE layers restack at ``idx - first_k_dense_replace``; V3's
+      ``e_score_correction_bias`` loads as ``router_bias``.
+    """
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    r, h, vd = cfg.kv_lora_rank, cfg.num_heads, cfg.v_head_dim
+    n_dense = min(cfg.first_k_dense_replace, cfg.num_layers) if cfg.num_experts else cfg.num_layers
+    n_moe = cfg.num_layers - n_dense
+    e = cfg.num_experts
+    perm = _rope_deinterleave(rope)
+
+    # staging[group][key][layer-or-(layer,expert)] where group is
+    # "dense_layers" (first k) or "layers" (MoE tail)
+    staging: Dict[str, Dict[str, Dict]] = {"dense_layers": {}, "layers": {}}
+    top: Dict[str, np.ndarray] = {}
+
+    def put(group: str, key: str, idx, value) -> None:
+        staging[group].setdefault(key, {})[idx] = value
+
+    def q_deinterleave(t: np.ndarray) -> np.ndarray:
+        # t: [in, H*(nope+rope)] — permute each head's rope columns
+        t = t.reshape(t.shape[0], h, nope + rope).copy()
+        t[..., nope:] = t[..., nope + perm]
+        return t.reshape(t.shape[0], -1)
+
+    for name, tensor in _iter_safetensors(model_dir):
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            top["embed"] = tensor
+            continue
+        if name == "norm.weight":
+            top["final_norm"] = tensor
+            continue
+        if name == "lm_head.weight":
+            top["lm_head"] = tensor.T
+            continue
+        if not name.startswith("layers."):
+            continue
+        _, idx, rest = name.split(".", 2)
+        idx = int(idx)
+        group = "dense_layers" if idx < n_dense else "layers"
+        li = idx if idx < n_dense else idx - n_dense
+
+        if rest == "input_layernorm.weight":
+            put(group, "ln1", li, tensor)
+        elif rest == "post_attention_layernorm.weight":
+            put(group, "ln2", li, tensor)
+        elif rest == "self_attn.q_proj.weight":
+            put(group, "wq", li, q_deinterleave(tensor.T))
+        elif rest == "self_attn.q_a_proj.weight":
+            put(group, "w_dq", li, tensor.T)
+        elif rest == "self_attn.q_a_layernorm.weight":
+            put(group, "ln_q", li, tensor)
+        elif rest == "self_attn.q_b_proj.weight":
+            put(group, "w_uq", li, q_deinterleave(tensor.T))
+        elif rest == "self_attn.kv_a_proj_with_mqa.weight":
+            t = tensor.T  # [D, r+rope]
+            put(group, "w_dkv", li, t[:, :r])
+            put(group, "w_kr", li, t[:, r:][:, perm])
+        elif rest == "self_attn.kv_a_layernorm.weight":
+            put(group, "ln_kv", li, tensor)
+        elif rest == "self_attn.kv_b_proj.weight":
+            t = tensor.reshape(h, nope + vd, r)  # [H, nope+v, r]
+            put(group, "w_uk", li, np.transpose(t[:, :nope, :], (2, 0, 1)))
+            put(group, "w_uv", li, np.transpose(t[:, nope:, :], (2, 0, 1)))
+        elif rest == "self_attn.o_proj.weight":
+            put(group, "wo", li, tensor.T)
+        elif rest.startswith("mlp.experts."):
+            _, _, ei, proj, _ = rest.split(".")
+            key = {"gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down"}[proj]
+            put(group, key, (li, int(ei)), tensor.T)
+        elif rest.startswith("mlp.shared_experts."):
+            _, _, proj, _ = rest.split(".")
+            key = {
+                "gate_proj": "w_sh_gate", "up_proj": "w_sh_up",
+                "down_proj": "w_sh_down",
+            }[proj]
+            put(group, key, li, tensor.T)
+        elif rest == "mlp.gate.weight":
+            put(group, "router", li, tensor.T)
+        elif rest == "mlp.gate.e_score_correction_bias":
+            put(group, "router_bias", li, tensor)
+        elif rest in (
+            "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight"
+        ):
+            key = {
+                "mlp.gate_proj.weight": "w_gate",
+                "mlp.up_proj.weight": "w_up",
+                "mlp.down_proj.weight": "w_down",
+            }[rest]
+            put(group, key, li, tensor.T)
+        else:
+            logger.debug("skipping unmapped tensor %s", name)
+
+    params: Dict = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    if n_dense > 0:
+        params["dense_layers"] = _stack_group(
+            staging["dense_layers"], n_dense, 0, dtype, "dense_layers"
+        )
+    if n_moe > 0:
+        params["layers"] = _stack_group(staging["layers"], n_moe, e, dtype, "layers")
+    return params
+
+
+def load_checkpoint_params(model_dir: str, cfg: ModelConfig, arch, dtype=jnp.bfloat16) -> Dict:
+    """Dispatch to the loader for the resolved architecture module.
+
+    Raises (rather than silently serving random weights — a user pointing
+    the engine at a real checkpoint must never get plausible-looking
+    garbage) when no loader exists for the architecture.
+    """
+    name = arch.__name__.rsplit(".", 1)[-1]
+    loaders = {
+        "llama": load_llama_params,
+        "mixtral": load_mixtral_params,
+        "deepseek": load_deepseek_params,
+    }
+    if name not in loaders:
+        raise NotImplementedError(
+            f"no weight loader for architecture {name!r} (checkpoint at {model_dir})"
+        )
+    return loaders[name](model_dir, cfg, dtype)
 
 
 def has_checkpoint(model_dir: str) -> bool:

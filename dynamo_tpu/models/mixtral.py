@@ -65,6 +65,7 @@ def moe_mlp(
     scoring: str = "softmax",           # "softmax" (Mixtral/V2) | "sigmoid" (V3)
     norm_topk: bool = True,             # renormalize top-k gate weights
     routed_scaling: float = 1.0,        # DeepSeek routed_scaling_factor
+    router_bias: Optional[jax.Array] = None,  # [E] V3 e_score_correction_bias
 ) -> jax.Array:
     """Top-k routed SwiGLU experts via dense one-hot dispatch.
 
@@ -85,7 +86,13 @@ def moe_mlp(
         probs = jax.nn.softmax(logits, axis=-1)
     else:
         raise ValueError(f"unknown moe scoring {scoring!r}")
-    gate_vals, gate_idx = lax.top_k(probs, top_k)                        # [T, K]
+    if router_bias is not None:
+        # V3 aux-loss-free balancing: the bias steers expert *selection*
+        # but the combine weights stay the unbiased scores
+        _, gate_idx = lax.top_k(probs + router_bias[None, :], top_k)
+        gate_vals = jnp.take_along_axis(probs, gate_idx, axis=1)
+    else:
+        gate_vals, gate_idx = lax.top_k(probs, top_k)                    # [T, K]
     if norm_topk:
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(axis=-1, keepdims=True), 1e-9
@@ -196,6 +203,7 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
             cfg.num_experts_per_tok, capacity, valid=valid,
             scoring=cfg.moe_scoring_func, norm_topk=cfg.norm_topk_prob,
             routed_scaling=cfg.routed_scaling_factor,
+            router_bias=layer_params.get("router_bias"),
         )
         y = y.reshape(b, s, -1)
         if "w_sh_gate" in layer_params:
