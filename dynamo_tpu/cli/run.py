@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-RAM KV offload tier capacity in blocks (0 = off)")
     p.add_argument("--num-kv-blocks", type=int, default=2048,
                    help="HBM paged-cache capacity in blocks")
+    p.add_argument("--allow-random-weights", action="store_true",
+                   help="serve random-init weights when the model dir has no "
+                        "checkpoint (topology dry runs only)")
     # disaggregated prefill/decode (xPyD)
     p.add_argument("--remote-prefill", action="store_true",
                    help="decode worker: offload long prefills to the prefill queue")
@@ -70,6 +73,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip remote prefill when the queue is this deep")
     p.add_argument("--advertise-host", default="127.0.0.1",
                    help="host other workers use to reach this worker's KV transfer server")
+    # multi-host bring-up (reference MultiNodeConfig {num_nodes, node_rank,
+    # leader_addr}, lib/llm/src/engines.rs:39-57; Ray leader/follower,
+    # lib/engines/vllm0_7/src/ray.rs:66-230 — here JAX's coordinator is the
+    # leader and the mesh spans slices, ICI within / DCN across)
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="hosts in this worker's mesh (multi-host serving)")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this host's rank (0 = leader/coordinator)")
+    p.add_argument("--leader-addr", default="",
+                   help="host:port of node 0's JAX coordinator")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -362,6 +375,17 @@ async def amain(argv: List[str]) -> None:
     src, engine_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
     logging.basicConfig(level=logging.DEBUG if flags.verbose else logging.INFO)
+
+    if flags.num_nodes > 1:
+        # must run before the first jax backend touch in this process so
+        # jax.devices() is already global when the engine builds its mesh
+        from ..parallel.mesh import MultiHostConfig, initialize_multihost
+
+        initialize_multihost(MultiHostConfig(
+            leader_addr=flags.leader_addr,
+            num_nodes=flags.num_nodes,
+            node_rank=flags.node_rank,
+        ))
 
     if src == "prefill":
         await run_prefill(flags)

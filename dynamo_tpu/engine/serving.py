@@ -38,6 +38,7 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
         tp_size=getattr(flags, "tensor_parallel_size", 1),
         host_kv_blocks=getattr(flags, "host_kv_blocks", 0) or 0,
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
+        allow_random_weights=getattr(flags, "allow_random_weights", False),
     )
 
 

@@ -353,11 +353,28 @@ class DynStoreServer:
 
 
 class DynStoreClient(DiscoveryClient, MessagingClient):
-    """One client implementing both planes over a single multiplexed TCP conn."""
+    """One client implementing both planes over a single multiplexed TCP conn.
+
+    Survives broker restarts (reference analog: etcd lease
+    re-establishment, lib/runtime/src/transports/etcd/lease.rs:19-117):
+    on connection loss it reconnects with backoff and restores the whole
+    session — leases are re-granted (their *client-side* ids are stable,
+    so lease-derived endpoint keys/subjects don't change), lease-attached
+    keys are re-put, prefix watches re-arm (emitting synthetic PUT/DELETE
+    events for whatever changed while detached), and subscriptions
+    re-subscribe. In-flight RPCs at the moment of loss still fail; new
+    RPCs block until the session is back (up to ``max_reconnect_wait``).
+
+    Scope note: only *lease-attached* keys are restored — they are this
+    client's ephemeral registrations. Durable unleased KV lives in the
+    broker, which is a single unreplicated process; restart loses it.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
         self.host = host
         self.port = port
+        self.reconnect = True          # False restores fail-fast semantics
+        self.max_reconnect_wait = 30.0  # how long new RPCs wait for a session
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -374,6 +391,11 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
         self._primary_lease: Optional[Lease] = None
         self._closed = False
         self._bg_tasks: set = set()
+        # client-lease-handle -> {"server": server lease id, "ttl": float,
+        # "keys": {key: value}} — everything needed to rebuild the session
+        self._client_leases: Dict[int, Dict] = {}
+        self._connected = asyncio.Event()
+        self._reconnect_task: Optional[asyncio.Task] = None
 
     def _spawn_bg(self, coro) -> None:
         """Fire-and-forget RPC with a strong task reference (GC-safe)."""
@@ -390,12 +412,15 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
     async def connect(self) -> "DynStoreClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._reader_task = asyncio.create_task(self._read_loop())
+        self._connected.set()
         return self
 
     async def close(self) -> None:
         self._closed = True
         for t in self._keepalive_tasks.values():
             t.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._reader_task:
             self._reader_task.cancel()
         if self._writer:
@@ -413,24 +438,109 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
                 fut = self._pending.pop(frame.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result(frame)
-        # connection lost: fail all pending RPCs
+        # connection lost: fail all in-flight RPCs (their responses are gone)
+        self._connected.clear()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("dynstore connection lost"))
         self._pending.clear()
-        for w in self._watchers.values():
-            w.cancel()
-        for s in self._subs.values():
-            s.cancel()
+        if self._closed or not self.reconnect:
+            for w in self._watchers.values():
+                w.cancel()
+            for s in self._subs.values():
+                s.cancel()
+            return
+        if self._reconnect_task is None or self._reconnect_task.done():
+            self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        """Re-dial with exponential backoff, then rebuild the session."""
+        delay = 0.05
+        while not self._closed:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            self._reader_task = asyncio.create_task(self._read_loop())
+            try:
+                await self._restore_session()
+            except (ConnectionError, OSError, RuntimeError, asyncio.TimeoutError) as e:
+                logger.warning("dynstore session restore failed, retrying: %s", e)
+                self._writer.close()
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            self._connected.set()
+            logger.info("dynstore reconnected to %s:%d", self.host, self.port)
+            return
+
+    async def _restore_session(self) -> None:
+        """Re-grant leases, re-put their keys, re-arm watches and subs.
+
+        Watch re-arm reconciles the broker's current state against what the
+        watcher had already seen, emitting synthetic DELETE/PUT events so
+        consumers converge without missing transitions.
+
+        Order matters and mirrors initial bring-up (component.py serve):
+        subscriptions re-arm BEFORE lease keys re-put — the moment another
+        client's watch sees our re-registered endpoint key it may push a
+        request at our subject, which must already have its subscriber."""
+        # the new broker allocates ids from scratch: stale wid/sid state
+        # from the old id space must go first, or a fresh id that collides
+        # with an old one gets evicted/dropped by the stale bookkeeping
+        live_subs = list(self._subs.values())
+        live_watchers = list(self._watchers.values())
+        self._subs.clear()
+        self._watchers.clear()
+        self._early_pushes.clear()
+        self._dead_ids.clear()
+
+        for sub in live_subs:
+            kwargs = {"group": sub._dyn_group} if sub._dyn_group else {}
+            resp = await self._rpc_now("sub", subject=sub._dyn_subject, **kwargs)
+            sub._dyn_sid = resp["sid"]
+            self._subs[resp["sid"]] = sub
+            self._drain_early(resp["sid"])
+        for state in self._client_leases.values():
+            resp = await self._rpc_now("lease_grant", ttl=state["ttl"])
+            state["server"] = resp["lease"]
+            for key, value in state["keys"].items():
+                await self._rpc_now(
+                    "kv_put", key=key, value=value, lease=state["server"]
+                )
+        for watcher in live_watchers:
+            resp = await self._rpc_now("watch", prefix=watcher._dyn_prefix)
+            watcher._dyn_wid = resp["wid"]
+            self._watchers[resp["wid"]] = watcher
+            seen: Dict[str, bytes] = watcher._dyn_seen
+            now_kvs: Dict[str, bytes] = resp["kvs"]
+            for key in [k for k in seen if k not in now_kvs]:
+                watcher._emit(WatchEvent(WatchEventType.DELETE, key, seen.pop(key)))
+            for key, value in now_kvs.items():
+                if seen.get(key) != value:
+                    seen[key] = value
+                    watcher._emit(WatchEvent(WatchEventType.PUT, key, value))
+            self._drain_early(resp["wid"])
 
     def _handle_push(self, frame: dict) -> None:
         kind = frame["push"]
         if kind == "watch":
             watcher = self._watchers.get(frame["wid"])
             if watcher is not None:
-                watcher._emit(
-                    WatchEvent(WatchEventType(frame["type"]), frame["key"], frame["value"])
+                ev = WatchEvent(
+                    WatchEventType(frame["type"]), frame["key"], frame["value"]
                 )
+                # track what the consumer has seen so a reconnect can
+                # reconcile (synthetic events for the detached window)
+                if ev.type is WatchEventType.PUT:
+                    watcher._dyn_seen[ev.key] = ev.value
+                else:
+                    watcher._dyn_seen.pop(ev.key, None)
+                watcher._emit(ev)
             else:
                 self._buffer_early(frame["wid"], frame)
         elif kind == "msg":
@@ -459,7 +569,9 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
         for frame in self._early_pushes.pop(rid, []):
             self._handle_push(frame)
 
-    async def _rpc(self, op: str, rpc_timeout: Optional[float] = 30.0, **kwargs) -> dict:
+    async def _rpc_now(self, op: str, rpc_timeout: Optional[float] = 30.0, **kwargs) -> dict:
+        """Issue an RPC on the current connection (no reconnect gate) —
+        used by session restore, which runs while disconnected-for-users."""
         if self._writer is None:
             raise ConnectionError("client not connected")
         rid = next(self._ids)
@@ -473,37 +585,96 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
             raise RuntimeError(f"dynstore {op} failed: {resp.get('error')}")
         return resp
 
+    async def _rpc(self, op: str, rpc_timeout: Optional[float] = 30.0, **kwargs) -> dict:
+        if not self._connected.is_set() and self.reconnect and not self._closed:
+            try:
+                await asyncio.wait_for(
+                    self._connected.wait(), self.max_reconnect_wait
+                )
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    f"dynstore unreachable for {self.max_reconnect_wait}s"
+                ) from None
+        return await self._rpc_now(op, rpc_timeout, **kwargs)
+
     # --- DiscoveryClient ---
+
+    def _server_lease(self, lease_id: Optional[int]) -> Optional[int]:
+        """Client lease handle → current server lease id. Handles are
+        stable across reconnects (endpoint keys embed them); the server id
+        changes every re-grant."""
+        if lease_id is None:
+            return None
+        state = self._client_leases.get(lease_id)
+        return state["server"] if state else lease_id
 
     async def grant_lease(self, ttl: float = 10.0) -> Lease:
         resp = await self._rpc("lease_grant", ttl=ttl)
-        lease = Lease(id=resp["lease"], ttl=resp["ttl"])
-        self._keepalive_tasks[lease.id] = asyncio.create_task(self._keepalive(lease))
+        handle = next(self._ids)
+        self._client_leases[handle] = {
+            "server": resp["lease"], "ttl": resp["ttl"], "keys": {},
+        }
+        lease = Lease(id=handle, ttl=resp["ttl"])
+        self._keepalive_tasks[handle] = asyncio.create_task(self._keepalive(lease))
         return lease
 
     async def _keepalive(self, lease: Lease) -> None:
-        while not self._closed:
+        while not self._closed and lease.id in self._client_leases:
             await asyncio.sleep(lease.ttl / 3.0)
+            if not self._connected.is_set():
+                # reconnect in progress; restore re-grants the lease
+                await self._connected.wait()
+                continue
             try:
-                resp = await self._rpc("lease_keepalive", lease=lease.id)
+                resp = await self._rpc_now(
+                    "lease_keepalive", lease=self._server_lease(lease.id)
+                )
                 if not resp.get("alive"):
-                    logger.warning("lease %d no longer alive", lease.id)
-                    return
+                    # the broker reaped the lease while the connection
+                    # stayed up (e.g. a >ttl event-loop stall): re-grant it
+                    # and re-put its keys right here — the reconnect path
+                    # only covers connection loss
+                    logger.warning(
+                        "lease %d reaped while connected — re-granting", lease.id
+                    )
+                    state = self._client_leases.get(lease.id)
+                    if state is not None:
+                        g = await self._rpc_now("lease_grant", ttl=state["ttl"])
+                        state["server"] = g["lease"]
+                        for key, value in state["keys"].items():
+                            await self._rpc_now(
+                                "kv_put", key=key, value=value,
+                                lease=state["server"],
+                            )
             except (ConnectionError, RuntimeError, asyncio.TimeoutError):
-                return
+                continue  # the read loop handles the disconnect
 
     async def revoke_lease(self, lease_id: int) -> None:
         task = self._keepalive_tasks.pop(lease_id, None)
         if task:
             task.cancel()
-        await self._rpc("lease_revoke", lease=lease_id)
+        state = self._client_leases.pop(lease_id, None)
+        await self._rpc(
+            "lease_revoke", lease=state["server"] if state else lease_id
+        )
+
+    def _track_lease_key(self, key: str, value: bytes, lease_id: Optional[int]) -> None:
+        if lease_id is not None and lease_id in self._client_leases:
+            self._client_leases[lease_id]["keys"][key] = value
 
     async def kv_create(self, key: str, value: bytes, lease_id: Optional[int] = None) -> bool:
-        resp = await self._rpc("kv_create", key=key, value=value, lease=lease_id)
+        resp = await self._rpc(
+            "kv_create", key=key, value=value, lease=self._server_lease(lease_id)
+        )
+        if resp["created"]:
+            self._track_lease_key(key, value, lease_id)
         return resp["created"]
 
     async def kv_put(self, key: str, value: bytes, lease_id: Optional[int] = None) -> None:
-        await self._rpc("kv_put", key=key, value=value, lease=lease_id)
+        await self._rpc(
+            "kv_put", key=key, value=value, lease=self._server_lease(lease_id)
+        )
+        self._track_lease_key(key, value, lease_id)
 
     async def kv_get(self, key: str) -> Optional[bytes]:
         return (await self._rpc("kv_get", key=key))["value"]
@@ -513,19 +684,25 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
 
     async def kv_delete(self, key: str) -> None:
         await self._rpc("kv_delete", key=key)
+        for state in self._client_leases.values():
+            state["keys"].pop(key, None)
 
     async def watch_prefix(self, prefix: str):
         resp = await self._rpc("watch", prefix=prefix)
         wid = resp["wid"]
 
         def on_cancel():
-            self._watchers.pop(wid, None)
-            self._early_pushes.pop(wid, None)
-            self._dead_ids.add(wid)
+            live_wid = watcher._dyn_wid  # may have been re-armed since
+            self._watchers.pop(live_wid, None)
+            self._early_pushes.pop(live_wid, None)
+            self._dead_ids.add(live_wid)
             if not self._closed:
-                self._spawn_bg(self._rpc("unwatch", wid=wid))
+                self._spawn_bg(self._rpc("unwatch", wid=live_wid))
 
         watcher = PrefixWatcher(on_cancel=on_cancel)
+        watcher._dyn_prefix = prefix
+        watcher._dyn_wid = wid
+        watcher._dyn_seen = dict(resp["kvs"])
         self._watchers[wid] = watcher
         self._drain_early(wid)
         return resp["kvs"], watcher
@@ -535,26 +712,30 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
     async def publish(self, subject: str, payload: bytes) -> None:
         await self._rpc("pub", subject=subject, payload=payload)
 
-    def _make_sub(self, sid: int) -> Subscription:
+    def _make_sub(self, sid: int, subject: str, group: Optional[str]) -> Subscription:
         def on_cancel():
-            self._subs.pop(sid, None)
-            self._early_pushes.pop(sid, None)
-            self._dead_ids.add(sid)
+            live_sid = sub._dyn_sid  # may have been re-armed since
+            self._subs.pop(live_sid, None)
+            self._early_pushes.pop(live_sid, None)
+            self._dead_ids.add(live_sid)
             if not self._closed:
-                self._spawn_bg(self._rpc("unsub", sid=sid))
+                self._spawn_bg(self._rpc("unsub", sid=live_sid))
 
         sub = Subscription(on_cancel=on_cancel)
+        sub._dyn_subject = subject
+        sub._dyn_group = group
+        sub._dyn_sid = sid
         self._subs[sid] = sub
         self._drain_early(sid)
         return sub
 
     async def subscribe(self, subject: str) -> Subscription:
         resp = await self._rpc("sub", subject=subject)
-        return self._make_sub(resp["sid"])
+        return self._make_sub(resp["sid"], subject, None)
 
     async def service_subscribe(self, subject: str, queue_group: str) -> Subscription:
         resp = await self._rpc("sub", subject=subject, group=queue_group)
-        return self._make_sub(resp["sid"])
+        return self._make_sub(resp["sid"], subject, queue_group)
 
     async def request(self, subject: str, payload: bytes, timeout: float = 30.0) -> bytes:
         reply_subject = f"_inbox.{id(self)}.{next(self._ids)}"
@@ -574,13 +755,21 @@ class DynStoreClient(DiscoveryClient, MessagingClient):
     async def queue_pop(
         self, queue: str, timeout: Optional[float] = None, visibility: float = 60.0
     ) -> Optional[WorkItem]:
-        resp = await self._rpc(
-            "queue_pop",
-            rpc_timeout=None if timeout is None else timeout + 5.0,
-            queue=queue,
-            **({"timeout": timeout} if timeout is not None else {}),
-            visibility=visibility,
-        )
+        while True:
+            try:
+                resp = await self._rpc(
+                    "queue_pop",
+                    rpc_timeout=None if timeout is None else timeout + 5.0,
+                    queue=queue,
+                    **({"timeout": timeout} if timeout is not None else {}),
+                    visibility=visibility,
+                )
+                break
+            except ConnectionError:
+                # an indefinitely-blocking pop rides out broker restarts;
+                # timed pops surface the error (callers own the retry)
+                if timeout is not None or self._closed or not self.reconnect:
+                    raise
         if resp["payload"] is None:
             return None
         item_id = resp["item"]

@@ -1,0 +1,98 @@
+"""Two-process multi-host bring-up over localhost (CPU backend).
+
+Each process plays one "node": rank 0 hosts the JAX coordinator
+(the leader, reference MultiNodeConfig leader_addr semantics,
+lib/llm/src/engines.rs:39-57), both join via
+parallel.mesh.initialize_multihost, and together they run ONE jitted
+sharded step over a global 4-device dp x tp mesh — the GPU-free
+equivalent of the reference's Ray leader/follower vLLM bring-up
+(lib/engines/vllm0_7/src/ray.rs:66-230).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from dynamo_tpu.parallel.mesh import MultiHostConfig, initialize_multihost, make_mesh
+
+rank = int(sys.argv[1])
+leader = sys.argv[2]
+initialize_multihost(MultiHostConfig(
+    leader_addr=leader, num_nodes=2, node_rank=rank,
+))
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+devices = jax.devices()
+assert len(devices) == 4, f"global device count {len(devices)}"
+assert jax.process_count() == 2
+
+mesh = make_mesh({"dp": 2, "tp": 2}, devices)
+x_spec = NamedSharding(mesh, P("dp", None))
+w_spec = NamedSharding(mesh, P(None, "tp"))
+
+# one sharded "layer step": batch over dp, features over tp
+xg = np.arange(4 * 8, dtype=np.float32).reshape(4, 8) / 100.0
+wg = np.linspace(-1, 1, 64, dtype=np.float32).reshape(8, 8)
+x = jax.make_array_from_process_local_data(x_spec, xg[rank * 2 : rank * 2 + 2])
+w = jax.device_put(wg, w_spec)
+
+y = jax.jit(lambda x, w: jnp.tanh(x @ w), out_shardings=x_spec)(x, w)
+# this process's devices all sit in one dp row -> every addressable shard
+# holds the same 2 global rows (replicated over local tp)
+want = np.tanh(xg[rank * 2 : rank * 2 + 2] @ wg)
+for s in y.addressable_shards:
+    np.testing.assert_allclose(np.asarray(s.data), want, rtol=1e-4, atol=1e-6)
+print(f"RANK{rank}_OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(300)
+def test_two_process_sharded_step(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    leader = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # drop the TPU site hook; this is a CPU test
+    env["JAX_PLATFORMS"] = "cpu"
+    env["REPO_ROOT"] = repo
+    # each process contributes 2 virtual CPU devices -> 4 global
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(rank), leader],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    assert "RANK0_OK" in outs[0]
+    assert "RANK1_OK" in outs[1]

@@ -244,10 +244,65 @@ async def test_dynstore_conn_drop_expires_lease():
         client = await Client(ep2).start()
         await client.wait_for_instances(1)
 
-        # hard-kill the worker's connection (process death)
+        # hard-kill the worker's connection (process death — disable the
+        # reconnect layer, which would otherwise resurrect the instance)
+        worker_drt.discovery.reconnect = False
         worker_drt.discovery._writer.close()
         await asyncio.sleep(0.3)
         assert len(client.instances) == 0
         await watcher_drt.close()
     finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_dynstore_broker_restart_graph_keeps_serving():
+    """Kill and restart the broker under an active graph: the clients
+    reconnect with backoff, re-grant leases, re-register endpoints, re-arm
+    watches/subscriptions, and requests flow again (reference analog: etcd
+    lease re-establishment, lib/runtime/src/transports/etcd/lease.rs)."""
+    server = DynStoreServer(port=0)
+    await server.start()
+    port = server.port
+    worker_drt = await DistributedRuntime.connect(port=port)
+    client_drt = await DistributedRuntime.connect(port=port)
+    try:
+        ep_w = worker_drt.namespace("prod").component("w").endpoint("gen")
+        serving = await ep_w.serve(echo_handler)
+        ep_c = client_drt.namespace("prod").component("w").endpoint("gen")
+        client = await Client(ep_c).start()
+        await client.wait_for_instances(1)
+
+        sub = await client_drt.messaging.subscribe("events.test")
+
+        out = [t["tok"] async for t in client.generate(Context({"text": "before restart"}))]
+        assert out == ["before", "restart"]
+
+        # broker dies and comes back on the same port
+        await server.stop()
+        await asyncio.sleep(0.2)
+        server = DynStoreServer(port=port)
+        await server.start()
+
+        # worker re-registers under the SAME instance id (stable client
+        # lease handle) and the client's re-armed watch re-discovers it
+        await client.wait_for_instances(1)
+        out = [t["tok"] async for t in client.generate(Context({"text": "after restart"}))]
+        assert out == ["after", "restart"]
+
+        # re-armed subscription still delivers
+        await worker_drt.messaging.publish("events.test", b"again")
+        msg = await asyncio.wait_for(sub.__anext__(), 5.0)
+        assert msg.payload == b"again"
+
+        # work queue usable through the new broker
+        await client_drt.messaging.queue_push("q2", b"job")
+        item = await worker_drt.messaging.queue_pop("q2", timeout=2.0)
+        assert item.payload == b"job"
+        item.ack()
+
+        await serving.stop()
+    finally:
+        await worker_drt.close()
+        await client_drt.close()
         await server.stop()
