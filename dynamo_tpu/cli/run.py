@@ -179,7 +179,7 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         from ..runtime.pipeline import build_pipeline
 
         mdc = load_mdc(flags)
-        tokenizer = HFTokenizer.from_pretrained_dir(flags.model_path)
+        tokenizer = HFTokenizer.from_model_path(flags.model_path)
         core = await build_core_engine(engine_spec, flags, mdc, events, drt=drt)
         return (
             build_pipeline([OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core),

@@ -111,7 +111,7 @@ class Backend(Operator):
 
     @classmethod
     def from_mdc(cls, mdc) -> "Backend":
-        tok = HFTokenizer.from_pretrained_dir(mdc.model_path) if mdc.model_path else None
+        tok = HFTokenizer.from_model_path(mdc.model_path) if mdc.model_path else None
         return cls(tok)
 
     async def generate(

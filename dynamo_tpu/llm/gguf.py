@@ -88,6 +88,12 @@ def _read_string(f: BinaryIO) -> str:
     return data.decode("utf-8", errors="replace")
 
 
+def _remaining(f: BinaryIO) -> int:
+    import os
+
+    return os.fstat(f.fileno()).st_size - f.tell()
+
+
 def _read_value(f: BinaryIO, vtype: int, depth: int = 0) -> Any:
     if vtype in _SCALAR_FMT:
         return _read(f, _SCALAR_FMT[vtype])
@@ -100,6 +106,11 @@ def _read_value(f: BinaryIO, vtype: int, depth: int = 0) -> Any:
             raise GgufError("GGUF array nesting too deep")
         item_type = _read(f, "<I")
         count = _read(f, "<Q")
+        # every element consumes >= 1 byte: a count beyond the remaining
+        # file size is corrupt and would otherwise exhaust memory before
+        # the truncation error fires
+        if count > _remaining(f):
+            raise GgufError(f"implausible GGUF array count {count}")
         return [_read_value(f, item_type, depth + 1) for _ in range(count)]
     raise GgufError(f"unknown GGUF metadata type {vtype}")
 
@@ -116,6 +127,8 @@ def read_gguf(path: str, max_tensors: int = 100_000) -> GgufFile:
         kv_count = _read(f, "<Q")
         if tensor_count > max_tensors:
             raise GgufError(f"implausible tensor count {tensor_count}")
+        if kv_count > _remaining(f):
+            raise GgufError(f"implausible metadata count {kv_count}")
 
         metadata: Dict[str, Any] = {}
         for _ in range(kv_count):
@@ -158,6 +171,81 @@ def model_config_from_gguf(g: GgufFile):
         num_experts=g.arch_key("expert_count", 0) or 0,
         num_experts_per_tok=g.arch_key("expert_used_count", 2) or 2,
     )
+
+
+# GGUF tokenizer token_type values (ggml vocab semantics)
+_TT_NORMAL, _TT_UNKNOWN, _TT_CONTROL = 1, 2, 3
+_TT_USER_DEFINED, _TT_UNUSED, _TT_BYTE = 4, 5, 6
+
+
+def tokenizer_from_gguf(g: GgufFile):
+    """Reconstruct a working tokenizer from GGUF metadata.
+
+    GGUF embeds the full vocab (``tokenizer.ggml.tokens`` + scores/types,
+    merges for BPE) rather than a tokenizer.json; rebuild the equivalent
+    ``tokenizers.Tokenizer`` so a .gguf model can actually tokenize and
+    detokenize (reference: lib/llm/src/gguf/* tokenizer reconstruction).
+
+    - ``tokenizer.ggml.model == "llama"`` → SentencePiece-style Unigram
+      with byte fallback and the ▁ whitespace convention;
+    - ``"gpt2"`` → byte-level BPE from the embedded merges.
+    """
+    from tokenizers import AddedToken, Tokenizer, decoders, normalizers, pre_tokenizers
+    from tokenizers.models import BPE, Unigram
+
+    md = g.metadata
+    tokens = md.get("tokenizer.ggml.tokens")
+    if not tokens:
+        raise GgufError("GGUF carries no tokenizer.ggml.tokens")
+    model_kind = md.get("tokenizer.ggml.model", "llama")
+    types = md.get("tokenizer.ggml.token_type") or [_TT_NORMAL] * len(tokens)
+
+    if model_kind == "gpt2":
+        merges_raw = md.get("tokenizer.ggml.merges") or []
+        merges = [tuple(m.split(" ", 1)) for m in merges_raw if " " in m]
+        vocab = {t: i for i, t in enumerate(tokens)}
+        tok = Tokenizer(BPE(vocab=vocab, merges=merges))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+    elif model_kind == "llama":
+        scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
+        unk_id = md.get("tokenizer.ggml.unknown_token_id")
+        if unk_id is None:
+            unk_id = next(
+                (i for i, t in enumerate(types) if t == _TT_UNKNOWN), 0
+            )
+        vocab = list(zip(tokens, (float(s) for s in scores)))
+        tok = Tokenizer(Unigram(vocab, unk_id=int(unk_id), byte_fallback=True))
+        tok.normalizer = normalizers.Sequence(
+            [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+        )
+        tok.decoder = decoders.Sequence([
+            decoders.Replace("▁", " "),
+            decoders.ByteFallback(),
+            decoders.Fuse(),
+            decoders.Strip(" ", 1, 0),
+        ])
+    else:
+        raise GgufError(f"unsupported GGUF tokenizer model {model_kind!r}")
+
+    specials = [
+        AddedToken(tokens[i], special=True, normalized=False)
+        for i, t in enumerate(types)
+        if t == _TT_CONTROL
+    ]
+    if specials:
+        tok.add_special_tokens(specials)
+    # USER_DEFINED tokens (llama.cpp converters mark SPM added_tokens this
+    # way, e.g. chat markers) must match whole pre-normalization but stay
+    # visible in decode — added, not special
+    user_defined = [
+        AddedToken(tokens[i], special=False, normalized=False)
+        for i, t in enumerate(types)
+        if t == _TT_USER_DEFINED
+    ]
+    if user_defined:
+        tok.add_tokens(user_defined)
+    return tok
 
 
 def mdc_from_gguf(path: str, display_name: Optional[str] = None,

@@ -34,6 +34,8 @@ class ModelDeploymentCard:
     bos_token: Optional[str] = None
     eos_token: Optional[str] = None
     model_type: str = "chat"  # "chat" | "completions" | "both"
+    # how this model emits tool calls (llm/tools.py FORMATS); "auto" probes
+    tool_call_format: Optional[str] = "auto"
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     checksum: Optional[str] = None
 

@@ -86,11 +86,20 @@ class OpenAIPreprocessor(Operator):
     def __init__(self, mdc: ModelDeploymentCard, tokenizer: Optional[HFTokenizer] = None):
         self.mdc = mdc
         self.tokenizer = tokenizer or (
-            HFTokenizer.from_pretrained_dir(mdc.model_path) if mdc.model_path else None
+            HFTokenizer.from_model_path(mdc.model_path) if mdc.model_path else None
         )
         self.formatter = PromptFormatter(
             mdc.chat_template, mdc.bos_token or "", mdc.eos_token or ""
         )
+        # fail at construction, not after a full generation has been spent
+        if mdc.tool_call_format is not None:
+            from .tools import FORMATS
+
+            if mdc.tool_call_format not in FORMATS:
+                raise EngineError(
+                    f"unknown tool_call_format {mdc.tool_call_format!r}; "
+                    f"use one of {FORMATS} or None to disable"
+                )
 
     # ---------- forward: request translation ----------
 
@@ -180,18 +189,36 @@ class OpenAIPreprocessor(Operator):
         backend_stream: AsyncIterator[BackendOutput],
         prompt_tokens: int,
         include_usage: bool = False,
+        tool_format: Optional[str] = None,
     ) -> AsyncIterator[ChatCompletionChunk]:
-        """BackendOutput deltas → OpenAI chat chunks (role chunk first)."""
+        """BackendOutput deltas → OpenAI chat chunks (role chunk first).
+
+        When ``tool_format`` is set (the request carried tools and
+        tool_choice != "none"), content is held back and the finished text
+        is parsed for tool calls (llm/tools.py): a successful parse emits
+        ONE delta carrying ``tool_calls`` with finish_reason="tool_calls"
+        — clients never see the raw call syntax as text; a failed parse
+        flushes the buffered text as ordinary content."""
         yield ChatCompletionChunk(
             id=request_id,
             model=model,
             choices=[ChatStreamChoice(delta=ChatChoiceDelta(role="assistant"))],
         )
         completion_tokens = 0
-        finish: Optional[FinishReason] = None
+        buffered: List[str] = []
+        buffered_lps: List[LogprobEntry] = []
+        last_finish: Optional[str] = None
         async for out in backend_stream:
             completion_tokens = max(completion_tokens, out.cum_tokens)
-            finish = out.finish_reason
+            if tool_format is not None:
+                if out.text:
+                    buffered.append(out.text)
+                lp = self._logprobs(out)
+                if lp and lp.content:
+                    buffered_lps.extend(lp.content)
+                if out.finish_reason:
+                    last_finish = out.finish_reason.to_openai()
+                continue
             if out.text or out.finish_reason:
                 yield ChatCompletionChunk(
                     id=request_id,
@@ -205,6 +232,37 @@ class OpenAIPreprocessor(Operator):
                             logprobs=self._logprobs(out),
                         )
                     ],
+                )
+        if tool_format is not None:
+            from .tools import extract_tool_calls
+
+            text = "".join(buffered)
+            content, calls = extract_tool_calls(text, tool_format)
+            lps = ChoiceLogprobs(content=buffered_lps) if buffered_lps else None
+            if calls:
+                indexed = [{"index": i, **c} for i, c in enumerate(calls)]
+                yield ChatCompletionChunk(
+                    id=request_id,
+                    model=model,
+                    choices=[ChatStreamChoice(
+                        # prose around the call blocks is real content —
+                        # OpenAI responses carry it alongside tool_calls
+                        delta=ChatChoiceDelta(
+                            content=content or None, tool_calls=indexed
+                        ),
+                        finish_reason="tool_calls",
+                        logprobs=lps,
+                    )],
+                )
+            else:
+                yield ChatCompletionChunk(
+                    id=request_id,
+                    model=model,
+                    choices=[ChatStreamChoice(
+                        delta=ChatChoiceDelta(content=text),
+                        finish_reason=last_finish or "stop",
+                        logprobs=lps,
+                    )],
                 )
         if include_usage:
             yield ChatCompletionChunk(
@@ -295,6 +353,11 @@ class OpenAIPreprocessor(Operator):
             request_id = new_request_id("cmpl")
         backend_stream = next_engine.generate(request.map(preprocessed))
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        kwargs = {}
+        # tool_call_format=None on the card disables parsing entirely
+        if (is_chat and req.tools and req.tool_choice != "none"
+                and self.mdc.tool_call_format is not None):
+            kwargs["tool_format"] = self.mdc.tool_call_format
         translate = self.chat_stream if is_chat else self.completion_stream
         async for chunk in translate(
             request_id,
@@ -302,5 +365,6 @@ class OpenAIPreprocessor(Operator):
             backend_stream,
             prompt_tokens=len(preprocessed.token_ids),
             include_usage=include_usage,
+            **kwargs,
         ):
             yield chunk

@@ -81,7 +81,7 @@ def build_processor_pipeline(
 ) -> AsyncEngine:
     """OpenAI-level engine: preprocess → route → worker → detokenize."""
     tokenizer = tokenizer or (
-        HFTokenizer.from_pretrained_dir(mdc.model_path) if mdc.model_path else None
+        HFTokenizer.from_model_path(mdc.model_path) if mdc.model_path else None
     )
     return build_pipeline(
         [OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)],

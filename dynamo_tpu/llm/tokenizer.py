@@ -34,6 +34,16 @@ class HFTokenizer:
             raise FileNotFoundError(f"no tokenizer.json under {model_dir}")
         return cls.from_file(path)
 
+    @classmethod
+    def from_model_path(cls, model_path: str) -> "HFTokenizer":
+        """HF snapshot dir (tokenizer.json) OR a .gguf file (vocab
+        reconstructed from the embedded GGUF metadata, llm/gguf.py)."""
+        if model_path.endswith(".gguf"):
+            from .gguf import read_gguf, tokenizer_from_gguf
+
+            return cls(tokenizer_from_gguf(read_gguf(model_path)))
+        return cls.from_pretrained_dir(model_path)
+
     def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
 

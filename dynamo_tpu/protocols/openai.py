@@ -216,6 +216,7 @@ def aggregate_chat_stream(
     finish: Dict[int, Optional[str]] = {}
     logprobs: Dict[int, List[LogprobEntry]] = {}
     role: Dict[int, str] = {}
+    tool_calls: Dict[int, List[Dict[str, Any]]] = {}
     usage: Optional[Usage] = None
     rid, model, created = "", "", int(time.time())
     for chunk in chunks:
@@ -230,11 +231,17 @@ def aggregate_chat_stream(
                 role[idx] = choice.delta.role
             if choice.delta.content:
                 content.setdefault(idx, []).append(choice.delta.content)
+            if choice.delta.tool_calls:
+                # streamed entries carry a stream "index" key; drop it here
+                tool_calls.setdefault(idx, []).extend(
+                    {k: v for k, v in c.items() if k != "index"}
+                    for c in choice.delta.tool_calls
+                )
             if choice.finish_reason is not None:
                 finish[idx] = choice.finish_reason
             if choice.logprobs and choice.logprobs.content:
                 logprobs.setdefault(idx, []).extend(choice.logprobs.content)
-    indices = sorted(set(content) | set(finish) | set(role)) or [0]
+    indices = sorted(set(content) | set(finish) | set(role) | set(tool_calls)) or [0]
     return ChatCompletionResponse(
         id=rid,
         model=model,
@@ -243,7 +250,10 @@ def aggregate_chat_stream(
             ChatChoice(
                 index=i,
                 message=ChatMessage(
-                    role=role.get(i, "assistant"), content="".join(content.get(i, []))
+                    role=role.get(i, "assistant"),
+                    content="".join(content.get(i, [])) or None
+                    if i in tool_calls else "".join(content.get(i, [])),
+                    tool_calls=tool_calls.get(i),
                 ),
                 finish_reason=finish.get(i),
                 logprobs=ChoiceLogprobs(content=logprobs[i]) if i in logprobs else None,

@@ -125,5 +125,115 @@ def test_truncated_file(tmp_path):
     path = tmp_path / "trunc.gguf"
     path.write_bytes(b"GGUF" + struct.pack("<I", 3) + struct.pack("<Q", 0)
                      + struct.pack("<Q", 5))  # promises 5 kvs, has none
-    with pytest.raises(GgufError, match="truncated"):
+    with pytest.raises(GgufError, match="truncated|implausible"):
+        read_gguf(str(path))
+
+
+# ---------- tokenizer reconstruction (tokenizer.ggml.* -> Tokenizer) ----------
+
+
+def _tok_array(strings):
+    return (
+        struct.pack("<I", T_STRING)
+        + struct.pack("<Q", len(strings))
+        + b"".join(_s(t) for t in strings)
+    )
+
+
+def _i32_array(vals):
+    T_INT32 = 5
+    return (
+        struct.pack("<I", T_INT32)
+        + struct.pack("<Q", len(vals))
+        + b"".join(struct.pack("<i", v) for v in vals)
+    )
+
+
+def _f32_array(vals):
+    return (
+        struct.pack("<I", T_FLOAT32)
+        + struct.pack("<Q", len(vals))
+        + b"".join(struct.pack("<f", v) for v in vals)
+    )
+
+
+def test_gguf_bpe_tokenizer_matches_original(tmp_path):
+    """A byte-level-BPE vocab shipped inside GGUF reconstructs to a
+    tokenizer that encodes identically to the original."""
+    import json as _json
+
+    from fixtures import build_tiny_tokenizer
+
+    from dynamo_tpu.llm.gguf import tokenizer_from_gguf
+    from dynamo_tpu.llm.tokenizer import HFTokenizer
+
+    orig = build_tiny_tokenizer()
+    spec = _json.loads(orig.to_str())
+    vocab = spec["model"]["vocab"]
+    tokens = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    merges = [
+        m if isinstance(m, str) else " ".join(m) for m in spec["model"]["merges"]
+    ]
+    types = [3 if t.startswith("<") and t.endswith(">") else 1 for t in tokens]
+
+    path = tmp_path / "bpe.gguf"
+    write_gguf(path, [
+        _kv("general.architecture", T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.model", T_STRING, _s("gpt2")),
+        _kv("tokenizer.ggml.tokens", T_ARRAY, _tok_array(tokens)),
+        _kv("tokenizer.ggml.merges", T_ARRAY, _tok_array(merges)),
+        _kv("tokenizer.ggml.token_type", T_ARRAY, _i32_array(types)),
+    ])
+
+    rebuilt = tokenizer_from_gguf(read_gguf(str(path)))
+    for text in ("hello world", "the user asks a question", "a b c"):
+        assert rebuilt.encode(text, add_special_tokens=False).ids == \
+            orig.encode(text, add_special_tokens=False).ids
+        assert rebuilt.decode(rebuilt.encode(text).ids) == \
+            orig.decode(orig.encode(text).ids)
+
+    # end-to-end path: HFTokenizer.from_model_path on a .gguf
+    wrapped = HFTokenizer.from_model_path(str(path))
+    assert wrapped.decode(wrapped.encode("hello world")) == orig.decode(
+        orig.encode("hello world", add_special_tokens=False).ids
+    )
+
+
+def test_gguf_unigram_tokenizer_roundtrip(tmp_path):
+    """SentencePiece-style (model='llama') vocab: encode/decode round-trips."""
+    from dynamo_tpu.llm.gguf import tokenizer_from_gguf
+
+    tokens = ["<unk>", "<s>", "</s>", "▁hello", "▁world", "▁",
+              "h", "e", "l", "o", "w", "r", "d"]
+    scores = [0.0, 0.0, 0.0, -1.0, -1.0, -2.0,
+              -5.0, -5.0, -5.0, -5.0, -5.0, -5.0, -5.0]
+    types = [2, 3, 3] + [1] * 10
+
+    path = tmp_path / "spm.gguf"
+    write_gguf(path, [
+        _kv("general.architecture", T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.model", T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.tokens", T_ARRAY, _tok_array(tokens)),
+        _kv("tokenizer.ggml.scores", T_ARRAY, _f32_array(scores)),
+        _kv("tokenizer.ggml.token_type", T_ARRAY, _i32_array(types)),
+        _kv("tokenizer.ggml.unknown_token_id", T_UINT32, struct.pack("<I", 0)),
+    ])
+    tok = tokenizer_from_gguf(read_gguf(str(path)))
+    ids = tok.encode("hello world", add_special_tokens=False).ids
+    assert ids[0] == tokens.index("▁hello")
+    assert ids[1] == tokens.index("▁world")
+    assert tok.decode(ids) == "hello world"
+
+
+def test_gguf_rejects_implausible_array_count(tmp_path):
+    """Corrupt array counts fail fast instead of exhausting memory."""
+    path = tmp_path / "bad.gguf"
+    blob = (
+        _s("tokenizer.ggml.tokens")
+        + struct.pack("<I", T_ARRAY)
+        + struct.pack("<I", T_STRING)
+        + struct.pack("<Q", 1 << 50)   # claims 2^50 elements
+    )
+    write_gguf(path, [blob])
+    with pytest.raises(GgufError, match="implausible"):
         read_gguf(str(path))

@@ -1,0 +1,168 @@
+"""Tool-call parsing → OpenAI tool_calls (llm/tools.py + chat_stream).
+
+Reference analog: lib/llm/src/preprocessor/tools.rs ToolCallingMatcher
+(whole-message JSON); this framework also parses hermes/mistral formats
+and actually wires the result into the delta stream + aggregator, which
+the reference leaves as a TODO (chat_completions/delta.rs:131)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.tools import parse_tool_calls
+from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+from dynamo_tpu.protocols.openai import aggregate_chat_stream
+
+
+def _args(call):
+    return json.loads(call["function"]["arguments"])
+
+
+def test_parse_whole_json_object():
+    calls = parse_tool_calls('{"name": "get_weather", "arguments": {"city": "SF"}}')
+    assert len(calls) == 1
+    assert calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert _args(calls[0]) == {"city": "SF"}
+    assert calls[0]["id"].startswith("call-")
+
+
+def test_parse_json_parameters_key_and_array():
+    calls = parse_tool_calls(
+        '[{"name": "a", "parameters": {"x": 1}}, {"name": "b", "arguments": {}}]'
+    )
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+    assert _args(calls[0]) == {"x": 1}
+
+
+def test_parse_hermes_blocks():
+    text = (
+        'I will check.\n<tool_call>\n{"name": "lookup", "arguments": {"q": "tpu"}}\n'
+        '</tool_call><tool_call>{"name": "sum", "arguments": {"a": 1, "b": 2}}</tool_call>'
+    )
+    calls = parse_tool_calls(text)
+    assert [c["function"]["name"] for c in calls] == ["lookup", "sum"]
+    assert _args(calls[1]) == {"a": 1, "b": 2}
+
+
+def test_parse_mistral_prefix():
+    calls = parse_tool_calls('[TOOL_CALLS] [{"name": "f", "arguments": {"k": "v"}}]')
+    assert calls[0]["function"]["name"] == "f"
+
+
+def test_plain_text_is_not_a_tool_call():
+    assert parse_tool_calls("The weather in SF is sunny.") is None
+    assert parse_tool_calls('{"no_name_key": 1}') is None
+    assert parse_tool_calls("<tool_call>not json</tool_call>") is None
+
+
+def test_explicit_format_rejects_others():
+    assert parse_tool_calls('{"name": "f", "arguments": {}}', fmt="hermes") is None
+    with pytest.raises(ValueError):
+        parse_tool_calls("x", fmt="nope")
+
+
+# ---------- chat_stream integration ----------
+
+
+async def _fake_backend(texts, finish=FinishReason.STOP):
+    async def gen():
+        for i, t in enumerate(texts):
+            yield BackendOutput(
+                text=t,
+                token_ids=[i],
+                cum_tokens=i + 1,
+                finish_reason=finish if i == len(texts) - 1 else None,
+            )
+    return gen()
+
+
+def _mk_preprocessor():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+
+    mdc = ModelDeploymentCard(display_name="t", slug="t", model_path=None)
+
+    class _NullTok:
+        def id_to_token(self, i):
+            return str(i)
+
+    return OpenAIPreprocessor(mdc, tokenizer=_NullTok())
+
+
+@pytest.mark.asyncio
+async def test_chat_stream_emits_tool_call_delta():
+    pre = _mk_preprocessor()
+    stream = await _fake_backend(
+        ['{"name": "get_w', 'eather", "arguments": {"city": "SF"}}']
+    )
+    chunks = [
+        c async for c in pre.chat_stream(
+            "id1", "m", stream, prompt_tokens=3, tool_format="auto"
+        )
+    ]
+    # role chunk + tool_calls chunk; the raw JSON text is never streamed
+    assert all(not c.choices or not c.choices[0].delta.content for c in chunks)
+    final = chunks[-1]
+    assert final.choices[0].finish_reason == "tool_calls"
+    tc = final.choices[0].delta.tool_calls
+    assert tc[0]["function"]["name"] == "get_weather"
+    assert tc[0]["index"] == 0
+
+    resp = aggregate_chat_stream(chunks)
+    assert resp.choices[0].finish_reason == "tool_calls"
+    assert resp.choices[0].message.tool_calls[0]["function"]["name"] == "get_weather"
+    assert "index" not in resp.choices[0].message.tool_calls[0]
+
+
+@pytest.mark.asyncio
+async def test_chat_stream_flushes_text_when_not_a_tool_call():
+    pre = _mk_preprocessor()
+    stream = await _fake_backend(["It is ", "sunny."])
+    chunks = [
+        c async for c in pre.chat_stream(
+            "id2", "m", stream, prompt_tokens=3, tool_format="auto"
+        )
+    ]
+    final = chunks[-1]
+    assert final.choices[0].delta.content == "It is sunny."
+    assert final.choices[0].finish_reason == "stop"
+    resp = aggregate_chat_stream(chunks)
+    assert resp.choices[0].message.content == "It is sunny."
+    assert resp.choices[0].message.tool_calls is None
+
+
+@pytest.mark.asyncio
+async def test_chat_stream_without_tools_streams_normally():
+    pre = _mk_preprocessor()
+    stream = await _fake_backend(["a", "b"])
+    chunks = [
+        c async for c in pre.chat_stream("id3", "m", stream, prompt_tokens=1)
+    ]
+    texts = [c.choices[0].delta.content for c in chunks if c.choices and c.choices[0].delta.content]
+    assert texts == ["a", "b"]
+
+
+def test_extract_preserves_surrounding_content():
+    from dynamo_tpu.llm.tools import extract_tool_calls
+
+    content, calls = extract_tool_calls(
+        'Let me check.\n<tool_call>{"name": "f", "arguments": {}}</tool_call>'
+    )
+    assert content == "Let me check."
+    assert calls[0]["function"]["name"] == "f"
+    content, calls = extract_tool_calls("plain text")
+    assert content == "plain text" and calls is None
+
+
+def test_bad_tool_format_rejected_at_construction():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.runtime.engine import EngineError
+
+    mdc = ModelDeploymentCard(
+        display_name="t", slug="t", tool_call_format="llama9"
+    )
+    with pytest.raises(EngineError, match="tool_call_format"):
+        OpenAIPreprocessor(mdc, tokenizer=object())
