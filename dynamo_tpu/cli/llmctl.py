@@ -98,7 +98,8 @@ async def amain(argv: List[str]) -> int:
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.WARNING)
+    from ..utils.logging import setup_logging
+    setup_logging(logging.WARNING)
     raise SystemExit(asyncio.run(amain(sys.argv[1:])))
 
 

@@ -213,8 +213,38 @@ async def run_http(flags, engine, mdc) -> None:
 
     await service.start()
     print(f"listening on http://{flags.http_host}:{service.port}", flush=True)
+    # SIGTERM drains in-flight requests for up to the configured grace
+    # period (reference WorkerConfig.graceful_shutdown_timeout, DYN_WORKER_
+    # env) instead of dropping streams mid-token
+    import signal
+
+    from ..utils.config import RuntimeSettings
+
+    settings = RuntimeSettings.from_settings()
+    stop_event = asyncio.Event()
+    force_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def _on_signal():
+        # first signal: drain; second: skip the drain and exit now
+        if stop_event.is_set():
+            force_event.set()
+        stop_event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _on_signal)
+        except (NotImplementedError, RuntimeError):
+            pass
     try:
-        await asyncio.Event().wait()
+        await stop_event.wait()
+        # stop accepting first — otherwise new requests keep arriving and
+        # the drain below can never converge under steady traffic
+        await service.stop_accepting()
+        deadline = loop.time() + settings.graceful_shutdown_timeout
+        while (service.metrics.inflight_total() > 0
+               and loop.time() < deadline and not force_event.is_set()):
+            await asyncio.sleep(0.1)
     finally:
         if watcher:
             await watcher.stop()
@@ -374,7 +404,8 @@ async def run_prefill(flags) -> None:
 async def amain(argv: List[str]) -> None:
     src, engine_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
-    logging.basicConfig(level=logging.DEBUG if flags.verbose else logging.INFO)
+    from ..utils.logging import setup_logging
+    setup_logging(logging.DEBUG if flags.verbose else logging.INFO)
 
     if flags.num_nodes > 1:
         # must run before the first jax backend touch in this process so

@@ -112,6 +112,10 @@ class ServiceMetrics:
     def register(self, metric) -> None:
         self._extra.append(metric)
 
+    def inflight_total(self) -> float:
+        """Sum of in-flight requests across models (graceful-drain gate)."""
+        return sum(self.inflight.values.values())
+
     def render(self) -> str:
         lines: List[str] = []
         for m in (self.requests_total, self.inflight, self.duration, self.ttft, *self._extra):

@@ -21,6 +21,7 @@ import msgpack
 from aiohttp import web
 
 from ..protocols import sse
+from ..utils.logging import stage_summary
 from ..protocols.openai import (
     ChatCompletionChunk,
     ChatCompletionRequest,
@@ -83,17 +84,25 @@ class HttpService:
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/health", self.handle_health)
         self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
 
     # ---------- lifecycle ----------
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
         if self.port == 0:
             self.port = self._runner.addresses[0][1]
         logger.info("http service on %s:%d", self.host, self.port)
+
+    async def stop_accepting(self) -> None:
+        """Close the listening socket but keep in-flight connections alive
+        (the first phase of graceful shutdown: drain without accepting)."""
+        if self._site is not None:
+            await self._site.stop()
+            self._site = None
 
     async def stop(self) -> None:
         if self._runner is not None:
@@ -125,6 +134,7 @@ class HttpService:
         timer = self.metrics.track(api_req.model)
         status = "error"
         ctx = Context(api_req)
+        ctx.add_stage("http")
         try:
             stream = engine.generate(ctx).__aiter__()
             # prime the first chunk BEFORE committing a status line so
@@ -162,6 +172,13 @@ class HttpService:
         finally:
             ctx.context.stop_generating()
             timer.finish(status)
+            if ctx.stages and logger.isEnabledFor(logging.DEBUG):
+                logger.debug(
+                    "request %s %s: %s",
+                    ctx.id, status, stage_summary(ctx.stages),
+                    extra={"request_id": ctx.id,
+                           "stages": [s for s, _ in ctx.stages]},
+                )
 
     async def _stream_sse(
         self,

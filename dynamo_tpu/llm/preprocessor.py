@@ -345,12 +345,14 @@ class OpenAIPreprocessor(Operator):
     ) -> AsyncIterator[Any]:
         req = request.payload
         is_chat = isinstance(req, ChatCompletionRequest)
+        request.add_stage("preprocess")
         if is_chat:
             preprocessed = self.preprocess_chat(req)
             request_id = new_request_id()
         else:
             preprocessed = self.preprocess_completion(req)
             request_id = new_request_id("cmpl")
+        request.add_stage("generate")
         backend_stream = next_engine.generate(request.map(preprocessed))
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         kwargs = {}

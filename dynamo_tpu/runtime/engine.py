@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import time
 import uuid
 from typing import Any, AsyncIterator, Dict, Generic, Optional, TypeVar
 
@@ -71,6 +72,18 @@ class Context(Generic[T]):
     @property
     def id(self) -> str:
         return self.context.id
+
+    def add_stage(self, name: str) -> None:
+        """Record a processing stage + monotonic timestamp on the request
+        (reference: pipeline/context.rs:125 add_stage). Stages survive
+        ``map`` because they live in the baggage; the frontend logs the
+        per-stage latency breakdown at completion
+        (utils/logging.py stage_summary)."""
+        self.baggage.setdefault("stages", []).append((name, time.monotonic()))
+
+    @property
+    def stages(self):
+        return self.baggage.get("stages", [])
 
     def map(self, new_payload: Any) -> "Context[Any]":
         """New payload, same identity/control/baggage."""

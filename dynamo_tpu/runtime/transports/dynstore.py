@@ -789,7 +789,8 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
-    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    from ...utils.logging import setup_logging
+    setup_logging(logging.DEBUG if args.verbose else logging.INFO)
     server = DynStoreServer(args.host, args.port)
     asyncio.run(server.serve_forever())
 

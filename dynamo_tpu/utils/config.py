@@ -1,0 +1,109 @@
+"""Layered runtime configuration: defaults → TOML files → DYN_* env.
+
+Reference analog: lib/runtime/src/config.rs:26-176 — Figment layering of
+``Serialized::defaults`` / ``/opt/dynamo/{defaults,etc}/runtime.toml`` /
+``Env::prefixed("DYN_RUNTIME_")`` with empty-env filtering. Same
+precedence here (env on top), dataclass-typed, stdlib ``tomllib``.
+
+Usage:
+    @dataclasses.dataclass
+    class MyConfig:
+        num_workers: int = 16
+
+    cfg = from_settings(MyConfig, "DYN_RUNTIME_")
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import tomllib
+from typing import List, Optional, Sequence, Type, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# same search order as the reference's figment(): defaults file then the
+# site file; later layers win
+DEFAULT_CONFIG_FILES = (
+    "/opt/dynamo/defaults/runtime.toml",
+    "/opt/dynamo/etc/runtime.toml",
+)
+CONFIG_PATH_ENV = "DYN_CONFIG_PATH"  # extra TOML, highest file layer
+
+
+def _coerce(raw: str, field_type) -> object:
+    """Env strings → the dataclass field's type. ``field_type`` may be a
+    string (PEP 563 postponed annotations) or an actual type."""
+    t = field_type if isinstance(field_type, str) else str(field_type)
+    if "bool" in t:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if "int" in t:
+        return int(raw)
+    if "float" in t:
+        return float(raw)
+    if "ist[" in t or t == "list":  # List[...] / list[...]
+        return json.loads(raw)
+    return raw
+
+
+def from_settings(
+    cls: Type[T],
+    env_prefix: str,
+    config_files: Sequence[str] = DEFAULT_CONFIG_FILES,
+    section: Optional[str] = None,
+) -> T:
+    """Build ``cls`` from defaults, TOML layers, then ``{env_prefix}FIELD``
+    env vars (empty env values are ignored, like the reference's
+    filter_map). Unknown TOML keys are ignored with a debug log; bad env
+    values raise — misconfiguration should fail at startup, loudly."""
+    values = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+
+    paths = list(config_files)
+    extra = os.environ.get(CONFIG_PATH_ENV)
+    if extra:
+        paths.append(extra)
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        if section is not None:
+            data = data.get(section, {})
+        for key, value in data.items():
+            if key in fields:
+                values[key] = value
+            else:
+                logger.debug("ignoring unknown config key %s in %s", key, path)
+
+    for name, field in fields.items():
+        raw = os.environ.get(f"{env_prefix}{name.upper()}")
+        if raw:  # empty env vars are treated as unset (reference semantics)
+            values[name] = _coerce(raw, field.type)
+    return cls(**values)
+
+
+@dataclasses.dataclass
+class RuntimeSettings:
+    """Worker-process runtime knobs (reference RuntimeConfig/WorkerConfig).
+
+    ``DYN_RUNTIME_NUM_WORKER_THREADS`` sizes the blocking-work executor
+    (the asyncio analog of the reference's tokio worker threads);
+    ``DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT`` bounds HTTP drain on SIGTERM.
+    """
+
+    num_worker_threads: int = 16
+    graceful_shutdown_timeout: float = 30.0
+
+    @classmethod
+    def from_settings(cls) -> "RuntimeSettings":
+        base = from_settings(cls, "DYN_RUNTIME_", section="runtime")
+        # the reference reads the shutdown timeout under DYN_WORKER_
+        raw = os.environ.get("DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT")
+        if raw:
+            base.graceful_shutdown_timeout = float(raw)
+        return base

@@ -1,0 +1,113 @@
+"""Env/TOML layered config + structured logging (utils/config, utils/logging).
+
+Reference analog: lib/runtime/src/config.rs (Figment layering with
+DYN_* env on top, empty vars ignored) and logging.rs (DYN_LOG filters,
+DYN_LOGGING_JSONL)."""
+
+import dataclasses
+import io
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.utils.config import RuntimeSettings, from_settings
+from dynamo_tpu.utils.logging import (
+    JsonlFormatter,
+    parse_filter,
+    setup_logging,
+    stage_summary,
+)
+
+
+@dataclasses.dataclass
+class _Cfg:
+    workers: int = 4
+    rate: float = 1.5
+    debug: bool = False
+    name: str = "default"
+
+
+def test_defaults_when_nothing_set(tmp_path):
+    cfg = from_settings(_Cfg, "TEST_X_", config_files=())
+    assert cfg == _Cfg()
+
+
+def test_toml_layer_then_env_wins(tmp_path, monkeypatch):
+    toml = tmp_path / "conf.toml"
+    toml.write_text('workers = 8\nname = "from-toml"\nunknown_key = 1\n')
+    cfg = from_settings(_Cfg, "TEST_X_", config_files=(str(toml),))
+    assert cfg.workers == 8 and cfg.name == "from-toml"
+
+    monkeypatch.setenv("TEST_X_WORKERS", "16")
+    monkeypatch.setenv("TEST_X_DEBUG", "true")
+    monkeypatch.setenv("TEST_X_RATE", "2.25")
+    monkeypatch.setenv("TEST_X_NAME", "")  # empty == unset (reference semantics)
+    cfg = from_settings(_Cfg, "TEST_X_", config_files=(str(toml),))
+    assert cfg.workers == 16
+    assert cfg.debug is True
+    assert cfg.rate == 2.25
+    assert cfg.name == "from-toml"
+
+
+def test_dyn_config_path_env(tmp_path, monkeypatch):
+    toml = tmp_path / "site.toml"
+    toml.write_text("workers = 32\n")
+    monkeypatch.setenv("DYN_CONFIG_PATH", str(toml))
+    cfg = from_settings(_Cfg, "TEST_X_", config_files=())
+    assert cfg.workers == 32
+
+
+def test_runtime_settings_env(monkeypatch):
+    monkeypatch.setenv("DYN_RUNTIME_NUM_WORKER_THREADS", "3")
+    monkeypatch.setenv("DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "7.5")
+    s = RuntimeSettings.from_settings()
+    assert s.num_worker_threads == 3
+    assert s.graceful_shutdown_timeout == 7.5
+
+
+def test_parse_filter_spec():
+    root, per = parse_filter("warn,dynamo_tpu.engine=debug,aiohttp=error")
+    assert root == logging.WARNING
+    assert per == {"dynamo_tpu.engine": logging.DEBUG, "aiohttp": logging.ERROR}
+
+
+def test_setup_logging_jsonl(monkeypatch):
+    monkeypatch.setenv("DYN_LOGGING_JSONL", "1")
+    monkeypatch.setenv("DYN_LOG", "info,quiet.mod=error")
+    buf = io.StringIO()
+    setup_logging(stream=buf)
+    try:
+        logging.getLogger("test.target").info(
+            "hello %s", "world", extra={"request_id": "r1"}
+        )
+        logging.getLogger("quiet.mod").info("suppressed")
+        lines = [l for l in buf.getvalue().splitlines() if l]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["level"] == "INFO"
+        assert rec["target"] == "test.target"
+        assert rec["message"] == "hello world"
+        assert rec["request_id"] == "r1"
+        assert "time" in rec
+    finally:
+        logging.getLogger().handlers[:] = []
+        logging.getLogger("quiet.mod").setLevel(logging.NOTSET)
+
+
+def test_stage_summary():
+    stages = [("http", 1.0), ("preprocess", 1.010), ("generate", 1.025)]
+    s = stage_summary(stages)
+    assert s.startswith("http=10.0ms preprocess=15.0ms generate=")
+    assert stage_summary([]) == ""
+
+
+def test_context_add_stage():
+    from dynamo_tpu.runtime.engine import Context
+
+    ctx = Context({"x": 1})
+    ctx.add_stage("http")
+    mapped = ctx.map({"y": 2})
+    mapped.add_stage("preprocess")
+    # stages survive map() — shared baggage
+    assert [s for s, _ in ctx.stages] == ["http", "preprocess"]
