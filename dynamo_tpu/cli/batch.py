@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import time
 
+from ..protocols.annotated import Annotated
 from ..protocols.openai import ChatCompletionRequest
 from ..runtime.engine import Context
 
@@ -31,6 +32,8 @@ async def run_batch(flags, engine, mdc, path: str) -> None:
         first = None
         parts = []
         async for chunk in engine.generate(Context(req)):
+            if Annotated.maybe_from_wire(chunk) is not None:
+                continue  # annotation envelopes carry no completion text
             d = chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
             for choice in d.get("choices", []):
                 content = (choice.get("delta") or {}).get("content")

@@ -268,6 +268,10 @@ async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
             model=name, messages=[{"role": "user", "content": line}], stream=True
         )
         async for chunk in engine.generate(Context(req)):
+            from ..protocols.annotated import Annotated
+
+            if Annotated.maybe_from_wire(chunk) is not None:
+                continue  # annotation envelopes carry no printable text
             d = chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
             for choice in d.get("choices", []):
                 content = (choice.get("delta") or {}).get("content")
@@ -300,11 +304,15 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
 
     def make_openai_handler(engine):
         async def handler(payload, ctx):
+            from ..protocols.annotated import Annotated
             from ..protocols.openai import ChatCompletionRequest, CompletionRequest
 
             cls = ChatCompletionRequest if "messages" in payload else CompletionRequest
             async for chunk in engine.generate(Context(cls.model_validate(payload), ctx)):
-                yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
+                if isinstance(chunk, Annotated):
+                    yield chunk.to_wire()
+                else:
+                    yield chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)
 
         return handler
 

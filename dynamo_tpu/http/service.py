@@ -21,6 +21,7 @@ import msgpack
 from aiohttp import web
 
 from ..protocols import sse
+from ..protocols.annotated import Annotated
 from ..utils.logging import stage_summary
 from ..protocols.openai import (
     ChatCompletionChunk,
@@ -148,9 +149,16 @@ class HttpService:
                 resp, status = await self._stream_sse(request, ctx, first, stream, timer)
                 return resp
             chunks = []
-            if first is not None:
+            if first is not None and Annotated.maybe_from_wire(first) is None:
                 chunks.append(chunk_cls.model_validate(_as_dict(first)))
             async for chunk in stream:
+                ann = Annotated.maybe_from_wire(chunk)
+                if ann is not None:
+                    if ann.is_error:  # a swallowed error must not look ok
+                        raise EngineError(
+                            ann.comment[0] if ann.comment else "engine error"
+                        )
+                    continue  # annotations are stream-only side channel
                 if _has_payload(_as_dict(chunk)):
                     timer.first_token()
                 chunks.append(chunk_cls.model_validate(_as_dict(chunk)))
@@ -196,17 +204,27 @@ class HttpService:
             }
         )
         await resp.prepare(request)
+
+        async def _write(chunk) -> None:
+            ann = Annotated.maybe_from_wire(chunk)
+            if ann is not None:
+                # annotation events ride SSE event/comment lines with no
+                # data payload (reference annotated.rs wire mapping)
+                await resp.write(sse.encode_event(
+                    None, event=ann.event,
+                    comment=ann.comment[0] if ann.comment else None,
+                ))
+                return
+            d = _as_dict(chunk)
+            if _has_payload(d):
+                timer.first_token()
+            await resp.write(sse.encode_event(d))
+
         try:
             if first is not None:
-                d = _as_dict(first)
-                if _has_payload(d):
-                    timer.first_token()
-                await resp.write(sse.encode_event(d))
+                await _write(first)
             async for chunk in chunks:
-                d = _as_dict(chunk)
-                if _has_payload(d):
-                    timer.first_token()
-                await resp.write(sse.encode_event(d))
+                await _write(chunk)
             await resp.write(sse.encode_done())
             await resp.write_eof()
             return resp, "success"

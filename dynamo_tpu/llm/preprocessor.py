@@ -17,6 +17,11 @@ from typing import Any, AsyncIterator, List, Optional, Union
 
 import jinja2
 
+from ..protocols.annotated import (
+    ANNOTATION_FORMATTED_PROMPT,
+    ANNOTATION_TOKEN_IDS,
+    Annotated,
+)
 from ..protocols.common import (
     BackendOutput,
     FinishReason,
@@ -178,6 +183,15 @@ class OpenAIPreprocessor(Operator):
             mdc_checksum=self.mdc.checksum,
             annotations=list((req.nvext and req.nvext.annotations) or []),
         )
+        # side-channel payloads for requested annotations (not wire fields;
+        # generate() turns them into Annotated events ahead of the stream —
+        # reference preprocessor.rs:134-160 formatted_prompt/token_ids)
+        values = {}
+        if ANNOTATION_FORMATTED_PROMPT in out.annotations and prompt_text is not None:
+            values[ANNOTATION_FORMATTED_PROMPT] = prompt_text
+        if ANNOTATION_TOKEN_IDS in out.annotations:
+            values[ANNOTATION_TOKEN_IDS] = list(token_ids)
+        out._annotation_values = values
         return out
 
     # ---------- backward: response translation ----------
@@ -352,6 +366,9 @@ class OpenAIPreprocessor(Operator):
         else:
             preprocessed = self.preprocess_completion(req)
             request_id = new_request_id("cmpl")
+        # requested annotations stream ahead of the data as named events
+        for name, value in getattr(preprocessed, "_annotation_values", {}).items():
+            yield Annotated.from_annotation(name, value)
         request.add_stage("generate")
         backend_stream = next_engine.generate(request.map(preprocessed))
         include_usage = bool(req.stream_options and req.stream_options.include_usage)

@@ -183,3 +183,50 @@ async def test_pipeline_respects_max_tokens(mdc, tokenizer):
     chunks = [c async for c in engine.generate(Context(req2))]
     usage = [c for c in chunks if c.usage is not None]
     assert usage and usage[-1].usage.completion_tokens == 3
+
+
+def test_annotated_envelope_roundtrip():
+    from dynamo_tpu.protocols.annotated import Annotated
+
+    a = Annotated.from_annotation("token_ids", [1, 2, 3])
+    assert a.is_annotation and not a.is_error
+    assert a.annotation_value() == [1, 2, 3]
+    wire = a.to_wire()
+    back = Annotated.maybe_from_wire(wire)
+    assert back.event == "token_ids" and back.annotation_value() == [1, 2, 3]
+    assert Annotated.maybe_from_wire({"choices": []}) is None
+    err = Annotated.from_error("boom")
+    assert err.is_error and not err.is_annotation
+
+
+@pytest.mark.asyncio
+async def test_preprocessor_emits_requested_annotations(mdc, tokenizer):
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols.annotated import Annotated
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+    class _NullEngine(AsyncEngine):
+        async def generate(self, request):
+            from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+
+            yield BackendOutput(
+                text="ok", token_ids=[5], cum_tokens=1,
+                finish_reason=FinishReason.STOP,
+            )
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[{"role": "user", "content": "hello"}],
+        nvext={"annotations": ["formatted_prompt", "token_ids"]},
+    )
+
+    chunks = [c async for c in pre.generate(Context(req), _NullEngine())]
+    anns = [c for c in chunks if isinstance(c, Annotated)]
+    assert {a.event for a in anns} == {"formatted_prompt", "token_ids"}
+    by_name = {a.event: a.annotation_value() for a in anns}
+    assert "hello" in by_name["formatted_prompt"]
+    assert isinstance(by_name["token_ids"], list) and by_name["token_ids"]
+    # annotations precede the data chunks
+    assert isinstance(chunks[0], Annotated)
