@@ -252,6 +252,7 @@ async def run_http(flags, engine, mdc) -> None:
 
 
 async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
+    from ..protocols.annotated import Annotated
     from ..protocols.openai import ChatCompletionRequest
     from ..runtime.engine import Context
 
@@ -268,8 +269,6 @@ async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
             model=name, messages=[{"role": "user", "content": line}], stream=True
         )
         async for chunk in engine.generate(Context(req)):
-            from ..protocols.annotated import Annotated
-
             if Annotated.maybe_from_wire(chunk) is not None:
                 continue  # annotation envelopes carry no printable text
             d = chunk if isinstance(chunk, dict) else chunk.model_dump(exclude_none=True)

@@ -148,16 +148,21 @@ class HttpService:
             if api_req.stream:
                 resp, status = await self._stream_sse(request, ctx, first, stream, timer)
                 return resp
+            def _check_annotated(chunk):
+                """None for data chunks; the envelope for annotations.
+                Error envelopes raise — a swallowed error must not look ok."""
+                ann = Annotated.maybe_from_wire(chunk)
+                if ann is not None and ann.is_error:
+                    raise EngineError(
+                        ann.comment[0] if ann.comment else "engine error"
+                    )
+                return ann
+
             chunks = []
-            if first is not None and Annotated.maybe_from_wire(first) is None:
+            if first is not None and _check_annotated(first) is None:
                 chunks.append(chunk_cls.model_validate(_as_dict(first)))
             async for chunk in stream:
-                ann = Annotated.maybe_from_wire(chunk)
-                if ann is not None:
-                    if ann.is_error:  # a swallowed error must not look ok
-                        raise EngineError(
-                            ann.comment[0] if ann.comment else "engine error"
-                        )
+                if _check_annotated(chunk) is not None:
                     continue  # annotations are stream-only side channel
                 if _has_payload(_as_dict(chunk)):
                     timer.first_token()
@@ -208,6 +213,14 @@ class HttpService:
         async def _write(chunk) -> None:
             ann = Annotated.maybe_from_wire(chunk)
             if ann is not None:
+                if ann.is_error:
+                    # match the mid-stream exception convention below:
+                    # data-line parsers must see the error payload
+                    await resp.write(sse.encode_event(
+                        {"error": {"message": ann.comment[0] if ann.comment
+                                   else "engine error"}}
+                    ))
+                    return
                 # annotation events ride SSE event/comment lines with no
                 # data payload (reference annotated.rs wire mapping)
                 await resp.write(sse.encode_event(

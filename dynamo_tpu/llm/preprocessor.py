@@ -183,15 +183,13 @@ class OpenAIPreprocessor(Operator):
             mdc_checksum=self.mdc.checksum,
             annotations=list((req.nvext and req.nvext.annotations) or []),
         )
-        # side-channel payloads for requested annotations (not wire fields;
-        # generate() turns them into Annotated events ahead of the stream —
-        # reference preprocessor.rs:134-160 formatted_prompt/token_ids)
-        values = {}
+        # payloads for requested annotations (generate() turns them into
+        # Annotated events ahead of the stream — reference
+        # preprocessor.rs:134-160 formatted_prompt/token_ids)
         if ANNOTATION_FORMATTED_PROMPT in out.annotations and prompt_text is not None:
-            values[ANNOTATION_FORMATTED_PROMPT] = prompt_text
+            out.annotation_values[ANNOTATION_FORMATTED_PROMPT] = prompt_text
         if ANNOTATION_TOKEN_IDS in out.annotations:
-            values[ANNOTATION_TOKEN_IDS] = list(token_ids)
-        out._annotation_values = values
+            out.annotation_values[ANNOTATION_TOKEN_IDS] = list(token_ids)
         return out
 
     # ---------- backward: response translation ----------
@@ -367,7 +365,7 @@ class OpenAIPreprocessor(Operator):
             preprocessed = self.preprocess_completion(req)
             request_id = new_request_id("cmpl")
         # requested annotations stream ahead of the data as named events
-        for name, value in getattr(preprocessed, "_annotation_values", {}).items():
+        for name, value in preprocessed.annotation_values.items():
             yield Annotated.from_annotation(name, value)
         request.add_stage("generate")
         backend_stream = next_engine.generate(request.map(preprocessed))

@@ -1,14 +1,21 @@
-"""Parallelism toolkit: meshes, multi-host bring-up, sequence parallelism.
+"""Parallelism toolkit: meshes, multi-host bring-up, sequence + pipeline
+parallelism.
 
-See mesh.py for the axis vocabulary (dp/tp/sp/ep/pp) and
-ring_attention.py / sequence.py for long-context attention.
+See mesh.py for the axis vocabulary (dp/tp/sp/ep/pp),
+ring_attention.py / sequence.py for long-context attention, and
+pipeline.py for the collective GPipe schedule over the pp axis.
 """
 
 from .mesh import AXES, MultiHostConfig, initialize_multihost, make_mesh, mesh_shape
+from .pipeline import pipeline_forward, stage_cache, stage_params, unstage_cache
 from .ring_attention import dense_reference, ring_attention, ulysses_attention
 from .sequence import choose_strategy, sp_prefill_attention
 
 __all__ = [
+    "pipeline_forward",
+    "stage_cache",
+    "stage_params",
+    "unstage_cache",
     "AXES",
     "MultiHostConfig",
     "initialize_multihost",

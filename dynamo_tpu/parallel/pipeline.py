@@ -1,0 +1,200 @@
+"""Pipeline parallelism: layers sharded by stage, microbatches in flight.
+
+The last parallelism axis from SURVEY.md §2.12 (reference analog: the
+vllm0_7 engine's Ray-based pipeline_parallel_size pass-through,
+lib/engines/vllm0_7/src/{ray.rs,vllm_inc.py:38} — the reference never
+implements PP itself, it forwards a flag to vLLM).
+
+TPU-first formulation — a *collective* GPipe schedule inside one SPMD
+program (no per-stage processes, no RPC):
+
+- the mesh's ``pp`` axis holds P stages; the stacked layer params
+  [L, ...] reshape to [P, L/P, ...] and shard on the leading axis, so
+  under ``shard_map`` each device owns its stage's layer block and the
+  per-layer ``lax.scan`` runs over just L/P layers;
+- the paged KV cache [L, N, bs, KVH, D] shards the same way — each
+  stage reads/writes only its own layer slab, in place;
+- the batch splits into M microbatches; for T = M + P - 1 ticks every
+  device runs the same step: compute its layer block on the microbatch
+  it currently holds, then ``lax.ppermute`` the activations one stage
+  down the ring. Stage 0 injects (embedding) and the last stage
+  collects; warm-up/drain ticks carry garbage that is masked out — KV
+  writes use the scatter drop sentinel so invalid ticks touch nothing.
+
+Embedding/logits stay replicated (cheap relative to the trunk); combine
+``pp`` with ``tp``/``dp`` axes by nesting specs — this module only owns
+the pp dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from ..models import llama
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+def stage_params(params, num_stages: int):
+    """Reshape stacked layer params [L, ...] → [P, L/P, ...] for pp sharding."""
+    l = jax.tree.leaves(params["layers"])[0].shape[0]
+    if l % num_stages:
+        raise ValueError(f"{l} layers not divisible by {num_stages} pp stages")
+    staged = dict(params)
+    staged["layers"] = jax.tree.map(
+        lambda x: x.reshape(num_stages, l // num_stages, *x.shape[1:]),
+        params["layers"],
+    )
+    return staged
+
+
+def stage_cache(kv_cache: KVCache, num_stages: int) -> KVCache:
+    """[L, N, bs, KVH, D] → [P, L/P, N, bs, KVH, D] (stage-local slabs)."""
+    def split(c):
+        l = c.shape[0]
+        return c.reshape(num_stages, l // num_stages, *c.shape[1:])
+
+    return tuple(split(c) for c in kv_cache)
+
+
+def unstage_cache(kv_cache: KVCache) -> KVCache:
+    return tuple(c.reshape(-1, *c.shape[2:]) for c in kv_cache)
+
+
+def param_specs(params) -> dict:
+    """Specs for staged params: layer stacks shard over pp on the stage
+    axis (inner dims replicated — combine with tp by editing these)."""
+    specs = {"embed": P(), "final_norm": P()}
+    if "lm_head" in params:
+        specs["lm_head"] = P()
+    specs["layers"] = jax.tree.map(lambda _: P("pp"), params["layers"])
+    return specs
+
+
+CACHE_SPEC = P("pp")  # [P, L/P, N, bs, KVH, D]
+
+
+def pipeline_forward(
+    params,                   # staged params (stage_params output)
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S]
+    kv_cache: KVCache,        # staged cache (stage_cache output)
+    block_tables: jax.Array,  # [B, W]
+    slot_mapping: jax.Array,  # [B, S]
+    context_lens: jax.Array,  # [B]
+    mesh,
+    num_microbatches: Optional[int] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Llama-family forward with the trunk pipelined over the pp axis.
+
+    Returns (logits [B, S, V], updated staged cache) — same contract as
+    llama.forward modulo the staged cache layout. M defaults to P (the
+    minimum that fills the pipeline; raise it to shrink the bubble).
+    """
+    num_stages = mesh.shape["pp"]
+    b, s = tokens.shape
+    m = num_microbatches or num_stages
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb = b // m
+
+    def split_mb(x):
+        return x.reshape(m, mb, *x.shape[1:])
+
+    tokens_mb = split_mb(tokens)
+    positions_mb = split_mb(positions)
+    tables_mb = split_mb(block_tables)
+    slots_mb = split_mb(slot_mapping)
+    ctx_mb = split_mb(context_lens)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            param_specs(params),
+            (CACHE_SPEC, CACHE_SPEC),
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), (CACHE_SPEC, CACHE_SPEC)),
+        check_vma=False,
+    )
+    def run(params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb):
+        stage = lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == num_stages - 1
+        # shard_map gives the local block with a leading singleton stage dim
+        local_layers = jax.tree.map(lambda x: x[0], params["layers"])
+        k_local, v_local = kv_cache[0][0], kv_cache[1][0]
+
+        d_model = cfg.hidden_size
+        ticks = m + num_stages - 1
+
+        def tick(t, carry):
+            x_state, k_local, v_local, outputs = carry
+            # which microbatch does THIS stage hold at tick t?
+            mb_idx = jnp.clip(t - stage, 0, m - 1)
+            valid = jnp.logical_and(t - stage >= 0, t - stage < m)
+
+            tok = lax.dynamic_index_in_dim(tokens_mb, mb_idx, 0, keepdims=False)
+            pos = lax.dynamic_index_in_dim(positions_mb, mb_idx, 0, keepdims=False)
+            tab = lax.dynamic_index_in_dim(tables_mb, mb_idx, 0, keepdims=False)
+            slots = lax.dynamic_index_in_dim(slots_mb, mb_idx, 0, keepdims=False)
+            ctx = lax.dynamic_index_in_dim(ctx_mb, mb_idx, 0, keepdims=False)
+
+            # stage 0 injects the embedded microbatch; others use the
+            # activations ppermuted in at the end of the previous tick
+            injected = params["embed"][tok]
+            x_in = jnp.where(is_first, injected, x_state)
+
+            # invalid (warm-up/drain) ticks must not write KV: the drop
+            # sentinel routes their scatter out of range
+            slots = jnp.where(valid, slots, -1)
+
+            attn_fn = llama.make_gqa_attn_fn(
+                cfg, mb, s, pos, slots, tab, ctx, mesh=None
+            )
+            hidden, (k_local, v_local), _ = llama.run_layers(
+                x_in, (k_local, v_local), local_layers, cfg, attn_fn,
+                llama._swiglu_mlp,
+            )
+
+            # last stage collects its finished microbatch
+            out_idx = jnp.clip(t - (num_stages - 1), 0, m - 1)
+            take = jnp.logical_and(is_last, valid)
+            current = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(take, hidden, current), out_idx, 0
+            )
+
+            # rotate activations one stage down the ring
+            x_state = lax.ppermute(
+                hidden, "pp",
+                [(i, (i + 1) % num_stages) for i in range(num_stages)],
+            )
+            return x_state, k_local, v_local, outputs
+
+        x0 = jnp.zeros((mb, s, d_model), params["embed"].dtype)
+        out0 = jnp.zeros((m, mb, s, d_model), params["embed"].dtype)
+        x_state, k_local, v_local, outputs = lax.fori_loop(
+            0, ticks, tick, (x0, k_local, v_local, out0)
+        )
+
+        # only the last stage holds real outputs; psum broadcasts them
+        outputs = lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), "pp"
+        )
+        return outputs, (k_local[None], v_local[None])
+
+    outputs, kv_cache = run(
+        params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb
+    )
+    hidden = outputs.reshape(b, s, -1)
+    return llama.lm_logits(hidden, params, cfg), kv_cache

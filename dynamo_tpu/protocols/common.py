@@ -94,6 +94,10 @@ class PreprocessedRequest:
     model: Optional[str] = None
     mdc_checksum: Optional[str] = None
     annotations: List[str] = dataclasses.field(default_factory=list)
+    # payloads answering requested annotations (formatted_prompt,
+    # token_ids) — local side channel, deliberately NOT a wire field: the
+    # preprocessor emits them as Annotated events before dispatch
+    annotation_values: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_wire(self) -> dict:
         return {
