@@ -198,6 +198,11 @@ async def run_http(flags, engine, mdc) -> None:
         manager.add_chat_model(name, engine)
         if mdc is not None:  # pipeline engines dispatch chat AND completions
             manager.add_completion_model(name, engine)
+        manager.set_metadata(
+            name,
+            model_type="both" if mdc is not None else "chat",
+            max_model_len=mdc.context_length if mdc is not None else None,
+        )
     service = HttpService(manager, flags.http_host, flags.http_port)
 
     watcher = None
@@ -340,7 +345,8 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         engine = build_processor_pipeline(mdc, client, router)
         serving = await endpoint.serve(make_openai_handler(engine))
         name = flags.model_name or mdc.display_name
-        await register_model(drt, flags.namespace, name, path, model_type="both")
+        await register_model(drt, flags.namespace, name, path, model_type="both",
+                             mdc={"context_length": mdc.context_length})
         print(f"processor serving {path} (model={name} → {flags.worker_endpoint})", flush=True)
 
     elif flags.token_level:
@@ -371,7 +377,10 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         serving = await endpoint.serve(make_openai_handler(engine))
         name = flags.model_name or (mdc.display_name if mdc else "echo")
         model_type = "both" if mdc is not None else "chat"
-        await register_model(drt, flags.namespace, name, path, model_type=model_type)
+        await register_model(
+            drt, flags.namespace, name, path, model_type=model_type,
+            mdc={"context_length": mdc.context_length} if mdc else None,
+        )
         print(f"worker serving {path} (model={name})", flush=True)
 
     try:

@@ -51,6 +51,12 @@ class ModelManager:
     def __init__(self) -> None:
         self.chat_engines: Dict[str, AsyncEngine] = {}
         self.completion_engines: Dict[str, AsyncEngine] = {}
+        self.metadata: Dict[str, dict] = {}  # name → /v1/models extras
+
+    def set_metadata(self, name: str, **meta) -> None:
+        self.metadata.setdefault(name, {}).update(
+            {k: v for k, v in meta.items() if v is not None}
+        )
 
     def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
         self.chat_engines[name] = engine
@@ -61,6 +67,7 @@ class ModelManager:
     def remove_model(self, name: str) -> None:
         self.chat_engines.pop(name, None)
         self.completion_engines.pop(name, None)
+        self.metadata.pop(name, None)  # a re-registration starts clean
 
     def model_names(self) -> list:
         return sorted(set(self.chat_engines) | set(self.completion_engines))
@@ -210,36 +217,43 @@ class HttpService:
         )
         await resp.prepare(request)
 
-        async def _write(chunk) -> None:
+        async def _write(chunk) -> bool:
+            """Write one stream element; True = stream must terminate."""
             ann = Annotated.maybe_from_wire(chunk)
             if ann is not None:
                 if ann.is_error:
                     # match the mid-stream exception convention below:
-                    # data-line parsers must see the error payload
+                    # error payload on a data line, then end the stream
                     await resp.write(sse.encode_event(
                         {"error": {"message": ann.comment[0] if ann.comment
                                    else "engine error"}}
                     ))
-                    return
+                    return True
                 # annotation events ride SSE event/comment lines with no
                 # data payload (reference annotated.rs wire mapping)
                 await resp.write(sse.encode_event(
                     None, event=ann.event,
                     comment=ann.comment[0] if ann.comment else None,
                 ))
-                return
+                return False
             d = _as_dict(chunk)
             if _has_payload(d):
                 timer.first_token()
             await resp.write(sse.encode_event(d))
+            return False
 
         try:
-            if first is not None:
-                await _write(first)
-            async for chunk in chunks:
-                await _write(chunk)
+            failed = first is not None and await _write(first)
+            if not failed:
+                async for chunk in chunks:
+                    if await _write(chunk):
+                        failed = True
+                        break
             await resp.write(sse.encode_done())
             await resp.write_eof()
+            if failed:
+                ctx.context.stop_generating()
+                return resp, "error"
             return resp, "success"
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away — stop generation upstream
@@ -269,8 +283,11 @@ class HttpService:
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response(
             ModelList(
-                data=[ModelInfo(id=name) for name in self.manager.model_names()]
-            ).model_dump()
+                data=[
+                    ModelInfo(id=name, **self.manager.metadata.get(name, {}))
+                    for name in self.manager.model_names()
+                ]
+            ).model_dump(exclude_none=True)
         )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -397,6 +414,11 @@ class ModelWatcher:
         client = await Client(endpoint, self.router_mode).start()
         self._clients[name] = client
         model_type = entry.get("model_type", "chat")
+        self.manager.set_metadata(
+            name,
+            model_type=model_type,
+            max_model_len=(entry.get("mdc") or {}).get("context_length"),
+        )
         if model_type in ("chat", "both"):
             self.manager.add_chat_model(name, client)
         if model_type in ("completions", "both"):

@@ -12,6 +12,7 @@ bidirectional Operator + DeltaGenerator) and preprocessor/prompt/template/*
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any, AsyncIterator, List, Optional, Union
 
@@ -368,7 +369,6 @@ class OpenAIPreprocessor(Operator):
         for name, value in preprocessed.annotation_values.items():
             yield Annotated.from_annotation(name, value)
         request.add_stage("generate")
-        backend_stream = next_engine.generate(request.map(preprocessed))
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         kwargs = {}
         # tool_call_format=None on the card disables parsing entirely
@@ -376,6 +376,21 @@ class OpenAIPreprocessor(Operator):
                 and self.mdc.tool_call_format is not None):
             kwargs["tool_format"] = self.mdc.tool_call_format
         translate = self.chat_stream if is_chat else self.completion_stream
+
+        n = preprocessed.sampling_options.n or 1
+        if n > 1:
+            # n-way fan-out: n independent engine streams, choice indices
+            # rewritten per stream, usage summed into one final chunk
+            # (reference parity: SamplingOptions carries n,
+            # lib/llm/src/protocols/common.rs:248-316)
+            async for chunk in self._fan_out(
+                n, request, preprocessed, next_engine, translate,
+                request_id, req.model, include_usage, kwargs,
+            ):
+                yield chunk
+            return
+
+        backend_stream = next_engine.generate(request.map(preprocessed))
         async for chunk in translate(
             request_id,
             req.model,
@@ -385,3 +400,91 @@ class OpenAIPreprocessor(Operator):
             **kwargs,
         ):
             yield chunk
+
+    async def _fan_out(
+        self, n, request, preprocessed, next_engine, translate,
+        request_id, model, include_usage, kwargs,
+    ):
+        """Run n independent sampled continuations of one prompt.
+
+        Each choice gets its own engine request (n=1, seed offset by the
+        choice index so seeded requests stay reproducible but distinct)
+        and streams concurrently; chunks are re-indexed per choice and
+        usage totals combine at the end."""
+        import dataclasses as _dc
+
+        from ..runtime.engine import AsyncEngineContext
+
+        prompt_tokens = len(preprocessed.token_ids)
+        queue: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        usage_total = Usage(prompt_tokens=prompt_tokens)
+        # each choice gets its OWN engine context: an engine finishing one
+        # choice stops that choice's context in its finally, which with a
+        # shared context would truncate the sibling streams mid-generation
+        child_ctxs = [AsyncEngineContext() for _ in range(n)]
+
+        async def relay_stop() -> None:
+            # client disconnect on the parent fans out to every child
+            await request.context.wait_stopped()
+            for c in child_ctxs:
+                c.stop_generating()
+
+        async def one_choice(i: int) -> None:
+            seed = preprocessed.sampling_options.seed
+            samp = _dc.replace(
+                preprocessed.sampling_options,
+                n=1,
+                seed=(seed + i) if seed is not None else None,
+            )
+            sub = _dc.replace(
+                preprocessed, sampling_options=samp, annotation_values={}
+            )
+            sub_ctx = Context(sub, child_ctxs[i], dict(request.baggage))
+            try:
+                async for chunk in translate(
+                    request_id, model, next_engine.generate(sub_ctx),
+                    prompt_tokens=prompt_tokens, include_usage=include_usage,
+                    **kwargs,
+                ):
+                    if getattr(chunk, "usage", None) is not None:
+                        usage_total.completion_tokens += chunk.usage.completion_tokens
+                        continue
+                    for choice in chunk.choices:
+                        choice.index = i
+                    await queue.put(chunk)
+            except BaseException as e:
+                await queue.put(e)
+                return
+            await queue.put(DONE)
+
+        tasks = [asyncio.ensure_future(one_choice(i)) for i in range(n)]
+        stop_task = asyncio.ensure_future(relay_stop())
+        live = n
+        try:
+            while live:
+                item = await queue.get()
+                if item is DONE:
+                    live -= 1
+                elif isinstance(item, BaseException):
+                    raise item
+                else:
+                    yield item
+        finally:
+            stop_task.cancel()
+            for t in tasks:
+                t.cancel()
+            for c in child_ctxs:
+                c.stop_generating()
+        if include_usage:
+            usage_total.total_tokens = (
+                usage_total.prompt_tokens + usage_total.completion_tokens
+            )
+            chunk_cls = (
+                ChatCompletionChunk
+                if translate == self.chat_stream
+                else CompletionResponse
+            )
+            yield chunk_cls(
+                id=request_id, model=model, choices=[], usage=usage_total
+            )

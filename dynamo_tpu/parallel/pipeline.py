@@ -59,6 +59,10 @@ def stage_cache(kv_cache: KVCache, num_stages: int) -> KVCache:
     """[L, N, bs, KVH, D] → [P, L/P, N, bs, KVH, D] (stage-local slabs)."""
     def split(c):
         l = c.shape[0]
+        if l % num_stages:
+            raise ValueError(
+                f"{l} cache layers not divisible by {num_stages} pp stages"
+            )
         return c.reshape(num_stages, l // num_stages, *c.shape[1:])
 
     return tuple(split(c) for c in kv_cache)

@@ -193,6 +193,9 @@ class ModelInfo(BaseModel):
     object: Literal["model"] = "model"
     created: int = Field(default_factory=lambda: int(time.time()))
     owned_by: str = "dynamo-tpu"
+    # dynamo extensions (reference http/service/openai.rs model metadata)
+    max_model_len: Optional[int] = None
+    model_type: Optional[str] = None
 
 
 class ModelList(BaseModel):

@@ -166,3 +166,115 @@ def test_bad_tool_format_rejected_at_construction():
     )
     with pytest.raises(EngineError, match="tool_call_format"):
         OpenAIPreprocessor(mdc, tokenizer=object())
+
+
+@pytest.mark.asyncio
+async def test_n_fan_out_yields_indexed_choices():
+    """n=2 runs two engine streams; choices carry distinct indices and the
+    aggregate has two choices (reference SamplingOptions.n parity)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols.common import (
+        BackendOutput,
+        FinishReason,
+        PreprocessedRequest,
+    )
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+    calls = []
+
+    class _Engine(AsyncEngine):
+        async def generate(self, request):
+            req = request.payload
+            calls.append(req.sampling_options.seed)
+            text = f"answer-{len(calls)}"
+            yield BackendOutput(
+                text=text, token_ids=[1], cum_tokens=1,
+                finish_reason=FinishReason.STOP,
+            )
+
+    class _Tok:
+        def encode(self, text, add_special_tokens=False):
+            return [1, 2, 3]
+
+        def id_to_token(self, i):
+            return str(i)
+
+    mdc = ModelDeploymentCard(display_name="t", slug="t")
+    pre = OpenAIPreprocessor(mdc, tokenizer=_Tok())
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "hi"}],
+        n=2, seed=100,
+        stream_options={"include_usage": True}, stream=True,
+    )
+    chunks = [c async for c in pre.generate(Context(req), _Engine())]
+
+    # per-choice seeds are isolated and derived from the request seed
+    assert sorted(calls) == [100, 101]
+    indices = {
+        ch.index
+        for c in chunks for ch in c.choices
+        if ch.delta.content
+    }
+    assert indices == {0, 1}
+    usage = [c.usage for c in chunks if c.usage is not None]
+    assert len(usage) == 1 and usage[0].completion_tokens == 2
+
+    from dynamo_tpu.protocols.openai import aggregate_chat_stream
+
+    resp = aggregate_chat_stream(chunks)
+    assert len(resp.choices) == 2
+    contents = {c.message.content for c in resp.choices}
+    assert contents == {"answer-1", "answer-2"}
+
+
+@pytest.mark.asyncio
+async def test_n_fan_out_choices_do_not_truncate_each_other():
+    """Engines stop their request context when a stream completes (the
+    serving engine does this in its finally); with n>1 each choice must
+    own its context or the first finisher truncates the siblings."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest, aggregate_chat_stream
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+    started = []
+
+    class _Engine(AsyncEngine):
+        async def generate(self, request):
+            i = len(started)
+            started.append(i)
+            try:
+                steps = 1 if i == 0 else 4  # choice 0 finishes first
+                for k in range(steps):
+                    if request.context.is_stopped and k > 0:
+                        return  # honor cooperative cancellation
+                    await asyncio.sleep(0.01)
+                    yield BackendOutput(
+                        text=f"c{i}t{k} ", token_ids=[k], cum_tokens=k + 1,
+                        finish_reason=FinishReason.STOP if k == steps - 1 else None,
+                    )
+            finally:
+                # the serving engine stops the context when ITS stream ends
+                request.context.stop_generating()
+
+    class _Tok:
+        def encode(self, text, add_special_tokens=False):
+            return [1]
+
+        def id_to_token(self, i):
+            return str(i)
+
+    pre = OpenAIPreprocessor(
+        ModelDeploymentCard(display_name="t", slug="t"), tokenizer=_Tok()
+    )
+    req = ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}], n=2,
+    )
+    chunks = [c async for c in pre.generate(Context(req), _Engine())]
+    resp = aggregate_chat_stream(chunks)
+    by_index = {c.index: c.message.content for c in resp.choices}
+    assert by_index[0] == "c0t0 "
+    assert by_index[1] == "c1t0 c1t1 c1t2 c1t3 ", by_index
