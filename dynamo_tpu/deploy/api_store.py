@@ -1,0 +1,188 @@
+"""api-store: REST CRUD over deployment records, backed by sqlite.
+
+Reference analog: deploy/dynamo/api-store — the service the reference's
+CLI and operator use to persist deployment artifacts/records. Same REST
+surface shape (list/get/create/update/delete deployments as JSON
+documents), stdlib sqlite3 for durability, aiohttp like the rest of the
+framework's HTTP plane.
+
+Run standalone:  python -m dynamo_tpu.deploy.api_store --port 8790
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sqlite3
+import time
+from typing import Optional
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 8790
+
+
+class DeploymentStore:
+    """sqlite-backed document store: name → deployment spec (JSON)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.db = sqlite3.connect(path)
+        self.db.execute(
+            "CREATE TABLE IF NOT EXISTS deployments ("
+            " name TEXT PRIMARY KEY,"
+            " spec TEXT NOT NULL,"
+            " created REAL NOT NULL,"
+            " updated REAL NOT NULL)"
+        )
+        self.db.commit()
+
+    def list(self) -> list:
+        rows = self.db.execute(
+            "SELECT name, spec, created, updated FROM deployments ORDER BY name"
+        ).fetchall()
+        return [
+            {"name": n, "spec": json.loads(s), "created": c, "updated": u}
+            for n, s, c, u in rows
+        ]
+
+    def get(self, name: str) -> Optional[dict]:
+        row = self.db.execute(
+            "SELECT name, spec, created, updated FROM deployments WHERE name=?",
+            (name,),
+        ).fetchone()
+        if row is None:
+            return None
+        n, s, c, u = row
+        return {"name": n, "spec": json.loads(s), "created": c, "updated": u}
+
+    def put(self, name: str, spec: dict) -> dict:
+        now = time.time()
+        existing = self.get(name)
+        if existing is None:
+            self.db.execute(
+                "INSERT INTO deployments (name, spec, created, updated)"
+                " VALUES (?, ?, ?, ?)",
+                (name, json.dumps(spec), now, now),
+            )
+        else:
+            self.db.execute(
+                "UPDATE deployments SET spec=?, updated=? WHERE name=?",
+                (json.dumps(spec), now, name),
+            )
+        self.db.commit()
+        return self.get(name)  # type: ignore[return-value]
+
+    def delete(self, name: str) -> bool:
+        cur = self.db.execute("DELETE FROM deployments WHERE name=?", (name,))
+        self.db.commit()
+        return cur.rowcount > 0
+
+
+class ApiStoreService:
+    """aiohttp REST frontend over a DeploymentStore."""
+
+    def __init__(self, store: Optional[DeploymentStore] = None,
+                 host: str = "0.0.0.0", port: int = DEFAULT_PORT):
+        self.store = store or DeploymentStore()
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.router.add_get("/api/v1/deployments", self.handle_list)
+        self.app.router.add_post("/api/v1/deployments", self.handle_create)
+        self.app.router.add_get("/api/v1/deployments/{name}", self.handle_get)
+        self.app.router.add_put("/api/v1/deployments/{name}", self.handle_update)
+        self.app.router.add_delete("/api/v1/deployments/{name}", self.handle_delete)
+        self.app.router.add_get("/health", self.handle_health)
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        logger.info("api-store on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ---------- handlers ----------
+
+    async def handle_list(self, request: web.Request) -> web.Response:
+        return web.json_response({"deployments": self.store.list()})
+
+    async def handle_get(self, request: web.Request) -> web.Response:
+        record = self.store.get(request.match_info["name"])
+        if record is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(record)
+
+    async def handle_create(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            name = body["name"]
+            spec = body.get("spec", {})
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            return web.json_response({"error": f"invalid body: {e}"}, status=400)
+        if self.store.get(name) is not None:
+            return web.json_response(
+                {"error": f"deployment {name!r} exists"}, status=409
+            )
+        return web.json_response(self.store.put(name, spec), status=201)
+
+    async def handle_update(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"invalid body: {e}"}, status=400)
+        # accept the same envelope POST takes ({name, spec}) or a bare spec
+        if isinstance(body, dict) and set(body) <= {"name", "spec"} and "spec" in body:
+            if body.get("name") not in (None, name):
+                return web.json_response(
+                    {"error": "body name does not match URL"}, status=400
+                )
+            body = body["spec"]
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"error": "spec must be a JSON object"}, status=400
+            )
+        if self.store.get(name) is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(self.store.put(name, body))
+
+    async def handle_delete(self, request: web.Request) -> web.Response:
+        if not self.store.delete(request.match_info["name"]):
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"deleted": True})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu api-store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--db", default="dynamo_api_store.sqlite")
+    args = parser.parse_args()
+    from ..utils.logging import setup_logging
+
+    setup_logging(logging.INFO)
+
+    async def run():
+        service = ApiStoreService(DeploymentStore(args.db), args.host, args.port)
+        await service.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

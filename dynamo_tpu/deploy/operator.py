@@ -1,0 +1,345 @@
+"""Kubernetes operator for DynamoTpuGraphDeployment resources.
+
+Reference analog: deploy/dynamo/operator — the Go controller that turns
+a DynamoDeployment CR (artifact + per-service overrides,
+api/v1alpha1/dynamodeployment_types.go:31-60) into child Deployments/
+Services (internal/controller/dynamodeployment_controller.go). Same
+shape here, TPU-native:
+
+- ``render_manifests(cr)`` is a PURE function: CR spec → the desired
+  child manifests (dynstore, frontend, one Deployment per service role,
+  Services, a ConfigMap of engine flags). Workers request
+  ``google.com/tpu`` resources and pin TPU node pools via GKE selectors.
+- ``Reconciler`` diffs desired vs. observed through a pluggable
+  ``KubeClient`` (apply/delete/list) and is idempotent — the control
+  loop can run from a watch or a poll. ``InMemoryKube`` backs the tests;
+  ``KubectlClient`` shells out to kubectl for real clusters (no
+  kubernetes python client in the image, and the operator only needs
+  apply/delete/get semantics).
+
+The CRD itself ships as YAML in deploy/kubernetes/crd.yaml with example
+CRs alongside.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import time
+from typing import Dict, List, Optional, Protocol
+
+logger = logging.getLogger(__name__)
+
+GROUP = "dynamo.tpu"
+VERSION = "v1alpha1"
+KIND = "DynamoTpuGraphDeployment"
+PLURAL = "dynamotpugraphdeployments"
+
+MANAGED_BY = {"app.kubernetes.io/managed-by": "dynamo-tpu-operator"}
+
+# role → in=/out= argv of cli.run (the service binaries, SURVEY §2.6/2.7)
+ROLE_ARGS = {
+    "frontend": ["in=http", "out=none"],
+    "processor": ["in=dyn://{ns}.processor.chat", "out=processor"],
+    "worker": ["in=dyn://{ns}.backend.generate", "out=jax", "--token-level"],
+    "decode": ["in=dyn://{ns}.backend.generate", "out=jax", "--token-level",
+               "--remote-prefill"],
+    "prefill": ["in=prefill", "out=jax"],
+}
+
+DYNSTORE_PORT = 4871
+HTTP_PORT = 8080
+
+
+def _labels(cr_name: str, service: str) -> Dict[str, str]:
+    return {
+        "app.kubernetes.io/name": "dynamo-tpu",
+        "app.kubernetes.io/instance": cr_name,
+        "app.kubernetes.io/component": service,
+        **MANAGED_BY,
+    }
+
+
+def _owner_ref(cr: dict) -> dict:
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": KIND,
+        "name": cr["metadata"]["name"],
+        "uid": cr["metadata"].get("uid", ""),
+        "controller": True,
+    }
+
+
+def _deployment(cr: dict, service: str, spec: dict) -> dict:
+    name = cr["metadata"]["name"]
+    ns = cr["metadata"].get("namespace", "default")
+    graph_ns = cr["spec"].get("namespace", "public")
+    image = spec.get("image") or cr["spec"].get("image", "dynamo-tpu:latest")
+    role = spec.get("role", service)
+    if role not in ROLE_ARGS and role != "dynstore":
+        raise ValueError(f"unknown service role {role!r} for {service}")
+
+    if role == "dynstore":
+        command = ["python", "-m", "dynamo_tpu.runtime.transports.dynstore",
+                   "--host", "0.0.0.0", "--port", str(DYNSTORE_PORT)]
+        ports = [{"containerPort": DYNSTORE_PORT, "name": "dynstore"}]
+    else:
+        argv = [a.format(ns=graph_ns) for a in ROLE_ARGS[role]]
+        command = ["python", "-m", "dynamo_tpu.cli.run", *argv,
+                   "--store-host", f"{name}-dynstore",
+                   "--store-port", str(DYNSTORE_PORT),
+                   "--namespace", graph_ns]
+        if spec.get("modelPath"):
+            command += ["--model-path", spec["modelPath"]]
+        if spec.get("modelName") or cr["spec"].get("modelName"):
+            command += ["--model-name",
+                        spec.get("modelName") or cr["spec"]["modelName"]]
+        command += list(spec.get("extraArgs", []))
+        ports = (
+            [{"containerPort": HTTP_PORT, "name": "http"}]
+            if role == "frontend" else []
+        )
+
+    container: dict = {
+        "name": service,
+        "image": image,
+        "command": command,
+        "ports": ports,
+        "env": [
+            {"name": "DYN_LOGGING_JSONL", "value": "1"},
+            *[{"name": k, "value": str(v)}
+              for k, v in (spec.get("env") or {}).items()],
+        ],
+    }
+    pod_spec: dict = {"containers": [container]}
+
+    tpus = spec.get("tpus", 0)
+    if tpus:
+        container["resources"] = {
+            "requests": {"google.com/tpu": str(tpus)},
+            "limits": {"google.com/tpu": str(tpus)},
+        }
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator":
+                spec.get("tpuAccelerator", "tpu-v5-lite-podslice"),
+            "cloud.google.com/gke-tpu-topology": spec.get("tpuTopology", "1x1"),
+        }
+
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{name}-{service}",
+            "namespace": ns,
+            "labels": _labels(name, service),
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": _labels(name, service)},
+            "template": {
+                "metadata": {"labels": _labels(name, service)},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def _service(cr: dict, service: str, port: int, port_name: str) -> dict:
+    name = cr["metadata"]["name"]
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{name}-{service}",
+            "namespace": cr["metadata"].get("namespace", "default"),
+            "labels": _labels(name, service),
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "selector": _labels(name, service),
+            "ports": [{"port": port, "targetPort": port, "name": port_name}],
+        },
+    }
+
+
+def render_manifests(cr: dict) -> List[dict]:
+    """CR → desired child manifests. Pure; raises on invalid specs."""
+    services: Dict[str, dict] = dict(cr["spec"].get("services") or {})
+    manifests: List[dict] = []
+    # every graph gets its control/message plane + frontend unless the CR
+    # overrides them explicitly
+    services.setdefault("dynstore", {"role": "dynstore"})
+    services.setdefault("frontend", {"role": "frontend"})
+    for service, spec in services.items():
+        manifests.append(_deployment(cr, service, spec))
+        role = spec.get("role", service)
+        if role == "dynstore":
+            manifests.append(_service(cr, service, DYNSTORE_PORT, "dynstore"))
+        elif role == "frontend":
+            manifests.append(_service(cr, service, HTTP_PORT, "http"))
+    return manifests
+
+
+def _key(m: dict) -> str:
+    return f'{m["kind"]}/{m["metadata"].get("namespace", "default")}/{m["metadata"]["name"]}'
+
+
+class KubeClient(Protocol):
+    """The three verbs the reconcile loop needs."""
+
+    def apply(self, manifest: dict) -> None: ...
+
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    def list_managed(self, namespace: str, instance: str) -> List[dict]: ...
+
+
+class InMemoryKube:
+    """Test double with real apply/delete/list semantics."""
+
+    def __init__(self) -> None:
+        self.objects: Dict[str, dict] = {}
+
+    def apply(self, manifest: dict) -> None:
+        self.objects[_key(manifest)] = json.loads(json.dumps(manifest))
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.objects.pop(f"{kind}/{namespace}/{name}", None)
+
+    def list_managed(self, namespace: str, instance: str) -> List[dict]:
+        out = []
+        for m in self.objects.values():
+            labels = m["metadata"].get("labels", {})
+            if (m["metadata"].get("namespace", "default") == namespace
+                    and labels.get("app.kubernetes.io/instance") == instance
+                    and labels.get("app.kubernetes.io/managed-by")
+                    == MANAGED_BY["app.kubernetes.io/managed-by"]):
+                out.append(m)
+        return out
+
+
+class KubectlClient:
+    """Real-cluster client via kubectl (present on operator pods)."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    def _run(self, *args: str, stdin: Optional[str] = None) -> str:
+        proc = subprocess.run(
+            [self.kubectl, *args], input=stdin, capture_output=True,
+            text=True, check=True,
+        )
+        return proc.stdout
+
+    def apply(self, manifest: dict) -> None:
+        self._run("apply", "-f", "-", stdin=json.dumps(manifest))
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._run("delete", kind.lower(), name, "-n", namespace,
+                  "--ignore-not-found")
+
+    def list_managed(self, namespace: str, instance: str) -> List[dict]:
+        selector = (
+            f"app.kubernetes.io/instance={instance},"
+            f"app.kubernetes.io/managed-by="
+            f"{MANAGED_BY['app.kubernetes.io/managed-by']}"
+        )
+        out = self._run(
+            "get", "deployments,services", "-n", namespace,
+            "-l", selector, "-o", "json",
+        )
+        return json.loads(out).get("items", [])
+
+
+class Reconciler:
+    """Desired-state reconcile: render, apply changed, prune orphans.
+
+    Reference analog: dynamodeployment_controller.go Reconcile — but as
+    an explicit diff over manifests so the same function serves a watch
+    loop, a poll loop, and the unit tests.
+    """
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+        # last applied spec per child, to skip no-op applies
+        self._applied: Dict[str, str] = {}
+
+    def reconcile(self, cr: dict) -> Dict[str, List[str]]:
+        """Bring the cluster to the CR's desired state. Returns a change
+        summary {applied: [...], deleted: [...]} (for status/events)."""
+        name = cr["metadata"]["name"]
+        ns = cr["metadata"].get("namespace", "default")
+        desired = {_key(m): m for m in render_manifests(cr)}
+        observed = {_key(o): o for o in self.client.list_managed(ns, name)}
+
+        applied, deleted = [], []
+        for key, manifest in desired.items():
+            serialized = json.dumps(manifest, sort_keys=True)
+            # re-apply on spec change AND on external deletion — the cache
+            # alone would never repair drift (e.g. kubectl delete of a child)
+            if self._applied.get(key) != serialized or key not in observed:
+                self.client.apply(manifest)
+                self._applied[key] = serialized
+                applied.append(key)
+
+        for key, obj in observed.items():
+            if key not in desired:
+                self.client.delete(
+                    obj["kind"],
+                    obj["metadata"].get("namespace", "default"),
+                    obj["metadata"]["name"],
+                )
+                self._applied.pop(key, None)
+                deleted.append(key)
+        return {"applied": applied, "deleted": deleted}
+
+    def finalize(self, cr: dict) -> List[str]:
+        """CR deleted: remove every managed child."""
+        name = cr["metadata"]["name"]
+        ns = cr["metadata"].get("namespace", "default")
+        removed = []
+        for observed in self.client.list_managed(ns, name):
+            self.client.delete(
+                observed["kind"],
+                observed["metadata"].get("namespace", "default"),
+                observed["metadata"]["name"],
+            )
+            self._applied.pop(_key(observed), None)
+            removed.append(_key(observed))
+        return removed
+
+
+def control_loop(
+    reconciler: Reconciler,
+    get_crs,                 # () -> List[dict] current CRs
+    interval: float = 10.0,
+    stop=None,               # threading.Event-like; None = run forever
+) -> None:
+    """Poll-based control loop (watch-based callers drive reconcile()
+    directly from events instead)."""
+    seen: Dict[tuple, dict] = {}
+    while stop is None or not stop.is_set():
+        # key by (namespace, name): same-named CRs in different namespaces
+        # are distinct graphs
+        current = {
+            (c["metadata"].get("namespace", "default"), c["metadata"]["name"]): c
+            for c in get_crs()
+        }
+        for key, cr in current.items():
+            try:
+                changes = reconciler.reconcile(cr)
+                if changes["applied"] or changes["deleted"]:
+                    logger.info("reconciled %s/%s: %s", key[0], key[1], changes)
+            except Exception:
+                logger.exception("reconcile failed for %s/%s", key[0], key[1])
+        for key, cr in list(seen.items()):
+            if key not in current:
+                logger.info("finalizing deleted CR %s/%s", key[0], key[1])
+                reconciler.finalize(cr)
+        seen = current
+        if stop is not None and stop.wait(interval):
+            break
+        if stop is None:
+            time.sleep(interval)

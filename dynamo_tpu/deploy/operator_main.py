@@ -1,0 +1,58 @@
+"""Operator binary: poll DynamoTpuGraphDeployment CRs via kubectl and
+reconcile (the in-cluster entrypoint the helm chart deploys).
+
+Reference analog: deploy/dynamo/operator cmd/main.go. The poll loop is
+deliberate — kubectl handles auth/watch reconnection complexity, and
+serving graphs change rarely; watch-driven callers can instead feed
+``Reconciler.reconcile`` from their own event source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import subprocess
+import threading
+
+from ..utils.logging import setup_logging
+from .operator import GROUP, PLURAL, KubectlClient, Reconciler, control_loop
+
+logger = logging.getLogger(__name__)
+
+
+def get_crs(kubectl: str = "kubectl", namespace: str | None = None) -> list:
+    args = [kubectl, "get", f"{PLURAL}.{GROUP}", "-o", "json"]
+    args += ["-n", namespace] if namespace else ["--all-namespaces"]
+    try:
+        out = subprocess.run(
+            args, capture_output=True, text=True, check=True
+        ).stdout
+    except subprocess.CalledProcessError as e:
+        logger.warning("listing CRs failed: %s", e.stderr.strip())
+        return []
+    return json.loads(out).get("items", [])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu operator")
+    parser.add_argument("--interval", type=float, default=10.0)
+    parser.add_argument("--namespace", default=None,
+                        help="watch one namespace (default: all)")
+    parser.add_argument("--kubectl", default="kubectl")
+    args = parser.parse_args()
+    setup_logging(logging.INFO)
+
+    reconciler = Reconciler(KubectlClient(args.kubectl))
+    logger.info("operator watching %s.%s every %.0fs",
+                PLURAL, GROUP, args.interval)
+    control_loop(
+        reconciler,
+        lambda: get_crs(args.kubectl, args.namespace),
+        interval=args.interval,
+        stop=threading.Event(),  # run until killed; Event never set
+    )
+
+
+if __name__ == "__main__":
+    main()

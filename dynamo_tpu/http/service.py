@@ -412,8 +412,16 @@ class ModelWatcher:
         ns, comp, ep = parse_endpoint_path(entry["endpoint"])
         endpoint = self.drt.namespace(ns).component(comp).endpoint(ep)
         client = await Client(endpoint, self.router_mode).start()
+        previous = self._clients.pop(name, None)
+        if previous is not None:
+            # re-registration PUT: release the old client's watch task
+            # instead of leaking one per worker churn event
+            await previous.close()
         self._clients[name] = client
         model_type = entry.get("model_type", "chat")
+        # replace, not merge: stale metadata from the previous
+        # registration must not survive a PUT without mdc
+        self.manager.metadata.pop(name, None)
         self.manager.set_metadata(
             name,
             model_type=model_type,

@@ -416,7 +416,9 @@ class OpenAIPreprocessor(Operator):
         from ..runtime.engine import AsyncEngineContext
 
         prompt_tokens = len(preprocessed.token_ids)
-        queue: asyncio.Queue = asyncio.Queue()
+        # bounded: children block once the consumer lags, restoring the
+        # pull-based flow control the single-stream path gets for free
+        queue: asyncio.Queue = asyncio.Queue(maxsize=16)
         DONE = object()
         usage_total = Usage(prompt_tokens=prompt_tokens)
         # each choice gets its OWN engine context: an engine finishing one

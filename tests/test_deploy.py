@@ -1,0 +1,163 @@
+"""Deployment plane: manifest rendering, reconcile loop, api-store CRUD.
+
+Reference analog: the operator controller tests
+(deploy/dynamo/operator/internal/controller/*_test.go) — here the
+reconcile logic is pure-Python and tested against an in-memory cluster.
+"""
+
+import pytest
+
+from dynamo_tpu.deploy import InMemoryKube, Reconciler, render_manifests
+from dynamo_tpu.deploy.api_store import ApiStoreService, DeploymentStore
+
+
+def _cr(name="g1", services=None, **spec):
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoTpuGraphDeployment",
+        "metadata": {"name": name, "namespace": "serving", "uid": "u-1"},
+        "spec": {"image": "dynamo-tpu:test", "namespace": "public",
+                 "services": services or {}, **spec},
+    }
+
+
+def test_render_defaults_include_dynstore_and_frontend():
+    manifests = render_manifests(_cr())
+    kinds = {(m["kind"], m["metadata"]["name"]) for m in manifests}
+    assert ("Deployment", "g1-dynstore") in kinds
+    assert ("Deployment", "g1-frontend") in kinds
+    assert ("Service", "g1-dynstore") in kinds
+    assert ("Service", "g1-frontend") in kinds
+    for m in manifests:
+        assert m["metadata"]["ownerReferences"][0]["name"] == "g1"
+
+
+def test_render_worker_gets_tpu_resources_and_wiring():
+    cr = _cr(services={
+        "decode": {
+            "role": "decode", "replicas": 2, "tpus": 4, "tpuTopology": "2x2",
+            "modelPath": "/models/llama", "extraArgs": ["--tensor-parallel-size", "4"],
+        },
+        "prefill": {"role": "prefill", "replicas": 4, "tpus": 1,
+                    "modelPath": "/models/llama"},
+    }, modelName="llama")
+    by_name = {m["metadata"]["name"]: m for m in render_manifests(cr)}
+
+    decode = by_name["g1-decode"]
+    assert decode["spec"]["replicas"] == 2
+    container = decode["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    sel = decode["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+    cmd = container["command"]
+    assert "in=dyn://public.backend.generate" in cmd
+    assert "--remote-prefill" in cmd
+    assert "--store-host" in cmd and "g1-dynstore" in cmd
+    assert "--model-name" in cmd and "llama" in cmd
+    assert "--tensor-parallel-size" in cmd
+
+    prefill = by_name["g1-prefill"]
+    assert prefill["spec"]["replicas"] == 4
+    assert "in=prefill" in prefill["spec"]["template"]["spec"]["containers"][0]["command"]
+
+
+def test_render_rejects_unknown_role():
+    with pytest.raises(ValueError, match="unknown service role"):
+        render_manifests(_cr(services={"x": {"role": "nonsense"}}))
+
+
+def test_reconcile_applies_updates_and_prunes():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+
+    cr = _cr(services={"worker": {"role": "worker", "replicas": 1,
+                                  "modelPath": "/m"}})
+    changes = rec.reconcile(cr)
+    assert len(changes["applied"]) == len(render_manifests(cr))
+    assert not changes["deleted"]
+    assert "Deployment/serving/g1-worker" in kube.objects
+
+    # idempotent: nothing re-applied
+    changes = rec.reconcile(cr)
+    assert changes == {"applied": [], "deleted": []}
+
+    # scale up → only the changed child re-applies
+    cr["spec"]["services"]["worker"]["replicas"] = 3
+    changes = rec.reconcile(cr)
+    assert changes["applied"] == ["Deployment/serving/g1-worker"]
+    assert kube.objects["Deployment/serving/g1-worker"]["spec"]["replicas"] == 3
+
+    # remove the service → its Deployment is pruned
+    del cr["spec"]["services"]["worker"]
+    changes = rec.reconcile(cr)
+    assert "Deployment/serving/g1-worker" in changes["deleted"]
+    assert "Deployment/serving/g1-worker" not in kube.objects
+
+    # finalize removes everything managed
+    removed = rec.finalize(cr)
+    assert removed
+    assert not kube.list_managed("serving", "g1")
+
+
+@pytest.mark.asyncio
+async def test_api_store_crud_over_http(aiohttp_client=None):
+    import aiohttp
+
+    service = ApiStoreService(DeploymentStore(":memory:"), "127.0.0.1", 0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/api/v1/deployments",
+                              json={"name": "g1", "spec": {"replicas": 2}}) as r:
+                assert r.status == 201
+            async with s.post(f"{base}/api/v1/deployments",
+                              json={"name": "g1", "spec": {}}) as r:
+                assert r.status == 409
+            async with s.get(f"{base}/api/v1/deployments/g1") as r:
+                assert (await r.json())["spec"] == {"replicas": 2}
+            async with s.put(f"{base}/api/v1/deployments/g1",
+                             json={"replicas": 5}) as r:
+                assert (await r.json())["spec"] == {"replicas": 5}
+            async with s.get(f"{base}/api/v1/deployments") as r:
+                assert len((await r.json())["deployments"]) == 1
+            async with s.delete(f"{base}/api/v1/deployments/g1") as r:
+                assert (await r.json())["deleted"] is True
+            async with s.get(f"{base}/api/v1/deployments/g1") as r:
+                assert r.status == 404
+    finally:
+        await service.stop()
+
+
+def test_reconcile_repairs_external_deletion():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr(services={"worker": {"role": "worker"}})
+    rec.reconcile(cr)
+    # someone kubectl-deletes a child out from under the operator
+    kube.delete("Deployment", "serving", "g1-worker")
+    changes = rec.reconcile(cr)
+    assert "Deployment/serving/g1-worker" in changes["applied"]
+    assert "Deployment/serving/g1-worker" in kube.objects
+
+
+@pytest.mark.asyncio
+async def test_api_store_update_accepts_both_envelopes():
+    import aiohttp
+
+    service = ApiStoreService(DeploymentStore(":memory:"), "127.0.0.1", 0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            await s.post(f"{base}/api/v1/deployments",
+                         json={"name": "g1", "spec": {"a": 1}})
+            # envelope form (same shape POST takes)
+            async with s.put(f"{base}/api/v1/deployments/g1",
+                             json={"name": "g1", "spec": {"a": 2}}) as r:
+                assert (await r.json())["spec"] == {"a": 2}
+            # non-object specs rejected
+            async with s.put(f"{base}/api/v1/deployments/g1", json=[1, 2]) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
