@@ -137,25 +137,31 @@ class ApiStoreService:
         return web.json_response(self.store.put(name, spec), status=201)
 
     async def handle_update(self, request: web.Request) -> web.Response:
+        """PUT takes the SAME envelope as POST: {"spec": {...}} (name
+        optional, must match the URL). Requiring the envelope — instead of
+        guessing whether a body is a bare spec — keeps specs that happen to
+        contain a top-level "spec" key unambiguous."""
         name = request.match_info["name"]
         try:
             body = await request.json()
         except json.JSONDecodeError as e:
             return web.json_response({"error": f"invalid body: {e}"}, status=400)
-        # accept the same envelope POST takes ({name, spec}) or a bare spec
-        if isinstance(body, dict) and set(body) <= {"name", "spec"} and "spec" in body:
-            if body.get("name") not in (None, name):
-                return web.json_response(
-                    {"error": "body name does not match URL"}, status=400
-                )
-            body = body["spec"]
-        if not isinstance(body, dict):
+        if not isinstance(body, dict) or "spec" not in body:
+            return web.json_response(
+                {"error": 'body must be {"spec": {...}}'}, status=400
+            )
+        if body.get("name") not in (None, name):
+            return web.json_response(
+                {"error": "body name does not match URL"}, status=400
+            )
+        spec = body["spec"]
+        if not isinstance(spec, dict):
             return web.json_response(
                 {"error": "spec must be a JSON object"}, status=400
             )
         if self.store.get(name) is None:
             return web.json_response({"error": "not found"}, status=404)
-        return web.json_response(self.store.put(name, body))
+        return web.json_response(self.store.put(name, spec))
 
     async def handle_delete(self, request: web.Request) -> web.Response:
         if not self.store.delete(request.match_info["name"]):

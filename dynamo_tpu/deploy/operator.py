@@ -321,11 +321,20 @@ def control_loop(
     directly from events instead)."""
     seen: Dict[tuple, dict] = {}
     while stop is None or not stop.is_set():
+        listed = get_crs()
+        if listed is None:
+            # listing failed — do NOT mistake it for "no CRs" (which would
+            # finalize everything); retry next cycle
+            if stop is not None and stop.wait(interval):
+                break
+            if stop is None:
+                time.sleep(interval)
+            continue
         # key by (namespace, name): same-named CRs in different namespaces
         # are distinct graphs
         current = {
             (c["metadata"].get("namespace", "default"), c["metadata"]["name"]): c
-            for c in get_crs()
+            for c in listed
         }
         for key, cr in current.items():
             try:

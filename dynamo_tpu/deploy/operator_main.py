@@ -21,7 +21,10 @@ from .operator import GROUP, PLURAL, KubectlClient, Reconciler, control_loop
 logger = logging.getLogger(__name__)
 
 
-def get_crs(kubectl: str = "kubectl", namespace: str | None = None) -> list:
+def get_crs(kubectl: str = "kubectl", namespace: str | None = None):
+    """List CRs, or None when the listing itself failed — the loop must
+    skip that cycle; treating a transient API error as "no CRs" would
+    finalize (delete) every managed child cluster-wide."""
     args = [kubectl, "get", f"{PLURAL}.{GROUP}", "-o", "json"]
     args += ["-n", namespace] if namespace else ["--all-namespaces"]
     try:
@@ -30,7 +33,7 @@ def get_crs(kubectl: str = "kubectl", namespace: str | None = None) -> list:
         ).stdout
     except subprocess.CalledProcessError as e:
         logger.warning("listing CRs failed: %s", e.stderr.strip())
-        return []
+        return None
     return json.loads(out).get("items", [])
 
 

@@ -417,11 +417,11 @@ class ModelWatcher:
             # re-registration PUT: release the old client's watch task
             # instead of leaking one per worker churn event
             await previous.close()
+        # start clean: a narrowed model_type must not leave the closed
+        # client behind in the other engine map, nor stale metadata
+        self.manager.remove_model(name)
         self._clients[name] = client
         model_type = entry.get("model_type", "chat")
-        # replace, not merge: stale metadata from the previous
-        # registration must not survive a PUT without mdc
-        self.manager.metadata.pop(name, None)
         self.manager.set_metadata(
             name,
             model_type=model_type,

@@ -416,10 +416,12 @@ class OpenAIPreprocessor(Operator):
         from ..runtime.engine import AsyncEngineContext
 
         prompt_tokens = len(preprocessed.token_ids)
-        # bounded: children block once the consumer lags, restoring the
-        # pull-based flow control the single-stream path gets for free
+        # bounded: children block in put() once the consumer lags,
+        # restoring the pull-based flow control the single-stream path
+        # gets for free. No sentinels ride the queue — completion/errors
+        # surface through the gather below, so a cancelled child never
+        # wedges on a full queue.
         queue: asyncio.Queue = asyncio.Queue(maxsize=16)
-        DONE = object()
         usage_total = Usage(prompt_tokens=prompt_tokens)
         # each choice gets its OWN engine context: an engine finishing one
         # choice stops that choice's context in its finally, which with a
@@ -443,37 +445,38 @@ class OpenAIPreprocessor(Operator):
                 preprocessed, sampling_options=samp, annotation_values={}
             )
             sub_ctx = Context(sub, child_ctxs[i], dict(request.baggage))
-            try:
-                async for chunk in translate(
-                    request_id, model, next_engine.generate(sub_ctx),
-                    prompt_tokens=prompt_tokens, include_usage=include_usage,
-                    **kwargs,
-                ):
-                    if getattr(chunk, "usage", None) is not None:
-                        usage_total.completion_tokens += chunk.usage.completion_tokens
-                        continue
-                    for choice in chunk.choices:
-                        choice.index = i
-                    await queue.put(chunk)
-            except BaseException as e:
-                await queue.put(e)
-                return
-            await queue.put(DONE)
+            async for chunk in translate(
+                request_id, model, next_engine.generate(sub_ctx),
+                prompt_tokens=prompt_tokens, include_usage=include_usage,
+                **kwargs,
+            ):
+                if getattr(chunk, "usage", None) is not None:
+                    usage_total.completion_tokens += chunk.usage.completion_tokens
+                    continue
+                for choice in chunk.choices:
+                    choice.index = i
+                await queue.put(chunk)
 
         tasks = [asyncio.ensure_future(one_choice(i)) for i in range(n)]
         stop_task = asyncio.ensure_future(relay_stop())
-        live = n
+        all_done = asyncio.gather(*tasks)
         try:
-            while live:
-                item = await queue.get()
-                if item is DONE:
-                    live -= 1
-                elif isinstance(item, BaseException):
-                    raise item
-                else:
-                    yield item
+            while True:
+                get_task = asyncio.ensure_future(queue.get())
+                await asyncio.wait(
+                    {get_task, all_done}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if get_task.done():
+                    yield get_task.result()
+                    continue
+                get_task.cancel()
+                while not queue.empty():
+                    yield queue.get_nowait()
+                all_done.result()  # re-raises the first child failure
+                break
         finally:
             stop_task.cancel()
+            all_done.cancel()
             for t in tasks:
                 t.cancel()
             for c in child_ctxs:

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Build and run the native-core stress test under ASan+UBSan and TSan.
+# (Reference analog: the sanitizer CI over the reference's native
+# runtime; SURVEY.md §5 race detection.) Used by .github/workflows/ci.yml
+# and runnable locally:  bash dynamo_tpu/native/run_sanitizers.sh
+set -euo pipefail
+cd "$(dirname "$0")"
+
+CXX=${CXX:-g++}
+SRCS="src/indexer.cc src/capi.cc src/stress_test.cc"
+mkdir -p _build
+
+echo "== asan+ubsan =="
+$CXX -std=c++17 -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=address,undefined $SRCS -o _build/stress_asan -lpthread
+./_build/stress_asan
+
+echo "== tsan =="
+$CXX -std=c++17 -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=thread $SRCS -o _build/stress_tsan -lpthread
+./_build/stress_tsan
+
+echo "sanitizers clean"

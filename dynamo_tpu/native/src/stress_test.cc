@@ -1,0 +1,103 @@
+// Concurrency/memory stress driver for the native core, built by CI
+// under -fsanitize=thread and -fsanitize=address,undefined
+// (native/run_sanitizers.sh). Reference analog: the reference's
+// sanitizer CI jobs over its native runtime (SURVEY.md §5 race
+// detection); here the contract under test is the indexer's
+// mutex-guarded tree (indexer.cc Tree::mu) and the hashing hot path.
+//
+// Exit code 0 = clean; sanitizer reports fail the process.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+uint64_t dt_xxh64(const void* data, size_t len, uint64_t seed);
+size_t dt_compute_block_hashes(const uint32_t* tokens, size_t n_tokens,
+                               size_t block_size, uint64_t seed,
+                               uint64_t* out, size_t out_cap);
+void* dt_tree_new(double expiration_s);
+void dt_tree_free(void* tp);
+void dt_tree_apply_stored(void* tp, const char* worker, int has_parent,
+                          uint64_t parent, const uint64_t* hashes, size_t n);
+void dt_tree_apply_removed(void* tp, const char* worker,
+                           const uint64_t* hashes, size_t n);
+void dt_tree_remove_worker(void* tp, const char* worker);
+size_t dt_tree_size(void* tp);
+size_t dt_tree_clear_expired(void* tp);
+void* dt_tree_find_matches(void* tp, const uint64_t* hashes, size_t n,
+                           int early_exit);
+size_t dt_result_num_workers(void* rp);
+const char* dt_result_worker(void* rp, size_t i);
+uint32_t dt_result_score(void* rp, size_t i);
+void dt_result_free(void* rp);
+}
+
+namespace {
+
+constexpr int kThreads = 4;
+constexpr int kIters = 2000;
+constexpr size_t kChain = 8;
+
+void worker_thread(void* tree, int tid, std::atomic<uint64_t>* checksum) {
+  std::string worker = "worker-" + std::to_string(tid);
+  std::vector<uint32_t> tokens(64);
+  std::vector<uint64_t> hashes(kChain);
+  for (int it = 0; it < kIters; ++it) {
+    for (size_t i = 0; i < tokens.size(); ++i) {
+      tokens[i] = static_cast<uint32_t>(tid * 1000003 + it * 31 + i);
+    }
+    size_t n = dt_compute_block_hashes(tokens.data(), tokens.size(), 8,
+                                       1337, hashes.data(), hashes.size());
+    dt_tree_apply_stored(tree, worker.c_str(), /*has_parent=*/0, 0,
+                         hashes.data(), n);
+    void* res = dt_tree_find_matches(tree, hashes.data(), n, /*early=*/0);
+    for (size_t i = 0; i < dt_result_num_workers(res); ++i) {
+      checksum->fetch_add(dt_result_score(res, i) +
+                          std::strlen(dt_result_worker(res, i)));
+    }
+    dt_result_free(res);
+    if (it % 7 == 0) {
+      dt_tree_apply_removed(tree, worker.c_str(), hashes.data(), n / 2);
+    }
+    if (it % 251 == 250) {
+      dt_tree_remove_worker(tree, worker.c_str());
+    }
+    checksum->fetch_add(dt_tree_size(tree));
+    if (it % 97 == 0) {
+      dt_tree_clear_expired(tree);
+    }
+  }
+  dt_tree_remove_worker(tree, worker.c_str());
+}
+
+}  // namespace
+
+int main() {
+  // deterministic single-thread hashing sanity first
+  const char msg[] = "dynamo-tpu";
+  uint64_t h1 = dt_xxh64(msg, sizeof(msg) - 1, 0);
+  uint64_t h2 = dt_xxh64(msg, sizeof(msg) - 1, 0);
+  if (h1 != h2 || h1 == 0) {
+    std::fprintf(stderr, "hash instability\n");
+    return 1;
+  }
+
+  void* tree = dt_tree_new(/*expiration_s=*/0.5);
+  std::atomic<uint64_t> checksum{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back(worker_thread, tree, t, &checksum);
+  }
+  for (auto& th : threads) th.join();
+
+  size_t final_size = dt_tree_size(tree);
+  dt_tree_free(tree);
+  std::printf("stress ok: checksum=%llu final_size=%zu\n",
+              static_cast<unsigned long long>(checksum.load()), final_size);
+  return 0;
+}

@@ -117,7 +117,7 @@ async def test_api_store_crud_over_http(aiohttp_client=None):
             async with s.get(f"{base}/api/v1/deployments/g1") as r:
                 assert (await r.json())["spec"] == {"replicas": 2}
             async with s.put(f"{base}/api/v1/deployments/g1",
-                             json={"replicas": 5}) as r:
+                             json={"spec": {"replicas": 5}}) as r:
                 assert (await r.json())["spec"] == {"replicas": 5}
             async with s.get(f"{base}/api/v1/deployments") as r:
                 assert len((await r.json())["deployments"]) == 1
@@ -156,8 +156,16 @@ async def test_api_store_update_accepts_both_envelopes():
             async with s.put(f"{base}/api/v1/deployments/g1",
                              json={"name": "g1", "spec": {"a": 2}}) as r:
                 assert (await r.json())["spec"] == {"a": 2}
-            # non-object specs rejected
+            # a spec whose document contains a top-level "spec" key is
+            # preserved verbatim (no unwrap guessing)
+            async with s.put(f"{base}/api/v1/deployments/g1",
+                             json={"spec": {"spec": {"replicas": 2}}}) as r:
+                assert (await r.json())["spec"] == {"spec": {"replicas": 2}}
+            # bare (non-envelope) and non-object bodies rejected
             async with s.put(f"{base}/api/v1/deployments/g1", json=[1, 2]) as r:
+                assert r.status == 400
+            async with s.put(f"{base}/api/v1/deployments/g1",
+                             json={"a": 1}) as r:
                 assert r.status == 400
     finally:
         await service.stop()
