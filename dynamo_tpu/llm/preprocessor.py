@@ -460,6 +460,7 @@ class OpenAIPreprocessor(Operator):
         tasks = [asyncio.ensure_future(one_choice(i)) for i in range(n)]
         stop_task = asyncio.ensure_future(relay_stop())
         all_done = asyncio.gather(*tasks)
+        get_task = None
         try:
             while True:
                 get_task = asyncio.ensure_future(queue.get())
@@ -475,6 +476,8 @@ class OpenAIPreprocessor(Operator):
                 all_done.result()  # re-raises the first child failure
                 break
         finally:
+            if get_task is not None:
+                get_task.cancel()
             stop_task.cancel()
             all_done.cancel()
             for t in tasks:

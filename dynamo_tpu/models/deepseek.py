@@ -28,7 +28,6 @@ llama.run_layers scans each layer group, mixtral.make_moe_mlp_fn routes.
 
 from __future__ import annotations
 
-import logging
 from typing import Any, Dict, Tuple
 
 import jax
@@ -46,7 +45,6 @@ KVCache = Tuple[jax.Array, jax.Array]  # (latent c_kv, shared k_rope) caches
 # the latent cache is replicated across tp (no head dim to shard)
 CACHE_SPEC = P()
 
-_warned_pallas = False
 
 
 def init_kv_cache(
@@ -210,8 +208,63 @@ def mla_paged_attention(
     return jnp.einsum("bsht,btr->bshr", probs, c)
 
 
+def mla_attention(
+    q_lat, q_rope, c_all, kr_all, li, block_tables, positions, context_lens,
+    scale, impl="auto", mesh=None, interpret=False,
+):
+    """MLA attention dispatch over the stacked compressed caches.
+
+    Decode (S == 1) on the Pallas path uses the MLA decode kernel
+    (ops/pallas_decode.py), which indexes the layer inside HBM — no
+    per-layer gather. Other shapes (and the XLA path) gather the layer
+    and run the dense formulation. Query heads shard over "tp" under a
+    multi-device mesh; the latent caches are replicated (no head dim).
+    """
+    from ..ops.attention import resolve_attention_impl
+
+    if (
+        q_lat.shape[1] == 1
+        and resolve_attention_impl(impl) == "pallas"
+    ):
+        from ..ops.pallas_decode import mla_paged_decode_attention
+
+        def fn(ql, qr, c, kr, bt, ctx, li):
+            return mla_paged_decode_attention(
+                ql, qr, c, kr, bt, ctx, layer_idx=li, scale=scale,
+                interpret=interpret,
+            )
+
+        li_arr = jnp.asarray(li, jnp.int32)
+        if mesh is not None and mesh.size > 1:
+            dp = "dp" if q_lat.shape[0] % mesh.shape.get("dp", 1) == 0 else None
+            fn = jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(
+                    P(dp, None, "tp", None),   # q_lat [B, 1, H, R]
+                    P(dp, None, "tp", None),   # q_rope
+                    CACHE_SPEC,                # c cache (replicated)
+                    CACHE_SPEC,                # kr cache
+                    P(dp, None),               # block_tables
+                    P(dp),                     # context_lens
+                    P(),                       # layer idx
+                ),
+                out_specs=P(dp, None, "tp", None),
+                check_vma=False,
+            )
+        return fn(q_lat, q_rope, c_all, kr_all, block_tables,
+                  context_lens, li_arr)
+
+    c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
+    kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
+    return mla_paged_attention(
+        q_lat, q_rope, c_layer, kr_layer, block_tables, positions,
+        context_lens, scale,
+    )
+
+
 def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                     context_lens):
+                     context_lens, mesh=None):
     """MLA attention block for llama.run_layers."""
     h = cfg.num_heads
     nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
@@ -233,19 +286,16 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta
         )  # [B, S, 1, rd]
 
-        # in-place scatter into the stacked caches; the read side below
-        # still gathers the layer (the MLA attention is the XLA path)
+        # in-place scatter into the stacked caches
         c_all, kr_all = scatter_kv_stacked(
             c_all, kr_all, c_kv[:, :, None, :], kr, slot_mapping, li
         )
-        c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
-        kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
 
         # absorb W_uk into the query, attend over the latent cache
         q_lat = jnp.einsum("bshn,rhn->bshr", q_nope, lp["w_uk"])
-        o_lat = mla_paged_attention(
-            q_lat, q_rope, c_layer, kr_layer, block_tables, positions,
-            context_lens, scale,
+        o_lat = mla_attention(
+            q_lat, q_rope, c_all, kr_all, li, block_tables, positions,
+            context_lens, scale, impl=cfg.attention_impl, mesh=mesh,
         )
         o = jnp.einsum("bshr,rhv->bshv", o_lat, lp["w_uv"])
         delta = o.reshape(b, s, -1) @ lp["wo"]
@@ -268,23 +318,14 @@ def forward(
     """Returns (logits [B, S, V], updated (c_kv, k_rope) caches). Dense
     prefix layers then MoE layers, chained through one contiguous cache.
 
-    MLA attention always runs the XLA gather path; a Pallas MLA kernel
-    does not exist yet, so ``attention_impl``/``mesh`` are accepted for
-    interface parity but the impl setting is ignored (warned once)."""
-    from ..ops.attention import resolve_attention_impl
-
-    if resolve_attention_impl(cfg.attention_impl) == "pallas":
-        global _warned_pallas
-        if not _warned_pallas:
-            _warned_pallas = True
-            logging.getLogger(__name__).warning(
-                "attention_impl resolves to 'pallas' but MLA has no Pallas "
-                "kernel yet — using the XLA gather path"
-            )
+    Decode steps on the Pallas path run the MLA decode kernel
+    (ops/pallas_decode.py mla_paged_decode_attention); prefill and the
+    XLA path run the dense gather formulation (mla_paged_attention)."""
     b, s = tokens.shape
     hidden = params["embed"][tokens]
     attn_fn = make_mla_attn_fn(
-        cfg, b, s, positions, slot_mapping, block_tables, context_lens
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens,
+        mesh=mesh,
     )
 
     li = 0

@@ -143,6 +143,187 @@ def _decode_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype).reshape(kvh, g, d)
 
 
+def _mla_decode_kernel(
+    bt_ref,    # scalar prefetch: block tables [B, W]
+    ctx_ref,   # scalar prefetch: context lens [B]
+    li_ref,    # scalar prefetch: layer index [1]
+    ql_ref,    # [1, H, R]   latent-absorbed queries
+    qr_ref,    # [1, H, RD]  decoupled rope queries
+    c_hbm,     # [L, N, page, 1, R]  compressed latent cache (ANY)
+    kr_hbm,    # [L, N, page, 1, RD] shared rope-key cache (ANY)
+    o_ref,     # [1, H, R]
+    c_buf,     # VMEM [2, P, page, 1, R]
+    kr_buf,    # VMEM [2, P, page, 1, RD]
+    sem,       # DMA semaphores [2]
+    *,
+    scale: float,
+    block_size: int,
+    pages_per_chunk: int,
+):
+    """MLA decode: score = q_lat·c + q_rope·k_rope, output = softmax·c.
+
+    Same double-buffered page pipeline as _decode_kernel, but the two key
+    components stream together and the value IS the latent (attention
+    weights re-read c) — so each page moves R+RD bytes once, not twice.
+    """
+    b = pl.program_id(0)
+    ctx = ctx_ref[b]
+    li = li_ref[0]
+    npages = pl.cdiv(ctx, block_size)
+    nchunks = pl.cdiv(npages, pages_per_chunk)
+
+    _, h, r = ql_ref.shape
+    rd = qr_ref.shape[-1]
+    chunk_t = pages_per_chunk * block_size
+
+    def page_copy(chunk, slot, i, hbm, buf):
+        p = jnp.minimum(chunk * pages_per_chunk + i, npages - 1)
+        return pltpu.make_async_copy(
+            hbm.at[li, bt_ref[b, p]], buf.at[slot, i], sem.at[slot]
+        )
+
+    def start(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, c_hbm, c_buf).start()
+            page_copy(chunk, slot, i, kr_hbm, kr_buf).start()
+
+    def wait(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, c_hbm, c_buf).wait()
+            page_copy(chunk, slot, i, kr_hbm, kr_buf).wait()
+
+    start(0, 0)
+    ql = ql_ref[0]  # [H, R]
+    qr = qr_ref[0]  # [H, RD]
+
+    def body(ch, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(ch, 2)
+
+        @pl.when(ch + 1 < nchunks)
+        def _prefetch():
+            start(ch + 1, jax.lax.rem(ch + 1, 2))
+
+        wait(ch, slot)
+        c = c_buf[slot].reshape(chunk_t, r)
+        kr = kr_buf[slot].reshape(chunk_t, rd)
+
+        key_pos = ch * chunk_t + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_t), 1
+        )
+        valid = key_pos < ctx
+
+        s_log = (
+            jax.lax.dot_general(
+                ql, c, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                qr, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * scale                                        # [H, chunk_t]
+        s_log = jnp.where(valid, s_log, MASK_VALUE)
+
+        m_new = jnp.maximum(m, jnp.max(s_log, -1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p_unn = jnp.exp(s_log - m_new)
+        l_new = alpha * l + jnp.sum(p_unn, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_unn.astype(c.dtype), c,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [H, R]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((h, 1), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, r), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nchunks, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+)
+def mla_paged_decode_attention(
+    q_lat: jax.Array,        # [B, 1, H, R] latent-absorbed queries
+    q_rope: jax.Array,       # [B, 1, H, RD] post-RoPE decoupled queries
+    c_cache: jax.Array,      # [L, N, page, 1, R] (or 4-D single layer)
+    kr_cache: jax.Array,     # [L, N, page, 1, RD]
+    block_tables: jax.Array, # [B, W] int32
+    context_lens: jax.Array, # [B] int32
+    layer_idx: Optional[jax.Array] = None,
+    scale: float = 1.0,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """DeepSeek MLA single-token attention over the compressed cache.
+
+    Returns the latent output [B, 1, H, R] (caller applies W_uv). Same
+    role as models/deepseek.mla_paged_attention's decode case without the
+    per-layer gather: the layer is indexed inside HBM.
+    """
+    b, s, h, r = q_lat.shape
+    assert s == 1, "decode kernel is specialized to one query token"
+    rd = q_rope.shape[-1]
+    if c_cache.ndim == 4:
+        c_cache, kr_cache = c_cache[None], kr_cache[None]
+    _, _, block_size, _, _ = c_cache.shape
+    li = (
+        jnp.zeros((1,), jnp.int32)
+        if layer_idx is None
+        else jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    )
+    pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, r), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, h, rd), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, h, r), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, 1, r), c_cache.dtype
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, 1, rd), kr_cache.dtype
+            ),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _mla_decode_kernel,
+            scale=scale,
+            block_size=block_size,
+            pages_per_chunk=pages_per_chunk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, r), q_lat.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        li,
+        q_lat.reshape(b, h, r),
+        q_rope.reshape(b, h, rd),
+        c_cache,
+        kr_cache,
+    )
+    return out.reshape(b, 1, h, r)
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
 )

@@ -163,3 +163,78 @@ def test_prefill_kernel_stacked_layer_idx():
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
+
+
+def test_mla_decode_matches_xla_reference():
+    """MLA decode kernel vs models/deepseek.mla_paged_attention (interpret)."""
+    from dynamo_tpu.models.deepseek import mla_paged_attention
+    from dynamo_tpu.ops.pallas_decode import mla_paged_decode_attention
+
+    rng = np.random.default_rng(7)
+    layers, b, h, r, rd, bs, w = 2, 4, 8, 32, 16, 8, 8
+    n_blocks = b * w + 2
+    q_lat = jnp.asarray(rng.standard_normal((b, 1, h, r)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((b, 1, h, rd)), jnp.float32)
+    c_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, 1, r)), jnp.float32
+    )
+    kr_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, 1, rd)), jnp.float32
+    )
+    bt = jnp.asarray(
+        rng.permutation(n_blocks)[: b * w].reshape(b, w), jnp.int32
+    )
+    ctx = jnp.asarray([1, 13, 40, 64], jnp.int32)
+    positions = (ctx - 1)[:, None]
+    scale = 0.25
+
+    for li in range(layers):
+        ref = mla_paged_attention(
+            q_lat, q_rope, c_cache[li], kr_cache[li], bt, positions, ctx, scale
+        )
+        out = mla_paged_decode_attention(
+            q_lat, q_rope, c_cache, kr_cache, bt, ctx,
+            layer_idx=jnp.int32(li), scale=scale, pages_per_chunk=2,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"layer {li}",
+        )
+
+
+def test_mla_attention_dispatch_and_mesh():
+    """deepseek.mla_attention routes decode to the kernel, incl. tp mesh."""
+    from dynamo_tpu.engine.model_runner import build_mesh
+    from dynamo_tpu.models.deepseek import mla_attention, mla_paged_attention
+
+    rng = np.random.default_rng(8)
+    layers, b, h, r, rd, bs, w = 2, 4, 8, 32, 16, 8, 4
+    n_blocks = b * w + 1
+    q_lat = jnp.asarray(rng.standard_normal((b, 1, h, r)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((b, 1, h, rd)), jnp.float32)
+    c_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, 1, r)), jnp.float32
+    )
+    kr_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, 1, rd)), jnp.float32
+    )
+    bt = jnp.asarray(rng.permutation(n_blocks)[: b * w].reshape(b, w), jnp.int32)
+    ctx = jnp.asarray([5, 17, 30, 9], jnp.int32)
+    positions = (ctx - 1)[:, None]
+
+    ref = mla_paged_attention(
+        q_lat, q_rope, c_cache[1], kr_cache[1], bt, positions, ctx, 0.5
+    )
+    out = mla_attention(
+        q_lat, q_rope, c_cache, kr_cache, jnp.int32(1), bt, positions, ctx,
+        0.5, impl="pallas", interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    mesh = build_mesh(2, 4)
+    out = mla_attention(
+        q_lat, q_rope, c_cache, kr_cache, jnp.int32(1), bt, positions, ctx,
+        0.5, impl="pallas", mesh=mesh, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
