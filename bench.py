@@ -10,19 +10,24 @@ v5e: ~819 GB/s HBM. A value near 1.0 means the engine is at roofline;
 the reference's engines (vLLM-class) typically sit at 0.5-0.7 of roofline
 on their hardware (no absolute numbers are published in the reference —
 BASELINE.md).
+
+The attention impl defaults to "auto" (the Pallas decode kernel on TPU);
+if that path fails to compile/run on the bench host, the run retries on
+the XLA path so the metric records engine throughput rather than a crash.
 """
 
 from __future__ import annotations
 
 import json
 import time
+import traceback
 
 import numpy as np
 
 V5E_HBM_GBPS = 819e9
 
 
-def main() -> None:
+def run_once(attention_impl: str) -> dict:
     import os
 
     import jax
@@ -36,7 +41,7 @@ def main() -> None:
     mcfg = ModelConfig(**(dict(
         vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2,
-    ) if smoke else FLAGSHIP))
+    ) if smoke else FLAGSHIP), attention_impl=attention_impl)
     cfg = EngineConfig(
         model=mcfg, max_batch_size=8, max_model_len=2048, kv_block_size=16,
         num_kv_blocks=1024, dtype="float32" if smoke else "bfloat16",
@@ -95,12 +100,27 @@ def main() -> None:
     roofline_steps = V5E_HBM_GBPS / step_bytes
     roofline_toks = roofline_steps * b
 
-    print(json.dumps({
+    return {
         "metric": "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512",
         "value": round(toks_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_sec / roofline_toks, 3),
-    }))
+    }
+
+
+def main() -> None:
+    result = None
+    try:
+        result = run_once("auto")
+    except Exception:
+        traceback.print_exc()
+        print("pallas path failed; retrying on the XLA path", flush=True)
+    if result is None:
+        # retry OUTSIDE the except block: an in-flight exception would pin
+        # the failed attempt's frame (params + KV caches) in HBM while the
+        # retry allocates its own copy
+        result = run_once("xla")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

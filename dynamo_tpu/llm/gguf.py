@@ -190,8 +190,10 @@ def tokenizer_from_gguf(g: GgufFile):
       with byte fallback and the ▁ whitespace convention;
     - ``"gpt2"`` → byte-level BPE from the embedded merges.
     """
-    from tokenizers import AddedToken, Tokenizer, decoders, normalizers, pre_tokenizers
-    from tokenizers.models import BPE, Unigram
+    from tokenizers import AddedToken, Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE
+
+    from .tokenizer import build_unigram_tokenizer
 
     md = g.metadata
     tokens = md.get("tokenizer.ggml.tokens")
@@ -207,45 +209,29 @@ def tokenizer_from_gguf(g: GgufFile):
         tok = Tokenizer(BPE(vocab=vocab, merges=merges))
         tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
         tok.decoder = decoders.ByteLevel()
-    elif model_kind == "llama":
+        specials = [
+            AddedToken(tokens[i], special=True, normalized=False)
+            for i, t in enumerate(types)
+            if t == _TT_CONTROL
+        ]
+        if specials:
+            tok.add_special_tokens(specials)
+        user_defined = [
+            AddedToken(tokens[i], special=False, normalized=False)
+            for i, t in enumerate(types)
+            if t == _TT_USER_DEFINED
+        ]
+        if user_defined:
+            tok.add_tokens(user_defined)
+        return tok
+    if model_kind == "llama":
         scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
         unk_id = md.get("tokenizer.ggml.unknown_token_id")
-        if unk_id is None:
-            unk_id = next(
-                (i for i, t in enumerate(types) if t == _TT_UNKNOWN), 0
-            )
-        vocab = list(zip(tokens, (float(s) for s in scores)))
-        tok = Tokenizer(Unigram(vocab, unk_id=int(unk_id), byte_fallback=True))
-        tok.normalizer = normalizers.Sequence(
-            [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+        # SPM-semantics construction shared with tokenizer.model loading
+        return build_unigram_tokenizer(
+            tokens, [float(s) for s in scores], list(types), unk_id
         )
-        tok.decoder = decoders.Sequence([
-            decoders.Replace("▁", " "),
-            decoders.ByteFallback(),
-            decoders.Fuse(),
-            decoders.Strip(" ", 1, 0),
-        ])
-    else:
-        raise GgufError(f"unsupported GGUF tokenizer model {model_kind!r}")
-
-    specials = [
-        AddedToken(tokens[i], special=True, normalized=False)
-        for i, t in enumerate(types)
-        if t == _TT_CONTROL
-    ]
-    if specials:
-        tok.add_special_tokens(specials)
-    # USER_DEFINED tokens (llama.cpp converters mark SPM added_tokens this
-    # way, e.g. chat markers) must match whole pre-normalization but stay
-    # visible in decode — added, not special
-    user_defined = [
-        AddedToken(tokens[i], special=False, normalized=False)
-        for i, t in enumerate(types)
-        if t == _TT_USER_DEFINED
-    ]
-    if user_defined:
-        tok.add_tokens(user_defined)
-    return tok
+    raise GgufError(f"unsupported GGUF tokenizer model {model_kind!r}")
 
 
 def mdc_from_gguf(path: str, display_name: Optional[str] = None,

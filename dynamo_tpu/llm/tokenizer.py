@@ -16,6 +16,145 @@ from tokenizers import Tokenizer
 
 REPLACEMENT_CHAR = "�"
 
+# SentencePiece piece types (sentencepiece.proto; same semantics GGUF
+# re-encodes in tokenizer.ggml.token_type — llm/gguf.py)
+_SPM_NORMAL, _SPM_UNKNOWN, _SPM_CONTROL = 1, 2, 3
+_SPM_USER_DEFINED, _SPM_UNUSED, _SPM_BYTE = 4, 5, 6
+
+
+def build_unigram_tokenizer(tokens, scores, types, unk_id=None) -> Tokenizer:
+    """SentencePiece-semantics Unigram tokenizer from raw vocab data.
+
+    Shared by the GGUF reconstruction (llm/gguf.py) and tokenizer.model
+    loading: ▁ whitespace convention, byte fallback, CONTROL pieces
+    special, USER_DEFINED pieces matched whole but visible in decode.
+    """
+    from tokenizers import AddedToken, decoders, normalizers
+    from tokenizers.models import Unigram
+
+    if unk_id is None:
+        unk_id = next(
+            (i for i, t in enumerate(types) if t == _SPM_UNKNOWN), 0
+        )
+    vocab = list(zip(tokens, scores))
+    tok = Tokenizer(Unigram(vocab, unk_id=int(unk_id), byte_fallback=True))
+    tok.normalizer = normalizers.Sequence(
+        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+    )
+    tok.decoder = decoders.Sequence([
+        decoders.Replace("▁", " "),
+        decoders.ByteFallback(),
+        decoders.Fuse(),
+        decoders.Strip(" ", 1, 0),
+    ])
+    specials = [
+        AddedToken(tokens[i], special=True, normalized=False)
+        for i, t in enumerate(types)
+        if t == _SPM_CONTROL
+    ]
+    if specials:
+        tok.add_special_tokens(specials)
+    user_defined = [
+        AddedToken(tokens[i], special=False, normalized=False)
+        for i, t in enumerate(types)
+        if t == _SPM_USER_DEFINED
+    ]
+    if user_defined:
+        tok.add_tokens(user_defined)
+    return tok
+
+
+def tokenizer_from_spm(path: str) -> Tokenizer:
+    """Build a tokenizer from a SentencePiece ``tokenizer.model``.
+
+    Parses the SPM protobuf through transformers' bundled schema (no
+    sentencepiece package needed) and rebuilds the equivalent fast
+    tokenizer (reference analog: lib/llm/src/tokenizers.rs SentencePiece
+    support — the coverage gap called out in round 1).
+    """
+    from transformers.convert_slow_tokenizer import import_protobuf
+
+    model_pb2 = import_protobuf()
+    proto = model_pb2.ModelProto()
+    with open(path, "rb") as f:
+        proto.ParseFromString(f.read())
+    tokens = [p.piece for p in proto.pieces]
+    scores = [p.score for p in proto.pieces]
+    types = [int(p.type) for p in proto.pieces]
+    unk_id = proto.trainer_spec.unk_id if proto.HasField("trainer_spec") else None
+
+    model_type = (
+        int(proto.trainer_spec.model_type)
+        if proto.HasField("trainer_spec") else 1
+    )
+    if model_type == 2:  # SPM BPE (original Llama/Mistral exports)
+        return _build_spm_bpe_tokenizer(tokens, types, unk_id)
+    if model_type != 1:
+        raise ValueError(
+            f"unsupported SentencePiece model_type {model_type} in {path} "
+            "(supported: 1=unigram, 2=bpe)"
+        )
+    return build_unigram_tokenizer(tokens, scores, types, unk_id)
+
+
+def _build_spm_bpe_tokenizer(tokens, types, unk_id=None) -> Tokenizer:
+    """SPM-BPE (model_type=2) reconstruction.
+
+    SPM-BPE merge priority is the merged piece's vocab rank: recover
+    merges by splitting each piece at every boundary where both halves
+    exist, ordered by the merged piece's id (the public
+    SentencePieceExtractor recipe), then run standard BPE with byte
+    fallback under the ▁ whitespace convention.
+    """
+    from tokenizers import AddedToken, decoders, normalizers
+    from tokenizers.models import BPE
+
+    vocab = {t: i for i, t in enumerate(tokens)}
+    merges = []
+    for piece, piece_id in vocab.items():
+        if len(piece) < 2 or types[piece_id] != _SPM_NORMAL:
+            continue
+        local = [
+            (piece[:i], piece[i:])
+            for i in range(1, len(piece))
+            if piece[:i] in vocab and piece[i:] in vocab
+        ]
+        # prefer the split whose halves merged earliest (lowest max rank)
+        local.sort(key=lambda ab: max(vocab[ab[0]], vocab[ab[1]]))
+        merges.extend((piece_id, ab) for ab in local[:1])
+    merges = [ab for _, ab in sorted(merges)]
+
+    if unk_id is None:
+        unk_id = next((i for i, t in enumerate(types) if t == _SPM_UNKNOWN), 0)
+    tok = Tokenizer(BPE(
+        vocab=vocab, merges=merges, unk_token=tokens[int(unk_id)],
+        fuse_unk=True, byte_fallback=True,
+    ))
+    tok.normalizer = normalizers.Sequence(
+        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+    )
+    tok.decoder = decoders.Sequence([
+        decoders.Replace("▁", " "),
+        decoders.ByteFallback(),
+        decoders.Fuse(),
+        decoders.Strip(" ", 1, 0),
+    ])
+    specials = [
+        AddedToken(tokens[i], special=True, normalized=False)
+        for i, t in enumerate(types)
+        if t == _SPM_CONTROL
+    ]
+    if specials:
+        tok.add_special_tokens(specials)
+    user_defined = [
+        AddedToken(tokens[i], special=False, normalized=False)
+        for i, t in enumerate(types)
+        if t == _SPM_USER_DEFINED
+    ]
+    if user_defined:
+        tok.add_tokens(user_defined)
+    return tok
+
 
 class HFTokenizer:
     """Thin wrapper over ``tokenizers.Tokenizer`` with the framework surface."""
@@ -30,9 +169,15 @@ class HFTokenizer:
     @classmethod
     def from_pretrained_dir(cls, model_dir: str) -> "HFTokenizer":
         path = os.path.join(model_dir, "tokenizer.json")
-        if not os.path.exists(path):
-            raise FileNotFoundError(f"no tokenizer.json under {model_dir}")
-        return cls.from_file(path)
+        if os.path.exists(path):
+            return cls.from_file(path)
+        spm = os.path.join(model_dir, "tokenizer.model")
+        if os.path.exists(spm):
+            # SentencePiece-only snapshots (original Llama/Mistral exports)
+            return cls(tokenizer_from_spm(spm))
+        raise FileNotFoundError(
+            f"no tokenizer.json or tokenizer.model under {model_dir}"
+        )
 
     @classmethod
     def from_model_path(cls, model_path: str) -> "HFTokenizer":

@@ -65,3 +65,62 @@ def test_token_sequence_init_with_tokens():
     seq = TokenSequence(list(range(10)), block_size=4)
     assert len(seq.blocks) == 2
     assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
+
+
+def test_spm_tokenizer_model_loading(tmp_path):
+    """tokenizer.model-only snapshots load via the SPM protobuf path."""
+    from transformers.convert_slow_tokenizer import import_protobuf
+
+    from dynamo_tpu.llm.tokenizer import HFTokenizer
+
+    model_pb2 = import_protobuf()
+    proto = model_pb2.ModelProto()
+    pieces = [
+        ("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+        ("▁hello", -1.0, 1), ("▁world", -1.0, 1),
+        ("▁", -2.0, 1), ("h", -5.0, 1), ("e", -5.0, 1),
+        ("l", -5.0, 1), ("o", -5.0, 1), ("w", -5.0, 1), ("r", -5.0, 1),
+        ("d", -5.0, 1),
+    ]
+    for piece, score, tp in pieces:
+        p = proto.pieces.add()
+        p.piece, p.score, p.type = piece, score, tp
+    proto.trainer_spec.unk_id = 0
+    path = tmp_path / "tokenizer.model"
+    path.write_bytes(proto.SerializeToString())
+
+    tok = HFTokenizer.from_pretrained_dir(str(tmp_path))
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    assert tok.id_to_token(ids[0]) == "▁hello"
+
+
+def test_spm_bpe_model_type(tmp_path):
+    """SPM BPE protos (model_type=2, original Llama exports) reconstruct
+    merge order from vocab ranks."""
+    from transformers.convert_slow_tokenizer import import_protobuf
+
+    from dynamo_tpu.llm.tokenizer import HFTokenizer
+
+    model_pb2 = import_protobuf()
+    proto = model_pb2.ModelProto()
+    # ranks encode merge priority: he < ll < llo < hello
+    pieces = [
+        ("<unk>", 0.0, 2), ("<s>", 0.0, 3),
+        ("▁", -1.0, 1),
+        ("h", -2.0, 1), ("e", -2.0, 1), ("l", -2.0, 1), ("o", -2.0, 1),
+        ("he", -3.0, 1), ("ll", -3.5, 1), ("llo", -4.0, 1),
+        ("hello", -5.0, 1),
+    ]
+    for piece, score, tp in pieces:
+        p = proto.pieces.add()
+        p.piece, p.score, p.type = piece, score, tp
+    proto.trainer_spec.unk_id = 0
+    proto.trainer_spec.model_type = 2  # BPE
+    (tmp_path / "tokenizer.model").write_bytes(proto.SerializeToString())
+
+    tok = HFTokenizer.from_pretrained_dir(str(tmp_path))
+    ids = tok.encode("hello")
+    names = [tok.id_to_token(i) for i in ids]
+    assert names == ["▁", "hello"], names
+    assert tok.decode(ids) == "hello"
