@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import json
 import time
-import traceback
 
 import numpy as np
 
@@ -108,17 +107,47 @@ def run_once(attention_impl: str) -> dict:
     }
 
 
-def main() -> None:
-    result = None
+def _run_impl_subprocess(impl: str, timeout_s: float):
+    """Run one bench attempt in a child process with a hard timeout.
+
+    A Mosaic compile can (rarely) hang rather than fail; an in-process
+    attempt would then wedge the whole bench. The child prints its result
+    JSON on the last line; timeout/crash → None and the caller falls back.
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import json; from bench import run_once; "
+        f"print('BENCH_RESULT ' + json.dumps(run_once({impl!r})))"
+    )
     try:
-        result = run_once("auto")
-    except Exception:
-        traceback.print_exc()
-        print("pallas path failed; retrying on the XLA path", flush=True)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, cwd=__import__("os").path.dirname(
+                __import__("os").path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench[{impl}] timed out after {timeout_s:.0f}s", flush=True)
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            return json.loads(line[len("BENCH_RESULT "):])
+    sys.stderr.write(proc.stderr[-4000:])
+    print(f"bench[{impl}] failed (rc={proc.returncode})", flush=True)
+    return None
+
+
+def main() -> None:
+    # preferred impl first (subprocess + timeout guards against compile
+    # hangs), then the XLA path as fallback so the metric records engine
+    # throughput rather than a crash
+    import os
+
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
+    result = _run_impl_subprocess("auto", timeout_s=timeout_s)
     if result is None:
-        # retry OUTSIDE the except block: an in-flight exception would pin
-        # the failed attempt's frame (params + KV caches) in HBM while the
-        # retry allocates its own copy
+        print("preferred path failed; retrying on the XLA path", flush=True)
         result = run_once("xla")
     print(json.dumps(result))
 

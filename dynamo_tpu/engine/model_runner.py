@@ -314,9 +314,19 @@ class ModelRunner:
 
     def _build_block_ops(self):
         repl = NamedSharding(self.mesh, P())
-
+        # transferred blocks use the LOGICAL trailing dims — the cache's
+        # lane padding (ops/attention.lane_pad) stays on-device and off
+        # the wire; gather slices it away, scatter re-pads with zeros
+        cfg = self.config.model
+        if getattr(cfg, "kv_lora_rank", 0):
+            true_dims = (cfg.kv_lora_rank, cfg.qk_rope_head_dim)
+        else:
+            true_dims = (cfg.head_dim, cfg.head_dim)
         def gather(k_cache, v_cache, ids):
-            return k_cache[:, ids], v_cache[:, ids]
+            return (
+                k_cache[:, ids, ..., : true_dims[0]],
+                v_cache[:, ids, ..., : true_dims[1]],
+            )
 
         self._gather_jit = jax.jit(
             gather,
@@ -324,7 +334,17 @@ class ModelRunner:
             out_shardings=(repl, repl),
         )
 
+        def _repad(blocks, dim):
+            short = dim - blocks.shape[-1]
+            if short > 0:
+                blocks = jnp.pad(
+                    blocks, [(0, 0)] * (blocks.ndim - 1) + [(0, short)]
+                )
+            return blocks
+
         def scatter(k_cache, v_cache, ids, k_blocks, v_blocks):
+            k_blocks = _repad(k_blocks, k_cache.shape[-1])
+            v_blocks = _repad(v_blocks, v_cache.shape[-1])
             return (
                 k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype)),
                 v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype)),

@@ -190,10 +190,10 @@ def tokenizer_from_gguf(g: GgufFile):
       with byte fallback and the ▁ whitespace convention;
     - ``"gpt2"`` → byte-level BPE from the embedded merges.
     """
-    from tokenizers import AddedToken, Tokenizer, decoders, pre_tokenizers
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
     from tokenizers.models import BPE
 
-    from .tokenizer import build_unigram_tokenizer
+    from .tokenizer import add_spm_added_tokens, build_unigram_tokenizer
 
     md = g.metadata
     tokens = md.get("tokenizer.ggml.tokens")
@@ -209,20 +209,8 @@ def tokenizer_from_gguf(g: GgufFile):
         tok = Tokenizer(BPE(vocab=vocab, merges=merges))
         tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
         tok.decoder = decoders.ByteLevel()
-        specials = [
-            AddedToken(tokens[i], special=True, normalized=False)
-            for i, t in enumerate(types)
-            if t == _TT_CONTROL
-        ]
-        if specials:
-            tok.add_special_tokens(specials)
-        user_defined = [
-            AddedToken(tokens[i], special=False, normalized=False)
-            for i, t in enumerate(types)
-            if t == _TT_USER_DEFINED
-        ]
-        if user_defined:
-            tok.add_tokens(user_defined)
+        # GGUF token_type reuses the SPM piece-type ids (_TT_* == _SPM_*)
+        add_spm_added_tokens(tok, tokens, types)
         return tok
     if model_kind == "llama":
         scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)

@@ -22,31 +22,12 @@ _SPM_NORMAL, _SPM_UNKNOWN, _SPM_CONTROL = 1, 2, 3
 _SPM_USER_DEFINED, _SPM_UNUSED, _SPM_BYTE = 4, 5, 6
 
 
-def build_unigram_tokenizer(tokens, scores, types, unk_id=None) -> Tokenizer:
-    """SentencePiece-semantics Unigram tokenizer from raw vocab data.
+def add_spm_added_tokens(tok: Tokenizer, tokens, types) -> None:
+    """Register CONTROL pieces as specials and USER_DEFINED pieces as
+    whole-match tokens (shared by every SPM-semantics reconstruction:
+    the two builders here and llm/gguf.py's gpt2 branch)."""
+    from tokenizers import AddedToken
 
-    Shared by the GGUF reconstruction (llm/gguf.py) and tokenizer.model
-    loading: ▁ whitespace convention, byte fallback, CONTROL pieces
-    special, USER_DEFINED pieces matched whole but visible in decode.
-    """
-    from tokenizers import AddedToken, decoders, normalizers
-    from tokenizers.models import Unigram
-
-    if unk_id is None:
-        unk_id = next(
-            (i for i, t in enumerate(types) if t == _SPM_UNKNOWN), 0
-        )
-    vocab = list(zip(tokens, scores))
-    tok = Tokenizer(Unigram(vocab, unk_id=int(unk_id), byte_fallback=True))
-    tok.normalizer = normalizers.Sequence(
-        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
-    )
-    tok.decoder = decoders.Sequence([
-        decoders.Replace("▁", " "),
-        decoders.ByteFallback(),
-        decoders.Fuse(),
-        decoders.Strip(" ", 1, 0),
-    ])
     specials = [
         AddedToken(tokens[i], special=True, normalized=False)
         for i, t in enumerate(types)
@@ -61,6 +42,41 @@ def build_unigram_tokenizer(tokens, scores, types, unk_id=None) -> Tokenizer:
     ]
     if user_defined:
         tok.add_tokens(user_defined)
+
+
+def _set_spm_surface(tok: Tokenizer) -> None:
+    """The ▁ whitespace convention: prepend/replace on the way in,
+    replace/byte-fallback/fuse/strip on the way out."""
+    from tokenizers import decoders, normalizers
+
+    tok.normalizer = normalizers.Sequence(
+        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+    )
+    tok.decoder = decoders.Sequence([
+        decoders.Replace("▁", " "),
+        decoders.ByteFallback(),
+        decoders.Fuse(),
+        decoders.Strip(" ", 1, 0),
+    ])
+
+
+def build_unigram_tokenizer(tokens, scores, types, unk_id=None) -> Tokenizer:
+    """SentencePiece-semantics Unigram tokenizer from raw vocab data.
+
+    Shared by the GGUF reconstruction (llm/gguf.py) and tokenizer.model
+    loading: ▁ whitespace convention, byte fallback, CONTROL pieces
+    special, USER_DEFINED pieces matched whole but visible in decode.
+    """
+    from tokenizers.models import Unigram
+
+    if unk_id is None:
+        unk_id = next(
+            (i for i, t in enumerate(types) if t == _SPM_UNKNOWN), 0
+        )
+    vocab = list(zip(tokens, scores))
+    tok = Tokenizer(Unigram(vocab, unk_id=int(unk_id), byte_fallback=True))
+    _set_spm_surface(tok)
+    add_spm_added_tokens(tok, tokens, types)
     return tok
 
 
@@ -101,12 +117,14 @@ def _build_spm_bpe_tokenizer(tokens, types, unk_id=None) -> Tokenizer:
     """SPM-BPE (model_type=2) reconstruction.
 
     SPM-BPE merge priority is the merged piece's vocab rank: recover
-    merges by splitting each piece at every boundary where both halves
-    exist, ordered by the merged piece's id (the public
-    SentencePieceExtractor recipe), then run standard BPE with byte
-    fallback under the ▁ whitespace convention.
+    merges by splitting each piece at EVERY boundary where both halves
+    exist (the public SentencePieceExtractor recipe keeps all valid
+    splits — a piece can be reachable through several merge paths, and
+    dropping one can make the piece unreachable when an earlier merge
+    consumes its preferred split), ordered by the merged piece's id,
+    then run standard BPE with byte fallback under the ▁ whitespace
+    convention.
     """
-    from tokenizers import AddedToken, decoders, normalizers
     from tokenizers.models import BPE
 
     vocab = {t: i for i, t in enumerate(tokens)}
@@ -119,9 +137,10 @@ def _build_spm_bpe_tokenizer(tokens, types, unk_id=None) -> Tokenizer:
             for i in range(1, len(piece))
             if piece[:i] in vocab and piece[i:] in vocab
         ]
-        # prefer the split whose halves merged earliest (lowest max rank)
+        # within a piece, order splits by the rank at which their halves
+        # became available (earliest-merged halves first)
         local.sort(key=lambda ab: max(vocab[ab[0]], vocab[ab[1]]))
-        merges.extend((piece_id, ab) for ab in local[:1])
+        merges.extend(((piece_id, j), ab) for j, ab in enumerate(local))
     merges = [ab for _, ab in sorted(merges)]
 
     if unk_id is None:
@@ -130,29 +149,8 @@ def _build_spm_bpe_tokenizer(tokens, types, unk_id=None) -> Tokenizer:
         vocab=vocab, merges=merges, unk_token=tokens[int(unk_id)],
         fuse_unk=True, byte_fallback=True,
     ))
-    tok.normalizer = normalizers.Sequence(
-        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
-    )
-    tok.decoder = decoders.Sequence([
-        decoders.Replace("▁", " "),
-        decoders.ByteFallback(),
-        decoders.Fuse(),
-        decoders.Strip(" ", 1, 0),
-    ])
-    specials = [
-        AddedToken(tokens[i], special=True, normalized=False)
-        for i, t in enumerate(types)
-        if t == _SPM_CONTROL
-    ]
-    if specials:
-        tok.add_special_tokens(specials)
-    user_defined = [
-        AddedToken(tokens[i], special=False, normalized=False)
-        for i, t in enumerate(types)
-        if t == _SPM_USER_DEFINED
-    ]
-    if user_defined:
-        tok.add_tokens(user_defined)
+    _set_spm_surface(tok)
+    add_spm_added_tokens(tok, tokens, types)
     return tok
 
 

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from ..ops.attention import scatter_kv_stacked
+from ..ops.attention import lane_pad, scatter_kv_stacked
 from .llama import _swiglu_mlp, apply_rope, base_specs, lm_logits, rms_norm, run_layers
 from .mixtral import make_moe_mlp_fn
 
@@ -50,12 +50,18 @@ CACHE_SPEC = P()
 def init_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ) -> KVCache:
-    """Compressed cache: c_kv [L,N,bs,1,r] + k_rope [L,N,bs,1,rd]."""
+    """Compressed cache: c_kv [L,N,bs,1,r] + k_rope [L,N,bs,1,rd].
+
+    Minor dims are lane-padded (ops/attention.lane_pad): free in HBM and
+    required by the MLA decode kernel's manual page DMA."""
     c = jnp.zeros(
-        (cfg.num_layers, num_blocks, block_size, 1, cfg.kv_lora_rank), dtype
+        (cfg.num_layers, num_blocks, block_size, 1, lane_pad(cfg.kv_lora_rank)),
+        dtype,
     )
     kr = jnp.zeros(
-        (cfg.num_layers, num_blocks, block_size, 1, cfg.qk_rope_head_dim), dtype
+        (cfg.num_layers, num_blocks, block_size, 1,
+         lane_pad(cfg.qk_rope_head_dim)),
+        dtype,
     )
     return c, kr
 
@@ -220,7 +226,13 @@ def mla_attention(
     and run the dense formulation. Query heads shard over "tp" under a
     multi-device mesh; the latent caches are replicated (no head dim).
     """
-    from ..ops.attention import resolve_attention_impl
+    from ..ops.attention import _pad_minor, resolve_attention_impl
+
+    # caches carry lane padding; zero-padded queries score 0 against the
+    # zero pad lanes, and the padded latent output is sliced back below
+    r = q_lat.shape[-1]
+    q_lat = _pad_minor(q_lat, c_all.shape[-1])
+    q_rope = _pad_minor(q_rope, kr_all.shape[-1])
 
     if (
         q_lat.shape[1] == 1
@@ -253,14 +265,14 @@ def mla_attention(
                 check_vma=False,
             )
         return fn(q_lat, q_rope, c_all, kr_all, block_tables,
-                  context_lens, li_arr)
+                  context_lens, li_arr)[..., :r]
 
     c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
     kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
     return mla_paged_attention(
         q_lat, q_rope, c_layer, kr_layer, block_tables, positions,
         context_lens, scale,
-    )
+    )[..., :r]
 
 
 def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,

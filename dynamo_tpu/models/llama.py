@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from ..ops.attention import attention, scatter_kv_stacked
+from ..ops.attention import attention, lane_pad, scatter_kv_stacked
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]  # k, v: [L, N_blocks, bs, KVH, D]
@@ -121,7 +121,12 @@ def param_specs(params: Params) -> Dict:
 def init_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ) -> KVCache:
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    # minor dim lane-padded: physically free (XLA tiles HBM to 128 lanes)
+    # and required by the manual-DMA decode kernel (ops/attention.lane_pad)
+    shape = (
+        cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+        lane_pad(cfg.head_dim),
+    )
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

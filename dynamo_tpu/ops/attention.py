@@ -20,6 +20,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+LANE = 128  # TPU vector lane width — HBM layouts tile the minor dim to this
+
+
+def lane_pad(d: int) -> int:
+    """Smallest multiple of LANE >= d.
+
+    KV caches are allocated with their minor (head/latent) dim padded to
+    this: Mosaic requires DMA slices of HBM refs to be lane-aligned, and
+    XLA pads the tiled HBM layout to 128 lanes anyway — so a head_dim-64
+    cache already occupies 128 lanes physically; making the padding
+    explicit costs no memory and unlocks the manual-DMA decode kernels
+    (ops/pallas_decode.py). Pad lanes are kept zero (zero-padded writes)
+    so padded q · padded k contributes nothing to attention scores.
+    """
+    return -(-d // LANE) * LANE
+
+
+def _pad_minor(x: jax.Array, d: int) -> jax.Array:
+    """Zero-pad the trailing dim of x up to d (no-op if already d)."""
+    if x.shape[-1] == d:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, d - x.shape[-1])]
+    return jnp.pad(x, pad)
+
 
 def scatter_kv(
     k_cache: jax.Array,  # [N_blocks, block_size, KVH, D] (one layer)
@@ -34,6 +58,8 @@ def scatter_kv(
     latent in "k" and the shared rope key in "v" (models/deepseek.py)."""
     n_blocks, block_size, kvh, dk = k_cache.shape
     vh, dv = v_cache.shape[-2:]
+    new_k = _pad_minor(new_k, dk)
+    new_v = _pad_minor(new_v, dv)
     flat_k = k_cache.reshape(n_blocks * block_size, kvh, dk)
     flat_v = v_cache.reshape(n_blocks * block_size, vh, dv)
     idx = slot_mapping.reshape(-1)
@@ -65,6 +91,8 @@ def scatter_kv_stacked(
     """
     l, n_blocks, block_size, kvh, dk = k_all.shape
     vh, dv = v_all.shape[-2:]
+    new_k = _pad_minor(new_k, dk)
+    new_v = _pad_minor(new_v, dv)
     idx = slot_mapping.reshape(-1)
     # drop sentinel AND per-layer overflow → past-the-end: a negative index
     # would wrap (see scatter_kv), and a positive out-of-range one would land
@@ -159,12 +187,17 @@ def attention(
     """
     stacked = k_cache.ndim == 5
     li = jnp.asarray(0 if layer_idx is None else layer_idx, jnp.int32)
+    # scale from the TRUE head dim; the cache may carry lane padding
+    d = q.shape[-1]
+    scale = d ** -0.5
+    dk = k_cache.shape[-1]
+    q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
     if resolve_attention_impl(impl) == "xla":
         if stacked:
             k_cache = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
             v_cache = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
-                               context_lens)
+                               context_lens, scale=scale)[..., :d]
 
     from .pallas_attention import paged_flash_attention
     from .pallas_decode import paged_decode_attention
@@ -173,10 +206,14 @@ def attention(
         k_cache, v_cache = k_cache[None], v_cache[None]
     decode = q.shape[1] == 1
     if decode:
-        fn = functools.partial(paged_decode_attention, interpret=interpret)
+        fn = functools.partial(
+            paged_decode_attention, scale=scale, interpret=interpret
+        )
         args = (q, k_cache, v_cache, block_tables, context_lens, li)
     else:
-        fn = functools.partial(paged_flash_attention, interpret=interpret)
+        fn = functools.partial(
+            paged_flash_attention, scale=scale, interpret=interpret
+        )
         base_pos = positions[:, 0].astype(jnp.int32)
         args = (q, k_cache, v_cache, block_tables, base_pos, context_lens, li)
     if mesh is not None and mesh.size > 1:
@@ -200,7 +237,7 @@ def attention(
             out_specs=P(dp, None, "tp", None),
             check_vma=False,  # pallas out_shape carries no vma annotation
         )
-    return fn(*args)
+    return fn(*args)[..., :d]
 
 
 def prefill_attention(

@@ -55,6 +55,17 @@ def _decode_kernel(
     block_size: int,
     pages_per_chunk: int,
 ):
+    """One grid step = one batch row; a fori_loop walks only LIVE chunks.
+
+    Compute is ONE pair of MXU dots per chunk for ALL kv heads: the chunk
+    KV flattens to [chunk_t * KVH, D] and every q row scores against every
+    (token, head) column; a head-match mask (+ the validity mask) drives
+    cross-head scores to MASK_VALUE, so their softmax weight is exactly 0
+    and the single probs @ V dot sums only same-head contributions. This
+    trades KVH× redundant MXU flops (trivial at decode shapes) for not
+    issuing KVH tiny [G, chunk] dots per chunk — decode attention is DMA
+    bound; op-issue overhead was the previous kernel's limiter.
+    """
     b = pl.program_id(0)
     ctx = ctx_ref[b]
     li = li_ref[0]
@@ -64,6 +75,7 @@ def _decode_kernel(
     _, kvh, g, d = q_ref.shape
     rows = kvh * g
     chunk_t = pages_per_chunk * block_size
+    cols = chunk_t * kvh
 
     def page_copy(chunk, slot, i, hbm, buf):
         # pages past the live range duplicate the last live page — their
@@ -84,10 +96,17 @@ def _decode_kernel(
             page_copy(chunk, slot, i, v_hbm, v_buf).wait()
 
     start(0, 0)
-    q = q_ref[0].reshape(rows, d)  # [KVH*G, D]
+    q = q_ref[0].reshape(rows, d)  # [KVH*G, D], rows ordered (head, group)
+
+    # column j of the flattened chunk is (token j // KVH, head j % KVH);
+    # row r serves head r // G — both masks are plain iota arithmetic
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) % kvh
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) // g
+    head_match = col_head == row_head                    # loop-invariant
+    col_tok = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) // kvh
 
     def body(c, carry):
-        m, l, acc = carry
+        m, l, acc = carry                                 # [rows,128]x2, [rows,D]
         slot = jax.lax.rem(c, 2)
 
         @pl.when(c + 1 < nchunks)
@@ -95,52 +114,42 @@ def _decode_kernel(
             start(c + 1, jax.lax.rem(c + 1, 2))
 
         wait(c, slot)
-        k = k_buf[slot].reshape(chunk_t, kvh, d)
-        v = v_buf[slot].reshape(chunk_t, kvh, d)
+        k = k_buf[slot].reshape(cols, d)                  # [(tok, head), D]
+        v = v_buf[slot].reshape(cols, d)
 
         # decode causality: the query is the newest token, so every key
         # with position < ctx is visible — a pure validity mask.
-        key_pos = c * chunk_t + jax.lax.broadcasted_iota(
-            jnp.int32, (1, chunk_t), 1
-        )
-        valid = key_pos < ctx                             # [1, chunk_t]
+        mask = jnp.logical_and(head_match, c * chunk_t + col_tok < ctx)
 
-        ms, ls, accs = [], [], []
-        for h in range(kvh):
-            s_log = jax.lax.dot_general(
-                q[h * g : (h + 1) * g], k[:, h, :],
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                                     # [G, chunk_t]
-            s_log = jnp.where(valid, s_log, MASK_VALUE)
+        s_log = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                         # [rows, cols]
+        s_log = jnp.where(mask, s_log, MASK_VALUE)
 
-            m_h = m[h * g : (h + 1) * g]
-            m_new = jnp.maximum(m_h, jnp.max(s_log, -1, keepdims=True))
-            alpha = jnp.exp(m_h - m_new)
-            p_unn = jnp.exp(s_log - m_new)
-            l_new = alpha * l[h * g : (h + 1) * g] + jnp.sum(
-                p_unn, -1, keepdims=True
-            )
-            pv = jax.lax.dot_general(
-                p_unn.astype(v.dtype), v[:, h, :],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                             # [G, D]
-            ms.append(m_new)
-            ls.append(l_new)
-            accs.append(acc[h * g : (h + 1) * g] * alpha + pv)
-        return (
-            jnp.concatenate(ms, 0),
-            jnp.concatenate(ls, 0),
-            jnp.concatenate(accs, 0),
-        )
+        m_cur = jnp.max(s_log, -1, keepdims=True)         # [rows, 1]
+        m_new = jnp.maximum(m, m_cur)                     # [rows, 128]
+        alpha = jnp.exp(m - m_new)
+        p_unn = jnp.exp(s_log - m_new[:, 0:1])            # [rows, cols]
+        l_new = alpha * l + jnp.sum(p_unn, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_unn.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # [rows, D]
+        return m_new, l_new, acc * alpha[:, 0:1] + pv
 
-    m0 = jnp.full((rows, 1), MASK_VALUE, jnp.float32)
-    l0 = jnp.zeros((rows, 1), jnp.float32)
+    # m/l ride as [rows, 128] lane-broadcast carries (the layout Mosaic
+    # handles without sub-lane-width relayouts; same trick as the scratch
+    # accumulators in pallas_attention.py)
+    m0 = jnp.full((rows, 128), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((rows, 128), jnp.float32)
     acc0 = jnp.zeros((rows, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nchunks, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype).reshape(kvh, g, d)
+    l1 = l[:, 0:1]
+    l1 = jnp.where(l1 == 0.0, 1.0, l1)
+    o_ref[0] = (acc / l1).astype(o_ref.dtype).reshape(kvh, g, d)
 
 
 def _mla_decode_kernel(
@@ -227,21 +236,23 @@ def _mla_decode_kernel(
 
         m_new = jnp.maximum(m, jnp.max(s_log, -1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p_unn = jnp.exp(s_log - m_new)
+        p_unn = jnp.exp(s_log - m_new[:, 0:1])
         l_new = alpha * l + jnp.sum(p_unn, -1, keepdims=True)
         pv = jax.lax.dot_general(
             p_unn.astype(c.dtype), c,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                # [H, R]
-        return m_new, l_new, acc * alpha + pv
+        return m_new, l_new, acc * alpha[:, 0:1] + pv
 
-    m0 = jnp.full((h, 1), MASK_VALUE, jnp.float32)
-    l0 = jnp.zeros((h, 1), jnp.float32)
+    # [H, 128] lane-broadcast running stats (see _decode_kernel)
+    m0 = jnp.full((h, 128), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((h, 128), jnp.float32)
     acc0 = jnp.zeros((h, r), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nchunks, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l1 = l[:, 0:1]
+    l1 = jnp.where(l1 == 0.0, 1.0, l1)
+    o_ref[0] = (acc / l1).astype(o_ref.dtype)
 
 
 @functools.partial(

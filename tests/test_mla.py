@@ -31,8 +31,10 @@ def test_cache_is_compressed():
     """Per-token cache line is r + rope_dim, independent of heads."""
     cfg = ModelConfig(**MLA_CFG)
     c, kr = deepseek.init_kv_cache(cfg, num_blocks=8, block_size=4)
-    assert c.shape == (2, 8, 4, 1, 16)    # kv_lora_rank
-    assert kr.shape == (2, 8, 4, 1, 8)    # qk_rope_head_dim
+    # minor dims are lane-padded to 128 (physically free in the tiled HBM
+    # layout; required by the manual-DMA decode kernel)
+    assert c.shape == (2, 8, 4, 1, 128)   # lane_pad(kv_lora_rank=16)
+    assert kr.shape == (2, 8, 4, 1, 128)  # lane_pad(qk_rope_head_dim=8)
     # vs a GQA cache of the same config: 2 * kvh * head_dim per token
     mla_line = 16 + 8
     gqa_line = 2 * 4 * 16
