@@ -96,6 +96,13 @@ def load_mdc(flags):
     # accept a HF repo id anywhere a path is accepted (reference:
     # launch/dynamo-run/src/hub.rs) — local dirs pass through untouched
     flags.model_path = resolve_model_path(flags.model_path)
+    if flags.model_path.endswith(".gguf"):
+        from ..llm.gguf import mdc_from_gguf
+
+        return mdc_from_gguf(
+            flags.model_path, flags.model_name,
+            kv_block_size=flags.kv_block_size,
+        )
     return ModelDeploymentCard.from_local_path(
         flags.model_path, flags.model_name, kv_block_size=flags.kv_block_size
     )

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import models
 from ..models import llama
+from ..ops.attention import _pad_minor
 from .config import EngineConfig
 from .sampling import SamplingParams, logprobs_for, sample
 
@@ -334,17 +335,9 @@ class ModelRunner:
             out_shardings=(repl, repl),
         )
 
-        def _repad(blocks, dim):
-            short = dim - blocks.shape[-1]
-            if short > 0:
-                blocks = jnp.pad(
-                    blocks, [(0, 0)] * (blocks.ndim - 1) + [(0, short)]
-                )
-            return blocks
-
         def scatter(k_cache, v_cache, ids, k_blocks, v_blocks):
-            k_blocks = _repad(k_blocks, k_cache.shape[-1])
-            v_blocks = _repad(v_blocks, v_cache.shape[-1])
+            k_blocks = _pad_minor(k_blocks, k_cache.shape[-1])
+            v_blocks = _pad_minor(v_blocks, v_cache.shape[-1])
             return (
                 k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype)),
                 v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype)),

@@ -59,6 +59,7 @@ class GgufFile:
     version: int
     metadata: Dict[str, Any]
     tensors: List[GgufTensorInfo]
+    data_offset: int = 0  # absolute file offset where tensor data begins
 
     @property
     def architecture(self) -> Optional[str]:
@@ -146,31 +147,79 @@ def read_gguf(path: str, max_tensors: int = 100_000) -> GgufFile:
             ggml_type = _read(f, "<I")
             offset = _read(f, "<Q")
             tensors.append(GgufTensorInfo(name, shape, ggml_type, offset))
-    return GgufFile(version=version, metadata=metadata, tensors=tensors)
+        # tensor data begins at the next alignment boundary; per-tensor
+        # offsets (above) are relative to this point
+        align = int(metadata.get("general.alignment", 32) or 32)
+        data_offset = (f.tell() + align - 1) // align * align
+    return GgufFile(
+        version=version, metadata=metadata, tensors=tensors,
+        data_offset=data_offset,
+    )
+
+
+def hf_config_from_gguf(g: GgufFile) -> Dict[str, Any]:
+    """GGUF architecture metadata → HF config.json-shaped dict.
+
+    One translation shared by the MDC (whose ``config`` field rides the
+    discovery plane and feeds engine_config_from_mdc) and
+    model_config_from_gguf, so a .gguf-backed worker builds the same
+    ModelConfig as a snapshot-backed one.
+
+    Only architectures whose converters share the llama graph + q/k
+    permute are accepted — anything else must fail HERE, loudly, or the
+    llama loader would serve plausible-looking garbage for e.g. a qwen2
+    export (biases dropped, unpermute applied that its converter never
+    performed).
+    """
+    arch = g.architecture
+    if arch not in ("llama", "mistral"):
+        raise GgufError(
+            f"unsupported GGUF architecture {arch!r} (supported: llama, "
+            "mistral — other families need their own tensor mapping)"
+        )
+    tokens = g.metadata.get("tokenizer.ggml.tokens")
+    heads = g.arch_key("attention.head_count", 32)
+    tied = g.metadata.get("general.tie_word_embeddings")
+    if tied is None:
+        # llama.cpp omits the flag; tied models simply ship no output.weight
+        tied = not any(t.name == "output.weight" for t in g.tensors)
+    cfg: Dict[str, Any] = {
+        "vocab_size": len(tokens) if tokens else g.arch_key("vocab_size", 32000),
+        "hidden_size": g.arch_key("embedding_length", 4096),
+        "intermediate_size": g.arch_key("feed_forward_length", 11008),
+        "num_hidden_layers": g.arch_key("block_count", 32),
+        "num_attention_heads": heads,
+        "num_key_value_heads": g.arch_key("attention.head_count_kv", heads),
+        "rope_theta": float(g.arch_key("rope.freq_base", 10000.0)),
+        "rms_norm_eps": float(
+            g.arch_key("attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        "max_position_embeddings": g.arch_key("context_length", 4096),
+        "tie_word_embeddings": bool(tied),
+        "architectures": ["LlamaForCausalLM"],
+    }
+    key_len = g.arch_key("attention.key_length")
+    if key_len:
+        cfg["head_dim"] = key_len
+    experts = g.arch_key("expert_count", 0) or 0
+    if experts:
+        cfg["num_local_experts"] = experts
+        cfg["num_experts_per_tok"] = g.arch_key("expert_used_count", 2) or 2
+        cfg["architectures"] = ["MixtralForCausalLM"]
+    eos = g.metadata.get("tokenizer.ggml.eos_token_id")
+    if eos is not None:
+        cfg["eos_token_id"] = eos
+    bos = g.metadata.get("tokenizer.ggml.bos_token_id")
+    if bos is not None:
+        cfg["bos_token_id"] = bos
+    return cfg
 
 
 def model_config_from_gguf(g: GgufFile):
     """Architecture config from GGUF metadata (llama-family keys)."""
     from ..engine.config import ModelConfig
 
-    tokens = g.metadata.get("tokenizer.ggml.tokens")
-    vocab = len(tokens) if tokens else g.arch_key("vocab_size", 32000)
-    heads = g.arch_key("attention.head_count", 32)
-    return ModelConfig(
-        vocab_size=vocab,
-        hidden_size=g.arch_key("embedding_length", 4096),
-        intermediate_size=g.arch_key("feed_forward_length", 11008),
-        num_layers=g.arch_key("block_count", 32),
-        num_heads=heads,
-        num_kv_heads=g.arch_key("attention.head_count_kv", heads),
-        rope_theta=float(g.arch_key("rope.freq_base", 10000.0)),
-        rms_norm_eps=float(
-            g.arch_key("attention.layer_norm_rms_epsilon", 1e-5)
-        ),
-        max_position_embeddings=g.arch_key("context_length", 4096),
-        num_experts=g.arch_key("expert_count", 0) or 0,
-        num_experts_per_tok=g.arch_key("expert_used_count", 2) or 2,
-    )
+    return ModelConfig.from_hf_config(hf_config_from_gguf(g))
 
 
 # GGUF tokenizer token_type values (ggml vocab semantics)
@@ -240,5 +289,7 @@ def mdc_from_gguf(path: str, display_name: Optional[str] = None,
         chat_template=g.metadata.get("tokenizer.chat_template"),
         bos_token_id=g.metadata.get("tokenizer.ggml.bos_token_id"),
         eos_token_ids=[eos] if eos is not None else [],
-        config={"architecture": g.architecture, "gguf_version": g.version},
+        # HF-shaped so engine_config_from_mdc builds the same ModelConfig
+        # a snapshot-backed worker would
+        config=hf_config_from_gguf(g),
     )

@@ -22,12 +22,14 @@ _SNAPSHOT_PATTERNS = [
 
 
 def resolve_model_path(name_or_path: str, revision: str | None = None) -> str:
-    """Local directory → itself; anything else → HF snapshot download.
+    """Local directory or file (.gguf) → itself; else → HF snapshot download.
 
     Raises a clear error (rather than a deep stack) when the id is not a
     directory and the hub is unreachable and the cache has no copy.
     """
-    if os.path.isdir(name_or_path):
+    if os.path.isdir(name_or_path) or (
+        os.path.isfile(name_or_path) and name_or_path.endswith(".gguf")
+    ):
         return name_or_path
     try:
         from huggingface_hub import snapshot_download

@@ -323,14 +323,123 @@ def load_deepseek_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -
     return params
 
 
+def _gguf_unpermute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Invert llama.cpp's q/k row permutation on a [out, in] weight.
+
+    The public HF→GGUF converter permutes attn_q/attn_k rows so ggml's
+    interleaved rope matches HF's half-rotation rope
+    (w.reshape(H, 2, out//H//2, in).swapaxes(1, 2)); this engine uses the
+    HF convention (models/llama.apply_rope), so loading a .gguf must undo
+    it per head.
+    """
+    out, inner = w.shape
+    hd = out // n_head
+    return (
+        w.reshape(n_head, hd // 2, 2, inner)
+        .swapaxes(1, 2)
+        .reshape(out, inner)
+    )
+
+
+def load_gguf_llama_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """llama.cpp ``.gguf`` checkpoint → stacked param pytree.
+
+    Tensor data dequantizes through llm/gguf_tensors.py (f16/bf16 and the
+    common q* block formats); names follow llama.cpp's export scheme
+    (token_embd, blk.N.attn_q, ...). With this the engine serves a .gguf
+    end-to-end: tokenizer from metadata (llm/gguf.py), weights from here.
+    """
+    import ml_dtypes
+
+    from ..llm.gguf import read_gguf
+    from ..llm.gguf_tensors import iter_gguf_tensors
+
+    # dequantization yields float32; staging a whole 70B checkpoint at 4
+    # bytes per element would need ~4x the serving footprint in host RAM,
+    # so narrow to the target dtype per tensor as it streams in
+    stage_dtype = (
+        ml_dtypes.bfloat16 if dtype == jnp.bfloat16
+        else np.float16 if dtype == jnp.float16
+        else np.float32
+    )
+
+    l = cfg.num_layers
+    staging: Dict[str, Dict[int, np.ndarray]] = {
+        k: {} for k in ("ln1", "wq", "wk", "wv", "wo", "ln2", "w_gate", "w_up", "w_down")
+    }
+    top: Dict[str, np.ndarray] = {}
+    mapping = {
+        "attn_norm.weight": ("ln1", False),
+        "attn_q.weight": ("wq", True),
+        "attn_k.weight": ("wk", True),
+        "attn_v.weight": ("wv", True),
+        "attn_output.weight": ("wo", True),
+        "ffn_norm.weight": ("ln2", False),
+        "ffn_gate.weight": ("w_gate", True),
+        "ffn_up.weight": ("w_up", True),
+        "ffn_down.weight": ("w_down", True),
+    }
+
+    g = read_gguf(path)
+    for name, tensor in iter_gguf_tensors(path, g):
+        tensor = tensor.astype(stage_dtype)
+        if name == "token_embd.weight":
+            top["embed"] = tensor
+        elif name == "output_norm.weight":
+            top["final_norm"] = tensor
+        elif name == "output.weight":
+            top["lm_head"] = tensor.T
+        elif name.startswith("blk."):
+            _, idx, rest = name.split(".", 2)
+            if rest not in mapping:
+                logger.debug("skipping unmapped gguf tensor %s", name)
+                continue
+            key, transpose = mapping[rest]
+            if key == "wq":
+                tensor = _gguf_unpermute(tensor, cfg.num_heads)
+            elif key == "wk":
+                tensor = _gguf_unpermute(tensor, cfg.num_kv_heads)
+            staging[key][int(idx)] = tensor.T if transpose else tensor
+
+    missing = [k for k, v in staging.items() if len(v) != l]
+    if missing:
+        raise ValueError(
+            f"incomplete gguf checkpoint: {missing} have "
+            f"{[len(staging[k]) for k in missing]} of {l} layers"
+        )
+
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "layers": {
+            k: jnp.asarray(
+                np.stack([staging[k][i] for i in range(l)]), dtype=dtype
+            )
+            for k in staging
+        },
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    elif not cfg.tie_word_embeddings:
+        logger.info("no output.weight in gguf; using tied embeddings")
+    return params
+
+
 def load_checkpoint_params(model_dir: str, cfg: ModelConfig, arch, dtype=jnp.bfloat16) -> Dict:
     """Dispatch to the loader for the resolved architecture module.
 
+    ``model_dir`` may be an HF snapshot directory or a ``.gguf`` file.
     Raises (rather than silently serving random weights — a user pointing
     the engine at a real checkpoint must never get plausible-looking
     garbage) when no loader exists for the architecture.
     """
     name = arch.__name__.rsplit(".", 1)[-1]
+    if model_dir.endswith(".gguf"):
+        if name != "llama":
+            raise NotImplementedError(
+                f"gguf loading is llama-family only (got {name!r})"
+            )
+        return load_gguf_llama_params(model_dir, cfg, dtype)
     loaders = {
         "llama": load_llama_params,
         "mixtral": load_mixtral_params,
@@ -344,4 +453,6 @@ def load_checkpoint_params(model_dir: str, cfg: ModelConfig, arch, dtype=jnp.bfl
 
 
 def has_checkpoint(model_dir: str) -> bool:
+    if model_dir.endswith(".gguf"):
+        return os.path.exists(model_dir)
     return bool(glob.glob(os.path.join(model_dir, "*.safetensors")))

@@ -104,7 +104,11 @@ def test_mdc_from_gguf(gguf_path):
     assert mdc.bos_token_id == 0
     assert mdc.eos_token_ids == [1]
     assert mdc.chat_template == "{{ messages }}"
-    assert mdc.config["architecture"] == "llama"
+    # config is HF-shaped so engine_config_from_mdc rebuilds the same
+    # ModelConfig a snapshot-backed worker would
+    assert mdc.config["hidden_size"] == 64
+    assert mdc.config["num_hidden_layers"] == 2
+    assert mdc.config["num_attention_heads"] == 8
 
 
 def test_rejects_non_gguf(tmp_path):
