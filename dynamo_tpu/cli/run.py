@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip remote prefill when the queue is this deep")
     p.add_argument("--advertise-host", default="127.0.0.1",
                    help="host other workers use to reach this worker's KV transfer server")
+    p.add_argument("--kv-transfer", choices=("tcp", "ici"), default="tcp",
+                   help="KV block payload path: tcp (host bounce, works "
+                        "anywhere) or ici (HBM-to-HBM XLA collective; "
+                        "requires prefill+decode in one jax.distributed "
+                        "world via --num-nodes/--leader-addr)")
+    p.add_argument("--ici-sender-rank", type=int, default=1,
+                   help="jax process index of the prefill (sender) worker")
+    p.add_argument("--ici-receiver-rank", type=int, default=0,
+                   help="jax process index of the decode (receiver) worker")
     # multi-host bring-up (reference MultiNodeConfig {num_nodes, node_rank,
     # leader_addr}, lib/llm/src/engines.rs:39-57; Ray leader/follower,
     # lib/engines/vllm0_7/src/ray.rs:66-230 — here JAX's coordinator is the
@@ -85,6 +94,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host:port of node 0's JAX coordinator")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
+
+
+def _make_ici(flags, runner):
+    """--kv-transfer ici → the collective HBM-to-HBM plane, else None."""
+    if getattr(flags, "kv_transfer", "tcp") != "ici":
+        return None
+    from ..disagg.ici_transfer import IciKvTransfer, kv_block_shapes
+
+    return IciKvTransfer(
+        kv_block_shapes(runner.config),
+        runner.kv_cache[0].dtype,
+        sender_rank=flags.ici_sender_rank,
+        receiver_rank=flags.ici_receiver_rank,
+    )
 
 
 def load_mdc(flags):
@@ -152,6 +175,7 @@ async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None)
                 return await RemotePrefillCoordinator(
                     drt, runner, namespace=flags.namespace,
                     router=router, advertise_host=flags.advertise_host,
+                    ici=_make_ici(flags, runner),
                 ).start()
 
         return await JaxServingEngine.create(
@@ -415,7 +439,10 @@ async def run_prefill(flags) -> None:
     runner = await loop.run_in_executor(
         None, lambda: ModelRunner(engine_config, model_dir=mdc.model_path)
     )
-    worker = PrefillWorker(drt, runner, engine_config, namespace=flags.namespace)
+    worker = PrefillWorker(
+        drt, runner, engine_config, namespace=flags.namespace,
+        ici=_make_ici(flags, runner),
+    )
     print(f"prefill worker consuming {worker.queue.name}", flush=True)
     try:
         await worker.run()

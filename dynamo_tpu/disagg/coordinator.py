@@ -39,6 +39,7 @@ class RemotePrefillCoordinator:
         advertise_host: str = "127.0.0.1",
         depth_refresh_s: float = 0.25,
         prefill_timeout_s: float = 120.0,
+        ici=None,  # IciKvTransfer (receiver role) → bytes ride ICI/DCN
     ):
         self.drt = drt
         self.runner = runner
@@ -53,6 +54,7 @@ class RemotePrefillCoordinator:
             on_commit=self._commit,
             authorize=self._authorize,
             host=advertise_host,
+            ici_recv=None if ici is None else ici.recv,
         )
         self._pending: Dict[str, asyncio.Future] = {}
         self._queue_depth = 0

@@ -1,0 +1,219 @@
+"""HBM-to-HBM KV block transfer over ICI/DCN via XLA collectives.
+
+The TCP plane (disagg/transfer.py) moves KV blocks device → host → socket
+→ host → device; correct everywhere, but bounded by PCIe + host copies.
+When the prefill and decode workers share one ``jax.distributed`` process
+group (same pod slice, or cross-slice over DCN), the bytes can instead
+ride the interconnect directly: both sides enter one jitted ``ppermute``
+over a two-device "peer" mesh — the sender's HBM shard lands in the
+receiver's HBM with XLA routing it over ICI (or DCN between slices),
+no host involvement. This is the TPU-native analog of the reference's
+NIXL RDMA writes (docs/disagg_serving.md:60-100,
+examples/llm/utils/nixl.py:59-109): the "registered memory descriptor"
+becomes a mesh + sharding, and the "RDMA put" an XLA collective.
+
+Control flow stays on the existing TCP channel (ordering + commit): the
+sender first streams an ``ici_blocks`` header (ids, bucket — no payload),
+then both sides enter the collective for the bucketed block arrays. A
+lost peer surfaces as the collective's timeout rather than a hung socket.
+
+The engine's jitted block gather/scatter already produce/accept
+*replicated* arrays, so the payload needs only ONE device per side: the
+mesh takes the first local device of each process, and other devices
+idle for the transfer's duration (the gather that feeds it is itself a
+collective over the worker's own mesh).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+class IciKvTransfer:
+    """One sender↔receiver pair of the collective transfer plane.
+
+    Both processes construct this with the same ``(sender_rank,
+    receiver_rank)`` and the same block shapes, then the sender calls
+    :meth:`send` while the receiver calls :meth:`recv` — each call is one
+    entry into the shared collective program, so the two sides MUST pair
+    calls 1:1 (the ``ici_blocks`` header on the TCP channel provides that
+    ordering). A sequence number rides INSIDE the collective payload: if
+    a sender dies between header and collective, the orphaned receiver
+    entry eventually pairs with a later send — the embedded seq then
+    mismatches the header's and the payload is dropped instead of being
+    scattered under the wrong request (see KvTransferServer).
+
+    ``buckets`` defaults to the runner's block-op ladder so gathered
+    shapes hit the compiled programs exactly; payloads larger than the
+    top bucket must be chunked by the caller (PrefillWorker does).
+    """
+
+    def __init__(
+        self,
+        kv_block_shape: Tuple[Tuple[int, ...], Tuple[int, ...]],
+        dtype,
+        sender_rank: int = 0,
+        receiver_rank: int = 1,
+        buckets: Optional[Sequence[int]] = None,
+    ):
+        if buckets is None:
+            from ..engine.model_runner import ModelRunner
+
+            buckets = ModelRunner.BLOCK_OP_BUCKETS
+        if jax.process_count() < 2:
+            raise RuntimeError(
+                "ICI kv transfer needs a multi-process jax.distributed "
+                "world (use parallel.mesh.initialize_multihost)"
+            )
+        self.k_shape, self.v_shape = kv_block_shape  # [L, bs, KVH, D]-like
+        self.dtype = dtype
+        self.buckets = tuple(sorted(buckets))
+        me = jax.process_index()
+        if me not in (sender_rank, receiver_rank):
+            raise RuntimeError(
+                f"process {me} is neither sender {sender_rank} nor "
+                f"receiver {receiver_rank}"
+            )
+        self.is_sender = me == sender_rank
+
+        def first_local_device(rank: int):
+            devs = [d for d in jax.devices() if d.process_index == rank]
+            if not devs:
+                raise RuntimeError(f"no devices for process {rank}")
+            return devs[0]
+
+        # peer axis: [sender, receiver]
+        self.mesh = Mesh(
+            np.array(
+                [first_local_device(sender_rank),
+                 first_local_device(receiver_rank)]
+            ),
+            ("peer",),
+        )
+        self.sharding = NamedSharding(self.mesh, P("peer"))
+        self._programs: Dict[int, object] = {}
+
+    # ---------- the collective ----------
+
+    def _program(self, bucket: int):
+        prog = self._programs.get(bucket)
+        if prog is not None:
+            return prog
+
+        def step(k_buf, v_buf, seq_buf):
+            # peer 0 → peer 1; peer 1's (zero) shard rotates back to 0 and
+            # is discarded — a pure shift would need a conditional, and
+            # the dead shard costs the same ICI hop either way
+            perm = [(0, 1), (1, 0)]
+            return (
+                jax.lax.ppermute(k_buf, "peer", perm),
+                jax.lax.ppermute(v_buf, "peer", perm),
+                jax.lax.ppermute(seq_buf, "peer", perm),
+            )
+
+        kb = (1,) + self._bucket_shape(self.k_shape, bucket)
+        vb = (1,) + self._bucket_shape(self.v_shape, bucket)
+        prog = jax.jit(
+            jax.shard_map(
+                step, mesh=self.mesh,
+                in_specs=(P("peer"), P("peer"), P("peer")),
+                out_specs=(P("peer"), P("peer"), P("peer")),
+            ),
+        )
+        self._programs[bucket] = (prog, kb, vb)
+        return self._programs[bucket]
+
+    @staticmethod
+    def _bucket_shape(shape: Tuple[int, ...], bucket: int) -> Tuple[int, ...]:
+        # block arrays are [L, n, bs, heads, d]; bucket the n axis
+        return (shape[0], bucket) + tuple(shape[2:])
+
+    def bucket_for(self, nblocks: int) -> int:
+        for b in self.buckets:
+            if nblocks <= b:
+                return b
+        return self.buckets[-1]
+
+    def _global(self, local: jnp.ndarray) -> jax.Array:
+        """[bucket-shape] local payload → [2, ...] peer-sharded global."""
+        return jax.make_array_from_single_device_arrays(
+            (2,) + tuple(local.shape),
+            self.sharding,
+            [jax.device_put(local[None], self.mesh.devices.flat[
+                0 if self.is_sender else 1])],
+        )
+
+    def _enter(self, bucket: int, k_local, v_local, seq: int):
+        (prog, kb, vb) = self._program(bucket)
+        k_g = self._global(k_local)
+        v_g = self._global(v_local)
+        seq_g = self._global(jnp.full((8,), seq, jnp.int32))
+        ko, vo, so = prog(k_g, v_g, seq_g)
+        # each process addresses exactly its own peer shard
+        k_shard = ko.addressable_shards[0].data[0]
+        v_shard = vo.addressable_shards[0].data[0]
+        seq_shard = int(np.asarray(so.addressable_shards[0].data[0])[0])
+        return k_shard, v_shard, seq_shard
+
+    # ---------- roles ----------
+
+    def send(self, k_blocks, v_blocks, seq: int = 0) -> None:
+        """Sender side: k/v [L, n<=top bucket, bs, heads, d] device or host."""
+        assert self.is_sender
+        n = k_blocks.shape[1]
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"{n} blocks exceed the top transfer bucket "
+                f"{self.buckets[-1]}; chunk the payload"
+            )
+        bucket = self.bucket_for(n)
+        k = jnp.asarray(k_blocks, self.dtype)
+        v = jnp.asarray(v_blocks, self.dtype)
+        if n < bucket:
+            pad = [(0, 0)] * k.ndim
+            pad[1] = (0, bucket - n)
+            k = jnp.pad(k, pad)
+            v = jnp.pad(v, pad)
+        self._enter(bucket, k, v, seq)
+
+    def recv(self, nblocks: int):
+        """Receiver side: returns (k, v, seq) — device arrays
+        [L, n, bs, heads, d] plus the seq embedded by the sender."""
+        assert not self.is_sender
+        bucket = self.bucket_for(nblocks)
+        (prog, kb, vb) = self._program(bucket)
+        k0 = jnp.zeros(kb[1:], self.dtype)
+        v0 = jnp.zeros(vb[1:], self.dtype)
+        k, v, seq = self._enter(bucket, k0, v0, 0)
+        return k[:, :nblocks], v[:, :nblocks], seq
+
+
+def kv_block_shapes(config) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Transfer-plane block shapes for an EngineConfig — must agree on
+    both workers (same reason block geometry is pinned in the MDC).
+
+    Trailing dims are the LOGICAL kv dims: the runner's jitted gather
+    strips the cache's lane padding and its scatter re-pads, so the
+    interconnect moves only real bytes (matches the TCP wire format).
+    """
+    from ..models import resolve
+
+    m = config.model
+    arch = resolve(m)
+    name = arch.__name__.rsplit(".", 1)[-1]
+    l, bs = m.num_layers, config.kv_block_size
+    if name == "deepseek":
+        return (
+            (l, 1, bs, 1, m.kv_lora_rank),
+            (l, 1, bs, 1, m.qk_rope_head_dim),
+        )
+    d = m.head_dim
+    return ((l, 1, bs, m.num_kv_heads, d), (l, 1, bs, m.num_kv_heads, d))

@@ -41,6 +41,7 @@ class PrefillWorker:
         namespace: str = "public",
         component: str = "backend",
         transfer_chunk_blocks: int = 16,
+        ici=None,  # IciKvTransfer (sender role) → bytes ride ICI/DCN
     ):
         self.drt = drt
         self.runner = runner
@@ -48,6 +49,8 @@ class PrefillWorker:
         self.namespace = namespace
         self.component = component
         self.transfer_chunk_blocks = transfer_chunk_blocks
+        self.ici = ici
+        self._ici_seq = 0
         self.queue = PrefillQueue(drt.messaging, namespace)
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
@@ -147,20 +150,52 @@ class PrefillWorker:
             first_block = rpr.num_cached // bs
             src_ids = block_ids[first_block:]
             dst_ids = rpr.block_ids[first_block : len(block_ids)]
-            k, v = await loop.run_in_executor(
-                None, lambda: self.runner.gather_blocks(src_ids)
-            )
             client = await self._client(rpr.engine_id)
-            await client.send_blocks(
-                rpr.request_id, dst_ids, k, v,
-                chunk_blocks=self.transfer_chunk_blocks,
+            use_ici = self.ici is not None and "ici" in getattr(
+                client, "modes", ("tcp",)
             )
+            if self.ici is not None and not use_ici:
+                # decode side can't receive collective payloads — sending
+                # the header anyway would strand THIS worker inside a
+                # collective that never pairs; fall back loudly
+                logger.warning(
+                    "engine %s transfer server has no ici mode; falling "
+                    "back to tcp for this transfer", rpr.engine_id,
+                )
+            nbytes = 0
+            if use_ici:
+                # collective plane: ids over TCP (ordering), bytes HBM→HBM;
+                # chunk at the top transfer bucket — sender and receiver
+                # must enter identically-shaped programs
+                chunk = self.ici.buckets[-1]
+                for i in range(0, len(src_ids), chunk):
+                    src = src_ids[i : i + chunk]
+                    dst = dst_ids[i : i + chunk]
+                    k, v = await loop.run_in_executor(
+                        None, lambda s=src: self.runner.gather_blocks_device(s)
+                    )
+                    self._ici_seq += 1
+                    seq = self._ici_seq
+                    await client.send_ici_blocks(rpr.request_id, dst, seq)
+                    await loop.run_in_executor(
+                        None, lambda a=k, b=v, s=seq: self.ici.send(a, b, s)
+                    )
+                    nbytes += k.nbytes + v.nbytes
+            else:
+                k, v = await loop.run_in_executor(
+                    None, lambda: self.runner.gather_blocks(src_ids)
+                )
+                await client.send_blocks(
+                    rpr.request_id, dst_ids, k, v,
+                    chunk_blocks=self.transfer_chunk_blocks,
+                )
+                nbytes = k.nbytes + v.nbytes
             await client.send_commit(
                 rpr.request_id, token, lp if rpr.want_logprobs else None
             )
             self.prefills += 1
             self.prefill_tokens += len(prompt) - num_cached
-            self.transfer_bytes += k.nbytes + v.nbytes
+            self.transfer_bytes += nbytes
         finally:
             self.allocator.free_blocks(block_ids)
 
@@ -175,6 +210,8 @@ class PrefillWorker:
             raise ConnectionError(f"no kv transfer descriptor for {engine_id}")
         desc = msgpack.unpackb(raw, raw=False)
         client = await KvTransferClient(desc["host"], desc["port"]).connect()
+        # payload paths BOTH ends support (older descriptors: tcp only)
+        client.modes = tuple(desc.get("modes", ("tcp",)))
         self._clients[engine_id] = client
         return client
 

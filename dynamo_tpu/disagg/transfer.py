@@ -61,6 +61,7 @@ class KvTransferServer:
         on_commit: Callable[[str, int, Optional[float]], None],
         authorize: Optional[Callable[[str, Sequence[int]], bool]] = None,
         host: str = "127.0.0.1",
+        ici_recv: Optional[Callable[[int], tuple]] = None,
     ):
         # scatter(request_id, block_ids, k, v) — may return an awaitable; an
         # async scatter MUST re-validate the request id after any await (the
@@ -71,6 +72,11 @@ class KvTransferServer:
         # into reallocated blocks
         self.authorize = authorize or (lambda request_id, ids: True)
         self.host = host
+        # ici_recv(nblocks) -> (k, v): enter the collective transfer plane
+        # (disagg/ici_transfer.py) and return device arrays. The TCP frame
+        # "ici_blocks" is then control-only — ids ride the socket, bytes
+        # ride the interconnect.
+        self.ici_recv = ici_recv
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -81,7 +87,11 @@ class KvTransferServer:
 
     @property
     def descriptor(self) -> dict:
-        return {"host": self.host, "port": self.port}
+        # modes let the prefill side pick a payload path BOTH ends support
+        # — sending an ici frame to a tcp-only server would strand the
+        # sender inside a collective that never pairs
+        modes = ["tcp"] + (["ici"] if self.ici_recv is not None else [])
+        return {"host": self.host, "port": self.port, "modes": modes}
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -109,6 +119,36 @@ class KvTransferServer:
                     # scatter may be a coroutine that stages the host→device
                     # copy off-loop so decode streaming isn't stalled
                     result = self.scatter(header["request_id"], header["block_ids"], k, v)
+                    if inspect.isawaitable(result):
+                        await result
+                elif mtype == "ici_blocks":
+                    ids = header["block_ids"]
+                    if self.ici_recv is None:
+                        logger.error("ici_blocks frame but no ici plane")
+                        return
+                    loop = asyncio.get_running_loop()
+                    # the sender has entered (or is about to enter) the
+                    # collective — the receive MUST happen even for a
+                    # cancelled request, or both sides deadlock; authorize
+                    # decides only whether the payload is scattered
+                    k, v, seq = await loop.run_in_executor(
+                        None, self.ici_recv, len(ids)
+                    )
+                    if seq != header.get("seq", 0):
+                        # a sender died between header and collective and
+                        # this entry paired with a LATER send — the payload
+                        # belongs to some other request; dropping it loses
+                        # that transfer (its redelivery re-sends) but never
+                        # scatters bytes under the wrong ids
+                        logger.error(
+                            "ici transfer seq mismatch (header %s, payload "
+                            "%s) — dropping mis-paired payload",
+                            header.get("seq"), seq,
+                        )
+                        continue
+                    if not self.authorize(header["request_id"], ids):
+                        continue  # request gone — drop the received blocks
+                    result = self.scatter(header["request_id"], ids, k, v)
                     if inspect.isawaitable(result):
                         await result
                 elif mtype == "commit":
@@ -178,6 +218,20 @@ class KvTransferClient:
             self.writer.write(kb)
             self.writer.write(vb)
             await self.writer.drain()
+
+    async def send_ici_blocks(
+        self, request_id: str, block_ids: List[int], seq: int = 0
+    ) -> None:
+        """Announce a collective-plane transfer: ids over TCP, bytes over
+        ICI/DCN (the caller enters IciKvTransfer.send(..., seq=seq) after
+        this drains; the receiver cross-checks seq against the payload)."""
+        self._send_header({
+            "type": "ici_blocks",
+            "request_id": request_id,
+            "block_ids": list(map(int, block_ids)),
+            "seq": int(seq),
+        })
+        await self.writer.drain()
 
     async def send_commit(self, request_id: str, first_token: int,
                           logprob: Optional[float] = None) -> None:

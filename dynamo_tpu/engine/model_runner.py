@@ -33,9 +33,18 @@ logger = logging.getLogger(__name__)
 
 def build_mesh(dp: int, tp: int, devices=None, ep: int = 1) -> Mesh:
     """(dp, ep, tp) mesh; tp innermost so its collectives ride fastest ICI.
-    ep=1 keeps the axis present (specs may name it) but trivial."""
-    devices = devices if devices is not None else jax.devices()
+    ep=1 keeps the axis present (specs may name it) but trivial.
+
+    Device pick: LOCAL devices when they suffice — in a multi-process
+    world (disagg workers sharing a jax.distributed group for the ICI
+    transfer plane) each engine runs its own independent program and must
+    not claim the peer's devices. A mesh larger than the local count is
+    the single-engine multi-host case and takes the global list.
+    """
     n = dp * ep * tp
+    if devices is None:
+        local = jax.local_devices()
+        devices = local if n <= len(local) else jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh {dp}x{ep}x{tp} needs {n} devices, have {len(devices)}")
     arr = np.asarray(devices[:n]).reshape(dp, ep, tp)
@@ -358,8 +367,18 @@ class ModelRunner:
 
     def gather_blocks(self, block_ids) -> Tuple[np.ndarray, np.ndarray]:
         """Read KV blocks out of HBM → host arrays [L, n, bs, KVH, D] ×2."""
+        k, v = self.gather_blocks_device(block_ids)
+        return np.asarray(jax.device_get(k)), np.asarray(jax.device_get(v))
+
+    def gather_blocks_device(self, block_ids):
+        """Read KV blocks as DEVICE arrays [L, n, bs, KVH, D] ×2.
+
+        Same bucketed gather as gather_blocks without the host round-trip —
+        feeds the collective transfer plane (disagg/ici_transfer.py), which
+        moves HBM→HBM and must never bounce through numpy.
+        """
         ids = list(block_ids)
-        k_parts, v_parts = [], []
+        ks, vs = [], []
         i = 0
         while i < len(ids):
             chunk = ids[i : i + self.BLOCK_OP_BUCKETS[-1]]
@@ -368,12 +387,12 @@ class ModelRunner:
             k, v = self._gather_jit(
                 self.kv_cache[0], self.kv_cache[1], jnp.asarray(padded, jnp.int32)
             )
-            k_parts.append(np.asarray(jax.device_get(k))[:, : len(chunk)])
-            v_parts.append(np.asarray(jax.device_get(v))[:, : len(chunk)])
+            ks.append(k[:, : len(chunk)])
+            vs.append(v[:, : len(chunk)])
             i += len(chunk)
-        if len(k_parts) == 1:
-            return k_parts[0], v_parts[0]
-        return np.concatenate(k_parts, axis=1), np.concatenate(v_parts, axis=1)
+        if len(ks) == 1:
+            return ks[0], vs[0]
+        return jnp.concatenate(ks, axis=1), jnp.concatenate(vs, axis=1)
 
     def scatter_blocks(self, block_ids, k_blocks, v_blocks) -> None:
         """Write KV block data [L, n, bs, KVH, D] into HBM cache slots.

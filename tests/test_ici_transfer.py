@@ -1,0 +1,185 @@
+"""Collective (ICI/DCN-analog) KV transfer plane.
+
+Two real processes join a jax.distributed world over localhost CPU and
+move KV block payloads HBM-analog → HBM-analog through the shared
+ppermute program (disagg/ici_transfer.py) — the GPU-free equivalent of
+the reference's NIXL RDMA path (examples/llm/utils/nixl.py:59-109).
+The in-process tests cover the TCP control frames: ids ride the socket,
+and a cancelled request must still enter the collective (deadlock
+avoidance) while its payload is dropped.
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from dynamo_tpu.parallel.mesh import MultiHostConfig, initialize_multihost
+
+rank = int(sys.argv[1])
+leader = sys.argv[2]
+initialize_multihost(MultiHostConfig(
+    leader_addr=leader, num_nodes=2, node_rank=rank,
+))
+
+import numpy as np
+import jax.numpy as jnp
+from dynamo_tpu.disagg.ici_transfer import IciKvTransfer
+
+K_SHAPE = (2, 1, 4, 2, 8)   # [L, n, bs, KVH, D]
+V_SHAPE = (2, 1, 4, 2, 8)
+xfer = IciKvTransfer(
+    (K_SHAPE, V_SHAPE), jnp.float32, sender_rank=1, receiver_rank=0,
+)
+
+rng = np.random.default_rng(3)
+n = 3  # not a bucket size: exercises pad-to-bucket (4) + slice-back
+k_blocks = rng.normal(size=(2, n, 4, 2, 8)).astype(np.float32)
+v_blocks = rng.normal(size=(2, n, 4, 2, 8)).astype(np.float32)
+
+if rank == 1:
+    xfer.send(k_blocks, v_blocks, seq=41)
+    # second transfer re-uses the compiled program
+    xfer.send(k_blocks[:, :1] * 2.0, v_blocks[:, :1] * 2.0, seq=42)
+    print("RANK1_OK", flush=True)
+else:
+    k, v, seq = xfer.recv(n)
+    assert seq == 41, seq
+    np.testing.assert_allclose(np.asarray(k), k_blocks, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), v_blocks, rtol=1e-6)
+    k2, v2, seq2 = xfer.recv(1)
+    assert seq2 == 42, seq2
+    np.testing.assert_allclose(np.asarray(k2), k_blocks[:, :1] * 2.0, rtol=1e-6)
+    print("RANK0_OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_collective_transfer():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    leader = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # drop the TPU site hook; CPU test
+    env["JAX_PLATFORMS"] = "cpu"
+    env["REPO_ROOT"] = repo
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(rank), leader],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    assert "RANK0_OK" in outs[0]
+    assert "RANK1_OK" in outs[1]
+
+
+class _StubIci:
+    """Stands in for IciKvTransfer.recv on the server side."""
+
+    def __init__(self, seq=0):
+        self.calls = []
+        self.seq = seq
+        self.k = np.ones((2, 2, 4, 2, 8), np.float32)
+        self.v = np.full((2, 2, 4, 2, 8), 2.0, np.float32)
+
+    def recv(self, nblocks):
+        self.calls.append(nblocks)
+        return self.k[:, :nblocks], self.v[:, :nblocks], self.seq
+
+
+async def test_ici_header_scatters_via_collective():
+    ici = _StubIci(seq=9)
+    scattered = []
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: scattered.append((rid, ids, k, v)),
+        on_commit=lambda *a: None,
+        ici_recv=ici.recv,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_ici_blocks("r1", [5, 9], seq=9)
+        await client.send_commit("r1", 7)
+        await client.close()
+    finally:
+        await server.close()
+    assert ici.calls == [2]
+    (rid, ids, k, v), = scattered
+    assert rid == "r1" and ids == [5, 9]
+    np.testing.assert_array_equal(k, ici.k)
+    np.testing.assert_array_equal(v, ici.v)
+    assert "ici" in server.descriptor["modes"]
+
+
+async def test_seq_mismatch_drops_mispaired_payload():
+    """A payload whose embedded seq differs from the header's (orphaned
+    collective entry pairing with a later send) must never be scattered."""
+    ici = _StubIci(seq=3)
+    scattered = []
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: scattered.append(rid),
+        on_commit=lambda *a: None,
+        ici_recv=ici.recv,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_ici_blocks("r1", [5], seq=7)  # header says 7
+        await client.send_commit("r1", 0)
+        await client.close()
+    finally:
+        await server.close()
+    assert ici.calls == [1]
+    assert scattered == []
+
+
+async def test_cancelled_request_still_enters_collective():
+    """Un-authorized ici frames must still call recv (sender is already in
+    the collective — skipping would deadlock both workers) but drop data."""
+    ici = _StubIci()
+    scattered = []
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: scattered.append(rid),
+        on_commit=lambda *a: None,
+        authorize=lambda rid, ids: False,
+        ici_recv=ici.recv,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_ici_blocks("gone", [1])
+        await client.send_commit("gone", 0)
+        await client.close()
+    finally:
+        await server.close()
+    assert ici.calls == [1]   # entered the collective
+    assert scattered == []    # but nothing written
