@@ -55,6 +55,7 @@ class RemotePrefillCoordinator:
             authorize=self._authorize,
             host=advertise_host,
             ici_recv=None if ici is None else ici.recv,
+            ici_rank=None if ici is None else ici.receiver_rank,
         )
         self._pending: Dict[str, asyncio.Future] = {}
         self._queue_depth = 0

@@ -76,6 +76,8 @@ class IciKvTransfer:
         self.k_shape, self.v_shape = kv_block_shape  # [L, bs, KVH, D]-like
         self.dtype = dtype
         self.buckets = tuple(sorted(buckets))
+        self.sender_rank = sender_rank
+        self.receiver_rank = receiver_rank
         me = jax.process_index()
         if me not in (sender_rank, receiver_rank):
             raise RuntimeError(

@@ -151,36 +151,41 @@ class PrefillWorker:
             src_ids = block_ids[first_block:]
             dst_ids = rpr.block_ids[first_block : len(block_ids)]
             client = await self._client(rpr.engine_id)
-            use_ici = self.ici is not None and "ici" in getattr(
-                client, "modes", ("tcp",)
-            )
-            if self.ici is not None and not use_ici:
-                # decode side can't receive collective payloads — sending
-                # the header anyway would strand THIS worker inside a
-                # collective that never pairs; fall back loudly
-                logger.warning(
-                    "engine %s transfer server has no ici mode; falling "
-                    "back to tcp for this transfer", rpr.engine_id,
-                )
+            use_ici = self.ici is not None and self._ici_usable(client)
             nbytes = 0
             if use_ici:
                 # collective plane: ids over TCP (ordering), bytes HBM→HBM;
                 # chunk at the top transfer bucket — sender and receiver
                 # must enter identically-shaped programs
                 chunk = self.ici.buckets[-1]
-                for i in range(0, len(src_ids), chunk):
-                    src = src_ids[i : i + chunk]
-                    dst = dst_ids[i : i + chunk]
-                    k, v = await loop.run_in_executor(
-                        None, lambda s=src: self.runner.gather_blocks_device(s)
+                try:
+                    for i in range(0, len(src_ids), chunk):
+                        src = src_ids[i : i + chunk]
+                        dst = dst_ids[i : i + chunk]
+                        k, v = await loop.run_in_executor(
+                            None,
+                            lambda s=src: self.runner.gather_blocks_device(s),
+                        )
+                        self._ici_seq += 1
+                        seq = self._ici_seq
+                        await client.send_ici_blocks(rpr.request_id, dst, seq)
+                        await loop.run_in_executor(
+                            None, lambda a=k, b=v, s=seq: self.ici.send(a, b, s)
+                        )
+                        nbytes += k.nbytes + v.nbytes
+                except BaseException:
+                    # the plane's pairing discipline is now unknowable (a
+                    # header may be out without its collective entry, or
+                    # vice versa) and collectives cannot be cancelled —
+                    # abandon the plane: all future transfers go TCP, the
+                    # receiver's seq check drops any mis-paired leftovers,
+                    # and this item redelivers over TCP
+                    logger.exception(
+                        "ici transfer failed; abandoning the collective "
+                        "plane (falling back to tcp permanently)"
                     )
-                    self._ici_seq += 1
-                    seq = self._ici_seq
-                    await client.send_ici_blocks(rpr.request_id, dst, seq)
-                    await loop.run_in_executor(
-                        None, lambda a=k, b=v, s=seq: self.ici.send(a, b, s)
-                    )
-                    nbytes += k.nbytes + v.nbytes
+                    self.ici = None
+                    raise
             else:
                 k, v = await loop.run_in_executor(
                     None, lambda: self.runner.gather_blocks(src_ids)
@@ -199,6 +204,25 @@ class PrefillWorker:
         finally:
             self.allocator.free_blocks(block_ids)
 
+    def _ici_usable(self, client) -> bool:
+        """The collective plane applies only when the TARGET engine is this
+        plane's configured receiver — another ici-enabled engine would
+        enter a DIFFERENT mesh and both sides would hang unpaired."""
+        modes = getattr(client, "modes", ("tcp",))
+        if "ici" not in modes:
+            logger.warning(
+                "transfer server has no ici mode; using tcp for this engine"
+            )
+            return False
+        rank = getattr(client, "ici_rank", None)
+        if rank != self.ici.receiver_rank:
+            logger.warning(
+                "engine's ici receiver rank %s != configured %s; using tcp",
+                rank, self.ici.receiver_rank,
+            )
+            return False
+        return True
+
     async def _client(self, engine_id: str) -> KvTransferClient:
         client = self._clients.get(engine_id)
         if client is not None:
@@ -212,6 +236,7 @@ class PrefillWorker:
         client = await KvTransferClient(desc["host"], desc["port"]).connect()
         # payload paths BOTH ends support (older descriptors: tcp only)
         client.modes = tuple(desc.get("modes", ("tcp",)))
+        client.ici_rank = desc.get("ici_rank")
         self._clients[engine_id] = client
         return client
 

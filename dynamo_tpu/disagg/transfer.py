@@ -62,6 +62,7 @@ class KvTransferServer:
         authorize: Optional[Callable[[str, Sequence[int]], bool]] = None,
         host: str = "127.0.0.1",
         ici_recv: Optional[Callable[[int], tuple]] = None,
+        ici_rank: Optional[int] = None,
     ):
         # scatter(request_id, block_ids, k, v) — may return an awaitable; an
         # async scatter MUST re-validate the request id after any await (the
@@ -72,11 +73,16 @@ class KvTransferServer:
         # into reallocated blocks
         self.authorize = authorize or (lambda request_id, ids: True)
         self.host = host
-        # ici_recv(nblocks) -> (k, v): enter the collective transfer plane
-        # (disagg/ici_transfer.py) and return device arrays. The TCP frame
+        # ici_recv(nblocks) -> (k, v, seq): enter the collective transfer
+        # plane (disagg/ici_transfer.py) and return device arrays plus the
+        # seq the sender embedded in the payload (checked against the
+        # header's — load-bearing for mis-pair detection). The TCP frame
         # "ici_blocks" is then control-only — ids ride the socket, bytes
-        # ride the interconnect.
+        # ride the interconnect. ici_rank is this receiver's jax process
+        # index, advertised so senders only pick ici when THEIR plane
+        # pairs with this engine.
         self.ici_recv = ici_recv
+        self.ici_rank = ici_rank
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -91,7 +97,10 @@ class KvTransferServer:
         # — sending an ici frame to a tcp-only server would strand the
         # sender inside a collective that never pairs
         modes = ["tcp"] + (["ici"] if self.ici_recv is not None else [])
-        return {"host": self.host, "port": self.port, "modes": modes}
+        desc = {"host": self.host, "port": self.port, "modes": modes}
+        if self.ici_rank is not None:
+            desc["ici_rank"] = self.ici_rank
+        return desc
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

@@ -169,3 +169,25 @@ class EngineConfig:
             if length <= b:
                 return b
         raise ValueError(f"prompt length {length} exceeds max bucket {self.prefill_buckets[-1]}")
+
+    def kv_width_buckets(self) -> List[int]:
+        """The decode block-table width ladder: powers of two from 8 up to
+        the full per-seq width (always included). One compiled decode
+        program exists per bucket; ModelRunner.warmup sweeps the ladder."""
+        widths = []
+        w = 8
+        while w < self.blocks_per_seq:
+            widths.append(w)
+            w *= 2
+        widths.append(self.blocks_per_seq)
+        return widths
+
+    def kv_width_bucket(self, nblocks: int) -> int:
+        """Block-table width for a decode step covering ``nblocks`` live
+        blocks. Attention cost on the gather/page-walk side scales with
+        table width, so short contexts must not pay max_model_len's
+        width."""
+        for w in self.kv_width_buckets():
+            if nblocks <= w:
+                return w
+        return self.blocks_per_seq

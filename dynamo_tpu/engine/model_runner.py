@@ -425,13 +425,22 @@ class ModelRunner:
             i += len(chunk)
 
     def warmup(self, decode_batch: Optional[int] = None) -> None:
-        """Compile the decode-shape program up front."""
+        """Compile the decode programs up front — one per KV-width bucket.
+
+        The scheduler sizes decode block tables with
+        EngineConfig.kv_width_bucket, so serving touches a ladder of
+        widths, not just blocks_per_seq; compiling the ladder here keeps
+        multi-ten-second TPU compiles out of the first requests' latency
+        (the analog of GPU engines' startup capture sweeps).
+        """
         b = decode_batch or self.config.max_batch_size
-        w = self.config.blocks_per_seq
         zeros2 = np.zeros((b, 1), np.int32)
-        self.step(
-            zeros2, zeros2, np.zeros((b, w), np.int32), np.full((b, 1), -1, np.int32),
-            np.ones(b, np.int32), np.zeros(b, np.int32),
-            np.zeros(b, np.float32), np.zeros(b, np.int32), np.ones(b, np.float32),
-            jax.random.PRNGKey(0),
-        )
+        for w in self.config.kv_width_buckets():
+            self.step(
+                zeros2, zeros2, np.zeros((b, w), np.int32),
+                np.full((b, 1), -1, np.int32),
+                np.ones(b, np.int32), np.zeros(b, np.int32),
+                np.zeros(b, np.float32), np.zeros(b, np.int32),
+                np.ones(b, np.float32),
+                jax.random.PRNGKey(0),
+            )

@@ -521,7 +521,6 @@ class Scheduler:
     async def _decode(self, loop, active: List[EngineRequest]) -> None:
         cfg = self.config
         b = cfg.max_batch_size
-        w = cfg.blocks_per_seq
         bs = cfg.kv_block_size
 
         # make sure each active sequence has a block for its next position
@@ -537,6 +536,12 @@ class Scheduler:
         self.allocator.flush_offload()
         if not active:
             return
+
+        # KV-width bucketing: the block table (and so the gather/page walk
+        # behind attention) is sized to the LIVE context, rounded up a
+        # power-of-two ladder — short-context decode doesn't pay the
+        # max_model_len table width (one compiled program per bucket)
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
 
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)

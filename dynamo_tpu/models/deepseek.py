@@ -267,11 +267,15 @@ def mla_attention(
         return fn(q_lat, q_rope, c_all, kr_all, block_tables,
                   context_lens, li_arr)[..., :r]
 
-    c_layer = jax.lax.dynamic_index_in_dim(c_all, li, 0, keepdims=False)
-    kr_layer = jax.lax.dynamic_index_in_dim(kr_all, li, 0, keepdims=False)
+    # layer indexing through the gather (see ops/attention.attention):
+    # block n of layer li is flat row li*N + n — no full-layer copy
+    l, n_blocks = c_all.shape[:2]
+    c_flat = c_all.reshape((l * n_blocks,) + c_all.shape[2:])
+    kr_flat = kr_all.reshape((l * n_blocks,) + kr_all.shape[2:])
+    li_arr = jnp.asarray(li, jnp.int32)
     return mla_paged_attention(
-        q_lat, q_rope, c_layer, kr_layer, block_tables, positions,
-        context_lens, scale,
+        q_lat, q_rope, c_flat, kr_flat, block_tables + li_arr * n_blocks,
+        positions, context_lens, scale,
     )[..., :r]
 
 

@@ -194,8 +194,15 @@ def attention(
     q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
     if resolve_attention_impl(impl) == "xla":
         if stacked:
-            k_cache = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
-            v_cache = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
+            # index the layer through the gather itself: block id n of
+            # layer li lives at flat row li*N + n. dynamic_index_in_dim
+            # would materialize a full-layer copy every scan step (~2x the
+            # whole cache in HBM traffic per forward); offsetting the
+            # (tiny) block table is free
+            l, n_blocks = k_cache.shape[:2]
+            k_cache = k_cache.reshape((l * n_blocks,) + k_cache.shape[2:])
+            v_cache = v_cache.reshape((l * n_blocks,) + v_cache.shape[2:])
+            block_tables = block_tables + li * n_blocks
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
                                context_lens, scale=scale)[..., :d]
 
