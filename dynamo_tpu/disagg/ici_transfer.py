@@ -37,6 +37,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 logger = logging.getLogger(__name__)
 
 
+class IciSendError(RuntimeError):
+    """A send failed. ``entered`` tells the caller whether the collective
+    was dispatched: False → the receiver's entry is still unpaired (send a
+    balancing entry); True → the collective itself failed, which unwinds
+    BOTH processes' entries (do not balance — there is nothing to pair)."""
+
+    def __init__(self, cause: BaseException, entered: bool):
+        super().__init__(f"ici send failed ({cause}); entered={entered}")
+        self.cause = cause
+        self.entered = entered
+
+
 class IciKvTransfer:
     """One sender↔receiver pair of the collective transfer plane.
 
@@ -168,7 +180,11 @@ class IciKvTransfer:
     # ---------- roles ----------
 
     def send(self, k_blocks, v_blocks, seq: int = 0) -> None:
-        """Sender side: k/v [L, n<=top bucket, bs, heads, d] device or host."""
+        """Sender side: k/v [L, n<=top bucket, bs, heads, d] device or host.
+
+        Raises IciSendError carrying whether the collective was entered —
+        the caller needs that to keep the plane's 1:1 pairing discipline.
+        """
         assert self.is_sender
         n = k_blocks.shape[1]
         if n > self.buckets[-1]:
@@ -177,14 +193,35 @@ class IciKvTransfer:
                 f"{self.buckets[-1]}; chunk the payload"
             )
         bucket = self.bucket_for(n)
-        k = jnp.asarray(k_blocks, self.dtype)
-        v = jnp.asarray(v_blocks, self.dtype)
-        if n < bucket:
-            pad = [(0, 0)] * k.ndim
-            pad[1] = (0, bucket - n)
-            k = jnp.pad(k, pad)
-            v = jnp.pad(v, pad)
-        self._enter(bucket, k, v, seq)
+        entered = False
+        try:
+            k = jnp.asarray(k_blocks, self.dtype)
+            v = jnp.asarray(v_blocks, self.dtype)
+            if n < bucket:
+                pad = [(0, 0)] * k.ndim
+                pad[1] = (0, bucket - n)
+                k = jnp.pad(k, pad)
+                v = jnp.pad(v, pad)
+            (prog, kb, vb) = self._program(bucket)
+            k_g = self._global(k)
+            v_g = self._global(v)
+            seq_g = self._global(jnp.full((8,), seq, jnp.int32))
+            entered = True
+            prog(k_g, v_g, seq_g)
+        except BaseException as e:
+            raise IciSendError(e, entered) from e
+
+    def send_balancing_entry(self, nblocks: int) -> None:
+        """Pair an orphaned receiver entry (header out, collective never
+        entered) with a poison payload: seq -1 matches no header, so the
+        receiver drops it and the plane returns to 1:1."""
+        assert self.is_sender
+        bucket = self.bucket_for(nblocks)
+        (prog, kb, vb) = self._program(bucket)
+        k0 = jnp.zeros(kb[1:], self.dtype)
+        v0 = jnp.zeros(vb[1:], self.dtype)
+        prog(self._global(k0), self._global(v0),
+             self._global(jnp.full((8,), -1, jnp.int32)))
 
     def recv(self, nblocks: int):
         """Receiver side: returns (k, v, seq) — device arrays

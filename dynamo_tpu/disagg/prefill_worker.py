@@ -157,35 +157,70 @@ class PrefillWorker:
                 # collective plane: ids over TCP (ordering), bytes HBM→HBM;
                 # chunk at the top transfer bucket — sender and receiver
                 # must enter identically-shaped programs
+                from .ici_transfer import IciSendError
+
                 chunk = self.ici.buckets[-1]
-                try:
-                    for i in range(0, len(src_ids), chunk):
-                        src = src_ids[i : i + chunk]
-                        dst = dst_ids[i : i + chunk]
-                        k, v = await loop.run_in_executor(
-                            None,
-                            lambda s=src: self.runner.gather_blocks_device(s),
-                        )
-                        self._ici_seq += 1
-                        seq = self._ici_seq
+                for i in range(0, len(src_ids), chunk):
+                    src = src_ids[i : i + chunk]
+                    dst = dst_ids[i : i + chunk]
+                    # gather precedes the header: a gather failure leaves
+                    # the plane balanced (no unpaired receiver entry)
+                    k, v = await loop.run_in_executor(
+                        None,
+                        lambda s=src: self.runner.gather_blocks_device(s),
+                    )
+                    self._ici_seq += 1
+                    seq = self._ici_seq
+                    try:
                         await client.send_ici_blocks(rpr.request_id, dst, seq)
+                    except BaseException:
+                        # header delivery unknowable → pairing discipline
+                        # unknowable → abandon the plane (tcp from now on);
+                        # the receiver's seq check drops any leftover
+                        logger.exception(
+                            "ici header send failed; abandoning the "
+                            "collective plane (tcp fallback)"
+                        )
+                        self.ici = None
+                        raise
+                    try:
                         await loop.run_in_executor(
                             None, lambda a=k, b=v, s=seq: self.ici.send(a, b, s)
                         )
-                        nbytes += k.nbytes + v.nbytes
-                except BaseException:
-                    # the plane's pairing discipline is now unknowable (a
-                    # header may be out without its collective entry, or
-                    # vice versa) and collectives cannot be cancelled —
-                    # abandon the plane: all future transfers go TCP, the
-                    # receiver's seq check drops any mis-paired leftovers,
-                    # and this item redelivers over TCP
-                    logger.exception(
-                        "ici transfer failed; abandoning the collective "
-                        "plane (falling back to tcp permanently)"
-                    )
-                    self.ici = None
-                    raise
+                    except IciSendError as e:
+                        if not e.entered:
+                            # receiver holds an unpaired entry for this
+                            # header — pair it with a poison payload (seq
+                            # -1 never matches) so the plane stays 1:1 and
+                            # REMAINS usable for the redelivery
+                            try:
+                                await loop.run_in_executor(
+                                    None,
+                                    lambda n=len(dst):
+                                        self.ici.send_balancing_entry(n),
+                                )
+                                logger.warning(
+                                    "ici send failed before entering the "
+                                    "collective; balanced the plane and "
+                                    "keeping it"
+                                )
+                            except BaseException:
+                                logger.exception(
+                                    "balancing entry failed; abandoning "
+                                    "the collective plane (tcp fallback)"
+                                )
+                                self.ici = None
+                        else:
+                            # the collective itself failed — both sides'
+                            # entries unwound, but the distributed runtime
+                            # is now suspect
+                            logger.exception(
+                                "ici collective failed; abandoning the "
+                                "plane (tcp fallback)"
+                            )
+                            self.ici = None
+                        raise
+                    nbytes += k.nbytes + v.nbytes
             else:
                 k, v = await loop.run_in_executor(
                     None, lambda: self.runner.gather_blocks(src_ids)
@@ -215,7 +250,10 @@ class PrefillWorker:
             )
             return False
         rank = getattr(client, "ici_rank", None)
-        if rank != self.ici.receiver_rank:
+        # rank None = descriptor predates rank advertisement — trust the
+        # mode flag (matches pre-rank behavior; a genuine mismatch is only
+        # detectable when the receiver says who it is)
+        if rank is not None and rank != self.ici.receiver_rank:
             logger.warning(
                 "engine's ici receiver rank %s != configured %s; using tcp",
                 rank, self.ici.receiver_rank,

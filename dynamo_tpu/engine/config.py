@@ -27,9 +27,15 @@ class ModelConfig:
     num_kv_heads: int = 8
     head_dim: Optional[int] = None
     rope_theta: float = 10000.0
+    # HF rope_scaling dict (rope_type/type + params): "linear" and
+    # "llama3" are applied exactly (models/llama.rope_frequencies);
+    # other types load with a loud warning (unscaled frequencies)
+    rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
+    # qkv projection biases (Qwen2-family); o_proj stays bias-free
+    attention_bias: bool = False
     # MoE (Mixtral-class); num_experts == 0 means dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -87,6 +93,13 @@ class ModelConfig:
             ),
             head_dim=config.get("head_dim"),
             rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=config.get("rope_scaling") or None,
+            # Qwen2-family checkpoints carry qkv biases but their HF config
+            # has no attention_bias key — infer from the architecture name
+            attention_bias=config.get(
+                "attention_bias",
+                "qwen2" in str(config.get("architectures", "")).lower(),
+            ),
             rms_norm_eps=config.get("rms_norm_eps", 1e-5),
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             tie_word_embeddings=config.get("tie_word_embeddings", False),

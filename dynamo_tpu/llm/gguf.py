@@ -201,6 +201,16 @@ def hf_config_from_gguf(g: GgufFile) -> Dict[str, Any]:
     key_len = g.arch_key("attention.key_length")
     if key_len:
         cfg["head_dim"] = key_len
+    scale_type = g.arch_key("rope.scaling.type")
+    if scale_type:
+        cfg["rope_scaling"] = {
+            "rope_type": scale_type,
+            "factor": float(g.arch_key("rope.scaling.factor", 1.0) or 1.0),
+            "original_max_position_embeddings": g.arch_key(
+                "rope.scaling.original_context_length",
+                cfg["max_position_embeddings"],
+            ),
+        }
     experts = g.arch_key("expert_count", 0) or 0
     if experts:
         cfg["num_local_experts"] = experts

@@ -294,12 +294,13 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         else:
             qfull = (x @ lp["wq"]).reshape(b, s, h, nope + rope_d)
         q_nope, q_rope = qfull[..., :nope], qfull[..., nope:]
-        q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
+        q_rope = apply_rope(q_rope, positions, cfg.rope_theta, cfg.rope_scaling)
 
         # compressed KV state for the new tokens
         c_kv = rms_norm(x @ lp["w_dkv"], lp["ln_kv"], cfg.rms_norm_eps)
         kr = apply_rope(
-            (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta
+            (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta,
+            cfg.rope_scaling,
         )  # [B, S, 1, rd]
 
         # in-place scatter into the stacked caches

@@ -40,14 +40,57 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (norm * weight.astype(jnp.float32)).astype(dtype)
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
-    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+def rope_frequencies(
+    head_dim: int, theta: float, scaling: Optional[dict] = None
+) -> jax.Array:
+    """Inverse rope frequencies, with HF ``rope_scaling`` applied.
+
+    "linear" divides all frequencies by the factor; "llama3" (Llama-3.1+)
+    scales low-frequency bands by the factor with a smooth ramp between
+    the high/low wavelength thresholds — matching transformers'
+    ROPE_INIT_FUNCTIONS exactly so long-context checkpoints serve the
+    positions they were trained for. Unknown types warn once and load
+    unscaled (degrades only beyond the original context window).
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if not scaling:
+        return inv_freq
+    kind = scaling.get("rope_type") or scaling.get("type")
+    factor = float(scaling.get("factor", 1.0))
+    if kind == "linear":
+        return inv_freq / factor
+    if kind == "llama3":
+        low = float(scaling.get("low_freq_factor", 1.0))
+        high = float(scaling.get("high_freq_factor", 4.0))
+        orig = float(scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        return jnp.where(
+            wavelen < orig / high, inv_freq,            # high freq: keep
+            jnp.where(wavelen > orig / low, inv_freq / factor, scaled),
+        )
+    if kind not in (None, "default"):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "rope_scaling type %r not implemented; serving with unscaled "
+            "frequencies (contexts beyond the original window degrade)",
+            kind,
+        )
+    return inv_freq
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float,
+    scaling: Optional[dict] = None,
+) -> jax.Array:
     """x: [B, S, H, D]; positions: [B, S]. HF-style half-rotation RoPE."""
     d = x.shape[-1]
-    inv_freq = rope_frequencies(d, theta)                       # [D/2]
+    inv_freq = rope_frequencies(d, theta, scaling)              # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     cos = jnp.cos(angles)[:, :, None, :]                        # [B, S, 1, D/2]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -66,19 +109,24 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     def w(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
 
+    layers = {
+        "ln1": jnp.ones((l, d_model), dtype),
+        "wq": w(keys[1], (l, d_model, h * hd), d_model),
+        "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
+        "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
+        "wo": w(keys[4], (l, h * hd, d_model), h * hd),
+        "ln2": jnp.ones((l, d_model), dtype),
+        "w_gate": w(keys[5], (l, d_model, inter), d_model),
+        "w_up": w(keys[6], (l, d_model, inter), d_model),
+        "w_down": w(keys[7], (l, inter, d_model), inter),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((l, h * hd), dtype)
+        layers["bk"] = jnp.zeros((l, kvh * hd), dtype)
+        layers["bv"] = jnp.zeros((l, kvh * hd), dtype)
     params: Params = {
         "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
-        "layers": {
-            "ln1": jnp.ones((l, d_model), dtype),
-            "wq": w(keys[1], (l, d_model, h * hd), d_model),
-            "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
-            "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
-            "wo": w(keys[4], (l, h * hd, d_model), h * hd),
-            "ln2": jnp.ones((l, d_model), dtype),
-            "w_gate": w(keys[5], (l, d_model, inter), d_model),
-            "w_up": w(keys[6], (l, d_model, inter), d_model),
-            "w_down": w(keys[7], (l, inter, d_model), inter),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((d_model,), dtype),
     }
     if not cfg.tie_word_embeddings:
@@ -94,6 +142,10 @@ ATTN_LAYER_SPECS = {
     "wv": P(None, None, "tp"),
     "wo": P(None, "tp", None),
     "ln2": P(),
+    # qkv biases follow their projection's output sharding
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
 }
 
 
@@ -143,11 +195,18 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):
-        q = (x @ layer_params["wq"]).reshape(b, s, h_heads, hd)
-        k = (x @ layer_params["wk"]).reshape(b, s, kvh, hd)
-        v = (x @ layer_params["wv"]).reshape(b, s, kvh, hd)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = x @ layer_params["wq"]
+        k = x @ layer_params["wk"]
+        v = x @ layer_params["wv"]
+        if "bq" in layer_params:  # Qwen2-family qkv biases, pre-rope
+            q = q + layer_params["bq"]
+            k = k + layer_params["bk"]
+            v = v + layer_params["bv"]
+        q = q.reshape(b, s, h_heads, hd)
+        k = k.reshape(b, s, kvh, hd)
+        v = v.reshape(b, s, kvh, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         # in-place scatter into the stacked cache + layer-indexed kernels:
         # no per-layer cache slice is ever materialized inside the scan

@@ -68,6 +68,10 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
         "mlp.gate_proj.weight": ("w_gate", True),
         "mlp.up_proj.weight": ("w_up", True),
         "mlp.down_proj.weight": ("w_down", True),
+        # Qwen2-family qkv biases (models/llama.py adds them pre-rope)
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
     }
 
     for name, tensor in _iter_safetensors(model_dir):
@@ -82,7 +86,10 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
             _, idx, rest = name.split(".", 2)
             if rest in mapping:
                 key, transpose = mapping[rest]
-                staging[key][int(idx)] = tensor.T if transpose else tensor
+                # bias keys exist only when the checkpoint ships them
+                staging.setdefault(key, {})[int(idx)] = (
+                    tensor.T if transpose else tensor
+                )
             else:
                 logger.debug("skipping unmapped tensor %s", name)
 

@@ -53,6 +53,8 @@ if rank == 1:
     xfer.send(k_blocks, v_blocks, seq=41)
     # second transfer re-uses the compiled program
     xfer.send(k_blocks[:, :1] * 2.0, v_blocks[:, :1] * 2.0, seq=42)
+    # a balancing entry pairs an orphaned receiver entry with seq -1
+    xfer.send_balancing_entry(1)
     print("RANK1_OK", flush=True)
 else:
     k, v, seq = xfer.recv(n)
@@ -62,6 +64,9 @@ else:
     k2, v2, seq2 = xfer.recv(1)
     assert seq2 == 42, seq2
     np.testing.assert_allclose(np.asarray(k2), k_blocks[:, :1] * 2.0, rtol=1e-6)
+    k3, v3, seq3 = xfer.recv(1)
+    assert seq3 == -1, seq3            # poison payload → caller drops
+    assert not np.any(np.asarray(k3))
     print("RANK0_OK", flush=True)
 """
 
