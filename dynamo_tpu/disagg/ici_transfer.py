@@ -165,13 +165,21 @@ class IciKvTransfer:
                 0 if self.is_sender else 1])],
         )
 
+    def _stage(self, bucket: int, k_local, v_local, seq: int):
+        """Device-put the peer-sharded operands. Errors here are
+        PRE-entry: the collective has not been dispatched yet."""
+        prog, _, _ = self._program(bucket)
+        return prog, (
+            self._global(k_local),
+            self._global(v_local),
+            self._global(jnp.full((8,), seq, jnp.int32)),
+        )
+
     def _enter(self, bucket: int, k_local, v_local, seq: int):
-        (prog, kb, vb) = self._program(bucket)
-        k_g = self._global(k_local)
-        v_g = self._global(v_local)
-        seq_g = self._global(jnp.full((8,), seq, jnp.int32))
-        ko, vo, so = prog(k_g, v_g, seq_g)
-        # each process addresses exactly its own peer shard
+        prog, args = self._stage(bucket, k_local, v_local, seq)
+        ko, vo, so = prog(*args)
+        # each process addresses exactly its own peer shard; pulling seq
+        # to host synchronizes, so collective failures surface here
         k_shard = ko.addressable_shards[0].data[0]
         v_shard = vo.addressable_shards[0].data[0]
         seq_shard = int(np.asarray(so.addressable_shards[0].data[0])[0])
@@ -202,26 +210,30 @@ class IciKvTransfer:
                 pad[1] = (0, bucket - n)
                 k = jnp.pad(k, pad)
                 v = jnp.pad(v, pad)
-            (prog, kb, vb) = self._program(bucket)
-            k_g = self._global(k)
-            v_g = self._global(v)
-            seq_g = self._global(jnp.full((8,), seq, jnp.int32))
+            prog, args = self._stage(bucket, k, v, seq)
             entered = True
-            prog(k_g, v_g, seq_g)
+            # synchronize: jax dispatch is async, and a collective failure
+            # must surface HERE (inside the entered=True window) for the
+            # caller's pairing-discipline classification — not at some
+            # unrelated later device sync
+            jax.block_until_ready(prog(*args))
         except BaseException as e:
             raise IciSendError(e, entered) from e
 
     def send_balancing_entry(self, nblocks: int) -> None:
         """Pair an orphaned receiver entry (header out, collective never
         entered) with a poison payload: seq -1 matches no header, so the
-        receiver drops it and the plane returns to 1:1."""
+        receiver drops it and the plane returns to 1:1. Synchronous: a
+        failure must surface to the caller, which then abandons the
+        plane rather than logging it healthy."""
         assert self.is_sender
         bucket = self.bucket_for(nblocks)
-        (prog, kb, vb) = self._program(bucket)
-        k0 = jnp.zeros(kb[1:], self.dtype)
-        v0 = jnp.zeros(vb[1:], self.dtype)
-        prog(self._global(k0), self._global(v0),
-             self._global(jnp.full((8,), -1, jnp.int32)))
+        _, kb, vb = self._program(bucket)
+        prog, args = self._stage(
+            bucket, jnp.zeros(kb[1:], self.dtype),
+            jnp.zeros(vb[1:], self.dtype), -1,
+        )
+        jax.block_until_ready(prog(*args))
 
     def recv(self, nblocks: int):
         """Receiver side: returns (k, v, seq) — device arrays

@@ -27,9 +27,10 @@ class ModelConfig:
     num_kv_heads: int = 8
     head_dim: Optional[int] = None
     rope_theta: float = 10000.0
-    # HF rope_scaling dict (rope_type/type + params): "linear" and
-    # "llama3" are applied exactly (models/llama.rope_frequencies);
-    # other types load with a loud warning (unscaled frequencies)
+    # HF rope_scaling dict (rope_type/type + params): "linear", "llama3"
+    # and "yarn" (incl. DeepSeek's mscale variant) are applied exactly
+    # (models/llama.rope_frequencies); other types load with a loud
+    # warning (unscaled frequencies)
     rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096

@@ -202,7 +202,7 @@ def hf_config_from_gguf(g: GgufFile) -> Dict[str, Any]:
     if key_len:
         cfg["head_dim"] = key_len
     scale_type = g.arch_key("rope.scaling.type")
-    if scale_type:
+    if scale_type and scale_type != "none":  # llama.cpp writes "none"
         cfg["rope_scaling"] = {
             "rope_type": scale_type,
             "factor": float(g.arch_key("rope.scaling.factor", 1.0) or 1.0),

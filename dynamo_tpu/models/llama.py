@@ -20,6 +20,7 @@ framework.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -40,27 +41,38 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (norm * weight.astype(jnp.float32)).astype(dtype)
 
 
+def _yarn_mscale(factor: float, mscale: float = 1.0) -> float:
+    if factor <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(factor) + 1.0
+
+
 def rope_frequencies(
     head_dim: int, theta: float, scaling: Optional[dict] = None
-) -> jax.Array:
-    """Inverse rope frequencies, with HF ``rope_scaling`` applied.
+) -> Tuple[jax.Array, float]:
+    """(inverse rope frequencies, attention factor) with HF
+    ``rope_scaling`` applied.
 
     "linear" divides all frequencies by the factor; "llama3" (Llama-3.1+)
-    scales low-frequency bands by the factor with a smooth ramp between
-    the high/low wavelength thresholds — matching transformers'
-    ROPE_INIT_FUNCTIONS exactly so long-context checkpoints serve the
-    positions they were trained for. Unknown types warn once and load
+    scales low-frequency bands with a smooth ramp between the high/low
+    wavelength thresholds; "yarn" (DeepSeek-V2/V3 and NTK-extended
+    models) blends interpolated and extrapolated frequencies over the
+    beta_fast/beta_slow correction range and returns the mscale
+    attention factor the rotation must be multiplied by (cos/sin
+    scaling; q and k each carry it, so scores scale by its square —
+    matching transformers' ROPE_INIT_FUNCTIONS and DeepSeek's
+    mscale/mscale_all_dim variant exactly). Unknown types warn and load
     unscaled (degrades only beyond the original context window).
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
     if not scaling:
-        return inv_freq
+        return inv_freq, 1.0
     kind = scaling.get("rope_type") or scaling.get("type")
     factor = float(scaling.get("factor", 1.0))
     if kind == "linear":
-        return inv_freq / factor
+        return inv_freq / factor, 1.0
     if kind == "llama3":
         low = float(scaling.get("low_freq_factor", 1.0))
         high = float(scaling.get("high_freq_factor", 4.0))
@@ -72,7 +84,43 @@ def rope_frequencies(
         return jnp.where(
             wavelen < orig / high, inv_freq,            # high freq: keep
             jnp.where(wavelen > orig / low, inv_freq / factor, scaled),
+        ), 1.0
+    if kind == "yarn":
+        orig = float(scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+
+        def correction_dim(num_rotations: float) -> float:
+            return (head_dim / 2.0) * math.log(
+                orig / (num_rotations * 2.0 * math.pi)
+            ) / math.log(theta)
+
+        low = max(math.floor(correction_dim(beta_fast)), 0)
+        # transformers clamps to head_dim - 1 (not the D/2 frequency
+        # count) — the ramp denominator must match HF exactly or every
+        # mid-band blend shifts
+        high = min(math.ceil(correction_dim(beta_slow)), head_dim - 1)
+        if low == high:
+            high += 0.001  # avoid a zero-width ramp
+        ramp = jnp.clip(
+            (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
+            / (high - low), 0.0, 1.0,
         )
+        extrapolation_w = 1.0 - ramp   # high-frequency dims: keep as-is
+        inv = (inv_freq / factor) * (1.0 - extrapolation_w) \
+            + inv_freq * extrapolation_w
+        attention_factor = scaling.get("attention_factor")
+        if attention_factor is None:
+            mscale = float(scaling.get("mscale", 1.0) or 1.0)
+            mscale_all = float(scaling.get("mscale_all_dim", 0.0) or 0.0)
+            if mscale_all:
+                # DeepSeek variant: ratio of the two mscale curves
+                attention_factor = _yarn_mscale(factor, mscale) / _yarn_mscale(
+                    factor, mscale_all
+                )
+            else:
+                attention_factor = _yarn_mscale(factor)
+        return inv, float(attention_factor)
     if kind not in (None, "default"):
         import logging
 
@@ -81,19 +129,23 @@ def rope_frequencies(
             "frequencies (contexts beyond the original window degrade)",
             kind,
         )
-    return inv_freq
+    return inv_freq, 1.0
 
 
 def apply_rope(
     x: jax.Array, positions: jax.Array, theta: float,
     scaling: Optional[dict] = None,
 ) -> jax.Array:
-    """x: [B, S, H, D]; positions: [B, S]. HF-style half-rotation RoPE."""
+    """x: [B, S, H, D]; positions: [B, S]. HF-style half-rotation RoPE.
+
+    The yarn attention factor rides on cos/sin (as in transformers), so
+    q·k scores carry its square without touching the softmax scale.
+    """
     d = x.shape[-1]
-    inv_freq = rope_frequencies(d, theta, scaling)              # [D/2]
+    inv_freq, attn_factor = rope_frequencies(d, theta, scaling)   # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]                        # [B, S, 1, D/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :] * attn_factor            # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :] * attn_factor
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)

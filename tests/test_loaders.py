@@ -218,6 +218,78 @@ def test_llama3_rope_scaling_matches_hf(llama3_scaled_dir):
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+@pytest.fixture(scope="module")
+def llama_yarn_dir(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 16,
+            "beta_fast": 32.0, "beta_slow": 1.0,
+        },
+    )
+    torch.manual_seed(4)
+    model = LlamaForCausalLM(cfg)
+    d = tmp_path_factory.mktemp("llamayarn")
+    model.save_pretrained(d, safe_serialization=True)
+    return d, cfg, model
+
+
+def test_yarn_rope_scaling_matches_hf(llama_yarn_dir):
+    """yarn frequencies + the mscale attention factor match transformers
+    (the tiny original window spreads the prompt across the correction
+    range, so both the blend and the cos/sin scaling are exercised)."""
+    d, cfg, model = llama_yarn_dir
+    got = _serve_logits(d, cfg, PROMPT)
+    want = _hf_logits(model, PROMPT)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.fixture(scope="module")
+def deepseek_yarn_dir(tmp_path_factory):
+    import torch
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        first_k_dense_replace=1, norm_topk_prob=False,
+        routed_scaling_factor=1.0, scoring_func="softmax",
+        kv_lora_rank=16, q_lora_rank=24, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        n_group=1, topk_group=1, topk_method="greedy",
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 16,
+            "beta_fast": 32.0, "beta_slow": 1.0,
+            "mscale": 0.707, "mscale_all_dim": 0.707,
+        },
+    )
+    torch.manual_seed(5)
+    model = DeepseekV2ForCausalLM(cfg)
+    d = tmp_path_factory.mktemp("dsyarn")
+    model.save_pretrained(d, safe_serialization=True)
+    return d, cfg, model
+
+
+def test_deepseek_yarn_matches_hf(deepseek_yarn_dir):
+    """DeepSeek's yarn variant: mscale_all_dim² on the softmax scale plus
+    the mscale ratio on the rope rotation, as real V2/V3 configs use."""
+    d, cfg, model = deepseek_yarn_dir
+    got = _serve_logits(d, cfg, PROMPT)
+    want = _hf_logits(model, PROMPT)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
 def test_missing_loader_raises(tmp_path):
     """A checkpoint with no loader for its architecture must raise, not
     silently serve random weights (ADVICE round 1)."""
