@@ -92,6 +92,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="this host's rank (0 = leader/coordinator)")
     p.add_argument("--leader-addr", default="",
                    help="host:port of node 0's JAX coordinator")
+    # profiling (utils/profiling.py — XLA profiler, the TPU-first answer
+    # to the reference's external genai-perf measurement)
+    p.add_argument("--profile-dir", default="",
+                   help="enable GET /debug/profile trace capture into this "
+                        "directory (in=http only)")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="start the jax profiler gRPC server on this port "
+                        "(TensorBoard remote capture; any role)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -234,7 +242,10 @@ async def run_http(flags, engine, mdc) -> None:
             model_type="both" if mdc is not None else "chat",
             max_model_len=mdc.context_length if mdc is not None else None,
         )
-    service = HttpService(manager, flags.http_host, flags.http_port)
+    service = HttpService(
+        manager, flags.http_host, flags.http_port,
+        profile_dir=flags.profile_dir or None,
+    )
 
     watcher = None
     if flags.store_port is not None:
@@ -467,6 +478,13 @@ async def amain(argv: List[str]) -> None:
             num_nodes=flags.num_nodes,
             node_rank=flags.node_rank,
         ))
+
+    if flags.profiler_port:
+        # AFTER multihost init: start_server touches the backend, which
+        # would pin a local-only world before jax.distributed runs
+        from ..utils.profiling import enable_profiler_server
+
+        enable_profiler_server(flags.profiler_port)
 
     if src == "prefill":
         await run_prefill(flags)

@@ -80,17 +80,23 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics_prefix: str = "dynamo",
+        profile_dir: Optional[str] = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics(metrics_prefix)
+        self.profile_dir = profile_dir
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
         self.app.router.add_get("/v1/models", self.handle_models)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/health", self.handle_health)
+        if profile_dir:
+            # opt-in only: trace capture costs device time and writes disk
+            self.app.router.add_get("/debug/profile", self.handle_profile)
+            self._profile_lock = asyncio.Lock()
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
 
@@ -295,6 +301,28 @@ class HttpService:
 
     async def handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "models": self.manager.model_names()})
+
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """GET /debug/profile?seconds=N — capture an XLA profiler trace of
+        live traffic (enabled only with a configured profile dir)."""
+        from ..utils.profiling import capture_trace_async
+
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response({"error": "bad seconds"}, status=400)
+        if seconds != seconds:  # NaN survives min/max clamping
+            return web.json_response({"error": "bad seconds"}, status=400)
+        seconds = min(max(seconds, 0.1), 60.0)
+        # jax allows ONE active trace per process — serialize via a
+        # non-blocking lock so a concurrent capture gets a clean 409
+        if self._profile_lock.locked():
+            return web.json_response(
+                {"error": "a capture is already in flight"}, status=409
+            )
+        async with self._profile_lock:
+            trace_dir = await capture_trace_async(self.profile_dir, seconds)
+        return web.json_response({"trace_dir": trace_dir, "seconds": seconds})
 
 
 class _StreamDisconnect(Exception):

@@ -279,24 +279,36 @@ def mla_attention(
     )[..., :r]
 
 
-def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                     context_lens, mesh=None):
-    """MLA attention block for llama.run_layers."""
+def mla_softmax_scale(cfg) -> float:
+    """MLA attention softmax scale, incl. DeepSeek's yarn mscale.
+
+    With yarn + mscale_all_dim, the softmax scale carries mscale_all_dim²
+    over the WHOLE score (nope + rope); the rope part's cos/sin carry the
+    mscale/mscale_all ratio (llama.apply_rope) — together the rope score
+    scales by mscale², per DeepSeek's own modeling code (the checkpoints
+    were trained with it). transformers' NATIVE DeepseekV2 class omits
+    the softmax adjustment (its V3 class applies it); this framework
+    follows the canonical training-time semantics for both —
+    tests/test_loaders.py pins this computed scale.
+    """
     from .llama import _yarn_mscale
 
-    h = cfg.num_heads
-    nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
-    scale = (nope + rope_d) ** -0.5
+    scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
     sc = cfg.rope_scaling or {}
     if (sc.get("rope_type") or sc.get("type")) == "yarn":
-        # DeepSeek yarn: the softmax scale carries mscale_all_dim² over
-        # the WHOLE score (nope + rope); the rope part's cos/sin carry
-        # the mscale/mscale_all ratio (llama.apply_rope) — together the
-        # rope score scales by mscale² as in the HF modeling code
-        mscale_all = float(sc.get("mscale_all_dim", 0.0) or 0.0)
+        mscale_all = float(sc.get("mscale_all_dim") or 0.0)
         if mscale_all:
             m = _yarn_mscale(float(sc.get("factor", 1.0)), mscale_all)
             scale = scale * m * m
+    return scale
+
+
+def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                     context_lens, mesh=None):
+    """MLA attention block for llama.run_layers."""
+    h = cfg.num_heads
+    nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    scale = mla_softmax_scale(cfg)
 
     def attn_fn(x, lp, c_all, kr_all, li):
         # queries (optionally through the q low-rank bottleneck)

@@ -111,10 +111,12 @@ def rope_frequencies(
             + inv_freq * extrapolation_w
         attention_factor = scaling.get("attention_factor")
         if attention_factor is None:
-            mscale = float(scaling.get("mscale", 1.0) or 1.0)
-            mscale_all = float(scaling.get("mscale_all_dim", 0.0) or 0.0)
-            if mscale_all:
-                # DeepSeek variant: ratio of the two mscale curves
+            mscale = float(scaling.get("mscale") or 0.0)
+            mscale_all = float(scaling.get("mscale_all_dim") or 0.0)
+            if mscale and mscale_all:
+                # DeepSeek variant: ratio of the two mscale curves —
+                # taken only when BOTH keys are present, exactly as
+                # transformers' _compute_yarn_parameters does
                 attention_factor = _yarn_mscale(factor, mscale) / _yarn_mscale(
                     factor, mscale_all
                 )

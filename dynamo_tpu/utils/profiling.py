@@ -1,0 +1,61 @@
+"""Device profiling hooks: jax.profiler capture, on demand.
+
+The reference measures performance externally (genai-perf, perf.sh —
+SURVEY.md §5 notes no in-repo profiler integration); on TPU the
+first-class tool is the XLA profiler, so this framework wires it in as
+part of the serving surface:
+
+- ``enable_profiler_server(port)`` starts jax's profiler gRPC server —
+  TensorBoard (or ``jax.profiler.trace_remote``) can then capture traces
+  from a live worker, the standard remote-capture workflow.
+- ``capture_trace(out_dir, seconds)`` records a trace window in-process
+  (device activity + HLO annotations) — the engine's HTTP service
+  exposes it at ``GET /debug/profile`` when ``--profile-dir`` is set, so
+  an operator can grab a trace of live traffic with one curl.
+
+Both are thin wrappers so non-serving code (bench.py, tests) can reuse
+the same entry points.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+_server_started = False
+
+
+def enable_profiler_server(port: int) -> None:
+    """Start the jax profiler gRPC server (idempotent; once per process)."""
+    global _server_started
+    if _server_started:
+        return
+    import jax
+
+    jax.profiler.start_server(port)
+    _server_started = True
+    logger.info("jax profiler server on port %d (TensorBoard-capturable)", port)
+
+
+def capture_trace(out_dir: str, seconds: float) -> str:
+    """Record a profiler trace window; returns the trace directory.
+
+    Blocking — run it in an executor from async code. Each capture lands
+    in a timestamped subdirectory so consecutive captures never collide.
+    """
+    import jax
+
+    trace_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        time.sleep(seconds)
+    return trace_dir
+
+
+async def capture_trace_async(out_dir: str, seconds: float) -> str:
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, capture_trace, out_dir, seconds)

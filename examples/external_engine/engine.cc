@@ -1,0 +1,58 @@
+// Example EXTERNAL engine integrating through the C ABI.
+//
+// Demonstrates the contract the reference exposes through lib/bindings/c
+// (KV event publication from a non-Python engine): a C++ engine embeds
+// the dt_* symbols (dynamo_tpu/native/src/capi.cc), publishes
+// stored/removed KV-block events as it fills its own cache, and serves
+// generation through a tiny C interface that any host (here:
+// examples/external_engine/engine.py via ctypes) can call.
+//
+// Build (the test does this automatically):
+//   g++ -O2 -shared -fPIC -I dynamo_tpu/native/src \
+//       examples/external_engine/engine.cc dynamo_tpu/native/src/capi.cc \
+//       -o ext_engine.so
+//
+// The engine itself is deliberately trivial — it echoes the prompt —
+// because the point is the INTEGRATION surface, not the model: real
+// engines swap the body of ext_generate and keep the same dt_* event
+// calls. (Echoing forward, not reversed: a reversed chat prompt leads
+// with the template's EOS and the backend correctly stops at once.)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int dt_capi_init(const char* ns, const char* component, const char* worker_id,
+                 uint32_t kv_block_size, uint64_t capacity);
+int dt_capi_shutdown();
+// block hashes are computed ABI-side from the tokens (same rolling
+// scheme as the router's indexer); parent_hash chains prefix blocks
+int dt_kv_event_publish_stored(uint64_t event_id, const uint32_t* tokens,
+                               size_t num_tokens, const uint64_t* parent_hash);
+int dt_kv_event_publish_removed(uint64_t event_id, const uint64_t* block_hashes,
+                                size_t num_blocks);
+
+int ext_engine_init(const char* worker_id, uint32_t block_size) {
+  return dt_capi_init("public", "backend", worker_id, block_size, 4096);
+}
+
+int ext_engine_shutdown() { return dt_capi_shutdown(); }
+
+// Generate: reverse the prompt into `out` (toy decode), publishing one
+// "stored" KV event per full block of the prompt — exactly what a real
+// engine does as prefill KV lands in its cache.
+long ext_generate(const uint32_t* prompt, size_t n, uint32_t block_size,
+                  uint32_t* out, size_t cap) {
+  static uint64_t event_id = 0;
+  size_t nblocks = n / block_size;
+  if (nblocks > 0) {
+    // no parent: each prompt starts a fresh prefix chain
+    dt_kv_event_publish_stored(++event_id, prompt, nblocks * block_size,
+                               nullptr);
+  }
+  size_t m = n < cap ? n : cap;
+  for (size_t i = 0; i < m; ++i) out[i] = prompt[i];
+  return static_cast<long>(m);
+}
+}

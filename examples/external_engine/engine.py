@@ -1,0 +1,89 @@
+"""BYO-engine shim hosting the C++ external engine (engine.cc).
+
+Run with:  dynamo-run in=http out=pytok:examples/external_engine/engine.py \
+               --model-path <tokenizer dir>
+
+The shim shows the full external-engine integration surface the
+reference offers through its C bindings (lib/bindings/c): the engine is
+a shared library speaking the dt_* ABI; generation flows through the
+pytok contract (PreprocessedRequest in, EngineOutput chunks out), and
+the KV events the C++ side publishes are drained with dt_capi_drain —
+ready to feed a KVEventPublisher so the KV router prefix-matches onto
+this engine like any native one.
+"""
+
+import asyncio
+import ctypes
+import json
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB = None
+_BLOCK_SIZE = 16
+
+
+def _build_and_load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    repo = os.path.dirname(os.path.dirname(_HERE))
+    so = os.path.join(_HERE, "ext_engine.so")
+    src = os.path.join(_HERE, "engine.cc")
+    capi = os.path.join(repo, "dynamo_tpu", "native", "src", "capi.cc")
+    if not os.path.exists(so) or os.path.getmtime(so) < max(
+        os.path.getmtime(src), os.path.getmtime(capi)
+    ):
+        import subprocess
+
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, capi,
+             "-o", so],
+            check=True,
+        )
+    lib = ctypes.CDLL(so)
+    lib.ext_engine_init.restype = ctypes.c_int
+    lib.ext_engine_init.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.ext_generate.restype = ctypes.c_long
+    lib.ext_generate.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+    ]
+    lib.dt_capi_drain.restype = ctypes.c_long
+    lib.dt_capi_drain.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    _LIB = lib
+    return lib
+
+
+async def initialize(engine_args: dict):
+    lib = _build_and_load()
+    rc = lib.ext_engine_init(b"ext-worker-0", _BLOCK_SIZE)
+    if rc != 0:
+        raise RuntimeError(f"ext_engine_init failed rc={rc}")
+
+
+def drain_kv_events():
+    """Pull KV events the C++ engine published (JSON dicts)."""
+    lib = _build_and_load()
+    out, events = ctypes.create_string_buffer(1 << 16), []
+    while True:
+        n = lib.dt_capi_drain(out, len(out))
+        if n <= 0:
+            break
+        events.append(json.loads(out.raw[:n].decode()))
+    return events
+
+
+async def generate(request: dict):
+    lib = _build_and_load()
+    prompt = request.get("token_ids") or []
+    max_tokens = (request.get("stop_conditions") or {}).get("max_tokens") or 8
+    arr = (ctypes.c_uint32 * max(len(prompt), 1))(*prompt)
+    cap = max(int(max_tokens), 1)
+    out = (ctypes.c_uint32 * cap)()
+    n = await asyncio.get_running_loop().run_in_executor(
+        None,
+        lambda: lib.ext_generate(arr, len(prompt), _BLOCK_SIZE, out, cap),
+    )
+    for i in range(n):
+        yield {"token_ids": [int(out[i])]}
+    yield {"token_ids": [], "finish_reason": "stop"}

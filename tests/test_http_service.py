@@ -243,3 +243,53 @@ async def test_model_watcher_hot_add_remove():
         await service.stop()
         await watcher.stop()
         await serving.stop()
+
+
+@pytest.mark.asyncio
+async def test_profile_endpoint_captures_trace(tmp_path):
+    """--profile-dir exposes /debug/profile; a capture writes a trace dir
+    (jax profiler works on CPU, so this runs the real capture path)."""
+    import os
+
+    manager = ModelManager()
+    manager.add_chat_model("echo", EchoEngineFull())
+    service = HttpService(
+        manager, host="127.0.0.1", port=0, profile_dir=str(tmp_path)
+    )
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/debug/profile?seconds=0.2"
+            async with s.get(url) as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["trace_dir"].startswith(str(tmp_path))
+            # the capture produced profiler artifacts on disk
+            files = [
+                os.path.join(dp, f)
+                for dp, _dn, fn in os.walk(body["trace_dir"]) for f in fn
+            ]
+            assert files, "no trace files written"
+            async with s.get(
+                f"http://127.0.0.1:{service.port}/debug/profile?seconds=abc"
+            ) as r:
+                assert r.status == 400
+            async with s.get(
+                f"http://127.0.0.1:{service.port}/debug/profile?seconds=nan"
+            ) as r:
+                assert r.status == 400  # NaN survives min/max clamps
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_profile_endpoint_absent_without_dir():
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{service.port}/debug/profile"
+            ) as r:
+                assert r.status == 404
+    finally:
+        await service.stop()

@@ -267,11 +267,15 @@ def deepseek_yarn_dir(tmp_path_factory):
         qk_nope_head_dim=16, v_head_dim=16,
         max_position_embeddings=128, tie_word_embeddings=False,
         n_group=1, topk_group=1, topk_method="greedy",
+        # mscale_all_dim deliberately ABSENT: transformers' native V2
+        # class and DeepSeek's canonical code agree only then (HF V2
+        # omits the mscale² softmax adjustment its V3 class applies), so
+        # this fixture keeps logits comparable; the canonical softmax
+        # scale itself is pinned by test_deepseek_mscale_softmax_scale
         rope_scaling={
             "rope_type": "yarn", "factor": 4.0,
             "original_max_position_embeddings": 16,
             "beta_fast": 32.0, "beta_slow": 1.0,
-            "mscale": 0.707, "mscale_all_dim": 0.707,
         },
     )
     torch.manual_seed(5)
@@ -282,12 +286,35 @@ def deepseek_yarn_dir(tmp_path_factory):
 
 
 def test_deepseek_yarn_matches_hf(deepseek_yarn_dir):
-    """DeepSeek's yarn variant: mscale_all_dim² on the softmax scale plus
-    the mscale ratio on the rope rotation, as real V2/V3 configs use."""
+    """yarn frequency blend + attention factor through the MLA path."""
     d, cfg, model = deepseek_yarn_dir
     got = _serve_logits(d, cfg, PROMPT)
     want = _hf_logits(model, PROMPT)
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_deepseek_mscale_softmax_scale():
+    """Canonical DeepSeek yarn semantics: mscale_all_dim² multiplies the
+    MLA softmax scale (real V2/V3 configs set mscale_all_dim; the
+    checkpoints were trained with this — DeepSeek's own modeling code)."""
+    import math
+
+    from dynamo_tpu.models.deepseek import mla_softmax_scale
+
+    base = ModelConfig(
+        kv_lora_rank=16, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16,
+    )
+    assert mla_softmax_scale(base) == pytest.approx(24 ** -0.5)
+
+    scaled = ModelConfig(
+        kv_lora_rank=16, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16,
+        rope_scaling={"rope_type": "yarn", "factor": 40.0,
+                      "mscale": 1.0, "mscale_all_dim": 1.0},
+    )
+    m = 0.1 * 1.0 * math.log(40.0) + 1.0
+    assert mla_softmax_scale(scaled) == pytest.approx(24 ** -0.5 * m * m)
 
 
 def test_missing_loader_raises(tmp_path):
