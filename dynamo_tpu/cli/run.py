@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--router-mode", default="round_robin",
                    choices=["random", "round_robin", "kv"])
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="experts shard over the ep mesh axis (MoE)")
+    p.add_argument("--data-parallel-size", type=int, default=1,
+                   help="batch shards over the dp mesh axis")
     p.add_argument("--token-level", action="store_true",
                    help="serve PreprocessedRequests (engine worker behind a processor)")
     p.add_argument("--worker-endpoint", default=None,

@@ -36,6 +36,8 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
         or min(mdc.context_length, model_cfg.max_position_embeddings),
         kv_block_size=mdc.kv_block_size,
         tp_size=getattr(flags, "tensor_parallel_size", 1),
+        ep_size=getattr(flags, "expert_parallel_size", 1),
+        dp_size=getattr(flags, "data_parallel_size", 1),
         host_kv_blocks=getattr(flags, "host_kv_blocks", 0) or 0,
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
         allow_random_weights=getattr(flags, "allow_random_weights", False),

@@ -48,6 +48,7 @@ class Worker:
         from dynamo_tpu.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
 
         flags = _WorkerFlags(self.service_config)
+        _maybe_join_world(flags)
         self.instance_id = f"w-{uuid.uuid4().hex[:12]}"
         comp = self.drt.namespace(NAMESPACE).component("Worker")
         self.publisher = KvEventPublisher(comp, self.instance_id)
@@ -76,6 +77,8 @@ class _WorkerFlags:
         self.max_batch_size = int(cfg.get("max-batch-size", 8))
         self.max_model_len = cfg.get("max-model-len")
         self.tensor_parallel_size = int(cfg.get("tensor-parallel-size", 1))
+        self.expert_parallel_size = int(cfg.get("expert-parallel-size", 1))
+        self.data_parallel_size = int(cfg.get("data-parallel-size", 1))
         self.host_kv_blocks = int(cfg.get("host-kv-blocks", 0))
         self.extra_engine_args = cfg.get("extra-engine-args")
         self.remote_prefill = bool(cfg.get("remote-prefill", False))
@@ -83,8 +86,30 @@ class _WorkerFlags:
         self.max_prefill_queue_size = int(cfg.get("max-prefill-queue-size", 16))
         self.namespace = NAMESPACE
         self.advertise_host = cfg.get("advertise-host", "127.0.0.1")
+        # multi-host world + collective KV transfer plane (docs/multihost.md,
+        # docs/disagg_serving.md) — same keys/defaults as cli.run's parser
+        self.num_nodes = int(cfg.get("num-nodes", 1))
+        self.node_rank = int(cfg.get("node-rank", 0))
+        self.leader_addr = cfg.get("leader-addr", "")
+        self.kv_transfer = cfg.get("kv-transfer", "tcp")
+        self.ici_sender_rank = int(cfg.get("ici-sender-rank", 1))
+        self.ici_receiver_rank = int(cfg.get("ici-receiver-rank", 0))
         if self.max_model_len is not None:
             self.max_model_len = int(self.max_model_len)
+
+
+def _maybe_join_world(flags) -> None:
+    """num-nodes > 1 → join the jax.distributed world BEFORE the first
+    backend touch (supervisor mode runs each service in its own process;
+    in-process test graphs must not set num-nodes)."""
+    if getattr(flags, "num_nodes", 1) > 1:
+        from dynamo_tpu.parallel.mesh import MultiHostConfig, initialize_multihost
+
+        initialize_multihost(MultiHostConfig(
+            leader_addr=flags.leader_addr,
+            num_nodes=flags.num_nodes,
+            node_rank=flags.node_rank,
+        ))
 
 
 # --------------------------------------------------------------------------
@@ -233,12 +258,13 @@ class PrefillWorker:
 
     @async_on_start
     async def setup(self):
-        from dynamo_tpu.cli.run import load_mdc
+        from dynamo_tpu.cli.run import _make_ici, load_mdc
         from dynamo_tpu.disagg import PrefillWorker as PrefillLoop
         from dynamo_tpu.engine.model_runner import ModelRunner
         from dynamo_tpu.engine.serving import engine_config_from_mdc
 
         flags = _WorkerFlags(self.service_config)
+        _maybe_join_world(flags)
         if flags.model_path is None:
             raise ValueError("PrefillWorker requires model-path in its config")
         mdc = load_mdc(flags)
@@ -248,6 +274,7 @@ class PrefillWorker:
             None, lambda: ModelRunner(engine_config, model_dir=mdc.model_path)
         )
         self.worker = PrefillLoop(
-            self.drt, runner, engine_config, namespace=NAMESPACE
+            self.drt, runner, engine_config, namespace=NAMESPACE,
+            ici=_make_ici(flags, runner),
         )
         self._task = self.drt.runtime.spawn(self.worker.run())

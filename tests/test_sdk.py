@@ -4,6 +4,7 @@ Mirrors the reference SDK's test strategy (reference: deploy/dynamo/sdk/
 src/dynamo/sdk/tests/{test_config,test_link,test_e2e}.py)."""
 
 import asyncio
+import os
 
 import pytest
 
@@ -253,3 +254,61 @@ async def test_e2e_unknown_endpoint_raises():
             client.nope
     finally:
         await stop_graph(drt2, handles)
+
+
+class TestLadderConfigs:
+    """The BASELINE.json config ladder ships as loadable example YAMLs."""
+
+    CONFIGS = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "llm", "configs",
+    )
+
+    def _load(self, name):
+        return ServiceConfig.from_file(os.path.join(self.CONFIGS, name))
+
+    def test_all_ladder_configs_parse(self):
+        for name in ("agg.yaml", "agg_router.yaml", "disagg_router.yaml",
+                     "tp70b_router.yaml", "mixtral_ep.yaml",
+                     "disagg_ici.yaml", "deepseek_mla_disagg.yaml"):
+            cfg = self._load(name)
+            worker = cfg.get("Worker")
+            assert worker.get("model-path"), name
+            assert cfg.get("Frontend").get("http-port"), name
+
+    def test_tp70b_shards_and_routes(self):
+        cfg = self._load("tp70b_router.yaml")
+        assert cfg.get("Worker")["tensor-parallel-size"] == 8
+        assert cfg.get("Processor")["router-mode"] == "kv"
+
+    def test_ici_configs_join_one_world(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(self.CONFIGS)))
+        from examples.llm.components import _WorkerFlags
+
+        for name in ("disagg_ici.yaml", "deepseek_mla_disagg.yaml"):
+            cfg = self._load(name)
+            w, p = cfg.get("Worker"), cfg.get("PrefillWorker")
+            assert w["kv-transfer"] == p["kv-transfer"] == "ici", name
+            assert w["num-nodes"] == p["num-nodes"] == 2, name
+            assert w["node-rank"] != p["node-rank"], name
+            assert w["leader-addr"] == p["leader-addr"], name
+            # the REAL wiring: the SDK worker services build their flags
+            # through _WorkerFlags — the keys must survive the mapping
+            wf, pf = _WorkerFlags(w), _WorkerFlags(p)
+            assert wf.kv_transfer == pf.kv_transfer == "ici", name
+            assert wf.num_nodes == pf.num_nodes == 2, name
+            assert wf.node_rank != pf.node_rank, name
+
+    def test_worker_flags_map_parallelism(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(self.CONFIGS)))
+        from examples.llm.components import _WorkerFlags
+
+        cfg = self._load("mixtral_ep.yaml")
+        flags = _WorkerFlags(cfg.get("Worker"))
+        assert flags.expert_parallel_size == 8
+        cfg = self._load("tp70b_router.yaml")
+        assert _WorkerFlags(cfg.get("Worker")).tensor_parallel_size == 8
