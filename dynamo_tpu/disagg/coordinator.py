@@ -109,7 +109,8 @@ class RemotePrefillCoordinator:
                      frequency_penalty: float = 0.0,
                      repetition_penalty: float = 1.0,
                      seed: Optional[int] = None,
-                     want_logprobs: bool = False) -> asyncio.Future:
+                     want_logprobs: bool = False,
+                     logit_bias: Optional[dict] = None) -> asyncio.Future:
         """Enqueue the prompt; returns a future → (first_token, logprob)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
@@ -124,7 +125,7 @@ class RemotePrefillCoordinator:
                 min_p=min_p, presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
                 repetition_penalty=repetition_penalty, seed=seed,
-                want_logprobs=want_logprobs,
+                want_logprobs=want_logprobs, logit_bias=logit_bias,
             ))
         except Exception:
             # push failed — nothing is coming; don't leak the pending entry
@@ -166,13 +167,14 @@ class RemotePrefillCoordinator:
         self.runner.scatter_blocks(block_ids, k_dev, v_dev)
 
     def _commit(self, request_id: str, first_token: int,
-                logprob: Optional[float]) -> None:
+                logprob: Optional[float],
+                top: Optional[dict] = None) -> None:
         fut = self._pending.pop(request_id, None)
         if fut is None or fut.done():
             logger.warning("commit for unknown request %s", request_id)
             return
         self.remote_completed += 1
-        fut.set_result((first_token, logprob))
+        fut.set_result((first_token, logprob, top))
 
     def metrics(self) -> dict:
         return {

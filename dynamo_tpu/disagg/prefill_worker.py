@@ -118,10 +118,12 @@ class PrefillWorker:
                 self.key, step_key = jax.random.split(self.key)
                 seed_keys = np.asarray(
                     jax.random.key_data(step_key), np.uint32)[None, :]
-            # penalty state: prompt presence for repetition penalty on the
-            # one sampled token (slot 0 of this worker's runner)
-            self.runner.set_sample_row(0, prompt, [])
-            next_tokens, lps = self.runner.step(
+            # sampling state: prompt presence for repetition penalty plus
+            # the request's logit_bias (slot 0 of this worker's runner)
+            self.runner.set_sample_row(
+                0, prompt, [], logit_bias=rpr.logit_bias
+            )
+            next_tokens, lps, top_vals, top_ids = self.runner.step(
                 *arrays,
                 np.asarray([rpr.temperature], np.float32),
                 np.asarray([rpr.top_k], np.int32),
@@ -134,9 +136,18 @@ class PrefillWorker:
                 counters=np.zeros(1, np.int32),
                 sample_slots=np.zeros(1, np.int32),
             )
-            token, lp = await loop.run_in_executor(
+            token, lp, top = await loop.run_in_executor(
                 None,
-                lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0])),
+                lambda: (
+                    int(np.asarray(next_tokens)[0]),
+                    float(np.asarray(lps)[0]),
+                    {
+                        int(t): float(v)
+                        for t, v in zip(
+                            np.asarray(top_ids)[0], np.asarray(top_vals)[0]
+                        )
+                    } if rpr.want_logprobs else None,
+                ),
             )
 
             # feed the local prefix cache so future prompts skip this work
@@ -231,7 +242,8 @@ class PrefillWorker:
                 )
                 nbytes = k.nbytes + v.nbytes
             await client.send_commit(
-                rpr.request_id, token, lp if rpr.want_logprobs else None
+                rpr.request_id, token, lp if rpr.want_logprobs else None,
+                top=top,
             )
             self.prefills += 1
             self.prefill_tokens += len(prompt) - num_cached

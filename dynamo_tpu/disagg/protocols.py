@@ -40,13 +40,24 @@ class RemotePrefillRequest:
     repetition_penalty: float = 1.0
     seed: Optional[int] = None
     want_logprobs: bool = False
+    logit_bias: Optional[dict] = None  # token id → additive logit offset
 
     def to_wire(self) -> bytes:
-        return msgpack.packb(dataclasses.asdict(self), use_bin_type=True)
+        d = dataclasses.asdict(self)
+        if d.get("logit_bias"):
+            # string keys on the wire: msgpack's strict decode (queue pop)
+            # rejects int map keys
+            d["logit_bias"] = {str(k): v for k, v in d["logit_bias"].items()}
+        return msgpack.packb(d, use_bin_type=True)
 
     @classmethod
     def from_wire(cls, data: bytes) -> "RemotePrefillRequest":
-        return cls(**msgpack.unpackb(data, raw=False))
+        d = msgpack.unpackb(data, raw=False)
+        if d.get("logit_bias"):
+            d["logit_bias"] = {
+                int(k): float(v) for k, v in d["logit_bias"].items()
+            }
+        return cls(**d)
 
 
 class PrefillQueue:

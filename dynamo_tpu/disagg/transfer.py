@@ -161,9 +161,12 @@ class KvTransferServer:
                     if inspect.isawaitable(result):
                         await result
                 elif mtype == "commit":
+                    top = header.get("top")
                     self.on_commit(
                         header["request_id"], header["first_token"],
                         header.get("logprob"),
+                        {int(k): float(v) for k, v in top.items()}
+                        if top else None,
                     )
                     # ack the commit so the sender can safely release blocks
                     writer.write(struct.pack(">I", 1) + b"\x01")
@@ -243,12 +246,16 @@ class KvTransferClient:
         await self.writer.drain()
 
     async def send_commit(self, request_id: str, first_token: int,
-                          logprob: Optional[float] = None) -> None:
+                          logprob: Optional[float] = None,
+                          top: Optional[dict] = None) -> None:
         self._send_header({
             "type": "commit",
             "request_id": request_id,
             "first_token": int(first_token),
             "logprob": None if logprob is None else float(logprob),
+            # first-token top-logprob alternatives (string token-id keys
+            # for the msgpack strict decode)
+            "top": {str(k): float(v) for k, v in top.items()} if top else None,
         })
         await self.writer.drain()
         # wait for the receiver's ack — after this the decode side owns the KV

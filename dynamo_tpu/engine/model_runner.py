@@ -26,7 +26,7 @@ from .. import models
 from ..models import llama
 from ..ops.attention import _pad_minor
 from .config import EngineConfig
-from .sampling import SamplingParams, logprobs_for, sample
+from .sampling import SamplingParams, logprobs_for, sample, top_logprobs_for
 
 logger = logging.getLogger(__name__)
 
@@ -130,13 +130,15 @@ class ModelRunner:
         self.cache_sharding = NamedSharding(self.mesh, cache_spec)
         self.kv_cache = tuple(jax.device_put(c, self.cache_sharding) for c in cache)
 
-        # per-slot sampling-penalty state: generated-token counts + prompt
-        # presence, [num_slots, vocab] on device (see engine/sampling.py)
+        # per-slot sampling state: generated-token counts, prompt presence,
+        # and OpenAI logit_bias rows — [num_slots, vocab] on device
+        # (see engine/sampling.py)
         self.state_sharding = NamedSharding(self.mesh, P("dp", None))
         b, v = config.max_batch_size, cfg.vocab_size
         self.sample_state = (
             jax.device_put(jnp.zeros((b, v), jnp.int32), self.state_sharding),
             jax.device_put(jnp.zeros((b, v), jnp.bool_), self.state_sharding),
+            jax.device_put(jnp.zeros((b, v), jnp.float32), self.state_sharding),
         )
 
         self._step_compiled = {}
@@ -154,9 +156,9 @@ class ModelRunner:
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
 
-        def step(params, k_cache, v_cache, counts, seen, tokens, positions,
-                 block_tables, slot_mapping, context_lens, last_idx,
-                 samp, sample_slots, commit):
+        def step(params, k_cache, v_cache, counts, seen, bias, tokens,
+                 positions, block_tables, slot_mapping, context_lens,
+                 last_idx, samp, sample_slots, commit):
             logits, (k_cache, v_cache) = arch.forward(
                 params, cfg, tokens, positions, (k_cache, v_cache),
                 block_tables, slot_mapping, context_lens,
@@ -166,15 +168,20 @@ class ModelRunner:
             last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
             row_counts = counts[sample_slots]              # [b, V]
             row_seen = seen[sample_slots]
-            next_tokens = sample(last_logits, samp, row_counts, row_seen)
-            lps = logprobs_for(last_logits, next_tokens)
+            row_bias = bias[sample_slots]
+            next_tokens = sample(
+                last_logits, samp, row_counts, row_seen, bias=row_bias
+            )
+            lps = logprobs_for(last_logits + row_bias, next_tokens)
+            top_vals, top_ids = top_logprobs_for(last_logits + row_bias)
             # count the sampled token as generated for its slot — but only
             # for rows whose sample the scheduler will keep (``commit``;
             # intermediate prefill-chunk samples are discarded)
             counts = counts.at[sample_slots, next_tokens].add(
                 commit.astype(jnp.int32)
             )
-            return next_tokens, lps, k_cache, v_cache, counts, seen
+            return (next_tokens, lps, top_vals, top_ids,
+                    k_cache, v_cache, counts, seen, bias)
 
         samp_spec = SamplingParams(
             temperature=batch_spec, top_k=batch_spec, top_p=batch_spec,
@@ -184,13 +191,14 @@ class ModelRunner:
         )
         self._step = jax.jit(
             step,
-            donate_argnums=(1, 2, 3, 4),
+            donate_argnums=(1, 2, 3, 4, 5),
             in_shardings=(
                 self.param_shardings,        # params
                 self.cache_sharding,         # k
                 self.cache_sharding,         # v
                 self.state_sharding,         # counts
                 self.state_sharding,         # seen
+                self.state_sharding,         # bias
                 batch2_spec,                 # tokens [B, S]
                 batch2_spec,                 # positions
                 batch2_spec,                 # block_tables
@@ -201,8 +209,9 @@ class ModelRunner:
                 batch_spec,                  # sample_slots
                 batch_spec,                  # commit
             ),
-            out_shardings=(batch_spec, batch_spec, self.cache_sharding,
-                           self.cache_sharding, self.state_sharding,
+            out_shardings=(batch_spec, batch_spec, batch2_spec, batch2_spec,
+                           self.cache_sharding, self.cache_sharding,
+                           self.state_sharding, self.state_sharding,
                            self.state_sharding),
         )
 
@@ -265,9 +274,10 @@ class ModelRunner:
             sample_slots = np.arange(b, dtype=np.int32)
         if commit is None:
             commit = np.zeros(b, bool)
-        next_tokens, lps, k, v, counts, seen = self._step(
+        (next_tokens, lps, top_vals, top_ids,
+         k, v, counts, seen, bias) = self._step(
             self.params, self.kv_cache[0], self.kv_cache[1],
-            self.sample_state[0], self.sample_state[1],
+            self.sample_state[0], self.sample_state[1], self.sample_state[2],
             jnp.asarray(tokens, jnp.int32), jnp.asarray(positions, jnp.int32),
             jnp.asarray(block_tables, jnp.int32), jnp.asarray(slot_mapping, jnp.int32),
             jnp.asarray(context_lens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
@@ -275,12 +285,15 @@ class ModelRunner:
             jnp.asarray(sample_slots, jnp.int32), jnp.asarray(commit, jnp.bool_),
         )
         self.kv_cache = (k, v)
-        self.sample_state = (counts, seen)
-        return next_tokens, lps
+        self.sample_state = (counts, seen, bias)
+        return next_tokens, lps, top_vals, top_ids
 
-    def set_sample_row(self, slot: int, prompt_ids, generated_ids=()) -> None:
-        """Install penalty state for a slot at admission: prompt presence +
-        generated-token counts (non-empty when resuming a preempted stream)."""
+    def set_sample_row(
+        self, slot: int, prompt_ids, generated_ids=(), logit_bias=None
+    ) -> None:
+        """Install sampling state for a slot at admission: prompt presence,
+        generated-token counts (non-empty when resuming a preempted
+        stream), and the request's OpenAI logit_bias row."""
         v = self.config.model.vocab_size
         seen_row = np.zeros(v, bool)
         if len(prompt_ids):
@@ -288,10 +301,15 @@ class ModelRunner:
         counts_row = np.zeros(v, np.int32)
         if len(generated_ids):
             np.add.at(counts_row, np.asarray(generated_ids, np.int64), 1)
+        bias_row = np.zeros(v, np.float32)
+        for tid, b in (logit_bias or {}).items():
+            tid = int(tid)
+            if 0 <= tid < v:
+                bias_row[tid] = float(b)
         self.sample_state = self._set_row_jit(
-            self.sample_state[0], self.sample_state[1],
+            self.sample_state[0], self.sample_state[1], self.sample_state[2],
             jnp.asarray(slot, jnp.int32), jnp.asarray(counts_row),
-            jnp.asarray(seen_row),
+            jnp.asarray(seen_row), jnp.asarray(bias_row),
         )
 
     # ---------- paged-block gather / scatter ----------
@@ -306,18 +324,20 @@ class ModelRunner:
     def _build_sample_row(self):
         repl = NamedSharding(self.mesh, P())
 
-        def set_row(counts, seen, slot, counts_row, seen_row):
+        def set_row(counts, seen, bias, slot, counts_row, seen_row, bias_row):
             return (
                 counts.at[slot].set(counts_row),
                 seen.at[slot].set(seen_row),
+                bias.at[slot].set(bias_row),
             )
 
         self._set_row_jit = jax.jit(
             set_row,
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1, 2),
             in_shardings=(self.state_sharding, self.state_sharding,
-                          repl, repl, repl),
-            out_shardings=(self.state_sharding, self.state_sharding),
+                          self.state_sharding, repl, repl, repl, repl),
+            out_shardings=(self.state_sharding, self.state_sharding,
+                           self.state_sharding),
         )
 
     BLOCK_OP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)

@@ -104,10 +104,13 @@ def sample(
     params: SamplingParams,
     counts: Optional[jax.Array] = None,   # [B, V] i32 generated-token counts
     seen: Optional[jax.Array] = None,     # [B, V] bool prompt-token presence
+    bias: Optional[jax.Array] = None,     # [B, V] f32 OpenAI logit_bias rows
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
 
     # ---- penalties (on raw logits, before temperature) ----
     if counts is not None:
@@ -162,3 +165,15 @@ def logprobs_for(
     """Log-probability of the chosen tokens (for OutputOptions.logprobs)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+
+
+# alternatives returned with every step — covers OpenAI's top_logprobs
+# (≤ 20); a fixed width keeps the step program's shapes static
+TOP_LOGPROBS_K = 20
+
+
+def top_logprobs_for(logits: jax.Array) -> tuple:
+    """(values [B, K], ids [B, K]) of the K most likely tokens per row."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(logp, TOP_LOGPROBS_K)
+    return vals, ids.astype(jnp.int32)

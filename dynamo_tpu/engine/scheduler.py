@@ -82,6 +82,7 @@ class EngineRequest:
     repetition_penalty: float = 1.0
     base_key: Optional[np.ndarray] = None  # uint32[2] per-request PRNG key
     want_logprobs: bool = False
+    logprobs_n: int = 0  # alternatives per token (OpenAI top_logprobs)
     # runtime state
     slot: int = -1
     block_ids: List[int] = dataclasses.field(default_factory=list)
@@ -179,6 +180,7 @@ class Scheduler:
                 0, 2**32, size=2, dtype=np.uint32
             )
         er.want_logprobs = bool(er.req.output_options.logprobs)
+        er.logprobs_n = int(er.req.output_options.logprobs or 0)
         self.waiting.append(er)
         self.wake.set()
 
@@ -210,13 +212,28 @@ class Scheduler:
                 return i
         return None
 
-    def _emit(self, er: EngineRequest, token: int, logprob: Optional[float]) -> None:
+    def _emit(self, er: EngineRequest, token: int, logprob: Optional[float],
+              top: Optional[dict] = None) -> None:
         out = EngineOutput(
             token_ids=[token],
             finish_reason=er.finish,
-            logprobs=[TokenLogprob(token, logprob)] if logprob is not None else None,
+            logprobs=(
+                [TokenLogprob(token, logprob, top)]
+                if logprob is not None else None
+            ),
         )
         er.out_queue.put_nowait(out)
+
+    def _top_row(self, er: EngineRequest, top_vals, top_ids, row: int):
+        """The request's top-N alternatives dict from a step's [B, K]
+        top-logprob arrays (None unless the request asked for them)."""
+        if not er.want_logprobs or er.logprobs_n <= 0:
+            return None
+        n = min(er.logprobs_n, top_vals.shape[1])
+        return {
+            int(t): float(v)
+            for t, v in zip(top_ids[row, :n], top_vals[row, :n])
+        }
 
     def _finish(self, er: EngineRequest, reason: FinishReason, emit: bool = True) -> None:
         er.finish = reason
@@ -372,6 +389,7 @@ class Scheduler:
                 repetition_penalty=er.repetition_penalty,
                 seed=er.req.sampling_options.seed,
                 want_logprobs=er.want_logprobs,
+                logit_bias=er.req.sampling_options.logit_bias,
             )
         except Exception:
             # queue unreachable — release and let the local path take it
@@ -427,7 +445,7 @@ class Scheduler:
         The prefill worker already wrote the KV blocks into our cache and
         sampled the first token (max_tokens=1 semantics, reference:
         examples/llm/components/prefill_worker.py:148-178)."""
-        token, lp = er.remote_future.result()
+        token, lp, top = er.remote_future.result()
         er.remote_future = None
         er.slot = slot
         self.slots[slot] = er
@@ -435,11 +453,18 @@ class Scheduler:
         er.pending_token = token
         er.generated = 1
         # penalty/PRNG state for the decode steps this slot is entering
-        self.runner.set_sample_row(slot, er.prompt, [token])
+        self.runner.set_sample_row(
+            slot, er.prompt, [token],
+            logit_bias=er.req.sampling_options.logit_bias,
+        )
         er.seq = TokenSequence(er.prompt, block_size=self.config.kv_block_size)
         self._register_completed_blocks(er)
         er.finish = self._check_finish(er, token)
-        self._emit(er, token, lp if er.want_logprobs else None)
+        if top and er.logprobs_n > 0:
+            top = dict(list(top.items())[: er.logprobs_n])
+        else:
+            top = None
+        self._emit(er, token, lp if er.want_logprobs else None, top)
         if er.finish is not None:
             self._finish(er, er.finish, emit=False)
 
@@ -465,7 +490,10 @@ class Scheduler:
         er.registered_blocks = 0
         # penalty state for the slot: prompt presence + (on resume) counts
         # of the already-generated tokens
-        self.runner.set_sample_row(slot, er.prompt, er.resume_tokens)
+        self.runner.set_sample_row(
+            slot, er.prompt, er.resume_tokens,
+            logit_bias=er.req.sampling_options.logit_bias,
+        )
         self.prefilling = er
 
     async def _prefill_chunk(self, loop, er: EngineRequest) -> None:
@@ -481,7 +509,7 @@ class Scheduler:
             cfg, er.prefill_tokens[:end], er.prefill_pos, er.block_ids
         )
         t0 = time.monotonic()
-        next_tokens, lps = self.runner.step(
+        next_tokens, lps, top_vals, top_ids = self.runner.step(
             *arrays,
             np.asarray([er.temperature], np.float32),
             np.asarray([er.top_k], np.int32),
@@ -507,14 +535,18 @@ class Scheduler:
         if not final:
             return
 
-        token, lp = await loop.run_in_executor(
-            None, lambda: (int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]))
+        token, lp, tv, ti = await loop.run_in_executor(
+            None, lambda: (
+                int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]),
+                np.asarray(top_vals), np.asarray(top_ids),
+            )
         )
         self.prefilling = None
         er.pending_token = token
         er.generated += 1  # += not =: resumed requests keep their count
         er.finish = self._check_finish(er, token)
-        self._emit(er, token, lp if er.want_logprobs else None)
+        self._emit(er, token, lp if er.want_logprobs else None,
+                   self._top_row(er, tv, ti, 0))
         if er.finish is not None:
             self._finish(er, er.finish, emit=False)
 
@@ -575,15 +607,16 @@ class Scheduler:
             ctrs[i] = er.generated
             commit[i] = True
 
-        next_tokens, lps = self.runner.step(
+        next_tokens, lps, top_vals, top_ids = self.runner.step(
             tokens, positions, btab, slot_map, ctx_lens, last_idx,
             temp, top_k, top_p,
             min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
             repetition_penalty=rep, seed_keys=keys, counters=ctrs,
             sample_slots=np.arange(b, dtype=np.int32), commit=commit,
         )
-        toks, lpn = await loop.run_in_executor(
-            None, lambda: (np.asarray(next_tokens), np.asarray(lps))
+        toks, lpn, tv, ti = await loop.run_in_executor(
+            None, lambda: (np.asarray(next_tokens), np.asarray(lps),
+                           np.asarray(top_vals), np.asarray(top_ids))
         )
         self.steps += 1
 
@@ -598,7 +631,8 @@ class Scheduler:
             er.pending_token = token
             er.generated += 1
             er.finish = self._check_finish(er, token)
-            self._emit(er, token, float(lpn[er.slot]) if er.want_logprobs else None)
+            self._emit(er, token, float(lpn[er.slot]) if er.want_logprobs else None,
+                       self._top_row(er, tv, ti, er.slot))
             if er.finish is not None:
                 self._finish(er, er.finish, emit=False)
 

@@ -171,6 +171,11 @@ class OpenAIPreprocessor(Operator):
                 presence_penalty=req.presence_penalty,
                 repetition_penalty=req.repetition_penalty,
                 seed=req.seed,
+                # OpenAI wire uses string token-id keys; clamp per spec
+                logit_bias={
+                    int(k): max(-100.0, min(100.0, float(v)))
+                    for k, v in req.logit_bias.items()
+                } if getattr(req, "logit_bias", None) else None,
             ),
             output_options=OutputOptions(
                 logprobs=(
@@ -178,6 +183,7 @@ class OpenAIPreprocessor(Operator):
                     if isinstance(getattr(req, "logprobs", None), bool) and req.logprobs
                     else (req.logprobs if isinstance(getattr(req, "logprobs", None), int) else None)
                 ),
+                echo_prompt=bool(getattr(req, "echo", False)),
             ),
             eos_token_ids=list(self.mdc.eos_token_ids),
             model=req.model,
@@ -320,8 +326,16 @@ class OpenAIPreprocessor(Operator):
         backend_stream: AsyncIterator[BackendOutput],
         prompt_tokens: int,
         include_usage: bool = False,
+        echo_text: Optional[str] = None,
     ) -> AsyncIterator[CompletionResponse]:
         completion_tokens = 0
+        if echo_text:
+            # OpenAI `echo`: the prompt leads the completion text
+            yield CompletionResponse(
+                id=request_id,
+                model=model,
+                choices=[CompletionChoice(text=echo_text, finish_reason=None)],
+            )
         async for out in backend_stream:
             completion_tokens = max(completion_tokens, out.cum_tokens)
             if out.text or out.finish_reason:
@@ -375,6 +389,12 @@ class OpenAIPreprocessor(Operator):
         if (is_chat and req.tools and req.tool_choice != "none"
                 and self.mdc.tool_call_format is not None):
             kwargs["tool_format"] = self.mdc.tool_call_format
+        if not is_chat and preprocessed.output_options.echo_prompt:
+            kwargs["echo_text"] = (
+                req.prompt if isinstance(req.prompt, str)
+                else self.tokenizer.decode(preprocessed.token_ids)
+                if self.tokenizer else None
+            )
         translate = self.chat_stream if is_chat else self.completion_stream
 
         n = preprocessed.sampling_options.n or 1

@@ -58,12 +58,24 @@ class SamplingOptions:
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
     seed: Optional[int] = None
+    # OpenAI logit_bias: token id → additive logit offset (engine applies
+    # it in the jitted sampler via a per-slot bias row)
+    logit_bias: Optional[Dict[int, float]] = None
 
     def to_wire(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if d.get("logit_bias"):
+            # string keys on the wire: msgpack's default strict_map_key
+            # decoding (and JSON) reject int map keys
+            d["logit_bias"] = {str(k): v for k, v in d["logit_bias"].items()}
+        return d
 
     @classmethod
     def from_wire(cls, d: dict) -> "SamplingOptions":
+        if d.get("logit_bias"):
+            d = {**d, "logit_bias": {
+                int(k): float(v) for k, v in d["logit_bias"].items()
+            }}
         return cls(**d)
 
 
@@ -152,7 +164,16 @@ class EngineOutput:
             d["text"] = self.text
         if self.logprobs is not None:
             d["logprobs"] = [
-                {"token_id": lp.token_id, "logprob": lp.logprob, "top": lp.top}
+                {
+                    "token_id": lp.token_id,
+                    "logprob": lp.logprob,
+                    # string keys: int map keys fail msgpack's strict
+                    # decode on the dial-back stream (and JSON)
+                    "top": (
+                        {str(k): v for k, v in lp.top.items()}
+                        if lp.top else lp.top
+                    ),
+                }
                 for lp in self.logprobs
             ]
         if self.kv_transfer_params is not None:
@@ -168,7 +189,11 @@ class EngineOutput:
             finish_reason=FinishReason(fr) if fr else None,
             text=d.get("text"),
             logprobs=[
-                TokenLogprob(lp["token_id"], lp["logprob"], lp.get("top"))
+                TokenLogprob(
+                    lp["token_id"], lp["logprob"],
+                    {int(k): float(v) for k, v in lp["top"].items()}
+                    if lp.get("top") else None,
+                )
                 for lp in lps
             ]
             if lps

@@ -70,6 +70,7 @@ class ChatCompletionRequest(BaseModel):
     seed: Optional[int] = None
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None
+    logit_bias: Optional[Dict[str, float]] = None
     min_tokens: Optional[int] = None
     ignore_eos: Optional[bool] = None
     user: Optional[str] = None
@@ -105,6 +106,7 @@ class CompletionRequest(BaseModel):
     repetition_penalty: Optional[float] = None
     seed: Optional[int] = None
     logprobs: Optional[int] = None
+    logit_bias: Optional[Dict[str, float]] = None
     echo: Optional[bool] = None
     min_tokens: Optional[int] = None
     ignore_eos: Optional[bool] = None

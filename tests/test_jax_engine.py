@@ -21,6 +21,7 @@ from dynamo_tpu.models import llama
 from dynamo_tpu.models.loader import load_llama_params
 from dynamo_tpu.protocols.common import (
     FinishReason,
+    OutputOptions,
     PreprocessedRequest,
     SamplingOptions,
     StopConditions,
@@ -302,7 +303,7 @@ def test_tp_sharded_runner_matches_single_device(hf_model_dir, hf_logits):
     btab = np.zeros((1, econfig.blocks_per_seq), np.int32)
     btab[0, : -(-s // bs)] = np.arange(-(-s // bs))
     slot_map = (btab[0, positions // bs] * bs + positions % bs).astype(np.int32)
-    next_tokens, _ = runner.step(
+    next_tokens, *_ = runner.step(
         tokens, positions, btab, slot_map,
         np.asarray([s], np.int32), np.asarray([s - 1], np.int32),
         np.zeros(1, np.float32), np.zeros(1, np.int32), np.ones(1, np.float32),
@@ -495,3 +496,69 @@ async def test_sampling_penalties_and_seed_isolation(hf_model_dir):
     with pytest.raises(EngineError):
         await one([1, 5, 9], n=2)
     await engine.close()
+
+
+@pytest.mark.asyncio
+async def test_logit_bias_forces_and_bans_tokens(hf_model_dir):
+    """OpenAI logit_bias: +100 forces a token under greedy; -100 bans the
+    greedy choice (the engine applies per-slot bias rows in the sampler)."""
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32",
+    )
+    engine = await JaxServingEngine.create(mdc, engine_config=econfig, warmup=False)
+    prompt = [1, 17, 43, 99, 7]
+
+    async def gen(bias):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=3, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, logit_bias=bias),
+        )
+        toks = []
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+        return toks
+
+    baseline = await gen(None)
+    forced = await gen({123: 100.0})
+    banned = await gen({baseline[0]: -100.0})
+    await engine.close()
+    assert forced == [123, 123, 123]
+    assert banned[0] != baseline[0]
+
+
+@pytest.mark.asyncio
+async def test_top_logprobs_stream(hf_model_dir):
+    """top_logprobs alternatives ride each token's logprobs entry and the
+    chosen (greedy) token leads its own top list."""
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32",
+    )
+    engine = await JaxServingEngine.create(mdc, engine_config=econfig, warmup=False)
+    req = PreprocessedRequest(
+        token_ids=[1, 17, 43, 99, 7],
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        output_options=OutputOptions(logprobs=3),
+    )
+    entries = []
+    async for out in engine.generate(Context(req)):
+        for lp in out.get("logprobs") or []:
+            entries.append(lp)
+    await engine.close()
+    assert len(entries) == 4
+    for lp in entries:
+        top = lp["top"]
+        assert len(top) == 3
+        ids = list(top)
+        # greedy: the sampled token is the most likely → first in top
+        assert int(ids[0]) == lp["token_id"]
+        vals = [top[i] for i in ids]
+        assert vals == sorted(vals, reverse=True)
+        assert abs(vals[0] - lp["logprob"]) < 1e-5

@@ -230,3 +230,75 @@ async def test_preprocessor_emits_requested_annotations(mdc, tokenizer):
     assert isinstance(by_name["token_ids"], list) and by_name["token_ids"]
     # annotations precede the data chunks
     assert isinstance(chunks[0], Annotated)
+
+
+def test_preprocess_maps_logit_bias_and_echo(mdc, tokenizer):
+    from dynamo_tpu.protocols.openai import CompletionRequest
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "hi"}],
+        logit_bias={"42": 150.0, "7": -150.0},  # OpenAI string keys, clamped
+    )
+    out = pre.preprocess_chat(req)
+    assert out.sampling_options.logit_bias == {42: 100.0, 7: -100.0}
+
+    creq = CompletionRequest(model="tiny-llama", prompt="hello", echo=True)
+    cout = pre.preprocess_completion(creq)
+    assert cout.output_options.echo_prompt is True
+    assert cout.sampling_options.logit_bias is None
+
+
+async def test_completion_echo_prepends_prompt(mdc, tokenizer):
+    """`echo: true` leads the completion stream with the prompt text."""
+    from dynamo_tpu.llm.backend import BackendOutput
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+
+    async def backend():
+        yield BackendOutput(token_ids=[5], text="out!", cum_tokens=1, finish_reason=None)
+
+    chunks = [
+        r async for r in pre.completion_stream(
+            "cmpl-1", "tiny-llama", backend(), prompt_tokens=2,
+            echo_text="hello ",
+        )
+    ]
+    texts = [c.choices[0].text for c in chunks if c.choices]
+    assert texts == ["hello ", "out!"]
+
+
+def test_int_keyed_dicts_survive_msgpack_strict_decode():
+    """logit_bias and top-logprob dicts ride msgpack planes whose decoders
+    use the strict default (int map keys rejected) — wire forms must
+    stringify keys and from_wire must restore ints."""
+    import msgpack
+
+    from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+    from dynamo_tpu.protocols.common import (
+        EngineOutput, SamplingOptions, TokenLogprob,
+    )
+
+    so = SamplingOptions(temperature=0.5, logit_bias={42: -5.0, 7: 3.5})
+    rt = SamplingOptions.from_wire(
+        msgpack.unpackb(msgpack.packb(so.to_wire(), use_bin_type=True),
+                        raw=False)
+    )
+    assert rt.logit_bias == {42: -5.0, 7: 3.5}
+
+    out = EngineOutput(
+        token_ids=[9],
+        logprobs=[TokenLogprob(9, -0.1, {9: -0.1, 2: -2.0})],
+    )
+    rt_out = EngineOutput.from_wire(
+        msgpack.unpackb(msgpack.packb(out.to_wire(), use_bin_type=True),
+                        raw=False)
+    )
+    assert rt_out.logprobs[0].top == {9: -0.1, 2: -2.0}
+
+    rpr = RemotePrefillRequest(
+        request_id="r", engine_id="e", token_ids=[1], block_ids=[0],
+        logit_bias={3: 1.0},
+    )
+    assert RemotePrefillRequest.from_wire(rpr.to_wire()).logit_bias == {3: 1.0}

@@ -167,7 +167,7 @@ def test_model_runner_moe_ep_sharding(dp, ep, tp):
     for i in range(b):
         btab[i, 0] = i
     slot_map = btab[:, :1] * bs + positions
-    next_tokens, _ = runner.step(
+    next_tokens, *_ = runner.step(
         tokens, positions, btab, slot_map, np.full(b, s, np.int32),
         np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
         np.zeros(b, np.int32), np.ones(b, np.float32), jax.random.PRNGKey(0),
