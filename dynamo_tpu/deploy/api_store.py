@@ -130,6 +130,12 @@ class ApiStoreService:
             spec = body.get("spec", {})
         except (json.JSONDecodeError, KeyError, TypeError) as e:
             return web.json_response({"error": f"invalid body: {e}"}, status=400)
+        if not isinstance(spec, dict):
+            # same contract as PUT — a stored non-dict spec would blow up
+            # every consumer that renders manifests from the record
+            return web.json_response(
+                {"error": "spec must be a JSON object"}, status=400
+            )
         if self.store.get(name) is not None:
             return web.json_response(
                 {"error": f"deployment {name!r} exists"}, status=409

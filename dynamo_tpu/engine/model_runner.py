@@ -295,12 +295,18 @@ class ModelRunner:
         generated-token counts (non-empty when resuming a preempted
         stream), and the request's OpenAI logit_bias row."""
         v = self.config.model.vocab_size
+        # defense in depth: the engine rejects out-of-vocab prompts at
+        # admission (serving.py), but this state write must never fault
+        # the scheduler loop — numpy fancy indexing neither clamps nor
+        # drops, so filter
         seen_row = np.zeros(v, bool)
         if len(prompt_ids):
-            seen_row[np.asarray(prompt_ids, np.int64)] = True
+            ids = np.asarray(prompt_ids, np.int64)
+            seen_row[ids[(ids >= 0) & (ids < v)]] = True
         counts_row = np.zeros(v, np.int32)
         if len(generated_ids):
-            np.add.at(counts_row, np.asarray(generated_ids, np.int64), 1)
+            gids = np.asarray(generated_ids, np.int64)
+            np.add.at(counts_row, gids[(gids >= 0) & (gids < v)], 1)
         bias_row = np.zeros(v, np.float32)
         for tid, b in (logit_bias or {}).items():
             tid = int(tid)

@@ -179,7 +179,9 @@ class Scheduler:
             er.base_key = self._rng.integers(
                 0, 2**32, size=2, dtype=np.uint32
             )
-        er.want_logprobs = bool(er.req.output_options.logprobs)
+        # logprobs is a COUNT: 0 = chosen token's logprob with no
+        # alternatives (None = off) — bool() would drop the 0 case
+        er.want_logprobs = er.req.output_options.logprobs is not None
         er.logprobs_n = int(er.req.output_options.logprobs or 0)
         self.waiting.append(er)
         self.wake.set()
@@ -367,11 +369,21 @@ class Scheduler:
             # prompt + resume_tokens; the remote path would restart the
             # stream from the prompt alone
             return False
+        # cheap pre-check before the (hash-the-whole-prompt) prefix probe:
+        # a larger prefix hit can only make the uncached suffix smaller,
+        # so a prompt that doesn't qualify with hit=0 never qualifies —
+        # and this loop runs for EVERY waiting request EVERY pass
+        if not self.disagg.decide(len(er.prompt), 0):
+            return False
         probe = self.allocator.probe_prefix(er.prompt)
         # host-tier blocks count as hit: restoring them locally is far
         # cheaper than a remote prefill round-trip
         prefix_hit = self.allocator.cached_tokens(probe)
         if not self.disagg.decide(len(er.prompt), prefix_hit):
+            # rejected on the hit term (the pre-check passed, and between
+            # the two calls only the hit changed); hits only grow, so this
+            # request belongs to the local path permanently
+            er.remote_attempted = True
             return False
         er.remote_attempted = True
         try:

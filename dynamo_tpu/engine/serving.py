@@ -99,6 +99,18 @@ class JaxServingEngine(AsyncEngine):
                 f"prompt length {len(req.token_ids)} exceeds engine max_model_len "
                 f"{self.config.max_model_len}"
             )
+        # token-id prompts arrive unvalidated from /v1/completions; an
+        # out-of-range id would fault deep inside the scheduler's penalty
+        # state (numpy fancy indexing) and kill the engine loop for
+        # everyone — reject HERE, per request
+        vocab = self.config.model.vocab_size
+        bad = next(
+            (t for t in req.token_ids if not 0 <= int(t) < vocab), None
+        )
+        if bad is not None:
+            raise EngineError(
+                f"prompt token id {bad} outside vocab [0, {vocab})"
+            )
         n = req.sampling_options.n
         if n is not None and n > 1:
             # reject rather than silently sample one choice (parity:

@@ -123,6 +123,13 @@ class OpenAIPreprocessor(Operator):
         return self._build(req, token_ids, prompt, max_tokens=req.effective_max_tokens())
 
     def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        if req.best_of is not None and req.best_of != (req.n or 1):
+            # served honestly or not at all: silently degrading best_of to
+            # n would return different completions than the client asked
+            # to select among
+            raise EngineError(
+                "best_of != n is not supported; use n-way sampling"
+            )
         prompt = req.prompt
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
             token_ids = list(prompt)
@@ -178,11 +185,7 @@ class OpenAIPreprocessor(Operator):
                 } if getattr(req, "logit_bias", None) else None,
             ),
             output_options=OutputOptions(
-                logprobs=(
-                    (req.top_logprobs or 1)
-                    if isinstance(getattr(req, "logprobs", None), bool) and req.logprobs
-                    else (req.logprobs if isinstance(getattr(req, "logprobs", None), int) else None)
-                ),
+                logprobs=self._logprobs_count(req),
                 echo_prompt=bool(getattr(req, "echo", False)),
             ),
             eos_token_ids=list(self.mdc.eos_token_ids),
@@ -200,6 +203,24 @@ class OpenAIPreprocessor(Operator):
         return out
 
     # ---------- backward: response translation ----------
+
+    @staticmethod
+    def _logprobs_count(req) -> Optional[int]:
+        """OpenAI logprobs fields → alternatives count (None = off).
+
+        Chat: ``logprobs: true`` + optional ``top_logprobs`` (0 means
+        "chosen token only, no alternatives"). Completions: ``logprobs``
+        IS the count, 0 included.
+        """
+        lp = getattr(req, "logprobs", None)
+        if isinstance(lp, bool):
+            if not lp:
+                return None
+            top = getattr(req, "top_logprobs", None)
+            return int(top) if top is not None else 0
+        if isinstance(lp, int):
+            return int(lp)
+        return None
 
     async def chat_stream(
         self,
@@ -227,36 +248,99 @@ class OpenAIPreprocessor(Operator):
         buffered: List[str] = []
         buffered_lps: List[LogprobEntry] = []
         last_finish: Optional[str] = None
+        # tool-call jail: with tools enabled, stream prose NORMALLY and
+        # withhold text only from a potential call marker onward — holding
+        # the whole generation (as a naive buffer-then-parse would) turns
+        # TTFT into full-generation latency for plain prose answers
+        from .tools import marker_prefix_len as _marker_prefix_len
+        from .tools import stream_markers as _tool_stream_markers
+
+        markers = (
+            _tool_stream_markers(tool_format) if tool_format is not None
+            else ()
+        )
+        pending = ""    # streamed-side tail that may be a marker prefix
+        jailed = False
+        first_text = True
+
+        def _chunk(text: str, lp=None, finish=None) -> ChatCompletionChunk:
+            return ChatCompletionChunk(
+                id=request_id,
+                model=model,
+                choices=[ChatStreamChoice(
+                    delta=ChatChoiceDelta(content=text or None),
+                    finish_reason=finish,
+                    logprobs=lp,
+                )],
+            )
+
         async for out in backend_stream:
             completion_tokens = max(completion_tokens, out.cum_tokens)
-            if tool_format is not None:
+            if tool_format is None:
+                if out.text or out.finish_reason:
+                    yield _chunk(
+                        out.text, self._logprobs(out),
+                        out.finish_reason.to_openai() if out.finish_reason
+                        else None,
+                    )
+                continue
+
+            lp = self._logprobs(out)
+            if out.finish_reason:
+                last_finish = out.finish_reason.to_openai()
+            if not jailed and out.text:
+                if first_text and out.text.lstrip()[:1] in ("{", "["):
+                    # a leading JSON value is the json tool-call form —
+                    # no later marker would flag it
+                    jailed = True
+                if out.text.strip():
+                    first_text = False
+            if jailed:
+                if pending:
+                    buffered.insert(0, pending)
+                    pending = ""
                 if out.text:
                     buffered.append(out.text)
-                lp = self._logprobs(out)
                 if lp and lp.content:
                     buffered_lps.extend(lp.content)
-                if out.finish_reason:
-                    last_finish = out.finish_reason.to_openai()
                 continue
-            if out.text or out.finish_reason:
-                yield ChatCompletionChunk(
-                    id=request_id,
-                    model=model,
-                    choices=[
-                        ChatStreamChoice(
-                            delta=ChatChoiceDelta(content=out.text),
-                            finish_reason=out.finish_reason.to_openai()
-                            if out.finish_reason
-                            else None,
-                            logprobs=self._logprobs(out),
-                        )
-                    ],
-                )
+            pending += out.text or ""
+            hit = min(
+                (pending.find(m) for m in markers if pending.find(m) >= 0),
+                default=-1,
+            )
+            if hit >= 0:
+                # prose before the marker streams; the marker and
+                # everything after is withheld for parsing (its logprobs
+                # ride the final parsed chunk)
+                jailed = True
+                release, held = pending[:hit], pending[hit:]
+                pending = ""
+                if held:
+                    buffered.append(held)
+                if lp and lp.content:
+                    buffered_lps.extend(lp.content)
+                chunk_lp = None
+            else:
+                keep = _marker_prefix_len(pending, markers)
+                release = pending[: len(pending) - keep] if keep else pending
+                pending = pending[len(pending) - keep:] if keep else ""
+                chunk_lp = lp
+            if release:
+                yield _chunk(release, chunk_lp)
+            elif lp and lp.content and chunk_lp is lp:
+                buffered_lps.extend(lp.content)
+
         if tool_format is not None:
             from .tools import extract_tool_calls
 
-            text = "".join(buffered)
-            content, calls = extract_tool_calls(text, tool_format)
+            if jailed:
+                text = "".join(buffered)
+                content, calls = extract_tool_calls(text, tool_format)
+            else:
+                # no marker ever appeared — whatever tail is pending is
+                # plain prose
+                text, content, calls = pending, pending, []
             lps = ChoiceLogprobs(content=buffered_lps) if buffered_lps else None
             if calls:
                 indexed = [{"index": i, **c} for i, c in enumerate(calls)]
@@ -274,15 +358,7 @@ class OpenAIPreprocessor(Operator):
                     )],
                 )
             else:
-                yield ChatCompletionChunk(
-                    id=request_id,
-                    model=model,
-                    choices=[ChatStreamChoice(
-                        delta=ChatChoiceDelta(content=text),
-                        finish_reason=last_finish or "stop",
-                        logprobs=lps,
-                    )],
-                )
+                yield _chunk(content, lps, last_finish or "stop")
         if include_usage:
             yield ChatCompletionChunk(
                 id=request_id,

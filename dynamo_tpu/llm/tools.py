@@ -120,3 +120,30 @@ def parse_tool_calls(
     """Extract tool calls from a complete generation, or None if the text
     is not a tool call (callers then deliver it as normal content)."""
     return extract_tool_calls(text, fmt)[1]
+
+
+def stream_markers(fmt: str = "auto"):
+    """Substrings whose appearance in a stream signals a potential tool
+    call: the backend's streaming jail withholds text only from a marker
+    onward (the ``json`` format has no marker — a leading JSON value is
+    its only signature, which the caller checks on the first chunk)."""
+    if fmt == "hermes":
+        return ("<tool_call>",)
+    if fmt == "mistral":
+        return (_MISTRAL_PREFIX,)
+    if fmt == "json":
+        return ()
+    return ("<tool_call>", _MISTRAL_PREFIX)
+
+
+def marker_prefix_len(tail: str, markers) -> int:
+    """Longest suffix of ``tail`` that is a proper prefix of any marker —
+    that many chars must be withheld in case the marker completes in the
+    next chunk (same idea as the detokenizer's stop-string jail)."""
+    best = 0
+    for m in markers:
+        for k in range(min(len(tail), len(m) - 1), 0, -1):
+            if tail.endswith(m[:k]):
+                best = max(best, k)
+                break
+    return best

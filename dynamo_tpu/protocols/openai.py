@@ -107,6 +107,7 @@ class CompletionRequest(BaseModel):
     seed: Optional[int] = None
     logprobs: Optional[int] = None
     logit_bias: Optional[Dict[str, float]] = None
+    best_of: Optional[int] = None
     echo: Optional[bool] = None
     min_tokens: Optional[int] = None
     ignore_eos: Optional[bool] = None

@@ -302,3 +302,45 @@ def test_int_keyed_dicts_survive_msgpack_strict_decode():
         logit_bias={3: 1.0},
     )
     assert RemotePrefillRequest.from_wire(rpr.to_wire()).logit_bias == {3: 1.0}
+
+
+def test_best_of_rejected_unless_equal_n(mdc, tokenizer):
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.runtime.engine import EngineError
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    with pytest.raises(EngineError, match="best_of"):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=3)
+        )
+    # best_of == n degenerates to plain n-way sampling — accepted
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", best_of=2, n=2)
+    )
+    assert out.sampling_options.n == 2
+
+
+def test_logprobs_zero_edge_cases(mdc, tokenizer):
+    """completions logprobs=0 and chat top_logprobs=0 mean 'chosen token's
+    logprob, no alternatives' — NOT off, and not one alternative."""
+    from dynamo_tpu.protocols.openai import CompletionRequest
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", logprobs=0)
+    )
+    assert out.output_options.logprobs == 0
+    out = pre.preprocess_chat(ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+        logprobs=True, top_logprobs=0,
+    ))
+    assert out.output_options.logprobs == 0
+    out = pre.preprocess_chat(ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+        logprobs=True,
+    ))
+    assert out.output_options.logprobs == 0
+    out = pre.preprocess_chat(ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+    ))
+    assert out.output_options.logprobs is None

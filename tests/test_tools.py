@@ -117,7 +117,9 @@ async def test_chat_stream_emits_tool_call_delta():
 
 
 @pytest.mark.asyncio
-async def test_chat_stream_flushes_text_when_not_a_tool_call():
+async def test_chat_stream_streams_prose_incrementally_with_tools():
+    """tools enabled + plain prose answer → content streams as it is
+    generated (no call marker ever appears), not as one final flush."""
     pre = _mk_preprocessor()
     stream = await _fake_backend(["It is ", "sunny."])
     chunks = [
@@ -125,12 +127,41 @@ async def test_chat_stream_flushes_text_when_not_a_tool_call():
             "id2", "m", stream, prompt_tokens=3, tool_format="auto"
         )
     ]
-    final = chunks[-1]
-    assert final.choices[0].delta.content == "It is sunny."
-    assert final.choices[0].finish_reason == "stop"
+    texts = [
+        c.choices[0].delta.content for c in chunks
+        if c.choices and c.choices[0].delta.content
+    ]
+    assert len(texts) >= 2  # incremental, not one buffered flush
     resp = aggregate_chat_stream(chunks)
     assert resp.choices[0].message.content == "It is sunny."
+    assert resp.choices[0].finish_reason == "stop"
     assert resp.choices[0].message.tool_calls is None
+
+
+@pytest.mark.asyncio
+async def test_chat_stream_jails_marker_split_across_chunks():
+    """Prose streams; a <tool_call> marker arriving SPLIT across deltas is
+    still withheld and parsed (the marker-prefix jail)."""
+    pre = _mk_preprocessor()
+    stream = await _fake_backend([
+        "Let me check. ", "<tool_",
+        'call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>',
+    ])
+    chunks = [
+        c async for c in pre.chat_stream(
+            "id3", "m", stream, prompt_tokens=3, tool_format="hermes"
+        )
+    ]
+    texts = [
+        c.choices[0].delta.content for c in chunks
+        if c.choices and c.choices[0].delta.content
+    ]
+    # the prose streamed, the raw call syntax never did
+    assert any("Let me check." in t for t in texts)
+    assert not any("<tool_call>" in t for t in texts)
+    final = chunks[-1]
+    assert final.choices[0].finish_reason == "tool_calls"
+    assert final.choices[0].delta.tool_calls[0]["function"]["name"] == "get_weather"
 
 
 @pytest.mark.asyncio
