@@ -103,6 +103,9 @@ class EngineRequest:
     remote_future: Optional[asyncio.Future] = None
     remote_deadline: float = 0.0
     remote_attempted: bool = False
+    # passes to skip before re-probing for remote eligibility (set when a
+    # prefix-hit rejection made the probe pointless for a while)
+    remote_backoff: int = 0
 
     @property
     def max_new(self) -> int:
@@ -364,6 +367,9 @@ class Scheduler:
         """
         if er.remote_attempted:
             return False  # already tried remote once — prefill locally
+        if er.remote_backoff > 0:
+            er.remote_backoff -= 1
+            return False
         if er.resume_tokens:
             # preempted stream: only the local path knows to re-prefill
             # prompt + resume_tokens; the remote path would restart the
@@ -380,10 +386,11 @@ class Scheduler:
         # cheaper than a remote prefill round-trip
         prefix_hit = self.allocator.cached_tokens(probe)
         if not self.disagg.decide(len(er.prompt), prefix_hit):
-            # rejected on the hit term (the pre-check passed, and between
-            # the two calls only the hit changed); hits only grow, so this
-            # request belongs to the local path permanently
-            er.remote_attempted = True
+            # rejected on the hit term. NOT permanent: cached prefixes can
+            # be evicted and the router threshold is live-tunable — back
+            # off instead, so the (whole-prompt) probe doesn't re-run on
+            # every scheduler pass while conditions are unchanged
+            er.remote_backoff = 8
             return False
         er.remote_attempted = True
         try:

@@ -111,6 +111,14 @@ class JaxServingEngine(AsyncEngine):
             raise EngineError(
                 f"prompt token id {bad} outside vocab [0, {vocab})"
             )
+        if req.stop_conditions.max_tokens == 0:
+            # an empty completion: nothing to schedule, finish immediately
+            from ..protocols.common import EngineOutput, FinishReason
+
+            yield EngineOutput(
+                token_ids=[], finish_reason=FinishReason.LENGTH
+            ).to_wire()
+            return
         n = req.sampling_options.n
         if n is not None and n > 1:
             # reject rather than silently sample one choice (parity:

@@ -159,18 +159,29 @@ class OpenAIPreprocessor(Operator):
                 f"{self.mdc.context_length}"
             )
         ignore_eos = bool(req.ignore_eos or (req.nvext and req.nvext.ignore_eos))
+        # nvext.greed_sampling forces greedy regardless of temperature
+        # (reference nvext surface)
+        temperature = (
+            0.0 if (req.nvext and req.nvext.greed_sampling)
+            else req.temperature
+        )
         budget = self.mdc.context_length - len(token_ids)
         out = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(
-                max_tokens=min(max_tokens, budget) if max_tokens else budget,
+                # `is not None`: an explicit max_tokens=0 means an EMPTY
+                # completion, not the full context budget
+                max_tokens=(
+                    min(max_tokens, budget) if max_tokens is not None
+                    else budget
+                ),
                 min_tokens=req.min_tokens,
                 stop=req.stop_list() or None,
                 ignore_eos=ignore_eos,
             ),
             sampling_options=SamplingOptions(
                 n=req.n,
-                temperature=req.temperature,
+                temperature=temperature,
                 top_p=req.top_p,
                 top_k=req.top_k,
                 min_p=req.min_p,
@@ -289,9 +300,12 @@ class OpenAIPreprocessor(Operator):
             if out.finish_reason:
                 last_finish = out.finish_reason.to_openai()
             if not jailed and out.text:
-                if first_text and out.text.lstrip()[:1] in ("{", "["):
+                if (first_text and tool_format in ("json", "auto")
+                        and out.text.lstrip()[:1] in ("{", "[")):
                     # a leading JSON value is the json tool-call form —
-                    # no later marker would flag it
+                    # no later marker would flag it. Only those formats:
+                    # for hermes/mistral a '[1] footnote...' opener is
+                    # ordinary prose and must stream
                     jailed = True
                 if out.text.strip():
                     first_text = False

@@ -344,3 +344,24 @@ def test_logprobs_zero_edge_cases(mdc, tokenizer):
         model="m", messages=[{"role": "user", "content": "x"}],
     ))
     assert out.output_options.logprobs is None
+
+
+def test_nvext_greed_sampling_forces_greedy(mdc, tokenizer):
+    from dynamo_tpu.protocols.openai import NvExt
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    out = pre.preprocess_chat(ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+        temperature=0.9, nvext=NvExt(greed_sampling=True),
+    ))
+    assert out.sampling_options.temperature == 0.0
+
+
+def test_max_tokens_zero_means_empty_completion(mdc, tokenizer):
+    from dynamo_tpu.protocols.openai import CompletionRequest
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", max_tokens=0)
+    )
+    assert out.stop_conditions.max_tokens == 0
