@@ -141,7 +141,6 @@ class ModelRunner:
             jax.device_put(jnp.zeros((b, v), jnp.float32), self.state_sharding),
         )
 
-        self._step_compiled = {}
         self._build_step()
         self._build_block_ops()
         self._build_sample_row()
@@ -451,14 +450,35 @@ class ModelRunner:
             i += len(chunk)
 
     def warmup(self, decode_batch: Optional[int] = None) -> None:
-        """Compile the decode programs up front — one per KV-width bucket.
+        """Compile the serving programs up front: the decode program per
+        KV-width bucket plus the largest prefill bucket.
 
         The scheduler sizes decode block tables with
         EngineConfig.kv_width_bucket, so serving touches a ladder of
         widths, not just blocks_per_seq; compiling the ladder here keeps
         multi-ten-second TPU compiles out of the first requests' latency
         (the analog of GPU engines' startup capture sweeps).
+
+        Resilience: if a Pallas kernel fails to COMPILE here under
+        ``attention_impl: auto`` (a Mosaic regression on this hardware /
+        toolchain), serving falls back to the XLA attention path instead
+        of crashing on the first request — same contract as bench.py's
+        fallback, now at the engine level.
         """
+        try:
+            self._warmup_once(decode_batch)
+        except Exception:
+            if self.config.model.attention_impl != "auto":
+                raise
+            logger.exception(
+                "pallas warmup failed; falling back to the XLA attention "
+                "path for this engine"
+            )
+            self.config.model.attention_impl = "xla"
+            self._build_step()
+            self._warmup_once(decode_batch)
+
+    def _warmup_once(self, decode_batch: Optional[int] = None) -> None:
         b = decode_batch or self.config.max_batch_size
         zeros2 = np.zeros((b, 1), np.int32)
         for w in self.config.kv_width_buckets():
@@ -470,3 +490,16 @@ class ModelRunner:
                 np.ones(b, np.float32),
                 jax.random.PRNGKey(0),
             )
+        # one prefill-shaped program (largest bucket, full table width) so
+        # the flash-prefill kernel's compile also happens — and fails —
+        # here rather than on the first real prompt
+        s = self.config.prefill_buckets[-1]
+        w = self.config.blocks_per_seq
+        self.step(
+            np.zeros((1, s), np.int32), np.zeros((1, s), np.int32),
+            np.zeros((1, w), np.int32), np.full((1, s), -1, np.int32),
+            np.ones(1, np.int32), np.zeros(1, np.int32),
+            np.zeros(1, np.float32), np.zeros(1, np.int32),
+            np.ones(1, np.float32),
+            jax.random.PRNGKey(0),
+        )

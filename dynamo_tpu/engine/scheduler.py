@@ -103,13 +103,17 @@ class EngineRequest:
     remote_future: Optional[asyncio.Future] = None
     remote_deadline: float = 0.0
     remote_attempted: bool = False
-    # passes to skip before re-probing for remote eligibility (set when a
-    # prefix-hit rejection made the probe pointless for a while)
-    remote_backoff: int = 0
+    # monotonic deadline before which the remote-eligibility probe is not
+    # re-run (set when a prefix-hit rejection made it pointless for a while;
+    # time-based — the scheduler loop can spin every ~1 ms)
+    remote_backoff_until: float = 0.0
 
     @property
     def max_new(self) -> int:
-        return self.req.stop_conditions.max_tokens or 16384
+        # `is None`, not falsy: an explicit 0 means an empty completion —
+        # the serving layer fast-paths it, but the invariant lives HERE
+        mt = self.req.stop_conditions.max_tokens
+        return 16384 if mt is None else mt
 
     @property
     def min_new(self) -> int:
@@ -367,8 +371,7 @@ class Scheduler:
         """
         if er.remote_attempted:
             return False  # already tried remote once — prefill locally
-        if er.remote_backoff > 0:
-            er.remote_backoff -= 1
+        if time.monotonic() < er.remote_backoff_until:
             return False
         if er.resume_tokens:
             # preempted stream: only the local path knows to re-prefill
@@ -390,7 +393,7 @@ class Scheduler:
             # be evicted and the router threshold is live-tunable — back
             # off instead, so the (whole-prompt) probe doesn't re-run on
             # every scheduler pass while conditions are unchanged
-            er.remote_backoff = 8
+            er.remote_backoff_until = time.monotonic() + 0.25
             return False
         er.remote_attempted = True
         try:

@@ -111,20 +111,22 @@ class JaxServingEngine(AsyncEngine):
             raise EngineError(
                 f"prompt token id {bad} outside vocab [0, {vocab})"
             )
-        if req.stop_conditions.max_tokens == 0:
-            # an empty completion: nothing to schedule, finish immediately
-            from ..protocols.common import EngineOutput, FinishReason
-
-            yield EngineOutput(
-                token_ids=[], finish_reason=FinishReason.LENGTH
-            ).to_wire()
-            return
         n = req.sampling_options.n
         if n is not None and n > 1:
             # reject rather than silently sample one choice (parity:
             # reference SamplingOptions carries n/best_of to engines that
             # implement them — lib/llm/src/protocols/common.rs:248-316)
             raise EngineError("n > 1 is not supported by this engine")
+        if req.stop_conditions.max_tokens == 0:
+            # an empty completion: nothing to schedule, finish immediately
+            # (AFTER the validation above — unsupported shapes must reject
+            # consistently regardless of max_tokens)
+            from ..protocols.common import EngineOutput, FinishReason
+
+            yield EngineOutput(
+                token_ids=[], finish_reason=FinishReason.LENGTH
+            ).to_wire()
+            return
         er = EngineRequest(
             request_id=request.id or uuid.uuid4().hex,
             prompt=list(req.token_ids),

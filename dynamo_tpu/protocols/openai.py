@@ -80,7 +80,11 @@ class ChatCompletionRequest(BaseModel):
     nvext: Optional[NvExt] = None
 
     def effective_max_tokens(self) -> Optional[int]:
-        return self.max_completion_tokens or self.max_tokens
+        # `is None`, not falsy: max_completion_tokens=0 means an empty
+        # completion, same as the completions endpoint's max_tokens=0
+        if self.max_completion_tokens is not None:
+            return self.max_completion_tokens
+        return self.max_tokens
 
     def stop_list(self) -> List[str]:
         if self.stop is None:

@@ -562,3 +562,40 @@ async def test_top_logprobs_stream(hf_model_dir):
         vals = [top[i] for i in ids]
         assert vals == sorted(vals, reverse=True)
         assert abs(vals[0] - lp["logprob"]) < 1e-5
+
+
+def test_warmup_falls_back_to_xla_when_pallas_cannot_compile(hf_model_dir):
+    """attention_impl auto + a Pallas path that cannot compile on this
+    backend → warmup flips the engine to XLA instead of leaving a bomb
+    for the first request (pallas_call is uncompilable on CPU without
+    interpret mode, which makes this a REAL failure-path test)."""
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    cfg.attention_impl = "auto"
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", prefill_buckets=[16],
+    )
+    params = load_llama_params(hf_model_dir, cfg, jnp.float32)
+    runner = ModelRunner(econfig, params=params)
+    from dynamo_tpu.ops import attention as attn_mod
+
+    orig = attn_mod.resolve_attention_impl
+    try:
+        # force 'auto' to resolve to pallas as it would on TPU
+        attn_mod.resolve_attention_impl = (
+            lambda impl: "pallas" if impl == "auto" else orig(impl)
+        )
+        runner._build_step()
+        runner.warmup()
+    finally:
+        attn_mod.resolve_attention_impl = orig
+    assert cfg.attention_impl == "xla"
+    # and the engine actually serves afterwards
+    out, *_ = runner.step(
+        np.zeros((2, 1), np.int32), np.zeros((2, 1), np.int32),
+        np.zeros((2, 8), np.int32), np.full((2, 1), -1, np.int32),
+        np.ones(2, np.int32), np.zeros(2, np.int32),
+        np.zeros(2, np.float32), np.zeros(2, np.int32),
+        np.ones(2, np.float32), jax.random.PRNGKey(0),
+    )
+    assert np.asarray(out).shape == (2,)
