@@ -11,9 +11,14 @@ the reference's engines (vLLM-class) typically sit at 0.5-0.7 of roofline
 on their hardware (no absolute numbers are published in the reference —
 BASELINE.md).
 
-The attention impl defaults to "auto" (the Pallas decode kernel on TPU);
-if that path fails to compile/run on the bench host, the run retries on
-the XLA path so the metric records engine throughput rather than a crash.
+Attempt order: the known-safe XLA path first (bank a number), then a
+tiny-shape subprocess probe of the Pallas decode kernel, then — only if
+the probe passed — the Pallas attempt with the remaining budget. The
+best valid number wins. A hung Mosaic compile can wedge a host's shared
+compile service (round-2 lesson), so nothing Pallas compiles before the
+XLA number is recorded, and every attempt runs in a child with a hard
+timeout. Budget knobs: BENCH_TOTAL_BUDGET_S (default 1380),
+BENCH_TIMEOUT_S (per-XLA-attempt, default 600), BENCH_XLA_ONLY=1.
 """
 
 from __future__ import annotations
@@ -143,25 +148,52 @@ def _run_impl_subprocess(impl: str, timeout_s: float):
 
 
 def main() -> None:
-    # preferred impl first (subprocess + timeout guards against compile
-    # hangs), then the XLA path as fallback so the metric records engine
-    # throughput rather than a crash; both attempts run in children so a
-    # wedged device/compile service can never hang the bench itself
+    # Bank a number FIRST, improve on it second. Ordering is deliberate:
+    # the XLA path's compile is known-safe, while a Pallas kernel's first
+    # Mosaic compile on a new host can hang the machine's shared compile
+    # service for every later process (observed: round 2 recorded rc 124
+    # and no number because the preferred path ran first and wedged the
+    # relay). So: (1) measure the XLA path in a child with a bounded
+    # timeout; (2) probe the decode kernel standalone on tiny shapes in
+    # a child; (3) only if the probe passes, run the Pallas attempt with
+    # the remaining budget. Whatever happens in (2)/(3), the XLA number
+    # from (1) is already in hand and gets printed.
     import os
+    import time as _time
 
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
-    result = _run_impl_subprocess("auto", timeout_s=timeout_s)
-    if result is None:
-        print("preferred path failed; retrying on the XLA path", flush=True)
-        result = _run_impl_subprocess("xla", timeout_s=timeout_s)
-    if result is None:
-        result = {
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1380"))
+    xla_timeout = min(float(os.environ.get("BENCH_TIMEOUT_S", "600")), total_budget)
+    t0 = _time.monotonic()
+
+    result = _run_impl_subprocess("xla", timeout_s=xla_timeout)
+    best = result
+
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from dynamo_tpu.ops.probe import probe_kernel
+
+        if probe_kernel("decode", timeout_s=min(180.0, remaining - 120)):
+            remaining = total_budget - (_time.monotonic() - t0)
+            pallas = _run_impl_subprocess("pallas", timeout_s=max(remaining, 60))
+            if pallas is not None and (
+                best is None or pallas["value"] > best["value"]
+            ):
+                best = pallas
+        else:
+            print("pallas decode kernel probe failed; keeping the XLA "
+                  "number", flush=True)
+
+    if best is None:
+        best = {
             "metric": METRIC,
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "error": "both attempts failed or timed out (device/compile "
+            "error": "all attempts failed or timed out (device/compile "
                      "service unreachable?)",
         }
-    print(json.dumps(result))
+    print(json.dumps(best))
 
 
 if __name__ == "__main__":

@@ -110,6 +110,7 @@ class RemotePrefillCoordinator:
                      repetition_penalty: float = 1.0,
                      seed: Optional[int] = None,
                      want_logprobs: bool = False,
+                     logprobs_n: int = 0,
                      logit_bias: Optional[dict] = None) -> asyncio.Future:
         """Enqueue the prompt; returns a future → (first_token, logprob)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -125,7 +126,8 @@ class RemotePrefillCoordinator:
                 min_p=min_p, presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
                 repetition_penalty=repetition_penalty, seed=seed,
-                want_logprobs=want_logprobs, logit_bias=logit_bias,
+                want_logprobs=want_logprobs, logprobs_n=logprobs_n,
+                logit_bias=logit_bias,
             ))
         except Exception:
             # push failed — nothing is coming; don't leak the pending entry

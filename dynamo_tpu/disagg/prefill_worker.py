@@ -135,6 +135,10 @@ class PrefillWorker:
                 seed_keys=seed_keys,
                 counters=np.zeros(1, np.int32),
                 sample_slots=np.zeros(1, np.int32),
+                # alternatives only when the request asked for top_logprobs
+                # (logprobs=0 means chosen-token logprob only — skip the
+                # [B, V] top-k sort, same gate as the decode scheduler)
+                want_top=rpr.logprobs_n > 0,
             )
             token, lp, top = await loop.run_in_executor(
                 None,
@@ -146,7 +150,7 @@ class PrefillWorker:
                         for t, v in zip(
                             np.asarray(top_ids)[0], np.asarray(top_vals)[0]
                         )
-                    } if rpr.want_logprobs else None,
+                    } if rpr.logprobs_n > 0 else None,
                 ),
             )
 
@@ -241,10 +245,20 @@ class PrefillWorker:
                     chunk_blocks=self.transfer_chunk_blocks,
                 )
                 nbytes = k.nbytes + v.nbytes
-            await client.send_commit(
+            committed = await client.send_commit(
                 rpr.request_id, token, lp if rpr.want_logprobs else None,
                 top=top,
             )
+            if not committed:
+                # the receiver dropped a payload frame and nacked — the
+                # decode side re-prefills locally after its timeout. Work
+                # is done from this worker's perspective (ack the queue
+                # item; a redelivery would nack again: the request id
+                # stays revoked on the decode side).
+                logger.warning(
+                    "decode engine nacked commit for %s (dropped payload); "
+                    "it will fall back to local prefill", rpr.request_id,
+                )
             self.prefills += 1
             self.prefill_tokens += len(prompt) - num_cached
             self.transfer_bytes += nbytes

@@ -40,6 +40,10 @@ class RemotePrefillRequest:
     repetition_penalty: float = 1.0
     seed: Optional[int] = None
     want_logprobs: bool = False
+    # alternatives count (OpenAI top_logprobs): 0 = chosen-token logprob
+    # only — the prefill worker then skips the [B, V] top-k sort and
+    # ships no top dict (matches the decode scheduler's logprobs_n gate)
+    logprobs_n: int = 0
     logit_bias: Optional[dict] = None  # token id → additive logit offset
 
     def to_wire(self) -> bytes:

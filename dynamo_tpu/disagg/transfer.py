@@ -17,14 +17,22 @@ Wire format, length-prefixed msgpack header + raw payloads:
   {type: "blocks", request_id, block_ids, shape, dtype, k_bytes, v_bytes}
   <k raw bytes> <v raw bytes>
   {type: "commit", request_id, first_token, logprob, generated}
+
+The commit is acked with one framed byte: \x01 = committed, \x00 = nacked
+(an earlier payload frame for the request was dropped — the decode side
+must NOT resume over blocks that were never scattered; its request falls
+back to local prefill via the coordinator's prefill_timeout_s).
 """
 
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import inspect
 import logging
 import struct
+import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import msgpack
@@ -33,6 +41,13 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 MAX_HEADER = 1 << 20
+# dropped-payload bookkeeping: ids are removed when their commit is
+# nacked; requests that never commit would otherwise accumulate forever.
+# TTL >> any sane commit delay (the decode side's prefill timeout is
+# 120 s), so expiry never un-poisons a commit that could still arrive;
+# the count cap is a last-resort bound and LOGS what it evicts.
+MAX_DROPPED = 4096
+DROPPED_TTL_S = 600.0
 
 
 def _np_dtype(name: str):
@@ -63,6 +78,7 @@ class KvTransferServer:
         host: str = "127.0.0.1",
         ici_recv: Optional[Callable[[int], tuple]] = None,
         ici_rank: Optional[int] = None,
+        ici_recv_timeout_s: float = 120.0,
     ):
         # scatter(request_id, block_ids, k, v) — may return an awaitable; an
         # async scatter MUST re-validate the request id after any await (the
@@ -83,8 +99,61 @@ class KvTransferServer:
         # pairs with this engine.
         self.ici_recv = ici_recv
         self.ici_rank = ici_rank
+        # generous default: the first recv compiles the collective program
+        self.ici_recv_timeout_s = ici_recv_timeout_s
+        # collective entries are strictly ordered — serialize receives
+        # across connections (the payloads pair with headers 1:1)
+        self._ici_lock = asyncio.Lock()
+        # request ids with a dropped payload frame (seq mismatch, revoked
+        # authorization, recv timeout): their commit must be NACKED — the
+        # decode side would otherwise resume over blocks that were never
+        # scattered, silently corrupting the stream. id -> monotonic time
+        # of the drop (insertion-ordered; TTL + logged-cap pruning).
+        self._dropped: Dict[str, float] = {}
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
+
+    def _mark_dropped(self, request_id: str) -> None:
+        now = time.monotonic()
+        self._dropped.pop(request_id, None)
+        self._dropped[request_id] = now
+        # TTL expiry (insertion order == time order): anything this old
+        # can no longer see a commit — the decode side gave up on the
+        # request minutes ago
+        for rid, t in list(self._dropped.items()):
+            if now - t <= DROPPED_TTL_S:
+                break
+            del self._dropped[rid]
+        while len(self._dropped) > MAX_DROPPED:
+            rid, _ = next(iter(self._dropped.items()))
+            del self._dropped[rid]
+            # un-poisoning is the corruption this set exists to prevent —
+            # if this ever fires under real load, raise the cap
+            logger.error(
+                "dropped-payload set over cap (%d); evicting %s — a late "
+                "commit for it would now be accepted", MAX_DROPPED, rid,
+            )
+
+    @staticmethod
+    def _call_in_daemon_thread(fn, *args) -> "concurrent.futures.Future":
+        """Run fn on a fresh DAEMON thread. A stranded collective recv
+        blocks its thread forever; ThreadPoolExecutor workers are
+        non-daemon and joined by an atexit hook, so a wedged one would
+        hang interpreter shutdown — daemon threads don't."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def work():
+            try:
+                result = fn(*args)
+            except BaseException as e:
+                if not fut.cancelled():
+                    fut.set_exception(e)
+            else:
+                if not fut.cancelled():
+                    fut.set_result(result)
+
+        threading.Thread(target=work, daemon=True, name="ici-recv").start()
+        return fut
 
     async def start(self) -> "KvTransferServer":
         self._server = await asyncio.start_server(self._handle, self.host, 0)
@@ -120,7 +189,10 @@ class KvTransferServer:
                     k_raw = await _read_exact(reader, header["k_bytes"])
                     v_raw = await _read_exact(reader, header["v_bytes"])
                     if not self.authorize(header["request_id"], header["block_ids"]):
-                        continue  # request gone — drop the frame
+                        # request gone — drop the frame; a later commit for
+                        # this id must be nacked, not resumed-on
+                        self._mark_dropped(header["request_id"])
+                        continue
                     dtype = _np_dtype(header["dtype"])
                     shape = tuple(header["shape"])
                     k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
@@ -135,14 +207,41 @@ class KvTransferServer:
                     if self.ici_recv is None:
                         logger.error("ici_blocks frame but no ici plane")
                         return
-                    loop = asyncio.get_running_loop()
                     # the sender has entered (or is about to enter) the
                     # collective — the receive MUST happen even for a
                     # cancelled request, or both sides deadlock; authorize
-                    # decides only whether the payload is scattered
-                    k, v, seq = await loop.run_in_executor(
-                        None, self.ici_recv, len(ids)
-                    )
+                    # decides only whether the payload is scattered. The
+                    # receive is BOUNDED: a sender that died after the
+                    # header leaves an entry that never pairs, and an
+                    # unbounded wait would strand this handler (and its
+                    # thread) forever.
+                    try:
+                        async with self._ici_lock:
+                            k, v, seq = await asyncio.wait_for(
+                                asyncio.wrap_future(
+                                    self._call_in_daemon_thread(
+                                        self.ici_recv, len(ids)
+                                    )
+                                ),
+                                timeout=self.ici_recv_timeout_s,
+                            )
+                    except asyncio.TimeoutError:
+                        # receiver-side plane abandonment: the stranded
+                        # recv owns the plane's only executor thread, so
+                        # the plane is unusable — stop advertising it.
+                        # Future ici frames (this or any connection) error
+                        # and close, which the sender surfaces as its own
+                        # abandonment; this request's commit gets nacked
+                        # and the decode side falls back to local prefill.
+                        logger.error(
+                            "ici recv timed out after %.0fs (sender lost "
+                            "after header?) — abandoning the ici plane on "
+                            "the receiver side",
+                            self.ici_recv_timeout_s,
+                        )
+                        self.ici_recv = None
+                        self._mark_dropped(header["request_id"])
+                        continue
                     if seq != header.get("seq", 0):
                         # a sender died between header and collective and
                         # this entry paired with a LATER send — the payload
@@ -154,16 +253,34 @@ class KvTransferServer:
                             "%s) — dropping mis-paired payload",
                             header.get("seq"), seq,
                         )
+                        self._mark_dropped(header["request_id"])
                         continue
                     if not self.authorize(header["request_id"], ids):
+                        self._mark_dropped(header["request_id"])
                         continue  # request gone — drop the received blocks
                     result = self.scatter(header["request_id"], ids, k, v)
                     if inspect.isawaitable(result):
                         await result
                 elif mtype == "commit":
+                    rid = header["request_id"]
+                    if rid in self._dropped:
+                        # a payload frame for this request was dropped —
+                        # its KV blocks were never (fully) scattered, so
+                        # committing would resume decode over garbage.
+                        # Nack: the sender releases its side, the decode
+                        # side's pending future times out and the request
+                        # re-prefills locally.
+                        del self._dropped[rid]
+                        logger.warning(
+                            "nacking commit for %s: an earlier payload "
+                            "frame was dropped", rid,
+                        )
+                        writer.write(struct.pack(">I", 1) + b"\x00")
+                        await writer.drain()
+                        continue
                     top = header.get("top")
                     self.on_commit(
-                        header["request_id"], header["first_token"],
+                        rid, header["first_token"],
                         header.get("logprob"),
                         {int(k): float(v) for k, v in top.items()}
                         if top else None,
@@ -247,7 +364,10 @@ class KvTransferClient:
 
     async def send_commit(self, request_id: str, first_token: int,
                           logprob: Optional[float] = None,
-                          top: Optional[dict] = None) -> None:
+                          top: Optional[dict] = None) -> bool:
+        """Returns True if the receiver committed, False if it nacked
+        (a payload frame was dropped — the decode side will re-prefill
+        locally; the sender just releases its resources either way)."""
         self._send_header({
             "type": "commit",
             "request_id": request_id,
@@ -259,7 +379,8 @@ class KvTransferClient:
         })
         await self.writer.drain()
         # wait for the receiver's ack — after this the decode side owns the KV
-        await _read_exact(self.reader, 5)
+        ack = await _read_exact(self.reader, 5)
+        return ack[-1:] == b"\x01"
 
     async def close(self) -> None:
         if self.writer is not None:

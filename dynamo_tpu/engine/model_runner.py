@@ -26,7 +26,7 @@ from .. import models
 from ..models import llama
 from ..ops.attention import _pad_minor
 from .config import EngineConfig
-from .sampling import SamplingParams, logprobs_for, sample, top_logprobs_for
+from .sampling import SamplingParams, sample, top_logprobs_for
 
 logger = logging.getLogger(__name__)
 
@@ -123,23 +123,10 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, P),
         )
 
-        cache = self.arch.init_kv_cache(
-            cfg, config.num_kv_blocks, config.kv_block_size, self.dtype
-        )
         cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
         self.cache_sharding = NamedSharding(self.mesh, cache_spec)
-        self.kv_cache = tuple(jax.device_put(c, self.cache_sharding) for c in cache)
-
-        # per-slot sampling state: generated-token counts, prompt presence,
-        # and OpenAI logit_bias rows — [num_slots, vocab] on device
-        # (see engine/sampling.py)
         self.state_sharding = NamedSharding(self.mesh, P("dp", None))
-        b, v = config.max_batch_size, cfg.vocab_size
-        self.sample_state = (
-            jax.device_put(jnp.zeros((b, v), jnp.int32), self.state_sharding),
-            jax.device_put(jnp.zeros((b, v), jnp.bool_), self.state_sharding),
-            jax.device_put(jnp.zeros((b, v), jnp.float32), self.state_sharding),
-        )
+        self._reinit_device_state()
 
         self._build_step()
         self._build_block_ops()
@@ -155,9 +142,11 @@ class ModelRunner:
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
 
+        from .sampling import top_k_width
+
         def step(params, k_cache, v_cache, counts, seen, bias, tokens,
                  positions, block_tables, slot_mapping, context_lens,
-                 last_idx, samp, sample_slots, commit):
+                 last_idx, samp, sample_slots, commit, want_top):
             logits, (k_cache, v_cache) = arch.forward(
                 params, cfg, tokens, positions, (k_cache, v_cache),
                 block_tables, slot_mapping, context_lens,
@@ -171,8 +160,22 @@ class ModelRunner:
             next_tokens = sample(
                 last_logits, samp, row_counts, row_seen, bias=row_bias
             )
-            lps = logprobs_for(last_logits + row_bias, next_tokens)
-            top_vals, top_ids = top_logprobs_for(last_logits + row_bias)
+            logp = jax.nn.log_softmax(
+                (last_logits + row_bias).astype(jnp.float32), axis=-1
+            )
+            lps = jnp.take_along_axis(logp, next_tokens[:, None], axis=-1)[:, 0]
+            # top-K alternatives only when some active request asked
+            # (OpenAI top_logprobs): the [B, V] top_k sort is fixed
+            # decode-hot-path cost otherwise. lax.cond keeps one compiled
+            # program either way — the flag is a traced scalar.
+            kw = top_k_width(cfg.vocab_size)
+            top_vals, top_ids = jax.lax.cond(
+                want_top,
+                lambda lp: top_logprobs_for(last_logits, lp),
+                lambda lp: (jnp.zeros((b, kw), jnp.float32),
+                            jnp.zeros((b, kw), jnp.int32)),
+                logp,
+            )
             # count the sampled token as generated for its slot — but only
             # for rows whose sample the scheduler will keep (``commit``;
             # intermediate prefill-chunk samples are discarded)
@@ -207,6 +210,7 @@ class ModelRunner:
                 samp_spec,                   # SamplingParams pytree
                 batch_spec,                  # sample_slots
                 batch_spec,                  # commit
+                repl,                        # want_top scalar
             ),
             out_shardings=(batch_spec, batch_spec, batch2_spec, batch2_spec,
                            self.cache_sharding, self.cache_sharding,
@@ -235,6 +239,7 @@ class ModelRunner:
         counters: Optional[np.ndarray] = None,    # [B] i32 fold-in counters
         sample_slots: Optional[np.ndarray] = None,  # [B] i32 state-row per batch row
         commit: Optional[np.ndarray] = None,      # [B] bool count sampled token
+        want_top: bool = True,  # compute top-K alternatives this step?
     ) -> Tuple[jax.Array, jax.Array]:
         """Run one compiled step; returns (next_tokens, logprobs) device arrays.
 
@@ -282,6 +287,7 @@ class ModelRunner:
             jnp.asarray(context_lens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
             samp,
             jnp.asarray(sample_slots, jnp.int32), jnp.asarray(commit, jnp.bool_),
+            jnp.asarray(bool(want_top), jnp.bool_),
         )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
@@ -459,24 +465,76 @@ class ModelRunner:
         multi-ten-second TPU compiles out of the first requests' latency
         (the analog of GPU engines' startup capture sweeps).
 
-        Resilience: if a Pallas kernel fails to COMPILE here under
-        ``attention_impl: auto`` (a Mosaic regression on this hardware /
-        toolchain), serving falls back to the XLA attention path instead
-        of crashing on the first request — same contract as bench.py's
-        fallback, now at the engine level.
+        Resilience, layered (a Mosaic compile can HANG, not just fail,
+        and a hung compile wedges a host's shared compile service for
+        every process — so a try/except alone is not enough):
+
+        1. Under ``attention_impl: auto`` on TPU, every Pallas kernel the
+           engine would compile is first probed standalone on tiny shapes
+           in a SUBPROCESS with a hard timeout (ops/probe.py). Timeout or
+           failure → the engine resolves to the XLA path before any
+           in-process Pallas compile ever starts.
+        2. If an in-process compile still fails at full shapes (probe
+           passed on tiny ones), the try/except falls back to XLA. The
+           donated cache/sample-state buffers may already be consumed by
+           a partially-executed step, so they are re-initialized before
+           the retry.
         """
+        from ..ops.attention import resolve_attention_impl
+
+        cfg = self.config.model
+        if (cfg.attention_impl == "auto"
+                and resolve_attention_impl("auto") == "pallas"):
+            import os
+
+            from ..ops.probe import probe_serving_kernels
+
+            timeout_s = float(os.environ.get("DYN_PALLAS_PROBE_TIMEOUT_S", "180"))
+            if not probe_serving_kernels(
+                mla=cfg.kv_lora_rank > 0, timeout_s=timeout_s
+            ):
+                logger.warning(
+                    "pallas kernel probe failed or timed out; this engine "
+                    "serves on the XLA attention path"
+                )
+                cfg.attention_impl = "xla"
+                self._build_step()
         try:
             self._warmup_once(decode_batch)
         except Exception:
-            if self.config.model.attention_impl != "auto":
+            if cfg.attention_impl != "auto":
                 raise
             logger.exception(
                 "pallas warmup failed; falling back to the XLA attention "
                 "path for this engine"
             )
-            self.config.model.attention_impl = "xla"
+            cfg.attention_impl = "xla"
             self._build_step()
+            self._reinit_device_state()
             self._warmup_once(decode_batch)
+
+    def _reinit_device_state(self) -> None:
+        """(Re)build the donated device state: the paged KV cache and the
+        per-slot sampling state (generated-token counts, prompt presence,
+        OpenAI logit_bias rows — [num_slots, vocab]; see engine/sampling.py).
+
+        Called from __init__ and from the warmup fallback: a step that
+        fails DURING execution (after dispatch) has already consumed the
+        donated kv_cache/sample_state buffers, so the XLA retry needs
+        fresh arrays. Params are never donated and survive."""
+        cfg = self.config
+        cache = self.arch.init_kv_cache(
+            cfg.model, cfg.num_kv_blocks, cfg.kv_block_size, self.dtype
+        )
+        self.kv_cache = tuple(
+            jax.device_put(c, self.cache_sharding) for c in cache
+        )
+        b, v = cfg.max_batch_size, cfg.model.vocab_size
+        self.sample_state = (
+            jax.device_put(jnp.zeros((b, v), jnp.int32), self.state_sharding),
+            jax.device_put(jnp.zeros((b, v), jnp.bool_), self.state_sharding),
+            jax.device_put(jnp.zeros((b, v), jnp.float32), self.state_sharding),
+        )
 
     def _warmup_once(self, decode_batch: Optional[int] = None) -> None:
         b = decode_batch or self.config.max_batch_size

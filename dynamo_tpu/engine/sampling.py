@@ -158,22 +158,23 @@ def sample(
     return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
-def logprobs_for(
-    logits: jax.Array,   # [B, V]
-    token_ids: jax.Array,  # [B]
-) -> jax.Array:
-    """Log-probability of the chosen tokens (for OutputOptions.logprobs)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
-
-
 # alternatives returned with every step — covers OpenAI's top_logprobs
 # (≤ 20); a fixed width keeps the step program's shapes static
 TOP_LOGPROBS_K = 20
 
 
-def top_logprobs_for(logits: jax.Array) -> tuple:
-    """(values [B, K], ids [B, K]) of the K most likely tokens per row."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    vals, ids = jax.lax.top_k(logp, TOP_LOGPROBS_K)
+def top_k_width(vocab_size: int) -> int:
+    """The step program's top-logprobs width: lax.top_k(k) requires
+    k <= vocab (tiny test vocabs would otherwise fail outright)."""
+    return min(TOP_LOGPROBS_K, vocab_size)
+
+
+def top_logprobs_for(logits: jax.Array, logp: Optional[jax.Array] = None) -> tuple:
+    """(values [B, K], ids [B, K]) of the K most likely tokens per row.
+
+    Pass ``logp`` to reuse an already-computed log_softmax (the step
+    program shares it with the chosen-token logprob)."""
+    if logp is None:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(logp, top_k_width(logits.shape[-1]))
     return vals, ids.astype(jnp.int32)

@@ -411,6 +411,7 @@ class Scheduler:
                 repetition_penalty=er.repetition_penalty,
                 seed=er.req.sampling_options.seed,
                 want_logprobs=er.want_logprobs,
+                logprobs_n=er.logprobs_n,
                 logit_bias=er.req.sampling_options.logit_bias,
             )
         except Exception:
@@ -544,6 +545,7 @@ class Scheduler:
             counters=np.asarray([er.generated], np.int32),
             sample_slots=np.asarray([er.slot], np.int32),
             commit=np.asarray([final], bool),
+            want_top=er.logprobs_n > 0,
         )
         self.steps += 1
         er.prefill_pos = end
@@ -635,6 +637,9 @@ class Scheduler:
             min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
             repetition_penalty=rep, seed_keys=keys, counters=ctrs,
             sample_slots=np.arange(b, dtype=np.int32), commit=commit,
+            # the [B, V] top-k sort only runs when some active request
+            # asked for alternatives (ADVICE r2: fixed decode-path cost)
+            want_top=any(er.logprobs_n > 0 for er in active),
         )
         toks, lpn, tv, ti = await loop.run_in_executor(
             None, lambda: (np.asarray(next_tokens), np.asarray(lps),

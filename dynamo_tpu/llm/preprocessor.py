@@ -271,8 +271,37 @@ class OpenAIPreprocessor(Operator):
             else ()
         )
         pending = ""    # streamed-side tail that may be a marker prefix
+        # logprob entries for exactly the tokens whose text sits in
+        # ``pending`` — released text carries its own entries, withheld
+        # text buffers its own (no duplication across the jail boundary)
+        pending_lps: List[LogprobEntry] = []
         jailed = False
         first_text = True
+
+        def _split_lps(entries: List[LogprobEntry], nchars: int,
+                       total_chars: int):
+            """Split entries at a character boundary of their joint text.
+
+            When the vocab piece strings sum to the decoded text's length
+            (plain-ASCII tokens), a token-length walk is exact; a token
+            straddling the boundary goes to the withheld side, matching
+            the withheld marker token. Byte-fallback / multi-byte pieces
+            decode to different lengths than their piece strings — then
+            the split falls back to proportional-by-count: boundary
+            placement is approximate but every entry still lands on
+            exactly one side (no duplication, no loss)."""
+            if not entries:
+                return [], []
+            if sum(len(e.token or "") for e in entries) == total_chars:
+                used = 0
+                for i, e in enumerate(entries):
+                    tl = len(e.token or "")
+                    if used + tl > nchars:
+                        return entries[:i], entries[i:]
+                    used += tl
+                return entries, []
+            i = int(round(nchars / max(total_chars, 1) * len(entries)))
+            return entries[:i], entries[i:]
 
         def _chunk(text: str, lp=None, finish=None) -> ChatCompletionChunk:
             return ChatCompletionChunk(
@@ -313,37 +342,46 @@ class OpenAIPreprocessor(Operator):
                 if pending:
                     buffered.insert(0, pending)
                     pending = ""
+                    buffered_lps[:0] = pending_lps
+                    pending_lps = []
                 if out.text:
                     buffered.append(out.text)
                 if lp and lp.content:
                     buffered_lps.extend(lp.content)
                 continue
             pending += out.text or ""
+            if lp and lp.content:
+                pending_lps.extend(lp.content)
             hit = min(
                 (pending.find(m) for m in markers if pending.find(m) >= 0),
                 default=-1,
             )
             if hit >= 0:
-                # prose before the marker streams; the marker and
-                # everything after is withheld for parsing (its logprobs
-                # ride the final parsed chunk)
+                # prose before the marker streams WITH its logprob
+                # entries; the marker and everything after is withheld
+                # for parsing (its entries ride the final parsed chunk)
                 jailed = True
+                total = len(pending)
                 release, held = pending[:hit], pending[hit:]
                 pending = ""
+                rel_lps, held_lps = _split_lps(pending_lps, hit, total)
+                pending_lps = []
                 if held:
                     buffered.append(held)
-                if lp and lp.content:
-                    buffered_lps.extend(lp.content)
-                chunk_lp = None
+                buffered_lps.extend(held_lps)
             else:
                 keep = _marker_prefix_len(pending, markers)
+                total = len(pending)
                 release = pending[: len(pending) - keep] if keep else pending
                 pending = pending[len(pending) - keep:] if keep else ""
-                chunk_lp = lp
+                rel_lps, pending_lps = _split_lps(
+                    pending_lps, len(release), total
+                )
             if release:
-                yield _chunk(release, chunk_lp)
-            elif lp and lp.content and chunk_lp is lp:
-                buffered_lps.extend(lp.content)
+                yield _chunk(
+                    release,
+                    ChoiceLogprobs(content=rel_lps) if rel_lps else None,
+                )
 
         if tool_format is not None:
             from .tools import extract_tool_calls
@@ -351,11 +389,13 @@ class OpenAIPreprocessor(Operator):
             if jailed:
                 text = "".join(buffered)
                 content, calls = extract_tool_calls(text, tool_format)
+                final_lps = buffered_lps
             else:
                 # no marker ever appeared — whatever tail is pending is
-                # plain prose
+                # plain prose (its entries never buffered: they're here)
                 text, content, calls = pending, pending, []
-            lps = ChoiceLogprobs(content=buffered_lps) if buffered_lps else None
+                final_lps = buffered_lps + pending_lps
+            lps = ChoiceLogprobs(content=final_lps) if final_lps else None
             if calls:
                 indexed = [{"index": i, **c} for i, c in enumerate(calls)]
                 yield ChatCompletionChunk(

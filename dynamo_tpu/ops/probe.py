@@ -1,0 +1,213 @@
+"""Subprocess compile probes for the Pallas kernels.
+
+Why a subprocess: a pathological Mosaic compile can HANG rather than fail
+(observed on shared-compile-service TPU hosts, where one wedged compile
+then blocks every later backend init on the machine). An in-process
+try/except around warmup catches failures but not hangs, so any *first*
+compile of a Pallas kernel on a given host happens in a child process
+with a hard timeout — on timeout or failure the engine falls back to the
+XLA attention path and serving never wedges.
+
+One child probes ALL requested kernels in a single JAX/backend init
+(cold backend init dominates probe latency). Hosts whose TPU runtime is
+process-exclusive (the child cannot acquire the device while the serving
+process holds it) are detected from the child's stderr and reported as
+*inconclusive* — the engine then proceeds with its normal in-process
+compile under try/except, because on such hosts a child can never
+compile anything and there is no shared compile service to wedge.
+
+Reference analog: the startup capture/warmup sweeps the GPU engines run
+before serving traffic (SURVEY.md §2.4); same contract, plus hang
+isolation that CUDA toolchains don't need but shared TPU compile relays
+do.
+
+Used by ``bench.py`` (probe before the full-model attempt) and by
+``ModelRunner.warmup`` (probe before any in-process Pallas compile).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from typing import Dict, Iterable, Optional
+
+logger = logging.getLogger(__name__)
+
+# in-process memo: kind -> True | False | None (None = inconclusive).
+# One probe per process is enough — the result can't change under us,
+# and warmup may run once per engine instance.
+_PROBE_CACHE: Dict[str, Optional[bool]] = {}
+
+# child-stderr markers meaning "the TPU is held by another process", not
+# "the kernel is broken" — the probe is then inconclusive, not a failure
+_EXCLUSIVE_DEVICE_MARKERS = (
+    "already in use",
+    "device or resource busy",
+    "failed to open libtpu",
+    "unable to acquire",
+)
+
+_PROBE_SRC = r"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def probe_decode():
+    from dynamo_tpu.ops.pallas_decode import paged_decode_attention
+
+    l, n, page, kvh, d, b, w = 2, 16, 16, 2, 128, 2, 4
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, 1, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(paged_decode_attention(q, k, v, bt, ctx, jnp.asarray(1, jnp.int32)))
+
+
+def probe_prefill():
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 8, 128
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    base = jnp.zeros((b,), jnp.int32)
+    ctx = jnp.asarray([s], jnp.int32)
+    np.asarray(paged_flash_attention(q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32)))
+
+
+def probe_mla_decode():
+    from dynamo_tpu.ops.pallas_decode import mla_paged_decode_attention
+
+    l, n, page, r, rd, b, w, h = 2, 16, 16, 128, 128, 2, 4, 4
+    c = jnp.zeros((l, n, page, 1, r), jnp.bfloat16)
+    kr = jnp.zeros((l, n, page, 1, rd), jnp.bfloat16)
+    ql = jnp.ones((b, 1, h, r), jnp.bfloat16)
+    qr = jnp.ones((b, 1, h, rd), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(
+        mla_paged_decode_attention(ql, qr, c, kr, bt, ctx, jnp.asarray(1, jnp.int32))
+    )
+
+
+PROBES = {
+    "decode": probe_decode,
+    "prefill": probe_prefill,
+    "mla_decode": probe_mla_decode,
+}
+for kind in sys.argv[1:]:
+    PROBES[kind]()
+    # flush per kind: if a later kernel hangs/crashes the child, the
+    # parent still credits the ones that finished
+    print("PROBE_OK", kind, flush=True)
+"""
+
+
+def probe_kernels(
+    kinds: Iterable[str],
+    timeout_s: float = 180.0,
+    cwd: Optional[str] = None,
+) -> Dict[str, Optional[bool]]:
+    """Compile-and-run Pallas kernels on tiny shapes in ONE child process.
+
+    ``kinds`` ⊆ {"decode", "prefill", "mla_decode"}. Returns per kind:
+    True (compiled and ran), False (failed or timed out — do not compile
+    this kernel in-process), or None (inconclusive: the child could not
+    acquire the TPU because this process holds it exclusively).
+
+    Results are memoized per process. ``DYN_SKIP_PALLAS_PROBE=1``
+    short-circuits to all-True (hosts where the kernels are known-good);
+    ``DYN_FORCE_XLA=1`` to all-False.
+    """
+    kinds = list(kinds)
+    if os.environ.get("DYN_FORCE_XLA"):
+        return {k: False for k in kinds}
+    if os.environ.get("DYN_SKIP_PALLAS_PROBE"):
+        return {k: True for k in kinds}
+    todo = [k for k in kinds if k not in _PROBE_CACHE]
+    if todo:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        stdout, stderr, rc, timed_out = "", "", -1, False
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC, *todo],
+                capture_output=True, text=True, timeout=timeout_s,
+                cwd=cwd or repo_root, env=env,
+            )
+            stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+                else (e.stdout or "")
+        except Exception:
+            logger.exception("pallas kernel probe errored")
+        exclusive = any(
+            m in stderr.lower() for m in _EXCLUSIVE_DEVICE_MARKERS
+        )
+        for k in todo:
+            if f"PROBE_OK {k}" in stdout:
+                _PROBE_CACHE[k] = True
+            elif exclusive:
+                _PROBE_CACHE[k] = None
+                logger.warning(
+                    "pallas %s probe inconclusive: this process holds the "
+                    "TPU exclusively; will compile in-process instead", k,
+                )
+            else:
+                _PROBE_CACHE[k] = False
+                if timed_out:
+                    logger.warning(
+                        "pallas %s probe timed out after %.0fs — treating "
+                        "the kernel as uncompilable on this host "
+                        "(XLA fallback)", k, timeout_s,
+                    )
+                else:
+                    logger.warning(
+                        "pallas %s probe failed (rc=%s): %s",
+                        k, rc, stderr[-2000:],
+                    )
+    return {k: _PROBE_CACHE[k] for k in kinds}
+
+
+def probe_kernel(
+    kind: str, timeout_s: float = 180.0, cwd: Optional[str] = None
+) -> bool:
+    """Single-kernel probe; inconclusive counts as False (callers like
+    bench.py that can simply skip the Pallas attempt)."""
+    return probe_kernels([kind], timeout_s=timeout_s, cwd=cwd)[kind] is True
+
+
+def probe_serving_kernels(
+    mla: bool = False, timeout_s: float = 180.0
+) -> bool:
+    """Probe every kernel a serving engine under ``attention_impl=auto``
+    would compile — the dense engines' decode + flash-prefill kernels,
+    or ONLY the MLA decode kernel for MLA models (MLA prefill always
+    runs the dense XLA formulation; models/deepseek.py).
+
+    True → let auto resolve to pallas. Any hard failure/timeout → False.
+    Inconclusive (exclusive-device host) → True with a warning: a child
+    can never compile there, and the in-process try/except fallback
+    still guards plain failures.
+    """
+    kinds = ["mla_decode"] if mla else ["decode", "prefill"]
+    results = probe_kernels(kinds, timeout_s=timeout_s)
+    if any(v is False for v in results.values()):
+        return False
+    if any(v is None for v in results.values()):
+        logger.warning(
+            "pallas probes inconclusive (%s); proceeding with in-process "
+            "compile under the warmup fallback", results,
+        )
+    return True

@@ -188,3 +188,81 @@ async def test_cancelled_request_still_enters_collective():
         await server.close()
     assert ici.calls == [1]   # entered the collective
     assert scattered == []    # but nothing written
+
+
+async def test_commit_after_dropped_payload_is_nacked():
+    """ADVICE r2 medium-1: a dropped payload (seq mismatch here) must
+    poison the request's commit — the decode side would otherwise resume
+    over blocks that were never scattered. The sender sees the nack; the
+    decode future stays unresolved and local-prefill fallback kicks in."""
+    ici = _StubIci(seq=3)
+    commits = []
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: None,
+        on_commit=lambda rid, *a: commits.append(rid),
+        ici_recv=ici.recv,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_ici_blocks("r1", [5], seq=7)  # payload mis-paired
+        assert await client.send_commit("r1", 0) is False  # nacked
+        # a healthy request on the same connection still commits
+        ici.seq = 8
+        await client.send_ici_blocks("r2", [6], seq=8)
+        assert await client.send_commit("r2", 1) is True
+        await client.close()
+    finally:
+        await server.close()
+    assert commits == ["r2"]
+
+
+async def test_unauthorized_tcp_frame_nacks_commit():
+    """The authorize=False drop path marks the request too (TCP frames)."""
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: None,
+        on_commit=lambda *a: pytest.fail("must not commit"),
+        authorize=lambda rid, ids: False,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        k = np.zeros((1, 1, 4, 2, 8), np.float32)
+        await client.send_blocks("gone", [3], k, k)
+        assert await client.send_commit("gone", 0) is False
+        await client.close()
+    finally:
+        await server.close()
+
+
+async def test_ici_recv_timeout_abandons_plane():
+    """ADVICE r2 medium-2: a sender lost after the header must not strand
+    the handler forever — the bounded recv times out, the plane is
+    abandoned receiver-side, and the request's commit is nacked."""
+
+    class _HangIci:
+        def recv(self, nblocks):
+            # long enough to trip the 0.3 s bound, short enough that the
+            # stranded non-daemon executor thread doesn't hold pytest's
+            # interpreter exit hostage
+            import time
+
+            time.sleep(5)
+            return None, None, 0
+
+    server = KvTransferServer(
+        scatter=lambda rid, ids, k, v: pytest.fail("must not scatter"),
+        on_commit=lambda *a: pytest.fail("must not commit"),
+        ici_recv=_HangIci().recv,
+        ici_recv_timeout_s=0.3,
+    )
+    await server.start()
+    try:
+        client = await KvTransferClient("127.0.0.1", server.port).connect()
+        await client.send_ici_blocks("r1", [5], seq=1)
+        assert await client.send_commit("r1", 0) is False  # nacked
+        assert server.ici_recv is None  # plane abandoned
+        assert "ici" not in server.descriptor["modes"]
+        await client.close()
+    finally:
+        await server.close()

@@ -1,0 +1,200 @@
+"""Pallas kernel compile probes + hang-proof warmup (ops/probe.py).
+
+The failure mode under test is the one that killed round 2's telemetry:
+a Mosaic kernel compile that HANGS (not fails) wedges the host's shared
+compile service for every process. The engine must therefore never start
+a first Pallas compile in-process — ops/probe.py runs it in a child with
+a hard timeout, and ModelRunner.warmup consults the probe before any
+in-process compile under ``attention_impl: auto``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.ops import attention as attn_mod
+from dynamo_tpu.ops import probe as probe_mod
+
+
+@pytest.fixture(autouse=True)
+def _clear_probe_cache(monkeypatch):
+    probe_mod._PROBE_CACHE.clear()
+    # tests below control probe behavior explicitly
+    monkeypatch.delenv("DYN_SKIP_PALLAS_PROBE", raising=False)
+    monkeypatch.delenv("DYN_FORCE_XLA", raising=False)
+    yield
+    probe_mod._PROBE_CACHE.clear()
+
+
+def tiny_runner(attention_impl="auto"):
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, attention_impl=attention_impl,
+    )
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=32, kv_block_size=8,
+        num_kv_blocks=16, dtype="float32", prefill_buckets=[16],
+        allow_random_weights=True,
+    )
+    return ModelRunner(econfig), econfig
+
+
+def test_probe_times_out_on_hanging_compile(monkeypatch):
+    """A probe child that never finishes (the Mosaic-hang stand-in) must
+    come back False within the timeout, not block forever."""
+    monkeypatch.setattr(
+        probe_mod, "_PROBE_SRC", "import time\ntime.sleep(600)\n"
+    )
+    t0 = time.monotonic()
+    assert probe_mod.probe_kernel("decode", timeout_s=2.0) is False
+    assert time.monotonic() - t0 < 30
+    # memoized: the second call must not pay the timeout again
+    t1 = time.monotonic()
+    assert probe_mod.probe_kernel("decode", timeout_s=2.0) is False
+    assert time.monotonic() - t1 < 0.1
+
+
+def test_probe_fails_cleanly_on_cpu():
+    """pallas_call is uncompilable on the CPU backend without interpret
+    mode — a real failed-compile probe, exercised end-to-end."""
+    assert probe_mod.probe_kernel("decode", timeout_s=120.0) is False
+
+
+def test_probe_env_overrides(monkeypatch):
+    monkeypatch.setenv("DYN_FORCE_XLA", "1")
+    assert probe_mod.probe_kernel("decode") is False
+    monkeypatch.delenv("DYN_FORCE_XLA")
+    monkeypatch.setenv("DYN_SKIP_PALLAS_PROBE", "1")
+    assert probe_mod.probe_kernel("decode") is True
+
+
+def test_probe_multi_kind_partial_credit(monkeypatch):
+    """One child probes all kinds; kinds that printed PROBE_OK before the
+    child died are credited, the rest are not."""
+    monkeypatch.setattr(
+        probe_mod, "_PROBE_SRC",
+        "print('PROBE_OK decode', flush=True)\nraise SystemExit(1)\n",
+    )
+    res = probe_mod.probe_kernels(["decode", "prefill"], timeout_s=60)
+    assert res == {"decode": True, "prefill": False}
+
+
+def test_probe_exclusive_device_is_inconclusive(monkeypatch):
+    """A child that cannot acquire the TPU (this process holds it) must
+    not condemn the kernels — warmup then compiles in-process as before."""
+    monkeypatch.setattr(
+        probe_mod, "_PROBE_SRC",
+        "import sys\n"
+        "sys.stderr.write('The TPU is already in use by process 123\\n')\n"
+        "raise SystemExit(1)\n",
+    )
+    res = probe_mod.probe_kernels(["decode", "prefill"], timeout_s=60)
+    assert res == {"decode": None, "prefill": None}
+    # serving treats inconclusive as "try in-process" (True)
+    probe_mod._PROBE_CACHE.clear()
+    assert probe_mod.probe_serving_kernels() is True
+
+
+def test_serving_probe_kinds():
+    """MLA engines compile ONLY the MLA decode kernel on the pallas path
+    (deepseek.py) — the probe must not gate them on the dense kernels;
+    dense engines probe decode + flash prefill."""
+    seen = []
+
+    def fake(kinds, timeout_s=0.0, cwd=None):
+        seen.append(list(kinds))
+        return {k: True for k in kinds}
+
+    orig = probe_mod.probe_kernels
+    probe_mod.probe_kernels = fake
+    try:
+        assert probe_mod.probe_serving_kernels(mla=True) is True
+        assert probe_mod.probe_serving_kernels(mla=False) is True
+    finally:
+        probe_mod.probe_kernels = orig
+    assert seen == [["mla_decode"], ["decode", "prefill"]]
+
+
+def test_warmup_consults_probe_before_any_pallas_compile(monkeypatch):
+    """auto + failing probe → warmup flips to XLA without ever building
+    a Pallas program in-process (a hanging compile would thus never run
+    in the serving process)."""
+    calls = []
+    monkeypatch.setattr(
+        attn_mod, "resolve_attention_impl",
+        lambda impl: "pallas" if impl == "auto" else impl,
+    )
+    monkeypatch.setattr(
+        probe_mod, "probe_serving_kernels",
+        lambda mla=False, timeout_s=0: calls.append((mla, timeout_s)) or False,
+    )
+    runner, econfig = tiny_runner("auto")
+    runner.warmup()
+    assert calls, "warmup did not consult the probe"
+    assert econfig.model.attention_impl == "xla"
+    out, *_ = runner.step(
+        np.zeros((2, 1), np.int32), np.zeros((2, 1), np.int32),
+        np.zeros((2, 4), np.int32), np.full((2, 1), -1, np.int32),
+        np.ones(2, np.int32), np.zeros(2, np.int32),
+        np.zeros(2, np.float32), np.zeros(2, np.int32),
+        np.ones(2, np.float32), jax.random.PRNGKey(0),
+    )
+    assert np.asarray(out).shape == (2,)
+
+
+def test_warmup_inprocess_failure_reinits_donated_state(monkeypatch):
+    """Probe passes (tiny shapes) but the full-shape in-process compile
+    fails → fallback must re-initialize the donated cache/sample-state
+    buffers before retrying, then serve on XLA."""
+    monkeypatch.setattr(
+        attn_mod, "resolve_attention_impl",
+        lambda impl: "pallas" if impl == "auto" else impl,
+    )
+    monkeypatch.setattr(
+        probe_mod, "probe_serving_kernels", lambda mla=False, timeout_s=0: True
+    )
+    runner, econfig = tiny_runner("auto")
+    runner.warmup()  # pallas fails on CPU → except-path fallback
+    assert econfig.model.attention_impl == "xla"
+    for arr in (*runner.kv_cache, *runner.sample_state):
+        assert not arr.is_deleted()
+    out, *_ = runner.step(
+        np.zeros((2, 1), np.int32), np.zeros((2, 1), np.int32),
+        np.zeros((2, 4), np.int32), np.full((2, 1), -1, np.int32),
+        np.ones(2, np.int32), np.zeros(2, np.int32),
+        np.zeros(2, np.float32), np.zeros(2, np.int32),
+        np.ones(2, np.float32), jax.random.PRNGKey(0),
+    )
+    assert np.asarray(out).shape == (2,)
+
+
+def test_mla_models_probe_mla_kernel(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(
+        attn_mod, "resolve_attention_impl",
+        lambda impl: "pallas" if impl == "auto" else impl,
+    )
+    monkeypatch.setattr(
+        probe_mod, "probe_serving_kernels",
+        lambda mla=False, timeout_s=0: seen.setdefault("mla", mla) or False,
+    )
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48, num_layers=2,
+        num_heads=4, num_kv_heads=4, head_dim=16, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=12, v_head_dim=12,
+        attention_impl="auto",
+    )
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=32, kv_block_size=8,
+        num_kv_blocks=16, dtype="float32", prefill_buckets=[16],
+        allow_random_weights=True,
+    )
+    runner = ModelRunner(econfig)
+    runner.warmup()
+    assert seen["mla"] is True
+    assert cfg.attention_impl == "xla"

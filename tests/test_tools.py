@@ -309,3 +309,52 @@ async def test_n_fan_out_choices_do_not_truncate_each_other():
     by_index = {c.index: c.message.content for c in resp.choices}
     assert by_index[0] == "c0t0 "
     assert by_index[1] == "c1t0 c1t1 c1t2 c1t3 ", by_index
+
+
+@pytest.mark.asyncio
+async def test_jail_splits_logprob_entries_at_marker_boundary():
+    """ADVICE r2: prose released before a mid-chunk marker must stream
+    WITH its own logprob entries; only the withheld tokens' entries ride
+    the final tool-call chunk — no duplication, no misalignment."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols.common import TokenLogprob
+
+    call = '{"name": "f", "arguments": {}}'
+    vocab = {1: "Hi", 2: "<tool_call>", 3: call, 4: "</tool_call>"}
+
+    class _MapTok:
+        def id_to_token(self, i):
+            return vocab.get(i, str(i))
+
+    mdc = ModelDeploymentCard(display_name="t", slug="t", model_path=None)
+    pre = OpenAIPreprocessor(mdc, tokenizer=_MapTok())
+
+    async def gen():
+        # one chunk carrying prose + the whole call — the marker lands
+        # mid-chunk, exactly the case that used to strip the released
+        # prose of its logprobs and duplicate them on the final chunk
+        yield BackendOutput(
+            text="Hi<tool_call>" + call + "</tool_call>",
+            token_ids=[1, 2, 3, 4],
+            cum_tokens=4,
+            finish_reason=FinishReason.STOP,
+            logprobs=[TokenLogprob(i, -0.25 * i) for i in (1, 2, 3, 4)],
+        )
+
+    chunks = [
+        c async for c in pre.chat_stream(
+            "id9", "m", gen(), prompt_tokens=1, tool_format="hermes"
+        )
+    ]
+    prose = [
+        c for c in chunks
+        if c.choices and c.choices[0].delta.content == "Hi"
+    ]
+    assert len(prose) == 1
+    (entries,) = [prose[0].choices[0].logprobs.content]
+    assert [e.token for e in entries] == ["Hi"]
+    final = chunks[-1]
+    assert final.choices[0].delta.tool_calls
+    held = final.choices[0].logprobs.content
+    assert [e.token for e in held] == ["<tool_call>", call, "</tool_call>"]
