@@ -175,6 +175,11 @@ def main() -> None:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from dynamo_tpu.ops.probe import probe_kernel
 
+        # the bench workload is decode-only (run_once builds a single
+        # S=1 step; ops/attention dispatches S==1 to the decode kernel,
+        # never the flash-prefill one), so only the decode kernel needs
+        # probing — serving engines probe their full kernel set in
+        # ModelRunner.warmup instead
         if probe_kernel("decode", timeout_s=min(180.0, remaining - 120)):
             remaining = total_budget - (_time.monotonic() - t0)
             pallas = _run_impl_subprocess("pallas", timeout_s=max(remaining, 60))

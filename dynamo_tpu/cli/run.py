@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="experts shard over the ep mesh axis (MoE)")
     p.add_argument("--data-parallel-size", type=int, default=1,
                    help="batch shards over the dp mesh axis")
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="dense trunk stages over the pp mesh axis "
+                        "(collective GPipe; reference analog: "
+                        "pipeline_parallel_size=num_nodes)")
     p.add_argument("--token-level", action="store_true",
                    help="serve PreprocessedRequests (engine worker behind a processor)")
     p.add_argument("--worker-endpoint", default=None,

@@ -153,7 +153,13 @@ class EngineConfig:
     num_kv_blocks: int = 2048        # HBM budget for the paged cache
     prefill_buckets: Optional[List[int]] = None
     dtype: str = "bfloat16"
-    # mesh axes: data-parallel replicas x expert-parallel x tensor-parallel
+    # mesh axes: pipeline stages x data-parallel replicas x expert-parallel
+    # x tensor-parallel. pp > 1 stages the dense trunk over a collective
+    # GPipe schedule (parallel/pipeline.py) — reference analog:
+    # pipeline_parallel_size = num_nodes (lib/engines/vllm0_7/src/
+    # vllm_inc.py:37-38 over Ray); here it is one SPMD program over the
+    # mesh's pp axis.
+    pp_size: int = 1
     dp_size: int = 1
     ep_size: int = 1
     tp_size: int = 1

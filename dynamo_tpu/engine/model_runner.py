@@ -31,9 +31,12 @@ from .sampling import SamplingParams, sample, top_logprobs_for
 logger = logging.getLogger(__name__)
 
 
-def build_mesh(dp: int, tp: int, devices=None, ep: int = 1) -> Mesh:
-    """(dp, ep, tp) mesh; tp innermost so its collectives ride fastest ICI.
-    ep=1 keeps the axis present (specs may name it) but trivial.
+def build_mesh(dp: int, tp: int, devices=None, ep: int = 1, pp: int = 1) -> Mesh:
+    """(pp, dp, ep, tp) mesh; tp innermost so its collectives ride the
+    fastest ICI, pp outermost so stage hops cross the slowest links
+    (stages communicate once per microbatch tick, tp all-reduces twice
+    per layer). ep=1/pp=1 keep those axes present (specs may name them)
+    but trivial.
 
     Device pick: LOCAL devices when they suffice — in a multi-process
     world (disagg workers sharing a jax.distributed group for the ICI
@@ -41,14 +44,16 @@ def build_mesh(dp: int, tp: int, devices=None, ep: int = 1) -> Mesh:
     not claim the peer's devices. A mesh larger than the local count is
     the single-engine multi-host case and takes the global list.
     """
-    n = dp * ep * tp
+    n = pp * dp * ep * tp
     if devices is None:
         local = jax.local_devices()
         devices = local if n <= len(local) else jax.devices()
     if n > len(devices):
-        raise ValueError(f"mesh {dp}x{ep}x{tp} needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, ep, tp)
-    return Mesh(arr, ("dp", "ep", "tp"))
+        raise ValueError(
+            f"mesh {pp}x{dp}x{ep}x{tp} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(pp, dp, ep, tp)
+    return Mesh(arr, ("pp", "dp", "ep", "tp"))
 
 
 def param_specs(params) -> Dict:
@@ -74,8 +79,25 @@ class ModelRunner:
         self.arch = models.resolve(cfg)
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         self.mesh = mesh or build_mesh(
-            config.dp_size, config.tp_size, ep=config.ep_size
+            config.dp_size, config.tp_size, ep=config.ep_size,
+            pp=config.pp_size,
         )
+        if config.pp_size > 1:
+            if self.arch is not llama:
+                raise NotImplementedError(
+                    "pipeline parallelism currently stages the dense "
+                    "llama-family trunk only (MoE/MLA models: use tp/ep)"
+                )
+            if cfg.num_layers % config.pp_size:
+                raise ValueError(
+                    f"{cfg.num_layers} layers not divisible by "
+                    f"pp {config.pp_size}"
+                )
+            if config.dp_size > 1 or config.ep_size > 1:
+                raise NotImplementedError(
+                    "pp composes with tp only (dp routes replicas at the "
+                    "cluster layer instead; see runtime/client.py)"
+                )
 
         if cfg.kv_lora_rank == 0 and cfg.num_kv_heads % config.tp_size != 0:
             # (MLA caches a per-token latent, no KV head dim to shard)
@@ -114,7 +136,20 @@ class ModelRunner:
                     cfg, jax.random.PRNGKey(config.seed), self.dtype
                 )
 
-        pspecs = self.arch.param_specs(params)
+        if config.pp_size > 1:
+            # stage the stacked layers/cache for the collective GPipe
+            # schedule: [L, ...] → [P, L/P, ...] sharded on the stage axis
+            from ..parallel import pipeline as pp_mod
+
+            params = pp_mod.stage_params(params, config.pp_size)
+            pspecs = pp_mod.param_specs(params, tp=config.tp_size > 1)
+            cache_spec = (
+                pp_mod.CACHE_SPEC_TP if config.tp_size > 1
+                else pp_mod.CACHE_SPEC
+            )
+        else:
+            pspecs = self.arch.param_specs(params)
+            cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, pspecs
         )
@@ -123,7 +158,6 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, P),
         )
 
-        cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
         self.cache_sharding = NamedSharding(self.mesh, cache_spec)
         self.state_sharding = NamedSharding(self.mesh, P("dp", None))
         self._reinit_device_state()
@@ -144,15 +178,44 @@ class ModelRunner:
 
         from .sampling import top_k_width
 
+        if self.config.pp_size > 1:
+            from ..parallel.pipeline import pipeline_forward
+
+            def forward(params, cache, tokens, positions, bt, slots, ctx):
+                return pipeline_forward(
+                    params, cfg, tokens, positions, cache, bt, slots, ctx,
+                    mesh,
+                )
+        else:
+            def forward(params, cache, tokens, positions, bt, slots, ctx):
+                return arch.forward(
+                    params, cfg, tokens, positions, cache, bt, slots, ctx,
+                    mesh=mesh,
+                )
+
         def step(params, k_cache, v_cache, counts, seen, bias, tokens,
                  positions, block_tables, slot_mapping, context_lens,
-                 last_idx, samp, sample_slots, commit, want_top):
-            logits, (k_cache, v_cache) = arch.forward(
-                params, cfg, tokens, positions, (k_cache, v_cache),
+                 last_idx, samp, sample_slots, commit, want_top,
+                 targets, want_prompt):
+            logits, (k_cache, v_cache) = forward(
+                params, (k_cache, v_cache), tokens, positions,
                 block_tables, slot_mapping, context_lens,
-                mesh=mesh,
             )
             b = tokens.shape[0]
+            # prompt logprobs (OutputOptions.prompt_logprobs, reference:
+            # lib/llm/src/protocols/common.rs:320-341): logprob of each
+            # NEXT prompt token at every position — the prefill logits
+            # are already here; gated because the [B, S, V] log_softmax
+            # is pure overhead for the vast majority of requests
+            prompt_lps = jax.lax.cond(
+                want_prompt,
+                lambda lg: jnp.take_along_axis(
+                    jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1),
+                    targets[..., None], axis=-1,
+                )[..., 0],
+                lambda lg: jnp.zeros(lg.shape[:2], jnp.float32),
+                logits,
+            )
             last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
             row_counts = counts[sample_slots]              # [b, V]
             row_seen = seen[sample_slots]
@@ -182,7 +245,7 @@ class ModelRunner:
             counts = counts.at[sample_slots, next_tokens].add(
                 commit.astype(jnp.int32)
             )
-            return (next_tokens, lps, top_vals, top_ids,
+            return (next_tokens, lps, top_vals, top_ids, prompt_lps,
                     k_cache, v_cache, counts, seen, bias)
 
         samp_spec = SamplingParams(
@@ -211,8 +274,11 @@ class ModelRunner:
                 batch_spec,                  # sample_slots
                 batch_spec,                  # commit
                 repl,                        # want_top scalar
+                batch2_spec,                 # targets [B, S]
+                repl,                        # want_prompt scalar
             ),
             out_shardings=(batch_spec, batch_spec, batch2_spec, batch2_spec,
+                           batch2_spec,
                            self.cache_sharding, self.cache_sharding,
                            self.state_sharding, self.state_sharding,
                            self.state_sharding),
@@ -240,6 +306,8 @@ class ModelRunner:
         sample_slots: Optional[np.ndarray] = None,  # [B] i32 state-row per batch row
         commit: Optional[np.ndarray] = None,      # [B] bool count sampled token
         want_top: bool = True,  # compute top-K alternatives this step?
+        targets: Optional[np.ndarray] = None,  # [B, S] next-prompt-token ids
+        want_prompt: bool = False,  # compute prompt logprobs at `targets`?
     ) -> Tuple[jax.Array, jax.Array]:
         """Run one compiled step; returns (next_tokens, logprobs) device arrays.
 
@@ -278,7 +346,9 @@ class ModelRunner:
             sample_slots = np.arange(b, dtype=np.int32)
         if commit is None:
             commit = np.zeros(b, bool)
-        (next_tokens, lps, top_vals, top_ids,
+        if targets is None:
+            targets = np.zeros_like(tokens)
+        (next_tokens, lps, top_vals, top_ids, prompt_lps,
          k, v, counts, seen, bias) = self._step(
             self.params, self.kv_cache[0], self.kv_cache[1],
             self.sample_state[0], self.sample_state[1], self.sample_state[2],
@@ -288,10 +358,12 @@ class ModelRunner:
             samp,
             jnp.asarray(sample_slots, jnp.int32), jnp.asarray(commit, jnp.bool_),
             jnp.asarray(bool(want_top), jnp.bool_),
+            jnp.asarray(targets, jnp.int32),
+            jnp.asarray(bool(want_prompt), jnp.bool_),
         )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
-        return next_tokens, lps, top_vals, top_ids
+        return next_tokens, lps, top_vals, top_ids, prompt_lps
 
     def set_sample_row(
         self, slot: int, prompt_ids, generated_ids=(), logit_bias=None
@@ -363,7 +435,16 @@ class ModelRunner:
             true_dims = (cfg.kv_lora_rank, cfg.qk_rope_head_dim)
         else:
             true_dims = (cfg.head_dim, cfg.head_dim)
+        # the wire layout is always [L, n, bs, H, D]; a pp-staged cache
+        # ([P, L/P, N, ...]) flattens its stage axis at the gather and
+        # re-splits at the scatter, so disagg transfer / host offload see
+        # one format regardless of pipeline layout
+        staged = self.config.pp_size > 1
+
         def gather(k_cache, v_cache, ids):
+            if staged:
+                k_cache = k_cache.reshape(-1, *k_cache.shape[2:])
+                v_cache = v_cache.reshape(-1, *v_cache.shape[2:])
             return (
                 k_cache[:, ids, ..., : true_dims[0]],
                 v_cache[:, ids, ..., : true_dims[1]],
@@ -378,6 +459,16 @@ class ModelRunner:
         def scatter(k_cache, v_cache, ids, k_blocks, v_blocks):
             k_blocks = _pad_minor(k_blocks, k_cache.shape[-1])
             v_blocks = _pad_minor(v_blocks, v_cache.shape[-1])
+            if staged:
+                shape_k, shape_v = k_cache.shape, v_cache.shape
+                k_cache = k_cache.reshape(-1, *shape_k[2:])
+                v_cache = v_cache.reshape(-1, *shape_v[2:])
+                return (
+                    k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype))
+                    .reshape(shape_k),
+                    v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype))
+                    .reshape(shape_v),
+                )
             return (
                 k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype)),
                 v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype)),
@@ -526,6 +617,10 @@ class ModelRunner:
         cache = self.arch.init_kv_cache(
             cfg.model, cfg.num_kv_blocks, cfg.kv_block_size, self.dtype
         )
+        if cfg.pp_size > 1:
+            from ..parallel.pipeline import stage_cache
+
+            cache = stage_cache(tuple(cache), cfg.pp_size)
         self.kv_cache = tuple(
             jax.device_put(c, self.cache_sharding) for c in cache
         )

@@ -83,6 +83,14 @@ class EngineRequest:
     base_key: Optional[np.ndarray] = None  # uint32[2] per-request PRNG key
     want_logprobs: bool = False
     logprobs_n: int = 0  # alternatives per token (OpenAI top_logprobs)
+    # OutputOptions.prompt_logprobs: logprob of every prompt token given
+    # its prefix, computed during prefill (device rows collected per
+    # chunk, converted once on the final chunk)
+    want_prompt_lps: bool = False
+    prompt_lp_parts: List = dataclasses.field(default_factory=list)
+    # sent once with the first output — a preempted request's re-prefill
+    # must not recompute or re-emit them mid-stream
+    prompt_lps_emitted: bool = False
     # runtime state
     slot: int = -1
     block_ids: List[int] = dataclasses.field(default_factory=list)
@@ -190,6 +198,7 @@ class Scheduler:
         # alternatives (None = off) — bool() would drop the 0 case
         er.want_logprobs = er.req.output_options.logprobs is not None
         er.logprobs_n = int(er.req.output_options.logprobs or 0)
+        er.want_prompt_lps = er.req.output_options.prompt_logprobs is not None
         self.waiting.append(er)
         self.wake.set()
 
@@ -222,7 +231,8 @@ class Scheduler:
         return None
 
     def _emit(self, er: EngineRequest, token: int, logprob: Optional[float],
-              top: Optional[dict] = None) -> None:
+              top: Optional[dict] = None,
+              prompt_lps: Optional[list] = None) -> None:
         out = EngineOutput(
             token_ids=[token],
             finish_reason=er.finish,
@@ -230,6 +240,7 @@ class Scheduler:
                 [TokenLogprob(token, logprob, top)]
                 if logprob is not None else None
             ),
+            prompt_logprobs=prompt_lps,
         )
         er.out_queue.put_nowait(out)
 
@@ -378,6 +389,11 @@ class Scheduler:
             # prompt + resume_tokens; the remote path would restart the
             # stream from the prompt alone
             return False
+        if er.want_prompt_lps:
+            # prompt logprobs need every position's logits on THIS engine
+            # (the remote protocol ships KV + one sampled token, not a
+            # [S, V] logits sweep) — prefill locally
+            return False
         # cheap pre-check before the (hash-the-whole-prompt) prefix probe:
         # a larger prefix hit can only make the uncached suffix smaller,
         # so a prompt that doesn't qualify with hit=0 never qualifies —
@@ -500,7 +516,16 @@ class Scheduler:
         slot = self._free_slot()
         assert slot is not None
         tokens_all = er.prompt + er.resume_tokens
-        er.block_ids, er.num_cached = self.allocator.allocate_prompt(tokens_all)
+        if er.want_prompt_lps:
+            # every prompt position must run through the model — a prefix
+            # cache hit would skip its logits. Blank the probe's hits so
+            # allocation proceeds with zero cached tokens.
+            probe = self.allocator.probe_prefix(tokens_all)
+            er.block_ids, er.num_cached = self.allocator.allocate_prompt(
+                tokens_all, probe=(probe[0], [], [])
+            )
+        else:
+            er.block_ids, er.num_cached = self.allocator.allocate_prompt(tokens_all)
         if not er.remote_attempted:  # remote fallback already counted itself
             self.prefix_hit_tokens += er.num_cached
             self.prefix_total_tokens += len(tokens_all)
@@ -531,8 +556,20 @@ class Scheduler:
         arrays = build_prefill_arrays(
             cfg, er.prefill_tokens[:end], er.prefill_pos, er.block_ids
         )
+        start = er.prefill_pos
+        targets = None
+        n_tgt = 0
+        if er.want_prompt_lps and not er.prompt_lps_emitted:
+            # target at bucket index i (absolute position start+i) is the
+            # NEXT prompt token; only prompt positions count (a resumed
+            # request's re-prefilled generation tokens are not prompt)
+            bucket = arrays[0].shape[1]
+            targets = np.zeros((1, bucket), np.int32)
+            nxt = er.prefill_tokens[start + 1 : end + 1]
+            targets[0, : len(nxt)] = nxt
+            n_tgt = max(0, min(take, len(er.prompt) - 1 - start))
         t0 = time.monotonic()
-        next_tokens, lps, top_vals, top_ids = self.runner.step(
+        next_tokens, lps, top_vals, top_ids, plps = self.runner.step(
             *arrays,
             np.asarray([er.temperature], np.float32),
             np.asarray([er.top_k], np.int32),
@@ -546,7 +583,12 @@ class Scheduler:
             sample_slots=np.asarray([er.slot], np.int32),
             commit=np.asarray([final], bool),
             want_top=er.logprobs_n > 0,
+            targets=targets,
+            want_prompt=er.want_prompt_lps,
         )
+        if n_tgt > 0:
+            # keep the DEVICE row; one host conversion on the final chunk
+            er.prompt_lp_parts.append((plps, n_tgt))
         self.steps += 1
         er.prefill_pos = end
         er.context_len = end
@@ -559,18 +601,40 @@ class Scheduler:
         if not final:
             return
 
-        token, lp, tv, ti = await loop.run_in_executor(
+        token, lp, tv, ti, plist = await loop.run_in_executor(
             None, lambda: (
                 int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]),
                 np.asarray(top_vals), np.asarray(top_ids),
+                [
+                    float(x)
+                    for row, cnt in er.prompt_lp_parts
+                    for x in np.asarray(row)[0, :cnt]
+                ],
             )
         )
         self.prefilling = None
+        prompt_lps = None
+        if er.want_prompt_lps and not er.prompt_lps_emitted:
+            # OpenAI/vLLM convention: the first prompt token has no
+            # conditioning prefix — its entry is None
+            prompt_lps = [None] + plist
+            er.prompt_lps_emitted = True
+        er.prompt_lp_parts = []
+        if er.max_new == 0:
+            # prompt-scoring request (echo + logprobs + max_tokens=0):
+            # the prefill ran for its logits; no token is emitted
+            er.finish = FinishReason.LENGTH
+            er.out_queue.put_nowait(EngineOutput(
+                token_ids=[], finish_reason=er.finish,
+                prompt_logprobs=prompt_lps,
+            ))
+            self._finish(er, er.finish, emit=False)
+            return
         er.pending_token = token
         er.generated += 1  # += not =: resumed requests keep their count
         er.finish = self._check_finish(er, token)
         self._emit(er, token, lp if er.want_logprobs else None,
-                   self._top_row(er, tv, ti, 0))
+                   self._top_row(er, tv, ti, 0), prompt_lps=prompt_lps)
         if er.finish is not None:
             self._finish(er, er.finish, emit=False)
 
@@ -631,7 +695,7 @@ class Scheduler:
             ctrs[i] = er.generated
             commit[i] = True
 
-        next_tokens, lps, top_vals, top_ids = self.runner.step(
+        next_tokens, lps, top_vals, top_ids, _ = self.runner.step(
             tokens, positions, btab, slot_map, ctx_lens, last_idx,
             temp, top_k, top_p,
             min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
@@ -688,6 +752,8 @@ class Scheduler:
         er.registered_blocks = 0
         er.prefill_tokens = []
         er.prefill_pos = 0
+        # re-prefill recomputes prompt logprobs from scratch
+        er.prompt_lp_parts = []
         # er.generated keeps its value: max_tokens accounting + PRNG
         # fold-in counters continue, not restart
         self.waiting.appendleft(er)

@@ -38,6 +38,7 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
         tp_size=getattr(flags, "tensor_parallel_size", 1),
         ep_size=getattr(flags, "expert_parallel_size", 1),
         dp_size=getattr(flags, "data_parallel_size", 1),
+        pp_size=getattr(flags, "pipeline_parallel_size", 1),
         host_kv_blocks=getattr(flags, "host_kv_blocks", 0) or 0,
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
@@ -117,10 +118,14 @@ class JaxServingEngine(AsyncEngine):
             # reference SamplingOptions carries n/best_of to engines that
             # implement them — lib/llm/src/protocols/common.rs:248-316)
             raise EngineError("n > 1 is not supported by this engine")
-        if req.stop_conditions.max_tokens == 0:
+        if (req.stop_conditions.max_tokens == 0
+                and req.output_options.prompt_logprobs is None):
             # an empty completion: nothing to schedule, finish immediately
             # (AFTER the validation above — unsupported shapes must reject
-            # consistently regardless of max_tokens)
+            # consistently regardless of max_tokens). Prompt-SCORING
+            # requests (prompt_logprobs + max_tokens=0, the OpenAI
+            # echo+logprobs idiom) do schedule: the prefill must run for
+            # its logits even though no token is generated.
             from ..protocols.common import EngineOutput, FinishReason
 
             yield EngineOutput(

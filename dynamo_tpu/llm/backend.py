@@ -155,6 +155,7 @@ class Backend(Operator):
                 text="".join(texts) if texts else None,
                 finish_reason=finish,
                 logprobs=out.logprobs,
+                prompt_logprobs=out.prompt_logprobs,
                 cum_tokens=decoder.generated,
             )
             if finish is not None:

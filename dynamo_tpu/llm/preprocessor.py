@@ -197,6 +197,14 @@ class OpenAIPreprocessor(Operator):
             ),
             output_options=OutputOptions(
                 logprobs=self._logprobs_count(req),
+                # OpenAI legacy completions: echo + logprobs returns the
+                # prompt tokens' logprobs too (chat has no echo attr)
+                prompt_logprobs=(
+                    self._logprobs_count(req)
+                    if getattr(req, "echo", False)
+                    and self._logprobs_count(req) is not None
+                    else None
+                ),
                 echo_prompt=bool(getattr(req, "echo", False)),
             ),
             eos_token_ids=list(self.mdc.eos_token_ids),
@@ -449,6 +457,26 @@ class OpenAIPreprocessor(Operator):
             )
         return ChoiceLogprobs(content=entries)
 
+    def _prompt_logprobs_dict(self, token_ids, prompt_lps) -> dict:
+        """OpenAI legacy completions logprobs block for the echoed prompt:
+        tokens / token_logprobs / text_offset (first entry None — the
+        first prompt token has no conditioning prefix)."""
+        toks = [
+            (self.tokenizer.id_to_token(t) if self.tokenizer else str(t))
+            or str(t)
+            for t in token_ids
+        ]
+        offsets, pos = [], 0
+        for t in toks:
+            offsets.append(pos)
+            pos += len(t)
+        return {
+            "tokens": toks,
+            "token_logprobs": list(prompt_lps[: len(toks)]),
+            "top_logprobs": None,
+            "text_offset": offsets,
+        }
+
     async def completion_stream(
         self,
         request_id: str,
@@ -457,9 +485,14 @@ class OpenAIPreprocessor(Operator):
         prompt_tokens: int,
         include_usage: bool = False,
         echo_text: Optional[str] = None,
+        prompt_token_ids: Optional[List[int]] = None,
     ) -> AsyncIterator[CompletionResponse]:
         completion_tokens = 0
-        if echo_text:
+        # with prompt_token_ids the echo chunk waits for the first
+        # backend output, which carries the prompt logprobs (the engine
+        # computes them during prefill)
+        echo_pending = bool(echo_text) and prompt_token_ids is not None
+        if echo_text and not echo_pending:
             # OpenAI `echo`: the prompt leads the completion text
             yield CompletionResponse(
                 id=request_id,
@@ -468,6 +501,21 @@ class OpenAIPreprocessor(Operator):
             )
         async for out in backend_stream:
             completion_tokens = max(completion_tokens, out.cum_tokens)
+            if echo_pending:
+                echo_pending = False
+                lp_dict = (
+                    self._prompt_logprobs_dict(
+                        prompt_token_ids, out.prompt_logprobs
+                    )
+                    if out.prompt_logprobs is not None else None
+                )
+                yield CompletionResponse(
+                    id=request_id,
+                    model=model,
+                    choices=[CompletionChoice(
+                        text=echo_text, finish_reason=None, logprobs=lp_dict,
+                    )],
+                )
             if out.text or out.finish_reason:
                 yield CompletionResponse(
                     id=request_id,
@@ -513,7 +561,12 @@ class OpenAIPreprocessor(Operator):
         for name, value in preprocessed.annotation_values.items():
             yield Annotated.from_annotation(name, value)
         request.add_stage("generate")
-        include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        # OpenAI semantics: non-streaming responses ALWAYS carry usage;
+        # streaming only includes the final usage chunk on opt-in
+        include_usage = bool(
+            (req.stream_options and req.stream_options.include_usage)
+            or not getattr(req, "stream", False)
+        )
         kwargs = {}
         # tool_call_format=None on the card disables parsing entirely
         if (is_chat and req.tools and req.tool_choice != "none"
@@ -525,6 +578,8 @@ class OpenAIPreprocessor(Operator):
                 else self.tokenizer.decode(preprocessed.token_ids)
                 if self.tokenizer else None
             )
+            if preprocessed.output_options.prompt_logprobs is not None:
+                kwargs["prompt_token_ids"] = list(preprocessed.token_ids)
         translate = self.chat_stream if is_chat else self.completion_stream
 
         n = preprocessed.sampling_options.n or 1

@@ -21,12 +21,45 @@ from ..engine.config import ModelConfig
 logger = logging.getLogger(__name__)
 
 
+_SCALE_SUFFIXES = ("_scale", "_scale_inv")
+
+
+def _dequant_fp8(arr: np.ndarray, scale: Optional[np.ndarray],
+                 inverse_blocks: bool) -> np.ndarray:
+    """FP8 tensor (as float32) × its scale → float32.
+
+    Two schemes cover the FP8 checkpoints in the wild:
+    - ``weight_scale`` (compressed-tensors / FP8-dynamic exports, the
+      reference's canonical 70B model examples/llm/benchmarks/perf.sh:18):
+      scalar or per-output-channel; straight multiply.
+    - ``weight_scale_inv`` (DeepSeek-V3/R1 native FP8): per 128×128
+      block; expand blockwise over both weight axes.
+    """
+    if scale is None:
+        return arr
+    scale = scale.astype(np.float32)
+    if inverse_blocks and scale.ndim == 2 and arr.ndim == 2:
+        # fixed 128x128 blocks, last block partial (the layout DeepSeek's
+        # quantization_config.weight_block_size=[128,128] describes)
+        bs_ = 128
+        expanded = np.repeat(np.repeat(scale, bs_, axis=0), bs_, axis=1)
+        return arr * expanded[: arr.shape[0], : arr.shape[1]]
+    if scale.ndim == 1 and arr.ndim >= 2 and scale.size == arr.shape[0]:
+        scale = scale.reshape(-1, *([1] * (arr.ndim - 1)))
+    return arr * scale
+
+
 def _iter_safetensors(model_dir: str):
     """Stream (name, np.ndarray) from all shards. Goes through the torch
     framework because safetensors' numpy framework cannot represent
     bfloat16 (the dtype real Llama-class checkpoints ship in); bf16 stays
     2 bytes/element via an ml_dtypes view so staging a large checkpoint
-    doesn't double host RAM."""
+    doesn't double host RAM.
+
+    FP8 tensors (compressed-tensors ``weight_scale`` exports and
+    DeepSeek-native ``weight_scale_inv`` block scales) are upconverted to
+    bf16 at load — TPUs have no fp8 compute path in this engine yet, so
+    the checkpoint serves at bf16 memory cost (one loud warning)."""
     import ml_dtypes
     import torch
     from safetensors import safe_open
@@ -34,17 +67,53 @@ def _iter_safetensors(model_dir: str):
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no .safetensors under {model_dir}")
+    # name → shard, built lazily on the FIRST fp8 tensor (so an fp8
+    # weight can find its scale across shard boundaries) — the common
+    # bf16/fp16 checkpoint never pays the extra key-listing pass
+    index: Dict[str, str] = {}
+
+    def ensure_index() -> Dict[str, str]:
+        if not index:
+            for p in files:
+                with safe_open(p, framework="pt") as f:
+                    for n in f.keys():
+                        index[n] = p
+        return index
+
+    def read(name: str) -> "torch.Tensor":
+        with safe_open(index[name], framework="pt") as f:
+            return f.get_tensor(name)
+
+    warned = False
     for path in files:
         with safe_open(path, framework="pt") as f:
             for name in f.keys():
+                if name.endswith(_SCALE_SUFFIXES) or name.endswith(
+                    ("input_scale", "k_scale", "v_scale")
+                ):
+                    continue  # consumed with (or irrelevant to) a weight
                 t = f.get_tensor(name)
                 if t.dtype == torch.bfloat16:
                     arr = t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
                 elif "float8" in str(t.dtype):
-                    raise NotImplementedError(
-                        f"{name} is {t.dtype}: quantized (FP8) checkpoints "
-                        "are not supported — provide a bf16/fp16 export"
-                    )
+                    if not warned:
+                        warned = True
+                        logger.warning(
+                            "FP8 checkpoint: upconverting to bf16 at load "
+                            "(weights occupy 2x the quantized size in HBM; "
+                            "TPU-native int8/fp8 compute not yet wired)"
+                        )
+                    scale = inv = None
+                    idx = ensure_index()
+                    if f"{name}_scale" in idx:
+                        scale = read(f"{name}_scale").to(torch.float32).numpy()
+                    elif f"{name}_scale_inv" in idx:
+                        inv = read(f"{name}_scale_inv").to(torch.float32).numpy()
+                    arr = _dequant_fp8(
+                        t.to(torch.float32).numpy(),
+                        scale if scale is not None else inv,
+                        inverse_blocks=inv is not None,
+                    ).astype(ml_dtypes.bfloat16)
                 else:
                     arr = t.numpy()
                 yield name, arr

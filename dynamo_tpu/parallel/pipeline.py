@@ -72,17 +72,28 @@ def unstage_cache(kv_cache: KVCache) -> KVCache:
     return tuple(c.reshape(-1, *c.shape[2:]) for c in kv_cache)
 
 
-def param_specs(params) -> dict:
+def param_specs(params, tp: bool = False) -> dict:
     """Specs for staged params: layer stacks shard over pp on the stage
-    axis (inner dims replicated — combine with tp by editing these)."""
+    axis. With ``tp`` the inner dims also shard Megatron-style — each
+    spec is llama's per-layer tp spec with "pp" prepended for the stage
+    axis (wq/wk/wv/w_gate/w_up column-parallel, wo/w_down row-parallel);
+    lm_head stays vocab-sharded over tp at the outer (GSPMD) level."""
     specs = {"embed": P(), "final_norm": P()}
     if "lm_head" in params:
-        specs["lm_head"] = P()
-    specs["layers"] = jax.tree.map(lambda _: P("pp"), params["layers"])
+        specs["lm_head"] = P(None, "tp") if tp else P()
+    if tp:
+        layer_specs = llama.param_specs({"layers": params["layers"]})["layers"]
+        specs["layers"] = {
+            k: P("pp", *s) for k, s in layer_specs.items()
+        }
+    else:
+        specs["layers"] = jax.tree.map(lambda _: P("pp"), params["layers"])
     return specs
 
 
 CACHE_SPEC = P("pp")  # [P, L/P, N, bs, KVH, D]
+# with tp: KV heads shard over tp inside each stage's slab
+CACHE_SPEC_TP = P("pp", None, None, None, "tp", None)
 
 
 def pipeline_forward(
@@ -103,9 +114,18 @@ def pipeline_forward(
     llama.forward modulo the staged cache layout. M defaults to P (the
     minimum that fills the pipeline; raise it to shrink the bubble).
     """
+    import dataclasses as _dc
+    import math as _math
+
     num_stages = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
     b, s = tokens.shape
-    m = num_microbatches or num_stages
+    # auto microbatching: M = P fills the pipeline, but the batch must
+    # split evenly — prefill runs at B=1, so fall back to the largest
+    # divisor (m=1 degrades to stage-serial execution, still correct)
+    m = num_microbatches or (
+        num_stages if b % num_stages == 0 else _math.gcd(b, num_stages)
+    )
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     mb = b // m
@@ -119,15 +139,28 @@ def pipeline_forward(
     slots_mb = split_mb(slot_mapping)
     ctx_mb = split_mb(context_lens)
 
+    cache_spec = CACHE_SPEC_TP if tp > 1 else CACHE_SPEC
+    # each stage computes attention/MLP on its tp-local head/column shard
+    # (activations replicated over tp, Megatron-style: one psum after the
+    # attention output projection, one after w_down)
+    local_cfg = (
+        _dc.replace(
+            cfg,
+            num_heads=cfg.num_heads // tp,
+            num_kv_heads=cfg.num_kv_heads // tp,
+        )
+        if tp > 1 else cfg
+    )
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(
-            param_specs(params),
-            (CACHE_SPEC, CACHE_SPEC),
+            param_specs(params, tp=tp > 1),
+            (cache_spec, cache_spec),
             P(), P(), P(), P(), P(),
         ),
-        out_specs=(P(), (CACHE_SPEC, CACHE_SPEC)),
+        out_specs=(P(), (cache_spec, cache_spec)),
         check_vma=False,
     )
     def run(params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb):
@@ -162,12 +195,21 @@ def pipeline_forward(
             # sentinel routes their scatter out of range
             slots = jnp.where(valid, slots, -1)
 
-            attn_fn = llama.make_gqa_attn_fn(
-                cfg, mb, s, pos, slots, tab, ctx, mesh=None
+            base_attn = llama.make_gqa_attn_fn(
+                local_cfg, mb, s, pos, slots, tab, ctx, mesh=None
             )
+            if tp > 1:
+                def attn_fn(x, lp, k, v, li):
+                    delta, k, v = base_attn(x, lp, k, v, li)
+                    return lax.psum(delta, "tp"), k, v
+
+                def mlp_fn(x, lp):
+                    return lax.psum(llama._swiglu_mlp(x, lp), "tp")
+            else:
+                attn_fn, mlp_fn = base_attn, llama._swiglu_mlp
             hidden, (k_local, v_local), _ = llama.run_layers(
                 x_in, (k_local, v_local), local_layers, cfg, attn_fn,
-                llama._swiglu_mlp,
+                mlp_fn,
             )
 
             # last stage collects its finished microbatch

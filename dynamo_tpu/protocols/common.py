@@ -153,6 +153,10 @@ class EngineOutput:
     logprobs: Optional[List[TokenLogprob]] = None
     # engine-side detokenized text, if the engine chooses to provide it
     text: Optional[str] = None
+    # OutputOptions.prompt_logprobs result: one entry per prompt token
+    # (first None — no conditioning prefix), sent once with the first
+    # output (reference: lib/llm/src/protocols/common.rs:320-341)
+    prompt_logprobs: Optional[List[Optional[float]]] = None
     # KV/scheduling telemetry piggybacked on outputs (optional)
     kv_transfer_params: Optional[dict] = None
 
@@ -162,6 +166,8 @@ class EngineOutput:
             d["finish_reason"] = self.finish_reason.value
         if self.text is not None:
             d["text"] = self.text
+        if self.prompt_logprobs is not None:
+            d["prompt_logprobs"] = self.prompt_logprobs
         if self.logprobs is not None:
             d["logprobs"] = [
                 {
@@ -198,6 +204,7 @@ class EngineOutput:
             ]
             if lps
             else None,
+            prompt_logprobs=d.get("prompt_logprobs"),
             kv_transfer_params=d.get("kv_transfer_params"),
         )
 
@@ -210,4 +217,5 @@ class BackendOutput:
     text: Optional[str]
     finish_reason: Optional[FinishReason] = None
     logprobs: Optional[List[TokenLogprob]] = None
+    prompt_logprobs: Optional[List[Optional[float]]] = None
     cum_tokens: int = 0

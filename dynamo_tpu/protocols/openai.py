@@ -277,6 +277,8 @@ def aggregate_chat_stream(
 def aggregate_completion_stream(chunks: List[CompletionResponse]) -> CompletionResponse:
     text: Dict[int, List[str]] = {}
     finish: Dict[int, Optional[str]] = {}
+    lps: Dict[int, dict] = {}
+    textlen: Dict[int, int] = {}
     usage: Optional[Usage] = None
     rid, model, created = "", "", int(time.time())
     for chunk in chunks:
@@ -286,7 +288,27 @@ def aggregate_completion_stream(chunks: List[CompletionResponse]) -> CompletionR
         if chunk.usage is not None:
             usage = chunk.usage
         for choice in chunk.choices:
+            if choice.logprobs:
+                # merge legacy logprobs blocks; offsets rebase onto the
+                # text accumulated BEFORE this chunk (the chunk's offsets
+                # are relative to its own text)
+                base = textlen.get(choice.index, 0)
+                m = lps.setdefault(choice.index, {
+                    "tokens": [], "token_logprobs": [],
+                    "top_logprobs": None, "text_offset": [],
+                })
+                m["tokens"] += choice.logprobs.get("tokens", [])
+                m["token_logprobs"] += choice.logprobs.get("token_logprobs", [])
+                tops = choice.logprobs.get("top_logprobs")
+                if tops:
+                    m["top_logprobs"] = (m["top_logprobs"] or []) + tops
+                m["text_offset"] += [
+                    base + o for o in choice.logprobs.get("text_offset", [])
+                ]
             if choice.text:
+                textlen[choice.index] = (
+                    textlen.get(choice.index, 0) + len(choice.text)
+                )
                 text.setdefault(choice.index, []).append(choice.text)
             if choice.finish_reason is not None:
                 finish[choice.index] = choice.finish_reason
@@ -296,7 +318,10 @@ def aggregate_completion_stream(chunks: List[CompletionResponse]) -> CompletionR
         model=model,
         created=created,
         choices=[
-            CompletionChoice(index=i, text="".join(text.get(i, [])), finish_reason=finish.get(i))
+            CompletionChoice(
+                index=i, text="".join(text.get(i, [])),
+                finish_reason=finish.get(i), logprobs=lps.get(i),
+            )
             for i in indices
         ],
         usage=usage,

@@ -599,3 +599,116 @@ def test_warmup_falls_back_to_xla_when_pallas_cannot_compile(hf_model_dir):
         np.ones(2, np.float32), jax.random.PRNGKey(0),
     )
     assert np.asarray(out).shape == (2,)
+
+
+@pytest.mark.asyncio
+async def test_prompt_logprobs_honored(hf_model_dir, hf_logits):
+    """OutputOptions.prompt_logprobs (reference common.rs:320-341) must be
+    HONORED: one entry per prompt token (first None), matching the
+    model's actual next-token log-softmax, independent of chunking and
+    of a warm prefix cache."""
+    prompt, ref_logits, _ = hf_logits
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=64, dtype="float32", prefill_buckets=[4, 16],
+        max_prefill_tokens_per_step=4,  # force multi-chunk prefill
+    )
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=econfig, warmup=False
+    )
+
+    async def one():
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            output_options=OutputOptions(prompt_logprobs=0),
+        )
+        outs = []
+        async for out in engine.generate(Context(req)):
+            outs.append(out)
+        return outs
+
+    outs = await one()
+    plps = outs[0]["prompt_logprobs"]
+    assert plps is not None and len(plps) == len(prompt)
+    assert plps[0] is None
+    # expected: log_softmax of the HF reference logits at each next token
+    ref = np.asarray(ref_logits, np.float64)
+    ref_lse = np.log(np.sum(np.exp(ref - ref.max(-1, keepdims=True)), -1))
+    for i in range(1, len(prompt)):
+        want = ref[i - 1, prompt[i]] - ref[i - 1].max() - ref_lse[i - 1]
+        assert abs(plps[i] - want) < 5e-3, (i, plps[i], want)
+    # later outputs don't repeat them
+    assert all(o.get("prompt_logprobs") is None for o in outs[1:])
+
+    # a warm prefix cache must not swallow positions: run the SAME prompt
+    # again (its blocks are now cached) — full-length result, same values
+    outs2 = await one()
+    plps2 = outs2[0]["prompt_logprobs"]
+    assert len(plps2) == len(prompt)
+    np.testing.assert_allclose(
+        [x for x in plps2[1:]], [x for x in plps[1:]], rtol=1e-5, atol=1e-6
+    )
+    await engine.close()
+
+
+@pytest.mark.asyncio
+async def test_prompt_logprobs_absent_by_default(hf_model_dir):
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", prefill_buckets=[16],
+    )
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=econfig, warmup=False
+    )
+    req = PreprocessedRequest(
+        token_ids=[1, 5, 9],
+        stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+    async for out in engine.generate(Context(req)):
+        assert out.get("prompt_logprobs") is None
+    await engine.close()
+
+
+@pytest.mark.asyncio
+async def test_prompt_scoring_max_tokens_zero(hf_model_dir):
+    """The OpenAI prompt-scoring idiom (echo + logprobs + max_tokens=0)
+    must run the prefill for its logits and return prompt_logprobs with
+    NO generated token — not short-circuit to an empty response."""
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", prefill_buckets=[16],
+    )
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=econfig, warmup=False
+    )
+    prompt = [1, 17, 43, 99, 7]
+    req = PreprocessedRequest(
+        token_ids=prompt,
+        stop_conditions=StopConditions(max_tokens=0),
+        sampling_options=SamplingOptions(temperature=0.0),
+        output_options=OutputOptions(prompt_logprobs=0),
+    )
+    outs = [o async for o in engine.generate(Context(req))]
+    assert outs[0].get("prompt_logprobs") is not None
+    assert len(outs[0]["prompt_logprobs"]) == len(prompt)
+    assert all(not o.get("token_ids") for o in outs)
+    assert outs[-1]["finish_reason"] == "length"
+
+    # plain max_tokens=0 (no prompt_logprobs) still short-circuits
+    req2 = PreprocessedRequest(
+        token_ids=prompt,
+        stop_conditions=StopConditions(max_tokens=0),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+    outs2 = [o async for o in engine.generate(Context(req2))]
+    assert outs2 == [{"token_ids": [], "finish_reason": "length"}]
+    await engine.close()

@@ -365,3 +365,53 @@ def test_max_tokens_zero_means_empty_completion(mdc, tokenizer):
         CompletionRequest(model="m", prompt="x", max_tokens=0)
     )
     assert out.stop_conditions.max_tokens == 0
+
+
+def test_preprocess_completion_sets_prompt_logprobs_for_echo(mdc, tokenizer):
+    """OpenAI legacy completions: echo + logprobs asks the engine for
+    prompt logprobs too; either flag alone does not."""
+    from dynamo_tpu.protocols.openai import CompletionRequest
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    both = pre.preprocess_completion(CompletionRequest(
+        model="m", prompt="hello", echo=True, logprobs=0,
+    ))
+    assert both.output_options.prompt_logprobs == 0
+    echo_only = pre.preprocess_completion(CompletionRequest(
+        model="m", prompt="hello", echo=True,
+    ))
+    assert echo_only.output_options.prompt_logprobs is None
+    lp_only = pre.preprocess_completion(CompletionRequest(
+        model="m", prompt="hello", logprobs=2,
+    ))
+    assert lp_only.output_options.prompt_logprobs is None
+
+
+async def test_completion_echo_carries_prompt_logprobs(mdc, tokenizer):
+    """With prompt_token_ids the echo chunk waits for the first backend
+    output and renders its prompt_logprobs as the legacy logprobs block."""
+    from dynamo_tpu.llm.backend import BackendOutput
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    ids = [3, 4]
+
+    async def backend():
+        yield BackendOutput(
+            token_ids=[5], text="out!", cum_tokens=1, finish_reason=None,
+            prompt_logprobs=[None] + [-0.5] * (len(ids) - 1),
+        )
+
+    chunks = [
+        r async for r in pre.completion_stream(
+            "cmpl-2", "m", backend(), prompt_tokens=len(ids),
+            echo_text="hello world", prompt_token_ids=list(ids),
+        )
+    ]
+    echo = chunks[0].choices[0]
+    assert echo.text == "hello world"
+    lp = echo.logprobs
+    assert lp is not None
+    assert len(lp["tokens"]) == len(ids)
+    assert lp["token_logprobs"][0] is None
+    assert all(v == -0.5 for v in lp["token_logprobs"][1:])
+    assert lp["text_offset"][0] == 0
