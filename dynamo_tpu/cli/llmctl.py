@@ -1,12 +1,18 @@
-"""llmctl: manage model registrations in the discovery plane.
+"""llmctl: manage model registrations and graph deployments.
 
-Reference analog: launch/llmctl (reference: launch/llmctl/src/main.rs:105-452
+Reference analogs: launch/llmctl (reference: launch/llmctl/src/main.rs:105-452
 — ``llmctl http add chat-models <name> dyn://ns.comp.ep`` writing
-ModelEntry records the HTTP frontend's model watcher picks up).
+ModelEntry records the HTTP frontend's model watcher picks up) and the
+SDK's deploy client (reference: deploy/dynamo/sdk/src/dynamo/sdk/cli/
+deploy.py — POSTing a packaged graph to the api-store, which creates the
+cluster deployment).
 
     python -m dynamo_tpu.cli.llmctl --store-port 4871 http add chat-models m8b dyn://public.backend.generate
     python -m dynamo_tpu.cli.llmctl --store-port 4871 http list
     python -m dynamo_tpu.cli.llmctl --store-port 4871 http remove chat-models m8b
+    python -m dynamo_tpu.cli.llmctl deploy create mygraph -f graph.json --api-store http://store:8790
+    python -m dynamo_tpu.cli.llmctl deploy list --api-store http://store:8790
+    python -m dynamo_tpu.cli.llmctl deploy delete mygraph --api-store http://store:8790
 """
 
 from __future__ import annotations
@@ -38,7 +44,8 @@ KINDS = {
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="llmctl")
     p.add_argument("--store-host", default="127.0.0.1")
-    p.add_argument("--store-port", type=int, required=True)
+    p.add_argument("--store-port", type=int, default=None,
+                   help="dynstore port (required for the http plane)")
     p.add_argument("--namespace", default="public")
     sub = p.add_subparsers(dest="plane", required=True)
     http = sub.add_parser("http", help="manage the HTTP frontend's models")
@@ -54,7 +61,82 @@ def build_parser() -> argparse.ArgumentParser:
     rm.add_argument("name")
 
     hsub.add_parser("list")
+
+    dep = sub.add_parser(
+        "deploy", help="manage graph deployments via the api-store"
+    )
+    # shared by every deploy leaf so the flag works in any position
+    store_opt = argparse.ArgumentParser(add_help=False)
+    store_opt.add_argument("--api-store", default="http://127.0.0.1:8790",
+                           help="api-store base URL")
+    dsub = dep.add_subparsers(dest="action", required=True)
+    dc = dsub.add_parser("create", parents=[store_opt],
+                         help="register a graph deployment spec")
+    dc.add_argument("name")
+    dc.add_argument("-f", "--file", required=True,
+                    help="JSON (or YAML) deployment spec — the CR spec: "
+                         "{services: {...}, modelName: ...}")
+    du = dsub.add_parser("update", parents=[store_opt])
+    du.add_argument("name")
+    du.add_argument("-f", "--file", required=True)
+    dg = dsub.add_parser("get", parents=[store_opt])
+    dg.add_argument("name")
+    dsub.add_parser("list", parents=[store_opt])
+    dd = dsub.add_parser("delete", parents=[store_opt])
+    dd.add_argument("name")
     return p
+
+
+def _load_spec(path: str) -> dict:
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        return yaml.safe_load(text)
+
+
+def run_deploy(args) -> int:
+    """Sync deploy-plane commands (no distributed runtime needed)."""
+    import json
+
+    from ..deploy.store_source import ApiStoreClient
+
+    client = ApiStoreClient(args.api_store)
+    if args.action == "create":
+        rec = client.create(args.name, _load_spec(args.file))
+        print(f"created deployment {rec['name']}")
+        return 0
+    if args.action == "update":
+        rec = client.update(args.name, _load_spec(args.file))
+        print(f"updated deployment {rec['name']}")
+        return 0
+    if args.action == "get":
+        rec = client.get(args.name)
+        if rec is None:
+            print(f"deployment {args.name!r} not found")
+            return 1
+        print(json.dumps(rec, indent=2))
+        return 0
+    if args.action == "list":
+        records = client.list()
+        if not records:
+            print("(no deployments)")
+        for rec in records:
+            conds = (rec.get("status") or {}).get("conditions") or []
+            health = conds[0]["status"] if conds else "-"
+            print(f"{rec['name']:30s} reconciled={health:6s} "
+                  f"services={len(rec['spec'].get('services') or {})}")
+        return 0
+    if args.action == "delete":
+        client.delete(args.name)
+        print(f"deleted deployment {args.name}")
+        return 0
+    return 2
 
 
 async def run(args, drt: DistributedRuntime) -> int:
@@ -90,6 +172,11 @@ async def run(args, drt: DistributedRuntime) -> int:
 
 async def amain(argv: List[str]) -> int:
     args = build_parser().parse_args(argv)
+    if args.plane == "deploy":
+        return run_deploy(args)
+    if args.store_port is None:
+        print("--store-port is required for the http plane")
+        return 2
     drt = await DistributedRuntime.connect(args.store_host, args.store_port)
     try:
         return await run(args, drt)

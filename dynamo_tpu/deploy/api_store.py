@@ -36,28 +36,47 @@ class DeploymentStore:
             " name TEXT PRIMARY KEY,"
             " spec TEXT NOT NULL,"
             " created REAL NOT NULL,"
-            " updated REAL NOT NULL)"
+            " updated REAL NOT NULL,"
+            " status TEXT)"
         )
+        try:  # migrate pre-status databases in place
+            self.db.execute("ALTER TABLE deployments ADD COLUMN status TEXT")
+        except sqlite3.OperationalError:
+            pass
         self.db.commit()
+
+    @staticmethod
+    def _record(row) -> dict:
+        n, s, c, u, st = row
+        return {
+            "name": n, "spec": json.loads(s), "created": c, "updated": u,
+            "status": json.loads(st) if st else None,
+        }
 
     def list(self) -> list:
         rows = self.db.execute(
-            "SELECT name, spec, created, updated FROM deployments ORDER BY name"
+            "SELECT name, spec, created, updated, status FROM deployments"
+            " ORDER BY name"
         ).fetchall()
-        return [
-            {"name": n, "spec": json.loads(s), "created": c, "updated": u}
-            for n, s, c, u in rows
-        ]
+        return [self._record(r) for r in rows]
 
     def get(self, name: str) -> Optional[dict]:
         row = self.db.execute(
-            "SELECT name, spec, created, updated FROM deployments WHERE name=?",
+            "SELECT name, spec, created, updated, status FROM deployments"
+            " WHERE name=?",
             (name,),
         ).fetchone()
-        if row is None:
-            return None
-        n, s, c, u = row
-        return {"name": n, "spec": json.loads(s), "created": c, "updated": u}
+        return None if row is None else self._record(row)
+
+    def set_status(self, name: str, status: dict) -> bool:
+        """Reconciler write-back: the store plays the CR's status
+        subresource for store-sourced deployments."""
+        cur = self.db.execute(
+            "UPDATE deployments SET status=? WHERE name=?",
+            (json.dumps(status), name),
+        )
+        self.db.commit()
+        return cur.rowcount > 0
 
     def put(self, name: str, spec: dict) -> dict:
         now = time.time()
@@ -96,6 +115,9 @@ class ApiStoreService:
         self.app.router.add_get("/api/v1/deployments/{name}", self.handle_get)
         self.app.router.add_put("/api/v1/deployments/{name}", self.handle_update)
         self.app.router.add_delete("/api/v1/deployments/{name}", self.handle_delete)
+        self.app.router.add_put(
+            "/api/v1/deployments/{name}/status", self.handle_status
+        )
         self.app.router.add_get("/health", self.handle_health)
         self._runner: Optional[web.AppRunner] = None
 
@@ -168,6 +190,22 @@ class ApiStoreService:
         if self.store.get(name) is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response(self.store.put(name, spec))
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        """Status subresource for store-sourced deployments (written by
+        the operator's reconcile loop, read back via GET/list)."""
+        name = request.match_info["name"]
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"invalid body: {e}"}, status=400)
+        if not isinstance(body, dict) or not isinstance(body.get("status"), dict):
+            return web.json_response(
+                {"error": 'body must be {"status": {...}}'}, status=400
+            )
+        if not self.store.set_status(name, body["status"]):
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(self.store.get(name))
 
     async def handle_delete(self, request: web.Request) -> web.Response:
         if not self.store.delete(request.match_info["name"]):

@@ -187,7 +187,7 @@ def _key(m: dict) -> str:
 
 
 class KubeClient(Protocol):
-    """The three verbs the reconcile loop needs."""
+    """The verbs the reconcile loop needs."""
 
     def apply(self, manifest: dict) -> None: ...
 
@@ -195,12 +195,16 @@ class KubeClient(Protocol):
 
     def list_managed(self, namespace: str, instance: str) -> List[dict]: ...
 
+    def update_status(self, cr: dict, status: dict) -> None: ...
+
 
 class InMemoryKube:
     """Test double with real apply/delete/list semantics."""
 
     def __init__(self) -> None:
         self.objects: Dict[str, dict] = {}
+        # (namespace, name) → last written CR status
+        self.statuses: Dict[tuple, dict] = {}
 
     def apply(self, manifest: dict) -> None:
         self.objects[_key(manifest)] = json.loads(json.dumps(manifest))
@@ -218,6 +222,11 @@ class InMemoryKube:
                     == MANAGED_BY["app.kubernetes.io/managed-by"]):
                 out.append(m)
         return out
+
+    def update_status(self, cr: dict, status: dict) -> None:
+        key = (cr["metadata"].get("namespace", "default"),
+               cr["metadata"]["name"])
+        self.statuses[key] = json.loads(json.dumps(status))
 
 
 class KubectlClient:
@@ -252,6 +261,17 @@ class KubectlClient:
         )
         return json.loads(out).get("items", [])
 
+    def update_status(self, cr: dict, status: dict) -> None:
+        """Write the CR's status subresource (the CRD enables it) so
+        ``kubectl get`` shows reconcile health — reference analog:
+        dynamodeployment_controller.go status/conditions handling."""
+        self._run(
+            "patch", f"{PLURAL}.{GROUP}", cr["metadata"]["name"],
+            "-n", cr["metadata"].get("namespace", "default"),
+            "--type=merge", "--subresource=status",
+            "-p", json.dumps({"status": status}),
+        )
+
 
 class Reconciler:
     """Desired-state reconcile: render, apply changed, prune orphans.
@@ -261,39 +281,102 @@ class Reconciler:
     loop, a poll loop, and the unit tests.
     """
 
-    def __init__(self, client: KubeClient):
+    def __init__(self, client: KubeClient, status_writer=None):
         self.client = client
+        # where CR status lands: the kube client's status subresource by
+        # default; store-sourced CRs write back into their store record
+        self._status_writer = status_writer
         # last applied spec per child, to skip no-op applies
         self._applied: Dict[str, str] = {}
+        # last written status per CR: steady-state cycles must not patch
+        # the API server every poll, and lastTransitionTime must mark the
+        # actual transition (k8s condition convention)
+        self._status_written: Dict[tuple, dict] = {}
 
     def reconcile(self, cr: dict) -> Dict[str, List[str]]:
-        """Bring the cluster to the CR's desired state. Returns a change
-        summary {applied: [...], deleted: [...]} (for status/events)."""
+        """Bring the cluster to the CR's desired state, then write the
+        CR's status (observed generation, child counts, Reconciled
+        condition). Returns a change summary {applied: [...],
+        deleted: [...]} (for events/logs)."""
         name = cr["metadata"]["name"]
         ns = cr["metadata"].get("namespace", "default")
-        desired = {_key(m): m for m in render_manifests(cr)}
-        observed = {_key(o): o for o in self.client.list_managed(ns, name)}
+        try:
+            desired = {_key(m): m for m in render_manifests(cr)}
+            observed = {_key(o): o for o in self.client.list_managed(ns, name)}
 
-        applied, deleted = [], []
-        for key, manifest in desired.items():
-            serialized = json.dumps(manifest, sort_keys=True)
-            # re-apply on spec change AND on external deletion — the cache
-            # alone would never repair drift (e.g. kubectl delete of a child)
-            if self._applied.get(key) != serialized or key not in observed:
-                self.client.apply(manifest)
-                self._applied[key] = serialized
-                applied.append(key)
+            applied, deleted = [], []
+            for key, manifest in desired.items():
+                serialized = json.dumps(manifest, sort_keys=True)
+                # re-apply on spec change AND on external deletion — the
+                # cache alone would never repair drift (e.g. kubectl
+                # delete of a child)
+                if self._applied.get(key) != serialized or key not in observed:
+                    self.client.apply(manifest)
+                    self._applied[key] = serialized
+                    applied.append(key)
 
-        for key, obj in observed.items():
-            if key not in desired:
-                self.client.delete(
-                    obj["kind"],
-                    obj["metadata"].get("namespace", "default"),
-                    obj["metadata"]["name"],
-                )
-                self._applied.pop(key, None)
-                deleted.append(key)
+            for key, obj in observed.items():
+                if key not in desired:
+                    self.client.delete(
+                        obj["kind"],
+                        obj["metadata"].get("namespace", "default"),
+                        obj["metadata"]["name"],
+                    )
+                    self._applied.pop(key, None)
+                    deleted.append(key)
+        except Exception as e:
+            self.write_status(cr, error=str(e))
+            raise
+        counts: Dict[str, int] = {}
+        for m in desired.values():
+            counts[m["kind"]] = counts.get(m["kind"], 0) + 1
+        self.write_status(
+            cr, children=counts,
+            changed=bool(applied or deleted),
+        )
         return {"applied": applied, "deleted": deleted}
+
+    def write_status(self, cr: dict, children: Optional[Dict[str, int]] = None,
+                     error: Optional[str] = None,
+                     changed: bool = False) -> None:
+        """Best-effort CR status write (failures must never fail the
+        reconcile itself)."""
+        condition = {
+            "type": "Reconciled",
+            "status": "False" if error else "True",
+            "reason": "ReconcileError" if error else "ReconcileSucceeded",
+            "message": error or (
+                "children updated" if changed else "in sync"
+            ),
+            "lastTransitionTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        status = {
+            "observedGeneration": cr["metadata"].get("generation"),
+            "children": children or {},
+            "conditions": [condition],
+        }
+        cr_key = (cr["metadata"].get("namespace", "default"),
+                  cr["metadata"]["name"])
+        prev = self._status_written.get(cr_key)
+        if prev is not None:
+            prev_cond = prev["conditions"][0]
+            if prev_cond["status"] == condition["status"]:
+                # same condition state → keep the original transition
+                # time; and if nothing else changed, skip the patch
+                condition["lastTransitionTime"] = prev_cond["lastTransitionTime"]
+                if prev == status:
+                    return
+        try:
+            (self._status_writer or self.client.update_status)(cr, status)
+            self._status_written[cr_key] = status
+        except Exception:
+            logger.exception(
+                "status update failed for %s/%s",
+                cr["metadata"].get("namespace", "default"),
+                cr["metadata"]["name"],
+            )
 
     def finalize(self, cr: dict) -> List[str]:
         """CR deleted: remove every managed child."""

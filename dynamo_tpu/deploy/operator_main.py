@@ -43,15 +43,33 @@ def main() -> None:
     parser.add_argument("--namespace", default=None,
                         help="watch one namespace (default: all)")
     parser.add_argument("--kubectl", default="kubectl")
+    parser.add_argument(
+        "--api-store-url", default=None,
+        help="reconcile deployments registered in the api-store instead "
+             "of (or in addition to) cluster CRs; reconcile status is "
+             "written back into the store record",
+    )
     args = parser.parse_args()
     setup_logging(logging.INFO)
 
-    reconciler = Reconciler(KubectlClient(args.kubectl))
-    logger.info("operator watching %s.%s every %.0fs",
-                PLURAL, GROUP, args.interval)
+    if args.api_store_url:
+        from .store_source import ApiStoreClient
+
+        store = ApiStoreClient(args.api_store_url)
+        reconciler = Reconciler(
+            KubectlClient(args.kubectl), status_writer=store.write_status
+        )
+        source = store.get_crs
+        logger.info("operator sourcing CRs from api-store %s every %.0fs",
+                    args.api_store_url, args.interval)
+    else:
+        reconciler = Reconciler(KubectlClient(args.kubectl))
+        source = lambda: get_crs(args.kubectl, args.namespace)  # noqa: E731
+        logger.info("operator watching %s.%s every %.0fs",
+                    PLURAL, GROUP, args.interval)
     control_loop(
         reconciler,
-        lambda: get_crs(args.kubectl, args.namespace),
+        source,
         interval=args.interval,
         stop=threading.Event(),  # run until killed; Event never set
     )

@@ -516,10 +516,11 @@ class Scheduler:
         slot = self._free_slot()
         assert slot is not None
         tokens_all = er.prompt + er.resume_tokens
-        if er.want_prompt_lps:
+        if er.want_prompt_lps and not er.prompt_lps_emitted:
             # every prompt position must run through the model — a prefix
             # cache hit would skip its logits. Blank the probe's hits so
-            # allocation proceeds with zero cached tokens.
+            # allocation proceeds with zero cached tokens. (A resumed
+            # request that already emitted them uses the cache normally.)
             probe = self.allocator.probe_prefix(tokens_all)
             er.block_ids, er.num_cached = self.allocator.allocate_prompt(
                 tokens_all, probe=(probe[0], [], [])
@@ -584,7 +585,9 @@ class Scheduler:
             commit=np.asarray([final], bool),
             want_top=er.logprobs_n > 0,
             targets=targets,
-            want_prompt=er.want_prompt_lps,
+            # targets is None (n_tgt 0) once emitted — skip the [S, V]
+            # log_softmax entirely on a resumed request's re-prefill
+            want_prompt=targets is not None,
         )
         if n_tgt > 0:
             # keep the DEVICE row; one host conversion on the final chunk

@@ -460,16 +460,33 @@ class OpenAIPreprocessor(Operator):
     def _prompt_logprobs_dict(self, token_ids, prompt_lps) -> dict:
         """OpenAI legacy completions logprobs block for the echoed prompt:
         tokens / token_logprobs / text_offset (first entry None — the
-        first prompt token has no conditioning prefix)."""
-        toks = [
-            (self.tokenizer.id_to_token(t) if self.tokenizer else str(t))
-            or str(t)
-            for t in token_ids
-        ]
-        offsets, pos = [], 0
-        for t in toks:
-            offsets.append(pos)
-            pos += len(t)
+        first prompt token has no conditioning prefix).
+
+        Offsets index into the DECODED echo text, so each token string is
+        the decoded-prefix delta (raw vocab pieces — byte-fallback,
+        BPE space markers — have different lengths than the text they
+        decode to and would drift every subsequent offset)."""
+        token_ids = list(token_ids)
+        if self.tokenizer is not None and hasattr(self.tokenizer, "decode"):
+            prefixes = [""] + [
+                self.tokenizer.decode(token_ids[: i + 1])
+                for i in range(len(token_ids))
+            ]
+            toks = [
+                prefixes[i + 1][len(prefixes[i]):]
+                for i in range(len(token_ids))
+            ]
+            offsets = [len(prefixes[i]) for i in range(len(token_ids))]
+        else:
+            toks = [
+                (self.tokenizer.id_to_token(t) if self.tokenizer else str(t))
+                or str(t)
+                for t in token_ids
+            ]
+            offsets, pos = [], 0
+            for t in toks:
+                offsets.append(pos)
+                pos += len(t)
         return {
             "tokens": toks,
             "token_logprobs": list(prompt_lps[: len(toks)]),

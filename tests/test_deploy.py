@@ -169,3 +169,106 @@ async def test_api_store_update_accepts_both_envelopes():
                 assert r.status == 400
     finally:
         await service.stop()
+
+
+# ---------- CR status + api-store → operator wiring (round 3) ----------
+
+
+def _cr3(name="g1", generation=3, services=None):
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoTpuGraphDeployment",
+        "metadata": {"name": name, "namespace": "default",
+                     "generation": generation},
+        "spec": {"services": services or {"worker": {"role": "worker"}}},
+    }
+
+
+def test_reconcile_writes_cr_status():
+    """After reconcile the CR status carries the observed generation,
+    child counts, and a Reconciled=True condition (reference analog:
+    dynamodeployment_controller.go status handling)."""
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    rec.reconcile(_cr3(generation=7))
+    status = kube.statuses[("default", "g1")]
+    assert status["observedGeneration"] == 7
+    assert status["children"] == {"Deployment": 3, "Service": 2}
+    (cond,) = status["conditions"]
+    assert (cond["type"], cond["status"]) == ("Reconciled", "True")
+    assert cond["reason"] == "ReconcileSucceeded"
+
+    # second pass: in sync, still True
+    rec.reconcile(_cr3(generation=7))
+    assert kube.statuses[("default", "g1")]["conditions"][0]["message"] == "in sync"
+
+
+def test_reconcile_error_writes_false_condition():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    bad = _cr3(services={"worker": {"role": "no-such-role"}})
+    with pytest.raises(ValueError):
+        rec.reconcile(bad)
+    (cond,) = kube.statuses[("default", "g1")]["conditions"]
+    assert (cond["status"], cond["reason"]) == ("False", "ReconcileError")
+    assert "no-such-role" in cond["message"]
+
+
+async def test_store_to_operator_end_to_end():
+    """llmctl-deploy path: POST a graph spec to the api-store, source CRs
+    from the store, reconcile into InMemoryKube, status lands back in the
+    record; DELETE → finalize prunes the children (reference analog:
+    api-store create_dynamo_deployment → k8s objects,
+    ai_dynamo_store/api/deployments.py:30)."""
+    import asyncio
+
+    from dynamo_tpu.deploy.operator import control_loop  # noqa: F401
+    from dynamo_tpu.deploy.store_source import ApiStoreClient, record_to_cr
+
+    service = ApiStoreService(DeploymentStore(":memory:"), "127.0.0.1", 0)
+    await service.start()
+    try:
+        client = ApiStoreClient(f"http://127.0.0.1:{service.port}")
+        loop = asyncio.get_running_loop()
+
+        # llmctl deploy create (sync client off the event loop thread)
+        spec = {"services": {"worker": {"role": "worker", "tpus": 4}},
+                "modelName": "tiny"}
+        await loop.run_in_executor(None, lambda: client.create("graph1", spec))
+
+        kube = InMemoryKube()
+        rec = Reconciler(kube, status_writer=client.write_status)
+        crs = await loop.run_in_executor(None, client.get_crs)
+        assert len(crs) == 1 and crs[0]["metadata"]["name"] == "graph1"
+        for cr in crs:
+            await loop.run_in_executor(None, rec.reconcile, cr)
+
+        # children exist (worker + default dynstore/frontend + services)
+        kinds = sorted(k.split("/")[0] for k in kube.objects)
+        assert kinds.count("Deployment") == 3 and kinds.count("Service") == 2
+
+        # status round-tripped into the store record
+        rec1 = await loop.run_in_executor(None, client.get, "graph1")
+        cond = rec1["status"]["conditions"][0]
+        assert (cond["type"], cond["status"]) == ("Reconciled", "True")
+
+        # llmctl deploy delete → finalize prunes every child
+        await loop.run_in_executor(None, client.delete, "graph1")
+        crs2 = await loop.run_in_executor(None, client.get_crs)
+        assert crs2 == []
+        removed = rec.finalize(record_to_cr(
+            {"name": "graph1", "spec": spec, "updated": 1}
+        ))
+        assert len(removed) == 5 and kube.objects == {}
+    finally:
+        await service.stop()
+
+
+async def test_store_source_unreachable_returns_none():
+    """A dead store must yield None (skip cycle), never [] (finalize all)."""
+    from dynamo_tpu.deploy.store_source import ApiStoreClient
+
+    client = ApiStoreClient("http://127.0.0.1:1", timeout=0.5)
+    import asyncio
+    loop = asyncio.get_running_loop()
+    assert await loop.run_in_executor(None, client.get_crs) is None
