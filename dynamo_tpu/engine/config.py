@@ -170,6 +170,12 @@ class EngineConfig:
     allow_random_weights: bool = False
     # scheduler knobs
     max_prefill_tokens_per_step: int = 8192
+    # concurrent prompts batched into ONE prefill step (rows padded to a
+    # power-of-two ladder; one compiled program per (rows, bucket)).
+    # Serial prefill (the round-2 design) queued TTFT linearly under
+    # prompt bursts; batching amortizes the weight stream and per-step
+    # overhead across rows. 1 restores strictly-serial behavior.
+    max_prefill_batch: int = 4
     enable_prefix_caching: bool = True
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
@@ -179,6 +185,12 @@ class EngineConfig:
         if self.prefill_buckets is None:
             self.prefill_buckets = default_prefill_buckets(self.max_model_len)
         self.prefill_buckets = sorted(self.prefill_buckets)
+        # clamp into the compiled row ladder: values past the top bucket
+        # would admit more rows than the step arrays hold (IndexError in
+        # the scheduler), and <= 0 would silently admit nothing
+        self.max_prefill_batch = max(
+            1, min(self.max_prefill_batch, self.PREFILL_ROW_BUCKETS[-1])
+        )
 
     @property
     def blocks_per_seq(self) -> int:
@@ -189,6 +201,21 @@ class EngineConfig:
             if length <= b:
                 return b
         raise ValueError(f"prompt length {length} exceeds max bucket {self.prefill_buckets[-1]}")
+
+    PREFILL_ROW_BUCKETS = (1, 2, 4, 8)
+
+    def prefill_row_buckets(self) -> List[int]:
+        """Row-count ladder for batched prefill: the prefill batch pads to
+        the next power of two (one compiled program per (rows, bucket));
+        warmup sweeps this ladder."""
+        cap = self.prefill_row_bucket(self.max_prefill_batch)
+        return [r for r in self.PREFILL_ROW_BUCKETS if r <= cap]
+
+    def prefill_row_bucket(self, n: int) -> int:
+        for r in self.PREFILL_ROW_BUCKETS:
+            if n <= r:
+                return r
+        return self.PREFILL_ROW_BUCKETS[-1]
 
     def kv_width_buckets(self) -> List[int]:
         """The decode block-table width ladder: powers of two from 8 up to

@@ -643,16 +643,18 @@ class ModelRunner:
                 np.ones(b, np.float32),
                 jax.random.PRNGKey(0),
             )
-        # one prefill-shaped program (largest bucket, full table width) so
-        # the flash-prefill kernel's compile also happens — and fails —
-        # here rather than on the first real prompt
+        # prefill-shaped programs (largest bucket, full table width) over
+        # the batched-prefill row ladder, so the flash-prefill kernel's
+        # compiles also happen — and fail — here rather than on the first
+        # real prompt burst
         s = self.config.prefill_buckets[-1]
         w = self.config.blocks_per_seq
-        self.step(
-            np.zeros((1, s), np.int32), np.zeros((1, s), np.int32),
-            np.zeros((1, w), np.int32), np.full((1, s), -1, np.int32),
-            np.ones(1, np.int32), np.zeros(1, np.int32),
-            np.zeros(1, np.float32), np.zeros(1, np.int32),
-            np.ones(1, np.float32),
-            jax.random.PRNGKey(0),
-        )
+        for r in self.config.prefill_row_buckets():
+            self.step(
+                np.zeros((r, s), np.int32), np.zeros((r, s), np.int32),
+                np.zeros((r, w), np.int32), np.full((r, s), -1, np.int32),
+                np.ones(r, np.int32), np.zeros(r, np.int32),
+                np.zeros(r, np.float32), np.zeros(r, np.int32),
+                np.ones(r, np.float32),
+                jax.random.PRNGKey(0),
+            )

@@ -39,15 +39,17 @@ logger = logging.getLogger(__name__)
 
 
 def build_prefill_arrays(cfg: EngineConfig, prompt: List[int], num_cached: int,
-                         block_ids: List[int]):
+                         block_ids: List[int], bucket: Optional[int] = None):
     """Batch-of-1 arrays for one bucketed prefill step.
 
     Shared by the scheduler's local prefill and the disagg prefill worker.
     Returns (tokens, positions, block_tables, slot_mapping, context_lens,
-    last_idx) — the leading arguments of ``ModelRunner.step``.
+    last_idx) — the leading arguments of ``ModelRunner.step``. Pass
+    ``bucket`` to pad to a caller-chosen bucket (the batched prefill path
+    pads every row to the batch's common bucket).
     """
     suffix = prompt[num_cached:]
-    bucket = cfg.bucket_for(len(suffix))
+    bucket = bucket or cfg.bucket_for(len(suffix))
     w = cfg.blocks_per_seq
     bs = cfg.kv_block_size
 
@@ -154,7 +156,9 @@ class Scheduler:
         self.waiting: deque = deque()
         self.pending_remote: List[EngineRequest] = []
         self.slots: List[Optional[EngineRequest]] = [None] * config.max_batch_size
-        self.prefilling: Optional[EngineRequest] = None
+        # the prefill BATCH: up to max_prefill_batch requests whose
+        # chunked prefills run as rows of one step
+        self.prefilling: List[EngineRequest] = []
         self.wake = asyncio.Event()
         self._rng = np.random.default_rng(config.seed)
         self._task: Optional[asyncio.Task] = None
@@ -305,8 +309,8 @@ class Scheduler:
                     self._finish(er, FinishReason.CANCELLED)
             for er in [s for s in self.slots if s is not None]:
                 if er.ctx.is_stopped:
-                    if er is self.prefilling:
-                        self.prefilling = None
+                    if er in self.prefilling:
+                        self.prefilling.remove(er)
                     self._finish(er, FinishReason.CANCELLED)
 
             # remote prefill completions / cancellations / timeouts
@@ -326,8 +330,10 @@ class Scheduler:
                         self.waiting.remove(er)
                         progressed = True
 
-            # local admission: claim a slot + blocks, begin a chunked prefill
-            while (self.waiting and self.prefilling is None
+            # local admission: claim a slot + blocks, join the prefill
+            # batch (up to max_prefill_batch prompts prefill together)
+            while (self.waiting
+                   and len(self.prefilling) < self.config.max_prefill_batch
                    and self._free_slot() is not None):
                 er = self.waiting[0]
                 try:
@@ -337,19 +343,20 @@ class Scheduler:
                 self.waiting.popleft()
                 progressed = True
 
-            # one prefill chunk (≤ max_prefill_tokens_per_step tokens) per
-            # loop pass, interleaved with the decode step below so active
-            # streams keep a bounded ITL while a long prompt prefills
-            # (reference analog: chunked-prefill toggles,
+            # one prefill step (≤ max_prefill_tokens_per_step tokens,
+            # split across the batch) per loop pass, interleaved with the
+            # decode step below so active streams keep a bounded ITL
+            # while prompts prefill (reference analog: chunked-prefill +
+            # batching of the engines behind
             # examples/llm/components/worker.py:72-74)
-            if self.prefilling is not None:
-                await self._prefill_chunk(loop, self.prefilling)
+            if self.prefilling:
+                await self._prefill_chunk(loop, list(self.prefilling))
                 progressed = True
 
             # decode one token for every active slot
             active = [
                 s for s in self.slots
-                if s is not None and s is not self.prefilling
+                if s is not None and s not in self.prefilling
             ]
             if active:
                 await self._decode(loop, active)
@@ -543,103 +550,156 @@ class Scheduler:
             slot, er.prompt, er.resume_tokens,
             logit_bias=er.req.sampling_options.logit_bias,
         )
-        self.prefilling = er
+        self.prefilling.append(er)
 
-    async def _prefill_chunk(self, loop, er: EngineRequest) -> None:
-        """Run ONE bucketed prefill chunk; on the final chunk, sample/emit."""
+    async def _prefill_chunk(self, loop, ers: List[EngineRequest]) -> None:
+        """ONE batched prefill step: every prefilling request advances a
+        chunk as a row of the same program (rows padded to the power-of-
+        two ladder, lengths to the common bucket); rows that finish their
+        prompt sample/emit. The token budget splits across rows."""
         cfg = self.config
-        total = len(er.prefill_tokens)
-        budget = cfg.max_prefill_tokens_per_step or total
-        take = min(total - er.prefill_pos, budget)
-        end = er.prefill_pos + take
-        final = end >= total
+        rows = cfg.prefill_row_bucket(len(ers))
+        budget = cfg.max_prefill_tokens_per_step
+        # the ITL bound is on COMPUTED positions = padded rows x padded
+        # bucket, so cap the bucket at the largest that keeps
+        # rows * bucket within budget (padding included), not just the
+        # per-row take
+        if budget:
+            allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
+            bucket_cap = allowed[-1] if allowed else cfg.prefill_buckets[0]
+        else:
+            bucket_cap = cfg.prefill_buckets[-1]
+        plan = []  # (er, start, end, take, final)
+        for er in ers:
+            total = len(er.prefill_tokens)
+            take = min(total - er.prefill_pos, bucket_cap)
+            end = er.prefill_pos + take
+            plan.append((er, er.prefill_pos, end, take, end >= total))
+        bucket = cfg.bucket_for(max(p[3] for p in plan))  # <= bucket_cap
 
-        arrays = build_prefill_arrays(
-            cfg, er.prefill_tokens[:end], er.prefill_pos, er.block_ids
-        )
-        start = er.prefill_pos
-        targets = None
-        n_tgt = 0
-        if er.want_prompt_lps and not er.prompt_lps_emitted:
-            # target at bucket index i (absolute position start+i) is the
-            # NEXT prompt token; only prompt positions count (a resumed
-            # request's re-prefilled generation tokens are not prompt)
-            bucket = arrays[0].shape[1]
-            targets = np.zeros((1, bucket), np.int32)
-            nxt = er.prefill_tokens[start + 1 : end + 1]
-            targets[0, : len(nxt)] = nxt
-            n_tgt = max(0, min(take, len(er.prompt) - 1 - start))
+        tokens = np.zeros((rows, bucket), np.int32)
+        positions = np.zeros((rows, bucket), np.int32)
+        btab = np.zeros((rows, cfg.blocks_per_seq), np.int32)
+        slot_map = np.full((rows, bucket), -1, np.int32)
+        ctx_lens = np.ones(rows, np.int32)
+        last_idx = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.ones(rows, np.float32)
+        min_p = np.zeros(rows, np.float32)
+        pres = np.zeros(rows, np.float32)
+        freq = np.zeros(rows, np.float32)
+        rep = np.ones(rows, np.float32)
+        keys = np.zeros((rows, 2), np.uint32)
+        ctrs = np.zeros(rows, np.int32)
+        sample_slots = np.zeros(rows, np.int32)
+        commit = np.zeros(rows, bool)
+        targets = np.zeros((rows, bucket), np.int32)
+        n_tgts = [0] * len(plan)
+        want_prompt = False
+
+        for i, (er, start, end, take, final) in enumerate(plan):
+            t, p, bt, sm, cl, li = build_prefill_arrays(
+                cfg, er.prefill_tokens[:end], start, er.block_ids,
+                bucket=bucket,
+            )
+            tokens[i], positions[i] = t[0], p[0]
+            btab[i], slot_map[i] = bt[0], sm[0]
+            ctx_lens[i], last_idx[i] = cl[0], li[0]
+            (temp[i], top_k[i], top_p[i], min_p[i], pres[i], freq[i],
+             rep[i]) = (er.temperature, er.top_k, er.top_p, er.min_p,
+                        er.presence_penalty, er.frequency_penalty,
+                        er.repetition_penalty)
+            keys[i] = er.base_key
+            ctrs[i] = er.generated
+            sample_slots[i] = er.slot
+            commit[i] = final
+            if er.want_prompt_lps and not er.prompt_lps_emitted:
+                # target at bucket index j (absolute position start+j) is
+                # the NEXT prompt token; only prompt positions count (a
+                # resumed request's generation tokens are not prompt)
+                want_prompt = True
+                nxt = er.prefill_tokens[start + 1 : end + 1]
+                targets[i, : len(nxt)] = nxt
+                n_tgts[i] = max(0, min(take, len(er.prompt) - 1 - start))
+
         t0 = time.monotonic()
         next_tokens, lps, top_vals, top_ids, plps = self.runner.step(
-            *arrays,
-            np.asarray([er.temperature], np.float32),
-            np.asarray([er.top_k], np.int32),
-            np.asarray([er.top_p], np.float32),
-            min_p=np.asarray([er.min_p], np.float32),
-            presence_penalty=np.asarray([er.presence_penalty], np.float32),
-            frequency_penalty=np.asarray([er.frequency_penalty], np.float32),
-            repetition_penalty=np.asarray([er.repetition_penalty], np.float32),
-            seed_keys=er.base_key[None, :],
-            counters=np.asarray([er.generated], np.int32),
-            sample_slots=np.asarray([er.slot], np.int32),
-            commit=np.asarray([final], bool),
-            want_top=er.logprobs_n > 0,
-            targets=targets,
-            # targets is None (n_tgt 0) once emitted — skip the [S, V]
-            # log_softmax entirely on a resumed request's re-prefill
-            want_prompt=targets is not None,
+            tokens, positions, btab, slot_map, ctx_lens, last_idx,
+            temp, top_k, top_p,
+            min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
+            repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+            sample_slots=sample_slots, commit=commit,
+            want_top=any(er.logprobs_n > 0 for er, *_ in plan),
+            targets=targets, want_prompt=want_prompt,
         )
-        if n_tgt > 0:
-            # keep the DEVICE row; one host conversion on the final chunk
-            er.prompt_lp_parts.append((plps, n_tgt))
         self.steps += 1
-        er.prefill_pos = end
-        er.context_len = end
-        # prefix blocks become matchable (and KV events publish) as soon as
-        # each chunk's KV is scheduled — device ordering guarantees the
-        # write lands before any later step reads it
-        self._register_completed_blocks(er)
-        logger.debug("prefill chunk %s [%d:%d)/%d %.1fms", er.request_id,
-                     end - take, end, total, 1e3 * (time.monotonic() - t0))
-        if not final:
+
+        finals = []
+        for i, (er, start, end, take, final) in enumerate(plan):
+            if n_tgts[i] > 0:
+                # keep the DEVICE row; one host conversion at the end
+                er.prompt_lp_parts.append((plps[i : i + 1], n_tgts[i]))
+            er.prefill_pos = end
+            er.context_len = end
+            # prefix blocks become matchable (and KV events publish) as
+            # soon as each chunk's KV is scheduled — device ordering
+            # guarantees the write lands before any later step reads it
+            self._register_completed_blocks(er)
+            logger.debug("prefill chunk %s [%d:%d)/%d %.1fms",
+                         er.request_id, start, end,
+                         len(er.prefill_tokens),
+                         1e3 * (time.monotonic() - t0))
+            if final:
+                finals.append(i)
+        if not finals:
             return
 
-        token, lp, tv, ti, plist = await loop.run_in_executor(
-            None, lambda: (
-                int(np.asarray(next_tokens)[0]), float(np.asarray(lps)[0]),
-                np.asarray(top_vals), np.asarray(top_ids),
-                [
+        def _to_host():
+            # every device→host transfer off the event loop: final-row
+            # outputs plus any accumulated prompt-logprob rows (an
+            # echo+logprobs prompt may hold many chunk rows)
+            plists = {
+                i: [
                     float(x)
-                    for row, cnt in er.prompt_lp_parts
+                    for row, cnt in plan[i][0].prompt_lp_parts
                     for x in np.asarray(row)[0, :cnt]
-                ],
-            )
-        )
-        self.prefilling = None
-        prompt_lps = None
-        if er.want_prompt_lps and not er.prompt_lps_emitted:
-            # OpenAI/vLLM convention: the first prompt token has no
-            # conditioning prefix — its entry is None
-            prompt_lps = [None] + plist
-            er.prompt_lps_emitted = True
-        er.prompt_lp_parts = []
-        if er.max_new == 0:
-            # prompt-scoring request (echo + logprobs + max_tokens=0):
-            # the prefill ran for its logits; no token is emitted
-            er.finish = FinishReason.LENGTH
-            er.out_queue.put_nowait(EngineOutput(
-                token_ids=[], finish_reason=er.finish,
-                prompt_logprobs=prompt_lps,
-            ))
-            self._finish(er, er.finish, emit=False)
-            return
-        er.pending_token = token
-        er.generated += 1  # += not =: resumed requests keep their count
-        er.finish = self._check_finish(er, token)
-        self._emit(er, token, lp if er.want_logprobs else None,
-                   self._top_row(er, tv, ti, 0), prompt_lps=prompt_lps)
-        if er.finish is not None:
-            self._finish(er, er.finish, emit=False)
+                ]
+                for i in finals
+                if plan[i][0].prompt_lp_parts
+            }
+            return (np.asarray(next_tokens), np.asarray(lps),
+                    np.asarray(top_vals), np.asarray(top_ids), plists)
+
+        toks, lpn, tv, ti, plists = await loop.run_in_executor(None, _to_host)
+        for i in finals:
+            er = plan[i][0]
+            self.prefilling.remove(er)
+            prompt_lps = None
+            if er.want_prompt_lps and not er.prompt_lps_emitted:
+                # OpenAI/vLLM convention: the first prompt token has no
+                # conditioning prefix — its entry is None
+                prompt_lps = [None] + plists.get(i, [])
+                er.prompt_lps_emitted = True
+            er.prompt_lp_parts = []
+            if er.max_new == 0:
+                # prompt-scoring request (echo + logprobs + max_tokens=0):
+                # the prefill ran for its logits; no token is emitted
+                er.finish = FinishReason.LENGTH
+                er.out_queue.put_nowait(EngineOutput(
+                    token_ids=[], finish_reason=er.finish,
+                    prompt_logprobs=prompt_lps,
+                ))
+                self._finish(er, er.finish, emit=False)
+                continue
+            token = int(toks[i])
+            er.pending_token = token
+            er.generated += 1  # += not =: resumed requests keep their count
+            er.finish = self._check_finish(er, token)
+            self._emit(er, token, float(lpn[i]) if er.want_logprobs else None,
+                       self._top_row(er, tv, ti, i), prompt_lps=prompt_lps)
+            if er.finish is not None:
+                self._finish(er, er.finish, emit=False)
 
     async def _decode(self, loop, active: List[EngineRequest]) -> None:
         cfg = self.config
