@@ -17,11 +17,13 @@ sender first streams an ``ici_blocks`` header (ids, bucket — no payload),
 then both sides enter the collective for the bucketed block arrays. A
 lost peer surfaces as the collective's timeout rather than a hung socket.
 
-The engine's jitted block gather/scatter already produce/accept
-*replicated* arrays, so the payload needs only ONE device per side: the
-mesh takes the first local device of each process, and other devices
-idle for the transfer's duration (the gather that feeds it is itself a
-collective over the worker's own mesh).
+The payload STRIPES across device pairs: the mesh is [2, P] ("peer" ×
+"pair") over min(sender-local, receiver-local) devices (rounded down to
+a power of two), the bucketed block axis splits into P stripes, and the
+single ppermute moves every stripe concurrently over its own link — so
+transfer bandwidth scales with the local device count instead of being
+bounded by one ICI link (each stripe is an independent peer hop in the
+same collective program).
 """
 
 from __future__ import annotations
@@ -98,21 +100,26 @@ class IciKvTransfer:
             )
         self.is_sender = me == sender_rank
 
-        def first_local_device(rank: int):
+        def local_devices_of(rank: int):
             devs = [d for d in jax.devices() if d.process_index == rank]
             if not devs:
                 raise RuntimeError(f"no devices for process {rank}")
-            return devs[0]
+            return devs
 
-        # peer axis: [sender, receiver]
+        devs_s = local_devices_of(sender_rank)
+        devs_r = local_devices_of(receiver_rank)
+        # stripe across as many device PAIRS as both sides have; a power
+        # of two keeps stripes even over the power-of-two buckets
+        pairs = min(len(devs_s), len(devs_r))
+        while pairs & (pairs - 1):
+            pairs -= 1
+        self.pairs = pairs
+        # peer axis: [sender, receiver]; pair axis: the parallel links
         self.mesh = Mesh(
-            np.array(
-                [first_local_device(sender_rank),
-                 first_local_device(receiver_rank)]
-            ),
-            ("peer",),
+            np.array([devs_s[:pairs], devs_r[:pairs]]),
+            ("peer", "pair"),
         )
-        self.sharding = NamedSharding(self.mesh, P("peer"))
+        self.sharding = NamedSharding(self.mesh, P("peer", "pair"))
         self._programs: Dict[int, object] = {}
 
     # ---------- the collective ----------
@@ -123,9 +130,10 @@ class IciKvTransfer:
             return prog
 
         def step(k_buf, v_buf, seq_buf):
-            # peer 0 → peer 1; peer 1's (zero) shard rotates back to 0 and
-            # is discarded — a pure shift would need a conditional, and
-            # the dead shard costs the same ICI hop either way
+            # peer 0 → peer 1 on every pair link at once; peer 1's (zero)
+            # shard rotates back to 0 and is discarded — a pure shift
+            # would need a conditional, and the dead shard costs the same
+            # hop either way
             perm = [(0, 1), (1, 0)]
             return (
                 jax.lax.ppermute(k_buf, "peer", perm),
@@ -133,22 +141,31 @@ class IciKvTransfer:
                 jax.lax.ppermute(seq_buf, "peer", perm),
             )
 
-        kb = (1,) + self._bucket_shape(self.k_shape, bucket)
-        vb = (1,) + self._bucket_shape(self.v_shape, bucket)
+        eff = self._eff_bucket(bucket)
+        kb = self._local_shape(self.k_shape, eff)
+        vb = self._local_shape(self.v_shape, eff)
         prog = jax.jit(
             jax.shard_map(
                 step, mesh=self.mesh,
-                in_specs=(P("peer"), P("peer"), P("peer")),
-                out_specs=(P("peer"), P("peer"), P("peer")),
+                in_specs=(P("peer", "pair"), P("peer", "pair"),
+                          P("peer", "pair")),
+                out_specs=(P("peer", "pair"), P("peer", "pair"),
+                           P("peer", "pair")),
             ),
         )
         self._programs[bucket] = (prog, kb, vb)
         return self._programs[bucket]
 
-    @staticmethod
-    def _bucket_shape(shape: Tuple[int, ...], bucket: int) -> Tuple[int, ...]:
-        # block arrays are [L, n, bs, heads, d]; bucket the n axis
-        return (shape[0], bucket) + tuple(shape[2:])
+    def _eff_bucket(self, bucket: int) -> int:
+        """Bucket padded so the block axis splits evenly across pairs
+        (rounded UP to a multiple — a truncating split would silently
+        drop the tail stripes of non-power-of-two custom buckets)."""
+        return -(-bucket // self.pairs) * self.pairs
+
+    def _local_shape(self, shape: Tuple[int, ...], eff: int) -> Tuple[int, ...]:
+        # block arrays are [L, n, bs, heads, d]; the n axis carries the
+        # (padded) bucket and stripes across pairs inside _global
+        return (shape[0], eff) + tuple(shape[2:])
 
     def bucket_for(self, nblocks: int) -> int:
         for b in self.buckets:
@@ -157,12 +174,22 @@ class IciKvTransfer:
         return self.buckets[-1]
 
     def _global(self, local: jnp.ndarray) -> jax.Array:
-        """[bucket-shape] local payload → [2, ...] peer-sharded global."""
+        """Local payload [L, eff_bucket, ...] → [2, P, L, stripe, ...]
+        peer×pair-sharded global (this side's row populated, the peer's
+        addressed by its own process)."""
+        st = local.shape[1] // self.pairs
+        row = 0 if self.is_sender else 1
+        shards = [
+            jax.device_put(
+                local[:, i * st : (i + 1) * st][None, None],
+                self.mesh.devices[row, i],
+            )
+            for i in range(self.pairs)
+        ]
         return jax.make_array_from_single_device_arrays(
-            (2,) + tuple(local.shape),
+            (2, self.pairs, local.shape[0], st) + tuple(local.shape[2:]),
             self.sharding,
-            [jax.device_put(local[None], self.mesh.devices.flat[
-                0 if self.is_sender else 1])],
+            shards,
         )
 
     def _stage(self, bucket: int, k_local, v_local, seq: int):
@@ -172,17 +199,31 @@ class IciKvTransfer:
         return prog, (
             self._global(k_local),
             self._global(v_local),
-            self._global(jnp.full((8,), seq, jnp.int32)),
+            self._global(jnp.full((1, 8 * self.pairs), seq, jnp.int32)),
         )
 
     def _enter(self, bucket: int, k_local, v_local, seq: int):
         prog, args = self._stage(bucket, k_local, v_local, seq)
         ko, vo, so = prog(*args)
-        # each process addresses exactly its own peer shard; pulling seq
-        # to host synchronizes, so collective failures surface here
-        k_shard = ko.addressable_shards[0].data[0]
-        v_shard = vo.addressable_shards[0].data[0]
-        seq_shard = int(np.asarray(so.addressable_shards[0].data[0])[0])
+        # each process addresses its own row of pair stripes; reassemble
+        # them in pair order. Pulling seq to host synchronizes, so
+        # collective failures surface here.
+        def assemble(out):
+            stripes = sorted(out.addressable_shards, key=lambda s: s.index[1])
+            parts = [s.data[0, 0] for s in stripes]
+            if len(parts) == 1:
+                return parts[0]
+            # stripes are committed to their own devices; gather them onto
+            # the first local device (device-to-device hop) to hand one
+            # array downstream
+            dev0 = parts[0].devices().pop()
+            return jnp.concatenate(
+                [jax.device_put(p, dev0) for p in parts], axis=1
+            )
+
+        k_shard = assemble(ko)
+        v_shard = assemble(vo)
+        seq_shard = int(np.asarray(so.addressable_shards[0].data).ravel()[0])
         return k_shard, v_shard, seq_shard
 
     # ---------- roles ----------
@@ -201,13 +242,14 @@ class IciKvTransfer:
                 f"{self.buckets[-1]}; chunk the payload"
             )
         bucket = self.bucket_for(n)
+        eff = self._eff_bucket(bucket)
         entered = False
         try:
             k = jnp.asarray(k_blocks, self.dtype)
             v = jnp.asarray(v_blocks, self.dtype)
-            if n < bucket:
+            if n < eff:
                 pad = [(0, 0)] * k.ndim
-                pad[1] = (0, bucket - n)
+                pad[1] = (0, eff - n)
                 k = jnp.pad(k, pad)
                 v = jnp.pad(v, pad)
             prog, args = self._stage(bucket, k, v, seq)
@@ -230,8 +272,8 @@ class IciKvTransfer:
         bucket = self.bucket_for(nblocks)
         _, kb, vb = self._program(bucket)
         prog, args = self._stage(
-            bucket, jnp.zeros(kb[1:], self.dtype),
-            jnp.zeros(vb[1:], self.dtype), -1,
+            bucket, jnp.zeros(kb, self.dtype),
+            jnp.zeros(vb, self.dtype), -1,
         )
         jax.block_until_ready(prog(*args))
 
@@ -241,8 +283,8 @@ class IciKvTransfer:
         assert not self.is_sender
         bucket = self.bucket_for(nblocks)
         (prog, kb, vb) = self._program(bucket)
-        k0 = jnp.zeros(kb[1:], self.dtype)
-        v0 = jnp.zeros(vb[1:], self.dtype)
+        k0 = jnp.zeros(kb, self.dtype)
+        v0 = jnp.zeros(vb, self.dtype)
         k, v, seq = self._enter(bucket, k0, v0, 0)
         return k[:, :nblocks], v[:, :nblocks], seq
 

@@ -43,6 +43,7 @@ V_SHAPE = (2, 1, 4, 2, 8)
 xfer = IciKvTransfer(
     (K_SHAPE, V_SHAPE), jnp.float32, sender_rank=1, receiver_rank=0,
 )
+assert xfer.pairs == 2, xfer.pairs  # striping across both device pairs
 
 rng = np.random.default_rng(3)
 n = 3  # not a bucket size: exercises pad-to-bucket (4) + slice-back
@@ -84,6 +85,10 @@ def test_two_process_collective_transfer():
     env.pop("PYTHONPATH", None)  # drop the TPU site hook; CPU test
     env["JAX_PLATFORMS"] = "cpu"
     env["REPO_ROOT"] = repo
+    # two virtual devices per process: the transfer stripes the payload
+    # across both device pairs (the single-pair path is the degenerate
+    # case of the same program)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
     procs = [
         subprocess.Popen(
