@@ -125,7 +125,11 @@ class IciKvTransfer:
     # ---------- the collective ----------
 
     def _program(self, bucket: int):
-        prog = self._programs.get(bucket)
+        # key by EFFECTIVE bucket: every bucket below the pair count pads
+        # to the same shapes, and duplicate XLA compiles of an identical
+        # program are pure waste on compile-bound TPU hosts
+        eff_key = self._eff_bucket(bucket)
+        prog = self._programs.get(eff_key)
         if prog is not None:
             return prog
 
@@ -153,8 +157,8 @@ class IciKvTransfer:
                            P("peer", "pair")),
             ),
         )
-        self._programs[bucket] = (prog, kb, vb)
-        return self._programs[bucket]
+        self._programs[eff_key] = (prog, kb, vb)
+        return self._programs[eff_key]
 
     def _eff_bucket(self, bucket: int) -> int:
         """Bucket padded so the block axis splits evenly across pairs

@@ -1,0 +1,193 @@
+"""OpenAI-frontend load generator: concurrency sweep with TTFT/ITL stats.
+
+Reference analog: examples/llm/benchmarks/perf.sh (reference:
+examples/llm/benchmarks/perf.sh:18-54 — genai-perf concurrency sweep
+1→256 at ISL 3000 / OSL 150 against the deployed graph). Same
+methodology without the external tool: streaming chat requests at a
+bounded concurrency, measuring per-request time-to-first-token,
+inter-token latency, and end-to-end duration, aggregated per
+concurrency level as one JSON line.
+
+    python examples/llm/benchmarks/loadgen.py \
+        --url http://127.0.0.1:8080 --model m8b \
+        --concurrency 1,4,16,64 --requests 64 --isl 3000 --osl 150
+
+ISL is approximated with a repeated-word prompt unless --prompt-file
+provides real text (token-exact ISL needs the server's tokenizer; the
+reference's genai-perf synthesizes prompts the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+import aiohttp
+
+
+def percentile(values: List[float], p: float) -> Optional[float]:
+    """None (→ JSON null) on empty input: NaN is not valid JSON, and an
+    all-errors level is exactly when the output must stay parseable."""
+    if not values:
+        return None
+    xs = sorted(values)
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(1e3 * v, 1)
+
+
+class RequestResult:
+    __slots__ = ("ok", "ttft", "duration", "itls", "tokens", "error")
+
+    def __init__(self):
+        self.ok = False
+        self.ttft: Optional[float] = None
+        self.duration = 0.0
+        self.itls: List[float] = []
+        self.tokens = 0
+        self.error: Optional[str] = None
+
+
+async def run_one(
+    session: aiohttp.ClientSession, url: str, model: str, prompt: str,
+    osl: int,
+) -> RequestResult:
+    res = RequestResult()
+    body = {
+        "model": model,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": osl,
+        "temperature": 0.0,
+        "stream": True,
+        # count completion tokens server-side (usage on the final chunk)
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.perf_counter()
+    last = t0
+    try:
+        async with session.post(
+            f"{url}/v1/chat/completions", json=body,
+            timeout=aiohttp.ClientTimeout(total=600),
+        ) as resp:
+            if resp.status != 200:
+                res.error = f"http {resp.status}: {(await resp.text())[:200]}"
+                return res
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                now = time.perf_counter()
+                if chunk.get("usage"):
+                    res.tokens = chunk["usage"].get("completion_tokens", 0)
+                choices = chunk.get("choices") or []
+                if choices and (
+                    (choices[0].get("delta") or {}).get("content")
+                    or choices[0].get("finish_reason")
+                ):
+                    if res.ttft is None:
+                        res.ttft = now - t0
+                    else:
+                        res.itls.append(now - last)
+                    last = now
+        res.duration = time.perf_counter() - t0
+        res.ok = res.ttft is not None
+    except Exception as e:  # noqa: BLE001 — any failure is a data point
+        res.error = f"{type(e).__name__}: {e}"
+    return res
+
+
+async def run_level(
+    url: str, model: str, prompt: str, osl: int, requests: int,
+    concurrency: int,
+) -> dict:
+    sem = asyncio.Semaphore(concurrency)
+    results: List[RequestResult] = []
+    t0 = time.perf_counter()
+
+    # the default connector caps at 100 connections — a 256-level sweep
+    # would silently measure 100-way concurrency with pool-wait time
+    # folded into TTFT
+    connector = aiohttp.TCPConnector(limit=max(concurrency, 100))
+    async with aiohttp.ClientSession(connector=connector) as session:
+        async def one():
+            async with sem:
+                results.append(
+                    await run_one(session, url, model, prompt, osl)
+                )
+
+        await asyncio.gather(*(one() for _ in range(requests)))
+    wall = time.perf_counter() - t0
+
+    oks = [r for r in results if r.ok]
+    ttfts = [r.ttft for r in oks]
+    itls = [itl for r in oks for itl in r.itls]
+    tokens = sum(r.tokens or len(r.itls) + 1 for r in oks)
+    return {
+        "concurrency": concurrency,
+        "requests": requests,
+        "ok": len(oks),
+        "errors": len(results) - len(oks),
+        "req_per_s": round(len(oks) / wall, 3) if wall else 0.0,
+        "output_tok_per_s": round(tokens / wall, 1) if wall else 0.0,
+        "ttft_p50_ms": _ms(percentile(ttfts, 50)),
+        "ttft_p95_ms": _ms(percentile(ttfts, 95)),
+        "itl_p50_ms": _ms(percentile(itls, 50)),
+        "itl_p95_ms": _ms(percentile(itls, 95)),
+        "duration_s": round(wall, 2),
+    }
+
+
+async def sweep(
+    url: str, model: str, prompt: str, osl: int, requests: int,
+    levels: List[int],
+) -> List[dict]:
+    out = []
+    for c in levels:
+        level = await run_level(url, model, prompt, osl, requests, c)
+        print(json.dumps(level), flush=True)
+        out.append(level)
+    return out
+
+
+def build_prompt(isl_words: int, prompt_file: Optional[str]) -> str:
+    if prompt_file:
+        with open(prompt_file) as f:
+            return f.read()
+    # synthetic prompt ~1 token/word for common tokenizers
+    return " ".join(f"word{i % 97}" for i in range(isl_words))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu load generator")
+    p.add_argument("--url", default="http://127.0.0.1:8080")
+    p.add_argument("--model", required=True)
+    p.add_argument("--concurrency", default="1,4,16",
+                   help="comma-separated sweep levels")
+    p.add_argument("--requests", type=int, default=32,
+                   help="requests per level")
+    p.add_argument("--isl", type=int, default=3000,
+                   help="approx input length in words (reference sweep: 3000)")
+    p.add_argument("--osl", type=int, default=150,
+                   help="output tokens per request (reference sweep: 150)")
+    p.add_argument("--prompt-file", default=None)
+    args = p.parse_args()
+
+    prompt = build_prompt(args.isl, args.prompt_file)
+    levels = [int(x) for x in args.concurrency.split(",") if x]
+    asyncio.run(
+        sweep(args.url, args.model, prompt, args.osl, args.requests, levels)
+    )
+
+
+if __name__ == "__main__":
+    main()
