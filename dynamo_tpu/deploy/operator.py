@@ -394,43 +394,71 @@ class Reconciler:
         return removed
 
 
+def cr_key(cr: dict) -> tuple:
+    """(namespace, name) — same-named CRs in different namespaces are
+    distinct graphs."""
+    return (cr["metadata"].get("namespace", "default"), cr["metadata"]["name"])
+
+
+def safe_reconcile(reconciler: Reconciler, cr: dict) -> bool:
+    """Reconcile one CR; log instead of raising (one bad CR or one
+    transient kubectl error must not take a control loop down). False =
+    failed, caller should arrange a retry sooner than the next resync."""
+    try:
+        changes = reconciler.reconcile(cr)
+        if changes["applied"] or changes["deleted"]:
+            logger.info("reconciled %s/%s: %s", *cr_key(cr), changes)
+        return True
+    except Exception:
+        logger.exception("reconcile failed for %s/%s", *cr_key(cr))
+        return False
+
+
+def safe_finalize(reconciler: Reconciler, cr: dict) -> bool:
+    try:
+        reconciler.finalize(cr)
+        return True
+    except Exception:
+        logger.exception("finalize failed for %s/%s", *cr_key(cr))
+        return False
+
+
+def relist_reconcile(
+    reconciler: Reconciler,
+    listed: List[dict],
+    seen: Dict[tuple, dict],
+) -> Dict[tuple, dict]:
+    """One full-state pass shared by the poll and watch loops: reconcile
+    every listed CR, finalize every previously-seen CR that vanished
+    from the listing. Returns the new ``seen`` map."""
+    current = {cr_key(c): c for c in listed}
+    for cr in current.values():
+        safe_reconcile(reconciler, cr)
+    for key, cr in seen.items():
+        if key not in current:
+            logger.info("finalizing deleted CR %s/%s", key[0], key[1])
+            if not safe_finalize(reconciler, cr):
+                # children remain for now; the CR stays absent from every
+                # later listing, so the next pass retries the teardown
+                current[key] = cr  # keep it in seen for the retry
+    return current
+
+
 def control_loop(
     reconciler: Reconciler,
     get_crs,                 # () -> List[dict] current CRs
     interval: float = 10.0,
     stop=None,               # threading.Event-like; None = run forever
 ) -> None:
-    """Poll-based control loop (watch-based callers drive reconcile()
-    directly from events instead)."""
+    """Poll-based control loop (watch-based callers use watch.watch_loop
+    instead; both share relist_reconcile)."""
     seen: Dict[tuple, dict] = {}
     while stop is None or not stop.is_set():
         listed = get_crs()
-        if listed is None:
-            # listing failed — do NOT mistake it for "no CRs" (which would
-            # finalize everything); retry next cycle
-            if stop is not None and stop.wait(interval):
-                break
-            if stop is None:
-                time.sleep(interval)
-            continue
-        # key by (namespace, name): same-named CRs in different namespaces
-        # are distinct graphs
-        current = {
-            (c["metadata"].get("namespace", "default"), c["metadata"]["name"]): c
-            for c in listed
-        }
-        for key, cr in current.items():
-            try:
-                changes = reconciler.reconcile(cr)
-                if changes["applied"] or changes["deleted"]:
-                    logger.info("reconciled %s/%s: %s", key[0], key[1], changes)
-            except Exception:
-                logger.exception("reconcile failed for %s/%s", key[0], key[1])
-        for key, cr in list(seen.items()):
-            if key not in current:
-                logger.info("finalizing deleted CR %s/%s", key[0], key[1])
-                reconciler.finalize(cr)
-        seen = current
+        if listed is not None:
+            seen = relist_reconcile(reconciler, listed, seen)
+        # listed None = listing failed — do NOT mistake it for "no CRs"
+        # (which would finalize everything); retry next cycle
         if stop is not None and stop.wait(interval):
             break
         if stop is None:

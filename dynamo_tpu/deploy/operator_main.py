@@ -1,10 +1,12 @@
-"""Operator binary: poll DynamoTpuGraphDeployment CRs via kubectl and
-reconcile (the in-cluster entrypoint the helm chart deploys).
+"""Operator binary: reconcile DynamoTpuGraphDeployment CRs via kubectl
+(the in-cluster entrypoint the helm chart deploys).
 
-Reference analog: deploy/dynamo/operator cmd/main.go. The poll loop is
-deliberate — kubectl handles auth/watch reconnection complexity, and
-serving graphs change rarely; watch-driven callers can instead feed
-``Reconciler.reconcile`` from their own event source.
+Reference analog: deploy/dynamo/operator cmd/main.go. Two drive modes:
+the default watch loop (kubectl --watch events + relist-on-reconnect,
+matching controller-runtime's informer+resync semantics) and a plain
+poll loop (--poll) for API servers where long watches are awkward.
+``--leader-elect`` arbitrates replicas through a coordination.k8s.io
+Lease, like the Go operator's LeaderElection flag.
 """
 
 from __future__ import annotations
@@ -43,6 +45,16 @@ def main() -> None:
     parser.add_argument("--namespace", default=None,
                         help="watch one namespace (default: all)")
     parser.add_argument("--kubectl", default="kubectl")
+    parser.add_argument("--poll", action="store_true",
+                        help="poll every --interval instead of watching")
+    parser.add_argument("--resync-interval", type=float, default=300.0,
+                        help="watch mode: relist+reconcile at least this "
+                             "often (the watch's request timeout)")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="run only while holding the operator Lease")
+    parser.add_argument("--leader-elect-namespace", default="default")
+    parser.add_argument("--identity", default=None,
+                        help="leader-election identity (default: hostname)")
     parser.add_argument(
         "--api-store-url", default=None,
         help="reconcile deployments registered in the api-store instead "
@@ -52,6 +64,7 @@ def main() -> None:
     args = parser.parse_args()
     setup_logging(logging.INFO)
 
+    poll = args.poll
     if args.api_store_url:
         from .store_source import ApiStoreClient
 
@@ -60,19 +73,41 @@ def main() -> None:
             KubectlClient(args.kubectl), status_writer=store.write_status
         )
         source = store.get_crs
+        poll = True  # the store has no watch API; poll it
         logger.info("operator sourcing CRs from api-store %s every %.0fs",
                     args.api_store_url, args.interval)
     else:
         reconciler = Reconciler(KubectlClient(args.kubectl))
         source = lambda: get_crs(args.kubectl, args.namespace)  # noqa: E731
-        logger.info("operator watching %s.%s every %.0fs",
-                    PLURAL, GROUP, args.interval)
-    control_loop(
-        reconciler,
-        source,
-        interval=args.interval,
-        stop=threading.Event(),  # run until killed; Event never set
-    )
+        logger.info("operator %s %s.%s",
+                    "polling" if poll else "watching", PLURAL, GROUP)
+
+    stop = threading.Event()  # set only by a lost leader lease
+    if poll:
+        drive = lambda: control_loop(  # noqa: E731
+            reconciler, source, interval=args.interval, stop=stop)
+    else:
+        from .watch import KubectlWatchSource, watch_loop
+
+        drive = lambda: watch_loop(  # noqa: E731
+            reconciler, source,
+            KubectlWatchSource(args.kubectl, args.namespace,
+                               resync_interval_s=args.resync_interval),
+            stop=stop)
+
+    if args.leader_elect:
+        import socket
+
+        from .leader import KubectlLeases, LeaderElector
+
+        elector = LeaderElector(
+            KubectlLeases(args.kubectl),
+            identity=args.identity or socket.gethostname(),
+            namespace=args.leader_elect_namespace,
+        )
+        elector.run(stop, drive)
+    else:
+        drive()
 
 
 if __name__ == "__main__":

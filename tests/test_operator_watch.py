@@ -1,0 +1,263 @@
+"""Watch-driven operator loop + Lease leader election.
+
+Reference analog: deploy/dynamo/operator's controller-runtime event
+machinery and cmd/main.go LeaderElection. The loop is driven from
+in-memory event streams and the election from an in-memory CAS store —
+no kubectl in the loop.
+"""
+
+import threading
+
+from dynamo_tpu.deploy.leader import InMemoryLeases, LeaderElector
+from dynamo_tpu.deploy.operator import InMemoryKube, Reconciler
+from dynamo_tpu.deploy.watch import iter_watch_events, watch_loop
+
+
+def _cr(name="g1", namespace="default", services=None, generation=1):
+    return {
+        "metadata": {"name": name, "namespace": namespace,
+                     "generation": generation, "uid": "u-" + name},
+        "spec": {"namespace": "public", "services": services or {}},
+    }
+
+
+def test_iter_watch_events_handles_split_and_concatenated_docs():
+    docs = (
+        '{"type": "ADDED", "object": {"a": 1}}\n'
+        '{\n  "type": "MODIFIED",\n  "object": {"a": 2}\n}'
+        '{"type": "DELETED", "object": {"a": 3}}'
+    )
+    # feed in awkward chunk sizes (split mid-document)
+    chunks = [docs[i:i + 7] for i in range(0, len(docs), 7)]
+    events = list(iter_watch_events(chunks))
+    assert [e["type"] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+    assert [e["object"]["a"] for e in events] == [1, 2, 3]
+
+
+def _run_watch_once(reconciler, listed, streams):
+    """Run watch_loop until the streams are exhausted, then stop it."""
+    stop = threading.Event()
+    it = iter(streams)
+
+    def open_stream():
+        try:
+            return next(it)
+        except StopIteration:
+            stop.set()
+            return []
+
+    watch_loop(reconciler, lambda: listed, open_stream, stop=stop,
+               reconnect_backoff_s=0.0)
+
+
+def test_watch_events_reconcile_and_finalize():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr("g1")
+    # initial relist is empty; the stream delivers ADDED then DELETED
+    _run_watch_once(rec, [], [[
+        {"type": "ADDED", "object": cr},
+        {"type": "DELETED", "object": cr},
+    ]])
+    assert kube.objects == {}  # children created by ADDED, torn down by DELETED
+
+
+def test_watch_added_creates_children():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr("g1")
+    _run_watch_once(rec, [cr], [[{"type": "ADDED", "object": cr}]])
+    assert any("g1-frontend" in k for k in kube.objects)
+    assert any("g1-dynstore" in k for k in kube.objects)
+
+
+def test_relist_finalizes_cr_deleted_while_disconnected():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr("g1")
+    # stream 1: CR appears. stream 2 opens after a gap during which the
+    # CR was deleted — the relist (now empty) must finalize it even
+    # though no DELETED event was ever observed.
+    _run_watch_once(rec, [], [[{"type": "ADDED", "object": cr}], []])
+    assert kube.objects == {}
+
+
+def test_watch_list_failure_is_not_no_crs():
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr("g1")
+    _run_watch_once(rec, [cr], [[{"type": "ADDED", "object": cr}]])
+    assert kube.objects
+    # a failed relist (None) must not finalize anything
+    stop = threading.Event()
+    calls = {"n": 0}
+
+    def failing_list():
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            stop.set()
+        return None
+
+    watch_loop(rec, failing_list, lambda: [], stop=stop,
+               reconnect_backoff_s=0.0)
+    assert kube.objects  # children survived the API outage
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_leader_first_comer_acquires():
+    leases = InMemoryLeases()
+    a = LeaderElector(leases, "a", clock=FakeClock())
+    assert a.try_acquire_or_renew()
+    assert a.try_acquire_or_renew()  # renewal keeps the lease
+
+
+def test_leader_follower_waits_full_ttl_then_takes_over():
+    leases = InMemoryLeases()
+    clock_a, clock_b = FakeClock(), FakeClock()
+    a = LeaderElector(leases, "a", lease_duration_s=15, clock=clock_a)
+    b = LeaderElector(leases, "b", lease_duration_s=15, clock=clock_b)
+    assert a.try_acquire_or_renew()
+    # b just arrived: holder looks alive until a full TTL passes locally
+    assert not b.try_acquire_or_renew()
+    clock_b.t = 10.0
+    assert not b.try_acquire_or_renew()
+    # a keeps renewing → b's observation fingerprint changes → TTL restarts
+    assert a.try_acquire_or_renew()
+    clock_b.t = 20.0
+    assert not b.try_acquire_or_renew()
+    # a goes silent; a full TTL after b's last fingerprint change, b wins
+    clock_b.t = 36.0
+    assert b.try_acquire_or_renew()
+    # the deposed leader's next renewal must fail (CAS conflict)
+    assert not a.try_acquire_or_renew()
+
+
+def test_leader_renew_time_is_valid_microtime_and_increases():
+    # the apiserver rejects a Lease whose spec.renewTime is not an
+    # RFC3339 MicroTime — and observers rely on every renewal producing
+    # a *different* stamp
+    from datetime import datetime
+
+    elector = LeaderElector(InMemoryLeases(), "a", clock=FakeClock())
+    stamps = [elector._spec(0)["renewTime"] for _ in range(3)]
+    for s in stamps:
+        datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
+    assert stamps == sorted(set(stamps))
+
+
+def test_leader_kubectl_read_raises_on_non_notfound_failure():
+    # an API blip must not read as "lease absent" (a create attempt
+    # would then fail and depose a healthy leader); only NotFound may
+    # map to (None, None)
+    import pytest
+
+    from dynamo_tpu.deploy.leader import KubectlLeases
+
+    with pytest.raises(Exception):
+        KubectlLeases(kubectl="false").read("default", "x")
+
+
+def test_leader_cas_conflict_single_winner():
+    leases = InMemoryLeases()
+    electors = [LeaderElector(leases, f"e{i}", clock=FakeClock())
+                for i in range(4)]
+    wins = [e.try_acquire_or_renew() for e in electors]
+    assert sum(wins) == 1
+
+
+def test_watch_failed_reconcile_abandons_stream_for_early_relist():
+    # a transient reconcile failure on a quiet cluster must not wait for
+    # the resync timeout: the loop abandons the stream and the relist
+    # retries within the base delay
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    cr = _cr("g1")
+    fail_once = {"n": 0}
+    orig = rec.reconcile
+
+    def flaky(c):
+        fail_once["n"] += 1
+        if fail_once["n"] == 1:
+            raise RuntimeError("transient apply failure")
+        return orig(c)
+
+    rec.reconcile = flaky
+    # stream 1 delivers ADDED (reconcile fails → stream abandoned); the
+    # relist before stream 2 retries and succeeds
+    _run_watch_once(rec, [cr], [[{"type": "ADDED", "object": cr}], []])
+    assert fail_once["n"] >= 2
+    assert any("g1-frontend" in k for k in kube.objects)
+
+
+class FlakyLeases(InMemoryLeases):
+    """Raises on demand to model an unreachable API."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+
+    def read(self, namespace, name):
+        if self.fail:
+            raise RuntimeError("apiserver unreachable")
+        return super().read(namespace, name)
+
+
+def test_leader_transient_api_blip_does_not_depose():
+    clock = FakeClock()
+    leases = FlakyLeases()
+    elector = LeaderElector(leases, "a", lease_duration_s=15,
+                            renew_deadline_s=10, clock=clock)
+    assert elector.try_acquire_or_renew()
+    stop = threading.Event()
+    # one failed renewal inside the deadline: retry, not step-down
+    leases.fail = True
+    import pytest
+    with pytest.raises(RuntimeError):
+        elector.try_acquire_or_renew()
+    leases.fail = False
+    assert elector.try_acquire_or_renew()
+    assert not stop.is_set()
+
+
+def test_leader_steps_down_past_renew_deadline():
+    # real clock: renewal keeps failing past the deadline → step down
+    leases = FlakyLeases()
+    elector = LeaderElector(leases, "a", lease_duration_s=0.3,
+                            renew_interval_s=0.01, renew_deadline_s=0.05)
+    assert elector.try_acquire_or_renew()
+    leases.fail = True
+    stop = threading.Event()
+    t = threading.Thread(target=elector._renew_until_lost, args=(stop,),
+                         daemon=True)
+    t.start()
+    assert stop.wait(timeout=5.0), "leader failed to step down"
+    t.join(timeout=2.0)
+
+
+def test_leader_run_leads_then_steps_down_when_lease_lost():
+    leases = InMemoryLeases()
+    clock = FakeClock()
+    elector = LeaderElector(leases, "a", renew_interval_s=0.01, clock=clock)
+    led = threading.Event()
+    stop = threading.Event()
+
+    def lead():
+        led.set()
+        # usurp the lease out from under the leader; its renewer must
+        # notice the CAS conflict and set stop
+        other = LeaderElector(leases, "b", clock=clock)
+        spec, version = leases.read("default", "dynamo-tpu-operator")
+        assert leases.write("default", "dynamo-tpu-operator",
+                            other._spec(1), version)
+        assert stop.wait(timeout=5.0)
+
+    elector.run(stop, lead)
+    assert led.is_set()
+    assert stop.is_set()
