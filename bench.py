@@ -11,14 +11,18 @@ the reference's engines (vLLM-class) typically sit at 0.5-0.7 of roofline
 on their hardware (no absolute numbers are published in the reference —
 BASELINE.md).
 
-Attempt order: the known-safe XLA path first (bank a number), then a
+Attempt order: the known-safe per-token XLA path first (bank a number),
+then the engine's fused multi-step decode on the same XLA path
+(multi_step_decode: 8 steps per dispatch via lax.scan — amortizes the
+fixed dispatch overhead that dominates small-model decode), then a
 tiny-shape subprocess probe of the Pallas decode kernel, then — only if
-the probe passed — the Pallas attempt with the remaining budget. The
-best valid number wins. A hung Mosaic compile can wedge a host's shared
-compile service (round-2 lesson), so nothing Pallas compiles before the
-XLA number is recorded, and every attempt runs in a child with a hard
-timeout. Budget knobs: BENCH_TOTAL_BUDGET_S (default 1380),
-BENCH_TIMEOUT_S (per-XLA-attempt, default 600), BENCH_XLA_ONLY=1.
+the probe passed — the Pallas burst attempt with the remaining budget.
+The best valid number wins. A hung Mosaic compile can wedge a host's
+shared compile service (round-2 lesson), so nothing Pallas compiles
+before the XLA number is recorded, and every attempt runs in a child
+with a hard timeout. Budget knobs: BENCH_TOTAL_BUDGET_S (default 1380),
+BENCH_TIMEOUT_S (per-XLA-attempt, default 600), BENCH_XLA_ONLY=1,
+BENCH_SINGLE_STEP_ONLY=1.
 """
 
 from __future__ import annotations
@@ -32,7 +36,7 @@ V5E_HBM_GBPS = 819e9
 METRIC = "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512"
 
 
-def run_once(attention_impl: str) -> dict:
+def run_once(attention_impl: str, burst: int = 1) -> dict:
     import os
 
     import jax
@@ -82,22 +86,43 @@ def run_once(attention_impl: str) -> dict:
     slot_mapping = (block_tables[:, ctx // bs] * bs + ctx % bs)[:, None]
     context_lens = jnp.full((b,), ctx + 1, jnp.int32)
 
+    if burst > 1:
+        # the engine's multi_step_decode path: K steps fused into one
+        # dispatch via lax.scan (steady-state position, same per-token
+        # work) — measures how much of the per-dispatch overhead the
+        # fused program removes
+        def decode_burst(params, k_cache, v_cache, tok0):
+            def one(carry, _):
+                k_cache, v_cache, toks = carry
+                nt, k_cache, v_cache = decode_step(
+                    params, k_cache, v_cache, toks[:, None], positions,
+                    slot_mapping, context_lens,
+                )
+                return (k_cache, v_cache, nt), None
+            (k_cache, v_cache, nt), _ = jax.lax.scan(
+                one, (k_cache, v_cache, tok0), None, length=burst
+            )
+            return nt, k_cache, v_cache
+        step = jax.jit(decode_burst, donate_argnums=(1, 2))
+        dispatch = lambda out, k, v: step(params, k, v, out)  # noqa: E731
+    else:
+        dispatch = lambda out, k, v: step(  # noqa: E731
+            params, k, v, out[:, None], positions, slot_mapping, context_lens
+        )
+
     # warmup / compile
-    out, k_cache, v_cache = step(
-        params, k_cache, v_cache, tokens, positions, slot_mapping, context_lens
-    )
+    out = jnp.zeros((b,), jnp.int32) if burst > 1 else tokens[:, 0]
+    out, k_cache, v_cache = dispatch(out, k_cache, v_cache)
     out.block_until_ready()
 
-    n_steps = 4 if smoke else 64
+    n_steps = (4 * burst) if smoke else 64
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        out, k_cache, v_cache = step(
-            params, k_cache, v_cache, out[:, None], positions, slot_mapping, context_lens
-        )
+    for _ in range(n_steps // burst):
+        out, k_cache, v_cache = dispatch(out, k_cache, v_cache)
     out.block_until_ready()
     dt = time.perf_counter() - t0
 
-    toks_per_sec = b * n_steps / dt
+    toks_per_sec = b * (n_steps // burst) * burst / dt
 
     # HBM roofline: per decode step, stream weights once + per-seq KV(ctx)
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
@@ -116,7 +141,7 @@ def run_once(attention_impl: str) -> dict:
     }
 
 
-def _run_impl_subprocess(impl: str, timeout_s: float):
+def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1):
     """Run one bench attempt in a child process with a hard timeout.
 
     A Mosaic compile can (rarely) hang rather than fail; an in-process
@@ -128,7 +153,7 @@ def _run_impl_subprocess(impl: str, timeout_s: float):
 
     code = (
         "import json; from bench import run_once; "
-        f"print('BENCH_RESULT ' + json.dumps(run_once({impl!r})))"
+        f"print('BENCH_RESULT ' + json.dumps(run_once({impl!r}, {burst})))"
     )
     try:
         proc = subprocess.run(
@@ -168,6 +193,17 @@ def main() -> None:
     result = _run_impl_subprocess("xla", timeout_s=xla_timeout)
     best = result
 
+    # the engine's fused multi-step decode (multi_step_decode=8): same
+    # XLA-safe program shape, K dispatches' overhead amortized into one
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 360 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        burst = _run_impl_subprocess(
+            "xla", timeout_s=min(300.0, remaining - 240), burst=8
+        )
+        if burst is not None and (best is None
+                                  or burst["value"] > best["value"]):
+            best = burst
+
     remaining = total_budget - (_time.monotonic() - t0)
     if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):
         import sys
@@ -182,7 +218,18 @@ def main() -> None:
         # ModelRunner.warmup instead
         if probe_kernel("decode", timeout_s=min(180.0, remaining - 120)):
             remaining = total_budget - (_time.monotonic() - t0)
-            pallas = _run_impl_subprocess("pallas", timeout_s=max(remaining, 60))
+            pallas = _run_impl_subprocess(
+                "pallas", timeout_s=max(min(remaining - 120, 480), 60),
+                burst=8,
+            )
+            if pallas is None:
+                # the probe validates the bare kernel, not the scanned
+                # program — if the burst wrapper is what failed, the
+                # single-step Pallas attempt is still worth banking
+                remaining = total_budget - (_time.monotonic() - t0)
+                pallas = _run_impl_subprocess(
+                    "pallas", timeout_s=max(remaining, 60)
+                )
             if pallas is not None and (
                 best is None or pallas["value"] > best["value"]
             ):

@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="host-RAM KV offload tier capacity in blocks (0 = off)")
+    p.add_argument("--multi-step-decode", type=int, default=1,
+                   help="decode steps fused per device dispatch (tokens "
+                        "stream in bursts of K; 1 = per-token)")
     p.add_argument("--num-kv-blocks", type=int, default=2048,
                    help="HBM paged-cache capacity in blocks")
     p.add_argument("--allow-random-weights", action="store_true",

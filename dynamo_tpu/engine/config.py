@@ -176,6 +176,16 @@ class EngineConfig:
     # prompt bursts; batching amortizes the weight stream and per-step
     # overhead across rows. 1 restores strictly-serial behavior.
     max_prefill_batch: int = 4
+    # decode steps fused into ONE device dispatch (lax.scan inside the
+    # compiled program). Each dispatch pays fixed host+launch overhead
+    # (scheduler bookkeeping, transfer latency, program launch); at small
+    # per-step compute that overhead dominates, and fusing K steps
+    # amortizes it K-fold — the TPU-native analog of the multi-step
+    # scheduling the reference's engines use. Tokens stream in bursts of
+    # K (ITL becomes bursty), so it only engages when no prefill work is
+    # waiting, and 1 (default) keeps strict per-token dispatch. Sampling
+    # is bit-identical either way (same per-row PRNG fold-in counters).
+    multi_step_decode: int = 1
     enable_prefix_caching: bool = True
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
@@ -191,6 +201,9 @@ class EngineConfig:
         self.max_prefill_batch = max(
             1, min(self.max_prefill_batch, self.PREFILL_ROW_BUCKETS[-1])
         )
+        # a burst must fit comfortably inside one sequence's block budget;
+        # 64 already amortizes dispatch overhead past the point of returns
+        self.multi_step_decode = max(1, min(self.multi_step_decode, 64))
 
     @property
     def blocks_per_seq(self) -> int:

@@ -64,6 +64,44 @@ def param_specs(params) -> Dict:
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, N, bs, KVH, D] — KV heads over tp
 
 
+def _sample_and_logprobs(cfg, last_logits, samp, counts, seen, bias,
+                         sample_slots, commit, want_top):
+    """The per-token tail shared by the single step and every scan
+    iteration of the fused burst: penalty-aware sampling, the sampled
+    token's logprob, gated top-K alternatives, and the committed-count
+    update. One implementation ⇒ the burst's bit-identical-stream
+    guarantee can't drift from the single-step program."""
+    from .sampling import top_k_width
+
+    b = last_logits.shape[0]
+    row_counts = counts[sample_slots]
+    row_seen = seen[sample_slots]
+    row_bias = bias[sample_slots]
+    next_tokens = sample(last_logits, samp, row_counts, row_seen,
+                         bias=row_bias)
+    logp = jax.nn.log_softmax(
+        (last_logits + row_bias).astype(jnp.float32), axis=-1
+    )
+    lps = jnp.take_along_axis(logp, next_tokens[:, None], axis=-1)[:, 0]
+    # top-K alternatives only when some active request asked (OpenAI
+    # top_logprobs): the [B, V] top_k sort is fixed hot-path cost
+    # otherwise. lax.cond keeps one compiled program either way.
+    kw = top_k_width(cfg.vocab_size)
+    top_vals, top_ids = jax.lax.cond(
+        want_top,
+        lambda lp_: top_logprobs_for(last_logits, lp_),
+        lambda lp_: (jnp.zeros((b, kw), jnp.float32),
+                     jnp.zeros((b, kw), jnp.int32)),
+        logp,
+    )
+    # count the sampled token as generated for its slot — but only for
+    # rows whose sample the scheduler will keep (``commit``)
+    counts = counts.at[sample_slots, next_tokens].add(
+        commit.astype(jnp.int32)
+    )
+    return next_tokens, lps, top_vals, top_ids, counts
+
+
 class ModelRunner:
     """Owns params + cache on device and the compiled step programs."""
 
@@ -163,21 +201,17 @@ class ModelRunner:
         self._reinit_device_state()
 
         self._build_step()
+        self._build_burst()
         self._build_block_ops()
         self._build_sample_row()
 
     # ---------- the unified step program ----------
 
-    def _build_step(self):
+    def _make_forward(self):
+        """The model-forward closure both compiled programs trace."""
         cfg = self.config.model
         mesh = self.mesh
         arch = self.arch
-        batch_spec = NamedSharding(mesh, P("dp"))
-        batch2_spec = NamedSharding(mesh, P("dp", None))
-        repl = NamedSharding(mesh, P())
-
-        from .sampling import top_k_width
-
         if self.config.pp_size > 1:
             from ..parallel.pipeline import pipeline_forward
 
@@ -192,6 +226,15 @@ class ModelRunner:
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
                     mesh=mesh,
                 )
+        return forward
+
+    def _build_step(self):
+        cfg = self.config.model
+        mesh = self.mesh
+        batch_spec = NamedSharding(mesh, P("dp"))
+        batch2_spec = NamedSharding(mesh, P("dp", None))
+        repl = NamedSharding(mesh, P())
+        forward = self._make_forward()
 
         def step(params, k_cache, v_cache, counts, seen, bias, tokens,
                  positions, block_tables, slot_mapping, context_lens,
@@ -217,33 +260,9 @@ class ModelRunner:
                 logits,
             )
             last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
-            row_counts = counts[sample_slots]              # [b, V]
-            row_seen = seen[sample_slots]
-            row_bias = bias[sample_slots]
-            next_tokens = sample(
-                last_logits, samp, row_counts, row_seen, bias=row_bias
-            )
-            logp = jax.nn.log_softmax(
-                (last_logits + row_bias).astype(jnp.float32), axis=-1
-            )
-            lps = jnp.take_along_axis(logp, next_tokens[:, None], axis=-1)[:, 0]
-            # top-K alternatives only when some active request asked
-            # (OpenAI top_logprobs): the [B, V] top_k sort is fixed
-            # decode-hot-path cost otherwise. lax.cond keeps one compiled
-            # program either way — the flag is a traced scalar.
-            kw = top_k_width(cfg.vocab_size)
-            top_vals, top_ids = jax.lax.cond(
-                want_top,
-                lambda lp: top_logprobs_for(last_logits, lp),
-                lambda lp: (jnp.zeros((b, kw), jnp.float32),
-                            jnp.zeros((b, kw), jnp.int32)),
-                logp,
-            )
-            # count the sampled token as generated for its slot — but only
-            # for rows whose sample the scheduler will keep (``commit``;
-            # intermediate prefill-chunk samples are discarded)
-            counts = counts.at[sample_slots, next_tokens].add(
-                commit.astype(jnp.int32)
+            next_tokens, lps, top_vals, top_ids, counts = _sample_and_logprobs(
+                cfg, last_logits, samp, counts, seen, bias, sample_slots,
+                commit, want_top,
             )
             return (next_tokens, lps, top_vals, top_ids, prompt_lps,
                     k_cache, v_cache, counts, seen, bias)
@@ -283,6 +302,138 @@ class ModelRunner:
                            self.state_sharding, self.state_sharding,
                            self.state_sharding),
         )
+
+    def _build_burst(self):
+        """K fused decode steps per dispatch (config.multi_step_decode).
+
+        A ``lax.scan`` chains K single-token decode steps inside ONE
+        compiled program: each iteration feeds the sampled token back as
+        the next input and derives its KV slot from the block table on
+        device, so the host pays scheduler bookkeeping + launch latency
+        once per K tokens instead of per token. Sampling math and PRNG
+        fold-in (base key + ``counters + step``) are identical to the
+        single-step program — the token stream is bit-equal for any K.
+        The reference reaches the same amortization through its engines'
+        multi-step scheduling; this is the one-SPMD-program version.
+        """
+        K = self.config.multi_step_decode
+        self._burst = None
+        if K <= 1:
+            return
+        cfg = self.config.model
+        mesh = self.mesh
+        bs = self.config.kv_block_size
+        batch_spec = NamedSharding(mesh, P("dp"))
+        batch2_spec = NamedSharding(mesh, P("dp", None))
+        repl = NamedSharding(mesh, P())
+        steps_spec = NamedSharding(mesh, P(None, "dp"))
+        steps3_spec = NamedSharding(mesh, P(None, "dp", None))
+        forward = self._make_forward()
+
+        import dataclasses as _dc
+
+        def burst(params, k_cache, v_cache, counts, seen, bias, tokens0,
+                  positions0, block_tables, samp, sample_slots, commit,
+                  want_top):
+            b = tokens0.shape[0]
+            rows = jnp.arange(b)
+
+            def one(carry, step_i):
+                k_cache, v_cache, counts, toks, pos = carry
+                # the slot for each row's pending token, straight from the
+                # block table (the host precomputes this in the single-step
+                # path); inactive rows write nowhere
+                slot = block_tables[rows, pos // bs] * bs + pos % bs
+                slot = jnp.where(commit, slot, -1)
+                logits, (k_cache, v_cache) = forward(
+                    params, (k_cache, v_cache), toks[:, None], pos[:, None],
+                    block_tables, slot[:, None], pos + 1,
+                )
+                samp_i = _dc.replace(samp, counters=samp.counters + step_i)
+                nt, lp, tv, ti, counts = _sample_and_logprobs(
+                    cfg, logits[:, 0], samp_i, counts, seen, bias,
+                    sample_slots, commit, want_top,
+                )
+                return (k_cache, v_cache, counts, nt, pos + 1), (nt, lp, tv, ti)
+
+            init = (k_cache, v_cache, counts, tokens0, positions0)
+            (k_cache, v_cache, counts, _, _), (toks, lps, tvs, tis) = (
+                jax.lax.scan(one, init, jnp.arange(K))
+            )
+            return (toks, lps, tvs, tis, k_cache, v_cache, counts, seen,
+                    bias)
+
+        samp_spec = SamplingParams(
+            temperature=batch_spec, top_k=batch_spec, top_p=batch_spec,
+            min_p=batch_spec, presence_penalty=batch_spec,
+            frequency_penalty=batch_spec, repetition_penalty=batch_spec,
+            keys=batch2_spec, counters=batch_spec,
+        )
+        self._burst = jax.jit(
+            burst,
+            donate_argnums=(1, 2, 3, 4, 5),
+            in_shardings=(
+                self.param_shardings,
+                self.cache_sharding, self.cache_sharding,
+                self.state_sharding, self.state_sharding, self.state_sharding,
+                batch_spec,                  # tokens0 [B]
+                batch_spec,                  # positions0 [B]
+                batch2_spec,                 # block_tables [B, W]
+                samp_spec,
+                batch_spec,                  # sample_slots
+                batch_spec,                  # commit
+                repl,                        # want_top
+            ),
+            out_shardings=(steps_spec, steps_spec, steps3_spec, steps3_spec,
+                           self.cache_sharding, self.cache_sharding,
+                           self.state_sharding, self.state_sharding,
+                           self.state_sharding),
+        )
+
+    def decode_burst(
+        self,
+        tokens0: np.ndarray,       # [B] pending token per row
+        positions0: np.ndarray,    # [B] its position
+        block_tables: np.ndarray,  # [B, W] covering positions0 + K
+        temperature: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        *,
+        min_p: np.ndarray,
+        presence_penalty: np.ndarray,
+        frequency_penalty: np.ndarray,
+        repetition_penalty: np.ndarray,
+        seed_keys: np.ndarray,
+        counters: np.ndarray,
+        commit: np.ndarray,        # [B] row is live (inactive rows inert)
+        want_top: bool = False,
+    ):
+        """Run the K-step fused decode; returns [K, B]-leading arrays."""
+        samp = SamplingParams(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+            min_p=jnp.asarray(min_p, jnp.float32),
+            presence_penalty=jnp.asarray(presence_penalty, jnp.float32),
+            frequency_penalty=jnp.asarray(frequency_penalty, jnp.float32),
+            repetition_penalty=jnp.asarray(repetition_penalty, jnp.float32),
+            keys=jnp.asarray(seed_keys, jnp.uint32),
+            counters=jnp.asarray(counters, jnp.int32),
+        )
+        b = tokens0.shape[0]
+        (toks, lps, tvs, tis, k, v, counts, seen, bias) = self._burst(
+            self.params, self.kv_cache[0], self.kv_cache[1],
+            self.sample_state[0], self.sample_state[1], self.sample_state[2],
+            jnp.asarray(tokens0, jnp.int32), jnp.asarray(positions0, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            samp,
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.asarray(commit, jnp.bool_),
+            jnp.asarray(bool(want_top), jnp.bool_),
+        )
+        self.kv_cache = (k, v)
+        self.sample_state = (counts, seen, bias)
+        return toks, lps, tvs, tis
 
     def step(
         self,
@@ -590,6 +741,7 @@ class ModelRunner:
                 )
                 cfg.attention_impl = "xla"
                 self._build_step()
+                self._build_burst()
         try:
             self._warmup_once(decode_batch)
         except Exception:
@@ -601,6 +753,7 @@ class ModelRunner:
             )
             cfg.attention_impl = "xla"
             self._build_step()
+            self._build_burst()
             self._reinit_device_state()
             self._warmup_once(decode_batch)
 
@@ -643,6 +796,21 @@ class ModelRunner:
                 np.ones(b, np.float32),
                 jax.random.PRNGKey(0),
             )
+        # the fused multi-step decode program over the same width ladder
+        # (inert rows: commit all-False writes nothing and samples noise)
+        if self._burst is not None:
+            z1 = np.zeros(b, np.int32)
+            for w in self.config.kv_width_buckets():
+                self.decode_burst(
+                    z1, z1, np.zeros((b, w), np.int32),
+                    np.zeros(b, np.float32), z1, np.ones(b, np.float32),
+                    min_p=np.zeros(b, np.float32),
+                    presence_penalty=np.zeros(b, np.float32),
+                    frequency_penalty=np.zeros(b, np.float32),
+                    repetition_penalty=np.ones(b, np.float32),
+                    seed_keys=np.zeros((b, 2), np.uint32), counters=z1,
+                    commit=np.zeros(b, bool), want_top=False,
+                )
         # prefill-shaped programs (largest bucket, full table width) over
         # the batched-prefill row ladder, so the flash-prefill kernel's
         # compiles also happen — and fail — here rather than on the first

@@ -353,13 +353,20 @@ class Scheduler:
                 await self._prefill_chunk(loop, list(self.prefilling))
                 progressed = True
 
-            # decode one token for every active slot
+            # decode every active slot: one token, or a fused K-step
+            # burst (multi_step_decode) when nothing is waiting on the
+            # runner — prefill work pins K to 1 so chunked-prefill
+            # interleaving (bounded TTFT) is never traded for throughput
             active = [
                 s for s in self.slots
                 if s is not None and s not in self.prefilling
             ]
             if active:
-                await self._decode(loop, active)
+                k_steps = self.config.multi_step_decode
+                if (k_steps > 1 and (self.prefilling or self.waiting
+                                     or self.pending_remote)):
+                    k_steps = 1
+                await self._decode(loop, active, k_steps)
                 progressed = True
 
             if not progressed:
@@ -701,14 +708,30 @@ class Scheduler:
             if er.finish is not None:
                 self._finish(er, er.finish, emit=False)
 
-    async def _decode(self, loop, active: List[EngineRequest]) -> None:
+    async def _decode(self, loop, active: List[EngineRequest],
+                      k_steps: int = 1) -> None:
         cfg = self.config
         b = cfg.max_batch_size
         bs = cfg.kv_block_size
 
-        # make sure each active sequence has a block for its next position
+        # a K-step burst writes K tokens of KV per row before the host
+        # sees any of them, so every row needs blocks for all K positions
+        # up front, and no row may run past the block-table/model-len
+        # horizon mid-burst (such rows finish within one burst anyway —
+        # fall back to per-token stepping for everyone this pass)
+        if k_steps > 1 and any(
+            er.context_len + k_steps + 1 > cfg.max_model_len for er in active
+        ):
+            k_steps = 1
+
+        # make sure each active sequence has blocks for its next position
+        # (all k_steps of them under a burst)
         for er in list(active):
-            if not self._ensure_block_for(er, er.context_len):
+            ok = all(
+                self._ensure_block_for(er, er.context_len + j)
+                for j in range(k_steps)
+            )
+            if not ok:
                 # out of memory: evict the youngest request back to waiting
                 # (simple preemption — recompute later)
                 logger.warning("KV OOM: preempting %s", er.request_id)
@@ -758,37 +781,61 @@ class Scheduler:
             ctrs[i] = er.generated
             commit[i] = True
 
-        next_tokens, lps, top_vals, top_ids, _ = self.runner.step(
-            tokens, positions, btab, slot_map, ctx_lens, last_idx,
-            temp, top_k, top_p,
-            min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
-            repetition_penalty=rep, seed_keys=keys, counters=ctrs,
-            sample_slots=np.arange(b, dtype=np.int32), commit=commit,
-            # the [B, V] top-k sort only runs when some active request
-            # asked for alternatives (ADVICE r2: fixed decode-path cost)
-            want_top=any(er.logprobs_n > 0 for er in active),
-        )
+        # the [B, V] top-k sort only runs when some active request
+        # asked for alternatives (ADVICE r2: fixed decode-path cost)
+        want_top = any(er.logprobs_n > 0 for er in active)
+
+        if k_steps > 1:
+            next_tokens, lps, top_vals, top_ids = self.runner.decode_burst(
+                tokens[:, 0], positions[:, 0], btab,
+                temp, top_k, top_p,
+                min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
+                repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+                commit=commit, want_top=want_top,
+            )
+        else:
+            next_tokens, lps, top_vals, top_ids, _ = self.runner.step(
+                tokens, positions, btab, slot_map, ctx_lens, last_idx,
+                temp, top_k, top_p,
+                min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
+                repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+                sample_slots=np.arange(b, dtype=np.int32), commit=commit,
+                want_top=want_top,
+            )
         toks, lpn, tv, ti = await loop.run_in_executor(
             None, lambda: (np.asarray(next_tokens), np.asarray(lps),
                            np.asarray(top_vals), np.asarray(top_ids))
         )
         self.steps += 1
+        if k_steps == 1:
+            # [B] → [1, B] so the emit loop below is one shape
+            toks, lpn = toks[None], lpn[None]
+            tv, ti = tv[None], ti[None]
 
-        for er in active:
-            if er.finish is not None:
-                continue
-            token = int(toks[er.slot])
-            # the pending token's KV is now written
-            er.seq.push(er.pending_token)
-            er.context_len += 1
-            self._register_completed_blocks(er)
-            er.pending_token = token
-            er.generated += 1
-            er.finish = self._check_finish(er, token)
-            self._emit(er, token, float(lpn[er.slot]) if er.want_logprobs else None,
-                       self._top_row(er, tv, ti, er.slot))
-            if er.finish is not None:
-                self._finish(er, er.finish, emit=False)
+        # emit in step order; a request that finishes at step j has its
+        # trailing burst tokens (sampled ahead on device) discarded —
+        # their KV went into this request's own still-unregistered or
+        # over-allocated blocks, which are freed with the request, so
+        # nothing another sequence can observe was touched
+        for j in range(k_steps):
+            for er in active:
+                if er.finish is not None:
+                    continue
+                token = int(toks[j, er.slot])
+                # the pending token's KV is now written
+                er.seq.push(er.pending_token)
+                er.context_len += 1
+                self._register_completed_blocks(er)
+                er.pending_token = token
+                er.generated += 1
+                er.finish = self._check_finish(er, token)
+                self._emit(
+                    er, token,
+                    float(lpn[j, er.slot]) if er.want_logprobs else None,
+                    self._top_row(er, tv[j], ti[j], er.slot),
+                )
+                if er.finish is not None:
+                    self._finish(er, er.finish, emit=False)
 
     def _preempt(self, er: EngineRequest) -> None:
         """Return a request to the waiting queue, releasing its blocks.

@@ -41,6 +41,7 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
         pp_size=getattr(flags, "pipeline_parallel_size", 1),
         host_kv_blocks=getattr(flags, "host_kv_blocks", 0) or 0,
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
+        multi_step_decode=getattr(flags, "multi_step_decode", 1) or 1,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
     )
 

@@ -1,0 +1,191 @@
+"""Fused multi-step decode (EngineConfig.multi_step_decode).
+
+The K-step burst must be invisible in outputs: the same prompts, seeds,
+and sampling knobs produce bit-identical token streams whether the
+engine dispatches per token (K=1) or per burst (K>1) — the burst fuses
+dispatch, not semantics. Reference analog: the multi-step scheduling of
+the engines behind examples/llm/components/worker.py, which likewise
+trades ITL granularity for dispatch amortization.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.serving import JaxServingEngine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+from fixtures import make_model_dir
+
+TINY = dict(
+    vocab_size=512,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=256,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    d = make_model_dir(tmp_path_factory.mktemp("msmodel"), name="tiny-ms")
+    cfg = LlamaConfig(**TINY, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).save_pretrained(d, safe_serialization=True)
+    with open(os.path.join(d, "config.json")) as f:
+        c = json.load(f)
+    c["eos_token_id"] = 2
+    c["bos_token_id"] = 1
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(c, f)
+    return d
+
+
+def _config(model_dir, multi_step, **kw):
+    cfg = ModelConfig.from_model_dir(model_dir)
+    return EngineConfig(
+        model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=96, dtype="float32", multi_step_decode=multi_step,
+        **kw,
+    )
+
+
+async def _collect(engine, token_ids, sampling, max_tokens=24,
+                   ignore_eos=True, stop_hidden=None):
+    req = PreprocessedRequest(
+        token_ids=list(token_ids),
+        stop_conditions=StopConditions(
+            max_tokens=max_tokens, ignore_eos=ignore_eos,
+            stop_token_ids_hidden=stop_hidden,
+        ),
+        sampling_options=sampling,
+    )
+    toks, finish = [], None
+    async for out in engine.generate(Context(req)):
+        toks.extend(out["token_ids"])
+        if out.get("finish_reason"):
+            finish = out["finish_reason"]
+    return toks, finish
+
+
+def _runs(model_dir, multi_step):
+    async def go():
+        mdc = ModelDeploymentCard.from_local_path(model_dir)
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=_config(model_dir, multi_step), warmup=False
+        )
+        results = []
+        # greedy; seeded sampling; penalties + repetition; concurrent pair
+        results.append(await _collect(
+            engine, [1, 17, 43, 99, 7], SamplingOptions(temperature=0.0)))
+        results.append(await _collect(
+            engine, [1, 5, 9, 13], SamplingOptions(temperature=0.8, seed=7)))
+        results.append(await _collect(
+            engine, [1, 100, 200, 300],
+            SamplingOptions(temperature=0.7, seed=3, top_k=40,
+                            frequency_penalty=0.5, repetition_penalty=1.2)))
+        pair = await asyncio.gather(
+            _collect(engine, [1, 42, 42], SamplingOptions(temperature=0.0)),
+            _collect(engine, [1, 7, 7, 7, 7],
+                     SamplingOptions(temperature=0.9, seed=11)),
+        )
+        results.extend(pair)
+        await engine.close()
+        return results
+
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+
+
+def test_burst_streams_bit_equal_to_single_step(model_dir):
+    assert _runs(model_dir, 1) == _runs(model_dir, 4)
+
+
+@pytest.mark.asyncio
+async def test_burst_actually_engages(model_dir):
+    # guard against the equivalence tests passing vacuously: K=4 must
+    # produce ~4x fewer device dispatches for the same token count
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4), warmup=False)
+    toks, _ = await _collect(engine, [1, 5, 9],
+                             SamplingOptions(temperature=0.0), max_tokens=16)
+    steps = engine.scheduler.steps
+    await engine.close()
+    assert len(toks) == 16
+    # 1 prefill dispatch + ceil(16/4) bursts, plus slack for scheduling
+    assert steps <= 8, f"burst never engaged ({steps} dispatches)"
+
+
+@pytest.mark.asyncio
+async def test_burst_stop_mid_burst_trims_and_finishes(model_dir):
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    single = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 1), warmup=False)
+    # find greedy continuation, then declare its 2nd token a hidden stop:
+    # under K=4 the stop lands mid-burst and the tail must be trimmed
+    toks, _ = await _collect(single, [1, 5, 9],
+                             SamplingOptions(temperature=0.0), max_tokens=6)
+    stop_tok = toks[1]
+    want, want_finish = await _collect(
+        single, [1, 5, 9], SamplingOptions(temperature=0.0), max_tokens=6,
+        stop_hidden=[stop_tok])
+    await single.close()
+    assert want_finish == "stop" and len(want) < len(toks)
+
+    burst = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4), warmup=False)
+    got, finish = await _collect(
+        burst, [1, 5, 9], SamplingOptions(temperature=0.0), max_tokens=6,
+        stop_hidden=[stop_tok])
+    await burst.close()
+    assert (got, finish) == (want, want_finish)
+
+
+@pytest.mark.asyncio
+async def test_burst_near_model_len_falls_back_and_finishes(model_dir):
+    # a context within K of max_model_len forces per-token stepping; the
+    # request still ends with reason length at the same point
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    cfg = _config(model_dir, 8)
+    cfg.max_model_len = 32
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=cfg, warmup=False)
+    toks, finish = await _collect(
+        engine, list(range(1, 21)), SamplingOptions(temperature=0.0),
+        max_tokens=64)
+    await engine.close()
+    assert finish == "length"
+    assert len(toks) == 32 - 20  # runs right up to max_model_len
+
+
+@pytest.mark.asyncio
+async def test_burst_with_prefix_cache_reuse(model_dir):
+    # burst-written blocks enter the prefix cache; a rerun must hit the
+    # cache and still produce the identical stream
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4, enable_prefix_caching=True),
+        warmup=False)
+    prompt = [1] + list(range(50, 50 + 23))
+    first, _ = await _collect(engine, prompt, SamplingOptions(temperature=0.0))
+    second, _ = await _collect(engine, prompt, SamplingOptions(temperature=0.0))
+    m = engine.metrics()
+    await engine.close()
+    assert first == second
+    assert m["gpu_prefix_cache_hit_rate"] > 0.0
