@@ -212,11 +212,12 @@ def test_pp_engine_serves_request_end_to_end():
     params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
     mdc = ModelDeploymentCard(display_name="t", slug="t", model_path=None)
 
-    async def serve(pp):
+    async def serve(pp, multi_step=1):
         econfig = EngineConfig(
             model=CFG, max_batch_size=4, max_model_len=64, kv_block_size=8,
             num_kv_blocks=64, dtype="float32", pp_size=pp,
             prefill_buckets=[16], allow_random_weights=True,
+            multi_step_decode=multi_step,
         )
         engine = await JaxServingEngine.create(
             mdc, engine_config=econfig, params=params, warmup=False
@@ -235,6 +236,10 @@ def test_pp_engine_serves_request_end_to_end():
     ref = asyncio.run(serve(1))
     got = asyncio.run(serve(2))
     assert got == ref and len(got) == 8
+    # the fused decode burst composes with the staged pp trunk: the scan
+    # body traces pipeline_forward per step, stream unchanged
+    burst = asyncio.run(serve(2, multi_step=4))
+    assert burst == ref
 
 
 def test_pp_rejects_unsupported_configs():
