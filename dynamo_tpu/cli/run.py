@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--multi-step-decode", type=int, default=1,
                    help="decode steps fused per device dispatch (tokens "
                         "stream in bursts of K; 1 = per-token)")
+    p.add_argument("--quantization", choices=["int8"], default=None,
+                   help="serving-time weight-only quantization (halves "
+                        "the decode weight stream; llama-family)")
     p.add_argument("--num-kv-blocks", type=int, default=2048,
                    help="HBM paged-cache capacity in blocks")
     p.add_argument("--allow-random-weights", action="store_true",

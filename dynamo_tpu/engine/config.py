@@ -52,6 +52,10 @@ class ModelConfig:
     # attention implementation: "auto" (pallas on TPU, xla elsewhere),
     # "xla", or "pallas"
     attention_impl: str = "auto"
+    # serving-time weight quantization: None (checkpoint dtype) or "int8"
+    # (per-out-channel weight-only; halves the decode weight stream —
+    # models/quant.py). Llama-family trunks only for now.
+    quantization: Optional[str] = None
     # MLA (DeepSeek-class); kv_lora_rank > 0 enables MLA attention
     kv_lora_rank: int = 0
     q_lora_rank: int = 0

@@ -150,6 +150,22 @@ class ModelRunner:
             raise ValueError(
                 f"num_experts {cfg.num_experts} not divisible by ep {config.ep_size}"
             )
+        # config-only quantization checks, BEFORE any checkpoint I/O: a
+        # 70B load must not stream for minutes just to hit a config error
+        if cfg.quantization:
+            if cfg.quantization != "int8":
+                raise ValueError(
+                    f"unknown quantization {cfg.quantization!r} (only int8)"
+                )
+            if self.arch is not llama:
+                raise NotImplementedError(
+                    "int8 weight quantization currently covers the "
+                    "llama-family trunk (MoE/MLA: serve unquantized)"
+                )
+            if config.pp_size > 1:
+                raise NotImplementedError(
+                    "int8 quantization does not compose with pp staging yet"
+                )
 
         if params is None:
             if model_dir is not None:
@@ -174,6 +190,11 @@ class ModelRunner:
                     cfg, jax.random.PRNGKey(config.seed), self.dtype
                 )
 
+        if cfg.quantization:
+            from ..models import quant
+
+            params = quant.quantize_params(params)
+
         if config.pp_size > 1:
             # stage the stacked layers/cache for the collective GPipe
             # schedule: [L, ...] → [P, L/P, ...] sharded on the stage axis
@@ -187,6 +208,10 @@ class ModelRunner:
             )
         else:
             pspecs = self.arch.param_specs(params)
+            if cfg.quantization:
+                from ..models import quant
+
+                pspecs = quant.mirror_specs(params, pspecs)
             cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, pspecs

@@ -29,6 +29,8 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
     across disaggregated workers or transferred KV lands in the wrong slots.
     """
     model_cfg = ModelConfig.from_hf_config(mdc.config) if mdc.config else ModelConfig()
+    if getattr(flags, "quantization", None):
+        model_cfg.quantization = flags.quantization
     return EngineConfig(
         model=model_cfg,
         max_batch_size=getattr(flags, "max_batch_size", 8),

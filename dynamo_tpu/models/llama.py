@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
 from ..ops.attention import attention, lane_pad, scatter_kv_stacked
+from .quant import dense
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]  # k, v: [L, N_blocks, bs, KVH, D]
@@ -237,8 +238,8 @@ def init_kv_cache(
 
 
 def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
-    gate = jax.nn.silu(x @ layer_params["w_gate"])
-    return (gate * (x @ layer_params["w_up"])) @ layer_params["w_down"]
+    gate = jax.nn.silu(dense(x, layer_params["w_gate"]))
+    return dense(gate * dense(x, layer_params["w_up"]), layer_params["w_down"])
 
 
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
@@ -249,9 +250,9 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):
-        q = x @ layer_params["wq"]
-        k = x @ layer_params["wk"]
-        v = x @ layer_params["wv"]
+        q = dense(x, layer_params["wq"])
+        k = dense(x, layer_params["wk"])
+        v = dense(x, layer_params["wv"])
         if "bq" in layer_params:  # Qwen2-family qkv biases, pre-rope
             q = q + layer_params["bq"]
             k = k + layer_params["bk"]
@@ -269,7 +270,7 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             q, k_all, v_all, block_tables, positions, context_lens,
             impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
         )
-        delta = attn.reshape(b, s, h_heads * hd) @ layer_params["wo"]
+        delta = dense(attn.reshape(b, s, h_heads * hd), layer_params["wo"])
         return delta, k_all, v_all
 
     return attn_fn
@@ -310,7 +311,9 @@ def run_layers(
 def lm_logits(hidden: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")
-    return hidden @ (params["embed"].T if lm_head is None else lm_head)
+    if lm_head is None:
+        return hidden @ params["embed"].T  # tied: embed stays unquantized
+    return dense(hidden, lm_head)
 
 
 def decoder_forward(

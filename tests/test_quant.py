@@ -1,0 +1,198 @@
+"""Weight-only int8 serving (ModelConfig.quantization).
+
+Reference analog: the quantized checkpoints the reference's engines
+serve as their canonical workload (examples/llm/benchmarks/perf.sh
+FP8-dynamic model); here quantization is a serving-time transform.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model_runner import ModelRunner, build_mesh
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.quant import (
+    QuantizedWeight, dense, quantize_int8, quantize_params,
+)
+
+TINY = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+)
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 48, 32)) * 0.2
+    qw = quantize_int8(w)
+    assert qw.q.dtype == jnp.int8 and qw.scale.shape == (3, 32)
+    deq = qw.q.astype(jnp.float32) * qw.scale[:, None, :]
+    # symmetric rounding: error per element <= scale/2
+    err = jnp.abs(deq - w)
+    assert bool(jnp.all(err <= qw.scale[:, None, :] * 0.5 + 1e-7))
+
+
+def test_dense_matches_explicit_dequant():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 48), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (48, 32), jnp.float32)
+    qw = quantize_int8(w)
+    got = dense(x, qw)
+    want = (x @ qw.q.astype(jnp.float32)) * qw.scale
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    # plain arrays pass through untouched
+    np.testing.assert_allclose(
+        np.asarray(dense(x, w)), np.asarray(x @ w), rtol=1e-6)
+
+
+def test_quantize_params_targets_matmul_weights_only():
+    cfg = ModelConfig(**TINY)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"]["wq"], QuantizedWeight)
+    assert isinstance(qp["layers"]["w_down"], QuantizedWeight)
+    assert isinstance(qp["lm_head"], QuantizedWeight)
+    assert not isinstance(qp["embed"], QuantizedWeight)
+    assert not isinstance(qp["layers"]["ln1"], QuantizedWeight)
+    # the weight stream halves (int8 vs f32 here: 4x on the quantized set)
+    orig = sum(x.size * x.dtype.itemsize
+               for x in jax.tree.leaves(params["layers"]))
+    quant = sum(x.size * x.dtype.itemsize
+                for x in jax.tree.leaves(qp["layers"]))
+    assert quant < orig / 2
+
+
+def test_mirror_specs_shards_scales():
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.models.quant import mirror_specs
+
+    qw = quantize_int8(jnp.ones((2, 8, 16)))
+    specs = mirror_specs(
+        {"wq": qw, "ln1": jnp.ones(4)},
+        {"wq": P(None, None, "tp"), "ln1": P()},
+    )
+    assert tuple(specs["wq"].q) == (None, None, "tp")
+    assert tuple(specs["wq"].scale) == (None, "tp")  # in axis dropped
+    # 2D lm_head-style weight: scale shards with the out (vocab) axis
+    qw2 = quantize_int8(jnp.ones((8, 16)))
+    s2 = mirror_specs({"lm_head": qw2}, {"lm_head": P(None, "tp")})
+    assert tuple(s2["lm_head"].scale) == ("tp",)
+
+
+def _logits(cfg, params, prompt):
+    """One prefill over a fresh tiny cache, raw logits out."""
+    k, v = llama.init_kv_cache(cfg, 16, 8, jnp.float32)
+    s = len(prompt)
+    tokens = jnp.asarray([prompt], jnp.int32)
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    bt = jnp.arange(4, dtype=jnp.int32)[None]
+    slots = positions
+    logits, _ = llama.forward(
+        params, cfg, tokens, positions, (k, v), bt, slots,
+        jnp.asarray([s], jnp.int32),
+    )
+    return np.asarray(logits[0, -1], np.float64)
+
+
+def test_quantized_logits_track_full_precision():
+    cfg = ModelConfig(**TINY, attention_impl="xla")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12]
+    full = _logits(cfg, params, prompt)
+    quant = _logits(cfg, quantize_params(params), prompt)
+    cos = np.dot(full, quant) / (np.linalg.norm(full) * np.linalg.norm(quant))
+    assert cos > 0.99, f"quantized logits diverged (cos={cos:.4f})"
+
+
+def test_quantized_runner_serves_on_tp_mesh():
+    # sharded execution: q and scale follow the Megatron specs through
+    # the mirrored spec tree (8 virtual CPU devices from conftest)
+    cfg = EngineConfig(
+        model=ModelConfig(**TINY, attention_impl="xla", quantization="int8"),
+        max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", tp_size=2, prefill_buckets=[16],
+    )
+    runner = ModelRunner(cfg, mesh=build_mesh(1, 2, jax.devices()[:2]))
+    b, s = 2, 8
+    tokens = np.random.default_rng(0).integers(0, 256, (b, s)).astype(np.int32)
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    btab = np.zeros((b, cfg.blocks_per_seq), np.int32)
+    btab[0, 0], btab[1, 0] = 0, 1
+    slots = btab[:, :1] * 8 + positions
+    nt, *_ = runner.step(
+        tokens, positions, btab, slots, np.full(b, s, np.int32),
+        np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+        np.zeros(b, np.int32), np.ones(b, np.float32),
+        jax.random.PRNGKey(0),
+    )
+    assert np.asarray(nt).shape == (b,)
+
+
+@pytest.mark.asyncio
+async def test_quantized_engine_serves_deterministically(tmp_path):
+    import json as _json
+    import os as _os
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dynamo_tpu.engine.serving import JaxServingEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from fixtures import make_model_dir
+
+    d = make_model_dir(tmp_path, name="tiny-q")
+    hf = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf).save_pretrained(d, safe_serialization=True)
+    c = _json.load(open(_os.path.join(d, "config.json")))
+    c["eos_token_id"] = 2
+    _json.dump(c, open(_os.path.join(d, "config.json"), "w"))
+
+    mdc = ModelDeploymentCard.from_local_path(d)
+    mcfg = ModelConfig.from_model_dir(d)
+    mcfg.quantization = "int8"
+    econfig = EngineConfig(
+        model=mcfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", multi_step_decode=4,
+    )
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=econfig, warmup=False)
+
+    async def run():
+        req = PreprocessedRequest(
+            token_ids=[1, 17, 43, 99],
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        toks = []
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+        return toks
+
+    first = await run()
+    second = await run()
+    await engine.close()
+    assert len(first) == 8 and first == second
+
+
+def test_quantization_rejects_unsupported():
+    moe = ModelConfig(**TINY, num_experts=4, quantization="int8")
+    cfg = EngineConfig(
+        model=moe, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=16, dtype="float32",
+    )
+    with pytest.raises(NotImplementedError):
+        ModelRunner(cfg)
