@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="serving-time weight-only quantization (halves "
                         "the decode weight stream; llama-family)")
+    p.add_argument("--spec-ngram-tokens", type=int, default=0,
+                   help="ngram speculative decoding: propose up to K "
+                        "tokens per step from the context's own history "
+                        "(greedy requests; 0 = off)")
+    p.add_argument("--spec-ngram-match", type=int, default=3,
+                   help="trailing n-gram length the proposer looks up")
     p.add_argument("--num-kv-blocks", type=int, default=2048,
                    help="HBM paged-cache capacity in blocks")
     p.add_argument("--allow-random-weights", action="store_true",

@@ -123,7 +123,7 @@ class PrefillWorker:
             self.runner.set_sample_row(
                 0, prompt, [], logit_bias=rpr.logit_bias
             )
-            next_tokens, lps, top_vals, top_ids, _ = self.runner.step(
+            next_tokens, lps, top_vals, top_ids, *_ = self.runner.step(
                 *arrays,
                 np.asarray([rpr.temperature], np.float32),
                 np.asarray([rpr.top_k], np.int32),

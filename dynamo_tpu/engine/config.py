@@ -190,6 +190,15 @@ class EngineConfig:
     # waiting, and 1 (default) keeps strict per-token dispatch. Sampling
     # is bit-identical either way (same per-row PRNG fold-in counters).
     multi_step_decode: int = 1
+    # n-gram (prompt-lookup) speculative decoding: propose up to K tokens
+    # per decode step by matching the context's trailing n-gram against
+    # its own history, then VERIFY all K+1 positions in one forward.
+    # Decode is bandwidth-bound (weights stream once per step regardless
+    # of S), so accepted tokens are nearly free — the reference's engines
+    # ship the same technique (vLLM ngram speculative decoding). Greedy,
+    # penalty-free requests only; mixed batches fall back per step.
+    spec_ngram_tokens: int = 0   # K proposal tokens (0 = off)
+    spec_ngram_match: int = 3    # trailing n-gram length to look up
     enable_prefix_caching: bool = True
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
@@ -208,6 +217,8 @@ class EngineConfig:
         # a burst must fit comfortably inside one sequence's block budget;
         # 64 already amortizes dispatch overhead past the point of returns
         self.multi_step_decode = max(1, min(self.multi_step_decode, 64))
+        self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
+        self.spec_ngram_match = max(1, self.spec_ngram_match)
 
     @property
     def blocks_per_seq(self) -> int:

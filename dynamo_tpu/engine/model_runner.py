@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import models
-from ..models import llama
+from ..models import llama, quant
 from ..ops.attention import _pad_minor
 from .config import EngineConfig
 from .sampling import SamplingParams, sample, top_logprobs_for
@@ -191,8 +191,6 @@ class ModelRunner:
                 )
 
         if cfg.quantization:
-            from ..models import quant
-
             params = quant.quantize_params(params)
 
         if config.pp_size > 1:
@@ -209,8 +207,6 @@ class ModelRunner:
         else:
             pspecs = self.arch.param_specs(params)
             if cfg.quantization:
-                from ..models import quant
-
                 pspecs = quant.mirror_specs(params, pspecs)
             cache_spec = getattr(self.arch, "CACHE_SPEC", CACHE_SPEC)
         self.params = jax.tree.map(
@@ -264,12 +260,23 @@ class ModelRunner:
         def step(params, k_cache, v_cache, counts, seen, bias, tokens,
                  positions, block_tables, slot_mapping, context_lens,
                  last_idx, samp, sample_slots, commit, want_top,
-                 targets, want_prompt):
+                 targets, want_prompt, want_greedy):
             logits, (k_cache, v_cache) = forward(
                 params, (k_cache, v_cache), tokens, positions,
                 block_tables, slot_mapping, context_lens,
             )
             b = tokens.shape[0]
+            # per-position greedy tokens (ngram speculative verify): the
+            # argmax at position j is the model's next token after
+            # consuming tokens[:j+1] — the host compares it against the
+            # proposal to find the accepted prefix. Gated: pure overhead
+            # for non-speculative steps.
+            greedy_all = jax.lax.cond(
+                want_greedy,
+                lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                lambda lg: jnp.zeros(lg.shape[:2], jnp.int32),
+                logits,
+            )
             # prompt logprobs (OutputOptions.prompt_logprobs, reference:
             # lib/llm/src/protocols/common.rs:320-341): logprob of each
             # NEXT prompt token at every position — the prefill logits
@@ -290,7 +297,7 @@ class ModelRunner:
                 commit, want_top,
             )
             return (next_tokens, lps, top_vals, top_ids, prompt_lps,
-                    k_cache, v_cache, counts, seen, bias)
+                    greedy_all, k_cache, v_cache, counts, seen, bias)
 
         samp_spec = SamplingParams(
             temperature=batch_spec, top_k=batch_spec, top_p=batch_spec,
@@ -320,9 +327,10 @@ class ModelRunner:
                 repl,                        # want_top scalar
                 batch2_spec,                 # targets [B, S]
                 repl,                        # want_prompt scalar
+                repl,                        # want_greedy scalar
             ),
             out_shardings=(batch_spec, batch_spec, batch2_spec, batch2_spec,
-                           batch2_spec,
+                           batch2_spec, batch2_spec,
                            self.cache_sharding, self.cache_sharding,
                            self.state_sharding, self.state_sharding,
                            self.state_sharding),
@@ -484,7 +492,8 @@ class ModelRunner:
         want_top: bool = True,  # compute top-K alternatives this step?
         targets: Optional[np.ndarray] = None,  # [B, S] next-prompt-token ids
         want_prompt: bool = False,  # compute prompt logprobs at `targets`?
-    ) -> Tuple[jax.Array, jax.Array]:
+        want_greedy: bool = False,  # per-position argmax (spec verify)?
+    ) -> Tuple[jax.Array, ...]:
         """Run one compiled step; returns (next_tokens, logprobs) device arrays.
 
         Legacy callers pass a single ``key`` (tests, warmup, dry runs): it is
@@ -524,7 +533,7 @@ class ModelRunner:
             commit = np.zeros(b, bool)
         if targets is None:
             targets = np.zeros_like(tokens)
-        (next_tokens, lps, top_vals, top_ids, prompt_lps,
+        (next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all,
          k, v, counts, seen, bias) = self._step(
             self.params, self.kv_cache[0], self.kv_cache[1],
             self.sample_state[0], self.sample_state[1], self.sample_state[2],
@@ -536,10 +545,11 @@ class ModelRunner:
             jnp.asarray(bool(want_top), jnp.bool_),
             jnp.asarray(targets, jnp.int32),
             jnp.asarray(bool(want_prompt), jnp.bool_),
+            jnp.asarray(bool(want_greedy), jnp.bool_),
         )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
-        return next_tokens, lps, top_vals, top_ids, prompt_lps
+        return next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all
 
     def set_sample_row(
         self, slot: int, prompt_ids, generated_ids=(), logit_bias=None
@@ -835,6 +845,20 @@ class ModelRunner:
                     repetition_penalty=np.ones(b, np.float32),
                     seed_keys=np.zeros((b, 2), np.uint32), counters=z1,
                     commit=np.zeros(b, bool), want_top=False,
+                )
+        # the ngram-speculative verify shape (S = K+1 on decode-width
+        # tables) over the same ladder
+        if self.config.spec_ngram_tokens:
+            sK = self.config.spec_ngram_tokens + 1
+            zs = np.zeros((b, sK), np.int32)
+            for w in self.config.kv_width_buckets():
+                self.step(
+                    zs, zs, np.zeros((b, w), np.int32),
+                    np.full((b, sK), -1, np.int32),
+                    np.ones(b, np.int32), np.zeros(b, np.int32),
+                    np.zeros(b, np.float32), np.zeros(b, np.int32),
+                    np.ones(b, np.float32),
+                    jax.random.PRNGKey(0), want_greedy=True,
                 )
         # prefill-shaped programs (largest bucket, full table width) over
         # the batched-prefill row ladder, so the flash-prefill kernel's

@@ -38,6 +38,30 @@ from .sampling import host_row, seed_to_key
 logger = logging.getLogger(__name__)
 
 
+def ngram_propose(history: List[int], match: int, k: int) -> List[int]:
+    """Prompt-lookup proposal: find the most recent earlier occurrence of
+    the trailing ``match``-gram in the sequence's own history and return
+    up to ``k`` tokens that followed it. Reference analog: the ngram
+    speculative decoding of the engines the reference delegates to."""
+    n = len(history)
+    if n < match + 1 or k <= 0:
+        return []
+    tail = np.asarray(history[-match:], np.int64)
+    h = np.asarray(history, np.int64)
+    # windows over h[:-1]: every start i has at least one continuation
+    # token, and the trailing gram itself (start n-match) is excluded
+    win = np.lib.stride_tricks.sliding_window_view(h[:-1], match)
+    hits = np.nonzero((win == tail).all(axis=1))[0]
+    if hits.size == 0:
+        return []
+    # latest match whose continuation is full-length; else the earliest
+    # (longest) one — a repetitive tail would otherwise propose almost
+    # nothing because the most recent occurrence abuts the history end
+    full = hits[hits + match + k <= n]
+    i = int(full[-1]) if full.size else int(hits[0])
+    return [int(t) for t in history[i + match: i + match + k]]
+
+
 def build_prefill_arrays(cfg: EngineConfig, prompt: List[int], num_cached: int,
                          block_ids: List[int], bucket: Optional[int] = None):
     """Batch-of-1 arrays for one bucketed prefill step.
@@ -167,6 +191,9 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.prefix_total_tokens = 0
         self.steps = 0
+        # ngram speculative decoding acceptance telemetry
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # ---------- public API ----------
 
@@ -220,6 +247,9 @@ class Scheduler:
                 if self.prefix_total_tokens else 0.0
             ),
         }
+        if self.config.spec_ngram_tokens:
+            out["spec_proposed_tokens"] = self.spec_proposed
+            out["spec_accepted_tokens"] = self.spec_accepted
         if self.allocator.tier2 is not None:
             out.update(self.allocator.tier2.metrics())
         if self.disagg is not None:
@@ -362,11 +392,18 @@ class Scheduler:
                 if s is not None and s not in self.prefilling
             ]
             if active:
-                k_steps = self.config.multi_step_decode
-                if (k_steps > 1 and (self.prefilling or self.waiting
-                                     or self.pending_remote)):
-                    k_steps = 1
-                await self._decode(loop, active, k_steps)
+                runner_idle = not (self.prefilling or self.waiting
+                                   or self.pending_remote)
+                if (self.config.spec_ngram_tokens > 0 and runner_idle
+                        and all(self._spec_eligible(er) for er in active)):
+                    # ngram speculative verify: greedy penalty-free
+                    # batches only; anything else falls through
+                    await self._decode_spec(loop, active)
+                else:
+                    k_steps = self.config.multi_step_decode
+                    if k_steps > 1 and not runner_idle:
+                        k_steps = 1
+                    await self._decode(loop, active, k_steps)
                 progressed = True
 
             if not progressed:
@@ -631,7 +668,7 @@ class Scheduler:
                 n_tgts[i] = max(0, min(take, len(er.prompt) - 1 - start))
 
         t0 = time.monotonic()
-        next_tokens, lps, top_vals, top_ids, plps = self.runner.step(
+        next_tokens, lps, top_vals, top_ids, plps, _ = self.runner.step(
             tokens, positions, btab, slot_map, ctx_lens, last_idx,
             temp, top_k, top_p,
             min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
@@ -707,6 +744,127 @@ class Scheduler:
                        self._top_row(er, tv, ti, i), prompt_lps=prompt_lps)
             if er.finish is not None:
                 self._finish(er, er.finish, emit=False)
+
+    def _spec_eligible(self, er: EngineRequest) -> bool:
+        """Speculative verify preserves the exact stream only for greedy,
+        penalty-free, bias-free requests that want no logprobs: the
+        verify step's raw argmax must equal what sequential sampling
+        would pick, and per-position logprobs are not computed."""
+        return (er.temperature == 0.0
+                and er.presence_penalty == 0.0
+                and er.frequency_penalty == 0.0
+                and er.repetition_penalty == 1.0
+                and not er.want_logprobs and er.logprobs_n == 0
+                and not er.req.sampling_options.logit_bias)
+
+    async def _decode_spec(self, loop, active: List[EngineRequest]) -> None:
+        """One ngram-speculative decode pass: propose up to K tokens per
+        row from its own history, verify all K+1 positions in ONE forward
+        (decode is bandwidth-bound — the weights stream once either way),
+        and emit the accepted prefix plus the correction token.
+
+        KV discipline matches the burst path: every proposed position's
+        KV is written during the verify; rejected positions' slots are
+        simply rewritten when decoding reaches them again, and block
+        registration only ever covers positions below the host
+        context_len, which advances by accepted tokens only.
+        """
+        cfg = self.config
+        b = cfg.max_batch_size
+        bs = cfg.kv_block_size
+        K = cfg.spec_ngram_tokens
+        S = K + 1
+        if any(er.context_len + S + 1 > cfg.max_model_len for er in active):
+            # a row is within K of the horizon; it finishes momentarily
+            return await self._decode(loop, active, 1)
+
+        # proposals first: when nothing matches anywhere (non-repetitive
+        # output), the K+1-wide verify would be pure per-step overhead —
+        # run the normal decode (incl. its fused burst) instead
+        props: dict = {}
+        for er in active:
+            history = list(er.seq.token_ids) + [er.pending_token]
+            props[er.slot] = ngram_propose(history, cfg.spec_ngram_match, K)
+        if not any(props.values()):
+            return await self._decode(loop, active, cfg.multi_step_decode)
+
+        for er in list(active):
+            ok = all(
+                self._ensure_block_for(er, er.context_len + j)
+                for j in range(S)
+            )
+            if not ok:
+                logger.warning("KV OOM: preempting %s", er.request_id)
+                self._preempt(er)
+                active.remove(er)
+        self.allocator.flush_offload()
+        if not active:
+            return
+
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
+        tokens = np.zeros((b, S), np.int32)
+        positions = np.zeros((b, S), np.int32)
+        slot_map = np.full((b, S), -1, np.int32)
+        btab = np.zeros((b, w), np.int32)
+        ctx_lens = np.ones(b, np.int32)
+        last_idx = np.zeros(b, np.int32)
+
+        for er in active:
+            i = er.slot
+            pos0 = er.context_len
+            prop = props[i]
+            row = [er.pending_token] + prop
+            tokens[i, : len(row)] = row
+            positions[i] = pos0 + np.arange(S)
+            for j in range(S):
+                pj = pos0 + j
+                slot_map[i, j] = er.block_ids[pj // bs] * bs + pj % bs
+            btab[i, : len(er.block_ids)] = er.block_ids
+            # causal masking is by absolute position, so padding rows'
+            # junk keys (past their proposal) are invisible to every
+            # valid query at an earlier position
+            ctx_lens[i] = pos0 + S
+            last_idx[i] = len(row) - 1
+
+        zf, zi = np.zeros(b, np.float32), np.zeros(b, np.int32)
+        *_, greedy_all = self.runner.step(
+            tokens, positions, btab, slot_map, ctx_lens, last_idx,
+            zf, zi, np.ones(b, np.float32),
+            min_p=zf, presence_penalty=zf, frequency_penalty=zf,
+            repetition_penalty=np.ones(b, np.float32),
+            seed_keys=np.zeros((b, 2), np.uint32), counters=zi,
+            sample_slots=np.arange(b, dtype=np.int32),
+            commit=np.zeros(b, bool),  # greedy chain: counts never consulted
+            want_top=False, want_greedy=True,
+        )
+        ga = await loop.run_in_executor(None, lambda: np.asarray(greedy_all))
+        self.steps += 1
+
+        for er in active:
+            if er.finish is not None:
+                continue
+            i = er.slot
+            prop = props[i]
+            a = 0
+            while a < len(prop) and int(ga[i, a]) == prop[a]:
+                a += 1
+            self.spec_proposed += len(prop)
+            self.spec_accepted += a
+            # emit accepted prefix + the correction token, with the same
+            # pending-token discipline as every other decode path
+            for j in range(a + 1):
+                if er.finish is not None:
+                    break
+                token = int(ga[i, j])
+                er.seq.push(er.pending_token)
+                er.context_len += 1
+                self._register_completed_blocks(er)
+                er.pending_token = token
+                er.generated += 1
+                er.finish = self._check_finish(er, token)
+                self._emit(er, token, None, None)
+                if er.finish is not None:
+                    self._finish(er, er.finish, emit=False)
 
     async def _decode(self, loop, active: List[EngineRequest],
                       k_steps: int = 1) -> None:
@@ -794,7 +952,7 @@ class Scheduler:
                 commit=commit, want_top=want_top,
             )
         else:
-            next_tokens, lps, top_vals, top_ids, _ = self.runner.step(
+            next_tokens, lps, top_vals, top_ids, *_ = self.runner.step(
                 tokens, positions, btab, slot_map, ctx_lens, last_idx,
                 temp, top_k, top_p,
                 min_p=min_p, presence_penalty=pres, frequency_penalty=freq,

@@ -93,7 +93,13 @@ def mirror_specs(params: Dict, specs: Dict) -> Dict:
     def walk(p, s):
         if isinstance(p, QuantizedWeight):
             spec = tuple(s)  # PartitionSpec iterates its per-dim entries
-            scale_spec = P(*(spec[:-2] + spec[-1:])) if len(spec) >= 2 else P()
+            if len(spec) != p.q.ndim:
+                # rank-deficient specs would silently mis-align the scale
+                raise ValueError(
+                    f"quantized weight needs a full-rank spec: got {s} "
+                    f"for a {p.q.ndim}-d weight"
+                )
+            scale_spec = P(*(spec[:-2] + spec[-1:]))
             return QuantizedWeight(q=s, scale=scale_spec)
         if isinstance(p, dict):
             return {k: walk(v, s[k]) for k, v in p.items()}

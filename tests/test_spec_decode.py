@@ -1,0 +1,167 @@
+"""N-gram (prompt-lookup) speculative decoding.
+
+The verify step must be invisible in outputs: a greedy request streams
+the identical tokens with speculation on or off — acceptance only
+changes how many device dispatches the stream costs. Reference analog:
+the ngram speculative decoding of the engines the reference delegates
+to (vLLM `speculative_model: [ngram]`).
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.scheduler import ngram_propose
+from dynamo_tpu.engine.serving import JaxServingEngine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+from fixtures import make_model_dir
+
+
+def test_ngram_propose_finds_latest_match():
+    #        0  1  2  3  4  5  6  7  8
+    hist = [5, 6, 7, 1, 2, 5, 6, 9, 5, 6]
+    # tail (5, 6) matched latest at start 5 → continuation [9, 5, 6]
+    assert ngram_propose(hist, 2, 3) == [9, 5, 6]
+    assert ngram_propose(hist, 2, 1) == [9]
+
+
+def test_ngram_propose_no_match_or_short_history():
+    assert ngram_propose([1, 2, 3, 4], 2, 3) == []      # (3,4) unseen
+    assert ngram_propose([1, 2], 3, 3) == []            # too short
+    assert ngram_propose([7, 7, 7, 7], 2, 8) == [7, 7]  # runs off the end
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    d = make_model_dir(tmp_path_factory.mktemp("specmodel"), name="tiny-spec")
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).save_pretrained(d, safe_serialization=True)
+    with open(os.path.join(d, "config.json")) as f:
+        c = json.load(f)
+    c["eos_token_id"] = 2
+    c["bos_token_id"] = 1
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(c, f)
+    return d
+
+
+def _config(model_dir, spec, **kw):
+    cfg = ModelConfig.from_model_dir(model_dir)
+    return EngineConfig(
+        model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=96, dtype="float32", spec_ngram_tokens=spec,
+        spec_ngram_match=2, **kw,
+    )
+
+
+async def _collect(engine, token_ids, sampling, max_tokens=24):
+    req = PreprocessedRequest(
+        token_ids=list(token_ids),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=sampling,
+    )
+    toks = []
+    async for out in engine.generate(Context(req)):
+        toks.extend(out["token_ids"])
+    return toks
+
+
+def _runs(model_dir, spec):
+    async def go():
+        mdc = ModelDeploymentCard.from_local_path(model_dir)
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=_config(model_dir, spec), warmup=False)
+        results = []
+        # a looping prompt (proposals will fire) and a plain one
+        results.append(await _collect(
+            engine, [1, 9, 8, 9, 8, 9, 8], SamplingOptions(temperature=0.0)))
+        results.append(await _collect(
+            engine, [1, 17, 43, 99, 7], SamplingOptions(temperature=0.0)))
+        # a sampled request: not spec-eligible, must still stream right
+        results.append(await _collect(
+            engine, [1, 5, 9, 13], SamplingOptions(temperature=0.8, seed=7)))
+        # concurrent greedy pair
+        results.extend(await asyncio.gather(
+            _collect(engine, [1, 42, 42, 42, 42], SamplingOptions(temperature=0.0)),
+            _collect(engine, [1, 7, 100, 7, 100, 7], SamplingOptions(temperature=0.0)),
+        ))
+        metrics = engine.metrics()
+        await engine.close()
+        return results, metrics
+
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+
+
+def test_spec_streams_bit_equal_and_accepts(model_dir):
+    base, base_m = _runs(model_dir, 0)
+    spec, spec_m = _runs(model_dir, 4)
+    assert spec == base
+    assert "spec_proposed_tokens" not in base_m
+    assert spec_m["spec_proposed_tokens"] > 0  # proposals actually fired
+
+
+@pytest.mark.asyncio
+async def test_spec_saves_dispatches_on_repetitive_output(model_dir):
+    # a model generating a short cycle is the ideal case: acceptance
+    # should make dispatches << generated tokens once a cycle emerges
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4), warmup=False)
+    toks = await _collect(
+        engine, [1, 9, 8, 9, 8, 9, 8], SamplingOptions(temperature=0.0),
+        max_tokens=32)
+    m = engine.metrics()
+    steps = engine.scheduler.steps
+    await engine.close()
+    assert len(toks) == 32
+    if m["spec_accepted_tokens"] > 0:
+        assert steps < 32 + 2  # prefill + fewer decode dispatches
+
+
+@pytest.mark.asyncio
+async def test_spec_with_eos_stop(model_dir):
+    # eos handling mid-accepted-run must match the sequential engine
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+
+    async def run(spec, stop_ids):
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=_config(model_dir, spec), warmup=False)
+        req = PreprocessedRequest(
+            token_ids=[1, 9, 8, 9, 8],
+            stop_conditions=StopConditions(
+                max_tokens=24, ignore_eos=True,
+                stop_token_ids_hidden=stop_ids),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        toks, finish = [], None
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+            if out.get("finish_reason"):
+                finish = out["finish_reason"]
+        await engine.close()
+        return toks, finish
+
+    full, _ = await run(0, None)
+    stop_tok = full[3]
+    want = await run(0, [stop_tok])
+    got = await run(4, [stop_tok])
+    assert got == want
