@@ -56,6 +56,15 @@ class ModelConfig:
     # (per-out-channel weight-only; halves the decode weight stream —
     # models/quant.py). Llama-family trunks only for now.
     quantization: Optional[str] = None
+    # Gemma-2 family (models/gemma2.py): sandwich norms, GeGLU, logit
+    # softcapping, alternating sliding-window attention. model_family
+    # "gemma2" routes models.resolve; the numeric fields are 0/off for
+    # every other family.
+    model_family: str = ""
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    query_pre_attn_scalar: int = 0
+    sliding_window: int = 0
     # MLA (DeepSeek-class); kv_lora_rank > 0 enables MLA attention
     kv_lora_rank: int = 0
     q_lora_rank: int = 0
@@ -80,6 +89,7 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, config: dict) -> "ModelConfig":
+        arch = str(config.get("architectures", "")).lower()
         if (config.get("n_group") or 1) > 1:
             # V3's device/group-limited top-k is a routing *restriction*;
             # silently ignoring it would route differently than the
@@ -101,10 +111,7 @@ class ModelConfig:
             rope_scaling=config.get("rope_scaling") or None,
             # Qwen2-family checkpoints carry qkv biases but their HF config
             # has no attention_bias key — infer from the architecture name
-            attention_bias=config.get(
-                "attention_bias",
-                "qwen2" in str(config.get("architectures", "")).lower(),
-            ),
+            attention_bias=config.get("attention_bias", "qwen2" in arch),
             rms_norm_eps=config.get("rms_norm_eps", 1e-5),
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
@@ -118,6 +125,16 @@ class ModelConfig:
             moe_scoring_func=config.get("scoring_func", "softmax"),
             norm_topk_prob=config.get("norm_topk_prob", True),
             routed_scaling_factor=config.get("routed_scaling_factor", 1.0) or 1.0,
+            # Gemma-2 (config.json keys; sliding_window exists in other
+            # families' configs too, so gate on the architecture)
+            model_family="gemma2" if "gemma2" in arch else "",
+            attn_logit_softcap=config.get("attn_logit_softcapping") or 0.0,
+            final_logit_softcap=config.get("final_logit_softcapping") or 0.0,
+            query_pre_attn_scalar=config.get("query_pre_attn_scalar", 0) or 0,
+            sliding_window=(
+                (config.get("sliding_window", 0) or 0)
+                if "gemma2" in arch else 0
+            ),
             # MLA (DeepSeek config.json keys)
             kv_lora_rank=config.get("kv_lora_rank", 0) or 0,
             q_lora_rank=config.get("q_lora_rank", 0) or 0,

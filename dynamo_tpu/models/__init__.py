@@ -28,6 +28,10 @@ def resolve(cfg: ModelConfig):
         from . import mixtral
 
         return mixtral
+    if cfg.model_family == "gemma2":
+        from . import gemma2
+
+        return gemma2
     from . import llama
 
     return llama

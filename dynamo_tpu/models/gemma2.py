@@ -1,0 +1,160 @@
+"""Gemma-2 family: sandwich norms, GeGLU, softcapped + alternating
+sliding-window attention, tied embeddings.
+
+Architecture deltas vs the llama trunk (matching HF
+transformers/models/gemma2/modeling_gemma2.py, validated logit-exact in
+tests/test_gemma2.py):
+
+- embeddings scaled by sqrt(hidden_size) (cast to the activation dtype
+  first, like HF's ``normalizer`` tensor);
+- RMSNorm multiplies by ``1 + weight`` and runs in float32;
+- four norms per layer: pre/post attention and pre/post MLP — the post
+  norms apply to the block OUTPUT before the residual add;
+- GeGLU MLP (tanh-approximated gelu on the gate);
+- attention scaled by ``query_pre_attn_scalar**-0.5`` with logit
+  softcapping, and EVEN layers see only a sliding window of the cache
+  (``config.layer_types``: sliding/full alternating from layer 0);
+- logits through the tied embedding with final softcapping.
+
+Softcap/window ride the XLA attention path (ops/attention.py falls back
+from Pallas for these semantics). Reference analog: the Gemma models of
+the engines the reference delegates to (vLLM model zoo, SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from ..ops.attention import attention, scatter_kv_stacked
+from .llama import apply_rope, init_kv_cache  # noqa: F401  (shared cache layout)
+
+Params = Dict
+KVCache = Tuple[jax.Array, jax.Array]
+
+CACHE_SPEC = P(None, None, None, "tp", None)
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """Gemma RMSNorm: float32 compute, multiply by (1 + weight)."""
+    x32 = x.astype(jnp.float32)
+    n = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (n * (1.0 + weight.astype(jnp.float32))).astype(x.dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    l, d_model = cfg.num_layers, cfg.hidden_size
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    inter = cfg.intermediate_size
+    keys = jax.random.split(key, 9)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    layers = {
+        "ln1": jnp.zeros((l, d_model), dtype),           # (1 + w) centered
+        "wq": w(keys[1], (l, d_model, h * hd), d_model),
+        "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
+        "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
+        "wo": w(keys[4], (l, h * hd, d_model), h * hd),
+        "ln_post_attn": jnp.zeros((l, d_model), dtype),
+        "ln_pre_mlp": jnp.zeros((l, d_model), dtype),
+        "w_gate": w(keys[5], (l, d_model, inter), d_model),
+        "w_up": w(keys[6], (l, d_model, inter), d_model),
+        "w_down": w(keys[7], (l, inter, d_model), inter),
+        "ln_post_mlp": jnp.zeros((l, d_model), dtype),
+    }
+    return {
+        "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
+        "layers": layers,
+        "final_norm": jnp.zeros((d_model,), dtype),
+    }
+
+
+def param_specs(params: Params) -> Dict:
+    layer_specs = {
+        "ln1": P(), "ln_post_attn": P(), "ln_pre_mlp": P(),
+        "ln_post_mlp": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    specs = {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": {k: layer_specs[k] for k in params["layers"]},
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S]
+    kv_cache: KVCache,        # stacked [L, N, bs, KVH, Dpad]
+    block_tables: jax.Array,  # [B, W]
+    slot_mapping: jax.Array,  # [B, S]
+    context_lens: jax.Array,  # [B]
+    mesh=None,
+) -> Tuple[jax.Array, KVCache]:
+    b, s = tokens.shape
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    eps = cfg.rms_norm_eps
+    hidden = params["embed"][tokens]
+    hidden = hidden * jnp.asarray(
+        math.sqrt(cfg.hidden_size), hidden.dtype
+    )
+    scale = (cfg.query_pre_attn_scalar or hd) ** -0.5
+    k_all, v_all = kv_cache
+
+    def layer_step(carry, lp):
+        hidden, k_all, v_all, li = carry
+        x = rms_norm(hidden, lp["ln1"], eps)
+        q = (x @ lp["wq"]).reshape(b, s, h, hd)
+        k = (x @ lp["wk"]).reshape(b, s, kvh, hd)
+        v = (x @ lp["wv"]).reshape(b, s, kvh, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, None)
+        k = apply_rope(k, positions, cfg.rope_theta, None)
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
+        # layer_types alternates sliding/full starting sliding at layer 0
+        window = (
+            jnp.where(li % 2 == 0, cfg.sliding_window, jnp.int32(1 << 30))
+            if cfg.sliding_window else None
+        )
+        attn = attention(
+            q, k_all, v_all, block_tables, positions, context_lens,
+            impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
+            scale=scale, softcap=cfg.attn_logit_softcap,
+            sliding_window=window,
+        )
+        delta = attn.reshape(b, s, h * hd) @ lp["wo"]
+        hidden = hidden + rms_norm(delta, lp["ln_post_attn"], eps)
+        x = rms_norm(hidden, lp["ln_pre_mlp"], eps)
+        gate = jax.nn.gelu(x @ lp["w_gate"], approximate=True)
+        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        hidden = hidden + rms_norm(mlp, lp["ln_post_mlp"], eps)
+        return (hidden, k_all, v_all, li + 1), None
+
+    (hidden, k_all, v_all, _), _ = jax.lax.scan(
+        layer_step, (hidden, k_all, v_all, jnp.int32(0)), params["layers"]
+    )
+    hidden = rms_norm(hidden, params["final_norm"], eps)
+    lm_head = params.get("lm_head")  # untied finetunes; normally tied
+    logits = hidden @ (params["embed"].T if lm_head is None else lm_head)
+    cap = cfg.final_logit_softcap
+    if cap:
+        logits = cap * jnp.tanh(logits / cap)
+    return logits, (k_all, v_all)

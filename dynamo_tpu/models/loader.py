@@ -119,30 +119,16 @@ def _iter_safetensors(model_dir: str):
                 yield name, arr
 
 
-def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
-    """HF Llama/Mistral/Qwen-style checkpoint → stacked param pytree."""
-    l = cfg.num_layers
-    staging: Dict[str, Dict[int, np.ndarray]] = {
-        k: {} for k in ("ln1", "wq", "wk", "wv", "wo", "ln2", "w_gate", "w_up", "w_down")
-    }
+def _stream_hf_params(model_dir: str, mapping: Dict, n_layers: int,
+                      required, label: str):
+    """Shared HF-checkpoint streaming for dense trunks: route the
+    top-level tensors (embed / final norm / lm_head, transposed) and
+    stage per-layer tensors by ``mapping`` (name → (key, transpose)).
+    Validates the ``required`` layer keys are complete; keys outside
+    ``required`` (e.g. Qwen's optional qkv biases) must be complete only
+    if the checkpoint ships any of them. Returns (top, staging)."""
+    staging: Dict[str, Dict[int, np.ndarray]] = {}
     top: Dict[str, np.ndarray] = {}
-
-    mapping = {
-        "input_layernorm.weight": ("ln1", False),
-        "self_attn.q_proj.weight": ("wq", True),
-        "self_attn.k_proj.weight": ("wk", True),
-        "self_attn.v_proj.weight": ("wv", True),
-        "self_attn.o_proj.weight": ("wo", True),
-        "post_attention_layernorm.weight": ("ln2", False),
-        "mlp.gate_proj.weight": ("w_gate", True),
-        "mlp.up_proj.weight": ("w_up", True),
-        "mlp.down_proj.weight": ("w_down", True),
-        # Qwen2-family qkv biases (models/llama.py adds them pre-rope)
-        "self_attn.q_proj.bias": ("bq", False),
-        "self_attn.k_proj.bias": ("bk", False),
-        "self_attn.v_proj.bias": ("bv", False),
-    }
-
     for name, tensor in _iter_safetensors(model_dir):
         name = name.removeprefix("model.")
         if name == "embed_tokens.weight":
@@ -155,19 +141,46 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
             _, idx, rest = name.split(".", 2)
             if rest in mapping:
                 key, transpose = mapping[rest]
-                # bias keys exist only when the checkpoint ships them
                 staging.setdefault(key, {})[int(idx)] = (
                     tensor.T if transpose else tensor
                 )
             else:
                 logger.debug("skipping unmapped tensor %s", name)
-
-    missing = [k for k, v in staging.items() if len(v) != l]
+    present = set(staging) | set(required)
+    missing = [k for k in present if len(staging.get(k, ())) != n_layers]
     if missing:
         raise ValueError(
-            f"incomplete checkpoint: {missing} have "
-            f"{[len(staging[k]) for k in missing]} of {l} layers"
+            f"incomplete checkpoint: {label} {missing} have "
+            f"{[len(staging.get(k, ())) for k in missing]} of {n_layers} layers"
         )
+    return top, staging
+
+
+def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF Llama/Mistral/Qwen-style checkpoint → stacked param pytree."""
+    l = cfg.num_layers
+    mapping = {
+        "input_layernorm.weight": ("ln1", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("ln2", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+        # Qwen2-family qkv biases (models/llama.py adds them pre-rope);
+        # optional — present only when the checkpoint ships them
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
+    }
+    top, staging = _stream_hf_params(
+        model_dir, mapping, l,
+        required=("ln1", "wq", "wk", "wv", "wo", "ln2",
+                  "w_gate", "w_up", "w_down"),
+        label="llama",
+    )
 
     def stack(key):
         return jnp.asarray(
@@ -187,6 +200,41 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
     return params
 
 
+def load_gemma2_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF Gemma2ForCausalLM checkpoint → stacked param pytree.
+
+    Gemma-2 ships four norms per layer and normally ties lm_head to the
+    embedding; an untied finetune's lm_head is honored when present
+    (models/gemma2.py applies the (1+w) norm semantics and the
+    sqrt(hidden) embedding scale at forward time)."""
+    l = cfg.num_layers
+    mapping = {
+        "input_layernorm.weight": ("ln1", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("ln_post_attn", False),
+        "pre_feedforward_layernorm.weight": ("ln_pre_mlp", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+        "post_feedforward_layernorm.weight": ("ln_post_mlp", False),
+    }
+    top, staging = _stream_hf_params(
+        model_dir, mapping, l,
+        required=tuple(key for key, _ in mapping.values()), label="gemma2",
+    )
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "layers": _stack_group(staging, l, 1, dtype, "gemma2"),
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    return params
+
+
 def _stack_group(
     staging: Dict[str, Dict], n_layers: int, n_experts: int, dtype, label: str
 ) -> Dict:
@@ -194,6 +242,10 @@ def _stack_group(
     indexed by (layer, expert) tuples), validating completeness."""
     out = {}
     for key, by_idx in staging.items():
+        if not by_idx:
+            raise ValueError(
+                f"incomplete checkpoint: {label}.{key} has 0 tensors"
+            )
         per_expert = isinstance(next(iter(by_idx)), tuple)
         want = n_layers * n_experts if per_expert else n_layers
         if len(by_idx) != want:
@@ -520,6 +572,7 @@ def load_checkpoint_params(model_dir: str, cfg: ModelConfig, arch, dtype=jnp.bfl
         "llama": load_llama_params,
         "mixtral": load_mixtral_params,
         "deepseek": load_deepseek_params,
+        "gemma2": load_gemma2_params,
     }
     if name not in loaders:
         raise NotImplementedError(

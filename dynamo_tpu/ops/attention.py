@@ -117,13 +117,15 @@ def paged_attention(
     q_positions: jax.Array,  # [B, S] absolute position of each query token
     context_lens: jax.Array, # [B] total valid tokens (incl. current) per seq
     scale: Optional[float] = None,
+    softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
+    sliding_window=None,     # scalar (may be traced): keys within the window
 ) -> jax.Array:
     """Reference paged attention: gather → masked softmax → weighted sum.
 
     Causal semantics: query at absolute position p attends cache positions
-    j where j <= p and j < context_len. Cache position of slot s in the
-    gathered layout is exactly its sequence position (block_tables are in
-    sequence order).
+    j where j <= p and j < context_len — and, with ``sliding_window`` w,
+    j > p - w. Cache position of slot s in the gathered layout is exactly
+    its sequence position (block_tables are in sequence order).
     """
     b, s, h, d = q.shape
     _, block_size, kvh, _ = k_cache.shape
@@ -140,10 +142,16 @@ def paged_attention(
     qg = q.reshape(b, s, kvh, groups, d)
     logits = jnp.einsum("bskgd,btkd->bskgt", qg * scale, k)
 
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
+
     key_pos = jnp.arange(w * block_size)[None, None, :]          # [1, 1, T]
     causal = key_pos <= q_positions[:, :, None]                   # [B, S, T]
     valid = key_pos < context_lens[:, None, None]                 # [B, 1→S, T]
-    mask = (causal & valid)[:, :, None, None, :]                  # [B, S, 1, 1, T]
+    mask = causal & valid                                         # [B, S, T]
+    if sliding_window is not None:
+        mask &= key_pos > (q_positions[:, :, None] - sliding_window)
+    mask = mask[:, :, None, None, :]                              # [B, S, 1, 1, T]
     logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
 
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -172,6 +180,9 @@ def attention(
     mesh=None,
     interpret: bool = False,
     layer_idx=None,          # required when the cache is stacked (5-D)
+    scale: Optional[float] = None,  # override the head-dim default
+    softcap: float = 0.0,           # Gemma-2 attention logit softcapping
+    sliding_window=None,            # scalar window (XLA path only)
 ) -> jax.Array:
     """Paged-attention dispatch: XLA gather path or the Pallas kernels.
 
@@ -189,9 +200,14 @@ def attention(
     li = jnp.asarray(0 if layer_idx is None else layer_idx, jnp.int32)
     # scale from the TRUE head dim; the cache may carry lane padding
     d = q.shape[-1]
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
     dk = k_cache.shape[-1]
     q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
+    if softcap or sliding_window is not None:
+        # the Pallas kernels don't implement softcapping / windowed masks
+        # (Gemma-2 semantics); those models ride the XLA path
+        impl = "xla"
     if resolve_attention_impl(impl) == "xla":
         if stacked:
             # index the layer through the gather itself: block id n of
@@ -204,7 +220,8 @@ def attention(
             v_cache = v_cache.reshape((l * n_blocks,) + v_cache.shape[2:])
             block_tables = block_tables + li * n_blocks
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
-                               context_lens, scale=scale)[..., :d]
+                               context_lens, scale=scale, softcap=softcap,
+                               sliding_window=sliding_window)[..., :d]
 
     from .pallas_attention import paged_flash_attention
     from .pallas_decode import paged_decode_attention
