@@ -131,9 +131,15 @@ class ModelConfig:
             attn_logit_softcap=config.get("attn_logit_softcapping") or 0.0,
             final_logit_softcap=config.get("final_logit_softcapping") or 0.0,
             query_pre_attn_scalar=config.get("query_pre_attn_scalar", 0) or 0,
+            # honored whenever the checkpoint's HF modeling honors it:
+            # gemma2 alternates it per layer; mistral/phi3-style configs
+            # apply it to every layer; qwen2 ships the key but disables
+            # it via use_sliding_window
             sliding_window=(
                 (config.get("sliding_window", 0) or 0)
-                if "gemma2" in arch else 0
+                if ("gemma2" in arch
+                    or config.get("use_sliding_window", True))
+                else 0
             ),
             # MLA (DeepSeek config.json keys)
             kv_lora_rank=config.get("kv_lora_rank", 0) or 0,

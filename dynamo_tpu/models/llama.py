@@ -124,6 +124,14 @@ def rope_frequencies(
             else:
                 attention_factor = _yarn_mscale(factor)
         return inv, float(attention_factor)
+    if kind in ("longrope", "su"):
+        # longrope's short_factor rescales frequencies INSIDE the original
+        # window too — unscaled serving would be wrong at every context
+        # length, not just long ones, so refuse instead of degrading
+        raise NotImplementedError(
+            "rope_scaling type 'longrope' (Phi-3 128k variants) is not "
+            "implemented; serve the base-context variant instead"
+        )
     if kind not in (None, "default"):
         import logging
 
@@ -201,6 +209,9 @@ ATTN_LAYER_SPECS = {
     "bq": P(None, "tp"),
     "bk": P(None, "tp"),
     "bv": P(None, "tp"),
+    # per-head-dim q/k norms (Qwen3): shared across heads → replicated
+    "q_norm": P(),
+    "k_norm": P(),
 }
 
 
@@ -260,6 +271,9 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         q = q.reshape(b, s, h_heads, hd)
         k = k.reshape(b, s, kvh, hd)
         v = v.reshape(b, s, kvh, hd)
+        if "q_norm" in layer_params:  # Qwen3-family per-head norms, pre-rope
+            q = rms_norm(q, layer_params["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, layer_params["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
@@ -269,6 +283,9 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         attn = attention(
             q, k_all, v_all, block_tables, positions, context_lens,
             impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
+            # mistral/phi3-style whole-model window (0 = full attention;
+            # rides the XLA path — see ops/attention.py)
+            sliding_window=cfg.sliding_window or None,
         )
         delta = dense(attn.reshape(b, s, h_heads * hd), layer_params["wo"])
         return delta, k_all, v_all

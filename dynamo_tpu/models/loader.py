@@ -174,13 +174,49 @@ def load_llama_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> D
         "self_attn.q_proj.bias": ("bq", False),
         "self_attn.k_proj.bias": ("bk", False),
         "self_attn.v_proj.bias": ("bv", False),
+        # Qwen3-family per-head q/k norms (pre-rope, over head_dim)
+        "self_attn.q_norm.weight": ("q_norm", False),
+        "self_attn.k_norm.weight": ("k_norm", False),
+        # Phi-3 fuses qkv and gate|up into single projections; split
+        # below after streaming
+        "self_attn.qkv_proj.weight": ("_qkv", True),
+        "mlp.gate_up_proj.weight": ("_gate_up", True),
     }
     top, staging = _stream_hf_params(
-        model_dir, mapping, l,
-        required=("ln1", "wq", "wk", "wv", "wo", "ln2",
-                  "w_gate", "w_up", "w_down"),
+        model_dir, mapping, l, required=("ln1", "ln2", "wo", "w_down"),
         label="llama",
     )
+    if "_qkv" in staging:
+        # Phi-3 layout: rows [q | k | v] on the out axis (post-transpose
+        # the out axis is last): q = heads*hd, k = v = kv_heads*hd
+        qd = cfg.num_heads * cfg.head_dim
+        kvd = cfg.num_kv_heads * cfg.head_dim
+        for i, t in staging.pop("_qkv").items():
+            if t.shape[1] != qd + 2 * kvd:
+                # a silent short slice would serve plausible garbage
+                raise ValueError(
+                    f"fused qkv width {t.shape[1]} != heads*hd + 2*kv*hd "
+                    f"= {qd + 2 * kvd} (config/checkpoint mismatch)"
+                )
+            staging.setdefault("wq", {})[i] = t[:, :qd]
+            staging.setdefault("wk", {})[i] = t[:, qd:qd + kvd]
+            staging.setdefault("wv", {})[i] = t[:, qd + kvd:]
+    if "_gate_up" in staging:
+        inter = cfg.intermediate_size
+        for i, t in staging.pop("_gate_up").items():
+            if t.shape[1] != 2 * inter:
+                raise ValueError(
+                    f"fused gate_up width {t.shape[1]} != "
+                    f"2*intermediate_size = {2 * inter}"
+                )
+            staging.setdefault("w_gate", {})[i] = t[:, :inter]
+            staging.setdefault("w_up", {})[i] = t[:, inter:]
+    missing = [k for k in ("wq", "wk", "wv", "w_gate", "w_up")
+               if len(staging.get(k, ())) != l]
+    if missing:
+        raise ValueError(
+            f"incomplete checkpoint: llama {missing} incomplete over {l} layers"
+        )
 
     def stack(key):
         return jnp.asarray(
