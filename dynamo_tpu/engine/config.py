@@ -90,6 +90,22 @@ class ModelConfig:
     @classmethod
     def from_hf_config(cls, config: dict) -> "ModelConfig":
         arch = str(config.get("architectures", "")).lower()
+        rope_scaling = config.get("rope_scaling") or None
+        if rope_scaling and rope_scaling.get(
+                "rope_type", rope_scaling.get("type")) in ("longrope", "su"):
+            # longrope's profile choice and attention factor need the
+            # original/extended windows, which live OUTSIDE the HF
+            # rope_scaling dict — carry them in (models/llama.py)
+            rope_scaling = dict(rope_scaling)
+            rope_scaling.setdefault(
+                "original_max_position_embeddings",
+                config.get("original_max_position_embeddings")
+                or config.get("max_position_embeddings", 4096),
+            )
+            rope_scaling.setdefault(
+                "max_position_embeddings",
+                config.get("max_position_embeddings", 4096),
+            )
         if (config.get("n_group") or 1) > 1:
             # V3's device/group-limited top-k is a routing *restriction*;
             # silently ignoring it would route differently than the
@@ -108,7 +124,7 @@ class ModelConfig:
             ),
             head_dim=config.get("head_dim"),
             rope_theta=config.get("rope_theta", 10000.0),
-            rope_scaling=config.get("rope_scaling") or None,
+            rope_scaling=rope_scaling,
             # Qwen2-family checkpoints carry qkv biases but their HF config
             # has no attention_bias key — infer from the architecture name
             attention_bias=config.get("attention_bias", "qwen2" in arch),

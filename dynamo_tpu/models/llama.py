@@ -125,12 +125,10 @@ def rope_frequencies(
                 attention_factor = _yarn_mscale(factor)
         return inv, float(attention_factor)
     if kind in ("longrope", "su"):
-        # longrope's short_factor rescales frequencies INSIDE the original
-        # window too — unscaled serving would be wrong at every context
-        # length, not just long ones, so refuse instead of degrading
-        raise NotImplementedError(
-            "rope_scaling type 'longrope' (Phi-3 128k variants) is not "
-            "implemented; serve the base-context variant instead"
+        # handled in apply_rope: the short/long factor choice depends on
+        # the call's sequence length (a traced value), not just config
+        raise ValueError(
+            "longrope is resolved inside apply_rope, not rope_frequencies"
         )
     if kind not in (None, "default"):
         import logging
@@ -143,9 +141,53 @@ def rope_frequencies(
     return inv_freq, 1.0
 
 
+def _longrope_frequencies(d: int, theta: float, scaling: dict, positions,
+                          seq_basis=None):
+    """Phi-3 longrope (transformers _compute_longrope_parameters +
+    dynamic_rope_update): per-dim short/long frequency rescaling, the
+    profile chosen PER ROW by whether that sequence's covered context
+    exceeds the pretraining window — a traced comparison, since one
+    compiled program serves all lengths, and per-row so one long request
+    cannot flip co-batched short requests onto the long profile. Keys
+    roped while a sequence was still short keep their short-profile
+    rotation as it grows — exactly what HF's cached generation does
+    (dynamic_rope_update re-ropes only new positions). The attention
+    factor sqrt(1 + ln(len_ratio)/ln(original)) rides cos/sin regardless
+    of profile, as HF applies it.
+
+    ``seq_basis`` [B] is each row's covered context length (the engine
+    passes context_lens); without it, each row's max position stands in.
+    """
+    missing = [k for k in ("short_factor", "long_factor") if k not in scaling]
+    if missing or "original_max_position_embeddings" not in scaling:
+        raise ValueError(
+            f"longrope rope_scaling needs short_factor/long_factor and "
+            f"original_max_position_embeddings (missing: "
+            f"{missing + [k for k in ['original_max_position_embeddings'] if k not in scaling]}); "
+            "ModelConfig.from_hf_config injects the window fields from "
+            "the checkpoint config"
+        )
+    original = scaling["original_max_position_embeddings"]
+    maxpos = scaling.get("max_position_embeddings", original)
+    factor = maxpos / original
+    attn_factor = scaling.get("attention_factor") or (
+        1.0 if factor <= 1.0
+        else math.sqrt(1.0 + math.log(factor) / math.log(original))
+    )
+    base_pow = theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    short = jnp.asarray(scaling["short_factor"], jnp.float32)
+    long = jnp.asarray(scaling["long_factor"], jnp.float32)
+    if seq_basis is None:
+        seq_basis = jnp.max(positions, axis=-1) + 1  # [B]
+    is_long = (seq_basis > original)[:, None, None]   # [B, 1, 1]
+    ext = jnp.where(is_long, long[None, None, :], short[None, None, :])
+    return 1.0 / (ext * base_pow), float(attn_factor)  # [B, 1, D/2]
+
+
 def apply_rope(
     x: jax.Array, positions: jax.Array, theta: float,
     scaling: Optional[dict] = None,
+    seq_basis=None,  # [B] covered context per row (longrope profile choice)
 ) -> jax.Array:
     """x: [B, S, H, D]; positions: [B, S]. HF-style half-rotation RoPE.
 
@@ -153,7 +195,14 @@ def apply_rope(
     q·k scores carry its square without touching the softmax scale.
     """
     d = x.shape[-1]
-    inv_freq, attn_factor = rope_frequencies(d, theta, scaling)   # [D/2]
+    kind = (scaling or {}).get("rope_type", (scaling or {}).get("type"))
+    if kind in ("longrope", "su"):
+        # [B, 1, D/2] — per-row profile; broadcasts with positions below
+        inv_freq, attn_factor = _longrope_frequencies(
+            d, theta, scaling, positions, seq_basis
+        )
+    else:
+        inv_freq, attn_factor = rope_frequencies(d, theta, scaling)  # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     cos = jnp.cos(angles)[:, :, None, :] * attn_factor            # [B, S, 1, D/2]
     sin = jnp.sin(angles)[:, :, None, :] * attn_factor
@@ -274,8 +323,10 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         if "q_norm" in layer_params:  # Qwen3-family per-head norms, pre-rope
             q = rms_norm(q, layer_params["q_norm"], cfg.rms_norm_eps)
             k = rms_norm(k, layer_params["k_norm"], cfg.rms_norm_eps)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling,
+                       seq_basis=context_lens)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling,
+                       seq_basis=context_lens)
 
         # in-place scatter into the stacked cache + layer-indexed kernels:
         # no per-layer cache slice is ever materialized inside the scan

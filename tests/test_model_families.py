@@ -143,15 +143,76 @@ def test_phi3_sliding_window_logits_match_hf(tmp_path):
     )
 
 
-def test_longrope_is_rejected():
-    import pytest as _pytest
+def test_phi3_longrope_both_profiles_match_hf(tmp_path):
+    """Phi-3 128k-style longrope: the short profile (prompt inside the
+    pretraining window) and the long profile (prompt beyond it) must
+    both match HF, including the always-on attention factor."""
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
 
-    from dynamo_tpu.models.llama import rope_frequencies
+    cfg = Phi3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, original_max_position_embeddings=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        pad_token_id=0,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.1 * i for i in range(8)],
+            "long_factor": [2.0 + 0.5 * i for i in range(8)],
+        },
+    )
+    d = _save(tmp_path, "tiny-phi3-lr", Phi3ForCausalLM, cfg)
 
-    with _pytest.raises(NotImplementedError):
-        rope_frequencies(16, 10000.0, {"rope_type": "longrope",
-                                       "short_factor": [1.0] * 8,
-                                       "long_factor": [2.0] * 8})
+    model = Phi3ForCausalLM.from_pretrained(
+        d, torch_dtype=torch.float32, attn_implementation="eager")
+    model.eval()
+    mc = ModelConfig.from_model_dir(d)
+    mc.attention_impl = "xla"
+    params = load_checkpoint_params(d, mc, llama, jnp.float32)
+
+    def ours(prompt):
+        s = len(prompt)
+        k, v = llama.init_kv_cache(mc, 16, 8, jnp.float32)
+        logits, _ = llama.forward(
+            params, mc, jnp.asarray([prompt], jnp.int32),
+            jnp.arange(s, dtype=jnp.int32)[None], (k, v),
+            jnp.arange(8, dtype=jnp.int32)[None],
+            jnp.arange(s, dtype=jnp.int32)[None],
+            jnp.asarray([s], jnp.int32),
+        )
+        return np.asarray(logits[0])
+
+    short_prompt = PROMPT               # 10 tokens <= 16: short profile
+    long_prompt = (PROMPT * 3)[:24]     # 24 tokens  > 16: long profile
+    for prompt in (short_prompt, long_prompt):
+        with torch.no_grad():
+            hf = model(torch.tensor([prompt])).logits[0].numpy()
+        np.testing.assert_allclose(ours(prompt), hf, rtol=2e-4, atol=2e-4)
+
+
+def test_longrope_profile_is_per_row():
+    # a long-context request co-batched with a short one must not flip
+    # the short row onto the long profile
+    from dynamo_tpu.models.llama import apply_rope
+
+    scaling = {
+        "type": "longrope",
+        "short_factor": [1.0 + 0.1 * i for i in range(8)],
+        "long_factor": [2.0 + 0.5 * i for i in range(8)],
+        "original_max_position_embeddings": 16,
+        "max_position_embeddings": 64,
+    }
+    x = jnp.ones((2, 4, 2, 16), jnp.float32)
+    positions = jnp.tile(jnp.arange(4, dtype=jnp.int32)[None], (2, 1))
+    mixed = apply_rope(x, positions, 10000.0, scaling,
+                       seq_basis=jnp.asarray([10, 40], jnp.int32))
+    alone = apply_rope(x[:1], positions[:1], 10000.0, scaling,
+                       seq_basis=jnp.asarray([10], jnp.int32))
+    np.testing.assert_allclose(np.asarray(mixed[0]), np.asarray(alone[0]),
+                               rtol=1e-6)
+    # and the long row really uses a different profile
+    assert not np.allclose(np.asarray(mixed[1]), np.asarray(mixed[0]))
 
 
 def test_phi3_logits_match_hf(phi3_dir):
