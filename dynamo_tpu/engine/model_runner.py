@@ -229,7 +229,12 @@ class ModelRunner:
     # ---------- the unified step program ----------
 
     def _make_forward(self):
-        """The model-forward closure both compiled programs trace."""
+        """(trunk, head) closures both compiled programs trace: the trunk
+        returns pre-final-norm hidden states, the head applies final norm
+        + lm head (+ per-family logit tail) to any [..., D] slice. The
+        split lets the step run the head on ONLY the sampled positions —
+        the full-S [B, S, V] head is the dominant prefill matmul and pure
+        waste for every position nobody reads."""
         cfg = self.config.model
         mesh = self.mesh
         arch = self.arch
@@ -239,15 +244,21 @@ class ModelRunner:
             def forward(params, cache, tokens, positions, bt, slots, ctx):
                 return pipeline_forward(
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
-                    mesh,
+                    mesh, return_hidden=True,
                 )
+            head_fn = llama.logits_from_hidden  # pp stages the llama trunk
         else:
             def forward(params, cache, tokens, positions, bt, slots, ctx):
                 return arch.forward(
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
-                    mesh=mesh,
+                    mesh=mesh, return_hidden=True,
                 )
-        return forward
+            head_fn = arch.logits_from_hidden
+
+        def head(hidden, params):
+            return head_fn(hidden, params, cfg)
+
+        return forward, head
 
     def _build_step(self):
         cfg = self.config.model
@@ -255,43 +266,53 @@ class ModelRunner:
         batch_spec = NamedSharding(mesh, P("dp"))
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
-        forward = self._make_forward()
+        forward, head = self._make_forward()
 
         def step(params, k_cache, v_cache, counts, seen, bias, tokens,
                  positions, block_tables, slot_mapping, context_lens,
                  last_idx, samp, sample_slots, commit, want_top,
                  targets, want_prompt, want_greedy):
-            logits, (k_cache, v_cache) = forward(
+            hidden, (k_cache, v_cache) = forward(
                 params, (k_cache, v_cache), tokens, positions,
                 block_tables, slot_mapping, context_lens,
             )
             b = tokens.shape[0]
-            # per-position greedy tokens (ngram speculative verify): the
-            # argmax at position j is the model's next token after
-            # consuming tokens[:j+1] — the host compares it against the
-            # proposal to find the accepted prefix. Gated: pure overhead
-            # for non-speculative steps.
-            greedy_all = jax.lax.cond(
-                want_greedy,
-                lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
-                lambda lg: jnp.zeros(lg.shape[:2], jnp.int32),
-                logits,
-            )
+            # the full-S [B, S, V] head exists ONLY inside this gated
+            # branch — it serves two consumers that need every position:
             # prompt logprobs (OutputOptions.prompt_logprobs, reference:
-            # lib/llm/src/protocols/common.rs:320-341): logprob of each
-            # NEXT prompt token at every position — the prefill logits
-            # are already here; gated because the [B, S, V] log_softmax
-            # is pure overhead for the vast majority of requests
-            prompt_lps = jax.lax.cond(
-                want_prompt,
-                lambda lg: jnp.take_along_axis(
-                    jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1),
-                    targets[..., None], axis=-1,
-                )[..., 0],
-                lambda lg: jnp.zeros(lg.shape[:2], jnp.float32),
-                logits,
+            # lib/llm/src/protocols/common.rs:320-341) and the ngram
+            # speculative verify's per-position argmax. Everything else
+            # samples from the last_idx slice below, so ordinary prefill
+            # never pays vocab-width compute for positions nobody reads.
+            want_full = jnp.logical_or(want_prompt, want_greedy)
+
+            def full_head(h):
+                lg = head(h, params)                      # [B, S, V]
+                # the f32 log_softmax + gather serves prompt_logprobs
+                # only — a speculative verify (want_greedy) needs just
+                # the argmax, so keep the two consumers' costs separate
+                plp = jax.lax.cond(
+                    want_prompt,
+                    lambda l: jnp.take_along_axis(
+                        jax.nn.log_softmax(l.astype(jnp.float32), axis=-1),
+                        targets[..., None], axis=-1,
+                    )[..., 0],
+                    lambda l: jnp.zeros(l.shape[:2], jnp.float32),
+                    lg,
+                )
+                ga = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return plp, ga
+
+            prompt_lps, greedy_all = jax.lax.cond(
+                want_full,
+                full_head,
+                lambda h: (jnp.zeros(h.shape[:2], jnp.float32),
+                           jnp.zeros(h.shape[:2], jnp.int32)),
+                hidden,
             )
-            last_logits = logits[jnp.arange(b), last_idx]  # [B, V]
+            last_logits = head(
+                hidden[jnp.arange(b), last_idx], params
+            )  # [B, V]
             next_tokens, lps, top_vals, top_ids, counts = _sample_and_logprobs(
                 cfg, last_logits, samp, counts, seen, bias, sample_slots,
                 commit, want_top,
@@ -361,7 +382,7 @@ class ModelRunner:
         repl = NamedSharding(mesh, P())
         steps_spec = NamedSharding(mesh, P(None, "dp"))
         steps3_spec = NamedSharding(mesh, P(None, "dp", None))
-        forward = self._make_forward()
+        forward, head = self._make_forward()
 
         import dataclasses as _dc
 
@@ -378,14 +399,14 @@ class ModelRunner:
                 # path); inactive rows write nowhere
                 slot = block_tables[rows, pos // bs] * bs + pos % bs
                 slot = jnp.where(commit, slot, -1)
-                logits, (k_cache, v_cache) = forward(
+                hidden, (k_cache, v_cache) = forward(
                     params, (k_cache, v_cache), toks[:, None], pos[:, None],
                     block_tables, slot[:, None], pos + 1,
                 )
                 samp_i = _dc.replace(samp, counters=samp.counters + step_i)
                 nt, lp, tv, ti, counts = _sample_and_logprobs(
-                    cfg, logits[:, 0], samp_i, counts, seen, bias,
-                    sample_slots, commit, want_top,
+                    cfg, head(hidden[:, 0], params), samp_i, counts, seen,
+                    bias, sample_slots, commit, want_top,
                 )
                 return (k_cache, v_cache, counts, nt, pos + 1), (nt, lp, tv, ti)
 

@@ -355,6 +355,7 @@ def forward(
     slot_mapping: jax.Array,  # [B, S]
     context_lens: jax.Array,  # [B]
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Returns (logits [B, S, V], updated (c_kv, k_rope) caches). Dense
     prefix layers then MoE layers, chained through one contiguous cache.
@@ -380,4 +381,10 @@ def forward(
             hidden, kv_cache, params["layers"], cfg, attn_fn,
             make_moe_mlp_fn(cfg, b, s, slot_mapping), li0=li,
         )
+    if return_hidden:
+        return hidden, kv_cache
     return lm_logits(hidden, params, cfg), kv_cache
+
+
+# final norm + lm head over any [..., D] slice (engine/model_runner.py)
+logits_from_hidden = lm_logits

@@ -109,6 +109,7 @@ def forward(
     slot_mapping: jax.Array,  # [B, S]
     context_lens: jax.Array,  # [B]
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     b, s = tokens.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -151,10 +152,19 @@ def forward(
     (hidden, k_all, v_all, _), _ = jax.lax.scan(
         layer_step, (hidden, k_all, v_all, jnp.int32(0)), params["layers"]
     )
-    hidden = rms_norm(hidden, params["final_norm"], eps)
+    if return_hidden:
+        return hidden, (k_all, v_all)
+    return logits_from_hidden(hidden, params, cfg), (k_all, v_all)
+
+
+def logits_from_hidden(hidden: jax.Array, params: Params,
+                       cfg: ModelConfig) -> jax.Array:
+    """Final (1+w) norm + tied-or-untied head + final softcapping over
+    any [..., D] slice (the engine samples from last-position hidden)."""
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")  # untied finetunes; normally tied
     logits = hidden @ (params["embed"].T if lm_head is None else lm_head)
     cap = cfg.final_logit_softcap
     if cap:
         logits = cap * jnp.tanh(logits / cap)
-    return logits, (k_all, v_all)
+    return logits

@@ -384,6 +384,12 @@ def lm_logits(hidden: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     return dense(hidden, lm_head)
 
 
+# the engine's name for "final norm + lm head over any [..., D] slice":
+# it samples from last-position hidden states without paying the full
+# [B, S, V] head (engine/model_runner.py)
+logits_from_hidden = lm_logits
+
+
 def decoder_forward(
     params: Params,
     cfg: ModelConfig,
@@ -395,13 +401,17 @@ def decoder_forward(
     context_lens: jax.Array,  # [B] valid tokens incl. the ones being written
     mesh=None,                # multi-device mesh for the pallas shard_map path
     mlp_fn=_swiglu_mlp,       # (normed_x [B,S,D], layer_params) -> [B,S,D]
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Shared decoder trunk: embed → scan(attention + mlp_fn) → logits.
 
     The attention block (RoPE, paged-KV scatter, GQA attention) is common
     to GQA families; ``mlp_fn`` is the per-family feed-forward — dense
     SwiGLU here, routed experts in models/mixtral.py.
-    Returns (logits [B, S, V], updated kv_cache).
+    Returns (logits [B, S, V], updated kv_cache) — or the pre-final-norm
+    hidden states [B, S, D] with ``return_hidden``, so the engine can
+    run ``logits_from_hidden`` on just the positions it samples (the
+    full-S lm head is the dominant prefill matmul otherwise).
     """
     b, s = tokens.shape
     hidden = params["embed"][tokens]  # [B, S, D]
@@ -411,6 +421,8 @@ def decoder_forward(
     hidden, kv_cache, _ = run_layers(
         hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn
     )
+    if return_hidden:
+        return hidden, kv_cache
     return lm_logits(hidden, params, cfg), kv_cache
 
 
@@ -424,9 +436,11 @@ def forward(
     slot_mapping: jax.Array,
     context_lens: jax.Array,
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Llama forward = shared trunk with the dense SwiGLU MLP."""
     return decoder_forward(
         params, cfg, tokens, positions, kv_cache, block_tables,
         slot_mapping, context_lens, mesh=mesh, mlp_fn=_swiglu_mlp,
+        return_hidden=return_hidden,
     )

@@ -34,6 +34,7 @@ from .llama import (  # shared trunk + specs
     base_specs,
     decoder_forward,
     init_kv_cache,
+    logits_from_hidden,  # noqa: F401  (engine samples from hidden slices)
 )
 
 Params = Dict[str, Any]
@@ -175,6 +176,7 @@ def forward(
     slot_mapping: jax.Array,  # [B, S]
     context_lens: jax.Array,  # [B]
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Returns (logits [B, S, V], updated kv_cache): the shared decoder
     trunk (models/llama.py decoder_forward) with the routed-experts MLP.
@@ -184,6 +186,7 @@ def forward(
         params, cfg, tokens, positions, kv_cache, block_tables,
         slot_mapping, context_lens, mesh=mesh,
         mlp_fn=make_moe_mlp_fn(cfg, b, s, slot_mapping),
+        return_hidden=return_hidden,
     )
 
 

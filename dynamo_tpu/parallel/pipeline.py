@@ -107,6 +107,7 @@ def pipeline_forward(
     context_lens: jax.Array,  # [B]
     mesh,
     num_microbatches: Optional[int] = None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Llama-family forward with the trunk pipelined over the pp axis.
 
@@ -243,4 +244,6 @@ def pipeline_forward(
         params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb
     )
     hidden = outputs.reshape(b, s, -1)
+    if return_hidden:
+        return hidden, kv_cache
     return llama.lm_logits(hidden, params, cfg), kv_cache
