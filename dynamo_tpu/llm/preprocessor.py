@@ -325,7 +325,10 @@ class OpenAIPreprocessor(Operator):
         async for out in backend_stream:
             completion_tokens = max(completion_tokens, out.cum_tokens)
             if tool_format is None:
-                if out.text or out.finish_reason:
+                # out.logprobs without text: the detokenizer held this
+                # token's characters (multi-byte piece mid-sequence) —
+                # the entry must still reach the client or counts drift
+                if out.text or out.finish_reason or out.logprobs:
                     yield _chunk(
                         out.text, self._logprobs(out),
                         out.finish_reason.to_openai() if out.finish_reason
@@ -433,29 +436,58 @@ class OpenAIPreprocessor(Operator):
                 ),
             )
 
+    def _token_str(self, tid: int) -> str:
+        """Display string for one vocab id (chat and legacy-completions
+        logprob blocks must render tokens identically)."""
+        return (self.tokenizer.id_to_token(tid)
+                if self.tokenizer else str(tid)) or str(tid)
+
     def _logprobs(self, out: BackendOutput) -> Optional[ChoiceLogprobs]:
         if not out.logprobs:
             return None
         entries = []
         for lp in out.logprobs:
-            token_str = (
-                self.tokenizer.id_to_token(lp.token_id) if self.tokenizer else str(lp.token_id)
-            ) or str(lp.token_id)
             entries.append(
                 LogprobEntry(
-                    token=token_str,
+                    token=self._token_str(lp.token_id),
                     logprob=lp.logprob,
                     top_logprobs=[
-                        {
-                            "token": (self.tokenizer.id_to_token(t) if self.tokenizer else str(t))
-                            or str(t),
-                            "logprob": p,
-                        }
+                        {"token": self._token_str(t), "logprob": p}
                         for t, p in (lp.top or {}).items()
                     ],
                 )
             )
         return ChoiceLogprobs(content=entries)
+
+    def _completion_logprobs_dict(self, out: BackendOutput) -> Optional[dict]:
+        """OpenAI legacy completions logprobs block for one generation
+        chunk (tokens / token_logprobs / top_logprobs / text_offset).
+        Offsets are chunk-relative; with one token per chunk (the decode
+        stream's shape) they are exact, and a multi-token chunk (the
+        stop-string jail releasing buffered prose) splits the chunk text
+        proportionally — same fallback the chat path uses."""
+        if not out.logprobs:
+            return None
+        n = len(out.logprobs)
+        text_len = len(out.text or "")
+        toks, tlps, tops, offs = [], [], [], []
+        for i, lp in enumerate(out.logprobs):
+            toks.append(self._token_str(lp.token_id))
+            tlps.append(lp.logprob)
+            tops.append(
+                {self._token_str(t): p for t, p in lp.top.items()}
+                if lp.top else None
+            )
+            offs.append(int(round(i / n * text_len)))
+        return {
+            "tokens": toks,
+            "token_logprobs": tlps,
+            # one entry per token even when all None: the aggregator
+            # concatenates blocks, so a collapsed list would shift later
+            # chunks' top entries onto the wrong tokens
+            "top_logprobs": tops,
+            "text_offset": offs,
+        }
 
     def _prompt_logprobs_dict(self, token_ids, prompt_lps) -> dict:
         """OpenAI legacy completions logprobs block for the echoed prompt:
@@ -490,7 +522,9 @@ class OpenAIPreprocessor(Operator):
         return {
             "tokens": toks,
             "token_logprobs": list(prompt_lps[: len(toks)]),
-            "top_logprobs": None,
+            # per-token placeholders keep the aggregate list aligned with
+            # tokens when generation chunks append their top entries
+            "top_logprobs": [None] * len(toks),
             "text_offset": offsets,
         }
 
@@ -533,7 +567,9 @@ class OpenAIPreprocessor(Operator):
                         text=echo_text, finish_reason=None, logprobs=lp_dict,
                     )],
                 )
-            if out.text or out.finish_reason:
+            # out.logprobs without text: the detokenizer held this token's
+            # characters (multi-byte piece) — its entry must still flow
+            if out.text or out.finish_reason or out.logprobs:
                 yield CompletionResponse(
                     id=request_id,
                     model=model,
@@ -543,6 +579,10 @@ class OpenAIPreprocessor(Operator):
                             finish_reason=out.finish_reason.to_openai()
                             if out.finish_reason
                             else None,
+                            # legacy logprobs block for this chunk's
+                            # tokens; offsets are chunk-relative (the
+                            # aggregator rebases onto accumulated text)
+                            logprobs=self._completion_logprobs_dict(out),
                         )
                     ],
                 )

@@ -269,6 +269,46 @@ async def test_completion_echo_prepends_prompt(mdc, tokenizer):
     assert texts == ["hello ", "out!"]
 
 
+async def test_completion_stream_carries_legacy_logprobs(mdc, tokenizer):
+    """Legacy completions `logprobs: N` must yield the per-chunk
+    tokens/token_logprobs/top_logprobs/text_offset block — the engine
+    computes them; dropping them in assembly is the accepted-but-ignored
+    class round 1 banned."""
+    from dynamo_tpu.llm.backend import BackendOutput
+    from dynamo_tpu.protocols.common import TokenLogprob
+    from dynamo_tpu.protocols.openai import aggregate_completion_stream
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+
+    async def backend():
+        yield BackendOutput(
+            token_ids=[5], text="one", cum_tokens=1, finish_reason=None,
+            logprobs=[TokenLogprob(5, -0.25, {5: -0.25, 7: -1.5})],
+        )
+        yield BackendOutput(
+            token_ids=[9], text=" two", cum_tokens=2, finish_reason=None,
+            logprobs=[TokenLogprob(9, -0.5, None)],
+        )
+
+    chunks = [
+        r async for r in pre.completion_stream(
+            "cmpl-1", "m", backend(), prompt_tokens=2,
+        )
+    ]
+    blocks = [c.choices[0].logprobs for c in chunks if c.choices]
+    assert all(b is not None for b in blocks)
+    assert blocks[0]["token_logprobs"] == [-0.25]
+    assert blocks[0]["top_logprobs"][0] and len(blocks[0]["top_logprobs"][0]) == 2
+    # aggregation rebases offsets onto the accumulated text, and the
+    # top_logprobs list stays token-aligned (None placeholders survive)
+    agg = aggregate_completion_stream(chunks)
+    lp = agg.choices[0].logprobs
+    assert lp["token_logprobs"] == [-0.25, -0.5]
+    assert lp["text_offset"] == [0, len("one")]
+    assert len(lp["top_logprobs"]) == len(lp["tokens"])
+    assert lp["top_logprobs"][1] is None
+
+
 def test_int_keyed_dicts_survive_msgpack_strict_decode():
     """logit_bias and top-logprob dicts ride msgpack planes whose decoders
     use the strict default (int map keys rejected) — wire forms must
