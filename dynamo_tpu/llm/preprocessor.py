@@ -59,6 +59,25 @@ FALLBACK_CHAT_TEMPLATE = (
 )
 
 
+def _child_request(preprocessed, i: int, output_options=None):
+    """One seeded single-sample child of a fanned-out request (the n-way
+    fan-out and the buffered best_of path share this): n=1, seed offset
+    by the child index so seeded requests stay reproducible but
+    distinct, annotation side-channels off."""
+    import dataclasses as _dc
+
+    seed = preprocessed.sampling_options.seed
+    samp = _dc.replace(
+        preprocessed.sampling_options, n=1,
+        seed=(seed + i) if seed is not None else None,
+    )
+    return _dc.replace(
+        preprocessed, sampling_options=samp,
+        output_options=output_options or preprocessed.output_options,
+        annotation_values={},
+    )
+
+
 class PromptFormatter:
     """Jinja2 chat-template renderer (HF tokenizer_config semantics)."""
 
@@ -124,12 +143,25 @@ class OpenAIPreprocessor(Operator):
 
     def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
         if req.best_of is not None and req.best_of != (req.n or 1):
-            # served honestly or not at all: silently degrading best_of to
-            # n would return different completions than the client asked
-            # to select among
-            raise EngineError(
-                "best_of != n is not supported; use n-way sampling"
-            )
+            # OpenAI semantics: best_of candidates are generated
+            # server-side and the n highest-cumulative-logprob ones
+            # returned; that selection needs complete outputs, so it
+            # cannot stream, and best_of < n has nothing to select
+            if req.best_of < (req.n or 1):
+                raise EngineError("best_of must be >= n")
+            if req.best_of > 20:  # OpenAI's cap; also bounds the fan-out
+                raise EngineError("best_of must be <= 20")
+            if req.stream:
+                raise EngineError("best_of cannot be used with streaming")
+            if req.echo:
+                raise EngineError("best_of cannot be combined with echo")
+            if (req.temperature is not None and req.temperature == 0) or (
+                    req.nvext and req.nvext.greed_sampling):
+                # greedy candidates are identical: the selection is
+                # meaningless and the client pays best_of x the tokens
+                raise EngineError(
+                    "best_of > n requires sampling (temperature > 0)"
+                )
         prompt = req.prompt
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
             token_ids = list(prompt)
@@ -459,35 +491,36 @@ class OpenAIPreprocessor(Operator):
             )
         return ChoiceLogprobs(content=entries)
 
+    def _legacy_logprobs_block(self, entries, offsets) -> dict:
+        """tokens / token_logprobs / top_logprobs / text_offset from
+        TokenLogprob entries + their text offsets (one rendering shared
+        by the streaming chunks and the buffered best_of path)."""
+        return {
+            "tokens": [self._token_str(e.token_id) for e in entries],
+            "token_logprobs": [e.logprob for e in entries],
+            # one entry per token even when all None: the aggregator
+            # concatenates blocks, so a collapsed list would shift later
+            # chunks' top entries onto the wrong tokens
+            "top_logprobs": [
+                {self._token_str(t): p for t, p in e.top.items()}
+                if e.top else None
+                for e in entries
+            ],
+            "text_offset": list(offsets),
+        }
+
     def _completion_logprobs_dict(self, out: BackendOutput) -> Optional[dict]:
         """OpenAI legacy completions logprobs block for one generation
-        chunk (tokens / token_logprobs / top_logprobs / text_offset).
-        Offsets are chunk-relative; with one token per chunk (the decode
-        stream's shape) they are exact, and a multi-token chunk (the
-        stop-string jail releasing buffered prose) splits the chunk text
-        proportionally — same fallback the chat path uses."""
+        chunk. Offsets are chunk-relative; with one token per chunk (the
+        decode stream's shape) they are exact, and a multi-token chunk
+        (the stop-string jail releasing buffered prose) splits the chunk
+        text proportionally — same fallback the chat path uses."""
         if not out.logprobs:
             return None
         n = len(out.logprobs)
         text_len = len(out.text or "")
-        toks, tlps, tops, offs = [], [], [], []
-        for i, lp in enumerate(out.logprobs):
-            toks.append(self._token_str(lp.token_id))
-            tlps.append(lp.logprob)
-            tops.append(
-                {self._token_str(t): p for t, p in lp.top.items()}
-                if lp.top else None
-            )
-            offs.append(int(round(i / n * text_len)))
-        return {
-            "tokens": toks,
-            "token_logprobs": tlps,
-            # one entry per token even when all None: the aggregator
-            # concatenates blocks, so a collapsed list would shift later
-            # chunks' top entries onto the wrong tokens
-            "top_logprobs": tops,
-            "text_offset": offs,
-        }
+        offs = [int(round(i / n * text_len)) for i in range(n)]
+        return self._legacy_logprobs_block(out.logprobs, offs)
 
     def _prompt_logprobs_dict(self, token_ids, prompt_lps) -> dict:
         """OpenAI legacy completions logprobs block for the echoed prompt:
@@ -640,6 +673,17 @@ class OpenAIPreprocessor(Operator):
         translate = self.chat_stream if is_chat else self.completion_stream
 
         n = preprocessed.sampling_options.n or 1
+        best_of = (getattr(req, "best_of", None) or n) if not is_chat else n
+        if best_of > n:
+            # OpenAI best_of: generate best_of candidates, return the n
+            # with the highest cumulative logprob (buffered — selection
+            # needs complete outputs; preprocess rejected stream/echo)
+            async for chunk in self._best_of(
+                best_of, n, request, preprocessed, next_engine,
+                request_id, req.model,
+            ):
+                yield chunk
+            return
         if n > 1:
             # n-way fan-out: n independent engine streams, choice indices
             # rewritten per stream, usage summed into one final chunk
@@ -662,6 +706,93 @@ class OpenAIPreprocessor(Operator):
             **kwargs,
         ):
             yield chunk
+
+    async def _best_of(
+        self, best_of, n, request, preprocessed, next_engine,
+        request_id, model,
+    ):
+        """OpenAI legacy best_of: run ``best_of`` buffered candidates and
+        return the ``n`` highest-cumulative-logprob completions.
+
+        Candidates are forced to compute chosen-token logprobs (the
+        ranking signal) even when the client asked for none; blocks are
+        attached to the response only when the client did ask. Usage
+        counts EVERY candidate's tokens — all of them were generated.
+        Reference parity: SamplingOptions carries n/best_of
+        (lib/llm/src/protocols/common.rs:248-316).
+        """
+        import dataclasses as _dc
+
+        from ..runtime.engine import AsyncEngineContext
+
+        prompt_tokens = len(preprocessed.token_ids)
+        want_lp = preprocessed.output_options.logprobs
+        child_ctxs = [AsyncEngineContext() for _ in range(best_of)]
+
+        async def relay_stop() -> None:
+            await request.context.wait_stopped()
+            for c in child_ctxs:
+                c.stop_generating()
+
+        # ranking needs chosen-token logprobs even when the client asked
+        # for none (0 = chosen only, no alternatives)
+        oo = _dc.replace(
+            preprocessed.output_options,
+            logprobs=want_lp if want_lp is not None else 0,
+        )
+
+        async def one(i: int):
+            sub = _child_request(preprocessed, i, output_options=oo)
+            sub_ctx = Context(sub, child_ctxs[i], dict(request.baggage))
+            text, cum, ntoks, finish = "", 0.0, 0, None
+            entries, offs = [], []
+            async for out in next_engine.generate(sub_ctx):
+                base, ln = len(text), len(out.text or "")
+                if out.text:
+                    text += out.text
+                if out.logprobs:
+                    m = len(out.logprobs)
+                    for j, lp in enumerate(out.logprobs):
+                        cum += lp.logprob
+                        entries.append(lp)
+                        offs.append(base + int(round(j / m * ln)))
+                ntoks = max(ntoks, out.cum_tokens)
+                if out.finish_reason:
+                    finish = out.finish_reason.to_openai()
+            return text, cum, ntoks, finish, entries, offs
+
+        stop_task = asyncio.ensure_future(relay_stop())
+        try:
+            results = await asyncio.gather(*(one(i) for i in range(best_of)))
+        finally:
+            stop_task.cancel()
+            for c in child_ctxs:
+                c.stop_generating()
+
+        # OpenAI's documented selection: highest log probability PER
+        # TOKEN — raw cumulative sums would systematically favor short
+        # (early-stopping) candidates
+        ranked = sorted(
+            results, key=lambda r: r[1] / max(len(r[4]), 1), reverse=True
+        )[:n]
+        choices = []
+        for idx, (text, _cum, _nt, finish, entries, offs) in enumerate(ranked):
+            lp_dict = (
+                self._legacy_logprobs_block(entries, offs)
+                if want_lp is not None and entries else None
+            )
+            choices.append(CompletionChoice(
+                index=idx, text=text, finish_reason=finish, logprobs=lp_dict,
+            ))
+        completion_tokens = sum(r[2] for r in results)
+        yield CompletionResponse(
+            id=request_id, model=model, choices=choices,
+            usage=Usage(
+                prompt_tokens=prompt_tokens,
+                completion_tokens=completion_tokens,
+                total_tokens=prompt_tokens + completion_tokens,
+            ),
+        )
 
     async def _fan_out(
         self, n, request, preprocessed, next_engine, translate,
@@ -697,15 +828,7 @@ class OpenAIPreprocessor(Operator):
                 c.stop_generating()
 
         async def one_choice(i: int) -> None:
-            seed = preprocessed.sampling_options.seed
-            samp = _dc.replace(
-                preprocessed.sampling_options,
-                n=1,
-                seed=(seed + i) if seed is not None else None,
-            )
-            sub = _dc.replace(
-                preprocessed, sampling_options=samp, annotation_values={}
-            )
+            sub = _child_request(preprocessed, i)
             sub_ctx = Context(sub, child_ctxs[i], dict(request.baggage))
             async for chunk in translate(
                 request_id, model, next_engine.generate(sub_ctx),

@@ -11,6 +11,7 @@ from dynamo_tpu.protocols.common import FinishReason
 from dynamo_tpu.protocols.openai import (
     ChatCompletionChunk,
     ChatCompletionRequest,
+    CompletionRequest,
     aggregate_chat_stream,
 )
 from dynamo_tpu.runtime.engine import Context, EngineError
@@ -309,6 +310,95 @@ async def test_completion_stream_carries_legacy_logprobs(mdc, tokenizer):
     assert lp["top_logprobs"][1] is None
 
 
+async def test_best_of_selects_highest_cum_logprob(mdc, tokenizer):
+    """best_of=3, n=1: three candidates run, the highest-cumulative-
+    logprob one returns, usage counts every candidate's tokens."""
+    from dynamo_tpu.llm.backend import BackendOutput
+    from dynamo_tpu.protocols.common import TokenLogprob
+    from dynamo_tpu.runtime.engine import AsyncEngine
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    seen_seeds = []
+
+    class FakeEngine(AsyncEngine):
+        async def generate(self, ctx):
+            seed = ctx.payload.sampling_options.seed
+            seen_seeds.append(seed)
+            # candidate quality keyed off the child seed offset
+            lp = {10: -0.1, 11: -2.0, 12: -0.9}[seed]
+            yield BackendOutput(
+                token_ids=[5], text=f"cand{seed}", cum_tokens=2,
+                finish_reason=None,
+                logprobs=[TokenLogprob(5, lp, None)],
+            )
+            from dynamo_tpu.protocols.common import FinishReason
+            yield BackendOutput(
+                token_ids=[6], text="!", cum_tokens=2,
+                finish_reason=FinishReason.STOP,
+                logprobs=[TokenLogprob(6, -0.1, None)],
+            )
+
+    req = CompletionRequest(model="m", prompt="x", best_of=3, n=1, seed=10)
+    chunks = [c async for c in pre.generate(Context(req), FakeEngine())]
+    assert len(chunks) == 1
+    resp = chunks[0]
+    assert sorted(seen_seeds) == [10, 11, 12]
+    assert len(resp.choices) == 1
+    assert resp.choices[0].text == "cand10!"       # -0.2 beats -1.0/-2.1
+    assert resp.choices[0].index == 0
+    assert resp.choices[0].logprobs is None        # client asked for none
+    assert resp.usage.completion_tokens == 6       # all three candidates
+
+
+async def test_best_of_returns_n_ranked_with_logprobs(mdc, tokenizer):
+    from dynamo_tpu.llm.backend import BackendOutput
+    from dynamo_tpu.protocols.common import FinishReason, TokenLogprob
+    from dynamo_tpu.runtime.engine import AsyncEngine
+
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+
+    class FakeEngine(AsyncEngine):
+        async def generate(self, ctx):
+            seed = ctx.payload.sampling_options.seed
+            lp = {7: -3.0, 8: -0.5, 9: -1.0}[seed]
+            yield BackendOutput(
+                token_ids=[5], text=f"c{seed}", cum_tokens=1,
+                finish_reason=FinishReason.STOP,
+                logprobs=[TokenLogprob(5, lp, {5: lp})],
+            )
+
+    req = CompletionRequest(
+        model="m", prompt="x", best_of=3, n=2, seed=7, logprobs=1)
+    chunks = [c async for c in pre.generate(Context(req), FakeEngine())]
+    resp = chunks[0]
+    assert [c.text for c in resp.choices] == ["c8", "c9"]  # ranked
+    assert [c.index for c in resp.choices] == [0, 1]
+    assert resp.choices[0].logprobs["token_logprobs"] == [-0.5]
+    assert resp.choices[0].logprobs["top_logprobs"][0]
+
+
+def test_best_of_rejections(mdc, tokenizer):
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    from dynamo_tpu.runtime.engine import EngineError
+
+    with pytest.raises(EngineError):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=1, n=2))
+    with pytest.raises(EngineError):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=3, stream=True))
+    with pytest.raises(EngineError):
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=3, echo=True))
+    with pytest.raises(EngineError):  # greedy candidates are identical
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=3,
+                              temperature=0))
+    with pytest.raises(EngineError):  # OpenAI's amplification cap
+        pre.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", best_of=21))
+
+
 def test_int_keyed_dicts_survive_msgpack_strict_decode():
     """logit_bias and top-logprob dicts ride msgpack planes whose decoders
     use the strict default (int map keys rejected) — wire forms must
@@ -344,15 +434,13 @@ def test_int_keyed_dicts_survive_msgpack_strict_decode():
     assert RemotePrefillRequest.from_wire(rpr.to_wire()).logit_bias == {3: 1.0}
 
 
-def test_best_of_rejected_unless_equal_n(mdc, tokenizer):
-    from dynamo_tpu.protocols.openai import CompletionRequest
-    from dynamo_tpu.runtime.engine import EngineError
-
+def test_best_of_accepted_non_streaming(mdc, tokenizer):
     pre = OpenAIPreprocessor(mdc, tokenizer)
-    with pytest.raises(EngineError, match="best_of"):
-        pre.preprocess_completion(
-            CompletionRequest(model="m", prompt="x", best_of=3)
-        )
+    # best_of > n: accepted for buffered selection (see _best_of)
+    out = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="x", best_of=3)
+    )
+    assert out.sampling_options.n in (None, 1)
     # best_of == n degenerates to plain n-way sampling — accepted
     out = pre.preprocess_completion(
         CompletionRequest(model="m", prompt="x", best_of=2, n=2)
