@@ -194,8 +194,9 @@ async def test_prefill_queue_ack_and_redelivery():
 # ---------------------------------------------------------------- e2e
 
 
-async def _decode_engine_with_disagg(hf_model_dir, hub, **router_kw):
-    runner, econfig = _make_runner(hf_model_dir)
+async def _decode_engine_with_disagg(hf_model_dir, hub, engine_overrides=None,
+                                     **router_kw):
+    runner, econfig = _make_runner(hf_model_dir, **(engine_overrides or {}))
     drt = DistributedRuntime.in_process(hub)
     timeout = router_kw.pop("timeout", 60.0)
     router = DisaggRouter(**router_kw)
@@ -207,6 +208,44 @@ async def _decode_engine_with_disagg(hf_model_dir, hub, **router_kw):
     sched = Scheduler(runner, econfig, disagg=coord)
     sched.start()
     return sched, coord, drt, econfig
+
+
+async def test_remote_prefill_with_spec_decode_matches_local(hf_model_dir):
+    """Ngram speculative decoding on a disagg decode worker: the stream
+    after a REMOTE prefill (seq installed from transferred KV) must equal
+    pure local generation — proposals draw on the installed history."""
+    prompt = [1, 9, 8, 9, 8, 9, 8, 9, 8, 21, 40, 2]  # repetitive → proposals
+
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base-spec", prompt, max_tokens=12)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+
+    hub = MemoryHub()
+    sched, coord, drt_d, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0,
+        max_prefill_queue_size=100,
+        engine_overrides={"spec_ngram_tokens": 4, "spec_ngram_match": 2},
+    )
+    runner_p, pconfig = _make_runner(hf_model_dir)
+    drt_p = DistributedRuntime.in_process(hub)
+    worker = PrefillWorker(drt_p, runner_p, pconfig)
+    worker_task = asyncio.create_task(worker.run())
+    try:
+        er1 = _greedy_request("r1-spec", prompt, max_tokens=12)
+        sched.add_request(er1)
+        out1 = await _collect(er1)
+        assert out1 == baseline
+        assert coord.remote_completed == 1
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await sched.stop()
+        await drt_p.close()
+        await drt_d.close()
 
 
 async def test_remote_prefill_matches_local(hf_model_dir):
