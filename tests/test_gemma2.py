@@ -132,6 +132,42 @@ async def test_gemma2_engine_greedy_matches_hf_generate(model_dir, hf_out):
     assert toks == hf_gen
 
 
+@pytest.mark.asyncio
+async def test_gemma2_multi_step_burst_bit_equal(model_dir):
+    """The fused decode burst composes with gemma2's distinct logit tail
+    (softcap inside logits_from_hidden): streams identical at K=1/K=4."""
+    from dynamo_tpu.engine.serving import JaxServingEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+
+    async def serve(k):
+        mcfg = ModelConfig.from_model_dir(model_dir)
+        mcfg.attention_impl = "xla"
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=EngineConfig(
+                model=mcfg, max_batch_size=2, max_model_len=64,
+                kv_block_size=8, num_kv_blocks=32, dtype="float32",
+                multi_step_decode=k,
+            ), warmup=False)
+        req = PreprocessedRequest(
+            token_ids=PROMPT,
+            stop_conditions=StopConditions(max_tokens=12, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.8, seed=3),
+        )
+        toks = []
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+        await engine.close()
+        return toks
+
+    assert await serve(1) == await serve(4)
+
+
 def test_sliding_window_actually_masks(model_dir):
     """With the window forced tiny, positions beyond it must stop
     influencing the next-token logits on sliding layers: perturbing an

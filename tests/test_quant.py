@@ -164,9 +164,12 @@ async def test_quantized_engine_serves_deterministically(tmp_path):
     mdc = ModelDeploymentCard.from_local_path(d)
     mcfg = ModelConfig.from_model_dir(d)
     mcfg.quantization = "int8"
+    # composed with the fused burst AND ngram speculation: the quantized
+    # head feeds both the scan body and the verify's greedy argmax
     econfig = EngineConfig(
         model=mcfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
         num_kv_blocks=32, dtype="float32", multi_step_decode=4,
+        spec_ngram_tokens=4, spec_ngram_match=2,
     )
     engine = await JaxServingEngine.create(
         mdc, engine_config=econfig, warmup=False)
