@@ -387,6 +387,66 @@ async def test_preemption_resumes_stream(hf_model_dir):
 
 
 @pytest.mark.asyncio
+async def test_preemption_under_speculative_decode(hf_model_dir):
+    """KV OOM during the speculative path (which reserves K+1 positions
+    ahead) must preempt and resume with the same continuity guarantees
+    as plain decode — and the resumed stream still totals max_tokens."""
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+
+    async def run_with(num_blocks, prompts, max_tokens=20):
+        econfig = EngineConfig(
+            model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
+            num_kv_blocks=num_blocks, dtype="float32",
+            enable_prefix_caching=False,
+            spec_ngram_tokens=4, spec_ngram_match=2,
+        )
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=econfig, warmup=False
+        )
+        sched = engine.scheduler
+        preempted = []
+        orig = sched._preempt
+
+        def rec(er):
+            preempted.append(er.request_id)
+            orig(er)
+
+        sched._preempt = rec
+
+        async def one(p):
+            req = PreprocessedRequest(
+                token_ids=p,
+                stop_conditions=StopConditions(
+                    max_tokens=max_tokens, ignore_eos=True
+                ),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            toks = []
+            async for out in engine.generate(Context(req)):
+                toks.extend(out["token_ids"])
+            return toks
+
+        outs = await asyncio.gather(*(one(p) for p in prompts))
+        m = engine.metrics()
+        await engine.close()
+        return outs, preempted, m
+
+    # repetitive prompts so ngram proposals fire
+    prompts = [
+        [1] + [9, 8] * 8,
+        [1] + [5, 6] * 8,
+        [1] + [3, 4] * 8,
+    ]
+    want, none_preempted, _ = await run_with(64, prompts)
+    assert not none_preempted
+    got, preempted, metrics = await run_with(10, prompts)
+    assert preempted, "test is vacuous: no preemption happened"
+    for w, g in zip(want, got):
+        assert len(g) == len(w) == 20  # no restarted/duplicated emission
+
+
+@pytest.mark.asyncio
 async def test_chunked_prefill_bounds_decode_stall(hf_model_dir):
     """With max_prefill_tokens_per_step set, a long prompt prefills in
     chunks interleaved with decode steps, and outputs stay identical."""
