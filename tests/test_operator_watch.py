@@ -103,6 +103,49 @@ def test_watch_list_failure_is_not_no_crs():
     assert kube.objects  # children survived the API outage
 
 
+def test_watch_churn_converges_to_final_cr_set():
+    """Arbitrary interleavings of ADDED/MODIFIED/DELETED across several
+    reconnects must converge: children exist exactly for the CRs alive
+    at the end, regardless of event order or drops between streams."""
+    import random
+
+    rng = random.Random(7)
+    names = [f"g{i}" for i in range(5)]
+    kube = InMemoryKube()
+    rec = Reconciler(kube)
+    alive = {}
+    streams = []
+    for _ in range(6):  # six reconnects
+        events = []
+        for _ in range(8):
+            name = rng.choice(names)
+            if name in alive and rng.random() < 0.4:
+                events.append({"type": "DELETED", "object": alive.pop(name)})
+            else:
+                cr = _cr(name, generation=rng.randrange(100))
+                alive[name] = cr
+                events.append({
+                    "type": rng.choice(["ADDED", "MODIFIED"]), "object": cr,
+                })
+        # drop a random suffix: the relist must repair what the stream
+        # never delivered (deletions between streams)
+        streams.append(events[: rng.randrange(4, len(events) + 1)])
+        # CRs deleted in the dropped suffix are still deleted cluster-side
+        for e in events[len(streams[-1]):]:
+            key = e["object"]["metadata"]["name"]
+            if e["type"] == "DELETED":
+                alive.pop(key, None)
+            else:
+                alive[key] = e["object"]
+
+    _run_watch_once(rec, list(alive.values()), streams)
+    have = {
+        m["metadata"]["labels"]["app.kubernetes.io/instance"]
+        for m in kube.objects.values()
+    }
+    assert have == set(alive)
+
+
 class FakeClock:
     def __init__(self):
         self.t = 0.0
