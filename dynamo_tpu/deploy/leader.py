@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import subprocess
 import threading
 import time
@@ -104,11 +105,14 @@ class KubectlLeases:
         obj = json.loads(proc.stdout)
         return obj.get("spec", {}), obj["metadata"].get("resourceVersion")
 
-    # stderr markers of a genuine lost CAS race (vs a transport failure,
-    # which must raise — a transient API error misread as "conflict"
-    # would depose a leader that still holds a valid lease)
-    _CONFLICT_MARKERS = ("conflict", "alreadyexists", "already exists",
-                         "object has been modified")
+    # a genuine lost CAS race surfaces as kubectl's structured status
+    # reason — "Error from server (Conflict): ..." / "(AlreadyExists)".
+    # Match that token, not free-text substrings: an unrelated API error
+    # whose message merely *contains* "conflict" must raise (transient
+    # failure), not read as an authoritative loss that deposes a leader
+    # still holding a valid lease.
+    _CAS_REASON = re.compile(
+        r"error from server \((conflict|alreadyexists)\)", re.IGNORECASE)
 
     def write(self, namespace: str, name: str, spec: dict,
               expected_version: Optional[str]) -> bool:
@@ -120,7 +124,7 @@ class KubectlLeases:
         )
         if proc.returncode != 0:
             err = proc.stderr.strip()
-            if any(m in err.lower() for m in self._CONFLICT_MARKERS):
+            if self._CAS_REASON.search(err):
                 logger.debug("lease write lost the CAS race: %s", err)
                 return False
             raise RuntimeError(f"lease write failed: {err}")

@@ -781,6 +781,28 @@ class ModelRunner:
         from ..ops.attention import resolve_attention_impl
 
         cfg = self.config.model
+        if (cfg.attn_logit_softcap or cfg.sliding_window) and \
+                resolve_attention_impl(cfg.attention_impl) != "xla":
+            # ops/attention.py forces impl="xla" per-call for these
+            # semantics (the Pallas kernels implement neither softcapping
+            # nor windowed masks) — say so once at init instead of
+            # silently serving whole families off the fast path
+            # (docs/models.md#attention-path-limitations), and resolve the
+            # config to xla so warmup never probes/compiles Pallas
+            # attention kernels that could not execute anyway
+            logger.info(
+                "model uses %s: attention serves on the XLA path (the "
+                "Pallas kernels do not implement these semantics)",
+                " + ".join(
+                    n for n, on in (
+                        ("logit softcapping", cfg.attn_logit_softcap),
+                        ("sliding-window masks", cfg.sliding_window),
+                    ) if on
+                ),
+            )
+            cfg.attention_impl = "xla"
+            self._build_step()
+            self._build_burst()
         if (cfg.attention_impl == "auto"
                 and resolve_attention_impl("auto") == "pallas"):
             import os

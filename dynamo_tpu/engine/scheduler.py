@@ -610,6 +610,15 @@ class Scheduler:
         # per-row take
         if budget:
             allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
+            # a full batch can exceed the budget even at the smallest
+            # bucket — admit fewer rows this step instead of overrunning
+            # (the tail of `ers` stays in self.prefilling for next pass)
+            while not allowed and rows > cfg.PREFILL_ROW_BUCKETS[0]:
+                rows = max(r for r in cfg.PREFILL_ROW_BUCKETS if r < rows)
+                ers = ers[:rows]
+                allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
+            # budget < one row at the smallest bucket: best-effort floor
+            # (a single row must still advance or prefill livelocks)
             bucket_cap = allowed[-1] if allowed else cfg.prefill_buckets[0]
         else:
             bucket_cap = cfg.prefill_buckets[-1]

@@ -619,6 +619,15 @@ class OpenAIPreprocessor(Operator):
                         )
                     ],
                 )
+        if echo_pending:
+            # the backend stream ended without a single output (immediate
+            # cancel/zero-token completion) — the client still must get the
+            # echoed prompt text, just without prompt logprobs
+            yield CompletionResponse(
+                id=request_id,
+                model=model,
+                choices=[CompletionChoice(text=echo_text, finish_reason=None)],
+            )
         if include_usage:
             yield CompletionResponse(
                 id=request_id,

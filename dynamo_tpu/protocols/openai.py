@@ -13,9 +13,36 @@ import time
 import uuid
 from typing import Any, Dict, List, Literal, Optional, Union
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 from .common import FinishReason
+
+# Request fields the engine does not honor. The reference carries
+# use_beam_search/length_penalty in SamplingOptions as an engine
+# pass-through (reference: lib/llm/src/protocols/common.rs:248-316); no
+# TPU engine here implements beam search, so accepting them silently
+# would change sampling semantics without telling the client. Reject at
+# the boundary with a 400 instead.
+_UNSUPPORTED_SAMPLING_FIELDS = ("use_beam_search", "length_penalty")
+
+
+def _reject_unsupported_extras(req: BaseModel) -> BaseModel:
+    extra = req.model_extra or {}
+    # no-op values are allowed: clients built on vLLM-style SamplingParams
+    # serialize their defaults (use_beam_search=false, length_penalty=1.0),
+    # which request no beam search at all
+    if extra.get("use_beam_search"):
+        raise ValueError(
+            "'use_beam_search' is not supported by this server (beam "
+            "search is not implemented); remove it from the request"
+        )
+    lp = extra.get("length_penalty")
+    if lp is not None and lp != 1.0:
+        raise ValueError(
+            "'length_penalty' is not supported by this server (beam "
+            "search is not implemented); remove it from the request"
+        )
+    return req
 
 
 class NvExt(BaseModel):
@@ -79,6 +106,8 @@ class ChatCompletionRequest(BaseModel):
     response_format: Optional[Dict[str, Any]] = None
     nvext: Optional[NvExt] = None
 
+    _no_beam = model_validator(mode="after")(_reject_unsupported_extras)
+
     def effective_max_tokens(self) -> Optional[int]:
         # `is None`, not falsy: max_completion_tokens=0 means an empty
         # completion, same as the completions endpoint's max_tokens=0
@@ -117,6 +146,8 @@ class CompletionRequest(BaseModel):
     ignore_eos: Optional[bool] = None
     user: Optional[str] = None
     nvext: Optional[NvExt] = None
+
+    _no_beam = model_validator(mode="after")(_reject_unsupported_extras)
 
     def stop_list(self) -> List[str]:
         if self.stop is None:

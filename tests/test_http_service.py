@@ -122,6 +122,56 @@ async def test_unknown_model_404_and_bad_body_400():
 
 
 @pytest.mark.asyncio
+async def test_beam_search_fields_rejected_400():
+    """use_beam_search/length_penalty are engine pass-throughs in the
+    reference (lib/llm/src/protocols/common.rs:248-316) that no engine here
+    honors — they must be rejected loudly, not silently ignored."""
+    service = await start_echo_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            for field, value in (("use_beam_search", True), ("length_penalty", 0.8)):
+                async with s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={
+                        "model": "echo",
+                        "messages": [{"role": "user", "content": "x"}],
+                        field: value,
+                    },
+                ) as r:
+                    assert r.status == 400
+                    body = await r.json()
+                    assert field in body["error"]["message"]
+                async with s.post(
+                    f"http://127.0.0.1:{service.port}/v1/completions",
+                    json={"model": "echo", "prompt": "x", field: value},
+                ) as r:
+                    assert r.status == 400
+            # no-op values (vLLM-client serialized defaults) are allowed:
+            # null, use_beam_search=false, length_penalty=1.0
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "use_beam_search": False,
+                    "length_penalty": 1.0,
+                },
+            ) as r:
+                assert r.status == 200
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "echo",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "use_beam_search": None,
+                },
+            ) as r:
+                assert r.status == 200
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
 async def test_streaming_validation_error_is_http_400(tmp_path):
     """Oversized prompt with stream=true must get a 400, not a 200-SSE-error."""
     model_dir = make_model_dir(tmp_path)

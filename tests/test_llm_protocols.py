@@ -543,3 +543,26 @@ async def test_completion_echo_carries_prompt_logprobs(mdc, tokenizer):
     assert lp["token_logprobs"][0] is None
     assert all(v == -0.5 for v in lp["token_logprobs"][1:])
     assert lp["text_offset"][0] == 0
+
+
+async def test_completion_echo_emitted_when_stream_yields_nothing(mdc, tokenizer):
+    """ADVICE r3: with echo+logprobs the echo chunk waits for the first
+    backend output — but if the stream ends with none (immediate
+    cancel/zero-token completion) the client must still get the echoed
+    prompt text, just without prompt logprobs."""
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+
+    async def empty_backend():
+        return
+        yield  # pragma: no cover
+
+    chunks = [
+        r async for r in pre.completion_stream(
+            "cmpl-3", "m", empty_backend(), prompt_tokens=2,
+            echo_text="hello world", prompt_token_ids=[3, 4],
+        )
+    ]
+    assert chunks, "echo chunk was dropped on an empty stream"
+    echo = chunks[0].choices[0]
+    assert echo.text == "hello world"
+    assert echo.logprobs is None

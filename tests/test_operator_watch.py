@@ -207,6 +207,22 @@ def test_leader_kubectl_read_raises_on_non_notfound_failure():
         KubectlLeases(kubectl="false").read("default", "x")
 
 
+def test_leader_kubectl_write_classifies_structured_reason_only():
+    # only kubectl's structured status reason — "Error from server
+    # (Conflict)" / "(AlreadyExists)" — means a lost CAS race; an
+    # unrelated error merely *containing* the word "conflict" must raise
+    from dynamo_tpu.deploy.leader import KubectlLeases
+
+    cas = KubectlLeases._CAS_REASON
+    assert cas.search('Error from server (Conflict): Operation cannot be '
+                      'fulfilled on leases.coordination.k8s.io "x"')
+    assert cas.search('error from server (AlreadyExists): leases "x" '
+                      'already exists')
+    assert not cas.search('error validating data: field conflict in spec')
+    assert not cas.search('dial tcp: lookup apiserver: conflict-zone.local '
+                          'no such host')
+
+
 def test_leader_cas_conflict_single_winner():
     leases = InMemoryLeases()
     electors = [LeaderElector(leases, f"e{i}", clock=FakeClock())
