@@ -65,6 +65,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=None)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    p.add_argument("--isolate-engine", action="store_true",
+                   help="host pystr:/pytok: engines in a supervised "
+                        "subprocess (heartbeat + respawn; an engine crash "
+                        "or hung compile cannot take the worker down)")
+    p.add_argument("--engine-heartbeat-s", type=float, default=5.0,
+                   help="isolated-engine heartbeat interval; the child's "
+                        "event loop must pong within interval x misses "
+                        "(sync work belongs in run_in_executor)")
+    p.add_argument("--engine-heartbeat-misses", type=int, default=6,
+                   help="consecutive missed pongs before the isolated "
+                        "engine is declared wedged and killed")
+    p.add_argument("--engine-init-timeout-s", type=float, default=120.0,
+                   help="isolated-engine spawn+initialize() deadline")
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="host-RAM KV offload tier capacity in blocks (0 = off)")
     p.add_argument("--multi-step-decode", type=int, default=1,
@@ -171,6 +184,23 @@ def _engine_args(flags) -> dict:
         return json.load(f)
 
 
+async def _load_python_engine(path: str, flags):
+    """BYO python-file engine, in-process or (``--isolate-engine``)
+    hosted in a supervised subprocess with heartbeat + respawn."""
+    if getattr(flags, "isolate_engine", False):
+        from ..llm.engines.subprocess_host import SubprocessEngine
+
+        return await SubprocessEngine.load(
+            path, _engine_args(flags),
+            heartbeat_interval_s=getattr(flags, "engine_heartbeat_s", 5.0),
+            heartbeat_misses=getattr(flags, "engine_heartbeat_misses", 6),
+            init_timeout_s=getattr(flags, "engine_init_timeout_s", 120.0),
+        )
+    from ..llm.engines.python_file import PythonFileEngine
+
+    return await PythonFileEngine.load(path, _engine_args(flags))
+
+
 async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None):
     """Token-level engine (PreprocessedRequest → EngineOutput stream)."""
     from ..llm.engines.echo import EchoEngineCore
@@ -178,10 +208,8 @@ async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None)
     if engine_spec == "echo_core":
         return EchoEngineCore()
     if engine_spec.startswith("pytok:"):
-        from ..llm.engines.python_file import PythonFileEngine
-
-        return await PythonFileEngine.load(
-            engine_spec[len("pytok:"):], _engine_args(flags)
+        return await _load_python_engine(
+            engine_spec[len("pytok:"):], flags
         )
     if engine_spec == "jax":
         from ..engine.serving import JaxServingEngine
@@ -224,10 +252,8 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         return EchoEngineFull(), None
     if engine_spec.startswith("pystr:"):
         # bring-your-own OpenAI-level engine (reference: out=pystr:<file>)
-        from ..llm.engines.python_file import PythonFileEngine
-
-        engine = await PythonFileEngine.load(
-            engine_spec[len("pystr:"):], _engine_args(flags)
+        engine = await _load_python_engine(
+            engine_spec[len("pystr:"):], flags
         )
         return engine, None
 

@@ -1,0 +1,457 @@
+"""Out-of-process engine hosting: supervised subprocess + framed IPC.
+
+Reference analog: the reference runs GPU engines as supervised child
+processes with an IPC plane and liveness checks (reference:
+lib/engines/sglang/src/worker.rs:307-445 spawn/monitor/respawn,
+lib/engines/vllm0_7/src/worker.rs:96-115, ZMQ plane
+lib/runtime/src/transports/zmq.rs:98-418). Here the same isolation is
+built TPU-first: the hazard this quarantines is not a CUDA OOM but a
+pathological Mosaic/XLA compile that can hang an entire host process
+(and, through it, the worker's lease bookkeeping). The engine child can
+hang or die arbitrarily; the hosting worker stays alive, fails the
+in-flight requests cleanly through the error prologue, and respawns.
+
+Plane layout (one unix socket per engine, frames are the runtime's
+4-byte length-prefixed msgpack maps — same codec as runtime/network.py):
+
+    parent → child:  {t: "init", engine_args}          once, first
+                     {t: "req",  id, payload}          start a stream
+                     {t: "stop", id} | {t: "kill", id} cancel a stream
+                     {t: "ping", n}                    heartbeat
+                     {t: "shutdown"}                   graceful exit
+    child → parent:  {t: "ready"} | {t: "init_error", error}
+                     {t: "data", id, payload}
+                     {t: "end",  id} | {t: "error", id, error}
+                     {t: "pong", n}
+
+Streams multiplex over the one socket by request id. Heartbeats ride the
+same socket on purpose: a child whose event loop is wedged (compile hang
+in the import path, user code blocking the loop) stops ponging even
+though the process is alive — exactly the failure kill -9 can't detect
+from the outside.
+
+Supervision policy: a child that exits, breaks the socket, or misses
+``heartbeat_misses`` consecutive pongs is SIGKILLed; every in-flight
+request fails with ``EngineError`` (before first output → the network
+layer's error prologue) or ``EngineStreamDied`` (mid-stream). The next
+``generate`` respawns lazily, up to ``max_restarts`` consecutive
+failed spawns with exponential backoff; a successful init resets the
+budget.
+
+Engine-author contract: the heartbeat measures the child's EVENT LOOP,
+so a ``generate`` that runs long synchronous work inline (a blocking
+jit compile, CPU tokenization loops) will stop ponging and be killed as
+wedged. Run sync work through ``run_in_executor`` (as
+examples/external_engine/engine.py does) — or raise the budget: the
+defaults (5s × 6 misses ≈ 30s) and ``init_timeout_s`` are tunable per
+engine via the CLI's ``--engine-heartbeat-s/--engine-heartbeat-misses/
+--engine-init-timeout-s``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import sys
+import tempfile
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ...runtime.engine import AsyncEngine, Context, EngineError
+
+logger = logging.getLogger(__name__)
+
+
+class EngineStreamDied(Exception):
+    """The engine process died after the stream had started."""
+
+
+def _to_wire(payload: Any) -> Any:
+    if hasattr(payload, "model_dump"):
+        return payload.model_dump(exclude_none=True)
+    if hasattr(payload, "to_wire"):
+        return payload.to_wire()
+    return payload
+
+
+class SubprocessEngine(AsyncEngine):
+    """Hosts a BYO python-file engine (python_file.py contract) in a
+    supervised child process behind the AsyncEngine trait."""
+
+    def __init__(
+        self,
+        path: str,
+        engine_args: Optional[dict] = None,
+        *,
+        init_timeout_s: float = 120.0,
+        heartbeat_interval_s: float = 5.0,
+        heartbeat_misses: int = 6,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.5,
+        child_env: Optional[Dict[str, str]] = None,
+    ):
+        self.path = path
+        self.engine_args = engine_args or {}
+        self.init_timeout_s = init_timeout_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_misses = heartbeat_misses
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.child_env = child_env
+
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self._pong = 0
+        self._spawn_lock: Optional[asyncio.Lock] = None
+        self._consecutive_failures = 0
+        self._closed = False
+        # observability for tests/metrics: how many times the child was
+        # (re)spawned successfully
+        self.spawn_count = 0
+
+    @classmethod
+    async def load(
+        cls, path: str, engine_args: Optional[dict] = None, **kw
+    ) -> "SubprocessEngine":
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"python engine file not found: {path}")
+        eng = cls(path, engine_args, **kw)
+        await eng._ensure_running()
+        return eng
+
+    # ---------- lifecycle ----------
+
+    async def _ensure_running(self) -> None:
+        if self._closed:
+            raise EngineError("engine host is closed")
+        if self._spawn_lock is None:
+            self._spawn_lock = asyncio.Lock()
+        async with self._spawn_lock:
+            if self._proc is not None and self._proc.returncode is None \
+                    and self._writer is not None:
+                return
+            delay = self.restart_backoff_s
+            while True:
+                if self._consecutive_failures > self.max_restarts:
+                    raise EngineError(
+                        f"engine {self.path} failed to start "
+                        f"{self._consecutive_failures} consecutive times; "
+                        "giving up"
+                    )
+                try:
+                    await self._spawn_once()
+                    self._consecutive_failures = 0
+                    return
+                except EngineError:
+                    raise
+                except Exception as e:
+                    self._consecutive_failures += 1
+                    logger.warning(
+                        "engine spawn attempt failed (%d/%d): %s",
+                        self._consecutive_failures, self.max_restarts, e,
+                    )
+                    if self._consecutive_failures > self.max_restarts:
+                        raise EngineError(
+                            f"engine {self.path} failed to start: {e}"
+                        ) from e
+                    await asyncio.sleep(delay)
+                    delay *= 2
+
+    async def _spawn_once(self) -> None:
+        sock_dir = tempfile.mkdtemp(prefix="dyn-engine-")
+        sock_path = os.path.join(sock_dir, "ipc.sock")
+        connected: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        async def on_connect(reader, writer):
+            if not connected.done():
+                connected.set_result((reader, writer))
+            else:  # only the hosted child may dial in
+                writer.close()
+
+        server = await asyncio.start_unix_server(on_connect, sock_path)
+        env = dict(os.environ if self.child_env is None else self.child_env)
+        env["DYN_ENGINE_SOCKET"] = sock_path
+        # the child runs `-m dynamo_tpu...`: make the package importable
+        # regardless of the parent's cwd
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        pp = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = pkg_parent + (os.pathsep + pp if pp else "")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "dynamo_tpu.llm.engines.subprocess_host",
+            self.path, env=env,
+        )
+        try:
+            reader, writer = await asyncio.wait_for(
+                connected, timeout=self.init_timeout_s
+            )
+            from ...runtime.transports.dynstore import read_frame, write_frame
+
+            write_frame(writer, {"t": "init", "engine_args": self.engine_args})
+            await writer.drain()
+            frame = await asyncio.wait_for(
+                read_frame(reader), timeout=self.init_timeout_s
+            )
+            if frame is None:
+                raise RuntimeError("engine exited during init")
+            if frame.get("t") == "init_error":
+                # a deterministic user-code failure: do not burn restarts
+                raise EngineError(
+                    f"engine init failed: {frame.get('error')}"
+                )
+            if frame.get("t") != "ready":
+                raise RuntimeError(f"unexpected init reply {frame.get('t')!r}")
+        except (asyncio.TimeoutError, RuntimeError):
+            with contextlib.suppress(ProcessLookupError):
+                proc.kill()
+            raise
+        finally:
+            server.close()
+            # the socket only exists for the initial dial-in; a
+            # crash-looping engine must not accumulate tmp dirs
+            with contextlib.suppress(OSError):
+                os.unlink(sock_path)
+            with contextlib.suppress(OSError):
+                os.rmdir(sock_dir)
+        self._proc = proc
+        self._writer = writer
+        self._pong = 0
+        self.spawn_count += 1
+        self._reader_task = asyncio.create_task(self._read_loop(reader))
+        self._hb_task = asyncio.create_task(self._heartbeat_loop(writer))
+        logger.info(
+            "engine subprocess for %s up (pid %d)", self.path, proc.pid
+        )
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        from ...runtime.transports.dynstore import read_frame
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                t = frame.get("t")
+                if t == "pong":
+                    self._pong = frame.get("n", 0)
+                    continue
+                q = self._streams.get(frame.get("id"))
+                if q is not None:
+                    q.put_nowait(frame)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        finally:
+            await self._on_child_down("engine process disconnected")
+
+    async def _heartbeat_loop(self, writer: asyncio.StreamWriter) -> None:
+        from ...runtime.transports.dynstore import write_frame
+
+        n = 0
+        try:
+            while True:
+                await asyncio.sleep(self.heartbeat_interval_s)
+                n += 1
+                write_frame(writer, {"t": "ping", "n": n})
+                await writer.drain()
+                if n - self._pong > self.heartbeat_misses:
+                    logger.error(
+                        "engine %s missed %d heartbeats; killing (a wedged "
+                        "child — e.g. a hung compile — never exits on its own)",
+                        self.path, n - self._pong,
+                    )
+                    await self._on_child_down(
+                        f"engine unresponsive: missed "
+                        f"{n - self._pong} heartbeats"
+                    )
+                    return
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            await self._on_child_down("engine process disconnected")
+        except asyncio.CancelledError:
+            raise
+
+    async def _on_child_down(self, reason: str) -> None:
+        """Fail all in-flight streams and reap the child. Idempotent —
+        and the hand-off is claimed SYNCHRONOUSLY before the first await:
+        the heartbeat path and the read-loop EOF path race to call this,
+        and the loser must find nothing left to fail (else the requester
+        sees the generic 'disconnected' instead of the real reason)."""
+        proc, self._proc = self._proc, None
+        writer, self._writer = self._writer, None
+        streams, self._streams = self._streams, {}
+        hb, self._hb_task = self._hb_task, None
+        if proc is not None and proc.returncode is not None:
+            reason = f"{reason} (exit code {proc.returncode})"
+        # fail the streams before any await: past the first suspension
+        # point this task can itself be cancelled by the competing path
+        # (the read loop cancels the heartbeat task, and vice versa), and
+        # a cancelled loser must not take the error frames with it
+        for q in streams.values():
+            q.put_nowait({"t": "error", "error": reason, "died": True})
+        if hb is not None and hb is not asyncio.current_task():
+            hb.cancel()
+        if writer is not None:
+            with contextlib.suppress(Exception):
+                writer.close()
+        if proc is not None:
+            with contextlib.suppress(ProcessLookupError):
+                proc.kill()
+            with contextlib.suppress(Exception):
+                await proc.wait()
+
+    async def close(self) -> None:
+        self._closed = True
+        writer = self._writer
+        if writer is not None:
+            from ...runtime.transports.dynstore import write_frame
+
+            with contextlib.suppress(Exception):
+                write_frame(writer, {"t": "shutdown"})
+                await writer.drain()
+            proc = self._proc
+            if proc is not None:
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(proc.wait(), timeout=2.0)
+        await self._on_child_down("engine host closed")
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+
+    # ---------- serving ----------
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
+        await self._ensure_running()
+        from ...runtime.transports.dynstore import write_frame
+
+        rid = f"{request.id}-{uuid.uuid4().hex[:8]}"
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        writer = self._writer
+        started = False
+        ctx = request.context
+
+        async def watch_cancel():
+            await ctx.wait_stopped()
+            t = "kill" if ctx.is_killed else "stop"
+            w = self._writer
+            if w is not None:
+                with contextlib.suppress(Exception):
+                    write_frame(w, {"t": t, "id": rid})
+                    await w.drain()
+
+        cancel_task = asyncio.create_task(watch_cancel())
+        try:
+            write_frame(writer, {"t": "req", "id": rid,
+                                 "payload": _to_wire(request.payload)})
+            await writer.drain()
+            while True:
+                frame = await q.get()
+                t = frame.get("t")
+                if t == "data":
+                    started = True
+                    yield frame.get("payload")
+                elif t == "end":
+                    return
+                elif t == "error":
+                    msg = frame.get("error", "engine error")
+                    if frame.get("died") and started:
+                        # the stream was already flowing: the network
+                        # layer turns this into a mid-stream err frame
+                        raise EngineStreamDied(msg)
+                    raise EngineError(msg)
+                else:
+                    logger.warning("unexpected engine frame %r", t)
+        finally:
+            cancel_task.cancel()
+            self._streams.pop(rid, None)
+
+
+# ---------------------------------------------------------------------------
+# child entrypoint
+# ---------------------------------------------------------------------------
+
+
+async def _child_main(engine_path: str) -> int:
+    sock = os.environ["DYN_ENGINE_SOCKET"]
+    reader, writer = await asyncio.open_unix_connection(sock)
+    from ...runtime.transports.dynstore import read_frame, write_frame
+
+    init = await read_frame(reader)
+    if init is None or init.get("t") != "init":
+        return 2
+
+    try:
+        from .python_file import PythonFileEngine
+
+        engine = await PythonFileEngine.load(
+            engine_path, init.get("engine_args") or {}
+        )
+    except BaseException as e:  # report, don't just die: init errors are
+        write_frame(writer, {          # deterministic, not restartable
+            "t": "init_error", "error": f"{type(e).__name__}: {e}",
+        })
+        await writer.drain()
+        return 3
+    write_frame(writer, {"t": "ready"})
+    await writer.drain()
+
+    tasks: Dict[str, asyncio.Task] = {}
+    send_lock = asyncio.Lock()
+
+    async def send(frame: dict) -> None:
+        async with send_lock:  # frames from concurrent streams interleave
+            write_frame(writer, frame)
+            await writer.drain()
+
+    async def run_stream(rid: str, payload: Any) -> None:
+        try:
+            async for chunk in engine.generate(Context(payload)):
+                await send({"t": "data", "id": rid, "payload": chunk})
+            await send({"t": "end", "id": rid})
+        except asyncio.CancelledError:
+            await send({"t": "end", "id": rid})
+            raise
+        except BaseException as e:
+            await send({
+                "t": "error", "id": rid,
+                "error": f"{type(e).__name__}: {e}",
+            })
+        finally:
+            tasks.pop(rid, None)
+
+    while True:
+        frame = await read_frame(reader)
+        if frame is None:
+            break
+        t = frame.get("t")
+        if t == "ping":
+            await send({"t": "pong", "n": frame.get("n", 0)})
+        elif t == "req":
+            rid = frame["id"]
+            tasks[rid] = asyncio.create_task(
+                run_stream(rid, frame.get("payload"))
+            )
+        elif t in ("stop", "kill"):
+            task = tasks.get(frame.get("id"))
+            if task is not None:
+                task.cancel()
+        elif t == "shutdown":
+            break
+    for task in list(tasks.values()):
+        task.cancel()
+    return 0
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: python -m dynamo_tpu.llm.engines.subprocess_host "
+              "<engine_file.py>", file=sys.stderr)
+        raise SystemExit(2)
+    raise SystemExit(asyncio.run(_child_main(sys.argv[1])))
+
+
+if __name__ == "__main__":
+    main()

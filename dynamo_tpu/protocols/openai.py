@@ -17,16 +17,12 @@ from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 from .common import FinishReason
 
-# Request fields the engine does not honor. The reference carries
-# use_beam_search/length_penalty in SamplingOptions as an engine
-# pass-through (reference: lib/llm/src/protocols/common.rs:248-316); no
-# TPU engine here implements beam search, so accepting them silently
-# would change sampling semantics without telling the client. Reject at
-# the boundary with a 400 instead.
-_UNSUPPORTED_SAMPLING_FIELDS = ("use_beam_search", "length_penalty")
-
-
 def _reject_unsupported_extras(req: BaseModel) -> BaseModel:
+    """Reject beam-search fields the engine does not honor. The reference
+    carries use_beam_search/length_penalty in SamplingOptions as an engine
+    pass-through (reference: lib/llm/src/protocols/common.rs:248-316); no
+    TPU engine here implements beam search, so accepting them silently
+    would change sampling semantics without telling the client."""
     extra = req.model_extra or {}
     # no-op values are allowed: clients built on vLLM-style SamplingParams
     # serialize their defaults (use_beam_search=false, length_penalty=1.0),
