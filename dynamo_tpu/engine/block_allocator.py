@@ -1,13 +1,25 @@
 """Paged KV block allocator with prefix-cache reuse and KV event hooks.
 
 Semantics follow the reference's block-manager design (SURVEY.md §2.2,
-reference: lib/llm/src/kv/{manager,reuse}.rs — match-then-allocate with a
-reuse pool of refcount-0 hashed blocks, LRU eviction) re-designed around
-the engine's flat block-id space:
+reference: lib/llm/src/kv/{manager,reuse,reserved}.rs) re-designed
+around the engine's flat block-id space:
 
-- ``allocate_prompt`` first matches the prompt's chained block hashes
-  against cached blocks (prefix-cache hit → those tokens skip prefill),
-  then takes free blocks, then evicts LRU reusable blocks.
+- ``allocate_prompt`` stages exactly like the reference's
+  ``KvStorageManager::prepare_prefill_sequence`` (kv/manager.rs:22-121):
+  match INFLIGHT blocks first (refcount > 0 — another sequence is
+  actively computing/holding the same prefix, reference kv/reserved.rs),
+  then REUSABLE pooled blocks (refcount 0, state preserved), then take
+  fresh/evicted blocks and restore any host-tier extension.
+- The reuse pool is priority-ordered FIFO, not flat LRU (reference
+  kv/reuse.rs AvailableBlocks): eviction pops the lowest priority class
+  first and oldest-returned within a class, so important prefixes (e.g.
+  system prompts) are retained longest. Priorities attach per sequence
+  hash via ``set_priority`` — the reference's UpdateBlock control path.
+- ``pin_blocks``/``unpin_blocks`` fence a block against reclaim while an
+  out-of-band consumer (host-tier restore in flight, a KV transfer
+  reading the slot) depends on its contents — the reference's fence/
+  reset machinery (kv/reuse.rs fence, docstring "Synchronization").
+  Freeing a pinned block defers the release until unpin.
 - Completed blocks (prompt or generated) are registered by sequence hash
   and announced via the ``events`` callback — the same stream the KV-aware
   router indexes (kv_router/publisher.py).
@@ -16,8 +28,9 @@ the engine's flat block-id space:
 from __future__ import annotations
 
 import dataclasses
-from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Tuple
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..tokens import compute_block_hashes
 
@@ -28,6 +41,58 @@ class KvEventSink:
 
     on_stored: Callable[[List[int], Optional[int]], None] = lambda hashes, parent: None
     on_removed: Callable[[List[int]], None] = lambda hashes: None
+
+
+class _ReusePool:
+    """Priority-ordered FIFO of refcount-0 cached blocks.
+
+    Eviction order is (priority asc, return-tick asc): the lowest
+    priority class is drained first, oldest first within a class —
+    the reference's PriorityKey ordering (kv/reuse.rs:246-270).
+    Implemented as a lazy-deletion heap; membership is the dict.
+    """
+
+    def __init__(self) -> None:
+        self._entry: Dict[int, Tuple[int, int]] = {}  # bid → (prio, tick)
+        self._heap: List[Tuple[int, int, int]] = []   # (prio, tick, bid)
+        self._tick = itertools.count()
+
+    def add(self, bid: int, priority: int = 0) -> None:
+        tick = next(self._tick)
+        self._entry[bid] = (priority, tick)
+        heapq.heappush(self._heap, (priority, tick, bid))
+
+    def discard(self, bid: int) -> None:
+        self._entry.pop(bid, None)  # heap entry invalidated lazily
+
+    def reprioritize(self, bid: int, priority: int) -> None:
+        if bid in self._entry:
+            # keeps its FIFO position within the NEW class via a new tick
+            self.add(bid, priority)
+
+    def pop(self, skip: Optional[Set[int]] = None) -> Optional[int]:
+        """Evict the (priority, FIFO)-first block, skipping ``skip``."""
+        deferred: List[Tuple[int, int, int]] = []
+        out: Optional[int] = None
+        while self._heap:
+            prio, tick, bid = heapq.heappop(self._heap)
+            if self._entry.get(bid) != (prio, tick):
+                continue  # stale entry (discarded or reprioritized)
+            if skip and bid in skip:
+                deferred.append((prio, tick, bid))
+                continue
+            del self._entry[bid]
+            out = bid
+            break
+        for item in deferred:  # pinned blocks keep their order
+            heapq.heappush(self._heap, item)
+        return out
+
+    def __contains__(self, bid: int) -> bool:
+        return bid in self._entry
+
+    def __len__(self) -> int:
+        return len(self._entry)
 
 
 class BlockAllocator:
@@ -52,26 +117,89 @@ class BlockAllocator:
         self.by_hash: Dict[int, int] = {}
         self.block_hash: Dict[int, int] = {}   # block id → sequence hash
         self.refcount: Dict[int, int] = {}
-        # refcount-0 cached blocks, LRU order (oldest first) — evictable
-        self.reusable: "OrderedDict[int, None]" = OrderedDict()
+        # refcount-0 cached blocks, priority-FIFO order — evictable
+        self.reusable = _ReusePool()
+        # sequence_hash → retention priority (default 0; higher = kept longer)
+        self.hash_priority: Dict[int, int] = {}
+        # fenced blocks: excluded from eviction/free until unpinned.
+        # COUNTED — two consumers can fence the same block (e.g. two
+        # concurrent transfers reading it); the fence holds until the
+        # last unpin
+        self.pinned: Dict[int, int] = {}
+        self._deferred_free: List[int] = []
+        # match staging telemetry (reference manager.rs staging order)
+        self.matched_inflight_total = 0
+        self.matched_reusable_total = 0
 
     # ---------- accounting ----------
 
     @property
     def available(self) -> int:
-        return len(self.free) + len(self.reusable)
+        pinned_reusable = sum(1 for b in self.pinned if b in self.reusable)
+        return len(self.free) + len(self.reusable) - pinned_reusable
 
     @property
     def used(self) -> int:
         return self.num_blocks - self.available
+
+    # ---------- priorities / fences ----------
+
+    def set_priority(self, sequence_hashes: List[int], priority: int) -> None:
+        """Retention priority for blocks by content hash (reference:
+        kv/reuse.rs UpdateBlock). Applies to blocks already pooled and to
+        any future pooling of these hashes; priority 0 blocks evict first."""
+        for h in sequence_hashes:
+            if priority == 0:
+                self.hash_priority.pop(h, None)
+            else:
+                self.hash_priority[h] = priority
+            bid = self.by_hash.get(h)
+            if bid is not None and bid in self.reusable:
+                self.reusable.reprioritize(bid, priority)
+
+    def pin_blocks(self, block_ids: List[int]) -> None:
+        """Fence blocks against reclaim: a pinned block is never evicted
+        from the reuse pool, and a concurrent free defers until the LAST
+        unpin — the guard for restores/transfers reading the slot
+        out-of-band."""
+        for bid in block_ids:
+            self.pinned[bid] = self.pinned.get(bid, 0) + 1
+
+    def unpin_blocks(self, block_ids: List[int]) -> None:
+        for bid in block_ids:
+            n = self.pinned.get(bid, 0) - 1
+            if n > 0:
+                self.pinned[bid] = n
+            else:
+                self.pinned.pop(bid, None)
+        if self._deferred_free:
+            # a block re-acquired while pinned (probe_prefix matched it and
+            # _ref'd) cancels its pending free: releasing it now would make
+            # a LIVE block evictable (silent KV corruption on reuse)
+            self._deferred_free = [
+                b for b in self._deferred_free if self.refcount.get(b, 0) == 0
+            ]
+            ready = [b for b in self._deferred_free if b not in self.pinned]
+            self._deferred_free = [
+                b for b in self._deferred_free if b in self.pinned
+            ]
+            if ready:
+                self._release(ready)
+
+    def fence(self) -> None:
+        """Synchronization point (reference kv/reuse.rs fence): all
+        offloads queued/staged so far are committed to the host tier."""
+        self.flush_offload()
+        if self.tier2 is not None:
+            self.tier2.drain()
 
     # ---------- core ops ----------
 
     def _take_block(self) -> int:
         if self.free:
             return self.free.pop()
-        if self.reusable:
-            bid, _ = self.reusable.popitem(last=False)  # LRU
+        bid = self.reusable.pop(skip=self.pinned)
+        if bid is not None:
             h = self.block_hash.pop(bid, None)
             if h is not None:
                 self.by_hash.pop(h, None)
@@ -155,11 +283,22 @@ class BlockAllocator:
         # pinning the matched prefix removes its refcount-0 blocks from the
         # evictable pool, so subtract them — otherwise _take_block could
         # exhaust mid-allocation after state was already mutated
-        pinned = sum(1 for bid in cached_blocks if bid in self.reusable)
+        pinned = sum(
+            1 for bid in cached_blocks
+            if bid in self.reusable and bid not in self.pinned
+        )
         if n_new > self.available - pinned:
             raise MemoryError(
                 f"need {n_new} blocks, {self.available - pinned} available"
             )
+        # staging telemetry: inflight (shared with a live sequence) vs
+        # reusable-pool matches — the reference's two match stages
+        self.matched_inflight_total += sum(
+            1 for bid in cached_blocks if self.refcount.get(bid, 0) > 0
+        )
+        self.matched_reusable_total += sum(
+            1 for bid in cached_blocks if self.refcount.get(bid, 0) == 0
+        )
         for bid in cached_blocks:
             self._ref(bid)
         new_blocks = [self._take_block() for _ in range(n_new)]
@@ -170,6 +309,10 @@ class BlockAllocator:
         self.flush_offload()
 
         if host_hashes:
+            # commit staged offloads first: drain applies capacity
+            # eviction, and the keep-check below must see the post-drain
+            # store (a staged hash can be the one capacity evicts)
+            self.tier2.drain()
             # taking blocks above may itself have evicted host-tier entries
             # (capacity pressure) — keep only the still-resident prefix run
             keep = 0
@@ -178,7 +321,13 @@ class BlockAllocator:
             host_hashes = host_hashes[:keep]
         if host_hashes:
             restore_bids = new_blocks[: len(host_hashes)]
-            self.tier2.restore(host_hashes, restore_bids)
+            # fence the restore targets for the duration of the restore
+            # dispatch: nothing may reclaim a slot with a copy in flight
+            self.pin_blocks(restore_bids)
+            try:
+                self.tier2.restore(host_hashes, restore_bids)
+            finally:
+                self.unpin_blocks(restore_bids)
             for i, h in enumerate(host_hashes):
                 idx = len(cached_blocks) + i
                 parent = hashes[idx - 1] if idx > 0 else None
@@ -202,7 +351,7 @@ class BlockAllocator:
 
     def _ref(self, bid: int) -> None:
         self.refcount[bid] = self.refcount.get(bid, 0) + 1
-        self.reusable.pop(bid, None)  # no longer evictable
+        self.reusable.discard(bid)  # no longer evictable
 
     def register_complete(
         self, bid: int, sequence_hash: int, parent_hash: Optional[int]
@@ -219,17 +368,28 @@ class BlockAllocator:
 
     def free_blocks(self, block_ids: List[int]) -> None:
         """Release a sequence's references. Hashed blocks become reusable
-        (still matchable until evicted); anonymous blocks go to the free list."""
-        removed_hashes: List[int] = []
+        (still matchable until evicted); anonymous blocks go to the free
+        list. Pinned blocks defer until ``unpin_blocks``."""
+        ready: List[int] = []
         for bid in block_ids:
             rc = self.refcount.get(bid, 0) - 1
             if rc > 0:
                 self.refcount[bid] = rc
                 continue
             self.refcount.pop(bid, None)
-            if bid in self.block_hash and self.enable_prefix_caching:
-                self.reusable[bid] = None
-                self.reusable.move_to_end(bid)
+            if bid in self.pinned:
+                if bid not in self._deferred_free:  # re-freed after re-ref
+                    self._deferred_free.append(bid)
+                continue
+            ready.append(bid)
+        self._release(ready)
+
+    def _release(self, block_ids: List[int]) -> None:
+        removed_hashes: List[int] = []
+        for bid in block_ids:
+            h = self.block_hash.get(bid)
+            if h is not None and self.enable_prefix_caching:
+                self.reusable.add(bid, self.hash_priority.get(h, 0))
             else:
                 h = self.block_hash.pop(bid, None)
                 if h is not None:

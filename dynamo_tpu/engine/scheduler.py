@@ -169,8 +169,10 @@ class Scheduler:
         if config.host_kv_blocks > 0:
             from ..kv import KvHostTier
 
+            # device-array gather: offload staging keeps the D2H copy
+            # asynchronous (host_tier.drain materializes later)
             tier2 = KvHostTier(
-                runner.gather_blocks, runner.scatter_blocks,
+                runner.gather_blocks_device, runner.scatter_blocks,
                 config.host_kv_blocks,
             )
         self.allocator = BlockAllocator(
@@ -405,6 +407,12 @@ class Scheduler:
                         k_steps = 1
                     await self._decode(loop, active, k_steps)
                 progressed = True
+
+            # materialize staged host-tier offloads now that this pass's
+            # device work is already dispatched: the D2H copies overlapped
+            # the step; drain only waits out any straggler
+            if self.allocator.tier2 is not None:
+                self.allocator.tier2.drain()
 
             if not progressed:
                 self.wake.clear()

@@ -7,21 +7,32 @@ scatter/gather copy kernel lib/llm/src/kernels/block_copy.cu) — the
 subsystem behind the reference's "+40% TTFT from KV offload to system
 memory" headline (docs/architecture.md:91). Here the device↔host movement
 is the runner's jitted XLA gather/scatter over the paged cache plus
-``jax.device_get``/``device_put`` host staging.
+asynchronous D2H staging.
 
-A block is offloaded *at HBM eviction time*: when the allocator pops an
-LRU reusable block to hand its slot to new data, the block's KV is still
+A block is offloaded *at HBM eviction time*: when the allocator pops a
+reusable block to hand its slot to new data, the block's KV is still
 intact, so it is read out to host RAM first, keyed by its chained sequence
 hash. On a later prompt whose prefix extends past the HBM-cached blocks,
 host-resident blocks are restored into freshly allocated slots instead of
 being recomputed — turning a prefill recompute into one H2D copy.
+
+Offload is staged, not synchronous (the analog of the reference's
+``CopyStream::trigger_layer`` overlap, lib/llm/src/kv/layer.rs:100-1140):
+``offload_batch`` only *dispatches* the device gather — legal because the
+single device stream executes it before any later write to those slots —
+and starts the D2H copy (``copy_to_host_async``); the decode loop never
+blocks on device→host materialization. ``drain()`` (called by the
+scheduler after the next step is already dispatched, and forced by
+``restore``/allocator ``fence()``) turns finished copies into numpy and
+makes them evictable/capacity-accounted. Staged blocks are matchable the
+whole time — a hit between dispatch and drain is not lost.
 """
 
 from __future__ import annotations
 
 import logging
 from collections import OrderedDict
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,7 +40,8 @@ logger = logging.getLogger(__name__)
 
 
 class KvHostTier:
-    """LRU store of KV blocks in host RAM, keyed by sequence hash."""
+    """Store of KV blocks in host RAM, keyed by sequence hash, with
+    asynchronous device→host staging."""
 
     def __init__(
         self,
@@ -42,16 +54,20 @@ class KvHostTier:
         self.capacity_blocks = capacity_blocks
         # sequence_hash → (k [L,1,bs,KVH,D], v) host arrays; LRU order
         self.store: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        # dispatched-but-unmaterialized gathers: (hashes, k_arr, v_arr)
+        # where the arrays may be device-resident with a D2H in flight
+        self._staged: List[Tuple[List[int], object, object]] = []
+        self._staged_hashes: set = set()
         # telemetry
         self.offloaded_total = 0
         self.restored_total = 0
         self.evicted_total = 0
 
     def __len__(self) -> int:
-        return len(self.store)
+        return len(self.store) + len(self._staged_hashes)
 
     def has(self, sequence_hash: int) -> bool:
-        return sequence_hash in self.store
+        return sequence_hash in self.store or sequence_hash in self._staged_hashes
 
     def offload(self, sequence_hash: int, block_id: int) -> None:
         """Read one HBM block out to host before its slot is reused."""
@@ -60,27 +76,50 @@ class KvHostTier:
     def offload_batch(self, pairs: Sequence[Tuple[int, int]]) -> None:
         """Offload many evicted blocks with ONE bucketed device gather.
 
-        Callers evicting several blocks in a burst (a long prompt's
-        allocation) batch here so the device round-trip is paid once, not
-        per block.
+        Only dispatches: the gather is enqueued on the device stream (so
+        it reads the slots before any later overwrite) and the D2H copy
+        is started; materialization happens in ``drain``. Callers
+        evicting several blocks in a burst (a long prompt's allocation)
+        batch here so the device round-trip is paid once, not per block.
         """
         fresh = []
         for h, bid in pairs:
             if h in self.store:
                 self.store.move_to_end(h)
-            else:
+            elif h not in self._staged_hashes:
                 fresh.append((h, bid))
         if not fresh:
             return
         k, v = self.gather_fn([bid for _h, bid in fresh])
-        for i, (h, _bid) in enumerate(fresh):
-            # copy: a slice view would pin the whole (bucket-padded) gather
-            # buffer, breaking the capacity_blocks accounting
-            self.store[h] = (
-                np.ascontiguousarray(k[:, i : i + 1]),
-                np.ascontiguousarray(v[:, i : i + 1]),
-            )
+        for arr in (k, v):
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        hashes = [h for h, _bid in fresh]
+        self._staged.append((hashes, k, v))
+        self._staged_hashes.update(hashes)
         self.offloaded_total += len(fresh)
+
+    def drain(self) -> None:
+        """Materialize all staged offloads into the host store (blocks
+        only on still-running D2H copies) and enforce capacity."""
+        if not self._staged:
+            return
+        staged, self._staged = self._staged, []
+        self._staged_hashes.clear()
+        for hashes, k, v in staged:
+            k = np.asarray(k)
+            v = np.asarray(v)
+            for i, h in enumerate(hashes):
+                if h in self.store:
+                    self.store.move_to_end(h)
+                    continue
+                # copy: a slice view would pin the whole (bucket-padded)
+                # gather buffer, breaking the capacity_blocks accounting
+                self.store[h] = (
+                    np.ascontiguousarray(k[:, i : i + 1]),
+                    np.ascontiguousarray(v[:, i : i + 1]),
+                )
         while len(self.store) > self.capacity_blocks:
             self.store.popitem(last=False)
             self.evicted_total += 1
@@ -90,6 +129,8 @@ class KvHostTier:
         assert len(hashes) == len(block_ids)
         if not hashes:
             return
+        if any(h in self._staged_hashes for h in hashes):
+            self.drain()
         ks, vs = zip(*(self.store[h] for h in hashes))
         k = np.concatenate(ks, axis=1)
         v = np.concatenate(vs, axis=1)
@@ -99,17 +140,19 @@ class KvHostTier:
         self.restored_total += len(hashes)
 
     def match_extension(self, hashes: Sequence[int], start: int) -> List[int]:
-        """Longest host-resident run of ``hashes`` starting at index ``start``."""
+        """Longest host-resident (stored or staged) run of ``hashes``
+        starting at index ``start``."""
         out: List[int] = []
         for h in hashes[start:]:
-            if h not in self.store:
+            if not self.has(h):
                 break
             out.append(h)
         return out
 
     def metrics(self) -> dict:
         return {
-            "host_kv_blocks": len(self.store),
+            "host_kv_blocks": len(self),
+            "host_kv_staged": len(self._staged_hashes),
             "host_kv_capacity": self.capacity_blocks,
             "host_kv_offloaded_total": self.offloaded_total,
             "host_kv_restored_total": self.restored_total,

@@ -43,7 +43,10 @@ def test_host_tier_offload_restore_lru():
     for bid, h in [(0, 100), (1, 101), (2, 102)]:
         store.write(bid, np.full(4, bid, np.float32))
         tier.offload(h, bid)
-    # capacity 2 → hash 100 was LRU-evicted
+    # offload only stages; all three visible until drain...
+    assert tier.has(100) and tier.has(101) and tier.has(102)
+    tier.drain()
+    # ...then capacity 2 → hash 100 was LRU-evicted
     assert not tier.has(100) and tier.has(101) and tier.has(102)
     assert tier.evicted_total == 1
     # restore 101 into slot 5

@@ -1,0 +1,407 @@
+"""Block-manager reuse semantics: priority eviction classes, inflight
+match staging, pin fences, and asynchronous host-tier offload overlap.
+
+VERDICT r3 items 5+7 — parity with the reference's
+lib/llm/src/kv/{reuse,reserved,manager}.rs: priority + FIFO reuse
+queues, match-inflight-then-reusable staging, fences so a block with a
+copy in flight can't be reclaimed, and offload that never stalls the
+decode loop on device→host materialization.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.block_allocator import BlockAllocator, KvEventSink
+from dynamo_tpu.kv import KvHostTier
+from dynamo_tpu.tokens import compute_block_hashes
+
+BS = 4  # block size for all tests
+
+
+def fill_and_free(alloc, prompt, store=None):
+    """Allocate a prompt, register its complete blocks, free it.
+    Returns (block_ids, hashes)."""
+    blocks, _ = alloc.allocate_prompt(prompt)
+    hashes = compute_block_hashes(prompt, BS)
+    parent = None
+    n_complete = len(prompt) // BS
+    for bid, h in zip(blocks[:n_complete], hashes):
+        if store is not None:
+            store.write(bid, np.full(4, h % 251, np.float32))
+        alloc.register_complete(bid, h, parent)
+        parent = h
+    alloc.free_blocks(blocks)
+    return blocks, hashes
+
+
+class FakeStore:
+    def __init__(self, num_blocks):
+        self.data = {i: None for i in range(num_blocks)}
+
+    def write(self, bid, value):
+        self.data[bid] = value
+
+    def gather(self, ids):
+        k = np.stack([self.data[i] for i in ids])[None]
+        return k, k.copy()
+
+    def scatter(self, ids, k, v):
+        for j, bid in enumerate(ids):
+            self.data[bid] = k[0, j]
+
+
+# ---------------------------------------------------------------------------
+# priority classes
+# ---------------------------------------------------------------------------
+
+
+def test_priority_eviction_order():
+    """Eviction drains the lowest priority class first, FIFO within a
+    class (reference kv/reuse.rs PriorityKey ordering)."""
+    removed = []
+    events = KvEventSink(on_removed=lambda hs: removed.extend(hs))
+    alloc = BlockAllocator(6, BS, events=events)
+
+    # three 2-block prompts fill the cache, then free → 6 reusable blocks
+    prompts = [list(range(s, s + 8)) for s in (10, 100, 200)]
+    hashes = [fill_and_free(alloc, p)[1] for p in prompts]
+    # prompt 1 (middle) is important: retain longest
+    alloc.set_priority(hashes[1], 5)
+
+    # evicting all six: priority-0 classes go first in free order
+    # (prompt0's blocks, then prompt2's), then the priority-5 class
+    order = []
+    for _ in range(6):
+        removed.clear()
+        alloc.allocate_block()
+        order.extend(removed)
+    assert order[:2] == list(hashes[0])
+    assert order[2:4] == list(hashes[2])
+    assert order[4:6] == list(hashes[1])
+
+
+def test_set_priority_rekeys_already_pooled_blocks():
+    alloc = BlockAllocator(4, BS)
+    _, h_a = fill_and_free(alloc, list(range(8)))
+    _, h_b = fill_and_free(alloc, list(range(50, 58)))
+    # both pooled at priority 0; promote A afterwards
+    alloc.set_priority(h_a, 3)
+    removed = []
+    alloc.events.on_removed = lambda hs: removed.extend(hs)
+    for _ in range(4):
+        alloc.allocate_block()
+    assert removed[:2] == list(h_b)   # B (prio 0) evicted first
+    assert removed[2:] == list(h_a)
+
+
+# ---------------------------------------------------------------------------
+# inflight-then-reusable match staging
+# ---------------------------------------------------------------------------
+
+
+def test_shared_prefix_storm_shares_inflight_blocks():
+    """Many concurrent sequences over one prefix: the prefix blocks are
+    shared by refcount (reference kv/reserved.rs inflight matching) —
+    never duplicated, never double-used, and the staging counters split
+    inflight vs reusable matches."""
+    alloc = BlockAllocator(32, BS)
+    prefix = list(range(1, 17))           # 4 complete blocks
+    seqs = []
+
+    # first sequence computes the prefix and keeps it live (inflight)
+    blocks0, cached0 = alloc.allocate_prompt(prefix + [77])
+    assert cached0 == 0
+    hashes = compute_block_hashes(prefix, BS)
+    parent = None
+    for bid, h in zip(blocks0[:4], hashes):
+        alloc.register_complete(bid, h, parent)
+        parent = h
+    seqs.append(blocks0)
+
+    # a storm of sequences with the same prefix while seq0 is STILL live
+    for i in range(8):
+        blocks, cached = alloc.allocate_prompt(prefix + [100 + i])
+        assert cached == 16
+        assert blocks[:4] == blocks0[:4]      # shared, not recomputed
+        seqs.append(blocks)
+    assert alloc.matched_inflight_total == 8 * 4
+    assert alloc.matched_reusable_total == 0
+    for bid in blocks0[:4]:
+        assert alloc.refcount[bid] == 9
+
+    # all release → prefix blocks pooled exactly once each
+    for blocks in seqs:
+        alloc.free_blocks(blocks)
+    for bid in blocks0[:4]:
+        assert bid in alloc.reusable
+        assert alloc.refcount.get(bid, 0) == 0
+
+    # next match is a REUSABLE-stage hit
+    blocks2, cached2 = alloc.allocate_prompt(prefix + [500])
+    assert cached2 == 16
+    assert alloc.matched_reusable_total == 4
+    alloc.free_blocks(blocks2)
+
+
+def test_no_double_use_under_churn():
+    """Arbitrary allocate/free churn: a block id is never live in two
+    places (sum of per-sequence refs == allocator refcount)."""
+    rng = np.random.default_rng(7)
+    alloc = BlockAllocator(16, BS)
+    live = {}  # name → block list
+    for step in range(300):
+        if live and (len(live) > 5 or rng.random() < 0.45):
+            name = list(live)[rng.integers(len(live))]
+            alloc.free_blocks(live.pop(name))
+        else:
+            start = int(rng.integers(0, 8)) * BS
+            length = int(rng.integers(5, 20))
+            prompt = list(range(start, start + length))
+            try:
+                blocks, _ = alloc.allocate_prompt(prompt)
+            except MemoryError:
+                continue
+            hashes = compute_block_hashes(prompt, BS)
+            parent = None
+            for bid, h in zip(blocks[: len(prompt) // BS], hashes):
+                alloc.register_complete(bid, h, parent)
+                parent = h
+            live[f"s{step}"] = blocks
+        # invariant: allocator refcounts == external holds
+        holds = {}
+        for blocks in live.values():
+            for bid in blocks:
+                holds[bid] = holds.get(bid, 0) + 1
+        assert holds == {k: v for k, v in alloc.refcount.items() if v > 0}
+        # and no live block is evictable
+        for bid in holds:
+            assert bid not in alloc.reusable
+
+
+# ---------------------------------------------------------------------------
+# pins / fences
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_block_survives_eviction_pressure():
+    alloc = BlockAllocator(4, BS)
+    _, h = fill_and_free(alloc, list(range(8)))     # 2 hashed reusable
+    bid = alloc.by_hash[h[0]]
+    alloc.pin_blocks([bid])
+    taken = [alloc.allocate_block() for _ in range(3)]  # 2 free + 1 evict
+    assert bid not in taken                  # the pinned block was skipped
+    assert alloc.by_hash.get(h[0]) == bid    # still matchable
+    with pytest.raises(MemoryError):
+        alloc.allocate_block()               # only the pinned block remains
+    alloc.unpin_blocks([bid])
+    assert alloc.allocate_block() == bid     # now reclaimable
+
+
+def test_free_of_pinned_block_defers_until_unpin():
+    alloc = BlockAllocator(4, BS)
+    blocks, _ = alloc.allocate_prompt(list(range(6)))
+    alloc.pin_blocks(blocks[:1])
+    alloc.free_blocks(blocks)
+    # the pinned block's release deferred: not reusable, not free
+    assert blocks[0] not in alloc.reusable
+    assert blocks[0] not in alloc.free
+    assert blocks[1] in alloc.free
+    alloc.unpin_blocks(blocks[:1])
+    assert blocks[0] in alloc.free
+
+
+def test_pins_are_counted_across_consumers():
+    """Two consumers fencing the same block: the fence must hold until
+    the LAST unpin (a set would drop it at the first)."""
+    alloc = BlockAllocator(4, BS)
+    _, h = fill_and_free(alloc, list(range(8)))
+    bid = alloc.by_hash[h[0]]
+    alloc.pin_blocks([bid])      # consumer 1
+    alloc.pin_blocks([bid])      # consumer 2
+    alloc.unpin_blocks([bid])    # consumer 1 done
+    taken = [alloc.allocate_block() for _ in range(3)]
+    assert bid not in taken      # consumer 2 still holds the fence
+    alloc.unpin_blocks([bid])
+    assert alloc.allocate_block() == bid
+
+
+def test_block_reacquired_while_pinned_cancels_deferred_free():
+    """free → (pinned, deferred) → re-matched by a new prompt → unpin:
+    the deferred free must NOT fire — the block is live again, and
+    releasing it would let eviction corrupt a live sequence's KV."""
+    alloc = BlockAllocator(8, BS)
+    prompt = list(range(1, 9))   # 2 blocks, both complete
+    blocks, hashes = fill_and_free(alloc, prompt)
+    # re-take it live, pin (transfer in flight), then free the sequence
+    blocks2, cached = alloc.allocate_prompt(prompt + [99])
+    assert blocks2[:1] == blocks[:1]
+    alloc.pin_blocks(blocks2[:1])
+    alloc.free_blocks(blocks2)   # block 0 deferred (pinned)
+    # a NEW prompt re-acquires the deferred block before the unpin
+    blocks3, _ = alloc.allocate_prompt(prompt + [77])
+    assert blocks3[0] == blocks2[0]
+    assert alloc.refcount[blocks3[0]] == 1
+    alloc.unpin_blocks(blocks2[:1])
+    # the live block must not have been released to the pool
+    assert blocks3[0] not in alloc.reusable
+    assert blocks3[0] not in alloc.free
+    assert alloc.refcount[blocks3[0]] == 1
+    alloc.free_blocks(blocks3)
+    assert blocks3[0] in alloc.reusable  # normal release once truly free
+
+
+def test_restore_targets_are_fenced_during_restore():
+    """While the host tier writes a restore, the target slots are pinned
+    (a reclaim racing the copy would corrupt the restored prefix)."""
+    store = FakeStore(8)
+    tier = KvHostTier(store.gather, store.scatter, capacity_blocks=16)
+    alloc = BlockAllocator(8, BS, tier2=tier)
+    observed = {}
+
+    orig_restore = tier.restore
+
+    def spying_restore(hashes, bids):
+        observed["pinned_during"] = all(b in alloc.pinned for b in bids)
+        orig_restore(hashes, bids)
+
+    tier.restore = spying_restore
+    _, h_a = fill_and_free(alloc, list(range(1, 13)), store)
+    # force A out of HBM entirely
+    big = list(range(100, 100 + 8 * BS))
+    blocks_b, _ = alloc.allocate_prompt(big)
+    alloc.free_blocks(blocks_b)
+    # A's prefix restores from host → spying_restore must see pins
+    blocks_a2, cached = alloc.allocate_prompt(list(range(1, 13)))
+    assert cached > 0 and observed["pinned_during"]
+    assert not alloc.pinned                   # released after the restore
+    alloc.free_blocks(blocks_a2)
+
+
+# ---------------------------------------------------------------------------
+# async offload staging
+# ---------------------------------------------------------------------------
+
+
+class SlowD2H:
+    """Device-array stand-in whose host materialization completes
+    ``delay`` seconds after the copy STARTED (copy_to_host_async), the
+    way a real D2H DMA behaves."""
+
+    def __init__(self, arr, delay):
+        self.arr = arr
+        self.delay = delay
+        self.t0 = None
+
+    def copy_to_host_async(self):
+        if self.t0 is None:
+            self.t0 = time.monotonic()
+
+    def __array__(self, dtype=None, copy=None):
+        start = self.t0 if self.t0 is not None else time.monotonic()
+        remaining = start + self.delay - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+        return self.arr if dtype is None else self.arr.astype(dtype)
+
+
+def test_offload_dispatch_does_not_block_on_d2h():
+    """offload_batch must cost dispatch time only; the D2H latency is
+    paid by drain — and not even there if compute overlapped it
+    (reference CopyStream::trigger_layer overlap, kv/layer.rs:100-1140)."""
+    store = FakeStore(8)
+    DELAY = 0.2
+
+    def slow_gather(ids):
+        k, v = store.gather(ids)
+        return SlowD2H(k, DELAY), SlowD2H(v, DELAY)
+
+    tier = KvHostTier(slow_gather, store.scatter, capacity_blocks=16)
+    for bid in range(4):
+        store.write(bid, np.full(4, bid, np.float32))
+
+    t0 = time.monotonic()
+    tier.offload_batch([(100 + b, b) for b in range(4)])
+    dispatch_cost = time.monotonic() - t0
+    assert dispatch_cost < DELAY / 4, f"offload blocked: {dispatch_cost:.3f}s"
+    assert tier.has(101)                      # staged blocks are matchable
+
+    time.sleep(DELAY)                         # "compute" overlaps the copy
+    t0 = time.monotonic()
+    tier.drain()
+    drain_cost = time.monotonic() - t0
+    assert drain_cost < DELAY / 4, f"drain re-paid the copy: {drain_cost:.3f}s"
+
+    # correctness survived the overlap
+    tier.restore([101], [7])
+    np.testing.assert_array_equal(store.data[7], np.full(4, 1, np.float32))
+
+
+def test_match_and_restore_hit_staged_blocks():
+    """A prefix hit landing between dispatch and drain is not lost."""
+    store = FakeStore(8)
+    tier = KvHostTier(store.gather, store.scatter, capacity_blocks=16)
+    alloc = BlockAllocator(8, BS, tier2=tier)
+    prompt = list(range(1, 13))
+    _, hashes = fill_and_free(alloc, prompt, store)
+    # evict A (queues offload), then immediately re-request before any
+    # drain: the staged entries must match and restore bit-exact
+    big = list(range(100, 100 + 8 * BS))
+    blocks_b, _ = alloc.allocate_prompt(big)
+    alloc.free_blocks(blocks_b)
+    blocks_a2, cached = alloc.allocate_prompt(prompt)
+    assert cached == 8  # 2 of 3 complete blocks restorable (cap rule: -1)
+    for bid, h in zip(blocks_a2[:2], hashes):
+        np.testing.assert_array_equal(
+            store.data[bid], np.full(4, h % 251, np.float32)
+        )
+    alloc.free_blocks(blocks_a2)
+
+
+def test_fence_commits_staged_offloads():
+    store = FakeStore(8)
+    tier = KvHostTier(store.gather, store.scatter, capacity_blocks=16)
+    alloc = BlockAllocator(8, BS, tier2=tier)
+    _, hashes = fill_and_free(alloc, list(range(1, 13)), store)
+    blocks_b, _ = alloc.allocate_prompt(list(range(100, 100 + 8 * BS)))
+    alloc.free_blocks(blocks_b)
+    assert tier.metrics()["host_kv_staged"] > 0
+    alloc.fence()
+    assert tier.metrics()["host_kv_staged"] == 0
+    assert all(tier.has(h) for h in hashes)
+
+
+def test_host_tier_thrash_keeps_restores_exact():
+    """Offload/restore thrash under a small host tier: every restore is
+    bit-exact and nothing is double-freed (VERDICT done-bar: zero
+    double-use / lost-restore under contention)."""
+    store = FakeStore(8)
+    tier = KvHostTier(store.gather, store.scatter, capacity_blocks=4)
+    alloc = BlockAllocator(8, BS, tier2=tier)
+    prompts = {n: list(range(1 + 40 * n, 13 + 40 * n)) for n in range(4)}
+    expected = {
+        n: compute_block_hashes(p, BS) for n, p in prompts.items()
+    }
+    rng = np.random.default_rng(3)
+    for step in range(120):
+        n = int(rng.integers(0, 4))
+        prompt = prompts[n]
+        blocks, cached = alloc.allocate_prompt(prompt)
+        hashes = expected[n]
+        # recompute the non-cached suffix (simulating prefill), then
+        # verify every restored block carries the right content
+        n_restored = cached // BS
+        for bid, h in zip(blocks[:n_restored], hashes):
+            np.testing.assert_array_equal(
+                store.data[bid], np.full(4, h % 251, np.float32),
+                err_msg=f"step {step}: lost/corrupt restore of {h}",
+            )
+        parent = None
+        for bid, h in zip(blocks[:3], hashes):
+            store.write(bid, np.full(4, h % 251, np.float32))
+            alloc.register_complete(bid, h, parent)
+            parent = h
+        alloc.free_blocks(blocks)
+        if step % 7 == 0:
+            alloc.fence()
