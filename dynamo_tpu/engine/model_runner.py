@@ -152,20 +152,10 @@ class ModelRunner:
             )
         # config-only quantization checks, BEFORE any checkpoint I/O: a
         # 70B load must not stream for minutes just to hit a config error
-        if cfg.quantization:
-            if cfg.quantization != "int8":
-                raise ValueError(
-                    f"unknown quantization {cfg.quantization!r} (only int8)"
-                )
-            if self.arch is not llama:
-                raise NotImplementedError(
-                    "int8 weight quantization currently covers the "
-                    "llama-family trunk (MoE/MLA: serve unquantized)"
-                )
-            if config.pp_size > 1:
-                raise NotImplementedError(
-                    "int8 quantization does not compose with pp staging yet"
-                )
+        if cfg.quantization and cfg.quantization != "int8":
+            raise ValueError(
+                f"unknown quantization {cfg.quantization!r} (only int8)"
+            )
 
         if params is None:
             if model_dir is not None:
@@ -199,6 +189,8 @@ class ModelRunner:
             from ..parallel import pipeline as pp_mod
 
             params = pp_mod.stage_params(params, config.pp_size)
+            # pp_mod.param_specs mirrors QuantizedWeight leaves itself (the
+            # same tree feeds its shard_map in_specs)
             pspecs = pp_mod.param_specs(params, tp=config.tp_size > 1)
             cache_spec = (
                 pp_mod.CACHE_SPEC_TP if config.tp_size > 1

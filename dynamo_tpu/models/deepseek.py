@@ -38,6 +38,7 @@ from ..engine.config import ModelConfig
 from ..ops.attention import lane_pad, scatter_kv_stacked
 from .llama import _swiglu_mlp, apply_rope, base_specs, lm_logits, rms_norm, run_layers
 from .mixtral import make_moe_mlp_fn
+from .quant import dense
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]  # (latent c_kv, shared k_rope) caches
@@ -119,11 +120,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         params["lm_head"] = w(keys[1], (d_model, cfg.vocab_size), d_model)
 
     if n_dense > 0:
-        dense = _attn_params(cfg, n_dense, keys[2], w, dtype)
-        dense["w_gate"] = w(keys[3], (n_dense, d_model, inter), d_model)
-        dense["w_up"] = w(keys[4], (n_dense, d_model, inter), d_model)
-        dense["w_down"] = w(keys[5], (n_dense, inter, d_model), inter)
-        params["dense_layers"] = dense
+        group = _attn_params(cfg, n_dense, keys[2], w, dtype)
+        group["w_gate"] = w(keys[3], (n_dense, d_model, inter), d_model)
+        group["w_up"] = w(keys[4], (n_dense, d_model, inter), d_model)
+        group["w_down"] = w(keys[5], (n_dense, inter, d_model), inter)
+        params["dense_layers"] = group
 
     if n_moe > 0:
         moe = _attn_params(cfg, n_moe, keys[6], w, dtype)
@@ -311,17 +312,19 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     scale = mla_softmax_scale(cfg)
 
     def attn_fn(x, lp, c_all, kr_all, li):
-        # queries (optionally through the q low-rank bottleneck)
+        # queries (optionally through the q low-rank bottleneck);
+        # quant.dense serves these int8 under --quantization (w_kr and
+        # the absorbed w_uk/w_uv stay full precision, see quant.py keys)
         if "w_uq" in lp:
-            cq = rms_norm(x @ lp["w_dq"], lp["ln_q"], cfg.rms_norm_eps)
-            qfull = (cq @ lp["w_uq"]).reshape(b, s, h, nope + rope_d)
+            cq = rms_norm(dense(x, lp["w_dq"]), lp["ln_q"], cfg.rms_norm_eps)
+            qfull = dense(cq, lp["w_uq"]).reshape(b, s, h, nope + rope_d)
         else:
-            qfull = (x @ lp["wq"]).reshape(b, s, h, nope + rope_d)
+            qfull = dense(x, lp["wq"]).reshape(b, s, h, nope + rope_d)
         q_nope, q_rope = qfull[..., :nope], qfull[..., nope:]
         q_rope = apply_rope(q_rope, positions, cfg.rope_theta, cfg.rope_scaling)
 
         # compressed KV state for the new tokens
-        c_kv = rms_norm(x @ lp["w_dkv"], lp["ln_kv"], cfg.rms_norm_eps)
+        c_kv = rms_norm(dense(x, lp["w_dkv"]), lp["ln_kv"], cfg.rms_norm_eps)
         kr = apply_rope(
             (x @ lp["w_kr"])[:, :, None, :], positions, cfg.rope_theta,
             cfg.rope_scaling,
@@ -339,7 +342,7 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             context_lens, scale, impl=cfg.attention_impl, mesh=mesh,
         )
         o = jnp.einsum("bshr,rhv->bshv", o_lat, lp["w_uv"])
-        delta = o.reshape(b, s, -1) @ lp["wo"]
+        delta = dense(o.reshape(b, s, -1), lp["wo"])
         return delta, c_all, kr_all
 
     return attn_fn

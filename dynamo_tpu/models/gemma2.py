@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..engine.config import ModelConfig
 from ..ops.attention import attention, scatter_kv_stacked
 from .llama import apply_rope, init_kv_cache  # noqa: F401  (shared cache layout)
+from .quant import dense
 
 Params = Dict
 KVCache = Tuple[jax.Array, jax.Array]
@@ -124,9 +125,9 @@ def forward(
     def layer_step(carry, lp):
         hidden, k_all, v_all, li = carry
         x = rms_norm(hidden, lp["ln1"], eps)
-        q = (x @ lp["wq"]).reshape(b, s, h, hd)
-        k = (x @ lp["wk"]).reshape(b, s, kvh, hd)
-        v = (x @ lp["wv"]).reshape(b, s, kvh, hd)
+        q = dense(x, lp["wq"]).reshape(b, s, h, hd)
+        k = dense(x, lp["wk"]).reshape(b, s, kvh, hd)
+        v = dense(x, lp["wv"]).reshape(b, s, kvh, hd)
         q = apply_rope(q, positions, cfg.rope_theta, None)
         k = apply_rope(k, positions, cfg.rope_theta, None)
         k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
@@ -141,11 +142,11 @@ def forward(
             scale=scale, softcap=cfg.attn_logit_softcap,
             sliding_window=window,
         )
-        delta = attn.reshape(b, s, h * hd) @ lp["wo"]
+        delta = dense(attn.reshape(b, s, h * hd), lp["wo"])
         hidden = hidden + rms_norm(delta, lp["ln_post_attn"], eps)
         x = rms_norm(hidden, lp["ln_pre_mlp"], eps)
-        gate = jax.nn.gelu(x @ lp["w_gate"], approximate=True)
-        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.gelu(dense(x, lp["w_gate"]), approximate=True)
+        mlp = dense(gate * dense(x, lp["w_up"]), lp["w_down"])
         hidden = hidden + rms_norm(mlp, lp["ln_post_mlp"], eps)
         return (hidden, k_all, v_all, li + 1), None
 
@@ -163,7 +164,10 @@ def logits_from_hidden(hidden: jax.Array, params: Params,
     any [..., D] slice (the engine samples from last-position hidden)."""
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")  # untied finetunes; normally tied
-    logits = hidden @ (params["embed"].T if lm_head is None else lm_head)
+    logits = (
+        hidden @ params["embed"].T if lm_head is None
+        else dense(hidden, lm_head)
+    )
     cap = cfg.final_logit_softcap
     if cap:
         logits = cap * jnp.tanh(logits / cap)

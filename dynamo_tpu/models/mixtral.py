@@ -36,6 +36,7 @@ from .llama import (  # shared trunk + specs
     init_kv_cache,
     logits_from_hidden,  # noqa: F401  (engine samples from hidden slices)
 )
+from .quant import dense, expert_einsum
 
 Params = Dict[str, Any]
 KVCache = Tuple[jax.Array, jax.Array]
@@ -114,9 +115,11 @@ def moe_mlp(
     combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)  # [T, E, C]
 
     x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)   # [E, C, D]
-    h = jax.nn.silu(jnp.einsum("ecd,edi->eci", x_e, w_gate))
-    h = h * jnp.einsum("ecd,edi->eci", x_e, w_up)
-    y_e = jnp.einsum("eci,eid->ecd", h, w_down)                    # [E, C, D]
+    # expert_einsum: dispatches to int8 weights (scale on the out axis)
+    # when the checkpoint is served quantized
+    h = jax.nn.silu(expert_einsum("ecd,edi->eci", x_e, w_gate))
+    h = h * expert_einsum("ecd,edi->eci", x_e, w_up)
+    y_e = expert_einsum("eci,eid->ecd", h, w_down)                 # [E, C, D]
     return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y_e)
 
 
@@ -211,8 +214,11 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
         y = y.reshape(b, s, -1)
         if "w_sh_gate" in layer_params:
             # always-on shared expert(s) alongside the routed ones
-            gate = jax.nn.silu(x @ layer_params["w_sh_gate"])
-            y = y + (gate * (x @ layer_params["w_sh_up"])) @ layer_params["w_sh_down"]
+            gate = jax.nn.silu(dense(x, layer_params["w_sh_gate"]))
+            y = y + dense(
+                gate * dense(x, layer_params["w_sh_up"]),
+                layer_params["w_sh_down"],
+            )
         return y
 
     return mlp

@@ -63,15 +63,37 @@ def dense(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
-# weights worth quantizing: the big matmul operands of the llama-family
-# trunk. embed stays full (it is a gather + tied-logit transpose), norms
-# and biases are tiny.
+def expert_einsum(subscripts: str, x: jax.Array, w) -> jax.Array:
+    """Expert-batched matmul for plain or quantized stacked weights.
+
+    Contract (the MoE expert shapes of models/mixtral.py): ``w`` is
+    [E, in, out] with the contraction over ``in`` (axis -2), and the
+    result is [E, C, out] — so the per-output-channel scale [E, out]
+    broadcasts as ``scale[:, None, :]``.
+    """
+    if isinstance(w, QuantizedWeight):
+        y = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
+        return y * w.scale.astype(x.dtype)[:, None, :]
+    return jnp.einsum(subscripts, x, w)
+
+
+# weights worth quantizing: the big matmul operands. embed stays full (it
+# is a gather + tied-logit transpose), norms and biases are tiny, MoE
+# routers steer expert selection (precision-sensitive and tiny), and
+# MLA's w_kr/w_uk/w_uv stay full (w_kr keeps RoPE keys exact; w_uk/w_uv
+# use nonstandard contraction layouts and are latent-rank small).
 LLAMA_QUANT_KEYS = frozenset(
     {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
 )
+# + DeepSeek shared experts and MLA low-rank projections; mixtral expert
+# stacks reuse the w_gate/w_up/w_down names (rank-4 [L, E, in, out] —
+# quantize_int8 and the specs are rank-generic)
+QUANT_KEYS = LLAMA_QUANT_KEYS | frozenset(
+    {"w_sh_gate", "w_sh_up", "w_sh_down", "w_dq", "w_uq", "w_dkv"}
+)
 
 
-def quantize_params(params: Dict, keys: frozenset = LLAMA_QUANT_KEYS) -> Dict:
+def quantize_params(params: Dict, keys: frozenset = QUANT_KEYS) -> Dict:
     """Quantize the named matmul weights anywhere in a nested param dict."""
     def walk(node):
         if isinstance(node, dict):
@@ -92,15 +114,17 @@ def mirror_specs(params: Dict, specs: Dict) -> Dict:
     in axis (second-to-last entry)."""
     def walk(p, s):
         if isinstance(p, QuantizedWeight):
-            spec = tuple(s)  # PartitionSpec iterates its per-dim entries
+            if isinstance(s, QuantizedWeight):
+                return s  # already mirrored (e.g. built by a tree.map)
+            # pad a rank-deficient spec with None (JAX semantics: trailing
+            # dims unsharded) so the in/out axes align positionally
+            spec = tuple(s) + (None,) * (p.q.ndim - len(tuple(s)))
             if len(spec) != p.q.ndim:
-                # rank-deficient specs would silently mis-align the scale
                 raise ValueError(
-                    f"quantized weight needs a full-rank spec: got {s} "
-                    f"for a {p.q.ndim}-d weight"
+                    f"spec {s} has more entries than the {p.q.ndim}-d weight"
                 )
             scale_spec = P(*(spec[:-2] + spec[-1:]))
-            return QuantizedWeight(q=s, scale=scale_spec)
+            return QuantizedWeight(q=P(*spec), scale=scale_spec)
         if isinstance(p, dict):
             return {k: walk(v, s[k]) for k, v in p.items()}
         return s

@@ -88,7 +88,12 @@ def param_specs(params, tp: bool = False) -> dict:
         }
     else:
         specs["layers"] = jax.tree.map(lambda _: P("pp"), params["layers"])
-    return specs
+    # int8 serving: QuantizedWeight leaves need mirrored spec NODES (the
+    # scale is one rank lower than q) — both for device_put and for the
+    # shard_map in_specs below
+    from ..models import quant
+
+    return quant.mirror_specs(params, specs)
 
 
 CACHE_SPEC = P("pp")  # [P, L/P, N, bs, KVH, D]

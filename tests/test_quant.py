@@ -83,16 +83,16 @@ def test_mirror_specs_shards_scales():
     assert tuple(s2["lm_head"].scale) == ("tp",)
 
 
-def _logits(cfg, params, prompt):
+def _logits(cfg, params, prompt, arch=llama):
     """One prefill over a fresh tiny cache, raw logits out."""
-    k, v = llama.init_kv_cache(cfg, 16, 8, jnp.float32)
+    cache = arch.init_kv_cache(cfg, 16, 8, jnp.float32)
     s = len(prompt)
     tokens = jnp.asarray([prompt], jnp.int32)
     positions = jnp.arange(s, dtype=jnp.int32)[None]
     bt = jnp.arange(4, dtype=jnp.int32)[None]
     slots = positions
-    logits, _ = llama.forward(
-        params, cfg, tokens, positions, (k, v), bt, slots,
+    logits, _ = arch.forward(
+        params, cfg, tokens, positions, cache, bt, slots,
         jnp.asarray([s], jnp.int32),
     )
     return np.asarray(logits[0, -1], np.float64)
@@ -191,11 +191,172 @@ async def test_quantized_engine_serves_deterministically(tmp_path):
     assert len(first) == 8 and first == second
 
 
-def test_quantization_rejects_unsupported():
-    moe = ModelConfig(**TINY, num_experts=4, quantization="int8")
+def test_quantization_rejects_unknown_scheme():
     cfg = EngineConfig(
-        model=moe, max_batch_size=2, max_model_len=64, kv_block_size=8,
+        model=ModelConfig(**TINY, quantization="fp4"),
+        max_batch_size=2, max_model_len=64, kv_block_size=8,
         num_kv_blocks=16, dtype="float32",
     )
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="fp4"):
         ModelRunner(cfg)
+
+
+MOE_CFG = dict(
+    vocab_size=256, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=8, num_experts=4,
+    num_experts_per_tok=2,
+)
+MLA_CFG = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=96, num_layers=2,
+    num_heads=4, num_kv_heads=4, head_dim=16,
+    kv_lora_rank=16, qk_rope_head_dim=8, qk_nope_head_dim=12, v_head_dim=12,
+)
+
+
+def test_quantized_moe_logits_track_full_precision():
+    """VERDICT r3 item 6: int8 composes with routed experts — the expert
+    einsums dispatch through quant.expert_einsum."""
+    from dynamo_tpu.models import mixtral
+
+    cfg = ModelConfig(**MOE_CFG, attention_impl="xla")
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12]
+    full = _logits(cfg, params, prompt, arch=mixtral)
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"]["w_gate"], QuantizedWeight)  # [L,E,D,I]
+    assert not isinstance(qp["layers"]["router"], QuantizedWeight)
+    quant = _logits(cfg, qp, prompt, arch=mixtral)
+    cos = np.dot(full, quant) / (np.linalg.norm(full) * np.linalg.norm(quant))
+    assert cos > 0.99, f"quantized MoE logits diverged (cos={cos:.4f})"
+
+
+def test_quantized_mla_logits_track_full_precision():
+    """int8 composes with MLA: the low-rank projections serve quantized;
+    w_kr / absorbed w_uk / w_uv stay full precision."""
+    from dynamo_tpu.models import deepseek
+
+    cfg = ModelConfig(
+        **{**MLA_CFG, "q_lora_rank": 24}, attention_impl="xla"
+    )
+    params = deepseek.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12]
+    full = _logits(cfg, params, prompt, arch=deepseek)
+    qp = quantize_params(params)
+    layers = qp["dense_layers"] if "dense_layers" in qp else qp["layers"]
+    assert isinstance(layers["w_dkv"], QuantizedWeight)
+    assert isinstance(layers["w_uq"], QuantizedWeight)
+    assert not isinstance(layers["w_kr"], QuantizedWeight)
+    assert not isinstance(layers["w_uk"], QuantizedWeight)
+    quant = _logits(cfg, qp, prompt, arch=deepseek)
+    cos = np.dot(full, quant) / (np.linalg.norm(full) * np.linalg.norm(quant))
+    assert cos > 0.99, f"quantized MLA logits diverged (cos={cos:.4f})"
+
+
+def test_quantized_gemma2_logits_track_full_precision():
+    """Gemma-2's own forward (sandwich norms, GeGLU, softcaps) also
+    serves int8 — every family's matmuls route through quant.dense."""
+    from dynamo_tpu.models import gemma2
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+        attention_impl="xla", attn_logit_softcap=50.0,
+        final_logit_softcap=30.0, sliding_window=8,
+        query_pre_attn_scalar=8, tie_word_embeddings=True,
+    )
+    params = gemma2.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12]
+    full = _logits(cfg, params, prompt, arch=gemma2)
+    quant = _logits(cfg, quantize_params(params), prompt, arch=gemma2)
+    cos = np.dot(full, quant) / (np.linalg.norm(full) * np.linalg.norm(quant))
+    assert cos > 0.99, f"quantized gemma2 logits diverged (cos={cos:.4f})"
+
+
+def test_quantized_moe_runner_serves_on_ep_mesh():
+    """int8 expert stacks shard over ep×tp through the mirrored specs."""
+    cfg = EngineConfig(
+        model=ModelConfig(**MOE_CFG, attention_impl="xla",
+                          quantization="int8"),
+        max_batch_size=2, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=32, dtype="float32", ep_size=2, tp_size=2,
+        prefill_buckets=[16],
+    )
+    runner = ModelRunner(
+        cfg, mesh=build_mesh(1, 2, ep=2, devices=jax.devices()[:4])
+    )
+    b, s = 2, 8
+    tokens = np.random.default_rng(0).integers(0, 256, (b, s)).astype(np.int32)
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    btab = np.zeros((b, cfg.blocks_per_seq), np.int32)
+    btab[0, 0], btab[1, 0] = 0, 1
+    slots = btab[:, :1] * 8 + positions
+    nt, *_ = runner.step(
+        tokens, positions, btab, slots, np.full(b, s, np.int32),
+        np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+        np.zeros(b, np.int32), np.ones(b, np.float32),
+        jax.random.PRNGKey(0),
+    )
+    assert np.asarray(nt).shape == (b,)
+
+
+@pytest.mark.asyncio
+async def test_quantized_pp_engine_serves(tmp_path):
+    """int8 × pp: staged QuantizedWeight leaves ([P, L/P, ...]) serve
+    through the collective GPipe engine path, composed with the K-burst."""
+    import json as _json
+    import os as _os
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dynamo_tpu.engine.serving import JaxServingEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from fixtures import make_model_dir
+
+    d = make_model_dir(tmp_path, name="tiny-qpp")
+    hf = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf).save_pretrained(d, safe_serialization=True)
+    c = _json.load(open(_os.path.join(d, "config.json")))
+    c["eos_token_id"] = 2
+    _json.dump(c, open(_os.path.join(d, "config.json"), "w"))
+
+    mdc = ModelDeploymentCard.from_local_path(d)
+
+    async def run(quantization):
+        mcfg = ModelConfig.from_model_dir(d)
+        mcfg.attention_impl = "xla"
+        mcfg.quantization = quantization
+        econfig = EngineConfig(
+            model=mcfg, max_batch_size=2, max_model_len=64, kv_block_size=8,
+            num_kv_blocks=32, dtype="float32", pp_size=2,
+            multi_step_decode=2,
+        )
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=econfig, warmup=False)
+        req = PreprocessedRequest(
+            token_ids=[1, 17, 43, 99],
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        toks = []
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+        await engine.close()
+        return toks
+
+    full = await run(None)
+    quant = await run("int8")
+    assert len(quant) == 8
+    # greedy decode over a tiny random model: int8 should track the
+    # full-precision trajectory for at least the first tokens
+    assert quant[0] == full[0]
