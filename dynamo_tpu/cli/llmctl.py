@@ -73,12 +73,17 @@ def build_parser() -> argparse.ArgumentParser:
     dc = dsub.add_parser("create", parents=[store_opt],
                          help="register a graph deployment spec")
     dc.add_argument("name")
-    dc.add_argument("-f", "--file", required=True,
+    dc.add_argument("-f", "--file",
                     help="JSON (or YAML) deployment spec — the CR spec: "
                          "{services: {...}, modelName: ...}")
+    dc.add_argument("--from-artifact", metavar="TARBALL",
+                    help="versioned graph artifact (sdk.build output); "
+                         "the spec is rendered from its manifest, with "
+                         "-f (if given) overlaid on top")
     du = dsub.add_parser("update", parents=[store_opt])
     du.add_argument("name")
-    du.add_argument("-f", "--file", required=True)
+    du.add_argument("-f", "--file")
+    du.add_argument("--from-artifact", metavar="TARBALL")
     dg = dsub.add_parser("get", parents=[store_opt])
     dg.add_argument("name")
     dsub.add_parser("list", parents=[store_opt])
@@ -107,12 +112,36 @@ def run_deploy(args) -> int:
     from ..deploy.store_source import ApiStoreClient
 
     client = ApiStoreClient(args.api_store)
+
+    def _resolve_spec() -> dict:
+        """--from-artifact renders the spec from the manifest; -f (alone
+        or on top) supplies/overlays raw spec fields."""
+        artifact = getattr(args, "from_artifact", None)
+        if not artifact and not args.file:
+            raise SystemExit("one of -f/--file or --from-artifact is required")
+        spec: dict = {}
+        if artifact:
+            from ..sdk.build import deployment_spec, inspect_artifact
+
+            spec = deployment_spec(inspect_artifact(artifact))
+        if args.file:
+            overlay = _load_spec(args.file)
+            services = {**spec.get("services", {}),
+                        **overlay.get("services", {})}
+            spec = {**spec, **overlay}
+            if services:
+                spec["services"] = services
+        return spec
+
     if args.action == "create":
-        rec = client.create(args.name, _load_spec(args.file))
-        print(f"created deployment {rec['name']}")
+        spec = _resolve_spec()
+        rec = client.create(args.name, spec)
+        ver = (spec.get("artifact") or {}).get("version")
+        print(f"created deployment {rec['name']}"
+              + (f" (artifact {ver})" if ver else ""))
         return 0
     if args.action == "update":
-        rec = client.update(args.name, _load_spec(args.file))
+        rec = client.update(args.name, _resolve_spec())
         print(f"updated deployment {rec['name']}")
         return 0
     if args.action == "get":

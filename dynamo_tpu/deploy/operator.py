@@ -357,6 +357,13 @@ class Reconciler:
             "children": children or {},
             "conditions": [condition],
         }
+        artifact = (cr.get("spec") or {}).get("artifact") or {}
+        if artifact.get("version"):
+            # artifact-pinned deploys surface what they run (sdk.build
+            # content-addressed version) in the CR status
+            status["artifactVersion"] = artifact["version"]
+            if artifact.get("name"):
+                status["artifactName"] = artifact["name"]
         cr_key = (cr["metadata"].get("namespace", "default"),
                   cr["metadata"]["name"])
         prev = self._status_written.get(cr_key)
