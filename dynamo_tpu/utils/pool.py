@@ -1,0 +1,208 @@
+"""Generic fixed-capacity object pool with RAII-style returns.
+
+Reference analog: lib/runtime/src/utils/pool.rs:23-241 — a pool of
+pre-created values handed out as unique items whose drop returns them,
+convertible to shared (refcounted) items where the last clone returns.
+Re-designed on asyncio: ``acquire`` awaits availability instead of
+spinning, items are async-context-managers (the idiomatic Python RAII),
+and a ``weakref.finalize`` safety net returns leaked items so a dropped
+reference can never shrink the pool.
+
+    pool = Pool([conn1, conn2], on_return=lambda c: c.reset())
+    async with await pool.acquire() as conn:
+        await conn.send(...)
+    # returned (and reset) here — or at GC if the item leaks
+
+Shared items (reference SharedPoolItem) let several readers hold one
+value; the value returns when the last share is released:
+
+    item = await pool.acquire()
+    a, b = item.share(), item.share()
+    a.release(); b.release()   # second release returns the value
+"""
+
+from __future__ import annotations
+
+import asyncio
+import weakref
+from collections import deque
+from typing import Any, Awaitable, Callable, Deque, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class PoolExhausted(Exception):
+    """try_acquire on an empty pool / acquire past its deadline."""
+
+
+class Pool(Generic[T]):
+    def __init__(
+        self,
+        items: List[T],
+        on_return: Optional[Callable[[T], None]] = None,
+    ):
+        self._items: Deque[T] = deque(items)
+        self.capacity = len(items)
+        self.on_return = on_return
+        self._waiters: Deque[asyncio.Future] = deque()
+
+    @classmethod
+    async def create(
+        cls,
+        factory: Callable[[], Awaitable[T]],
+        n: int,
+        on_return: Optional[Callable[[T], None]] = None,
+    ) -> "Pool[T]":
+        return cls([await factory() for _ in range(n)], on_return=on_return)
+
+    @property
+    def available(self) -> int:
+        return len(self._items)
+
+    def try_acquire(self) -> "PoolItem[T]":
+        if not self._items:
+            raise PoolExhausted(f"pool empty ({self.capacity} items out)")
+        return PoolItem(self, self._items.popleft())
+
+    async def acquire(self, timeout: Optional[float] = None) -> "PoolItem[T]":
+        if self._items:
+            return PoolItem(self, self._items.popleft())
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            value = await (
+                asyncio.wait_for(fut, timeout) if timeout is not None else fut
+            )
+        except (asyncio.TimeoutError, asyncio.CancelledError) as e:
+            # the race that silently drains pools: _return may have
+            # already handed the value to this future in the same tick
+            # the timeout/cancel fired — recover it or it is lost forever
+            if fut.done() and not fut.cancelled():
+                self._return(fut.result())
+            if isinstance(e, asyncio.CancelledError):
+                raise  # cancellation must propagate, not become Exhausted
+            raise PoolExhausted(
+                f"no item available within {timeout}s"
+            ) from None
+        finally:
+            if fut in self._waiters:  # timed out / cancelled before handoff
+                self._waiters.remove(fut)
+        return PoolItem(self, value)
+
+    def _return(self, value: T) -> None:
+        if self.on_return is not None:
+            self.on_return(value)
+        # direct hand-off to the oldest live waiter, else back to the deque
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(value)
+                return
+        self._items.append(value)
+
+
+class PoolItem(Generic[T]):
+    """Unique handle: exactly one return, on release/exit/GC."""
+
+    def __init__(self, pool: Pool[T], value: T):
+        self._pool = pool
+        self._value: Optional[T] = value
+        self._returned = False
+        # the RAII safety net: a leaked (garbage-collected) item must not
+        # shrink the pool. Deliberately does NOT hold a ref to self.
+        self._finalizer = weakref.finalize(self, _return_once, pool, [value])
+
+    @property
+    def value(self) -> T:
+        if self._returned:
+            raise RuntimeError("pool item already returned")
+        return self._value  # type: ignore[return-value]
+
+    def release(self) -> None:
+        if not self._returned:
+            self._returned = True
+            self._finalizer.detach()
+            value, self._value = self._value, None
+            self._pool._return(value)  # type: ignore[arg-type]
+
+    def share(self) -> "SharedPoolItem[T]":
+        """Convert to a refcounted shared handle (consumes this item)."""
+        if self._returned:
+            raise RuntimeError("pool item already returned")
+        self._finalizer.detach()
+        self._returned = True
+        value, self._value = self._value, None
+        state = _SharedState(self._pool, value)  # type: ignore[arg-type]
+        return SharedPoolItem(state)
+
+    async def __aenter__(self) -> T:
+        return self.value
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+    def __enter__(self) -> T:
+        return self.value
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _return_once(pool: Pool, box: list) -> None:
+    if box:
+        pool._return(box.pop())
+
+
+class _SharedState(Generic[T]):
+    def __init__(self, pool: Pool[T], value: T):
+        self.pool = pool
+        self.value = value
+        self.count = 0
+        self.returned = False
+        # same GC safety net as PoolItem: once every SharedPoolItem handle
+        # is dropped (released or leaked), this state is unreachable and
+        # the finalizer returns the value if no explicit release did
+        self._finalizer = weakref.finalize(
+            self, _return_shared_once, pool, [value]
+        )
+
+    def drop(self) -> None:
+        self.count -= 1
+        if self.count == 0 and not self.returned:
+            self.returned = True
+            self._finalizer.detach()
+            self.pool._return(self.value)
+
+
+def _return_shared_once(pool: Pool, box: list) -> None:
+    if box:
+        pool._return(box.pop())
+
+
+class SharedPoolItem(Generic[T]):
+    """Cloneable handle; the LAST release returns the value."""
+
+    def __init__(self, state: _SharedState[T]):
+        self._state = state
+        self._released = False
+        state.count += 1
+
+    @property
+    def value(self) -> T:
+        if self._released:
+            raise RuntimeError("shared pool item already released")
+        return self._state.value
+
+    @property
+    def strong_count(self) -> int:
+        return self._state.count
+
+    def share(self) -> "SharedPoolItem[T]":
+        if self._released:
+            raise RuntimeError("shared pool item already released")
+        return SharedPoolItem(self._state)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._state.drop()

@@ -1,0 +1,158 @@
+"""Flagship-shape validation without flagship hardware (VERDICT r3
+weak #5): every BASELINE ladder rung's model is traced at its REAL
+dimensions via ``jax.eval_shape`` (no buffers allocated), and the 70B
+TP step is lowered with real Megatron shardings over an 8-device mesh.
+
+Tiny-shape tests can hide bugs that only appear at real dims (reshape
+factorizations, head/expert divisibility, cache layout padding, >2**31
+element counts); abstract evaluation catches those for free.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model_runner import build_mesh
+from dynamo_tpu.models import resolve
+
+# BASELINE.md ladder rungs at their true public dimensions
+LADDER = {
+    "llama3-8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0,
+    ),
+    "llama3-70b": ModelConfig(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        num_experts=8, num_experts_per_tok=2,
+    ),
+    "deepseek-r1": ModelConfig(
+        vocab_size=129280, hidden_size=7168, intermediate_size=18432,
+        num_layers=61, num_heads=128, num_kv_heads=128, head_dim=128,
+        kv_lora_rank=512, q_lora_rank=1536,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        num_experts=256, num_experts_per_tok=8,
+        moe_intermediate_size=2048, first_k_dense_replace=3,
+        n_shared_experts=1,
+    ),
+}
+
+# public parameter counts (within tolerance: embeddings/norm details)
+EXPECTED_PARAMS = {
+    "llama3-8b": 8.0e9,
+    "llama3-70b": 70.6e9,
+    "mixtral-8x7b": 46.7e9,
+    "deepseek-r1": 671e9,
+}
+
+
+def _tree_params(shapes) -> int:
+    return sum(int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(shapes))
+
+
+@pytest.mark.parametrize("name", sorted(LADDER))
+def test_ladder_model_traces_at_real_dims(name):
+    cfg = LADDER[name]
+    cfg.attention_impl = "xla"
+    arch = resolve(cfg)
+    num_blocks, bs = 2048, 16
+
+    param_shapes = jax.eval_shape(
+        lambda key: arch.init_params(cfg, key, jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+    total = _tree_params(param_shapes)
+    want = EXPECTED_PARAMS[name]
+    assert abs(total - want) / want < 0.10, (
+        f"{name}: param count {total / 1e9:.1f}B vs expected "
+        f"{want / 1e9:.1f}B — the real-dims config is wrong"
+    )
+
+    cache_shapes = jax.eval_shape(
+        lambda: arch.init_kv_cache(cfg, num_blocks, bs, jnp.bfloat16)
+    )
+
+    def run(params, cache, tokens, positions, btab, slots, ctx):
+        logits, cache = arch.forward(
+            params, cfg, tokens, positions, cache, btab, slots, ctx,
+        )
+        return logits
+
+    # decode step at serving batch; prefill chunk at a real bucket
+    for b, s in ((16, 1), (1, 512)):
+        w = 8192 // bs
+        out = jax.eval_shape(
+            run,
+            param_shapes, cache_shapes,
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b, w), jnp.int32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        )
+        assert out.shape == (b, s, cfg.vocab_size)
+        assert out.dtype == jnp.bfloat16
+
+
+def test_llama70b_tp8_step_lowers_with_real_shardings():
+    """The 70B decode step LOWERS (trace + StableHLO, still no buffers)
+    with the production tp=8 Megatron shardings on an 8-device mesh —
+    catches spec/rank/divisibility errors GSPMD would reject."""
+    cfg = LADDER["llama3-70b"]
+    cfg.attention_impl = "xla"
+    arch = resolve(cfg)
+    mesh = build_mesh(1, 8, jax.devices()[:8])
+    num_blocks, bs = 2048, 16
+
+    param_shapes = jax.eval_shape(
+        lambda key: arch.init_params(cfg, key, jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+    pspecs = arch.param_specs(param_shapes)
+    sharded_params = jax.tree.map(
+        lambda sds, spec: jax.ShapeDtypeStruct(
+            sds.shape, sds.dtype,
+            sharding=NamedSharding(mesh, spec),
+        ),
+        param_shapes, pspecs,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    cache_shapes = jax.tree.map(
+        lambda sds: jax.ShapeDtypeStruct(
+            sds.shape, sds.dtype,
+            sharding=NamedSharding(mesh, P(None, None, None, "tp", None)),
+        ),
+        jax.eval_shape(
+            lambda: arch.init_kv_cache(cfg, num_blocks, bs, jnp.bfloat16)
+        ),
+    )
+
+    b, s, w = 16, 1, 8192 // bs
+
+    def run(params, cache, tokens, positions, btab, slots, ctx):
+        logits, cache = arch.forward(
+            params, cfg, tokens, positions, cache, btab, slots, ctx,
+            mesh=mesh,
+        )
+        return logits, cache
+
+    lowered = jax.jit(run).lower(
+        sharded_params, cache_shapes,
+        jax.ShapeDtypeStruct((b, s), jnp.int32),
+        jax.ShapeDtypeStruct((b, s), jnp.int32),
+        jax.ShapeDtypeStruct((b, w), jnp.int32),
+        jax.ShapeDtypeStruct((b, s), jnp.int32),
+        jax.ShapeDtypeStruct((b,), jnp.int32),
+    )
+    text = lowered.as_text()
+    assert "stablehlo" in text or "mhlo" in text or "module" in text

@@ -1,0 +1,132 @@
+"""Generic object pool (utils/pool.py — reference utils/pool.rs analog)."""
+
+import asyncio
+import gc
+
+import pytest
+
+from dynamo_tpu.utils.pool import Pool, PoolExhausted
+
+
+async def test_acquire_release_cycle_and_reset_hook():
+    resets = []
+    pool = Pool(["a", "b"], on_return=resets.append)
+    assert pool.available == 2 and pool.capacity == 2
+    async with await pool.acquire() as v1:
+        assert v1 == "a" and pool.available == 1
+        item2 = pool.try_acquire()
+        assert item2.value == "b" and pool.available == 0
+        with pytest.raises(PoolExhausted):
+            pool.try_acquire()
+        item2.release()
+        assert pool.available == 1
+    assert pool.available == 2
+    assert resets == ["b", "a"]
+    # double release is a no-op; using a returned item raises
+    item = pool.try_acquire()
+    item.release()
+    item.release()
+    with pytest.raises(RuntimeError):
+        _ = item.value
+    assert pool.available == 2
+
+
+async def test_acquire_waits_for_return():
+    pool = Pool([1])
+    item = await pool.acquire()
+    got = []
+
+    async def waiter():
+        got.append((await pool.acquire()).value)
+
+    task = asyncio.create_task(waiter())
+    await asyncio.sleep(0.01)
+    assert not got                      # blocked: pool empty
+    item.release()
+    await asyncio.wait_for(task, 5)
+    assert got == [1]
+    # the hand-off went straight to the waiter, not through the deque,
+    # and the waiter's item still returns normally
+    assert pool.available == 1
+
+    with pytest.raises(PoolExhausted):
+        i = await pool.acquire()
+        try:
+            await pool.acquire(timeout=0.05)
+        finally:
+            i.release()
+
+
+async def test_leaked_item_returns_at_gc():
+    pool = Pool(["x"])
+    item = await pool.acquire()
+    assert pool.available == 0
+    del item                             # leaked without release
+    gc.collect()
+    assert pool.available == 1           # the finalizer returned it
+
+
+async def test_shared_items_return_on_last_release():
+    pool = Pool(["v"])
+    shared = (await pool.acquire()).share()
+    clone = shared.share()
+    assert shared.strong_count == 2
+    assert shared.value == clone.value == "v"
+    shared.release()
+    assert pool.available == 0           # clone still holds it
+    clone.release()
+    assert pool.available == 1
+    with pytest.raises(RuntimeError):
+        _ = clone.value
+
+
+async def test_cancelled_waiter_does_not_lose_the_item():
+    """A release can hand the value to a waiter's future in the same
+    tick its cancellation fires — the value must be recovered, not
+    silently drained from the pool."""
+    pool = Pool(["conn"])
+    holder = await pool.acquire()
+
+    async def waiter():
+        await pool.acquire()
+
+    task = asyncio.create_task(waiter())
+    await asyncio.sleep(0.01)           # waiter parked on its future
+    holder.release()                    # hand-off resolves the future...
+    task.cancel()                       # ...and the cancel lands first
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    assert pool.available == 1, "cancelled hand-off drained the pool"
+    # and a plain timeout near a hand-off also recovers
+    h2 = await pool.acquire()
+    t2 = asyncio.create_task(pool.acquire(timeout=0.02))
+    await asyncio.sleep(0.05)
+    h2.release()
+    with pytest.raises(PoolExhausted):
+        await t2
+    assert pool.available == 1
+
+
+async def test_leaked_shared_item_returns_at_gc():
+    pool = Pool(["v"])
+    shared = (await pool.acquire()).share()
+    clone = shared.share()
+    shared.release()
+    del shared, clone                   # last handle leaked, not released
+    gc.collect()
+    assert pool.available == 1, "leaked shared item shrank the pool"
+
+
+async def test_concurrent_churn_preserves_capacity():
+    pool = Pool(list(range(4)))
+    seen = set()
+
+    async def worker(n):
+        for _ in range(25):
+            async with await pool.acquire() as v:
+                seen.add(v)
+                await asyncio.sleep(0)
+
+    await asyncio.gather(*(worker(i) for i in range(16)))
+    assert pool.available == 4
+    assert seen == {0, 1, 2, 3}
