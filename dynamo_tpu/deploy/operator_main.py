@@ -45,6 +45,15 @@ def main() -> None:
     parser.add_argument("--namespace", default=None,
                         help="watch one namespace (default: all)")
     parser.add_argument("--kubectl", default="kubectl")
+    parser.add_argument(
+        "--kube-client", choices=("kubectl", "api"), default="kubectl",
+        help="cluster access: shell out to kubectl, or talk REST to the "
+             "API server directly (in-cluster serviceaccount, or "
+             "--kube-api-url for an explicit endpoint)",
+    )
+    parser.add_argument("--kube-api-url", default=None,
+                        help="API server base URL (api mode; default: "
+                             "in-cluster serviceaccount discovery)")
     parser.add_argument("--poll", action="store_true",
                         help="poll every --interval instead of watching")
     parser.add_argument("--resync-interval", type=float, default=300.0,
@@ -64,23 +73,35 @@ def main() -> None:
     args = parser.parse_args()
     setup_logging(logging.INFO)
 
+    if args.kube_client == "api":
+        from .kube_api import KubeApiClient
+
+        kube = (
+            KubeApiClient(args.kube_api_url) if args.kube_api_url
+            else KubeApiClient.from_in_cluster()
+        )
+    else:
+        kube = KubectlClient(args.kubectl)
+
     poll = args.poll
     if args.api_store_url:
         from .store_source import ApiStoreClient
 
         store = ApiStoreClient(args.api_store_url)
-        reconciler = Reconciler(
-            KubectlClient(args.kubectl), status_writer=store.write_status
-        )
+        reconciler = Reconciler(kube, status_writer=store.write_status)
         source = store.get_crs
         poll = True  # the store has no watch API; poll it
         logger.info("operator sourcing CRs from api-store %s every %.0fs",
                     args.api_store_url, args.interval)
     else:
-        reconciler = Reconciler(KubectlClient(args.kubectl))
-        source = lambda: get_crs(args.kubectl, args.namespace)  # noqa: E731
-        logger.info("operator %s %s.%s",
-                    "polling" if poll else "watching", PLURAL, GROUP)
+        reconciler = Reconciler(kube)
+        if args.kube_client == "api":
+            source = lambda: kube.get_crs(args.namespace)  # noqa: E731
+        else:
+            source = lambda: get_crs(args.kubectl, args.namespace)  # noqa: E731
+        logger.info("operator %s %s.%s via %s",
+                    "polling" if poll else "watching", PLURAL, GROUP,
+                    args.kube_client)
 
     stop = threading.Event()  # set only by a lost leader lease
     if poll:
@@ -89,11 +110,18 @@ def main() -> None:
     else:
         from .watch import KubectlWatchSource, watch_loop
 
+        if args.kube_client == "api":
+            open_stream = lambda: kube.open_watch(  # noqa: E731
+                args.namespace,
+                timeout_seconds=int(args.resync_interval),
+            )
+        else:
+            open_stream = KubectlWatchSource(
+                args.kubectl, args.namespace,
+                resync_interval_s=args.resync_interval,
+            )
         drive = lambda: watch_loop(  # noqa: E731
-            reconciler, source,
-            KubectlWatchSource(args.kubectl, args.namespace,
-                               resync_interval_s=args.resync_interval),
-            stop=stop)
+            reconciler, source, open_stream, stop=stop)
 
     if args.leader_elect:
         import socket
