@@ -25,6 +25,7 @@ watch-loop source contract:
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
@@ -32,9 +33,9 @@ import ssl
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
-from .operator import GROUP, KIND, MANAGED_BY, PLURAL, VERSION
+from .operator import GROUP, KIND, PLURAL, VERSION, managed_selector
 
 logger = logging.getLogger(__name__)
 
@@ -176,16 +177,11 @@ class KubeApiClient:
                 raise
 
     def list_managed(self, namespace: str, instance: str) -> List[dict]:
-        selector = (
-            f"app.kubernetes.io/instance={instance},"
-            f"app.kubernetes.io/managed-by="
-            f"{MANAGED_BY['app.kubernetes.io/managed-by']}"
-        )
         items: List[dict] = []
         for kind, (prefix, plural) in _KIND_PATHS.items():
             out = self._request(
                 "GET", self._child_path(kind, namespace),
-                query={"labelSelector": selector},
+                query={"labelSelector": managed_selector(instance)},
             )
             api_version = prefix.removeprefix("/apis/").removeprefix("/api/")
             for obj in (out or {}).get("items", []):
@@ -217,9 +213,60 @@ class KubeApiClient:
                 obj.setdefault("kind", KIND)
                 obj.setdefault("apiVersion", f"{GROUP}/{VERSION}")
             return items
-        except (KubeApiError, OSError) as e:
+        except (KubeApiError, OSError, http.client.HTTPException,
+                json.JSONDecodeError) as e:
+            # IncompleteRead on a truncated body is an HTTPException, not
+            # an OSError; a garbled body is a JSONDecodeError — both are
+            # "API failed this cycle", never allowed to kill the loop
             logger.warning("CR list failed: %s", e)
             return None
+
+    # ---------- coordination.k8s.io Leases (leader election) ----------
+
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1"
+
+    def read_lease(
+        self, namespace: str, name: str
+    ) -> Tuple[Optional[dict], Optional[str]]:
+        """LeaseClient.read: (spec, resourceVersion), or (None, None) when
+        absent. Any non-404 failure RAISES — 'lease absent' and 'API
+        unreachable' must stay distinct or a blip deposes a healthy
+        leader (deploy/leader.py)."""
+        try:
+            obj = self._request(
+                "GET",
+                f"{self._LEASE_BASE}/namespaces/{namespace}/leases/{name}",
+            )
+        except KubeApiError as e:
+            if e.status == 404:
+                return None, None
+            raise
+        return obj.get("spec", {}), obj["metadata"].get("resourceVersion")
+
+    def write_lease(self, namespace: str, name: str, spec: dict,
+                    expected_version: Optional[str]) -> bool:
+        """LeaseClient.write: CAS commit. POST when expected_version is
+        None (create-only — 409 AlreadyExists = lost the race), PUT with
+        resourceVersion otherwise (409 Conflict = lost the race). Other
+        failures raise (transient, NOT an authoritative loss)."""
+        body = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec,
+        }
+        base = f"{self._LEASE_BASE}/namespaces/{namespace}/leases"
+        try:
+            if expected_version is None:
+                self._request("POST", base, body=body)
+            else:
+                body["metadata"]["resourceVersion"] = expected_version
+                self._request("PUT", f"{base}/{name}", body=body)
+        except KubeApiError as e:
+            if e.status == 409:
+                logger.debug("lease write lost the CAS race: %s", e)
+                return False
+            raise
+        return True
 
     def open_watch(
         self, namespace: Optional[str] = None,
@@ -248,3 +295,20 @@ class KubeApiClient:
                     return
         finally:
             resp.close()
+
+
+class KubeApiLeases:
+    """deploy/leader.py LeaseClient over the REST client — leader
+    election without a kubectl binary in the image (the kubectl analog
+    is leader.KubectlLeases)."""
+
+    def __init__(self, client: KubeApiClient):
+        self.client = client
+
+    def read(self, namespace: str, name: str):
+        return self.client.read_lease(namespace, name)
+
+    def write(self, namespace: str, name: str, spec: dict,
+              expected_version: Optional[str]) -> bool:
+        return self.client.write_lease(namespace, name, spec,
+                                       expected_version)

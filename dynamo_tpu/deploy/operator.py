@@ -38,6 +38,17 @@ PLURAL = "dynamotpugraphdeployments"
 
 MANAGED_BY = {"app.kubernetes.io/managed-by": "dynamo-tpu-operator"}
 
+
+def managed_selector(instance: str) -> str:
+    """labelSelector for one CR's managed children — the single source
+    both cluster clients (kubectl + REST) list/prune by; a drifting copy
+    would silently stop orphan pruning for one of them."""
+    return (
+        f"app.kubernetes.io/instance={instance},"
+        f"app.kubernetes.io/managed-by="
+        f"{MANAGED_BY['app.kubernetes.io/managed-by']}"
+    )
+
 # role → in=/out= argv of cli.run (the service binaries, SURVEY §2.6/2.7)
 ROLE_ARGS = {
     "frontend": ["in=http", "out=none"],
@@ -250,14 +261,9 @@ class KubectlClient:
                   "--ignore-not-found")
 
     def list_managed(self, namespace: str, instance: str) -> List[dict]:
-        selector = (
-            f"app.kubernetes.io/instance={instance},"
-            f"app.kubernetes.io/managed-by="
-            f"{MANAGED_BY['app.kubernetes.io/managed-by']}"
-        )
         out = self._run(
             "get", "deployments,services", "-n", namespace,
-            "-l", selector, "-o", "json",
+            "-l", managed_selector(instance), "-o", "json",
         )
         return json.loads(out).get("items", [])
 

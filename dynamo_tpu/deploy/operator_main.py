@@ -128,8 +128,16 @@ def main() -> None:
 
         from .leader import KubectlLeases, LeaderElector
 
+        if args.kube_client == "api":
+            # lease CAS over the same REST client — no kubectl binary
+            # needed in the image for any operator feature
+            from .kube_api import KubeApiLeases
+
+            leases = KubeApiLeases(kube)
+        else:
+            leases = KubectlLeases(args.kubectl)
         elector = LeaderElector(
-            KubectlLeases(args.kubectl),
+            leases,
             identity=args.identity or socket.gethostname(),
             namespace=args.leader_elect_namespace,
         )

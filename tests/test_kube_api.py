@@ -91,6 +91,10 @@ class FakeKubeApiServer:
         if path.startswith(CR_BASE):
             return await self._handle_cr(request, path, parts)
 
+        # ---- coordination.k8s.io Leases (real CAS semantics) ----
+        if path.startswith("/apis/coordination.k8s.io/v1"):
+            return await self._handle_lease(request, parts)
+
         # ---- children: /apis/apps/v1/... or /api/v1/... ----
         ns_i = parts.index("namespaces")
         ns, plural = parts[ns_i + 1], parts[ns_i + 2]
@@ -135,6 +139,38 @@ class FakeKubeApiServer:
             return web.json_response({"items": items})
 
         return web.json_response({"reason": "NotFound"}, status=404)
+
+    async def _handle_lease(self, request, parts):
+        self.leases = getattr(self, "leases", {})  # (ns, name) → (obj, rv)
+        ns = parts[parts.index("namespaces") + 1]
+        name = parts[-1] if parts[-1] != "leases" else None
+        if request.method == "GET":
+            entry = self.leases.get((ns, name))
+            if entry is None:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            return web.json_response(entry[0])
+        if request.method == "POST":
+            body = json.loads(await request.text())
+            key = (ns, body["metadata"]["name"])
+            if key in self.leases:
+                return web.json_response(
+                    {"reason": "AlreadyExists"}, status=409)
+            self.rv += 1
+            body["metadata"]["resourceVersion"] = str(self.rv)
+            self.leases[key] = (body, str(self.rv))
+            return web.json_response(body, status=201)
+        if request.method == "PUT":
+            body = json.loads(await request.text())
+            entry = self.leases.get((ns, name))
+            if entry is None:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            if body["metadata"].get("resourceVersion") != entry[1]:
+                return web.json_response({"reason": "Conflict"}, status=409)
+            self.rv += 1
+            body["metadata"]["resourceVersion"] = str(self.rv)
+            self.leases[(ns, name)] = (body, str(self.rv))
+            return web.json_response(body)
+        return web.json_response({"reason": "MethodNotAllowed"}, status=405)
 
     async def _handle_cr(self, request, path, parts):
         if path.endswith("/status") and request.method == "PATCH":
@@ -291,6 +327,43 @@ async def test_get_crs_restores_kind_and_none_on_dead_api():
         assert await _in_thread(dead.get_crs) is None
 
 
+async def test_lease_cas_over_rest_single_winner():
+    """KubeApiLeases: create-only POST and resourceVersion'd PUT give
+    real CAS — two electors racing produce exactly one leader, and a
+    stale-version renewal is an authoritative loss, not an error."""
+    from dynamo_tpu.deploy.kube_api import KubeApiLeases
+    from dynamo_tpu.deploy.leader import LeaderElector
+
+    async with fake_server() as fake:
+        client = client_for(fake)
+        leases = KubeApiLeases(client)
+
+        def cas_round():
+            electors = [
+                LeaderElector(leases, f"e{i}", namespace="default")
+                for i in range(4)
+            ]
+            return [e.try_acquire_or_renew() for e in electors]
+
+        wins = await _in_thread(cas_round)
+        assert sum(wins) == 1
+
+        # stale-version write: read, let someone else write, then CAS
+        spec, version = await _in_thread(
+            lambda: leases.read("default", "dynamo-tpu-operator"))
+        assert spec is not None
+        ok = await _in_thread(
+            lambda: leases.write(
+                "default", "dynamo-tpu-operator",
+                {**spec, "holderIdentity": "usurper"}, version))
+        assert ok  # first CAS with the fresh version wins
+        stale = await _in_thread(
+            lambda: leases.write(
+                "default", "dynamo-tpu-operator",
+                {**spec, "holderIdentity": "stale"}, version))
+        assert stale is False  # lost race → False, never an exception
+
+
 async def test_token_file_is_reread_per_request(tmp_path):
     """Bound serviceaccount tokens rotate on disk (~1h); caching the
     startup token would 401 forever after expiry."""
@@ -330,7 +403,15 @@ async def test_watch_loop_over_rest_stream():
         )
         loop_thread.start()
         try:
-            await asyncio.sleep(0.3)  # let the relist+stream come up
+            # wait for the stream to actually register (a fixed sleep
+            # races the relist on a loaded host; a missed ADDED event
+            # could not be recovered inside the poll window below)
+            for _ in range(200):
+                if fake.watch_queues:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("watch stream never connected")
             fake.put_cr("w1", {"services": {"worker": {"role": "worker"}}})
             for _ in range(100):
                 if ("deployments", "default", "w1-worker") in fake.objects:
