@@ -28,9 +28,19 @@ BENCH_SINGLE_STEP_ONLY=1.
 from __future__ import annotations
 
 import json
+import os as _os
 import time
 
 import numpy as np
+
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+# honor JAX_PLATFORMS despite the site hook's early jax import, so CPU
+# smoke runs (BENCH_SMOKE=1 JAX_PLATFORMS=cpu) never touch the relay
+from dynamo_tpu.utils.platform import apply_jax_platform_override  # noqa: E402
+
+apply_jax_platform_override()
 
 V5E_HBM_GBPS = 819e9
 METRIC = "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512"
@@ -152,6 +162,33 @@ def run_once(attention_impl: str, burst: int = 1) -> dict:
     }
 
 
+def _relay_probe(timeout_s: float = 90.0) -> str:
+    """Cheap aliveness check: can a child compile a 128x128 matmul?
+
+    The host's compile service is shared and serializes; a wedged Mosaic
+    compile (observed rounds 2 and 4) blocks EVERY process's compiles,
+    including trivial XLA ones. Returns ``"alive"``, ``"wedged"`` (child
+    hung — drain-waiting may heal it), or ``"crashed"`` (child failed
+    fast — deterministic breakage a wait cannot fix).
+    """
+    import subprocess
+    import sys
+
+    code = ("import os, jax; "
+            "p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); "
+            "import jax.numpy as jnp; x = jnp.ones((128, 128)); "
+            "print('RELAY_ALIVE', float((x @ x).sum()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "wedged"
+    return "alive" if "RELAY_ALIVE" in proc.stdout else "crashed"
+
+
 def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1):
     """Run one bench attempt in a child process with a hard timeout.
 
@@ -201,6 +238,45 @@ def main() -> None:
     xla_timeout = min(float(os.environ.get("BENCH_TIMEOUT_S", "600")), total_budget)
     t0 = _time.monotonic()
 
+    health = _relay_probe()
+    if health == "wedged":
+        # wedged relay: wait for the remote compile queue to drain before
+        # spending real budget, but cap the wait so a dead-all-day relay
+        # still leaves time for one full XLA attempt (it may heal between
+        # probes — observed recovery is abrupt, not gradual). A "crashed"
+        # probe is deterministic breakage: waiting cannot heal it, so
+        # skip the drain and let the (fast-failing) attempts report it.
+        print("relay preflight hung (compile service wedged); waiting "
+              "for it to drain", flush=True)
+        drain_deadline = t0 + min(0.4 * total_budget, 600.0)
+        while _time.monotonic() < drain_deadline:
+            _time.sleep(45.0)
+            health = _relay_probe()
+            if health == "alive":
+                print("relay recovered; proceeding", flush=True)
+                break
+            if health == "crashed":
+                # wedge became deterministic breakage; waiting can't heal
+                break
+    if health == "crashed":
+        print("relay preflight failed fast (device init error, not a "
+              "wedge); attempting anyway", flush=True)
+    if health == "wedged":
+        # don't burn the whole budget queueing 600s attempts on a dead
+        # relay — one bounded XLA try, then the burst/Pallas ladder is
+        # skipped by the budget checks below
+        xla_timeout = min(xla_timeout, 300.0)
+
+    # persistent compilation cache: repeated bench runs (and the driver's
+    # end-of-round run) reuse executables instead of re-compiling through
+    # the shared relay; harmless no-op where serialization is unsupported.
+    # Set AFTER the health probes — a cache hit on the probe matmul would
+    # report "alive" without ever touching the relay.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+
     def note(label: str, result) -> None:
         # one line per attempt: the driver log keeps the whole lever
         # table even though only the best goes on the final line
@@ -209,6 +285,15 @@ def main() -> None:
 
     result = _run_impl_subprocess("xla", timeout_s=xla_timeout)
     note("xla:k1", result)
+    if result is None:
+        # one retry: a draining relay often comes back abruptly, and the
+        # XLA number is the one that must not be lost
+        remaining = total_budget - (_time.monotonic() - t0)
+        if remaining > 180:
+            result = _run_impl_subprocess(
+                "xla", timeout_s=min(300.0, remaining - 60)
+            )
+            note("xla:k1-retry", result)
     best = result
 
     # the engine's fused multi-step decode (multi_step_decode=K): same
@@ -276,6 +361,21 @@ def main() -> None:
             "error": "all attempts failed or timed out (device/compile "
                      "service unreachable?)",
         }
+        # NOT this run's measurement — the most recent number this same
+        # workload produced on live hardware, kept in-tree so a relay
+        # outage at bench time doesn't erase the evidence; read from the
+        # results file so the pointer can never go stale
+        levers_rel = "examples/llm/benchmarks/results/bench_levers_r04.json"
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    levers_rel)) as f:
+                recorded = json.load(f)
+            best["last_live_measurement"] = {
+                "file": levers_rel, **recorded.get("headline", {}),
+            }
+        except (OSError, ValueError):
+            pass
     print(json.dumps(best))
 
 

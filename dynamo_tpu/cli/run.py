@@ -553,6 +553,9 @@ async def amain(argv: List[str]) -> None:
 
 
 def main() -> None:
+    from ..utils.platform import apply_jax_platform_override
+
+    apply_jax_platform_override()
     try:
         asyncio.run(amain(sys.argv[1:]))
     except KeyboardInterrupt:

@@ -1,0 +1,42 @@
+"""JAX platform override helper.
+
+Some environments import jax at interpreter startup via a site hook
+pinned to the real TPU (platform "axon"), snapshotting jax's config
+before per-process env vars can influence it — `JAX_PLATFORMS=cpu
+python ...` is silently ignored. Re-applying the env var to the live
+config after import restores the expected contract. Shared by the CLI,
+bench harness, and any launcher that spawns workers with a forced
+platform (tests/conftest.py applies the same pattern inline because it
+must run before this package is importable).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def apply_jax_platform_override() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative even after an early jax import.
+
+    No-op when the env var is unset. A failure to apply is loud: the
+    caller asked for a specific platform (usually to stay OFF a shared
+    TPU), and silently proceeding on the wrong one queues compiles
+    through the shared relay — the exact outage mode this guard exists
+    to prevent.
+    """
+    requested = os.environ.get("JAX_PLATFORMS")
+    if not requested:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
+    except Exception as e:  # noqa: BLE001 - diagnosed, not swallowed
+        logger.warning(
+            "could not re-apply JAX_PLATFORMS=%s to jax config (%s: %s); "
+            "jax may run on the platform selected at interpreter startup",
+            requested, type(e).__name__, e,
+        )

@@ -36,25 +36,29 @@ def build_tiny_tokenizer() -> Tokenizer:
     return tok
 
 
-def make_model_dir(tmp_path, name="tiny-llama", context_length=256) -> str:
-    """Write a fake HF snapshot dir: tokenizer.json + config.json + tokenizer_config.json."""
+def make_model_dir(tmp_path, name="tiny-llama", context_length=256,
+                   config_overrides=None) -> str:
+    """Write a fake HF snapshot dir: tokenizer.json + config.json + tokenizer_config.json.
+
+    ``config_overrides`` merges extra/replacement keys into config.json
+    (e.g. real model dims for a flagship-shape serving benchmark).
+    """
     model_dir = os.path.join(str(tmp_path), name)
     os.makedirs(model_dir, exist_ok=True)
     tok = build_tiny_tokenizer()
     tok.save(os.path.join(model_dir, "tokenizer.json"))
     eos_id = tok.token_to_id("</s>")
     bos_id = tok.token_to_id("<s>")
+    config = {
+        "model_type": "llama",
+        "eos_token_id": eos_id,
+        "bos_token_id": bos_id,
+        "max_position_embeddings": context_length,
+        "vocab_size": tok.get_vocab_size(),
+    }
+    config.update(config_overrides or {})
     with open(os.path.join(model_dir, "config.json"), "w") as f:
-        json.dump(
-            {
-                "model_type": "llama",
-                "eos_token_id": eos_id,
-                "bos_token_id": bos_id,
-                "max_position_embeddings": context_length,
-                "vocab_size": tok.get_vocab_size(),
-            },
-            f,
-        )
+        json.dump(config, f)
     with open(os.path.join(model_dir, "tokenizer_config.json"), "w") as f:
         json.dump(
             {
