@@ -363,19 +363,26 @@ def main() -> None:
         }
         # NOT this run's measurement — the most recent number this same
         # workload produced on live hardware, kept in-tree so a relay
-        # outage at bench time doesn't erase the evidence; read from the
-        # results file so the pointer can never go stale
-        levers_rel = "examples/llm/benchmarks/results/bench_levers_r04.json"
-        try:
-            with open(os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    levers_rel)) as f:
-                recorded = json.load(f)
-            best["last_live_measurement"] = {
-                "file": levers_rel, **recorded.get("headline", {}),
-            }
-        except (OSError, ValueError):
-            pass
+        # outage at bench time doesn't erase the evidence; glob for the
+        # newest round's levers file so the pointer can never go stale
+        import glob as _glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = sorted(_glob.glob(os.path.join(
+            here, "examples", "llm", "benchmarks", "results",
+            "bench_levers_r*.json")))
+        for path in reversed(candidates):
+            try:
+                with open(path) as f:
+                    recorded = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if recorded.get("headline"):
+                best["last_live_measurement"] = {
+                    "file": os.path.relpath(path, here),
+                    **recorded["headline"],
+                }
+                break
     print(json.dumps(best))
 
 

@@ -173,15 +173,10 @@ def load_mdc(flags):
 
 
 def _engine_args(flags) -> dict:
-    """--extra-engine-args <file.json> → kwargs for the engine
-    (reference: dynamo-run's JSON passthrough, flags.rs:175)."""
-    path = getattr(flags, "extra_engine_args", None)
-    if not path:
-        return {}
-    import json
+    """--extra-engine-args <file.json> → kwargs for the engine."""
+    from ..engine.serving import load_extra_engine_args
 
-    with open(path) as f:
-        return json.load(f)
+    return load_extra_engine_args(flags)
 
 
 async def _load_python_engine(path: str, flags):

@@ -773,38 +773,33 @@ class ModelRunner:
         from ..ops.attention import resolve_attention_impl
 
         cfg = self.config.model
-        if (cfg.attn_logit_softcap or cfg.sliding_window) and \
-                resolve_attention_impl(cfg.attention_impl) != "xla":
-            # ops/attention.py forces impl="xla" per-call for these
-            # semantics (the Pallas kernels implement neither softcapping
-            # nor windowed masks) — say so once at init instead of
-            # silently serving whole families off the fast path
-            # (docs/models.md#attention-path-limitations), and resolve the
-            # config to xla so warmup never probes/compiles Pallas
-            # attention kernels that could not execute anyway
-            logger.info(
-                "model uses %s: attention serves on the XLA path (the "
-                "Pallas kernels do not implement these semantics)",
-                " + ".join(
-                    n for n, on in (
-                        ("logit softcapping", cfg.attn_logit_softcap),
-                        ("sliding-window masks", cfg.sliding_window),
-                    ) if on
-                ),
-            )
-            cfg.attention_impl = "xla"
-            self._build_step()
-            self._build_burst()
-        if (cfg.attention_impl == "auto"
+        if (resolve_attention_impl(cfg.attention_impl) == "pallas"
                 and resolve_attention_impl("auto") == "pallas"):
+            # probe EXPLICIT pallas too, not just auto: the wedge risk is
+            # the first Mosaic compile on a shared-compile-service host,
+            # and that risk doesn't care how the impl was selected. Only
+            # the failure handling differs — auto falls back to XLA,
+            # explicit refuses loudly instead of compiling in-process.
+            # (resolve("auto") == "pallas" ⇔ a TPU backend — CPU runs,
+            # where Mosaic can't wedge anything, skip the probe.)
             import os
 
             from ..ops.probe import probe_serving_kernels
 
             timeout_s = float(os.environ.get("DYN_PALLAS_PROBE_TIMEOUT_S", "180"))
             if not probe_serving_kernels(
-                mla=cfg.kv_lora_rank > 0, timeout_s=timeout_s
+                mla=cfg.kv_lora_rank > 0,
+                windowed=bool(cfg.attn_logit_softcap or cfg.sliding_window),
+                timeout_s=timeout_s,
             ):
+                if cfg.attention_impl != "auto":
+                    raise RuntimeError(
+                        "attention_impl='pallas' was requested explicitly "
+                        "but the kernel probe failed or timed out; refusing "
+                        "the in-process Mosaic compile (a hung compile "
+                        "wedges this host's shared compile service). Use "
+                        "attention_impl='auto' for automatic XLA fallback."
+                    )
                 logger.warning(
                     "pallas kernel probe failed or timed out; this engine "
                     "serves on the XLA attention path"
@@ -812,6 +807,22 @@ class ModelRunner:
                 cfg.attention_impl = "xla"
                 self._build_step()
                 self._build_burst()
+        if (cfg.attn_logit_softcap or cfg.sliding_window) and \
+                resolve_attention_impl(cfg.attention_impl) == "pallas":
+            # the Pallas kernels implement softcapping and windowed masks
+            # natively (the window rides as a scalar operand; windowed
+            # decode walks only the window's pages) — logged AFTER the
+            # probe decision so it is only ever true
+            logger.info(
+                "model uses %s: serving on the Pallas windowed/softcap "
+                "kernel variants",
+                " + ".join(
+                    n for n, on in (
+                        ("logit softcapping", cfg.attn_logit_softcap),
+                        ("sliding-window masks", cfg.sliding_window),
+                    ) if on
+                ),
+            )
         try:
             self._warmup_once(decode_batch)
         except Exception:

@@ -22,16 +22,44 @@ from .scheduler import EngineRequest, Scheduler
 logger = logging.getLogger(__name__)
 
 
-def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
+def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
     """The one place MDC + CLI flags become an EngineConfig.
 
     Shared by decode engines and prefill workers — block geometry MUST match
     across disaggregated workers or transferred KV lands in the wrong slots.
+
+    ``extra`` is the ``--extra-engine-args`` JSON passthrough (reference:
+    dynamo-run flags.rs:175): keys naming ModelConfig fields override the
+    model config (e.g. ``attention_impl``), keys naming EngineConfig
+    fields override the engine config; unknown keys are rejected loudly.
     """
+    import dataclasses
+
     model_cfg = ModelConfig.from_hf_config(mdc.config) if mdc.config else ModelConfig()
     if getattr(flags, "quantization", None):
         model_cfg.quantization = flags.quantization
-    return EngineConfig(
+    if extra is None:
+        extra = load_extra_engine_args(flags)
+    extra = dict(extra or {})
+    model_extra = {}
+    engine_extra = {}
+    model_fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    engine_fields = {f.name for f in dataclasses.fields(EngineConfig)}
+    for key, value in extra.items():
+        if key in model_fields:
+            model_extra[key] = value
+        elif key in engine_fields and key != "model":
+            engine_extra[key] = value
+        else:
+            raise ValueError(
+                f"--extra-engine-args key {key!r} matches no ModelConfig or "
+                f"EngineConfig field"
+            )
+    if model_extra:
+        # replace (not setattr) so __post_init__ re-validates/derives —
+        # e.g. kv_lora_rank without the MLA head dims must fail loudly
+        model_cfg = dataclasses.replace(model_cfg, **model_extra)
+    return _apply_engine_extra(engine_extra, EngineConfig(
         model=model_cfg,
         max_batch_size=getattr(flags, "max_batch_size", 8),
         max_model_len=getattr(flags, "max_model_len", None)
@@ -47,7 +75,36 @@ def engine_config_from_mdc(mdc, flags=None) -> EngineConfig:
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
-    )
+    ))
+
+
+def load_extra_engine_args(flags) -> dict:
+    """--extra-engine-args <file.json> → dict (reference: dynamo-run's
+    JSON passthrough, flags.rs:175). The ONE parse site — the CLI's
+    python-file engine path reuses it."""
+    path = getattr(flags, "extra_engine_args", None)
+    if not path:
+        return {}
+    import json
+
+    with open(path) as f:
+        return json.load(f)
+
+
+def _apply_engine_extra(extra: dict, cfg: EngineConfig) -> EngineConfig:
+    """Apply --extra-engine-args EngineConfig overrides after construction.
+
+    dataclasses.replace re-runs __post_init__, but the bucket derivation
+    only fires when prefill_buckets is None — so a max_model_len override
+    without an explicit bucket list must drop the already-derived buckets
+    or the new length would keep the old (possibly too-short) ladder."""
+    if not extra:
+        return cfg
+    import dataclasses
+
+    if "max_model_len" in extra and "prefill_buckets" not in extra:
+        extra = dict(extra, prefill_buckets=None)
+    return dataclasses.replace(cfg, **extra)
 
 
 class JaxServingEngine(AsyncEngine):

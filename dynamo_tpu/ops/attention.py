@@ -182,7 +182,7 @@ def attention(
     layer_idx=None,          # required when the cache is stacked (5-D)
     scale: Optional[float] = None,  # override the head-dim default
     softcap: float = 0.0,           # Gemma-2 attention logit softcapping
-    sliding_window=None,            # scalar window (XLA path only)
+    sliding_window=None,            # scalar window (int or traced); None = off
 ) -> jax.Array:
     """Paged-attention dispatch: XLA gather path or the Pallas kernels.
 
@@ -204,10 +204,6 @@ def attention(
         scale = d ** -0.5
     dk = k_cache.shape[-1]
     q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
-    if softcap or sliding_window is not None:
-        # the Pallas kernels don't implement softcapping / windowed masks
-        # (Gemma-2 semantics); those models ride the XLA path
-        impl = "xla"
     if resolve_attention_impl(impl) == "xla":
         if stacked:
             # index the layer through the gather itself: block id n of
@@ -226,20 +222,45 @@ def attention(
     from .pallas_attention import paged_flash_attention
     from .pallas_decode import paged_decode_attention
 
+    import os
+
+    # trace-time escape: lets model-level tests drive the full Pallas
+    # path through jitted forwards on CPU (models don't plumb interpret)
+    interpret = interpret or bool(os.environ.get("DYN_PALLAS_INTERPRET"))
     if not stacked:
         k_cache, v_cache = k_cache[None], v_cache[None]
+    # the window may be a traced scalar (Gemma-2 alternates windowed/full
+    # layers inside its layer scan) — it rides as a [1] operand so the
+    # kernels stay compiled once across layers; None = disabled sentinel
+    win = (
+        jnp.full((1,), jnp.int32(2**30))
+        if sliding_window is None
+        else jnp.asarray(sliding_window, jnp.int32).reshape(1)
+    )
     decode = q.shape[1] == 1
     if decode:
         fn = functools.partial(
-            paged_decode_attention, scale=scale, interpret=interpret
+            paged_decode_attention, scale=scale, interpret=interpret,
+            softcap=softcap,
         )
-        args = (q, k_cache, v_cache, block_tables, context_lens, li)
+        args = (q, k_cache, v_cache, block_tables, context_lens, li, win)
+
+        def call(q, k_cache, v_cache, block_tables, context_lens, li, win):
+            return fn(q, k_cache, v_cache, block_tables, context_lens, li,
+                      window=win)
     else:
         fn = functools.partial(
-            paged_flash_attention, scale=scale, interpret=interpret
+            paged_flash_attention, scale=scale, interpret=interpret,
+            softcap=softcap,
         )
         base_pos = positions[:, 0].astype(jnp.int32)
-        args = (q, k_cache, v_cache, block_tables, base_pos, context_lens, li)
+        args = (q, k_cache, v_cache, block_tables, base_pos, context_lens,
+                li, win)
+
+        def call(q, k_cache, v_cache, block_tables, base_pos, context_lens,
+                 li, win):
+            return fn(q, k_cache, v_cache, block_tables, base_pos,
+                      context_lens, li, window=win)
     if mesh is not None and mesh.size > 1:
         # batch shards over dp only when divisible — the scheduler prefills
         # with B=1, which each dp group then computes redundantly (decode,
@@ -253,15 +274,15 @@ def attention(
         ]
         if not decode:
             in_specs.append(P(dp))             # base_pos
-        in_specs.extend([P(dp), P()])          # context_lens, layer_idx
-        fn = jax.shard_map(
-            fn,
+        in_specs.extend([P(dp), P(), P()])     # context_lens, layer_idx, win
+        call = jax.shard_map(
+            call,
             mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=P(dp, None, "tp", None),
             check_vma=False,  # pallas out_shape carries no vma annotation
         )
-    return fn(*args)[..., :d]
+    return call(*args)[..., :d]
 
 
 def prefill_attention(

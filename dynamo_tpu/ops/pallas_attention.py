@@ -36,6 +36,7 @@ def _kernel(
     ctx_ref,    # scalar prefetch: context lens [B]
     base_ref,   # scalar prefetch: base query position [B]
     li_ref,     # scalar prefetch: layer index [1] (consumed by index_maps)
+    win_ref,    # scalar prefetch: sliding window [1] (>= ctx disables)
     q_ref,      # [1, Sc, KVH, G, D] (VMEM block)
     k_ref,      # [1, 1, bs, KVH, D] — one cache page of one layer
     v_ref,
@@ -46,6 +47,7 @@ def _kernel(
     *,
     scale: float,
     block_size: int,
+    softcap: float,
 ):
     b = pl.program_id(0)
     c = pl.program_id(1)
@@ -63,11 +65,16 @@ def _kernel(
 
     ctx = ctx_ref[b]
     base = base_ref[b]
+    window = win_ref[0]
     page_start = w * block_size
     chunk_base = base + c * sc  # absolute position of this chunk's row 0
 
     # page live iff it holds context AND is causally visible to the chunk
+    # AND (with a window) its last key is within window of some chunk query
     live = jnp.logical_and(page_start < ctx, page_start <= chunk_base + sc - 1)
+    live = jnp.logical_and(
+        live, page_start + block_size + window > chunk_base + 1
+    )
 
     @pl.when(live)
     def _compute():
@@ -79,6 +86,7 @@ def _kernel(
             jnp.int32, (rows, block_size), 0
         ) // g
         mask = jnp.logical_and(key_pos <= qpos, key_pos < ctx)
+        mask = jnp.logical_and(mask, key_pos > qpos - window)
 
         for h in range(kvh):
             lo = h * rows
@@ -91,6 +99,8 @@ def _kernel(
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale                                           # [rows, bs]
+            if softcap:
+                s_log = softcap * jnp.tanh(s_log / softcap)
             s_log = jnp.where(mask, s_log, MASK_VALUE)
 
             m_prev = m_scr[lo : lo + rows, 0:1]                 # [rows, 1]
@@ -121,7 +131,7 @@ def _kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "q_chunk", "interpret")
+    jax.jit, static_argnames=("scale", "q_chunk", "interpret", "softcap")
 )
 def paged_flash_attention(
     q: jax.Array,            # [B, S, H, D] (post-RoPE)
@@ -134,6 +144,8 @@ def paged_flash_attention(
     scale: Optional[float] = None,
     q_chunk: int = 128,
     interpret: bool = False,
+    softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
+    window=None,             # sliding window (int or traced scalar); None = off
 ) -> jax.Array:
     b, s, h, d = q.shape
     if k_cache.ndim == 4:
@@ -143,6 +155,11 @@ def paged_flash_attention(
         jnp.zeros((1,), jnp.int32)
         if layer_idx is None
         else jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    )
+    win = (
+        jnp.full((1,), jnp.int32(2**30))
+        if window is None
+        else jnp.asarray(window, jnp.int32).reshape(1)
     )
     w = block_tables.shape[1]
     g = h // kvh
@@ -166,15 +183,25 @@ def paged_flash_attention(
         by_causal = jnp.maximum(base_ref[b_idx] + (c + 1) * sc - 1, 0) // block_size
         return jnp.minimum(by_ctx, by_causal)
 
-    def q_map(i, c, wi, bt, ctx, base, li):
+    def first_needed_page(b_idx, c, base_ref, win_ref):
+        # nearest page a windowed chunk can see: the chunk's first query
+        # (at base + c*sc) sees nothing before base + c*sc - window + 1.
+        # Window off (2**30) clamps to page 0. Leading grid steps re-fetch
+        # this page; the pipeline skips the repeat DMAs and the kernel's
+        # live predicate skips their compute.
+        lo = base_ref[b_idx] + c * sc - win_ref[0] + 1
+        return jnp.maximum(lo, 0) // block_size
+
+    def q_map(i, c, wi, bt, ctx, base, li, win):
         return (i * num_chunks + c, 0, 0, 0, 0)
 
-    def kv_map(i, c, wi, bt, ctx, base, li):
+    def kv_map(i, c, wi, bt, ctx, base, li, win):
         wi = jnp.minimum(wi, last_needed_page(i, c, ctx, base))
+        wi = jnp.maximum(wi, first_needed_page(i, c, base, win))
         return (li[0], bt[i, wi], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(b, num_chunks, w),
         in_specs=[
             pl.BlockSpec((1, sc, kvh, g, d), q_map),
@@ -190,7 +217,9 @@ def paged_flash_attention(
     )
 
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block_size=block_size),
+        functools.partial(
+            _kernel, scale=scale, block_size=block_size, softcap=softcap
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * num_chunks, sc, kvh, g, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -202,6 +231,7 @@ def paged_flash_attention(
         context_lens.astype(jnp.int32),
         base_pos.astype(jnp.int32),
         li,
+        win,
         qg,
         k_cache,
         v_cache,

@@ -43,6 +43,7 @@ def _decode_kernel(
     bt_ref,    # scalar prefetch: block tables [B, W] (SMEM)
     ctx_ref,   # scalar prefetch: context lens [B]
     li_ref,    # scalar prefetch: layer index [1]
+    win_ref,   # scalar prefetch: sliding window [1] (>= ctx disables)
     q_ref,     # [1, KVH, G, D] VMEM block
     k_hbm,     # [L, N, page, KVH, D] in HBM (ANY)
     v_hbm,
@@ -54,6 +55,7 @@ def _decode_kernel(
     scale: float,
     block_size: int,
     pages_per_chunk: int,
+    softcap: float,
 ):
     """One grid step = one batch row; a fori_loop walks only LIVE chunks.
 
@@ -65,12 +67,19 @@ def _decode_kernel(
     trades KVH× redundant MXU flops (trivial at decode shapes) for not
     issuing KVH tiny [G, chunk] dots per chunk — decode attention is DMA
     bound; op-issue overhead was the previous kernel's limiter.
+
+    With a sliding window the walk starts at the first chunk holding a
+    visible key (the decode query sits at ctx-1, so only positions in
+    [ctx - window, ctx) matter): windowed decode costs O(window) DMA,
+    not O(context) — the gathered XLA path always pays full width.
     """
     b = pl.program_id(0)
     ctx = ctx_ref[b]
     li = li_ref[0]
     npages = pl.cdiv(ctx, block_size)          # live pages (ctx >= 1 in decode)
     nchunks = pl.cdiv(npages, pages_per_chunk)
+    # first key position the decode query (at ctx-1) can see
+    win_start = jnp.maximum(ctx - win_ref[0], 0)
 
     _, kvh, g, d = q_ref.shape
     rows = kvh * g
@@ -95,7 +104,8 @@ def _decode_kernel(
             page_copy(chunk, slot, i, k_hbm, k_buf).wait()
             page_copy(chunk, slot, i, v_hbm, v_buf).wait()
 
-    start(0, 0)
+    first_chunk = win_start // chunk_t         # 0 when the window is off
+    start(first_chunk, jax.lax.rem(first_chunk, 2))
     q = q_ref[0].reshape(rows, d)  # [KVH*G, D], rows ordered (head, group)
 
     # column j of the flattened chunk is (token j // KVH, head j % KVH);
@@ -118,14 +128,18 @@ def _decode_kernel(
         v = v_buf[slot].reshape(cols, d)
 
         # decode causality: the query is the newest token, so every key
-        # with position < ctx is visible — a pure validity mask.
-        mask = jnp.logical_and(head_match, c * chunk_t + col_tok < ctx)
+        # with position < ctx is visible — a pure validity mask (plus the
+        # window's lower bound; win_start == 0 when the window is off).
+        key_pos = c * chunk_t + col_tok
+        mask = head_match & (key_pos < ctx) & (key_pos >= win_start)
 
         s_log = jax.lax.dot_general(
             q, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                         # [rows, cols]
+        if softcap:
+            s_log = softcap * jnp.tanh(s_log / softcap)
         s_log = jnp.where(mask, s_log, MASK_VALUE)
 
         m_cur = jnp.max(s_log, -1, keepdims=True)         # [rows, 1]
@@ -146,7 +160,7 @@ def _decode_kernel(
     m0 = jnp.full((rows, 128), MASK_VALUE, jnp.float32)
     l0 = jnp.zeros((rows, 128), jnp.float32)
     acc0 = jnp.zeros((rows, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nchunks, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(first_chunk, nchunks, body, (m0, l0, acc0))
     l1 = l[:, 0:1]
     l1 = jnp.where(l1 == 0.0, 1.0, l1)
     o_ref[0] = (acc / l1).astype(o_ref.dtype).reshape(kvh, g, d)
@@ -336,7 +350,8 @@ def mla_paged_decode_attention(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+    jax.jit,
+    static_argnames=("scale", "pages_per_chunk", "interpret", "softcap"),
 )
 def paged_decode_attention(
     q: jax.Array,            # [B, 1, H, D] (post-RoPE)
@@ -348,8 +363,14 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     pages_per_chunk: int = 8,
     interpret: bool = False,
+    softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
+    window=None,             # sliding window (int or traced scalar); None = off
 ) -> jax.Array:
-    """Single-token paged attention; returns [B, 1, H, D]."""
+    """Single-token paged attention; returns [B, 1, H, D].
+
+    ``window`` may be traced (Gemma-2 alternates windowed/full layers
+    inside its layer scan), so it rides as a scalar-prefetch operand; the
+    kernel starts its page walk at the window's first live chunk."""
     b, s, h, d = q.shape
     assert s == 1, "decode kernel is specialized to one query token"
     if k_cache.ndim == 4:
@@ -363,6 +384,11 @@ def paged_decode_attention(
         if layer_idx is None
         else jnp.asarray(layer_idx, jnp.int32).reshape(1)
     )
+    win = (
+        jnp.full((1,), jnp.int32(2**30))
+        if window is None
+        else jnp.asarray(window, jnp.int32).reshape(1)
+    )
     # fewer in-flight copies than pages in a short context wastes nothing;
     # more than the table width would index past it
     pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
@@ -370,7 +396,7 @@ def paged_decode_attention(
     qs = q.reshape(b, kvh, g, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
@@ -395,6 +421,7 @@ def paged_decode_attention(
             scale=scale,
             block_size=block_size,
             pages_per_chunk=pages_per_chunk,
+            softcap=softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
@@ -406,6 +433,7 @@ def paged_decode_attention(
         block_tables.astype(jnp.int32),
         context_lens.astype(jnp.int32),
         li,
+        win,
         qs,
         k_cache,
         v_cache,

@@ -69,6 +69,24 @@ def probe_decode():
     np.asarray(paged_decode_attention(q, k, v, bt, ctx, jnp.asarray(1, jnp.int32)))
 
 
+def probe_decode_windowed():
+    # windowed + softcapped variant (Gemma-2/Mistral-class configs): a
+    # different static specialization, so its Mosaic compile needs its
+    # own probe — but ONLY engines whose model uses it pay for it
+    from dynamo_tpu.ops.pallas_decode import paged_decode_attention
+
+    l, n, page, kvh, d, b, w = 2, 16, 16, 2, 128, 2, 4
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, 1, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(paged_decode_attention(
+        q, k, v, bt, ctx, jnp.asarray(1, jnp.int32),
+        softcap=50.0, window=jnp.asarray(16, jnp.int32),
+    ))
+
+
 def probe_prefill():
     from dynamo_tpu.ops.pallas_attention import paged_flash_attention
 
@@ -80,6 +98,22 @@ def probe_prefill():
     base = jnp.zeros((b,), jnp.int32)
     ctx = jnp.asarray([s], jnp.int32)
     np.asarray(paged_flash_attention(q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32)))
+
+
+def probe_prefill_windowed():
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 8, 128
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    base = jnp.zeros((b,), jnp.int32)
+    ctx = jnp.asarray([s], jnp.int32)
+    np.asarray(paged_flash_attention(
+        q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32),
+        softcap=50.0, window=jnp.asarray(48, jnp.int32),
+    ))
 
 
 def probe_mla_decode():
@@ -99,7 +133,9 @@ def probe_mla_decode():
 
 PROBES = {
     "decode": probe_decode,
+    "decode_windowed": probe_decode_windowed,
     "prefill": probe_prefill,
+    "prefill_windowed": probe_prefill_windowed,
     "mla_decode": probe_mla_decode,
 }
 for kind in sys.argv[1:]:
@@ -117,7 +153,8 @@ def probe_kernels(
 ) -> Dict[str, Optional[bool]]:
     """Compile-and-run Pallas kernels on tiny shapes in ONE child process.
 
-    ``kinds`` ⊆ {"decode", "prefill", "mla_decode"}. Returns per kind:
+    ``kinds`` ⊆ {"decode", "decode_windowed", "prefill",
+    "prefill_windowed", "mla_decode"}. Returns per kind:
     True (compiled and ran), False (failed or timed out — do not compile
     this kernel in-process), or None (inconclusive: the child could not
     acquire the TPU because this process holds it exclusively).
@@ -189,19 +226,25 @@ def probe_kernel(
 
 
 def probe_serving_kernels(
-    mla: bool = False, timeout_s: float = 180.0
+    mla: bool = False, windowed: bool = False, timeout_s: float = 180.0
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
-    would compile — the dense engines' decode + flash-prefill kernels,
-    or ONLY the MLA decode kernel for MLA models (MLA prefill always
-    runs the dense XLA formulation; models/deepseek.py).
+    would compile — the dense engines' decode + flash-prefill kernels
+    (plus the windowed+softcap specializations only when the model config
+    uses them), or ONLY the MLA decode kernel for MLA models (MLA prefill
+    always runs the dense XLA formulation; models/deepseek.py).
 
     True → let auto resolve to pallas. Any hard failure/timeout → False.
     Inconclusive (exclusive-device host) → True with a warning: a child
     can never compile there, and the in-process try/except fallback
     still guards plain failures.
     """
-    kinds = ["mla_decode"] if mla else ["decode", "prefill"]
+    if mla:
+        kinds = ["mla_decode"]
+    elif windowed:
+        kinds = ["decode", "prefill", "decode_windowed", "prefill_windowed"]
+    else:
+        kinds = ["decode", "prefill"]
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

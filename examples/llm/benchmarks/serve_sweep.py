@@ -170,7 +170,10 @@ def main() -> None:
             for line in lg.stdout.splitlines():
                 line = line.strip()
                 if line.startswith("{"):
-                    lvl = json.loads(line)
+                    try:
+                        lvl = json.loads(line)
+                    except ValueError:
+                        continue  # log line that happens to start with '{'
                     levels.append(lvl)
                     print(json.dumps(lvl), flush=True)
             if lg.returncode != 0:

@@ -825,3 +825,31 @@ async def test_prompt_scoring_max_tokens_zero(hf_model_dir):
     outs2 = [o async for o in engine.generate(Context(req2))]
     assert outs2 == [{"token_ids": [], "finish_reason": "length"}]
     await engine.close()
+
+
+def test_extra_engine_args_override_model_and_engine_fields(hf_model_dir):
+    """--extra-engine-args JSON passthrough (reference: dynamo-run
+    flags.rs:175): ModelConfig keys hit the model config, EngineConfig
+    keys the engine config; a max_model_len override re-derives the
+    prefill-bucket ladder; unknown keys fail loudly."""
+    from dynamo_tpu.engine.serving import engine_config_from_mdc
+
+    mdc = ModelDeploymentCard.from_local_path(hf_model_dir)
+    cfg = engine_config_from_mdc(
+        mdc, extra={"attention_impl": "pallas", "num_kv_blocks": 77}
+    )
+    assert cfg.model.attention_impl == "pallas"
+    assert cfg.num_kv_blocks == 77
+
+    # max_model_len override must re-derive buckets past the old top
+    small = engine_config_from_mdc(mdc)
+    bigger = engine_config_from_mdc(
+        mdc, extra={"max_model_len": 4 * small.max_model_len}
+    )
+    assert bigger.max_model_len == 4 * small.max_model_len
+    assert bigger.prefill_buckets[-1] >= bigger.max_model_len \
+        or bigger.prefill_buckets[-1] > small.prefill_buckets[-1]
+    assert bigger.bucket_for(small.prefill_buckets[-1] + 1)
+
+    with pytest.raises(ValueError, match="no ModelConfig or EngineConfig"):
+        engine_config_from_mdc(mdc, extra={"not_a_field": 1})

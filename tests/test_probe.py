@@ -131,7 +131,7 @@ def test_warmup_consults_probe_before_any_pallas_compile(monkeypatch):
     )
     monkeypatch.setattr(
         probe_mod, "probe_serving_kernels",
-        lambda mla=False, timeout_s=0: calls.append((mla, timeout_s)) or False,
+        lambda mla=False, timeout_s=0, **kw: calls.append((mla, timeout_s)) or False,
     )
     runner, econfig = tiny_runner("auto")
     runner.warmup()
@@ -156,7 +156,7 @@ def test_warmup_inprocess_failure_reinits_donated_state(monkeypatch):
         lambda impl: "pallas" if impl == "auto" else impl,
     )
     monkeypatch.setattr(
-        probe_mod, "probe_serving_kernels", lambda mla=False, timeout_s=0: True
+        probe_mod, "probe_serving_kernels", lambda mla=False, timeout_s=0, **kw: True
     )
     runner, econfig = tiny_runner("auto")
     runner.warmup()  # pallas fails on CPU → except-path fallback
@@ -181,7 +181,7 @@ def test_mla_models_probe_mla_kernel(monkeypatch):
     )
     monkeypatch.setattr(
         probe_mod, "probe_serving_kernels",
-        lambda mla=False, timeout_s=0: seen.setdefault("mla", mla) or False,
+        lambda mla=False, timeout_s=0, **kw: seen.setdefault("mla", mla) or False,
     )
     cfg = ModelConfig(
         vocab_size=128, hidden_size=32, intermediate_size=48, num_layers=2,
