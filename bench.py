@@ -366,11 +366,15 @@ def main() -> None:
         # outage at bench time doesn't erase the evidence; glob for the
         # newest round's levers file so the pointer can never go stale
         import glob as _glob
+        import re as _re
 
         here = os.path.dirname(os.path.abspath(__file__))
-        candidates = sorted(_glob.glob(os.path.join(
-            here, "examples", "llm", "benchmarks", "results",
-            "bench_levers_r*.json")))
+        candidates = sorted(
+            _glob.glob(os.path.join(
+                here, "examples", "llm", "benchmarks", "results",
+                "bench_levers_r*.json")),
+            key=lambda p: int(_re.search(r"_r(\d+)", p).group(1)),
+        )
         for path in reversed(candidates):
             try:
                 with open(path) as f:

@@ -247,6 +247,19 @@ class EngineConfig:
         if self.prefill_buckets is None:
             self.prefill_buckets = default_prefill_buckets(self.max_model_len)
         self.prefill_buckets = sorted(self.prefill_buckets)
+        if self.dp_size > 1:
+            if self.max_batch_size % self.dp_size:
+                raise ValueError(
+                    f"max_batch_size {self.max_batch_size} not divisible "
+                    f"by dp_size {self.dp_size} (decode rows shard over dp)"
+                )
+            # batch rows shard over dp in every compiled program (jit
+            # in_shardings P("dp")), so the padded prefill row ladder must
+            # stay dp-divisible too — scale it; short batches ride as
+            # inert pad rows
+            self.PREFILL_ROW_BUCKETS = tuple(
+                r * self.dp_size for r in type(self).PREFILL_ROW_BUCKETS
+            )
         # clamp into the compiled row ladder: values past the top bucket
         # would admit more rows than the step arrays hold (IndexError in
         # the scheduler), and <= 0 would silently admit nothing

@@ -121,20 +121,18 @@ class ModelRunner:
             pp=config.pp_size,
         )
         if config.pp_size > 1:
-            if self.arch is not llama:
+            from ..models import mixtral as _mixtral
+
+            if self.arch not in (llama, _mixtral):
                 raise NotImplementedError(
-                    "pipeline parallelism currently stages the dense "
-                    "llama-family trunk only (MoE/MLA models: use tp/ep)"
+                    "pipeline parallelism stages the GQA trunk families "
+                    "(llama-family dense + mixtral MoE); MLA/gemma2 "
+                    "models: use tp/ep"
                 )
             if cfg.num_layers % config.pp_size:
                 raise ValueError(
                     f"{cfg.num_layers} layers not divisible by "
                     f"pp {config.pp_size}"
-                )
-            if config.dp_size > 1 or config.ep_size > 1:
-                raise NotImplementedError(
-                    "pp composes with tp only (dp routes replicas at the "
-                    "cluster layer instead; see runtime/client.py)"
                 )
 
         if cfg.kv_lora_rank == 0 and cfg.num_kv_heads % config.tp_size != 0:
@@ -190,8 +188,11 @@ class ModelRunner:
 
             params = pp_mod.stage_params(params, config.pp_size)
             # pp_mod.param_specs mirrors QuantizedWeight leaves itself (the
-            # same tree feeds its shard_map in_specs)
-            pspecs = pp_mod.param_specs(params, tp=config.tp_size > 1)
+            # same tree feeds pipeline_forward's shard_map in_specs); the
+            # family's own specs carry ep for MoE expert stacks
+            pspecs = pp_mod.param_specs(
+                params, tp=config.tp_size > 1, arch=self.arch
+            )
             cache_spec = (
                 pp_mod.CACHE_SPEC_TP if config.tp_size > 1
                 else pp_mod.CACHE_SPEC
@@ -236,9 +237,9 @@ class ModelRunner:
             def forward(params, cache, tokens, positions, bt, slots, ctx):
                 return pipeline_forward(
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
-                    mesh, return_hidden=True,
+                    mesh, return_hidden=True, arch=arch,
                 )
-            head_fn = llama.logits_from_hidden  # pp stages the llama trunk
+            head_fn = arch.logits_from_hidden
         else:
             def forward(params, cache, tokens, positions, bt, slots, ctx):
                 return arch.forward(

@@ -303,10 +303,17 @@ def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
 
 
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                     context_lens, mesh):
+                     context_lens, mesh, kv_gather_axis=None):
     """The standard attention block: QKV + RoPE, paged-KV scatter, GQA
     attention, output projection. Families with different attention (MLA,
-    models/deepseek.py) plug their own via run_layers' attn_fn."""
+    models/deepseek.py) plug their own via run_layers' attn_fn.
+
+    ``kv_gather_axis``: inside a manual shard_map whose batch rows shard
+    over that mesh axis while the KV cache stays replicated across it
+    (the pipelined pp x dp program, parallel/pipeline.py), every member
+    must apply EVERY member's cache writes or the replicas diverge — the
+    new K/V and their slots are all-gathered over the axis before the
+    scatter; attention still runs on the local rows only."""
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):
@@ -330,7 +337,15 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
 
         # in-place scatter into the stacked cache + layer-indexed kernels:
         # no per-layer cache slice is ever materialized inside the scan
-        k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
+        if kv_gather_axis is not None:
+            k_w = jax.lax.all_gather(k, kv_gather_axis, axis=0, tiled=True)
+            v_w = jax.lax.all_gather(v, kv_gather_axis, axis=0, tiled=True)
+            slots_w = jax.lax.all_gather(
+                slot_mapping, kv_gather_axis, axis=0, tiled=True
+            )
+        else:
+            k_w, v_w, slots_w = k, v, slot_mapping
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k_w, v_w, slots_w, li)
         attn = attention(
             q, k_all, v_all, block_tables, positions, context_lens,
             impl=cfg.attention_impl, mesh=mesh, layer_idx=li,

@@ -68,6 +68,7 @@ def moe_mlp(
     norm_topk: bool = True,             # renormalize top-k gate weights
     routed_scaling: float = 1.0,        # DeepSeek routed_scaling_factor
     router_bias: Optional[jax.Array] = None,  # [E] V3 e_score_correction_bias
+    ep_axis: Optional[str] = None,      # manual-shard_map expert axis
 ) -> jax.Array:
     """Top-k routed SwiGLU experts via dense one-hot dispatch.
 
@@ -77,6 +78,13 @@ def moe_mlp(
     the checkpoint family: Mixtral = softmax scores + renormalized top-k;
     DeepSeek-V2 = softmax, norm_topk_prob=False, scaled routed output;
     DeepSeek-V3 = sigmoid scores.
+
+    ``ep_axis``: inside a manual shard_map where the expert stacks are
+    sharded over that mesh axis (the pipelined pp x ep program), the
+    routing (cheap, replicated) runs over the GLOBAL expert set and the
+    dispatch/combine tensors are sliced to this member's experts; the
+    returned value is then a PARTIAL sum the caller must psum over the
+    axis (together with its tp reduction).
     """
     t, d = x.shape
     e = router_w.shape[1]
@@ -113,6 +121,17 @@ def moe_mlp(
     slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
     dispatch = slot.sum(axis=1)                              # [T, E, C] 0/1
     combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)  # [T, E, C]
+
+    if ep_axis is not None:
+        # expert stacks are axis-local: keep only this member's experts
+        # (slot queueing above ran on global E, so capacity order is
+        # identical to the unsharded math). e from router_w, not
+        # w_gate.shape — the expert stacks may be QuantizedWeight
+        # (int8 serving), which carries no .shape
+        e_local = e // lax.axis_size(ep_axis)
+        e0 = lax.axis_index(ep_axis) * e_local
+        dispatch = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+        combine = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
 
     x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)   # [E, C, D]
     # expert_einsum: dispatches to int8 weights (scale on the out axis)
@@ -193,9 +212,12 @@ def forward(
     )
 
 
-def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
+def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
+                    ep_axis: Optional[str] = None):
     """Routed-experts mlp_fn for run_layers/decoder_forward; shared with
-    models/deepseek.py (DeepSeek MoE layers, incl. its shared expert)."""
+    models/deepseek.py (DeepSeek MoE layers, incl. its shared expert).
+    ``ep_axis`` (manual shard_map callers): see moe_mlp — the routed part
+    becomes a partial sum the caller reduces over the axis."""
     capacity = expert_capacity(
         b * s, cfg.num_experts, cfg.num_experts_per_tok, cfg.moe_capacity_factor
     )
@@ -210,9 +232,18 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
             scoring=cfg.moe_scoring_func, norm_topk=cfg.norm_topk_prob,
             routed_scaling=cfg.routed_scaling_factor,
             router_bias=layer_params.get("router_bias"),
+            ep_axis=ep_axis,
         )
         y = y.reshape(b, s, -1)
         if "w_sh_gate" in layer_params:
+            if ep_axis is not None:
+                # the caller psums the routed PARTIAL over ep; a shared
+                # expert computed replicated would be multiplied by the
+                # axis size (only the staged-mixtral path sets ep_axis,
+                # and mixtral has no shared experts)
+                raise NotImplementedError(
+                    "shared experts under a manual ep axis"
+                )
             # always-on shared expert(s) alongside the routed ones
             gate = jax.nn.silu(dense(x, layer_params["w_sh_gate"]))
             y = y + dense(

@@ -30,6 +30,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -1e30
 
+from .pallas_decode import _out_vma  # noqa: E402  (shared vma-union helper)
+
 
 def _kernel(
     bt_ref,     # scalar prefetch: block tables [B, W]
@@ -221,7 +223,10 @@ def paged_flash_attention(
             _kernel, scale=scale, block_size=block_size, softcap=softcap
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * num_chunks, sc, kvh, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * num_chunks, sc, kvh, g, d), q.dtype,
+            vma=_out_vma(q, k_cache),
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),

@@ -39,6 +39,16 @@ from jax.experimental.pallas import tpu as pltpu
 MASK_VALUE = -1e30
 
 
+def _out_vma(*arrays):
+    """Varying-manual-axes annotation for pallas out_shape: the output
+    varies over every manual mesh axis any input varies over. Needed so
+    the kernels compose with ``check_vma=True`` shard_maps (the
+    partial-manual pipeline in parallel/pipeline.py); None outside
+    shard_map tracing, preserving plain-jit behavior."""
+    vma = frozenset().union(*(jax.typeof(a).vma for a in arrays))
+    return vma or None
+
+
 def _decode_kernel(
     bt_ref,    # scalar prefetch: block tables [B, W] (SMEM)
     ctx_ref,   # scalar prefetch: context lens [B]
@@ -332,7 +342,8 @@ def mla_paged_decode_attention(
             pages_per_chunk=pages_per_chunk,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, r), q_lat.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, r), q_lat.dtype,
+                                       vma=_out_vma(q_lat, c_cache)),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
         ),
@@ -424,7 +435,8 @@ def paged_decode_attention(
             softcap=softcap,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype,
+                                       vma=_out_vma(q, k_cache)),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
         ),

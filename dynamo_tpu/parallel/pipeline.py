@@ -72,22 +72,28 @@ def unstage_cache(kv_cache: KVCache) -> KVCache:
     return tuple(c.reshape(-1, *c.shape[2:]) for c in kv_cache)
 
 
-def param_specs(params, tp: bool = False) -> dict:
-    """Specs for staged params: layer stacks shard over pp on the stage
-    axis. With ``tp`` the inner dims also shard Megatron-style — each
-    spec is llama's per-layer tp spec with "pp" prepended for the stage
-    axis (wq/wk/wv/w_gate/w_up column-parallel, wo/w_down row-parallel);
-    lm_head stays vocab-sharded over tp at the outer (GSPMD) level."""
+def param_specs(params, tp: bool = False, arch=None) -> dict:
+    """Placement specs for staged params: layer stacks shard over pp on
+    the stage axis. With ``tp`` the inner dims also shard Megatron-style —
+    each spec is the family's per-layer tp spec with "pp" prepended for
+    the stage axis (wq/wk/wv/w_gate/w_up column-parallel, wo/w_down
+    row-parallel; MoE experts additionally over "ep"); lm_head stays
+    vocab-sharded over tp at the outer (GSPMD) level."""
+    arch = arch or llama
     specs = {"embed": P(), "final_norm": P()}
     if "lm_head" in params:
         specs["lm_head"] = P(None, "tp") if tp else P()
-    if tp:
-        layer_specs = llama.param_specs({"layers": params["layers"]})["layers"]
-        specs["layers"] = {
-            k: P("pp", *s) for k, s in layer_specs.items()
-        }
-    else:
-        specs["layers"] = jax.tree.map(lambda _: P("pp"), params["layers"])
+    # always start from the family's specs so non-tp axes (MoE "ep" on
+    # the expert stacks) survive even when tp is off — only the "tp"
+    # names are stripped at tp=1
+    layer_specs = arch.param_specs({"layers": params["layers"]})["layers"]
+
+    def axis(a):
+        return None if (a == "tp" and not tp) else a
+
+    specs["layers"] = {
+        k: P("pp", *(axis(a) for a in s)) for k, s in layer_specs.items()
+    }
     # int8 serving: QuantizedWeight leaves need mirrored spec NODES (the
     # scale is one rank lower than q) — both for device_put and for the
     # shard_map in_specs below
@@ -113,18 +119,41 @@ def pipeline_forward(
     mesh,
     num_microbatches: Optional[int] = None,
     return_hidden: bool = False,
+    arch=None,                # family module (llama default; mixtral = MoE)
 ) -> Tuple[jax.Array, KVCache]:
-    """Llama-family forward with the trunk pipelined over the pp axis.
+    """GQA-family forward with the trunk pipelined over the pp axis.
 
     Returns (logits [B, S, V], updated staged cache) — same contract as
-    llama.forward modulo the staged cache layout. M defaults to P (the
-    minimum that fills the pipeline; raise it to shrink the bubble).
+    the family's forward modulo the staged cache layout. M defaults to P
+    (the minimum that fills the pipeline; raise it to shrink the bubble).
+
+    The shard_map is fully manual (dp/ep included) with explicit
+    collectives — a partial-manual formulation (dp/ep left to GSPMD)
+    crashes XLA's bf16 AllReducePromotion pass on this toolchain, because
+    shardy inserts a sharding_constraint inside the psum reducer region:
+
+    - dp: microbatch rows shard over "dp" when divisible; the KV cache is
+      replicated across dp, so each member all-gathers every member's new
+      K/V + slots before the cache scatter (make_gqa_attn_fn's
+      kv_gather_axis) and attends its local rows only. A batch too small
+      to split (B=1 prefill) is computed replicated — the non-pp path's
+      behavior.
+    - ep (MoE): expert stacks shard over "ep"; routing runs replicated
+      over the global expert set, each member computes its local experts,
+      and ONE psum over (tp, ep) finishes both the Megatron row-parallel
+      contraction and the expert combine (moe_mlp's ep_axis).
     """
     import dataclasses as _dc
     import math as _math
 
+    from ..models import mixtral as _mixtral
+
+    arch = arch or llama
+    moe = arch is _mixtral
     num_stages = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
+    ep = mesh.shape.get("ep", 1) if moe else 1
     b, s = tokens.shape
     # auto microbatching: M = P fills the pipeline, but the batch must
     # split evenly — prefill runs at B=1, so fall back to the largest
@@ -135,6 +164,12 @@ def pipeline_forward(
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     mb = b // m
+    # shard microbatch rows over dp when they split evenly; otherwise
+    # every dp member computes the full rows redundantly (exactly the
+    # non-pp engine's prefill-at-B=1 behavior)
+    shard_dp = dp > 1 and mb % dp == 0
+    mb_local = mb // dp if shard_dp else mb
+    batch_spec = P(None, "dp") if shard_dp else P()
 
     def split_mb(x):
         return x.reshape(m, mb, *x.shape[1:])
@@ -157,16 +192,17 @@ def pipeline_forward(
         )
         if tp > 1 else cfg
     )
+    mlp_axes = ("tp", "ep") if ep > 1 else "tp"
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(
-            param_specs(params, tp=tp > 1),
+            param_specs(params, tp=tp > 1, arch=arch),
             (cache_spec, cache_spec),
-            P(), P(), P(), P(), P(),
+            batch_spec, batch_spec, batch_spec, batch_spec, batch_spec,
         ),
-        out_specs=(P(), (cache_spec, cache_spec)),
+        out_specs=(batch_spec, (cache_spec, cache_spec)),
         check_vma=False,
     )
     def run(params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb):
@@ -202,17 +238,28 @@ def pipeline_forward(
             slots = jnp.where(valid, slots, -1)
 
             base_attn = llama.make_gqa_attn_fn(
-                local_cfg, mb, s, pos, slots, tab, ctx, mesh=None
+                local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
+                kv_gather_axis="dp" if shard_dp else None,
             )
-            if tp > 1:
+            base_mlp = (
+                _mixtral.make_moe_mlp_fn(
+                    cfg, mb_local, s, slots,
+                    ep_axis="ep" if ep > 1 else None,
+                ) if moe
+                else llama._swiglu_mlp
+            )
+            if tp > 1 or ep > 1:
                 def attn_fn(x, lp, k, v, li):
                     delta, k, v = base_attn(x, lp, k, v, li)
                     return lax.psum(delta, "tp"), k, v
 
                 def mlp_fn(x, lp):
-                    return lax.psum(llama._swiglu_mlp(x, lp), "tp")
+                    # ONE reduction finishes both the Megatron
+                    # row-parallel contraction (tp) and, for MoE, the
+                    # local-expert combine (ep)
+                    return lax.psum(base_mlp(x, lp), mlp_axes)
             else:
-                attn_fn, mlp_fn = base_attn, llama._swiglu_mlp
+                attn_fn, mlp_fn = base_attn, base_mlp
             hidden, (k_local, v_local), _ = llama.run_layers(
                 x_in, (k_local, v_local), local_layers, cfg, attn_fn,
                 mlp_fn,
@@ -233,8 +280,8 @@ def pipeline_forward(
             )
             return x_state, k_local, v_local, outputs
 
-        x0 = jnp.zeros((mb, s, d_model), params["embed"].dtype)
-        out0 = jnp.zeros((m, mb, s, d_model), params["embed"].dtype)
+        x0 = jnp.zeros((mb_local, s, d_model), params["embed"].dtype)
+        out0 = jnp.zeros((m, mb_local, s, d_model), params["embed"].dtype)
         x_state, k_local, v_local, outputs = lax.fori_loop(
             0, ticks, tick, (x0, k_local, v_local, out0)
         )
@@ -251,4 +298,4 @@ def pipeline_forward(
     hidden = outputs.reshape(b, s, -1)
     if return_hidden:
         return hidden, kv_cache
-    return llama.lm_logits(hidden, params, cfg), kv_cache
+    return arch.logits_from_hidden(hidden, params, cfg), kv_cache
