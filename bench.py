@@ -369,11 +369,15 @@ def main() -> None:
         import re as _re
 
         here = os.path.dirname(os.path.abspath(__file__))
+        def round_num(path: str) -> int:
+            m = _re.search(r"_r(\d+)", os.path.basename(path))
+            return int(m.group(1)) if m else -1
+
         candidates = sorted(
             _glob.glob(os.path.join(
                 here, "examples", "llm", "benchmarks", "results",
                 "bench_levers_r*.json")),
-            key=lambda p: int(_re.search(r"_r(\d+)", p).group(1)),
+            key=round_num,
         )
         for path in reversed(candidates):
             try:

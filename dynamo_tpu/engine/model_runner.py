@@ -121,12 +121,13 @@ class ModelRunner:
             pp=config.pp_size,
         )
         if config.pp_size > 1:
+            from ..models import gemma2 as _gemma2
             from ..models import mixtral as _mixtral
 
-            if self.arch not in (llama, _mixtral):
+            if self.arch not in (llama, _mixtral, _gemma2):
                 raise NotImplementedError(
                     "pipeline parallelism stages the GQA trunk families "
-                    "(llama-family dense + mixtral MoE); MLA/gemma2 "
+                    "(llama-family dense, mixtral MoE, gemma2); MLA "
                     "models: use tp/ep"
                 )
             if cfg.num_layers % config.pp_size:
@@ -239,17 +240,15 @@ class ModelRunner:
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
                     mesh, return_hidden=True, arch=arch,
                 )
-            head_fn = arch.logits_from_hidden
         else:
             def forward(params, cache, tokens, positions, bt, slots, ctx):
                 return arch.forward(
                     params, cfg, tokens, positions, cache, bt, slots, ctx,
                     mesh=mesh, return_hidden=True,
                 )
-            head_fn = arch.logits_from_hidden
 
         def head(hidden, params):
-            return head_fn(hidden, params, cfg)
+            return arch.logits_from_hidden(hidden, params, cfg)
 
         return forward, head
 

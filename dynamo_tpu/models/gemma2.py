@@ -16,9 +16,10 @@ tests/test_gemma2.py):
   (``config.layer_types``: sliding/full alternating from layer 0);
 - logits through the tied embedding with final softcapping.
 
-Softcap/window ride the XLA attention path (ops/attention.py falls back
-from Pallas for these semantics). Reference analog: the Gemma models of
-the engines the reference delegates to (vLLM model zoo, SURVEY §2.4).
+Softcap/window serve on the Pallas kernels natively (the window rides as
+a runtime scalar operand; ops/attention.py). Reference analog: the Gemma
+models of the engines the reference delegates to (vLLM model zoo,
+SURVEY §2.4).
 """
 
 from __future__ import annotations
@@ -32,7 +33,11 @@ from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
 from ..ops.attention import attention, scatter_kv_stacked
-from .llama import apply_rope, init_kv_cache  # noqa: F401  (shared cache layout)
+from .llama import (  # noqa: F401  (shared cache layout)
+    apply_rope,
+    gather_kv_writes,
+    init_kv_cache,
+)
 from .quant import dense
 
 Params = Dict
@@ -100,6 +105,87 @@ def param_specs(params: Params) -> Dict:
     return specs
 
 
+def embed_tokens(params: Params, tokens: jax.Array) -> jax.Array:
+    """Gemma scales embeddings by sqrt(hidden_size) (HF ``normalizer``)."""
+    hidden = params["embed"][tokens]
+    d_model = params["embed"].shape[-1]
+    return hidden * jnp.asarray(math.sqrt(d_model), hidden.dtype)
+
+
+def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                 context_lens, mesh, kv_gather_axis=None, layer_offset=0):
+    """Gemma-2 attention block for run_layers: plain-rope QKV,
+    query_pre_attn_scalar scaling, logit softcap, and the alternating
+    per-layer sliding window (EVEN layers windowed). Same contract as
+    llama.make_gqa_attn_fn incl. ``kv_gather_axis`` (the pipelined
+    pp x dp program's replicated-cache sync; see llama.py).
+
+    ``layer_offset``: under pipeline staging ``li`` is the STAGE-LOCAL
+    layer index (it addresses the stage's cache slab), but the
+    sliding/full alternation follows the GLOBAL layer number — the
+    stage's first global layer index comes in here (may be traced)."""
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    scale = (cfg.query_pre_attn_scalar or hd) ** -0.5
+
+    def attn_fn(x, lp, k_all, v_all, li):
+        q = dense(x, lp["wq"]).reshape(b, s, h, hd)
+        k = dense(x, lp["wk"]).reshape(b, s, kvh, hd)
+        v = dense(x, lp["wv"]).reshape(b, s, kvh, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, None)
+        k = apply_rope(k, positions, cfg.rope_theta, None)
+        if kv_gather_axis is not None:
+            k_w, v_w, slots_w = gather_kv_writes(k, v, slot_mapping,
+                                                 kv_gather_axis)
+        else:
+            k_w, v_w, slots_w = k, v, slot_mapping
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k_w, v_w, slots_w, li)
+        # layer_types alternates sliding/full starting sliding at layer 0
+        window = (
+            jnp.where((li + layer_offset) % 2 == 0, cfg.sliding_window,
+                      jnp.int32(1 << 30))
+            if cfg.sliding_window else None
+        )
+        attn = attention(
+            q, k_all, v_all, block_tables, positions, context_lens,
+            impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
+            scale=scale, softcap=cfg.attn_logit_softcap,
+            sliding_window=window,
+        )
+        delta = dense(attn.reshape(b, s, h * hd), lp["wo"])
+        return delta, k_all, v_all
+
+    return attn_fn
+
+
+def mlp_fn(x: jax.Array, lp) -> jax.Array:
+    """GeGLU (tanh-approximated gelu on the gate)."""
+    gate = jax.nn.gelu(dense(x, lp["w_gate"]), approximate=True)
+    return dense(gate * dense(x, lp["w_up"]), lp["w_down"])
+
+
+def run_layers(hidden, kv_cache, layers, cfg, attn_fn, mlp, li0: int = 0):
+    """Sandwich-norm layer scan: pre/post norms around BOTH the attention
+    and MLP blocks, post norms applied to the block output before the
+    residual add. Same contract as llama.run_layers (pipeline staging
+    calls this with psum-wrapped attn/mlp)."""
+    eps = cfg.rms_norm_eps
+    k_all, v_all = kv_cache
+
+    def layer_step(carry, lp):
+        hidden, k_all, v_all, li = carry
+        x = rms_norm(hidden, lp["ln1"], eps)
+        delta, k_all, v_all = attn_fn(x, lp, k_all, v_all, li)
+        hidden = hidden + rms_norm(delta, lp["ln_post_attn"], eps)
+        x = rms_norm(hidden, lp["ln_pre_mlp"], eps)
+        hidden = hidden + rms_norm(mlp(x, lp), lp["ln_post_mlp"], eps)
+        return (hidden, k_all, v_all, li + 1), None
+
+    (hidden, k_all, v_all, li), _ = jax.lax.scan(
+        layer_step, (hidden, k_all, v_all, jnp.int32(li0)), layers
+    )
+    return hidden, (k_all, v_all), li
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -113,49 +199,16 @@ def forward(
     return_hidden: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     b, s = tokens.shape
-    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    eps = cfg.rms_norm_eps
-    hidden = params["embed"][tokens]
-    hidden = hidden * jnp.asarray(
-        math.sqrt(cfg.hidden_size), hidden.dtype
+    hidden = embed_tokens(params, tokens)
+    attn_fn = make_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens, mesh
     )
-    scale = (cfg.query_pre_attn_scalar or hd) ** -0.5
-    k_all, v_all = kv_cache
-
-    def layer_step(carry, lp):
-        hidden, k_all, v_all, li = carry
-        x = rms_norm(hidden, lp["ln1"], eps)
-        q = dense(x, lp["wq"]).reshape(b, s, h, hd)
-        k = dense(x, lp["wk"]).reshape(b, s, kvh, hd)
-        v = dense(x, lp["wv"]).reshape(b, s, kvh, hd)
-        q = apply_rope(q, positions, cfg.rope_theta, None)
-        k = apply_rope(k, positions, cfg.rope_theta, None)
-        k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
-        # layer_types alternates sliding/full starting sliding at layer 0
-        window = (
-            jnp.where(li % 2 == 0, cfg.sliding_window, jnp.int32(1 << 30))
-            if cfg.sliding_window else None
-        )
-        attn = attention(
-            q, k_all, v_all, block_tables, positions, context_lens,
-            impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
-            scale=scale, softcap=cfg.attn_logit_softcap,
-            sliding_window=window,
-        )
-        delta = dense(attn.reshape(b, s, h * hd), lp["wo"])
-        hidden = hidden + rms_norm(delta, lp["ln_post_attn"], eps)
-        x = rms_norm(hidden, lp["ln_pre_mlp"], eps)
-        gate = jax.nn.gelu(dense(x, lp["w_gate"]), approximate=True)
-        mlp = dense(gate * dense(x, lp["w_up"]), lp["w_down"])
-        hidden = hidden + rms_norm(mlp, lp["ln_post_mlp"], eps)
-        return (hidden, k_all, v_all, li + 1), None
-
-    (hidden, k_all, v_all, _), _ = jax.lax.scan(
-        layer_step, (hidden, k_all, v_all, jnp.int32(0)), params["layers"]
+    hidden, kv_cache, _ = run_layers(
+        hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn
     )
     if return_hidden:
-        return hidden, (k_all, v_all)
-    return logits_from_hidden(hidden, params, cfg), (k_all, v_all)
+        return hidden, kv_cache
+    return logits_from_hidden(hidden, params, cfg), kv_cache
 
 
 def logits_from_hidden(hidden: jax.Array, params: Params,

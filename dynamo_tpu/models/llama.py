@@ -297,9 +297,27 @@ def init_kv_cache(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def embed_tokens(params: Params, tokens: jax.Array) -> jax.Array:
+    """Plain embedding lookup (Gemma overrides with its sqrt(d) scale)."""
+    return params["embed"][tokens]
+
+
 def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
     gate = jax.nn.silu(dense(x, layer_params["w_gate"]))
     return dense(gate * dense(x, layer_params["w_up"]), layer_params["w_down"])
+
+
+def gather_kv_writes(k, v, slot_mapping, axis):
+    """All-gather new K/V and their slots over a manual mesh axis whose
+    members shard the batch rows while replicating the KV cache (the
+    pipelined pp x dp program): every member must apply EVERY member's
+    cache writes or the replicas diverge. Shared by the GQA and Gemma-2
+    attention factories."""
+    return (
+        jax.lax.all_gather(k, axis, axis=0, tiled=True),
+        jax.lax.all_gather(v, axis, axis=0, tiled=True),
+        jax.lax.all_gather(slot_mapping, axis, axis=0, tiled=True),
+    )
 
 
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
@@ -338,11 +356,8 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         # in-place scatter into the stacked cache + layer-indexed kernels:
         # no per-layer cache slice is ever materialized inside the scan
         if kv_gather_axis is not None:
-            k_w = jax.lax.all_gather(k, kv_gather_axis, axis=0, tiled=True)
-            v_w = jax.lax.all_gather(v, kv_gather_axis, axis=0, tiled=True)
-            slots_w = jax.lax.all_gather(
-                slot_mapping, kv_gather_axis, axis=0, tiled=True
-            )
+            k_w, v_w, slots_w = gather_kv_writes(k, v, slot_mapping,
+                                                 kv_gather_axis)
         else:
             k_w, v_w, slots_w = k, v, slot_mapping
         k_all, v_all = scatter_kv_stacked(k_all, v_all, k_w, v_w, slots_w, li)

@@ -141,7 +141,19 @@ def pipeline_forward(
     - ep (MoE): expert stacks shard over "ep"; routing runs replicated
       over the global expert set, each member computes its local experts,
       and ONE psum over (tp, ep) finishes both the Megatron row-parallel
-      contraction and the expert combine (moe_mlp's ep_axis).
+      contraction and the expert combine (moe_mlp's ep_axis). Known
+      semantics delta vs the unstaged engine: expert capacity is sized
+      per MICROBATCH (mb*s tokens), not per full batch — a microbatch
+      whose tokens concentrate on one expert can drop tokens the
+      unstaged engine would keep. moe_capacity_factor (default 2.0)
+      absorbs this in practice; raise it if pp-MoE quality drifts.
+
+    Families plug in through module hooks with llama defaults:
+    ``embed_tokens`` / ``make_attn_fn`` / ``run_layers`` / ``mlp_fn``
+    (Gemma-2 overrides all four for its scaled embeddings, softcap +
+    alternating-window attention, and sandwich-norm layer step; the
+    window alternation follows the GLOBAL layer index via
+    make_attn_fn's layer_offset).
     """
     import dataclasses as _dc
     import math as _math
@@ -150,6 +162,10 @@ def pipeline_forward(
 
     arch = arch or llama
     moe = arch is _mixtral
+    embed_fn = getattr(arch, "embed_tokens", llama.embed_tokens)
+    make_attn = getattr(arch, "make_attn_fn", llama.make_gqa_attn_fn)
+    run_layers_fn = getattr(arch, "run_layers", llama.run_layers)
+    family_mlp = getattr(arch, "mlp_fn", llama._swiglu_mlp)
     num_stages = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
     dp = mesh.shape.get("dp", 1)
@@ -192,7 +208,10 @@ def pipeline_forward(
         )
         if tp > 1 else cfg
     )
-    mlp_axes = ("tp", "ep") if ep > 1 else "tp"
+    # reduce only over axes the mesh actually has (library callers may
+    # build pp-only or pp x ep meshes; ep > 1 implies an ep axis exists)
+    attn_axes = ("tp",) if "tp" in mesh.axis_names else ()
+    mlp_axes = attn_axes + (("ep",) if ep > 1 else ())
 
     @functools.partial(
         jax.shard_map,
@@ -211,6 +230,7 @@ def pipeline_forward(
         is_last = stage == num_stages - 1
         # shard_map gives the local block with a leading singleton stage dim
         local_layers = jax.tree.map(lambda x: x[0], params["layers"])
+        layers_per_stage = jax.tree.leaves(local_layers)[0].shape[0]
         k_local, v_local = kv_cache[0][0], kv_cache[1][0]
 
         d_model = cfg.hidden_size
@@ -230,28 +250,37 @@ def pipeline_forward(
 
             # stage 0 injects the embedded microbatch; others use the
             # activations ppermuted in at the end of the previous tick
-            injected = params["embed"][tok]
+            injected = embed_fn(params, tok)
             x_in = jnp.where(is_first, injected, x_state)
 
             # invalid (warm-up/drain) ticks must not write KV: the drop
             # sentinel routes their scatter out of range
             slots = jnp.where(valid, slots, -1)
 
-            base_attn = llama.make_gqa_attn_fn(
-                local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
+            attn_kwargs = dict(
                 kv_gather_axis="dp" if shard_dp else None,
+            )
+            if make_attn is not llama.make_gqa_attn_fn:
+                # gemma2's window alternation follows the GLOBAL layer
+                # index; the stage's cache slab is locally indexed
+                attn_kwargs["layer_offset"] = stage * layers_per_stage
+            base_attn = make_attn(
+                local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
+                **attn_kwargs,
             )
             base_mlp = (
                 _mixtral.make_moe_mlp_fn(
                     cfg, mb_local, s, slots,
                     ep_axis="ep" if ep > 1 else None,
                 ) if moe
-                else llama._swiglu_mlp
+                else family_mlp
             )
-            if tp > 1 or ep > 1:
+            if mlp_axes:
                 def attn_fn(x, lp, k, v, li):
                     delta, k, v = base_attn(x, lp, k, v, li)
-                    return lax.psum(delta, "tp"), k, v
+                    return (
+                        lax.psum(delta, attn_axes) if attn_axes else delta
+                    ), k, v
 
                 def mlp_fn(x, lp):
                     # ONE reduction finishes both the Megatron
@@ -260,7 +289,7 @@ def pipeline_forward(
                     return lax.psum(base_mlp(x, lp), mlp_axes)
             else:
                 attn_fn, mlp_fn = base_attn, base_mlp
-            hidden, (k_local, v_local), _ = llama.run_layers(
+            hidden, (k_local, v_local), _ = run_layers_fn(
                 x_in, (k_local, v_local), local_layers, cfg, attn_fn,
                 mlp_fn,
             )
