@@ -80,8 +80,11 @@ def run_once(attention_impl: str, burst: int = 1) -> dict:
         from dynamo_tpu.models.quant import quantize_params
 
         params = quantize_params(params)
+    kv_dtype = (
+        jnp.float8_e4m3fn if os.environ.get("BENCH_KV") == "fp8" else dtype
+    )
     k_cache, v_cache = llama.init_kv_cache(
-        mcfg, cfg.num_kv_blocks, cfg.kv_block_size, dtype
+        mcfg, cfg.num_kv_blocks, cfg.kv_block_size, kv_dtype
     )
 
     block_tables = jnp.asarray(
@@ -144,7 +147,8 @@ def run_once(attention_impl: str, burst: int = 1) -> dict:
     # HBM roofline: per decode step, stream weights once + per-seq KV(ctx)
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
     kv_bytes_per_seq = (
-        2 * mcfg.num_layers * ctx * mcfg.num_kv_heads * mcfg.head_dim * 2
+        2 * mcfg.num_layers * ctx * mcfg.num_kv_heads * mcfg.head_dim
+        * jnp.dtype(kv_dtype).itemsize
     )
     step_bytes = param_bytes + b * kv_bytes_per_seq
     roofline_steps = V5E_HBM_GBPS / step_bytes
@@ -154,6 +158,8 @@ def run_once(attention_impl: str, burst: int = 1) -> dict:
     if os.environ.get("BENCH_QUANT") == "int8":
         # a different workload must not masquerade as the bf16 series
         metric = metric.replace("_bf16_", "_int8_")
+    if os.environ.get("BENCH_KV") == "fp8":
+        metric += "_kvfp8"
     return {
         "metric": metric,
         "value": round(toks_per_sec, 1),
@@ -328,9 +334,14 @@ def main() -> None:
         # the bench workload is decode-only (run_once builds a single
         # S=1 step; ops/attention dispatches S==1 to the decode kernel,
         # never the flash-prefill one), so only the decode kernel needs
-        # probing — serving engines probe their full kernel set in
-        # ModelRunner.warmup instead
-        if probe_kernel("decode", timeout_s=min(180.0, remaining - 120)):
+        # probing — in the dtype specialization this run will compile
+        # (BENCH_KV=fp8 builds a distinct Mosaic program). Serving
+        # engines probe their full kernel set in ModelRunner.warmup.
+        decode_kind = (
+            "decode_fp8" if os.environ.get("BENCH_KV") == "fp8"
+            else "decode"
+        )
+        if probe_kernel(decode_kind, timeout_s=min(180.0, remaining - 120)):
             remaining = total_budget - (_time.monotonic() - t0)
             pallas = _run_impl_subprocess(
                 "pallas", timeout_s=max(min(remaining - 120, 480), 60),

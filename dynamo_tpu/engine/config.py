@@ -196,6 +196,14 @@ class EngineConfig:
     num_kv_blocks: int = 2048        # HBM budget for the paged cache
     prefill_buckets: Optional[List[int]] = None
     dtype: str = "bfloat16"
+    # paged-KV-cache storage dtype: "auto" stores at the engine dtype;
+    # "fp8" stores float8_e4m3fn — halves the decode KV stream and
+    # doubles cache capacity for ~6% elementwise KV error (the standard
+    # serving lever the reference's engines expose as kv_cache_dtype).
+    # Unscaled e4m3: post-rope K and V are O(1), well inside its ±448
+    # range. GQA families only (the MLA latent is too quantization-
+    # sensitive; ModelRunner rejects the combination).
+    kv_cache_dtype: str = "auto"
     # mesh axes: pipeline stages x data-parallel replicas x expert-parallel
     # x tensor-parallel. pp > 1 stages the dense trunk over a collective
     # GPipe schedule (parallel/pipeline.py) — reference analog:

@@ -116,6 +116,21 @@ class ModelRunner:
         cfg = config.model
         self.arch = models.resolve(cfg)
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        if config.kv_cache_dtype not in ("auto", "fp8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {config.kv_cache_dtype!r} "
+                "(auto | fp8)"
+            )
+        if config.kv_cache_dtype == "fp8" and cfg.kv_lora_rank > 0:
+            raise NotImplementedError(
+                "fp8 KV cache is GQA-family only: the MLA compressed "
+                "latent doubles as the value and is too sensitive to "
+                "e4m3 quantization"
+            )
+        self.kv_dtype = (
+            jnp.float8_e4m3fn if config.kv_cache_dtype == "fp8"
+            else self.dtype
+        )
         self.mesh = mesh or build_mesh(
             config.dp_size, config.tp_size, ep=config.ep_size,
             pp=config.pp_size,
@@ -790,6 +805,7 @@ class ModelRunner:
             if not probe_serving_kernels(
                 mla=cfg.kv_lora_rank > 0,
                 windowed=bool(cfg.attn_logit_softcap or cfg.sliding_window),
+                fp8_kv=self.config.kv_cache_dtype == "fp8",
                 timeout_s=timeout_s,
             ):
                 if cfg.attention_impl != "auto":
@@ -849,7 +865,7 @@ class ModelRunner:
         fresh arrays. Params are never donated and survive."""
         cfg = self.config
         cache = self.arch.init_kv_cache(
-            cfg.model, cfg.num_kv_blocks, cfg.kv_block_size, self.dtype
+            cfg.model, cfg.num_kv_blocks, cfg.kv_block_size, self.kv_dtype
         )
         if cfg.pp_size > 1:
             from ..parallel.pipeline import stage_cache

@@ -75,6 +75,7 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
+        kv_cache_dtype=getattr(flags, "kv_cache_dtype", "auto") or "auto",
     ))
 
 

@@ -321,7 +321,8 @@ def gather_kv_writes(k, v, slot_mapping, axis):
 
 
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                     context_lens, mesh, kv_gather_axis=None):
+                     context_lens, mesh, kv_gather_axis=None,
+                     layer_offset=0):
     """The standard attention block: QKV + RoPE, paged-KV scatter, GQA
     attention, output projection. Families with different attention (MLA,
     models/deepseek.py) plug their own via run_layers' attn_fn.
@@ -331,7 +332,13 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     (the pipelined pp x dp program, parallel/pipeline.py), every member
     must apply EVERY member's cache writes or the replicas diverge — the
     new K/V and their slots are all-gathered over the axis before the
-    scatter; attention still runs on the local rows only."""
+    scatter; attention still runs on the local rows only.
+
+    ``layer_offset`` is part of the family attn-factory contract (the
+    pipeline passes the stage's first GLOBAL layer index): this family
+    has no per-layer-index semantics, so it is accepted and ignored —
+    Gemma-2's window alternation is the consumer."""
+    del layer_offset  # no global-layer-index semantics in this family
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):

@@ -58,8 +58,9 @@ def scatter_kv(
     latent in "k" and the shared rope key in "v" (models/deepseek.py)."""
     n_blocks, block_size, kvh, dk = k_cache.shape
     vh, dv = v_cache.shape[-2:]
-    new_k = _pad_minor(new_k, dk)
-    new_v = _pad_minor(new_v, dv)
+    # cast at the write (fp8 KV cache stores e4m3; no-op otherwise)
+    new_k = _pad_minor(new_k, dk).astype(k_cache.dtype)
+    new_v = _pad_minor(new_v, dv).astype(v_cache.dtype)
     flat_k = k_cache.reshape(n_blocks * block_size, kvh, dk)
     flat_v = v_cache.reshape(n_blocks * block_size, vh, dv)
     idx = slot_mapping.reshape(-1)
@@ -91,8 +92,8 @@ def scatter_kv_stacked(
     """
     l, n_blocks, block_size, kvh, dk = k_all.shape
     vh, dv = v_all.shape[-2:]
-    new_k = _pad_minor(new_k, dk)
-    new_v = _pad_minor(new_v, dv)
+    new_k = _pad_minor(new_k, dk).astype(k_all.dtype)
+    new_v = _pad_minor(new_v, dv).astype(v_all.dtype)
     idx = slot_mapping.reshape(-1)
     # drop sentinel AND per-layer overflow → past-the-end: a negative index
     # would wrap (see scatter_kv), and a positive out-of-range one would land
@@ -134,9 +135,10 @@ def paged_attention(
     if scale is None:
         scale = d ** -0.5
 
-    # gather: [B, W, bs, KVH, D] → [B, W*bs, KVH, D]
-    k = k_cache[block_tables].reshape(b, w * block_size, kvh, d)
-    v = v_cache[block_tables].reshape(b, w * block_size, kvh, d)
+    # gather: [B, W, bs, KVH, D] → [B, W*bs, KVH, D]; upcast from the
+    # cache storage dtype (fp8 serving) to the compute dtype
+    k = k_cache[block_tables].reshape(b, w * block_size, kvh, d).astype(q.dtype)
+    v = v_cache[block_tables].reshape(b, w * block_size, kvh, d).astype(q.dtype)
 
     # [B, S, H, D] x [B, T, KVH, D] with GQA: fold H → (KVH, G)
     qg = q.reshape(b, s, kvh, groups, d)

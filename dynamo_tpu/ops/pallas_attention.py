@@ -93,8 +93,9 @@ def _kernel(
         for h in range(kvh):
             lo = h * rows
             q = q_ref[0, :, h, :, :].reshape(rows, d)          # [rows, D]
-            k = k_ref[0, 0, :, h, :]                            # [bs, D]
-            v = v_ref[0, 0, :, h, :]
+            # upcast from the cache storage dtype (fp8 serving)
+            k = k_ref[0, 0, :, h, :].astype(q.dtype)            # [bs, D]
+            v = v_ref[0, 0, :, h, :].astype(q.dtype)
 
             s_log = jax.lax.dot_general(
                 q, k,

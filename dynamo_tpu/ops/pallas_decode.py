@@ -134,8 +134,10 @@ def _decode_kernel(
             start(c + 1, jax.lax.rem(c + 1, 2))
 
         wait(c, slot)
-        k = k_buf[slot].reshape(cols, d)                  # [(tok, head), D]
-        v = v_buf[slot].reshape(cols, d)
+        # upcast from the cache storage dtype (fp8 serving stores e4m3;
+        # the dots and the p·V product must run at the compute dtype)
+        k = k_buf[slot].reshape(cols, d).astype(q.dtype)  # [(tok, head), D]
+        v = v_buf[slot].reshape(cols, d).astype(q.dtype)
 
         # decode causality: the query is the newest token, so every key
         # with position < ctx is visible — a pure validity mask (plus the

@@ -131,11 +131,76 @@ def probe_mla_decode():
     )
 
 
+def probe_decode_fp8():
+    # fp8 KV serving: the cache rides as float8_e4m3fn and the kernel
+    # upcasts after the DMA — a distinct Mosaic specialization
+    from dynamo_tpu.ops.pallas_decode import paged_decode_attention
+
+    l, n, page, kvh, d, b, w = 2, 16, 16, 2, 128, 2, 4
+    k = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    q = jnp.ones((b, 1, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(paged_decode_attention(q, k, v, bt, ctx, jnp.asarray(1, jnp.int32)))
+
+
+def probe_prefill_fp8():
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 8, 128
+    k = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    base = jnp.zeros((b,), jnp.int32)
+    ctx = jnp.asarray([s], jnp.int32)
+    np.asarray(paged_flash_attention(q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32)))
+
+
+def probe_decode_windowed_fp8():
+    # softcap x fp8 cache: what a Gemma-2-class model with
+    # kv_cache_dtype=fp8 actually compiles (softcap is a static
+    # specialization AND the dtype is — neither probe alone covers it)
+    from dynamo_tpu.ops.pallas_decode import paged_decode_attention
+
+    l, n, page, kvh, d, b, w = 2, 16, 16, 2, 128, 2, 4
+    k = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    q = jnp.ones((b, 1, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(paged_decode_attention(
+        q, k, v, bt, ctx, jnp.asarray(1, jnp.int32),
+        softcap=50.0, window=jnp.asarray(16, jnp.int32),
+    ))
+
+
+def probe_prefill_windowed_fp8():
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 8, 128
+    k = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.float8_e4m3fn)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    base = jnp.zeros((b,), jnp.int32)
+    ctx = jnp.asarray([s], jnp.int32)
+    np.asarray(paged_flash_attention(
+        q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32),
+        softcap=50.0, window=jnp.asarray(48, jnp.int32),
+    ))
+
+
 PROBES = {
     "decode": probe_decode,
     "decode_windowed": probe_decode_windowed,
+    "decode_fp8": probe_decode_fp8,
+    "decode_windowed_fp8": probe_decode_windowed_fp8,
     "prefill": probe_prefill,
     "prefill_windowed": probe_prefill_windowed,
+    "prefill_fp8": probe_prefill_fp8,
+    "prefill_windowed_fp8": probe_prefill_windowed_fp8,
     "mla_decode": probe_mla_decode,
 }
 for kind in sys.argv[1:]:
@@ -226,7 +291,8 @@ def probe_kernel(
 
 
 def probe_serving_kernels(
-    mla: bool = False, windowed: bool = False, timeout_s: float = 180.0
+    mla: bool = False, windowed: bool = False, fp8_kv: bool = False,
+    timeout_s: float = 180.0,
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
     would compile — the dense engines' decode + flash-prefill kernels
@@ -241,10 +307,17 @@ def probe_serving_kernels(
     """
     if mla:
         kinds = ["mla_decode"]
-    elif windowed:
-        kinds = ["decode", "prefill", "decode_windowed", "prefill_windowed"]
+    elif fp8_kv:
+        # an fp8-cache engine ONLY compiles fp8-dtype specializations —
+        # probe those (plus the softcap x fp8 combination for windowed/
+        # softcapped models; softcap and dtype are both static keys)
+        kinds = ["decode_fp8", "prefill_fp8"]
+        if windowed:
+            kinds += ["decode_windowed_fp8", "prefill_windowed_fp8"]
     else:
         kinds = ["decode", "prefill"]
+        if windowed:
+            kinds += ["decode_windowed", "prefill_windowed"]
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

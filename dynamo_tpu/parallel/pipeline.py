@@ -257,16 +257,13 @@ def pipeline_forward(
             # sentinel routes their scatter out of range
             slots = jnp.where(valid, slots, -1)
 
-            attn_kwargs = dict(
-                kv_gather_axis="dp" if shard_dp else None,
-            )
-            if make_attn is not llama.make_gqa_attn_fn:
-                # gemma2's window alternation follows the GLOBAL layer
-                # index; the stage's cache slab is locally indexed
-                attn_kwargs["layer_offset"] = stage * layers_per_stage
+            # layer_offset is part of the attn-factory contract: the
+            # stage's first GLOBAL layer index (gemma2's window
+            # alternation consumes it; llama ignores it)
             base_attn = make_attn(
                 local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
-                **attn_kwargs,
+                kv_gather_axis="dp" if shard_dp else None,
+                layer_offset=stage * layers_per_stage,
             )
             base_mlp = (
                 _mixtral.make_moe_mlp_fn(
