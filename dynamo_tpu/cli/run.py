@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ngram speculative decoding: propose up to K "
                         "tokens per step from the context's own history "
                         "(greedy requests; 0 = off)")
+    p.add_argument("--spec-draft-model", default=None,
+                   help="draft-model speculative decoding: HF dir of a "
+                        "small same-tokenizer model that proposes "
+                        "--spec-draft-tokens per round (one fused burst) "
+                        "for the target to verify in one forward")
+    p.add_argument("--spec-draft-tokens", type=int, default=0,
+                   help="proposals per draft round (2..16)")
     p.add_argument("--spec-ngram-match", type=int, default=3,
                    help="trailing n-gram length the proposer looks up")
     p.add_argument("--num-kv-blocks", type=int, default=2048,

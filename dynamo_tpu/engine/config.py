@@ -246,6 +246,18 @@ class EngineConfig:
     # penalty-free requests only; mixed batches fall back per step.
     spec_ngram_tokens: int = 0   # K proposal tokens (0 = off)
     spec_ngram_match: int = 3    # trailing n-gram length to look up
+    # draft-MODEL speculative decoding: a small model proposes K tokens
+    # per round (its fused K-step burst = ONE extra dispatch) and the
+    # target verifies all K+1 positions in one forward — the
+    # draft/verify speculation reference-class engines ship. The draft
+    # keeps a mirror paged cache on the SAME block ids as the target
+    # (same allocator decisions), so prefix-cache hits, resume, and
+    # block registration carry valid draft context for free. Greedy,
+    # penalty-free requests only (stream is provably identical either
+    # way). Mutually exclusive with ngram speculation; incompatible
+    # with the host KV tier (restored blocks would hold stale draft KV).
+    spec_draft_model: Optional[str] = None  # HF dir of the draft model
+    spec_draft_tokens: int = 0              # K proposals per round (2..16)
     enable_prefix_caching: bool = True
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
@@ -279,6 +291,29 @@ class EngineConfig:
         self.multi_step_decode = max(1, min(self.multi_step_decode, 64))
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
+        if self.spec_draft_tokens and not self.spec_draft_model:
+            raise ValueError(
+                "spec_draft_tokens set without spec_draft_model — "
+                "speculation would silently stay off"
+            )
+        if self.spec_draft_model:
+            if not 2 <= self.spec_draft_tokens <= 16:
+                raise ValueError(
+                    "spec_draft_model needs spec_draft_tokens in 2..16 "
+                    f"(got {self.spec_draft_tokens}; a 1-token draft "
+                    "round never amortizes the extra dispatch)"
+                )
+            if self.spec_ngram_tokens:
+                raise ValueError(
+                    "spec_draft_model and spec_ngram_tokens are mutually "
+                    "exclusive proposal sources"
+                )
+            if self.host_kv_blocks:
+                raise ValueError(
+                    "spec_draft_model is incompatible with the host KV "
+                    "tier: restored blocks would carry stale draft KV "
+                    "(the draft cache mirrors device block ids only)"
+                )
 
     @property
     def blocks_per_seq(self) -> int:

@@ -161,10 +161,15 @@ class Scheduler:
         config: EngineConfig,
         events: Optional[KvEventSink] = None,
         disagg=None,  # Optional[RemotePrefillCoordinator]
+        draft_runner: Optional[ModelRunner] = None,
     ):
         self.runner = runner
         self.config = config
         self.disagg = disagg
+        # draft-model speculation: the draft's paged cache mirrors the
+        # target's block ids — every prefill chunk replays on the draft,
+        # and the decode loop proposes with the draft's K-step burst
+        self.draft = draft_runner
         tier2 = None
         if config.host_kv_blocks > 0:
             from ..kv import KvHostTier
@@ -249,7 +254,7 @@ class Scheduler:
                 if self.prefix_total_tokens else 0.0
             ),
         }
-        if self.config.spec_ngram_tokens:
+        if self.config.spec_ngram_tokens or self.draft is not None:
             out["spec_proposed_tokens"] = self.spec_proposed
             out["spec_accepted_tokens"] = self.spec_accepted
         if self.allocator.tier2 is not None:
@@ -396,10 +401,15 @@ class Scheduler:
             if active:
                 runner_idle = not (self.prefilling or self.waiting
                                    or self.pending_remote)
-                if (self.config.spec_ngram_tokens > 0 and runner_idle
+                speculating = (
+                    self.config.spec_ngram_tokens > 0
+                    or self.draft is not None
+                )
+                if (speculating and runner_idle
                         and all(self._spec_eligible(er) for er in active)):
-                    # ngram speculative verify: greedy penalty-free
-                    # batches only; anything else falls through
+                    # speculative verify (ngram or draft-model proposals):
+                    # greedy penalty-free batches only; anything else
+                    # falls through
                     await self._decode_spec(loop, active)
                 else:
                     k_steps = self.config.multi_step_decode
@@ -695,6 +705,18 @@ class Scheduler:
             targets=targets, want_prompt=want_prompt,
         )
         self.steps += 1
+        if self.draft is not None:
+            # mirror the chunk on the draft model: same tokens, same
+            # slots, same (shared) block ids — so the draft cache holds
+            # the full context every speculative round assumes. Sampling
+            # is inert (commit all-False; nothing reads the outputs).
+            dtemp, dtop_k, dtop_p, dkw = self._inert_sampling(rows)
+            self.draft.step(
+                tokens, positions, btab, slot_map, ctx_lens, last_idx,
+                dtemp, dtop_k, dtop_p,
+                sample_slots=sample_slots,
+                commit=np.zeros(rows, bool), want_top=False, **dkw,
+            )
 
         finals = []
         for i, (er, start, end, take, final) in enumerate(plan):
@@ -774,36 +796,90 @@ class Scheduler:
                 and not er.want_logprobs and er.logprobs_n == 0
                 and not er.req.sampling_options.logit_bias)
 
+    @staticmethod
+    def _inert_sampling(n: int):
+        """Greedy, penalty-free sampling arrays for draft-mirror runs
+        (nothing reads the sampled outputs): positional (temperature,
+        top_k, top_p) plus the keyword tail as one dict."""
+        zf = np.zeros(n, np.float32)
+        zi = np.zeros(n, np.int32)
+        return zf, zi, np.ones(n, np.float32), dict(
+            min_p=zf, presence_penalty=zf, frequency_penalty=zf,
+            repetition_penalty=np.ones(n, np.float32),
+            seed_keys=np.zeros((n, 2), np.uint32), counters=zi,
+        )
+
+    async def _draft_propose(self, loop, active: List[EngineRequest],
+                             K: int) -> dict:
+        """K greedy proposals per row from the draft model's fused burst.
+
+        ONE extra dispatch per round: the draft's ``multi_step_decode``
+        is K+1, so the burst also writes the K-th proposal's KV into the
+        mirror cache (the (K+1)th sampled token is discarded — it exists
+        only to drive that final KV write). Inactive rows run inert.
+        """
+        cfg = self.config
+        b = cfg.max_batch_size
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
+        tokens0 = np.zeros(b, np.int32)
+        positions0 = np.zeros(b, np.int32)
+        btab = np.zeros((b, w), np.int32)
+        commit = np.zeros(b, bool)
+        for er in active:
+            i = er.slot
+            tokens0[i] = er.pending_token
+            positions0[i] = er.context_len
+            btab[i, : len(er.block_ids)] = er.block_ids
+            commit[i] = True
+        temp, top_k, top_p, kw = self._inert_sampling(b)
+        toksK, *_ = self.draft.decode_burst(
+            tokens0, positions0, btab, temp, top_k, top_p,
+            commit=commit, want_top=False, **kw,
+        )
+        tk = await loop.run_in_executor(None, lambda: np.asarray(toksK))
+        self.steps += 1
+        return {
+            er.slot: [int(t) for t in tk[:K, er.slot]] for er in active
+        }
+
     async def _decode_spec(self, loop, active: List[EngineRequest]) -> None:
-        """One ngram-speculative decode pass: propose up to K tokens per
-        row from its own history, verify all K+1 positions in ONE forward
-        (decode is bandwidth-bound — the weights stream once either way),
-        and emit the accepted prefix plus the correction token.
+        """One speculative decode pass: propose up to K tokens per row —
+        from the row's own history (ngram) or from the draft model's
+        fused K-step burst — verify all K+1 positions in ONE target
+        forward (decode is bandwidth-bound — the weights stream once
+        either way), and emit the accepted prefix plus the correction
+        token.
 
         KV discipline matches the burst path: every proposed position's
-        KV is written during the verify; rejected positions' slots are
-        simply rewritten when decoding reaches them again, and block
-        registration only ever covers positions below the host
+        KV is written during the verify (and, for draft proposals, into
+        the draft's mirror cache during the burst); rejected positions'
+        slots are simply rewritten when decoding reaches them again, and
+        block registration only ever covers positions below the host
         context_len, which advances by accepted tokens only.
         """
         cfg = self.config
         b = cfg.max_batch_size
         bs = cfg.kv_block_size
-        K = cfg.spec_ngram_tokens
+        K = cfg.spec_draft_tokens if self.draft is not None \
+            else cfg.spec_ngram_tokens
         S = K + 1
         if any(er.context_len + S + 1 > cfg.max_model_len for er in active):
             # a row is within K of the horizon; it finishes momentarily
             return await self._decode(loop, active, 1)
 
-        # proposals first: when nothing matches anywhere (non-repetitive
-        # output), the K+1-wide verify would be pure per-step overhead —
-        # run the normal decode (incl. its fused burst) instead
         props: dict = {}
-        for er in active:
-            history = list(er.seq.token_ids) + [er.pending_token]
-            props[er.slot] = ngram_propose(history, cfg.spec_ngram_match, K)
-        if not any(props.values()):
-            return await self._decode(loop, active, cfg.multi_step_decode)
+        if self.draft is None:
+            # ngram proposals first: when nothing matches anywhere
+            # (non-repetitive output), the K+1-wide verify would be pure
+            # per-step overhead — run the normal decode (incl. its fused
+            # burst) instead
+            for er in active:
+                history = list(er.seq.token_ids) + [er.pending_token]
+                props[er.slot] = ngram_propose(
+                    history, cfg.spec_ngram_match, K
+                )
+            if not any(props.values()):
+                return await self._decode(loop, active, cfg.multi_step_decode)
 
         for er in list(active):
             ok = all(
@@ -817,6 +893,12 @@ class Scheduler:
         self.allocator.flush_offload()
         if not active:
             return
+
+        if self.draft is not None:
+            # draft proposals: ONE K-step greedy burst of the small model
+            # (blocks are allocated above, so the burst's KV writes into
+            # the mirror cache land in valid slots)
+            props = await self._draft_propose(loop, active, K)
 
         w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
         tokens = np.zeros((b, S), np.int32)
@@ -898,6 +980,13 @@ class Scheduler:
             er.context_len + k_steps + 1 > cfg.max_model_len for er in active
         ):
             k_steps = 1
+        if self.draft is not None:
+            # plain decode must keep the draft's mirror cache current
+            # (the next speculative round assumes draft KV for every
+            # position < context); the mirror runs per-token, so pin the
+            # target to per-token too — with a draft configured, the
+            # fused burst's role is played by speculation itself
+            k_steps = 1
 
         # make sure each active sequence has blocks for its next position
         # (all k_steps of them under a burst)
@@ -977,6 +1066,17 @@ class Scheduler:
                 sample_slots=np.arange(b, dtype=np.int32), commit=commit,
                 want_top=want_top,
             )
+            if self.draft is not None:
+                # mirror the step on the draft (inert sampling): the
+                # speculative rounds assume the draft cache covers every
+                # position the target has decoded
+                dtemp, dtop_k, dtop_p, dkw = self._inert_sampling(b)
+                self.draft.step(
+                    tokens, positions, btab, slot_map, ctx_lens, last_idx,
+                    dtemp, dtop_k, dtop_p,
+                    sample_slots=np.arange(b, dtype=np.int32),
+                    commit=np.zeros(b, bool), want_top=False, **dkw,
+                )
         toks, lpn, tv, ti = await loop.run_in_executor(
             None, lambda: (np.asarray(next_tokens), np.asarray(lps),
                            np.asarray(top_vals), np.asarray(top_ids))

@@ -74,6 +74,8 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         multi_step_decode=getattr(flags, "multi_step_decode", 1) or 1,
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
+        spec_draft_model=getattr(flags, "spec_draft_model", None),
+        spec_draft_tokens=getattr(flags, "spec_draft_tokens", 0) or 0,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
         kv_cache_dtype=getattr(flags, "kv_cache_dtype", "auto") or "auto",
     ))
@@ -108,6 +110,42 @@ def _apply_engine_extra(extra: dict, cfg: EngineConfig) -> EngineConfig:
     return dataclasses.replace(cfg, **extra)
 
 
+def build_draft_config(target: EngineConfig) -> EngineConfig:
+    """EngineConfig for the draft model of draft-speculative decoding.
+
+    The draft's paged cache MIRRORS the target's block ids (same
+    allocator decisions drive both), so block geometry must match
+    exactly; the draft always runs unsharded (it is small by
+    construction) with its K-step fused burst as the proposal program.
+    """
+    import dataclasses
+
+    draft_model = ModelConfig.from_model_dir(target.spec_draft_model)
+    if draft_model.vocab_size < target.model.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.vocab_size} smaller than target "
+            f"{target.model.vocab_size}: target token ids would be out "
+            "of range for the draft (the two must share a tokenizer)"
+        )
+    if draft_model.max_position_embeddings < target.max_model_len:
+        raise ValueError(
+            f"draft max_position_embeddings "
+            f"{draft_model.max_position_embeddings} < target max_model_len "
+            f"{target.max_model_len}: past its rope range the draft's "
+            "proposals degrade to noise and every round pays for nothing"
+        )
+    return dataclasses.replace(
+        target,
+        model=draft_model,
+        spec_draft_model=None, spec_draft_tokens=0,  # no recursion
+        tp_size=1, dp_size=1, ep_size=1, pp_size=1,
+        # K+1 burst steps for K proposals: the extra step writes the
+        # K-th proposal's KV into the mirror cache, so a fully-accepted
+        # round leaves no draft-KV hole behind the new context
+        multi_step_decode=target.spec_draft_tokens + 1,
+    )
+
+
 class JaxServingEngine(AsyncEngine):
     def __init__(self, runner: ModelRunner, scheduler: Scheduler, config: EngineConfig):
         self.runner = runner
@@ -134,18 +172,42 @@ class JaxServingEngine(AsyncEngine):
         if engine_config is None:
             engine_config = engine_config_from_mdc(mdc, flags)
         loop = asyncio.get_running_loop()
-        runner = await loop.run_in_executor(
+        runner_fut = loop.run_in_executor(
             None,
             lambda: ModelRunner(engine_config, params=params, mesh=mesh,
                                 model_dir=mdc.model_path),
         )
+        draft_runner = None
+        if engine_config.spec_draft_model:
+            # target and draft builds share nothing — load concurrently
+            draft_config = build_draft_config(engine_config)
+            draft_fut = loop.run_in_executor(
+                None,
+                lambda: ModelRunner(
+                    draft_config, model_dir=engine_config.spec_draft_model
+                ),
+            )
+            runner, draft_runner = await asyncio.gather(runner_fut, draft_fut)
+        else:
+            runner = await runner_fut
         disagg = None
         if disagg_factory is not None:
+            if draft_runner is not None:
+                raise ValueError(
+                    "spec_draft_model is incompatible with disaggregated "
+                    "remote prefill: remotely-computed KV never passes "
+                    "through the draft model, so its mirror cache would "
+                    "be stale for every remote-prefilled request"
+                )
             disagg = await disagg_factory(runner)
-        scheduler = Scheduler(runner, engine_config, events, disagg=disagg)
+        scheduler = Scheduler(runner, engine_config, events, disagg=disagg,
+                              draft_runner=draft_runner)
         engine = cls(runner, scheduler, engine_config)
         if warmup:
-            await loop.run_in_executor(None, runner.warmup)
+            futs = [loop.run_in_executor(None, runner.warmup)]
+            if draft_runner is not None:
+                futs.append(loop.run_in_executor(None, draft_runner.warmup))
+            await asyncio.gather(*futs)
         scheduler.start()
         return engine
 
