@@ -167,3 +167,39 @@ def test_draft_config_validation(target_dir, draft_dir):
     assert dcfg.model.vocab_size >= mcfg.vocab_size
     assert dcfg.multi_step_decode == 5  # K+1 burst
     assert dcfg.spec_draft_model is None
+
+
+def test_draft_composes_with_fp8_cache_and_tp(target_dir, draft_dir):
+    """Draft speculation atop an fp8 KV cache and a tp-sharded target
+    (the draft inherits the cache dtype; it always runs unsharded):
+    stream equals the plain engine with the SAME cache dtype."""
+
+    async def serve(draft, kv_dtype, tp):
+        econfig = EngineConfig(
+            model=ModelConfig.from_model_dir(target_dir),
+            max_batch_size=2, max_model_len=128, kv_block_size=8,
+            num_kv_blocks=64, dtype="float32", prefill_buckets=[32],
+            kv_cache_dtype=kv_dtype, tp_size=tp,
+            spec_draft_model=draft, spec_draft_tokens=4 if draft else 0,
+        )
+        mdc = ModelDeploymentCard.from_local_path(target_dir)
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=econfig, warmup=False)
+        req = PreprocessedRequest(
+            token_ids=PROMPTS[0],
+            stop_conditions=StopConditions(max_tokens=10, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        toks = []
+        async for out in engine.generate(Context(req)):
+            toks.extend(out["token_ids"])
+        await engine.close()
+        return toks
+
+    ref = asyncio.run(serve(None, "fp8", 1))
+    got = asyncio.run(serve(draft_dir, "fp8", 1))
+    assert got == ref
+
+    ref_tp = asyncio.run(serve(None, "auto", 2))
+    got_tp = asyncio.run(serve(draft_dir, "auto", 2))
+    assert got_tp == ref_tp
