@@ -580,11 +580,14 @@ class ModelRunner:
         return next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all
 
     def set_sample_row(
-        self, slot: int, prompt_ids, generated_ids=(), logit_bias=None
+        self, slot: int, prompt_ids, generated_ids=(), logit_bias=None,
+        guided_mask=None,
     ) -> None:
         """Install sampling state for a slot at admission: prompt presence,
         generated-token counts (non-empty when resuming a preempted
-        stream), and the request's OpenAI logit_bias row."""
+        stream), and the request's OpenAI logit_bias row — plus, for
+        guided decoding, the initial token mask (``guided_mask``: dense
+        [V] float32 the logit_bias entries add onto)."""
         v = self.config.model.vocab_size
         # defense in depth: the engine rejects out-of-vocab prompts at
         # admission (serving.py), but this state write must never fault
@@ -598,11 +601,14 @@ class ModelRunner:
         if len(generated_ids):
             gids = np.asarray(generated_ids, np.int64)
             np.add.at(counts_row, gids[(gids >= 0) & (gids < v)], 1)
-        bias_row = np.zeros(v, np.float32)
+        bias_row = (
+            np.asarray(guided_mask, np.float32).copy()
+            if guided_mask is not None else np.zeros(v, np.float32)
+        )
         for tid, b in (logit_bias or {}).items():
             tid = int(tid)
             if 0 <= tid < v:
-                bias_row[tid] = float(b)
+                bias_row[tid] += float(b)
         self.sample_state = self._set_row_jit(
             self.sample_state[0], self.sample_state[1], self.sample_state[2],
             jnp.asarray(slot, jnp.int32), jnp.asarray(counts_row),
@@ -636,6 +642,75 @@ class ModelRunner:
             out_shardings=(self.state_sharding, self.state_sharding,
                            self.state_sharding),
         )
+
+        def set_bias(bias, slot, bias_row):
+            return bias.at[slot].set(bias_row)
+
+        # bias-only row update (guided decoding rewrites its mask every
+        # step; counts/seen must not be touched mid-stream)
+        self._set_bias_jit = jax.jit(
+            set_bias,
+            donate_argnums=(0,),
+            in_shardings=(self.state_sharding, repl, repl),
+            out_shardings=self.state_sharding,
+        )
+
+        def edit_bias(bias, slot, ids, vals):
+            row = bias[slot]
+            # pad ids are vocab_size (out of range) → dropped
+            row = row.at[ids].set(vals, mode="drop")
+            return bias.at[slot].set(row)
+
+        # sparse per-step edits: guided masks change only at a trie
+        # node's neighborhood (a handful of ids), not across the vocab —
+        # one compiled program per id-count bucket, no [V] H2D per token
+        self._edit_bias_jit = jax.jit(
+            edit_bias,
+            donate_argnums=(0,),
+            in_shardings=(self.state_sharding, repl, repl, repl),
+            out_shardings=self.state_sharding,
+        )
+
+    BIAS_EDIT_BUCKETS = (8, 32, 128)
+
+    def set_bias_row(self, slot: int, bias_row: np.ndarray) -> None:
+        """Replace ONE slot's sampler bias row (guided decoding's
+        per-step token mask; also carries the request's logit_bias)."""
+        counts, seen, bias = self.sample_state
+        self.sample_state = (
+            counts, seen,
+            self._set_bias_jit(
+                bias, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(bias_row, jnp.float32),
+            ),
+        )
+
+    def edit_bias_entries(self, slot: int, ids, vals) -> bool:
+        """Sparse update of ONE slot's bias row: ``row[ids] = vals``.
+
+        ids/vals pad to a small static bucket (pad id = vocab_size,
+        dropped by the scatter). Returns False when the edit exceeds the
+        largest bucket — the caller falls back to set_bias_row."""
+        n = len(ids)
+        bucket = next(
+            (b for b in self.BIAS_EDIT_BUCKETS if n <= b), None
+        )
+        if bucket is None:
+            return False
+        v = self.config.model.vocab_size
+        ids_p = np.full(bucket, v, np.int32)
+        vals_p = np.zeros(bucket, np.float32)
+        ids_p[:n] = np.asarray(ids, np.int32)
+        vals_p[:n] = np.asarray(vals, np.float32)
+        counts, seen, bias = self.sample_state
+        self.sample_state = (
+            counts, seen,
+            self._edit_bias_jit(
+                bias, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(ids_p), jnp.asarray(vals_p),
+            ),
+        )
+        return True
 
     BLOCK_OP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 

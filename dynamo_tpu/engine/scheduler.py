@@ -38,6 +38,22 @@ from .sampling import host_row, seed_to_key
 logger = logging.getLogger(__name__)
 
 
+GUIDED_END = -1  # terminal marker key inside a guided-choice trie
+
+
+def build_choice_trie(choice_ids: List[List[int]]) -> dict:
+    """Token trie over the guided choices' canonical tokenizations:
+    nested {token_id: child} dicts with GUIDED_END marking a complete
+    choice (choices may be prefixes of one another)."""
+    root: dict = {}
+    for ids in choice_ids:
+        node = root
+        for t in ids:
+            node = node.setdefault(int(t), {})
+        node[GUIDED_END] = True
+    return root
+
+
 def ngram_propose(history: List[int], match: int, k: int) -> List[int]:
     """Prompt-lookup proposal: find the most recent earlier occurrence of
     the trailing ``match``-gram in the sequence's own history and return
@@ -133,6 +149,10 @@ class EngineRequest:
     # preemption-resume: generated tokens already emitted before preemption;
     # re-prefilled (prompt + resume_tokens) so the stream CONTINUES
     resume_tokens: List[int] = dataclasses.field(default_factory=list)
+    # guided decoding: current node of the choice trie (None = free) and
+    # the token ids its mask currently allows (for sparse bias edits)
+    guided_node: Optional[dict] = None
+    guided_allowed: List[int] = dataclasses.field(default_factory=list)
     # disaggregated prefill state
     remote_future: Optional[asyncio.Future] = None
     remote_deadline: float = 0.0
@@ -463,6 +483,10 @@ class Scheduler:
             # (the remote protocol ships KV + one sampled token, not a
             # [S, V] logits sweep) — prefill locally
             return False
+        if er.req.sampling_options.guided_choice_token_ids:
+            # the remote prefill samples the FIRST token without this
+            # engine's guided mask — constrained requests prefill locally
+            return False
         # cheap pre-check before the (hash-the-whole-prompt) prefix probe:
         # a larger prefix hit can only make the uncached suffix smaller,
         # so a prompt that doesn't qualify with hit=0 never qualifies —
@@ -606,11 +630,27 @@ class Scheduler:
         self.slots[slot] = er
         er.seq = TokenSequence(tokens_all, block_size=self.config.kv_block_size)
         er.registered_blocks = 0
+        # guided decoding: (re)build the choice trie and walk it past any
+        # already-emitted tokens (a resumed request continues mid-choice)
+        gids = er.req.sampling_options.guided_choice_token_ids
+        if gids:
+            node = build_choice_trie(gids)
+            for t in er.resume_tokens:
+                nxt = node.get(int(t))
+                if nxt is None:
+                    node = {}
+                    break
+                node = nxt
+            er.guided_node = node
         # penalty state for the slot: prompt presence + (on resume) counts
-        # of the already-generated tokens
+        # of the already-generated tokens (+ the guided mask for the
+        # FIRST sampled token — the prefill's final chunk samples it)
         self.runner.set_sample_row(
             slot, er.prompt, er.resume_tokens,
             logit_bias=er.req.sampling_options.logit_bias,
+            guided_mask=(
+                self._guided_mask(er) if er.guided_node is not None else None
+            ),
         )
         self.prefilling.append(er)
 
@@ -779,6 +819,7 @@ class Scheduler:
             er.pending_token = token
             er.generated += 1  # += not =: resumed requests keep their count
             er.finish = self._check_finish(er, token)
+            self._guided_after_token(er)
             self._emit(er, token, float(lpn[i]) if er.want_logprobs else None,
                        self._top_row(er, tv, ti, i), prompt_lps=prompt_lps)
             if er.finish is not None:
@@ -788,13 +829,74 @@ class Scheduler:
         """Speculative verify preserves the exact stream only for greedy,
         penalty-free, bias-free requests that want no logprobs: the
         verify step's raw argmax must equal what sequential sampling
-        would pick, and per-position logprobs are not computed."""
+        would pick, and per-position logprobs are not computed. Guided
+        rows are excluded too — their mask changes every step."""
         return (er.temperature == 0.0
                 and er.presence_penalty == 0.0
                 and er.frequency_penalty == 0.0
                 and er.repetition_penalty == 1.0
                 and not er.want_logprobs and er.logprobs_n == 0
-                and not er.req.sampling_options.logit_bias)
+                and not er.req.sampling_options.logit_bias
+                and er.guided_node is None)
+
+    def _guided_allowed_ids(self, er: EngineRequest) -> List[int]:
+        """Token ids the current trie node permits next: its children,
+        plus the eos ids at a terminal node (choices that prefix longer
+        choices resolve to the longer one unless the model emits eos)."""
+        v = self.config.model.vocab_size
+        node = er.guided_node or {}
+        allowed = [t for t in node if t != GUIDED_END and 0 <= t < v]
+        if GUIDED_END in node:
+            allowed.extend(
+                int(e) for e in er.req.eos_token_ids or []
+                if 0 <= int(e) < v
+            )
+        return allowed
+
+    def _guided_mask(self, er: EngineRequest) -> np.ndarray:
+        """Dense [V] additive mask for the NEXT sampled token: 0 for the
+        allowed ids, a large negative everywhere else. Used at admission
+        (set_sample_row); per-step updates edit sparsely instead."""
+        v = self.config.model.vocab_size
+        mask = np.full(v, -1e9, np.float32)
+        er.guided_allowed = self._guided_allowed_ids(er)
+        mask[er.guided_allowed] = 0.0
+        return mask
+
+    def _guided_after_token(self, er: EngineRequest) -> None:
+        """Advance the trie past the just-sampled token; install the next
+        mask, or finish when a choice completes. Runs between
+        _check_finish and _emit so the completing token still streams."""
+        if er.guided_node is None or er.finish is not None:
+            return
+        node = er.guided_node.get(er.pending_token)
+        if node is None:
+            # eos at a terminal node (or a defensive derail): done
+            er.finish = FinishReason.STOP
+            return
+        er.guided_node = node
+        if not any(t != GUIDED_END for t in node):
+            er.finish = FinishReason.STOP  # choice complete
+            return
+        # sparse edit: only the old node's and new node's neighborhoods
+        # change — O(branching), not O(vocab), per token
+        user_bias = er.req.sampling_options.logit_bias or {}
+        new_allowed = self._guided_allowed_ids(er)
+        new_set = set(new_allowed)
+        changed = list(new_set | set(er.guided_allowed))
+        vals = [
+            (0.0 if t in new_set else -1e9) + float(user_bias.get(t, 0.0))
+            for t in changed
+        ]
+        if not self.runner.edit_bias_entries(er.slot, changed, vals):
+            # neighborhood wider than the largest edit bucket: rebuild
+            mask = self._guided_mask(er)
+            for tid, b in user_bias.items():
+                tid = int(tid)
+                if 0 <= tid < len(mask):
+                    mask[tid] += float(b)
+            self.runner.set_bias_row(er.slot, mask)
+        er.guided_allowed = new_allowed
 
     @staticmethod
     def _inert_sampling(n: int):
@@ -987,6 +1089,10 @@ class Scheduler:
             # target to per-token too — with a draft configured, the
             # fused burst's role is played by speculation itself
             k_steps = 1
+        if any(er.guided_node is not None for er in active):
+            # guided rows rewrite their mask between tokens on the host;
+            # a fused burst would sample K tokens against one stale mask
+            k_steps = 1
 
         # make sure each active sequence has blocks for its next position
         # (all k_steps of them under a burst)
@@ -1104,6 +1210,7 @@ class Scheduler:
                 er.pending_token = token
                 er.generated += 1
                 er.finish = self._check_finish(er, token)
+                self._guided_after_token(er)
                 self._emit(
                     er, token,
                     float(lpn[j, er.slot]) if er.want_logprobs else None,

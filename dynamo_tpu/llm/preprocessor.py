@@ -178,6 +178,42 @@ class OpenAIPreprocessor(Operator):
             raise EngineError(f"no tokenizer available for {self.mdc.display_name}")
         return self.tokenizer.encode(prompt)
 
+    @staticmethod
+    def _guided_choice(req) -> Optional[List[str]]:
+        """vLLM-style ``guided_choice`` extra field (top level or nvext):
+        constrain the completion to exactly one of the given strings.
+        Present-but-empty is rejected — silently dropping the constraint
+        would hand unconstrained text to a client that relies on it."""
+        choices = (req.model_extra or {}).get("guided_choice")
+        if choices is None and req.nvext is not None:
+            choices = (req.nvext.model_extra or {}).get("guided_choice")
+        if choices is None:
+            return None
+        if (not isinstance(choices, list) or not choices or not all(
+                isinstance(c, str) and c for c in choices)):
+            raise EngineError(
+                "guided_choice must be a non-empty list of non-empty strings"
+            )
+        return list(choices)
+
+    def _guided_choice_ids(
+        self, choices: Optional[List[str]]
+    ) -> Optional[List[List[int]]]:
+        if not choices:
+            return None
+        if self.tokenizer is None:
+            raise EngineError(
+                "guided_choice requires a tokenizer (the choices must be "
+                "tokenized before the engine can constrain to them)"
+            )
+        # canonical-tokenization semantics: the engine constrains the
+        # output to each choice's whole-string token sequence (no
+        # special tokens — the choice is completion text)
+        return [
+            list(self.tokenizer.encode(c, add_special_tokens=False))
+            for c in choices
+        ]
+
     def _build(
         self,
         req: Union[ChatCompletionRequest, CompletionRequest],
@@ -198,6 +234,7 @@ class OpenAIPreprocessor(Operator):
             else req.temperature
         )
         budget = self.mdc.context_length - len(token_ids)
+        guided = self._guided_choice(req)
         out = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(
@@ -226,6 +263,8 @@ class OpenAIPreprocessor(Operator):
                     int(k): max(-100.0, min(100.0, float(v)))
                     for k, v in req.logit_bias.items()
                 } if getattr(req, "logit_bias", None) else None,
+                guided_choice=guided,
+                guided_choice_token_ids=self._guided_choice_ids(guided),
             ),
             output_options=OutputOptions(
                 logprobs=self._logprobs_count(req),

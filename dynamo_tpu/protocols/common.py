@@ -61,6 +61,15 @@ class SamplingOptions:
     # OpenAI logit_bias: token id → additive logit offset (engine applies
     # it in the jitted sampler via a per-slot bias row)
     logit_bias: Optional[Dict[int, float]] = None
+    # guided decoding (vLLM-style extra field): constrain the output to
+    # one of these strings. The preprocessor tokenizes each choice; the
+    # engine walks a token trie and masks the sampler's bias row per
+    # step. Canonical-tokenization semantics: the output follows each
+    # choice's whole-string tokenization.
+    guided_choice: Optional[List[str]] = None
+    # the trie's token ids (preprocessor-filled; engines consume this,
+    # not the strings — the engine holds no tokenizer)
+    guided_choice_token_ids: Optional[List[List[int]]] = None
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

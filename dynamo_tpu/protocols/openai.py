@@ -38,6 +38,13 @@ def _reject_unsupported_extras(req: BaseModel) -> BaseModel:
             "'length_penalty' is not supported by this server (beam "
             "search is not implemented); remove it from the request"
         )
+    rf = getattr(req, "response_format", None)
+    if rf and rf.get("type") not in (None, "text"):
+        raise ValueError(
+            f"response_format type {rf.get('type')!r} is not supported "
+            "(guided JSON is not implemented); for constrained outputs "
+            "use the 'guided_choice' extra field"
+        )
     return req
 
 

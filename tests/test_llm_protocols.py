@@ -566,3 +566,61 @@ async def test_completion_echo_emitted_when_stream_yields_nothing(mdc, tokenizer
     echo = chunks[0].choices[0]
     assert echo.text == "hello world"
     assert echo.logprobs is None
+
+
+def test_preprocess_guided_choice(mdc, tokenizer):
+    """vLLM-style guided_choice (top level or nvext): the preprocessor
+    carries the strings AND their canonical tokenizations so the engine
+    can constrain without holding a tokenizer."""
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "yes or no?"}],
+        max_tokens=10,
+        guided_choice=["yes", "no"],
+    )
+    out = pre.preprocess_chat(req)
+    so = out.sampling_options
+    assert so.guided_choice == ["yes", "no"]
+    assert so.guided_choice_token_ids == [
+        tokenizer.encode("yes", add_special_tokens=False),
+        tokenizer.encode("no", add_special_tokens=False),
+    ]
+    # wire round-trip (token-level workers receive these)
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+
+    back = PreprocessedRequest.from_wire(out.to_wire())
+    assert back.sampling_options.guided_choice_token_ids == \
+        so.guided_choice_token_ids
+
+    # nvext placement works too
+    req2 = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        nvext={"guided_choice": ["a"]},
+    )
+    assert pre.preprocess_chat(req2).sampling_options.guided_choice == ["a"]
+
+    # malformed lists are rejected loudly
+    from dynamo_tpu.runtime.engine import EngineError
+
+    bad = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        guided_choice=["ok", ""],
+    )
+    with pytest.raises(EngineError, match="non-empty"):
+        pre.preprocess_chat(bad)
+
+
+def test_response_format_json_rejected():
+    with pytest.raises(Exception, match="response_format"):
+        ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "x"}],
+            response_format={"type": "json_object"},
+        )
+    # explicit text type passes
+    ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}],
+        response_format={"type": "text"},
+    )
