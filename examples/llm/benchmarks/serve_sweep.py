@@ -94,6 +94,11 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model on CPU (JAX_PLATFORMS=cpu): harness "
                          "logic check, not a measurement")
+    ap.add_argument("--server-arg", action="append", default=[],
+                    help="extra flag passed through to cli.run (repeat; "
+                         "e.g. --server-arg=--kv-cache-dtype "
+                         "--server-arg=fp8) — lets a chip sweep exercise "
+                         "any serving lever without editing the harness")
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="serve_sweep_")
@@ -113,6 +118,7 @@ def main() -> None:
     ]
     if args.quantization:
         cmd += ["--quantization", args.quantization]
+    cmd += args.server_arg
     env = dict(os.environ)
     if args.smoke:
         env["JAX_PLATFORMS"] = "cpu"
@@ -140,6 +146,7 @@ def main() -> None:
                 "max_batch_size": args.max_batch_size,
                 "multi_step_decode": args.multi_step_decode,
                 "quantization": args.quantization,
+                "server_args": args.server_arg,  # the lever under test
                 "isl": args.isl, "osl": args.osl,
             },
             "sweep_wall_s": round(time.monotonic() - t_ready, 1),
