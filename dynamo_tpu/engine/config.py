@@ -106,6 +106,24 @@ class ModelConfig:
                 "max_position_embeddings",
                 config.get("max_position_embeddings", 4096),
             )
+        if config.get("num_experts") and (
+            config.get("mlp_only_layers")
+            or config.get("decoder_sparse_step", 1) != 1
+        ):
+            # Qwen-MoE variants that interleave dense MLP layers; the
+            # MoE trunk here is uniformly sparse
+            raise NotImplementedError(
+                "MoE checkpoints with mlp_only_layers/decoder_sparse_step "
+                "(mixed dense+sparse trunks) are not supported"
+            )
+        if config.get("shared_expert_intermediate_size"):
+            # Qwen2-MoE's sigmoid-gated shared expert — reject at config
+            # parse, BEFORE any multi-GB checkpoint stream starts (the
+            # loader keeps a tensor-level backstop)
+            raise NotImplementedError(
+                "Qwen2-MoE checkpoints (gated shared expert) are not "
+                "supported; Qwen3-MoE and Mixtral load"
+            )
         if (config.get("n_group") or 1) > 1:
             # V3's device/group-limited top-k is a routing *restriction*;
             # silently ignoring it would route differently than the
@@ -133,6 +151,7 @@ class ModelConfig:
             tie_word_embeddings=config.get("tie_word_embeddings", False),
             num_experts=config.get("num_local_experts", 0)
             or config.get("n_routed_experts", 0)
+            or config.get("num_experts", 0)  # Qwen-MoE config key
             or 0,
             num_experts_per_tok=config.get("num_experts_per_tok", 2),
             moe_intermediate_size=config.get("moe_intermediate_size", 0) or 0,

@@ -301,13 +301,17 @@ def _stack_group(
 
 
 def load_mixtral_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
-    """HF Mixtral-style MoE checkpoint → stacked param pytree.
+    """HF GShard-MoE checkpoint → stacked param pytree.
 
-    HF stores one tensor per (layer, expert) projection; the engine wants
-    [L, E, in, out] stacks so the routed-experts einsums (models/mixtral.py
-    moe_mlp) see every expert as one MXU-shaped batched matmul.
-    Reference analog: the reference loads MoE checkpoints through its GPU
-    engines' HF loaders (launch/dynamo-run/src/lib.rs:131).
+    Speaks both tensor naming schemes that resolve to the mixtral
+    module: Mixtral's ``block_sparse_moe.{gate,experts.N.w1/w2/w3}`` and
+    Qwen3-MoE's ``mlp.{gate,experts.N.gate/up/down_proj}`` (+ Qwen3's
+    per-head q/k norms). HF stores one tensor per (layer, expert)
+    projection; the engine wants [L, E, in, out] stacks so the
+    routed-experts einsums (models/mixtral.py moe_mlp) see every expert
+    as one MXU-shaped batched matmul. Reference analog: the reference
+    loads MoE checkpoints through its GPU engines' HF loaders
+    (launch/dynamo-run/src/lib.rs:131).
     """
     l, e = cfg.num_layers, cfg.num_experts
     staging: Dict[str, Dict] = {}
@@ -319,10 +323,16 @@ def load_mixtral_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) ->
         "self_attn.k_proj.weight": ("wk", True),
         "self_attn.v_proj.weight": ("wv", True),
         "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_norm.weight": ("q_norm", False),
+        "self_attn.k_norm.weight": ("k_norm", False),
         "post_attention_layernorm.weight": ("ln2", False),
         "block_sparse_moe.gate.weight": ("router", True),
+        "mlp.gate.weight": ("router", True),
     }
-    expert_map = {"w1": "w_gate", "w2": "w_down", "w3": "w_up"}
+    expert_map = {
+        "w1": "w_gate", "w2": "w_down", "w3": "w_up",            # mixtral
+        "gate_proj": "w_gate", "down_proj": "w_down", "up_proj": "w_up",
+    }
 
     for name, tensor in _iter_safetensors(model_dir):
         name = name.removeprefix("model.")
@@ -340,9 +350,18 @@ def load_mixtral_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) ->
                 staging.setdefault(key, {})[idx] = (
                     tensor.T if transpose else tensor
                 )
-            elif rest.startswith("block_sparse_moe.experts."):
+            elif rest.startswith(("block_sparse_moe.experts.",
+                                  "mlp.experts.")):
                 _, _, ei, proj, _ = rest.split(".")
                 staging.setdefault(expert_map[proj], {})[(idx, int(ei))] = tensor.T
+            elif rest.startswith("mlp.shared_expert"):
+                # Qwen2-MoE's gated shared expert — distinct semantics
+                # (sigmoid-gated output) this module does not implement
+                raise NotImplementedError(
+                    "Qwen2-MoE shared-expert checkpoints are not "
+                    "supported (gated shared expert); Qwen3-MoE and "
+                    "Mixtral load"
+                )
             else:
                 logger.debug("skipping unmapped tensor %s", name)
 
