@@ -157,3 +157,41 @@ def test_qwen2_moe_rejected_at_config_parse():
             {**TINY, "architectures": ["Qwen2MoeForCausalLM"],
              "shared_expert_intermediate_size": 64}
         )
+
+
+def test_qwen3_moe_pp_ep_matches_single_stage(model_dir):
+    """Loaded Qwen3-MoE weights (incl. per-head q/k norms) through the
+    pipelined pp x ep engine: same greedy step outputs as the unstaged
+    runner — the norms ride the shared attention factory under staging."""
+    from dynamo_tpu.engine.model_runner import ModelRunner
+
+    mcfg = ModelConfig.from_model_dir(model_dir)
+    mcfg.attention_impl = "xla"
+    params = load_checkpoint_params(model_dir, mcfg, mixtral, jnp.float32)
+
+    def run_step(pp, ep):
+        runner = ModelRunner(EngineConfig(
+            model=mcfg, max_batch_size=4, max_model_len=64, kv_block_size=8,
+            num_kv_blocks=64, dtype="float32", pp_size=pp, ep_size=ep,
+            prefill_buckets=[16],
+        ), params=params)
+        b, s, bs = 4, 8, 8
+        rng = np.random.default_rng(11)
+        tokens = rng.integers(0, mcfg.vocab_size, (b, s)).astype(np.int32)
+        positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+        w = runner.config.blocks_per_seq
+        btab = np.zeros((b, w), np.int32)
+        for i in range(b):
+            btab[i, 0] = i
+        slots = btab[:, :1] * bs + positions
+        out, *_ = runner.step(
+            tokens, positions, btab, slots, np.full(b, s, np.int32),
+            np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+            np.zeros(b, np.int32), np.ones(b, np.float32),
+            jax.random.PRNGKey(12),
+        )
+        return np.asarray(out)
+
+    ref = run_step(1, 1)
+    got = run_step(2, 2)
+    np.testing.assert_array_equal(got, ref)
