@@ -116,6 +116,19 @@ class ModelConfig:
                 "MoE checkpoints with mlp_only_layers/decoder_sparse_step "
                 "(mixed dense+sparse trunks) are not supported"
             )
+        lt = config.get("layer_types")
+        if "gptoss" in arch and lt:
+            want = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(len(lt))
+            ]
+            if list(lt) != want:
+                # the family module hardcodes the even-sliding alternation
+                # (models/gptoss.py window = li % 2 == 0)
+                raise NotImplementedError(
+                    "gpt-oss layer_types must alternate "
+                    "sliding/full starting sliding at layer 0"
+                )
         if config.get("shared_expert_intermediate_size"):
             # Qwen2-MoE's sigmoid-gated shared expert — reject at config
             # parse, BEFORE any multi-GB checkpoint stream starts (the
@@ -160,9 +173,13 @@ class ModelConfig:
             moe_scoring_func=config.get("scoring_func", "softmax"),
             norm_topk_prob=config.get("norm_topk_prob", True),
             routed_scaling_factor=config.get("routed_scaling_factor", 1.0) or 1.0,
-            # Gemma-2 (config.json keys; sliding_window exists in other
-            # families' configs too, so gate on the architecture)
-            model_family="gemma2" if "gemma2" in arch else "",
+            # Gemma-2 / GPT-OSS (config.json keys; sliding_window exists
+            # in other families' configs too, so gate on the architecture)
+            model_family=(
+                "gemma2" if "gemma2" in arch
+                else "gptoss" if "gptoss" in arch
+                else ""
+            ),
             attn_logit_softcap=config.get("attn_logit_softcapping") or 0.0,
             final_logit_softcap=config.get("final_logit_softcapping") or 0.0,
             query_pre_attn_scalar=config.get("query_pre_attn_scalar", 0) or 0,

@@ -24,6 +24,10 @@ def resolve(cfg: ModelConfig):
                 "which requires dynamo_tpu/models/deepseek.py"
             ) from e
         return deepseek
+    if cfg.model_family == "gptoss":
+        from . import gptoss
+
+        return gptoss
     if cfg.num_experts > 0:
         from . import mixtral
 

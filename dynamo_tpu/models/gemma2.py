@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 from ..engine.config import ModelConfig
 from ..ops.attention import attention, scatter_kv_stacked
 from .llama import (  # noqa: F401  (shared cache layout)
+    alternating_window,
     apply_rope,
     gather_kv_writes,
     init_kv_cache,
@@ -140,11 +141,7 @@ def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             k_w, v_w, slots_w = k, v, slot_mapping
         k_all, v_all = scatter_kv_stacked(k_all, v_all, k_w, v_w, slots_w, li)
         # layer_types alternates sliding/full starting sliding at layer 0
-        window = (
-            jnp.where((li + layer_offset) % 2 == 0, cfg.sliding_window,
-                      jnp.int32(1 << 30))
-            if cfg.sliding_window else None
-        )
+        window = alternating_window(cfg, li, layer_offset)
         attn = attention(
             q, k_all, v_all, block_tables, positions, context_lens,
             impl=cfg.attention_impl, mesh=mesh, layer_idx=li,

@@ -1,0 +1,196 @@
+"""GPT-OSS family: MoE trunk with attention sinks, qkv/o biases, and
+alternating sliding-window attention.
+
+Architecture deltas vs the llama trunk (matching HF
+transformers/models/gpt_oss/modeling_gpt_oss.py, validated logit-exact
+in tests/test_gptoss.py):
+
+- every attention projection carries a bias (incl. the output proj);
+- a learned per-head attention SINK joins each softmax as a virtual key
+  with no value — only the denominator grows (ops/attention.py sinks;
+  rides the XLA path);
+- EVEN layers see only a sliding window of the cache (config
+  layer_types alternates sliding/full from layer 0 — the gemma2
+  pattern, enforced at config parse);
+- yarn rope at theta 150k;
+- routed experts with a clamped sigmoid-GLU, fused interleaved gate_up
+  projection, per-projection biases, and a router whose bias
+  participates in both selection and combine weights
+  (models/mixtral.py gptoss_moe).
+
+Reference analog: the GPT-OSS models of the engines the reference
+delegates to (vLLM model zoo, SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from ..ops.attention import attention, scatter_kv_stacked
+from .llama import (  # noqa: F401  (shared cache layout + trunk pieces)
+    alternating_window,
+    apply_rope,
+    embed_tokens,
+    gather_kv_writes,
+    init_kv_cache,
+    lm_logits,
+    rms_norm,
+    run_layers,
+)
+from .mixtral import expert_capacity, gptoss_moe
+from .quant import dense
+
+Params = Dict
+KVCache = Tuple[jax.Array, jax.Array]
+
+CACHE_SPEC = P(None, None, None, "tp", None)
+
+logits_from_hidden = lm_logits
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    l, d_model = cfg.num_layers, cfg.hidden_size
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    e, inter = cfg.num_experts, cfg.intermediate_size
+    keys = jax.random.split(key, 10)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (cfg.vocab_size, d_model), d_model),
+        "layers": {
+            "ln1": jnp.ones((l, d_model), dtype),
+            "wq": w(keys[1], (l, d_model, h * hd), d_model),
+            "bq": jnp.zeros((l, h * hd), dtype),
+            "wk": w(keys[2], (l, d_model, kvh * hd), d_model),
+            "bk": jnp.zeros((l, kvh * hd), dtype),
+            "wv": w(keys[3], (l, d_model, kvh * hd), d_model),
+            "bv": jnp.zeros((l, kvh * hd), dtype),
+            "wo": w(keys[4], (l, h * hd, d_model), h * hd),
+            "bo": jnp.zeros((l, d_model), dtype),
+            "sinks": jnp.zeros((l, h), dtype),
+            "ln2": jnp.ones((l, d_model), dtype),
+            "router": w(keys[5], (l, d_model, e), d_model),
+            "router_bias": jnp.zeros((l, e), dtype),
+            "w_gate_up": w(keys[6], (l, e, d_model, 2 * inter), d_model),
+            "b_gate_up": jnp.zeros((l, e, 2 * inter), dtype),
+            "w_down": w(keys[7], (l, e, inter, d_model), inter),
+            "b_down": jnp.zeros((l, e, d_model), dtype),
+        },
+        "final_norm": jnp.ones((d_model,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[8], (d_model, cfg.vocab_size), d_model)
+    return params
+
+
+def param_specs(params: Params) -> Dict:
+    """Megatron TP on the attention projections; experts over ep (their
+    inner dims stay replicated — the interleaved gate/up layout makes a
+    clean tp split of 2I a follow-up, not a default)."""
+    layer_specs = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, None, "tp"), "bq": P(None, "tp"),
+        "wk": P(None, None, "tp"), "bk": P(None, "tp"),
+        "wv": P(None, None, "tp"), "bv": P(None, "tp"),
+        "wo": P(None, "tp", None), "bo": P(),
+        "sinks": P(None, "tp"),
+        "router": P(), "router_bias": P(),
+        "w_gate_up": P(None, "ep", None, None),
+        "b_gate_up": P(None, "ep", None),
+        "w_down": P(None, "ep", None, None),
+        "b_down": P(None, "ep", None),
+    }
+    specs = {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": {k: layer_specs[k] for k in params["layers"]},
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                 context_lens, mesh, kv_gather_axis=None, layer_offset=0):
+    """GPT-OSS attention for run_layers: biased QKV/O, yarn rope, the
+    per-head sink logits, and the alternating per-layer window (EVEN
+    global layers windowed; ``layer_offset`` carries the stage's first
+    global layer index under pipeline staging)."""
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def attn_fn(x, lp, k_all, v_all, li):
+        q = (dense(x, lp["wq"]) + lp["bq"]).reshape(b, s, h, hd)
+        k = (dense(x, lp["wk"]) + lp["bk"]).reshape(b, s, kvh, hd)
+        v = (dense(x, lp["wv"]) + lp["bv"]).reshape(b, s, kvh, hd)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        if kv_gather_axis is not None:
+            k_w, v_w, slots_w = gather_kv_writes(k, v, slot_mapping,
+                                                 kv_gather_axis)
+        else:
+            k_w, v_w, slots_w = k, v, slot_mapping
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k_w, v_w, slots_w, li)
+        window = alternating_window(cfg, li, layer_offset)
+        attn = attention(
+            q, k_all, v_all, block_tables, positions, context_lens,
+            impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
+            sliding_window=window, sinks=lp["sinks"],
+        )
+        delta = dense(attn.reshape(b, s, h * hd), lp["wo"]) + lp["bo"]
+        return delta, k_all, v_all
+
+    return attn_fn
+
+
+def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
+    """Routed-experts mlp_fn (gptoss_moe) for run_layers."""
+    capacity = expert_capacity(
+        b * s, cfg.num_experts, cfg.num_experts_per_tok,
+        cfg.moe_capacity_factor,
+    )
+    valid = (slot_mapping.reshape(b * s) >= 0).astype(jnp.float32)
+
+    def mlp(x, lp):
+        y = gptoss_moe(
+            x.reshape(b * s, -1),
+            lp["router"], lp["router_bias"],
+            lp["w_gate_up"], lp["b_gate_up"], lp["w_down"], lp["b_down"],
+            cfg.num_experts_per_tok, capacity, valid=valid,
+        )
+        return y.reshape(b, s, -1)
+
+    return mlp
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S]
+    positions: jax.Array,     # [B, S]
+    kv_cache: KVCache,        # stacked [L, N, bs, KVH, Dpad]
+    block_tables: jax.Array,  # [B, W]
+    slot_mapping: jax.Array,  # [B, S]
+    context_lens: jax.Array,  # [B]
+    mesh=None,
+    return_hidden: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    b, s = tokens.shape
+    hidden = embed_tokens(params, tokens)
+    attn_fn = make_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens, mesh
+    )
+    hidden, kv_cache, _ = run_layers(
+        hidden, kv_cache, params["layers"], cfg, attn_fn,
+        make_mlp_fn(cfg, b, s, slot_mapping),
+    )
+    if return_hidden:
+        return hidden, kv_cache
+    return logits_from_hidden(hidden, params, cfg), kv_cache

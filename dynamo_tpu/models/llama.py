@@ -307,6 +307,20 @@ def _swiglu_mlp(x: jax.Array, layer_params) -> jax.Array:
     return dense(gate * dense(x, layer_params["w_up"]), layer_params["w_down"])
 
 
+def alternating_window(cfg, li, layer_offset=0):
+    """Per-layer sliding window for families whose layer_types alternate
+    sliding/full starting sliding at GLOBAL layer 0 (Gemma-2, GPT-OSS;
+    the pattern is validated at config parse for gpt-oss). ``li`` may be
+    traced (inside the layer scan); ``layer_offset`` is the stage's first
+    global layer index under pipeline staging. None when the family has
+    no window at all."""
+    if not cfg.sliding_window:
+        return None
+    return jnp.where(
+        (li + layer_offset) % 2 == 0, cfg.sliding_window, jnp.int32(1 << 30)
+    )
+
+
 def gather_kv_writes(k, v, slot_mapping, axis):
     """All-gather new K/V and their slots over a manual mesh axis whose
     members shard the batch rows while replicating the KV cache (the

@@ -376,6 +376,122 @@ def load_mixtral_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) ->
     return params
 
 
+# MXFP4 (the canonical GPT-OSS release format): 4-bit e2m1 values packed
+# two-per-byte in 16-byte groups of 32, with one e8m0 exponent (biased
+# 127) per group
+_FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], np.float32,
+)
+
+
+def _dequant_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """[..., G, 16] uint8 blocks + [..., G] uint8 exponents →
+    [..., G*32] float32 (low nibble first, matching transformers'
+    integrations/mxfp4.convert_moe_packed_tensors)."""
+    vals = np.empty(blocks.shape[:-1] + (32,), np.float32)
+    vals[..., 0::2] = _FP4_VALUES[blocks & 0x0F]
+    vals[..., 1::2] = _FP4_VALUES[blocks >> 4]
+    vals *= np.exp2(scales.astype(np.int32) - 127)[..., None].astype(np.float32)
+    return vals.reshape(blocks.shape[:-2] + (blocks.shape[-2] * 32,))
+
+
+def load_gptoss_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """HF GPT-OSS checkpoint → param pytree (models/gptoss.py layout).
+
+    Unlike Mixtral/Qwen-MoE, the expert projections arrive already
+    STACKED per layer (``mlp.experts.gate_up_proj`` [E, D, 2I] etc. —
+    one tensor per layer, not per expert), so only the layer axis needs
+    stacking. Attention projections transpose like every HF linear; the
+    per-head ``sinks`` and all biases load as-is. The canonical MXFP4
+    releases (expert tensors shipped as ``*_blocks`` + ``*_scales``)
+    dequantize at load — values arrive [E, out, in] and transpose into
+    the engine's [E, in, out] stacks.
+    """
+    l = cfg.num_layers
+    staging: Dict[str, Dict] = {}
+    mx_staging: Dict[str, Dict] = {}  # (key, kind) -> {layer: tensor}
+    top: Dict[str, np.ndarray] = {}
+
+    name_map = {
+        "input_layernorm.weight": ("ln1", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.v_proj.bias": ("bv", False),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.o_proj.bias": ("bo", False),
+        "self_attn.sinks": ("sinks", False),
+        "post_attention_layernorm.weight": ("ln2", False),
+        "mlp.router.weight": ("router", True),
+        "mlp.router.bias": ("router_bias", False),
+        "mlp.experts.gate_up_proj": ("w_gate_up", False),
+        "mlp.experts.gate_up_proj_bias": ("b_gate_up", False),
+        "mlp.experts.down_proj": ("w_down", False),
+        "mlp.experts.down_proj_bias": ("b_down", False),
+    }
+
+    for name, tensor in _iter_safetensors(model_dir):
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            top["embed"] = tensor
+        elif name == "norm.weight":
+            top["final_norm"] = tensor
+        elif name == "lm_head.weight":
+            top["lm_head"] = tensor.T
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            if rest in name_map:
+                key, transpose = name_map[rest]
+                staging.setdefault(key, {})[int(idx)] = (
+                    tensor.T if transpose else tensor
+                )
+            elif rest.startswith("mlp.experts.") and rest.endswith(
+                ("_blocks", "_scales")
+            ):
+                proj, kind = rest.removeprefix("mlp.experts.").rsplit("_", 1)
+                key = {"gate_up_proj": "w_gate_up", "down_proj": "w_down"}[proj]
+                mx_staging.setdefault((key, kind), {})[int(idx)] = tensor
+            else:
+                logger.debug("skipping unmapped tensor %s", name)
+
+    for key in ("w_gate_up", "w_down"):
+        blocks = mx_staging.get((key, "blocks"), {})
+        scales = mx_staging.get((key, "scales"), {})
+        for idx, blk in blocks.items():
+            if idx not in scales:
+                raise ValueError(
+                    f"incomplete MXFP4 checkpoint: layers.{key} layer "
+                    f"{idx} has blocks but no scales"
+                )
+            # dequant [E, out, in] → engine stack [E, in, out]
+            staging.setdefault(key, {})[idx] = _dequant_mxfp4(
+                blk, scales[idx]
+            ).transpose(0, 2, 1)
+
+    layers = _stack_group(staging, l, 0, dtype, "layers")
+    required = {key for key, _ in name_map.values()} | {"w_gate_up", "w_down"}
+    missing = required - set(layers)
+    if missing:
+        # _stack_group can only validate keys that matched ≥1 tensor; a
+        # wholly-absent group (renamed/unknown format) must still fail
+        # with the loader's diagnostic, not a KeyError mid-trace
+        raise ValueError(
+            f"incomplete checkpoint: layers missing {sorted(missing)} "
+            f"(unrecognized tensor naming or quantization format?)"
+        )
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(top["final_norm"], dtype=dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype=dtype)
+    return params
+
+
 def _rope_deinterleave(n: int) -> np.ndarray:
     """Permutation mapping HF DeepSeek's interleaved rope pairs
     (x[2j], x[2j+1]) to this repo's half-rotation layout (x[j], x[j+n/2]).
@@ -628,6 +744,7 @@ def load_checkpoint_params(model_dir: str, cfg: ModelConfig, arch, dtype=jnp.bfl
         "mixtral": load_mixtral_params,
         "deepseek": load_deepseek_params,
         "gemma2": load_gemma2_params,
+        "gptoss": load_gptoss_params,
     }
     if name not in loaders:
         raise NotImplementedError(

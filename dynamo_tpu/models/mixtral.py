@@ -142,6 +142,58 @@ def moe_mlp(
     return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y_e)
 
 
+def gptoss_moe(
+    x: jax.Array,          # [T, D] flattened tokens
+    router_w: jax.Array,   # [D, E]
+    router_b: jax.Array,   # [E]
+    w_gate_up: jax.Array,  # [E, D, 2I] (gate/up INTERLEAVED on the last dim)
+    b_gate_up: jax.Array,  # [E, 2I]
+    w_down: jax.Array,     # [E, I, D]
+    b_down: jax.Array,     # [E, D]
+    top_k: int,
+    capacity: int,
+    valid: Optional[jax.Array] = None,
+    alpha: float = 1.702,
+    limit: float = 7.0,
+) -> jax.Array:
+    """GPT-OSS routed experts (semantics match HF modeling_gpt_oss):
+
+    - router logits include the bias in BOTH selection and combine
+      weights, softmaxed over the selected top-k only;
+    - experts compute a clamped sigmoid-GLU: gate capped at +limit, up
+      clamped to ±limit, out = (up+1) · gate·sigmoid(alpha·gate);
+    - gate/up arrive interleaved in one fused projection, and every
+      projection carries a bias.
+    Same dense one-hot dispatch/capacity machinery as moe_mlp.
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+
+    logits = (x @ router_w).astype(jnp.float32) + router_b.astype(jnp.float32)
+    gate_vals, gate_idx = lax.top_k(logits, top_k)                   # [T, K]
+    gate_vals = jax.nn.softmax(gate_vals, axis=-1)
+
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)          # [T, K, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None, None]
+        gate_vals = gate_vals * valid[:, None]
+    flat = onehot.reshape(t * top_k, e)
+    pos = jnp.cumsum(flat, axis=0) - flat
+    keep = (pos < capacity).astype(jnp.float32) * flat
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
+    dispatch = slot.sum(axis=1)                                      # [T, E, C]
+    combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)
+
+    x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)     # [E, C, D]
+    gu = expert_einsum("ecd,edi->eci", x_e, w_gate_up) + b_gate_up[:, None, :]
+    gate = jnp.minimum(gu[..., 0::2], limit)
+    up = jnp.clip(gu[..., 1::2], -limit, limit)
+    h = (up + 1.0) * (gate * jax.nn.sigmoid(gate * alpha))
+    y_e = expert_einsum("eci,eid->ecd", h, w_down) + b_down[:, None, :]
+    return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y_e)
+
+
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     l, d_model = cfg.num_layers, cfg.hidden_size
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim

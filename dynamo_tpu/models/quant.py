@@ -89,7 +89,10 @@ LLAMA_QUANT_KEYS = frozenset(
 # stacks reuse the w_gate/w_up/w_down names (rank-4 [L, E, in, out] —
 # quantize_int8 and the specs are rank-generic)
 QUANT_KEYS = LLAMA_QUANT_KEYS | frozenset(
-    {"w_sh_gate", "w_sh_up", "w_sh_down", "w_dq", "w_uq", "w_dkv"}
+    {"w_sh_gate", "w_sh_up", "w_sh_down", "w_dq", "w_uq", "w_dkv",
+     # GPT-OSS fused interleaved gate/up expert stacks: per-out-channel
+     # scales are interleaving-safe (each output column owns its scale)
+     "w_gate_up"}
 )
 
 

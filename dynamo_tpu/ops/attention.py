@@ -120,6 +120,7 @@ def paged_attention(
     scale: Optional[float] = None,
     softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
     sliding_window=None,     # scalar (may be traced): keys within the window
+    sinks=None,              # [H] per-head attention-sink logits (GPT-OSS)
 ) -> jax.Array:
     """Reference paged attention: gather → masked softmax → weighted sum.
 
@@ -127,6 +128,10 @@ def paged_attention(
     j where j <= p and j < context_len — and, with ``sliding_window`` w,
     j > p - w. Cache position of slot s in the gathered layout is exactly
     its sequence position (block_tables are in sequence order).
+
+    ``sinks``: a learned per-head logit that joins the softmax as a
+    virtual key contributing NO value — its only effect is the extra
+    exp(sink) term in the denominator (GPT-OSS attention sinks).
     """
     b, s, h, d = q.shape
     _, block_size, kvh, _ = k_cache.shape
@@ -156,7 +161,22 @@ def paged_attention(
     mask = mask[:, :, None, None, :]                              # [B, S, 1, 1, T]
     logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
 
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if sinks is not None:
+        # append the sink as one extra softmax column per (kv head,
+        # group), then drop its probability — the value sum is over real
+        # keys only, but the denominator includes exp(sink)
+        sink_col = jnp.broadcast_to(
+            jnp.asarray(sinks, logits.dtype).reshape(1, 1, kvh, groups, 1),
+            (b, s, kvh, groups, 1),
+        )
+        logits = jnp.concatenate([logits, sink_col], axis=-1)
+        probs = jax.nn.softmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(q.dtype)[..., :-1]
+    else:
+        probs = jax.nn.softmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(q.dtype)
     out = jnp.einsum("bskgt,btkd->bskgd", probs, v)
     return out.reshape(b, s, h, d)
 
@@ -185,8 +205,13 @@ def attention(
     scale: Optional[float] = None,  # override the head-dim default
     softcap: float = 0.0,           # Gemma-2 attention logit softcapping
     sliding_window=None,            # scalar window (int or traced); None = off
+    sinks=None,                     # [H] attention-sink logits (GPT-OSS)
 ) -> jax.Array:
     """Paged-attention dispatch: XLA gather path or the Pallas kernels.
+
+    ``sinks`` (GPT-OSS) currently rides the XLA path only — the Pallas
+    kernels' online softmax would need the sink folded into their
+    finalize step; until then models with sinks force impl="xla".
 
     Accepts the engine's full stacked-by-layer cache plus a runtime
     ``layer_idx`` — the Pallas kernels index the layer inside HBM, so the
@@ -206,6 +231,8 @@ def attention(
         scale = d ** -0.5
     dk = k_cache.shape[-1]
     q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
+    if sinks is not None:
+        impl = "xla"  # kernels lack the sink finalize term (see docstring)
     if resolve_attention_impl(impl) == "xla":
         if stacked:
             # index the layer through the gather itself: block id n of
@@ -219,7 +246,8 @@ def attention(
             block_tables = block_tables + li * n_blocks
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
                                context_lens, scale=scale, softcap=softcap,
-                               sliding_window=sliding_window)[..., :d]
+                               sliding_window=sliding_window,
+                               sinks=sinks)[..., :d]
 
     from .pallas_attention import paged_flash_attention
     from .pallas_decode import paged_decode_attention
