@@ -881,6 +881,7 @@ class ModelRunner:
                 mla=cfg.kv_lora_rank > 0,
                 windowed=bool(cfg.attn_logit_softcap or cfg.sliding_window),
                 fp8_kv=self.config.kv_cache_dtype == "fp8",
+                sinks=cfg.model_family == "gptoss",
                 timeout_s=timeout_s,
             ):
                 if cfg.attention_impl != "auto":

@@ -55,6 +55,28 @@ def expert_capacity(
     return max(1, int(num_tokens * top_k * capacity_factor / num_experts))
 
 
+def _dispatch_combine(gate_vals, gate_idx, e: int, capacity: int,
+                      valid: Optional[jax.Array]):
+    """Token-major slot assignment shared by every routed-MoE variant:
+    one-hot the expert choices, queue tokens per expert with a cumsum,
+    drop past ``capacity``, and return the [T, E, C] dispatch (0/1) and
+    combine (gate-weighted) tensors. Pad tokens (``valid == 0``) claim
+    no slots and contribute nothing."""
+    t, top_k = gate_idx.shape
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)      # [T, K, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None, None]
+        gate_vals = gate_vals * valid[:, None]
+    flat = onehot.reshape(t * top_k, e)
+    pos = jnp.cumsum(flat, axis=0) - flat                        # queue pos
+    keep = (pos < capacity).astype(jnp.float32) * flat
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
+    dispatch = slot.sum(axis=1)                                  # [T, E, C]
+    combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)
+    return dispatch, combine
+
+
 def moe_mlp(
     x: jax.Array,         # [T, D] flattened tokens
     router_w: jax.Array,  # [D, E]
@@ -86,7 +108,6 @@ def moe_mlp(
     returned value is then a PARTIAL sum the caller must psum over the
     axis (together with its tp reduction).
     """
-    t, d = x.shape
     e = router_w.shape[1]
 
     logits = (x @ router_w).astype(jnp.float32)                          # [T, E]
@@ -109,18 +130,8 @@ def moe_mlp(
         )
     gate_vals = gate_vals * routed_scaling
 
-    # slot assignment: token-major priority over the flattened (T, K) choices
-    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [T, K, E]
-    if valid is not None:
-        onehot = onehot * valid[:, None, None]
-        gate_vals = gate_vals * valid[:, None]
-    flat = onehot.reshape(t * top_k, e)
-    pos = jnp.cumsum(flat, axis=0) - flat                    # queue position
-    keep = (pos < capacity).astype(jnp.float32) * flat       # [T*K, E]
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
-    dispatch = slot.sum(axis=1)                              # [T, E, C] 0/1
-    combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)  # [T, E, C]
+    dispatch, combine = _dispatch_combine(gate_vals, gate_idx, e, capacity,
+                                          valid)
 
     if ep_axis is not None:
         # expert stacks are axis-local: keep only this member's experts
@@ -166,24 +177,14 @@ def gptoss_moe(
       projection carries a bias.
     Same dense one-hot dispatch/capacity machinery as moe_mlp.
     """
-    t, d = x.shape
     e = router_w.shape[1]
 
     logits = (x @ router_w).astype(jnp.float32) + router_b.astype(jnp.float32)
     gate_vals, gate_idx = lax.top_k(logits, top_k)                   # [T, K]
     gate_vals = jax.nn.softmax(gate_vals, axis=-1)
 
-    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)          # [T, K, E]
-    if valid is not None:
-        onehot = onehot * valid[:, None, None]
-        gate_vals = gate_vals * valid[:, None]
-    flat = onehot.reshape(t * top_k, e)
-    pos = jnp.cumsum(flat, axis=0) - flat
-    keep = (pos < capacity).astype(jnp.float32) * flat
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
-    dispatch = slot.sum(axis=1)                                      # [T, E, C]
-    combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)
+    dispatch, combine = _dispatch_combine(gate_vals, gate_idx, e, capacity,
+                                          valid)
 
     x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)     # [E, C, D]
     gu = expert_einsum("ecd,edi->eci", x_e, w_gate_up) + b_gate_up[:, None, :]

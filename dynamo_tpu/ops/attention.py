@@ -209,9 +209,9 @@ def attention(
 ) -> jax.Array:
     """Paged-attention dispatch: XLA gather path or the Pallas kernels.
 
-    ``sinks`` (GPT-OSS) currently rides the XLA path only — the Pallas
-    kernels' online softmax would need the sink folded into their
-    finalize step; until then models with sinks force impl="xla".
+    ``sinks`` (GPT-OSS): a per-head logit joining every softmax as a
+    virtual key with no value — both Pallas kernels fold it into their
+    finalize denominator; the XLA path appends a softmax column.
 
     Accepts the engine's full stacked-by-layer cache plus a runtime
     ``layer_idx`` — the Pallas kernels index the layer inside HBM, so the
@@ -231,8 +231,6 @@ def attention(
         scale = d ** -0.5
     dk = k_cache.shape[-1]
     q = _pad_minor(q, dk)  # zero pad lanes score 0 against zero cache pad
-    if sinks is not None:
-        impl = "xla"  # kernels lack the sink finalize term (see docstring)
     if resolve_attention_impl(impl) == "xla":
         if stacked:
             # index the layer through the gather itself: block id n of
@@ -268,16 +266,20 @@ def attention(
         else jnp.asarray(sliding_window, jnp.int32).reshape(1)
     )
     decode = q.shape[1] == 1
+    has_sinks = sinks is not None
+    sink_args = (sinks,) if has_sinks else ()
     if decode:
         fn = functools.partial(
             paged_decode_attention, scale=scale, interpret=interpret,
             softcap=softcap,
         )
-        args = (q, k_cache, v_cache, block_tables, context_lens, li, win)
+        args = (q, k_cache, v_cache, block_tables, context_lens, li,
+                win) + sink_args
 
-        def call(q, k_cache, v_cache, block_tables, context_lens, li, win):
+        def call(q, k_cache, v_cache, block_tables, context_lens, li, win,
+                 *sk):
             return fn(q, k_cache, v_cache, block_tables, context_lens, li,
-                      window=win)
+                      window=win, sinks=sk[0] if sk else None)
     else:
         fn = functools.partial(
             paged_flash_attention, scale=scale, interpret=interpret,
@@ -285,12 +287,13 @@ def attention(
         )
         base_pos = positions[:, 0].astype(jnp.int32)
         args = (q, k_cache, v_cache, block_tables, base_pos, context_lens,
-                li, win)
+                li, win) + sink_args
 
         def call(q, k_cache, v_cache, block_tables, base_pos, context_lens,
-                 li, win):
+                 li, win, *sk):
             return fn(q, k_cache, v_cache, block_tables, base_pos,
-                      context_lens, li, window=win)
+                      context_lens, li, window=win,
+                      sinks=sk[0] if sk else None)
     if mesh is not None and mesh.size > 1:
         # batch shards over dp only when divisible — the scheduler prefills
         # with B=1, which each dp group then computes redundantly (decode,
@@ -305,6 +308,8 @@ def attention(
         if not decode:
             in_specs.append(P(dp))             # base_pos
         in_specs.extend([P(dp), P(), P()])     # context_lens, layer_idx, win
+        if has_sinks:
+            in_specs.append(P("tp"))           # sinks follow the head shard
         call = jax.shard_map(
             call,
             mesh=mesh,

@@ -42,15 +42,16 @@ def _kernel(
     q_ref,      # [1, Sc, KVH, G, D] (VMEM block)
     k_ref,      # [1, 1, bs, KVH, D] — one cache page of one layer
     v_ref,
-    o_ref,      # [1, Sc, KVH, G, D]
-    m_scr,      # [KVH * Sc * G, 128] f32 running max
-    l_scr,      # [KVH * Sc * G, 128] f32 running denominator
-    acc_scr,    # [KVH * Sc * G, D] f32 running numerator
-    *,
+    *rest,      # ([sinks_ref [1, KVH, G] when has_sinks], o_ref, m/l/acc scratch)
     scale: float,
     block_size: int,
     softcap: float,
+    has_sinks: bool = False,
 ):
+    if has_sinks:
+        sinks_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     c = pl.program_id(1)
     w = pl.program_id(2)
@@ -128,6 +129,13 @@ def _kernel(
         for h in range(kvh):
             lo = h * rows
             l = l_scr[lo : lo + rows, 0:1]
+            if has_sinks:
+                # virtual sink key: denominator-only (any shared exp
+                # shift cancels, so the keys-only running max serves)
+                sk = jnp.broadcast_to(
+                    sinks_ref[0, h][None, :], (sc, g)
+                ).reshape(rows, 1)
+                l = l + jnp.exp(sk - m_scr[lo : lo + rows, 0:1])
             l = jnp.where(l == 0.0, 1.0, l)
             out = (acc_scr[lo : lo + rows, :] / l).astype(o_ref.dtype)
             o_ref[0, :, h, :, :] = out.reshape(sc, g, d)
@@ -149,6 +157,7 @@ def paged_flash_attention(
     interpret: bool = False,
     softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
     window=None,             # sliding window (int or traced scalar); None = off
+    sinks=None,              # [H] per-head sink logits (GPT-OSS); None = off
 ) -> jax.Array:
     b, s, h, d = q.shape
     if k_cache.ndim == 4:
@@ -203,14 +212,21 @@ def paged_flash_attention(
         wi = jnp.maximum(wi, first_needed_page(i, c, base, win))
         return (li[0], bt[i, wi], 0, 0, 0)
 
+    has_sinks = sinks is not None
+    in_specs = [
+        pl.BlockSpec((1, sc, kvh, g, d), q_map),
+        pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
+        pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
+    ]
+    if has_sinks:
+        in_specs.append(
+            pl.BlockSpec((1, kvh, g), lambda *_: (0, 0, 0))
+        )
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(b, num_chunks, w),
-        in_specs=[
-            pl.BlockSpec((1, sc, kvh, g, d), q_map),
-            pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
-            pl.BlockSpec((1, 1, block_size, kvh, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, sc, kvh, g, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((kvh * sc * g, 128), jnp.float32),
@@ -219,9 +235,23 @@ def paged_flash_attention(
         ],
     )
 
+    operands = [
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        base_pos.astype(jnp.int32),
+        li,
+        win,
+        qg,
+        k_cache,
+        v_cache,
+    ]
+    if has_sinks:
+        operands.append(jnp.asarray(sinks, jnp.float32).reshape(1, kvh, g))
+
     out = pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, block_size=block_size, softcap=softcap
+            _kernel, scale=scale, block_size=block_size, softcap=softcap,
+            has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
@@ -232,14 +262,5 @@ def paged_flash_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(
-        block_tables.astype(jnp.int32),
-        context_lens.astype(jnp.int32),
-        base_pos.astype(jnp.int32),
-        li,
-        win,
-        qg,
-        k_cache,
-        v_cache,
-    )
+    )(*operands)
     return out.reshape(b, s, h, d)

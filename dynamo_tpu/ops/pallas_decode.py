@@ -57,15 +57,12 @@ def _decode_kernel(
     q_ref,     # [1, KVH, G, D] VMEM block
     k_hbm,     # [L, N, page, KVH, D] in HBM (ANY)
     v_hbm,
-    o_ref,     # [1, KVH, G, D]
-    k_buf,     # VMEM scratch [2, P, page, KVH, D]
-    v_buf,
-    sem,       # DMA semaphores [2]
-    *,
+    *rest,     # ([sinks_ref [1, rows] when has_sinks], o_ref, scratch...)
     scale: float,
     block_size: int,
     pages_per_chunk: int,
     softcap: float,
+    has_sinks: bool = False,
 ):
     """One grid step = one batch row; a fori_loop walks only LIVE chunks.
 
@@ -82,7 +79,15 @@ def _decode_kernel(
     visible key (the decode query sits at ctx-1, so only positions in
     [ctx - window, ctx) matter): windowed decode costs O(window) DMA,
     not O(context) — the gathered XLA path always pays full width.
+
+    ``has_sinks`` (GPT-OSS): a learned per-row logit joins the softmax
+    as a virtual key with no value — one exp(sink - m) term added to
+    the denominator at finalize.
     """
+    if has_sinks:
+        sinks_ref, o_ref, k_buf, v_buf, sem = rest
+    else:
+        o_ref, k_buf, v_buf, sem = rest
     b = pl.program_id(0)
     ctx = ctx_ref[b]
     li = li_ref[0]
@@ -174,6 +179,13 @@ def _decode_kernel(
     acc0 = jnp.zeros((rows, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(first_chunk, nchunks, body, (m0, l0, acc0))
     l1 = l[:, 0:1]
+    if has_sinks:
+        # the sink is a virtual key with no value: denominator only.
+        # Any shared shift works for the exp terms (it cancels), so the
+        # keys-only running max m serves without a combined-max pass.
+        l1 = l1 + jnp.exp(
+            sinks_ref[0][:, None].astype(jnp.float32) - m[:, 0:1]
+        )
     l1 = jnp.where(l1 == 0.0, 1.0, l1)
     o_ref[0] = (acc / l1).astype(o_ref.dtype).reshape(kvh, g, d)
 
@@ -378,6 +390,7 @@ def paged_decode_attention(
     interpret: bool = False,
     softcap: float = 0.0,    # Gemma-2: logits ← cap·tanh(logits/cap)
     window=None,             # sliding window (int or traced scalar); None = off
+    sinks=None,              # [H] per-head sink logits (GPT-OSS); None = off
 ) -> jax.Array:
     """Single-token paged attention; returns [B, 1, H, D].
 
@@ -407,15 +420,22 @@ def paged_decode_attention(
     pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
 
     qs = q.reshape(b, kvh, g, d)
+    has_sinks = sinks is not None
+
+    in_specs = [
+        pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    if has_sinks:
+        # [1, rows] replicated to every grid step; row order (kv, g)
+        # matches the kernel's q flattening
+        in_specs.append(pl.BlockSpec((1, kvh * g), lambda i, *_: (0, 0)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, kvh, g, d), lambda i, *_: (i, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM(
@@ -428,6 +448,20 @@ def paged_decode_attention(
         ],
     )
 
+    operands = [
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        li,
+        win,
+        qs,
+        k_cache,
+        v_cache,
+    ]
+    if has_sinks:
+        operands.append(
+            jnp.asarray(sinks, jnp.float32).reshape(1, kvh * g)
+        )
+
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel,
@@ -435,6 +469,7 @@ def paged_decode_attention(
             block_size=block_size,
             pages_per_chunk=pages_per_chunk,
             softcap=softcap,
+            has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype,
@@ -443,13 +478,5 @@ def paged_decode_attention(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(
-        block_tables.astype(jnp.int32),
-        context_lens.astype(jnp.int32),
-        li,
-        win,
-        qs,
-        k_cache,
-        v_cache,
-    )
+    )(*operands)
     return out.reshape(b, 1, h, d)

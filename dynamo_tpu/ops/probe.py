@@ -192,15 +192,57 @@ def probe_prefill_windowed_fp8():
     ))
 
 
+def _probe_decode_sinks(dtype_name):
+    # attention sinks (GPT-OSS): has_sinks is a static specialization;
+    # the probe also exercises the windowed runtime path (the family
+    # alternates windowed layers)
+    from dynamo_tpu.ops.pallas_decode import paged_decode_attention
+
+    l, n, page, kvh, d, b, w = 2, 16, 16, 2, 128, 2, 4
+    dt = getattr(jnp, dtype_name)
+    k = jnp.zeros((l, n, page, kvh, d), dt)
+    v = jnp.zeros((l, n, page, kvh, d), dt)
+    q = jnp.ones((b, 1, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(paged_decode_attention(
+        q, k, v, bt, ctx, jnp.asarray(1, jnp.int32),
+        window=jnp.asarray(16, jnp.int32),
+        sinks=jnp.ones((4,), jnp.float32),
+    ))
+
+
+def _probe_prefill_sinks(dtype_name):
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 8, 128
+    dt = getattr(jnp, dtype_name)
+    k = jnp.zeros((l, n, page, kvh, d), dt)
+    v = jnp.zeros((l, n, page, kvh, d), dt)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    base = jnp.zeros((b,), jnp.int32)
+    ctx = jnp.asarray([s], jnp.int32)
+    np.asarray(paged_flash_attention(
+        q, k, v, bt, base, ctx, jnp.asarray(0, jnp.int32),
+        window=jnp.asarray(48, jnp.int32),
+        sinks=jnp.ones((4,), jnp.float32),
+    ))
+
+
 PROBES = {
     "decode": probe_decode,
     "decode_windowed": probe_decode_windowed,
     "decode_fp8": probe_decode_fp8,
     "decode_windowed_fp8": probe_decode_windowed_fp8,
+    "decode_sinks": lambda: _probe_decode_sinks("bfloat16"),
+    "decode_sinks_fp8": lambda: _probe_decode_sinks("float8_e4m3fn"),
     "prefill": probe_prefill,
     "prefill_windowed": probe_prefill_windowed,
     "prefill_fp8": probe_prefill_fp8,
     "prefill_windowed_fp8": probe_prefill_windowed_fp8,
+    "prefill_sinks": lambda: _probe_prefill_sinks("bfloat16"),
+    "prefill_sinks_fp8": lambda: _probe_prefill_sinks("float8_e4m3fn"),
     "mla_decode": probe_mla_decode,
 }
 for kind in sys.argv[1:]:
@@ -292,7 +334,7 @@ def probe_kernel(
 
 def probe_serving_kernels(
     mla: bool = False, windowed: bool = False, fp8_kv: bool = False,
-    timeout_s: float = 180.0,
+    sinks: bool = False, timeout_s: float = 180.0,
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
     would compile — the dense engines' decode + flash-prefill kernels
@@ -307,17 +349,17 @@ def probe_serving_kernels(
     """
     if mla:
         kinds = ["mla_decode"]
-    elif fp8_kv:
-        # an fp8-cache engine ONLY compiles fp8-dtype specializations —
-        # probe those (plus the softcap x fp8 combination for windowed/
-        # softcapped models; softcap and dtype are both static keys)
-        kinds = ["decode_fp8", "prefill_fp8"]
-        if windowed:
-            kinds += ["decode_windowed_fp8", "prefill_windowed_fp8"]
     else:
-        kinds = ["decode", "prefill"]
-        if windowed:
-            kinds += ["decode_windowed", "prefill_windowed"]
+        # the static specialization keys are (softcap on/off, sinks
+        # on/off, cache dtype) — probe exactly the set this engine's
+        # model config will compile
+        sfx = "_fp8" if fp8_kv else ""
+        if sinks:
+            kinds = [f"decode_sinks{sfx}", f"prefill_sinks{sfx}"]
+        else:
+            kinds = [f"decode{sfx}", f"prefill{sfx}"]
+            if windowed:
+                kinds += [f"decode_windowed{sfx}", f"prefill_windowed{sfx}"]
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

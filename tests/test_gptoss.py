@@ -230,8 +230,6 @@ def test_mxfp4_checkpoint_dequantizes_at_load(model_dir, tmp_path):
         expected[li] = (gu_w.transpose(0, 2, 1), dn_w.transpose(0, 2, 1))
     st_np.save_file(tensors, st_file)
 
-    from dynamo_tpu.models import gptoss as gptoss_mod
-
     params = load_gptoss_params(d, cfg, jnp.float32)
     for li, (gu, dn) in expected.items():
         np.testing.assert_array_equal(
@@ -240,7 +238,6 @@ def test_mxfp4_checkpoint_dequantizes_at_load(model_dir, tmp_path):
         np.testing.assert_array_equal(
             np.asarray(params["layers"]["w_down"][li]), dn
         )
-    del gptoss_mod
 
 
 def test_incomplete_checkpoint_fails_loudly(model_dir, tmp_path):
@@ -266,3 +263,42 @@ def test_incomplete_checkpoint_fails_loudly(model_dir, tmp_path):
     cfg = ModelConfig.from_model_dir(d)
     with pytest.raises(ValueError, match="missing.*w_gate_up"):
         load_gptoss_params(d, cfg, jnp.float32)
+
+
+def test_gptoss_pallas_kernels_match_xla(model_dir, monkeypatch):
+    """The sinks+window kernel variants serve GPT-OSS's full forward —
+    parity vs the XLA path for prefill AND a decode step."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    cfg_x = ModelConfig.from_model_dir(model_dir)
+    cfg_x.attention_impl = "xla"
+    cfg_x.moe_capacity_factor = 8.0
+    cfg_p = ModelConfig.from_model_dir(model_dir)
+    cfg_p.attention_impl = "pallas"
+    cfg_p.moe_capacity_factor = 8.0
+    params = load_checkpoint_params(model_dir, cfg_x, gptoss, jnp.float32)
+
+    s = len(PROMPT)
+    tokens = jnp.asarray([PROMPT], jnp.int32)
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    bt = jnp.arange(4, dtype=jnp.int32)[None]
+    ctx = jnp.asarray([s], jnp.int32)
+
+    outs = {}
+    for name, cfg in (("xla", cfg_x), ("pallas", cfg_p)):
+        k, v = gptoss.init_kv_cache(cfg, 16, 8, jnp.float32)
+        logits, (k, v) = gptoss.forward(
+            params, cfg, tokens, positions, (k, v), bt, positions, ctx
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        dlogits, _ = gptoss.forward(
+            params, cfg, nxt, jnp.asarray([[s]], jnp.int32), (k, v), bt,
+            jnp.asarray([[s]], jnp.int32), jnp.asarray([s + 1], jnp.int32),
+        )
+        outs[name] = (np.asarray(logits), np.asarray(dlogits))
+
+    np.testing.assert_allclose(
+        outs["pallas"][0], outs["xla"][0], rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        outs["pallas"][1], outs["xla"][1], rtol=2e-4, atol=2e-4
+    )

@@ -397,3 +397,98 @@ def test_attention_dispatch_windowed_softcap_rides_pallas():
         sliding_window=12,
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_decode_sinks_matches_xla_reference():
+    """GPT-OSS attention sinks: both kernels fold exp(sink - m) into the
+    finalize denominator; parity vs the XLA sink column, with and
+    without a window on top."""
+    rng = np.random.default_rng(14)
+    layers, b, h, kvh, d, bs, w = 2, 4, 8, 4, 64, 16, 8
+    q, k_cache, v_cache, bt = make_stacked_case(rng, layers, b, h, kvh, d, bs, w)
+    ctx = jnp.asarray([1, 17, 64, 128], jnp.int32)
+    positions = (ctx - 1)[:, None]
+    sinks = jnp.asarray(rng.standard_normal(h), jnp.float32)
+
+    ref = paged_attention(
+        q, k_cache[1], v_cache[1], bt, positions, ctx, sinks=sinks
+    )
+    out = paged_decode_attention(
+        q, k_cache, v_cache, bt, ctx,
+        layer_idx=jnp.int32(1), pages_per_chunk=2, interpret=True,
+        sinks=sinks,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    ref = paged_attention(
+        q, k_cache[0], v_cache[0], bt, positions, ctx, sinks=sinks,
+        sliding_window=20,
+    )
+    out = paged_decode_attention(
+        q, k_cache, v_cache, bt, ctx,
+        layer_idx=jnp.int32(0), interpret=True, sinks=sinks,
+        window=jnp.asarray(20, jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_sinks_matches_xla_reference():
+    from dynamo_tpu.ops.pallas_attention import paged_flash_attention
+
+    rng = np.random.default_rng(15)
+    layers, b, s, h, kvh, d, bs = 2, 2, 64, 8, 4, 64, 16
+    w = 8
+    n_blocks = b * w + 1
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, kvh, d)), jnp.float32
+    )
+    v_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, kvh, d)), jnp.float32
+    )
+    bt = jnp.asarray(rng.permutation(n_blocks)[: b * w].reshape(b, w), jnp.int32)
+    base = jnp.asarray([0, 48], jnp.int32)
+    ctx = jnp.asarray([s, 48 + s], jnp.int32)
+    positions = base[:, None] + jnp.arange(s)[None, :]
+    sinks = jnp.asarray(rng.standard_normal(h), jnp.float32)
+
+    ref = paged_attention(
+        q, k_cache[0], v_cache[0], bt, positions, ctx, sinks=sinks,
+        sliding_window=30,
+    )
+    out = paged_flash_attention(
+        q, k_cache, v_cache, bt, base, ctx,
+        layer_idx=jnp.int32(0), interpret=True, q_chunk=32,
+        window=jnp.asarray(30, jnp.int32), sinks=sinks,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_attention_dispatch_sinks_rides_pallas_incl_mesh():
+    """attention() routes sinks to the kernels (no more XLA forcing),
+    incl. under the dp x tp shard_map where sinks shard with the heads."""
+    from dynamo_tpu.engine.model_runner import build_mesh
+
+    rng = np.random.default_rng(16)
+    layers, b, h, kvh, d, bs, w = 2, 4, 8, 4, 64, 16, 4
+    q, k_cache, v_cache, bt = make_stacked_case(rng, layers, b, h, kvh, d, bs, w)
+    ctx = jnp.asarray([12, 30, 64, 5], jnp.int32)
+    positions = (ctx - 1)[:, None]
+    sinks = jnp.asarray(rng.standard_normal(h), jnp.float32)
+
+    ref = attention(
+        q, k_cache, v_cache, bt, positions, ctx, impl="xla",
+        layer_idx=jnp.int32(1), sinks=sinks,
+    )
+    out = attention(
+        q, k_cache, v_cache, bt, positions, ctx, impl="pallas",
+        interpret=True, layer_idx=jnp.int32(1), sinks=sinks,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    mesh = build_mesh(2, 4)
+    out = attention(
+        q, k_cache, v_cache, bt, positions, ctx, impl="pallas",
+        mesh=mesh, interpret=True, layer_idx=jnp.int32(1), sinks=sinks,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
