@@ -137,13 +137,25 @@ class ModelRunner:
         )
         if config.pp_size > 1:
             from ..models import gemma2 as _gemma2
+            from ..models import gptoss as _gptoss
             from ..models import mixtral as _mixtral
 
-            if self.arch not in (llama, _mixtral, _gemma2):
+            if self.arch not in (llama, _mixtral, _gemma2, _gptoss):
                 raise NotImplementedError(
                     "pipeline parallelism stages the GQA trunk families "
-                    "(llama-family dense, mixtral MoE, gemma2); MLA "
-                    "models: use tp/ep"
+                    "(llama-family dense, mixtral MoE, gemma2, gptoss); "
+                    "MLA models: use tp/ep"
+                )
+            if self.arch is _gptoss and config.tp_size > 1:
+                # the staged program's Megatron psums assume tp-PARTIAL
+                # layer outputs; gptoss's expert stacks and attention
+                # output bias are tp-replicated (models/gptoss.py
+                # param_specs), so a tp psum would multiply them by tp.
+                # Non-pp tp works (GSPMD reduces only the matmuls).
+                raise NotImplementedError(
+                    "gptoss pipeline staging composes with ep/dp; tp "
+                    "inside stages needs tp-sharded expert stacks — "
+                    "serve tp via the non-pp engine for now"
                 )
             if cfg.num_layers % config.pp_size:
                 raise ValueError(

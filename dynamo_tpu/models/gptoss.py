@@ -150,8 +150,14 @@ def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     return attn_fn
 
 
-def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
-    """Routed-experts mlp_fn (gptoss_moe) for run_layers."""
+def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
+                ep_axis=None):
+    """Routed-experts mlp_fn (gptoss_moe) for run_layers; ``ep_axis`` is
+    the manual-shard_map expert axis (pipeline staging) — the routed
+    output becomes a partial sum the caller reduces. Note the expert
+    BIASES under ep: each member adds its local experts' biases only
+    (dispatch/combine are sliced before the bias add), so the psum over
+    ep is exact."""
     capacity = expert_capacity(
         b * s, cfg.num_experts, cfg.num_experts_per_tok,
         cfg.moe_capacity_factor,
@@ -164,6 +170,7 @@ def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array):
             lp["router"], lp["router_bias"],
             lp["w_gate_up"], lp["b_gate_up"], lp["w_down"], lp["b_down"],
             cfg.num_experts_per_tok, capacity, valid=valid,
+            ep_axis=ep_axis,
         )
         return y.reshape(b, s, -1)
 

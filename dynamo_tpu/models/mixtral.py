@@ -56,12 +56,18 @@ def expert_capacity(
 
 
 def _dispatch_combine(gate_vals, gate_idx, e: int, capacity: int,
-                      valid: Optional[jax.Array]):
+                      valid: Optional[jax.Array],
+                      ep_axis: Optional[str] = None):
     """Token-major slot assignment shared by every routed-MoE variant:
     one-hot the expert choices, queue tokens per expert with a cumsum,
     drop past ``capacity``, and return the [T, E, C] dispatch (0/1) and
     combine (gate-weighted) tensors. Pad tokens (``valid == 0``) claim
-    no slots and contribute nothing."""
+    no slots and contribute nothing.
+
+    ``ep_axis`` (manual shard_map callers): the queueing runs over the
+    GLOBAL expert set — capacity order identical to unsharded math —
+    and the tensors are then sliced to this member's experts, making
+    the caller's output a partial sum to psum over the axis."""
     t, top_k = gate_idx.shape
     onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)      # [T, K, E]
     if valid is not None:
@@ -74,6 +80,11 @@ def _dispatch_combine(gate_vals, gate_idx, e: int, capacity: int,
     slot = (pos_oh * keep[..., None]).reshape(t, top_k, e, capacity)
     dispatch = slot.sum(axis=1)                                  # [T, E, C]
     combine = (slot * gate_vals[:, :, None, None]).sum(axis=1)
+    if ep_axis is not None:
+        e_local = e // lax.axis_size(ep_axis)
+        e0 = lax.axis_index(ep_axis) * e_local
+        dispatch = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+        combine = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
     return dispatch, combine
 
 
@@ -130,19 +141,10 @@ def moe_mlp(
         )
     gate_vals = gate_vals * routed_scaling
 
+    # e from router_w, not the expert stacks' .shape — they may be
+    # QuantizedWeight (int8 serving), which carries no .shape
     dispatch, combine = _dispatch_combine(gate_vals, gate_idx, e, capacity,
-                                          valid)
-
-    if ep_axis is not None:
-        # expert stacks are axis-local: keep only this member's experts
-        # (slot queueing above ran on global E, so capacity order is
-        # identical to the unsharded math). e from router_w, not
-        # w_gate.shape — the expert stacks may be QuantizedWeight
-        # (int8 serving), which carries no .shape
-        e_local = e // lax.axis_size(ep_axis)
-        e0 = lax.axis_index(ep_axis) * e_local
-        dispatch = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
-        combine = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
+                                          valid, ep_axis=ep_axis)
 
     x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)   # [E, C, D]
     # expert_einsum: dispatches to int8 weights (scale on the out axis)
@@ -166,6 +168,7 @@ def gptoss_moe(
     valid: Optional[jax.Array] = None,
     alpha: float = 1.702,
     limit: float = 7.0,
+    ep_axis: Optional[str] = None,
 ) -> jax.Array:
     """GPT-OSS routed experts (semantics match HF modeling_gpt_oss):
 
@@ -175,7 +178,9 @@ def gptoss_moe(
       clamped to ±limit, out = (up+1) · gate·sigmoid(alpha·gate);
     - gate/up arrive interleaved in one fused projection, and every
       projection carries a bias.
-    Same dense one-hot dispatch/capacity machinery as moe_mlp.
+    Same dense one-hot dispatch/capacity machinery as moe_mlp, incl.
+    the manual-shard_map ``ep_axis`` contract (partial sums the caller
+    psums over the axis).
     """
     e = router_w.shape[1]
 
@@ -184,7 +189,7 @@ def gptoss_moe(
     gate_vals = jax.nn.softmax(gate_vals, axis=-1)
 
     dispatch, combine = _dispatch_combine(gate_vals, gate_idx, e, capacity,
-                                          valid)
+                                          valid, ep_axis=ep_axis)
 
     x_e = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)     # [E, C, D]
     gu = expert_einsum("ecd,edi->eci", x_e, w_gate_up) + b_gate_up[:, None, :]

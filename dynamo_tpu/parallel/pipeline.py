@@ -158,14 +158,20 @@ def pipeline_forward(
     import dataclasses as _dc
     import math as _math
 
-    from ..models import mixtral as _mixtral
-
     arch = arch or llama
-    moe = arch is _mixtral
     embed_fn = getattr(arch, "embed_tokens", llama.embed_tokens)
     make_attn = getattr(arch, "make_attn_fn", llama.make_gqa_attn_fn)
     run_layers_fn = getattr(arch, "run_layers", llama.run_layers)
     family_mlp = getattr(arch, "mlp_fn", llama._swiglu_mlp)
+    # routed-MoE families expose a per-tick mlp factory taking the
+    # manual ep axis (mixtral.make_moe_mlp_fn; gptoss.make_mlp_fn)
+    moe_maker = None
+    if getattr(cfg, "num_experts", 0):
+        moe_maker = (
+            getattr(arch, "make_moe_mlp_fn", None)
+            or getattr(arch, "make_mlp_fn", None)
+        )
+    moe = moe_maker is not None
     num_stages = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
     dp = mesh.shape.get("dp", 1)
@@ -266,7 +272,7 @@ def pipeline_forward(
                 layer_offset=stage * layers_per_stage,
             )
             base_mlp = (
-                _mixtral.make_moe_mlp_fn(
+                moe_maker(
                     cfg, mb_local, s, slots,
                     ep_axis="ep" if ep > 1 else None,
                 ) if moe
