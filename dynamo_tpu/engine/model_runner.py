@@ -146,16 +146,16 @@ class ModelRunner:
                     "(llama-family dense, mixtral MoE, gemma2, gptoss); "
                     "MLA models: use tp/ep"
                 )
-            if self.arch is _gptoss and config.tp_size > 1:
-                # the staged program's Megatron psums assume tp-PARTIAL
-                # layer outputs; gptoss's expert stacks and attention
-                # output bias are tp-replicated (models/gptoss.py
-                # param_specs), so a tp psum would multiply them by tp.
-                # Non-pp tp works (GSPMD reduces only the matmuls).
-                raise NotImplementedError(
-                    "gptoss pipeline staging composes with ep/dp; tp "
-                    "inside stages needs tp-sharded expert stacks — "
-                    "serve tp via the non-pp engine for now"
+            if self.arch is _gptoss and config.tp_size > 1 and (
+                cfg.intermediate_size % config.tp_size
+            ):
+                # the interleaved gate/up stacks shard the 2I columns in
+                # contiguous chunks; whole gate/up pairs (and their
+                # matching w_down rows) stay together only when the
+                # expert width divides by tp
+                raise ValueError(
+                    f"gptoss intermediate_size {cfg.intermediate_size} "
+                    f"not divisible by tp {config.tp_size}"
                 )
             if cfg.num_layers % config.pp_size:
                 raise ValueError(

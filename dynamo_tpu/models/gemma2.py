@@ -114,7 +114,8 @@ def embed_tokens(params: Params, tokens: jax.Array) -> jax.Array:
 
 
 def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                 context_lens, mesh, kv_gather_axis=None, layer_offset=0):
+                 context_lens, mesh, kv_gather_axis=None, layer_offset=0,
+                 tp_axis=None):
     """Gemma-2 attention block for run_layers: plain-rope QKV,
     query_pre_attn_scalar scaling, logit softcap, and the alternating
     per-layer sliding window (EVEN layers windowed). Same contract as
@@ -125,6 +126,7 @@ def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     layer index (it addresses the stage's cache slab), but the
     sliding/full alternation follows the GLOBAL layer number — the
     stage's first global layer index comes in here (may be traced)."""
+    del tp_axis  # bias-free projections; the wo matmul is the partial
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     scale = (cfg.query_pre_attn_scalar or hd) ** -0.5
 

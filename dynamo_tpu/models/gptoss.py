@@ -92,9 +92,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def param_specs(params: Params) -> Dict:
-    """Megatron TP on the attention projections; experts over ep (their
-    inner dims stay replicated — the interleaved gate/up layout makes a
-    clean tp split of 2I a follow-up, not a default)."""
+    """Megatron TP on the attention projections; experts over ep with
+    their intermediates over tp. The interleaved gate/up layout shards
+    cleanly: a contiguous chunk of the 2I columns covers whole gate/up
+    pairs whenever I % tp == 0, and those pairs' intermediate channels
+    are exactly the w_down row chunk of the same tp member."""
     layer_specs = {
         "ln1": P(), "ln2": P(),
         "wq": P(None, None, "tp"), "bq": P(None, "tp"),
@@ -103,9 +105,9 @@ def param_specs(params: Params) -> Dict:
         "wo": P(None, "tp", None), "bo": P(),
         "sinks": P(None, "tp"),
         "router": P(), "router_bias": P(),
-        "w_gate_up": P(None, "ep", None, None),
-        "b_gate_up": P(None, "ep", None),
-        "w_down": P(None, "ep", None, None),
+        "w_gate_up": P(None, "ep", None, "tp"),
+        "b_gate_up": P(None, "ep", "tp"),
+        "w_down": P(None, "ep", "tp", None),
         "b_down": P(None, "ep", None),
     }
     specs = {
@@ -119,11 +121,17 @@ def param_specs(params: Params) -> Dict:
 
 
 def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                 context_lens, mesh, kv_gather_axis=None, layer_offset=0):
+                 context_lens, mesh, kv_gather_axis=None, layer_offset=0,
+                 tp_axis=None):
     """GPT-OSS attention for run_layers: biased QKV/O, yarn rope, the
     per-head sink logits, and the alternating per-layer window (EVEN
     global layers windowed; ``layer_offset`` carries the stage's first
-    global layer index under pipeline staging)."""
+    global layer index under pipeline staging).
+
+    ``tp_axis`` (manual shard_map): the returned delta must be a
+    tp-PARTIAL the caller psums — the wo matmul already is (row-sharded
+    weights), but the replicated output bias ``bo`` would be counted tp
+    times, so it scales by 1/tp here."""
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, lp, k_all, v_all, li):
@@ -144,20 +152,23 @@ def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
             impl=cfg.attention_impl, mesh=mesh, layer_idx=li,
             sliding_window=window, sinks=lp["sinks"],
         )
-        delta = dense(attn.reshape(b, s, h * hd), lp["wo"]) + lp["bo"]
+        bo = lp["bo"]
+        if tp_axis is not None:
+            bo = bo / jax.lax.axis_size(tp_axis)
+        delta = dense(attn.reshape(b, s, h * hd), lp["wo"]) + bo
         return delta, k_all, v_all
 
     return attn_fn
 
 
 def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
-                ep_axis=None):
-    """Routed-experts mlp_fn (gptoss_moe) for run_layers; ``ep_axis`` is
-    the manual-shard_map expert axis (pipeline staging) — the routed
-    output becomes a partial sum the caller reduces. Note the expert
-    BIASES under ep: each member adds its local experts' biases only
-    (dispatch/combine are sliced before the bias add), so the psum over
-    ep is exact."""
+                ep_axis=None, tp_axis=None):
+    """Routed-experts mlp_fn (gptoss_moe) for run_layers; ``ep_axis`` /
+    ``tp_axis`` are the manual-shard_map axes (pipeline staging) — the
+    routed output becomes a partial sum the caller reduces. Expert
+    biases stay exact under both: each member adds its local experts'
+    (ep) and local channels' (b_gate_up under tp) biases only, and the
+    output-dim b_down scales by 1/tp inside gptoss_moe."""
     capacity = expert_capacity(
         b * s, cfg.num_experts, cfg.num_experts_per_tok,
         cfg.moe_capacity_factor,
@@ -170,7 +181,7 @@ def make_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
             lp["router"], lp["router_bias"],
             lp["w_gate_up"], lp["b_gate_up"], lp["w_down"], lp["b_down"],
             cfg.num_experts_per_tok, capacity, valid=valid,
-            ep_axis=ep_axis,
+            ep_axis=ep_axis, tp_axis=tp_axis,
         )
         return y.reshape(b, s, -1)
 

@@ -336,7 +336,7 @@ def gather_kv_writes(k, v, slot_mapping, axis):
 
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
                      context_lens, mesh, kv_gather_axis=None,
-                     layer_offset=0):
+                     layer_offset=0, tp_axis=None):
     """The standard attention block: QKV + RoPE, paged-KV scatter, GQA
     attention, output projection. Families with different attention (MLA,
     models/deepseek.py) plug their own via run_layers' attn_fn.
@@ -353,6 +353,7 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     has no per-layer-index semantics, so it is accepted and ignored —
     Gemma-2's window alternation is the consumer."""
     del layer_offset  # no global-layer-index semantics in this family
+    del tp_axis  # qkv biases are tp-sharded; no replicated additive terms
     h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):

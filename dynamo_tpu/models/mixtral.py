@@ -169,6 +169,7 @@ def gptoss_moe(
     alpha: float = 1.702,
     limit: float = 7.0,
     ep_axis: Optional[str] = None,
+    tp_axis: Optional[str] = None,
 ) -> jax.Array:
     """GPT-OSS routed experts (semantics match HF modeling_gpt_oss):
 
@@ -181,6 +182,14 @@ def gptoss_moe(
     Same dense one-hot dispatch/capacity machinery as moe_mlp, incl.
     the manual-shard_map ``ep_axis`` contract (partial sums the caller
     psums over the axis).
+
+    ``tp_axis`` (manual shard_map): the expert stacks arrive tp-SHARDED
+    — w_gate_up/b_gate_up a contiguous even-aligned chunk of the
+    interleaved 2I columns (whole gate/up pairs, matching the w_down row
+    chunk of the same intermediate channels), so the local clamped-GLU
+    is exact on its channels and the down contraction is a genuine
+    tp-partial; b_down (an output-dim bias every member would add)
+    scales by 1/tp so the caller's psum restores it once.
     """
     e = router_w.shape[1]
 
@@ -196,7 +205,11 @@ def gptoss_moe(
     gate = jnp.minimum(gu[..., 0::2], limit)
     up = jnp.clip(gu[..., 1::2], -limit, limit)
     h = (up + 1.0) * (gate * jax.nn.sigmoid(gate * alpha))
-    y_e = expert_einsum("eci,eid->ecd", h, w_down) + b_down[:, None, :]
+    y_e = expert_einsum("eci,eid->ecd", h, w_down)
+    b = b_down[:, None, :]
+    if tp_axis is not None:
+        b = b / lax.axis_size(tp_axis)
+    y_e = y_e + b
     return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y_e)
 
 
@@ -271,11 +284,16 @@ def forward(
 
 
 def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
-                    ep_axis: Optional[str] = None):
+                    ep_axis: Optional[str] = None,
+                    tp_axis: Optional[str] = None):
     """Routed-experts mlp_fn for run_layers/decoder_forward; shared with
     models/deepseek.py (DeepSeek MoE layers, incl. its shared expert).
     ``ep_axis`` (manual shard_map callers): see moe_mlp — the routed part
-    becomes a partial sum the caller reduces over the axis."""
+    becomes a partial sum the caller reduces over the axis. ``tp_axis``
+    is accepted for factory-contract uniformity and ignored: the
+    bias-free expert stacks tp-shard their inner dims, so the output is
+    already a genuine tp-partial."""
+    del tp_axis
     capacity = expert_capacity(
         b * s, cfg.num_experts, cfg.num_experts_per_tok, cfg.moe_capacity_factor
     )

@@ -263,18 +263,23 @@ def pipeline_forward(
             # sentinel routes their scatter out of range
             slots = jnp.where(valid, slots, -1)
 
-            # layer_offset is part of the attn-factory contract: the
-            # stage's first GLOBAL layer index (gemma2's window
-            # alternation consumes it; llama ignores it)
+            # layer_offset and tp_axis are part of the factory contract:
+            # the stage's first GLOBAL layer index (gemma2/gptoss window
+            # alternation) and the manual tp axis (families with
+            # replicated additive terms — gptoss's bo/b_down — scale
+            # them so the Megatron psum restores each exactly once)
+            tp_ax = "tp" if attn_axes else None
             base_attn = make_attn(
                 local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
                 kv_gather_axis="dp" if shard_dp else None,
                 layer_offset=stage * layers_per_stage,
+                tp_axis=tp_ax,
             )
             base_mlp = (
                 moe_maker(
                     cfg, mb_local, s, slots,
                     ep_axis="ep" if ep > 1 else None,
+                    tp_axis=tp_ax,
                 ) if moe
                 else family_mlp
             )

@@ -304,57 +304,58 @@ def test_gptoss_pallas_kernels_match_xla(model_dir, monkeypatch):
     )
 
 
-def test_gptoss_pp_ep_matches_single_stage(model_dir):
-    """GPT-OSS staged over pp x ep: sinks, biases, window alternation
-    (GLOBAL layer parity), and the clamped-GLU MoE (local-expert slicing
-    + psum) must reproduce the unstaged runner's greedy step exactly."""
+def _gptoss_run_step(model_dir, params, mcfg, pp, ep, tp, seed):
     from dynamo_tpu.engine.model_runner import ModelRunner
 
+    runner = ModelRunner(EngineConfig(
+        model=mcfg, max_batch_size=4, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=64, dtype="float32", pp_size=pp, ep_size=ep,
+        tp_size=tp, prefill_buckets=[16],
+    ), params=params)
+    b, s, bs = 4, 8, 8
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, mcfg.vocab_size, (b, s)).astype(np.int32)
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    w = runner.config.blocks_per_seq
+    btab = np.zeros((b, w), np.int32)
+    for i in range(b):
+        btab[i, 0] = i
+    slots = btab[:, :1] * bs + positions
+    out, *_ = runner.step(
+        tokens, positions, btab, slots, np.full(b, s, np.int32),
+        np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+        np.zeros(b, np.int32), np.ones(b, np.float32),
+        jax.random.PRNGKey(seed + 1),
+    )
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("pp,ep,tp", [(2, 2, 1), (2, 2, 2)])
+def test_gptoss_pp_matches_single_stage(model_dir, pp, ep, tp):
+    """GPT-OSS staged over pp x ep (x tp): sinks, biases, GLOBAL-layer
+    window alternation, local-expert slicing + psum — and at tp>1 the
+    pair-preserving 2I expert chunks, 1/tp-scaled bo/b_down, and
+    tp-sharded sinks — must reproduce the unstaged greedy step."""
     mcfg = ModelConfig.from_model_dir(model_dir)
     mcfg.attention_impl = "xla"
     params = load_checkpoint_params(model_dir, mcfg, gptoss, jnp.float32)
-
-    def run_step(pp, ep):
-        runner = ModelRunner(EngineConfig(
-            model=mcfg, max_batch_size=4, max_model_len=64, kv_block_size=8,
-            num_kv_blocks=64, dtype="float32", pp_size=pp, ep_size=ep,
-            prefill_buckets=[16],
-        ), params=params)
-        b, s, bs = 4, 8, 8
-        rng = np.random.default_rng(21)
-        tokens = rng.integers(0, mcfg.vocab_size, (b, s)).astype(np.int32)
-        positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
-        w = runner.config.blocks_per_seq
-        btab = np.zeros((b, w), np.int32)
-        for i in range(b):
-            btab[i, 0] = i
-        slots = btab[:, :1] * bs + positions
-        out, *_ = runner.step(
-            tokens, positions, btab, slots, np.full(b, s, np.int32),
-            np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
-            np.zeros(b, np.int32), np.ones(b, np.float32),
-            jax.random.PRNGKey(22),
-        )
-        return np.asarray(out)
-
-    ref = run_step(1, 1)
-    got = run_step(2, 2)
+    ref = _gptoss_run_step(model_dir, params, mcfg, 1, 1, 1, seed=21)
+    got = _gptoss_run_step(model_dir, params, mcfg, pp, ep, tp, seed=21)
     np.testing.assert_array_equal(got, ref)
 
 
-def test_gptoss_pp_tp_rejected():
-    """tp inside gptoss stages would psum tp-replicated expert outputs
-    and the attention output bias tp times — rejected until the expert
-    stacks tp-shard."""
+def test_gptoss_pp_tp_indivisible_width_rejected():
+    """Heads and kv-heads divide tp here, so ONLY the expert-width
+    guard can fire: intermediate_size 45 % tp 2 != 0."""
     from dynamo_tpu.engine.model_runner import ModelRunner
 
     mcfg = ModelConfig(
-        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=4,
+        vocab_size=128, hidden_size=32, intermediate_size=45, num_layers=4,
         num_heads=4, num_kv_heads=2, head_dim=8, model_family="gptoss",
         num_experts=4, num_experts_per_tok=2, sliding_window=4,
         attention_bias=True,
     )
-    with pytest.raises(NotImplementedError, match="tp-sharded expert"):
+    with pytest.raises(ValueError, match="intermediate_size 45"):
         ModelRunner(EngineConfig(
             model=mcfg, max_batch_size=4, max_model_len=32, kv_block_size=8,
             num_kv_blocks=16, dtype="float32", pp_size=2, tp_size=2,
