@@ -330,16 +330,24 @@ def _gptoss_run_step(model_dir, params, mcfg, pp, ep, tp, seed):
     return np.asarray(out)
 
 
-@pytest.mark.parametrize("pp,ep,tp", [(2, 2, 1), (2, 2, 2)])
-def test_gptoss_pp_matches_single_stage(model_dir, pp, ep, tp):
-    """GPT-OSS staged over pp x ep (x tp): sinks, biases, GLOBAL-layer
-    window alternation, local-expert slicing + psum — and at tp>1 the
-    pair-preserving 2I expert chunks, 1/tp-scaled bo/b_down, and
-    tp-sharded sinks — must reproduce the unstaged greedy step."""
+@pytest.fixture(scope="module")
+def pp_reference(model_dir):
+    """Unstaged single-device greedy step, computed once for every
+    staged-topology parametrization."""
     mcfg = ModelConfig.from_model_dir(model_dir)
     mcfg.attention_impl = "xla"
     params = load_checkpoint_params(model_dir, mcfg, gptoss, jnp.float32)
     ref = _gptoss_run_step(model_dir, params, mcfg, 1, 1, 1, seed=21)
+    return params, mcfg, ref
+
+
+@pytest.mark.parametrize("pp,ep,tp", [(2, 2, 1), (2, 2, 2)])
+def test_gptoss_pp_matches_single_stage(model_dir, pp_reference, pp, ep, tp):
+    """GPT-OSS staged over pp x ep (x tp): sinks, biases, GLOBAL-layer
+    window alternation, local-expert slicing + psum — and at tp>1 the
+    pair-preserving 2I expert chunks, 1/tp-scaled bo/b_down, and
+    tp-sharded sinks — must reproduce the unstaged greedy step."""
+    params, mcfg, ref = pp_reference
     got = _gptoss_run_step(model_dir, params, mcfg, pp, ep, tp, seed=21)
     np.testing.assert_array_equal(got, ref)
 
