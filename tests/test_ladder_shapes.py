@@ -45,6 +45,15 @@ LADDER = {
         moe_intermediate_size=2048, first_k_dense_replace=3,
         n_shared_experts=1,
     ),
+    "gpt-oss-20b": ModelConfig(
+        vocab_size=201088, hidden_size=2880, intermediate_size=2880,
+        num_layers=24, num_heads=64, num_kv_heads=8, head_dim=64,
+        model_family="gptoss", num_experts=32, num_experts_per_tok=4,
+        sliding_window=128, attention_bias=True, rope_theta=150000.0,
+        rope_scaling={"rope_type": "yarn", "factor": 32.0,
+                      "beta_fast": 32.0, "beta_slow": 1.0,
+                      "original_max_position_embeddings": 4096},
+    ),
 }
 
 # public parameter counts (within tolerance: embeddings/norm details)
@@ -53,6 +62,7 @@ EXPECTED_PARAMS = {
     "llama3-70b": 70.6e9,
     "mixtral-8x7b": 46.7e9,
     "deepseek-r1": 671e9,
+    "gpt-oss-20b": 20.9e9,
 }
 
 
