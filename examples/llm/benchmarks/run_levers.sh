@@ -1,0 +1,50 @@
+#!/bin/bash
+# Sequential lever measurement on a LIVE TPU chip, encoding the
+# compile-relay discipline learned in rounds 2 and 4: every attempt goes
+# through bench.py (which banks the known-safe XLA number before any
+# Pallas compile, probes tiny shapes in a child first, and bounds every
+# attempt with a hard timeout), attempts run strictly one at a time, and
+# an early wedge aborts the rest instead of queueing compiles behind it.
+#
+# Usage: ./run_levers.sh [out.jsonl]   (from the repo root's env)
+set -u
+cd "$(dirname "$0")/../../.."
+OUT="${1:-examples/llm/benchmarks/results/levers_$(date -u +%Y%m%d_%H%M).jsonl}"
+mkdir -p "$(dirname "$OUT")"
+
+run() {
+    local label="$1"; shift
+    echo "=== $label ===" | tee -a "$OUT.log"
+    # env pairs come as VAR=VAL args
+    env "$@" python bench.py > /tmp/lever_out.$$ 2>>"$OUT.log"
+    local rc=$?
+    # preserve the banked attempt lines in BOTH outcomes — a crash after
+    # the XLA bank must not erase the partial measurements
+    grep '^attempt\[' /tmp/lever_out.$$ >> "$OUT.log" || true
+    if [ $rc -eq 0 ]; then
+        tail -1 /tmp/lever_out.$$ | sed "s/^/{\"label\": \"$label\", \"result\": /; s/$/}/" >> "$OUT"
+        tail -1 /tmp/lever_out.$$
+    else
+        cat /tmp/lever_out.$$ >> "$OUT.log"
+        echo "{\"label\": \"$label\", \"error\": \"bench rc $rc\"}" >> "$OUT"
+        # a crashed bench is at least as abort-worthy as a zero result:
+        # never queue more compiles behind a possibly-wedged relay
+        echo "bench crashed (rc $rc) on '$label'; stopping the matrix" | tee -a "$OUT.log"
+        rm -f /tmp/lever_out.$$
+        exit 1
+    fi
+    # a zero-value result means the relay died mid-matrix: stop queueing
+    if tail -1 "$OUT" | grep -q '"value": 0.0'; then
+        echo "relay appears wedged after '$label'; stopping the matrix" | tee -a "$OUT.log"
+        exit 1
+    fi
+    rm -f /tmp/lever_out.$$
+}
+
+# Order: cheapest/safest first; each bench.py internally banks XLA
+# before Pallas. BENCH_TOTAL_BUDGET_S bounds each lever's spend.
+run "bf16-baseline+pallas"  BENCH_TOTAL_BUDGET_S=1200
+run "int8-weights"          BENCH_QUANT=int8 BENCH_TOTAL_BUDGET_S=900
+run "fp8-kv"                BENCH_KV=fp8 BENCH_TOTAL_BUDGET_S=900
+run "int8+fp8kv"            BENCH_QUANT=int8 BENCH_KV=fp8 BENCH_TOTAL_BUDGET_S=900
+echo "lever matrix complete: $OUT"

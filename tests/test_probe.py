@@ -198,3 +198,39 @@ def test_mla_models_probe_mla_kernel(monkeypatch):
     runner.warmup()
     assert seen["mla"] is True
     assert cfg.attention_impl == "xla"
+
+
+def test_probe_matrix_matches_engine_compilations(monkeypatch):
+    """probe_serving_kernels must request EXACTLY the kernel
+    specializations the engine's config will compile — the static keys
+    are (softcap/window on/off, sinks on/off, cache dtype)."""
+    captured = {}
+
+    def fake_probe_kernels(kinds, timeout_s=0.0, cwd=None):
+        captured["kinds"] = list(kinds)
+        return {k: True for k in kinds}
+
+    monkeypatch.setattr(probe_mod, "probe_kernels", fake_probe_kernels)
+
+    cases = [
+        (dict(), ["decode", "prefill"]),
+        (dict(windowed=True),
+         ["decode", "prefill", "decode_windowed", "prefill_windowed"]),
+        (dict(fp8_kv=True), ["decode_fp8", "prefill_fp8"]),
+        (dict(windowed=True, fp8_kv=True),
+         ["decode_fp8", "prefill_fp8",
+          "decode_windowed_fp8", "prefill_windowed_fp8"]),
+        (dict(sinks=True), ["decode_sinks", "prefill_sinks"]),
+        (dict(sinks=True, fp8_kv=True),
+         ["decode_sinks_fp8", "prefill_sinks_fp8"]),
+        (dict(sinks=True, windowed=True),  # gptoss: window rides the
+         ["decode_sinks", "prefill_sinks"]),  # sinks specialization
+        (dict(mla=True), ["mla_decode"]),
+    ]
+    for kwargs, want in cases:
+        assert probe_mod.probe_serving_kernels(**kwargs), kwargs
+        assert captured["kinds"] == want, (kwargs, captured["kinds"])
+        # every requested kind must exist in the child's probe registry
+        # (PROBES lives inside the subprocess source string)
+        for k in want:
+            assert f'"{k}"' in probe_mod._PROBE_SRC, k
