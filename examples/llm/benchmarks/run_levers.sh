@@ -33,8 +33,10 @@ run() {
         rm -f /tmp/lever_out.$$
         exit 1
     fi
-    # a zero-value result means the relay died mid-matrix: stop queueing
-    if tail -1 "$OUT" | grep -q '"value": 0.0'; then
+    # every live attempt failed (bench.py now reports the BANKED number
+    # with "banked": true instead of 0.0) → the relay died mid-matrix:
+    # stop queueing compiles behind it
+    if tail -1 "$OUT" | grep -Eq '"banked": true|"value": 0.0'; then
         echo "relay appears wedged after '$label'; stopping the matrix" | tee -a "$OUT.log"
         exit 1
     fi
