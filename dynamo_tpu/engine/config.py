@@ -49,6 +49,13 @@ class ModelConfig:
     moe_scoring_func: str = "softmax"  # "softmax" | "sigmoid"
     norm_topk_prob: bool = True
     routed_scaling_factor: float = 1.0
+    # DeepSeek group-limited routing: experts partition into n_group
+    # groups, top-k selection is restricted to the topk_group
+    # best-scoring groups (V2 "group_limited_greedy" scores a group by
+    # its max expert, V3 "noaux_tc" by its top-2 sum of biased scores).
+    # n_group == 1 disables the restriction (Mixtral/Qwen/V2-Lite).
+    n_group: int = 1
+    topk_group: int = 1
     # attention implementation: "auto" (pallas on TPU, xla elsewhere),
     # "xla", or "pallas"
     attention_impl: str = "auto"
@@ -137,13 +144,34 @@ class ModelConfig:
                 "Qwen2-MoE checkpoints (gated shared expert) are not "
                 "supported; Qwen3-MoE and Mixtral load"
             )
-        if (config.get("n_group") or 1) > 1:
-            # V3's device/group-limited top-k is a routing *restriction*;
-            # silently ignoring it would route differently than the
-            # checkpoint was trained for
-            raise NotImplementedError(
-                "group-limited expert routing (n_group > 1) is not supported yet"
-            )
+        n_group = config.get("n_group", 1) or 1
+        topk_group = config.get("topk_group", 1) or 1
+        if config.get("topk_method") == "greedy":
+            # DeepSeek-V2-Lite ships n_group in its config but routes
+            # plain greedy — the restriction is off
+            n_group = topk_group = 1
+        n_experts = (config.get("num_local_experts", 0)
+                     or config.get("n_routed_experts", 0)
+                     or config.get("num_experts", 0) or 0)
+        if n_group > 1:
+            # the group-limited restriction only composes when the
+            # expert set tiles evenly into groups and the selection can
+            # still fill top_k from the permitted groups
+            if n_experts % n_group:
+                raise ValueError(
+                    f"n_group={n_group} does not divide "
+                    f"n_routed_experts={n_experts}"
+                )
+            if not (1 <= topk_group <= n_group):
+                raise ValueError(
+                    f"topk_group={topk_group} outside [1, n_group={n_group}]"
+                )
+            if topk_group * (n_experts // n_group) < config.get(
+                    "num_experts_per_tok", 2):
+                raise ValueError(
+                    "permitted groups hold fewer experts than "
+                    "num_experts_per_tok"
+                )
         return cls(
             vocab_size=config.get("vocab_size", 32000),
             hidden_size=config.get("hidden_size", 2048),
@@ -173,6 +201,8 @@ class ModelConfig:
             moe_scoring_func=config.get("scoring_func", "softmax"),
             norm_topk_prob=config.get("norm_topk_prob", True),
             routed_scaling_factor=config.get("routed_scaling_factor", 1.0) or 1.0,
+            n_group=n_group,
+            topk_group=topk_group,
             # Gemma-2 / GPT-OSS (config.json keys; sliding_window exists
             # in other families' configs too, so gate on the architecture)
             model_family=(

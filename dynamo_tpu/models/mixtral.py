@@ -101,6 +101,8 @@ def moe_mlp(
     norm_topk: bool = True,             # renormalize top-k gate weights
     routed_scaling: float = 1.0,        # DeepSeek routed_scaling_factor
     router_bias: Optional[jax.Array] = None,  # [E] V3 e_score_correction_bias
+    n_group: int = 1,                   # DeepSeek group-limited routing
+    topk_group: int = 1,                # groups the top-k may draw from
     ep_axis: Optional[str] = None,      # manual-shard_map expert axis
 ) -> jax.Array:
     """Top-k routed SwiGLU experts via dense one-hot dispatch.
@@ -128,13 +130,33 @@ def moe_mlp(
         probs = jax.nn.softmax(logits, axis=-1)
     else:
         raise ValueError(f"unknown moe scoring {scoring!r}")
-    if router_bias is not None:
-        # V3 aux-loss-free balancing: the bias steers expert *selection*
-        # but the combine weights stay the unbiased scores
-        _, gate_idx = lax.top_k(probs + router_bias[None, :], top_k)
-        gate_vals = jnp.take_along_axis(probs, gate_idx, axis=1)
-    else:
-        gate_vals, gate_idx = lax.top_k(probs, top_k)                    # [T, K]
+    # selection scores vs combine weights: V3's bias steers expert
+    # *selection* only; the combine weights are always the unbiased probs
+    select = probs if router_bias is None else probs + router_bias[None, :]
+    if n_group > 1:
+        # DeepSeek group-limited routing (reference serves these configs
+        # via vLLM passthrough, lib/engines/vllm0_8/src/lib.rs:374-380):
+        # score each group of E/G experts — V3 "noaux_tc" by its top-2
+        # sum of biased scores, V2 "group_limited_greedy" by its max —
+        # keep the topk_group best groups, and zero every other expert's
+        # selection score (HF masked_fill(~mask, 0.0); scores are
+        # sigmoid/softmax outputs ≥ 0, so zeroed experts lose top_k to
+        # any live one)
+        t = select.shape[0]
+        gsize = e // n_group
+        grouped = select.reshape(t, n_group, gsize)
+        if router_bias is not None:
+            top2, _ = lax.top_k(grouped, min(2, gsize))
+            group_scores = top2.sum(axis=-1)                       # [T, G]
+        else:
+            group_scores = grouped.max(axis=-1)                    # [T, G]
+        _, gsel = lax.top_k(group_scores, topk_group)              # [T, KG]
+        gmask = jax.nn.one_hot(gsel, n_group, dtype=select.dtype).sum(1)
+        select = jnp.where(
+            jnp.repeat(gmask, gsize, axis=1) > 0, select, 0.0
+        )
+    _, gate_idx = lax.top_k(select, top_k)                         # [T, K]
+    gate_vals = jnp.take_along_axis(probs, gate_idx, axis=1)
     if norm_topk:
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(axis=-1, keepdims=True), 1e-9
@@ -308,6 +330,7 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
             scoring=cfg.moe_scoring_func, norm_topk=cfg.norm_topk_prob,
             routed_scaling=cfg.routed_scaling_factor,
             router_bias=layer_params.get("router_bias"),
+            n_group=cfg.n_group, topk_group=cfg.topk_group,
             ep_axis=ep_axis,
         )
         y = y.reshape(b, s, -1)

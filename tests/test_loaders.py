@@ -156,6 +156,69 @@ def test_deepseek_v3_loader_matches_hf(deepseek_v3_dir):
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+def test_deepseek_v2_group_limited_matches_hf(tmp_path):
+    # V2 "group_limited_greedy": group score = the group's max expert.
+    # n_group=2, topk_group=1, top_k=2 forces BOTH selections from the
+    # winning group — unrestricted routing would pick a different pair
+    # whenever the two best experts straddle groups, so parity here
+    # exercises the restriction, not just the plain top-k.
+    import torch
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        first_k_dense_replace=1, norm_topk_prob=False,
+        routed_scaling_factor=1.0, scoring_func="softmax",
+        kv_lora_rank=16, q_lora_rank=24, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        n_group=2, topk_group=1, topk_method="group_limited_greedy",
+    )
+    torch.manual_seed(3)
+    model = DeepseekV2ForCausalLM(cfg)
+    d = tmp_path / "dsv2_grouped"
+    model.save_pretrained(d, safe_serialization=True)
+    got = _serve_logits(d, cfg, PROMPT)
+    want = _hf_logits(model, PROMPT)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_deepseek_v3_group_limited_matches_hf(tmp_path):
+    # V3 "noaux_tc": group score = sum of the group's top-2 BIASED
+    # scores; combine weights stay unbiased. The nonzero correction bias
+    # makes selection and combine diverge, and n_group=2/topk_group=1
+    # makes the group mask bite (see the V2 variant above).
+    import torch
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        first_k_dense_replace=1, norm_topk_prob=True,
+        routed_scaling_factor=2.5, scoring_func="sigmoid",
+        kv_lora_rank=16, q_lora_rank=24, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        n_group=2, topk_group=1,
+    )
+    torch.manual_seed(4)
+    model = DeepseekV3ForCausalLM(cfg)
+    for layer in model.model.layers[cfg.first_k_dense_replace:]:
+        layer.mlp.gate.e_score_correction_bias.data = (
+            torch.randn(cfg.n_routed_experts) * 0.5
+        )
+    d = tmp_path / "dsv3_grouped"
+    model.save_pretrained(d, safe_serialization=True)
+    got = _serve_logits(d, cfg, PROMPT)
+    want = _hf_logits(model, PROMPT)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
 @pytest.fixture(scope="module")
 def qwen2_dir(tmp_path_factory):
     import torch
