@@ -66,9 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-model-len", type=int, default=None)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
     p.add_argument("--isolate-engine", action="store_true",
-                   help="host pystr:/pytok: engines in a supervised "
-                        "subprocess (heartbeat + respawn; an engine crash "
-                        "or hung compile cannot take the worker down)")
+                   help="host the engine (out=jax, pystr:, pytok:) in a "
+                        "supervised subprocess (heartbeat + respawn; an "
+                        "engine crash or hung Mosaic/XLA compile cannot "
+                        "take the worker down)")
     p.add_argument("--engine-heartbeat-s", type=float, default=5.0,
                    help="isolated-engine heartbeat interval; the child's "
                         "event loop must pong within interval x misses "
@@ -219,6 +220,34 @@ async def build_core_engine(engine_spec: str, flags, mdc, events=None, drt=None)
             engine_spec[len("pytok:"):], flags
         )
     if engine_spec == "jax":
+        if getattr(flags, "isolate_engine", False):
+            # the native JAX engine is the actual compile-hang hazard
+            # (a wedged Mosaic compile freezes the whole host process);
+            # host it as a supervised child: heartbeats catch the wedge,
+            # the worker keeps its lease, in-flight requests fail through
+            # the error prologue, and the next request respawns the
+            # child (warm-started via the persistent compilation cache).
+            if getattr(flags, "remote_prefill", False):
+                raise SystemExit(
+                    "--isolate-engine is incompatible with "
+                    "--remote-prefill: the disagg coordinator needs "
+                    "in-process access to the runner's KV cache"
+                )
+            from ..llm.engines.subprocess_host import SubprocessEngine
+
+            wire_flags = {
+                k: v for k, v in vars(flags).items()
+                if isinstance(v, (str, int, float, bool, list, dict))
+                or v is None
+            }
+            wire_flags["isolate_engine"] = False  # no recursion
+            return await SubprocessEngine.load(
+                "@jax", {"flags": wire_flags},
+                heartbeat_interval_s=getattr(flags, "engine_heartbeat_s", 5.0),
+                heartbeat_misses=getattr(flags, "engine_heartbeat_misses", 6),
+                init_timeout_s=getattr(flags, "engine_init_timeout_s", 120.0),
+                events=events,
+            )
         from ..engine.serving import JaxServingEngine
 
         disagg_factory = None

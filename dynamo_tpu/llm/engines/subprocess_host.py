@@ -91,9 +91,19 @@ class SubprocessEngine(AsyncEngine):
         max_restarts: int = 3,
         restart_backoff_s: float = 0.5,
         child_env: Optional[Dict[str, str]] = None,
+        events=None,  # KvEventSink: child "kv" frames replay into it
     ):
         self.path = path
         self.engine_args = engine_args or {}
+        self.events = events
+        # refreshed by each pong (the child piggybacks engine.metrics()
+        # on the heartbeat); read synchronously by stats handlers
+        self._last_metrics: dict = {}
+        # block hashes the live child has advertised as stored: a child
+        # that dies takes its allocator (and every cached block) with
+        # it, so the worker-side sink must see them removed or the KV
+        # router would route to prefix hits that can never occur
+        self._kv_live_hashes: set = set()
         self.init_timeout_s = init_timeout_s
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_misses = heartbeat_misses
@@ -118,11 +128,17 @@ class SubprocessEngine(AsyncEngine):
     async def load(
         cls, path: str, engine_args: Optional[dict] = None, **kw
     ) -> "SubprocessEngine":
-        if not os.path.exists(path):
+        # "@"-prefixed specs are built-in engines ("@jax"), not files
+        if not path.startswith("@") and not os.path.exists(path):
             raise FileNotFoundError(f"python engine file not found: {path}")
         eng = cls(path, engine_args, **kw)
         await eng._ensure_running()
         return eng
+
+    def metrics(self) -> dict:
+        """Engine metrics as of the last heartbeat pong (the hosted
+        engine's metrics() output; {} until the first pong arrives)."""
+        return self._last_metrics
 
     # ---------- lifecycle ----------
 
@@ -239,6 +255,11 @@ class SubprocessEngine(AsyncEngine):
                 t = frame.get("t")
                 if t == "pong":
                     self._pong = frame.get("n", 0)
+                    if "m" in frame:
+                        self._last_metrics = frame["m"]
+                    continue
+                if t == "kv":
+                    self._on_kv_frame(frame)
                     continue
                 q = self._streams.get(frame.get("id"))
                 if q is not None:
@@ -247,6 +268,23 @@ class SubprocessEngine(AsyncEngine):
             pass
         finally:
             await self._on_child_down("engine process disconnected")
+
+    def _on_kv_frame(self, frame: dict) -> None:
+        """Replay a child KV event into the worker-side sink — the KV
+        router's radix index stays current even though the allocator
+        lives in the engine child."""
+        if self.events is None:
+            return
+        try:
+            hashes = frame.get("hashes") or []
+            if frame.get("ev") == "stored":
+                self._kv_live_hashes.update(hashes)
+                self.events.on_stored(hashes, frame.get("parent"))
+            elif frame.get("ev") == "removed":
+                self._kv_live_hashes.difference_update(hashes)
+                self.events.on_removed(hashes)
+        except Exception:
+            logger.exception("KV event replay failed")
 
     async def _heartbeat_loop(self, writer: asyncio.StreamWriter) -> None:
         from ...runtime.transports.dynstore import write_frame
@@ -284,6 +322,15 @@ class SubprocessEngine(AsyncEngine):
         writer, self._writer = self._writer, None
         streams, self._streams = self._streams, {}
         hb, self._hb_task = self._hb_task, None
+        # the dead child's cached blocks died with its allocator: purge
+        # them from the worker-side radix index before anything else
+        # (synchronous, like the stream failures below)
+        dead_hashes, self._kv_live_hashes = self._kv_live_hashes, set()
+        if dead_hashes and self.events is not None:
+            try:
+                self.events.on_removed(sorted(dead_hashes))
+            except Exception:
+                logger.exception("KV purge after child death failed")
         if proc is not None and proc.returncode is not None:
             reason = f"{reason} (exit code {proc.returncode})"
         # fail the streams before any await: past the first suspension
@@ -374,6 +421,40 @@ class SubprocessEngine(AsyncEngine):
 # ---------------------------------------------------------------------------
 
 
+async def _build_child_engine(engine_path: str, engine_args: dict,
+                              event_post) -> AsyncEngine:
+    """Instantiate the hosted engine inside the child.
+
+    ``engine_path`` is a python-file path (pystr:/pytok: contract) or
+    the ``@jax`` sentinel — the native JAX serving engine, THE engine
+    whose Mosaic/XLA compiles are the wedge hazard this host exists to
+    quarantine. For ``@jax``, ``engine_args['flags']`` carries the
+    parent CLI's flag namespace as a plain dict; KV events flow back to
+    the parent as ``{"t": "kv"}`` frames via ``event_post``."""
+    if engine_path == "@jax":
+        from types import SimpleNamespace
+
+        from ...cli.run import load_mdc
+        from ...engine.block_allocator import KvEventSink
+        from ...engine.serving import JaxServingEngine
+
+        flags = SimpleNamespace(**(engine_args.get("flags") or {}))
+        mdc = load_mdc(flags)
+        sink = KvEventSink(
+            on_stored=lambda hashes, parent: event_post(
+                {"t": "kv", "ev": "stored",
+                 "hashes": [int(h) for h in hashes],
+                 "parent": None if parent is None else int(parent)}),
+            on_removed=lambda hashes: event_post(
+                {"t": "kv", "ev": "removed",
+                 "hashes": [int(h) for h in hashes]}),
+        )
+        return await JaxServingEngine.create(mdc, flags, events=sink)
+    from .python_file import PythonFileEngine
+
+    return await PythonFileEngine.load(engine_path, engine_args)
+
+
 async def _child_main(engine_path: str) -> int:
     sock = os.environ["DYN_ENGINE_SOCKET"]
     reader, writer = await asyncio.open_unix_connection(sock)
@@ -383,21 +464,6 @@ async def _child_main(engine_path: str) -> int:
     if init is None or init.get("t") != "init":
         return 2
 
-    try:
-        from .python_file import PythonFileEngine
-
-        engine = await PythonFileEngine.load(
-            engine_path, init.get("engine_args") or {}
-        )
-    except BaseException as e:  # report, don't just die: init errors are
-        write_frame(writer, {          # deterministic, not restartable
-            "t": "init_error", "error": f"{type(e).__name__}: {e}",
-        })
-        await writer.drain()
-        return 3
-    write_frame(writer, {"t": "ready"})
-    await writer.drain()
-
     tasks: Dict[str, asyncio.Task] = {}
     send_lock = asyncio.Lock()
 
@@ -405,6 +471,29 @@ async def _child_main(engine_path: str) -> int:
         async with send_lock:  # frames from concurrent streams interleave
             write_frame(writer, frame)
             await writer.drain()
+
+    # KV events are posted synchronously from scheduler hooks; a FIFO
+    # queue + one pump preserves stored/removed ordering (reordering a
+    # block's stored after its removed would corrupt the radix index)
+    event_q: asyncio.Queue = asyncio.Queue()
+
+    async def _event_pump() -> None:
+        while True:
+            await send(await event_q.get())
+
+    try:
+        engine = await _build_child_engine(
+            engine_path, init.get("engine_args") or {}, event_q.put_nowait
+        )
+    except BaseException as e:  # report, don't just die: init errors are
+        write_frame(writer, {          # deterministic, not restartable
+            "t": "init_error", "error": f"{type(e).__name__}: {e}",
+        })
+        await writer.drain()
+        return 3
+    pump_task = asyncio.create_task(_event_pump())  # noqa: F841
+    write_frame(writer, {"t": "ready"})
+    await writer.drain()
 
     async def run_stream(rid: str, payload: Any) -> None:
         try:
@@ -428,7 +517,16 @@ async def _child_main(engine_path: str) -> int:
             break
         t = frame.get("t")
         if t == "ping":
-            await send({"t": "pong", "n": frame.get("n", 0)})
+            # pongs double as the metrics channel: the parent's
+            # stats_handler is synchronous, so it reads the cache the
+            # latest pong refreshed (≤ one heartbeat interval stale)
+            pong = {"t": "pong", "n": frame.get("n", 0)}
+            if hasattr(engine, "metrics"):
+                try:
+                    pong["m"] = engine.metrics()
+                except Exception:
+                    pass
+            await send(pong)
         elif t == "req":
             rid = frame["id"]
             tasks[rid] = asyncio.create_task(

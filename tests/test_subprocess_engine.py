@@ -349,3 +349,178 @@ async def test_stop_cancels_child_stream(tmp_path):
         assert eng.spawn_count == 1
     finally:
         await eng.close()
+
+
+# ---------------------------------------------------------------------------
+# @jax: the native engine hosted out-of-process (VERDICT r4 item 5 — the
+# actual compile-hang hazard runs as a supervised child; reference analog
+# lib/engines/sglang/src/worker.rs:307-445)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jax_model_dir(tmp_path_factory):
+    import json
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from fixtures import make_model_dir
+
+    d = make_model_dir(tmp_path_factory.mktemp("subproc_jax"), name="tiny-hf")
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).save_pretrained(d, safe_serialization=True)
+    with open(os.path.join(d, "config.json")) as f:
+        c = json.load(f)
+    c["eos_token_id"] = 2
+    c["bos_token_id"] = 1
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(c, f)
+    return str(d)
+
+
+def _jax_flags(model_dir):
+    return {
+        "model_path": model_dir, "model_name": "tiny-hf",
+        "kv_block_size": 8, "max_batch_size": 2, "max_model_len": 64,
+        "extra_engine_args": None, "isolate_engine": False,
+    }
+
+
+def _greedy_req(n=4):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        token_ids=[3, 7, 11],
+        stop_conditions=StopConditions(max_tokens=n),
+        sampling_options=SamplingOptions(temperature=0.0),
+    ).to_wire()
+
+
+@pytest.mark.asyncio
+async def test_jax_engine_hosted_in_subprocess(jax_model_dir):
+    """@jax child: serve → SIGSTOP (a wedged Mosaic compile freezes the
+    child's loop exactly like this) → heartbeat kill → respawn → serve."""
+    from dynamo_tpu.engine.block_allocator import KvEventSink
+
+    kv_events = []
+    sink = KvEventSink(
+        on_stored=lambda h, p: kv_events.append(("stored", list(h), p)),
+        on_removed=lambda h: kv_events.append(("removed", list(h))),
+    )
+    eng = await SubprocessEngine.load(
+        "@jax", {"flags": _jax_flags(jax_model_dir)},
+        child_env=child_env(), init_timeout_s=300.0,
+        heartbeat_interval_s=0.3, heartbeat_misses=3,
+        restart_backoff_s=0.05, events=sink,
+    )
+    try:
+        toks = await asyncio.wait_for(_collect(eng, _greedy_req()), 60)
+        assert len(toks) == 4
+        assert eng.spawn_count == 1
+
+        # inject the wedge: freeze the child process (its event loop —
+        # and with it every pong — stops, like a hung in-process compile)
+        pid = eng._proc.pid
+        os.kill(pid, signal.SIGSTOP)
+        with pytest.raises((EngineError, EngineStreamDied)) as ei:
+            await asyncio.wait_for(_collect(eng, _greedy_req()), 60)
+        assert "heartbeat" in str(ei.value)
+        # SIGKILL still lands on a SIGSTOPped pid; reaped by the host
+        for _ in range(100):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            os.kill(pid, signal.SIGCONT)
+            pytest.fail(f"wedged jax child {pid} still alive")
+
+        # serving resumes on a respawned child, greedy stream identical
+        toks2 = await asyncio.wait_for(_collect(eng, _greedy_req()), 120)
+        assert toks2 == toks
+        assert eng.spawn_count == 2
+    finally:
+        await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_jax_subprocess_forwards_kv_events_and_metrics(jax_model_dir):
+    """The child's allocator events replay into the worker-side sink
+    (KV-aware routing keeps working out-of-process) and engine metrics
+    ride the heartbeat pongs."""
+    from dynamo_tpu.engine.block_allocator import KvEventSink
+
+    kv_events = []
+    sink = KvEventSink(
+        on_stored=lambda h, p: kv_events.append(("stored", list(h), p)),
+        on_removed=lambda h: kv_events.append(("removed", list(h))),
+    )
+    eng = await SubprocessEngine.load(
+        "@jax", {"flags": _jax_flags(jax_model_dir)},
+        child_env=child_env(), init_timeout_s=300.0,
+        heartbeat_interval_s=0.2, events=sink,
+    )
+    try:
+        # a full-block prompt (block size 8) gets its prefix registered
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            token_ids=list(range(3, 3 + 16)),
+            stop_conditions=StopConditions(max_tokens=2),
+            sampling_options=SamplingOptions(temperature=0.0),
+        ).to_wire()
+        toks = await asyncio.wait_for(_collect(eng, req), 60)
+        assert len(toks) == 2
+        for _ in range(100):  # events ride the async pump; wait briefly
+            if any(e[0] == "stored" for e in kv_events):
+                break
+            await asyncio.sleep(0.05)
+        assert any(e[0] == "stored" for e in kv_events)
+        # metrics piggyback on pongs
+        for _ in range(100):
+            if eng.metrics():
+                break
+            await asyncio.sleep(0.05)
+        assert isinstance(eng.metrics(), dict) and eng.metrics()
+    finally:
+        await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_child_death_purges_advertised_kv_hashes():
+    """A dead child takes its allocator with it: every block hash it
+    advertised as stored must replay as removed into the worker-side
+    sink, or KV-aware routing would chase prefix hits that cannot
+    occur (code-review r5 finding)."""
+    from dynamo_tpu.engine.block_allocator import KvEventSink
+
+    events = []
+    sink = KvEventSink(
+        on_stored=lambda h, p: events.append(("stored", list(h))),
+        on_removed=lambda h: events.append(("removed", list(h))),
+    )
+    eng = SubprocessEngine("@unused", events=sink)
+    eng._on_kv_frame({"t": "kv", "ev": "stored", "hashes": [11, 12],
+                      "parent": None})
+    eng._on_kv_frame({"t": "kv", "ev": "stored", "hashes": [13],
+                      "parent": 12})
+    eng._on_kv_frame({"t": "kv", "ev": "removed", "hashes": [12]})
+    assert eng._kv_live_hashes == {11, 13}
+    await eng._on_child_down("test kill")
+    assert ("removed", [11, 13]) in events
+    assert eng._kv_live_hashes == set()
