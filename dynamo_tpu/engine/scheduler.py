@@ -38,20 +38,9 @@ from .sampling import host_row, seed_to_key
 logger = logging.getLogger(__name__)
 
 
-GUIDED_END = -1  # terminal marker key inside a guided-choice trie
-
-
-def build_choice_trie(choice_ids: List[List[int]]) -> dict:
-    """Token trie over the guided choices' canonical tokenizations:
-    nested {token_id: child} dicts with GUIDED_END marking a complete
-    choice (choices may be prefixes of one another)."""
-    root: dict = {}
-    for ids in choice_ids:
-        node = root
-        for t in ids:
-            node = node.setdefault(int(t), {})
-        node[GUIDED_END] = True
-    return root
+# constrained decoding lives in engine/guided.py; re-exported here for
+# callers/tests that import the trie primitives from the scheduler
+from .guided import GUIDED_END, TrieConstraint, build_choice_trie  # noqa: F401,E402
 
 
 def ngram_propose(history: List[int], match: int, k: int) -> List[int]:
@@ -149,9 +138,11 @@ class EngineRequest:
     # preemption-resume: generated tokens already emitted before preemption;
     # re-prefilled (prompt + resume_tokens) so the stream CONTINUES
     resume_tokens: List[int] = dataclasses.field(default_factory=list)
-    # guided decoding: current node of the choice trie (None = free) and
+    # guided decoding: the constraint cursor (TrieConstraint for
+    # guided_choice — built at admission; JsonConstraint for guided_json
+    # — attached by serving.generate, which owns the grammar cache) and
     # the token ids its mask currently allows (for sparse bias edits)
-    guided_node: Optional[dict] = None
+    guided: Optional[object] = None
     guided_allowed: List[int] = dataclasses.field(default_factory=list)
     # disaggregated prefill state
     remote_future: Optional[asyncio.Future] = None
@@ -483,9 +474,12 @@ class Scheduler:
             # (the remote protocol ships KV + one sampled token, not a
             # [S, V] logits sweep) — prefill locally
             return False
-        if er.req.sampling_options.guided_choice_token_ids:
+        if (er.req.sampling_options.guided_choice_token_ids
+                or er.req.sampling_options.guided_json
+                or er.guided is not None):
             # the remote prefill samples the FIRST token without this
-            # engine's guided mask — constrained requests prefill locally
+            # engine's guided mask — constrained requests (choice trie
+            # OR json grammar) prefill locally
             return False
         # cheap pre-check before the (hash-the-whole-prompt) prefix probe:
         # a larger prefix hit can only make the uncached suffix smaller,
@@ -630,18 +624,29 @@ class Scheduler:
         self.slots[slot] = er
         er.seq = TokenSequence(tokens_all, block_size=self.config.kv_block_size)
         er.registered_blocks = 0
-        # guided decoding: (re)build the choice trie and walk it past any
-        # already-emitted tokens (a resumed request continues mid-choice)
+        # guided decoding: (re)build the constraint and walk it past any
+        # already-emitted tokens (a resumed request continues mid-stream)
         gids = er.req.sampling_options.guided_choice_token_ids
         if gids:
-            node = build_choice_trie(gids)
+            er.guided = TrieConstraint(gids)
+        elif er.guided is not None:
+            er.guided.reset()  # json constraint attached by serving
+        if er.guided is not None:
             for t in er.resume_tokens:
-                nxt = node.get(int(t))
-                if nxt is None:
-                    node = {}
-                    break
-                node = nxt
-            er.guided_node = node
+                if er.guided.advance(int(t)) != "ok":
+                    # derailed resume (tokens that never followed the
+                    # mask — unreachable in normal operation): an
+                    # all-banned mask would still emit one unconstrained
+                    # token (an additive constant constrains nothing),
+                    # so finish the stream here instead
+                    self._finish(er, FinishReason.STOP)
+                    return
+            if not self._guided_allowed_ids(er):
+                # dead state: the vocab cannot express any legal
+                # continuation (serving validates expressibility at
+                # grammar build, so this is a defensive backstop)
+                self._finish(er, FinishReason.STOP)
+                return
         # penalty state for the slot: prompt presence + (on resume) counts
         # of the already-generated tokens (+ the guided mask for the
         # FIRST sampled token — the prefill's final chunk samples it)
@@ -649,7 +654,7 @@ class Scheduler:
             slot, er.prompt, er.resume_tokens,
             logit_bias=er.req.sampling_options.logit_bias,
             guided_mask=(
-                self._guided_mask(er) if er.guided_node is not None else None
+                self._guided_mask(er) if er.guided is not None else None
             ),
         )
         self.prefilling.append(er)
@@ -837,16 +842,16 @@ class Scheduler:
                 and er.repetition_penalty == 1.0
                 and not er.want_logprobs and er.logprobs_n == 0
                 and not er.req.sampling_options.logit_bias
-                and er.guided_node is None)
+                and er.guided is None)
 
     def _guided_allowed_ids(self, er: EngineRequest) -> List[int]:
-        """Token ids the current trie node permits next: its children,
-        plus the eos ids at a terminal node (choices that prefix longer
-        choices resolve to the longer one unless the model emits eos)."""
+        """Token ids the constraint permits next, plus the eos ids
+        wherever the constrained output may legally end (a terminal trie
+        node; a complete top-level JSON value)."""
         v = self.config.model.vocab_size
-        node = er.guided_node or {}
-        allowed = [t for t in node if t != GUIDED_END and 0 <= t < v]
-        if GUIDED_END in node:
+        ids, at_end = er.guided.allowed()
+        allowed = [t for t in ids if 0 <= t < v]
+        if at_end:
             allowed.extend(
                 int(e) for e in er.req.eos_token_ids or []
                 if 0 <= int(e) < v
@@ -864,24 +869,35 @@ class Scheduler:
         return mask
 
     def _guided_after_token(self, er: EngineRequest) -> None:
-        """Advance the trie past the just-sampled token; install the next
-        mask, or finish when a choice completes. Runs between
-        _check_finish and _emit so the completing token still streams."""
-        if er.guided_node is None or er.finish is not None:
+        """Advance the constraint past the just-sampled token; install
+        the next mask, or finish when the constraint completes. Runs
+        between _check_finish and _emit so the completing token still
+        streams."""
+        if er.guided is None or er.finish is not None:
             return
-        node = er.guided_node.get(er.pending_token)
-        if node is None:
-            # eos at a terminal node (or a defensive derail): done
+        key_before = er.guided.state_key()
+        verdict = er.guided.advance(er.pending_token)
+        if verdict != "ok":
+            # "done": constraint complete (closing brace / final choice
+            # token). "derail": eos at a legal end point (eos is never
+            # in the constraint's own alphabet) or a defensive fallback.
             er.finish = FinishReason.STOP
             return
-        er.guided_node = node
-        if not any(t != GUIDED_END for t in node):
-            er.finish = FinishReason.STOP  # choice complete
+        if er.guided.state_key() == key_before:
+            # same machine state → identical allowed set (e.g. JSON
+            # string-body tokens): the installed mask is already right
             return
         # sparse edit: only the old node's and new node's neighborhoods
         # change — O(branching), not O(vocab), per token
         user_bias = er.req.sampling_options.logit_bias or {}
         new_allowed = self._guided_allowed_ids(er)
+        if not new_allowed:
+            # dead state mid-stream (vocab cannot continue the grammar
+            # and no legal end here): stop at the valid prefix instead
+            # of emitting an unconstrained token through an all-banned
+            # mask
+            er.finish = FinishReason.STOP
+            return
         new_set = set(new_allowed)
         changed = list(new_set | set(er.guided_allowed))
         vals = [
@@ -1089,9 +1105,15 @@ class Scheduler:
             # target to per-token too — with a draft configured, the
             # fused burst's role is played by speculation itself
             k_steps = 1
-        if any(er.guided_node is not None for er in active):
+        if any(er.guided is not None for er in active):
             # guided rows rewrite their mask between tokens on the host;
-            # a fused burst would sample K tokens against one stale mask
+            # a fused burst would sample K tokens against one stale mask.
+            # NOTE this pins the WHOLE batch (all rows share one
+            # dispatch), so concurrent unguided requests also lose the
+            # burst while any guided request is active — documented in
+            # docs/models.md. Splitting guided rows into their own
+            # dispatch would pay two program launches per step, worse
+            # than the amortization it saves at serving batch sizes.
             k_steps = 1
 
         # make sure each active sequence has blocks for its next position

@@ -121,11 +121,16 @@ def build_draft_config(target: EngineConfig) -> EngineConfig:
     import dataclasses
 
     draft_model = ModelConfig.from_model_dir(target.spec_draft_model)
-    if draft_model.vocab_size < target.model.vocab_size:
+    if draft_model.vocab_size != target.model.vocab_size:
+        # smaller: target ids are out of range for the draft. LARGER is
+        # just as bad in the other direction — the draft can propose ids
+        # the target's embedding gather clamps and the verify step never
+        # accepts, silently wasting every speculation round.
         raise ValueError(
-            f"draft vocab {draft_model.vocab_size} smaller than target "
-            f"{target.model.vocab_size}: target token ids would be out "
-            "of range for the draft (the two must share a tokenizer)"
+            f"draft vocab {draft_model.vocab_size} != target "
+            f"{target.model.vocab_size}: the two must share a tokenizer "
+            "(out-of-range ids are either invalid for the draft or "
+            "never-accepted noise for the target)"
         )
     if draft_model.max_position_embeddings < target.max_model_len:
         raise ValueError(
@@ -151,6 +156,11 @@ class JaxServingEngine(AsyncEngine):
         self.runner = runner
         self.scheduler = scheduler
         self.config = config
+        # guided JSON: grammars (and the vocab piece table they share)
+        # are compiled once per distinct spec and reused across requests
+        self._model_path: Optional[str] = None
+        self._pieces = None
+        self._json_grammars: dict = {}
 
     @classmethod
     async def create(
@@ -203,6 +213,7 @@ class JaxServingEngine(AsyncEngine):
         scheduler = Scheduler(runner, engine_config, events, disagg=disagg,
                               draft_runner=draft_runner)
         engine = cls(runner, scheduler, engine_config)
+        engine._model_path = mdc.model_path  # guided-JSON piece table
         if warmup:
             futs = [loop.run_in_executor(None, runner.warmup)]
             if draft_runner is not None:
@@ -257,12 +268,18 @@ class JaxServingEngine(AsyncEngine):
                 token_ids=[], finish_reason=FinishReason.LENGTH
             ).to_wire()
             return
+        guided = None
+        if req.sampling_options.guided_json:
+            guided = await self._json_constraint(
+                req.sampling_options.guided_json
+            )
         er = EngineRequest(
             request_id=request.id or uuid.uuid4().hex,
             prompt=list(req.token_ids),
             req=req,
             ctx=request.context,
             out_queue=asyncio.Queue(),
+            guided=guided,
         )
         self.scheduler.add_request(er)
         try:
@@ -274,6 +291,87 @@ class JaxServingEngine(AsyncEngine):
         finally:
             # consumer went away (stop/kill/break) — scheduler will reap it
             request.context.stop_generating()
+
+    async def _json_constraint(self, spec: dict):
+        """Per-request cursor over the (cached) compiled grammar. The
+        first request with a new spec pays the compile + the O(vocab)
+        piece-table build in an executor thread; the scheduler loop
+        never blocks on it."""
+        import json as _json
+
+        from ..runtime.engine import EngineError
+        from .guided import JsonConstraint, JsonGrammar, build_piece_table
+
+        key = _json.dumps(spec, sort_keys=True)
+        entry = self._json_grammars.get(key)
+        if isinstance(entry, asyncio.Future):
+            # a concurrent first request is already building this spec:
+            # await it instead of paying the O(vocab) sweep N times
+            grammar = await asyncio.shield(entry)
+        else:
+            grammar = entry
+        if grammar is None:
+            if self._model_path is None:
+                raise EngineError(
+                    "guided json requires a tokenizer; this engine was "
+                    "built without a model path"
+                )
+            loop = asyncio.get_running_loop()
+
+            def build():
+                if self._pieces is None:
+                    from ..llm.tokenizer import HFTokenizer
+
+                    tok = HFTokenizer.from_model_path(self._model_path)
+                    self._pieces = build_piece_table(
+                        tok, self.config.model.vocab_size
+                    )
+                schema = (spec.get("schema")
+                          if spec.get("type") == "json_schema" else None)
+                g = JsonGrammar(self._pieces, schema)
+                # the first O(vocab) mask sweep belongs HERE (executor
+                # thread), not on the event loop — and it doubles as
+                # the expressibility check
+                ids, _at_end = JsonConstraint(g).allowed()
+                if not ids:
+                    # e.g. a tokenizer whose vocab has no brace/quote
+                    # pieces: the grammar is unsatisfiable — reject the
+                    # request instead of streaming junk-then-stop
+                    raise EngineError(
+                        "guided json: this model's tokenizer cannot "
+                        "express the requested grammar (no legal first "
+                        "token)"
+                    )
+                return g
+
+            fut = loop.create_future()
+            self._json_grammars[key] = fut  # followers await this build
+            try:
+                grammar = await loop.run_in_executor(None, build)
+            except ValueError as e:
+                err = EngineError(f"guided json: {e}")
+                fut.set_exception(err)
+                fut.exception()  # consumed (no un-retrieved warning)
+                self._json_grammars.pop(key, None)
+                raise err
+            except BaseException as e:
+                fut.set_exception(e)
+                fut.exception()
+                self._json_grammars.pop(key, None)
+                raise
+            fut.set_result(grammar)
+            # bounded LRU over distinct specs: each grammar's per-state
+            # mask cache can reach vocab-sized lists — adversarial
+            # unique-schema traffic must not grow memory without limit
+            evictable = [k for k, v in self._json_grammars.items()
+                         if not isinstance(v, asyncio.Future)]
+            while len(self._json_grammars) > 32 and evictable:
+                self._json_grammars.pop(evictable.pop(0), None)
+            self._json_grammars[key] = grammar  # resolve future → value
+        else:
+            self._json_grammars.pop(key)
+            self._json_grammars[key] = grammar  # LRU touch
+        return JsonConstraint(grammar)
 
     def metrics(self) -> dict:
         return self.scheduler.metrics()

@@ -196,6 +196,40 @@ class OpenAIPreprocessor(Operator):
             )
         return list(choices)
 
+    @staticmethod
+    def _guided_json(req) -> Optional[dict]:
+        """Guided JSON spec from ``response_format`` (OpenAI) or the
+        vLLM-style ``guided_json`` extra field (whose value IS the
+        schema). Validated here by compiling the schema — unsupported
+        keywords must 400 at the door, not crash the engine loop."""
+        spec = None
+        rf = getattr(req, "response_format", None)
+        if rf and rf.get("type") == "json_object":
+            spec = {"type": "json_object"}
+        elif rf and rf.get("type") == "json_schema":
+            spec = {"type": "json_schema",
+                    "schema": rf["json_schema"]["schema"]}
+        else:
+            gj = (req.model_extra or {}).get("guided_json")
+            if gj is None and req.nvext is not None:
+                gj = (req.nvext.model_extra or {}).get("guided_json")
+            if gj is not None:
+                if not isinstance(gj, dict):
+                    raise EngineError(
+                        "guided_json must be a JSON-schema object"
+                    )
+                spec = {"type": "json_schema", "schema": gj}
+        if spec is None:
+            return None
+        from ..engine.guided import compile_schema
+
+        try:
+            if spec["type"] == "json_schema":
+                compile_schema(spec["schema"])
+        except ValueError as e:
+            raise EngineError(str(e))
+        return spec
+
     def _guided_choice_ids(
         self, choices: Optional[List[str]]
     ) -> Optional[List[List[int]]]:
@@ -235,6 +269,12 @@ class OpenAIPreprocessor(Operator):
         )
         budget = self.mdc.context_length - len(token_ids)
         guided = self._guided_choice(req)
+        guided_json = self._guided_json(req)
+        if guided and guided_json:
+            raise EngineError(
+                "guided_choice and guided JSON (response_format/"
+                "guided_json) are mutually exclusive"
+            )
         out = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(
@@ -265,6 +305,7 @@ class OpenAIPreprocessor(Operator):
                 } if getattr(req, "logit_bias", None) else None,
                 guided_choice=guided,
                 guided_choice_token_ids=self._guided_choice_ids(guided),
+                guided_json=guided_json,
             ),
             output_options=OutputOptions(
                 logprobs=self._logprobs_count(req),

@@ -70,6 +70,11 @@ class SamplingOptions:
     # the trie's token ids (preprocessor-filled; engines consume this,
     # not the strings — the engine holds no tokenizer)
     guided_choice_token_ids: Optional[List[List[int]]] = None
+    # guided JSON (OpenAI response_format / vLLM guided_json extra):
+    # {"type": "json_object"} or {"type": "json_schema", "schema": {...}}.
+    # The engine compiles it to a character-level JSON machine driving
+    # the same per-step bias-row edits as guided_choice (engine/guided.py).
+    guided_json: Optional[dict] = None
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

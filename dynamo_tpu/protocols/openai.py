@@ -39,12 +39,22 @@ def _reject_unsupported_extras(req: BaseModel) -> BaseModel:
             "search is not implemented); remove it from the request"
         )
     rf = getattr(req, "response_format", None)
-    if rf and rf.get("type") not in (None, "text"):
+    if rf and rf.get("type") not in (None, "text", "json_object",
+                                     "json_schema"):
         raise ValueError(
-            f"response_format type {rf.get('type')!r} is not supported "
-            "(guided JSON is not implemented); for constrained outputs "
-            "use the 'guided_choice' extra field"
+            f"response_format type {rf.get('type')!r} is not supported; "
+            "use 'json_object', 'json_schema', 'text', or the "
+            "'guided_choice' extra field"
         )
+    if rf and rf.get("type") == "json_schema":
+        js = rf.get("json_schema")
+        if not isinstance(js, dict) or not isinstance(
+                js.get("schema"), dict):
+            raise ValueError(
+                "response_format json_schema requires "
+                "{'json_schema': {'schema': {...}}} (OpenAI structured-"
+                "outputs shape)"
+            )
     return req
 
 

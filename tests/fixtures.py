@@ -13,6 +13,10 @@ CORPUS = [
     "USER: what is the capital of france? ASSISTANT: paris STOP",
     "a b c d e f g h i j k l m n o p q r s t u v w x y z",
     "0 1 2 3 4 5 6 7 8 9 émojis ünïcode ✓ 中文 tokens",
+    # JSON structural characters: guided-JSON decoding needs the
+    # tokenizer to be able to EXPRESS the grammar (braces, quotes,
+    # colons, commas, brackets, minus, dot, backslash)
+    '{"name": "value", "n": [1, 2.5, -3], "ok": true, "x": null}',
 ]
 
 CHAT_TEMPLATE = (

@@ -613,14 +613,69 @@ def test_preprocess_guided_choice(mdc, tokenizer):
         pre.preprocess_chat(bad)
 
 
-def test_response_format_json_rejected():
+def test_response_format_surface():
+    # json_object / json_schema / text all validate at the type layer
+    for rf in (
+        {"type": "text"},
+        {"type": "json_object"},
+        {"type": "json_schema",
+         "json_schema": {"name": "x", "schema": {"type": "object",
+                                                 "properties": {"a": {}}}}},
+    ):
+        ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "x"}],
+            response_format=rf,
+        )
+    # unknown types and shapeless json_schema still 400
     with pytest.raises(Exception, match="response_format"):
         ChatCompletionRequest(
             model="m", messages=[{"role": "user", "content": "x"}],
-            response_format={"type": "json_object"},
+            response_format={"type": "grammar"},
         )
-    # explicit text type passes
-    ChatCompletionRequest(
-        model="m", messages=[{"role": "user", "content": "x"}],
-        response_format={"type": "text"},
+    with pytest.raises(Exception, match="json_schema"):
+        ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "x"}],
+            response_format={"type": "json_schema"},
+        )
+
+
+def test_preprocessor_guided_json(mdc, tokenizer):
+    pre = OpenAIPreprocessor(mdc, tokenizer)
+    req = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        response_format={"type": "json_object"},
     )
+    out = pre.preprocess_chat(req)
+    assert out.sampling_options.guided_json == {"type": "json_object"}
+
+    # vLLM-style extra field: the value IS the schema
+    req2 = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        guided_json={"type": "object", "properties": {"a": {"type": "string"}}},
+    )
+    out2 = pre.preprocess_chat(req2)
+    assert out2.sampling_options.guided_json["type"] == "json_schema"
+    assert out2.sampling_options.guided_json["schema"]["properties"]
+
+    # unsupported schema keywords 400 at the door, not in the engine
+    from dynamo_tpu.runtime.engine import EngineError
+
+    bad = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        guided_json={"type": "string", "pattern": "^a+$"},
+    )
+    with pytest.raises(EngineError, match="pattern"):
+        pre.preprocess_chat(bad)
+
+    # mutually exclusive with guided_choice
+    both = ChatCompletionRequest(
+        model="tiny-llama",
+        messages=[{"role": "user", "content": "x"}],
+        guided_choice=["a"],
+        guided_json={"type": "object", "properties": {"a": {}}},
+    )
+    with pytest.raises(EngineError, match="exclusive"):
+        pre.preprocess_chat(both)
