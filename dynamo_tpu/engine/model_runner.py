@@ -121,12 +121,15 @@ class ModelRunner:
                 f"unknown kv_cache_dtype {config.kv_cache_dtype!r} "
                 "(auto | fp8)"
             )
-        if config.kv_cache_dtype == "fp8" and cfg.kv_lora_rank > 0:
-            raise NotImplementedError(
-                "fp8 KV cache is GQA-family only: the MLA compressed "
-                "latent doubles as the value and is too sensitive to "
-                "e4m3 quantization"
-            )
+        # fp8 KV covers MLA too: the "latent too sensitive" intuition
+        # did not survive measurement — teacher-forced e4m3 round-trip
+        # noise on the full latent+rope cache matches the GQA fp8 path
+        # (rel logit err 0.043 vs 0.042, argmax flip 0.10 vs 0.10;
+        # examples/llm/benchmarks/results/fp8_mla_accuracy.json), and
+        # quantizing only the rope half halves the noise again if a
+        # future accuracy budget wants it. Kernel side: the MLA decode
+        # kernel upcasts after the DMA (its own Mosaic specialization,
+        # probed as "mla_decode_fp8").
         self.kv_dtype = (
             jnp.float8_e4m3fn if config.kv_cache_dtype == "fp8"
             else self.dtype
@@ -136,16 +139,35 @@ class ModelRunner:
             pp=config.pp_size,
         )
         if config.pp_size > 1:
+            from ..models import deepseek as _deepseek
             from ..models import gemma2 as _gemma2
             from ..models import gptoss as _gptoss
             from ..models import mixtral as _mixtral
 
-            if self.arch not in (llama, _mixtral, _gemma2, _gptoss):
+            if self.arch not in (llama, _mixtral, _gemma2, _gptoss,
+                                 _deepseek):
                 raise NotImplementedError(
-                    "pipeline parallelism stages the GQA trunk families "
-                    "(llama-family dense, mixtral MoE, gemma2, gptoss); "
-                    "MLA models: use tp/ep"
+                    "pipeline parallelism stages llama-family dense, "
+                    "mixtral MoE, gemma2, gptoss, and deepseek (MLA)"
                 )
+            if self.arch is _deepseek:
+                # the stage scan holds ONE homogeneous stacked layer
+                # group; a dense prefix (first_k_dense_replace > 0)
+                # would make stage 0's pytree differ from the rest
+                if cfg.num_experts > 0 and cfg.first_k_dense_replace > 0:
+                    raise NotImplementedError(
+                        "MLA over pp requires a homogeneous trunk "
+                        "(first_k_dense_replace == 0): a dense prefix "
+                        "cannot stack into the staged layer scan. Use "
+                        "tp/ep for mixed dense+MoE DeepSeek trunks."
+                    )
+                if config.tp_size > 1:
+                    raise NotImplementedError(
+                        "MLA over pp composes with dp/ep, not tp: the "
+                        "compressed latent cache has a single head, so "
+                        "there is no head axis for the manual-tp stage "
+                        "to shard (MLA tp runs on the GSPMD non-pp path)"
+                    )
             if self.arch is _gptoss and config.tp_size > 1 and (
                 cfg.intermediate_size % config.tp_size
             ):
@@ -891,7 +913,7 @@ class ModelRunner:
             timeout_s = float(os.environ.get("DYN_PALLAS_PROBE_TIMEOUT_S", "180"))
             if not probe_serving_kernels(
                 mla=cfg.kv_lora_rank > 0,
-                windowed=bool(cfg.attn_logit_softcap or cfg.sliding_window),
+                softcap=bool(cfg.attn_logit_softcap),
                 fp8_kv=self.config.kv_cache_dtype == "fp8",
                 sinks=cfg.model_family == "gptoss",
                 timeout_s=timeout_s,

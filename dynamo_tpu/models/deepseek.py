@@ -36,7 +36,15 @@ from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
 from ..ops.attention import lane_pad, scatter_kv_stacked
-from .llama import _swiglu_mlp, apply_rope, base_specs, lm_logits, rms_norm, run_layers
+from .llama import (
+    _swiglu_mlp,
+    apply_rope,
+    base_specs,
+    gather_kv_writes,
+    lm_logits,
+    rms_norm,
+    run_layers,
+)
 from .mixtral import make_moe_mlp_fn
 from .quant import dense
 
@@ -153,33 +161,35 @@ _MLA_ATTN_SPECS = {
 }
 
 
+_DENSE_LAYER_SPECS = {
+    **_MLA_ATTN_SPECS,
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+}
+_MOE_LAYER_SPECS = {
+    **_MLA_ATTN_SPECS,
+    "router": P(),
+    "router_bias": P(),
+    "w_gate": P(None, "ep", None, "tp"),
+    "w_up": P(None, "ep", None, "tp"),
+    "w_down": P(None, "ep", "tp", None),
+    "w_sh_gate": P(None, None, "tp"),
+    "w_sh_up": P(None, None, "tp"),
+    "w_sh_down": P(None, "tp", None),
+}
+
+
 def param_specs(params: Params) -> Dict:
     """Heads shard over tp; latent down-projections + cache replicate;
     experts (if MoE) over ep like models/mixtral.py."""
-    dense_specs = {
-        **_MLA_ATTN_SPECS,
-        "w_gate": P(None, None, "tp"),
-        "w_up": P(None, None, "tp"),
-        "w_down": P(None, "tp", None),
-    }
-    moe_specs = {
-        **_MLA_ATTN_SPECS,
-        "router": P(),
-        "router_bias": P(),
-        "w_gate": P(None, "ep", None, "tp"),
-        "w_up": P(None, "ep", None, "tp"),
-        "w_down": P(None, "ep", "tp", None),
-        "w_sh_gate": P(None, None, "tp"),
-        "w_sh_up": P(None, None, "tp"),
-        "w_sh_down": P(None, "tp", None),
-    }
     specs = base_specs(params)
     if "dense_layers" in params:
         specs["dense_layers"] = {
-            k: dense_specs[k] for k in params["dense_layers"]
+            k: _DENSE_LAYER_SPECS[k] for k in params["dense_layers"]
         }
     if "layers" in params:  # present iff the config is MoE
-        specs["layers"] = {k: moe_specs[k] for k in params["layers"]}
+        specs["layers"] = {k: _MOE_LAYER_SPECS[k] for k in params["layers"]}
     return specs
 
 
@@ -199,8 +209,9 @@ def mla_paged_attention(
     w = block_tables.shape[1]
     t = w * block_size
 
-    c = c_cache[block_tables].reshape(b, t, r)
-    kr = kr_cache[block_tables].reshape(b, t, rd)
+    # upcast from the cache storage dtype (fp8 serving stores e4m3)
+    c = c_cache[block_tables].reshape(b, t, r).astype(q_lat.dtype)
+    kr = kr_cache[block_tables].reshape(b, t, rd).astype(q_lat.dtype)
 
     scores = (
         jnp.einsum("bshr,btr->bsht", q_lat, c)
@@ -305,8 +316,15 @@ def mla_softmax_scale(cfg) -> float:
 
 
 def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
-                     context_lens, mesh=None):
-    """MLA attention block for llama.run_layers."""
+                     context_lens, mesh=None, kv_gather_axis=None):
+    """MLA attention block for llama.run_layers.
+
+    ``kv_gather_axis``: inside a manual shard_map whose batch rows shard
+    over that axis while the latent cache stays replicated across it
+    (the pipelined pp x dp program), every member must apply every
+    member's cache writes — the new latent/rope-key rows and their slots
+    are all-gathered over the axis before the scatter (exactly
+    llama.make_gqa_attn_fn's contract)."""
     h = cfg.num_heads
     nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     scale = mla_softmax_scale(cfg)
@@ -331,8 +349,13 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         )  # [B, S, 1, rd]
 
         # in-place scatter into the stacked caches
+        c_w, kr_w, slots_w = c_kv[:, :, None, :], kr, slot_mapping
+        if kv_gather_axis is not None:
+            c_w, kr_w, slots_w = gather_kv_writes(
+                c_w, kr_w, slot_mapping, kv_gather_axis
+            )
         c_all, kr_all = scatter_kv_stacked(
-            c_all, kr_all, c_kv[:, :, None, :], kr, slot_mapping, li
+            c_all, kr_all, c_w, kr_w, slots_w, li
         )
 
         # absorb W_uk into the query, attend over the latent cache
@@ -346,6 +369,36 @@ def make_mla_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         return delta, c_all, kr_all
 
     return attn_fn
+
+
+def make_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                 context_lens, mesh=None, kv_gather_axis=None,
+                 layer_offset=0, tp_axis=None):
+    """Pipeline attention factory (parallel/pipeline.py family-hook
+    contract, the pattern Gemma-2/GPT-OSS stage through). MLA has no
+    per-layer alternation, so ``layer_offset`` is accepted and ignored;
+    ``tp_axis`` must be None — the latent cache has a single head, so
+    there is no head axis to shard inside a manual-tp stage (MLA tp runs
+    on the GSPMD non-pp path; model_runner guards this)."""
+    del layer_offset
+    if tp_axis is not None:
+        raise NotImplementedError(
+            "MLA under pp composes with dp/ep, not manual tp (the "
+            "compressed latent cache has no head axis to shard)"
+        )
+    return make_mla_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens,
+        mesh=mesh, kv_gather_axis=kv_gather_axis,
+    )
+
+
+def pp_trunk_specs(group: Dict) -> Dict:
+    """Per-leaf tp/ep specs for the ONE homogeneous layer group the
+    pipeline stages (parallel/pipeline.py consults this instead of
+    param_specs because the staged group may be the renamed
+    dense_layers of a non-MoE config)."""
+    table = _MOE_LAYER_SPECS if "router" in group else _DENSE_LAYER_SPECS
+    return {k: table[k] for k in group}
 
 
 def forward(

@@ -252,8 +252,10 @@ def _mla_decode_kernel(
             start(ch + 1, jax.lax.rem(ch + 1, 2))
 
         wait(ch, slot)
-        c = c_buf[slot].reshape(chunk_t, r)
-        kr = kr_buf[slot].reshape(chunk_t, rd)
+        # upcast from the cache storage dtype (fp8 serving stores e4m3;
+        # no-op for bf16) — the score dots need a uniform compute dtype
+        c = c_buf[slot].reshape(chunk_t, r).astype(ql.dtype)
+        kr = kr_buf[slot].reshape(chunk_t, rd).astype(ql.dtype)
 
         key_pos = ch * chunk_t + jax.lax.broadcasted_iota(
             jnp.int32, (1, chunk_t), 1

@@ -145,6 +145,23 @@ def probe_decode_fp8():
     np.asarray(paged_decode_attention(q, k, v, bt, ctx, jnp.asarray(1, jnp.int32)))
 
 
+def probe_mla_decode_fp8():
+    # fp8 latent cache (MLA x fp8 serving): distinct Mosaic
+    # specialization of the MLA decode kernel (upcast after the DMA)
+    from dynamo_tpu.ops.pallas_decode import mla_paged_decode_attention
+
+    l, n, page, r, rd, b, w, h = 2, 16, 16, 128, 128, 2, 4, 4
+    c = jnp.zeros((l, n, page, 1, r), jnp.float8_e4m3fn)
+    kr = jnp.zeros((l, n, page, 1, rd), jnp.float8_e4m3fn)
+    ql = jnp.ones((b, 1, h, r), jnp.bfloat16)
+    qr = jnp.ones((b, 1, h, rd), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    np.asarray(
+        mla_paged_decode_attention(ql, qr, c, kr, bt, ctx, jnp.asarray(1, jnp.int32))
+    )
+
+
 def probe_prefill_fp8():
     from dynamo_tpu.ops.pallas_attention import paged_flash_attention
 
@@ -244,6 +261,7 @@ PROBES = {
     "prefill_sinks": lambda: _probe_prefill_sinks("bfloat16"),
     "prefill_sinks_fp8": lambda: _probe_prefill_sinks("float8_e4m3fn"),
     "mla_decode": probe_mla_decode,
+    "mla_decode_fp8": probe_mla_decode_fp8,
 }
 for kind in sys.argv[1:]:
     PROBES[kind]()
@@ -333,14 +351,14 @@ def probe_kernel(
 
 
 def probe_serving_kernels(
-    mla: bool = False, windowed: bool = False, fp8_kv: bool = False,
+    mla: bool = False, softcap: bool = False, fp8_kv: bool = False,
     sinks: bool = False, timeout_s: float = 180.0,
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
     would compile — the dense engines' decode + flash-prefill kernels
-    (plus the windowed+softcap specializations only when the model config
-    uses them), or ONLY the MLA decode kernel for MLA models (MLA prefill
-    always runs the dense XLA formulation; models/deepseek.py).
+    in the one specialization the model config selects, or ONLY the MLA
+    decode kernel for MLA models (MLA prefill always runs the dense XLA
+    formulation; models/deepseek.py).
 
     True → let auto resolve to pallas. Any hard failure/timeout → False.
     Inconclusive (exclusive-device host) → True with a warning: a child
@@ -348,18 +366,23 @@ def probe_serving_kernels(
     still guards plain failures.
     """
     if mla:
-        kinds = ["mla_decode"]
+        kinds = ["mla_decode_fp8" if fp8_kv else "mla_decode"]
     else:
         # the static specialization keys are (softcap on/off, sinks
-        # on/off, cache dtype) — probe exactly the set this engine's
-        # model config will compile
+        # on/off, cache dtype) — the sliding window is a runtime operand
+        # (pallas_decode: window=None rides as a 2^30 sentinel), so a
+        # window-only model (Mistral/Phi-3) compiles the base pair and a
+        # softcap model (Gemma-2) ONLY the softcap pair. Probing both
+        # pairs for either would waste a subprocess Mosaic compile.
         sfx = "_fp8" if fp8_kv else ""
         if sinks:
             kinds = [f"decode_sinks{sfx}", f"prefill_sinks{sfx}"]
+        elif softcap:
+            # "windowed" probe kinds ARE the softcap specialization
+            # (they compile softcap=50.0 + a window operand)
+            kinds = [f"decode_windowed{sfx}", f"prefill_windowed{sfx}"]
         else:
             kinds = [f"decode{sfx}", f"prefill{sfx}"]
-            if windowed:
-                kinds += [f"decode_windowed{sfx}", f"prefill_windowed{sfx}"]
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

@@ -43,14 +43,26 @@ KVCache = Tuple[jax.Array, jax.Array]
 
 
 def stage_params(params, num_stages: int):
-    """Reshape stacked layer params [L, ...] → [P, L/P, ...] for pp sharding."""
-    l = jax.tree.leaves(params["layers"])[0].shape[0]
+    """Reshape stacked layer params [L, ...] → [P, L/P, ...] for pp sharding.
+
+    The pipeline stages exactly ONE homogeneous layer group. A non-MoE
+    MLA model (models/deepseek.py, num_experts=0) stacks its trunk under
+    "dense_layers" instead of "layers"; it is renamed here — the staged
+    tree is consumed only by pipeline_forward, which addresses the trunk
+    as "layers". Mixed dense+MoE trunks (first_k_dense_replace > 0) are
+    rejected by the engine before staging: XLA's homogeneous stage scan
+    cannot hold two differently-shaped layer pytrees in one stacked
+    stage axis.
+    """
+    key = "layers" if "layers" in params else "dense_layers"
+    l = jax.tree.leaves(params[key])[0].shape[0]
     if l % num_stages:
         raise ValueError(f"{l} layers not divisible by {num_stages} pp stages")
     staged = dict(params)
+    staged.pop("dense_layers", None)
     staged["layers"] = jax.tree.map(
         lambda x: x.reshape(num_stages, l // num_stages, *x.shape[1:]),
-        params["layers"],
+        params[key],
     )
     return staged
 
@@ -85,8 +97,13 @@ def param_specs(params, tp: bool = False, arch=None) -> dict:
         specs["lm_head"] = P(None, "tp") if tp else P()
     # always start from the family's specs so non-tp axes (MoE "ep" on
     # the expert stacks) survive even when tp is off — only the "tp"
-    # names are stripped at tp=1
-    layer_specs = arch.param_specs({"layers": params["layers"]})["layers"]
+    # names are stripped at tp=1. Families whose staged trunk may be a
+    # renamed group (deepseek's dense_layers) provide pp_trunk_specs.
+    trunk_specs = getattr(arch, "pp_trunk_specs", None)
+    if trunk_specs is not None:
+        layer_specs = trunk_specs(params["layers"])
+    else:
+        layer_specs = arch.param_specs({"layers": params["layers"]})["layers"]
 
     def axis(a):
         return None if (a == "tp" and not tp) else a
@@ -268,7 +285,11 @@ def pipeline_forward(
             # alternation) and the manual tp axis (families with
             # replicated additive terms — gptoss's bo/b_down — scale
             # them so the Megatron psum restores each exactly once)
-            tp_ax = "tp" if attn_axes else None
+            # a size-1 tp axis still rides the psum (identity) but is
+            # NOT a manual tp shard — factories that reject or rescale
+            # under manual tp (MLA; gptoss's replicated biases) must
+            # only see a real one
+            tp_ax = "tp" if (attn_axes and tp > 1) else None
             base_attn = make_attn(
                 local_cfg, mb_local, s, pos, slots, tab, ctx, mesh=None,
                 kv_gather_axis="dp" if shard_dp else None,

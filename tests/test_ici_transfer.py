@@ -271,3 +271,92 @@ async def test_ici_recv_timeout_abandons_plane():
         await client.close()
     finally:
         await server.close()
+
+
+_DEATH_WORKER = r"""
+import os, sys, threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from dynamo_tpu.parallel.mesh import MultiHostConfig, initialize_multihost
+
+rank = int(sys.argv[1])
+leader = sys.argv[2]
+initialize_multihost(MultiHostConfig(
+    leader_addr=leader, num_nodes=2, node_rank=rank,
+))
+
+import numpy as np
+import jax.numpy as jnp
+from dynamo_tpu.disagg.ici_transfer import IciKvTransfer
+
+K_SHAPE = (2, 1, 4, 2, 8)
+xfer = IciKvTransfer(
+    (K_SHAPE, K_SHAPE), jnp.float32, sender_rank=1, receiver_rank=0,
+)
+k = np.ones((2, 1, 4, 2, 8), np.float32)
+
+if rank == 1:
+    xfer.send(k, k, seq=7)       # one good pairing proves the plane works
+    print("RANK1_DYING", flush=True)
+    os._exit(1)                  # peer death BEFORE the second entry
+else:
+    k1, v1, seq = xfer.recv(1)
+    assert seq == 7, seq
+    # the sender is now dead; the unpaired recv must not hang this
+    # process forever — bound it the way the serving layer does
+    # (KvTransferServer.ici_recv_timeout_s) and classify the plane dead
+    result = {}
+    def attempt():
+        try:
+            result["r"] = xfer.recv(1)
+        except BaseException as e:
+            result["e"] = type(e).__name__
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout=25.0)
+    if t.is_alive():
+        print("RANK0_OK survivor-bounded-timeout", flush=True)
+        os._exit(0)              # daemon thread still parked in the collective
+    if "e" in result:
+        print("RANK0_OK survivor-error", result["e"], flush=True)
+        os._exit(0)
+    print("RANK0_BAD got data from a dead peer", flush=True)
+    os._exit(1)
+"""
+
+
+def test_peer_death_mid_collective_bounds_the_survivor():
+    """VERDICT r4 item 8: kill one side between paired entries. The
+    survivor must classify the plane dead (error or bounded timeout) —
+    never hang forever, never fabricate data. Recovery above this layer:
+    the server's ici_recv_timeout_s abandons the plane and the request
+    falls back to TCP/local (tests in test_disagg.py)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    leader = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["REPO_ROOT"] = repo
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _DEATH_WORKER, str(rank), leader],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    try:
+        out1, _ = procs[1].communicate(timeout=240)
+        out0, _ = procs[0].communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    assert "RANK1_DYING" in out1
+    assert procs[1].returncode == 1  # died on purpose
+    assert "RANK0_OK" in out0, out0
+    assert procs[0].returncode == 0, out0

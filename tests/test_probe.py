@@ -203,7 +203,10 @@ def test_mla_models_probe_mla_kernel(monkeypatch):
 def test_probe_matrix_matches_engine_compilations(monkeypatch):
     """probe_serving_kernels must request EXACTLY the kernel
     specializations the engine's config will compile — the static keys
-    are (softcap/window on/off, sinks on/off, cache dtype)."""
+    are (softcap on/off, sinks on/off, cache dtype). The sliding window
+    is a runtime operand, never a specialization: a window-only model
+    (Mistral/Phi-3) compiles the base pair, a softcap model (Gemma-2)
+    ONLY the softcap pair — one pair per config, never both."""
     captured = {}
 
     def fake_probe_kernels(kinds, timeout_s=0.0, cwd=None):
@@ -214,18 +217,18 @@ def test_probe_matrix_matches_engine_compilations(monkeypatch):
 
     cases = [
         (dict(), ["decode", "prefill"]),
-        (dict(windowed=True),
-         ["decode", "prefill", "decode_windowed", "prefill_windowed"]),
+        (dict(softcap=True),  # "windowed" kinds ARE the softcap pair
+         ["decode_windowed", "prefill_windowed"]),
         (dict(fp8_kv=True), ["decode_fp8", "prefill_fp8"]),
-        (dict(windowed=True, fp8_kv=True),
-         ["decode_fp8", "prefill_fp8",
-          "decode_windowed_fp8", "prefill_windowed_fp8"]),
+        (dict(softcap=True, fp8_kv=True),
+         ["decode_windowed_fp8", "prefill_windowed_fp8"]),
         (dict(sinks=True), ["decode_sinks", "prefill_sinks"]),
         (dict(sinks=True, fp8_kv=True),
          ["decode_sinks_fp8", "prefill_sinks_fp8"]),
-        (dict(sinks=True, windowed=True),  # gptoss: window rides the
+        (dict(sinks=True, softcap=True),  # gptoss: window rides the
          ["decode_sinks", "prefill_sinks"]),  # sinks specialization
         (dict(mla=True), ["mla_decode"]),
+        (dict(mla=True, fp8_kv=True), ["mla_decode_fp8"]),
     ]
     for kwargs, want in cases:
         assert probe_mod.probe_serving_kernels(**kwargs), kwargs
