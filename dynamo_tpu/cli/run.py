@@ -302,10 +302,16 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         mdc = load_mdc(flags)
         tokenizer = HFTokenizer.from_model_path(flags.model_path)
         core = await build_core_engine(engine_spec, flags, mdc, events, drt=drt)
-        return (
-            build_pipeline([OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core),
-            mdc,
+        pipe = build_pipeline(
+            [OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core
         )
+        if hasattr(core, "metrics"):
+            # surfaced on the frontend's /metrics as engine gauges
+            # (run_http) — slot/KV occupancy, prefix hits, speculation
+            # acceptance; the reference publishes the same counters via
+            # its ForwardPassMetrics plane
+            pipe.engine_metrics = core.metrics
+        return pipe, mdc
 
     raise SystemExit(f"unknown engine {engine_spec!r}")
 
@@ -328,6 +334,12 @@ async def run_http(flags, engine, mdc) -> None:
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
     )
+    if engine is not None and hasattr(engine, "engine_metrics"):
+        # local in-process (or subprocess-hosted) engine: its metrics
+        # ride the frontend's Prometheus surface
+        service.metrics.register_callback_gauges(
+            "dynamo_engine", engine.engine_metrics
+        )
 
     watcher = None
     if flags.store_port is not None:

@@ -89,6 +89,30 @@ class Histogram:
         return lines
 
 
+class _CallbackGauges:
+    """Gauges whose values come from a callback at render time."""
+
+    def __init__(self, prefix: str, fn):
+        self.prefix = prefix
+        self.fn = fn
+
+    def render(self) -> List[str]:
+        lines: List[str] = []
+        try:
+            vals = self.fn() or {}
+            if not isinstance(vals, dict):
+                return []  # BYO engines may return anything
+            for k, v in sorted(vals.items()):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                name = f"{self.prefix}_{k}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {float(v)}")
+        except Exception:
+            return []  # a broken engine must not take /metrics down
+        return lines
+
+
 class ServiceMetrics:
     """The HTTP service's metric set + request timing helper."""
 
@@ -111,6 +135,13 @@ class ServiceMetrics:
 
     def register(self, metric) -> None:
         self._extra.append(metric)
+
+    def register_callback_gauges(self, prefix: str, fn) -> None:
+        """Expose a dict-returning callback (e.g. the in-process
+        engine's ForwardPassMetrics analog — slot/KV occupancy, prefix
+        hit rate, speculation acceptance) as Prometheus gauges, pulled
+        fresh at every /metrics render."""
+        self._extra.append(_CallbackGauges(prefix, fn))
 
     def inflight_total(self) -> float:
         """Sum of in-flight requests across models (graceful-drain gate)."""

@@ -377,3 +377,26 @@ async def test_loadgen_sweep_against_echo_service():
         assert lv["ok"] == 6 and lv["errors"] == 0
         assert lv["req_per_s"] > 0
         assert lv["ttft_p50_ms"] >= 0 and lv["ttft_p95_ms"] >= lv["ttft_p50_ms"]
+
+
+def test_metrics_callback_gauges_render():
+    """Engine metrics registered as callback gauges appear on /metrics
+    renders, pulled fresh each time; a failing callback renders nothing
+    rather than taking the endpoint down."""
+    from dynamo_tpu.http.metrics import ServiceMetrics
+
+    m = ServiceMetrics("dynamo")
+    state = {"kv_active_blocks": 3, "gpu_prefix_cache_hit_rate": 0.5,
+             "spec_accepted_tokens": 7, "label": "not-a-number",
+             "flag": True}
+    m.register_callback_gauges("dynamo_engine", lambda: state)
+    text = m.render()
+    assert "dynamo_engine_kv_active_blocks 3.0" in text
+    assert "dynamo_engine_spec_accepted_tokens 7.0" in text
+    assert "label" not in text and "flag" not in text  # numbers only
+    state["kv_active_blocks"] = 9  # pulled fresh at every render
+    assert "dynamo_engine_kv_active_blocks 9.0" in m.render()
+
+    m2 = ServiceMetrics("dynamo")
+    m2.register_callback_gauges("dynamo_engine", lambda: 1 / 0)
+    assert m2.render()  # endpoint survives a broken engine callback
