@@ -335,20 +335,22 @@ def make_moe_mlp_fn(cfg: ModelConfig, b: int, s: int, slot_mapping: jax.Array,
         )
         y = y.reshape(b, s, -1)
         if "w_sh_gate" in layer_params:
-            if ep_axis is not None:
-                # the caller psums the routed PARTIAL over ep; a shared
-                # expert computed replicated would be multiplied by the
-                # axis size (only the staged-mixtral path sets ep_axis,
-                # and mixtral has no shared experts)
-                raise NotImplementedError(
-                    "shared experts under a manual ep axis"
-                )
             # always-on shared expert(s) alongside the routed ones
             gate = jax.nn.silu(dense(x, layer_params["w_sh_gate"]))
-            y = y + dense(
+            sh = dense(
                 gate * dense(x, layer_params["w_sh_up"]),
                 layer_params["w_sh_down"],
             )
+            if ep_axis is not None:
+                # the caller psums the routed PARTIAL over ep (and tp);
+                # the shared expert's weights replicate across ep, so
+                # every member computes the same contribution — scale by
+                # 1/ep so the joint psum restores it exactly once (the
+                # same trick gptoss uses for its replicated biases under
+                # manual tp). Under tp the w_sh_* columns/rows shard
+                # Megatron-style, so sh is already a genuine tp-partial.
+                sh = sh / lax.axis_size(ep_axis)
+            y = y + sh
         return y
 
     return mlp

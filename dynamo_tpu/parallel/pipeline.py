@@ -54,6 +54,15 @@ def stage_params(params, num_stages: int):
     cannot hold two differently-shaped layer pytrees in one stacked
     stage axis.
     """
+    if "layers" in params and "dense_layers" in params:
+        # staging would silently DROP the dense prefix — a truncated
+        # model with wrong logits; the engine guards this earlier, but
+        # stage_params is public library surface too
+        raise NotImplementedError(
+            "cannot stage a mixed dense+MoE trunk "
+            "(first_k_dense_replace > 0) over pp: the stage scan holds "
+            "one homogeneous layer group"
+        )
     key = "layers" if "layers" in params else "dense_layers"
     l = jax.tree.leaves(params[key])[0].shape[0]
     if l % num_stages:

@@ -568,10 +568,10 @@ def test_pp_stages_mla_trunk():
         moe_intermediate_size=32, n_shared_experts=1,
         first_k_dense_replace=0,
     )
-    # ep axis present (size 1): the expert-stack specs name it; shared
-    # experts keep the manual-ep guard, so expert sharding itself rides
-    # the non-pp GSPMD path for V2/V3-shaped trunks
     parity(moe_mla, {"pp": 2, "ep": 1})
+    # pp x ep with SHARED experts: the replicated shared contribution is
+    # 1/ep-scaled so the joint (ep) psum restores it exactly once
+    parity(moe_mla, {"pp": 2, "ep": 2})
 
 
 def test_model_runner_pp_mla_matches_single_stage():
