@@ -58,6 +58,66 @@ def make_flagship_dir(tmp: str, smoke: bool = False) -> str:
                           config_overrides=overrides)
 
 
+def make_draft_dir(tmp: str, target_dir: str, layers: int,
+                   smoke: bool = False) -> str:
+    """Same-tokenizer quarter-width draft next to the target: the engine
+    requires exact vocab match (engine/serving.build_draft_config)."""
+    import json as _json
+    import shutil
+
+    from fixtures import make_model_dir
+    from __graft_entry__ import FLAGSHIP
+
+    dims = dict(FLAGSHIP)
+    if smoke:
+        dims.update(hidden_size=64, intermediate_size=128,
+                    num_heads=4, num_kv_heads=2, head_dim=16)
+    overrides = {
+        "hidden_size": max(dims["hidden_size"] // 4, 64),
+        "intermediate_size": max(dims["intermediate_size"] // 4, 128),
+        "num_hidden_layers": layers,
+        "num_attention_heads": max(dims["num_heads"] // 4, 2),
+        "num_key_value_heads": max(dims["num_kv_heads"] // 4, 1),
+        "head_dim": dims["head_dim"],
+        "rope_theta": dims["rope_theta"],
+    }
+    with open(os.path.join(target_dir, "config.json")) as f:
+        overrides["vocab_size"] = _json.load(f)["vocab_size"]
+    d = make_model_dir(tmp, name="flagship-draft", context_length=2048,
+                       config_overrides=overrides)
+    # identical tokenizer files (the two must share a tokenizer)
+    for fn in ("tokenizer.json", "tokenizer_config.json"):
+        src = os.path.join(target_dir, fn)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(d, fn))
+    return d
+
+
+async def scrape_spec_metrics(url: str) -> dict:
+    """Pull speculation counters off the frontend's /metrics gauges."""
+    import re
+
+    import aiohttp
+
+    out = {}
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/metrics") as r:
+                text = await r.text()
+        for key in ("spec_proposed_tokens", "spec_accepted_tokens"):
+            m = re.search(rf"^dynamo_engine_{key} ([0-9.eE+-]+)$", text,
+                          re.MULTILINE)
+            if m:
+                out[key] = float(m.group(1))
+    except Exception:
+        pass
+    if out.get("spec_proposed_tokens"):
+        out["acceptance_rate"] = round(
+            out.get("spec_accepted_tokens", 0.0)
+            / out["spec_proposed_tokens"], 4)
+    return out
+
+
 async def wait_ready(url: str, timeout_s: float, server) -> None:
     import aiohttp
 
@@ -99,10 +159,21 @@ def main() -> None:
                          "e.g. --server-arg=--kv-cache-dtype "
                          "--server-arg=fp8) — lets a chip sweep exercise "
                          "any serving lever without editing the harness")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="synthesize a same-tokenizer draft model with "
+                         "this many layers (quarter width) and serve "
+                         "with --spec-draft-model: measures draft-model "
+                         "speculation end to end, acceptance scraped "
+                         "from /metrics (0 = off)")
+    ap.add_argument("--spec-draft-tokens", type=int, default=4)
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="serve_sweep_")
     model_dir = make_flagship_dir(tmp, smoke=args.smoke)
+    draft_dir = None
+    if args.spec_draft_layers:
+        draft_dir = make_draft_dir(
+            tmp, model_dir, layers=args.spec_draft_layers, smoke=args.smoke)
     url = f"http://127.0.0.1:{args.port}"
 
     cmd = [
@@ -118,6 +189,9 @@ def main() -> None:
     ]
     if args.quantization:
         cmd += ["--quantization", args.quantization]
+    if draft_dir is not None:
+        cmd += ["--spec-draft-model", draft_dir,
+                "--spec-draft-tokens", str(args.spec_draft_tokens)]
     cmd += args.server_arg
     env = dict(os.environ)
     if args.smoke:
@@ -147,8 +221,12 @@ def main() -> None:
                 "multi_step_decode": args.multi_step_decode,
                 "quantization": args.quantization,
                 "server_args": args.server_arg,  # the lever under test
+                "spec_draft_layers": args.spec_draft_layers or None,
+                "spec_draft_tokens": (args.spec_draft_tokens
+                                      if args.spec_draft_layers else None),
                 "isl": args.isl, "osl": args.osl,
             },
+            **({"spec": spec_box} if spec_box else {}),
             "sweep_wall_s": round(time.monotonic() - t_ready, 1),
             "levels": levels,
         }
@@ -157,6 +235,7 @@ def main() -> None:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
 
+    spec_box: dict = {}
     try:
         asyncio.run(wait_ready(url, args.warmup_timeout, server))
         t_ready = time.monotonic()
@@ -186,6 +265,8 @@ def main() -> None:
             if lg.returncode != 0:
                 print(f"loadgen c={c} rc={lg.returncode}: "
                       f"{lg.stderr[-500:]}", flush=True)
+            if draft_dir is not None:
+                spec_box.update(asyncio.run(scrape_spec_metrics(url)))
             write_out(t_ready)
         write_out(t_ready)
         print(f"wrote {args.out}", flush=True)
