@@ -430,33 +430,60 @@ async def test_ici_entered_failure_abandons_plane_and_request_completes(
         await drt_d.close()
 
 
-def test_ici_pre_entry_failure_balances_and_keeps_plane():
-    """entered=False: the receiver holds an unpaired entry — the sender
-    pairs it with a poison balancing entry and KEEPS the plane (the
-    redelivered attempt rides ici again). This drives the classification
-    branch of prefill_worker._handle directly."""
-    import jax.numpy as _jnp  # noqa: F401
+async def test_ici_pre_entry_failure_balances_and_keeps_plane(hf_model_dir):
+    """entered=False through the REAL prefill worker: the first attempt
+    fails pre-entry, the worker pairs the orphaned receiver entry with a
+    poison balancing entry and KEEPS the plane; the redelivered attempt
+    rides ici again (payload dropped by the receiver stub, commit
+    nacked) and the decode side's bounded timeout completes the stream
+    locally — identical to baseline."""
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12]
 
-    from dynamo_tpu.disagg.ici_transfer import IciSendError
+    runner_l, econfig_l = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig_l)
+    sched_l.start()
+    er = _greedy_request("base2", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
 
-    k = np.zeros((2, 1, 8, 2, 8), np.float32)
+    import time as _time
 
+    class _RecvDropIci:
+        receiver_rank = 0
+
+        def recv(self, nblocks):
+            _time.sleep(0.05)
+            shp = (econfig_l.model.num_layers, nblocks, 8,
+                   econfig_l.model.num_kv_heads, econfig_l.model.head_dim)
+            z = np.zeros(shp, np.float32)
+            return z, z, -1  # seq never matches a header -> dropped
+
+    hub = MemoryHub()
+    sched, coord, drt_d, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0,
+        max_prefill_queue_size=100, timeout=8.0,
+    )
+    coord._server.ici_recv = _RecvDropIci().recv
+    coord._server.ici_rank = 0
+    runner_p, pconfig = _make_runner(hf_model_dir)
+    drt_p = DistributedRuntime.in_process(hub)
     ici = _PreEntryFailIci(fail_times=1)
-    with pytest.raises(IciSendError) as ei:
-        ici.send(k, k, seq=1)
-    assert ei.value.entered is False
-    # recovery exactly as prefill_worker._handle does it
+    worker = PrefillWorker(drt_p, runner_p, pconfig, ici=ici)
+    worker.queue.visibility = 0.5
+    worker._ici_usable = lambda client: worker.ici is not None
+    worker_task = asyncio.create_task(worker.run())
     try:
-        ici.send(k, k, seq=2)
-    except IciSendError as e:
-        if not e.entered:
-            ici.send_balancing_entry(1)
-    assert ici.balanced == 0  # second send succeeded; no balancing
-
-    ici2 = _PreEntryFailIci(fail_times=2)
-    try:
-        ici2.send(k, k, seq=3)
-    except IciSendError as e:
-        assert not e.entered
-        ici2.send_balancing_entry(1)
-    assert ici2.balanced == 1  # orphaned entry paired with poison
+        er1 = _greedy_request("r-ici-balance", prompt)
+        sched.add_request(er1)
+        out1 = await asyncio.wait_for(_collect(er1), timeout=90)
+        assert out1 == baseline
+        assert ici.balanced == 1      # orphan paired with poison
+        assert worker.ici is ici      # plane KEPT after entered=False
+        assert ici.sends >= 2         # redelivery rode ici again
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await sched.stop()
+        await drt_p.close()
+        await drt_d.close()
