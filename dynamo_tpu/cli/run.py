@@ -156,9 +156,14 @@ def _make_ici(flags, runner):
         return None
     from ..disagg.ici_transfer import IciKvTransfer, kv_block_shapes
 
+    import jax as _jax
+
+    # the cache side may be a {"pre","stg"} pytree (mixed MLA under pp);
+    # every leaf shares one storage dtype
+    kv_dtype = _jax.tree.leaves(runner.kv_cache[0])[0].dtype
     return IciKvTransfer(
         kv_block_shapes(runner.config),
-        runner.kv_cache[0].dtype,
+        kv_dtype,
         sender_rank=flags.ici_sender_rank,
         receiver_rank=flags.ici_receiver_rank,
     )

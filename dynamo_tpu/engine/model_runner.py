@@ -138,6 +138,15 @@ class ModelRunner:
             config.dp_size, config.tp_size, ep=config.ep_size,
             pp=config.pp_size,
         )
+        # mixed dense+MoE MLA trunk under pp: the dense prefix stays
+        # replicated (params, cache, and compute) while the MoE trunk
+        # stages — parallel/pipeline.py's has_prefix path
+        self._pp_prefix_layers = (
+            cfg.first_k_dense_replace
+            if (config.pp_size > 1 and cfg.kv_lora_rank > 0
+                and cfg.num_experts > 0)
+            else 0
+        )
         if config.pp_size > 1:
             from ..models import deepseek as _deepseek
             from ..models import gemma2 as _gemma2
@@ -151,16 +160,6 @@ class ModelRunner:
                     "mixtral MoE, gemma2, gptoss, and deepseek (MLA)"
                 )
             if self.arch is _deepseek:
-                # the stage scan holds ONE homogeneous stacked layer
-                # group; a dense prefix (first_k_dense_replace > 0)
-                # would make stage 0's pytree differ from the rest
-                if cfg.num_experts > 0 and cfg.first_k_dense_replace > 0:
-                    raise NotImplementedError(
-                        "MLA over pp requires a homogeneous trunk "
-                        "(first_k_dense_replace == 0): a dense prefix "
-                        "cannot stack into the staged layer scan. Use "
-                        "tp/ep for mixed dense+MoE DeepSeek trunks."
-                    )
                 if config.tp_size > 1:
                     raise NotImplementedError(
                         "MLA over pp composes with dp/ep, not tp: the "
@@ -179,9 +178,13 @@ class ModelRunner:
                     f"gptoss intermediate_size {cfg.intermediate_size} "
                     f"not divisible by tp {config.tp_size}"
                 )
-            if cfg.num_layers % config.pp_size:
+            # only the STAGED trunk must tile into stages — a mixed MLA
+            # trunk's dense prefix is replicated, not staged (real V3:
+            # 61 layers = 3 dense + 58 staged, pp2-able)
+            staged_layers = cfg.num_layers - self._pp_prefix_layers
+            if staged_layers % config.pp_size:
                 raise ValueError(
-                    f"{cfg.num_layers} layers not divisible by "
+                    f"{staged_layers} staged layers not divisible by "
                     f"pp {config.pp_size}"
                 )
 
@@ -247,6 +250,9 @@ class ModelRunner:
                 pp_mod.CACHE_SPEC_TP if config.tp_size > 1
                 else pp_mod.CACHE_SPEC
             )
+            if self._pp_prefix_layers:
+                # replicated prefix slab + staged trunk slab per side
+                cache_spec = {"pre": P(), "stg": cache_spec}
         else:
             pspecs = self.arch.param_specs(params)
             if cfg.quantization:
@@ -260,7 +266,10 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, P),
         )
 
-        self.cache_sharding = NamedSharding(self.mesh, cache_spec)
+        self.cache_sharding = jax.tree.map(
+            lambda sp: NamedSharding(self.mesh, sp), cache_spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
         self.state_sharding = NamedSharding(self.mesh, P("dp", None))
         self._reinit_device_state()
 
@@ -761,17 +770,28 @@ class ModelRunner:
         # the wire layout is always [L, n, bs, H, D]; a pp-staged cache
         # ([P, L/P, N, ...]) flattens its stage axis at the gather and
         # re-splits at the scatter, so disagg transfer / host offload see
-        # one format regardless of pipeline layout
+        # one format regardless of pipeline layout. Mixed MLA trunks
+        # ({"pre", "stg"} sides) flatten with prefix layers leading —
+        # the same order deepseek.forward runs them.
         staged = self.config.pp_size > 1
+        n_pre = self._pp_prefix_layers
 
         def gather(k_cache, v_cache, ids):
-            if staged:
-                k_cache = k_cache.reshape(-1, *k_cache.shape[2:])
-                v_cache = v_cache.reshape(-1, *v_cache.shape[2:])
-            return (
-                k_cache[:, ids, ..., : true_dims[0]],
-                v_cache[:, ids, ..., : true_dims[1]],
-            )
+            # per-slab indexing: only the GATHERED blocks concatenate,
+            # never the full cache (a {"pre","stg"} concat would move
+            # the whole cache per 64-block bucket)
+            def g(c, dim):
+                if isinstance(c, dict):
+                    stg = c["stg"].reshape(-1, *c["stg"].shape[2:])
+                    return jnp.concatenate(
+                        [c["pre"][:, ids, ..., :dim],
+                         stg[:, ids, ..., :dim]], axis=0,
+                    )
+                if staged:
+                    c = c.reshape(-1, *c.shape[2:])
+                return c[:, ids, ..., :dim]
+
+            return g(k_cache, true_dims[0]), g(v_cache, true_dims[1])
 
         self._gather_jit = jax.jit(
             gather,
@@ -780,22 +800,27 @@ class ModelRunner:
         )
 
         def scatter(k_cache, v_cache, ids, k_blocks, v_blocks):
-            k_blocks = _pad_minor(k_blocks, k_cache.shape[-1])
-            v_blocks = _pad_minor(v_blocks, v_cache.shape[-1])
-            if staged:
-                shape_k, shape_v = k_cache.shape, v_cache.shape
-                k_cache = k_cache.reshape(-1, *shape_k[2:])
-                v_cache = v_cache.reshape(-1, *shape_v[2:])
-                return (
-                    k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype))
-                    .reshape(shape_k),
-                    v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype))
-                    .reshape(shape_v),
-                )
-            return (
-                k_cache.at[:, ids].set(k_blocks.astype(k_cache.dtype)),
-                v_cache.at[:, ids].set(v_blocks.astype(v_cache.dtype)),
-            )
+            def sc(c, blocks):
+                if isinstance(c, dict):
+                    blocks = _pad_minor(blocks, c["pre"].shape[-1])
+                    blocks = blocks.astype(c["pre"].dtype)
+                    stg_shape = c["stg"].shape
+                    stg = c["stg"].reshape(-1, *stg_shape[2:])
+                    return {
+                        # per-slab scatter: blocks split on the layer
+                        # axis (prefix layers lead the wire layout)
+                        "pre": c["pre"].at[:, ids].set(blocks[:n_pre]),
+                        "stg": stg.at[:, ids].set(blocks[n_pre:])
+                        .reshape(stg_shape),
+                    }
+                blocks = _pad_minor(blocks, c.shape[-1]).astype(c.dtype)
+                if staged:
+                    shape = c.shape
+                    c = c.reshape(-1, *shape[2:])
+                    return c.at[:, ids].set(blocks).reshape(shape)
+                return c.at[:, ids].set(blocks)
+
+            return sc(k_cache, k_blocks), sc(v_cache, v_blocks)
 
         self._scatter_jit = jax.jit(
             scatter,
@@ -980,7 +1005,8 @@ class ModelRunner:
         if cfg.pp_size > 1:
             from ..parallel.pipeline import stage_cache
 
-            cache = stage_cache(tuple(cache), cfg.pp_size)
+            cache = stage_cache(tuple(cache), cfg.pp_size,
+                                prefix_layers=self._pp_prefix_layers)
         self.kv_cache = tuple(
             jax.device_put(c, self.cache_sharding) for c in cache
         )

@@ -49,26 +49,27 @@ def stage_params(params, num_stages: int):
     MLA model (models/deepseek.py, num_experts=0) stacks its trunk under
     "dense_layers" instead of "layers"; it is renamed here — the staged
     tree is consumed only by pipeline_forward, which addresses the trunk
-    as "layers". Mixed dense+MoE trunks (first_k_dense_replace > 0) are
-    rejected by the engine before staging: XLA's homogeneous stage scan
-    cannot hold two differently-shaped layer pytrees in one stacked
-    stage axis.
+    as "layers". Mixed dense+MoE trunks (first_k_dense_replace > 0) keep
+    their dense prefix UNstaged under "dense_layers": XLA's homogeneous
+    stage scan cannot hold two differently-shaped layer pytrees, so the
+    (short) prefix replicates to every stage and runs at injection while
+    only the MoE trunk shards over pp.
     """
-    if "layers" in params and "dense_layers" in params:
-        # staging would silently DROP the dense prefix — a truncated
-        # model with wrong logits; the engine guards this earlier, but
-        # stage_params is public library surface too
-        raise NotImplementedError(
-            "cannot stage a mixed dense+MoE trunk "
-            "(first_k_dense_replace > 0) over pp: the stage scan holds "
-            "one homogeneous layer group"
-        )
     key = "layers" if "layers" in params else "dense_layers"
     l = jax.tree.leaves(params[key])[0].shape[0]
     if l % num_stages:
         raise ValueError(f"{l} layers not divisible by {num_stages} pp stages")
     staged = dict(params)
-    staged.pop("dense_layers", None)
+    if key == "layers" and "dense_layers" in params:
+        # mixed dense+MoE trunk (DeepSeek first_k_dense_replace > 0):
+        # the stage scan cannot stack two differently-shaped layer
+        # pytrees, so the (short) dense prefix stays UNstaged — it is
+        # kept under "dense_layers", replicated to every stage, and
+        # computed redundantly at injection (pipeline_forward); only
+        # the homogeneous MoE trunk shards over pp.
+        pass
+    else:
+        staged.pop("dense_layers", None)
     staged["layers"] = jax.tree.map(
         lambda x: x.reshape(num_stages, l // num_stages, *x.shape[1:]),
         params[key],
@@ -76,8 +77,14 @@ def stage_params(params, num_stages: int):
     return staged
 
 
-def stage_cache(kv_cache: KVCache, num_stages: int) -> KVCache:
-    """[L, N, bs, KVH, D] → [P, L/P, N, bs, KVH, D] (stage-local slabs)."""
+def stage_cache(kv_cache: KVCache, num_stages: int,
+                prefix_layers: int = 0) -> KVCache:
+    """[L, N, bs, KVH, D] → [P, L/P, N, bs, KVH, D] (stage-local slabs).
+
+    ``prefix_layers`` > 0 (mixed dense+MoE MLA trunks): the first k
+    layers belong to the replicated dense prefix — each side becomes
+    ``{"pre": [k, ...] replicated, "stg": [P, (L-k)/P, ...] staged}``.
+    """
     def split(c):
         l = c.shape[0]
         if l % num_stages:
@@ -86,11 +93,24 @@ def stage_cache(kv_cache: KVCache, num_stages: int) -> KVCache:
             )
         return c.reshape(num_stages, l // num_stages, *c.shape[1:])
 
+    if prefix_layers:
+        return tuple(
+            {"pre": c[:prefix_layers], "stg": split(c[prefix_layers:])}
+            for c in kv_cache
+        )
     return tuple(split(c) for c in kv_cache)
 
 
 def unstage_cache(kv_cache: KVCache) -> KVCache:
-    return tuple(c.reshape(-1, *c.shape[2:]) for c in kv_cache)
+    """Inverse of stage_cache: back to the wire layout [L, ...] with
+    prefix layers (if any) leading."""
+    def flat(c):
+        if isinstance(c, dict):
+            stg = c["stg"].reshape(-1, *c["stg"].shape[2:])
+            return jnp.concatenate([c["pre"], stg], axis=0)
+        return c.reshape(-1, *c.shape[2:])
+
+    return tuple(flat(c) for c in kv_cache)
 
 
 def param_specs(params, tp: bool = False, arch=None) -> dict:
@@ -120,6 +140,17 @@ def param_specs(params, tp: bool = False, arch=None) -> dict:
     specs["layers"] = {
         k: P("pp", *(axis(a) for a in s)) for k, s in layer_specs.items()
     }
+    if "dense_layers" in params:
+        # replicated dense prefix (mixed MLA trunk): every stage holds
+        # and computes it; its tp axes strip (MLA pp requires tp=1)
+        prefix_specs = (trunk_specs(params["dense_layers"])
+                        if trunk_specs is not None
+                        else arch.param_specs(
+                            {"dense_layers": params["dense_layers"]}
+                        )["dense_layers"])
+        specs["dense_layers"] = {
+            k: P(*(axis(a) for a in s)) for k, s in prefix_specs.items()
+        }
     # int8 serving: QuantizedWeight leaves need mirrored spec NODES (the
     # scale is one rank lower than q) — both for device_put and for the
     # shard_map in_specs below
@@ -245,15 +276,24 @@ def pipeline_forward(
     attn_axes = ("tp",) if "tp" in mesh.axis_names else ()
     mlp_axes = attn_axes + (("ep",) if ep > 1 else ())
 
+    # mixed dense+MoE MLA trunk: a replicated dense prefix rides beside
+    # the staged trunk — its params/cache replicate to every stage and
+    # the prefix compute runs redundantly at injection (the prefix is a
+    # few layers of sixty-plus; redundancy beats heterogeneous staging)
+    has_prefix = "dense_layers" in params
+    side_spec = (
+        {"pre": P(), "stg": cache_spec} if has_prefix else cache_spec
+    )
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(
             param_specs(params, tp=tp > 1, arch=arch),
-            (cache_spec, cache_spec),
+            (side_spec, side_spec),
             batch_spec, batch_spec, batch_spec, batch_spec, batch_spec,
         ),
-        out_specs=(batch_spec, (cache_spec, cache_spec)),
+        out_specs=(batch_spec, (side_spec, side_spec)),
         check_vma=False,
     )
     def run(params, kv_cache, tokens_mb, positions_mb, tables_mb, slots_mb, ctx_mb):
@@ -263,13 +303,18 @@ def pipeline_forward(
         # shard_map gives the local block with a leading singleton stage dim
         local_layers = jax.tree.map(lambda x: x[0], params["layers"])
         layers_per_stage = jax.tree.leaves(local_layers)[0].shape[0]
-        k_local, v_local = kv_cache[0][0], kv_cache[1][0]
+        if has_prefix:
+            k_pre, v_pre = kv_cache[0]["pre"], kv_cache[1]["pre"]
+            k_local, v_local = kv_cache[0]["stg"][0], kv_cache[1]["stg"][0]
+        else:
+            k_pre = v_pre = None
+            k_local, v_local = kv_cache[0][0], kv_cache[1][0]
 
         d_model = cfg.hidden_size
         ticks = m + num_stages - 1
 
         def tick(t, carry):
-            x_state, k_local, v_local, outputs = carry
+            x_state, k_local, v_local, k_pre, v_pre, outputs = carry
             # which microbatch does THIS stage hold at tick t?
             mb_idx = jnp.clip(t - stage, 0, m - 1)
             valid = jnp.logical_and(t - stage >= 0, t - stage < m)
@@ -280,14 +325,30 @@ def pipeline_forward(
             slots = lax.dynamic_index_in_dim(slots_mb, mb_idx, 0, keepdims=False)
             ctx = lax.dynamic_index_in_dim(ctx_mb, mb_idx, 0, keepdims=False)
 
-            # stage 0 injects the embedded microbatch; others use the
-            # activations ppermuted in at the end of the previous tick
-            injected = embed_fn(params, tok)
-            x_in = jnp.where(is_first, injected, x_state)
-
             # invalid (warm-up/drain) ticks must not write KV: the drop
             # sentinel routes their scatter out of range
             slots = jnp.where(valid, slots, -1)
+
+            # stage 0 injects the embedded microbatch; others use the
+            # activations ppermuted in at the end of the previous tick.
+            # With a dense prefix, injection = embed + the replicated
+            # prefix layers: every stage computes its current
+            # microbatch's prefix identically (writes land on disjoint
+            # slots, so the replicated caches converge regardless of
+            # tick order) and discards the result unless it is stage 0.
+            injected = embed_fn(params, tok)
+            if has_prefix:
+                pre_attn = make_attn(
+                    local_cfg, mb_local, s, pos, slots, tab, ctx,
+                    mesh=None,
+                    kv_gather_axis="dp" if shard_dp else None,
+                    layer_offset=0, tp_axis=None,
+                )
+                injected, (k_pre, v_pre), _ = run_layers_fn(
+                    injected, (k_pre, v_pre), params["dense_layers"],
+                    cfg, pre_attn, llama._swiglu_mlp,
+                )
+            x_in = jnp.where(is_first, injected, x_state)
 
             # layer_offset and tp_axis are part of the factory contract:
             # the stage's first GLOBAL layer index (gemma2/gptoss window
@@ -345,18 +406,21 @@ def pipeline_forward(
                 hidden, "pp",
                 [(i, (i + 1) % num_stages) for i in range(num_stages)],
             )
-            return x_state, k_local, v_local, outputs
+            return x_state, k_local, v_local, k_pre, v_pre, outputs
 
         x0 = jnp.zeros((mb_local, s, d_model), params["embed"].dtype)
         out0 = jnp.zeros((m, mb_local, s, d_model), params["embed"].dtype)
-        x_state, k_local, v_local, outputs = lax.fori_loop(
-            0, ticks, tick, (x0, k_local, v_local, out0)
+        x_state, k_local, v_local, k_pre, v_pre, outputs = lax.fori_loop(
+            0, ticks, tick, (x0, k_local, v_local, k_pre, v_pre, out0)
         )
 
         # only the last stage holds real outputs; psum broadcasts them
         outputs = lax.psum(
             jnp.where(is_last, outputs, jnp.zeros_like(outputs)), "pp"
         )
+        if has_prefix:
+            return outputs, ({"pre": k_pre, "stg": k_local[None]},
+                             {"pre": v_pre, "stg": v_local[None]})
         return outputs, (k_local[None], v_local[None])
 
     outputs, kv_cache = run(

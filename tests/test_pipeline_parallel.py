@@ -250,20 +250,18 @@ def test_pp_rejects_unsupported_configs():
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.model_runner import ModelRunner
 
-    # MLA stages over pp now (homogeneous trunks) — but a mixed
-    # dense+MoE trunk cannot stack into the homogeneous stage scan
-    mla_mixed = ModelConfig(
+    # MLA stages over pp (replicated dense prefix + staged MoE trunk) —
+    # but manual tp inside a stage has no latent head axis to shard
+    mla = ModelConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=4,
         num_heads=4, num_kv_heads=4, head_dim=16, kv_lora_rank=16,
         qk_rope_head_dim=8, qk_nope_head_dim=12, v_head_dim=12,
-        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
-        first_k_dense_replace=1,
     )
-    with pytest.raises(NotImplementedError, match="homogeneous"):
+    with pytest.raises(NotImplementedError, match="not tp"):
         ModelRunner(EngineConfig(
-            model=mla_mixed, max_batch_size=2, max_model_len=32,
+            model=mla, max_batch_size=2, max_model_len=32,
             kv_block_size=8, num_kv_blocks=16, dtype="float32", pp_size=2,
-            allow_random_weights=True,
+            tp_size=2, allow_random_weights=True,
         ))
     with pytest.raises(ValueError):
         ModelRunner(EngineConfig(
@@ -538,8 +536,9 @@ def test_pp_stages_mla_trunk():
             params, mcfg, tokens, positions, kv, btab, slots, ctx)
 
         pp = mesh.shape["pp"]
+        n_pre = mcfg.first_k_dense_replace if mcfg.num_experts else 0
         staged = stage_params(params, pp)
-        staged_kv = stage_cache(tuple(kv), pp)
+        staged_kv = stage_cache(tuple(kv), pp, prefix_layers=n_pre)
         got_logits, got_kv = pipeline_forward(
             staged, mcfg, tokens, positions, staged_kv, btab, slots, ctx,
             mesh, arch=deepseek,
@@ -572,6 +571,16 @@ def test_pp_stages_mla_trunk():
     # pp x ep with SHARED experts: the replicated shared contribution is
     # 1/ep-scaled so the joint (ep) psum restores it exactly once
     parity(moe_mla, {"pp": 2, "ep": 2})
+
+    # the REAL V2/V3 trunk layout: dense prefix + MoE trunk. The prefix
+    # cannot stack into the stage scan, so it runs REPLICATED (params,
+    # cache, compute) at injection while the MoE trunk stages — exact
+    # parity including both cache groups.
+    import dataclasses as _dc
+
+    mixed_mla = _dc.replace(moe_mla, num_layers=6, first_k_dense_replace=2)
+    parity(mixed_mla, {"pp": 2, "ep": 1})
+    parity(mixed_mla, {"pp": 2, "ep": 1, "dp": 2})  # prefix writes gather over dp
 
 
 def test_model_runner_pp_mla_matches_single_stage():
@@ -627,16 +636,59 @@ def test_model_runner_pp_mla_matches_single_stage():
     got = run_steps(cfg_for(2))
     np.testing.assert_array_equal(got, ref)
 
-    # guards: manual tp and mixed dense+MoE trunks reject loudly
+    # guard: manual tp rejects loudly (no latent head axis to shard)
     with pytest.raises(NotImplementedError, match="not tp"):
         ModelRunner(cfg_for(2, tp=2), params=params)
+
+    # the real V2/V3 layout (dense prefix + MoE trunk) serves through
+    # the engine: replicated prefix + staged trunk, same sampled tokens
     import dataclasses
 
     mixed = dataclasses.replace(
-        mcfg, num_experts=4, num_experts_per_tok=2,
-        moe_intermediate_size=32, first_k_dense_replace=1,
+        mcfg, num_layers=6, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=32, n_shared_experts=1,
+        first_k_dense_replace=2,
     )
     mixed_params = deepseek.init_params(mixed, jax.random.PRNGKey(1),
                                         jnp.float32)
-    with pytest.raises(NotImplementedError, match="homogeneous"):
-        ModelRunner(cfg_for(2, model=mixed), params=mixed_params)
+
+    def run_mixed(pp):
+        runner = ModelRunner(cfg_for(pp, model=mixed), params=mixed_params)
+        b, s, bs = 4, 8, 8
+        rng = np.random.default_rng(11)
+        tokens = rng.integers(0, mixed.vocab_size, (b, s)).astype(np.int32)
+        positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+        w = runner.config.blocks_per_seq
+        btab = np.zeros((b, w), np.int32)
+        for i in range(b):
+            btab[i, : s // bs] = np.arange(i * (s // bs), (i + 1) * (s // bs))
+        slots = np.take_along_axis(
+            btab, positions // bs, axis=1
+        ) * bs + positions % bs
+        out1, *_ = runner.step(
+            tokens, positions, btab, slots, np.full(b, s, np.int32),
+            np.full(b, s - 1, np.int32), np.zeros(b, np.float32),
+            np.zeros(b, np.int32), np.ones(b, np.float32),
+            jax.random.PRNGKey(12),
+        )
+        return np.asarray(out1)
+
+    np.testing.assert_array_equal(run_mixed(2), run_mixed(1))
+
+    # V3-shaped layer arithmetic: TOTAL layers need not divide by pp —
+    # only the staged trunk (61 = 3 dense + 58 staged in the real
+    # checkpoint; here 7 = 3 + 4). And the wire-layout block ops
+    # round-trip through the mixed {"pre","stg"} cache.
+    odd = dataclasses.replace(mixed, num_layers=7, first_k_dense_replace=3)
+    odd_params = deepseek.init_params(odd, jax.random.PRNGKey(13),
+                                      jnp.float32)
+    runner = ModelRunner(cfg_for(2, model=odd), params=odd_params)
+    rng = np.random.default_rng(14)
+    blocks_k = rng.standard_normal(
+        (7, 3, 8, 1, odd.kv_lora_rank)).astype(np.float32)
+    blocks_v = rng.standard_normal(
+        (7, 3, 8, 1, odd.qk_rope_head_dim)).astype(np.float32)
+    runner.scatter_blocks([2, 5, 9], blocks_k, blocks_v)
+    k_got, v_got = runner.gather_blocks([2, 5, 9])
+    np.testing.assert_allclose(np.asarray(k_got), blocks_k, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_got), blocks_v, rtol=1e-6)
