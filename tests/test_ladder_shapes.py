@@ -44,6 +44,10 @@ LADDER = {
         num_experts=256, num_experts_per_tok=8,
         moe_intermediate_size=2048, first_k_dense_replace=3,
         n_shared_experts=1,
+        # the real checkpoint's routing semantics (config.json):
+        # sigmoid scoring + noaux_tc group-limited top-k over 8 groups
+        moe_scoring_func="sigmoid", norm_topk_prob=True,
+        routed_scaling_factor=2.5, n_group=8, topk_group=4,
     ),
     "gpt-oss-20b": ModelConfig(
         vocab_size=201088, hidden_size=2880, intermediate_size=2880,
@@ -166,3 +170,38 @@ def test_llama70b_tp8_step_lowers_with_real_shardings():
     )
     text = lowered.as_text()
     assert "stablehlo" in text or "mhlo" in text or "module" in text
+
+
+def test_deepseek_r1_hf_config_parses():
+    """The north-star rung (BASELINE configs[4], DeepSeek-R1 671B): the
+    REAL config.json keys — group-limited routing included, the round-4
+    blocker — parse into a servable ModelConfig."""
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["DeepseekV3ForCausalLM"],
+        "vocab_size": 129280, "hidden_size": 7168,
+        "intermediate_size": 18432, "num_hidden_layers": 61,
+        "num_attention_heads": 128, "num_key_value_heads": 128,
+        "kv_lora_rank": 512, "q_lora_rank": 1536,
+        "qk_nope_head_dim": 128, "qk_rope_head_dim": 64,
+        "v_head_dim": 128,
+        "n_routed_experts": 256, "num_experts_per_tok": 8,
+        "moe_intermediate_size": 2048, "first_k_dense_replace": 3,
+        "n_shared_experts": 1,
+        "scoring_func": "sigmoid", "norm_topk_prob": True,
+        "routed_scaling_factor": 2.5, "n_group": 8, "topk_group": 4,
+        "topk_method": "noaux_tc",
+        "rope_theta": 10000.0, "max_position_embeddings": 163840,
+        "rms_norm_eps": 1e-6,
+    })
+    # the parsed config must MATCH the ladder's trace config — one
+    # source of truth for what "DeepSeek-R1 at real dims" means
+    want = LADDER["deepseek-r1"]
+    for field in ("vocab_size", "hidden_size", "intermediate_size",
+                  "num_layers", "num_heads", "kv_lora_rank",
+                  "q_lora_rank", "qk_nope_head_dim", "qk_rope_head_dim",
+                  "v_head_dim", "num_experts", "num_experts_per_tok",
+                  "moe_intermediate_size", "first_k_dense_replace",
+                  "n_shared_experts", "moe_scoring_func",
+                  "norm_topk_prob", "routed_scaling_factor", "n_group",
+                  "topk_group"):
+        assert getattr(cfg, field) == getattr(want, field), field
