@@ -189,3 +189,92 @@ async def test_prompt_burst_ttft_bounded_by_batched_prefill():
     # generous absolute bound: the whole burst's first tokens arrive
     # promptly (serial prefill queued them linearly)
     assert p95_b < 30.0, p95_b
+
+
+@pytest.mark.asyncio
+async def test_soak_engine_mixed_guided_traffic():
+    """Engine-level soak: concurrent guided-JSON, guided-choice, plain
+    sampled, and mid-stream-cancelled requests share one scheduler.
+    Every stream must terminate with a coherent finish (or clean
+    cancellation), every finished guided-JSON stream must parse, and
+    the engine must stay serviceable afterwards."""
+    import json as _json
+
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.serving import JaxServingEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import AsyncEngineContext
+
+    CFG = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, attention_impl="xla",
+    )
+    econfig = EngineConfig(
+        model=CFG, max_batch_size=4, max_model_len=64, kv_block_size=8,
+        num_kv_blocks=48, dtype="float32", prefill_buckets=[16],
+        allow_random_weights=True,
+    )
+    mdc = ModelDeploymentCard(display_name="t", slug="t")
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jax.numpy.float32)
+    engine = await JaxServingEngine.create(
+        mdc, engine_config=econfig, params=params, warmup=False)
+    # synthetic piece table (see test_guided.py PIECES rationale)
+    pieces = [None] * 128
+    for i, sym in enumerate(
+            ['{', '}', '[', ']', '"', ':', ',', ' ', '-', '0', '1', '7',
+             'a', 'b', 'true', 'null', '{"', '":', '", "', '2.5']):
+        pieces[i + 2] = sym
+    engine._pieces = pieces
+    engine._model_path = "<injected>"
+
+    async def one(i: int):
+        kind = i % 4
+        so = SamplingOptions(temperature=0.8, seed=i)
+        if kind == 0:
+            so = SamplingOptions(temperature=0.0,
+                                 guided_json={"type": "json_object"})
+        elif kind == 1:
+            so = SamplingOptions(temperature=1.2, seed=i,
+                                 guided_choice_token_ids=[[5, 9], [7]])
+        req = PreprocessedRequest(
+            token_ids=[1 + (i % 7), 17, 43],
+            stop_conditions=StopConditions(max_tokens=12, ignore_eos=True),
+            sampling_options=so,
+        )
+        ctx = AsyncEngineContext(f"soak-{i}")
+        toks, finish = [], None
+        n = 0
+        async for out in engine.generate(Context(req, ctx)):
+            toks.extend(out["token_ids"])
+            if out.get("finish_reason"):
+                finish = out["finish_reason"]
+            n += 1
+            if kind == 3 and n == 2:
+                ctx.stop_generating()  # mid-stream cancellation
+        if kind == 0 and finish == "stop":
+            text = "".join(pieces[t] for t in toks)
+            assert isinstance(_json.loads(text), dict), text
+        if kind == 1 and finish == "stop":
+            assert toks in ([5, 9], [7])
+        if kind != 3:
+            assert finish in ("stop", "length"), (kind, finish)
+        return finish
+
+    try:
+        for wave in range(4):
+            results = await asyncio.gather(
+                *[one(wave * 12 + j) for j in range(12)])
+            assert len(results) == 12
+        # still serviceable after the soak
+        final = await one(1000)  # kind 0: guided json
+        assert final in ("stop", "length")
+    finally:
+        await engine.close()
