@@ -61,7 +61,9 @@ class ModelConfig:
     attention_impl: str = "auto"
     # serving-time weight quantization: None (checkpoint dtype) or "int8"
     # (per-out-channel weight-only; halves the decode weight stream —
-    # models/quant.py). Llama-family trunks only for now.
+    # models/quant.py QUANT_KEYS: llama-family trunks, MoE expert
+    # stacks incl. GPT-OSS fused gate/up, DeepSeek shared experts and
+    # MLA low-rank projections).
     quantization: Optional[str] = None
     # Gemma-2 family (models/gemma2.py): sandwich norms, GeGLU, logit
     # softcapping, alternating sliding-window attention. model_family
