@@ -2,23 +2,48 @@
 
 Reference analog: launch/dynamo-run/src/input/batch.rs. Each line is
 {"text": ...} or a full chat request; writes JSONL results with latency and
-token counts to stdout (or --output).
+token counts to stdout (or --output). Per-request records carry TTFT,
+inter-token latency (mean/p99), and total duration; a final aggregate
+summary goes to stderr so result streams stay machine-parseable.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
+from typing import List
 
 from ..protocols.annotated import Annotated
 from ..protocols.openai import ChatCompletionRequest
 from ..runtime.engine import Context
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def itl_stats(gaps: List[float]) -> dict:
+    """Gaps between consecutive emissions → {mean, p99} (0.0 when a
+    request produced fewer than two chunks)."""
+    if not gaps:
+        return {"itl_mean_s": 0.0, "itl_p99_s": 0.0}
+    return {
+        "itl_mean_s": round(sum(gaps) / len(gaps), 4),
+        "itl_p99_s": round(_percentile(sorted(gaps), 0.99), 4),
+    }
+
+
 async def run_batch(flags, engine, mdc, path: str) -> None:
     name = flags.model_name or (mdc.display_name if mdc else "echo")
     with open(path) as f:
         lines = [json.loads(line) for line in f if line.strip()]
+    ttfts: List[float] = []
+    all_gaps: List[float] = []
     for i, entry in enumerate(lines):
         if "messages" in entry:
             req = ChatCompletionRequest.model_validate({"model": name, **entry})
@@ -30,6 +55,8 @@ async def run_batch(flags, engine, mdc, path: str) -> None:
             )
         start = time.monotonic()
         first = None
+        last_emit = None
+        gaps: List[float] = []
         parts = []
         async for chunk in engine.generate(Context(req)):
             if Annotated.maybe_from_wire(chunk) is not None:
@@ -38,17 +65,33 @@ async def run_batch(flags, engine, mdc, path: str) -> None:
             for choice in d.get("choices", []):
                 content = (choice.get("delta") or {}).get("content")
                 if content:
+                    now = time.monotonic()
                     if first is None:
-                        first = time.monotonic() - start
+                        first = now - start
+                    else:
+                        gaps.append(now - last_emit)
+                    last_emit = now
                     parts.append(content)
+        if first is not None:
+            ttfts.append(first)
+        all_gaps.extend(gaps)
         print(
             json.dumps(
                 {
                     "index": i,
                     "output": "".join(parts),
                     "ttft_s": round(first or 0.0, 4),
+                    **itl_stats(gaps),
                     "total_s": round(time.monotonic() - start, 4),
                 }
             ),
             flush=True,
         )
+    if lines:
+        summary = {
+            "requests": len(lines),
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4) if ttfts else 0.0,
+            "ttft_p99_s": round(_percentile(sorted(ttfts), 0.99), 4),
+            **itl_stats(all_gaps),
+        }
+        print(f"batch summary: {json.dumps(summary)}", file=sys.stderr, flush=True)

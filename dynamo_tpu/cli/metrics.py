@@ -49,8 +49,13 @@ class MetricsAggregator:
             f: Gauge(f"{prefix}_worker_{f}", f"worker {f} (scraped)")
             for f in _FPM_FIELDS
         }
-        self.inflight = Gauge(f"{prefix}_worker_inflight", "in-flight requests")
-        self.requests_total = Gauge(
+        self.inflight = Gauge(
+            f"{prefix}_worker_inflight_requests", "in-flight requests"
+        )
+        # a scraped snapshot of the worker's monotonic request counter —
+        # exposed as TYPE counter (values are set, not incremented, each
+        # scrape; the federation pattern)
+        self.requests_total = Counter(
             f"{prefix}_worker_requests_total", "requests handled (scraped)"
         )
         self.kv_hit_events = Counter(
@@ -83,7 +88,9 @@ class MetricsAggregator:
             }
         for iid, s in stats.items():
             self.inflight.set(float(s.get("inflight", 0)), instance=iid)
-            self.requests_total.set(float(s.get("requests_total", 0)), instance=iid)
+            self.requests_total.set_sample(
+                float(s.get("requests_total", 0)), instance=iid
+            )
             data = s.get("data")
             if data:
                 fpm = ForwardPassMetrics.from_wire(data)

@@ -146,6 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profiler-port", type=int, default=0,
                    help="start the jax profiler gRPC server on this port "
                         "(TensorBoard remote capture; any role)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="dyn:// roles: serve this process's Prometheus "
+                        "registry on a sidecar GET /metrics port (the "
+                        "router's per-worker load view, a token-level "
+                        "worker's scheduler/KV instruments; 0 = off — "
+                        "in=http exposes /metrics on the service port)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -316,6 +322,11 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
             # acceptance; the reference publishes the same counters via
             # its ForwardPassMetrics plane
             pipe.engine_metrics = core.metrics
+        if getattr(core, "registry", None) is not None:
+            # in-process jax engine: its full instrument set (scheduler
+            # step/phase histograms, KV counters, disagg RTT) merges into
+            # the frontend's exposition instead of the dict-gauge fallback
+            pipe.telemetry_registry = core.registry
         return pipe, mdc
 
     raise SystemExit(f"unknown engine {engine_spec!r}")
@@ -339,9 +350,13 @@ async def run_http(flags, engine, mdc) -> None:
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
     )
-    if engine is not None and hasattr(engine, "engine_metrics"):
-        # local in-process (or subprocess-hosted) engine: its metrics
-        # ride the frontend's Prometheus surface
+    if getattr(engine, "telemetry_registry", None) is not None:
+        # in-process engine: one registry, one exposition — HTTP,
+        # scheduler, KV allocator, and disagg instruments in one scrape
+        service.metrics.attach_registry(engine.telemetry_registry)
+    elif engine is not None and hasattr(engine, "engine_metrics"):
+        # subprocess-hosted / BYO engine: metrics cross the process
+        # boundary as a dict — expose them as callback gauges
         service.metrics.register_callback_gauges(
             "dynamo_engine", engine.engine_metrics
         )
@@ -438,6 +453,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
     from ..http.service import parse_endpoint_path, register_model
     from ..runtime.component import DistributedRuntime
     from ..runtime.engine import Context
+    from ..telemetry.server import maybe_start_metrics_server
 
     if flags.store_port is None:
         raise SystemExit("in=dyn:// requires --store-port")
@@ -446,6 +462,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
     ns_name, comp, ep_name = parse_endpoint_path(path)
     drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
     endpoint = drt.namespace(ns_name).component(comp).endpoint(ep_name)
+    mserver = None  # sidecar /metrics exposition (--metrics-port)
 
     def make_openai_handler(engine):
         async def handler(payload, ctx):
@@ -488,6 +505,12 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         name = flags.model_name or mdc.display_name
         await register_model(drt, flags.namespace, name, path, model_type="both",
                              mdc={"context_length": mdc.context_length})
+        if router is not None:
+            # the router's own observability surface: per-worker scraped
+            # load + routing decisions, previously internal-only
+            mserver = await maybe_start_metrics_server(
+                router.registry, flags.metrics_port
+            )
         print(f"processor serving {path} (model={name} → {flags.worker_endpoint})", flush=True)
 
     elif flags.token_level:
@@ -511,6 +534,11 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             instance_id=instance_id,
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
         )
+        # in-process jax engines carry the full scheduler/KV registry;
+        # workers with no registry (echo, BYO) just skip the sidecar
+        mserver = await maybe_start_metrics_server(
+            getattr(core, "registry", None), flags.metrics_port
+        )
         print(f"token-level worker {instance_id} serving {path}", flush=True)
 
     else:
@@ -522,11 +550,16 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             drt, flags.namespace, name, path, model_type=model_type,
             mdc={"context_length": mdc.context_length} if mdc else None,
         )
+        mserver = await maybe_start_metrics_server(
+            getattr(engine, "telemetry_registry", None), flags.metrics_port
+        )
         print(f"worker serving {path} (model={name})", flush=True)
 
     try:
         await asyncio.Event().wait()
     finally:
+        if mserver is not None:
+            await mserver.stop()
         await serving.stop()
 
 

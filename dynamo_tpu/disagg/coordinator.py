@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import uuid
 from typing import Dict, Optional, Sequence
 
 import msgpack
 import numpy as np
 
+from ..telemetry.registry import MetricsRegistry
 from .protocols import PrefillQueue, RemotePrefillRequest
 from .router import DisaggRouter
 from .transfer import KvTransferServer, transfer_key
@@ -61,9 +63,31 @@ class RemotePrefillCoordinator:
         self._queue_depth = 0
         self._depth_refresh_s = depth_refresh_s
         self._depth_task: Optional[asyncio.Task] = None
-        # telemetry
+        # telemetry — the registry is attached to the scheduler's, so
+        # these render in the engine's unified /metrics exposition
         self.remote_submitted = 0
         self.remote_completed = 0
+        self._submit_t: Dict[str, float] = {}  # request id → submit time
+        self.registry = MetricsRegistry()
+        self._rtt_hist = self.registry.histogram(
+            "dynamo_disagg_remote_prefill_duration_seconds",
+            "Remote prefill round trip: queue submit → first-token commit",
+        )
+        self._failures = self.registry.counter(
+            "dynamo_disagg_remote_prefill_failures_total",
+            "Remote prefills that never committed, by reason="
+            "submit|timeout|cancelled",
+        )
+        self.registry.callback_gauge(
+            "dynamo_disagg_pending_requests",
+            "Remote prefills submitted and not yet committed",
+            lambda: len(self._pending),
+        )
+        self.registry.callback_gauge(
+            "dynamo_disagg_queue_depth_requests",
+            "Prefill work-queue depth (cached; refreshed periodically)",
+            lambda: self._queue_depth,
+        )
 
     # ---------- lifecycle ----------
 
@@ -111,7 +135,8 @@ class RemotePrefillCoordinator:
                      seed: Optional[int] = None,
                      want_logprobs: bool = False,
                      logprobs_n: int = 0,
-                     logit_bias: Optional[dict] = None) -> asyncio.Future:
+                     logit_bias: Optional[dict] = None,
+                     trace_id: str = "") -> asyncio.Future:
         """Enqueue the prompt; returns a future → (first_token, logprob)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
@@ -127,20 +152,24 @@ class RemotePrefillCoordinator:
                 frequency_penalty=frequency_penalty,
                 repetition_penalty=repetition_penalty, seed=seed,
                 want_logprobs=want_logprobs, logprobs_n=logprobs_n,
-                logit_bias=logit_bias,
+                logit_bias=logit_bias, trace_id=trace_id,
             ))
         except Exception:
             # push failed — nothing is coming; don't leak the pending entry
             # (it would also keep authorizing frames for a dead request id)
             self._pending.pop(request_id, None)
+            self._failures.inc(reason="submit")
             raise
         self.remote_submitted += 1
+        self._submit_t[request_id] = time.monotonic()
         self._queue_depth += 1  # optimistic until the next refresh
         return fut
 
-    def cancel(self, request_id: str) -> None:
+    def cancel(self, request_id: str, reason: str = "cancelled") -> None:
         """Stop accepting frames for a request (cancel / timeout fallback)."""
         fut = self._pending.pop(request_id, None)
+        if self._submit_t.pop(request_id, None) is not None:
+            self._failures.inc(reason=reason)
         if fut is not None and not fut.done():
             fut.cancel()
 
@@ -176,6 +205,9 @@ class RemotePrefillCoordinator:
             logger.warning("commit for unknown request %s", request_id)
             return
         self.remote_completed += 1
+        t0 = self._submit_t.pop(request_id, None)
+        if t0 is not None:
+            self._rtt_hist.observe(time.monotonic() - t0)
         fut.set_result((first_token, logprob, top))
 
     def metrics(self) -> dict:

@@ -90,8 +90,9 @@ class PrefillWorker:
             await self._handle(rpr)
         except Exception:
             # no ack — the visibility window redelivers this item
-            logger.exception("prefill of %s failed; leaving for redelivery",
-                             rpr.request_id)
+            logger.exception("prefill of %s (trace %s) failed; leaving for "
+                             "redelivery", rpr.request_id,
+                             rpr.trace_id or rpr.request_id)
             stale = self._clients.pop(rpr.engine_id, None)
             if stale is not None:
                 await stale.close()

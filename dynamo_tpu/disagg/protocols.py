@@ -45,6 +45,9 @@ class RemotePrefillRequest:
     # ships no top dict (matches the decode scheduler's logprobs_n gate)
     logprobs_n: int = 0
     logit_bias: Optional[dict] = None  # token id → additive logit offset
+    # ingress-assigned correlation id (X-Request-Id); log/span context only —
+    # transfer authorization and pending state key on request_id
+    trace_id: str = ""
 
     def to_wire(self) -> bytes:
         d = dataclasses.asdict(self)

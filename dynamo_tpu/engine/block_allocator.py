@@ -103,6 +103,7 @@ class BlockAllocator:
         enable_prefix_caching: bool = True,
         events: Optional[KvEventSink] = None,
         tier2=None,  # Optional[KvHostTier] — host-RAM offload tier
+        registry=None,  # Optional[telemetry.MetricsRegistry]
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
@@ -130,6 +131,26 @@ class BlockAllocator:
         # match staging telemetry (reference manager.rs staging order)
         self.matched_inflight_total = 0
         self.matched_reusable_total = 0
+        if registry is None:
+            from ..telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry()  # private; owner renders nothing
+        self._evictions = registry.counter(
+            "dynamo_kv_evictions_total",
+            "Cached blocks evicted from the reuse pool to satisfy demand",
+        )
+        registry.callback_gauge(
+            "dynamo_kv_active_blocks", "KV blocks in use",
+            lambda: self.used,
+        )
+        registry.callback_gauge(
+            "dynamo_kv_total_blocks", "KV cache capacity in blocks",
+            lambda: self.num_blocks,
+        )
+        registry.callback_gauge(
+            "dynamo_kv_block_usage_ratio", "used / total KV blocks",
+            lambda: self.usage(),
+        )
 
     # ---------- accounting ----------
 
@@ -200,6 +221,7 @@ class BlockAllocator:
             return self.free.pop()
         bid = self.reusable.pop(skip=self.pinned)
         if bid is not None:
+            self._evictions.inc()
             h = self.block_hash.pop(bid, None)
             if h is not None:
                 self.by_hash.pop(h, None)

@@ -29,6 +29,7 @@ from ..protocols.common import (
     TokenLogprob,
 )
 from ..runtime.engine import AsyncEngineContext
+from ..telemetry.registry import STEP_BUCKETS, MetricsRegistry
 from ..tokens import TokenSequence
 from .block_allocator import BlockAllocator, KvEventSink
 from .config import EngineConfig
@@ -152,6 +153,9 @@ class EngineRequest:
     # re-run (set when a prefix-hit rejection made it pointless for a while;
     # time-based — the scheduler loop can spin every ~1 ms)
     remote_backoff_until: float = 0.0
+    # telemetry: monotonic time of the last token emission (0 = none yet);
+    # drives the inter-token-latency histogram and the first_token span
+    last_emit_t: float = 0.0
 
     @property
     def max_new(self) -> int:
@@ -173,6 +177,7 @@ class Scheduler:
         events: Optional[KvEventSink] = None,
         disagg=None,  # Optional[RemotePrefillCoordinator]
         draft_runner: Optional[ModelRunner] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.runner = runner
         self.config = config
@@ -191,9 +196,14 @@ class Scheduler:
                 runner.gather_blocks_device, runner.scatter_blocks,
                 config.host_kv_blocks,
             )
+        # shared metrics registry: the scheduler's, the allocator's, and
+        # (attached below) the disagg coordinator's instruments all render
+        # in the frontend's single /metrics exposition
+        self.registry = registry or MetricsRegistry()
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
             config.enable_prefix_caching, events, tier2=tier2,
+            registry=self.registry,
         )
         self.waiting: deque = deque()
         self.pending_remote: List[EngineRequest] = []
@@ -212,6 +222,82 @@ class Scheduler:
         # ngram speculative decoding acceptance telemetry
         self.spec_proposed = 0
         self.spec_accepted = 0
+        self._build_instruments()
+        if disagg is not None and getattr(disagg, "registry", None) is not None:
+            self.registry.attach(disagg.registry)
+
+    def _build_instruments(self) -> None:
+        """Register the scheduler's Prometheus instruments (the full
+        catalog is documented in docs/observability.md)."""
+        reg = self.registry
+        self._step_hist = reg.histogram(
+            "dynamo_scheduler_step_duration_seconds",
+            "One scheduler loop pass that made progress",
+            buckets=STEP_BUCKETS,
+        )
+        self._phase_hist = reg.histogram(
+            "dynamo_scheduler_phase_duration_seconds",
+            "Loop-phase latency, labelled phase="
+            "admission|prefill|decode|host_sync; phases are disjoint "
+            "(host_sync time is carved out of its enclosing phase)",
+            buckets=STEP_BUCKETS,
+        )
+        # device→host sync time accumulated inside the current
+        # prefill/decode phase window — subtracted from that window's
+        # observation so summing phase series never double-counts
+        self._host_sync_s = 0.0
+        self._itl_hist = reg.histogram(
+            "dynamo_scheduler_inter_token_latency_seconds",
+            "Gap between consecutive token emissions of one request",
+            buckets=STEP_BUCKETS,
+        )
+        self._preemptions = reg.counter(
+            "dynamo_scheduler_preemptions_total",
+            "Requests evicted back to the waiting queue on KV OOM",
+        )
+        self._spec_proposed_ctr = reg.counter(
+            "dynamo_scheduler_spec_proposed_tokens_total",
+            "Speculative tokens proposed (ngram or draft model)",
+        )
+        self._spec_accepted_ctr = reg.counter(
+            "dynamo_scheduler_spec_accepted_tokens_total",
+            "Speculative tokens accepted by the verify step",
+        )
+        reg.callback_gauge(
+            "dynamo_scheduler_active_slots",
+            "Batch slots currently decoding or prefilling",
+            lambda: sum(1 for s in self.slots if s is not None),
+        )
+        reg.callback_gauge(
+            "dynamo_scheduler_total_slots",
+            "Configured max_batch_size",
+            lambda: self.config.max_batch_size,
+        )
+        reg.callback_gauge(
+            "dynamo_scheduler_slot_occupancy_ratio",
+            "active_slots / total_slots",
+            lambda: (
+                sum(1 for s in self.slots if s is not None)
+                / self.config.max_batch_size
+            ),
+        )
+        reg.callback_gauge(
+            "dynamo_scheduler_waiting_requests",
+            "Admission queue depth (local waiting + pending remote prefill)",
+            lambda: len(self.waiting) + len(self.pending_remote),
+        )
+        reg.callback_gauge(
+            "dynamo_kv_prefix_hit_ratio",
+            "Prompt tokens served from the prefix cache / all prompt tokens",
+            lambda: (
+                self.prefix_hit_tokens / self.prefix_total_tokens
+                if self.prefix_total_tokens else 0.0
+            ),
+        )
+
+    def _observe_host_sync(self, dt: float) -> None:
+        self._phase_hist.observe(dt, phase="host_sync")
+        self._host_sync_s += dt
 
     # ---------- public API ----------
 
@@ -248,6 +334,7 @@ class Scheduler:
         er.want_logprobs = er.req.output_options.logprobs is not None
         er.logprobs_n = int(er.req.output_options.logprobs or 0)
         er.want_prompt_lps = er.req.output_options.prompt_logprobs is not None
+        er.ctx.add_stage("queued")
         self.waiting.append(er)
         self.wake.set()
 
@@ -285,6 +372,12 @@ class Scheduler:
     def _emit(self, er: EngineRequest, token: int, logprob: Optional[float],
               top: Optional[dict] = None,
               prompt_lps: Optional[list] = None) -> None:
+        now = time.monotonic()
+        if er.last_emit_t:
+            self._itl_hist.observe(now - er.last_emit_t)
+        else:
+            er.ctx.add_stage("first_token")
+        er.last_emit_t = now
         out = EngineOutput(
             token_ids=[token],
             finish_reason=er.finish,
@@ -309,6 +402,7 @@ class Scheduler:
 
     def _finish(self, er: EngineRequest, reason: FinishReason, emit: bool = True) -> None:
         er.finish = reason
+        er.ctx.add_stage("completion")
         if emit:
             er.out_queue.put_nowait(EngineOutput(token_ids=[], finish_reason=reason))
         er.out_queue.put_nowait(None)  # stream end sentinel
@@ -349,6 +443,7 @@ class Scheduler:
         loop = asyncio.get_running_loop()
         while not self._stopping:
             progressed = False
+            pass_t0 = time.monotonic()
 
             # drop cancelled requests (client disconnects / kills)
             for er in list(self.waiting):
@@ -369,6 +464,8 @@ class Scheduler:
             # queue push + block reservation (no local compute), so it
             # proceeds even while a local chunked prefill occupies the
             # runner; the pending window bounds block reservations
+            t_adm = time.monotonic()
+            admitted = False
             if self.disagg is not None:
                 for er in list(self.waiting):
                     if (len(self.pending_remote)
@@ -376,7 +473,7 @@ class Scheduler:
                         break
                     if await self._try_submit_remote(er):
                         self.waiting.remove(er)
-                        progressed = True
+                        progressed = admitted = True
 
             # local admission: claim a slot + blocks, join the prefill
             # batch (up to max_prefill_batch prompts prefill together)
@@ -389,7 +486,11 @@ class Scheduler:
                 except MemoryError:
                     break  # no memory — wait for a sequence to finish
                 self.waiting.popleft()
-                progressed = True
+                progressed = admitted = True
+            if admitted:
+                self._phase_hist.observe(
+                    time.monotonic() - t_adm, phase="admission"
+                )
 
             # one prefill step (≤ max_prefill_tokens_per_step tokens,
             # split across the batch) per loop pass, interleaved with the
@@ -398,7 +499,13 @@ class Scheduler:
             # batching of the engines behind
             # examples/llm/components/worker.py:72-74)
             if self.prefilling:
+                t_pf = time.monotonic()
+                self._host_sync_s = 0.0
                 await self._prefill_chunk(loop, list(self.prefilling))
+                self._phase_hist.observe(
+                    max(0.0, time.monotonic() - t_pf - self._host_sync_s),
+                    phase="prefill",
+                )
                 progressed = True
 
             # decode every active slot: one token, or a fused K-step
@@ -410,6 +517,8 @@ class Scheduler:
                 if s is not None and s not in self.prefilling
             ]
             if active:
+                t_dec = time.monotonic()
+                self._host_sync_s = 0.0
                 runner_idle = not (self.prefilling or self.waiting
                                    or self.pending_remote)
                 speculating = (
@@ -427,6 +536,10 @@ class Scheduler:
                     if k_steps > 1 and not runner_idle:
                         k_steps = 1
                     await self._decode(loop, active, k_steps)
+                self._phase_hist.observe(
+                    max(0.0, time.monotonic() - t_dec - self._host_sync_s),
+                    phase="decode",
+                )
                 progressed = True
 
             # materialize staged host-tier offloads now that this pass's
@@ -449,6 +562,7 @@ class Scheduler:
                 else:
                     await asyncio.sleep(0.001)
             else:
+                self._step_hist.observe(time.monotonic() - pass_t0)
                 await asyncio.sleep(0)  # let I/O run between steps
 
     # ---------- disaggregated prefill (decode side) ----------
@@ -516,6 +630,7 @@ class Scheduler:
                 want_logprobs=er.want_logprobs,
                 logprobs_n=er.logprobs_n,
                 logit_bias=er.req.sampling_options.logit_bias,
+                trace_id=er.ctx.trace_id,
             )
         except Exception:
             # queue unreachable — release and let the local path take it
@@ -527,6 +642,7 @@ class Scheduler:
             return False
         self.prefix_hit_tokens += er.num_cached
         self.prefix_total_tokens += len(er.prompt)
+        er.ctx.add_stage("admission")
         er.remote_deadline = time.monotonic() + self.disagg.prefill_timeout_s
         er.remote_future.add_done_callback(lambda _f: self.wake.set())
         self.pending_remote.append(er)
@@ -556,11 +672,15 @@ class Scheduler:
                 logger.warning("remote prefill timeout for %s; local fallback",
                                er.request_id)
                 self.pending_remote.remove(er)
-                self.disagg.cancel(er.request_id)
+                self.disagg.cancel(er.request_id, reason="timeout")
                 self.allocator.free_blocks(er.block_ids)
                 er.block_ids = []
                 er.num_cached = 0
                 er.remote_future = None
+                # marker span (same idiom as "preempted"): the second
+                # "admission" in the trace is a fallback re-admission,
+                # not a bug — and the remote wait is attributable to it
+                er.ctx.add_stage("remote_fallback")
                 self.waiting.appendleft(er)
                 progressed = True
         return progressed
@@ -572,6 +692,7 @@ class Scheduler:
         sampled the first token (max_tokens=1 semantics, reference:
         examples/llm/components/prefill_worker.py:148-178)."""
         token, lp, top = er.remote_future.result()
+        er.ctx.add_stage("remote_prefill")
         er.remote_future = None
         er.slot = slot
         self.slots[slot] = er
@@ -602,6 +723,7 @@ class Scheduler:
         off instead of restarting (vLLM recompute-preemption semantics)."""
         slot = self._free_slot()
         assert slot is not None
+        er.ctx.add_stage("admission")
         tokens_all = er.prompt + er.resume_tokens
         if er.want_prompt_lps and not er.prompt_lps_emitted:
             # every prompt position must run through the model — a prefix
@@ -799,10 +921,13 @@ class Scheduler:
             return (np.asarray(next_tokens), np.asarray(lps),
                     np.asarray(top_vals), np.asarray(top_ids), plists)
 
+        t_sync = time.monotonic()
         toks, lpn, tv, ti, plists = await loop.run_in_executor(None, _to_host)
+        self._observe_host_sync(time.monotonic() - t_sync)
         for i in finals:
             er = plan[i][0]
             self.prefilling.remove(er)
+            er.ctx.add_stage("prefill")
             prompt_lps = None
             if er.want_prompt_lps and not er.prompt_lps_emitted:
                 # OpenAI/vLLM convention: the first prompt token has no
@@ -1054,7 +1179,9 @@ class Scheduler:
             commit=np.zeros(b, bool),  # greedy chain: counts never consulted
             want_top=False, want_greedy=True,
         )
+        t_sync = time.monotonic()
         ga = await loop.run_in_executor(None, lambda: np.asarray(greedy_all))
+        self._observe_host_sync(time.monotonic() - t_sync)
         self.steps += 1
 
         for er in active:
@@ -1067,6 +1194,8 @@ class Scheduler:
                 a += 1
             self.spec_proposed += len(prop)
             self.spec_accepted += a
+            self._spec_proposed_ctr.inc(len(prop))
+            self._spec_accepted_ctr.inc(a)
             # emit accepted prefix + the correction token, with the same
             # pending-token discipline as every other decode path
             for j in range(a + 1):
@@ -1205,10 +1334,12 @@ class Scheduler:
                     sample_slots=np.arange(b, dtype=np.int32),
                     commit=np.zeros(b, bool), want_top=False, **dkw,
                 )
+        t_sync = time.monotonic()
         toks, lpn, tv, ti = await loop.run_in_executor(
             None, lambda: (np.asarray(next_tokens), np.asarray(lps),
                            np.asarray(top_vals), np.asarray(top_ids))
         )
+        self._observe_host_sync(time.monotonic() - t_sync)
         self.steps += 1
         if k_steps == 1:
             # [B] → [1, B] so the emit loop below is one shape
@@ -1247,6 +1378,8 @@ class Scheduler:
         Tokens already emitted to the client are PRESERVED: on re-admission
         the request re-prefills ``prompt + resume_tokens`` and the stream
         continues where it stopped (never restarts or diverges)."""
+        self._preemptions.inc()
+        er.ctx.add_stage("preempted")
         if er.slot >= 0:
             self.slots[er.slot] = None
             er.slot = -1

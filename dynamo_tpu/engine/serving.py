@@ -376,5 +376,12 @@ class JaxServingEngine(AsyncEngine):
     def metrics(self) -> dict:
         return self.scheduler.metrics()
 
+    @property
+    def registry(self):
+        """The engine's MetricsRegistry (scheduler + KV allocator +
+        disagg instruments) — attach it to the frontend's ServiceMetrics
+        so one /metrics scrape covers every layer."""
+        return self.scheduler.registry
+
     async def close(self) -> None:
         await self.scheduler.stop()

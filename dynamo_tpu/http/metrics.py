@@ -1,5 +1,10 @@
-"""Prometheus metrics for the HTTP service (no client lib in env — the
-text exposition format is simple enough to emit directly).
+"""HTTP-edge metrics: the service's instrument set + request timing helper.
+
+The instrument primitives (Counter/Gauge/Histogram and the label
+escaping that makes model names with quotes/backslashes/newlines legal
+exposition text) live in ``telemetry/registry.py``; this module keeps
+the HTTP service's metric set and re-exports the primitives for
+back-compat.
 
 Reference analog: lib/llm/src/http/service/metrics.rs:37-130 —
 ``{prefix}_http_service_requests_total`` / ``_inflight_requests`` /
@@ -9,149 +14,65 @@ Reference analog: lib/llm/src/http/service/metrics.rs:37-130 —
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Tuple
+from typing import Optional
 
-DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
-
-
-def _fmt_labels(labels: Dict[str, str]) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-    return "{" + inner + "}"
-
-
-class Counter:
-    def __init__(self, name: str, help_: str):
-        self.name = name
-        self.help = help_
-        self.values: Dict[Tuple[Tuple[str, str], ...], float] = {}
-
-    def inc(self, amount: float = 1.0, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        self.values[key] = self.values.get(key, 0.0) + amount
-
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, val in sorted(self.values.items()):
-            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
-        return lines
-
-
-class Gauge(Counter):
-    def set(self, value: float, **labels: str) -> None:
-        self.values[tuple(sorted(labels.items()))] = value
-
-    def dec(self, amount: float = 1.0, **labels: str) -> None:
-        self.inc(-amount, **labels)
-
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, val in sorted(self.values.items()):
-            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
-        return lines
-
-
-class Histogram:
-    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
-        self.name = name
-        self.help = help_
-        self.buckets = buckets
-        self.counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
-        self.sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
-        self.totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
-
-    def observe(self, value: float, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        if key not in self.counts:
-            self.counts[key] = [0] * len(self.buckets)
-            self.sums[key] = 0.0
-            self.totals[key] = 0
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                self.counts[key][i] += 1
-        self.sums[key] += value
-        self.totals[key] += 1
-
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self.counts):
-            labels = dict(key)
-            for i, b in enumerate(self.buckets):
-                lines.append(
-                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': str(b)})} {self.counts[key][i]}"
-                )
-            lines.append(
-                f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {self.totals[key]}"
-            )
-            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self.sums[key]}")
-            lines.append(f"{self.name}_count{_fmt_labels(labels)} {self.totals[key]}")
-        return lines
-
-
-class _CallbackGauges:
-    """Gauges whose values come from a callback at render time."""
-
-    def __init__(self, prefix: str, fn):
-        self.prefix = prefix
-        self.fn = fn
-
-    def render(self) -> List[str]:
-        lines: List[str] = []
-        try:
-            vals = self.fn() or {}
-            if not isinstance(vals, dict):
-                return []  # BYO engines may return anything
-            for k, v in sorted(vals.items()):
-                if isinstance(v, bool) or not isinstance(v, (int, float)):
-                    continue
-                name = f"{self.prefix}_{k}"
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {float(v)}")
-        except Exception:
-            return []  # a broken engine must not take /metrics down
-        return lines
+from ..telemetry.registry import (  # noqa: F401 — re-exported for callers
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    format_labels as _fmt_labels,
+)
 
 
 class ServiceMetrics:
-    """The HTTP service's metric set + request timing helper."""
+    """The HTTP service's metric set + request timing helper.
 
-    def __init__(self, prefix: str = "dynamo"):
-        self.requests_total = Counter(
+    All instruments live in ``self.registry`` — engine/scheduler/router
+    registries attach there so a single ``GET /metrics`` scrape exposes
+    every layer of the serving process.
+    """
+
+    def __init__(self, prefix: str = "dynamo",
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        self.requests_total = self.registry.counter(
             f"{prefix}_http_service_requests_total", "Total HTTP requests by model/status"
         )
-        self.inflight = Gauge(
+        self.inflight = self.registry.gauge(
             f"{prefix}_http_service_inflight_requests", "In-flight requests by model"
         )
-        self.duration = Histogram(
+        self.duration = self.registry.histogram(
             f"{prefix}_http_service_request_duration_seconds",
             "Request duration by model",
         )
-        self.ttft = Histogram(
+        self.ttft = self.registry.histogram(
             f"{prefix}_http_service_time_to_first_token_seconds",
             "Time to first streamed token by model",
         )
-        self._extra = []
 
     def register(self, metric) -> None:
-        self._extra.append(metric)
+        self.registry.register(metric)
 
     def register_callback_gauges(self, prefix: str, fn) -> None:
-        """Expose a dict-returning callback (e.g. the in-process
-        engine's ForwardPassMetrics analog — slot/KV occupancy, prefix
-        hit rate, speculation acceptance) as Prometheus gauges, pulled
-        fresh at every /metrics render."""
-        self._extra.append(_CallbackGauges(prefix, fn))
+        """Expose a dict-returning callback (e.g. a BYO engine's
+        ForwardPassMetrics analog — slot/KV occupancy, prefix hit rate,
+        speculation acceptance) as Prometheus gauges, pulled fresh at
+        every /metrics render."""
+        self.registry.register_callback_gauges(prefix, fn)
+
+    def attach_registry(self, registry: MetricsRegistry) -> None:
+        """Merge another component's registry into this exposition
+        (the in-process engine's scheduler/KV/disagg instruments)."""
+        self.registry.attach(registry)
 
     def inflight_total(self) -> float:
         """Sum of in-flight requests across models (graceful-drain gate)."""
         return sum(self.inflight.values.values())
 
     def render(self) -> str:
-        lines: List[str] = []
-        for m in (self.requests_total, self.inflight, self.duration, self.ttft, *self._extra):
-            lines.extend(m.render())
-        return "\n".join(lines) + "\n"
+        return self.registry.render()
 
     class _Timer:
         def __init__(self, metrics: "ServiceMetrics", model: str):

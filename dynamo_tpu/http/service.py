@@ -36,8 +36,9 @@ from ..protocols.openai import (
 from ..runtime.client import Client, NoInstancesError, RouterMode
 from ..runtime.component import DistributedRuntime
 from ..runtime.discovery import WatchEventType
-from ..runtime.engine import AsyncEngine, Context, EngineError
+from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context, EngineError
 from ..runtime.network import ResponseStreamError
+from ..telemetry.tracing import TraceRecorder
 from .metrics import ServiceMetrics
 
 logger = logging.getLogger(__name__)
@@ -86,6 +87,9 @@ class HttpService:
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics(metrics_prefix)
+        # completed request traces: ingress-assigned trace ids (honoring
+        # X-Request-Id) → span breakdowns at GET /debug/requests/{id}
+        self.traces = TraceRecorder()
         self.profile_dir = profile_dir
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
@@ -93,6 +97,8 @@ class HttpService:
         self.app.router.add_get("/v1/models", self.handle_models)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/health", self.handle_health)
+        self.app.router.add_get("/debug/requests", self.handle_debug_requests)
+        self.app.router.add_get("/debug/requests/{rid}", self.handle_debug_request)
         if profile_dir:
             # opt-in only: trace capture costs device time and writes disk
             self.app.router.add_get("/debug/profile", self.handle_profile)
@@ -121,6 +127,7 @@ class HttpService:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        self.traces.close()
 
     # ---------- helpers ----------
 
@@ -147,7 +154,14 @@ class HttpService:
 
         timer = self.metrics.track(api_req.model)
         status = "error"
-        ctx = Context(api_req)
+        # ingress-assigned trace id: honor the client's X-Request-Id so
+        # callers can correlate their logs with /debug/requests/{id} and
+        # every downstream hop (scheduler spans, remote prefill) by id.
+        # It is correlation-only: the engine-side request id stays a fresh
+        # UUID (AsyncEngineContext.id), so a reused/duplicate client id
+        # cannot collide in scheduler or disagg-coordinator state.
+        trace_id = (request.headers.get("X-Request-Id") or "").strip()[:128]
+        ctx = Context(api_req, AsyncEngineContext(trace_id=trace_id or None))
         ctx.add_stage("http")
         try:
             stream = engine.generate(ctx).__aiter__()
@@ -181,7 +195,10 @@ class HttpService:
                     timer.first_token()
                 chunks.append(chunk_cls.model_validate(_as_dict(chunk)))
             status = "success"
-            return web.json_response(aggregate(chunks).model_dump(exclude_none=True))
+            return web.json_response(
+                aggregate(chunks).model_dump(exclude_none=True),
+                headers={"X-Request-Id": ctx.trace_id},
+            )
         except (EngineError, ValueError) as e:
             return self._error(400, str(e))
         except NoInstancesError as e:
@@ -198,11 +215,12 @@ class HttpService:
         finally:
             ctx.context.stop_generating()
             timer.finish(status)
+            self.traces.record(ctx.trace_id, api_req.model, status, ctx.stages)
             if ctx.stages and logger.isEnabledFor(logging.DEBUG):
                 logger.debug(
                     "request %s %s: %s",
-                    ctx.id, status, stage_summary(ctx.stages),
-                    extra={"request_id": ctx.id,
+                    ctx.trace_id, status, stage_summary(ctx.stages),
+                    extra={"request_id": ctx.trace_id,
                            "stages": [s for s, _ in ctx.stages]},
                 )
 
@@ -219,6 +237,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                "X-Request-Id": ctx.trace_id,
             }
         )
         await resp.prepare(request)
@@ -301,6 +320,31 @@ class HttpService:
 
     async def handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "models": self.manager.model_names()})
+
+    async def handle_debug_requests(self, request: web.Request) -> web.Response:
+        """GET /debug/requests?limit=N — the most recent completed traces
+        (newest last), for finding an id when the client didn't pick one."""
+        try:
+            limit = int(request.query.get("limit", "20"))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        return web.json_response(
+            {"traces": self.traces.recent(max(1, min(limit, 200)))}
+        )
+
+    async def handle_debug_request(self, request: web.Request) -> web.Response:
+        """GET /debug/requests/{id} — per-request span breakdown (stage
+        names, offsets, durations) for a completed request. Issue the
+        request with an X-Request-Id header to pick the id yourself."""
+        rid = request.match_info["rid"]
+        trace = self.traces.get(rid)
+        if trace is None:
+            return web.json_response(
+                {"error": f"no completed trace for request id {rid!r} "
+                          "(unknown, evicted, or still in flight)"},
+                status=404,
+            )
+        return web.json_response(trace)
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """GET /debug/profile?seconds=N — capture an XLA profiler trace of

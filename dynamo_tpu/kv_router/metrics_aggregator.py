@@ -8,7 +8,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Callable, Dict, Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..runtime.client import Client
 from .protocols import ForwardPassMetrics
@@ -33,6 +34,10 @@ class KvMetricsAggregator:
         self.on_remove = on_remove
         self.on_sync = on_sync
         self.endpoints: Dict[str, ForwardPassMetrics] = {}
+        # monotonic time of each worker's last successful scrape — the
+        # staleness age tells operators (and the scheduler cost function's
+        # observers) how old a worker's load snapshot is
+        self.last_update: Dict[str, float] = {}
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> None:
@@ -55,6 +60,7 @@ class KvMetricsAggregator:
                 continue
             m = ForwardPassMetrics.from_wire(data)
             self.endpoints[iid] = m
+            self.last_update[iid] = time.monotonic()
             seen.add(iid)
             if self.on_update:
                 self.on_update(iid, m)
@@ -63,6 +69,7 @@ class KvMetricsAggregator:
         for iid in list(self.endpoints):
             if iid not in live:
                 del self.endpoints[iid]
+                self.last_update.pop(iid, None)
                 if self.on_remove:
                     self.on_remove(iid)
         if self.on_sync:
@@ -70,6 +77,52 @@ class KvMetricsAggregator:
             # successful scrape (e.g. died before their first poll)
             self.on_sync(live)
         return self.endpoints
+
+    def register_into(self, registry, prefix: str = "dynamo") -> None:
+        """Expose the per-worker snapshot as labelled gauges on a
+        MetricsRegistry (the router-side /metrics surface)."""
+
+        def per_worker(field: str) -> Callable[[], List[Tuple[dict, float]]]:
+            def collect():
+                return [
+                    ({"instance": iid}, float(getattr(m, field)))
+                    for iid, m in self.endpoints.items()
+                ]
+            return collect
+
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_kv_active_blocks",
+            "Worker's in-use KV blocks (scraped ForwardPassMetrics)",
+            per_worker("kv_active_blocks"),
+        )
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_kv_total_blocks",
+            "Worker's KV capacity in blocks (scraped)",
+            per_worker("kv_total_blocks"),
+        )
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_active_slots",
+            "Worker's busy batch slots (scraped)",
+            per_worker("request_active_slots"),
+        )
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_waiting_requests",
+            "Worker's admission-queue depth (scraped)",
+            per_worker("num_requests_waiting"),
+        )
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_prefix_hit_ratio",
+            "Worker's prefix-cache hit rate (scraped)",
+            per_worker("gpu_prefix_cache_hit_rate"),
+        )
+        registry.callback_gauge(
+            f"{prefix}_kv_router_worker_staleness_seconds",
+            "Age of the worker's last successful stats scrape",
+            lambda: [
+                ({"instance": iid}, time.monotonic() - t)
+                for iid, t in self.last_update.items()
+            ],
+        )
 
     def stop(self) -> None:
         if self._task:

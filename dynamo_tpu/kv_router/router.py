@@ -17,6 +17,7 @@ import msgpack
 
 from ..runtime.client import Client
 from ..runtime.component import Component
+from ..telemetry.registry import MetricsRegistry
 from ..tokens import compute_block_hashes
 from .indexer import KvIndexer, ShardedKvIndexer
 from .metrics_aggregator import KvMetricsAggregator
@@ -51,6 +52,19 @@ class KvRouter:
         )
         self._event_task: Optional[asyncio.Task] = None
         self._event_sub = None
+        # the router's own observability surface: per-worker scraped load
+        # (active blocks, prefix hit rate, scrape staleness) plus routing
+        # decision counters — previously internal-only state
+        self.registry = MetricsRegistry()
+        self.aggregator.register_into(self.registry)
+        self._decisions = self.registry.counter(
+            "dynamo_kv_router_decisions_total",
+            "Scheduling decisions, labelled by chosen worker",
+        )
+        self._overlap_blocks = self.registry.counter(
+            "dynamo_kv_router_overlap_blocks_total",
+            "Prefix-overlap blocks credited to chosen workers",
+        )
 
     def _on_worker_gone(self, worker_id: str) -> None:
         self.scheduler.remove_worker(worker_id)
@@ -81,6 +95,10 @@ class KvRouter:
         hashes = compute_block_hashes(token_ids, self.block_size)
         overlap = self.indexer.find_matches(hashes)
         decision = self.scheduler.schedule(len(token_ids), overlap)
+        self._decisions.inc(worker=str(decision.worker_id))
+        self._overlap_blocks.inc(
+            decision.matched_blocks, worker=str(decision.worker_id)
+        )
         try:
             await self.component.namespace.publish_event(
                 KV_HIT_RATE_EVENT,

@@ -816,7 +816,10 @@ class OpenAIPreprocessor(Operator):
 
         prompt_tokens = len(preprocessed.token_ids)
         want_lp = preprocessed.output_options.logprobs
-        child_ctxs = [AsyncEngineContext() for _ in range(best_of)]
+        child_ctxs = [
+            AsyncEngineContext(trace_id=request.context.trace_id)
+            for _ in range(best_of)
+        ]
 
         async def relay_stop() -> None:
             await request.context.wait_stopped()
@@ -857,6 +860,7 @@ class OpenAIPreprocessor(Operator):
             stop_task.cancel()
             for c in child_ctxs:
                 c.stop_generating()
+            request.context.merge_stages_from(child_ctxs)
 
         # OpenAI's documented selection: highest log probability PER
         # TOKEN — raw cumulative sums would systematically favor short
@@ -908,7 +912,10 @@ class OpenAIPreprocessor(Operator):
         # each choice gets its OWN engine context: an engine finishing one
         # choice stops that choice's context in its finally, which with a
         # shared context would truncate the sibling streams mid-generation
-        child_ctxs = [AsyncEngineContext() for _ in range(n)]
+        child_ctxs = [
+            AsyncEngineContext(trace_id=request.context.trace_id)
+            for _ in range(n)
+        ]
 
         async def relay_stop() -> None:
             # client disconnect on the parent fans out to every child
@@ -958,6 +965,7 @@ class OpenAIPreprocessor(Operator):
                 t.cancel()
             for c in child_ctxs:
                 c.stop_generating()
+            request.context.merge_stages_from(child_ctxs)
         if include_usage:
             usage_total.total_tokens = (
                 usage_total.prompt_tokens + usage_total.completion_tokens

@@ -115,9 +115,15 @@ class Client(AsyncEngine):
         return ids[next(self._rr) % len(ids)]
 
     async def open_stream(
-        self, payload: Any, instance_id: Optional[str] = None
+        self, payload: Any, instance_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> ResponseReceiver:
-        """Route, push the request, return the dialed-back response stream."""
+        """Route, push the request, return the dialed-back response stream.
+
+        ``trace_id`` rides the two-part message header so the worker-side
+        engine context (and everything downstream of it — scheduler spans,
+        remote-prefill requests, logs) keeps the ingress-assigned id.
+        """
         if not self._started:
             await self.start()
         target = self._pick(instance_id)
@@ -130,7 +136,10 @@ class Client(AsyncEngine):
             payload = payload.model_dump(mode="json", exclude_none=True)
         elif hasattr(payload, "to_wire"):
             payload = payload.to_wire()
-        two_part = {"header": {"req_id": req_id, "conn": conn}, "payload": payload}
+        header = {"req_id": req_id, "conn": conn}
+        if trace_id:
+            header["trace_id"] = trace_id
+        two_part = {"header": header, "payload": payload}
         await drt.messaging.publish(
             self.endpoint.subject(target), msgpack.packb(two_part, use_bin_type=True)
         )
@@ -139,7 +148,9 @@ class Client(AsyncEngine):
     async def generate(self, request: Context[Any]) -> AsyncIterator[Any]:
         """AsyncEngine over the network: request context controls propagate."""
         instance_id = request.baggage.get("instance_id")
-        receiver = await self.open_stream(request.payload, instance_id)
+        receiver = await self.open_stream(
+            request.payload, instance_id, trace_id=request.trace_id
+        )
         await receiver.wait_prologue()
 
         # propagate caller-side cancellation to the worker

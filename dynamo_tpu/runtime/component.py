@@ -268,7 +268,15 @@ class ServingEndpoint:
                     return self.handler.generate(Context(payload, ctx))
                 return self.handler(payload, ctx)
 
-            await respond_to(header["conn"], stream_fn, header.get("req_id", "?"))
+            # req_id (a fresh per-hop UUID) becomes the worker-side engine
+            # context id — it keys engine/disagg state, so it must be
+            # unique; the ingress-assigned trace id (e.g. X-Request-Id)
+            # rides alongside for span/log correlation end to end
+            await respond_to(
+                header["conn"], stream_fn,
+                header.get("req_id", "?"),
+                trace_id=header.get("trace_id"),
+            )
         finally:
             self.inflight -= 1
 

@@ -24,12 +24,43 @@ class AsyncEngineContext:
 
     ``stop_generating`` asks the producer to finish early but still emit any
     buffered output; ``kill`` demands immediate termination. Both are sticky.
+
+    The context also carries the request's trace: ``trace_id`` is the
+    ingress-assigned correlation id (honoring ``X-Request-Id``, so it may
+    repeat across requests) while ``id`` stays a per-request unique handle —
+    engine and disagg-coordinator state is keyed by ``id``, so a client
+    reusing a trace id cannot cross-wire another request's KV transfer or
+    first-token future. ``stages`` records (name, monotonic time) span marks
+    from every layer the request crosses — HTTP, scheduler
+    admission/prefill/first-token, completion. Storing them HERE (not in
+    pipeline baggage) means the scheduler, which only holds the
+    AsyncEngineContext, can stamp spans too.
     """
 
-    def __init__(self, request_id: Optional[str] = None):
+    def __init__(self, request_id: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         self.id: str = request_id or uuid.uuid4().hex
+        self.trace_id: str = trace_id or self.id
+        self.stages: list = []  # [(stage_name, time.monotonic())]
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
+
+    def add_stage(self, name: str) -> None:
+        """Record a processing span mark (reference:
+        pipeline/context.rs:125 add_stage)."""
+        self.stages.append((name, time.monotonic()))
+
+    def merge_stages_from(self, children: list) -> None:
+        """Fold per-choice child-context spans into this trace (the n>1 /
+        best_of fan-out gives every choice its own context for cancellation
+        isolation). Child stage names gain a ``#<choice>`` suffix and the
+        combined list stays chronological, so /debug/requests/{id} shows
+        engine spans for multi-choice requests too."""
+        for i, child in enumerate(children):
+            self.stages.extend(
+                (f"{name}#{i}", t) for name, t in child.stages
+            )
+        self.stages.sort(key=lambda s: s[1])
 
     def stop_generating(self) -> None:
         self._stopped.set()
@@ -73,17 +104,22 @@ class Context(Generic[T]):
     def id(self) -> str:
         return self.context.id
 
+    @property
+    def trace_id(self) -> str:
+        return self.context.trace_id
+
     def add_stage(self, name: str) -> None:
         """Record a processing stage + monotonic timestamp on the request
-        (reference: pipeline/context.rs:125 add_stage). Stages survive
-        ``map`` because they live in the baggage; the frontend logs the
-        per-stage latency breakdown at completion
-        (utils/logging.py stage_summary)."""
-        self.baggage.setdefault("stages", []).append((name, time.monotonic()))
+        (reference: pipeline/context.rs:125 add_stage). Stages live on the
+        shared AsyncEngineContext, so they survive ``map`` AND are visible
+        to token-level layers (the scheduler) that never see this wrapper;
+        the frontend logs/records the per-stage latency breakdown at
+        completion (utils/logging.py stage_summary, telemetry/tracing.py)."""
+        self.context.add_stage(name)
 
     @property
     def stages(self):
-        return self.baggage.get("stages", [])
+        return self.context.stages
 
     def map(self, new_payload: Any) -> "Context[Any]":
         """New payload, same identity/control/baggage."""

@@ -118,13 +118,16 @@ async def respond_to(
     conn_info: dict,
     stream_fn: Callable[[AsyncEngineContext], AsyncIterator[Any]],
     request_id: str,
+    trace_id: Optional[str] = None,
 ) -> None:
     """Worker side: dial back and pump ``stream_fn``'s output to the requester.
 
     Control frames from the requester (stop/kill) are applied to the
-    engine context while streaming.
+    engine context while streaming. ``trace_id`` is the ingress-assigned
+    correlation id riding the message header; ``request_id`` (the per-hop
+    wire id) keys worker-side engine state.
     """
-    ctx = AsyncEngineContext(request_id)
+    ctx = AsyncEngineContext(request_id, trace_id=trace_id)
     scheme = conn_info.get("scheme")
     if scheme == "local":
         stream = _local_streams.pop(conn_info["stream"], None)

@@ -1,0 +1,36 @@
+"""Unified engine telemetry: one metrics registry + per-request traces.
+
+The observability layer every component registers into (reference analogs:
+lib/llm/src/http/service/metrics.rs for the HTTP instrument set,
+ForwardPassMetrics for worker scrapes, and the pipeline Context's stage
+list for per-request latency breakdowns). The HTTP frontend renders ONE
+Prometheus exposition from a :class:`MetricsRegistry` that the scheduler,
+block allocator, KV router, and disagg coordinator all feed; per-request
+spans ride :class:`~dynamo_tpu.runtime.engine.AsyncEngineContext` and are
+queryable at ``GET /debug/requests/{id}``.
+"""
+
+from .registry import (
+    DEFAULT_BUCKETS,
+    CallbackGauge,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    format_labels,
+)
+from .tracing import TraceRecorder, span_breakdown
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "CallbackGauge",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TraceRecorder",
+    "escape_label_value",
+    "format_labels",
+    "span_breakdown",
+]

@@ -1,0 +1,63 @@
+"""Standalone /metrics listener for processes with no HTTP frontend.
+
+The OpenAI frontend renders its registry on the service's own
+``GET /metrics``; router processors and token-level workers serve
+dyn:// traffic only, so their instruments (per-worker scraped load,
+routing decisions, scheduler/KV internals) need a sidecar exposition
+port — enabled with ``--metrics-port`` (0 = off).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from .registry import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsServer:
+    """Minimal aiohttp app: GET /metrics → registry exposition."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 host: str = "0.0.0.0", port: int = 9090):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self._runner: Optional[web.AppRunner] = None
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.registry.render(),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    async def start(self) -> "MetricsServer":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        logger.info("metrics exposition on %s:%d/metrics", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+async def maybe_start_metrics_server(
+    registry: Optional[MetricsRegistry], port: int, host: str = "0.0.0.0",
+) -> Optional[MetricsServer]:
+    """Start a sidecar exposition iff a registry exists and a port was
+    requested — dyn:// roles call this unconditionally."""
+    if registry is None or not port:
+        return None
+    return await MetricsServer(registry, host, port).start()

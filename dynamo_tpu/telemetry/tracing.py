@@ -1,0 +1,112 @@
+"""Per-request trace spans: recorder + breakdown rendering.
+
+A request's trace id is assigned at ingress (the HTTP frontend honors
+``X-Request-Id``) and travels on the request's
+:class:`~dynamo_tpu.runtime.engine.AsyncEngineContext` — the same object
+the scheduler stamps stages onto (``admission`` → ``prefill`` →
+``first_token`` → ``completion``) and whose id rides the runtime
+messaging envelope so disaggregated remote-prefill hops carry context.
+
+Completed traces land in a bounded ring buffer, queryable at
+``GET /debug/requests/{id}``, and are optionally appended as JSONL to the
+file named by ``DYN_TRACE_JSONL`` (one object per request — the
+machine-shippable sibling of ``DYN_LOGGING_JSONL``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+TRACE_JSONL_ENV = "DYN_TRACE_JSONL"
+
+
+def span_breakdown(stages: List[Tuple[str, float]],
+                   end: Optional[float] = None) -> List[dict]:
+    """[(name, t_monotonic)] → spans with offsets and durations.
+
+    Each stage's duration runs to the NEXT stage (the last one to ``end``,
+    defaulting to now) — the structured twin of
+    ``utils.logging.stage_summary``.
+    """
+    if not stages:
+        return []
+    t0 = stages[0][1]
+    closed = list(stages) + [("", end if end is not None else time.monotonic())]
+    return [
+        {
+            "name": name,
+            "offset_s": round(t - t0, 6),
+            "duration_s": round(max(0.0, t_next - t), 6),
+        }
+        for (name, t), (_, t_next) in zip(closed, closed[1:])
+    ]
+
+
+class TraceRecorder:
+    """Bounded ring of completed request traces (+ optional JSONL sink)."""
+
+    def __init__(self, capacity: int = 512,
+                 jsonl_path: Optional[str] = None):
+        self.capacity = capacity
+        self.jsonl_path = (
+            jsonl_path if jsonl_path is not None
+            else os.environ.get(TRACE_JSONL_ENV) or None
+        )
+        # one persistent line-buffered handle — record() runs on the event
+        # loop, so a per-request open()/close() would stall every
+        # concurrent request on a slow disk
+        self._sink = None
+        self._traces: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+
+    def record(
+        self,
+        request_id: str,
+        model: str,
+        status: str,
+        stages: List[Tuple[str, float]],
+        end: Optional[float] = None,
+    ) -> dict:
+        end = end if end is not None else time.monotonic()
+        spans = span_breakdown(stages, end)
+        trace = {
+            "request_id": request_id,
+            "model": model,
+            "status": status,
+            "time": time.time(),
+            "total_s": round(end - stages[0][1], 6) if stages else 0.0,
+            "spans": spans,
+        }
+        self._traces[request_id] = trace  # a reused id replaces its trace
+        self._traces.move_to_end(request_id)
+        while len(self._traces) > self.capacity:
+            self._traces.popitem(last=False)
+        if self.jsonl_path:
+            try:
+                if self._sink is None:
+                    self._sink = open(self.jsonl_path, "a", buffering=1)
+                self._sink.write(json.dumps(trace, ensure_ascii=False) + "\n")
+            except (OSError, ValueError):
+                logger.warning("trace JSONL write to %s failed",
+                               self.jsonl_path, exc_info=True)
+        return trace
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+    def get(self, request_id: str) -> Optional[dict]:
+        return self._traces.get(request_id)
+
+    def recent(self, n: int = 50) -> List[dict]:
+        return list(self._traces.values())[-n:]
+
+    def __len__(self) -> int:
+        return len(self._traces)

@@ -19,8 +19,15 @@ import dataclasses
 import json
 import logging
 import os
-import tomllib
 from typing import List, Optional, Sequence, Type, TypeVar
+
+try:
+    import tomllib  # py3.11+ stdlib
+except ModuleNotFoundError:  # py3.10: same parser, pre-stdlib package name
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        tomllib = None  # no TOML parser: file layers skipped, env still applies
 
 logger = logging.getLogger(__name__)
 
@@ -69,6 +76,12 @@ def from_settings(
         paths.append(extra)
     for path in paths:
         if not os.path.exists(path):
+            continue
+        if tomllib is None:
+            logger.warning(
+                "tomllib unavailable (python < 3.11); ignoring config "
+                "file %s — set %sFIELD env vars instead", path, env_prefix,
+            )
             continue
         with open(path, "rb") as f:
             data = tomllib.load(f)

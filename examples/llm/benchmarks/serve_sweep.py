@@ -105,8 +105,14 @@ async def scrape_spec_metrics(url: str) -> dict:
             async with s.get(f"{url}/metrics") as r:
                 text = await r.text()
         for key in ("spec_proposed_tokens", "spec_accepted_tokens"):
-            m = re.search(rf"^dynamo_engine_{key} ([0-9.eE+-]+)$", text,
-                          re.MULTILINE)
+            # in-process engines expose dynamo_scheduler_*_total counters
+            # (telemetry registry); subprocess/BYO engines still surface
+            # dict snapshots as dynamo_engine_* callback gauges
+            m = re.search(
+                rf"^dynamo_scheduler_{key}_total ([0-9.eE+-]+)$", text,
+                re.MULTILINE,
+            ) or re.search(rf"^dynamo_engine_{key} ([0-9.eE+-]+)$", text,
+                           re.MULTILINE)
             if m:
                 out[key] = float(m.group(1))
     except Exception:

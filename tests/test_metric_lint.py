@@ -1,0 +1,64 @@
+"""Metric-name convention lint (scripts/check_metric_names.py) as a fast
+tier-1 test, so a PR registering an off-convention instrument fails CI."""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+from check_metric_names import (  # noqa: E402
+    RegisteredMetric,
+    check_name,
+    iter_registered_metrics,
+    run_check,
+)
+
+PACKAGE_ROOT = os.path.join(REPO_ROOT, "dynamo_tpu")
+
+
+def test_all_registered_metric_names_conform():
+    violations = run_check(PACKAGE_ROOT)
+    assert not violations, "\n".join(violations)
+
+
+def test_lint_sees_the_real_instrument_catalog():
+    """The AST walk must actually find the known call sites — an empty
+    scan would make the conformance test pass vacuously."""
+    names = {m.name for m in iter_registered_metrics(PACKAGE_ROOT)}
+    expected = {
+        "dynamo_http_service_requests_total",
+        "dynamo_http_service_time_to_first_token_seconds",
+        "dynamo_scheduler_step_duration_seconds",
+        "dynamo_scheduler_inter_token_latency_seconds",
+        "dynamo_kv_evictions_total",
+        "dynamo_kv_block_usage_ratio",
+        "dynamo_kv_router_decisions_total",
+        "dynamo_kv_router_worker_staleness_seconds",
+        "dynamo_disagg_remote_prefill_duration_seconds",
+        "dynamo_disagg_remote_prefill_failures_total",
+    }
+    missing = expected - names
+    assert not missing, f"lint no longer sees: {sorted(missing)}"
+    assert len(names) >= 25
+
+
+def _metric(name, kind):
+    return RegisteredMetric(name, kind, "x.py", 1)
+
+
+def test_rules_reject_bad_names():
+    assert check_name(_metric("dynamo_scheduler_preemptions", "counter"))
+    assert check_name(_metric("dynamo_BadCase_seconds", "gauge"))
+    assert check_name(_metric("dynamo_queue_depth", "gauge"))
+    assert check_name(_metric("dynamo_kv_usage_ratio", "histogram"))
+    assert check_name(_metric("dynamo_kv_blocks_total", "gauge"))
+    # too few segments: no component between prefix and unit
+    assert check_name(_metric("dynamo_total", "counter"))
+
+
+def test_rules_accept_good_names():
+    assert not check_name(_metric("dynamo_scheduler_preemptions_total", "counter"))
+    assert not check_name(_metric("dynamo_scheduler_step_duration_seconds", "histogram"))
+    assert not check_name(_metric("dynamo_kv_block_usage_ratio", "gauge"))
+    assert not check_name(_metric("dynamo_scheduler_active_slots", "gauge"))

@@ -1,0 +1,346 @@
+"""Telemetry correctness: exposition text validity, the unified registry,
+and per-request trace spans (scheduler-stamped stages end to end)."""
+
+import asyncio
+import json
+import math
+
+import pytest
+
+from dynamo_tpu.telemetry.exposition import (
+    histogram_series,
+    parse_exposition,
+)
+from dynamo_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+)
+from dynamo_tpu.telemetry.tracing import TraceRecorder, span_breakdown
+
+# ---------------------------------------------------------------- exposition
+
+
+def test_escaped_labels_round_trip():
+    """Backslashes, quotes, and newlines in label values (model names,
+    error strings) must survive render → parse unchanged."""
+    nasty = 'models\\v1"prod"\nllama'
+    c = Counter("dynamo_test_requests_total", "help")
+    c.inc(3, model=nasty, status="ok")
+    families = parse_exposition("\n".join(c.render()) + "\n")
+    fam = families["dynamo_test_requests_total"]
+    assert fam.type == "counter"
+    (sample,) = fam.samples
+    assert sample.labels["model"] == nasty
+    assert sample.labels["status"] == "ok"
+    assert sample.value == 3.0
+
+
+def test_escape_label_value_idempotent_inputs():
+    assert escape_label_value("plain") == "plain"
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+def test_help_text_escaped():
+    c = Counter("dynamo_test_total", "line one\nline two")
+    text = "\n".join(c.render())
+    # a raw newline in HELP would truncate the comment mid-line and leave
+    # an unparseable "line two" sample line
+    assert "# HELP dynamo_test_total line one\\nline two" in text
+
+
+def test_histogram_buckets_monotone_and_inf_equals_count():
+    h = Histogram("dynamo_test_duration_seconds", "help",
+                  buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.05):  # includes one beyond the ladder
+        h.observe(v, model="m")
+    families = parse_exposition("\n".join(h.render()) + "\n")
+    series = histogram_series(families["dynamo_test_duration_seconds"])
+    (entry,) = series.values()
+    bounds = [b for b, _ in entry["buckets"]]
+    counts = [c for _, c in entry["buckets"]]
+    assert bounds == [0.01, 0.1, 1.0, math.inf]
+    assert counts == sorted(counts), "cumulative bucket counts must be monotone"
+    assert counts[-1] == entry["count"] == 5
+    assert entry["sum"] == pytest.approx(5.605)
+
+
+def test_counter_monotonic_across_scrapes():
+    c = Counter("dynamo_test_events_total", "help")
+
+    def scrape():
+        fams = parse_exposition("\n".join(c.render()) + "\n")
+        return {
+            tuple(sorted(s.labels.items())): s.value
+            for s in fams["dynamo_test_events_total"].samples
+        }
+
+    c.inc(model="a")
+    c.inc(2, model="b")
+    first = scrape()
+    c.inc(model="a")
+    second = scrape()
+    for key, value in first.items():
+        assert second[key] >= value, "counters must never decrease"
+    assert second[(("model", "a"),)] == 2.0
+
+
+def test_gauge_set_and_dec():
+    g = Gauge("dynamo_test_inflight_requests", "help")
+    g.set(5, model="m")
+    g.dec(2, model="m")
+    fams = parse_exposition("\n".join(g.render()) + "\n")
+    assert fams["dynamo_test_inflight_requests"].type == "gauge"
+    assert fams["dynamo_test_inflight_requests"].samples[0].value == 3.0
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_registry_attach_merges_expositions():
+    """Engine-side instruments attached to the frontend registry render
+    in ONE scrape (the tentpole: one /metrics for every layer)."""
+    frontend = MetricsRegistry()
+    frontend.counter("dynamo_http_test_requests_total", "h").inc(model="m")
+    engine = MetricsRegistry()
+    engine.histogram("dynamo_scheduler_test_duration_seconds", "h").observe(0.1)
+    engine.callback_gauge("dynamo_kv_test_active_blocks", "h", lambda: 7)
+    frontend.attach(engine)
+    frontend.attach(engine)  # idempotent
+
+    families = parse_exposition(frontend.render())
+    assert "dynamo_http_test_requests_total" in families
+    assert "dynamo_scheduler_test_duration_seconds" in families
+    assert families["dynamo_kv_test_active_blocks"].samples[0].value == 7.0
+    assert "dynamo_scheduler_test_duration_seconds" in frontend.names()
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    a = reg.counter("dynamo_test_things_total", "h")
+    assert reg.counter("dynamo_test_things_total", "h") is a
+    with pytest.raises(ValueError):
+        reg.gauge("dynamo_test_things_total", "h")
+
+
+def test_callback_gauge_labeled_and_crash_safe():
+    reg = MetricsRegistry()
+    reg.callback_gauge(
+        "dynamo_test_worker_load_requests", "h",
+        lambda: [({"instance": "w1"}, 3), ({"instance": "w2"}, 5)],
+    )
+    reg.callback_gauge("dynamo_test_broken_requests", "h",
+                       lambda: 1 / 0)
+    families = parse_exposition(reg.render())
+    samples = {s.labels["instance"]: s.value
+               for s in families["dynamo_test_worker_load_requests"].samples}
+    assert samples == {"w1": 3.0, "w2": 5.0}
+    # the broken callback renders nothing — /metrics stays up
+    assert "dynamo_test_broken_requests" not in families
+
+
+# ---------------------------------------------------------------- tracing
+
+
+def test_span_breakdown_offsets_and_durations():
+    stages = [("http", 10.0), ("prefill", 10.5), ("completion", 11.0)]
+    spans = span_breakdown(stages, end=11.25)
+    assert [s["name"] for s in spans] == ["http", "prefill", "completion"]
+    assert [s["offset_s"] for s in spans] == [0.0, 0.5, 1.0]
+    assert [s["duration_s"] for s in spans] == [0.5, 0.5, 0.25]
+
+
+def test_trace_recorder_ring_and_jsonl(tmp_path):
+    path = tmp_path / "traces.jsonl"
+    rec = TraceRecorder(capacity=2, jsonl_path=str(path))
+    for i in range(3):
+        rec.record(f"req-{i}", "m", "success",
+                   [("http", 1.0), ("completion", 2.0)], end=2.5)
+    assert len(rec) == 2
+    assert rec.get("req-0") is None, "oldest trace evicted at capacity"
+    assert rec.get("req-2")["total_s"] == pytest.approx(1.5)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [t["request_id"] for t in lines] == ["req-0", "req-1", "req-2"]
+    assert lines[0]["spans"][0]["name"] == "http"
+
+
+# ------------------------------------------------------- scheduler end-to-end
+
+
+def _tiny_scheduler():
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8,
+    )
+    econfig = EngineConfig(
+        model=cfg, max_batch_size=2, max_model_len=32, kv_block_size=8,
+        num_kv_blocks=16, dtype="float32", prefill_buckets=[16],
+        allow_random_weights=True,
+    )
+    return Scheduler(ModelRunner(econfig), econfig)
+
+
+def _request(request_id, prompt, max_tokens=4):
+    from dynamo_tpu.engine.scheduler import EngineRequest
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling_options=SamplingOptions(temperature=0.0),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    return EngineRequest(
+        request_id=request_id, prompt=list(prompt), req=req,
+        ctx=Context(req).context, out_queue=asyncio.Queue(),
+    )
+
+
+async def _drain(er):
+    tokens = []
+    while True:
+        out = await asyncio.wait_for(er.out_queue.get(), timeout=60)
+        if out is None:
+            return tokens
+        tokens.extend(out.token_ids)
+
+
+@pytest.mark.asyncio
+async def test_scheduler_instruments_and_request_spans():
+    """One scrape of the scheduler's registry covers step/phase/ITL
+    histograms and KV gauges, and a served request's context carries the
+    admission → prefill → first_token → completion span marks."""
+    sched = _tiny_scheduler()
+    sched.start()
+    try:
+        ers = [_request("t-0", [1, 5, 9, 13]), _request("t-1", [1, 42, 7])]
+        for er in ers:
+            sched.add_request(er)
+        for er in ers:
+            assert len(await _drain(er)) == 4
+
+        families = parse_exposition(sched.registry.render())
+        step = histogram_series(
+            families["dynamo_scheduler_step_duration_seconds"])
+        (entry,) = step.values()
+        assert entry["count"] >= 1
+        counts = [c for _, c in entry["buckets"]]
+        assert counts == sorted(counts)
+        assert counts[-1] == entry["count"]
+
+        phases = {
+            key_val
+            for key in histogram_series(
+                families["dynamo_scheduler_phase_duration_seconds"])
+            for name, key_val in key if name == "phase"
+        }
+        assert {"admission", "prefill", "decode", "host_sync"} <= phases
+
+        # 2 requests × 4 tokens → 3 inter-token gaps each
+        itl = histogram_series(
+            families["dynamo_scheduler_inter_token_latency_seconds"])
+        assert list(itl.values())[0]["count"] == 6
+
+        assert families["dynamo_kv_total_blocks"].samples[0].value == 16
+        assert families["dynamo_scheduler_total_slots"].samples[0].value == 2
+        assert families["dynamo_scheduler_active_slots"].samples[0].value == 0
+
+        for er in ers:
+            names = [name for name, _ in er.ctx.stages]
+            required = ["queued", "admission", "prefill",
+                        "first_token", "completion"]
+            positions = [names.index(n) for n in required]
+            assert positions == sorted(positions), (
+                f"stages out of order: {names}")
+    finally:
+        await sched.stop()
+
+
+# ------------------------------------------------------- HTTP service surface
+
+
+@pytest.mark.asyncio
+async def test_http_trace_ids_and_debug_requests_endpoint():
+    """X-Request-Id is honored end to end: echoed on the response and
+    queryable as a span breakdown at GET /debug/requests/{id}."""
+    import aiohttp
+
+    from dynamo_tpu.http.service import HttpService, ModelManager
+    from dynamo_tpu.llm.engines.echo import EchoEngineFull
+
+    manager = ModelManager()
+    manager.add_chat_model("echo", EchoEngineFull())
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        body = {"model": "echo",
+                "messages": [{"role": "user", "content": "hi there"}]}
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"{base}/v1/chat/completions", json=body,
+                headers={"X-Request-Id": "trace-me-123"},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Request-Id"] == "trace-me-123"
+                await resp.json()
+
+            async with session.get(
+                f"{base}/debug/requests/trace-me-123") as resp:
+                assert resp.status == 200
+                trace = await resp.json()
+            assert trace["status"] == "success"
+            assert trace["model"] == "echo"
+            span_names = [s["name"] for s in trace["spans"]]
+            assert span_names[0] == "http"
+            assert trace["total_s"] >= 0
+
+            async with session.get(f"{base}/debug/requests/nope") as resp:
+                assert resp.status == 404
+
+            # the scrape the trace rode alongside is itself valid text
+            async with session.get(f"{base}/metrics") as resp:
+                families = parse_exposition(await resp.text())
+        dur = histogram_series(
+            families["dynamo_http_service_request_duration_seconds"])
+        entry = dur[(("model", "echo"),)]
+        counts = [c for _, c in entry["buckets"]]
+        assert counts == sorted(counts)
+        assert counts[-1] == entry["count"] >= 1
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_sidecar_server():
+    """dyn:// roles (router processor, token-level worker) expose their
+    registry on the --metrics-port sidecar listener."""
+    import aiohttp
+
+    from dynamo_tpu.telemetry.server import MetricsServer
+
+    reg = MetricsRegistry()
+    reg.counter("dynamo_kv_router_decisions_total", "h").inc(worker="w1")
+    server = await MetricsServer(reg, host="127.0.0.1", port=0).start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{server.port}/metrics") as resp:
+                assert resp.status == 200
+                families = parse_exposition(await resp.text())
+        fam = families["dynamo_kv_router_decisions_total"]
+        assert fam.samples[0].labels == {"worker": "w1"}
+    finally:
+        await server.stop()
