@@ -1,0 +1,41 @@
+"""dynlint: project-specific static analysis for dynamo-tpu.
+
+The reference Dynamo leans on rustc + clippy for its concurrency and
+purity guarantees; this package is the Python port's equivalent. It is
+a small AST-walking lint framework (no third-party deps, no imports of
+the code under analysis) with rules tuned to the invariants this
+codebase actually depends on:
+
+- the asyncio runtime/data plane must never block the event loop or
+  drop task exceptions (``async-blocking``, ``task-leak``,
+  ``lock-across-await``, ``silent-except``);
+- jitted/traced JAX code must stay pure and free of hidden host syncs
+  (``jit-impure`` — the static twin of the runtime ``host_sync``
+  phase histogram);
+- registered metric names must follow the house convention
+  (``metric-name`` — shared with scripts/check_metric_names.py).
+
+Entry points: ``scripts/dynlint.py`` (CLI, baseline-aware) and
+``tests/test_dynlint.py`` (tier-1 enforcement). Suppress a finding
+in place with ``# dynlint: allow(<rule>) - justification`` on the
+flagged line or the line above; record pre-existing debt in
+``scripts/dynlint_baseline.json`` (regenerate with
+``--update-baseline``). See docs/static_analysis.md.
+"""
+
+from .baseline import diff_against_baseline, load_baseline, write_baseline
+from .core import Finding, Rule, SourceModule, lint_paths, lint_source
+from .rules import all_rules, get_rules
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SourceModule",
+    "all_rules",
+    "get_rules",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "write_baseline",
+    "diff_against_baseline",
+]

@@ -1,0 +1,97 @@
+"""Baseline ("ratchet") file: recorded debt vs. new violations.
+
+The baseline maps line-number-free finding keys (``file:rule: message``)
+to occurrence counts. Existing debt stays recorded and visible; a NEW
+violation — any key whose live count exceeds its baselined count —
+fails the lint. Keys are line-free so unrelated edits that shift code
+up or down don't invalidate the file; moving or duplicating a violation
+*within* the same file is still absorbed, which is the deliberate
+trade-off every ratchet linter makes (the debt is per-site-identity,
+not per-coordinate).
+
+Stale entries (baselined debt that no longer exists) are reported as
+notes and dropped on ``--update-baseline`` so the ratchet only ever
+tightens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, NamedTuple, Sequence
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+class BaselineDiff(NamedTuple):
+    new: List[Finding]  # violations not covered by the baseline -> fail
+    known: List[Finding]  # covered by the baseline -> recorded debt
+    stale: List[str]  # keys with FEWER live findings than baselined -> prune
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Key -> allowed count. A missing file is an empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> Dict[str, int]:
+    counts = Counter(f.key() for f in findings)
+    entries = {k: counts[k] for k in sorted(counts)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "_comment": (
+                    "dynlint recorded debt. Do not add entries by hand: fix "
+                    "the finding or suppress it in place with a justified "
+                    "'# dynlint: allow(<rule>)'. Regenerate with "
+                    "'python scripts/dynlint.py --update-baseline' only when "
+                    "deliberately accepting new debt."
+                ),
+                "version": BASELINE_VERSION,
+                "entries": entries,
+            },
+            f,
+            indent=2,
+            sort_keys=False,
+        )
+        f.write("\n")
+    return dict(entries)
+
+
+def diff_against_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> BaselineDiff:
+    """Split live findings into new vs. known and spot stale debt.
+
+    When a key's live count exceeds its baseline count, the *excess*
+    findings (highest line numbers, i.e. most recently added in the
+    common append case) are reported as new.
+    """
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key(), []).append(f)
+    new: List[Finding] = []
+    known: List[Finding] = []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        group = sorted(group, key=lambda f: f.line)
+        known.extend(group[:allowed])
+        new.extend(group[allowed:])
+    # stale = OVER-allowance, not just zero live findings: fixing one of
+    # N identical debt items must shrink the recorded count, or the freed
+    # slot would silently absorb a future new identical violation
+    stale = sorted(
+        k for k, allowed in baseline.items()
+        if len(by_key.get(k, ())) < allowed
+    )
+    new.sort(key=lambda f: (f.file, f.line, f.rule))
+    known.sort(key=lambda f: (f.file, f.line, f.rule))
+    return BaselineDiff(new=new, known=known, stale=stale)

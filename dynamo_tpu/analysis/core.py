@@ -1,0 +1,290 @@
+"""dynlint framework: source model, rule protocol, suppressions, runner.
+
+Design constraints (why this is not just flake8 config):
+
+- Rules need *semantic* context a line-regex can't see — "is this call
+  inside an ``async def``", "is this function traced by ``jax.jit``",
+  "is this lock held across an ``await``". Everything here is AST.
+- The analyzer must never import the code under analysis (importing
+  dynamo_tpu modules pulls in jax; lint must run on a bare CPU box in
+  CI before any heavy dep is touched). Parsing only.
+- Findings are keyed *without* line numbers (``file:rule: message``)
+  so the checked-in baseline survives unrelated edits shifting lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, NamedTuple, Optional, Sequence
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SourceModule",
+    "dotted_name",
+    "iter_python_files",
+    "lint_paths",
+    "lint_source",
+]
+
+
+class Finding(NamedTuple):
+    """One rule violation at one source location."""
+
+    rule: str
+    file: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Line-number-free identity used for baseline matching."""
+        return f"{self.file}:{self.rule}: {self.message}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def render_github(self) -> str:
+        return (
+            f"::error file={self.file},line={self.line},"
+            f"title=dynlint/{self.rule}::{self.message}"
+        )
+
+
+# ``# dynlint: allow(rule-a, rule-b) - why this is fine``
+_ALLOW_RE = re.compile(r"#\s*dynlint:\s*allow\(([a-zA-Z0-9_,\- ]+)\)")
+
+
+class SourceModule:
+    """One parsed file plus the derived context rules share.
+
+    ``rel`` is the path findings are reported under; for real files it
+    is relative to the lint root's parent (``dynamo_tpu/http/service.py``),
+    for in-memory snippets (tests) it is whatever the caller passed.
+    """
+
+    def __init__(self, rel: str, source: str, tree: Optional[ast.AST] = None):
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source, filename=rel)
+        self._aliases: Optional[Dict[str, str]] = None
+
+    # --- import alias map -------------------------------------------------
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        """Local name -> canonical dotted path, from this module's imports.
+
+        ``import threading``            -> {"threading": "threading"}
+        ``import subprocess as sp``     -> {"sp": "subprocess"}
+        ``from time import sleep``      -> {"sleep": "time.sleep"}
+        ``from jax import jit as j``    -> {"j": "jax.jit"}
+        """
+        if self._aliases is None:
+            amap: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        amap[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0]
+                        )
+                elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                    for a in node.names:
+                        if a.name == "*":
+                            continue
+                        amap[a.asname or a.name] = f"{node.module}.{a.name}"
+            self._aliases = amap
+        return self._aliases
+
+    def resolve_call(self, func: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a call target, or None."""
+        return dotted_name(func, self.aliases)
+
+    # --- suppressions -----------------------------------------------------
+
+    def allowed_rules_at(self, line: int) -> frozenset:
+        """Rules suppressed for a finding on 1-indexed ``line``.
+
+        A suppression counts on the flagged line itself (trailing
+        comment), or on the immediately preceding line ONLY when that
+        line is a standalone comment — a trailing allow on the previous
+        line of code suppresses that line alone, never its neighbors.
+        """
+        allowed: set = set()
+
+        def collect(idx: int) -> None:
+            m = _ALLOW_RE.search(self.lines[idx])
+            if m:
+                allowed.update(
+                    part.strip() for part in m.group(1).split(",") if part.strip()
+                )
+
+        if 0 <= line - 1 < len(self.lines):
+            collect(line - 1)
+        if 0 <= line - 2 < len(self.lines) and \
+                self.lines[line - 2].lstrip().startswith("#"):
+            collect(line - 2)
+        return frozenset(allowed)
+
+    # --- traversal helpers ------------------------------------------------
+
+    def async_functions(self) -> Iterator[ast.AsyncFunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield node
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.rel, getattr(node, "lineno", 0), message)
+
+
+def dotted_name(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """``ast`` expression -> canonical dotted path through import aliases.
+
+    ``sp.run`` with ``import subprocess as sp`` -> ``subprocess.run``;
+    ``sleep`` with ``from time import sleep`` -> ``time.sleep``; a bare
+    un-imported name resolves to itself (covers builtins like ``open``).
+    An attribute chain only resolves when its root Name is a known
+    import — a local variable that happens to be called ``requests`` or
+    ``socket`` must NOT make ``requests.get(rid)`` look like the
+    requests library. Chains rooted in non-Name expressions
+    (``self.x.y()``) resolve to None likewise.
+    """
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        parts = [node.attr]
+        cur = node.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name) and cur.id in aliases:
+            return ".".join([aliases[cur.id]] + list(reversed(parts)))
+    return None
+
+
+def body_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's subtree WITHOUT descending into nested function
+    definitions or lambdas: a nested ``def`` runs in its own (possibly
+    sync, possibly deferred) context, so e.g. a blocking call inside it
+    is not a blocking call in *this* function's async context. Nested
+    ``async def`` bodies are still analyzed — the module walk visits
+    every AsyncFunctionDef independently.
+    """
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Rule:
+    """A named check over one SourceModule."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"<rule {self.name}>"
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+
+def iter_python_files(root: str) -> Iterator[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _run_rules(
+    mod: SourceModule, rules: Sequence[Rule]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for rule in rules:
+        for finding in rule.check(mod):
+            allowed = mod.allowed_rules_at(finding.line)
+            if rule.name in allowed or "all" in allowed:
+                continue
+            out.append(finding)
+    return out
+
+
+def lint_source(
+    source: str, rules: Sequence[Rule], rel: str = "<snippet>.py"
+) -> List[Finding]:
+    """Lint an in-memory snippet — the test-fixture entry point."""
+    return _run_rules(SourceModule(rel, source), rules)
+
+
+def report_rel(path: str) -> str:
+    """The scope-independent key path for one source file.
+
+    Ascend from the file's own directory through enclosing packages
+    (directories holding ``__init__.py``) and report relative to the
+    outermost package's parent — a file inside ``dynamo_tpu`` keys as
+    ``dynamo_tpu/engine/guided.py`` whether the lint was pointed at the
+    repo, the package, a subpackage, or the file itself, so baseline
+    entries always match. A file with no enclosing package keys as its
+    bare name.
+    """
+    path = os.path.abspath(path)
+    top = None
+    cur = os.path.dirname(path)
+    while os.path.exists(os.path.join(cur, "__init__.py")):
+        top = cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        cur = parent
+    base = os.path.dirname(top) if top is not None else os.path.dirname(path)
+    return os.path.relpath(path, base).replace(os.sep, "/")
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Sequence[Rule]
+) -> List[Finding]:
+    """Lint every ``.py`` under each path. Each file is keyed by its
+    package-relative path regardless of how the scan was scoped (see
+    ``report_rel``); overlapping path arguments are deduplicated so a
+    file is never counted twice against the baseline ratchet. A path
+    that does not exist raises — an empty scan must never read as a
+    clean one."""
+    findings: List[Finding] = []
+    seen: set = set()
+    for root in paths:
+        if not os.path.exists(root):
+            raise FileNotFoundError(f"lint path does not exist: {root}")
+        for path in iter_python_files(os.path.abspath(root)):
+            if path in seen:
+                continue
+            seen.add(path)
+            rel = report_rel(path)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                mod = SourceModule(rel, source)
+            except SyntaxError as e:
+                findings.append(
+                    Finding("parse-error", rel, e.lineno or 0,
+                            f"could not parse: {e.msg}")
+                )
+                continue
+            findings.extend(_run_rules(mod, rules))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

@@ -1,0 +1,37 @@
+"""dynlint rule registry. Rules self-describe; the CLI and tests pull
+the catalog from here so adding a rule is one import line."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from ..core import Rule
+from .async_blocking import AsyncBlockingRule
+from .jit_impure import JitImpureRule
+from .lock_across_await import LockAcrossAwaitRule
+from .metric_name import MetricNameRule
+from .silent_except import SilentExceptRule
+from .task_leak import TaskLeakRule
+
+_RULE_CLASSES = (
+    AsyncBlockingRule,
+    TaskLeakRule,
+    LockAcrossAwaitRule,
+    JitImpureRule,
+    SilentExceptRule,
+    MetricNameRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def get_rules(names: Sequence[str]) -> List[Rule]:
+    by_name: Dict[str, Rule] = {r.name: r for r in all_rules()}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise KeyError(
+            f"unknown rule(s) {unknown}; available: {sorted(by_name)}"
+        )
+    return [by_name[n] for n in names]

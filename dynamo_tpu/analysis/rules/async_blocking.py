@@ -1,0 +1,72 @@
+"""async-blocking: blocking calls inside ``async def``.
+
+One blocking call on the event loop stalls EVERY in-flight request on
+that loop — the scheduler stops stepping, heartbeats stop ponging (the
+subprocess host then SIGKILLs a healthy child as "wedged"), and ITL
+p99 explodes. The runtime's own rule of thumb (utils/profiling.py,
+subprocess_host.py docstrings) is "run sync work through
+run_in_executor"; this check makes that rule enforceable.
+
+Matched by canonical dotted name through import aliases, so
+``from time import sleep; sleep(1)`` is caught, and nested sync ``def``
+bodies are skipped (they run wherever they're called, typically an
+executor).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import ast
+
+from ..core import Finding, Rule, SourceModule, body_nodes
+
+# canonical dotted names that block the calling thread
+BLOCKING_CALLS = {
+    "time.sleep": "use 'await asyncio.sleep(...)'",
+    "os.system": "use 'await asyncio.create_subprocess_shell(...)'",
+    "os.wait": "use 'await proc.wait()' on an asyncio subprocess",
+    "os.waitpid": "use 'await proc.wait()' on an asyncio subprocess",
+    "subprocess.run": "use 'await asyncio.create_subprocess_exec(...)'",
+    "subprocess.call": "use 'await asyncio.create_subprocess_exec(...)'",
+    "subprocess.check_call": "use 'await asyncio.create_subprocess_exec(...)'",
+    "subprocess.check_output": "use 'await asyncio.create_subprocess_exec(...)'",
+    "subprocess.getoutput": "use 'await asyncio.create_subprocess_shell(...)'",
+    "subprocess.getstatusoutput": "use 'await asyncio.create_subprocess_shell(...)'",
+    "subprocess.Popen": "use 'await asyncio.create_subprocess_exec(...)'",
+    "socket.create_connection": "use 'await asyncio.open_connection(...)'",
+    "socket.getaddrinfo": "use 'await loop.getaddrinfo(...)'",
+    "socket.gethostbyname": "use 'await loop.getaddrinfo(...)'",
+    "urllib.request.urlopen": "use an executor or an async http client",
+    "requests.get": "use an executor or an async http client",
+    "requests.post": "use an executor or an async http client",
+    "requests.put": "use an executor or an async http client",
+    "requests.patch": "use an executor or an async http client",
+    "requests.delete": "use an executor or an async http client",
+    "requests.head": "use an executor or an async http client",
+    "requests.request": "use an executor or an async http client",
+    "open": "open via 'run_in_executor' (file IO blocks the loop)",
+}
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = (
+        "blocking call (sleep/subprocess/socket/file IO/requests) inside "
+        "an async function stalls the whole event loop"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for fn in mod.async_functions():
+            for node in body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = mod.resolve_call(node.func)
+                hint = BLOCKING_CALLS.get(name or "")
+                if hint is None:
+                    continue
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"blocking call {name}() in 'async def {fn.name}' — {hint}",
+                )

@@ -1,0 +1,177 @@
+"""jit-impure: side effects and host syncs inside traced JAX code.
+
+A function under ``jax.jit``/``pjit``/``shard_map`` runs ONCE as a
+Python trace, then replays as compiled XLA. Anything impure is wrong
+twice over:
+
+- **Mutation** (``self.x = ...``, ``global``) happens at trace time
+  only — silently absent from every subsequent call, a classic
+  "worked in the repl" bug.
+- **Host syncs** (``.item()``, ``np.asarray``, ``jax.device_get``,
+  ``block_until_ready``) either fail under tracing or, worse, force a
+  device→host round-trip per dispatch — the exact stall PR 1's
+  ``host_sync`` phase histogram exists to measure at runtime. This
+  rule is its static twin: catch the stall before it ships.
+- ``print`` fires once at trace time (misleading) — ``jax.debug.print``
+  is the traced form and is not flagged.
+
+Traced functions are found two ways: jit-ish decorators (including
+``functools.partial(jax.jit, ...)``) and the call form
+``jax.jit(fn)``/``jax.jit(lambda ...)`` resolved against same-module
+definitions — which is how engine/model_runner.py builds all its
+compiled steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+import ast
+
+from ..core import Finding, Rule, SourceModule
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+JIT_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.pjit",
+    "pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.shard_map",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+
+# dotted call names that force a device->host sync (or fail) under trace.
+# CANONICAL module names only: alias resolution maps "import numpy as np;
+# np.asarray" to "numpy.asarray", so "np.*" keys would never match
+HOST_SYNC_CALLS = {
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+    "jax.block_until_ready",
+}
+# method names that host-sync regardless of receiver
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_jit_wrapper(mod: SourceModule, node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``partial(jax.jit, ...)`` expressions."""
+    name = mod.resolve_call(node) if not isinstance(node, ast.Call) else None
+    if name in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        called = mod.resolve_call(node.func)
+        if called in JIT_WRAPPERS:
+            return True
+        if called in ("functools.partial", "partial") and node.args:
+            return _is_jit_wrapper(mod, node.args[0])
+    return False
+
+
+def _collect_traced(mod: SourceModule) -> List[Tuple[str, FuncNode]]:
+    """(display name, function node) for every traced function."""
+    defs: Dict[str, FuncNode] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    traced: List[Tuple[str, FuncNode]] = []
+    seen: Set[int] = set()
+
+    def add(name: str, fn: FuncNode) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            traced.append((name, fn))
+
+    # decorator form
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_wrapper(mod, dec):
+                    add(node.name, node)
+    # call form: jax.jit(fn) / jax.jit(lambda: ...)
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        if not _is_jit_wrapper(mod, node.func):
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Lambda):
+            add("<lambda>", target)
+        elif isinstance(target, ast.Name) and target.id in defs:
+            add(target.id, defs[target.id])
+    return traced
+
+
+class JitImpureRule(Rule):
+    name = "jit-impure"
+    description = (
+        "side effect or host sync inside a jitted/traced function: "
+        "mutation vanishes after trace, host syncs stall every dispatch"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for name, fn in _collect_traced(mod):
+            where = f"traced function '{name}'"
+            global_names: Set[str] = set()
+            # the whole subtree is traced — including nested defs, which
+            # jit inlines when called — so walk it all
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    global_names.update(node.names)
+                    continue
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            yield mod.finding(
+                                self.name,
+                                node,
+                                f"mutates self.{t.attr} in {where} — the "
+                                "write happens at trace time only",
+                            )
+                        elif isinstance(t, ast.Name) and t.id in global_names:
+                            yield mod.finding(
+                                self.name,
+                                node,
+                                f"mutates global '{t.id}' in {where} — the "
+                                "write happens at trace time only",
+                            )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                called = mod.resolve_call(node.func)
+                if called == "print":
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"print() in {where} fires at trace time only — "
+                        "use jax.debug.print",
+                    )
+                elif called in HOST_SYNC_CALLS:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"host-sync call {called}() in {where} — forces a "
+                        "device->host transfer per dispatch",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in HOST_SYNC_METHODS
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f".{node.func.attr}() in {where} — host-syncs the "
+                        "traced value",
+                    )

@@ -1,0 +1,100 @@
+"""lock-across-await: thread locks in async code, locks held over await.
+
+Two hazards, both deadlock-shaped:
+
+1. A ``threading.Lock`` acquired on the event loop blocks the whole
+   loop while contended — and if the holder needs the loop to make
+   progress (the common case here: a callback completes a future), the
+   process deadlocks. Async code wants ``asyncio.Lock``.
+2. ANY lock — even an ``asyncio.Lock`` via sync ``with`` — held across
+   an ``await`` extends the critical section over an arbitrary number
+   of scheduler round-trips; every other acquirer stalls behind a
+   suspension point they can't see. (``async with lock:`` is the
+   reviewed, intentional form and is not flagged.)
+
+Lock-ish context managers are recognized structurally
+(``threading.Lock()`` etc. inline) or by name (a last path segment
+containing ``lock``/``mutex``) — heuristic on purpose; name your locks
+like locks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import ast
+
+from ..core import Finding, Rule, SourceModule, body_nodes, dotted_name
+
+THREADING_PRIMITIVES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "multiprocessing.Lock",
+    "multiprocessing.RLock",
+}
+
+
+def _lockish_name(mod: SourceModule, expr: ast.AST) -> Optional[str]:
+    """Human-readable name if ``expr`` looks like a lock, else None."""
+    if isinstance(expr, ast.Call):
+        called = mod.resolve_call(expr.func)
+        if called in THREADING_PRIMITIVES:
+            return called + "()"
+        return None
+    name = dotted_name(expr, mod.aliases)
+    if name is None and isinstance(expr, ast.Attribute):
+        name = expr.attr  # self._lock and friends
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1].lower()
+    if "lock" in last or "mutex" in last:
+        return name
+    return None
+
+
+def _contains_await(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        for sub in ast.walk(node)
+    )
+
+
+class LockAcrossAwaitRule(Rule):
+    name = "lock-across-await"
+    description = (
+        "threading lock used in async code, or any lock held across an "
+        "await — both stall or deadlock the event loop"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for fn in mod.async_functions():
+            for node in body_nodes(fn):
+                # (1) thread-lock constructed in async context
+                if isinstance(node, ast.Call):
+                    called = mod.resolve_call(node.func)
+                    if called in THREADING_PRIMITIVES:
+                        yield mod.finding(
+                            self.name,
+                            node,
+                            f"{called}() created in 'async def {fn.name}' — "
+                            "use asyncio synchronization primitives",
+                        )
+                    continue
+                # (2) sync `with <lock>:` whose body awaits
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lock = _lockish_name(mod, item.context_expr)
+                        if lock is None:
+                            continue
+                        if any(_contains_await(stmt) for stmt in node.body):
+                            yield mod.finding(
+                                self.name,
+                                node,
+                                f"lock '{lock}' held across an await in "
+                                f"'async def {fn.name}' — the critical "
+                                "section spans scheduler round-trips",
+                            )
+                        break

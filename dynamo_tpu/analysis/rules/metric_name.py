@@ -1,0 +1,33 @@
+"""metric-name: registered instrument names follow the house convention.
+
+Thin rule wrapper over analysis/metric_names.py (the engine
+scripts/check_metric_names.py also shims); one implementation, two
+front doors — the historical standalone CLI keeps its exit-code
+contract, and dynlint folds the same check into the baseline/
+suppression machinery every other rule gets.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, Rule, SourceModule
+from ..metric_names import check_name, iter_tree_metrics
+
+
+class MetricNameRule(Rule):
+    name = "metric-name"
+    description = (
+        "registered Prometheus instrument name violates "
+        "dynamo_<component>_<name>_<unit>"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for metric in iter_tree_metrics(mod.tree, mod.rel):
+            for problem in check_name(metric):
+                yield Finding(
+                    self.name,
+                    mod.rel,
+                    metric.line,
+                    f"{metric.name}: {problem}",
+                )

@@ -1,0 +1,90 @@
+"""silent-except: broad handlers that neither log nor re-raise.
+
+``except Exception: pass`` turns every future bug in the guarded block
+into a silent no-op — the failure class that motivated this analyzer:
+nothing crashes, a counter just stops moving. Narrow handlers
+(``except ConnectionResetError``) are presumed deliberate and are not
+flagged; only ``except Exception``, ``except BaseException``, and bare
+``except`` qualify, and only when the body contains no raise and no
+call that surfaces the error (logger/logging/warnings/traceback/print).
+
+The runtime has legitimate best-effort sites (closing a dead writer,
+probing a tokenizer vocab); those carry an inline
+``# dynlint: allow(silent-except)`` with the justification right where
+a reviewer will read it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import ast
+
+from ..core import Finding, Rule, SourceModule
+
+BROAD = {"Exception", "BaseException"}
+LOG_ROOTS = {"logger", "logging", "log", "warnings", "traceback"}
+LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print_exc", "print_exception", "print_stack", "format_exc",
+    # propagating into a Future/callback IS observing the error
+    "set_exception",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _surfaces_error(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                return True
+            if isinstance(func, ast.Attribute):
+                if func.attr in LOG_METHODS:
+                    return True
+                root = func.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in LOG_ROOTS:
+                    return True
+    return False
+
+
+class SilentExceptRule(Rule):
+    name = "silent-except"
+    description = (
+        "broad except that neither logs nor re-raises: future failures "
+        "in the guarded block become silent no-ops"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _surfaces_error(node):
+                continue
+            caught = "bare except" if node.type is None else (
+                f"except {ast.unparse(node.type)}"
+            )
+            yield mod.finding(
+                self.name,
+                node,
+                f"{caught} swallows the error — log it, re-raise, or "
+                "narrow the exception type",
+            )

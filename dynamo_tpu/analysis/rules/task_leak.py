@@ -1,0 +1,67 @@
+"""task-leak: fire-and-forget ``asyncio.create_task``/``ensure_future``.
+
+Two distinct failure modes hide behind a discarded task handle:
+
+1. **Garbage collection** — the event loop holds only a weak reference
+   to tasks; with no strong reference the task can be collected
+   mid-flight and silently stop (runtime/network.py learned this the
+   hard way — see ResponseReceiver._pump_task).
+2. **Swallowed exceptions** — an unobserved task's exception surfaces
+   only as a destructor log line at GC time, long after the causal
+   context is gone.
+
+A handle is "kept" if the call result is assigned, stored, awaited,
+passed on, or returned. Only a bare expression statement — the value
+thrown away — is flagged. TaskGroup-style receivers (``tg``,
+``task_group``) are exempt: the group owns its tasks by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import ast
+
+from ..core import Finding, Rule, SourceModule
+
+SPAWN_ATTRS = {"create_task", "ensure_future"}
+GROUP_RECEIVERS = {"tg", "task_group", "taskgroup", "group", "nursery"}
+
+
+def _is_spawn(mod: SourceModule, call: ast.Call) -> bool:
+    func = call.func
+    name = mod.resolve_call(func)
+    if name in ("asyncio.create_task", "asyncio.ensure_future"):
+        return True
+    if isinstance(func, ast.Attribute) and func.attr in SPAWN_ATTRS:
+        # loop.create_task / runtime-ish spawners; skip TaskGroups, which
+        # keep strong references to their children themselves
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id.lower() in GROUP_RECEIVERS:
+            return False
+        return True
+    return False
+
+
+class TaskLeakRule(Rule):
+    name = "task-leak"
+    description = (
+        "create_task/ensure_future result discarded: the task can be "
+        "garbage-collected mid-flight and its exception is never observed"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            value = node.value
+            if isinstance(value, ast.Await):
+                continue  # awaited inline: observed
+            if isinstance(value, ast.Call) and _is_spawn(mod, value):
+                target = mod.resolve_call(value.func) or ast.unparse(value.func)
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"{target}() result discarded — keep a strong reference "
+                    "and observe its exception (add_done_callback or await)",
+                )

@@ -9,6 +9,7 @@ summary goes to stderr so result streams stay machine-parseable.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import sys
 import time
@@ -38,10 +39,17 @@ def itl_stats(gaps: List[float]) -> dict:
     }
 
 
+def _load_jsonl(path: str) -> List[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
 async def run_batch(flags, engine, mdc, path: str) -> None:
     name = flags.model_name or (mdc.display_name if mdc else "echo")
-    with open(path) as f:
-        lines = [json.loads(line) for line in f if line.strip()]
+    # off-loop read: the engine (and its KV publishers) may already be
+    # serving on this loop while a big batch file loads
+    lines = await asyncio.get_running_loop().run_in_executor(
+        None, _load_jsonl, path)
     ttfts: List[float] = []
     all_gaps: List[float] = []
     for i, entry in enumerate(lines):

@@ -387,5 +387,6 @@ class KvTransferClient:
             self.writer.close()
             try:
                 await self.writer.wait_closed()
+            # dynlint: allow(silent-except) - best-effort close of a possibly-dead peer
             except Exception:
                 pass

@@ -127,7 +127,10 @@ class HttpService:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
-        self.traces.close()
+        # close() joins the trace writer thread — off-loop, so a hung
+        # JSONL filesystem can't stall the rest of shutdown
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.traces.close)
 
     # ---------- helpers ----------
 
@@ -459,6 +462,10 @@ class ModelWatcher:
         self._clients: Dict[str, Client] = {}
         self._task: Optional[asyncio.Task] = None
         self._watcher = None
+        # strong refs to in-flight client.close() tasks spawned from the
+        # sync delete path: a bare ensure_future can be GC'd mid-close
+        # and would drop any close() exception on the floor
+        self._closing: set = set()
 
     async def start(self) -> None:
         prefix = f"{self.namespace}/{MODEL_REGISTRY_PREFIX}"
@@ -510,7 +517,16 @@ class ModelWatcher:
         self.manager.remove_model(name)
         client = self._clients.pop(name, None)
         if client is not None:
-            asyncio.ensure_future(client.close())
+            task = asyncio.ensure_future(client.close())
+            self._closing.add(task)
+
+            def _done(t: asyncio.Task, model: str = name) -> None:
+                self._closing.discard(t)
+                if not t.cancelled() and t.exception() is not None:
+                    logger.warning("closing client for removed model %s "
+                                   "failed: %s", model, t.exception())
+
+            task.add_done_callback(_done)
         logger.info("model %s removed", name)
 
     async def stop(self) -> None:
@@ -518,5 +534,9 @@ class ModelWatcher:
             self._watcher.cancel()
         if self._task is not None:
             self._task.cancel()
+        # drain close() tasks spawned by deletes racing shutdown, so their
+        # exceptions are observed before the loop is torn down under them
+        if self._closing:
+            await asyncio.gather(*list(self._closing), return_exceptions=True)
         for client in self._clients.values():
             await client.close()

@@ -16,6 +16,7 @@ one thread); ``ShardedKvIndexer`` partitions workers for scale.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Dict, List, Optional, Set
 
@@ -199,7 +200,10 @@ class _NativeTreeAdapter:
 def _make_tree(expiration_s: Optional[float], use_native: Optional[bool]):
     try:
         from .. import native
-    except Exception:
+    except Exception as e:
+        # pure-Python fallback is the design, but WHY the native core
+        # failed to import must be discoverable, not silent
+        logging.getLogger(__name__).debug("native core unavailable: %s", e)
         native = None
     if use_native is None and native is not None and native.disabled_by_env():
         use_native = False  # operator kill-switch (explicit True overrides)

@@ -8,6 +8,7 @@ lib/llm/src/recorder.rs + kv_router/recorder.rs.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import logging
 import os
@@ -39,24 +40,39 @@ class KvRecorder:
         self._task = None
         self._sub = None
         self._fh = None
+        # single dedicated writer thread: every file op (open, write,
+        # rotate, close) goes through it in submission order, so stop()
+        # can never close the handle under an in-flight write
+        self._io: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
     async def start(self) -> "KvRecorder":
-        self._fh = open(self.path, "a")
+        # file IO runs off-loop: this recorder shares the event loop with
+        # the router hot path, and an open() or flush() against a slow
+        # (network) filesystem must not stall it
+        self._io = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kv-recorder")
+        loop = asyncio.get_running_loop()
+        self._fh = await loop.run_in_executor(self._io, open, self.path, "a")
         self._sub = await self.component.subscribe_event(KV_EVENT_SUBJECT)
         self._task = self.component.drt.runtime.spawn(self._consume())
         return self
 
     async def _consume(self) -> None:
+        loop = asyncio.get_running_loop()
         async for msg in self._sub:
             try:
                 event = msgpack.unpackb(msg.payload, raw=False)
-                self._fh.write(json.dumps({"ts": time.time(), "event": event}) + "\n")
-                self._fh.flush()
+                line = json.dumps({"ts": time.time(), "event": event}) + "\n"
+                await loop.run_in_executor(self._io, self._write_line, line)
                 self.count += 1
                 if self.max_bytes and self._fh.tell() > self.max_bytes:
-                    self._rotate()
+                    await loop.run_in_executor(self._io, self._rotate)
             except Exception:
                 logger.exception("record failed")
+
+    def _write_line(self, line: str) -> None:
+        self._fh.write(line)
+        self._fh.flush()
 
     def _rotate(self) -> None:
         self._fh.close()
@@ -69,7 +85,21 @@ class KvRecorder:
         if self._task:
             self._task.cancel()
         if self._fh:
-            self._fh.close()
+            # close through the writer thread, resolving self._fh AT RUN
+            # time: FIFO ordering puts this after any queued write or
+            # _rotate, and a rotate that raced shutdown swapped the handle
+            # — binding self._fh.close here would close the old one and
+            # leak the new
+            await asyncio.get_running_loop().run_in_executor(
+                self._io, self._close_fh)
+        if self._io:
+            self._io.shutdown(wait=False)
+            self._io = None
+
+    def _close_fh(self) -> None:
+        fh, self._fh = self._fh, None
+        if fh is not None:
+            fh.close()
 
 
 def iter_recorded_events(path: str) -> Iterator[RouterEvent]:

@@ -485,6 +485,7 @@ async def _child_main(engine_path: str) -> int:
         engine = await _build_child_engine(
             engine_path, init.get("engine_args") or {}, event_q.put_nowait
         )
+    # dynlint: allow(silent-except) - error IS surfaced: the init_error frame below
     except BaseException as e:  # report, don't just die: init errors are
         write_frame(writer, {          # deterministic, not restartable
             "t": "init_error", "error": f"{type(e).__name__}: {e}",
@@ -503,6 +504,7 @@ async def _child_main(engine_path: str) -> int:
         except asyncio.CancelledError:
             await send({"t": "end", "id": rid})
             raise
+        # dynlint: allow(silent-except) - error IS surfaced: relayed as a wire frame
         except BaseException as e:
             await send({
                 "t": "error", "id": rid,
@@ -524,6 +526,7 @@ async def _child_main(engine_path: str) -> int:
             if hasattr(engine, "metrics"):
                 try:
                     pong["m"] = engine.metrics()
+                # dynlint: allow(silent-except) - best-effort metrics must never kill the pong
                 except Exception:
                     pass
             await send(pong)

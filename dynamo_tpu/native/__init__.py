@@ -247,6 +247,7 @@ class CApi:
         if getattr(self, "_sink_ref", None) is not None:
             try:
                 self._lib.dt_capi_set_sink(None, None)
+            # dynlint: allow(silent-except) - destructor at interpreter shutdown; nowhere to report
             except Exception:  # pragma: no cover - interpreter shutdown
                 pass
 

@@ -192,8 +192,10 @@ class Client(AsyncEngine):
                     f"_stats.{self.endpoint.subject(iid)}", b"", timeout=timeout
                 )
                 out[iid] = msgpack.unpackb(raw, raw=False)
-            except Exception:
-                pass
+            except Exception as e:
+                # dropping the answer is the contract; dropping the trace
+                # of WHY an instance never answers is not
+                logger.debug("stats scrape from %s failed: %s", iid, e)
 
         await asyncio.gather(*(one(i) for i in self.instance_ids()))
         return out

@@ -310,5 +310,10 @@ class ServingEndpoint:
             self.registered = False
             try:
                 await drt.discovery.kv_delete(self.endpoint.etcd_key(self.instance_id))
-            except Exception:
-                pass
+            except Exception as e:
+                # shutdown proceeds regardless, but a failed deregistration
+                # leaves a ghost instance for routers until the lease lapses
+                # — that is worth a line in the log, not silence
+                logger.warning("deregistering %s failed (instance stays "
+                               "visible until its lease expires): %s",
+                               self.endpoint.path(), e)

@@ -164,6 +164,7 @@ def _model_cards(config: dict) -> Dict[str, str]:
                         from ..llm.model_card import ModelDeploymentCard
 
                         cards[v] = ModelDeploymentCard.from_local_path(v).checksum
+                    # dynlint: allow(silent-except) - failure IS recorded: checksum "unavailable"
                     except Exception:  # unreadable model dir: record absence
                         cards[v] = "unavailable"
                 else:

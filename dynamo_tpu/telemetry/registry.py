@@ -157,6 +157,7 @@ class CallbackGauge:
                     if isinstance(v, bool) or not isinstance(v, (int, float)):
                         continue
                     samples.append((dict(labels), float(v)))
+        # dynlint: allow(silent-except) - a broken callback must not take /metrics down
         except Exception:
             return []
         if not samples:
@@ -194,8 +195,9 @@ class CallbackGauges:
                 name = f"{self.prefix}_{k}"
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {float(v)}")
+        # dynlint: allow(silent-except) - a broken engine must not take /metrics down
         except Exception:
-            return []  # a broken engine must not take /metrics down
+            return []
         return lines
 
 

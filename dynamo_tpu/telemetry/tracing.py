@@ -19,6 +19,8 @@ import collections
 import json
 import logging
 import os
+import queue
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -31,21 +33,26 @@ def span_breakdown(stages: List[Tuple[str, float]],
                    end: Optional[float] = None) -> List[dict]:
     """[(name, t_monotonic)] → spans with offsets and durations.
 
-    Each stage's duration runs to the NEXT stage (the last one to ``end``,
-    defaulting to now) — the structured twin of
+    Span ``X`` is the time from the PREVIOUS mark to the moment ``X``
+    was stamped — marks record phase completions (the scheduler stamps
+    ``prefill`` when prefill finishes), so attributing each gap to its
+    closing mark is what makes "prefill took 41ms" land under
+    ``prefill`` rather than under whatever mark happened to precede it.
+    The first mark anchors t=0; the tail from the last mark to ``end``
+    (default: now) is reported as ``egress``. The structured twin of
     ``utils.logging.stage_summary``.
     """
     if not stages:
         return []
     t0 = stages[0][1]
-    closed = list(stages) + [("", end if end is not None else time.monotonic())]
+    closed = list(stages) + [("egress", end if end is not None else time.monotonic())]
     return [
         {
-            "name": name,
+            "name": name_next,
             "offset_s": round(t - t0, 6),
             "duration_s": round(max(0.0, t_next - t), 6),
         }
-        for (name, t), (_, t_next) in zip(closed, closed[1:])
+        for (_, t), (name_next, t_next) in zip(closed, closed[1:])
     ]
 
 
@@ -53,17 +60,56 @@ class TraceRecorder:
     """Bounded ring of completed request traces (+ optional JSONL sink)."""
 
     def __init__(self, capacity: int = 512,
-                 jsonl_path: Optional[str] = None):
+                 jsonl_path: Optional[str] = None,
+                 jsonl_queue_size: int = 1024):
         self.capacity = capacity
         self.jsonl_path = (
             jsonl_path if jsonl_path is not None
             else os.environ.get(TRACE_JSONL_ENV) or None
         )
-        # one persistent line-buffered handle — record() runs on the event
-        # loop, so a per-request open()/close() would stall every
-        # concurrent request on a slow disk
+        # record() runs on the event loop (HttpService calls it per
+        # request), so ALL sink IO — the open included — happens on a
+        # dedicated single writer thread behind a BOUNDED queue: FIFO
+        # ordering is preserved, a slow (network) filesystem can't stall
+        # concurrent requests, and a HUNG one can't grow memory without
+        # bound — excess traces are dropped and counted instead
         self._sink = None
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, jsonl_queue_size))
+        self._writer: Optional[threading.Thread] = None
+        self._stop = threading.Event()  # close() signal; survives a full queue
+        self._abandoned = False  # close() gave up: the writer owns the sink
+        self.dropped = 0  # traces not written because the queue was full
         self._traces: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+
+    def _sink_write(self, line: str) -> None:
+        try:
+            if self._sink is None:
+                self._sink = open(self.jsonl_path, "a", buffering=1)
+            self._sink.write(line)
+        except (OSError, ValueError):
+            logger.warning("trace JSONL write to %s failed",
+                           self.jsonl_path, exc_info=True)
+
+    def _drain(self) -> None:
+        try:
+            while True:
+                try:
+                    line = self._queue.get(timeout=1.0)
+                except queue.Empty:
+                    # the stop flag (not just the sentinel) ends the loop:
+                    # a sentinel can fail to enqueue into a full queue, and
+                    # a writer that later recovers must still terminate
+                    if self._stop.is_set():
+                        return
+                    continue
+                if line is None:  # close() sentinel
+                    return
+                self._sink_write(line)
+        finally:
+            if self._abandoned and self._sink is not None:
+                # close() already returned without the sink — it's ours now
+                self._sink.close()
+                self._sink = None
 
     def record(
         self,
@@ -87,17 +133,52 @@ class TraceRecorder:
         self._traces.move_to_end(request_id)
         while len(self._traces) > self.capacity:
             self._traces.popitem(last=False)
-        if self.jsonl_path:
+        if self.jsonl_path and not self._stop.is_set():  # no sink after close()
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._drain, name="trace-jsonl", daemon=True)
+                self._writer.start()
             try:
-                if self._sink is None:
-                    self._sink = open(self.jsonl_path, "a", buffering=1)
-                self._sink.write(json.dumps(trace, ensure_ascii=False) + "\n")
-            except (OSError, ValueError):
-                logger.warning("trace JSONL write to %s failed",
-                               self.jsonl_path, exc_info=True)
+                self._queue.put_nowait(
+                    json.dumps(trace, ensure_ascii=False) + "\n")
+            except queue.Full:
+                self.dropped += 1
+                if self.dropped == 1 or self.dropped % 1000 == 0:
+                    logger.warning(
+                        "trace JSONL sink backed up (%d dropped so far) — "
+                        "is %s hung?", self.dropped, self.jsonl_path)
         return trace
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain queued writes (bounded by ``timeout``) and close the sink.
+        A writer wedged on a hung filesystem is abandoned — it's a daemon
+        thread — rather than hanging shutdown forever."""
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            deadline = time.monotonic() + timeout
+            self._stop.set()
+            try:
+                # bounded put sharing the overall budget: a backlogged-but-
+                # healthy writer frees a slot for the sentinel; a wedged
+                # one exhausts the deadline and is abandoned below
+                self._queue.put(None, timeout=timeout)
+            except queue.Full:
+                pass
+            writer.join(max(0.0, deadline - time.monotonic()))
+            if writer.is_alive():
+                # the stop flag guarantees the writer terminates (and
+                # closes the sink itself) if the filesystem ever recovers
+                self._abandoned = True
+                if writer.is_alive():
+                    logger.warning(
+                        "trace JSONL writer did not drain within %.1fs "
+                        "(%d queued, %d dropped); abandoning it — the "
+                        "daemon thread finishes the backlog and exits if "
+                        "the sink recovers",
+                        timeout, self._queue.qsize(), self.dropped)
+                    return  # the abandoned writer owns the sink now
+                # it exited in the race window after join() — reclaim
+                self._abandoned = False
         if self._sink is not None:
             self._sink.close()
             self._sink = None

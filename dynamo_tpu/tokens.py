@@ -106,6 +106,7 @@ def _get_native():
 
             if not native.disabled_by_env() and native.available():
                 _native_hashes = native.compute_block_hashes
+        # dynlint: allow(silent-except) - optional-native probe; pure-Python fallback is the contract
         except Exception:  # pragma: no cover - broken toolchain
             pass
     return _native_hashes

@@ -108,10 +108,18 @@ def setup_logging(default_level: int = logging.INFO, stream=None) -> None:
 
 
 def stage_summary(stages) -> str:
-    """[(name, t_monotonic)] → "preprocess=1.2ms backend=0.3ms ..." deltas."""
+    """[(name, t_monotonic)] → "preprocess=1.2ms backend=0.3ms ..." deltas.
+
+    ``name=<delta>`` is the time from the PREVIOUS mark to ``name`` —
+    marks are stamped at phase completion, so the delta lands under the
+    phase that actually spent it (same attribution as
+    telemetry.tracing.span_breakdown). The tail from the last mark to
+    now is ``egress``.
+    """
     if not stages:
         return ""
     parts = []
-    for (name, t), (_, t_next) in zip(stages, stages[1:] + [("", time.monotonic())]):
-        parts.append(f"{name}={(t_next - t) * 1e3:.1f}ms")
+    closed = list(stages) + [("egress", time.monotonic())]
+    for (_, t), (name_next, t_next) in zip(closed, closed[1:]):
+        parts.append(f"{name_next}={(t_next - t) * 1e3:.1f}ms")
     return " ".join(parts)

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""dynlint CLI: run the project's static analyzer against the baseline.
+
+Usage:
+    python scripts/dynlint.py [paths ...]
+        Lint (default: dynamo_tpu/). Exit 1 if any violation is NOT
+        covered by the baseline, else 0.
+    python scripts/dynlint.py --update-baseline
+        Rewrite the baseline to the current findings (accepting debt —
+        prefer fixing or an inline '# dynlint: allow(<rule>)').
+    python scripts/dynlint.py --format=github
+        Emit ::error workflow commands for CI annotations.
+    python scripts/dynlint.py --list-rules
+        Print the rule catalog.
+
+Options:
+    --baseline PATH   baseline file (default scripts/dynlint_baseline.json)
+    --no-baseline     report every finding, recorded debt included
+    --rules a,b       run only the named rules
+
+Exit codes: 0 clean (modulo baseline), 1 new violations, 2 usage error.
+The enforcement twin is tests/test_dynlint.py (marker: dynlint), which
+runs the same check in tier-1 with no network, TPU, or heavy imports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from dynamo_tpu.analysis import (  # noqa: E402
+    all_rules,
+    diff_against_baseline,
+    get_rules,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "scripts", "dynlint_baseline.json")
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dynlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("paths", nargs="*",
+                        default=[os.path.join(REPO_ROOT, "dynamo_tpu")])
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline; report all findings")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to current findings")
+    parser.add_argument("--format", choices=("text", "github"),
+                        default="text")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true")
+    try:
+        args = parser.parse_args(argv[1:])
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    rules = all_rules()
+    if args.list_rules:
+        width = max(len(r.name) for r in rules)
+        for r in rules:
+            print(f"{r.name:<{width}}  {r.description}")
+        return 0
+    if args.rules:
+        try:
+            rules = get_rules([s.strip() for s in args.rules.split(",") if s.strip()])
+        except KeyError as e:
+            print(f"dynlint: {e.args[0]}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = lint_paths(args.paths, rules)
+    except FileNotFoundError as e:
+        print(f"dynlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # the baseline is rewritten WHOLE from this run's findings: a
+        # narrowed scope would silently delete every entry outside it
+        default_scope = [os.path.join(REPO_ROOT, "dynamo_tpu")]
+        narrowed = args.rules or (
+            [os.path.abspath(p) for p in args.paths]
+            != [os.path.abspath(p) for p in default_scope]
+        )
+        if narrowed and args.baseline == DEFAULT_BASELINE:
+            print("dynlint: refusing --update-baseline with --rules or a "
+                  "narrowed path scope — it would drop every out-of-scope "
+                  "entry from the shared baseline. Run it bare, or point "
+                  "--baseline at a different file.", file=sys.stderr)
+            return 2
+        entries = write_baseline(args.baseline, findings)
+        print(f"baseline written: {len(entries)} unique finding(s) "
+              f"({len(findings)} total) -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    diff = diff_against_baseline(findings, baseline)
+
+    render = (lambda f: f.render_github()) if args.format == "github" \
+        else (lambda f: f.render())
+    for f in diff.new:
+        print(render(f))
+    if args.format == "text":
+        for key in diff.stale:
+            print(f"note: stale baseline entry (fixed? run "
+                  f"--update-baseline to prune): {key}")
+    if diff.new:
+        print(f"{len(diff.new)} new violation(s) "
+              f"({len(diff.known)} known in baseline)")
+        return 1
+    print(f"dynlint clean: 0 new violations "
+          f"({len(diff.known)} recorded in baseline, "
+          f"{len(diff.stale)} stale entr{'y' if len(diff.stale) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

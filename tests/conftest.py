@@ -38,6 +38,11 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "tpu: requires real TPU hardware (opt-in)")
     config.addinivalue_line("markers", "slow: long-running test")
     config.addinivalue_line("markers", "asyncio: run test in a fresh event loop")
+    config.addinivalue_line(
+        "markers",
+        "dynlint: static-analysis enforcement gate (pure AST walk — "
+        "no network, no TPU, no heavy imports; always on in tier-1)",
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

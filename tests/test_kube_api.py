@@ -419,7 +419,15 @@ async def test_watch_loop_over_rest_stream():
                 await asyncio.sleep(0.05)
             else:
                 raise AssertionError("watch event did not reconcile w1")
-            assert fake.crs[("default", "w1")]["status"]["conditions"]
+            # the status patch lands AFTER the deployment create — poll for
+            # it too, or a loaded host hits the gap (KeyError: 'status')
+            for _ in range(100):
+                if fake.crs.get(("default", "w1"), {}).get(
+                        "status", {}).get("conditions"):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("status conditions never patched")
 
             fake.delete_cr("w1")
             for _ in range(100):

@@ -147,11 +147,32 @@ def test_callback_gauge_labeled_and_crash_safe():
 
 
 def test_span_breakdown_offsets_and_durations():
+    # marks are stamped at phase COMPLETION: each gap is attributed to
+    # the mark that closes it, so prefill compute lands under "prefill"
     stages = [("http", 10.0), ("prefill", 10.5), ("completion", 11.0)]
     spans = span_breakdown(stages, end=11.25)
-    assert [s["name"] for s in spans] == ["http", "prefill", "completion"]
+    assert [s["name"] for s in spans] == ["prefill", "completion", "egress"]
     assert [s["offset_s"] for s in spans] == [0.0, 0.5, 1.0]
     assert [s["duration_s"] for s in spans] == [0.5, 0.5, 0.25]
+
+
+def test_trace_recorder_bounded_queue_drops_and_counts(tmp_path):
+    """A hung JSONL filesystem must not grow memory without bound: once
+    the writer queue is full, traces are dropped and counted."""
+    import threading
+
+    rec = TraceRecorder(jsonl_path=str(tmp_path / "t.jsonl"),
+                        jsonl_queue_size=1)
+    # stand in a finished thread for the writer so nothing drains the
+    # queue — the shape of a sink wedged mid-write
+    blocked = threading.Thread(target=lambda: None)
+    blocked.start()
+    blocked.join()
+    rec._writer = blocked
+    for i in range(3):
+        rec.record(f"req-{i}", "m", "success", [("http", 1.0)], end=2.0)
+    assert rec.dropped == 2 and rec._queue.qsize() == 1
+    rec.close(timeout=0.1)  # must return promptly, not hang
 
 
 def test_trace_recorder_ring_and_jsonl(tmp_path):
@@ -163,9 +184,10 @@ def test_trace_recorder_ring_and_jsonl(tmp_path):
     assert len(rec) == 2
     assert rec.get("req-0") is None, "oldest trace evicted at capacity"
     assert rec.get("req-2")["total_s"] == pytest.approx(1.5)
+    rec.close()  # sink IO runs on a writer thread; close() drains it
     lines = [json.loads(l) for l in path.read_text().splitlines()]
     assert [t["request_id"] for t in lines] == ["req-0", "req-1", "req-2"]
-    assert lines[0]["spans"][0]["name"] == "http"
+    assert lines[0]["spans"][0]["name"] == "completion"
 
 
 # ------------------------------------------------------- scheduler end-to-end
@@ -304,7 +326,9 @@ async def test_http_trace_ids_and_debug_requests_endpoint():
             assert trace["status"] == "success"
             assert trace["model"] == "echo"
             span_names = [s["name"] for s in trace["spans"]]
-            assert span_names[0] == "http"
+            # the echo engine stamps only the ingress "http" mark, so the
+            # whole request is the trailing egress span (end-attribution)
+            assert span_names[-1] == "egress"
             assert trace["total_s"] >= 0
 
             async with session.get(f"{base}/debug/requests/nope") as resp:

@@ -96,9 +96,11 @@ def test_setup_logging_jsonl(monkeypatch):
 
 
 def test_stage_summary():
+    # deltas attribute to the mark that CLOSES each gap (marks record
+    # phase completions); the tail to now is "egress"
     stages = [("http", 1.0), ("preprocess", 1.010), ("generate", 1.025)]
     s = stage_summary(stages)
-    assert s.startswith("http=10.0ms preprocess=15.0ms generate=")
+    assert s.startswith("preprocess=10.0ms generate=15.0ms egress=")
     assert stage_summary([]) == ""
 
 
