@@ -169,6 +169,63 @@ class EngineRequest:
         return self.req.stop_conditions.min_tokens or 0
 
 
+class _HostBatchState:
+    """Persistent ``(B, ·)`` host-side decode arrays.
+
+    The decode hot loop used to rebuild every sampling array (temp/
+    top_k/top_p/min_p/pres/freq/rep/keys) and the block table from
+    per-request Python loops on EVERY pass, even when batch membership
+    was unchanged — O(B·blocks_per_seq) of pure host overhead per
+    dispatch. These arrays now persist across passes and mutate only
+    when a slot's occupant changes (``install``) or a live row grows
+    blocks (``sync_blocks``). Rows of departed requests keep stale
+    values: they ride with ``commit=False``, so nothing reads their
+    outputs and the device never counts their samples.
+    """
+
+    def __init__(self, cfg: EngineConfig):
+        b = cfg.max_batch_size
+        self.temp = np.zeros(b, np.float32)
+        self.top_k = np.zeros(b, np.int32)
+        self.top_p = np.ones(b, np.float32)
+        self.min_p = np.zeros(b, np.float32)
+        self.pres = np.zeros(b, np.float32)
+        self.freq = np.zeros(b, np.float32)
+        self.rep = np.ones(b, np.float32)
+        self.keys = np.zeros((b, 2), np.uint32)
+        self.btab = np.zeros((b, cfg.blocks_per_seq), np.int32)
+        # blocks of each row already mirrored into ``btab``
+        self.synced_blocks = np.zeros(b, np.int32)
+
+    def install(self, er: "EngineRequest") -> None:
+        """(Re)write one slot's rows at admission / membership change."""
+        i = er.slot
+        (self.temp[i], self.top_k[i], self.top_p[i], self.min_p[i],
+         self.pres[i], self.freq[i], self.rep[i]) = (
+            er.temperature, er.top_k, er.top_p, er.min_p,
+            er.presence_penalty, er.frequency_penalty,
+            er.repetition_penalty,
+        )
+        self.keys[i] = er.base_key
+        n = len(er.block_ids)
+        self.btab[i, :n] = er.block_ids
+        self.btab[i, n:] = 0
+        self.synced_blocks[i] = n
+
+    def sync_blocks(self, er: "EngineRequest") -> None:
+        """Mirror a live row's grown (or rolled-back) block list."""
+        i = er.slot
+        n = len(er.block_ids)
+        s = int(self.synced_blocks[i])
+        if n == s:
+            return
+        if n < s:
+            self.btab[i, n:s] = 0
+        else:
+            self.btab[i, s:n] = er.block_ids[s:]
+        self.synced_blocks[i] = n
+
+
 class Scheduler:
     def __init__(
         self,
@@ -206,6 +263,8 @@ class Scheduler:
             registry=self.registry,
         )
         self.waiting: deque = deque()
+        # persistent decode-step host arrays (see _HostBatchState)
+        self._host = _HostBatchState(config)
         self.pending_remote: List[EngineRequest] = []
         self.slots: List[Optional[EngineRequest]] = [None] * config.max_batch_size
         # the prefill BATCH: up to max_prefill_batch requests whose
@@ -696,6 +755,7 @@ class Scheduler:
         er.remote_future = None
         er.slot = slot
         self.slots[slot] = er
+        self._host.install(er)
         er.context_len = len(er.prompt)
         er.pending_token = token
         er.generated = 1
@@ -744,6 +804,7 @@ class Scheduler:
         er.context_len = er.num_cached
         er.slot = slot
         self.slots[slot] = er
+        self._host.install(er)
         er.seq = TokenSequence(tokens_all, block_size=self.config.kv_block_size)
         er.registered_blocks = 0
         # guided decoding: (re)build the constraint and walk it past any
@@ -1270,37 +1331,32 @@ class Scheduler:
         # max_model_len table width (one compiled program per bucket)
         w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
 
+        # sampling params and the block table come from the persistent
+        # host state (mutated only on membership / block growth); only
+        # the genuinely per-pass scalars are rebuilt here
+        hs = self._host
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
         slot_map = np.full((b, 1), -1, np.int32)
-        btab = np.zeros((b, w), np.int32)
         ctx_lens = np.ones(b, np.int32)
         last_idx = np.zeros(b, np.int32)
-        temp = np.zeros(b, np.float32)
-        top_k = np.zeros(b, np.int32)
-        top_p = np.ones(b, np.float32)
-        min_p = np.zeros(b, np.float32)
-        pres = np.zeros(b, np.float32)
-        freq = np.zeros(b, np.float32)
-        rep = np.ones(b, np.float32)
-        keys = np.zeros((b, 2), np.uint32)
         ctrs = np.zeros(b, np.int32)
         commit = np.zeros(b, bool)
 
         for er in active:
             i = er.slot
             pos = er.context_len
+            hs.sync_blocks(er)
             tokens[i, 0] = er.pending_token
             positions[i, 0] = pos
             slot_map[i, 0] = er.block_ids[pos // bs] * bs + pos % bs
-            btab[i, : len(er.block_ids)] = er.block_ids
             ctx_lens[i] = pos + 1
-            temp[i], top_k[i], top_p[i] = er.temperature, er.top_k, er.top_p
-            min_p[i], pres[i], freq[i] = er.min_p, er.presence_penalty, er.frequency_penalty
-            rep[i] = er.repetition_penalty
-            keys[i] = er.base_key
             ctrs[i] = er.generated
             commit[i] = True
+        # .copy(), not a view: the persistent table mutates across passes
+        # while a dispatched program's host→device transfer may still be
+        # in flight — the step must capture a stable snapshot
+        btab = hs.btab[:, :w].copy()
 
         # the [B, V] top-k sort only runs when some active request
         # asked for alternatives (ADVICE r2: fixed decode-path cost)
@@ -1309,17 +1365,19 @@ class Scheduler:
         if k_steps > 1:
             next_tokens, lps, top_vals, top_ids = self.runner.decode_burst(
                 tokens[:, 0], positions[:, 0], btab,
-                temp, top_k, top_p,
-                min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
-                repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+                hs.temp, hs.top_k, hs.top_p,
+                min_p=hs.min_p, presence_penalty=hs.pres,
+                frequency_penalty=hs.freq,
+                repetition_penalty=hs.rep, seed_keys=hs.keys, counters=ctrs,
                 commit=commit, want_top=want_top,
             )
         else:
             next_tokens, lps, top_vals, top_ids, *_ = self.runner.step(
                 tokens, positions, btab, slot_map, ctx_lens, last_idx,
-                temp, top_k, top_p,
-                min_p=min_p, presence_penalty=pres, frequency_penalty=freq,
-                repetition_penalty=rep, seed_keys=keys, counters=ctrs,
+                hs.temp, hs.top_k, hs.top_p,
+                min_p=hs.min_p, presence_penalty=hs.pres,
+                frequency_penalty=hs.freq,
+                repetition_penalty=hs.rep, seed_keys=hs.keys, counters=ctrs,
                 sample_slots=np.arange(b, dtype=np.int32), commit=commit,
                 want_top=want_top,
             )
