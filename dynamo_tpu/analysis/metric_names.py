@@ -28,9 +28,11 @@ from typing import Iterator, List, NamedTuple, Optional
 PREFIX = "dynamo_"
 
 # the unit vocabulary: extend deliberately, not ad hoc
+# ("depth" added for structural stage-count gauges — the decode
+# pipeline's dispatch depth; same count family as slots/blocks)
 UNIT_SUFFIXES = (
     "total", "seconds", "bytes", "tokens", "blocks",
-    "requests", "slots", "ratio", "info",
+    "requests", "slots", "ratio", "info", "depth",
 )
 BASE_UNITS = ("seconds", "bytes", "tokens")  # what a histogram may measure
 

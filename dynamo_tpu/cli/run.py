@@ -84,6 +84,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--multi-step-decode", type=int, default=1,
                    help="decode steps fused per device dispatch (tokens "
                         "stream in bursts of K; 1 = per-token)")
+    p.add_argument("--decode-pipeline-depth", type=int, default=1,
+                   help="dispatch-ahead decode: 2 double-buffers bursts "
+                        "(burst k+1 dispatches while the host streams "
+                        "burst k's tokens); 0/1 = strictly synchronous")
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="serving-time weight-only quantization (halves "
                         "the decode weight stream; llama-family)")

@@ -388,6 +388,24 @@ class BlockAllocator:
         self.block_hash[bid] = sequence_hash
         self.events.on_stored([sequence_hash], parent_hash)
 
+    def rollback_tail(self, block_ids: List[int], keep: int) -> List[int]:
+        """Release the over-allocated tail of a sequence's block list.
+
+        The dispatch-ahead decode pipeline reserves block headroom for
+        2x the burst depth before every dispatch; a finish (eos/stop/
+        max-token/cancel) detected one burst late leaves the row holding
+        blocks whose only contents are over-decoded positions the host
+        never committed. Those tail blocks are by construction anonymous
+        (registration only ever covers positions below the host
+        ``context_len``), so releasing them returns them straight to the
+        free list. Returns the retained prefix.
+        """
+        keep = max(0, keep)
+        tail = block_ids[keep:]
+        if tail:
+            self.free_blocks(tail)
+        return block_ids[:keep]
+
     def free_blocks(self, block_ids: List[int]) -> None:
         """Release a sequence's references. Hashed blocks become reusable
         (still matchable until evicted); anonymous blocks go to the free

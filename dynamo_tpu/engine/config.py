@@ -305,6 +305,19 @@ class EngineConfig:
     # waiting, and 1 (default) keeps strict per-token dispatch. Sampling
     # is bit-identical either way (same per-row PRNG fold-in counters).
     multi_step_decode: int = 1
+    # dispatch-ahead decode: with depth 2, burst k+1 is dispatched before
+    # burst k's sampled tokens are synced to the host (JAX dispatch is
+    # async; the carry tokens are already device-resident), so the host's
+    # detokenize/stream/finish-check work for burst k overlaps burst
+    # k+1's device compute instead of leaving the TPU idle. Finishes
+    # (eos/stop/max-token/cancel) are detected one burst late and the
+    # over-decoded rows retro-invalidated (tokens truncated, KV blocks
+    # rolled back); block headroom for 2*K positions is reserved before
+    # every dispatch so the in-flight burst can never OOM. Guided
+    # decoding, speculative decoding, and prefill work force the
+    # synchronous path per pass. 0/1 = today's strictly-synchronous
+    # behavior, 2 = double-buffered (the only pipelined depth).
+    decode_pipeline_depth: int = 1
     # n-gram (prompt-lookup) speculative decoding: propose up to K tokens
     # per decode step by matching the context's trailing n-gram against
     # its own history, then VERIFY all K+1 positions in one forward.
@@ -357,6 +370,10 @@ class EngineConfig:
         # a burst must fit comfortably inside one sequence's block budget;
         # 64 already amortizes dispatch overhead past the point of returns
         self.multi_step_decode = max(1, min(self.multi_step_decode, 64))
+        # depth > 2 buys nothing: with one burst in flight the host is
+        # already fully overlapped, and reconciliation lag grows with
+        # every extra stage — clamp instead of failing
+        self.decode_pipeline_depth = max(0, min(self.decode_pipeline_depth, 2))
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
         if self.spec_draft_tokens and not self.spec_draft_model:

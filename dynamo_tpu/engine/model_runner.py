@@ -422,7 +422,10 @@ class ModelRunner:
         """
         K = self.config.multi_step_decode
         self._burst = None
-        if K <= 1:
+        if K <= 1 and self.config.decode_pipeline_depth < 2:
+            # the dispatch-ahead pipeline always runs through the burst
+            # program (its carry keeps sampled tokens device-resident),
+            # so pipelining with multi_step_decode=1 compiles a K=1 scan
             return
         cfg = self.config.model
         mesh = self.mesh

@@ -156,6 +156,11 @@ class EngineRequest:
     # telemetry: monotonic time of the last token emission (0 = none yet);
     # drives the inter-token-latency histogram and the first_token span
     last_emit_t: float = 0.0
+    # dispatch-ahead decode emitted tokens for this request since the
+    # last trace mark — a ``decode_pipeline`` stage is stamped when the
+    # pipelined segment ends (finish or drain), so span attribution
+    # separates overlapped decode from the synchronous tail
+    pipeline_span_open: bool = False
 
     @property
     def max_new(self) -> int:
@@ -226,6 +231,25 @@ class _HostBatchState:
         self.synced_blocks[i] = n
 
 
+@dataclasses.dataclass
+class _InflightBurst:
+    """One dispatched-but-unreconciled decode burst (pipeline depth 2).
+
+    Everything the host needs to reconcile the burst AFTER the next one
+    is already on device: the device-resident output arrays (synced in
+    one executor hop — the loop's only host sync) and the carry
+    (``last_tokens``) the next burst consumes without a host round-trip.
+    """
+
+    active: List["EngineRequest"]  # rows committed at dispatch
+    toks: object                   # device [K, B] sampled tokens
+    lps: object                    # device [K, B] their logprobs
+    tv: object                     # device [K, B, KW] top alternatives
+    ti: object
+    k_steps: int
+    last_tokens: object            # device [B]: the next burst's tokens0
+
+
 class Scheduler:
     def __init__(
         self,
@@ -281,6 +305,13 @@ class Scheduler:
         # ngram speculative decoding acceptance telemetry
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # dispatch-ahead decode pipeline (config.decode_pipeline_depth=2):
+        # the burst dispatched but not yet reconciled on the host, the
+        # device-idle bookkeeping behind the bubble histogram, and a
+        # dispatch counter for tests/metrics
+        self._inflight: Optional[_InflightBurst] = None
+        self._last_burst_done_t: Optional[float] = None
+        self.pipeline_bursts = 0
         self._build_instruments()
         if disagg is not None and getattr(disagg, "registry", None) is not None:
             self.registry.attach(disagg.registry)
@@ -309,6 +340,19 @@ class Scheduler:
             "dynamo_scheduler_inter_token_latency_seconds",
             "Gap between consecutive token emissions of one request",
             buckets=STEP_BUCKETS,
+        )
+        self._bubble_hist = reg.histogram(
+            "dynamo_engine_decode_pipeline_bubble_seconds",
+            "Host-observed device-idle gap between consecutive decode "
+            "bursts (0 when the next burst was dispatched while the "
+            "previous one was still executing on device)",
+            buckets=STEP_BUCKETS,
+        )
+        reg.callback_gauge(
+            "dynamo_engine_decode_pipeline_depth",
+            "Decode dispatch depth in effect: 2 while a burst is in "
+            "flight ahead of host reconciliation, else 1",
+            lambda: 2 if self._inflight is not None else 1,
         )
         self._preemptions = reg.counter(
             "dynamo_scheduler_preemptions_total",
@@ -414,6 +458,8 @@ class Scheduler:
         if self.config.spec_ngram_tokens or self.draft is not None:
             out["spec_proposed_tokens"] = self.spec_proposed
             out["spec_accepted_tokens"] = self.spec_accepted
+        if self.config.decode_pipeline_depth >= 2:
+            out["decode_pipeline_bursts"] = self.pipeline_bursts
         if self.allocator.tier2 is not None:
             out.update(self.allocator.tier2.metrics())
         if self.disagg is not None:
@@ -469,6 +515,20 @@ class Scheduler:
             self.slots[er.slot] = None
         self.allocator.free_blocks(er.block_ids)
         er.block_ids = []
+
+    def _advance_row(self, er: EngineRequest, token: int) -> None:
+        """Commit ONE sampled token to host state: the previous pending
+        token's KV is now written (push + register), the new token
+        becomes pending, and finish checks run. The single shared
+        implementation behind the synchronous decode loop, the
+        speculative accept loop, and the pipeline's reconciliation —
+        one copy, so the paths' streams cannot drift."""
+        er.seq.push(er.pending_token)
+        er.context_len += 1
+        self._register_completed_blocks(er)
+        er.pending_token = token
+        er.generated += 1
+        er.finish = self._check_finish(er, token)
 
     def _ensure_block_for(self, er: EngineRequest, position: int) -> bool:
         """Make sure a block exists covering ``position``."""
@@ -560,6 +620,9 @@ class Scheduler:
             if self.prefilling:
                 t_pf = time.monotonic()
                 self._host_sync_s = 0.0
+                # prefill work interleaves into the device stream: the
+                # burst-to-burst idle clock no longer means anything
+                self._last_burst_done_t = None
                 await self._prefill_chunk(loop, list(self.prefilling))
                 self._phase_hist.observe(
                     max(0.0, time.monotonic() - t_pf - self._host_sync_s),
@@ -584,21 +647,41 @@ class Scheduler:
                     self.config.spec_ngram_tokens > 0
                     or self.draft is not None
                 )
-                if (speculating and runner_idle
-                        and all(self._spec_eligible(er) for er in active)):
-                    # speculative verify (ngram or draft-model proposals):
-                    # greedy penalty-free batches only; anything else
-                    # falls through
-                    await self._decode_spec(loop, active)
+                spec_now = (speculating and runner_idle
+                            and all(self._spec_eligible(er) for er in active))
+                if not spec_now and self._pipeline_ok(active, runner_idle):
+                    # dispatch-ahead: burst k+1 goes to the device before
+                    # burst k's tokens are synced/emitted on the host
+                    await self._decode_pipelined(loop, active)
                 else:
-                    k_steps = self.config.multi_step_decode
-                    if k_steps > 1 and not runner_idle:
-                        k_steps = 1
-                    await self._decode(loop, active, k_steps)
+                    if self._inflight is not None:
+                        # sync barrier: reconcile the in-flight burst
+                        # before any non-pipelined dispatch (membership,
+                        # masks, or the program shape is changing)
+                        await self._drain_pipeline(loop)
+                        active = [er for er in active if er.finish is None]
+                    if not active:
+                        pass
+                    elif spec_now:
+                        # speculative verify (ngram or draft-model
+                        # proposals): greedy penalty-free batches only;
+                        # anything else falls through
+                        await self._decode_spec(loop, active)
+                    else:
+                        k_steps = self.config.multi_step_decode
+                        if k_steps > 1 and not runner_idle:
+                            k_steps = 1
+                        await self._decode(loop, active, k_steps)
                 self._phase_hist.observe(
                     max(0.0, time.monotonic() - t_dec - self._host_sync_s),
                     phase="decode",
                 )
+                progressed = True
+            elif self._inflight is not None:
+                # every pipelined row finished or was cancelled while its
+                # successor burst was in flight: reconcile the orphan (all
+                # rows skip at apply — pure over-decode, nothing emits)
+                await self._drain_pipeline(loop)
                 progressed = True
 
             # materialize staged host-tier offloads now that this pass's
@@ -609,6 +692,9 @@ class Scheduler:
 
             if not progressed:
                 self.wake.clear()
+                # about to sleep: the device-idle clock must not count
+                # request-starved idle as a pipeline bubble
+                self._last_burst_done_t = None
                 if not self.waiting and not any(self.slots):
                     if self.pending_remote:
                         # sleep but wake on remote completion or timeout check
@@ -623,6 +709,207 @@ class Scheduler:
             else:
                 self._step_hist.observe(time.monotonic() - pass_t0)
                 await asyncio.sleep(0)  # let I/O run between steps
+
+        # stopping: reconcile any dispatch-ahead burst so no sampled
+        # tokens are silently dropped and no device work is abandoned
+        await self._drain_pipeline(loop)
+
+    # ---------- dispatch-ahead decode (pipeline depth 2) ----------
+
+    def _pipeline_ok(self, active: List[EngineRequest],
+                     runner_idle: bool) -> bool:
+        """May this pass decode dispatch-ahead?
+
+        Guided decoding (per-token host mask edits), speculative decoding
+        (both proposal sources), ``n>1`` fan-out, prefill/admission work,
+        and rows within two bursts of the model-len horizon all force the
+        existing synchronous path — selected per-pass, never mid-burst.
+        A batch-membership surprise (a row active now that was not in the
+        dispatched burst) drains defensively.
+        """
+        cfg = self.config
+        if cfg.decode_pipeline_depth < 2 or not runner_idle:
+            return False
+        if self.draft is not None or cfg.spec_ngram_tokens > 0:
+            return False
+        K = cfg.multi_step_decode
+        for er in active:
+            if er.guided is not None:
+                return False
+            n = er.req.sampling_options.n
+            if n is not None and n > 1:
+                return False
+            if er.context_len + 2 * K + 1 > cfg.max_model_len:
+                return False
+        infl = self._inflight
+        if infl is not None:
+            live = {id(er) for er in infl.active if er.finish is None}
+            if live != {id(er) for er in active}:
+                return False
+        return True
+
+    async def _decode_pipelined(self, loop,
+                                active: List[EngineRequest]) -> None:
+        """One pipelined pass: dispatch burst k+1, then reconcile burst k
+        on the host while k+1 executes on device.
+
+        The carry (burst k's last sampled tokens) is already device-
+        resident inside the burst program's outputs, so burst k+1
+        consumes it without a host round-trip; the host then syncs,
+        detokenizes, streams, and finish-checks burst k's tokens during
+        burst k+1's device time. Block headroom for ``2*K`` positions is
+        reserved before every dispatch, so the in-flight burst can never
+        write to an unallocated slot; if reservation fails, the pipeline
+        drains (sync barrier) and the synchronous path — which owns
+        preemption — takes the pass.
+        """
+        cfg = self.config
+        b = cfg.max_batch_size
+        k_steps = cfg.multi_step_decode
+        infl = self._inflight
+        # device is ``ahead`` tokens past the host's committed state
+        ahead = infl.k_steps if infl is not None else 0
+
+        for er in active:
+            # 2*K from the host context: covers the burst dispatched now
+            # (positions ahead..ahead+K-1 past the committed state) and
+            # keeps the invariant once reconciliation advances the host
+            ok = all(
+                self._ensure_block_for(er, er.context_len + j)
+                for j in range(2 * k_steps)
+            )
+            if not ok:
+                # KV OOM: preemption needs fully-committed host state —
+                # drain, then let the sync path preempt/decode this pass
+                self.allocator.flush_offload()
+                await self._drain_pipeline(loop)
+                live = [e for e in active if e.finish is None]
+                if live:
+                    await self._decode(loop, live, k_steps)
+                return
+        # one batched host-offload gather for this pass's evictions,
+        # before the dispatch below overwrites the evicted slots
+        self.allocator.flush_offload()
+
+        hs = self._host
+        positions0 = np.zeros(b, np.int32)
+        ctrs = np.zeros(b, np.int32)
+        commit = np.zeros(b, bool)
+        for er in active:
+            i = er.slot
+            hs.sync_blocks(er)
+            positions0[i] = er.context_len + ahead
+            ctrs[i] = er.generated + ahead
+            commit[i] = True
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in active))
+        btab = hs.btab[:, :w].copy()
+        if infl is None:
+            # pipeline fill (first burst after a drain): tokens from host
+            tokens0 = np.zeros(b, np.int32)
+            for er in active:
+                tokens0[er.slot] = er.pending_token
+        else:
+            tokens0 = infl.last_tokens  # device-resident carry
+        want_top = any(er.logprobs_n > 0 for er in active)
+
+        # device-idle bookkeeping: if the previous burst's outputs are
+        # already materialized when this dispatch goes out, the device
+        # ran dry — charge the gap since the last host reconciliation
+        # (a host-observed approximation; 0 while the device is busy)
+        now = time.monotonic()
+        if self._last_burst_done_t is not None:
+            if infl is None:
+                self._bubble_hist.observe(now - self._last_burst_done_t)
+            else:
+                ready = getattr(infl.last_tokens, "is_ready", lambda: True)()
+                self._bubble_hist.observe(
+                    now - self._last_burst_done_t if ready else 0.0
+                )
+        self._last_burst_done_t = None
+
+        toks, lps, tv, ti = self.runner.decode_burst(
+            tokens0, positions0, btab, hs.temp, hs.top_k, hs.top_p,
+            min_p=hs.min_p, presence_penalty=hs.pres,
+            frequency_penalty=hs.freq, repetition_penalty=hs.rep,
+            seed_keys=hs.keys, counters=ctrs, commit=commit,
+            want_top=want_top,
+        )
+        self.steps += 1
+        self.pipeline_bursts += 1
+        self._inflight = _InflightBurst(
+            active=list(active), toks=toks, lps=lps, tv=tv, ti=ti,
+            k_steps=k_steps, last_tokens=toks[k_steps - 1],
+        )
+        if infl is not None:
+            # burst k+1 is on device — reconcile burst k while it runs
+            await self._apply_burst(loop, infl)
+            if all(er.finish is not None for er in self._inflight.active):
+                # burst k finished every row: k+1 is pure over-decode —
+                # reconcile it now instead of leaving an orphan in flight
+                await self._drain_pipeline(loop)
+
+    async def _apply_burst(self, loop, infl: _InflightBurst) -> None:
+        """Host half of the pipeline: sync the burst's sampled tokens
+        (the decode loop's ONLY host sync), emit/stream them, run finish
+        checks, and retro-invalidate rows that finished one burst late."""
+        t_sync = time.monotonic()
+        toks, lpn, tv, ti = await loop.run_in_executor(
+            None, lambda: (np.asarray(infl.toks), np.asarray(infl.lps),
+                           np.asarray(infl.tv), np.asarray(infl.ti)),
+        )
+        self._observe_host_sync(time.monotonic() - t_sync)
+        self._last_burst_done_t = time.monotonic()
+        for j in range(infl.k_steps):
+            for er in infl.active:
+                if er.finish is not None:
+                    continue  # finished/cancelled: over-decode discarded
+                token = int(toks[j, er.slot])
+                self._advance_row(er, token)
+                er.pipeline_span_open = True
+                self._emit(
+                    er, token,
+                    float(lpn[j, er.slot]) if er.want_logprobs else None,
+                    self._top_row(er, tv[j], ti[j], er.slot),
+                )
+                if er.finish is not None:
+                    self._finish_pipelined(er)
+
+    def _finish_pipelined(self, er: EngineRequest) -> None:
+        """A pipelined row finished (possibly one burst late): truncate
+        the over-decoded tokens (never emitted), roll the headroom blocks
+        holding only over-decoded KV back into the allocator, stamp the
+        ``decode_pipeline`` span, and free the slot.
+
+        The in-flight burst's writes to the rolled-back blocks are
+        harmless: the blocks are anonymous (never registered), and device
+        dispatch ordering lands those writes before any later program's
+        writes to a reallocated slot.
+        """
+        bs = self.config.kv_block_size
+        keep = -(-er.context_len // bs)  # blocks covering committed KV
+        er.block_ids = self.allocator.rollback_tail(er.block_ids, keep)
+        self._host.sync_blocks(er)
+        if er.pipeline_span_open:
+            er.ctx.add_stage("decode_pipeline")
+            er.pipeline_span_open = False
+        self._finish(er, er.finish, emit=False)
+
+    async def _drain_pipeline(self, loop) -> None:
+        """Sync barrier: reconcile the in-flight burst (if any) so every
+        synchronous consumer — preemption, prefill interleave, spec or
+        guided decode, shutdown — sees fully-committed host state."""
+        infl, self._inflight = self._inflight, None
+        if infl is None:
+            return
+        await self._apply_burst(loop, infl)
+        for er in infl.active:
+            # still-live rows close their pipelined span here so the
+            # synchronous tail that follows is attributed separately
+            # (finished rows were stamped by _finish_pipelined; cancelled
+            # rows already carry their completion mark)
+            if er.finish is None and er.pipeline_span_open:
+                er.ctx.add_stage("decode_pipeline")
+                er.pipeline_span_open = False
 
     # ---------- disaggregated prefill (decode side) ----------
 
@@ -1164,6 +1451,8 @@ class Scheduler:
         cfg = self.config
         b = cfg.max_batch_size
         bs = cfg.kv_block_size
+        # verify-step dispatches are not decode bursts; stop the clock
+        self._last_burst_done_t = None
         K = cfg.spec_draft_tokens if self.draft is not None \
             else cfg.spec_ngram_tokens
         S = K + 1
@@ -1263,12 +1552,7 @@ class Scheduler:
                 if er.finish is not None:
                     break
                 token = int(ga[i, j])
-                er.seq.push(er.pending_token)
-                er.context_len += 1
-                self._register_completed_blocks(er)
-                er.pending_token = token
-                er.generated += 1
-                er.finish = self._check_finish(er, token)
+                self._advance_row(er, token)
                 self._emit(er, token, None, None)
                 if er.finish is not None:
                     self._finish(er, er.finish, emit=False)
@@ -1362,6 +1646,15 @@ class Scheduler:
         # asked for alternatives (ADVICE r2: fixed decode-path cost)
         want_top = any(er.logprobs_n > 0 for er in active)
 
+        # synchronous path: the device has been idle since the previous
+        # burst's host sync completed — that gap IS the bubble the
+        # dispatch-ahead pipeline exists to close
+        if self._last_burst_done_t is not None:
+            self._bubble_hist.observe(
+                time.monotonic() - self._last_burst_done_t
+            )
+            self._last_burst_done_t = None
+
         if k_steps > 1:
             next_tokens, lps, top_vals, top_ids = self.runner.decode_burst(
                 tokens[:, 0], positions[:, 0], btab,
@@ -1398,6 +1691,7 @@ class Scheduler:
                            np.asarray(top_vals), np.asarray(top_ids))
         )
         self._observe_host_sync(time.monotonic() - t_sync)
+        self._last_burst_done_t = time.monotonic()
         self.steps += 1
         if k_steps == 1:
             # [B] → [1, B] so the emit loop below is one shape
@@ -1414,13 +1708,7 @@ class Scheduler:
                 if er.finish is not None:
                     continue
                 token = int(toks[j, er.slot])
-                # the pending token's KV is now written
-                er.seq.push(er.pending_token)
-                er.context_len += 1
-                self._register_completed_blocks(er)
-                er.pending_token = token
-                er.generated += 1
-                er.finish = self._check_finish(er, token)
+                self._advance_row(er, token)
                 self._guided_after_token(er)
                 self._emit(
                     er, token,

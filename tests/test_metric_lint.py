@@ -50,7 +50,9 @@ def _metric(name, kind):
 def test_rules_reject_bad_names():
     assert check_name(_metric("dynamo_scheduler_preemptions", "counter"))
     assert check_name(_metric("dynamo_BadCase_seconds", "gauge"))
-    assert check_name(_metric("dynamo_queue_depth", "gauge"))
+    # NOTE "depth" joined the unit vocabulary with the decode-pipeline
+    # depth gauge (structural stage counts); "size" remains a non-unit
+    assert check_name(_metric("dynamo_queue_size", "gauge"))
     assert check_name(_metric("dynamo_kv_usage_ratio", "histogram"))
     assert check_name(_metric("dynamo_kv_blocks_total", "gauge"))
     # too few segments: no component between prefix and unit

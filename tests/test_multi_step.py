@@ -57,12 +57,12 @@ def model_dir(tmp_path_factory):
     return d
 
 
-def _config(model_dir, multi_step, **kw):
+def _config(model_dir, multi_step, pipeline=1, **kw):
     cfg = ModelConfig.from_model_dir(model_dir)
     return EngineConfig(
         model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
         num_kv_blocks=96, dtype="float32", multi_step_decode=multi_step,
-        **kw,
+        decode_pipeline_depth=pipeline, **kw,
     )
 
 
@@ -84,11 +84,12 @@ async def _collect(engine, token_ids, sampling, max_tokens=24,
     return toks, finish
 
 
-def _runs(model_dir, multi_step):
+def _runs(model_dir, multi_step, pipeline=1):
     async def go():
         mdc = ModelDeploymentCard.from_local_path(model_dir)
         engine = await JaxServingEngine.create(
-            mdc, engine_config=_config(model_dir, multi_step), warmup=False
+            mdc, engine_config=_config(model_dir, multi_step, pipeline),
+            warmup=False,
         )
         results = []
         # greedy; seeded sampling; penalties + repetition; concurrent pair
@@ -172,6 +173,82 @@ async def test_burst_near_model_len_falls_back_and_finishes(model_dir):
     await engine.close()
     assert finish == "length"
     assert len(toks) == 32 - 20  # runs right up to max_model_len
+
+
+def test_pipelined_streams_bit_equal_to_sync(model_dir):
+    """Dispatch-ahead (decode_pipeline_depth=2) must be invisible in
+    outputs: greedy, seeded sampling, penalties, and concurrent pairs
+    all produce byte-identical streams vs the synchronous path."""
+    assert _runs(model_dir, 4, pipeline=1) == _runs(model_dir, 4, pipeline=2)
+
+
+def test_pipelined_single_step_bursts_bit_equal(model_dir):
+    # pipelining with multi_step_decode=1 runs a K=1 burst program —
+    # still identical to the plain per-token path
+    assert _runs(model_dir, 1, pipeline=1) == _runs(model_dir, 1, pipeline=2)
+
+
+@pytest.mark.asyncio
+async def test_pipelined_eos_one_burst_late_trims_and_finishes(model_dir):
+    """A stop token landing mid-burst under depth 2 is detected one burst
+    late: the over-decoded burst must be retro-invalidated (tokens
+    truncated, blocks rolled back, slot freed) and the emitted stream
+    must equal the synchronous path's, byte for byte."""
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+    single = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4), warmup=False)
+    toks, _ = await _collect(single, [1, 5, 9],
+                             SamplingOptions(temperature=0.0), max_tokens=12)
+    stop_tok = toks[5]  # lands mid-burst AND one burst late under K=4
+    want, want_finish = await _collect(
+        single, [1, 5, 9], SamplingOptions(temperature=0.0), max_tokens=12,
+        stop_hidden=[stop_tok])
+    await single.close()
+    assert want_finish == "stop" and len(want) < len(toks)
+
+    piped = await JaxServingEngine.create(
+        mdc, engine_config=_config(model_dir, 4, pipeline=2), warmup=False)
+    got, finish = await _collect(
+        piped, [1, 5, 9], SamplingOptions(temperature=0.0), max_tokens=12,
+        stop_hidden=[stop_tok])
+    sched = piped.scheduler
+    assert sched.pipeline_bursts > 0, "pipeline never engaged"
+    assert sched._inflight is None  # nothing left unreconciled
+    # retro-invalidation returned every block (no leak from headroom)
+    assert sched.allocator.used == 0
+    await piped.close()
+    assert (got, finish) == (want, want_finish)
+
+
+@pytest.mark.asyncio
+async def test_pipelined_bubble_metric_and_depth_gauge(model_dir):
+    """The pipelined run must dispatch ahead (depth gauge reads 2 while a
+    burst is in flight) and record bubble observations; the sync run
+    records strictly positive gaps."""
+    mdc = ModelDeploymentCard.from_local_path(model_dir)
+
+    async def run(depth):
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=_config(model_dir, 4, pipeline=depth),
+            warmup=False)
+        await _collect(engine, [1, 5, 9], SamplingOptions(temperature=0.0),
+                       max_tokens=16)
+        hist = engine.scheduler._bubble_hist
+        key = ()
+        totals = hist.totals.get(key, 0)
+        sums = hist.sums.get(key, 0.0)
+        bursts = engine.scheduler.pipeline_bursts
+        exposition = engine.scheduler.registry.render()
+        await engine.close()
+        return totals, sums, bursts, exposition
+
+    n_sync, sum_sync, bursts_sync, _ = await run(1)
+    n_pipe, sum_pipe, bursts_pipe, expo = await run(2)
+    assert bursts_sync == 0 and bursts_pipe > 0
+    assert n_sync > 0 and sum_sync > 0.0  # sync path: real host bubbles
+    assert n_pipe > 0  # pipelined path still observes (mostly zeros)
+    assert "dynamo_engine_decode_pipeline_bubble_seconds_bucket" in expo
+    assert "dynamo_engine_decode_pipeline_depth" in expo
 
 
 @pytest.mark.asyncio
