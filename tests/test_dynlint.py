@@ -660,6 +660,109 @@ def test_scoped_paths_produce_baseline_stable_keys():
                     if f.file == "dynamo_tpu/engine/guided.py"]
 
 
+# --------------------------------------------------------------------------
+# dispatch-ahead decode pipeline: the hot loop's purity contract
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_decode_pipeline_modules_pass_jit_impure_and_async_blocking():
+    """The pipelined decode path lives or dies on two properties dynlint
+    polices: no host syncs inside the traced burst program (jit-impure)
+    and no blocking calls on the scheduler's event loop (async-blocking
+    — the executor-side token sync must be the only host sync in the
+    loop). Pin them with ZERO findings, not baseline-covered ones."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "engine", "scheduler.py"),
+        os.path.join(PACKAGE_ROOT, "engine", "model_runner.py"),
+        os.path.join(PACKAGE_ROOT, "engine", "block_allocator.py"),
+    ]
+    found = lint_paths(modules, get_rules(["jit-impure", "async-blocking"]))
+    assert found == [], "pipeline hot path regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_scheduler_token_sync_is_the_only_loop_host_sync():
+    """Structural pin for the pipeline's purity claim: inside
+    engine/scheduler.py's async functions, every ``np.asarray`` host
+    sync happens inside a nested (executor-bound) ``def``, never
+    directly on the event loop."""
+    import ast
+
+    path = os.path.join(PACKAGE_ROOT, "engine", "scheduler.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+
+    def direct_calls(fn):
+        """Call nodes in fn's body, excluding nested function bodies
+        (those run wherever they're called — here, the executor)."""
+        out = []
+        stack = [n for n in ast.iter_child_nodes(fn)]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for call in direct_calls(node):
+            f_ = call.func
+            if (isinstance(f_, ast.Attribute) and f_.attr == "asarray"
+                    and isinstance(f_.value, ast.Name)
+                    and f_.value.id == "np"):
+                offenders.append((node.name, call.lineno))
+    assert not offenders, (
+        "np.asarray on the scheduler event loop (host sync must ride "
+        f"run_in_executor): {offenders}"
+    )
+
+
+def test_jit_impure_flags_host_sync_in_burst_shaped_program():
+    """TP fixture shaped like the burst program: an np.asarray of the
+    carry inside the traced function is exactly the per-dispatch stall
+    the pipeline exists to remove — jit-impure must catch it."""
+    out = findings(
+        """
+        import jax
+        import numpy as np
+
+        def build(step):
+            def burst(carry, tokens0):
+                toks = step(carry, tokens0)
+                host = np.asarray(toks)   # host sync under trace
+                return toks, host
+            return jax.jit(burst)
+        """,
+        "jit-impure",
+    )
+    assert [f.rule for f in out] == ["jit-impure"]
+    assert "numpy.asarray" in out[0].message
+
+
+def test_async_blocking_flags_sync_sleep_in_pipelined_loop_shape():
+    """TP fixture shaped like a naive dispatch-ahead loop that waits for
+    the device with a blocking sleep on the event loop."""
+    out = findings(
+        """
+        import time
+        async def decode_pipelined(runner, bursts):
+            for burst in bursts:
+                runner.dispatch(burst)
+                time.sleep(0.001)  # "wait for the device"
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
 @pytest.mark.dynlint
 def test_enforcement_scan_is_not_vacuous():
     """The walk must actually see the tree: recorded debt is present and
