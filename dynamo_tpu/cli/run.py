@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dispatch-ahead decode: 2 double-buffers bursts "
                         "(burst k+1 dispatches while the host streams "
                         "burst k's tokens); 0/1 = strictly synchronous")
+    p.add_argument("--disagg-stream-depth", type=int, default=2,
+                   help="streamed remote prefill: KV transfer frames in "
+                        "flight on the prefill worker (2 double-buffers "
+                        "— next frame gathers while the previous one is "
+                        "on the wire; 1 = strictly serial frames)")
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="serving-time weight-only quantization (halves "
                         "the decode weight stream; llama-family)")
@@ -576,6 +581,7 @@ async def run_prefill(flags) -> None:
     from ..engine.model_runner import ModelRunner
     from ..engine.serving import engine_config_from_mdc
     from ..runtime.component import DistributedRuntime
+    from ..telemetry.server import maybe_start_metrics_server
 
     if flags.store_port is None:
         raise SystemExit("in=prefill requires --store-port")
@@ -590,10 +596,18 @@ async def run_prefill(flags) -> None:
         drt, runner, engine_config, namespace=flags.namespace,
         ici=_make_ici(flags, runner),
     )
+    # same sidecar the decode workers run: prefill throughput, transfer
+    # bytes, queue wait, and the transfer-overlap histograms land in a
+    # scrapeable /metrics instead of only the ad-hoc metrics() dict
+    mserver = await maybe_start_metrics_server(
+        worker.registry, flags.metrics_port
+    )
     print(f"prefill worker consuming {worker.queue.name}", flush=True)
     try:
         await worker.run()
     finally:
+        if mserver is not None:
+            await mserver.stop()
         await worker.close()
         await drt.close()
 

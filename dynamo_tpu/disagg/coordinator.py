@@ -60,6 +60,8 @@ class RemotePrefillCoordinator:
             ici_rank=None if ici is None else ici.receiver_rank,
         )
         self._pending: Dict[str, asyncio.Future] = {}
+        # request id → AsyncEngineContext, for the kv_transfer stage mark
+        self._ctx: Dict[str, object] = {}
         self._queue_depth = 0
         self._depth_refresh_s = depth_refresh_s
         self._depth_task: Optional[asyncio.Task] = None
@@ -136,10 +138,17 @@ class RemotePrefillCoordinator:
                      want_logprobs: bool = False,
                      logprobs_n: int = 0,
                      logit_bias: Optional[dict] = None,
-                     trace_id: str = "") -> asyncio.Future:
-        """Enqueue the prompt; returns a future → (first_token, logprob)."""
+                     trace_id: str = "", ctx=None) -> asyncio.Future:
+        """Enqueue the prompt; returns a future → (first_token, logprob).
+
+        ``ctx`` (the request's AsyncEngineContext, optional) gets a
+        ``kv_transfer`` stage mark stamped when the commit lands, so the
+        trace attributes the remote compute+transfer span distinctly from
+        the scheduler's install latency."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        if ctx is not None:
+            self._ctx[request_id] = ctx
         try:
             await self.queue.push(RemotePrefillRequest(
                 request_id=request_id,
@@ -153,11 +162,13 @@ class RemotePrefillCoordinator:
                 repetition_penalty=repetition_penalty, seed=seed,
                 want_logprobs=want_logprobs, logprobs_n=logprobs_n,
                 logit_bias=logit_bias, trace_id=trace_id,
+                enqueued_at=time.time(),
             ))
         except Exception:
             # push failed — nothing is coming; don't leak the pending entry
             # (it would also keep authorizing frames for a dead request id)
             self._pending.pop(request_id, None)
+            self._ctx.pop(request_id, None)
             self._failures.inc(reason="submit")
             raise
         self.remote_submitted += 1
@@ -168,6 +179,7 @@ class RemotePrefillCoordinator:
     def cancel(self, request_id: str, reason: str = "cancelled") -> None:
         """Stop accepting frames for a request (cancel / timeout fallback)."""
         fut = self._pending.pop(request_id, None)
+        self._ctx.pop(request_id, None)
         if self._submit_t.pop(request_id, None) is not None:
             self._failures.inc(reason=reason)
         if fut is not None and not fut.done():
@@ -201,9 +213,16 @@ class RemotePrefillCoordinator:
                 logprob: Optional[float],
                 top: Optional[dict] = None) -> None:
         fut = self._pending.pop(request_id, None)
+        ctx = self._ctx.pop(request_id, None)
         if fut is None or fut.done():
             logger.warning("commit for unknown request %s", request_id)
             return
+        if ctx is not None:
+            # closing-mark semantics (telemetry/tracing.py): the span from
+            # the submit-side "admission" mark to here is the remote
+            # compute + streamed KV transfer; install latency then lands
+            # under the scheduler's "remote_prefill" mark
+            ctx.add_stage("kv_transfer")
         self.remote_completed += 1
         t0 = self._submit_t.pop(request_id, None)
         if t0 is not None:

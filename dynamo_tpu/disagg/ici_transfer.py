@@ -17,6 +17,16 @@ sender first streams an ``ici_blocks`` header (ids, bucket — no payload),
 then both sides enter the collective for the bucketed block arrays. A
 lost peer surfaces as the collective's timeout rather than a hung socket.
 
+The streamed prefill pipeline (disagg/prefill_worker.py) drives this
+plane PIPELINED: while one ``send`` runs in an executor thread, the next
+frame's device gather (and the next prefill chunk's compute) dispatch on
+the event loop — safe because ``send`` only touches its own gathered
+arrays, never the runner's donated cache buffers. The 1:1 pairing
+discipline is preserved by construction: at most one collective is in
+flight, and frame i+1's header is written only after frame i's ``send``
+resolved, so an ``IciSendError`` always classifies against the last
+header sent and the balancing rules below apply unchanged.
+
 The payload STRIPES across device pairs: the mesh is [2, P] ("peer" ×
 "pair") over min(sender-local, receiver-local) devices (rounded down to
 a power of two), the bucketed block axis splits into P stripes, and the

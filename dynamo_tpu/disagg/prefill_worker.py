@@ -5,18 +5,44 @@ examples/llm/components/prefill_worker.py:50-181 — poll loop over the NATS
 JetStream queue, NIXL metadata lookup in etcd, prefill with max_tokens=1,
 RDMA write into the decode worker's blocks). Here: pop the dynstore work
 queue, resolve the decode engine's transfer descriptor from discovery, run
-one bucketed prefill step on the local runner (using the worker's *own*
-prefix cache to skip recomputation), gather the needed blocks from HBM and
-stream them to the decode engine, then commit the sampled first token.
-The queue item is acked only after the commit is acknowledged — a crash
-anywhere earlier redelivers the work to another prefill worker.
+the prefill as a CHUNKED pipeline on the local runner (the same shared
+``build_prefill_arrays`` bucket ladder + ``max_prefill_tokens_per_step``
+budget the decode scheduler's local chunked prefill uses), and stream each
+chunk's completed KV blocks to the decode engine while the next chunk
+computes on device — the reference's ``CopyStream::trigger_layer`` per-layer
+overlap (disagg/transfer.py module docstring), lifted to per-chunk
+granularity. Remote TTFT then approaches ``max(compute, transfer)`` instead
+of their sum, and host memory is bounded at ≤2 chunk-sized frames instead
+of scaling with prompt length. The queue item is acked only after the
+commit is acknowledged — a crash anywhere earlier redelivers the work to
+another prefill worker. One streaming-era nuance: if the crash happened
+AFTER a frame shipped, the receiver conservatively poisons that request's
+commit (it cannot prove a re-stream covered everything the dead
+connection touched), so the redelivered attempt is nacked and the decode
+side completes via local-prefill fallback; crashes before the first frame
+redeliver-and-commit normally (docs/disagg_serving.md).
+
+Pipeline shape (both transfer planes):
+
+  chunk i compute ──▶ chunk i+1 compute ──▶ ...      (device, dispatch order)
+        └▶ frame gather (device)  └▶ frame gather
+               └▶ pack/host-sync + wire write        (pump: executor + socket)
+
+The jitted frame gather is dispatched on the event loop, BETWEEN chunk
+steps: the step donates the cache buffers, so every op touching
+``runner.kv_cache`` must serialize on one thread, and device dispatch order
+then pins each gather to read exactly the blocks its chunk completed. All
+host syncs (device→host copy, byte packing) and frame writes ride the pump
+off-loop — the executor-bound discipline dynlint's ``async-blocking`` rule
+enforces.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import msgpack
@@ -24,12 +50,69 @@ import numpy as np
 
 from ..engine.block_allocator import BlockAllocator
 from ..engine.sampling import seed_to_key
-from ..engine.scheduler import build_prefill_arrays
+from ..engine.scheduler import build_prefill_arrays, prefill_bucket_cap
+from ..telemetry.registry import MetricsRegistry
 from ..tokens import compute_block_hashes
 from .protocols import PrefillQueue, RemotePrefillRequest
 from .transfer import KvTransferClient, transfer_key
 
 logger = logging.getLogger(__name__)
+
+
+class _FramePipe:
+    """Bounded conveyor between the chunk loop and one transfer pump.
+
+    The producer (``_handle``'s chunk loop) dispatches device gathers and
+    enqueues (k_dev, v_dev, dst_ids) frames; the pump coroutine drains
+    them to the wire. ``maxsize=1`` plus the pump's one-frame lookahead
+    bounds live buffers: at most two chunk-sized frames exist in host
+    memory at any point (one being packed, one on the wire), regardless
+    of prompt length.
+    """
+
+    def __init__(self, depth: int, frame_blocks: int):
+        self.depth = depth  # 1 = strictly serial frames, 2 = double-buffered
+        self.frame_blocks = frame_blocks  # max KV blocks per frame
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self.closed = False  # pump consumed the end-of-stream sentinel
+        self.error: Optional[BaseException] = None
+        self.nbytes = 0
+        self.frames = 0
+        self.first_frame_t: Optional[float] = None
+        self.live_host_frames = 0
+        self.max_live_host_frames = 0
+        self.task: Optional[asyncio.Task] = None
+
+    async def put(self, frame) -> None:
+        if self.error is not None:
+            raise self.error
+        if self.first_frame_t is None:
+            self.first_frame_t = time.monotonic()
+        await self.q.put(frame)
+        # the pump may have failed while we were blocked on the queue
+        if self.error is not None:
+            raise self.error
+
+    async def drain(self) -> int:
+        """Flush: every enqueued frame is on the wire (or the pump's
+        failure is re-raised). Must be awaited before the commit frame."""
+        await self.q.put(None)
+        await self.task
+        if self.error is not None:
+            raise self.error
+        return self.nbytes
+
+    async def shutdown(self) -> None:
+        """Abnormal-exit cleanup: the happy path already joined the pump
+        via drain(); anything else is an error/cancel path where the
+        connection is being torn down anyway — cancel outright."""
+        if self.task is not None and not self.task.done():
+            self.task.cancel()
+            try:
+                await self.task
+            # dynlint: allow(silent-except) - cancel-join of an abandoned pump; the originating error already propagated via pipe.error
+            except BaseException:
+                pass
 
 
 class PrefillWorker:
@@ -59,10 +142,58 @@ class PrefillWorker:
         self.key = jax.random.PRNGKey(config.seed)
         self._clients: Dict[str, KvTransferClient] = {}
         self._stopping = False
-        # telemetry
+        # telemetry — plain attributes kept for the ad-hoc metrics() dict
+        # (and tests); the registry renders the same counts into the
+        # /metrics exposition (cli.run run_prefill --metrics-port)
         self.prefills = 0
         self.prefill_tokens = 0
         self.transfer_bytes = 0
+        self.transfer_frames = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_total_tokens = 0
+        self.max_live_host_frames = 0
+        self.registry = MetricsRegistry()
+        self._prefills_c = self.registry.counter(
+            "dynamo_prefill_worker_prefills_total",
+            "Remote prefills completed by this worker (committed or nacked)",
+        )
+        self._prefill_tokens_c = self.registry.counter(
+            "dynamo_prefill_worker_prefill_tokens_total",
+            "Prompt tokens actually computed (prefix-cache hits excluded)",
+        )
+        self._transfer_bytes_c = self.registry.counter(
+            "dynamo_prefill_worker_transfer_bytes_total",
+            "KV payload bytes shipped to decode engines (both planes)",
+        )
+        self._queue_wait_h = self.registry.histogram(
+            "dynamo_prefill_worker_queue_wait_seconds",
+            "Queue latency: decode-side enqueue → this worker's pop",
+        )
+        self._transfer_h = self.registry.histogram(
+            "dynamo_disagg_transfer_duration_seconds",
+            "KV transfer wall time: first frame enqueued → commit acked",
+        )
+        self._exposed_h = self.registry.histogram(
+            "dynamo_disagg_transfer_exposed_seconds",
+            "Non-overlapped transfer tail: time spent shipping KV (and the "
+            "commit RTT) AFTER the last prefill chunk's compute finished — "
+            "0 means the transfer fully hid behind compute",
+        )
+        self.registry.callback_gauge(
+            "dynamo_prefill_worker_kv_active_blocks",
+            "KV blocks held by in-flight prefills + this worker's prefix cache",
+            lambda: self.allocator.used,
+        )
+        self.registry.callback_gauge(
+            "dynamo_prefill_worker_prefix_hit_ratio",
+            "Prompt tokens skipped via this worker's own prefix cache / "
+            "total prompt tokens (mirror of the scheduler's "
+            "dynamo_kv_prefix_hit_ratio)",
+            lambda: (
+                self.prefix_hit_tokens / self.prefix_total_tokens
+                if self.prefix_total_tokens else 0.0
+            ),
+        )
 
     # ---------- main loop ----------
 
@@ -86,6 +217,10 @@ class PrefillWorker:
         if popped is None:
             return False
         rpr, ack = popped
+        if rpr.enqueued_at:
+            # wall-clock across processes (same deployment host class);
+            # clamp at 0 so skew never renders a negative wait
+            self._queue_wait_h.observe(max(0.0, time.time() - rpr.enqueued_at))
         try:
             await self._handle(rpr)
         except Exception:
@@ -102,6 +237,14 @@ class PrefillWorker:
 
     # ---------- the work ----------
 
+    def _chunk_cap(self) -> int:
+        """The shared single-row bucket cap (engine/scheduler.py
+        prefill_bucket_cap — the same derivation the decode scheduler's
+        chunked prefill uses), floored at the smallest bucket: one chunk
+        must still advance or the prefill livelocks."""
+        cap = prefill_bucket_cap(self.config)
+        return cap if cap is not None else self.config.prefill_buckets[0]
+
     async def _handle(self, rpr: RemotePrefillRequest) -> None:
         cfg = self.config
         bs = cfg.kv_block_size
@@ -109,8 +252,11 @@ class PrefillWorker:
         loop = asyncio.get_running_loop()
 
         block_ids, num_cached = self.allocator.allocate_prompt(prompt)
+        pipe: Optional[_FramePipe] = None
         try:
-            arrays = build_prefill_arrays(cfg, prompt, num_cached, block_ids)
+            client = await self._client(rpr.engine_id)
+            use_ici = self.ici is not None and self._ici_usable(client)
+
             if rpr.seed is not None:
                 # same key derivation as the decode scheduler's local path:
                 # fold_in(seed_key, generated=0) — bit-identical first token
@@ -124,11 +270,12 @@ class PrefillWorker:
             self.runner.set_sample_row(
                 0, prompt, [], logit_bias=rpr.logit_bias
             )
-            next_tokens, lps, top_vals, top_ids, *_ = self.runner.step(
-                *arrays,
+            samp_args = (
                 np.asarray([rpr.temperature], np.float32),
                 np.asarray([rpr.top_k], np.int32),
                 np.asarray([rpr.top_p], np.float32),
+            )
+            samp_kw = dict(
                 min_p=np.asarray([rpr.min_p], np.float32),
                 presence_penalty=np.asarray([rpr.presence_penalty], np.float32),
                 frequency_penalty=np.asarray([rpr.frequency_penalty], np.float32),
@@ -136,11 +283,51 @@ class PrefillWorker:
                 seed_keys=seed_keys,
                 counters=np.zeros(1, np.int32),
                 sample_slots=np.zeros(1, np.int32),
-                # alternatives only when the request asked for top_logprobs
-                # (logprobs=0 means chosen-token logprob only — skip the
-                # [B, V] top-k sort, same gate as the decode scheduler)
-                want_top=rpr.logprobs_n > 0,
             )
+
+            # stream plan: the decode side already holds blocks below
+            # first_block; everything from there ships as bounded frames,
+            # each as soon as its last position's KV is scheduled
+            first_block = rpr.num_cached // bs
+            limit = len(block_ids)
+            cap = self._chunk_cap()
+            frame_blocks = (
+                self.ici.buckets[-1] if use_ici else max(1, cap // bs)
+            )
+            pipe = self._start_pump(client, rpr, use_ici, frame_blocks)
+
+            shipped = first_block
+            # worker-side prefix-cache hits are complete KV from the start:
+            # ship them immediately so their transfer overlaps chunk 1
+            cached_ready = min(num_cached // bs, limit)
+            if cached_ready > shipped:
+                await self._ship(pipe, rpr, block_ids, shipped, cached_ready)
+                shipped = cached_ready
+
+            outs = None
+            pos, total = num_cached, len(prompt)
+            while True:
+                end = min(pos + cap, total)
+                final = end >= total
+                arrays = build_prefill_arrays(cfg, prompt[:end], pos, block_ids)
+                # dispatch-only: JAX queues the step; the one host sync
+                # happens once, on the final chunk's sampled outputs
+                outs = self.runner.step(
+                    *arrays, *samp_args, **samp_kw,
+                    # alternatives only when the request asked for
+                    # top_logprobs, and only on the chunk that samples
+                    # (same gate as the decode scheduler)
+                    want_top=final and rpr.logprobs_n > 0,
+                )
+                ready = limit if final else min(end // bs, limit)
+                if ready > shipped:
+                    await self._ship(pipe, rpr, block_ids, shipped, ready)
+                    shipped = ready
+                pos = end
+                if final:
+                    break
+
+            next_tokens, lps, top_vals, top_ids, *_ = outs
             token, lp, top = await loop.run_in_executor(
                 None,
                 lambda: (
@@ -154,6 +341,7 @@ class PrefillWorker:
                     } if rpr.logprobs_n > 0 else None,
                 ),
             )
+            t_compute_done = time.monotonic()
 
             # feed the local prefix cache so future prompts skip this work
             hashes = compute_block_hashes(prompt, bs)
@@ -162,94 +350,15 @@ class PrefillWorker:
                 self.allocator.register_complete(block_ids[i], h, parent)
                 parent = h
 
-            # gather + push the blocks the decode side doesn't already have
-            first_block = rpr.num_cached // bs
-            src_ids = block_ids[first_block:]
-            dst_ids = rpr.block_ids[first_block : len(block_ids)]
-            client = await self._client(rpr.engine_id)
-            use_ici = self.ici is not None and self._ici_usable(client)
-            nbytes = 0
-            if use_ici:
-                # collective plane: ids over TCP (ordering), bytes HBM→HBM;
-                # chunk at the top transfer bucket — sender and receiver
-                # must enter identically-shaped programs
-                from .ici_transfer import IciSendError
-
-                chunk = self.ici.buckets[-1]
-                for i in range(0, len(src_ids), chunk):
-                    src = src_ids[i : i + chunk]
-                    dst = dst_ids[i : i + chunk]
-                    # gather precedes the header: a gather failure leaves
-                    # the plane balanced (no unpaired receiver entry)
-                    k, v = await loop.run_in_executor(
-                        None,
-                        lambda s=src: self.runner.gather_blocks_device(s),
-                    )
-                    self._ici_seq += 1
-                    seq = self._ici_seq
-                    try:
-                        await client.send_ici_blocks(rpr.request_id, dst, seq)
-                    except BaseException:
-                        # header delivery unknowable → pairing discipline
-                        # unknowable → abandon the plane (tcp from now on);
-                        # the receiver's seq check drops any leftover
-                        logger.exception(
-                            "ici header send failed; abandoning the "
-                            "collective plane (tcp fallback)"
-                        )
-                        self.ici = None
-                        raise
-                    try:
-                        await loop.run_in_executor(
-                            None, lambda a=k, b=v, s=seq: self.ici.send(a, b, s)
-                        )
-                    except IciSendError as e:
-                        if not e.entered:
-                            # receiver holds an unpaired entry for this
-                            # header — pair it with a poison payload (seq
-                            # -1 never matches) so the plane stays 1:1 and
-                            # REMAINS usable for the redelivery
-                            try:
-                                await loop.run_in_executor(
-                                    None,
-                                    lambda n=len(dst):
-                                        self.ici.send_balancing_entry(n),
-                                )
-                                logger.warning(
-                                    "ici send failed before entering the "
-                                    "collective; balanced the plane and "
-                                    "keeping it"
-                                )
-                            except BaseException:
-                                logger.exception(
-                                    "balancing entry failed; abandoning "
-                                    "the collective plane (tcp fallback)"
-                                )
-                                self.ici = None
-                        else:
-                            # the collective itself failed — both sides'
-                            # entries unwound, but the distributed runtime
-                            # is now suspect
-                            logger.exception(
-                                "ici collective failed; abandoning the "
-                                "plane (tcp fallback)"
-                            )
-                            self.ici = None
-                        raise
-                    nbytes += k.nbytes + v.nbytes
-            else:
-                k, v = await loop.run_in_executor(
-                    None, lambda: self.runner.gather_blocks(src_ids)
-                )
-                await client.send_blocks(
-                    rpr.request_id, dst_ids, k, v,
-                    chunk_blocks=self.transfer_chunk_blocks,
-                )
-                nbytes = k.nbytes + v.nbytes
+            nbytes = await pipe.drain()
             committed = await client.send_commit(
                 rpr.request_id, token, lp if rpr.want_logprobs else None,
                 top=top,
             )
+            t_done = time.monotonic()
+            if pipe.first_frame_t is not None:
+                self._transfer_h.observe(t_done - pipe.first_frame_t)
+                self._exposed_h.observe(max(0.0, t_done - t_compute_done))
             if not committed:
                 # the receiver dropped a payload frame and nacked — the
                 # decode side re-prefills locally after its timeout. Work
@@ -263,8 +372,221 @@ class PrefillWorker:
             self.prefills += 1
             self.prefill_tokens += len(prompt) - num_cached
             self.transfer_bytes += nbytes
+            self.transfer_frames += pipe.frames
+            self.prefix_hit_tokens += num_cached
+            self.prefix_total_tokens += len(prompt)
+            self.max_live_host_frames = max(
+                self.max_live_host_frames, pipe.max_live_host_frames
+            )
+            self._prefills_c.inc()
+            self._prefill_tokens_c.inc(len(prompt) - num_cached)
+            self._transfer_bytes_c.inc(nbytes)
         finally:
+            if pipe is not None:
+                await pipe.shutdown()
             self.allocator.free_blocks(block_ids)
+
+    # ---------- the frame stream ----------
+
+    def _start_pump(self, client, rpr, use_ici: bool,
+                    frame_blocks: int) -> _FramePipe:
+        pipe = _FramePipe(
+            depth=getattr(self.config, "disagg_stream_depth", 2),
+            frame_blocks=frame_blocks,
+        )
+        pump = self._ici_pump if use_ici else self._tcp_pump
+        pipe.task = asyncio.ensure_future(self._run_pump(pipe, pump, client, rpr))
+        return pipe
+
+    async def _run_pump(self, pipe: _FramePipe, pump, client, rpr) -> None:
+        try:
+            await pump(pipe, client, rpr)
+        except asyncio.CancelledError:
+            # shutdown() cancelling this task — do NOT enter the consume
+            # loop: a caught cancellation is not re-delivered, so waiting
+            # on the queue here would block forever (nothing will feed it;
+            # the producer is the one tearing us down)
+            raise
+        # dynlint: allow(silent-except) - not swallowed: stored in pipe.error, re-raised by drain()/put()
+        except BaseException as e:
+            pipe.error = e
+            # keep consuming so a producer blocked on the bounded queue
+            # wakes up (it re-checks pipe.error after every put); stop at
+            # the sentinel — and skip entirely if the pump already saw it
+            while not pipe.closed:
+                if await pipe.q.get() is None:
+                    pipe.closed = True
+
+    async def _ship(self, pipe: _FramePipe, rpr, block_ids,
+                    lo: int, hi: int) -> None:
+        """Dispatch the device gather for blocks [lo, hi) and enqueue the
+        frames. Runs on the event loop by design: the gather must
+        serialize with the chunk steps (the step donates the cache
+        buffers it replaces), and loop-side dispatch order pins the read
+        between the chunk that completed these blocks and the next."""
+        step = pipe.frame_blocks
+        for i in range(lo, hi, step):
+            src = block_ids[i : min(i + step, hi)]
+            dst = rpr.block_ids[i : min(i + step, hi)]
+            k_dev, v_dev = self.runner.gather_blocks_device(src)
+            await pipe.put((k_dev, v_dev, dst))
+
+    async def _tcp_pump(self, pipe: _FramePipe, client, rpr) -> None:
+        """TCP plane: per frame, host-sync the gathered blocks in an
+        executor, then write the frame; with depth 2 the next frame's
+        host copy proceeds while the previous frame's bytes drain."""
+        loop = asyncio.get_running_loop()
+        prev_send: Optional[asyncio.Task] = None
+
+        async def send(k: np.ndarray, v: np.ndarray, dst: List[int]) -> None:
+            try:
+                await client.send_blocks(
+                    rpr.request_id, dst, k, v,
+                    chunk_blocks=self.transfer_chunk_blocks,
+                )
+                pipe.nbytes += k.nbytes + v.nbytes
+            finally:
+                pipe.live_host_frames -= 1
+
+        try:
+            while True:
+                frame = await pipe.q.get()
+                if frame is None:
+                    pipe.closed = True
+                    break
+                k_dev, v_dev, dst = frame
+                k, v = await loop.run_in_executor(
+                    None,
+                    lambda a=k_dev, b=v_dev: self.runner.blocks_to_host(a, b),
+                )
+                pipe.frames += 1
+                pipe.live_host_frames += 1
+                pipe.max_live_host_frames = max(
+                    pipe.max_live_host_frames, pipe.live_host_frames
+                )
+                if prev_send is not None:
+                    await prev_send
+                    prev_send = None
+                if pipe.depth >= 2:
+                    prev_send = asyncio.ensure_future(send(k, v, dst))
+                else:
+                    await send(k, v, dst)
+            if prev_send is not None:
+                await prev_send
+                prev_send = None
+        finally:
+            if prev_send is not None:
+                prev_send.cancel()
+                try:
+                    await prev_send
+                # dynlint: allow(silent-except) - cancel-join of the in-flight frame write on the error path; the primary error is already propagating
+                except BaseException:
+                    pass
+
+    async def _ici_pump(self, pipe: _FramePipe, client, rpr) -> None:
+        """Collective plane: ids over TCP (ordering), bytes HBM→HBM.
+
+        Pipelined but discipline-preserving: at most ONE collective is in
+        flight, and frame i+1's header is written only after frame i's
+        collective resolved — a failure therefore always classifies
+        against the LAST header sent, so the poison-balancing rules
+        (pre-entry → balance and keep the plane; entered/unknowable →
+        abandon) apply exactly as in the serial loop. The overlap comes
+        from the chunk loop: the next frame's device gather (and the next
+        chunk's compute) dispatch while this frame's bytes are on the
+        interconnect.
+        """
+        loop = asyncio.get_running_loop()
+        prev: Optional[Tuple] = None  # (executor future, ndst, nbytes)
+
+        async def finish_prev():
+            # clear BEFORE awaiting: a failed finish must never be
+            # re-awaited by the finally below — its classification
+            # (balancing entry / plane abandonment) already ran, and
+            # running it twice would itself unbalance the plane
+            nonlocal prev
+            p, prev = prev, None
+            await self._finish_ici_send(loop, pipe, p)
+
+        try:
+            while True:
+                frame = await pipe.q.get()
+                if frame is None:
+                    pipe.closed = True
+                    break
+                k_dev, v_dev, dst = frame
+                if prev is not None:
+                    await finish_prev()
+                self._ici_seq += 1
+                seq = self._ici_seq
+                try:
+                    await client.send_ici_blocks(rpr.request_id, dst, seq)
+                except BaseException:
+                    # header delivery unknowable → pairing discipline
+                    # unknowable → abandon the plane (tcp from now on);
+                    # the receiver's seq check drops any leftover
+                    logger.exception(
+                        "ici header send failed; abandoning the "
+                        "collective plane (tcp fallback)"
+                    )
+                    self.ici = None
+                    raise
+                pipe.frames += 1
+                fut = loop.run_in_executor(
+                    None, lambda a=k_dev, b=v_dev, s=seq: self.ici.send(a, b, s)
+                )
+                prev = (fut, len(dst), int(k_dev.nbytes) + int(v_dev.nbytes))
+                if pipe.depth < 2:
+                    await finish_prev()
+            if prev is not None:
+                await finish_prev()
+        finally:
+            if prev is not None:
+                # error/cancel path with a collective still in flight:
+                # join and classify it so the plane's pairing discipline
+                # (balancing entry or abandonment) runs instead of the
+                # future being abandoned with an unpaired receiver entry
+                try:
+                    await finish_prev()
+                # dynlint: allow(silent-except) - classification/balancing already ran inside; the primary error is propagating
+                except BaseException:
+                    pass
+
+    async def _finish_ici_send(self, loop, pipe: _FramePipe, prev) -> None:
+        from .ici_transfer import IciSendError
+
+        fut, ndst, nbytes = prev
+        try:
+            await fut
+        except IciSendError as e:
+            if not e.entered:
+                # receiver holds an unpaired entry for this header — pair
+                # it with a poison payload (seq -1 never matches) so the
+                # plane stays 1:1 and REMAINS usable for the redelivery
+                try:
+                    await loop.run_in_executor(
+                        None, lambda n=ndst: self.ici.send_balancing_entry(n)
+                    )
+                    logger.warning(
+                        "ici send failed before entering the collective; "
+                        "balanced the plane and keeping it"
+                    )
+                except BaseException:
+                    logger.exception(
+                        "balancing entry failed; abandoning the collective "
+                        "plane (tcp fallback)"
+                    )
+                    self.ici = None
+            else:
+                # the collective itself failed — both sides' entries
+                # unwound, but the distributed runtime is now suspect
+                logger.exception(
+                    "ici collective failed; abandoning the plane "
+                    "(tcp fallback)"
+                )
+                self.ici = None
+            raise
+        pipe.nbytes += nbytes
 
     def _ici_usable(self, client) -> bool:
         """The collective plane applies only when the TARGET engine is this

@@ -48,6 +48,10 @@ class RemotePrefillRequest:
     # ingress-assigned correlation id (X-Request-Id); log/span context only —
     # transfer authorization and pending state key on request_id
     trace_id: str = ""
+    # wall-clock (time.time) at enqueue, for the prefill worker's
+    # queue-wait histogram; 0 = unset (older senders). Telemetry only —
+    # never used for ordering or expiry (cross-process clock skew).
+    enqueued_at: float = 0.0
 
     def to_wire(self) -> bytes:
         d = dataclasses.asdict(self)
@@ -64,7 +68,11 @@ class RemotePrefillRequest:
             d["logit_bias"] = {
                 int(k): float(v) for k, v in d["logit_bias"].items()
             }
-        return cls(**d)
+        # drop unknown keys so the wire format stays forward-compatible:
+        # a newer coordinator adding a field must not crash an older
+        # worker's pop (mixed-version fleets during rolling upgrades)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 class PrefillQueue:

@@ -173,6 +173,14 @@ class KvTransferServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # request ids with payload frames on THIS connection whose commit
+        # has not arrived yet: a connection that dies mid-stream leaves
+        # those requests' caches partially scattered, so their commits
+        # must be nacked — streamed transfer means a frame can be on the
+        # wire while later chunks are still computing, and a sender crash
+        # between frames must never let a (redelivered) commit resume
+        # decode over a cache whose provenance this receiver can't prove
+        streaming: set = set()
         try:
             while True:
                 try:
@@ -185,6 +193,11 @@ class KvTransferServer:
                     return
                 header = msgpack.unpackb(await _read_exact(reader, hlen), raw=False)
                 mtype = header.get("type")
+                if mtype in ("blocks", "ici_blocks"):
+                    # mark BEFORE the payload read: dying mid-payload is
+                    # the same partial-stream hazard as dying between
+                    # frames
+                    streaming.add(header["request_id"])
                 if mtype == "blocks":
                     k_raw = await _read_exact(reader, header["k_bytes"])
                     v_raw = await _read_exact(reader, header["v_bytes"])
@@ -263,6 +276,7 @@ class KvTransferServer:
                         await result
                 elif mtype == "commit":
                     rid = header["request_id"]
+                    streaming.discard(rid)
                     if rid in self._dropped:
                         # a payload frame for this request was dropped —
                         # its KV blocks were never (fully) scattered, so
@@ -294,6 +308,13 @@ class KvTransferServer:
         except Exception:
             logger.exception("kv transfer connection failed")
         finally:
+            for rid in streaming:
+                logger.warning(
+                    "transfer connection closed mid-stream for %s; "
+                    "poisoning its commit (decode will fall back to "
+                    "local prefill)", rid,
+                )
+                self._mark_dropped(rid)
             writer.close()
 
     async def close(self) -> None:

@@ -339,6 +339,17 @@ class EngineConfig:
     # with the host KV tier (restored blocks would hold stale draft KV).
     spec_draft_model: Optional[str] = None  # HF dir of the draft model
     spec_draft_tokens: int = 0              # K proposals per round (2..16)
+    # streamed remote prefill (the disagg prefill worker): the worker
+    # always chunks its prefill with the shared bucket ladder +
+    # max_prefill_tokens_per_step and streams each chunk's completed KV
+    # blocks while the next chunk computes, so remote TTFT approaches
+    # max(compute, transfer) instead of compute + transfer. This knob is
+    # the transfer plane's frame depth: 2 (default) double-buffers — the
+    # next frame's gather/host-pack proceeds while the previous frame's
+    # bytes are on the wire — and 1 ships frames strictly serially.
+    # Streams are byte-identical at every depth; host memory is bounded
+    # at <= depth chunk-sized frames either way.
+    disagg_stream_depth: int = 2
     enable_prefix_caching: bool = True
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
@@ -374,6 +385,9 @@ class EngineConfig:
         # already fully overlapped, and reconciliation lag grows with
         # every extra stage — clamp instead of failing
         self.decode_pipeline_depth = max(0, min(self.decode_pipeline_depth, 2))
+        # one frame in flight is the serial floor; beyond two buys nothing
+        # (the wire is busy continuously at 2) and unbounds host buffers
+        self.disagg_stream_depth = max(1, min(self.disagg_stream_depth, 2))
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
         if self.spec_draft_tokens and not self.spec_draft_model:

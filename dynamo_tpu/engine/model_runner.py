@@ -840,15 +840,28 @@ class ModelRunner:
 
     def gather_blocks(self, block_ids) -> Tuple[np.ndarray, np.ndarray]:
         """Read KV blocks out of HBM → host arrays [L, n, bs, KVH, D] ×2."""
-        k, v = self.gather_blocks_device(block_ids)
-        return np.asarray(jax.device_get(k)), np.asarray(jax.device_get(v))
+        return self.blocks_to_host(*self.gather_blocks_device(block_ids))
+
+    @staticmethod
+    def blocks_to_host(k_dev, v_dev) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-sync one gathered (k, v) block frame.
+
+        The blocking half of the streamed-transfer split: callers on an
+        event loop dispatch ``gather_blocks_device`` inline (cheap, and it
+        must serialize with ``step``'s donated cache buffers) and run this
+        device→host copy in an executor, so the wire pump never stalls the
+        loop (disagg/prefill_worker.py's bounded per-chunk frames).
+        """
+        return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
 
     def gather_blocks_device(self, block_ids):
         """Read KV blocks as DEVICE arrays [L, n, bs, KVH, D] ×2.
 
-        Same bucketed gather as gather_blocks without the host round-trip —
-        feeds the collective transfer plane (disagg/ici_transfer.py), which
-        moves HBM→HBM and must never bounce through numpy.
+        Same bucketed gather as gather_blocks without the host round-trip.
+        Dispatch-only (no host sync): feeds the collective transfer plane
+        (disagg/ici_transfer.py, HBM→HBM — must never bounce through
+        numpy) and the streamed prefill pipeline's chunk-sized frames,
+        which pair it with ``blocks_to_host`` off-loop.
         """
         ids = list(block_ids)
         ks, vs = [], []

@@ -68,6 +68,25 @@ def ngram_propose(history: List[int], match: int, k: int) -> List[int]:
     return [int(t) for t in history[i + match: i + match + k]]
 
 
+def prefill_bucket_cap(cfg: EngineConfig, rows: int = 1) -> Optional[int]:
+    """Largest prefill bucket such that ``rows * bucket`` fits the
+    per-step token budget (the ITL bound counts padded positions, so the
+    cap is on the padded product). None when even the smallest bucket
+    overruns — the caller sheds rows (the scheduler) or floors at the
+    smallest bucket (the prefill worker: one chunk must still advance or
+    prefill livelocks). No budget = no cap.
+
+    Shared by the scheduler's local chunked prefill and the disagg
+    prefill worker's streamed chunking — both sides MUST derive the same
+    ladder or remote chunk shapes drift from local ones.
+    """
+    budget = cfg.max_prefill_tokens_per_step
+    if not budget:
+        return cfg.prefill_buckets[-1]
+    allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
+    return allowed[-1] if allowed else None
+
+
 def build_prefill_arrays(cfg: EngineConfig, prompt: List[int], num_cached: int,
                          block_ids: List[int], bucket: Optional[int] = None):
     """Batch-of-1 arrays for one bucketed prefill step.
@@ -977,6 +996,7 @@ class Scheduler:
                 logprobs_n=er.logprobs_n,
                 logit_bias=er.req.sampling_options.logit_bias,
                 trace_id=er.ctx.trace_id,
+                ctx=er.ctx,  # kv_transfer stage mark stamped at commit
             )
         except Exception:
             # queue unreachable — release and let the local path take it
@@ -1136,25 +1156,22 @@ class Scheduler:
         prompt sample/emit. The token budget splits across rows."""
         cfg = self.config
         rows = cfg.prefill_row_bucket(len(ers))
-        budget = cfg.max_prefill_tokens_per_step
         # the ITL bound is on COMPUTED positions = padded rows x padded
         # bucket, so cap the bucket at the largest that keeps
         # rows * bucket within budget (padding included), not just the
-        # per-row take
-        if budget:
-            allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
-            # a full batch can exceed the budget even at the smallest
-            # bucket — admit fewer rows this step instead of overrunning
-            # (the tail of `ers` stays in self.prefilling for next pass)
-            while not allowed and rows > cfg.PREFILL_ROW_BUCKETS[0]:
-                rows = max(r for r in cfg.PREFILL_ROW_BUCKETS if r < rows)
-                ers = ers[:rows]
-                allowed = [b for b in cfg.prefill_buckets if rows * b <= budget]
-            # budget < one row at the smallest bucket: best-effort floor
-            # (a single row must still advance or prefill livelocks)
-            bucket_cap = allowed[-1] if allowed else cfg.prefill_buckets[0]
-        else:
-            bucket_cap = cfg.prefill_buckets[-1]
+        # per-row take (prefill_bucket_cap — shared with the disagg
+        # prefill worker's streamed chunking)
+        cap = prefill_bucket_cap(cfg, rows)
+        # a full batch can exceed the budget even at the smallest
+        # bucket — admit fewer rows this step instead of overrunning
+        # (the tail of `ers` stays in self.prefilling for next pass)
+        while cap is None and rows > cfg.PREFILL_ROW_BUCKETS[0]:
+            rows = max(r for r in cfg.PREFILL_ROW_BUCKETS if r < rows)
+            ers = ers[:rows]
+            cap = prefill_bucket_cap(cfg, rows)
+        # budget < one row at the smallest bucket: best-effort floor
+        # (a single row must still advance or prefill livelocks)
+        bucket_cap = cap if cap is not None else cfg.prefill_buckets[0]
         plan = []  # (er, start, end, take, final)
         for er in ers:
             total = len(er.prefill_tokens)

@@ -73,6 +73,12 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
         multi_step_decode=getattr(flags, "multi_step_decode", 1) or 1,
         decode_pipeline_depth=getattr(flags, "decode_pipeline_depth", 1) or 1,
+        # no `or 2` fallback: an explicit 0 must clamp to 1 (serial), not
+        # silently flip back to double-buffered
+        disagg_stream_depth=(
+            2 if getattr(flags, "disagg_stream_depth", None) is None
+            else flags.disagg_stream_depth
+        ),
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
         spec_draft_model=getattr(flags, "spec_draft_model", None),

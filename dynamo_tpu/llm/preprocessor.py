@@ -519,21 +519,49 @@ class OpenAIPreprocessor(Operator):
                 text, content, calls = pending, pending, []
                 final_lps = buffered_lps + pending_lps
             lps = ChoiceLogprobs(content=final_lps) if final_lps else None
-            if calls:
-                indexed = [{"index": i, **c} for i, c in enumerate(calls)]
-                yield ChatCompletionChunk(
+
+            def _tc_chunk(entries, finish=None, lp=None):
+                return ChatCompletionChunk(
                     id=request_id,
                     model=model,
                     choices=[ChatStreamChoice(
-                        # prose around the call blocks is real content —
-                        # OpenAI responses carry it alongside tool_calls
-                        delta=ChatChoiceDelta(
-                            content=content or None, tool_calls=indexed
-                        ),
-                        finish_reason="tool_calls",
-                        logprobs=lps,
+                        delta=ChatChoiceDelta(tool_calls=entries),
+                        finish_reason=finish,
+                        logprobs=lp,
                     )],
                 )
+
+            if calls:
+                # the OpenAI streamed tool-call shape (this resolves the
+                # TODO the reference left at chat_completions/delta.rs:131
+                # — its deltas always carried tool_calls: None): per call,
+                # a header delta carrying index/id/type/function.name with
+                # empty arguments, then argument deltas carrying only
+                # {index, function.arguments} fragments for the client to
+                # concatenate. The closing chunk carries
+                # finish_reason="tool_calls" plus the withheld tokens'
+                # logprob entries.
+                if content:
+                    # prose around the call blocks is real content —
+                    # OpenAI responses carry it alongside tool_calls
+                    yield _chunk(content)
+                for i, call in enumerate(calls):
+                    yield _tc_chunk([{
+                        "index": i,
+                        "id": call["id"],
+                        "type": call["type"],
+                        "function": {
+                            "name": call["function"]["name"],
+                            "arguments": "",
+                        },
+                    }])
+                    args = call["function"]["arguments"]
+                    if args:
+                        yield _tc_chunk([{
+                            "index": i,
+                            "function": {"arguments": args},
+                        }])
+                yield _tc_chunk(None, finish="tool_calls", lp=lps)
             else:
                 yield _chunk(content, lps, last_finish or "stop")
         if include_usage:

@@ -3,10 +3,15 @@
 Reference analog: lib/llm/src/preprocessor/tools.rs ToolCallingMatcher —
 which only JSON-parses a whole message as {name, parameters|arguments}
 (and, notably, was never wired into the reference's delta layer; every
-delta carries ``tool_calls: None`` with a TODO at chat_completions/
-delta.rs:131). Here parsing covers the formats the popular open-weight
-families actually emit and feeds both the streaming delta path and the
-aggregated response (llm/preprocessor.py chat_stream).
+delta carried ``tool_calls: None`` with a TODO at chat_completions/
+delta.rs:131 — resolved here). Parsing covers the formats the popular
+open-weight families actually emit, and llm/preprocessor.py chat_stream
+emits the proper OpenAI STREAMED tool-call shape from it: per call, a
+header delta ({index, id, type, function.name, arguments: ""}) followed
+by {index, function.arguments} fragment deltas, closed by an empty
+delta with finish_reason="tool_calls"; protocols/openai.py
+aggregate_chat_stream folds the fragments back into whole entries for
+non-streaming responses.
 
 Formats:
 - ``hermes``   — ``<tool_call>{...}</tool_call>`` blocks (Hermes, Qwen)

@@ -286,11 +286,27 @@ def aggregate_chat_stream(
             if choice.delta.content:
                 content.setdefault(idx, []).append(choice.delta.content)
             if choice.delta.tool_calls:
-                # streamed entries carry a stream "index" key; drop it here
-                tool_calls.setdefault(idx, []).extend(
-                    {k: v for k, v in c.items() if k != "index"}
-                    for c in choice.delta.tool_calls
-                )
+                # fold the streamed shape back into whole entries: a delta
+                # carrying an "id" opens call slot "index"; id-less deltas
+                # are argument fragments that concatenate into that slot
+                # (the streamed tool-call contract chat_stream emits). The
+                # stream "index" key itself never reaches the aggregate.
+                merged = tool_calls.setdefault(idx, [])
+                for c in choice.delta.tool_calls:
+                    entry = {k: v for k, v in c.items() if k != "index"}
+                    si = c.get("index")
+                    if c.get("id") or si is None or si >= len(merged):
+                        merged.append(entry)
+                        continue
+                    target = merged[si]
+                    frag = (entry.get("function") or {})
+                    fn = target.setdefault("function", {})
+                    if frag.get("name"):
+                        fn["name"] = fn.get("name", "") + frag["name"]
+                    if frag.get("arguments"):
+                        fn["arguments"] = (
+                            fn.get("arguments", "") + frag["arguments"]
+                        )
             if choice.finish_reason is not None:
                 finish[idx] = choice.finish_reason
             if choice.logprobs and choice.logprobs.content:

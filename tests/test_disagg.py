@@ -295,6 +295,194 @@ async def test_remote_prefill_matches_local(hf_model_dir):
         await drt_d.close()
 
 
+async def test_remote_prefill_streamed_chunks_match_local(hf_model_dir):
+    """TCP plane, MULTI-CHUNK prompt: the worker's chunked prefill streams
+    per-chunk frames while later chunks compute, and the decode stream is
+    still byte-identical to pure local generation. Also pins the bounded-
+    buffer contract: never more than 2 chunk-sized host frames live."""
+    prompt = [1 + (i * 37) % 200 for i in range(28)]  # 28 tokens, 4 blocks
+
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base-stream", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+
+    hub = MemoryHub()
+    sched, coord, drt_d, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0,
+        max_prefill_queue_size=100,
+    )
+    # worker chunks at 8 tokens/step (1 block per chunk) → 4 chunks,
+    # streamed as multiple frames
+    runner_p, pconfig = _make_runner(
+        hf_model_dir,
+        prefill_buckets=[8, 16, 32, 64, 128],
+        max_prefill_tokens_per_step=8,
+    )
+    drt_p = DistributedRuntime.in_process(hub)
+    worker = PrefillWorker(drt_p, runner_p, pconfig)
+    worker_task = asyncio.create_task(worker.run())
+    try:
+        er1 = _greedy_request("r-stream", prompt)
+        sched.add_request(er1)
+        out1 = await _collect(er1)
+        assert out1 == baseline
+        assert coord.remote_completed == 1
+        assert worker.transfer_frames >= 4  # actually streamed, not one shot
+        assert worker.max_live_host_frames <= 2
+        # worker-side prefix-hit accounting: cold cache → ratio 0, but the
+        # totals registered (and render through the registry gauge)
+        assert worker.prefix_total_tokens == len(prompt)
+        assert worker.prefix_hit_tokens == 0
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await sched.stop()
+        await drt_p.close()
+        await drt_d.close()
+
+
+class _LoopbackIci:
+    """In-process collective plane: send/recv pair over a thread-safe
+    queue (send runs in the worker's executor, recv on the server's
+    daemon thread), preserving the seq-in-payload pairing contract."""
+
+    receiver_rank = 0
+
+    def __init__(self, buckets=(2,)):
+        import queue
+
+        self.buckets = tuple(buckets)
+        self.q = queue.Queue()
+        self.sends = 0
+
+    def send(self, k, v, seq=0):
+        self.sends += 1
+        self.q.put((np.asarray(k), np.asarray(v), int(seq)))
+
+    def recv(self, nblocks):
+        k, v, seq = self.q.get(timeout=30)
+        return k[:, :nblocks], v[:, :nblocks], seq
+
+
+async def test_remote_prefill_streamed_ici_matches_local(hf_model_dir):
+    """ICI plane, multi-chunk prompt: the pipelined gather→header→
+    collective loop (one collective in flight, headers strictly after the
+    previous collective resolves) delivers a byte-identical stream."""
+    prompt = [1 + (i * 53) % 199 for i in range(28)]
+
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base-ici-stream", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+
+    hub = MemoryHub()
+    sched, coord, drt_d, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0,
+        max_prefill_queue_size=100,
+    )
+    ici = _LoopbackIci(buckets=(2,))  # ≤2 blocks per collective frame
+    coord._server.ici_recv = ici.recv
+    coord._server.ici_rank = 0
+    runner_p, pconfig = _make_runner(
+        hf_model_dir,
+        prefill_buckets=[8, 16, 32, 64, 128],
+        max_prefill_tokens_per_step=8,
+    )
+    drt_p = DistributedRuntime.in_process(hub)
+    worker = PrefillWorker(drt_p, runner_p, pconfig, ici=ici)
+    worker._ici_usable = lambda client: worker.ici is not None
+    worker_task = asyncio.create_task(worker.run())
+    try:
+        er1 = _greedy_request("r-ici-stream", prompt)
+        sched.add_request(er1)
+        out1 = await _collect(er1)
+        assert out1 == baseline
+        assert coord.remote_completed == 1
+        assert ici.sends >= 2          # payload rode the collective plane
+        assert worker.ici is ici       # plane healthy throughout
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await sched.stop()
+        await drt_p.close()
+        await drt_d.close()
+
+
+async def test_mid_stream_sender_failure_nacks_commit_and_falls_back(
+        hf_model_dir):
+    """Sender dies BETWEEN two streamed KV frames: the receiver poisons
+    the request's commit, a later (redelivered) commit is nacked, the
+    request id is revoked on fallback, and the stream completes via
+    local prefill — byte-identical to baseline. Extends
+    test_remote_prefill_timeout_falls_back_local to the partial-stream
+    hazard that only exists now that frames ship before compute ends."""
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21, 9, 14, 100, 61]
+
+    runner_l, econfig = _make_runner(hf_model_dir)
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = _greedy_request("base-midfail", prompt)
+    sched_l.add_request(er)
+    baseline = await _collect(er)
+    await sched_l.stop()
+
+    hub = MemoryHub()
+    sched, coord, drt, _ = await _decode_engine_with_disagg(
+        hf_model_dir, hub, max_local_prefill_length=0,
+        max_prefill_queue_size=100, timeout=3.0,
+    )
+    drt_p = DistributedRuntime.in_process(hub)
+    q = PrefillQueue(drt_p.messaging, "public")
+    cfg = econfig.model
+    bs = econfig.kv_block_size
+    try:
+        er1 = _greedy_request("r-midfail", prompt)
+        sched.add_request(er1)
+        popped = await q.pop(timeout=10)
+        assert popped is not None
+        rpr, ack = popped
+        ack()  # we play the (sole) prefill worker by hand
+        shape = (cfg.num_layers, 1, bs, cfg.num_kv_heads, cfg.head_dim)
+        k = np.ones(shape, np.float32)
+
+        # attempt 1: one frame on the wire, then the connection dies
+        c1 = await KvTransferClient("127.0.0.1", coord._server.port).connect()
+        await c1.send_blocks(rpr.request_id, rpr.block_ids[:1], k, k)
+        await c1.close()          # killed between frames — no commit
+        await asyncio.sleep(0.1)  # let the server observe the EOF
+
+        # attempt 2 (a redelivery would do this): full stream + commit —
+        # the poisoned request id must be NACKED, not committed
+        c2 = await KvTransferClient("127.0.0.1", coord._server.port).connect()
+        for i in range(len(rpr.block_ids)):
+            await c2.send_blocks(rpr.request_id, rpr.block_ids[i : i + 1], k, k)
+        committed = await c2.send_commit(rpr.request_id, 42, None)
+        assert committed is False
+
+        # the decode side never resumes on the nacked commit: the bounded
+        # timeout falls back to LOCAL prefill and the stream matches
+        out = await asyncio.wait_for(_collect(er1), timeout=60)
+        assert out == baseline
+        assert coord.remote_completed == 0
+
+        # the request id was revoked at fallback: late frames are dropped
+        # and a late commit is nacked again, not resumed-on
+        await c2.send_blocks(rpr.request_id, rpr.block_ids[:1], k, k)
+        assert await c2.send_commit(rpr.request_id, 42, None) is False
+        await c2.close()
+    finally:
+        await sched.stop()
+        await drt_p.close()
+        await drt.close()
+
+
 async def test_remote_prefill_timeout_falls_back_local(hf_model_dir):
     """No prefill worker alive → decode worker recovers by prefilling locally."""
     prompt = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21]

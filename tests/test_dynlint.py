@@ -763,6 +763,70 @@ def test_async_blocking_flags_sync_sleep_in_pipelined_loop_shape():
     assert [f.rule for f in out] == ["async-blocking"]
 
 
+# --------------------------------------------------------------------------
+# streamed remote prefill: the transfer pipeline's purity contract
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_disagg_stream_modules_pass_jit_impure_and_async_blocking():
+    """The streamed remote-prefill pipeline has the same two load-bearing
+    properties as the decode pipeline: no host syncs under trace and no
+    blocking work on the worker's event loop — the device gather is
+    dispatch-only on the loop (it must serialize with the step's donated
+    cache buffers) while every host sync (device→host frame copy, byte
+    packing) and frame write rides the executor-bound pump. Pin the whole
+    disagg vertical clean, ZERO findings (not baseline-covered ones)."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "disagg", "prefill_worker.py"),
+        os.path.join(PACKAGE_ROOT, "disagg", "transfer.py"),
+        os.path.join(PACKAGE_ROOT, "disagg", "ici_transfer.py"),
+        os.path.join(PACKAGE_ROOT, "disagg", "coordinator.py"),
+    ]
+    found = lint_paths(modules, get_rules(["jit-impure", "async-blocking"]))
+    assert found == [], "streamed transfer hot path regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_sync_wait_in_streaming_pump_shape():
+    """TP fixture shaped like a naive frame pump that waits out the wire
+    with a blocking sleep on the loop — exactly what the executor-bound
+    pump discipline forbids."""
+    out = findings(
+        """
+        import time
+        async def frame_pump(frames, sock):
+            for k, v in frames:
+                sock.write(k.tobytes())
+                time.sleep(0.01)  # "let the bytes drain"
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+def test_jit_impure_flags_host_sync_in_gather_shaped_program():
+    """TP fixture shaped like the frame gather: an np.asarray inside the
+    traced gather is a per-frame device→host stall — the transfer would
+    serialize against compute instead of overlapping it."""
+    out = findings(
+        """
+        import jax
+        import numpy as np
+
+        def build(cache):
+            def gather(ids):
+                blocks = cache[:, ids]
+                return np.asarray(blocks)   # host sync under trace
+            return jax.jit(gather)
+        """,
+        "jit-impure",
+    )
+    assert [f.rule for f in out] == ["jit-impure"]
+    assert "numpy.asarray" in out[0].message
+
+
 @pytest.mark.dynlint
 def test_enforcement_scan_is_not_vacuous():
     """The walk must actually see the tree: recorded debt is present and

@@ -37,10 +37,18 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_kv_router_worker_staleness_seconds",
         "dynamo_disagg_remote_prefill_duration_seconds",
         "dynamo_disagg_remote_prefill_failures_total",
+        # streamed remote prefill (disagg/prefill_worker.py)
+        "dynamo_prefill_worker_prefills_total",
+        "dynamo_prefill_worker_prefill_tokens_total",
+        "dynamo_prefill_worker_transfer_bytes_total",
+        "dynamo_prefill_worker_queue_wait_seconds",
+        "dynamo_prefill_worker_prefix_hit_ratio",
+        "dynamo_disagg_transfer_duration_seconds",
+        "dynamo_disagg_transfer_exposed_seconds",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 25
+    assert len(names) >= 32
 
 
 def _metric(name, kind):

@@ -1,0 +1,211 @@
+"""Streamed remote prefill: the worker-side compute/transfer pipeline,
+isolated from model numerics by a deterministic fake runner + fake client.
+
+Pins the three structural claims of the streamed transfer pipeline
+(disagg/prefill_worker.py):
+
+- chunk i+1's COMPUTE dispatches before chunk i's frame finishes sending
+  (compute and transfer actually overlap — remote TTFT approaches
+  max(compute, transfer), not their sum);
+- at most 2 chunk-sized host buffers exist at any point (depth 2), and
+  exactly 1 at depth 1 — host memory no longer scales with prompt length;
+- the commit is sent only after every frame drained.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+
+def _config(**kw):
+    kw.setdefault("max_prefill_tokens_per_step", 8)
+    kw.setdefault("prefill_buckets", [8, 16, 32, 64, 128])
+    return EngineConfig(
+        model=ModelConfig(vocab_size=512, hidden_size=32,
+                          intermediate_size=64, num_layers=1, num_heads=2,
+                          num_kv_heads=1),
+        max_batch_size=2, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=64, dtype="float32", enable_prefix_caching=False,
+        **kw,
+    )
+
+
+class _FakeRunner:
+    """Dispatch-recording stand-in: step() logs the chunk's start
+    position, gathers log the frame's block ids."""
+
+    def __init__(self, config, events):
+        self.config = config
+        self.events = events
+
+    def set_sample_row(self, *a, **kw):
+        pass
+
+    def step(self, tokens, positions, btab, slot_map, ctx_lens, last_idx,
+             *args, **kw):
+        self.events.append(("step", int(np.asarray(positions)[0, 0])))
+        shape = np.asarray(tokens).shape
+        return (np.full(1, 7, np.int32), np.zeros(1, np.float32),
+                np.zeros((1, 8), np.float32), np.zeros((1, 8), np.int32),
+                np.zeros(shape, np.float32), np.zeros(shape, np.int32))
+
+    def gather_blocks_device(self, block_ids):
+        self.events.append(("gather", tuple(block_ids)))
+        shape = (1, len(block_ids), self.config.kv_block_size, 1, 4)
+        return (np.zeros(shape, np.float32), np.zeros(shape, np.float32))
+
+    @staticmethod
+    def blocks_to_host(k, v):
+        return np.asarray(k), np.asarray(v)
+
+
+class _SlowClient:
+    """Fake decode-side transfer client whose wire is slower than the
+    fake compute, forcing the overlap question."""
+
+    modes = ("tcp",)
+    ici_rank = None
+
+    def __init__(self, events, wire_delay=0.05):
+        self.events = events
+        self.wire_delay = wire_delay
+
+    async def send_blocks(self, request_id, block_ids, k, v, chunk_blocks=16):
+        self.events.append(("send_start", tuple(block_ids)))
+        await asyncio.sleep(self.wire_delay)
+        self.events.append(("send_done", tuple(block_ids)))
+
+    async def send_commit(self, request_id, token, logprob, top=None):
+        self.events.append(("commit",))
+        return True
+
+    async def close(self):
+        pass
+
+
+async def _run_worker(depth, n_tokens=24):
+    events = []
+    config = _config(disagg_stream_depth=depth)
+    drt = DistributedRuntime.in_process(MemoryHub())
+    worker = PrefillWorker(drt, _FakeRunner(config, events), config)
+    worker._clients["e1"] = _SlowClient(events)
+    blocks = -(-n_tokens // config.kv_block_size)
+    rpr = RemotePrefillRequest(
+        request_id="r1", engine_id="e1",
+        token_ids=[1 + i % 200 for i in range(n_tokens)],
+        block_ids=list(range(40, 40 + blocks)), num_cached=0, seed=0,
+    )
+    try:
+        await asyncio.wait_for(worker._handle(rpr), timeout=30)
+    finally:
+        await drt.close()
+    return events, worker
+
+
+@pytest.mark.asyncio
+async def test_compute_dispatches_ahead_of_frame_acks():
+    """24 tokens at an 8-token chunk cap = 3 chunks / 3 one-block frames:
+    every later chunk's compute must dispatch before the FIRST frame's
+    send completes (the wire is 50 ms; fake compute is instant)."""
+    events, worker = await _run_worker(depth=2)
+    steps = [i for i, e in enumerate(events) if e[0] == "step"]
+    assert len(steps) == 3
+    first_send_done = next(
+        i for i, e in enumerate(events) if e[0] == "send_done"
+    )
+    assert steps[1] < first_send_done and steps[2] < first_send_done, events
+    # the commit strictly follows every frame's completion
+    commit_i = events.index(("commit",))
+    send_dones = [i for i, e in enumerate(events) if e[0] == "send_done"]
+    send_starts = [i for i, e in enumerate(events) if e[0] == "send_start"]
+    assert len(send_dones) == len(send_starts) == 3
+    assert all(i < commit_i for i in send_dones)
+    assert worker.transfer_frames == 3
+    assert worker.prefills == 1
+
+
+@pytest.mark.asyncio
+async def test_host_buffers_bounded_at_depth():
+    """Depth 2 = at most two chunk-sized host frames live (one packing,
+    one on the wire); depth 1 = strictly serial, exactly one."""
+    _, w2 = await _run_worker(depth=2, n_tokens=48)  # 6 chunks
+    assert 1 <= w2.max_live_host_frames <= 2
+    _, w1 = await _run_worker(depth=1, n_tokens=48)
+    assert w1.max_live_host_frames == 1
+
+
+@pytest.mark.asyncio
+async def test_frame_failure_leaves_item_for_redelivery():
+    """A frame send that dies mid-stream fails the whole attempt (no ack,
+    no commit) and never deadlocks the bounded pipe."""
+    events = []
+    config = _config()
+
+    class _DyingClient(_SlowClient):
+        async def send_blocks(self, request_id, block_ids, k, v,
+                              chunk_blocks=16):
+            self.events.append(("send_start", tuple(block_ids)))
+            raise ConnectionResetError("wire died")
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    worker = PrefillWorker(drt, _FakeRunner(config, events), config)
+    worker._clients["e1"] = _DyingClient(events)
+    rpr = RemotePrefillRequest(
+        request_id="r1", engine_id="e1",
+        token_ids=list(range(1, 25)), block_ids=list(range(10, 13)),
+        num_cached=0, seed=0,
+    )
+    try:
+        with pytest.raises(ConnectionResetError):
+            await asyncio.wait_for(worker._handle(rpr), timeout=30)
+    finally:
+        await drt.close()
+    assert ("commit",) not in events
+    assert worker.prefills == 0
+    assert worker.allocator.used == 0  # blocks released on the error path
+
+
+@pytest.mark.asyncio
+async def test_compute_failure_with_healthy_pump_does_not_wedge():
+    """Producer-side failure while the pump is healthy and blocked on the
+    queue: shutdown() cancels the pump and _handle must re-raise promptly
+    — the pump's error-consume loop must never swallow its own
+    cancellation and wait on a queue nothing will ever feed."""
+    events = []
+    config = _config()
+
+    class _ExplodingRunner(_FakeRunner):
+        def step(self, *a, **kw):
+            if any(e[0] == "step" for e in self.events):
+                raise RuntimeError("device fault mid-chunk")
+            return super().step(*a, **kw)
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    worker = PrefillWorker(drt, _ExplodingRunner(config, events), config)
+    worker._clients["e1"] = _SlowClient(events, wire_delay=0.2)
+    rpr = RemotePrefillRequest(
+        request_id="r1", engine_id="e1",
+        token_ids=list(range(1, 25)), block_ids=list(range(10, 13)),
+        num_cached=0, seed=0,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="device fault"):
+            # wait_for is the regression oracle: the pre-fix behavior
+            # deadlocked in pipe.shutdown() and timed out here
+            await asyncio.wait_for(worker._handle(rpr), timeout=10)
+    finally:
+        await drt.close()
+    assert ("commit",) not in events
+    assert worker.allocator.used == 0
+
+
+def test_disagg_stream_depth_clamped():
+    assert _config(disagg_stream_depth=0).disagg_stream_depth == 1
+    assert _config(disagg_stream_depth=7).disagg_stream_depth == 2

@@ -102,18 +102,34 @@ async def test_chat_stream_emits_tool_call_delta():
             "id1", "m", stream, prompt_tokens=3, tool_format="auto"
         )
     ]
-    # role chunk + tool_calls chunk; the raw JSON text is never streamed
+    # role chunk + streamed tool-call deltas; raw JSON is never streamed
+    # as content
     assert all(not c.choices or not c.choices[0].delta.content for c in chunks)
     final = chunks[-1]
     assert final.choices[0].finish_reason == "tool_calls"
-    tc = final.choices[0].delta.tool_calls
-    assert tc[0]["function"]["name"] == "get_weather"
-    assert tc[0]["index"] == 0
+    assert not final.choices[0].delta.tool_calls  # closing chunk is empty
+    # the OpenAI streamed shape: a header delta (index/id/type/name, empty
+    # arguments) followed by argument-fragment deltas carrying only
+    # {index, function.arguments}
+    tc_chunks = [
+        c.choices[0].delta.tool_calls[0] for c in chunks
+        if c.choices and c.choices[0].delta.tool_calls
+    ]
+    header, frag = tc_chunks[0], tc_chunks[1]
+    assert header["index"] == 0
+    assert header["id"].startswith("call-")
+    assert header["type"] == "function"
+    assert header["function"] == {"name": "get_weather", "arguments": ""}
+    assert frag["index"] == 0 and "id" not in frag
+    assert json.loads(frag["function"]["arguments"]) == {"city": "SF"}
 
     resp = aggregate_chat_stream(chunks)
     assert resp.choices[0].finish_reason == "tool_calls"
-    assert resp.choices[0].message.tool_calls[0]["function"]["name"] == "get_weather"
-    assert "index" not in resp.choices[0].message.tool_calls[0]
+    call = resp.choices[0].message.tool_calls[0]
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+    assert call["id"].startswith("call-")
+    assert "index" not in call
 
 
 @pytest.mark.asyncio
@@ -161,7 +177,12 @@ async def test_chat_stream_jails_marker_split_across_chunks():
     assert not any("<tool_call>" in t for t in texts)
     final = chunks[-1]
     assert final.choices[0].finish_reason == "tool_calls"
-    assert final.choices[0].delta.tool_calls[0]["function"]["name"] == "get_weather"
+    headers = [
+        c.choices[0].delta.tool_calls[0] for c in chunks
+        if c.choices and c.choices[0].delta.tool_calls
+        and "id" in c.choices[0].delta.tool_calls[0]
+    ]
+    assert headers[0]["function"]["name"] == "get_weather"
 
 
 @pytest.mark.asyncio
@@ -354,7 +375,11 @@ async def test_jail_splits_logprob_entries_at_marker_boundary():
     assert len(prose) == 1
     (entries,) = [prose[0].choices[0].logprobs.content]
     assert [e.token for e in entries] == ["Hi"]
+    assert any(
+        c.choices and c.choices[0].delta.tool_calls for c in chunks
+    )
+    # the withheld tokens' entries ride the closing tool_calls chunk
     final = chunks[-1]
-    assert final.choices[0].delta.tool_calls
+    assert final.choices[0].finish_reason == "tool_calls"
     held = final.choices[0].logprobs.content
     assert [e.token for e in held] == ["<tool_call>", call, "</tool_call>"]
