@@ -155,6 +155,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profiler-port", type=int, default=0,
                    help="start the jax profiler gRPC server on this port "
                         "(TensorBoard remote capture; any role)")
+    # flight recorder + stall watchdog (telemetry/flight.py, watchdog.py)
+    p.add_argument("--flight-dir", default="",
+                   help="directory for flight artifacts (watchdog trips, "
+                        "SIGUSR2, /debug/flight?save=1); also settable "
+                        "via DYN_FLIGHT_DIR")
+    p.add_argument("--watchdog-stall-s", type=float, default=None,
+                   help="stall-watchdog deadline: trip (and dump a "
+                        "flight artifact) when the engine has pending "
+                        "work but its loop heartbeat or dispatch counter "
+                        "has been stale this long (default 30; 0 = off)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="dyn:// roles: serve this process's Prometheus "
                         "registry on a sidecar GET /metrics port (the "
@@ -617,6 +627,19 @@ async def amain(argv: List[str]) -> None:
     flags = build_parser().parse_args(rest)
     from ..utils.logging import setup_logging
     setup_logging(logging.DEBUG if flags.verbose else logging.INFO)
+
+    if flags.flight_dir:
+        # one env var is the single source of truth for every dump site
+        # (watchdog trips, SIGUSR2, /debug/flight?save=1)
+        import os
+
+        os.environ["DYN_FLIGHT_DIR"] = flags.flight_dir
+    # SIGUSR2 → flight artifact, on EVERY role (frontend, worker,
+    # prefill): the zero-downtime way to ask "what is this process
+    # doing" — works even when the event loop is wedged
+    from ..telemetry.watchdog import install_signal_dump
+
+    install_signal_dump()
 
     if flags.num_nodes > 1:
         # must run before the first jax backend touch in this process so

@@ -19,6 +19,7 @@ from typing import Dict, Optional, Sequence
 import msgpack
 import numpy as np
 
+from ..telemetry.flight import flight_recorder
 from ..telemetry.registry import MetricsRegistry
 from .protocols import PrefillQueue, RemotePrefillRequest
 from .router import DisaggRouter
@@ -182,6 +183,9 @@ class RemotePrefillCoordinator:
         self._ctx.pop(request_id, None)
         if self._submit_t.pop(request_id, None) is not None:
             self._failures.inc(reason=reason)
+            flight_recorder().record(
+                "disagg.cancel", request_id=request_id, reason=reason,
+            )
         if fut is not None and not fut.done():
             fut.cancel()
 
@@ -227,6 +231,10 @@ class RemotePrefillCoordinator:
         t0 = self._submit_t.pop(request_id, None)
         if t0 is not None:
             self._rtt_hist.observe(time.monotonic() - t0)
+        flight_recorder().record(
+            "disagg.commit", request_id=request_id,
+            rtt_s=round(time.monotonic() - t0, 4) if t0 is not None else None,
+        )
         fut.set_result((first_token, logprob, top))
 
     def metrics(self) -> dict:

@@ -51,6 +51,7 @@ import numpy as np
 from ..engine.block_allocator import BlockAllocator
 from ..engine.sampling import seed_to_key
 from ..engine.scheduler import build_prefill_arrays, prefill_bucket_cap
+from ..telemetry.flight import flight_recorder
 from ..telemetry.registry import MetricsRegistry
 from ..tokens import compute_block_hashes
 from .protocols import PrefillQueue, RemotePrefillRequest
@@ -194,10 +195,20 @@ class PrefillWorker:
                 if self.prefix_total_tokens else 0.0
             ),
         )
+        # the runner's XLA compile instruments render in this worker's
+        # sidecar scrape too; the flight ring records engine events
+        self.flight = flight_recorder()
+        compiles = getattr(runner, "compiles", None)
+        if compiles is not None:
+            self.registry.attach(compiles.registry)
 
     # ---------- main loop ----------
 
     async def run(self) -> None:
+        # compiles past this point stall queued prefills — tag them late
+        compiles = getattr(self.runner, "compiles", None)
+        if compiles is not None:
+            compiles.mark_serving_started()
         while not self._stopping:
             if not await self.serve_one(timeout=1.0):
                 continue
@@ -365,6 +376,10 @@ class PrefillWorker:
                 # is done from this worker's perspective (ack the queue
                 # item; a redelivery would nack again: the request id
                 # stays revoked on the decode side).
+                self.flight.record(
+                    "disagg.nack", request_id=rpr.request_id,
+                    trace_id=rpr.trace_id or None,
+                )
                 logger.warning(
                     "decode engine nacked commit for %s (dropped payload); "
                     "it will fall back to local prefill", rpr.request_id,

@@ -114,7 +114,12 @@ class KvTransferServer:
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _mark_dropped(self, request_id: str) -> None:
+        from ..telemetry.flight import flight_recorder
+
         now = time.monotonic()
+        flight_recorder().record(
+            "disagg.poison", request_id=request_id,
+        )
         self._dropped.pop(request_id, None)
         self._dropped[request_id] = now
         # TTL expiry (insertion order == time order): anything this old

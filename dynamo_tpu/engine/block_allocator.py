@@ -104,11 +104,17 @@ class BlockAllocator:
         events: Optional[KvEventSink] = None,
         tier2=None,  # Optional[KvHostTier] — host-RAM offload tier
         registry=None,  # Optional[telemetry.MetricsRegistry]
+        flight=None,  # Optional[telemetry.FlightRecorder]
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
         self.events = events or KvEventSink()
+        if flight is None:
+            from ..telemetry.flight import flight_recorder
+
+            flight = flight_recorder()
+        self.flight = flight
         self.tier2 = tier2
         # evictions collected during one allocation; offloaded in a single
         # batched gather (one device round-trip) by flush_offload
@@ -222,6 +228,10 @@ class BlockAllocator:
         bid = self.reusable.pop(skip=self.pinned)
         if bid is not None:
             self._evictions.inc()
+            self.flight.record(
+                "kv.eviction", block=bid,
+                offloaded=self.tier2 is not None,
+            )
             h = self.block_hash.pop(bid, None)
             if h is not None:
                 self.by_hash.pop(h, None)
@@ -231,6 +241,10 @@ class BlockAllocator:
                     self._pending_offload.append((h, bid))
                 self.events.on_removed([h])
             return bid
+        self.flight.record(
+            "kv.oom", used=self.used, total=self.num_blocks,
+            pinned=len(self.pinned),
+        )
         raise MemoryError("KV cache exhausted")
 
     def flush_offload(self) -> None:
@@ -310,6 +324,10 @@ class BlockAllocator:
             if bid in self.reusable and bid not in self.pinned
         )
         if n_new > self.available - pinned:
+            self.flight.record(
+                "kv.oom", needed=n_new,
+                available=self.available - pinned, total=self.num_blocks,
+            )
             raise MemoryError(
                 f"need {n_new} blocks, {self.available - pinned} available"
             )

@@ -354,6 +354,15 @@ class EngineConfig:
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
     host_kv_blocks: int = 0
+    # stall watchdog (telemetry/watchdog.py): trip when work is pending
+    # but the scheduler loop's heartbeat (or its dispatch counter) has
+    # been stale for this long — a wedged Mosaic compile or dead host
+    # sync then dumps a flight artifact to DYN_FLIGHT_DIR instead of
+    # freezing silently. 0 disables the watchdog. The deadline must
+    # comfortably exceed one loop PASS (chunked prefill bounds a pass;
+    # a cold late compile is the longest legitimate pass).
+    watchdog_stall_s: float = 30.0
+    watchdog_interval_s: float = 1.0
 
     def __post_init__(self):
         if self.prefill_buckets is None:
@@ -388,6 +397,10 @@ class EngineConfig:
         # one frame in flight is the serial floor; beyond two buys nothing
         # (the wire is busy continuously at 2) and unbounds host buffers
         self.disagg_stream_depth = max(1, min(self.disagg_stream_depth, 2))
+        # watchdog: negative means off (same as 0); the sampling interval
+        # floors at 50 ms so a mistyped value can't busy-spin the loop
+        self.watchdog_stall_s = max(0.0, self.watchdog_stall_s)
+        self.watchdog_interval_s = max(0.05, self.watchdog_interval_s)
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
         if self.spec_draft_tokens and not self.spec_draft_model:

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import models
 from ..models import llama, quant
 from ..ops.attention import _pad_minor
+from ..telemetry.flight import CompileTracker
 from .config import EngineConfig
 from .sampling import SamplingParams, sample, top_logprobs_for
 
@@ -272,6 +273,15 @@ class ModelRunner:
         )
         self.state_sharding = NamedSharding(self.mesh, P("dp", None))
         self._reinit_device_state()
+
+        # XLA compile observability: every compiled-program dispatch site
+        # below runs through compiles.track(program, shape-bucket key) —
+        # the first dispatch of a new key is the compile, and a compile
+        # after mark_serving_started() is a "late" compile (the
+        # recompile-storm signal; see telemetry/flight.py). The scheduler
+        # / prefill worker attach compiles.registry into the engine's
+        # scrape and flip the serving flag when they start.
+        self.compiles = CompileTracker()
 
         self._build_step()
         self._build_burst()
@@ -528,16 +538,21 @@ class ModelRunner:
             counters=jnp.asarray(counters, jnp.int32),
         )
         b = tokens0.shape[0]
-        (toks, lps, tvs, tis, k, v, counts, seen, bias) = self._burst(
-            self.params, self.kv_cache[0], self.kv_cache[1],
-            self.sample_state[0], self.sample_state[1], self.sample_state[2],
-            jnp.asarray(tokens0, jnp.int32), jnp.asarray(positions0, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32),
-            samp,
-            jnp.arange(b, dtype=jnp.int32),
-            jnp.asarray(commit, jnp.bool_),
-            jnp.asarray(bool(want_top), jnp.bool_),
-        )
+        with self.compiles.track(
+            "decode_burst", f"b{b}_w{block_tables.shape[1]}"
+        ):
+            (toks, lps, tvs, tis, k, v, counts, seen, bias) = self._burst(
+                self.params, self.kv_cache[0], self.kv_cache[1],
+                self.sample_state[0], self.sample_state[1],
+                self.sample_state[2],
+                jnp.asarray(tokens0, jnp.int32),
+                jnp.asarray(positions0, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                samp,
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.asarray(commit, jnp.bool_),
+                jnp.asarray(bool(want_top), jnp.bool_),
+            )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
         return toks, lps, tvs, tis
@@ -607,20 +622,30 @@ class ModelRunner:
             commit = np.zeros(b, bool)
         if targets is None:
             targets = np.zeros_like(tokens)
-        (next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all,
-         k, v, counts, seen, bias) = self._step(
-            self.params, self.kv_cache[0], self.kv_cache[1],
-            self.sample_state[0], self.sample_state[1], self.sample_state[2],
-            jnp.asarray(tokens, jnp.int32), jnp.asarray(positions, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32), jnp.asarray(slot_mapping, jnp.int32),
-            jnp.asarray(context_lens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
-            samp,
-            jnp.asarray(sample_slots, jnp.int32), jnp.asarray(commit, jnp.bool_),
-            jnp.asarray(bool(want_top), jnp.bool_),
-            jnp.asarray(targets, jnp.int32),
-            jnp.asarray(bool(want_prompt), jnp.bool_),
-            jnp.asarray(bool(want_greedy), jnp.bool_),
-        )
+        s = tokens.shape[1]
+        with self.compiles.track(
+            "prefill" if s > 1 else "decode",
+            f"b{b}_s{s}_w{block_tables.shape[1]}",
+        ):
+            (next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all,
+             k, v, counts, seen, bias) = self._step(
+                self.params, self.kv_cache[0], self.kv_cache[1],
+                self.sample_state[0], self.sample_state[1],
+                self.sample_state[2],
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(slot_mapping, jnp.int32),
+                jnp.asarray(context_lens, jnp.int32),
+                jnp.asarray(last_idx, jnp.int32),
+                samp,
+                jnp.asarray(sample_slots, jnp.int32),
+                jnp.asarray(commit, jnp.bool_),
+                jnp.asarray(bool(want_top), jnp.bool_),
+                jnp.asarray(targets, jnp.int32),
+                jnp.asarray(bool(want_prompt), jnp.bool_),
+                jnp.asarray(bool(want_greedy), jnp.bool_),
+            )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
         return next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all
@@ -655,11 +680,13 @@ class ModelRunner:
             tid = int(tid)
             if 0 <= tid < v:
                 bias_row[tid] += float(b)
-        self.sample_state = self._set_row_jit(
-            self.sample_state[0], self.sample_state[1], self.sample_state[2],
-            jnp.asarray(slot, jnp.int32), jnp.asarray(counts_row),
-            jnp.asarray(seen_row), jnp.asarray(bias_row),
-        )
+        with self.compiles.track("sample_row", f"v{v}"):
+            self.sample_state = self._set_row_jit(
+                self.sample_state[0], self.sample_state[1],
+                self.sample_state[2],
+                jnp.asarray(slot, jnp.int32), jnp.asarray(counts_row),
+                jnp.asarray(seen_row), jnp.asarray(bias_row),
+            )
 
     # ---------- paged-block gather / scatter ----------
     #
@@ -723,13 +750,16 @@ class ModelRunner:
         """Replace ONE slot's sampler bias row (guided decoding's
         per-step token mask; also carries the request's logit_bias)."""
         counts, seen, bias = self.sample_state
-        self.sample_state = (
-            counts, seen,
-            self._set_bias_jit(
-                bias, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(bias_row, jnp.float32),
-            ),
-        )
+        with self.compiles.track(
+            "guided_mask", f"v{self.config.model.vocab_size}"
+        ):
+            self.sample_state = (
+                counts, seen,
+                self._set_bias_jit(
+                    bias, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(bias_row, jnp.float32),
+                ),
+            )
 
     def edit_bias_entries(self, slot: int, ids, vals) -> bool:
         """Sparse update of ONE slot's bias row: ``row[ids] = vals``.
@@ -749,13 +779,14 @@ class ModelRunner:
         ids_p[:n] = np.asarray(ids, np.int32)
         vals_p[:n] = np.asarray(vals, np.float32)
         counts, seen, bias = self.sample_state
-        self.sample_state = (
-            counts, seen,
-            self._edit_bias_jit(
-                bias, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(ids_p), jnp.asarray(vals_p),
-            ),
-        )
+        with self.compiles.track("guided_mask_edit", f"n{bucket}"):
+            self.sample_state = (
+                counts, seen,
+                self._edit_bias_jit(
+                    bias, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(ids_p), jnp.asarray(vals_p),
+                ),
+            )
         return True
 
     BLOCK_OP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
@@ -870,9 +901,11 @@ class ModelRunner:
             chunk = ids[i : i + self.BLOCK_OP_BUCKETS[-1]]
             bucket = self._bucket_ids(len(chunk))
             padded = chunk + [chunk[-1]] * (bucket - len(chunk))
-            k, v = self._gather_jit(
-                self.kv_cache[0], self.kv_cache[1], jnp.asarray(padded, jnp.int32)
-            )
+            with self.compiles.track("kv_gather", f"n{bucket}"):
+                k, v = self._gather_jit(
+                    self.kv_cache[0], self.kv_cache[1],
+                    jnp.asarray(padded, jnp.int32)
+                )
             ks.append(k[:, : len(chunk)])
             vs.append(v[:, : len(chunk)])
             i += len(chunk)
@@ -903,10 +936,11 @@ class ModelRunner:
                 # identical values land on the same slot, so order is benign
                 kb = jnp.concatenate([kb, jnp.repeat(kb[:, -1:], pad, axis=1)], axis=1)
                 vb = jnp.concatenate([vb, jnp.repeat(vb[:, -1:], pad, axis=1)], axis=1)
-            k, v = self._scatter_jit(
-                self.kv_cache[0], self.kv_cache[1],
-                jnp.asarray(padded_ids, jnp.int32), kb, vb,
-            )
+            with self.compiles.track("kv_scatter", f"n{bucket}"):
+                k, v = self._scatter_jit(
+                    self.kv_cache[0], self.kv_cache[1],
+                    jnp.asarray(padded_ids, jnp.int32), kb, vb,
+                )
             self.kv_cache = (k, v)
             i += len(chunk)
 
@@ -974,6 +1008,7 @@ class ModelRunner:
                 cfg.attention_impl = "xla"
                 self._build_step()
                 self._build_burst()
+                self.compiles.reset_seen()  # rebuilt programs recompile
         if (cfg.attn_logit_softcap or cfg.sliding_window) and \
                 resolve_attention_impl(cfg.attention_impl) == "pallas":
             # the Pallas kernels implement softcapping and windowed masks
@@ -1003,6 +1038,7 @@ class ModelRunner:
             self._build_step()
             self._build_burst()
             self._reinit_device_state()
+            self.compiles.reset_seen()  # rebuilt programs recompile
             self._warmup_once(decode_batch)
 
     def _reinit_device_state(self) -> None:
@@ -1035,6 +1071,11 @@ class ModelRunner:
 
     def _warmup_once(self, decode_batch: Optional[int] = None) -> None:
         b = decode_batch or self.config.max_batch_size
+        # the sample-row install program is shape-invariant and otherwise
+        # compiles at the FIRST admission — a needless late compile on
+        # the first real request (flagged by the CompileTracker; writing
+        # zero rows to slot 0 is inert, admission overwrites them)
+        self.set_sample_row(0, [])
         zeros2 = np.zeros((b, 1), np.int32)
         for w in self.config.kv_width_buckets():
             self.step(

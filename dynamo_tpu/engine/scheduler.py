@@ -29,6 +29,7 @@ from ..protocols.common import (
     TokenLogprob,
 )
 from ..runtime.engine import AsyncEngineContext
+from ..telemetry.flight import FlightRecorder, flight_recorder
 from ..telemetry.registry import STEP_BUCKETS, MetricsRegistry
 from ..tokens import TokenSequence
 from .block_allocator import BlockAllocator, KvEventSink
@@ -278,10 +279,14 @@ class Scheduler:
         disagg=None,  # Optional[RemotePrefillCoordinator]
         draft_runner: Optional[ModelRunner] = None,
         registry: Optional[MetricsRegistry] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         self.runner = runner
         self.config = config
         self.disagg = disagg
+        # flight recorder: the process-wide engine-event ring every layer
+        # records into (telemetry/flight.py); injectable for tests
+        self.flight = flight if flight is not None else flight_recorder()
         # draft-model speculation: the draft's paged cache mirrors the
         # target's block ids — every prefill chunk replays on the draft,
         # and the decode loop proposes with the draft's K-step burst
@@ -303,7 +308,7 @@ class Scheduler:
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
             config.enable_prefix_caching, events, tier2=tier2,
-            registry=self.registry,
+            registry=self.registry, flight=self.flight,
         )
         self.waiting: deque = deque()
         # persistent decode-step host arrays (see _HostBatchState)
@@ -331,9 +336,18 @@ class Scheduler:
         self._inflight: Optional[_InflightBurst] = None
         self._last_burst_done_t: Optional[float] = None
         self.pipeline_bursts = 0
+        # watchdog heartbeat: stamped at the top of EVERY loop pass, so a
+        # loop wedged INSIDE a pass (hung compile, dead device sync) goes
+        # stale while a healthy-but-waiting loop stays fresh
+        self.last_loop_t = time.monotonic()
         self._build_instruments()
         if disagg is not None and getattr(disagg, "registry", None) is not None:
             self.registry.attach(disagg.registry)
+        # the runner's XLA compile instruments render in this scrape too
+        # (FakeRunner test doubles carry no tracker — guard)
+        compiles = getattr(runner, "compiles", None)
+        if compiles is not None:
+            self.registry.attach(compiles.registry)
 
     def _build_instruments(self) -> None:
         """Register the scheduler's Prometheus instruments (the full
@@ -424,6 +438,12 @@ class Scheduler:
     # ---------- public API ----------
 
     def start(self) -> None:
+        # any compile past this point interrupts live serving — the
+        # tracker tags it "late" (the recompile-storm signal)
+        for r in (self.runner, self.draft):
+            compiles = getattr(r, "compiles", None)
+            if compiles is not None:
+                compiles.mark_serving_started()
         self._task = asyncio.create_task(self._run())
 
     async def stop(self) -> None:
@@ -485,6 +505,51 @@ class Scheduler:
             out.update(self.disagg.metrics())
         return out
 
+    # ---------- watchdog surface (telemetry/watchdog.py) ----------
+
+    def watchdog_probe(self) -> dict:
+        """Liveness snapshot the stall watchdog samples: heartbeat stamp
+        of the last loop pass, the dispatch counter, and the pending-work
+        breakdown (local waiting vs remote-prefill waits vs active
+        slots)."""
+        return {
+            "heartbeat_t": self.last_loop_t,
+            "steps": self.steps,
+            "queue_depth": len(self.waiting),
+            "pending_remote": len(self.pending_remote),
+            "active": sum(1 for s in self.slots if s is not None),
+            "stopping": self._stopping,
+        }
+
+    def request_table(self) -> List[dict]:
+        """Active request snapshot for the flight artifact: every slot's
+        occupant plus the waiting/pending-remote queues."""
+        out = []
+        for i, er in enumerate(self.slots):
+            if er is None:
+                continue
+            out.append({
+                "state": "prefilling" if er in self.prefilling else "decoding",
+                "slot": i,
+                "request_id": er.request_id,
+                "trace_id": er.ctx.trace_id,
+                "prompt_tokens": len(er.prompt),
+                "generated": er.generated,
+                "context_len": er.context_len,
+                "blocks": len(er.block_ids),
+                "guided": er.guided is not None,
+            })
+        for state, ers in (("waiting", list(self.waiting)),
+                           ("pending_remote", self.pending_remote)):
+            out.extend({
+                "state": state,
+                "request_id": er.request_id,
+                "trace_id": er.ctx.trace_id,
+                "prompt_tokens": len(er.prompt),
+                "generated": er.generated,
+            } for er in ers)
+        return out
+
     # ---------- helpers ----------
 
     def _free_slot(self) -> Optional[int]:
@@ -526,6 +591,11 @@ class Scheduler:
 
     def _finish(self, er: EngineRequest, reason: FinishReason, emit: bool = True) -> None:
         er.finish = reason
+        self.flight.record(
+            "scheduler.finish", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, reason=str(reason),
+            generated=er.generated,
+        )
         er.ctx.add_stage("completion")
         if emit:
             er.out_queue.put_nowait(EngineOutput(token_ids=[], finish_reason=reason))
@@ -582,6 +652,9 @@ class Scheduler:
         while not self._stopping:
             progressed = False
             pass_t0 = time.monotonic()
+            # watchdog heartbeat (telemetry/watchdog.py): a wedge INSIDE
+            # this pass — hung compile, dead host sync — leaves it stale
+            self.last_loop_t = pass_t0
 
             # drop cancelled requests (client disconnects / kills)
             for er in list(self.waiting):
@@ -855,6 +928,11 @@ class Scheduler:
         )
         self.steps += 1
         self.pipeline_bursts += 1
+        self.flight.record(
+            "scheduler.burst_dispatch", k_steps=k_steps, rows=len(active),
+            pipelined=True, carried=infl is not None,
+            requests=[er.request_id for er in active[:8]],
+        )
         self._inflight = _InflightBurst(
             active=list(active), toks=toks, lps=lps, tv=tv, ti=ti,
             k_steps=k_steps, last_tokens=toks[k_steps - 1],
@@ -906,7 +984,13 @@ class Scheduler:
         """
         bs = self.config.kv_block_size
         keep = -(-er.context_len // bs)  # blocks covering committed KV
+        rolled = max(0, len(er.block_ids) - keep)
         er.block_ids = self.allocator.rollback_tail(er.block_ids, keep)
+        self.flight.record(
+            "scheduler.rollback", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, blocks=rolled,
+            reason=str(er.finish),
+        )
         self._host.sync_blocks(er)
         if er.pipeline_span_open:
             er.ctx.add_stage("decode_pipeline")
@@ -920,6 +1004,10 @@ class Scheduler:
         infl, self._inflight = self._inflight, None
         if infl is None:
             return
+        self.flight.record(
+            "scheduler.burst_drain", k_steps=infl.k_steps,
+            rows=len(infl.active),
+        )
         await self._apply_burst(loop, infl)
         for er in infl.active:
             # still-live rows close their pipelined span here so the
@@ -1009,6 +1097,11 @@ class Scheduler:
         self.prefix_hit_tokens += er.num_cached
         self.prefix_total_tokens += len(er.prompt)
         er.ctx.add_stage("admission")
+        self.flight.record(
+            "scheduler.remote_submit", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, prompt_tokens=len(er.prompt),
+            cached=er.num_cached,
+        )
         er.remote_deadline = time.monotonic() + self.disagg.prefill_timeout_s
         er.remote_future.add_done_callback(lambda _f: self.wake.set())
         self.pending_remote.append(er)
@@ -1039,6 +1132,10 @@ class Scheduler:
                                er.request_id)
                 self.pending_remote.remove(er)
                 self.disagg.cancel(er.request_id, reason="timeout")
+                self.flight.record(
+                    "disagg.local_fallback", request_id=er.request_id,
+                    trace_id=er.ctx.trace_id, reason="timeout",
+                )
                 self.allocator.free_blocks(er.block_ids)
                 er.block_ids = []
                 er.num_cached = 0
@@ -1091,6 +1188,11 @@ class Scheduler:
         slot = self._free_slot()
         assert slot is not None
         er.ctx.add_stage("admission")
+        self.flight.record(
+            "scheduler.admission", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, slot=slot,
+            prompt_tokens=len(er.prompt), resumed=bool(er.resume_tokens),
+        )
         tokens_all = er.prompt + er.resume_tokens
         if er.want_prompt_lps and not er.prompt_lps_emitted:
             # every prompt position must run through the model — a prefix
@@ -1672,6 +1774,11 @@ class Scheduler:
             )
             self._last_burst_done_t = None
 
+        self.flight.record(
+            "scheduler.burst_dispatch", k_steps=k_steps, rows=len(active),
+            pipelined=False,
+            requests=[er.request_id for er in active[:8]],
+        )
         if k_steps > 1:
             next_tokens, lps, top_vals, top_ids = self.runner.decode_burst(
                 tokens[:, 0], positions[:, 0], btab,
@@ -1742,6 +1849,11 @@ class Scheduler:
         the request re-prefills ``prompt + resume_tokens`` and the stream
         continues where it stopped (never restarts or diverges)."""
         self._preemptions.inc()
+        self.flight.record(
+            "scheduler.preemption", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, generated=er.generated,
+            blocks_freed=len(er.block_ids),
+        )
         er.ctx.add_stage("preempted")
         if er.slot >= 0:
             self.slots[er.slot] = None

@@ -81,6 +81,12 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         ),
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
+        # no `or` fallback: an explicit 0 must DISABLE the watchdog, not
+        # silently restore the default deadline
+        watchdog_stall_s=(
+            30.0 if getattr(flags, "watchdog_stall_s", None) is None
+            else flags.watchdog_stall_s
+        ),
         spec_draft_model=getattr(flags, "spec_draft_model", None),
         spec_draft_tokens=getattr(flags, "spec_draft_tokens", 0) or 0,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
@@ -163,6 +169,9 @@ class JaxServingEngine(AsyncEngine):
         self.runner = runner
         self.scheduler = scheduler
         self.config = config
+        # stall watchdog (telemetry/watchdog.py), attached by create();
+        # held here so close() can cancel its task
+        self.watchdog = None
         # guided JSON: grammars (and the vocab piece table they share)
         # are compiled once per distinct spec and reused across requests
         self._model_path: Optional[str] = None
@@ -227,6 +236,21 @@ class JaxServingEngine(AsyncEngine):
                 futs.append(loop.run_in_executor(None, draft_runner.warmup))
             await asyncio.gather(*futs)
         scheduler.start()
+        if engine_config.watchdog_stall_s > 0:
+            from ..telemetry.watchdog import StallWatchdog
+
+            # registered into the scheduler's registry so the trip
+            # counter and loop-lag gauge render in the engine scrape;
+            # registered as a dump source so GET /debug/flight and
+            # SIGUSR2 include this engine's probe + request table
+            engine.watchdog = StallWatchdog(
+                probe=scheduler.watchdog_probe,
+                requests=scheduler.request_table,
+                registry=scheduler.registry,
+                flight=scheduler.flight,
+                interval_s=engine_config.watchdog_interval_s,
+                stall_s=engine_config.watchdog_stall_s,
+            ).start()
         return engine
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[dict]:
@@ -391,4 +415,8 @@ class JaxServingEngine(AsyncEngine):
         return self.scheduler.registry
 
     async def close(self) -> None:
+        # watchdog first: a slow drain during scheduler.stop() must not
+        # read as a stall and dump a spurious artifact mid-shutdown
+        if self.watchdog is not None:
+            await self.watchdog.stop()
         await self.scheduler.stop()

@@ -99,6 +99,7 @@ class HttpService:
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/debug/requests", self.handle_debug_requests)
         self.app.router.add_get("/debug/requests/{rid}", self.handle_debug_request)
+        self.app.router.add_get("/debug/flight", self.handle_flight)
         if profile_dir:
             # opt-in only: trace capture costs device time and writes disk
             self.app.router.add_get("/debug/profile", self.handle_profile)
@@ -348,6 +349,37 @@ class HttpService:
                 status=404,
             )
         return web.json_response(trace)
+
+    async def handle_flight(self, request: web.Request) -> web.Response:
+        """GET /debug/flight[?save=1][&request=<id>] — the flight-recorder
+        dump on demand: ring events (optionally filtered to one request
+        id), all-thread stacks, every registered engine's liveness probe,
+        request table, and metrics snapshot (telemetry/watchdog.py). The
+        same artifact the stall watchdog writes on a trip; ``save=1``
+        additionally persists it to DYN_FLIGHT_DIR."""
+        from ..telemetry.watchdog import build_flight_artifact, write_flight_artifact
+
+        loop = asyncio.get_running_loop()
+        # stack walking + metrics rendering off-loop: /debug/flight is
+        # exactly the endpoint an operator hits when the loop is ailing
+        artifact = await loop.run_in_executor(
+            None, lambda: build_flight_artifact(reason="debug_endpoint")
+        )
+        if request.query.get("save"):
+            # persist the COMPLETE dump before any response filtering: an
+            # on-disk artifact must never silently be a one-request slice
+            artifact["artifact_path"] = await loop.run_in_executor(
+                None, lambda: write_flight_artifact(artifact)
+            )
+        rid = request.query.get("request")
+        if rid:
+            artifact["events"] = [
+                e for e in artifact["events"]
+                if e.get("request_id") == rid or e.get("trace_id") == rid
+            ]
+            artifact["filtered_to_request"] = rid
+        return web.json_response(artifact, dumps=lambda o: json.dumps(
+            o, default=str))
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """GET /debug/profile?seconds=N — capture an XLA profiler trace of

@@ -17,6 +17,7 @@ import msgpack
 
 from ..runtime.client import Client
 from ..runtime.component import Component
+from ..telemetry.flight import flight_recorder
 from ..telemetry.registry import MetricsRegistry
 from ..tokens import compute_block_hashes
 from .indexer import KvIndexer, ShardedKvIndexer
@@ -98,6 +99,11 @@ class KvRouter:
         self._decisions.inc(worker=str(decision.worker_id))
         self._overlap_blocks.inc(
             decision.matched_blocks, worker=str(decision.worker_id)
+        )
+        flight_recorder().record(
+            "kv_router.pick", worker=str(decision.worker_id),
+            isl_blocks=-(-len(token_ids) // self.block_size),
+            overlap_blocks=decision.matched_blocks,
         )
         try:
             await self.component.namespace.publish_event(

@@ -10,6 +10,7 @@ spans ride :class:`~dynamo_tpu.runtime.engine.AsyncEngineContext` and are
 queryable at ``GET /debug/requests/{id}``.
 """
 
+from .flight import CompileTracker, FlightRecorder, flight_recorder
 from .registry import (
     DEFAULT_BUCKETS,
     CallbackGauge,
@@ -21,16 +22,22 @@ from .registry import (
     format_labels,
 )
 from .tracing import TraceRecorder, span_breakdown
+from .watchdog import StallWatchdog, build_flight_artifact
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "CallbackGauge",
+    "CompileTracker",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "StallWatchdog",
     "TraceRecorder",
+    "build_flight_artifact",
     "escape_label_value",
+    "flight_recorder",
     "format_labels",
     "span_breakdown",
 ]

@@ -1,0 +1,386 @@
+"""Stall watchdog + flight-artifact dumps.
+
+Answers "why is the engine stuck RIGHT NOW": an asyncio task that
+samples the scheduler's loop heartbeat, the event loop's own lag, and
+queue-depth-vs-throughput, and on a trip dumps a **flight artifact** —
+the flight ring (telemetry/flight.py), all-thread stacks, a metrics
+snapshot, and the active request table — to ``DYN_FLIGHT_DIR`` while
+incrementing ``dynamo_watchdog_trips_total{reason}``. The same dump is
+reachable on demand at ``GET /debug/flight`` (http/service.py) and via
+``SIGUSR2`` (install_signal_dump).
+
+Trip conditions (each with its own ``reason`` label):
+
+- ``decode_stall`` — work is pending (active slots or queued requests)
+  but the scheduler loop's heartbeat stamp is older than ``stall_s``.
+  The loop stamps the heartbeat at the top of EVERY pass, so a healthy
+  loop that is merely *waiting* (idle wake, remote-prefill poll, chunked
+  prefill between chunks) stays fresh; only a loop wedged *inside* a
+  pass — a hung Mosaic compile, a host sync stuck on a dead device, an
+  executor job that never returns — goes stale.
+- ``no_throughput`` — requests are queued but the scheduler has not
+  dispatched a single step for ``stall_s`` while its heartbeat stays
+  fresh: the loop is spinning without making progress (e.g. leaked
+  slots starving admission).
+- ``event_loop_lag`` — the sampled sleep drift exceeded ``stall_s``:
+  something blocked the event loop itself for that long (the drift is
+  always exported as ``dynamo_runtime_event_loop_lag_seconds``).
+
+After a trip the watchdog re-arms only once the tripping condition
+clears, so a persistent wedge produces one artifact, not one per
+sampling interval.
+
+The watchdog holds its task handle and cancels it on ``stop()`` (the
+task-leak rule), and every filesystem write rides ``run_in_executor``
+(the async-blocking rule) — both pinned zero-finding by
+tests/test_dynlint.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+from .flight import FLIGHT_DIR_ENV, FlightRecorder, flight_recorder
+
+logger = logging.getLogger(__name__)
+
+# watchdogs register here so on-demand dumps (/debug/flight, SIGUSR2)
+# can include every engine's probe/request-table/metrics in one artifact
+_SOURCES: List["StallWatchdog"] = []
+
+
+def _thread_stacks() -> List[dict]:
+    """All-thread stacks via sys._current_frames, with thread names."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = []
+    for ident, frame in sys._current_frames().items():
+        stacks.append({
+            "thread_id": ident,
+            "name": names.get(ident, "?"),
+            "stack": [
+                line.rstrip("\n")
+                for line in traceback.format_stack(frame)
+            ],
+        })
+    return stacks
+
+
+def build_flight_artifact(reason: str = "on_demand",
+                          flight: Optional[FlightRecorder] = None,
+                          ) -> dict:
+    """Assemble one self-contained dump: ring + stacks + every
+    registered watchdog's probe, request table, and metrics snapshot.
+
+    Events merge across rings: the process-wide recorder plus any
+    private ring a registered engine records into (tests, multi-engine
+    processes), chronological, deduped by ring identity."""
+    rings = {}
+    if flight is not None:
+        rings[id(flight)] = flight
+    else:
+        g = flight_recorder()
+        rings[id(g)] = g
+        for wd in list(_SOURCES):
+            if wd.flight is not None:
+                rings.setdefault(id(wd.flight), wd.flight)
+    events = sorted(
+        (e for r in rings.values() for e in r.snapshot()),
+        key=lambda e: e["t"],
+    )
+    dropped = sum(r.dropped for r in rings.values())
+    sources = []
+    for wd in list(_SOURCES):
+        entry: dict = {"name": wd.name}
+        try:
+            entry["probe"] = wd.probe() if wd.probe is not None else None
+            entry["requests"] = (
+                wd.requests() if wd.requests is not None else None
+            )
+            entry["metrics"] = (
+                wd.registry.render() if wd.registry is not None else None
+            )
+            entry["last_trip"] = wd.last_trip
+        except Exception as e:
+            # a dump must degrade, never fail: a half-torn-down engine
+            # still contributes its name + the error
+            logger.warning("flight source %s failed during dump: %s",
+                           wd.name, e)
+            entry["error"] = repr(e)
+        sources.append(entry)
+    return {
+        "version": 1,
+        "reason": reason,
+        "time": time.time(),
+        "monotonic": time.monotonic(),
+        "pid": os.getpid(),
+        "events": events,
+        "dropped_events": dropped,
+        "threads": _thread_stacks(),
+        "sources": sources,
+    }
+
+
+def flight_dir() -> Optional[str]:
+    return os.environ.get(FLIGHT_DIR_ENV) or None
+
+
+def write_flight_artifact(artifact: dict,
+                          out_dir: Optional[str] = None) -> Optional[str]:
+    """Serialize one artifact to ``<dir>/flight-<pid>-<seq>-<reason>.json``.
+    Blocking (disk IO) — async callers run it in an executor. Returns the
+    path, or None when no dump dir is configured."""
+    out_dir = out_dir or flight_dir()
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    # monotonic-ns suffix: two dumps in the same second never collide
+    path = os.path.join(
+        out_dir,
+        f"flight-{os.getpid()}-{time.monotonic_ns()}"
+        f"-{artifact.get('reason', 'dump')}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(artifact, f, default=str, indent=1)
+    return path
+
+
+_signal_installed = False
+
+
+def install_signal_dump() -> bool:
+    """SIGUSR2 → write a flight artifact to DYN_FLIGHT_DIR (or log it as
+    a single JSON line when no dir is configured). Idempotent; main
+    thread only (signal module restriction); returns whether installed.
+
+    The handler spawns a short-lived thread for the dump so the signal
+    context does only scheduling — and so a wedged event loop (the very
+    situation that makes an operator reach for SIGUSR2) cannot block it.
+    """
+    global _signal_installed
+    if _signal_installed:
+        return True
+
+    def _dump_in_thread(signum, frame):
+        def work():
+            try:
+                artifact = build_flight_artifact(reason="sigusr2")
+                path = write_flight_artifact(artifact)
+                if path:
+                    logger.warning("flight artifact dumped to %s", path)
+                else:
+                    logger.warning(
+                        "flight artifact (no %s configured): %s",
+                        FLIGHT_DIR_ENV, json.dumps(artifact, default=str),
+                    )
+            except Exception:
+                logger.exception("SIGUSR2 flight dump failed")
+
+        threading.Thread(target=work, name="flight-dump", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGUSR2, _dump_in_thread)
+    except (ValueError, AttributeError, OSError) as e:
+        # non-main thread, or a platform without SIGUSR2
+        logger.debug("SIGUSR2 flight dump not installed: %s", e)
+        return False
+    _signal_installed = True
+    return True
+
+
+class StallWatchdog:
+    """Samples one engine's liveness; dumps + counts on a trip.
+
+    ``probe()`` returns the scheduler's liveness snapshot (see
+    Scheduler.watchdog_probe): ``heartbeat_t`` (monotonic stamp of the
+    last loop pass), ``steps`` (dispatch counter), ``queue_depth``,
+    ``active`` (occupied slots), ``stopping``. ``requests()`` returns
+    the active request table for the artifact.
+    """
+
+    def __init__(
+        self,
+        probe: Callable[[], dict],
+        requests: Optional[Callable[[], list]] = None,
+        registry=None,
+        flight: Optional[FlightRecorder] = None,
+        interval_s: float = 1.0,
+        stall_s: float = 30.0,
+        dump_dir: Optional[str] = None,
+        name: str = "engine",
+    ):
+        self.probe = probe
+        self.requests = requests
+        self.flight = flight if flight is not None else flight_recorder()
+        self.interval_s = max(0.02, interval_s)
+        self.stall_s = max(self.interval_s, stall_s)
+        self.dump_dir = dump_dir  # None → DYN_FLIGHT_DIR at dump time
+        self.name = name
+        self.registry = registry
+        if registry is None:
+            from .registry import MetricsRegistry
+
+            self.registry = MetricsRegistry()
+        self._trips = self.registry.counter(
+            "dynamo_watchdog_trips_total",
+            "Stall-watchdog trips, labelled reason="
+            "decode_stall|no_throughput|event_loop_lag",
+        )
+        self._lag_gauge = self.registry.gauge(
+            "dynamo_runtime_event_loop_lag_seconds",
+            "Sampled asyncio event-loop lag: how late the watchdog's "
+            "periodic sleep fired vs. its deadline",
+        )
+        self._task: Optional[asyncio.Task] = None
+        # (steps value, monotonic time it last changed) for no_throughput
+        self._steps_mark: Optional[tuple] = None
+        # reasons currently tripped; re-arm only when the condition clears
+        self._tripped: set = set()
+        self.trips: List[dict] = []  # public record for tests/inspection
+        self.last_trip: Optional[dict] = None
+        self.loop_lag_s = 0.0
+
+    # ---------- lifecycle ----------
+
+    def start(self) -> "StallWatchdog":
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name=f"watchdog-{self.name}")
+            _SOURCES.append(self)
+        return self
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if self in _SOURCES:
+            _SOURCES.remove(self)
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    # ---------- the loop ----------
+
+    async def _run(self) -> None:
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(self.interval_s)
+            self.loop_lag_s = max(
+                0.0, time.monotonic() - t0 - self.interval_s)
+            self._lag_gauge.set(self.loop_lag_s)
+            try:
+                await self._check(time.monotonic())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the watchdog must outlive a flaky probe — log and keep
+                # sampling (a dead watchdog is a silent failure mode of
+                # its own)
+                logger.exception("watchdog check failed; continuing")
+
+    async def _check(self, now: float) -> None:
+        snap = self.probe()
+        if snap.get("stopping"):
+            return
+        hb = snap.get("heartbeat_t")
+        heartbeat = now if hb is None else float(hb)
+        depth = int(snap.get("queue_depth") or 0)
+        active = int(snap.get("active") or 0)
+        # remote-prefill waits carry their own deadline + local-fallback
+        # machinery, so they count toward "the loop must be alive"
+        # (decode_stall) but NOT toward "the loop must be dispatching"
+        # (no_throughput) — a slow-but-healthy prefill worker is not a
+        # starvation
+        remote = int(snap.get("pending_remote") or 0)
+        steps = snap.get("steps")
+
+        # no_throughput bookkeeping: when did `steps` last advance? The
+        # clock also re-stamps while the queue is empty — steps frozen
+        # with nothing queued is rest, and without the reset the FIRST
+        # sample after a long idle gap that sees new arrivals would read
+        # the ancient mark and trip instantly
+        if steps is not None:
+            if (depth == 0 or self._steps_mark is None
+                    or self._steps_mark[0] != steps):
+                self._steps_mark = (steps, now)
+
+        pending = depth > 0 or active > 0 or remote > 0
+        stale = pending and (now - heartbeat) > self.stall_s
+        starved = (
+            depth > 0
+            and self._steps_mark is not None
+            and (now - self._steps_mark[1]) > self.stall_s
+        )
+        lagged = self.loop_lag_s > self.stall_s
+
+        await self._edge("decode_stall", stale, snap, now - heartbeat)
+        # a stale heartbeat already explains frozen steps — don't double-
+        # report the same wedge under a second reason
+        await self._edge("no_throughput", starved and not stale, snap,
+                         now - self._steps_mark[1] if self._steps_mark
+                         else 0.0)
+        await self._edge("event_loop_lag", lagged, snap, self.loop_lag_s)
+
+    async def _edge(self, reason: str, condition: bool, snap: dict,
+                    stalled_for: float) -> None:
+        """Edge-triggered trip: fire once when ``condition`` becomes
+        true; re-arm when it clears."""
+        if not condition:
+            self._tripped.discard(reason)
+            return
+        if reason in self._tripped:
+            return
+        self._tripped.add(reason)
+        await self.trip(reason, snap, stalled_for)
+
+    async def trip(self, reason: str, snap: dict,
+                   stalled_for: float) -> Optional[str]:
+        self._trips.inc(reason=reason)
+        self.flight.record(
+            "watchdog.trip", reason=reason, name=self.name,
+            stalled_for_s=round(stalled_for, 3), **{
+                k: snap.get(k)
+                for k in ("queue_depth", "active", "steps")
+            },
+        )
+        info = {
+            "reason": reason,
+            "name": self.name,
+            "time": time.time(),
+            "stalled_for_s": stalled_for,
+            "probe": dict(snap),
+        }
+        self.trips.append(info)
+        self.last_trip = info
+        loop = asyncio.get_running_loop()
+        # artifact assembly walks scheduler state and renders metrics —
+        # cheap, but the write is disk IO: both ride the executor so a
+        # slow volume can't stall the loop we're supposed to be watching
+        path = await loop.run_in_executor(None, self._dump, reason)
+        info["artifact"] = path
+        logger.error(
+            "WATCHDOG TRIP [%s] %s: stalled for %.1fs "
+            "(queue_depth=%s active=%s steps=%s)%s",
+            self.name, reason, stalled_for, snap.get("queue_depth"),
+            snap.get("active"), snap.get("steps"),
+            f" — flight artifact at {path}" if path
+            else f" — set {FLIGHT_DIR_ENV} to persist flight artifacts",
+        )
+        return path
+
+    def _dump(self, reason: str) -> Optional[str]:
+        # no flight= argument: this watchdog is registered in _SOURCES,
+        # so the artifact merges its ring WITH the process-wide one —
+        # coordinator/transfer/router events record into the global ring
+        # and must not vanish from trip dumps
+        artifact = build_flight_artifact(reason=reason)
+        return write_flight_artifact(artifact, self.dump_dir)

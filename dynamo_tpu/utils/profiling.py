@@ -20,13 +20,31 @@ the same entry points.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import time
+import uuid
 
 logger = logging.getLogger(__name__)
 
 _server_started = False
+
+# per-process capture counter: two captures in the same SECOND used to
+# collide (strftime has second resolution) and exist_ok=True silently
+# merged their trace files into one unreadable directory
+_capture_seq = itertools.count()
+
+
+def trace_dir_name() -> str:
+    """Unique-per-capture directory name: timestamp (human ordering) +
+    process-local counter (same-second captures in one process) + pid +
+    random suffix (same-second captures across processes sharing the
+    profile dir)."""
+    return (
+        time.strftime("trace-%Y%m%d-%H%M%S")
+        + f"-{os.getpid()}-{next(_capture_seq):04d}-{uuid.uuid4().hex[:6]}"
+    )
 
 
 def enable_profiler_server(port: int) -> None:
@@ -49,8 +67,10 @@ def capture_trace(out_dir: str, seconds: float) -> str:
     """
     import jax
 
-    trace_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
-    os.makedirs(trace_dir, exist_ok=True)
+    trace_dir = os.path.join(out_dir, trace_dir_name())
+    # exist_ok=False on purpose: a collision must fail loudly instead of
+    # silently merging two captures into one directory
+    os.makedirs(trace_dir)
     with jax.profiler.trace(trace_dir):
         time.sleep(seconds)
     return trace_dir

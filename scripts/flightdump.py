@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Offline pretty-printer for flight artifacts (telemetry/watchdog.py).
+
+A flight artifact is the JSON dump a stall-watchdog trip, SIGUSR2, or
+``GET /debug/flight?save=1`` writes to ``DYN_FLIGHT_DIR``: the engine's
+flight-ring events, all-thread stacks, per-engine liveness probes,
+request tables, and a metrics snapshot. Raw, it takes jq gymnastics to
+read; this renders it as a chronological event table plus the
+supporting sections.
+
+Usage:
+    python scripts/flightdump.py <artifact.json> [--request <id>]
+        [--last N] [--no-stacks] [--no-requests] [--metrics]
+
+``--request <id>`` filters the event table (and request tables) to one
+request/trace id — the "what happened to MY request" view. ``--last N``
+keeps only the most recent N events. ``--metrics`` additionally prints
+the (long) metrics snapshot of each source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+
+def _fmt_wall(wall: Optional[float]) -> str:
+    if not wall:
+        return "-" * 12
+    return time.strftime("%H:%M:%S", time.localtime(wall)) + (
+        ".%03d" % int((wall % 1) * 1000)
+    )
+
+
+def _fmt_data(evt: dict) -> str:
+    data = evt.get("data") or {}
+    return " ".join(f"{k}={v}" for k, v in data.items())
+
+
+def render_events(events: List[dict], t_ref: Optional[float]) -> List[str]:
+    """Chronological table: wall clock, seconds-before-dump, kind,
+    request id, and the event's structured payload."""
+    lines = [
+        f"{'WALL':<12} {'T-DUMP':>9} {'KIND':<26} {'REQUEST':<34} DATA",
+    ]
+    for evt in events:
+        rel = ""
+        if t_ref is not None and evt.get("t") is not None:
+            rel = f"{evt['t'] - t_ref:+.3f}s"
+        rid = evt.get("request_id") or ""
+        if evt.get("trace_id"):
+            rid = f"{rid} ({evt['trace_id']})" if rid else evt["trace_id"]
+        lines.append(
+            f"{_fmt_wall(evt.get('wall')):<12} {rel:>9} "
+            f"{evt.get('kind', '?'):<26} {rid:<34} {_fmt_data(evt)}"
+        )
+    return lines
+
+
+def render_requests(sources: List[dict],
+                    request: Optional[str]) -> List[str]:
+    lines: List[str] = []
+    for src in sources:
+        table = src.get("requests") or []
+        if request:
+            table = [r for r in table
+                     if request in (r.get("request_id"), r.get("trace_id"))]
+        if not table:
+            continue
+        lines.append(f"--- active requests [{src.get('name', '?')}] ---")
+        for row in table:
+            lines.append("  " + " ".join(
+                f"{k}={v}" for k, v in row.items()
+            ))
+    return lines
+
+
+def render_probes(sources: List[dict]) -> List[str]:
+    lines: List[str] = []
+    for src in sources:
+        probe = src.get("probe")
+        header = f"--- engine [{src.get('name', '?')}] ---"
+        if src.get("error"):
+            lines += [header, f"  dump error: {src['error']}"]
+            continue
+        if probe:
+            lines.append(header)
+            lines.append("  " + " ".join(f"{k}={v}" for k, v in probe.items()))
+            if src.get("last_trip"):
+                lt = src["last_trip"]
+                lines.append(
+                    f"  last trip: {lt.get('reason')} after "
+                    f"{lt.get('stalled_for_s', 0):.1f}s stalled"
+                )
+    return lines
+
+
+def render_stacks(threads: List[dict]) -> List[str]:
+    lines: List[str] = []
+    for th in threads:
+        lines.append(
+            f"--- thread {th.get('name', '?')} (id {th.get('thread_id')}) ---"
+        )
+        lines.extend("  " + ln for ln in th.get("stack", []))
+    return lines
+
+
+def render(artifact: dict, request: Optional[str] = None,
+           last: Optional[int] = None, stacks: bool = True,
+           requests: bool = True, metrics: bool = False) -> str:
+    out: List[str] = []
+    out.append(
+        f"flight artifact: reason={artifact.get('reason')} "
+        f"pid={artifact.get('pid')} "
+        f"time={_fmt_wall(artifact.get('time'))} "
+        f"events={len(artifact.get('events') or [])} "
+        f"(+{artifact.get('dropped_events', 0)} dropped)"
+    )
+    events = artifact.get("events") or []
+    if request:
+        events = [e for e in events
+                  if request in (e.get("request_id"), e.get("trace_id"))]
+        out.append(f"filtered to request {request}: {len(events)} events")
+    if last:
+        events = events[-last:]
+    out.append("")
+    out += render_events(events, artifact.get("monotonic"))
+    probes = render_probes(artifact.get("sources") or [])
+    if probes:
+        out.append("")
+        out += probes
+    if requests:
+        table = render_requests(artifact.get("sources") or [], request)
+        if table:
+            out.append("")
+            out += table
+    if stacks:
+        out.append("")
+        out += render_stacks(artifact.get("threads") or [])
+    if metrics:
+        for src in artifact.get("sources") or []:
+            if src.get("metrics"):
+                out.append("")
+                out.append(f"--- metrics [{src.get('name', '?')}] ---")
+                out.append(src["metrics"].rstrip("\n"))
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flightdump", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("artifact", help="flight artifact JSON path")
+    ap.add_argument("--request", default=None,
+                    help="filter events/request tables to one request or "
+                         "trace id")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the most recent N events")
+    ap.add_argument("--no-stacks", action="store_true",
+                    help="omit the thread-stack section")
+    ap.add_argument("--no-requests", action="store_true",
+                    help="omit the active-request tables")
+    ap.add_argument("--metrics", action="store_true",
+                    help="also print each source's metrics snapshot")
+    args = ap.parse_args(argv[1:])
+    try:
+        with open(args.artifact) as f:
+            artifact = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"flightdump: cannot read {args.artifact}: {e}",
+              file=sys.stderr)
+        return 2
+    print(render(
+        artifact, request=args.request, last=args.last,
+        stacks=not args.no_stacks, requests=not args.no_requests,
+        metrics=args.metrics,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -806,6 +806,69 @@ def test_async_blocking_flags_sync_wait_in_streaming_pump_shape():
     assert [f.rule for f in out] == ["async-blocking"]
 
 
+# --------------------------------------------------------------------------
+# flight recorder + stall watchdog: the always-on observability contract
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_flight_watchdog_modules_pass_async_blocking_and_task_leak():
+    """The flight ring runs on EVERY hot path and the watchdog watches
+    the loop it runs on, so their own discipline is load-bearing: the
+    ring append must never touch the event loop (no blocking IO in async
+    code — artifact writes ride run_in_executor) and the watchdog task
+    must be held and cancelled on stop (a leaked watchdog would sample a
+    dead engine forever). Pin both modules ZERO-finding, not
+    baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "telemetry", "flight.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "watchdog.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "flight/watchdog discipline regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_task_leak_flags_watchdog_shaped_discarded_task():
+    """TP fixture shaped like a careless watchdog: the sampling task's
+    handle is dropped, so stop() can never cancel it and it samples a
+    dead engine forever."""
+    out = findings(
+        """
+        import asyncio
+
+        class Watchdog:
+            def start(self):
+                asyncio.get_running_loop().create_task(self._run())
+
+            async def _run(self):
+                while True:
+                    await asyncio.sleep(1.0)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]
+
+
+def test_async_blocking_flags_artifact_write_on_loop_shape():
+    """TP fixture shaped like a naive trip handler that writes the
+    flight artifact directly on the event loop — exactly the stall the
+    watchdog exists to detect, committed by the watchdog itself."""
+    out = findings(
+        """
+        import json
+
+        async def on_trip(artifact, path):
+            with open(path, "w") as f:
+                json.dump(artifact, f)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+    assert "open" in out[0].message
+
+
 def test_jit_impure_flags_host_sync_in_gather_shaped_program():
     """TP fixture shaped like the frame gather: an np.asarray inside the
     traced gather is a per-frame device→host stall — the transfer would

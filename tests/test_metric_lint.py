@@ -45,10 +45,16 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_prefill_worker_prefix_hit_ratio",
         "dynamo_disagg_transfer_duration_seconds",
         "dynamo_disagg_transfer_exposed_seconds",
+        # flight recorder / watchdog / XLA compile observability
+        # (telemetry/flight.py, telemetry/watchdog.py)
+        "dynamo_engine_xla_compiles_total",
+        "dynamo_engine_xla_compile_duration_seconds",
+        "dynamo_watchdog_trips_total",
+        "dynamo_runtime_event_loop_lag_seconds",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 32
+    assert len(names) >= 36
 
 
 def _metric(name, kind):
