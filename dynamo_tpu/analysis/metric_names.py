@@ -29,10 +29,12 @@ PREFIX = "dynamo_"
 
 # the unit vocabulary: extend deliberately, not ad hoc
 # ("depth" added for structural stage-count gauges — the decode
-# pipeline's dispatch depth; same count family as slots/blocks)
+# pipeline's dispatch depth; same count family as slots/blocks.
+# "replicas" added with the SLA planner's replica-target gauge — worker
+# pool size is a first-class count unit in the deployment plane)
 UNIT_SUFFIXES = (
     "total", "seconds", "bytes", "tokens", "blocks",
-    "requests", "slots", "ratio", "info", "depth",
+    "requests", "slots", "ratio", "info", "depth", "replicas",
 )
 BASE_UNITS = ("seconds", "bytes", "tokens")  # what a histogram may measure
 

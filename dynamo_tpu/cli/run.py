@@ -165,6 +165,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "flight artifact) when the engine has pending "
                         "work but its loop heartbeat or dispatch counter "
                         "has been stale this long (default 30; 0 = off)")
+    # closed-loop SLA planner + HTTP-edge admission control (planner/)
+    p.add_argument("--admission-limit", type=int, default=0,
+                   help="HTTP-edge admission control: max concurrently "
+                        "admitted requests; overflow queues per priority "
+                        "class (X-Priority: high|normal|low), dequeued "
+                        "highest-first, shed with 429 + Retry-After on "
+                        "saturation or deadline (0 = admission off)")
+    p.add_argument("--admission-queue-depth", type=int, default=64,
+                   help="per-priority-class admission queue bound")
+    p.add_argument("--admission-queue-timeout-s", type=float, default=10.0,
+                   help="queue-wait deadline before a queued request is "
+                        "shed with 429")
+    p.add_argument("--planner", action="store_true",
+                   help="in=http: run an in-process planner loop that "
+                        "tightens/relaxes admission (and the disagg "
+                        "split) from the engine's own load signals")
+    p.add_argument("--planner-interval-s", type=float, default=2.0,
+                   help="planner observe→decide→actuate cadence")
+    p.add_argument("--planner-min-replicas", type=int, default=1)
+    p.add_argument("--planner-max-replicas", type=int, default=8)
+    p.add_argument("--planner-cooldown-s", type=float, default=30.0,
+                   help="scale-up cooldown per role (scale-down waits "
+                        "4x this)")
+    p.add_argument("--planner-deployment", default=None,
+                   help="in=planner: api-store deployment record whose "
+                        "per-role replica counts the planner patches "
+                        "(the operator applies them via --api-store-url)")
+    p.add_argument("--api-store-url", default=None,
+                   help="in=planner: api-store base URL for replica "
+                        "actuation")
+    p.add_argument("--router-staleness-bound-s", type=float, default=0.0,
+                   help="KV router: skip workers whose scraped load "
+                        "snapshot is older than this many seconds "
+                        "(0 = trust snapshots forever)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="dyn:// roles: serve this process's Prometheus "
                         "registry on a sidecar GET /metrics port (the "
@@ -365,9 +399,19 @@ async def run_http(flags, engine, mdc) -> None:
             model_type="both" if mdc is not None else "chat",
             max_model_len=mdc.context_length if mdc is not None else None,
         )
+    admission = None
+    if flags.admission_limit > 0:
+        from ..planner import AdmissionConfig, AdmissionController
+
+        admission = AdmissionController(AdmissionConfig(
+            limit=flags.admission_limit,
+            queue_depth=flags.admission_queue_depth,
+            queue_timeout_s=flags.admission_queue_timeout_s,
+        ))
     service = HttpService(
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
+        admission=admission,
     )
     if getattr(engine, "telemetry_registry", None) is not None:
         # in-process engine: one registry, one exposition — HTTP,
@@ -379,6 +423,37 @@ async def run_http(flags, engine, mdc) -> None:
         service.metrics.register_callback_gauges(
             "dynamo_engine", engine.engine_metrics
         )
+
+    planner = None
+    if flags.planner:
+        # in-process planner: the frontend's own saturation signals drive
+        # admission tightening (and, with an engine attached, the
+        # engine's slot/KV/queue state feeds the policy too)
+        from ..planner import (
+            LocalActuator,
+            Planner,
+            PlannerConfig,
+            PolicyConfig,
+            SlaPolicy,
+            engine_metrics_source,
+        )
+
+        policy = SlaPolicy(PolicyConfig(
+            min_replicas=flags.planner_min_replicas,
+            max_replicas=flags.planner_max_replicas,
+            scale_up_cooldown_s=flags.planner_cooldown_s,
+            scale_down_cooldown_s=flags.planner_cooldown_s * 4,
+        ))
+        planner = Planner(
+            policy, config=PlannerConfig(interval_s=flags.planner_interval_s)
+        )
+        if admission is not None:
+            planner.add_source(admission.snapshot)
+            planner.add_actuator(LocalActuator(admission=admission))
+        if engine is not None and hasattr(engine, "engine_metrics"):
+            planner.add_source(engine_metrics_source(engine.engine_metrics))
+        service.metrics.attach_registry(planner.registry)
+        planner.start()
 
     watcher = None
     if flags.store_port is not None:
@@ -426,6 +501,8 @@ async def run_http(flags, engine, mdc) -> None:
                and loop.time() < deadline and not force_event.is_set()):
             await asyncio.sleep(0.1)
     finally:
+        if planner is not None:
+            planner.stop()
         if watcher:
             await watcher.stop()
         await service.stop()
@@ -515,7 +592,8 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         router = None
         if flags.router_mode == "kv":
             router = await KvRouter(
-                w_endpoint.component, client, block_size=flags.kv_block_size
+                w_endpoint.component, client, block_size=flags.kv_block_size,
+                staleness_bound_s=flags.router_staleness_bound_s,
             ).start()
         else:
             await client.start()
@@ -622,6 +700,115 @@ async def run_prefill(flags) -> None:
         await drt.close()
 
 
+async def run_planner(flags) -> None:
+    """Standalone SLA-planner role (in=planner): scrape the worker pool's
+    load snapshots + the prefill work-queue depth, run the policy, and
+    actuate — disagg-router thresholds through the discovery plane, and
+    per-role replica counts through the api-store record the operator
+    reconciles (``--api-store-url`` + ``--planner-deployment``)."""
+    from ..disagg.protocols import PrefillQueue
+    from ..http.service import parse_endpoint_path
+    from ..kv_router.metrics_aggregator import KvMetricsAggregator
+    from ..planner import (
+        LocalActuator,
+        Planner,
+        PlannerConfig,
+        PolicyConfig,
+        SlaPolicy,
+        StoreScaleActuator,
+        aggregator_source,
+    )
+    from ..runtime.client import Client, RouterMode
+    from ..runtime.component import DistributedRuntime
+    from ..telemetry.server import maybe_start_metrics_server
+
+    if flags.store_port is None:
+        raise SystemExit("in=planner requires --store-port")
+    if not flags.worker_endpoint:
+        raise SystemExit(
+            "in=planner requires --worker-endpoint "
+            "(the decode workers to observe)"
+        )
+    drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
+    wns, wcomp, wep = parse_endpoint_path(flags.worker_endpoint)
+    client = Client(
+        drt.namespace(wns).component(wcomp).endpoint(wep),
+        RouterMode.ROUND_ROBIN,
+    )
+    await client.start()
+    aggregator = KvMetricsAggregator(client)
+    aggregator.start()
+
+    policy = SlaPolicy(
+        PolicyConfig(
+            min_replicas=flags.planner_min_replicas,
+            max_replicas=flags.planner_max_replicas,
+            scale_up_cooldown_s=flags.planner_cooldown_s,
+            scale_down_cooldown_s=flags.planner_cooldown_s * 4,
+        ),
+        initial_local_prefill_length=flags.max_local_prefill_length,
+        initial_prefill_queue_size=flags.max_prefill_queue_size,
+    )
+    planner = Planner(
+        policy, config=PlannerConfig(interval_s=flags.planner_interval_s)
+    )
+    planner.add_source(aggregator_source(aggregator))
+
+    # prefill work-queue depth: same cached-poll pattern the decode-side
+    # coordinator uses (disagg/coordinator.py _depth_loop). The dict
+    # starts EMPTY and empties again on failure — fabricating a 0 here
+    # would read as "queue drained" and steer the rebalance policy the
+    # wrong way exactly when the messaging plane is down.
+    queue = PrefillQueue(drt.messaging, flags.namespace)
+    depth: dict = {}
+
+    async def _depth_loop() -> None:
+        while True:
+            try:
+                depth["prefill.queue_depth"] = float(await queue.depth())
+            except Exception:
+                depth.clear()
+                logger.debug("prefill queue depth refresh failed",
+                             exc_info=True)
+            await asyncio.sleep(1.0)
+
+    depth_task = drt.runtime.spawn(_depth_loop())
+    planner.add_source(lambda: depth)
+
+    planner.add_actuator(LocalActuator(
+        discovery=drt.discovery, namespace=flags.namespace,
+        model_name=flags.model_name,
+    ))
+    if flags.api_store_url and flags.planner_deployment:
+        from ..deploy.store_source import ApiStoreClient
+
+        planner.add_actuator(StoreScaleActuator(
+            ApiStoreClient(flags.api_store_url), flags.planner_deployment,
+        ))
+    else:
+        logger.warning(
+            "in=planner without --api-store-url/--planner-deployment: "
+            "scale actions will be decided and logged but not actuated"
+        )
+
+    mserver = await maybe_start_metrics_server(
+        planner.registry, flags.metrics_port
+    )
+    planner.start(spawn=drt.runtime.spawn)
+    print(f"planner observing {flags.worker_endpoint} "
+          f"every {flags.planner_interval_s:.1f}s", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        planner.stop()
+        depth_task.cancel()
+        if mserver is not None:
+            await mserver.stop()
+        aggregator.stop()
+        await client.close()
+        await drt.close()
+
+
 async def amain(argv: List[str]) -> None:
     src, engine_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
@@ -661,6 +848,9 @@ async def amain(argv: List[str]) -> None:
 
     if src == "prefill":
         await run_prefill(flags)
+        return
+    if src == "planner":
+        await run_planner(flags)
         return
     if src.startswith("dyn://"):
         await run_worker(flags, engine_spec, src)

@@ -57,6 +57,11 @@ ROLE_ARGS = {
     "decode": ["in=dyn://{ns}.backend.generate", "out=jax", "--token-level",
                "--remote-prefill"],
     "prefill": ["in=prefill", "out=jax"],
+    # the SLA planner control-plane pod: observes the decode pool +
+    # prefill queue, actuates router config and (via the api-store)
+    # per-role replica counts
+    "planner": ["in=planner", "out=none",
+                "--worker-endpoint", "dyn://{ns}.backend.generate"],
 }
 
 DYNSTORE_PORT = 4871

@@ -82,11 +82,17 @@ class HttpService:
         port: int = 8080,
         metrics_prefix: str = "dynamo",
         profile_dir: Optional[str] = None,
+        admission=None,  # planner.admission.AdmissionController
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics(metrics_prefix)
+        # optional HTTP-edge admission control (priority classes, bounded
+        # queues, load shedding) — the actuated end of the SLA planner
+        self.admission = admission
+        if admission is not None:
+            self.metrics.attach_registry(admission.registry)
         # completed request traces: ingress-assigned trace ids (honoring
         # X-Request-Id) → span breakdowns at GET /debug/requests/{id}
         self.traces = TraceRecorder()
@@ -156,6 +162,27 @@ class HttpService:
         if engine is None:
             return self._error(404, f"model '{api_req.model}' not found", "model_not_found")
 
+        admitted = False
+        if self.admission is not None:
+            # priority-class admission control (planner/admission.py):
+            # shed/deadline rejections answer 429 + Retry-After BEFORE the
+            # request counts as inflight — shed traffic is accounted on
+            # the dynamo_planner_* instruments, not the service timers
+            from ..planner.admission import AdmissionRejected, parse_priority
+
+            priority = parse_priority(request.headers.get("X-Priority"))
+            rid = (request.headers.get("X-Request-Id") or "").strip()[:128]
+            try:
+                await self.admission.acquire(priority, request_id=rid)
+                admitted = True
+            except AdmissionRejected as e:
+                return web.json_response(
+                    {"error": {"message": str(e), "type": "overloaded",
+                               "code": 429}},
+                    status=429,
+                    headers={"Retry-After": e.retry_after_header},
+                )
+
         timer = self.metrics.track(api_req.model)
         status = "error"
         # ingress-assigned trace id: honor the client's X-Request-Id so
@@ -217,6 +244,8 @@ class HttpService:
             status = "disconnect"
             raise
         finally:
+            if admitted:
+                self.admission.release()
             ctx.context.stop_generating()
             timer.finish(status)
             self.traces.record(ctx.trace_id, api_req.model, status, ctx.stages)

@@ -36,6 +36,7 @@ class KvRouter:
         block_size: int = 16,
         num_shards: int = 1,
         poll_interval: float = 0.1,
+        staleness_bound_s: float = 0.0,
     ):
         self.component = component
         self.client = client
@@ -43,7 +44,10 @@ class KvRouter:
         self.indexer: Union[KvIndexer, ShardedKvIndexer] = (
             KvIndexer(block_size) if num_shards <= 1 else ShardedKvIndexer(num_shards, block_size)
         )
-        self.scheduler = KvScheduler(block_size)
+        # staleness_bound_s > 0: the cost function skips workers whose
+        # scraped snapshot is older than the bound (0 = trust forever)
+        self.scheduler = KvScheduler(
+            block_size, staleness_bound_s=staleness_bound_s or None)
         self.aggregator = KvMetricsAggregator(
             client,
             poll_interval=poll_interval,
@@ -65,6 +69,11 @@ class KvRouter:
         self._overlap_blocks = self.registry.counter(
             "dynamo_kv_router_overlap_blocks_total",
             "Prefix-overlap blocks credited to chosen workers",
+        )
+        self._stale_skips = self.registry.counter(
+            "dynamo_kv_router_stale_worker_skips_total",
+            "Workers excluded from a scheduling decision because their "
+            "load snapshot exceeded the staleness bound",
         )
 
     def _on_worker_gone(self, worker_id: str) -> None:
@@ -96,6 +105,9 @@ class KvRouter:
         hashes = compute_block_hashes(token_ids, self.block_size)
         overlap = self.indexer.find_matches(hashes)
         decision = self.scheduler.schedule(len(token_ids), overlap)
+        # federation pattern: the scheduler counts exclusions; the series
+        # mirrors its monotonic total (set_sample, not inc)
+        self._stale_skips.set_sample(float(self.scheduler.stale_skips))
         self._decisions.inc(worker=str(decision.worker_id))
         self._overlap_blocks.inc(
             decision.matched_blocks, worker=str(decision.worker_id)

@@ -17,7 +17,8 @@ import dataclasses
 import logging
 import math
 import random
-from typing import Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 from .indexer import OverlapScores
 from .protocols import ForwardPassMetrics
@@ -36,6 +37,9 @@ class WorkerState:
     # predicted deltas since the last metrics refresh
     predicted_active: int = 0
     predicted_blocks: int = 0
+    # monotonic time of the last metrics refresh; the cost function
+    # skips workers whose snapshot exceeds the staleness bound
+    updated_at: float = 0.0
 
     def cache_usage(self, block_size: int) -> float:
         total = self.metrics.kv_total_blocks or 1
@@ -50,19 +54,32 @@ class WorkerState:
 
 
 class KvScheduler:
-    def __init__(self, block_size: int = 16, require_free_slot: bool = False):
+    def __init__(self, block_size: int = 16, require_free_slot: bool = False,
+                 staleness_bound_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.block_size = block_size
         self.require_free_slot = require_free_slot
+        # snapshots older than this are not trusted by the cost function
+        # (None/0 = off). A worker whose scrape stopped (wedged engine,
+        # partitioned host) keeps its LAST load forever — typically a
+        # low-looking one, so without the bound it becomes a black hole
+        # every new request routes into.
+        self.staleness_bound_s = staleness_bound_s or None
+        self.clock = clock
         self.workers: Dict[str, WorkerState] = {}
+        self.stale_skips = 0  # lifetime stale-worker exclusions
 
     def update_metrics(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        now = self.clock()
         state = self.workers.get(worker_id)
         if state is None:
-            self.workers[worker_id] = WorkerState(worker_id, metrics)
+            self.workers[worker_id] = WorkerState(
+                worker_id, metrics, updated_at=now)
         else:
             state.metrics = metrics
             state.predicted_active = 0
             state.predicted_blocks = 0
+            state.updated_at = now
 
     def remove_worker(self, worker_id: str) -> None:
         self.workers.pop(worker_id, None)
@@ -75,10 +92,32 @@ class KvScheduler:
             raise AllWorkersBusy("no workers with metrics")
         total_blocks_needed = math.ceil(isl_tokens / self.block_size)
 
+        candidates = self.workers
+        if self.staleness_bound_s:
+            cutoff = self.clock() - self.staleness_bound_s
+            fresh = {wid: s for wid, s in self.workers.items()
+                     if s.updated_at >= cutoff}
+            if fresh and len(fresh) < len(self.workers):
+                self.stale_skips += len(self.workers) - len(fresh)
+                logger.debug(
+                    "kv schedule: skipping %d stale worker(s): %s",
+                    len(self.workers) - len(fresh),
+                    sorted(set(self.workers) - set(fresh)),
+                )
+                candidates = fresh
+            elif not fresh:
+                # EVERY snapshot is stale (scrape loop hiccup) — routing
+                # on old data beats refusing to route at all
+                logger.warning(
+                    "kv schedule: all %d worker snapshots exceed the "
+                    "%.1fs staleness bound; routing on stale data",
+                    len(self.workers), self.staleness_bound_s,
+                )
+
         best: List[str] = []
         best_logit = -float("inf")
         details = {}
-        for wid, state in self.workers.items():
+        for wid, state in candidates.items():
             if self.require_free_slot and (
                 state.metrics.request_active_slots + state.predicted_active
                 >= (state.metrics.request_total_slots or 1)

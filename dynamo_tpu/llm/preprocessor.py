@@ -129,6 +129,7 @@ class OpenAIPreprocessor(Operator):
     # ---------- forward: request translation ----------
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        self._validate_tool_choice(req)
         use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
         if use_raw and req.messages:
             prompt = "".join(m.text_content() for m in req.messages)
@@ -177,6 +178,37 @@ class OpenAIPreprocessor(Operator):
         if self.tokenizer is None:
             raise EngineError(f"no tokenizer available for {self.mdc.display_name}")
         return self.tokenizer.encode(prompt)
+
+    @staticmethod
+    def _validate_tool_choice(req: ChatCompletionRequest) -> None:
+        """Reject malformed ``tool_choice`` at the door (the named-
+        function and "required" forms the reference's delta layer left
+        unimplemented at chat_completions/delta.rs:131 — a full
+        generation must not be spent before a bad name 400s)."""
+        tc = req.tool_choice
+        if tc is None or tc in ("none", "auto", "required"):
+            if tc == "required" and not req.tools:
+                raise EngineError("tool_choice='required' needs tools")
+            return
+        if isinstance(tc, dict):
+            if tc.get("type") != "function":
+                raise EngineError(
+                    "tool_choice object must be "
+                    '{"type": "function", "function": {"name": ...}}'
+                )
+            name = (tc.get("function") or {}).get("name")
+            if not name or not isinstance(name, str):
+                raise EngineError("tool_choice.function.name is required")
+            names = {
+                (t.get("function") or {}).get("name")
+                for t in (req.tools or []) if isinstance(t, dict)
+            }
+            if name not in names:
+                raise EngineError(
+                    f"tool_choice function {name!r} is not in tools"
+                )
+            return
+        raise EngineError(f"unsupported tool_choice {tc!r}")
 
     @staticmethod
     def _guided_choice(req) -> Optional[List[str]]:
@@ -361,6 +393,7 @@ class OpenAIPreprocessor(Operator):
         prompt_tokens: int,
         include_usage: bool = False,
         tool_format: Optional[str] = None,
+        tool_jail: bool = False,
     ) -> AsyncIterator[ChatCompletionChunk]:
         """BackendOutput deltas → OpenAI chat chunks (role chunk first).
 
@@ -369,7 +402,10 @@ class OpenAIPreprocessor(Operator):
         is parsed for tool calls (llm/tools.py): a successful parse emits
         ONE delta carrying ``tool_calls`` with finish_reason="tool_calls"
         — clients never see the raw call syntax as text; a failed parse
-        flushes the buffered text as ordinary content."""
+        flushes the buffered text as ordinary content. ``tool_jail``
+        withholds from token 0: a forced call (tool_choice "required" or
+        a named function) means the whole output IS the call, so no
+        prose should stream while waiting for a marker."""
         yield ChatCompletionChunk(
             id=request_id,
             model=model,
@@ -395,7 +431,7 @@ class OpenAIPreprocessor(Operator):
         # ``pending`` — released text carries its own entries, withheld
         # text buffers its own (no duplication across the jail boundary)
         pending_lps: List[LogprobEntry] = []
-        jailed = False
+        jailed = tool_jail and tool_format is not None
         first_text = True
 
         def _split_lps(entries: List[LogprobEntry], nchars: int,
@@ -532,11 +568,13 @@ class OpenAIPreprocessor(Operator):
                 )
 
             if calls:
-                # the OpenAI streamed tool-call shape (this resolves the
-                # TODO the reference left at chat_completions/delta.rs:131
-                # — its deltas always carried tool_calls: None): per call,
-                # a header delta carrying index/id/type/function.name with
-                # empty arguments, then argument deltas carrying only
+                # the OpenAI streamed tool-call shape (the delta layer the
+                # reference left unimplemented at chat_completions/
+                # delta.rs:131 — its deltas always carried tool_calls:
+                # None; forced tool_choice, handled via tool_jail above,
+                # was the remaining piece): per call, a header delta
+                # carrying index/id/type/function.name with empty
+                # arguments, then argument deltas carrying only
                 # {index, function.arguments} fragments for the client to
                 # concatenate. The closing chunk carries
                 # finish_reason="tool_calls" plus the withheld tokens'
@@ -779,6 +817,12 @@ class OpenAIPreprocessor(Operator):
         if (is_chat and req.tools and req.tool_choice != "none"
                 and self.mdc.tool_call_format is not None):
             kwargs["tool_format"] = self.mdc.tool_call_format
+            if (req.tool_choice == "required"
+                    or isinstance(req.tool_choice, dict)):
+                # forced call (validated in preprocess): the entire
+                # output is expected to be the call — withhold from
+                # token 0 rather than waiting for a marker
+                kwargs["tool_jail"] = True
         if not is_chat and preprocessed.output_options.echo_prompt:
             kwargs["echo_text"] = (
                 req.prompt if isinstance(req.prompt, str)

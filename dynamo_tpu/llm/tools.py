@@ -3,8 +3,10 @@
 Reference analog: lib/llm/src/preprocessor/tools.rs ToolCallingMatcher —
 which only JSON-parses a whole message as {name, parameters|arguments}
 (and, notably, was never wired into the reference's delta layer; every
-delta carried ``tool_calls: None`` with a TODO at chat_completions/
-delta.rs:131 — resolved here). Parsing covers the formats the popular
+delta carried ``tool_calls: None``, left unimplemented at
+chat_completions/delta.rs:131 — resolved here, including the forced
+tool_choice forms "required" and named-function, which jail the stream
+from token 0). Parsing covers the formats the popular
 open-weight families actually emit, and llm/preprocessor.py chat_stream
 emits the proper OpenAI STREAMED tool-call shape from it: per call, a
 header delta ({index, id, type, function.name, arguments: ""}) followed

@@ -1,0 +1,445 @@
+"""HTTP-edge admission control: unit contracts + the traffic-spike e2e.
+
+The acceptance e2e (ISSUE 6): a fake engine behind the real HTTP
+frontend, scripted spike of mixed-priority traffic → only the lowest
+class sheds (429 + Retry-After), queued high-priority streams complete
+byte-identically under the queue-wait deadline, and the planner's
+scale-up lands as a replica patch observable in InMemoryKube.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.deploy import InMemoryKube, Reconciler
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.planner import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejected,
+    KubeActuator,
+    LocalActuator,
+    Planner,
+    PolicyConfig,
+    ScaleAction,
+    SlaPolicy,
+    parse_priority,
+)
+from dynamo_tpu.telemetry.flight import FlightRecorder
+
+
+# --------------------------------------------------------------------------
+# priority parsing
+# --------------------------------------------------------------------------
+
+
+def test_parse_priority_names_numbers_and_garbage():
+    assert parse_priority("high") == 2
+    assert parse_priority("HIGH ") == 2
+    assert parse_priority("normal") == 1
+    assert parse_priority("low") == 0
+    assert parse_priority("2") == 2
+    assert parse_priority("0") == 0
+    # absent/garbage/out-of-range degrade to normal — never to highest
+    assert parse_priority(None) == 1
+    assert parse_priority("") == 1
+    assert parse_priority("urgent!!") == 1
+    assert parse_priority("99") == 1
+    assert parse_priority("-1") == 1
+
+
+# --------------------------------------------------------------------------
+# controller unit contracts
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_slots_grant_highest_priority_first():
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=8, queue_timeout_s=5.0),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)  # takes the only slot
+    order = []
+
+    async def queued(priority, tag):
+        await ac.acquire(priority)
+        order.append(tag)
+
+    low = asyncio.create_task(queued(0, "low"))
+    await asyncio.sleep(0.01)  # low queues first
+    high = asyncio.create_task(queued(2, "high"))
+    await asyncio.sleep(0.01)
+    assert ac.queue_depth() == 2
+    ac.release()           # freed slot goes to high, despite arriving later
+    await high
+    ac.release()
+    await low
+    assert order == ["high", "low"]
+
+
+@pytest.mark.asyncio
+async def test_queue_full_and_deadline_reject_with_retry_after():
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=1, queue_timeout_s=0.05,
+                        retry_after_s=3.0),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)
+    waiting = asyncio.create_task(ac.acquire(1))
+    await asyncio.sleep(0.01)
+    # per-class queue bound: the second waiter is turned away immediately
+    with pytest.raises(AdmissionRejected) as e:
+        await ac.acquire(1)
+    assert e.value.outcome == "queue_full"
+    assert e.value.retry_after_header == "3"
+    # the queued one hits the deadline
+    with pytest.raises(AdmissionRejected) as e2:
+        await waiting
+    assert e2.value.outcome == "timeout"
+    ac.release()
+    assert ac.inflight == 0
+    text = ac.registry.render()
+    assert 'outcome="queue_full"' in text and 'outcome="timeout"' in text
+
+
+@pytest.mark.asyncio
+async def test_shed_level_rejects_and_flushes_only_low_classes():
+    flight = FlightRecorder(64)
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=8, queue_timeout_s=5.0),
+        flight=flight)
+    await ac.acquire(2)
+    queued_low = asyncio.create_task(ac.acquire(0))
+    queued_high = asyncio.create_task(ac.acquire(2))
+    await asyncio.sleep(0.01)
+
+    ac.set_shed_level(1)
+    # the queued LOW waiter is flushed with the shed rejection...
+    with pytest.raises(AdmissionRejected) as e:
+        await queued_low
+    assert e.value.outcome == "shed"
+    # ...the queued HIGH waiter is untouched
+    await asyncio.sleep(0.01)
+    assert not queued_high.done()
+    # new low arrivals shed at the door; normal and high still admitted
+    with pytest.raises(AdmissionRejected):
+        await ac.acquire(0)
+    ac.release()
+    await queued_high
+    ac.release()
+    # decisions are auditable in the flight ring
+    assert any(e["kind"] == "planner.shed" for e in flight.snapshot())
+
+
+@pytest.mark.asyncio
+async def test_raising_limit_grants_queued_waiters():
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=8, queue_timeout_s=5.0),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)
+    queued = asyncio.create_task(ac.acquire(1))
+    await asyncio.sleep(0.01)
+    assert not queued.done()
+    ac.set_limit(2)
+    await queued
+    assert ac.inflight == 2
+
+
+@pytest.mark.asyncio
+async def test_cancelled_waiter_does_not_hold_queue_state():
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=8, queue_timeout_s=5.0),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)
+    queued = asyncio.create_task(ac.acquire(1))
+    await asyncio.sleep(0.01)
+    queued.cancel()  # client disconnected while queued
+    with pytest.raises(asyncio.CancelledError):
+        await queued
+    assert ac.queue_depth() == 0
+    ac.release()  # freed slot must not be handed to the dead waiter
+    assert ac.inflight == 0
+    await ac.acquire(1)  # and the gate still works
+    ac.release()
+
+
+@pytest.mark.asyncio
+async def test_snapshot_feeds_planner_signal_names():
+    ac = AdmissionController(
+        AdmissionConfig(limit=2, queue_depth=8, queue_timeout_s=5.0),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)
+    snap = ac.snapshot()
+    assert snap["admission.inflight_ratio"] == 0.5
+    assert snap["admission.queue_depth"] == 0.0
+    assert snap["admission.shed_total"] == 0.0
+    ac.release()
+
+
+# --------------------------------------------------------------------------
+# the traffic-spike e2e (acceptance criteria)
+# --------------------------------------------------------------------------
+
+
+class SlowDeterministicEngine:
+    """OpenAI-level fake engine: fixed ids, fixed chunking, a scripted
+    per-token delay — so two runs of the same prompt produce
+    byte-identical SSE streams, loaded or not."""
+
+    def __init__(self, token_delay_s: float = 0.02):
+        self.token_delay_s = token_delay_s
+        self.active = 0
+        self.peak_active = 0
+
+    async def generate(self, ctx):
+        req = ctx.payload
+        words = req.messages[-1].text_content().split()
+        self.active += 1
+        self.peak_active = max(self.peak_active, self.active)
+        try:
+            for i, word in enumerate(words):
+                await asyncio.sleep(self.token_delay_s)
+                yield {
+                    "id": "chatcmpl-fixed",
+                    "object": "chat.completion.chunk",
+                    "created": 1,
+                    "model": req.model,
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"content": ("" if i == 0 else " ") + word},
+                        "finish_reason": None,
+                    }],
+                }
+            yield {
+                "id": "chatcmpl-fixed",
+                "object": "chat.completion.chunk",
+                "created": 1,
+                "model": req.model,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "stop"}],
+            }
+        finally:
+            self.active -= 1
+
+
+def _spike_cr():
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoTpuGraphDeployment",
+        "metadata": {"name": "spike", "namespace": "serving", "uid": "u-1"},
+        "spec": {
+            "image": "dynamo-tpu:test",
+            "namespace": "public",
+            "services": {
+                "decode": {"role": "decode", "replicas": 1,
+                           "modelPath": "/m"},
+                "prefill": {"role": "prefill", "replicas": 1,
+                            "modelPath": "/m"},
+            },
+        },
+    }
+
+
+async def _post_chat(session, port, prompt, priority, rid):
+    """One streamed chat request; returns (status, raw_sse_bytes,
+    ttft_s, retry_after_header)."""
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    ttft = None
+    raw = b""
+    async with session.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        json={"model": "slow",
+              "messages": [{"role": "user", "content": prompt}],
+              "stream": True},
+        headers={"X-Priority": priority, "X-Request-Id": rid},
+    ) as r:
+        async for chunk in r.content.iter_any():
+            if ttft is None and b'"content"' in chunk:
+                ttft = loop.time() - t0
+            raw += chunk
+        return r.status, raw, ttft, r.headers.get("Retry-After")
+
+
+@pytest.mark.asyncio
+async def test_traffic_spike_sheds_low_scales_up_and_keeps_high_identical():
+    """ISSUE 6 acceptance: spike → only the lowest class sheds, queued
+    high-priority TTFT holds the deadline, and the planner's scale-up
+    lands in InMemoryKube — one end-to-end test."""
+    engine = SlowDeterministicEngine(token_delay_s=0.02)
+    manager = ModelManager()
+    manager.add_chat_model("slow", engine)
+    flight = FlightRecorder(256)
+    deadline_s = 3.0
+    admission = AdmissionController(
+        AdmissionConfig(limit=2, queue_depth=16, queue_timeout_s=deadline_s,
+                        retry_after_s=2.0),
+        flight=flight)
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          admission=admission)
+
+    # planner: admission state is the saturation signal; actions land in
+    # an in-memory cluster (scale) and back on the admission gate (shed)
+    kube = InMemoryKube()
+    cr = _spike_cr()
+    kube_actuator = KubeActuator(Reconciler(kube), cr)
+    policy = SlaPolicy(PolicyConfig(
+        window_s=10.0,
+        decode_busy_up=0.9, decode_waiting_up=2.0,
+        saturation_busy=0.9, saturation_waiting=3.0,
+        min_replicas=1, max_replicas=4,
+        scale_up_cooldown_s=0.0, shed_step_cooldown_s=0.0,
+    ))
+    planner = Planner(
+        policy=policy,
+        sources=[
+            admission.snapshot,
+            lambda: {
+                "decode.slot_busy_ratio": (
+                    admission.inflight / admission.limit
+                    if admission.limit else 0.0),
+                "decode.waiting": float(admission.queue_depth()),
+            },
+        ],
+        actuators=[kube_actuator, LocalActuator(admission=admission)],
+        flight=flight,
+    )
+
+    await service.start()
+    prompt = "alpha beta gamma delta"
+    try:
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            # ---- baseline: one unloaded high-priority stream ----
+            status, baseline_raw, _, _ = await _post_chat(
+                s, service.port, prompt, "high", "base-0")
+            assert status == 200
+
+            # ---- occupy both slots with long high-priority streams, so
+            # the spike below queues deterministically ----
+            long_prompt = " ".join(f"tok{i}" for i in range(20))
+            occupiers = [
+                asyncio.create_task(_post_chat(
+                    s, service.port, long_prompt, "high", f"occ-{i}"))
+                for i in range(2)
+            ]
+            for _ in range(100):  # until both are admitted and streaming
+                await asyncio.sleep(0.01)
+                if admission.inflight == 2:
+                    break
+            assert admission.inflight == 2
+
+            # ---- spike: 6 low + 4 high land together; 0 free slots ----
+            spike = [
+                _post_chat(s, service.port, prompt, "low", f"low-{i}")
+                for i in range(6)
+            ] + [
+                _post_chat(s, service.port, prompt, "high", f"high-{i}")
+                for i in range(4)
+            ]
+            tasks = [asyncio.create_task(c) for c in spike]
+            for _ in range(100):  # until the whole spike is queued
+                await asyncio.sleep(0.01)
+                if admission.queue_depth() == 10:
+                    break
+            assert admission.queue_depth() == 10
+
+            # planner observes the saturation and acts: shed + scale-up
+            actions = await planner.step()
+            assert any(isinstance(a, ScaleAction) for a in actions)
+            assert policy.shed_level >= 1
+            assert admission.shed_level >= 1
+
+            results = await asyncio.gather(*tasks)
+            low_results, high_results = results[:6], results[6:]
+            for status, _raw, _t, _ra in await asyncio.gather(*occupiers):
+                assert status == 200
+            # the admission limit actually bounded engine concurrency
+            assert engine.peak_active <= 2
+
+            # only the lowest class shed: every queued low got 429 +
+            # Retry-After, every high completed
+            for status, raw, _, retry_after in low_results:
+                assert status == 429
+                assert retry_after is not None and int(retry_after) >= 1
+                assert b"shed" in raw or b"saturated" in raw
+            for status, raw, ttft, _ in high_results:
+                assert status == 200
+                # queued TTFT under the configured admission deadline
+                assert ttft is not None and ttft < deadline_s
+                # byte-identical to the unloaded baseline stream
+                assert raw == baseline_raw
+
+            # the scale-up action landed as a replica patch in the
+            # in-memory cluster
+            dep = kube.objects["Deployment/serving/spike-decode"]
+            assert dep["spec"]["replicas"] == 2
+
+            # high priority was never shed
+            text = service.metrics.render()
+            assert 'priority="low",outcome="shed"' not in text  # label order
+            assert ('dynamo_planner_admissions_total{outcome="shed",'
+                    'priority="low"}') in text
+            assert 'outcome="shed",priority="high"' not in text
+            assert 'outcome="timeout"' not in text
+
+            # decisions auditable in the flight ring: shed events carry
+            # the request ids, and the planner action timeline is there
+            events = flight.snapshot()
+            shed_ids = {e.get("request_id") for e in events
+                        if e["kind"] == "planner.shed"}
+            assert any(rid and rid.startswith("low-") for rid in shed_ids)
+            assert not any(rid and rid.startswith("high-")
+                           for rid in shed_ids)
+            assert any(e["kind"] == "planner.action"
+                       and e["data"]["action"] == "scale"
+                       for e in events)
+
+            # after the spike drains, recovery: relax the gate and a
+            # fresh low-priority request is admitted again
+            admission.set_shed_level(0)
+            status, raw, _, _ = await _post_chat(
+                s, service.port, prompt, "low", "recovered-0")
+            assert status == 200 and raw == baseline_raw
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_http_service_without_admission_unchanged():
+    """No admission controller configured → no 429 path, no header
+    requirement (the default construction stays byte-compatible)."""
+    engine = SlowDeterministicEngine(token_delay_s=0.0)
+    manager = ModelManager()
+    manager.add_chat_model("slow", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "slow",
+                      "messages": [{"role": "user", "content": "hi there"}],
+                      "stream": True},
+            ) as r:
+                assert r.status == 200
+                await r.read()
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_timed_out_waiters_leave_the_queue():
+    """A sustained retry storm (every client re-queueing each deadline)
+    must not accumulate abandoned waiter objects in the deques."""
+    ac = AdmissionController(
+        AdmissionConfig(limit=1, queue_depth=4, queue_timeout_s=0.02),
+        flight=FlightRecorder(16))
+    await ac.acquire(1)  # hold the only slot
+    for _ in range(10):
+        with pytest.raises(AdmissionRejected):
+            await ac.acquire(1)
+    # every timed-out waiter was discarded, not just flagged
+    assert sum(len(q) for q in ac._queues.values()) == 0
+    ac.release()
+    assert ac.inflight == 0

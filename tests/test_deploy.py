@@ -272,3 +272,14 @@ async def test_store_source_unreachable_returns_none():
     import asyncio
     loop = asyncio.get_running_loop()
     assert await loop.run_in_executor(None, client.get_crs) is None
+
+
+def test_render_planner_role():
+    """The planner control-plane pod renders like any other role and
+    observes the graph's own backend endpoint."""
+    cr = _cr(services={"planner": {"role": "planner"}})
+    by_name = {m["metadata"]["name"]: m for m in render_manifests(cr)}
+    cmd = by_name["g1-planner"]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "in=planner" in cmd
+    assert "--worker-endpoint" in cmd
+    assert "dyn://public.backend.generate" in cmd

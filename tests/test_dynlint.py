@@ -899,3 +899,66 @@ def test_enforcement_scan_is_not_vacuous():
     # the committed baseline's debt is real, live findings
     diff = diff_against_baseline(findings_all, load_baseline(BASELINE))
     assert len(diff.known) >= 1
+
+
+# --------------------------------------------------------------------------
+# closed-loop SLA planner: the control loop's own discipline
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_planner_modules_pass_async_blocking_and_task_leak():
+    """The planner loop is exactly the shape these rules police: a
+    periodic asyncio task that calls out to cluster clients (kubectl
+    subprocess, api-store REST — both MUST ride an executor) and that
+    stop() must be able to cancel (a leaked planner keeps scaling a
+    deployment nobody is watching). Pin the whole subsystem ZERO-finding,
+    not baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "planner", "planner.py"),
+        os.path.join(PACKAGE_ROOT, "planner", "policy.py"),
+        os.path.join(PACKAGE_ROOT, "planner", "signals.py"),
+        os.path.join(PACKAGE_ROOT, "planner", "admission.py"),
+        os.path.join(PACKAGE_ROOT, "planner", "actuation.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "planner loop discipline regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_kubectl_on_loop_shape():
+    """TP fixture shaped like a careless KubeActuator: the reconcile
+    (a kubectl subprocess under the hood) runs directly on the planner's
+    event loop, stalling every admission decision behind the API server."""
+    out = findings(
+        """
+        import subprocess
+
+        async def apply_scale(manifest):
+            subprocess.run(["kubectl", "apply", "-f", "-"], input=manifest)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+def test_task_leak_flags_planner_shaped_discarded_loop():
+    """TP fixture shaped like a careless planner: the observe→decide→
+    actuate task handle is dropped, so stop() can never cancel it and it
+    keeps patching replicas after shutdown."""
+    out = findings(
+        """
+        import asyncio
+
+        class Planner:
+            def start(self):
+                asyncio.create_task(self._loop())
+
+            async def _loop(self):
+                while True:
+                    await asyncio.sleep(2.0)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]

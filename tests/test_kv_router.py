@@ -214,3 +214,68 @@ async def test_recorder_and_replay(tmp_path):
     assert n == 2
     assert idx.find_matches(h).scores == {"w9": 1}
     await drt.close()
+
+
+# ---------- staleness-aware cost function ----------
+
+
+def test_scheduler_skips_stale_workers():
+    """A worker whose scrape stopped keeps its last (usually flattering)
+    snapshot forever; with a staleness bound the cost function stops
+    trusting it and routes to fresh workers even at worse load."""
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+
+    t = {"now": 0.0}
+    sched = KvScheduler(block_size=4, staleness_bound_s=2.0,
+                        clock=lambda: t["now"])
+    # the stale worker LOOKS idle; the fresh one looks loaded
+    sched.update_metrics("wedged", ForwardPassMetrics(
+        request_active_slots=0, request_total_slots=8,
+        kv_active_blocks=0, kv_total_blocks=100,
+    ))
+    sched.update_metrics("alive", ForwardPassMetrics(
+        request_active_slots=6, request_total_slots=8,
+        kv_active_blocks=50, kv_total_blocks=100,
+    ))
+    # both fresh: the idle-looking one wins on load
+    assert sched.schedule(16, OverlapScores()).worker_id == "wedged"
+
+    # only "alive" keeps scraping; "wedged" ages past the bound
+    t["now"] = 5.0
+    sched.update_metrics("alive", ForwardPassMetrics(
+        request_active_slots=6, request_total_slots=8,
+        kv_active_blocks=50, kv_total_blocks=100,
+    ))
+    d = sched.schedule(16, OverlapScores())
+    assert d.worker_id == "alive"
+    assert sched.stale_skips == 1
+
+
+def test_scheduler_all_stale_falls_back_to_routing():
+    """Every snapshot stale (scrape loop hiccup) → route on old data
+    rather than refusing every request."""
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+
+    t = {"now": 0.0}
+    sched = KvScheduler(block_size=4, staleness_bound_s=1.0,
+                        clock=lambda: t["now"])
+    sched.update_metrics("w1", ForwardPassMetrics(
+        request_active_slots=0, request_total_slots=8, kv_total_blocks=10,
+    ))
+    t["now"] = 60.0
+    d = sched.schedule(4, OverlapScores())
+    assert d.worker_id == "w1"
+    assert sched.stale_skips == 0  # fallback is not a skip
+
+
+def test_scheduler_without_bound_trusts_forever():
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+
+    t = {"now": 0.0}
+    sched = KvScheduler(block_size=4, clock=lambda: t["now"])
+    sched.update_metrics("w1", ForwardPassMetrics(
+        request_active_slots=0, request_total_slots=8, kv_total_blocks=10,
+    ))
+    t["now"] = 1e6
+    assert sched.schedule(4, OverlapScores()).worker_id == "w1"
+    assert sched.stale_skips == 0

@@ -51,10 +51,24 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_engine_xla_compile_duration_seconds",
         "dynamo_watchdog_trips_total",
         "dynamo_runtime_event_loop_lag_seconds",
+        # closed-loop SLA planner (planner/admission.py, planner/planner.py)
+        "dynamo_planner_admissions_total",
+        "dynamo_planner_queue_wait_seconds",
+        "dynamo_planner_admission_queue_depth_requests",
+        "dynamo_planner_inflight_requests",
+        "dynamo_planner_admission_limit_requests",
+        "dynamo_planner_shedding_info",
+        "dynamo_planner_actions_total",
+        "dynamo_planner_cycles_total",
+        "dynamo_planner_replica_target_replicas",
+        "dynamo_planner_shed_level_depth",
+        "dynamo_planner_local_prefill_threshold_tokens",
+        # staleness-aware KV routing (kv_router/router.py)
+        "dynamo_kv_router_stale_worker_skips_total",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 36
+    assert len(names) >= 48
 
 
 def _metric(name, kind):

@@ -1,0 +1,528 @@
+"""Closed-loop SLA planner: deterministic policy simulations + actuation.
+
+Scripted metric feeds through an injectable clock → pinned action
+sequences (hysteresis, cooldown, bounds), then the full loop: planner
+step → KubeActuator → Reconciler → InMemoryKube replica patch, and the
+local actuation paths (disagg router config, admission knobs,
+api-store record scaling).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.deploy import InMemoryKube, Reconciler
+from dynamo_tpu.planner import (
+    AdmissionAction,
+    AdmissionConfig,
+    AdmissionController,
+    KubeActuator,
+    LocalActuator,
+    Planner,
+    PolicyConfig,
+    RebalanceAction,
+    ScaleAction,
+    SignalStore,
+    SlaPolicy,
+    StoreScaleActuator,
+)
+from dynamo_tpu.telemetry.flight import FlightRecorder
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_policy(clock, **overrides):
+    defaults = dict(
+        window_s=10.0,
+        prefill_queue_wait_up_s=1.0,
+        prefill_queue_wait_down_s=0.1,
+        prefill_queue_depth_up=4.0,
+        decode_busy_up=0.9,
+        decode_busy_down=0.3,
+        decode_waiting_up=4.0,
+        min_replicas=1,
+        max_replicas=3,
+        scale_up_cooldown_s=30.0,
+        scale_down_cooldown_s=120.0,
+        rebalance_cooldown_s=30.0,
+        shed_step_cooldown_s=5.0,
+        relax_after_clear_s=30.0,
+    )
+    defaults.update(overrides)
+    return SlaPolicy(PolicyConfig(**defaults), clock=clock)
+
+
+# --------------------------------------------------------------------------
+# SignalStore
+# --------------------------------------------------------------------------
+
+
+def test_signal_store_window_aggregates():
+    clock = Clock()
+    store = SignalStore(window_s=100.0, clock=clock)
+    for i in range(5):
+        store.observe("x", float(i), t=float(i))
+    clock.t = 4.0
+    assert store.latest("x") == 4.0
+    assert store.mean("x") == 2.0
+    assert store.mean("x", window_s=2.0) == pytest.approx(3.0)  # t>=2: 2,3,4
+    assert store.max("x", window_s=2.0) == 4.0
+    assert store.delta("x") == 4.0
+    assert store.age("x") == 0.0
+    assert store.latest("missing", default=7.0) == 7.0
+    assert store.mean("missing") is None
+
+
+def test_signal_store_prunes_old_samples():
+    clock = Clock()
+    store = SignalStore(window_s=10.0, clock=clock)
+    store.observe("x", 1.0, t=0.0)
+    clock.t = 20.0
+    store.observe("x", 2.0)
+    # the t=0 sample fell out of the window entirely
+    assert store.mean("x") == 2.0
+    assert store.delta("x") == 0.0  # single sample left
+
+
+def test_signal_store_observe_many_skips_non_numeric():
+    store = SignalStore(clock=Clock())
+    store.observe_many({"a": 1, "b": "text", "c": True, "d": 2.5})
+    assert store.latest("a") == 1.0
+    assert store.latest("d") == 2.5
+    assert store.latest("b") is None and store.latest("c") is None
+
+
+# --------------------------------------------------------------------------
+# policy: scale with hysteresis, cooldown, bounds
+# --------------------------------------------------------------------------
+
+
+def test_prefill_scale_up_sequence_with_cooldown_and_max():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    replicas = {"prefill": 1, "decode": 1}
+
+    signals.observe("prefill.queue_wait_s", 2.0)
+    (a,) = policy.decide(signals, replicas)
+    assert isinstance(a, ScaleAction)
+    assert (a.role, a.current_replicas, a.target_replicas) == ("prefill", 1, 2)
+    assert a.direction == "up"
+    replicas["prefill"] = 2
+
+    # still hot 5s later: cooldown holds the second step back
+    clock.advance(5.0)
+    signals.observe("prefill.queue_wait_s", 2.0)
+    assert policy.decide(signals, replicas) == []
+
+    # past the cooldown: next step lands
+    clock.advance(30.0)
+    signals.observe("prefill.queue_wait_s", 2.0)
+    (a2,) = policy.decide(signals, replicas)
+    assert (a2.current_replicas, a2.target_replicas) == (2, 3)
+    replicas["prefill"] = 3
+
+    # at max_replicas: no action, and no cooldown burned
+    clock.advance(31.0)
+    signals.observe("prefill.queue_wait_s", 2.0)
+    assert policy.decide(signals, replicas) == []
+
+
+def test_prefill_hysteresis_dead_zone_and_scale_down():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    replicas = {"prefill": 2}
+
+    # between the down (0.1) and up (1.0) thresholds: nothing moves
+    signals.observe("prefill.queue_wait_s", 0.5)
+    signals.observe("prefill.queue_depth", 0.0)
+    assert policy.decide(signals, replicas) == []
+
+    # idle → scale down (advance past the window so the dead-zone
+    # sample no longer drags the mean above the down threshold)
+    clock.advance(11.0)
+    signals.observe("prefill.queue_wait_s", 0.01)
+    signals.observe("prefill.queue_depth", 0.0)
+    (a,) = policy.decide(signals, replicas)
+    assert isinstance(a, ScaleAction)
+    assert (a.direction, a.target_replicas) == ("down", 1)
+    replicas["prefill"] = 1
+
+    # min_replicas floor: no further down even after the long cooldown
+    clock.advance(121.0)
+    signals.observe("prefill.queue_wait_s", 0.01)
+    signals.observe("prefill.queue_depth", 0.0)
+    assert policy.decide(signals, replicas) == []
+
+
+def test_scale_down_waits_for_its_longer_cooldown():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    replicas = {"decode": 2}
+
+    signals.observe("decode.slot_busy_ratio", 0.95)
+    (up,) = policy.decide(signals, replicas)
+    assert up.direction == "up"
+    replicas["decode"] = 3
+
+    # load vanishes immediately; up-cooldown (30s) has passed but the
+    # down-cooldown (120s) has not — the capacity is kept
+    clock.advance(40.0)
+    signals.observe("decode.slot_busy_ratio", 0.0)
+    signals.observe("decode.waiting", 0.0)
+    assert policy.decide(signals, replicas) == []
+
+    clock.advance(120.0)
+    signals.observe("decode.slot_busy_ratio", 0.0)
+    (down,) = policy.decide(signals, replicas)
+    assert down.direction == "down" and down.target_replicas == 2
+
+
+def test_unknown_role_never_scales():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    signals.observe("prefill.queue_wait_s", 5.0)
+    assert policy.decide(signals, {}) == []  # role not deployed
+
+
+# --------------------------------------------------------------------------
+# policy: disagg rebalance
+# --------------------------------------------------------------------------
+
+
+def test_rebalance_moves_threshold_both_ways_with_cooldown():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    assert policy.local_prefill_length == 1000
+
+    # prefill queue backed up, decode has headroom → keep more local
+    signals.observe("prefill.queue_depth", 5.0)
+    signals.observe("decode.slot_busy_ratio", 0.5)
+    actions = policy.decide(signals, {})
+    (reb,) = [a for a in actions if isinstance(a, RebalanceAction)]
+    assert reb.max_local_prefill_length == 2000
+    assert policy.local_prefill_length == 2000
+
+    # cooldown: same pressure, no second move
+    clock.advance(5.0)
+    signals.observe("prefill.queue_depth", 5.0)
+    signals.observe("decode.slot_busy_ratio", 0.5)
+    assert [a for a in policy.decide(signals, {})
+            if isinstance(a, RebalanceAction)] == []
+
+    # decode saturated, queue drained → send more remote (back down)
+    clock.advance(31.0)
+    signals.observe("prefill.queue_depth", 0.0)
+    signals.observe("decode.slot_busy_ratio", 0.95)
+    actions = policy.decide(signals, {})
+    (reb2,) = [a for a in actions if isinstance(a, RebalanceAction)]
+    assert reb2.max_local_prefill_length == 1000
+
+
+def test_rebalance_clamps_to_bounds():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock, max_local_prefill_length=1500)
+    signals.observe("prefill.queue_depth", 5.0)
+    signals.observe("decode.slot_busy_ratio", 0.5)
+    (reb,) = [a for a in policy.decide(signals, {})
+              if isinstance(a, RebalanceAction)]
+    assert reb.max_local_prefill_length == 1500  # clamped, not 2000
+
+
+# --------------------------------------------------------------------------
+# policy: admission shed/relax ladder
+# --------------------------------------------------------------------------
+
+
+def test_admission_shed_ladder_and_relax():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+
+    # watchdog trip counter moves → saturated → shed level 1
+    signals.observe("watchdog.trips", 0.0)
+    clock.advance(1.0)
+    signals.observe("watchdog.trips", 1.0)
+    (a,) = policy.decide(signals, {})
+    assert isinstance(a, AdmissionAction) and a.shed_level == 1
+
+    # still saturated inside the step cooldown: hold
+    clock.advance(1.0)
+    signals.observe("watchdog.trips", 2.0)
+    assert policy.decide(signals, {}) == []
+
+    # past the step cooldown and still tripping: level 2 (the max —
+    # the highest class is never shed)
+    clock.advance(6.0)
+    signals.observe("watchdog.trips", 3.0)
+    (a2,) = policy.decide(signals, {})
+    assert a2.shed_level == 2
+    clock.advance(6.0)
+    signals.observe("watchdog.trips", 4.0)
+    assert policy.decide(signals, {}) == []  # capped
+
+    # trips stop; once the window slides past them the plane reads clear
+    clock.advance(15.0)  # old trip samples age out of the 10s window
+    signals.observe("watchdog.trips", 4.0)
+    assert policy.decide(signals, {}) == []  # first clear pass only arms
+    clock.advance(31.0)  # relax_after_clear_s elapsed
+    signals.observe("watchdog.trips", 4.0)
+    (r1,) = policy.decide(signals, {})
+    assert isinstance(r1, AdmissionAction) and r1.shed_level == 1
+    clock.advance(31.0)
+    signals.observe("watchdog.trips", 4.0)
+    (r2,) = policy.decide(signals, {})
+    assert r2.shed_level == 0
+
+
+def test_admission_sheds_on_kv_and_busy_saturation():
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock, saturation_kv_usage=0.95,
+                         saturation_busy=0.95, saturation_waiting=3.0)
+    signals.observe("kv.usage_ratio", 0.99)
+    (a,) = policy.decide(signals, {})
+    assert isinstance(a, AdmissionAction) and "kv usage" in a.reason
+
+    policy2 = make_policy(clock, saturation_busy=0.95, saturation_waiting=3.0)
+    signals2 = SignalStore(clock=clock)
+    signals2.observe("decode.slot_busy_ratio", 0.99)
+    signals2.observe("decode.waiting", 5.0)
+    actions = policy2.decide(signals2, {})
+    sheds = [a for a in actions if isinstance(a, AdmissionAction)]
+    assert len(sheds) == 1 and sheds[0].shed_level == 1
+
+
+# --------------------------------------------------------------------------
+# planner loop → actuators
+# --------------------------------------------------------------------------
+
+
+def _cr(prefill=1, decode=1):
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoTpuGraphDeployment",
+        "metadata": {"name": "g1", "namespace": "serving", "uid": "u-1"},
+        "spec": {
+            "image": "dynamo-tpu:test",
+            "namespace": "public",
+            "services": {
+                "prefill": {"role": "prefill", "replicas": prefill,
+                            "modelPath": "/m"},
+                "decode": {"role": "decode", "replicas": decode,
+                           "modelPath": "/m"},
+            },
+        },
+    }
+
+
+@pytest.mark.asyncio
+async def test_planner_scale_up_lands_in_inmemory_kube():
+    clock = Clock()
+    kube = InMemoryKube()
+    actuator = KubeActuator(Reconciler(kube), _cr())
+    flight = FlightRecorder(64)
+    planner = Planner(
+        policy=make_policy(clock),
+        sources=[lambda: {"prefill.queue_wait_s": 3.0,
+                          "prefill.queue_depth": 6.0}],
+        actuators=[actuator],
+        flight=flight,
+        clock=clock,
+    )
+    actions = await planner.step()
+    scale = [a for a in actions if isinstance(a, ScaleAction)]
+    assert scale and scale[0].role == "prefill"
+    dep = kube.objects["Deployment/serving/g1-prefill"]
+    assert dep["spec"]["replicas"] == 2
+    assert planner.actions_applied  # audit trail
+    # the actuator reports the patched CR's replica map back to policy
+    assert actuator.replicas() == {"prefill": 2, "decode": 1}
+    # decision is auditable: metric + flight event
+    text = planner.registry.render()
+    assert ('dynamo_planner_replica_target_replicas{role="prefill"} 2'
+            in text)
+    assert 'kind="scale_up"' in text
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "planner.action" in kinds
+
+    # second cycle inside the cooldown: no further patch
+    clock.advance(1.0)
+    await planner.step()
+    assert kube.objects["Deployment/serving/g1-prefill"]["spec"]["replicas"] == 2
+
+
+@pytest.mark.asyncio
+async def test_planner_survives_broken_source_and_actuator():
+    clock = Clock()
+
+    class ExplodingActuator:
+        async def apply(self, action):
+            raise RuntimeError("boom")
+
+    planner = Planner(
+        policy=make_policy(clock),
+        sources=[lambda: 1 / 0,
+                 lambda: {"prefill.queue_wait_s": 3.0}],
+        actuators=[ExplodingActuator()],
+        replicas=lambda: {"prefill": 1},
+        flight=FlightRecorder(16),
+        clock=clock,
+    )
+    actions = await planner.step()  # must not raise
+    assert [a for a in actions if isinstance(a, ScaleAction)]
+    assert planner.actions_applied == []  # nothing claimed the action
+    assert 'applied="false"' in planner.registry.render()
+
+
+@pytest.mark.asyncio
+async def test_local_actuator_rebalances_router_and_admission():
+    from dynamo_tpu.disagg.router import DisaggRouter
+
+    router = DisaggRouter(max_local_prefill_length=1000,
+                          max_prefill_queue_size=2)
+    admission = AdmissionController(
+        AdmissionConfig(limit=4), flight=FlightRecorder(16))
+    actuator = LocalActuator(disagg_router=router, admission=admission)
+
+    assert await actuator.apply(RebalanceAction(
+        max_local_prefill_length=2000, max_prefill_queue_size=3, reason="t"))
+    assert router.max_local_prefill_length == 2000
+    assert router.max_prefill_queue_size == 3
+
+    assert await actuator.apply(AdmissionAction(
+        shed_level=1, limit=8, reason="t"))
+    assert admission.shed_level == 1 and admission.limit == 8
+    # limit=None leaves the configured limit alone
+    assert await actuator.apply(AdmissionAction(
+        shed_level=0, limit=None, reason="t"))
+    assert admission.shed_level == 0 and admission.limit == 8
+
+    # an unhandled action type is declined, not swallowed
+    assert not await actuator.apply(ScaleAction(
+        role="decode", target_replicas=2, current_replicas=1, reason="t"))
+
+
+@pytest.mark.asyncio
+async def test_store_scale_actuator_patches_record():
+    class FakeStore:
+        def __init__(self):
+            self.rec = {"name": "g1", "spec": {
+                "services": {"decode": {"role": "decode", "replicas": 1}}}}
+
+        def get(self, name):
+            return self.rec if name == "g1" else None
+
+        def update(self, name, spec):
+            self.rec = {"name": name, "spec": spec}
+
+    store = FakeStore()
+    actuator = StoreScaleActuator(store, "g1")
+    assert await actuator.apply(ScaleAction(
+        role="decode", target_replicas=3, current_replicas=1, reason="t"))
+    assert store.rec["spec"]["services"]["decode"]["replicas"] == 3
+    assert await actuator.replicas() == {"decode": 3}
+    # unknown deployment: declined, no crash
+    missing = StoreScaleActuator(store, "nope")
+    assert not await missing.apply(ScaleAction(
+        role="decode", target_replicas=2, current_replicas=1, reason="t"))
+
+
+@pytest.mark.asyncio
+async def test_planner_loop_runs_and_stops():
+    clock = Clock()
+    planner = Planner(
+        policy=make_policy(clock),
+        sources=[lambda: {"prefill.queue_wait_s": 0.0}],
+        flight=FlightRecorder(16),
+        clock=clock,
+    )
+    planner.config.interval_s = 0.01
+    planner.start()
+    await asyncio.sleep(0.05)
+    planner.stop()
+    assert planner._task is None
+    text = planner.registry.render()
+    assert "dynamo_planner_cycles_total" in text
+
+
+# --------------------------------------------------------------------------
+# review hardening regressions
+# --------------------------------------------------------------------------
+
+
+def test_prefill_scales_up_on_depth_alone():
+    """The standalone planner often has ONLY the queue-depth poll (the
+    wait histogram lives on the workers) — depth must be an independent
+    trigger, not AND-gated on a signal that never arrives."""
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock)
+    signals.observe("prefill.queue_depth", 10.0)
+    (a,) = policy.decide(signals, {"prefill": 1})
+    assert isinstance(a, ScaleAction)
+    assert (a.role, a.direction) == ("prefill", "up")
+
+
+def test_saturation_from_admission_signals_alone():
+    """Pure-frontend planner (in=http out=none --planner): the edge's
+    own state — deep admission queue at full concurrency — must read as
+    saturation even with no engine/aggregator signal wired."""
+    clock = Clock()
+    signals = SignalStore(clock=clock)
+    policy = make_policy(clock, saturation_admission_queue=4.0)
+    signals.observe("admission.queue_depth", 8.0)
+    signals.observe("admission.inflight_ratio", 1.0)
+    (a,) = policy.decide(signals, {})
+    assert isinstance(a, AdmissionAction) and a.shed_level == 1
+    assert "admission queue" in a.reason
+
+
+def test_signal_latest_goes_blind_past_the_window():
+    """A source that stopped reporting must not serve its last value
+    forever — the policy should skip a dead signal, not act on it."""
+    clock = Clock()
+    store = SignalStore(window_s=10.0, clock=clock)
+    store.observe("prefill.queue_depth", 7.0)
+    assert store.latest("prefill.queue_depth") == 7.0
+    clock.advance(11.0)
+    assert store.latest("prefill.queue_depth") is None
+    assert store.latest("prefill.queue_depth", 0.0) == 0.0
+
+
+@pytest.mark.asyncio
+async def test_unapplied_action_rolls_back_policy_state():
+    """An action no actuator claims must not drift the policy's pacing
+    state: the shed level stays where reality is, and the decision
+    retries next cycle instead of silently relaxing later."""
+    clock = Clock()
+    policy = make_policy(clock)
+    planner = Planner(
+        policy=policy,
+        sources=[lambda: {"kv.usage_ratio": 0.99}],
+        actuators=[],  # nobody to apply the shed
+        flight=FlightRecorder(16),
+        clock=clock,
+    )
+    actions = await planner.step()
+    assert any(isinstance(a, AdmissionAction) for a in actions)
+    assert policy.shed_level == 0  # rolled back — nothing actually shed
+    clock.advance(6.0)
+    actions2 = await planner.step()  # retried, not escalated
+    sheds = [a for a in actions2 if isinstance(a, AdmissionAction)]
+    assert sheds and sheds[0].shed_level == 1
+    assert policy.shed_level == 0  # still unapplied, still rolled back

@@ -383,3 +383,150 @@ async def test_jail_splits_logprob_entries_at_marker_boundary():
     assert final.choices[0].finish_reason == "tool_calls"
     held = final.choices[0].logprobs.content
     assert [e.token for e in held] == ["<tool_call>", call, "</tool_call>"]
+
+
+# ---------- forced tool_choice (the delta.rs:131 leftover) ----------
+
+
+def _chat_req(**kw):
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+
+    return ChatCompletionRequest(
+        model="m", messages=[{"role": "user", "content": "x"}], **kw
+    )
+
+
+def test_tool_choice_validation_rejects_bad_forms():
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.runtime.engine import EngineError
+
+    tools = [{"type": "function", "function": {"name": "f"}}]
+    validate = OpenAIPreprocessor._validate_tool_choice
+
+    # valid forms pass
+    validate(_chat_req(tools=tools))
+    validate(_chat_req(tools=tools, tool_choice="auto"))
+    validate(_chat_req(tools=tools, tool_choice="none"))
+    validate(_chat_req(tools=tools, tool_choice="required"))
+    validate(_chat_req(tools=tools, tool_choice={
+        "type": "function", "function": {"name": "f"}}))
+
+    # a named function must exist in tools — 400 at the door, not a
+    # wasted generation
+    with pytest.raises(EngineError, match="not in tools"):
+        validate(_chat_req(tools=tools, tool_choice={
+            "type": "function", "function": {"name": "g"}}))
+    with pytest.raises(EngineError, match="name is required"):
+        validate(_chat_req(tools=tools, tool_choice={
+            "type": "function", "function": {}}))
+    with pytest.raises(EngineError, match="must be"):
+        validate(_chat_req(tools=tools, tool_choice={"type": "tool"}))
+    with pytest.raises(EngineError, match="unsupported tool_choice"):
+        validate(_chat_req(tools=tools, tool_choice="sometimes"))
+    with pytest.raises(EngineError, match="needs tools"):
+        validate(_chat_req(tool_choice="required"))
+
+
+@pytest.mark.asyncio
+async def test_tool_jail_withholds_from_token_zero():
+    """Forced tool_choice (required / named) jails from token 0: nothing
+    streams while the call is being generated — the disobedient-prose
+    case flushes once at the end as a single content chunk instead of
+    streaming incrementally."""
+    pre = _mk_preprocessor()
+    # json format + prose that would NOT trigger the leading-{ jail:
+    # without tool_jail this streams as two incremental content chunks
+    stream = await _fake_backend(["Hello ", "world"])
+    chunks = [
+        c async for c in pre.chat_stream(
+            "idj", "m", stream, prompt_tokens=1, tool_format="json",
+            tool_jail=True,
+        )
+    ]
+    content = [
+        c.choices[0].delta.content for c in chunks
+        if c.choices and c.choices[0].delta.content
+    ]
+    assert content == ["Hello world"]  # one flush, not incremental
+    assert chunks[-1].choices[0].finish_reason == "stop"
+
+    # same feed WITHOUT the jail: prose streams as it is generated
+    stream2 = await _fake_backend(["Hello ", "world"])
+    chunks2 = [
+        c async for c in pre.chat_stream(
+            "idk", "m", stream2, prompt_tokens=1, tool_format="json",
+        )
+    ]
+    content2 = [
+        c.choices[0].delta.content for c in chunks2
+        if c.choices and c.choices[0].delta.content
+    ]
+    assert content2 == ["Hello ", "world"]
+
+
+@pytest.mark.asyncio
+async def test_tool_jail_parses_forced_call():
+    pre = _mk_preprocessor()
+    stream = await _fake_backend(
+        ['{"name": "f", "argum', 'ents": {"k": 1}}']
+    )
+    chunks = [
+        c async for c in pre.chat_stream(
+            "idf", "m", stream, prompt_tokens=1, tool_format="json",
+            tool_jail=True,
+        )
+    ]
+    final = chunks[-1]
+    assert final.choices[0].finish_reason == "tool_calls"
+    resp = aggregate_chat_stream(chunks)
+    call = resp.choices[0].message.tool_calls[0]
+    assert call["function"]["name"] == "f"
+    assert json.loads(call["function"]["arguments"]) == {"k": 1}
+
+
+@pytest.mark.asyncio
+async def test_generate_plumbs_tool_jail_for_forced_choice():
+    """tool_choice='required' / named → generate() passes tool_jail to
+    chat_stream (observed through the single-flush behavior above)."""
+    from unittest import mock
+
+    pre = _mk_preprocessor()
+    req = _chat_req(
+        tools=[{"type": "function", "function": {"name": "f"}}],
+        tool_choice="required", stream=True,
+    )
+    pre.mdc.tool_call_format = "json"
+    seen = {}
+
+    async def fake_stream(*a, **kw):
+        seen.update(kw)
+        return
+        yield  # pragma: no cover
+
+    with mock.patch.object(pre, "preprocess_chat") as pc, \
+            mock.patch.object(pre, "chat_stream", side_effect=fake_stream):
+        from dynamo_tpu.protocols.common import (
+            OutputOptions,
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        pc.return_value = PreprocessedRequest(
+            token_ids=[1], stop_conditions=StopConditions(max_tokens=4),
+            sampling_options=SamplingOptions(),
+            output_options=OutputOptions(), model="m",
+        )
+
+        class _Next:
+            def generate(self, ctx):
+                async def g():
+                    return
+                    yield  # pragma: no cover
+                return g()
+
+        from dynamo_tpu.runtime.engine import Context
+
+        [c async for c in pre.generate(Context(req), _Next())]
+    assert seen.get("tool_format") == "json"
+    assert seen.get("tool_jail") is True
