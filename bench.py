@@ -47,7 +47,7 @@ METRIC = "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512"
 
 
 def run_once(attention_impl: str, burst: int = 1,
-             pipeline: bool = False) -> dict:
+             pipeline: bool = False, persistent: bool = False) -> dict:
     import os
 
     import jax
@@ -107,7 +107,37 @@ def run_once(attention_impl: str, burst: int = 1,
     slot_mapping = (block_tables[:, ctx // bs] * bs + ctx % bs)[:, None]
     context_lens = jnp.full((b,), ctx + 1, jnp.int32)
 
-    if burst > 1:
+    if burst > 1 and persistent:
+        # the engine's persistent decode loop (device_finish): the fused
+        # K-step burst additionally carries a per-row done mask and runs
+        # the stop-token membership check each step — the on-device
+        # finish detection the serving scheduler uses to chain bursts
+        # without a per-burst host barrier. The stop set here is chosen
+        # never to hit (token ids are < vocab), so the chain runs full
+        # length while paying the real per-step check cost.
+        stop_ids = jnp.full((b, 8), mcfg.vocab_size + 1, jnp.int32)
+
+        def decode_burst_df(params, k_cache, v_cache, tok0, done0):
+            def one(carry, _):
+                k_cache, v_cache, toks, done = carry
+                nt, k_cache, v_cache = decode_step(
+                    params, k_cache, v_cache, toks[:, None], positions,
+                    slot_mapping, context_lens,
+                )
+                nt = jnp.where(done, toks, nt)  # frozen rows hold
+                done = done | (nt[:, None] == stop_ids).any(axis=1)
+                return (k_cache, v_cache, nt, done), None
+            (k_cache, v_cache, nt, done), _ = jax.lax.scan(
+                one, (k_cache, v_cache, tok0, done0), None, length=burst
+            )
+            return nt, done, k_cache, v_cache
+        step = jax.jit(decode_burst_df, donate_argnums=(1, 2))
+        done0 = jnp.zeros((b,), jnp.bool_)
+
+        def dispatch(out, k, v):
+            nt, _done, k, v = step(params, k, v, out, done0)
+            return nt, k, v
+    elif burst > 1:
         # the engine's multi_step_decode path: K steps fused into one
         # dispatch via lax.scan (steady-state position, same per-token
         # work) — measures how much of the per-dispatch overhead the
@@ -138,7 +168,25 @@ def run_once(attention_impl: str, burst: int = 1,
 
     n_steps = (4 * burst) if smoke else 64
     t0 = time.perf_counter()
-    if pipeline:
+    if persistent:
+        # the engine's persistent decode loop: bursts dispatch
+        # back-to-back off the device-resident carry (finish detection
+        # rides inside the program — no per-burst verdict needed on the
+        # host), while a drain thread syncs every burst's tokens to the
+        # host — as serving must stream them — WITHOUT ever gating the
+        # next dispatch. Compare against xla:k8:pipelined (per-burst
+        # sync overlapped but still completing before dispatch k+2) and
+        # xla:k8 (never syncs, the unreachable upper bound).
+        import concurrent.futures as _cf
+
+        with _cf.ThreadPoolExecutor(max_workers=1) as drain:
+            drains = []
+            for _ in range(n_steps // burst):
+                out, k_cache, v_cache = dispatch(out, k_cache, v_cache)
+                drains.append(drain.submit(np.asarray, out))
+            for f in drains:
+                f.result()
+    elif pipeline:
         # the engine's dispatch-ahead decode loop
         # (EngineConfig.decode_pipeline_depth=2): every burst's sampled
         # tokens ARE synced to the host (the serving engine must stream
@@ -220,8 +268,12 @@ def _relay_probe(timeout_s: float = 45.0) -> str:
     The host's compile service is shared and serializes; a wedged Mosaic
     compile (observed rounds 2 and 4) blocks EVERY process's compiles,
     including trivial XLA ones. Returns ``"alive"``, ``"wedged"`` (child
-    hung — drain-waiting may heal it), or ``"crashed"`` (child failed
-    fast — deterministic breakage a wait cannot fix).
+    hung — drain-waiting may heal it), ``"crashed"`` (child failed
+    fast — deterministic breakage a wait cannot fix), or ``"cpu-only"``
+    (the child came up on the CPU backend: the relay "healed" into a
+    fallback that would measure CPU numbers and report them as the chip
+    metric — observed round 7; banking the recorded number is the only
+    honest output there).
     """
     import subprocess
     import sys
@@ -230,7 +282,8 @@ def _relay_probe(timeout_s: float = 45.0) -> str:
             "p = os.environ.get('JAX_PLATFORMS'); "
             "p and jax.config.update('jax_platforms', p); "
             "import jax.numpy as jnp; x = jnp.ones((128, 128)); "
-            "print('RELAY_ALIVE', float((x @ x).sum()))")
+            "print('RELAY_ALIVE', jax.default_backend(), "
+            "float((x @ x).sum()))")
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -238,11 +291,16 @@ def _relay_probe(timeout_s: float = 45.0) -> str:
         )
     except subprocess.TimeoutExpired:
         return "wedged"
-    return "alive" if "RELAY_ALIVE" in proc.stdout else "crashed"
+    for line in proc.stdout.splitlines():
+        if line.startswith("RELAY_ALIVE"):
+            backend = (line.split() + ["?", "?"])[1]
+            return "cpu-only" if backend == "cpu" else "alive"
+    return "crashed"
 
 
 def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1,
-                         pipeline: bool = False, label: str = ""):
+                         pipeline: bool = False, persistent: bool = False,
+                         label: str = ""):
     """Run one bench attempt in a child process with a hard timeout.
 
     A Mosaic compile can (rarely) hang rather than fail; an in-process
@@ -258,11 +316,13 @@ def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1,
     code = (
         "import json; from bench import run_once; "
         "print('BENCH_RESULT ' + json.dumps("
-        f"run_once({impl!r}, {burst}, pipeline={pipeline})))"
+        f"run_once({impl!r}, {burst}, pipeline={pipeline}, "
+        f"persistent={persistent})))"
     )
     t0 = time.monotonic()
     rec = {"label": label, "impl": impl, "burst": burst,
-           "pipeline": pipeline, "timeout_s": round(timeout_s, 1)}
+           "pipeline": pipeline, "persistent": persistent,
+           "timeout_s": round(timeout_s, 1)}
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -337,12 +397,29 @@ def main() -> None:
             if health == "alive":
                 print("relay recovered; proceeding", flush=True)
                 break
-            if health == "crashed":
-                # wedge became deterministic breakage; waiting can't heal
+            if health in ("crashed", "cpu-only"):
+                # wedge became deterministic breakage (crashed) or healed
+                # into the CPU fallback (cpu-only, banked below either
+                # way); more drain-waiting can't change the verdict
                 break
     if health == "crashed":
         print("relay preflight failed fast (device init error, not a "
               "wedge); attempting anyway", flush=True)
+    if health == "cpu-only" and not os.environ.get("BENCH_SMOKE"):
+        # no accelerator visible: every attempt would "succeed" on CPU
+        # and report garbage as the chip metric, silently replacing the
+        # real banked measurement — bank instead. (BENCH_SMOKE runs are
+        # logic checks on tiny shapes and keep going on CPU on purpose.)
+        print("relay preflight came up on the CPU backend (no chip "
+              "visible); banking the recorded number instead of "
+              "measuring CPU garbage", flush=True)
+        best = banked_fallback()
+        best["error"] = ("no accelerator visible (cpu-only backend); "
+                         "the chip metric cannot be measured here")
+        _log_attempt({"label": "banked-cpu-only", "result": best})
+        _log_attempt({"label": "winner", "result": best})
+        print(json.dumps(best))
+        return
     if health == "wedged":
         # still wedged after the drain window: every live attempt would
         # time out — bank the last real-hardware number IMMEDIATELY
@@ -427,6 +504,21 @@ def main() -> None:
         if piped is not None and (best is None
                                   or piped["value"] > best["value"]):
             best = piped
+
+    # the persistent decode loop (device-resident finish + chained
+    # dispatch + async row drain): the serving scheduler's new shape
+    # under --device-finish. Strictly more overlap than :pipelined —
+    # dispatch never waits for ANY burst's host sync to complete.
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 360 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        persist = _run_impl_subprocess(
+            "xla", timeout_s=min(300.0, remaining - 240), burst=8,
+            persistent=True, label="xla:k8:persistent",
+        )
+        note("xla:k8:persistent", persist)
+        if persist is not None and (best is None
+                                    or persist["value"] > best["value"]):
+            best = persist
 
     remaining = total_budget - (_time.monotonic() - t0)
     if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):

@@ -31,10 +31,13 @@ PREFIX = "dynamo_"
 # ("depth" added for structural stage-count gauges — the decode
 # pipeline's dispatch depth; same count family as slots/blocks.
 # "replicas" added with the SLA planner's replica-target gauge — worker
-# pool size is a first-class count unit in the deployment plane)
+# pool size is a first-class count unit in the deployment plane.
+# "length" added with the persistent decode loop's burst-chain gauge —
+# dispatches between host barriers; a structural count like depth, and
+# the Grafana panel derives p50/p99 via quantile_over_time)
 UNIT_SUFFIXES = (
     "total", "seconds", "bytes", "tokens", "blocks",
-    "requests", "slots", "ratio", "info", "depth", "replicas",
+    "requests", "slots", "ratio", "info", "depth", "replicas", "length",
 )
 BASE_UNITS = ("seconds", "bytes", "tokens")  # what a histogram may measure
 

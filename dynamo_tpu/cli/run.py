@@ -88,6 +88,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dispatch-ahead decode: 2 double-buffers bursts "
                         "(burst k+1 dispatches while the host streams "
                         "burst k's tokens); 0/1 = strictly synchronous")
+    p.add_argument("--device-finish", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="device-resident finish detection: the decode "
+                        "burst carries a per-row done mask (eos/stop/"
+                        "max-token checks inside the scan; finished rows "
+                        "freeze), so bursts chain back-to-back and "
+                        "completed rows drain asynchronously. auto = "
+                        "follow --decode-pipeline-depth >= 2")
     p.add_argument("--disagg-stream-depth", type=int, default=2,
                    help="streamed remote prefill: KV transfer frames in "
                         "flight on the prefill worker (2 double-buffers "

@@ -318,6 +318,19 @@ class EngineConfig:
     # synchronous path per pass. 0/1 = today's strictly-synchronous
     # behavior, 2 = double-buffered (the only pipelined depth).
     decode_pipeline_depth: int = 1
+    # device-resident finish detection (the persistent decode loop):
+    # "auto" | "on" | "off". When enabled, the fused decode burst carries
+    # a per-row ``done`` mask and evaluates EOS / hidden-stop /
+    # max-tokens / model-len checks INSIDE the scan — finished rows
+    # freeze (no further sampling or KV writes, padded emission) instead
+    # of ending the burst, so the scheduler dispatches bursts
+    # back-to-back off the device-resident carry and drains completed
+    # rows asynchronously, compacting batch membership only at natural
+    # barriers (admission, preemption, KV-OOM, drain). Rows needing
+    # host-side finish semantics (stop strings, guided decoding,
+    # speculative decoding, n>1) keep the per-burst sync path. "auto"
+    # engages with decode_pipeline_depth >= 2; "on" requires it.
+    device_finish: str = "auto"
     # n-gram (prompt-lookup) speculative decoding: propose up to K tokens
     # per decode step by matching the context's trailing n-gram against
     # its own history, then VERIFY all K+1 positions in one forward.
@@ -394,6 +407,32 @@ class EngineConfig:
         # already fully overlapped, and reconciliation lag grows with
         # every extra stage — clamp instead of failing
         self.decode_pipeline_depth = max(0, min(self.decode_pipeline_depth, 2))
+        if self.device_finish not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown device_finish {self.device_finish!r} "
+                "(auto | on | off)"
+            )
+        if self.device_finish == "on" and self.decode_pipeline_depth < 2:
+            # the chained dispatch only exists under the dispatch-ahead
+            # pipeline; an explicit "on" that silently never engaged
+            # would be worse than failing here
+            raise ValueError(
+                "device_finish='on' requires decode_pipeline_depth >= 2 "
+                "(the persistent loop rides the dispatch-ahead pipeline)"
+            )
+        if self.device_finish == "on" and (
+                self.spec_ngram_tokens or self.spec_draft_model):
+            # same rationale as the depth check: speculation is
+            # engine-static and unconditionally disables the chain
+            # (Scheduler._chain_ok), so an explicit "on" would silently
+            # never engage — per-request conditions (stop strings,
+            # guided, n>1) degrade at dispatch instead, as designed
+            raise ValueError(
+                "device_finish='on' is incompatible with speculative "
+                "decoding (spec_ngram_tokens / spec_draft_model): the "
+                "chained dispatch never engages while speculation is "
+                "configured — use device_finish='auto'"
+            )
         # one frame in flight is the serial floor; beyond two buys nothing
         # (the wire is busy continuously at 2) and unbounds host buffers
         self.disagg_stream_depth = max(1, min(self.disagg_stream_depth, 2))
@@ -430,6 +469,15 @@ class EngineConfig:
     @property
     def blocks_per_seq(self) -> int:
         return math.ceil(self.max_model_len / self.kv_block_size)
+
+    @property
+    def device_finish_enabled(self) -> bool:
+        """Resolved device-resident finish detection: explicit on/off,
+        auto follows the dispatch-ahead pipeline."""
+        if self.device_finish == "on":
+            return True
+        return (self.device_finish == "auto"
+                and self.decode_pipeline_depth >= 2)
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

@@ -432,6 +432,7 @@ class ModelRunner:
         """
         K = self.config.multi_step_decode
         self._burst = None
+        self._burst_df = None
         if K <= 1 and self.config.decode_pipeline_depth < 2:
             # the dispatch-ahead pipeline always runs through the burst
             # program (its carry keeps sampled tokens device-resident),
@@ -507,6 +508,102 @@ class ModelRunner:
                            self.state_sharding),
         )
 
+        if not self.config.device_finish_enabled:
+            return
+
+        # ---- the device-finish (persistent-loop) variant ----
+        #
+        # Same K-step scan, plus a per-row ``done`` carry and on-device
+        # finish state: EOS / hidden-stop membership ([B, STOP_ID_WIDTH]
+        # id matrix), per-row generated-token counters against min/max
+        # bounds, and the model-len horizon — evaluated each step by
+        # sampling.device_finish_mask, the exact mirror of
+        # Scheduler._check_finish. A row that finishes FREEZES: its KV
+        # slot goes to -1 (no writes), its sampling-penalty counts stop
+        # updating (``live`` gates _sample_and_logprobs' commit), its
+        # position/token/counter carries stop advancing, and its output
+        # lane emits -1 pads. The burst itself never ends early, so the
+        # scheduler can chain dispatches off the returned device carry
+        # (tokens/positions/gen/done) without any host round-trip.
+        from .sampling import device_finish_mask
+
+        max_len = self.config.max_model_len
+
+        def burst_df(params, k_cache, v_cache, counts, seen, bias,
+                     tokens0, positions0, gen0, done0, block_tables,
+                     samp, sample_slots, commit, want_top, stop_ids,
+                     min_new, max_new):
+            b = tokens0.shape[0]
+            rows = jnp.arange(b)
+
+            def one(carry, _step_i):
+                k_cache, v_cache, counts, toks, pos, gen, done = carry
+                live = jnp.logical_and(commit, jnp.logical_not(done))
+                slot = block_tables[rows, pos // bs] * bs + pos % bs
+                slot = jnp.where(live, slot, -1)
+                hidden, (k_cache, v_cache) = forward(
+                    params, (k_cache, v_cache), toks[:, None], pos[:, None],
+                    block_tables, slot[:, None], pos + 1,
+                )
+                # PRNG fold-in counter IS the carried generated count, so
+                # a frozen row's counter stops with it and a live row's
+                # matches the single-step path exactly
+                samp_i = _dc.replace(samp, counters=gen)
+                nt, lp, tv, ti, counts = _sample_and_logprobs(
+                    cfg, head(hidden[:, 0], params), samp_i, counts, seen,
+                    bias, sample_slots, live, want_top,
+                )
+                gen_n = gen + live.astype(jnp.int32)
+                newly = live & device_finish_mask(
+                    nt, gen_n, pos, stop_ids, min_new, max_new, max_len
+                )
+                done_n = done | newly
+                # the finishing token still emits (the host streams it);
+                # later steps of a frozen row emit -1 pads
+                out_tok = jnp.where(live, nt, -1)
+                out_lp = jnp.where(live, lp, 0.0)
+                adv = live & jnp.logical_not(newly)
+                toks_n = jnp.where(adv, nt, toks)
+                pos_n = jnp.where(adv, pos + 1, pos)
+                return ((k_cache, v_cache, counts, toks_n, pos_n, gen_n,
+                         done_n), (out_tok, out_lp, tv, ti))
+
+            init = (k_cache, v_cache, counts, tokens0, positions0, gen0,
+                    done0)
+            ((k_cache, v_cache, counts, tok_c, pos_c, gen_c, done_c),
+             (toks, lps, tvs, tis)) = jax.lax.scan(
+                one, init, jnp.arange(K)
+            )
+            return (toks, lps, tvs, tis, tok_c, pos_c, gen_c, done_c,
+                    k_cache, v_cache, counts, seen, bias)
+
+        self._burst_df = jax.jit(
+            burst_df,
+            donate_argnums=(1, 2, 3, 4, 5),
+            in_shardings=(
+                self.param_shardings,
+                self.cache_sharding, self.cache_sharding,
+                self.state_sharding, self.state_sharding, self.state_sharding,
+                batch_spec,                  # tokens0 [B]
+                batch_spec,                  # positions0 [B]
+                batch_spec,                  # gen0 [B]
+                batch_spec,                  # done0 [B]
+                batch2_spec,                 # block_tables [B, W]
+                samp_spec,
+                batch_spec,                  # sample_slots
+                batch_spec,                  # commit
+                repl,                        # want_top
+                batch2_spec,                 # stop_ids [B, E]
+                batch_spec,                  # min_new [B]
+                batch_spec,                  # max_new [B]
+            ),
+            out_shardings=(steps_spec, steps_spec, steps3_spec, steps3_spec,
+                           batch_spec, batch_spec, batch_spec, batch_spec,
+                           self.cache_sharding, self.cache_sharding,
+                           self.state_sharding, self.state_sharding,
+                           self.state_sharding),
+        )
+
     def decode_burst(
         self,
         tokens0: np.ndarray,       # [B] pending token per row
@@ -556,6 +653,73 @@ class ModelRunner:
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
         return toks, lps, tvs, tis
+
+    def decode_burst_chained(
+        self,
+        tokens0,                   # [B] np (chain start) or device carry
+        positions0,                # [B] likewise
+        gen0,                      # [B] generated-token counts, likewise
+        done0,                     # [B] bool done mask, likewise
+        block_tables: np.ndarray,  # [B, W]
+        temperature: np.ndarray,
+        top_k: np.ndarray,
+        top_p: np.ndarray,
+        *,
+        min_p: np.ndarray,
+        presence_penalty: np.ndarray,
+        frequency_penalty: np.ndarray,
+        repetition_penalty: np.ndarray,
+        seed_keys: np.ndarray,
+        commit: np.ndarray,        # [B] row is a (live) chain member
+        stop_ids: np.ndarray,      # [B, STOP_ID_WIDTH] -1-padded stop set
+        min_new: np.ndarray,       # [B] i32
+        max_new: np.ndarray,       # [B] i32
+        want_top: bool = False,
+    ):
+        """Run one K-step burst with device-resident finish detection.
+
+        Returns ``(toks, lps, tvs, tis, carry)`` with [K, B]-leading
+        output arrays (-1 pads past each row's finish) and ``carry`` the
+        next dispatch's device-resident ``(tokens, positions, gen,
+        done)`` — feed it straight back as the first four arguments to
+        chain bursts without a host round-trip.
+        """
+        b = block_tables.shape[0]
+        samp = SamplingParams(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+            min_p=jnp.asarray(min_p, jnp.float32),
+            presence_penalty=jnp.asarray(presence_penalty, jnp.float32),
+            frequency_penalty=jnp.asarray(frequency_penalty, jnp.float32),
+            repetition_penalty=jnp.asarray(repetition_penalty, jnp.float32),
+            keys=jnp.asarray(seed_keys, jnp.uint32),
+            counters=jnp.asarray(gen0, jnp.int32),  # carried in-scan
+        )
+        with self.compiles.track(
+            "decode_burst_df", f"b{b}_w{block_tables.shape[1]}"
+        ):
+            (toks, lps, tvs, tis, tok_c, pos_c, gen_c, done_c,
+             k, v, counts, seen, bias) = self._burst_df(
+                self.params, self.kv_cache[0], self.kv_cache[1],
+                self.sample_state[0], self.sample_state[1],
+                self.sample_state[2],
+                jnp.asarray(tokens0, jnp.int32),
+                jnp.asarray(positions0, jnp.int32),
+                jnp.asarray(gen0, jnp.int32),
+                jnp.asarray(done0, jnp.bool_),
+                jnp.asarray(block_tables, jnp.int32),
+                samp,
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.asarray(commit, jnp.bool_),
+                jnp.asarray(bool(want_top), jnp.bool_),
+                jnp.asarray(stop_ids, jnp.int32),
+                jnp.asarray(min_new, jnp.int32),
+                jnp.asarray(max_new, jnp.int32),
+            )
+        self.kv_cache = (k, v)
+        self.sample_state = (counts, seen, bias)
+        return toks, lps, tvs, tis, (tok_c, pos_c, gen_c, done_c)
 
     def step(
         self,
@@ -1100,6 +1264,29 @@ class ModelRunner:
                     repetition_penalty=np.ones(b, np.float32),
                     seed_keys=np.zeros((b, 2), np.uint32), counters=z1,
                     commit=np.zeros(b, bool), want_top=False,
+                )
+        # the device-finish burst variant over the same ladder (inert:
+        # commit all-False, so no row writes KV or counts); compiling it
+        # here keeps the persistent loop's first chain off the late-
+        # compile path exactly like the plain burst above
+        if getattr(self, "_burst_df", None) is not None:
+            from .sampling import STOP_ID_WIDTH
+
+            z1 = np.zeros(b, np.int32)
+            for w in self.config.kv_width_buckets():
+                self.decode_burst_chained(
+                    z1, z1, z1, np.zeros(b, bool),
+                    np.zeros((b, w), np.int32),
+                    np.zeros(b, np.float32), z1, np.ones(b, np.float32),
+                    min_p=np.zeros(b, np.float32),
+                    presence_penalty=np.zeros(b, np.float32),
+                    frequency_penalty=np.zeros(b, np.float32),
+                    repetition_penalty=np.ones(b, np.float32),
+                    seed_keys=np.zeros((b, 2), np.uint32),
+                    commit=np.zeros(b, bool),
+                    stop_ids=np.full((b, STOP_ID_WIDTH), -1, np.int32),
+                    min_new=z1, max_new=np.full(b, 1, np.int32),
+                    want_top=False,
                 )
         # the ngram-speculative verify shape (S = K+1 on decode-width
         # tables) over the same ladder

@@ -158,6 +158,52 @@ def sample(
     return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+# ---- device-resident finish detection (the persistent decode loop) ----
+#
+# The fused decode burst can evaluate EOS / hidden-stop / max-token /
+# model-len checks inside its scan and freeze finished rows instead of
+# ending the burst (model_runner._build_burst's device-finish variant).
+# The per-row stop-token set rides as a fixed-width id matrix; requests
+# whose set overflows the width stay on the host sync path (the
+# scheduler's admission-time "device-checkable" classification).
+
+STOP_ID_WIDTH = 8  # ids per row: eos ids + hidden stop ids, -1 padded
+
+
+def stop_id_row(eos_ids, hidden_ids, ignore_eos: bool) -> Optional[np.ndarray]:
+    """One request's device stop-token row: the merged eos (unless
+    suppressed) + hidden-stop id set, -1 padded to ``STOP_ID_WIDTH``.
+    Returns None when the set overflows the width — the request is not
+    device-checkable and must keep host-side finish checks."""
+    ids = set() if ignore_eos else {int(t) for t in (eos_ids or [])}
+    ids |= {int(t) for t in (hidden_ids or [])}
+    if len(ids) > STOP_ID_WIDTH:
+        return None
+    row = np.full(STOP_ID_WIDTH, -1, np.int32)
+    row[: len(ids)] = sorted(ids)
+    return row
+
+
+def device_finish_mask(
+    tokens: jax.Array,     # [B] i32 the step's sampled tokens
+    gen: jax.Array,        # [B] i32 generated count INCLUDING this token
+    pos: jax.Array,        # [B] i32 position the step's forward ran at
+    stop_ids: jax.Array,   # [B, STOP_ID_WIDTH] i32, -1 padded
+    min_new: jax.Array,    # [B] i32 min_tokens (suppresses eos/stop below)
+    max_new: jax.Array,    # [B] i32 effective max_tokens
+    max_model_len: int,
+) -> jax.Array:
+    """Per-row finish verdict for one scan step — the exact device
+    mirror of ``Scheduler._check_finish``: at host-check time the
+    committed context is ``pos + 1`` (the pending token's KV was just
+    written), so the model-len bound reads ``pos + 2 >= max_model_len``.
+    Token ids are non-negative, so the -1 padding never matches."""
+    hit = (tokens[:, None] == stop_ids).any(axis=1)
+    stop = (gen >= min_new) & hit
+    length = (gen >= max_new) | (pos + 2 >= max_model_len)
+    return stop | length
+
+
 # alternatives returned with every step — covers OpenAI's top_logprobs
 # (≤ 20); a fixed width keeps the step program's shapes static
 TOP_LOGPROBS_K = 20

@@ -35,7 +35,7 @@ from ..tokens import TokenSequence
 from .block_allocator import BlockAllocator, KvEventSink
 from .config import EngineConfig
 from .model_runner import ModelRunner
-from .sampling import host_row, seed_to_key
+from .sampling import STOP_ID_WIDTH, host_row, seed_to_key, stop_id_row
 
 logger = logging.getLogger(__name__)
 
@@ -181,6 +181,48 @@ class EngineRequest:
     # pipelined segment ends (finish or drain), so span attribution
     # separates overlapped decode from the synchronous tail
     pipeline_span_open: bool = False
+    # device-resident finish detection: the admission-time classification
+    # (hoisted out of the per-token hot path — _check_finish consults
+    # these precomputed sets instead of re-deriving eos/stop lists every
+    # token) plus the packed device stop-id row for the chained burst.
+    # ``device_checkable`` means every stop condition is expressible
+    # on device: pure eos/hidden-stop/max-tokens, no stop STRINGS, no
+    # n>1 fan-out, stop set within STOP_ID_WIDTH. Guided decoding is
+    # checked live at dispatch (the constraint attaches after admission).
+    device_checkable: bool = False
+    device_frozen: bool = False  # finish came from the device mask
+    fin_eos: frozenset = dataclasses.field(default_factory=frozenset)
+    fin_stop: frozenset = dataclasses.field(default_factory=frozenset)
+    fin_min_new: int = 0
+    fin_max_new: int = 16384
+    fin_stop_row: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        self.classify_finish()
+
+    def classify_finish(self) -> None:
+        """Precompute the finish-check state once per request."""
+        sc = self.req.stop_conditions
+        so = self.req.sampling_options
+        self.fin_min_new = self.min_new
+        self.fin_max_new = self.max_new
+        self.fin_eos = (
+            frozenset() if sc.ignore_eos
+            else frozenset(int(t) for t in (self.req.eos_token_ids or []))
+        )
+        self.fin_stop = frozenset(
+            int(t) for t in (sc.stop_token_ids_hidden or [])
+        )
+        row = stop_id_row(
+            self.req.eos_token_ids, sc.stop_token_ids_hidden, sc.ignore_eos
+        )
+        n = so.n
+        self.fin_stop_row = row
+        self.device_checkable = (
+            row is not None             # stop set fits the device width
+            and not sc.stop             # stop strings post-check on host
+            and (n is None or n <= 1)
+        )
 
     @property
     def max_new(self) -> int:
@@ -221,6 +263,11 @@ class _HostBatchState:
         self.btab = np.zeros((b, cfg.blocks_per_seq), np.int32)
         # blocks of each row already mirrored into ``btab``
         self.synced_blocks = np.zeros(b, np.int32)
+        # device-finish state (membership-static, consumed by the chained
+        # burst): packed stop-token ids and the min/max token bounds
+        self.stop_ids = np.full((b, STOP_ID_WIDTH), -1, np.int32)
+        self.min_new = np.zeros(b, np.int32)
+        self.max_new = np.full(b, np.iinfo(np.int32).max, np.int32)
 
     def install(self, er: "EngineRequest") -> None:
         """(Re)write one slot's rows at admission / membership change."""
@@ -232,6 +279,11 @@ class _HostBatchState:
             er.repetition_penalty,
         )
         self.keys[i] = er.base_key
+        self.min_new[i] = er.fin_min_new
+        self.max_new[i] = min(er.fin_max_new, np.iinfo(np.int32).max)
+        self.stop_ids[i] = (
+            er.fin_stop_row if er.fin_stop_row is not None else -1
+        )
         n = len(er.block_ids)
         self.btab[i, :n] = er.block_ids
         self.btab[i, n:] = 0
@@ -268,6 +320,11 @@ class _InflightBurst:
     ti: object
     k_steps: int
     last_tokens: object            # device [B]: the next burst's tokens0
+    # chained (device-finish) bursts: dispatch timestamp for the
+    # drain-lag histogram, and the flag that switches _apply_burst to
+    # frozen-row semantics (-1 pads skipped, device-finish counted)
+    dispatch_t: float = 0.0
+    device_finish: bool = False
 
 
 class Scheduler:
@@ -336,6 +393,17 @@ class Scheduler:
         self._inflight: Optional[_InflightBurst] = None
         self._last_burst_done_t: Optional[float] = None
         self.pipeline_bursts = 0
+        # persistent decode loop (config.device_finish): chained bursts
+        # dispatched off the device-resident carry, reconciled by the
+        # async row drain. Membership is FIXED for a chain's lifetime
+        # (finished rows freeze on device); it compacts only at the
+        # chain barrier (admission, preemption, KV-OOM, drain, stop).
+        self._chain: deque = deque()   # _InflightBurst FIFO awaiting drain
+        self._chain_members: List[EngineRequest] = []
+        self._chain_carry = None       # device (tokens, pos, gen, done)
+        self._chain_dispatched = 0     # bursts since the chain started
+        self._chain_pos0: Dict[int, int] = {}  # slot → context at start
+        self._last_chain_len = 0
         # watchdog heartbeat: stamped at the top of EVERY loop pass, so a
         # loop wedged INSIDE a pass (hung compile, dead device sync) goes
         # stale while a healthy-but-waiting loop stays fresh
@@ -385,7 +453,28 @@ class Scheduler:
             "dynamo_engine_decode_pipeline_depth",
             "Decode dispatch depth in effect: 2 while a burst is in "
             "flight ahead of host reconciliation, else 1",
-            lambda: 2 if self._inflight is not None else 1,
+            lambda: 2 if (self._inflight is not None or self._chain) else 1,
+        )
+        self._device_finished_ctr = reg.counter(
+            "dynamo_engine_device_finished_rows_total",
+            "Rows whose finish (eos/hidden-stop/max-tokens/model-len) "
+            "was detected inside the decode burst program and frozen on "
+            "device instead of ending the burst",
+        )
+        self._drain_lag_hist = reg.histogram(
+            "dynamo_engine_decode_drain_lag_seconds",
+            "Chained decode: one burst's dispatch-to-host-reconciliation "
+            "lag — how far the asynchronous row drain runs behind the "
+            "device",
+            buckets=STEP_BUCKETS,
+        )
+        reg.callback_gauge(
+            "dynamo_engine_decode_burst_chain_length",
+            "Decode bursts dispatched since the last host barrier: the "
+            "open chain's running count, else the last completed "
+            "chain's length (>1 means the host barrier is no longer "
+            "per burst)",
+            lambda: self._chain_dispatched or self._last_chain_len,
         )
         self._preemptions = reg.counter(
             "dynamo_scheduler_preemptions_total",
@@ -499,6 +588,10 @@ class Scheduler:
             out["spec_accepted_tokens"] = self.spec_accepted
         if self.config.decode_pipeline_depth >= 2:
             out["decode_pipeline_bursts"] = self.pipeline_bursts
+        if self.config.device_finish_enabled:
+            out["decode_burst_chain_length"] = (
+                self._chain_dispatched or self._last_chain_len
+            )
         if self.allocator.tier2 is not None:
             out.update(self.allocator.tier2.metrics())
         if self.disagg is not None:
@@ -594,7 +687,7 @@ class Scheduler:
         self.flight.record(
             "scheduler.finish", request_id=er.request_id,
             trace_id=er.ctx.trace_id, reason=str(reason),
-            generated=er.generated,
+            generated=er.generated, device_finished=er.device_frozen,
         )
         er.ctx.add_stage("completion")
         if emit:
@@ -741,11 +834,21 @@ class Scheduler:
                 )
                 spec_now = (speculating and runner_idle
                             and all(self._spec_eligible(er) for er in active))
-                if not spec_now and self._pipeline_ok(active, runner_idle):
+                if not spec_now and self._chain_ok(active, runner_idle):
+                    # persistent loop: chain the next burst off the
+                    # device-resident carry; finished rows freeze on
+                    # device and drain asynchronously
+                    await self._decode_chained(loop, active)
+                elif not spec_now and self._pipeline_ok(active, runner_idle):
                     # dispatch-ahead: burst k+1 goes to the device before
                     # burst k's tokens are synced/emitted on the host
-                    await self._decode_pipelined(loop, active)
+                    await self._chain_barrier(loop)
+                    active = [er for er in active if er.finish is None]
+                    if active:
+                        await self._decode_pipelined(loop, active)
                 else:
+                    await self._chain_barrier(loop)
+                    active = [er for er in active if er.finish is None]
                     if self._inflight is not None:
                         # sync barrier: reconcile the in-flight burst
                         # before any non-pipelined dispatch (membership,
@@ -768,6 +871,12 @@ class Scheduler:
                     max(0.0, time.monotonic() - t_dec - self._host_sync_s),
                     phase="decode",
                 )
+                progressed = True
+            elif self._chain or self._chain_members:
+                # every chained row finished or was cancelled while the
+                # chain was still dispatching: reconcile the queue and
+                # close the chain (frozen rows' pads apply as no-ops)
+                await self._chain_barrier(loop)
                 progressed = True
             elif self._inflight is not None:
                 # every pipelined row finished or was cancelled while its
@@ -802,8 +911,10 @@ class Scheduler:
                 self._step_hist.observe(time.monotonic() - pass_t0)
                 await asyncio.sleep(0)  # let I/O run between steps
 
-        # stopping: reconcile any dispatch-ahead burst so no sampled
-        # tokens are silently dropped and no device work is abandoned
+        # stopping: reconcile any chained or dispatch-ahead burst so no
+        # sampled tokens are silently dropped and no device work is
+        # abandoned
+        await self._chain_barrier(loop)
         await self._drain_pipeline(loop)
 
     # ---------- dispatch-ahead decode (pipeline depth 2) ----------
@@ -961,6 +1072,23 @@ class Scheduler:
                 if er.finish is not None:
                     continue  # finished/cancelled: over-decode discarded
                 token = int(toks[j, er.slot])
+                if infl.device_finish and token < 0:
+                    # -1 pad: the device froze this row at an earlier
+                    # step, whose application above set er.finish. A pad
+                    # with NO host verdict means the device mask and the
+                    # host mirror diverged — finishing the row loudly
+                    # beats decoding a frozen zombie forever.
+                    logger.error(
+                        "device froze %s without a host finish verdict "
+                        "(device_finish_mask / _check_finish mirror "
+                        "divergence?); forcing STOP", er.request_id,
+                    )
+                    er.finish = FinishReason.STOP
+                    # emit=True: unlike the normal path, no preceding
+                    # _emit carried the finish_reason — the client must
+                    # still see one before the stream sentinel
+                    self._finish_pipelined(er, emit=True)
+                    continue
                 self._advance_row(er, token)
                 er.pipeline_span_open = True
                 self._emit(
@@ -969,9 +1097,15 @@ class Scheduler:
                     self._top_row(er, tv[j], ti[j], er.slot),
                 )
                 if er.finish is not None:
+                    if infl.device_finish:
+                        # the device's mask froze this row at exactly
+                        # this step — the host check is the mirror that
+                        # names the reason and finalizes bookkeeping
+                        er.device_frozen = True
+                        self._device_finished_ctr.inc()
                     self._finish_pipelined(er)
 
-    def _finish_pipelined(self, er: EngineRequest) -> None:
+    def _finish_pipelined(self, er: EngineRequest, emit: bool = False) -> None:
         """A pipelined row finished (possibly one burst late): truncate
         the over-decoded tokens (never emitted), roll the headroom blocks
         holding only over-decoded KV back into the allocator, stamp the
@@ -995,7 +1129,10 @@ class Scheduler:
         if er.pipeline_span_open:
             er.ctx.add_stage("decode_pipeline")
             er.pipeline_span_open = False
-        self._finish(er, er.finish, emit=False)
+        # emit=False on the normal path: the finishing token's _emit
+        # already carried the finish_reason. The mirror-divergence
+        # fallback passes emit=True — nothing was emitted there.
+        self._finish(er, er.finish, emit=emit)
 
     async def _drain_pipeline(self, loop) -> None:
         """Sync barrier: reconcile the in-flight burst (if any) so every
@@ -1017,6 +1154,207 @@ class Scheduler:
             if er.finish is None and er.pipeline_span_open:
                 er.ctx.add_stage("decode_pipeline")
                 er.pipeline_span_open = False
+
+    # ---------- persistent decode loop (config.device_finish) ----------
+
+    # bursts allowed in flight ahead of the async drain: beyond this the
+    # dispatcher waits out the oldest sync (the device has CHAIN_MAX
+    # bursts queued — it cannot run dry while the host catches up), so
+    # per-burst device output buffers stay bounded
+    CHAIN_MAX_INFLIGHT = 4
+
+    def _chain_ok(self, active: List[EngineRequest],
+                  runner_idle: bool) -> bool:
+        """May this pass chain a burst off the device-resident carry?
+
+        Requires device-resident finish detection for EVERY active row:
+        the admission-time ``device_checkable`` classification (pure
+        eos/hidden-stop/max-tokens, no stop strings, no n>1) plus the
+        live guided check (the constraint attaches after admission).
+        Speculative decoding and non-idle passes fall back exactly like
+        the PR 3 pipeline. With a chain open, any row NOT already a
+        member (a membership surprise) forces the barrier.
+        """
+        cfg = self.config
+        if not (cfg.device_finish_enabled
+                and cfg.decode_pipeline_depth >= 2 and runner_idle):
+            return False
+        if self.draft is not None or cfg.spec_ngram_tokens > 0:
+            return False
+        if not active:
+            return False
+        for er in active:
+            if er.guided is not None or not er.device_checkable:
+                return False
+        if self._chain_members:
+            member_ids = {id(m) for m in self._chain_members}
+            if any(id(er) not in member_ids for er in active):
+                return False
+        return True
+
+    def _chain_ready(self, infl: _InflightBurst) -> bool:
+        """Non-blocking: are this burst's outputs already materialized?
+        (Host test doubles return numpy — always ready.)"""
+        return getattr(infl.toks, "is_ready", lambda: True)()
+
+    async def _apply_chain_head(self, loop) -> None:
+        """Reconcile the oldest queued chained burst (FIFO — token order
+        per row) and record its drain lag."""
+        infl = self._chain.popleft()
+        await self._apply_burst(loop, infl)
+        self._drain_lag_hist.observe(time.monotonic() - infl.dispatch_t)
+
+    async def _decode_chained(self, loop,
+                              active: List[EngineRequest]) -> None:
+        """One persistent-loop pass: dispatch the next burst straight off
+        the device-resident carry — WITHOUT waiting for any previous
+        burst's host reconciliation — then drain whatever bursts have
+        already materialized.
+
+        Finished rows freeze inside the burst program (no sampling, no
+        KV writes, -1 pads out), so membership never changes mid-chain:
+        the commit mask marks members, the device ``done`` mask marks
+        frozen rows, and rows cancelled on the host simply drop out of
+        the commit mask at the next dispatch. Block headroom is tracked
+        against the chain's own dispatch count (the host's committed
+        ``context_len`` lags by the whole drain queue), capped at the
+        model-len horizon — the device's LENGTH check freezes rows there,
+        so near-horizon rows stay chained instead of forcing sync.
+        """
+        cfg = self.config
+        b = cfg.max_batch_size
+        k_steps = max(1, cfg.multi_step_decode)
+        if self._inflight is not None:
+            # a plain dispatch-ahead burst predates this chain: reconcile
+            # it first so the chain starts from fully-committed state
+            await self._drain_pipeline(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return
+        if not self._chain_members:
+            self._chain_members = list(active)
+            self._chain_carry = None
+            self._chain_dispatched = 0
+            self._chain_pos0 = {er.slot: er.context_len for er in active}
+        members = self._chain_members
+        live = [er for er in members if er.finish is None]
+        if not live:
+            await self._chain_barrier(loop)
+            return
+        # headroom: positions this burst writes for a never-frozen row
+        # run through chain_pos0 + (n+1)*K - 1; reserve one position past
+        # that (the carry slot) and cap at the model-len horizon (the
+        # device freezes rows there — blocks past it are never touched)
+        n = self._chain_dispatched
+        for er in live:
+            limit = min(self._chain_pos0[er.slot] + (n + 1) * k_steps,
+                        cfg.max_model_len - 1)
+            if not self._ensure_block_for(er, limit):
+                # KV OOM: preemption needs fully-committed host state —
+                # barrier, then let the sync path preempt/decode
+                self.allocator.flush_offload()
+                await self._chain_barrier(loop)
+                live = [er for er in active if er.finish is None]
+                if live:
+                    await self._decode(loop, live, k_steps)
+                return
+            self._host.sync_blocks(er)
+        self.allocator.flush_offload()
+
+        hs = self._host
+        commit = np.zeros(b, bool)
+        for er in members:
+            commit[er.slot] = er.finish is None
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
+        btab = hs.btab[:, :w].copy()
+        want_top = any(er.logprobs_n > 0 for er in members)
+        if self._chain_carry is None:
+            # chain fill: the carry comes from committed host state
+            tokens0 = np.zeros(b, np.int32)
+            positions0 = np.zeros(b, np.int32)
+            gen0 = np.zeros(b, np.int32)
+            done0 = np.zeros(b, bool)
+            for er in live:
+                tokens0[er.slot] = er.pending_token
+                positions0[er.slot] = er.context_len
+                gen0[er.slot] = er.generated
+        else:
+            tokens0, positions0, gen0, done0 = self._chain_carry
+
+        # device-idle bookkeeping (same approximation as the pipelined
+        # path): a carry already materialized at dispatch time means the
+        # device ran dry since the last reconciliation
+        now = time.monotonic()
+        if self._last_burst_done_t is not None:
+            if self._chain_carry is None:
+                self._bubble_hist.observe(now - self._last_burst_done_t)
+            else:
+                ready = getattr(tokens0, "is_ready", lambda: True)()
+                self._bubble_hist.observe(
+                    now - self._last_burst_done_t if ready else 0.0
+                )
+        self._last_burst_done_t = None
+
+        toks, lps, tv, ti, carry = self.runner.decode_burst_chained(
+            tokens0, positions0, gen0, done0, btab,
+            hs.temp, hs.top_k, hs.top_p,
+            min_p=hs.min_p, presence_penalty=hs.pres,
+            frequency_penalty=hs.freq, repetition_penalty=hs.rep,
+            seed_keys=hs.keys, commit=commit, stop_ids=hs.stop_ids,
+            min_new=hs.min_new, max_new=hs.max_new, want_top=want_top,
+        )
+        self._chain_carry = carry
+        self._chain_dispatched += 1
+        self.steps += 1
+        self.pipeline_bursts += 1
+        self.flight.record(
+            "scheduler.burst_dispatch", k_steps=k_steps, rows=len(live),
+            pipelined=True, chained=True,
+            chain_len=self._chain_dispatched,
+            requests=[er.request_id for er in live[:8]],
+        )
+        self._chain.append(_InflightBurst(
+            active=list(live), toks=toks, lps=lps, tv=tv, ti=ti,
+            k_steps=k_steps, last_tokens=None,
+            dispatch_t=time.monotonic(), device_finish=True,
+        ))
+        # asynchronous row drain: reconcile every burst whose outputs
+        # already materialized (never gating the dispatch above), then
+        # enforce the in-flight bound
+        while self._chain and self._chain_ready(self._chain[0]):
+            await self._apply_chain_head(loop)
+        while len(self._chain) >= self.CHAIN_MAX_INFLIGHT:
+            await self._apply_chain_head(loop)
+        if all(er.finish is not None for er in members):
+            # every member finished: anything still queued or dispatched
+            # is frozen over-decode — close the chain now
+            await self._chain_barrier(loop)
+
+    async def _chain_barrier(self, loop) -> None:
+        """Host barrier: reconcile every queued chained burst and close
+        the chain — the ONLY place chain membership compacts. Runs before
+        admission-driven sync passes, preemption, spec/guided dispatch,
+        and shutdown."""
+        if not self._chain and not self._chain_members:
+            return
+        bursts = self._chain_dispatched
+        while self._chain:
+            await self._apply_chain_head(loop)
+        if self._chain_members:
+            self.flight.record(
+                "scheduler.burst_drain", chained=True, bursts=bursts,
+                rows=len(self._chain_members),
+            )
+            for er in self._chain_members:
+                if er.finish is None and er.pipeline_span_open:
+                    er.ctx.add_stage("decode_pipeline")
+                    er.pipeline_span_open = False
+        if bursts:
+            self._last_chain_len = bursts
+        self._chain_members = []
+        self._chain_carry = None
+        self._chain_dispatched = 0
+        self._chain_pos0 = {}
 
     # ---------- disaggregated prefill (decode side) ----------
 
@@ -1881,15 +2219,20 @@ class Scheduler:
         self.waiting.appendleft(er)
 
     def _check_finish(self, er: EngineRequest, token: int) -> Optional[FinishReason]:
-        sc = er.req.stop_conditions
-        if er.generated < er.min_new:
-            pass  # eos/stops suppressed below min_tokens
-        else:
-            if not sc.ignore_eos and token in (er.req.eos_token_ids or []):
+        """Per-token finish verdict off the admission-time classification
+        (EngineRequest.classify_finish): set membership against the
+        precomputed frozensets instead of re-deriving eos/stop lists
+        from the request every token — this runs for EVERY emitted token
+        of every request (incl. the async drain's hot path). Must stay
+        the exact host mirror of sampling.device_finish_mask."""
+        if er.generated >= er.fin_min_new:
+            # eos/stops suppressed below min_tokens; ignore_eos already
+            # emptied fin_eos at classification
+            if token in er.fin_eos:
                 return FinishReason.EOS
-            if sc.stop_token_ids_hidden and token in sc.stop_token_ids_hidden:
+            if token in er.fin_stop:
                 return FinishReason.STOP
-        if er.generated >= er.max_new:
+        if er.generated >= er.fin_max_new:
             return FinishReason.LENGTH
         if er.context_len + 1 >= self.config.max_model_len:
             return FinishReason.LENGTH

@@ -40,6 +40,7 @@ class FakeRunner:
         self.v = config.model.vocab_size
         self.step_calls = 0
         self.burst_calls = 0
+        self.chained_calls = 0
 
     def _advance(self, prev, pos):
         return (prev * 7 + pos * 13 + 1) % self.v
@@ -88,6 +89,46 @@ class FakeRunner:
         tv = np.zeros((K, b, 8), np.float32)
         ti = np.zeros((K, b, 8), np.int32)
         return toks, lps, tv, ti
+
+    def decode_burst_chained(self, tokens0, positions0, gen0, done0, btab,
+                             *args, commit=None, stop_ids=None,
+                             min_new=None, max_new=None, want_top=False,
+                             **kw):
+        """Host mirror of the device-finish burst: same token rule, plus
+        the freeze semantics — finished rows stop advancing and emit -1
+        pads; the carry (tokens/pos/gen/done) feeds the next call."""
+        self.chained_calls += 1
+        K = max(1, self.config.multi_step_decode)
+        prev = np.asarray(tokens0).astype(np.int64).copy()
+        pos = np.asarray(positions0).astype(np.int64).copy()
+        gen = np.asarray(gen0).astype(np.int64).copy()
+        done = np.asarray(done0).astype(bool).copy()
+        commit = np.asarray(commit).astype(bool)
+        b = prev.shape[0]
+        toks = np.full((K, b), -1, np.int32)
+        lps = np.zeros((K, b), np.float32)
+        max_len = self.config.max_model_len
+        for s in range(K):
+            live = commit & ~done
+            nt = self._advance(prev, pos)
+            gen = gen + live.astype(np.int64)
+            hit = (nt[:, None] == np.asarray(stop_ids)).any(axis=1)
+            newly = live & (
+                ((gen >= min_new) & hit)
+                | (gen >= max_new) | (pos + 2 >= max_len)
+            )
+            toks[s] = np.where(live, nt, -1)
+            lps[s] = np.where(live, -(nt % 7) / 10.0, 0.0)
+            adv = live & ~newly
+            prev = np.where(adv, nt, prev)
+            pos = np.where(adv, pos + 1, pos)
+            done = done | newly
+        tv = np.zeros((K, b, 8), np.float32)
+        ti = np.zeros((K, b, 8), np.int32)
+        return toks, lps, tv, ti, (
+            prev.astype(np.int32), pos.astype(np.int32),
+            gen.astype(np.int32), done,
+        )
 
 
 def _config(depth, k=4, **kw):
@@ -327,7 +368,194 @@ def test_near_horizon_rows_fall_back_to_sync():
     with finish reason length at the same point as the sync path."""
     want = _streams(1, max_tokens=200, max_model_len=32)
     box = {}
-    got = _streams(2, max_tokens=200, max_model_len=32, sched_out=box)
+    got = _streams(2, max_tokens=200, max_model_len=32,
+                   device_finish="off", sched_out=box)
     assert got == want
     assert all(f == "length" for _, f in got)
     assert box["sched"]._inflight is None
+
+
+# --------------------------------------------------------------------------
+# device-resident finish detection (config.device_finish) — the
+# persistent decode loop: chained bursts, frozen rows, async row drain
+# --------------------------------------------------------------------------
+
+
+def test_device_finish_differential_streams_identical():
+    """Streams must be byte-identical with device-finish on vs off —
+    token ids, logprob carriers, finish reasons — and the chained path
+    must actually engage: bursts dispatched between host barriers > 1
+    (the host barrier is no longer per burst)."""
+    want = _streams(1)
+    off_box, on_box = {}, {}
+    off = _streams(2, device_finish="off", sched_out=off_box)
+    on = _streams(2, sched_out=on_box)  # auto: enabled at depth 2
+    assert off == want
+    assert on == want
+    assert off_box["sched"].runner.chained_calls == 0
+    sched = on_box["sched"]
+    assert sched.runner.chained_calls > 1
+    assert sched._last_chain_len > 1, "host barrier still per burst"
+    assert not sched._chain and not sched._chain_members
+    # every finish was detected on device (all rows are device-checkable)
+    assert sum(sched._device_finished_ctr.values.values()) == len(PROMPTS)
+
+
+def test_device_finish_eos_mid_burst_freezes_row():
+    """EOS landing mid-burst under device finish: the row freezes ON
+    DEVICE at exactly the stop token (no over-decode at all — nothing
+    emits after it), the stream matches the sync path byte-for-byte,
+    and the reserved headroom blocks all roll back."""
+    plain = _streams(1, max_tokens=24)
+    eos = [plain[0][0][5]]  # lands mid-burst at K=4
+    want = _streams(1, max_tokens=24, eos=eos)
+    assert want[0][1] == "eos" and len(want[0][0]) <= 6
+    box = {}
+    got = _streams(2, max_tokens=24, eos=eos, sched_out=box)
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 0
+    assert sum(sched._device_finished_ctr.values.values()) >= 1
+    assert sched.allocator.used == 0  # headroom + rollback leak nothing
+
+
+def test_device_finish_max_tokens_at_burst_boundary():
+    """max_tokens an exact multiple of K: the LENGTH finish lands on the
+    last step of a burst — the device mask must freeze the row there
+    (not one burst late) and the stream must match the sync path."""
+    for mt in (8, 12):  # K=4 boundaries
+        want = _streams(1, max_tokens=mt)
+        box = {}
+        got = _streams(2, max_tokens=mt, sched_out=box)
+        assert got == want
+        assert all(len(toks) == mt and f == "length" for toks, f in got)
+        assert box["sched"].runner.chained_calls > 0
+        assert box["sched"].allocator.used == 0
+
+
+def test_stop_string_rows_forced_to_sync_path():
+    """Stop STRINGS need the backend's host-side post-check (the jail) —
+    such rows are classified not-device-checkable at admission and the
+    chain must never engage; the PR 3 per-burst-reconciled pipeline
+    serves them instead, with the stream unchanged."""
+    config = _config(2)
+
+    def reqs():
+        out = []
+        for p in PROMPTS:
+            req = PreprocessedRequest(
+                token_ids=list(p),
+                stop_conditions=StopConditions(max_tokens=12, ignore_eos=True,
+                                               stop=["never-matches"]),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[],
+            )
+            out.append(EngineRequest(
+                request_id=uuid.uuid4().hex, prompt=list(p), req=req,
+                ctx=AsyncEngineContext(), out_queue=asyncio.Queue(),
+            ))
+        return out
+    rs = reqs()
+    assert all(not er.device_checkable for er in rs)
+    box = {}
+
+    def grab(s):
+        box["sched"] = s
+
+    got = _run(config, rs, hooks=grab)
+    sched = box["sched"]
+    assert sched.runner.chained_calls == 0, "chained a stop-string row"
+    assert sched.pipeline_bursts > 0, "PR 3 pipeline should still engage"
+    want = _run(_config(1), reqs())
+    assert got == want
+
+
+def test_preemption_kv_oom_drains_chain_before_membership_changes():
+    """KV OOM mid-chain must run the chain barrier (every queued burst
+    reconciled, membership compacted) before preemption touches any
+    row — and the resumed streams still match the unconstrained run."""
+    want = _streams(1, max_tokens=24, num_kv_blocks=64)
+
+    preempts = []
+
+    def hook(sched):
+        orig = sched._preempt
+
+        def spy(er):
+            assert not sched._chain, "preempted with chained bursts in flight"
+            assert not sched._chain_members, \
+                "preempted before the chain membership barrier"
+            assert sched._inflight is None
+            preempts.append(er.request_id)
+            orig(er)
+
+        sched._preempt = spy
+
+    config = _config(2, num_kv_blocks=10)
+    reqs = [_request(p, 24) for p in PROMPTS]
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        hook(s)
+
+    got = _run(config, reqs, hooks=hooks)
+    assert preempts, "test is vacuous: no preemption happened"
+    assert box["sched"].runner.chained_calls > 0, "chain never engaged"
+    assert got == want
+
+
+def test_device_finish_near_horizon_rows_stay_chained():
+    """Under device finish, rows near max_model_len do NOT fall back to
+    sync (the PR 3 behavior): the device's LENGTH check (pos + 2 >=
+    max_model_len — the in-scan mirror of _check_finish's context_len +
+    1 bound) freezes them at exactly the horizon, headroom reservation
+    caps at max_model_len - 1, and the streams still match the sync
+    path byte-for-byte."""
+    want = _streams(1, max_tokens=200, max_model_len=32)
+    box = {}
+    got = _streams(2, max_tokens=200, max_model_len=32, sched_out=box)
+    assert got == want
+    assert all(f == "length" for _, f in got)
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 0, \
+        "near-horizon rows forced sync under device finish"
+    # every LENGTH finish at the horizon was detected on device
+    assert sum(sched._device_finished_ctr.values.values()) == len(PROMPTS)
+    assert sched.allocator.used == 0
+    assert not sched._chain and not sched._chain_members
+
+
+def test_late_drain_retro_invalidation_rolls_back_blocks():
+    """The chain reserves block headroom against its own dispatch count,
+    so a row finishing deep into a chain holds blocks covering positions
+    it froze before reaching — the drain's retro-invalidation must roll
+    that tail back into the allocator (rollback_tail observed with a
+    shrinking keep) and leak nothing."""
+    rollbacks = []
+
+    def hook(sched):
+        orig = sched.allocator.rollback_tail
+
+        def spy(block_ids, keep):
+            rollbacks.append((len(block_ids), keep))
+            return orig(block_ids, keep)
+
+        sched.allocator.rollback_tail = spy
+
+    plain = _streams(1, max_tokens=24)
+    eos = [plain[2][0][2]]  # row 2 stops early, deep headroom reserved
+    config = _config(2, num_kv_blocks=64)
+    reqs = [_request(p, 24, eos=eos) for p in PROMPTS]
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        hook(s)
+
+    _run(config, reqs, hooks=hooks)
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 0
+    assert any(total > keep for total, keep in rollbacks), \
+        "no over-reserved tail was ever rolled back"
+    assert sched.allocator.used == 0

@@ -763,6 +763,27 @@ def test_async_blocking_flags_sync_sleep_in_pipelined_loop_shape():
     assert [f.rule for f in out] == ["async-blocking"]
 
 
+def test_async_blocking_flags_drain_callback_waiting_on_loop():
+    """TP fixture shaped like a careless chained-decode drain: the
+    callback reconciling a queued burst waits out the device with a
+    blocking sleep ON the scheduler loop instead of syncing through the
+    executor — exactly the hop the persistent loop's async row drain
+    must ride (scheduler._apply_burst's run_in_executor)."""
+    out = findings(
+        """
+        import time
+        async def drain_chain(chain, apply_tokens):
+            while chain:
+                burst = chain.popleft()
+                while not burst.toks.is_ready():
+                    time.sleep(0.0005)  # "wait for the burst"
+                apply_tokens(burst)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
 # --------------------------------------------------------------------------
 # streamed remote prefill: the transfer pipeline's purity contract
 # --------------------------------------------------------------------------

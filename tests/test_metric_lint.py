@@ -65,6 +65,11 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_planner_local_prefill_threshold_tokens",
         # staleness-aware KV routing (kv_router/router.py)
         "dynamo_kv_router_stale_worker_skips_total",
+        # persistent decode loop: device-resident finish detection
+        # (engine/scheduler.py)
+        "dynamo_engine_device_finished_rows_total",
+        "dynamo_engine_decode_drain_lag_seconds",
+        "dynamo_engine_decode_burst_chain_length",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
