@@ -173,6 +173,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "flight artifact) when the engine has pending "
                         "work but its loop heartbeat or dispatch counter "
                         "has been stale this long (default 30; 0 = off)")
+    # self-healing serving (recovery/): trip → drain → migrate → respawn
+    p.add_argument("--self-heal", action="store_true",
+                   help="automated recovery: watchdog trips (and "
+                        "supervised-child deaths) drive drain → live "
+                        "request migration to a healthy peer → respawn; "
+                        "also enables POST /admin/drain for zero-"
+                        "downtime rolling updates")
+    p.add_argument("--drain-grace-s", type=float, default=5.0,
+                   help="soft-drain grace: how long committed work may "
+                        "finish on its own before migration starts")
+    p.add_argument("--respawn-max", type=int, default=3,
+                   help="consecutive failed respawns before the "
+                        "recovery controller gives up")
+    p.add_argument("--respawn-backoff-s", type=float, default=1.0,
+                   help="respawn backoff base (doubles per consecutive "
+                        "failure)")
+    p.add_argument("--migrate-peers", default="",
+                   help="comma-separated host:port list of peer "
+                        "migration receivers (in=dyn:// workers discover "
+                        "peers through the discovery plane instead)")
+    p.add_argument("--migrate-port", type=int, default=0,
+                   help="port for this worker's inbound-migration "
+                        "receiver (0 = ephemeral; started only with "
+                        "--self-heal on a native engine)")
     # closed-loop SLA planner + HTTP-edge admission control (planner/)
     p.add_argument("--admission-limit", type=int, default=0,
                    help="HTTP-edge admission control: max concurrently "
@@ -377,6 +401,14 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         pipe = build_pipeline(
             [OpenAIPreprocessor(mdc, tokenizer), Backend(tokenizer)], core
         )
+        # the recovery wiring (and /admin/drain) needs the token-level
+        # engine behind the preprocessing stages
+        pipe.core_engine = core
+        if getattr(core, "host_registry", None) is not None:
+            # subprocess-hosted engines: the supervision registry
+            # (restart counter) rides separately from the dict-gauge
+            # metrics the child pongs back
+            pipe.host_registry = core.host_registry
         if hasattr(core, "metrics"):
             # surfaced on the frontend's /metrics as engine gauges
             # (run_http) — slot/KV occupancy, prefix hits, speculation
@@ -391,6 +423,121 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         return pipe, mdc
 
     raise SystemExit(f"unknown engine {engine_spec!r}")
+
+
+async def _setup_self_healing(flags, core, admission=None, drt=None,
+                              component: str = "backend"):
+    """--self-heal wiring: a RecoveryController per engine plus (native
+    engines) a migration receiver for peers draining TOWARD this worker.
+
+    Returns (controller, migration_server) — either may be None. Native
+    in-process engines get the full ladder (trip → drain → migrate);
+    subprocess-hosted engines get the respawn ladder driven by child
+    deaths (their drain/migrate happens inside the child's own stack).
+    """
+    import uuid as _uuid
+
+    import msgpack as _msgpack
+
+    from ..recovery import (
+        MigrationServer,
+        MigrationSink,
+        RecoveryConfig,
+        RecoveryController,
+        migration_key,
+    )
+
+    config = RecoveryConfig(
+        drain_grace_s=flags.drain_grace_s,
+        respawn_backoff_s=flags.respawn_backoff_s,
+        max_respawns=flags.respawn_max,
+    )
+    # supervised-child engines: respawn ladder only — the wedge/death
+    # detection and stream failure live in the subprocess host itself.
+    # respawn() (not _ensure_running) so POST /admin/drain?respawn=1
+    # actually restarts a LIVE child (rolling engine restart), while a
+    # dead child just respawns; the controller suppresses the down
+    # listener during its own drain so the kill doesn't re-trigger it.
+    if hasattr(core, "add_down_listener"):
+        controller = RecoveryController(
+            engine_id=f"eng-{_uuid.uuid4().hex[:12]}",
+            respawner=core.respawn,
+            admission=admission,
+            config=config,
+        )
+        core.add_down_listener(controller.on_child_down)
+        return controller, None
+
+    scheduler = getattr(core, "scheduler", None)
+    if scheduler is None:
+        return None, None  # echo/BYO engines have nothing to recover
+    engine_id = f"eng-{_uuid.uuid4().hex[:12]}"
+    sink = MigrationSink(scheduler, core.runner)
+    server = await MigrationServer(
+        sink, host=flags.advertise_host, port=flags.migrate_port
+    ).start()
+
+    static_peers = [
+        {"host": hp.rsplit(":", 1)[0], "port": int(hp.rsplit(":", 1)[1]),
+         "engine_id": f"static-{hp}"}
+        for hp in flags.migrate_peers.split(",") if hp.strip()
+    ]
+    peers = (lambda: static_peers)
+    deregister = register = None
+    if drt is not None:
+        key = migration_key(flags.namespace, component, engine_id)
+        desc = _msgpack.packb(
+            dict(server.descriptor, engine_id=engine_id), use_bin_type=True
+        )
+        lease = await drt.discovery.primary_lease()
+        await drt.discovery.kv_put(key, desc, lease_id=lease.id)
+        # snapshot of live peer receivers, primed now and refreshed per
+        # drain; excludes self by engine_id inside the controller
+        peer_cache: list = list(static_peers)
+
+        async def refresh_peers():
+            prefix = migration_key(flags.namespace, component, "")
+            kvs = await drt.discovery.kv_get_prefix(prefix)
+            peer_cache[:] = static_peers + [
+                _msgpack.unpackb(v, raw=False) for v in kvs.values()
+            ]
+
+        async def deregister():
+            # routers already skip us via the draining snapshot; this
+            # removes the migration descriptor so no peer drains INTO a
+            # draining worker. Delete FIRST and unconditionally — a
+            # flaky peer refresh must neither leave the dead worker's
+            # descriptor registered nor abort the drain (the cache keeps
+            # its last known pool on refresh failure).
+            await drt.discovery.kv_delete(key)
+            try:
+                await refresh_peers()  # post-delete: self is gone too
+            except Exception:
+                logger.warning("peer refresh failed during drain; using "
+                               "last known peers", exc_info=True)
+
+        async def register():
+            await drt.discovery.kv_put(key, desc, lease_id=lease.id)
+
+        try:
+            await refresh_peers()
+        except Exception:
+            logger.warning("initial migration-peer discovery failed; "
+                           "starting with static peers only", exc_info=True)
+        peers = (lambda: peer_cache)
+
+    controller = RecoveryController(
+        engine_id=engine_id,
+        scheduler=scheduler,
+        runner=core.runner,
+        watchdog=getattr(core, "watchdog", None),
+        peers=peers,
+        deregister=deregister,
+        register=register,
+        admission=admission,
+        config=config,
+    )
+    return controller, server
 
 
 async def run_http(flags, engine, mdc) -> None:
@@ -431,6 +578,20 @@ async def run_http(flags, engine, mdc) -> None:
         service.metrics.register_callback_gauges(
             "dynamo_engine", engine.engine_metrics
         )
+    if getattr(engine, "host_registry", None) is not None:
+        # supervision instruments (engine-child restart counter)
+        service.metrics.attach_registry(engine.host_registry)
+
+    recovery = migserver = None
+    if flags.self_heal and engine is not None:
+        core = getattr(engine, "core_engine", engine)
+        recovery, migserver = await _setup_self_healing(
+            flags, core, admission=admission
+        )
+        if recovery is not None:
+            recovery.attach()
+            service.drainer = recovery.admin_drain
+            service.metrics.attach_registry(recovery.registry)
 
     planner = None
     if flags.planner:
@@ -511,6 +672,10 @@ async def run_http(flags, engine, mdc) -> None:
     finally:
         if planner is not None:
             planner.stop()
+        if recovery is not None:
+            await recovery.close()
+        if migserver is not None:
+            await migserver.close()
         if watcher:
             await watcher.stop()
         await service.stop()
@@ -639,6 +804,18 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             instance_id=instance_id,
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
         )
+        if flags.self_heal:
+            # watchdog trips drain this worker, migrate its in-flight
+            # requests to peer workers discovered under the component's
+            # migration prefix, and respawn (docs/self_healing.md)
+            recovery, _migserver = await _setup_self_healing(
+                flags, core, drt=drt, component=comp,
+            )
+            if recovery is not None:
+                recovery.attach()
+                reg = getattr(core, "registry", None)
+                if reg is not None:
+                    reg.attach(recovery.registry)
         # in-process jax engines carry the full scheduler/KV registry;
         # workers with no registry (echo, BYO) just skip the sidecar
         mserver = await maybe_start_metrics_server(

@@ -354,9 +354,18 @@ class KvTransferClient:
         chunk_blocks: int = 16,
     ) -> None:
         """Stream blocks in chunks so the receiver overlaps scatter w/ reads."""
+        from ..utils import faults
+
         n = len(block_ids)
         assert k_blocks.shape[1] == n
         for i in range(0, n, chunk_blocks):
+            if faults.fire("transfer_conn_drop"):
+                # chaos site: the sender dies mid-stream — the receiver
+                # must poison this request's commit (utils/faults.py)
+                self.writer.close()
+                raise ConnectionResetError(
+                    "fault injected: transfer_conn_drop"
+                )
             ids = block_ids[i : i + chunk_blocks]
             k = np.ascontiguousarray(k_blocks[:, i : i + len(ids)])
             v = np.ascontiguousarray(v_blocks[:, i : i + len(ids)])

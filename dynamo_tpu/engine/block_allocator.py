@@ -376,6 +376,20 @@ class BlockAllocator:
         num_cached = (len(cached_blocks) + len(host_hashes)) * self.block_size
         return cached_blocks + new_blocks, num_cached
 
+    def allocate_n(self, n: int) -> List[int]:
+        """``n`` anonymous blocks, all-or-nothing (migration admits: a
+        partial reservation would strand a half-scattered transfer).
+        On MemoryError everything taken so far is released first."""
+        got: List[int] = []
+        try:
+            for _ in range(n):
+                got.append(self.allocate_block(flush=False))
+        except MemoryError:
+            self.free_blocks(got)
+            raise
+        self.flush_offload()
+        return got
+
     def allocate_block(self, flush: bool = True) -> int:
         """One more block for a growing (decoding) sequence.
 

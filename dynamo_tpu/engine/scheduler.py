@@ -32,6 +32,7 @@ from ..runtime.engine import AsyncEngineContext
 from ..telemetry.flight import FlightRecorder, flight_recorder
 from ..telemetry.registry import STEP_BUCKETS, MetricsRegistry
 from ..tokens import TokenSequence
+from ..utils import faults
 from .block_allocator import BlockAllocator, KvEventSink
 from .config import EngineConfig
 from .model_runner import ModelRunner
@@ -379,6 +380,10 @@ class Scheduler:
         self._rng = np.random.default_rng(config.seed)
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
+        # drain gate (recovery/): True stops ALL admission — local slot
+        # claims, remote-prefill submits — while committed work proceeds;
+        # exported in metrics() so the KV router skips this worker
+        self.draining = False
         # telemetry (ForwardPassMetrics analog, SURVEY.md §2.2 KV metrics)
         self.prefix_hit_tokens = 0
         self.prefix_total_tokens = 0
@@ -548,10 +553,22 @@ class Scheduler:
         if self.disagg is not None:
             await self.disagg.close()
 
-    def add_request(self, er: EngineRequest) -> None:
+    def _prepare_request(self, er: EngineRequest) -> None:
+        """Per-request host fields shared by local admission and
+        migration admit (everything except the PRNG key, which a
+        migrated request brings along)."""
         so = er.req.sampling_options
         (er.temperature, er.top_k, er.top_p, er.min_p, er.presence_penalty,
          er.frequency_penalty, er.repetition_penalty) = host_row(so)
+        # logprobs is a COUNT: 0 = chosen token's logprob with no
+        # alternatives (None = off) — bool() would drop the 0 case
+        er.want_logprobs = er.req.output_options.logprobs is not None
+        er.logprobs_n = int(er.req.output_options.logprobs or 0)
+        er.want_prompt_lps = er.req.output_options.prompt_logprobs is not None
+
+    def add_request(self, er: EngineRequest) -> None:
+        self._prepare_request(er)
+        so = er.req.sampling_options
         if so.seed is not None:
             # per-request key: seeded sampling is reproducible AND isolated
             # from batchmates (each slot samples from its own PRNG stream)
@@ -560,14 +577,161 @@ class Scheduler:
             er.base_key = self._rng.integers(
                 0, 2**32, size=2, dtype=np.uint32
             )
-        # logprobs is a COUNT: 0 = chosen token's logprob with no
-        # alternatives (None = off) — bool() would drop the 0 case
-        er.want_logprobs = er.req.output_options.logprobs is not None
-        er.logprobs_n = int(er.req.output_options.logprobs or 0)
-        er.want_prompt_lps = er.req.output_options.prompt_logprobs is not None
         er.ctx.add_stage("queued")
         self.waiting.append(er)
         self.wake.set()
+
+    # ---------- drain / migration surface (recovery/) ----------
+
+    def set_draining(self, draining: bool = True) -> None:
+        """Gate admission: committed work proceeds, nothing new starts.
+        The flag rides the metrics() snapshot so KV routers skip this
+        worker, and the watchdog treats a draining engine as stopping
+        (gated queued work must not read as starvation)."""
+        self.draining = draining
+        self.wake.set()
+
+    async def seize(self, hard: bool = False, timeout_s: float = 5.0) -> None:
+        """Stop the loop for drain/migration.
+
+        Graceful (``hard=False``) lets the loop run its normal exit
+        barriers — every dispatched burst reconciles and streams its
+        tokens — and escalates to a cancel after ``timeout_s`` (a
+        half-wedged loop must not hang the drain). Hard cancels
+        immediately: a loop wedged inside a pass (the watchdog-trip
+        case) would never finish a barrier. Un-reconciled device work is
+        abandoned — its tokens were never emitted, so the committed host
+        state the migration packages stays exact.
+        """
+        self._stopping = True
+        self.draining = True
+        self.wake.set()
+        task, self._task = self._task, None
+        if task is not None:
+            if not hard:
+                try:
+                    await asyncio.wait_for(asyncio.shield(task), timeout_s)
+                except asyncio.TimeoutError:
+                    logger.warning(
+                        "graceful seize timed out after %.1fs; cancelling "
+                        "the scheduler loop", timeout_s,
+                    )
+                    hard = True
+            if hard and not task.done():
+                task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("scheduler loop raised during seize")
+        if self._inflight is not None or self._chain:
+            self.flight.record(
+                "scheduler.burst_abandon",
+                inflight=self._inflight is not None,
+                chained=len(self._chain),
+            )
+        self._inflight = None
+        self._chain.clear()
+        self._chain_members = []
+        self._chain_carry = None
+        self._chain_dispatched = 0
+        self._chain_pos0 = {}
+
+    def extract_requests(self) -> List[EngineRequest]:
+        """Detach every live request (slots, prefill batch, waiting
+        queue, pending remote prefills) WITHOUT finishing their streams
+        — the recovery controller migrates or fails each one. Requests
+        keep their block lists; the caller owns releasing them (after a
+        hot migration gathers the KV). Call only after ``seize``."""
+        out: List[EngineRequest] = []
+        for i, er in enumerate(self.slots):
+            if er is None:
+                continue
+            self.slots[i] = None
+            er.slot = -1
+            out.append(er)
+        self.prefilling.clear()
+        while self.waiting:
+            out.append(self.waiting.popleft())
+        for er in self.pending_remote:
+            if self.disagg is not None:
+                self.disagg.cancel(er.request_id, reason="drain")
+            er.remote_future = None
+            out.append(er)
+        self.pending_remote.clear()
+        for er in out:
+            self.flight.record(
+                "scheduler.extract", request_id=er.request_id,
+                trace_id=er.ctx.trace_id, generated=er.generated,
+                blocks=len(er.block_ids),
+            )
+        return out
+
+    def admit_migrated(self, er: EngineRequest, committed_tokens: List[int],
+                       block_ids: List[int]) -> bool:
+        """Admit a request migrated from a draining peer.
+
+        Hot (``block_ids`` non-empty, their KV already scattered): enter
+        the decode loop directly, exactly like a committed remote prefill
+        — except nothing is emitted here; every token up to and
+        including the pending one already streamed from the source.
+        Cold: join the waiting queue; the preemption-resume machinery
+        re-prefills ``prompt + resume_tokens`` and continues the stream.
+        Returns False (caller frees the blocks and nacks) when no slot
+        is free at install time."""
+        self._prepare_request(er)
+        if er.base_key is None:
+            # source predates per-request keys (or state was trimmed):
+            # fresh key — sampled continuations diverge from the
+            # counterfactual un-migrated stream, greedy ones do not
+            er.base_key = self._rng.integers(0, 2**32, size=2,
+                                             dtype=np.uint32)
+        er.ctx.add_stage("migration")
+        self.flight.record(
+            "scheduler.migrate_in", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, hot=bool(block_ids),
+            generated=er.generated,
+        )
+        if not block_ids:
+            # cold: never try remote prefill for a resumed stream (the
+            # remote path would restart from the prompt alone)
+            er.remote_attempted = bool(er.resume_tokens)
+            self.waiting.append(er)
+            self.wake.set()
+            return True
+        slot = self._free_slot()
+        if slot is None:
+            return False
+        bs = self.config.kv_block_size
+        er.slot = slot
+        er.block_ids = list(block_ids)
+        er.context_len = len(committed_tokens)
+        er.num_cached = 0
+        er.resume_tokens = []
+        er.seq = TokenSequence(committed_tokens, block_size=bs)
+        er.registered_blocks = 0
+        # every fallible step runs BEFORE the slot publishes: a failed
+        # install (e.g. a geometry surprise the receiver's reserve gate
+        # missed) must leave this scheduler exactly as it was — the
+        # written host-state row is harmless while the slot stays empty
+        self._host.install(er)
+        # penalty/PRNG state: presence of the prompt plus counts of every
+        # generated token (including the pending one — it was sampled and
+        # emitted; only its KV write is still owed)
+        gen = list(committed_tokens[len(er.prompt):])
+        if er.pending_token >= 0:
+            gen = gen + [er.pending_token]
+        self.runner.set_sample_row(
+            slot, er.prompt, gen,
+            logit_bias=er.req.sampling_options.logit_bias,
+        )
+        # completed prefix blocks become matchable here too — a migrated
+        # prefix seeds this worker's prefix cache
+        self._register_completed_blocks(er)
+        self.slots[slot] = er
+        self.wake.set()
+        return True
 
     def metrics(self) -> dict:
         active = sum(1 for s in self.slots if s is not None)
@@ -582,6 +746,10 @@ class Scheduler:
                 self.prefix_hit_tokens / self.prefix_total_tokens
                 if self.prefix_total_tokens else 0.0
             ),
+            # KV routers exclude draining workers from every decision
+            # (kv_router/scheduler.py) — the snapshot is the fastest
+            # deregistration channel there is
+            "draining": self.draining,
         }
         if self.config.spec_ngram_tokens or self.draft is not None:
             out["spec_proposed_tokens"] = self.spec_proposed
@@ -611,7 +779,9 @@ class Scheduler:
             "queue_depth": len(self.waiting),
             "pending_remote": len(self.pending_remote),
             "active": sum(1 for s in self.slots if s is not None),
-            "stopping": self._stopping,
+            # a draining engine's gated queue must not read as
+            # starvation — recovery owns it now, not the watchdog
+            "stopping": self._stopping or self.draining,
         }
 
     def request_table(self) -> List[dict]:
@@ -770,7 +940,7 @@ class Scheduler:
             # runner; the pending window bounds block reservations
             t_adm = time.monotonic()
             admitted = False
-            if self.disagg is not None:
+            if self.disagg is not None and not self.draining:
                 for er in list(self.waiting):
                     if (len(self.pending_remote)
                             >= self.config.max_batch_size):
@@ -782,6 +952,7 @@ class Scheduler:
             # local admission: claim a slot + blocks, join the prefill
             # batch (up to max_prefill_batch prompts prefill together)
             while (self.waiting
+                   and not self.draining
                    and len(self.prefilling) < self.config.max_prefill_batch
                    and self._free_slot() is not None):
                 er = self.waiting[0]
@@ -1061,10 +1232,16 @@ class Scheduler:
         (the decode loop's ONLY host sync), emit/stream them, run finish
         checks, and retro-invalidate rows that finished one burst late."""
         t_sync = time.monotonic()
-        toks, lpn, tv, ti = await loop.run_in_executor(
-            None, lambda: (np.asarray(infl.toks), np.asarray(infl.lps),
-                           np.asarray(infl.tv), np.asarray(infl.ti)),
-        )
+
+        def _sync_burst():
+            # chaos site: DYN_FAULT=decode_burst_hang wedges THIS thread
+            # — the exact executor-side shape of a hung Mosaic compile
+            # or a dead device mid-sync (utils/faults.py)
+            faults.maybe_hang("decode_burst_hang")
+            return (np.asarray(infl.toks), np.asarray(infl.lps),
+                    np.asarray(infl.tv), np.asarray(infl.ti))
+
+        toks, lpn, tv, ti = await loop.run_in_executor(None, _sync_burst)
         self._observe_host_sync(time.monotonic() - t_sync)
         self._last_burst_done_t = time.monotonic()
         for j in range(infl.k_steps):
@@ -2148,10 +2325,13 @@ class Scheduler:
                     commit=np.zeros(b, bool), want_top=False, **dkw,
                 )
         t_sync = time.monotonic()
-        toks, lpn, tv, ti = await loop.run_in_executor(
-            None, lambda: (np.asarray(next_tokens), np.asarray(lps),
-                           np.asarray(top_vals), np.asarray(top_ids))
-        )
+
+        def _sync_step():
+            faults.maybe_hang("decode_burst_hang")  # chaos site (see above)
+            return (np.asarray(next_tokens), np.asarray(lps),
+                    np.asarray(top_vals), np.asarray(top_ids))
+
+        toks, lpn, tv, ti = await loop.run_in_executor(None, _sync_step)
         self._observe_host_sync(time.monotonic() - t_sync)
         self._last_burst_done_t = time.monotonic()
         self.steps += 1

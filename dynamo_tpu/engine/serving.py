@@ -255,6 +255,17 @@ class JaxServingEngine(AsyncEngine):
         return engine
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[dict]:
+        if self.scheduler.draining or self.scheduler._stopping:
+            # a draining engine's admission is gated, and its extraction
+            # pass has (or will have) already run — a request queued now
+            # would sit in a seized scheduler forever. Fail fast with the
+            # retryable subclass (HTTP edge → 503 + Retry-After).
+            from ..runtime.engine import EngineDrainingError
+
+            raise EngineDrainingError(
+                "engine is draining (recovery or rolling update); "
+                "retry against the worker pool"
+            )
         payload = request.payload
         req = (
             payload

@@ -36,7 +36,13 @@ from ..protocols.openai import (
 from ..runtime.client import Client, NoInstancesError, RouterMode
 from ..runtime.component import DistributedRuntime
 from ..runtime.discovery import WatchEventType
-from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context, EngineError
+from ..runtime.engine import (
+    AsyncEngine,
+    AsyncEngineContext,
+    Context,
+    EngineDrainingError,
+    EngineError,
+)
 from ..runtime.network import ResponseStreamError
 from ..telemetry.tracing import TraceRecorder
 from .metrics import ServiceMetrics
@@ -106,6 +112,11 @@ class HttpService:
         self.app.router.add_get("/debug/requests", self.handle_debug_requests)
         self.app.router.add_get("/debug/requests/{rid}", self.handle_debug_request)
         self.app.router.add_get("/debug/flight", self.handle_flight)
+        # zero-downtime rolling updates: drain + live-migrate in-flight
+        # requests to peers (recovery/controller.py). Wired by the CLI
+        # when --self-heal builds a RecoveryController; 501 otherwise.
+        self.drainer = None  # async (mode, respawn) -> summary dict
+        self.app.router.add_post("/admin/drain", self.handle_admin_drain)
         if profile_dir:
             # opt-in only: trace capture costs device time and writes disk
             self.app.router.add_get("/debug/profile", self.handle_profile)
@@ -229,6 +240,14 @@ class HttpService:
             return web.json_response(
                 aggregate(chunks).model_dump(exclude_none=True),
                 headers={"X-Request-Id": ctx.trace_id},
+            )
+        except EngineDrainingError as e:
+            # transient: the worker behind this engine is draining for a
+            # recovery or rolling update — clients/LBs should retry
+            return web.json_response(
+                {"error": {"message": str(e), "type": "service_unavailable",
+                           "code": 503}},
+                status=503, headers={"Retry-After": "1"},
             )
         except (EngineError, ValueError) as e:
             return self._error(400, str(e))
@@ -409,6 +428,26 @@ class HttpService:
             artifact["filtered_to_request"] = rid
         return web.json_response(artifact, dumps=lambda o: json.dumps(
             o, default=str))
+
+    async def handle_admin_drain(self, request: web.Request) -> web.Response:
+        """POST /admin/drain[?mode=migrate|fail][&respawn=1] — stop
+        admission, let committed bursts finish, live-migrate the rest to
+        healthy peers, and (optionally) respawn — the rolling-model-
+        update runbook in docs/self_healing.md. Returns the drain
+        summary (requests finished / migrated / failed, duration)."""
+        if self.drainer is None:
+            return web.json_response(
+                {"error": "no recovery controller attached "
+                          "(serve with --self-heal)"},
+                status=501,
+            )
+        mode = request.query.get("mode", "migrate")
+        if mode not in ("migrate", "fail"):
+            return web.json_response({"error": f"bad mode {mode!r}"},
+                                     status=400)
+        respawn = request.query.get("respawn") in ("1", "true", "yes")
+        summary = await self.drainer(mode=mode, respawn=respawn)
+        return web.json_response(summary)
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """GET /debug/profile?seconds=N — capture an XLA profiler trace of

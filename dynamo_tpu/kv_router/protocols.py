@@ -69,6 +69,9 @@ class ForwardPassMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # recovery drain (recovery/controller.py): a draining worker accepts
+    # no new requests — routers must exclude it from every decision
+    draining: bool = False
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)

@@ -75,6 +75,11 @@ class KvRouter:
             "Workers excluded from a scheduling decision because their "
             "load snapshot exceeded the staleness bound",
         )
+        self._draining_skips = self.registry.counter(
+            "dynamo_kv_router_draining_worker_skips_total",
+            "Workers excluded from a scheduling decision because their "
+            "load snapshot carried the recovery-drain flag",
+        )
 
     def _on_worker_gone(self, worker_id: str) -> None:
         self.scheduler.remove_worker(worker_id)
@@ -108,6 +113,8 @@ class KvRouter:
         # federation pattern: the scheduler counts exclusions; the series
         # mirrors its monotonic total (set_sample, not inc)
         self._stale_skips.set_sample(float(self.scheduler.stale_skips))
+        self._draining_skips.set_sample(
+            float(self.scheduler.draining_skips))
         self._decisions.inc(worker=str(decision.worker_id))
         self._overlap_blocks.inc(
             decision.matched_blocks, worker=str(decision.worker_id)

@@ -68,6 +68,7 @@ class KvScheduler:
         self.clock = clock
         self.workers: Dict[str, WorkerState] = {}
         self.stale_skips = 0  # lifetime stale-worker exclusions
+        self.draining_skips = 0  # lifetime draining-worker exclusions
 
     def update_metrics(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
         now = self.clock()
@@ -92,17 +93,33 @@ class KvScheduler:
             raise AllWorkersBusy("no workers with metrics")
         total_blocks_needed = math.ceil(isl_tokens / self.block_size)
 
-        candidates = self.workers
+        # draining workers (recovery drain / rolling update) are out of
+        # the pool outright — unlike staleness there is no fallback: a
+        # drain is an explicit "send me nothing", and routing there
+        # would hand the request straight to a migration
+        candidates = {
+            wid: s for wid, s in self.workers.items()
+            if not getattr(s.metrics, "draining", False)
+        }
+        if len(candidates) < len(self.workers):
+            self.draining_skips += len(self.workers) - len(candidates)
+            logger.debug(
+                "kv schedule: skipping %d draining worker(s): %s",
+                len(self.workers) - len(candidates),
+                sorted(set(self.workers) - set(candidates)),
+            )
+        if not candidates:
+            raise AllWorkersBusy("all workers are draining")
         if self.staleness_bound_s:
             cutoff = self.clock() - self.staleness_bound_s
-            fresh = {wid: s for wid, s in self.workers.items()
+            fresh = {wid: s for wid, s in candidates.items()
                      if s.updated_at >= cutoff}
-            if fresh and len(fresh) < len(self.workers):
-                self.stale_skips += len(self.workers) - len(fresh)
+            if fresh and len(fresh) < len(candidates):
+                self.stale_skips += len(candidates) - len(fresh)
                 logger.debug(
                     "kv schedule: skipping %d stale worker(s): %s",
-                    len(self.workers) - len(fresh),
-                    sorted(set(self.workers) - set(fresh)),
+                    len(candidates) - len(fresh),
+                    sorted(set(candidates) - set(fresh)),
                 )
                 candidates = fresh
             elif not fresh:

@@ -123,6 +123,23 @@ class SubprocessEngine(AsyncEngine):
         # observability for tests/metrics: how many times the child was
         # (re)spawned successfully
         self.spawn_count = 0
+        # respawn observability: child deaths were invisible to telemetry
+        # — the restart counter (scraped via host_registry) and the
+        # engine.respawn flight event make every supervision cycle an
+        # auditable fact instead of a log line
+        from ...telemetry.registry import MetricsRegistry
+
+        self.host_registry = MetricsRegistry()
+        self._restarts = self.host_registry.counter(
+            "dynamo_engine_restarts_total",
+            "Supervised engine-child respawns, labelled reason="
+            "exit|heartbeat|disconnect|manual (what took the previous "
+            "child down)",
+        )
+        self._last_down_kind: Optional[str] = None
+        # child-death subscribers (recovery/controller.py): called with
+        # the down reason AFTER streams are failed; never during close()
+        self._down_listeners: list = []
 
     @classmethod
     async def load(
@@ -238,6 +255,16 @@ class SubprocessEngine(AsyncEngine):
         self._writer = writer
         self._pong = 0
         self.spawn_count += 1
+        if self.spawn_count > 1:
+            # a RE-spawn: the previous child died for _last_down_kind
+            reason = self._last_down_kind or "unknown"
+            self._restarts.inc(reason=reason)
+            from ...telemetry.flight import flight_recorder
+
+            flight_recorder().record(
+                "engine.respawn", path=self.path, pid=proc.pid,
+                spawn=self.spawn_count, reason=reason,
+            )
         self._reader_task = asyncio.create_task(self._read_loop(reader))
         self._hb_task = asyncio.create_task(self._heartbeat_loop(writer))
         logger.info(
@@ -304,7 +331,8 @@ class SubprocessEngine(AsyncEngine):
                     )
                     await self._on_child_down(
                         f"engine unresponsive: missed "
-                        f"{n - self._pong} heartbeats"
+                        f"{n - self._pong} heartbeats",
+                        kind="heartbeat",
                     )
                     return
         except (ConnectionResetError, BrokenPipeError, OSError):
@@ -312,7 +340,8 @@ class SubprocessEngine(AsyncEngine):
         except asyncio.CancelledError:
             raise
 
-    async def _on_child_down(self, reason: str) -> None:
+    async def _on_child_down(self, reason: str,
+                             kind: str = "disconnect") -> None:
         """Fail all in-flight streams and reap the child. Idempotent —
         and the hand-off is claimed SYNCHRONOUSLY before the first await:
         the heartbeat path and the read-loop EOF path race to call this,
@@ -322,6 +351,7 @@ class SubprocessEngine(AsyncEngine):
         writer, self._writer = self._writer, None
         streams, self._streams = self._streams, {}
         hb, self._hb_task = self._hb_task, None
+        winner = proc is not None or writer is not None or bool(streams)
         # the dead child's cached blocks died with its allocator: purge
         # them from the worker-side radix index before anything else
         # (synchronous, like the stream failures below)
@@ -333,6 +363,14 @@ class SubprocessEngine(AsyncEngine):
                 logger.exception("KV purge after child death failed")
         if proc is not None and proc.returncode is not None:
             reason = f"{reason} (exit code {proc.returncode})"
+            kind = "exit"
+        if winner and not self._closed:
+            self._last_down_kind = kind
+            for fn in list(self._down_listeners):
+                try:
+                    fn(kind)
+                except Exception:
+                    logger.exception("engine down listener failed")
         # fail the streams before any await: past the first suspension
         # point this task can itself be cancelled by the competing path
         # (the read loop cancels the heartbeat task, and vice versa), and
@@ -349,6 +387,21 @@ class SubprocessEngine(AsyncEngine):
                 proc.kill()
             with contextlib.suppress(Exception):
                 await proc.wait()
+
+    def add_down_listener(self, fn) -> None:
+        """Subscribe to child deaths (sync callback with the down kind;
+        not invoked for close()). The recovery controller uses this to
+        run its respawn ladder proactively instead of waiting for the
+        next request to pay the spawn."""
+        self._down_listeners.append(fn)
+
+    async def respawn(self, reason: str = "manual") -> None:
+        """Kill the current child (failing its streams) and bring a
+        fresh one up NOW — the supervised-child half of a recovery
+        respawn or a rolling engine restart."""
+        await self._on_child_down(f"manual respawn: {reason}",
+                                  kind="manual")
+        await self._ensure_running()
 
     async def close(self) -> None:
         self._closed = True
@@ -531,6 +584,12 @@ async def _child_main(engine_path: str) -> int:
                     pass
             await send(pong)
         elif t == "req":
+            from ...utils import faults
+
+            if faults.fire("child_exit"):
+                # chaos site: the child dies hard mid-serve — the parent
+                # must fail the stream and respawn (utils/faults.py)
+                os._exit(17)
             rid = frame["id"]
             tasks[rid] = asyncio.create_task(
                 run_stream(rid, frame.get("payload"))

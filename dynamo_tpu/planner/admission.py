@@ -61,7 +61,7 @@ class AdmissionRejected(Exception):
                  outcome: str = "shed"):
         super().__init__(message)
         self.retry_after_s = retry_after_s
-        self.outcome = outcome  # "shed" | "queue_full" | "timeout"
+        self.outcome = outcome  # "shed" | "queue_full" | "timeout" | "draining"
 
     @property
     def retry_after_header(self) -> str:
@@ -114,12 +114,16 @@ class AdmissionController:
             for level in range(len(PRIORITY_CLASSES))
         }
         self.shed_total = 0  # lifetime rejections, planner signal
+        # recovery drain (recovery/controller.py): while True EVERY class
+        # is rejected at the door — a draining worker takes nothing new,
+        # regardless of shed level or free slots
+        self.draining = False
 
         self.registry = registry or MetricsRegistry()
         self._admissions = self.registry.counter(
             "dynamo_planner_admissions_total",
             "Admission decisions by priority= class and outcome="
-            "admitted|shed|queue_full|timeout",
+            "admitted|shed|queue_full|timeout|draining",
         )
         self._queue_wait = self.registry.histogram(
             "dynamo_planner_queue_wait_seconds",
@@ -196,6 +200,22 @@ class AdmissionController:
                 w.fut.set_exception(self._rejection(class_level, "shed"))
         self._grant_free_slots()
 
+    def set_draining(self, draining: bool = True) -> None:
+        """Drain-aware admission: reject every class while the engine
+        behind this edge drains (recovery ladder / rolling update), and
+        flush already-queued waiters — their wait can only end in a
+        migration or a restart, never an admission."""
+        self.draining = draining
+        if not draining:
+            self._grant_free_slots()
+            return
+        for queue in self._queues.values():
+            while queue:
+                w = queue.popleft()
+                if w.abandoned or w.fut.done():
+                    continue
+                w.fut.set_exception(self._rejection(w.priority, "draining"))
+
     # ---------- request path ----------
 
     async def acquire(self, priority: int, request_id: str = "") -> None:
@@ -203,6 +223,9 @@ class AdmissionController:
         :class:`AdmissionRejected` on shed / queue-full / deadline."""
         priority = max(0, min(int(priority), len(PRIORITY_CLASSES) - 1))
         cls = PRIORITY_CLASSES[priority]
+        if self.draining:
+            self._count_rejection(priority, "draining", request_id)
+            raise self._rejection(priority, "draining")
         if priority < self.shed_level:
             self._count_rejection(priority, "shed", request_id)
             raise self._rejection(priority, "shed")
@@ -245,9 +268,11 @@ class AdmissionController:
             self._inflight -= 1
             self._grant_free_slots()
             raise
-        except AdmissionRejected:
-            # set_shed_level flushed this waiter mid-queue
-            self._count_rejection(priority, "shed", request_id)
+        except AdmissionRejected as e:
+            # set_shed_level / set_draining flushed this waiter mid-queue
+            self._count_rejection(
+                priority, getattr(e, "outcome", None) or "shed", request_id
+            )
             raise
         self._admissions.inc(priority=cls, outcome="admitted")
         self._queue_wait.observe(
@@ -266,6 +291,9 @@ class AdmissionController:
         if outcome == "shed":
             msg = (f"service saturated; priority class {cls!r} is being "
                    f"shed — retry later")
+        elif outcome == "draining":
+            msg = ("worker is draining (recovery or rolling update) — "
+                   "retry against the pool")
         elif outcome == "queue_full":
             msg = f"admission queue full for priority class {cls!r}"
         else:

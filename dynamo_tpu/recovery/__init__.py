@@ -1,0 +1,32 @@
+"""Self-healing serving: watchdog-driven drain/respawn + live migration.
+
+``controller.py`` owns the policy ladder (trip → drain → migrate →
+respawn); ``migration.py`` owns the wire plane that moves an in-flight
+request's committed KV + generation state to a healthy peer and relays
+its continued stream back. See docs/self_healing.md.
+"""
+
+from .controller import RecoveryConfig, RecoveryController
+from .migration import (
+    MigrationRejected,
+    MigrationServer,
+    MigrationSink,
+    MigrationState,
+    migrate_request,
+    migration_class,
+    migration_key,
+    package_request,
+)
+
+__all__ = [
+    "RecoveryConfig",
+    "RecoveryController",
+    "MigrationRejected",
+    "MigrationServer",
+    "MigrationSink",
+    "MigrationState",
+    "migrate_request",
+    "migration_class",
+    "migration_key",
+    "package_request",
+]

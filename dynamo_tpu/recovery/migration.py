@@ -1,0 +1,576 @@
+"""Live request migration: move an in-flight request to a healthy peer.
+
+A draining engine packages each live request's *committed* state — the
+token ids whose KV sits in its paged cache, the pending sampled token,
+the generation counter, the per-request PRNG key, and the sampling/
+penalty-relevant request body — and ships it to a peer engine, which
+admits the request into its own cache and resumes decode mid-stream.
+Two modes:
+
+- **hot** (healthy device, e.g. ``POST /admin/drain`` rolling updates):
+  the committed KV blocks ride along (gathered chunk-by-chunk, the same
+  bounded-frame discipline as the streamed prefill transfer), so the
+  peer resumes without recomputing anything.
+- **cold** (wedged device — a hung gather would just wedge the drain
+  too): only tokens ship; the peer re-prefills ``prompt + resume``
+  through the scheduler's existing preemption-resume machinery, which
+  already guarantees the continued stream is byte-identical.
+
+The client's stream never breaks: the source worker keeps the client
+connection and *relays* — after the peer commits, generated outputs
+stream back over the same migration connection and the source forwards
+them into the original request's output queue. The hop is recorded as a
+``migration`` trace stage (``/debug/requests/{id}``) and a
+``recovery.migrate`` flight event. Commit/poison semantics mirror
+``disagg/transfer.py``: a connection that dies before commit aborts the
+reservation on the receiver (blocks freed, nothing installed); a death
+after commit cancels the resumed request (its relay target is gone).
+
+Wire format (4-byte length-prefixed msgpack headers + raw payloads, the
+transfer plane's framing), one migration per connection::
+
+    → {type:"mig_begin", state:{...}, nblocks}
+    ← {type:"mig_ack", ok, reason?}
+    → {type:"mig_blocks", offset, shape, dtype, k_bytes, v_bytes} <k> <v>
+    → {type:"mig_commit"}
+    ← {type:"mig_ack", ok, reason?}
+    ← {type:"mig_data", payload: EngineOutput wire} ...
+    ← {type:"mig_end"} | {type:"mig_error", error}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import struct
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from ..disagg.transfer import MAX_HEADER, _np_dtype, _read_exact
+from ..protocols.common import EngineOutput, FinishReason, PreprocessedRequest
+from ..runtime.engine import AsyncEngineContext
+from ..telemetry.flight import flight_recorder
+from ..utils import faults
+
+logger = logging.getLogger(__name__)
+
+# blocks per KV frame: bounds sender/receiver host buffers like the
+# streamed prefill transfer's chunk frames
+MIGRATE_CHUNK_BLOCKS = 16
+
+
+def migration_key(namespace: str, component: str, engine_id: str) -> str:
+    """Discovery-plane key a worker's migration receiver registers under
+    (lease-scoped, like the KV transfer descriptor)."""
+    return f"{namespace}/components/{component}/migration/{engine_id}"
+
+
+class MigrationRejected(Exception):
+    """The peer cannot take this request (no slot, no memory, geometry
+    mismatch). The caller tries the next peer or fails the request."""
+
+
+@dataclasses.dataclass
+class MigrationState:
+    """Everything a peer needs to resume the request byte-identically."""
+
+    request_id: str
+    trace_id: str
+    req: dict                       # PreprocessedRequest.to_wire()
+    # hot: tokens whose KV ships (prompt + generated, == context_len);
+    # empty for a cold migration
+    committed_tokens: List[int]
+    # cold: generated tokens already emitted to the client (incl. the
+    # pending one) — the peer re-prefills prompt + resume and continues
+    resume_tokens: List[int]
+    pending_token: int              # sampled, emitted, KV not yet written
+    generated: int                  # max_tokens accounting + PRNG fold-in
+    base_key: List[int]             # per-request PRNG key (uint32 ×2)
+    prompt_lps_emitted: bool
+    kv_block_size: int              # geometry must match across engines
+
+    @property
+    def hot(self) -> bool:
+        return bool(self.committed_tokens)
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "MigrationState":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def migration_class(er) -> str:
+    """Migrate-vs-fail decision per request class: ``hot`` | ``cold`` |
+    ``fail``.
+
+    - guided_json → **fail**: the compiled-grammar cursor lives in the
+      serving layer's in-process cache; it cannot serialize, and a cold
+      resume on the peer would decode unconstrained.
+    - guided_choice → **cold**: ``_start_prefill`` rebuilds the trie
+      constraint from the request body and walks it past the resume
+      tokens — the peer reconstructs the exact cursor.
+    - prompt-logprobs not yet emitted → **cold**: the accumulated
+      device rows cannot ship; the peer's re-prefill recomputes them.
+    - mid-prefill / still-waiting → **cold** (no complete KV to ship).
+    - plain decode-state requests → **hot**.
+    """
+    so = er.req.sampling_options
+    if so.guided_json:
+        return "fail"
+    if er.guided is not None or so.guided_choice_token_ids:
+        return "cold"
+    if er.want_prompt_lps and not er.prompt_lps_emitted:
+        return "cold"
+    if (er.seq is None or er.pending_token < 0
+            or er.context_len != len(er.seq.token_ids)):
+        return "cold"
+    return "hot"
+
+
+def package_request(er, allocator, kv_block_size: int,
+                    allow_hot: bool = True) -> MigrationState:
+    """Build the wire state from an extracted request, releasing the
+    over-reserved block tail (hot) or all blocks (cold) back to the
+    source allocator. After this the request holds exactly the blocks
+    that must ship (hot) or none (cold)."""
+    cls = migration_class(er)
+    hot = allow_hot and cls == "hot"
+    if hot:
+        bs = kv_block_size
+        keep = -(-er.context_len // bs)
+        er.block_ids = allocator.rollback_tail(er.block_ids, keep)
+        committed = [int(t) for t in er.seq.token_ids]
+        resume: List[int] = []
+    else:
+        # cold: same resume computation as Scheduler._preempt — tokens
+        # already emitted continue, never restart
+        if er.seq is not None:
+            gen = [int(t) for t in er.seq.token_ids[len(er.prompt):]]
+            if er.pending_token >= 0:
+                gen = gen + [int(er.pending_token)]
+        else:
+            gen = [int(t) for t in er.resume_tokens]
+        committed = []
+        resume = gen
+        allocator.free_blocks(er.block_ids)
+        er.block_ids = []
+    return MigrationState(
+        request_id=er.request_id,
+        trace_id=er.ctx.trace_id,
+        req=er.req.to_wire(),
+        committed_tokens=committed,
+        resume_tokens=resume,
+        pending_token=int(er.pending_token) if hot else -1,
+        generated=int(er.generated),
+        base_key=[int(x) for x in np.asarray(er.base_key).tolist()]
+        if er.base_key is not None else [],
+        prompt_lps_emitted=bool(er.prompt_lps_emitted),
+        kv_block_size=kv_block_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _pack(writer: asyncio.StreamWriter, header: dict,
+          *payloads: bytes) -> None:
+    data = msgpack.packb(header, use_bin_type=True)
+    writer.write(struct.pack(">I", len(data)) + data)
+    for p in payloads:
+        writer.write(p)
+
+
+async def _read_header(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        raw_len = await _read_exact(reader, 4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (hlen,) = struct.unpack(">I", raw_len)
+    if hlen > MAX_HEADER:
+        raise ValueError(f"migration header too large: {hlen}")
+    return msgpack.unpackb(await _read_exact(reader, hlen), raw=False)
+
+
+# ---------------------------------------------------------------------------
+# receiver
+# ---------------------------------------------------------------------------
+
+
+class MigrationSink:
+    """Target-side binding to one engine: reserve blocks, scatter shipped
+    KV, and install the resumed request into the scheduler."""
+
+    def __init__(self, scheduler, runner):
+        self.scheduler = scheduler
+        self.runner = runner
+        # mig id → (state, block_ids) reserved but not yet committed
+        self._pending: Dict[str, Tuple[MigrationState, List[int]]] = {}
+
+    def reserve(self, state: MigrationState, nblocks: int) -> List[int]:
+        sched = self.scheduler
+        cfg = sched.config
+        if sched.draining:
+            raise MigrationRejected("peer is itself draining")
+        # geometry/capacity gate BEFORE any state mutates: a sequence
+        # this engine cannot hold must nack here, not blow up inside
+        # admit/prefill and corrupt a healthy scheduler (+1: the pending
+        # token still needs a writable position below the horizon)
+        prompt_len = len((state.req or {}).get("token_ids") or [])
+        total = (len(state.committed_tokens)
+                 or prompt_len + len(state.resume_tokens))
+        if total + 1 >= cfg.max_model_len:
+            raise MigrationRejected(
+                f"sequence of {total} tokens exceeds this engine's "
+                f"max_model_len {cfg.max_model_len}"
+            )
+        if nblocks > 0:
+            if state.kv_block_size != cfg.kv_block_size:
+                raise MigrationRejected(
+                    f"kv_block_size mismatch: sender "
+                    f"{state.kv_block_size} vs {cfg.kv_block_size}"
+                )
+            if nblocks > cfg.blocks_per_seq:
+                raise MigrationRejected(
+                    f"{nblocks} blocks exceed this engine's block-table "
+                    f"width {cfg.blocks_per_seq}"
+                )
+            if sched._free_slot() is None:
+                raise MigrationRejected("no free slot")
+            try:
+                block_ids = sched.allocator.allocate_n(nblocks)
+            except MemoryError as e:
+                raise MigrationRejected(f"no KV memory: {e}") from None
+        else:
+            block_ids = []
+        self._pending[state.request_id] = (state, block_ids)
+        return block_ids
+
+    async def scatter(self, mig_id: str, offset: int,
+                      k: np.ndarray, v: np.ndarray) -> None:
+        entry = self._pending.get(mig_id)
+        if entry is None:
+            raise MigrationRejected(f"unknown migration {mig_id}")
+        _state, block_ids = entry
+        n = k.shape[1]
+        if offset < 0 or offset + n > len(block_ids):
+            raise MigrationRejected(
+                f"block frame [{offset}:{offset + n}) outside reservation "
+                f"of {len(block_ids)}"
+            )
+        import jax
+
+        loop = asyncio.get_running_loop()
+        # stage the host→device copy off-loop (coordinator._scatter's
+        # discipline); the cache-mutating scatter stays on the loop so it
+        # serializes with the scheduler's own dispatches
+        k_dev, v_dev = await loop.run_in_executor(
+            None, lambda: (jax.device_put(k), jax.device_put(v))
+        )
+        # the migration may have been aborted during the await
+        if mig_id not in self._pending:
+            logger.info("dropping late migration KV frame for %s", mig_id)
+            return
+        self.runner.scatter_blocks(
+            block_ids[offset:offset + n], k_dev, v_dev
+        )
+
+    def commit(self, mig_id: str):
+        """Install the migrated request; returns the live EngineRequest
+        whose out_queue the caller pumps back to the sender."""
+        entry = self._pending.pop(mig_id, None)
+        if entry is None:
+            raise MigrationRejected(f"unknown migration {mig_id}")
+        state, block_ids = entry
+        # engine-side ids stay server-generated (PR 1 invariant): a
+        # duplicate/replayed migration id must not collide in scheduler
+        # state; the trace id alone carries cross-worker correlation
+        from ..engine.scheduler import EngineRequest
+
+        req = PreprocessedRequest.from_wire(state.req)
+        er = EngineRequest(
+            request_id=uuid.uuid4().hex,
+            prompt=list(req.token_ids),
+            req=req,
+            ctx=AsyncEngineContext(trace_id=state.trace_id or None),
+            out_queue=asyncio.Queue(),
+        )
+        er.generated = int(state.generated)
+        er.pending_token = int(state.pending_token)
+        er.prompt_lps_emitted = bool(state.prompt_lps_emitted)
+        er.resume_tokens = [int(t) for t in state.resume_tokens]
+        if state.base_key:
+            er.base_key = np.asarray(state.base_key, np.uint32)
+        try:
+            ok = self.scheduler.admit_migrated(
+                er, [int(t) for t in state.committed_tokens], block_ids
+            )
+        except Exception as e:
+            # install failures must stay MigrationRejected (blocks freed,
+            # sender nacked) — never corrupt the healthy peer's scheduler
+            self.scheduler.allocator.free_blocks(block_ids)
+            raise MigrationRejected(f"install failed: {e}") from e
+        if not ok:
+            self.scheduler.allocator.free_blocks(block_ids)
+            raise MigrationRejected("no free slot at commit")
+        return er
+
+    def abort(self, mig_id: str) -> None:
+        entry = self._pending.pop(mig_id, None)
+        if entry is not None:
+            _state, block_ids = entry
+            self.scheduler.allocator.free_blocks(block_ids)
+            flight_recorder().record(
+                "recovery.migrate_poison", request_id=_state.request_id,
+            )
+
+
+class MigrationServer:
+    """TCP receiver for inbound migrations, one migration per connection.
+
+    After commit the connection flips to streaming mode: the resumed
+    request's outputs ride back as ``mig_data`` frames until the stream
+    ends. A connection death before commit aborts the reservation (the
+    transfer plane's poison discipline); after commit it cancels the
+    resumed request — its relay target is gone."""
+
+    def __init__(self, sink: MigrationSink, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.sink = sink
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "MigrationServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def descriptor(self) -> dict:
+        return {"host": self.host, "port": self.port}
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        mig_id: Optional[str] = None
+        er = None
+        try:
+            while True:
+                header = await _read_header(reader)
+                if header is None:
+                    return
+                mtype = header.get("type")
+                if mtype == "mig_begin":
+                    state = MigrationState.from_wire(header["state"])
+                    try:
+                        self.sink.reserve(
+                            state, int(header.get("nblocks", 0))
+                        )
+                    except MigrationRejected as e:
+                        _pack(writer, {"type": "mig_ack", "ok": False,
+                                       "reason": str(e)})
+                        await writer.drain()
+                        return
+                    mig_id = state.request_id
+                    _pack(writer, {"type": "mig_ack", "ok": True})
+                    await writer.drain()
+                elif mtype == "mig_blocks":
+                    k_raw = await _read_exact(reader, header["k_bytes"])
+                    v_raw = await _read_exact(reader, header["v_bytes"])
+                    dtype = _np_dtype(header["dtype"])
+                    shape = tuple(header["shape"])
+                    await self.sink.scatter(
+                        mig_id, int(header["offset"]),
+                        np.frombuffer(k_raw, dtype=dtype).reshape(shape),
+                        np.frombuffer(v_raw, dtype=dtype).reshape(shape),
+                    )
+                elif mtype == "mig_commit":
+                    try:
+                        er = self.sink.commit(mig_id)
+                    except MigrationRejected as e:
+                        _pack(writer, {"type": "mig_ack", "ok": False,
+                                       "reason": str(e)})
+                        await writer.drain()
+                        return
+                    mig_id = None  # installed: no reservation to abort
+                    _pack(writer, {"type": "mig_ack", "ok": True})
+                    await writer.drain()
+                    await self._pump(er, writer)
+                    return
+                else:
+                    logger.error("unknown migration frame %r", mtype)
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, OSError):
+            pass
+        except MigrationRejected as e:
+            logger.warning("migration aborted: %s", e)
+        except Exception:
+            logger.exception("migration connection failed")
+        finally:
+            if mig_id is not None:
+                # died before commit: nothing installed — free the
+                # reservation (poison: a partial KV stream must never
+                # become a live request)
+                self.sink.abort(mig_id)
+            if er is not None and er.finish is None:
+                # died after commit: the relay (and so the client) is
+                # gone — stop the resumed request
+                er.ctx.stop_generating()
+            writer.close()
+
+    async def _pump(self, er, writer: asyncio.StreamWriter) -> None:
+        """Stream the resumed request's outputs back to the sender."""
+        while True:
+            out = await er.out_queue.get()
+            if out is None:
+                _pack(writer, {"type": "mig_end"})
+                await writer.drain()
+                return
+            _pack(writer, {"type": "mig_data", "payload": out.to_wire()})
+            await writer.drain()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# sender
+# ---------------------------------------------------------------------------
+
+
+async def migrate_request(
+    host: str,
+    port: int,
+    er,
+    state: MigrationState,
+    gather=None,                  # (block_ids) -> (k, v) host arrays; hot only
+    chunk_blocks: int = MIGRATE_CHUNK_BLOCKS,
+    connect_timeout_s: float = 5.0,
+) -> asyncio.Task:
+    """Ship one request to a peer and return the spawned relay task.
+
+    Raises ``MigrationRejected`` (peer nacked) or ``OSError``/
+    ``ConnectionError`` (peer unreachable, stream died) — in both cases
+    nothing was installed remotely and the caller may try another peer.
+    On success the request's blocks are the caller's to free; the
+    returned task relays the peer's outputs into ``er.out_queue`` until
+    the stream ends (the caller holds it and cancels on shutdown).
+    """
+    block_ids = list(er.block_ids) if state.hot else []
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), connect_timeout_s
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        _pack(writer, {
+            "type": "mig_begin", "state": state.to_wire(),
+            "nblocks": len(block_ids),
+        })
+        await writer.drain()
+        ack = await _read_header(reader)
+        if ack is None or not ack.get("ok"):
+            raise MigrationRejected(
+                (ack or {}).get("reason", "peer closed during begin")
+            )
+        for i in range(0, len(block_ids), chunk_blocks):
+            if faults.fire("transfer_conn_drop"):
+                writer.close()
+                raise ConnectionResetError(
+                    "fault injected: transfer_conn_drop"
+                )
+            ids = block_ids[i:i + chunk_blocks]
+            # the gather host-syncs device memory — off the loop, chunked
+            # so host buffers stay bounded at one frame
+            k, v = await loop.run_in_executor(
+                None, lambda ids=ids: gather(ids)
+            )
+            k = np.ascontiguousarray(k)
+            v = np.ascontiguousarray(v)
+            kb, vb = k.tobytes(), v.tobytes()
+            _pack(writer, {
+                "type": "mig_blocks", "offset": i,
+                "shape": list(k.shape), "dtype": k.dtype.name,
+                "k_bytes": len(kb), "v_bytes": len(vb),
+            }, kb, vb)
+            await writer.drain()
+        _pack(writer, {"type": "mig_commit"})
+        await writer.drain()
+        ack = await _read_header(reader)
+        if ack is None or not ack.get("ok"):
+            raise MigrationRejected(
+                (ack or {}).get("reason", "peer closed during commit")
+            )
+    except BaseException:
+        writer.close()
+        raise
+    # committed: the peer owns the request now. Stamp the hop where
+    # /debug/requests/{id} will show it, then relay.
+    er.ctx.add_stage("migration")
+    flight_recorder().record(
+        "recovery.migrate", request_id=er.request_id,
+        trace_id=er.ctx.trace_id, peer=f"{host}:{port}",
+        hot=state.hot, blocks=len(block_ids),
+        generated=int(state.generated),
+    )
+    return asyncio.get_running_loop().create_task(
+        _relay(reader, writer, er), name=f"mig-relay-{er.request_id[:8]}"
+    )
+
+
+async def _relay(reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, er) -> None:
+    """Forward the peer's resumed outputs into the original out_queue —
+    the client's stream continues without a break. A client disconnect
+    propagates to the peer by closing the connection."""
+    ended = False
+
+    async def watch_cancel():
+        await er.ctx.wait_stopped()
+        writer.close()  # peer sees the death and stops the request
+
+    cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
+    try:
+        while True:
+            header = await _read_header(reader)
+            if header is None:
+                break  # peer died mid-stream
+            mtype = header.get("type")
+            if mtype == "mig_data":
+                er.out_queue.put_nowait(
+                    EngineOutput.from_wire(header.get("payload") or {})
+                )
+            elif mtype == "mig_end":
+                er.out_queue.put_nowait(None)
+                ended = True
+                return
+            elif mtype == "mig_error":
+                logger.error("migrated request %s failed remotely: %s",
+                             er.request_id, header.get("error"))
+                break
+            else:
+                logger.error("unknown relay frame %r", mtype)
+                break
+    finally:
+        cancel_task.cancel()
+        writer.close()
+        if not ended and not er.ctx.is_stopped:
+            # the peer (or its connection) died mid-stream: the client
+            # must see a terminal frame, not silence
+            er.out_queue.put_nowait(
+                EngineOutput(token_ids=[],
+                             finish_reason=FinishReason.ERROR)
+            )
+            er.out_queue.put_nowait(None)

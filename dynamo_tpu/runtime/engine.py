@@ -145,3 +145,10 @@ class EngineError(Exception):
     get a clean error instead of an empty stream (reference:
     lib/runtime/src/pipeline/network/egress/push.rs ResponseStreamPrologue).
     """
+
+
+class EngineDrainingError(EngineError):
+    """The engine is draining (recovery ladder / rolling update) and takes
+    no new work. Transient by construction — the HTTP edge maps it to a
+    retryable 503 (vs. EngineError's 400) so load balancers and clients
+    re-dispatch to the pool instead of surfacing a client error."""

@@ -240,6 +240,10 @@ class StallWatchdog:
             "periodic sleep fired vs. its deadline",
         )
         self._task: Optional[asyncio.Task] = None
+        # trip subscribers (recovery/controller.py): called with the trip
+        # info dict AFTER the artifact is dumped — detection stays useful
+        # even when the subscriber's recovery goes wrong
+        self._trip_listeners: List[Callable[[dict], None]] = []
         # (steps value, monotonic time it last changed) for no_throughput
         self._steps_mark: Optional[tuple] = None
         # reasons currently tripped; re-arm only when the condition clears
@@ -375,7 +379,18 @@ class StallWatchdog:
             f" — flight artifact at {path}" if path
             else f" — set {FLIGHT_DIR_ENV} to persist flight artifacts",
         )
+        for fn in list(self._trip_listeners):
+            try:
+                fn(info)
+            except Exception:
+                # recovery must never take detection down with it
+                logger.exception("watchdog trip listener failed")
         return path
+
+    def add_trip_listener(self, fn: Callable[[dict], None]) -> None:
+        """Subscribe to trips (sync callback; schedule your own task for
+        anything long-running — the watchdog keeps sampling)."""
+        self._trip_listeners.append(fn)
 
     def _dump(self, reason: str) -> Optional[str]:
         # no flight= argument: this watchdog is registered in _SOURCES,

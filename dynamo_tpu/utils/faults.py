@@ -1,0 +1,154 @@
+"""First-class fault injection: make an engine wedge on demand.
+
+The self-healing stack (recovery/) is only trustworthy if its failure
+modes can be produced deterministically — a chaos test that waits for a
+real Mosaic hang is not a test. ``DYN_FAULT`` names injection *sites*
+compiled into the hot paths they sabotage::
+
+    DYN_FAULT=decode_burst_hang:once            # wedge the next decode sync
+    DYN_FAULT=transfer_conn_drop:0.1            # drop 10% of KV transfer conns
+    DYN_FAULT=child_exit:once,decode_burst_hang:0.01
+
+Spec grammar: ``site:once`` fires exactly once, ``site:<float>`` fires
+with that probability per evaluation, ``site:off`` disarms. Tests arm
+sites programmatically with ``arm()`` (no env mutation) and release
+hung sites with ``release()``.
+
+Sites currently wired (each documented in docs/self_healing.md):
+
+- ``decode_burst_hang`` — the scheduler's decode host-sync blocks (in
+  its executor thread) until ``release()``: the exact shape of a hung
+  Mosaic compile or a dead device, and the wedge the stall watchdog's
+  ``decode_stall`` trip exists to catch.
+- ``transfer_conn_drop`` — a KV transfer / migration client connection
+  dies mid-stream, exercising the receiver's poison-the-commit path.
+- ``child_exit`` — a supervised engine child (subprocess_host) exits
+  hard mid-serve, exercising the respawn ladder.
+
+Every fire is recorded in the flight ring (``fault.injected``) so a
+chaos run's artifact shows exactly which failures were synthetic.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+FAULT_ENV = "DYN_FAULT"
+
+_lock = threading.Lock()
+# site → spec: "once" (not yet fired) | float probability. Absent = off.
+_armed: Dict[str, object] = {}
+_env_loaded = False
+# sites that hung and await release; created lazily per site
+_hang_events: Dict[str, threading.Event] = {}
+fired_total: Dict[str, int] = {}
+
+
+def _load_env_locked() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    raw = os.environ.get(FAULT_ENV, "")
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, spec = part.partition(":")
+        try:
+            _arm_locked(site.strip(), spec.strip() or "once")
+        except ValueError as e:
+            # a typo'd fault spec must never take the server down — the
+            # operator is injecting faults on purpose, loudly
+            logger.error("ignoring malformed %s entry %r: %s",
+                         FAULT_ENV, part, e)
+
+
+def _arm_locked(site: str, spec: str) -> None:
+    if not site:
+        raise ValueError("empty fault site")
+    if spec == "off":
+        _armed.pop(site, None)
+        return
+    if spec == "once":
+        _armed[site] = "once"
+        return
+    p = float(spec)  # raises ValueError on garbage
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"probability {p} outside [0, 1]")
+    _armed[site] = p
+
+
+def arm(site: str, spec: str = "once") -> None:
+    """Programmatically arm a site (tests; same grammar as DYN_FAULT)."""
+    with _lock:
+        _load_env_locked()
+        _arm_locked(site, spec)
+
+
+def reset() -> None:
+    """Disarm everything and forget the env parse (tests)."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        fired_total.clear()
+        _env_loaded = False
+        for ev in _hang_events.values():
+            ev.set()
+        _hang_events.clear()
+
+
+def fire(site: str) -> bool:
+    """Should this evaluation of ``site`` fail? Consumes ``once`` arms.
+
+    Thread-safe and cheap when nothing is armed (one dict lookup under
+    a lock) — safe to call from executor threads and hot loops alike.
+    """
+    with _lock:
+        _load_env_locked()
+        spec = _armed.get(site)
+        if spec is None:
+            return False
+        if spec == "once":
+            del _armed[site]
+        elif random.random() >= spec:
+            return False
+        fired_total[site] = fired_total.get(site, 0) + 1
+    try:
+        from ..telemetry.flight import flight_recorder
+
+        flight_recorder().record("fault.injected", site=site)
+    # dynlint: allow(silent-except) - the injection (and its WARNING below) must land even if the flight ring import fails mid-teardown
+    except Exception:
+        pass
+    logger.warning("FAULT INJECTED [%s]", site)
+    return True
+
+
+def maybe_hang(site: str, timeout_s: float = 600.0) -> bool:
+    """If ``site`` fires, BLOCK the calling thread until ``release()``
+    (or the safety timeout). Call from the thread being sabotaged — for
+    ``decode_burst_hang`` that is the scheduler's executor sync thread,
+    never the event loop. Returns whether it hung."""
+    if not fire(site):
+        return False
+    with _lock:
+        ev = _hang_events.setdefault(site, threading.Event())
+    ev.wait(timeout_s)
+    return True
+
+
+def release(site: Optional[str] = None) -> None:
+    """Un-wedge hung sites (all of them when ``site`` is None)."""
+    with _lock:
+        events = (
+            [e for s, e in _hang_events.items() if site in (None, s)]
+        )
+    for ev in events:
+        ev.set()

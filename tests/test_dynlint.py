@@ -983,3 +983,71 @@ def test_task_leak_flags_planner_shaped_discarded_loop():
         "task-leak",
     )
     assert [f.rule for f in out] == ["task-leak"]
+
+
+# --------------------------------------------------------------------------
+# self-healing recovery: the drain/migrate/respawn stack's discipline
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_recovery_modules_pass_async_blocking_and_task_leak():
+    """The recovery ladder runs precisely when the engine is ailing —
+    a controller that blocks the event loop (a sleep-based respawn
+    backoff, an inline KV gather) would wedge the very loop the watchdog
+    is trying to save, and a dropped relay task would strand a migrated
+    client stream. Pin the subsystem (and the fault-injection helper the
+    chaos paths call from hot loops) ZERO-finding, not baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "recovery", "controller.py"),
+        os.path.join(PACKAGE_ROOT, "recovery", "migration.py"),
+        os.path.join(PACKAGE_ROOT, "utils", "faults.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "recovery discipline regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_respawn_loop_sleeping_on_loop():
+    """TP fixture shaped like a careless respawn ladder: the exponential
+    backoff runs time.sleep on the event loop, so every admission
+    decision, watchdog sample, and relay frame stalls behind it."""
+    out = findings(
+        """
+        import time
+
+        async def respawn_with_backoff(spawn):
+            delay = 1.0
+            for _ in range(3):
+                try:
+                    return await spawn()
+                except Exception:
+                    time.sleep(delay)
+                    delay *= 2
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+def test_task_leak_flags_migration_relay_shaped_discarded_task():
+    """TP fixture shaped like a careless migrator: the relay task that
+    forwards the peer's resumed stream is dropped on the floor — close()
+    can never cancel it and its exception is silently lost along with
+    the client's stream tail."""
+    out = findings(
+        """
+        import asyncio
+
+        class Migrator:
+            def ship(self, er):
+                asyncio.create_task(self._relay(er))
+
+            async def _relay(self, er):
+                while True:
+                    await asyncio.sleep(0.1)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]
