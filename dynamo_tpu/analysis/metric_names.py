@@ -34,10 +34,16 @@ PREFIX = "dynamo_"
 # pool size is a first-class count unit in the deployment plane.
 # "length" added with the persistent decode loop's burst-chain gauge —
 # dispatches between host barriers; a structural count like depth, and
-# the Grafana panel derives p50/p99 via quantile_over_time)
+# the Grafana panel derives p50/p99 via quantile_over_time.
+# "fraction" added with the live roofline gauge: unlike "ratio" (a
+# part-of-whole share of counted things), a fraction names achieved-
+# over-bound against a PHYSICAL limit — dynamo_engine_roofline_fraction
+# is achieved HBM bytes/s over the chip's peak, the serving-time mirror
+# of bench.py's vs_baseline)
 UNIT_SUFFIXES = (
     "total", "seconds", "bytes", "tokens", "blocks",
     "requests", "slots", "ratio", "info", "depth", "replicas", "length",
+    "fraction",
 )
 BASE_UNITS = ("seconds", "bytes", "tokens")  # what a histogram may measure
 

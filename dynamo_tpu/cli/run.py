@@ -227,6 +227,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--api-store-url", default=None,
                    help="in=planner: api-store base URL for replica "
                         "actuation")
+    # SLO targets + goodput accounting at the HTTP edge (telemetry/slo.py)
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="time-to-first-token SLO in ms: per-request "
+                        "attainment + goodput (SLO-met tokens/s) export "
+                        "on /metrics and feed the planner's slo.* "
+                        "signals (0 = unjudged)")
+    p.add_argument("--slo-itl-ms", type=float, default=0.0,
+                   help="inter-token-latency SLO in ms, judged on each "
+                        "request's WORST token gap at the edge (0 = "
+                        "unjudged)")
+    # per-request trace store bounds (telemetry/tracing.py)
+    p.add_argument("--trace-ttl-s", type=float, default=None,
+                   help="evict completed /debug/requests traces older "
+                        "than this (default 600; 0 keeps until the "
+                        "capacity bound evicts them)")
+    p.add_argument("--trace-capacity", type=int, default=None,
+                   help="max completed traces held for /debug/requests "
+                        "and /debug/trace (LRU beyond it; default 512)")
     p.add_argument("--router-staleness-bound-s", type=float, default=0.0,
                    help="KV router: skip workers whose scraped load "
                         "snapshot is older than this many seconds "
@@ -563,10 +581,21 @@ async def run_http(flags, engine, mdc) -> None:
             queue_depth=flags.admission_queue_depth,
             queue_timeout_s=flags.admission_queue_timeout_s,
         ))
+    slo = None
+    if flags.slo_ttft_ms > 0 or flags.slo_itl_ms > 0:
+        from ..telemetry.slo import SloTracker
+
+        slo = SloTracker(
+            ttft_s=flags.slo_ttft_ms / 1e3 if flags.slo_ttft_ms > 0 else None,
+            itl_s=flags.slo_itl_ms / 1e3 if flags.slo_itl_ms > 0 else None,
+        )
     service = HttpService(
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
         admission=admission,
+        slo=slo,
+        trace_ttl_s=flags.trace_ttl_s,
+        trace_capacity=flags.trace_capacity,
     )
     if getattr(engine, "telemetry_registry", None) is not None:
         # in-process engine: one registry, one exposition — HTTP,
@@ -619,6 +648,12 @@ async def run_http(flags, engine, mdc) -> None:
         if admission is not None:
             planner.add_source(admission.snapshot)
             planner.add_actuator(LocalActuator(admission=admission))
+        if slo is not None:
+            # user-visible latency as a first-class planner signal: the
+            # policy sheds on SLO attainment, not just queue proxies
+            from ..planner import slo_source
+
+            planner.add_source(slo_source(slo))
         if engine is not None and hasattr(engine, "engine_metrics"):
             planner.add_source(engine_metrics_source(engine.engine_metrics))
         service.metrics.attach_registry(planner.registry)
@@ -771,7 +806,8 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         else:
             await client.start()
         engine = build_processor_pipeline(mdc, client, router)
-        serving = await endpoint.serve(make_openai_handler(engine))
+        serving = await endpoint.serve(make_openai_handler(engine),
+                                       span_source="processor")
         name = flags.model_name or mdc.display_name
         await register_model(drt, flags.namespace, name, path, model_type="both",
                              mdc={"context_length": mdc.context_length})
@@ -803,6 +839,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             handler,
             instance_id=instance_id,
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
+            span_source="decode_engine",
         )
         if flags.self_heal:
             # watchdog trips drain this worker, migrate its in-flight

@@ -71,6 +71,10 @@ class RemotePrefillCoordinator:
         self.remote_submitted = 0
         self.remote_completed = 0
         self._submit_t: Dict[str, float] = {}  # request id → submit time
+        # request id → wall-clock at submit: the local half of the
+        # per-hop clock-offset estimate when the prefill worker's span
+        # export arrives on the commit frame (telemetry/stitch.py)
+        self._submit_wall: Dict[str, float] = {}
         self.registry = MetricsRegistry()
         self._rtt_hist = self.registry.histogram(
             "dynamo_disagg_remote_prefill_duration_seconds",
@@ -174,6 +178,7 @@ class RemotePrefillCoordinator:
             raise
         self.remote_submitted += 1
         self._submit_t[request_id] = time.monotonic()
+        self._submit_wall[request_id] = time.time()
         self._queue_depth += 1  # optimistic until the next refresh
         return fut
 
@@ -181,6 +186,7 @@ class RemotePrefillCoordinator:
         """Stop accepting frames for a request (cancel / timeout fallback)."""
         fut = self._pending.pop(request_id, None)
         self._ctx.pop(request_id, None)
+        self._submit_wall.pop(request_id, None)
         if self._submit_t.pop(request_id, None) is not None:
             self._failures.inc(reason=reason)
             flight_recorder().record(
@@ -215,9 +221,11 @@ class RemotePrefillCoordinator:
 
     def _commit(self, request_id: str, first_token: int,
                 logprob: Optional[float],
-                top: Optional[dict] = None) -> None:
+                top: Optional[dict] = None,
+                spans: Optional[dict] = None) -> None:
         fut = self._pending.pop(request_id, None)
         ctx = self._ctx.pop(request_id, None)
+        submit_wall = self._submit_wall.pop(request_id, None)
         if fut is None or fut.done():
             logger.warning("commit for unknown request %s", request_id)
             return
@@ -227,6 +235,25 @@ class RemotePrefillCoordinator:
             # compute + streamed KV transfer; install latency then lands
             # under the scheduler's "remote_prefill" mark
             ctx.add_stage("kv_transfer")
+            if spans and submit_wall is not None:
+                # the prefill worker's spans rode the commit frame: fold
+                # them into this request's trace. The forward "leg" is a
+                # QUEUE submit (the worker dequeues whenever it gets
+                # there), so the offset comes from the commit return leg
+                # alone — error bounded by the one-way commit transit,
+                # not half the queue wait (queued_forward semantics in
+                # telemetry/stitch.py)
+                from ..telemetry.stitch import remote_span_set
+
+                ctx.add_remote_spans(remote_span_set(
+                    spans.get("source", "prefill_worker"),
+                    spans.get("spans") or [],
+                    spans.get("recv_at", 0.0),
+                    spans.get("resp_sent_at", 0.0),
+                    submit_wall, time.time(),
+                    children=spans.get("children") or [],
+                    queued_forward=True,
+                ))
         self.remote_completed += 1
         t0 = self._submit_t.pop(request_id, None)
         if t0 is not None:

@@ -39,6 +39,7 @@ same collective program).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -258,6 +259,7 @@ class IciKvTransfer:
         bucket = self.bucket_for(n)
         eff = self._eff_bucket(bucket)
         entered = False
+        t0 = time.monotonic()
         try:
             k = jnp.asarray(k_blocks, self.dtype)
             v = jnp.asarray(v_blocks, self.dtype)
@@ -275,6 +277,16 @@ class IciKvTransfer:
             jax.block_until_ready(prog(*args))
         except BaseException as e:
             raise IciSendError(e, entered) from e
+        # collective-plane observability: each frame's seq/size/duration
+        # lands in the flight ring, so a stitched-trace gap over the ici
+        # hop is attributable frame by frame (thread-safe append — this
+        # runs on the prefill worker's executor thread)
+        from ..telemetry.flight import flight_recorder
+
+        flight_recorder().record(
+            "disagg.ici_send", seq=int(seq), blocks=int(n),
+            duration_s=round(time.monotonic() - t0, 4),
+        )
 
     def send_balancing_entry(self, nblocks: int) -> None:
         """Pair an orphaned receiver entry (header out, collective never
@@ -299,7 +311,14 @@ class IciKvTransfer:
         (prog, kb, vb) = self._program(bucket)
         k0 = jnp.zeros(kb, self.dtype)
         v0 = jnp.zeros(vb, self.dtype)
+        t0 = time.monotonic()
         k, v, seq = self._enter(bucket, k0, v0, 0)
+        from ..telemetry.flight import flight_recorder
+
+        flight_recorder().record(
+            "disagg.ici_recv", seq=int(seq), blocks=int(nblocks),
+            duration_s=round(time.monotonic() - t0, 4),
+        )
         return k[:, :nblocks], v[:, :nblocks], seq
 
 

@@ -49,6 +49,7 @@ import msgpack
 import numpy as np
 
 from ..engine.block_allocator import BlockAllocator
+from ..runtime.engine import AsyncEngineContext
 from ..engine.sampling import seed_to_key
 from ..engine.scheduler import build_prefill_arrays, prefill_bucket_cap
 from ..telemetry.flight import flight_recorder
@@ -228,12 +229,18 @@ class PrefillWorker:
         if popped is None:
             return False
         rpr, ack = popped
+        # per-request span context for the cluster-stitched trace: the
+        # worker's marks (dequeue → compute → transfer) ship back on the
+        # commit frame, stamped against THIS process's clock — the
+        # decode side folds them with a queue-transit offset estimate
+        ctx = AsyncEngineContext(trace_id=rpr.trace_id or rpr.request_id)
+        ctx.add_stage("prefill.dequeue")
         if rpr.enqueued_at:
             # wall-clock across processes (same deployment host class);
             # clamp at 0 so skew never renders a negative wait
             self._queue_wait_h.observe(max(0.0, time.time() - rpr.enqueued_at))
         try:
-            await self._handle(rpr)
+            await self._handle(rpr, ctx)
         except Exception:
             # no ack — the visibility window redelivers this item
             logger.exception("prefill of %s (trace %s) failed; leaving for "
@@ -256,7 +263,12 @@ class PrefillWorker:
         cap = prefill_bucket_cap(self.config)
         return cap if cap is not None else self.config.prefill_buckets[0]
 
-    async def _handle(self, rpr: RemotePrefillRequest) -> None:
+    async def _handle(self, rpr: RemotePrefillRequest,
+                      ctx: AsyncEngineContext) -> None:
+        # ctx is required: the caller stamps "prefill.dequeue" BEFORE
+        # calling, and the span export takes stages[0] as the hop's
+        # recv_at — a ctx built here would make that the compute-done
+        # mark and inflate the hop's estimated rtt by the whole prefill
         cfg = self.config
         bs = cfg.kv_block_size
         prompt = rpr.token_ids
@@ -353,6 +365,9 @@ class PrefillWorker:
                 ),
             )
             t_compute_done = time.monotonic()
+            # closing-mark semantics: the span from dequeue to here is
+            # the chunked prefill compute (final-chunk host sync incl.)
+            ctx.add_stage("prefill.compute")
 
             # feed the local prefix cache so future prompts skip this work
             hashes = compute_block_hashes(prompt, bs)
@@ -362,9 +377,21 @@ class PrefillWorker:
                 parent = h
 
             nbytes = await pipe.drain()
+            # every frame is on the wire: the transfer tail that did NOT
+            # hide behind compute closes here (the stitched-trace twin of
+            # dynamo_disagg_transfer_exposed_seconds)
+            ctx.add_stage("prefill.transfer")
             committed = await client.send_commit(
                 rpr.request_id, token, lp if rpr.want_logprobs else None,
                 top=top,
+                spans={
+                    "source": "prefill_worker",
+                    "spans": ctx.export_spans(),
+                    # offset-estimation pair: rpr.enqueued_at is the
+                    # decode side's send stamp; these two are ours
+                    "recv_at": ctx.wall(ctx.stages[0][1]),
+                    "resp_sent_at": time.time(),
+                },
             )
             t_done = time.monotonic()
             if pipe.first_frame_t is not None:
@@ -458,6 +485,7 @@ class PrefillWorker:
                 await client.send_blocks(
                     rpr.request_id, dst, k, v,
                     chunk_blocks=self.transfer_chunk_blocks,
+                    trace_id=rpr.trace_id or None,
                 )
                 pipe.nbytes += k.nbytes + v.nbytes
             finally:
@@ -535,7 +563,10 @@ class PrefillWorker:
                 self._ici_seq += 1
                 seq = self._ici_seq
                 try:
-                    await client.send_ici_blocks(rpr.request_id, dst, seq)
+                    await client.send_ici_blocks(
+                        rpr.request_id, dst, seq,
+                        trace_id=rpr.trace_id or None,
+                    )
                 except BaseException:
                     # header delivery unknowable → pairing discipline
                     # unknowable → abandon the plane (tcp from now on);

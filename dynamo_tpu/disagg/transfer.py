@@ -14,9 +14,15 @@ mirroring CopyStream::trigger_layer per-layer overlap semantics.
 
 Wire format, length-prefixed msgpack header + raw payloads:
 
-  {type: "blocks", request_id, block_ids, shape, dtype, k_bytes, v_bytes}
+  {type: "blocks", request_id, trace_id?, block_ids, shape, dtype, k_bytes, v_bytes}
   <k raw bytes> <v raw bytes>
-  {type: "commit", request_id, first_token, logprob, generated}
+  {type: "commit", request_id, first_token, logprob, generated, spans?}
+
+``spans`` is the prefill worker's span export for the cluster-stitched
+trace (telemetry/stitch.py): its wall-clock span marks plus the
+request-receipt/commit-send timestamps the decode side folds into a
+per-hop clock-offset estimate. ``trace_id`` rides payload frames so
+poison/drop flight events stay attributable to the ingress trace.
 
 The commit is acked with one framed byte: \x01 = committed, \x00 = nacked
 (an earlier payload frame for the request was dropped — the decode side
@@ -73,7 +79,9 @@ class KvTransferServer:
     def __init__(
         self,
         scatter: Callable[[str, Sequence[int], np.ndarray, np.ndarray], None],
-        on_commit: Callable[[str, int, Optional[float]], None],
+        # on_commit(request_id, first_token, logprob, top, spans) — spans
+        # is the sender's span export for the stitched trace (or None)
+        on_commit: Callable[..., None],
         authorize: Optional[Callable[[str, Sequence[int]], bool]] = None,
         host: str = "127.0.0.1",
         ici_recv: Optional[Callable[[int], tuple]] = None,
@@ -113,12 +121,13 @@ class KvTransferServer:
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
-    def _mark_dropped(self, request_id: str) -> None:
+    def _mark_dropped(self, request_id: str,
+                      trace_id: Optional[str] = None) -> None:
         from ..telemetry.flight import flight_recorder
 
         now = time.monotonic()
         flight_recorder().record(
-            "disagg.poison", request_id=request_id,
+            "disagg.poison", request_id=request_id, trace_id=trace_id,
         )
         self._dropped.pop(request_id, None)
         self._dropped[request_id] = now
@@ -209,7 +218,8 @@ class KvTransferServer:
                     if not self.authorize(header["request_id"], header["block_ids"]):
                         # request gone — drop the frame; a later commit for
                         # this id must be nacked, not resumed-on
-                        self._mark_dropped(header["request_id"])
+                        self._mark_dropped(header["request_id"],
+                                           header.get("trace_id"))
                         continue
                     dtype = _np_dtype(header["dtype"])
                     shape = tuple(header["shape"])
@@ -258,7 +268,8 @@ class KvTransferServer:
                             self.ici_recv_timeout_s,
                         )
                         self.ici_recv = None
-                        self._mark_dropped(header["request_id"])
+                        self._mark_dropped(header["request_id"],
+                                           header.get("trace_id"))
                         continue
                     if seq != header.get("seq", 0):
                         # a sender died between header and collective and
@@ -271,10 +282,12 @@ class KvTransferServer:
                             "%s) — dropping mis-paired payload",
                             header.get("seq"), seq,
                         )
-                        self._mark_dropped(header["request_id"])
+                        self._mark_dropped(header["request_id"],
+                                           header.get("trace_id"))
                         continue
                     if not self.authorize(header["request_id"], ids):
-                        self._mark_dropped(header["request_id"])
+                        self._mark_dropped(header["request_id"],
+                                           header.get("trace_id"))
                         continue  # request gone — drop the received blocks
                     result = self.scatter(header["request_id"], ids, k, v)
                     if inspect.isawaitable(result):
@@ -303,6 +316,7 @@ class KvTransferServer:
                         header.get("logprob"),
                         {int(k): float(v) for k, v in top.items()}
                         if top else None,
+                        header.get("spans"),
                     )
                     # ack the commit so the sender can safely release blocks
                     writer.write(struct.pack(">I", 1) + b"\x01")
@@ -352,6 +366,7 @@ class KvTransferClient:
         k_blocks: np.ndarray,   # [L, n, bs, KVH, D]
         v_blocks: np.ndarray,
         chunk_blocks: int = 16,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Stream blocks in chunks so the receiver overlaps scatter w/ reads."""
         from ..utils import faults
@@ -370,7 +385,7 @@ class KvTransferClient:
             k = np.ascontiguousarray(k_blocks[:, i : i + len(ids)])
             v = np.ascontiguousarray(v_blocks[:, i : i + len(ids)])
             kb, vb = k.tobytes(), v.tobytes()
-            self._send_header({
+            header = {
                 "type": "blocks",
                 "request_id": request_id,
                 "block_ids": list(map(int, ids)),
@@ -378,31 +393,41 @@ class KvTransferClient:
                 "dtype": k.dtype.name,
                 "k_bytes": len(kb),
                 "v_bytes": len(vb),
-            })
+            }
+            if trace_id:
+                header["trace_id"] = trace_id
+            self._send_header(header)
             self.writer.write(kb)
             self.writer.write(vb)
             await self.writer.drain()
 
     async def send_ici_blocks(
-        self, request_id: str, block_ids: List[int], seq: int = 0
+        self, request_id: str, block_ids: List[int], seq: int = 0,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Announce a collective-plane transfer: ids over TCP, bytes over
         ICI/DCN (the caller enters IciKvTransfer.send(..., seq=seq) after
         this drains; the receiver cross-checks seq against the payload)."""
-        self._send_header({
+        header = {
             "type": "ici_blocks",
             "request_id": request_id,
             "block_ids": list(map(int, block_ids)),
             "seq": int(seq),
-        })
+        }
+        if trace_id:
+            header["trace_id"] = trace_id
+        self._send_header(header)
         await self.writer.drain()
 
     async def send_commit(self, request_id: str, first_token: int,
                           logprob: Optional[float] = None,
-                          top: Optional[dict] = None) -> bool:
+                          top: Optional[dict] = None,
+                          spans: Optional[dict] = None) -> bool:
         """Returns True if the receiver committed, False if it nacked
         (a payload frame was dropped — the decode side will re-prefill
-        locally; the sender just releases its resources either way)."""
+        locally; the sender just releases its resources either way).
+        ``spans`` piggybacks the sender's span export for the stitched
+        trace — its wall-clock marks + recv/send timestamps."""
         self._send_header({
             "type": "commit",
             "request_id": request_id,
@@ -411,6 +436,7 @@ class KvTransferClient:
             # first-token top-logprob alternatives (string token-id keys
             # for the msgpack strict decode)
             "top": {str(k): float(v) for k, v in top.items()} if top else None,
+            "spans": spans,
         })
         await self.writer.drain()
         # wait for the receiver's ack — after this the decode side owns the KV

@@ -283,6 +283,30 @@ class ModelRunner:
         # scrape and flip the serving flag when they start.
         self.compiles = CompileTracker()
 
+        # live device-time + roofline accounting (telemetry/device_time.py):
+        # the byte model mirrors bench.py's — per decode step the device
+        # streams every param leaf once plus each live row's KV context.
+        # kv_bytes_per_token is EXACT for any cache layout (GQA, MLA
+        # latent, fp8, pp-staged): total cache bytes over total token
+        # capacity. The scheduler feeds observations at its existing
+        # reconciliation seams and attaches device_time.registry.
+        from ..telemetry.device_time import DeviceTimeTracker
+
+        def _leaf_bytes(tree) -> float:
+            return float(sum(
+                x.size * x.dtype.itemsize for x in jax.tree.leaves(tree)
+                if hasattr(x, "size") and hasattr(x, "dtype")
+            ))
+
+        self.param_bytes = _leaf_bytes(self.params)
+        self.kv_bytes_per_token = _leaf_bytes(self.kv_cache) / max(
+            1, config.num_kv_blocks * config.kv_block_size
+        )
+        self.device_time = DeviceTimeTracker(
+            param_bytes=self.param_bytes,
+            kv_bytes_per_token=self.kv_bytes_per_token,
+        )
+
         self._build_step()
         self._build_burst()
         self._build_block_ops()

@@ -326,6 +326,10 @@ class _InflightBurst:
     # frozen-row semantics (-1 pads skipped, device-finish counted)
     dispatch_t: float = 0.0
     device_finish: bool = False
+    # device-time accounting (telemetry/device_time.py): HBM bytes this
+    # burst must stream and the tokens it samples, fixed at dispatch
+    read_bytes: float = 0.0
+    tokens: int = 0
 
 
 class Scheduler:
@@ -421,6 +425,12 @@ class Scheduler:
         compiles = getattr(runner, "compiles", None)
         if compiles is not None:
             self.registry.attach(compiles.registry)
+        # live device-time + roofline accounting: observations feed at
+        # the loop's EXISTING reconciliation seams (executor host syncs,
+        # is_ready row drains) — never an added hot-path sync
+        self.device_time = getattr(runner, "device_time", None)
+        if self.device_time is not None:
+            self.registry.attach(self.device_time.registry)
 
     def _build_instruments(self) -> None:
         """Register the scheduler's Prometheus instruments (the full
@@ -687,7 +697,7 @@ class Scheduler:
             # counterfactual un-migrated stream, greedy ones do not
             er.base_key = self._rng.integers(0, 2**32, size=2,
                                              dtype=np.uint32)
-        er.ctx.add_stage("migration")
+        er.ctx.add_stage("migration.resume")
         self.flight.record(
             "scheduler.migrate_in", request_id=er.request_id,
             trace_id=er.ctx.trace_id, hot=bool(block_ids),
@@ -1067,6 +1077,8 @@ class Scheduler:
                 # about to sleep: the device-idle clock must not count
                 # request-starved idle as a pipeline bubble
                 self._last_burst_done_t = None
+                if self.device_time is not None:
+                    self.device_time.idle()
                 if not self.waiting and not any(self.slots):
                     if self.pending_remote:
                         # sleep but wake on remote completion or timeout check
@@ -1215,9 +1227,15 @@ class Scheduler:
             pipelined=True, carried=infl is not None,
             requests=[er.request_id for er in active[:8]],
         )
+        dt = self.device_time
         self._inflight = _InflightBurst(
             active=list(active), toks=toks, lps=lps, tv=tv, ti=ti,
             k_steps=k_steps, last_tokens=toks[k_steps - 1],
+            dispatch_t=now,
+            read_bytes=dt.decode_read_bytes(
+                k_steps, sum(er.context_len for er in active),
+            ) if dt is not None else 0.0,
+            tokens=k_steps * len(active),
         )
         if infl is not None:
             # burst k+1 is on device — reconcile burst k while it runs
@@ -1227,10 +1245,16 @@ class Scheduler:
                 # reconcile it now instead of leaving an orphan in flight
                 await self._drain_pipeline(loop)
 
-    async def _apply_burst(self, loop, infl: _InflightBurst) -> None:
+    async def _apply_burst(self, loop, infl: _InflightBurst,
+                           ready_hint: Optional[float] = None) -> None:
         """Host half of the pipeline: sync the burst's sampled tokens
         (the decode loop's ONLY host sync), emit/stream them, run finish
-        checks, and retro-invalidate rows that finished one burst late."""
+        checks, and retro-invalidate rows that finished one burst late.
+
+        ``ready_hint`` is the moment an ``is_ready`` probe saw the
+        outputs materialized (the async row drain) — the device-time
+        observation below prefers it over the post-sync stamp so drain
+        lag and D2H copy time are not charged as device compute."""
         t_sync = time.monotonic()
 
         def _sync_burst():
@@ -1244,6 +1268,14 @@ class Scheduler:
         toks, lpn, tv, ti = await loop.run_in_executor(None, _sync_burst)
         self._observe_host_sync(time.monotonic() - t_sync)
         self._last_burst_done_t = time.monotonic()
+        if self.device_time is not None and infl.dispatch_t:
+            self.device_time.observe(
+                "decode_burst_df" if infl.device_finish else "decode_burst",
+                "decode", infl.dispatch_t,
+                ready_hint if ready_hint is not None
+                else self._last_burst_done_t,
+                read_bytes=infl.read_bytes, tokens=infl.tokens,
+            )
         for j in range(infl.k_steps):
             for er in infl.active:
                 if er.finish is not None:
@@ -1378,7 +1410,10 @@ class Scheduler:
         """Reconcile the oldest queued chained burst (FIFO — token order
         per row) and record its drain lag."""
         infl = self._chain.popleft()
-        await self._apply_burst(loop, infl)
+        # outputs already materialized? then NOW is the ready stamp the
+        # device-time estimator should use — the sync below only copies
+        ready_hint = time.monotonic() if self._chain_ready(infl) else None
+        await self._apply_burst(loop, infl, ready_hint=ready_hint)
         self._drain_lag_hist.observe(time.monotonic() - infl.dispatch_t)
 
     async def _decode_chained(self, loop,
@@ -1490,10 +1525,17 @@ class Scheduler:
             chain_len=self._chain_dispatched,
             requests=[er.request_id for er in live[:8]],
         )
+        dt = self.device_time
         self._chain.append(_InflightBurst(
             active=list(live), toks=toks, lps=lps, tv=tv, ti=ti,
             k_steps=k_steps, last_tokens=None,
             dispatch_t=time.monotonic(), device_finish=True,
+            read_bytes=dt.decode_read_bytes(
+                k_steps,
+                sum(min(self._chain_pos0[er.slot] + n * k_steps,
+                        cfg.max_model_len) for er in live),
+            ) if dt is not None else 0.0,
+            tokens=k_steps * len(live),
         ))
         # asynchronous row drain: reconcile every burst whose outputs
         # already materialized (never gating the dispatch above), then
@@ -1906,6 +1948,12 @@ class Scheduler:
         t_sync = time.monotonic()
         toks, lpn, tv, ti, plists = await loop.run_in_executor(None, _to_host)
         self._observe_host_sync(time.monotonic() - t_sync)
+        if self.device_time is not None:
+            # non-final chunks never sync; their device time folds into
+            # this observation via the serialized-interval estimator
+            self.device_time.observe(
+                "prefill", "prefill", t0, time.monotonic(),
+            )
         for i in finals:
             er = plan[i][0]
             self.prefilling.remove(er)
@@ -2153,6 +2201,7 @@ class Scheduler:
             last_idx[i] = len(row) - 1
 
         zf, zi = np.zeros(b, np.float32), np.zeros(b, np.int32)
+        t_dispatch = time.monotonic()
         *_, greedy_all = self.runner.step(
             tokens, positions, btab, slot_map, ctx_lens, last_idx,
             zf, zi, np.ones(b, np.float32),
@@ -2166,6 +2215,16 @@ class Scheduler:
         t_sync = time.monotonic()
         ga = await loop.run_in_executor(None, lambda: np.asarray(greedy_all))
         self._observe_host_sync(time.monotonic() - t_sync)
+        if self.device_time is not None:
+            # the verify forward is one decode-shaped step over S
+            # positions: weights once + each row's (ctx + S) KV
+            self.device_time.observe(
+                "spec_verify", "decode", t_dispatch, time.monotonic(),
+                read_bytes=self.device_time.decode_read_bytes(
+                    1, sum(er.context_len + S for er in active),
+                ),
+                tokens=len(active),
+            )
         self.steps += 1
 
         for er in active:
@@ -2294,6 +2353,7 @@ class Scheduler:
             pipelined=False,
             requests=[er.request_id for er in active[:8]],
         )
+        t_dispatch = time.monotonic()
         if k_steps > 1:
             next_tokens, lps, top_vals, top_ids = self.runner.decode_burst(
                 tokens[:, 0], positions[:, 0], btab,
@@ -2334,6 +2394,15 @@ class Scheduler:
         toks, lpn, tv, ti = await loop.run_in_executor(None, _sync_step)
         self._observe_host_sync(time.monotonic() - t_sync)
         self._last_burst_done_t = time.monotonic()
+        if self.device_time is not None:
+            self.device_time.observe(
+                "decode_burst" if k_steps > 1 else "decode", "decode",
+                t_dispatch, self._last_burst_done_t,
+                read_bytes=self.device_time.decode_read_bytes(
+                    k_steps, sum(er.context_len for er in active),
+                ),
+                tokens=k_steps * len(active),
+            )
         self.steps += 1
         if k_steps == 1:
             # [B] → [1, B] so the emit loop below is one shape

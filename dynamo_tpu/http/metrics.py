@@ -37,6 +37,10 @@ class ServiceMetrics:
     def __init__(self, prefix: str = "dynamo",
                  registry: Optional[MetricsRegistry] = None):
         self.registry = registry or MetricsRegistry()
+        # optional SLO tracker (telemetry/slo.py): successful requests
+        # report their edge-measured TTFT / worst inter-token gap /
+        # token count at finish for attainment + goodput accounting
+        self.slo = None
         self.requests_total = self.registry.counter(
             f"{prefix}_http_service_requests_total", "Total HTTP requests by model/status"
         )
@@ -81,16 +85,45 @@ class ServiceMetrics:
             self.start = time.monotonic()
             self.status = "success"
             self.first_token_seen = False
+            # edge-side SLO accounting: TTFT, worst inter-token gap,
+            # and token count for the request's attainment verdict
+            self.ttft_s: Optional[float] = None
+            self.itl_max_s: Optional[float] = None
+            self.tokens = 0
+            self._last_token_t: Optional[float] = None
 
         def first_token(self) -> None:
             if not self.first_token_seen:
                 self.first_token_seen = True
-                self.metrics.ttft.observe(time.monotonic() - self.start, model=self.model)
+                self.ttft_s = time.monotonic() - self.start
+                self.metrics.ttft.observe(self.ttft_s, model=self.model)
+
+        def token(self, n: int = 1) -> None:
+            """One payload chunk left the edge: TTFT on the first, the
+            inter-token gap on every subsequent one. ``n`` is the
+            chunk's token count when the payload carries one (token-
+            level EngineOutput shapes); OpenAI chat/completions chunks
+            are one token per chunk on every current engine path."""
+            self.first_token()
+            now = time.monotonic()
+            if self._last_token_t is not None:
+                gap = now - self._last_token_t
+                if self.itl_max_s is None or gap > self.itl_max_s:
+                    self.itl_max_s = gap
+            self._last_token_t = now
+            self.tokens += max(1, n)
 
         def finish(self, status: str = "success") -> None:
             self.metrics.inflight.dec(model=self.model)
             self.metrics.requests_total.inc(model=self.model, status=status)
             self.metrics.duration.observe(time.monotonic() - self.start, model=self.model)
+            if (self.metrics.slo is not None and status == "success"
+                    and self.first_token_seen):
+                # only completed streams get a verdict: an error or
+                # disconnect is not an SLO miss, it is its own failure
+                self.metrics.slo.observe(
+                    self.ttft_s, self.itl_max_s, self.tokens
+                )
 
     def track(self, model: str) -> "ServiceMetrics._Timer":
         self.inflight.inc(model=model)

@@ -89,6 +89,9 @@ class HttpService:
         metrics_prefix: str = "dynamo",
         profile_dir: Optional[str] = None,
         admission=None,  # planner.admission.AdmissionController
+        slo=None,        # telemetry.slo.SloTracker
+        trace_ttl_s: Optional[float] = None,
+        trace_capacity: Optional[int] = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -99,9 +102,20 @@ class HttpService:
         self.admission = admission
         if admission is not None:
             self.metrics.attach_registry(admission.registry)
+        # optional SLO attainment + goodput accounting: per-request
+        # TTFT / worst-ITL verdicts at the edge (telemetry/slo.py)
+        if slo is not None:
+            self.metrics.slo = slo
+            self.metrics.attach_registry(slo.registry)
         # completed request traces: ingress-assigned trace ids (honoring
-        # X-Request-Id) → span breakdowns at GET /debug/requests/{id}
-        self.traces = TraceRecorder()
+        # X-Request-Id) → span breakdowns at GET /debug/requests/{id},
+        # cluster-stitched timelines at GET /debug/trace/{id}. Bounded
+        # by max-entries LRU AND TTL (evictions counted on
+        # dynamo_trace_evicted_total) so traffic can't grow trace memory
+        self.traces = TraceRecorder(
+            capacity=trace_capacity, ttl_s=trace_ttl_s,
+            registry=self.metrics.registry,
+        )
         self.profile_dir = profile_dir
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
@@ -111,6 +125,7 @@ class HttpService:
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/debug/requests", self.handle_debug_requests)
         self.app.router.add_get("/debug/requests/{rid}", self.handle_debug_request)
+        self.app.router.add_get("/debug/trace/{rid}", self.handle_debug_trace)
         self.app.router.add_get("/debug/flight", self.handle_flight)
         # zero-downtime rolling updates: drain + live-migrate in-flight
         # requests to peers (recovery/controller.py). Wired by the CLI
@@ -233,9 +248,10 @@ class HttpService:
             async for chunk in stream:
                 if _check_annotated(chunk) is not None:
                     continue  # annotations are stream-only side channel
-                if _has_payload(_as_dict(chunk)):
-                    timer.first_token()
-                chunks.append(chunk_cls.model_validate(_as_dict(chunk)))
+                d = _as_dict(chunk)
+                if _has_payload(d):
+                    timer.token(_payload_tokens(d))
+                chunks.append(chunk_cls.model_validate(d))
             status = "success"
             return web.json_response(
                 aggregate(chunks).model_dump(exclude_none=True),
@@ -267,7 +283,8 @@ class HttpService:
                 self.admission.release()
             ctx.context.stop_generating()
             timer.finish(status)
-            self.traces.record(ctx.trace_id, api_req.model, status, ctx.stages)
+            self.traces.record(ctx.trace_id, api_req.model, status,
+                               ctx.stages, ctx=ctx.context)
             if ctx.stages and logger.isEnabledFor(logging.DEBUG):
                 logger.debug(
                     "request %s %s: %s",
@@ -315,7 +332,7 @@ class HttpService:
                 return False
             d = _as_dict(chunk)
             if _has_payload(d):
-                timer.first_token()
+                timer.token(_payload_tokens(d))
             await resp.write(sse.encode_event(d))
             return False
 
@@ -397,6 +414,34 @@ class HttpService:
                 status=404,
             )
         return web.json_response(trace)
+
+    async def handle_debug_trace(self, request: web.Request) -> web.Response:
+        """GET /debug/trace/{id} — the request X-ray: every process's
+        spans (frontend, router hop, decode engine, prefill worker,
+        migration peer) stitched onto ONE clock-adjusted axis, plus the
+        per-hop offset/rtt estimates and the unattributed gaps. The
+        cluster answer to "where did this request's 900 ms TTFT go"."""
+        from ..telemetry.stitch import stitched_timeline, timeline_gaps
+
+        rid = request.match_info["rid"]
+        trace = self.traces.get(rid)
+        if trace is None:
+            return web.json_response(
+                {"error": f"no completed trace for request id {rid!r} "
+                          "(unknown, evicted, or still in flight)"},
+                status=404,
+            )
+        stitched = stitched_timeline(trace)
+        return web.json_response({
+            "request_id": trace["request_id"],
+            "model": trace.get("model"),
+            "status": trace.get("status"),
+            "total_s": trace.get("total_s"),
+            "sources": stitched["sources"],
+            "timeline": stitched["timeline"],
+            "gaps": timeline_gaps(stitched["timeline"],
+                                  min_gap_s=0.0005),
+        })
 
     async def handle_flight(self, request: web.Request) -> web.Response:
         """GET /debug/flight[?save=1][&request=<id>] — the flight-recorder
@@ -480,6 +525,17 @@ def _as_dict(chunk: Any) -> Any:
     if hasattr(chunk, "model_dump"):
         return chunk.model_dump(exclude_none=True)
     return chunk
+
+
+def _payload_tokens(chunk: Any) -> int:
+    """Token count of one payload chunk, for SLO goodput accounting.
+    OpenAI chat/completions chunks carry one token per chunk on every
+    current engine path (the scheduler emits per token even under
+    speculative decode); token-level shapes expose token_ids, so a
+    future multi-token chunk still counts fully."""
+    if isinstance(chunk, dict) and isinstance(chunk.get("token_ids"), list):
+        return len(chunk["token_ids"])
+    return 1
 
 
 def _has_payload(chunk: Any) -> bool:

@@ -105,8 +105,11 @@ class KvRouter:
             except Exception:
                 logger.exception("bad kv event")
 
-    async def schedule(self, token_ids) -> SchedulingDecision:
-        """token ids → chosen worker instance id (+hit telemetry)."""
+    async def schedule(self, token_ids,
+                       trace_id: Optional[str] = None) -> SchedulingDecision:
+        """token ids → chosen worker instance id (+hit telemetry).
+        ``trace_id`` rides the flight event so the pick is attributable
+        in a request's cluster-stitched X-ray."""
         hashes = compute_block_hashes(token_ids, self.block_size)
         overlap = self.indexer.find_matches(hashes)
         decision = self.scheduler.schedule(len(token_ids), overlap)
@@ -120,7 +123,8 @@ class KvRouter:
             decision.matched_blocks, worker=str(decision.worker_id)
         )
         flight_recorder().record(
-            "kv_router.pick", worker=str(decision.worker_id),
+            "kv_router.pick", trace_id=trace_id,
+            worker=str(decision.worker_id),
             isl_blocks=-(-len(token_ids) // self.block_size),
             overlap_blocks=decision.matched_blocks,
         )

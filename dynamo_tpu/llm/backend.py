@@ -129,38 +129,49 @@ class Backend(Operator):
         max_tokens = req.stop_conditions.max_tokens
 
         finished = False
-        async for out in next_engine.generate(request):
-            if isinstance(out, dict):  # off the wire
-                out = EngineOutput.from_wire(out)
-            texts: List[str] = []
-            emitted_ids: List[int] = []
-            finish: Optional[FinishReason] = out.finish_reason
-            for tid in out.token_ids:
-                text, tok_finish = decoder.step(tid)
-                emitted_ids.append(tid)
-                if text is not None:
-                    texts.append(text)
-                if tok_finish is not None:
-                    finish = tok_finish
+        # deterministic finalization: this loop BREAKS at the finish
+        # chunk, and an abandoned inner async generator is finalized
+        # only lazily (GC / asyncgen hooks). The network client's
+        # cleanup, which folds the worker's span export into the request
+        # trace (runtime/client.py), must run BEFORE upstream hops
+        # export THEIR spans — aclosing() runs the inner finally-chain
+        # synchronously at the break.
+        from contextlib import aclosing
+
+        engine_stream = next_engine.generate(request)
+        async with aclosing(engine_stream):
+            async for out in engine_stream:
+                if isinstance(out, dict):  # off the wire
+                    out = EngineOutput.from_wire(out)
+                texts: List[str] = []
+                emitted_ids: List[int] = []
+                finish: Optional[FinishReason] = out.finish_reason
+                for tid in out.token_ids:
+                    text, tok_finish = decoder.step(tid)
+                    emitted_ids.append(tid)
+                    if text is not None:
+                        texts.append(text)
+                    if tok_finish is not None:
+                        finish = tok_finish
+                        break
+                    if max_tokens is not None and decoder.generated >= max_tokens:
+                        finish = finish or FinishReason.LENGTH
+                        break
+                if finish is not None and finish not in (FinishReason.STOP,):
+                    tail = decoder.flush()
+                    if tail:
+                        texts.append(tail)
+                yield BackendOutput(
+                    token_ids=emitted_ids,
+                    text="".join(texts) if texts else None,
+                    finish_reason=finish,
+                    logprobs=out.logprobs,
+                    prompt_logprobs=out.prompt_logprobs,
+                    cum_tokens=decoder.generated,
+                )
+                if finish is not None:
+                    finished = True
                     break
-                if max_tokens is not None and decoder.generated >= max_tokens:
-                    finish = finish or FinishReason.LENGTH
-                    break
-            if finish is not None and finish not in (FinishReason.STOP,):
-                tail = decoder.flush()
-                if tail:
-                    texts.append(tail)
-            yield BackendOutput(
-                token_ids=emitted_ids,
-                text="".join(texts) if texts else None,
-                finish_reason=finish,
-                logprobs=out.logprobs,
-                prompt_logprobs=out.prompt_logprobs,
-                cum_tokens=decoder.generated,
-            )
-            if finish is not None:
-                finished = True
-                break
         if not finished:
             # engine stream ended without a finish reason (e.g. cancelled)
             tail = decoder.flush()

@@ -47,25 +47,45 @@ class KvRoutedClient(AsyncEngine):
         )
         if self.router is not None:
             try:
-                decision = await self.router.schedule(token_ids)
+                decision = await self.router.schedule(
+                    token_ids, trace_id=request.trace_id
+                )
                 request.baggage["instance_id"] = decision.worker_id
                 request.baggage["prefix_hit_tokens"] = decision.prefix_hit_tokens
+                # closing-mark span: the routing decision's latency in the
+                # stitched timeline (and which worker the hop went to)
+                request.add_stage("router.pick")
             except Exception:
                 logger.warning("kv scheduling failed; falling back", exc_info=True)
+        # explicit aclose on the inner stream: when a downstream consumer
+        # (llm/backend.py) closes THIS generator at the finish chunk, the
+        # client generator's cleanup — which folds the worker's span
+        # export into the request trace — must run synchronously, not at
+        # some later GC-driven finalization
+        stream = self.client.generate(request)
         try:
-            async for item in self.client.generate(request):
+            try:
+                async for item in stream:
+                    yield item
+                return
+            except NoInstancesError:
+                # the KV-chosen worker died between metrics poll and
+                # dispatch — retry once, letting the client's own mode
+                # pick a live instance
+                if "instance_id" not in request.baggage:
+                    raise
+                logger.warning(
+                    "kv-chosen worker %s gone; re-routing",
+                    request.baggage.pop("instance_id"),
+                )
+        finally:
+            await stream.aclose()
+        retry = self.client.generate(request)
+        try:
+            async for item in retry:
                 yield item
-            return
-        except NoInstancesError:
-            # the KV-chosen worker died between metrics poll and dispatch —
-            # retry once, letting the client's own mode pick a live instance
-            if "instance_id" not in request.baggage:
-                raise
-            logger.warning(
-                "kv-chosen worker %s gone; re-routing", request.baggage.pop("instance_id")
-            )
-        async for item in self.client.generate(request):
-            yield item
+        finally:
+            await retry.aclose()
 
     async def close(self) -> None:
         if self.router is not None:

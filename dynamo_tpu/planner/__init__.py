@@ -28,6 +28,7 @@ from .planner import (
     PlannerConfig,
     aggregator_source,
     engine_metrics_source,
+    slo_source,
 )
 from .policy import (
     Action,
@@ -61,4 +62,5 @@ __all__ = [
     "engine_metrics_source",
     "parse_priority",
     "scale_cr_service",
+    "slo_source",
 ]

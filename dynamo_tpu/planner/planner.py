@@ -240,6 +240,14 @@ def aggregator_source(aggregator) -> SignalSource:
     return snapshot
 
 
+def slo_source(tracker) -> SignalSource:
+    """A telemetry.slo.SloTracker → planner signals: rolling-window
+    attainment fractions + goodput rate under the ``slo.*`` names
+    policy.py consults (SIG_SLO_*). The edge's user-visible-latency
+    view of saturation."""
+    return tracker.snapshot
+
+
 def engine_metrics_source(metrics_fn) -> SignalSource:
     """A single engine's ``metrics()`` dict (scheduler ForwardPassMetrics
     shape + coordinator extras) → planner signals. The in-process path

@@ -38,6 +38,12 @@ SIG_KV_USAGE = "kv.usage_ratio"
 SIG_WATCHDOG_TRIPS = "watchdog.trips"
 SIG_ADMISSION_QUEUE_DEPTH = "admission.queue_depth"
 SIG_ADMISSION_INFLIGHT_RATIO = "admission.inflight_ratio"
+# user-visible latency (telemetry/slo.py SloTracker.snapshot — the HTTP
+# edge's per-request TTFT/ITL verdicts as rolling attainment fractions)
+SIG_SLO_ATTAINMENT = "slo.attainment"
+SIG_SLO_TTFT_ATTAINMENT = "slo.ttft_attainment"
+SIG_SLO_ITL_ATTAINMENT = "slo.itl_attainment"
+SIG_SLO_GOODPUT = "slo.goodput_tokens_per_s"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +121,12 @@ class PolicyConfig:
     rebalance_factor: float = 2.0        # threshold moves multiplicatively
 
     # ----- admission control -----
+    # SLO-driven saturation: attainment (SLO-met fraction of completed
+    # requests over the window) below this floor counts as saturation —
+    # the control loop acts on USER-VISIBLE latency, not queue proxies.
+    # Only consulted when the slo.* signals are registered (an edge
+    # serving without --slo-* flags feeds nothing and nothing changes).
+    slo_attainment_floor: float = 0.9
     saturation_kv_usage: float = 0.95
     saturation_busy: float = 0.95
     saturation_waiting: float = 8.0
@@ -331,6 +343,14 @@ class SlaPolicy:
             return f"decode busy {busy:.2f} with {waiting:.0f} waiting"
         if signals.delta(SIG_WATCHDOG_TRIPS, w) > 0:
             return "watchdog tripped"
+        # user-visible latency: the share of completed requests meeting
+        # their TTFT/ITL targets fell through the floor — saturation by
+        # the only definition the user can feel
+        slo = signals.mean(SIG_SLO_ATTAINMENT, w)
+        if (cfg.slo_attainment_floor > 0 and slo is not None
+                and slo < cfg.slo_attainment_floor):
+            return (f"slo attainment {slo:.2f} below floor "
+                    f"{cfg.slo_attainment_floor:.2f}")
         # the edge's own state: a deep admission queue at full
         # concurrency IS saturation even when no engine signal reaches
         # this planner (the pure-frontend configuration)

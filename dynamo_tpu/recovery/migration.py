@@ -29,13 +29,20 @@ after commit cancels the resumed request (its relay target is gone).
 Wire format (4-byte length-prefixed msgpack headers + raw payloads, the
 transfer plane's framing), one migration per connection::
 
-    → {type:"mig_begin", state:{...}, nblocks}
-    ← {type:"mig_ack", ok, reason?}
+    → {type:"mig_begin", state:{...}, nblocks, sent_at}
+    ← {type:"mig_ack", ok, reason?, recv_at, sent_at}
     → {type:"mig_blocks", offset, shape, dtype, k_bytes, v_bytes} <k> <v>
     → {type:"mig_commit"}
     ← {type:"mig_ack", ok, reason?}
     ← {type:"mig_data", payload: EngineOutput wire} ...
-    ← {type:"mig_end"} | {type:"mig_error", error}
+    ← {type:"mig_end", spans?, children?} | {type:"mig_error", error}
+
+The ``sent_at``/``recv_at`` wall-clock pair on the begin/ack exchange is
+the hop's clock-offset estimate (telemetry/stitch.py); ``mig_end`` then
+piggybacks the peer's span export so the migrated request's stitched
+timeline shows the resume on the peer instead of a silent gap — the
+source stamps ``migration.relay`` at commit, the peer
+``migration.resume`` at admit.
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ import asyncio
 import dataclasses
 import logging
 import struct
+import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
@@ -371,6 +379,7 @@ class MigrationServer:
                     return
                 mtype = header.get("type")
                 if mtype == "mig_begin":
+                    recv_at = time.time()
                     state = MigrationState.from_wire(header["state"])
                     try:
                         self.sink.reserve(
@@ -382,7 +391,11 @@ class MigrationServer:
                         await writer.drain()
                         return
                     mig_id = state.request_id
-                    _pack(writer, {"type": "mig_ack", "ok": True})
+                    # begin/ack is the offset-estimation pair: the sender
+                    # holds its own send/receive walls, we supply ours
+                    _pack(writer, {"type": "mig_ack", "ok": True,
+                                   "recv_at": recv_at,
+                                   "sent_at": time.time()})
                     await writer.drain()
                 elif mtype == "mig_blocks":
                     k_raw = await _read_exact(reader, header["k_bytes"])
@@ -434,7 +447,15 @@ class MigrationServer:
         while True:
             out = await er.out_queue.get()
             if out is None:
-                _pack(writer, {"type": "mig_end"})
+                # span export rides the stream-end frame: the peer's
+                # migration.resume → decode → completion marks (and any
+                # remote sets the peer itself collected) land in the
+                # source's stitched trace instead of a silent gap
+                _pack(writer, {
+                    "type": "mig_end",
+                    "spans": er.ctx.export_spans(),
+                    "children": list(er.ctx.remote_spans),
+                })
                 await writer.drain()
                 return
             _pack(writer, {"type": "mig_data", "payload": out.to_wire()})
@@ -474,16 +495,27 @@ async def migrate_request(
         asyncio.open_connection(host, port), connect_timeout_s
     )
     loop = asyncio.get_running_loop()
+    offset = rtt = 0.0
     try:
+        begin_sent = time.time()
         _pack(writer, {
             "type": "mig_begin", "state": state.to_wire(),
-            "nblocks": len(block_ids),
+            "nblocks": len(block_ids), "sent_at": begin_sent,
         })
         await writer.drain()
         ack = await _read_header(reader)
         if ack is None or not ack.get("ok"):
             raise MigrationRejected(
                 (ack or {}).get("reason", "peer closed during begin")
+            )
+        if ack.get("recv_at"):
+            # per-hop clock offset from the begin/ack pair — applied to
+            # the peer's span export when mig_end delivers it
+            from ..telemetry.stitch import estimate_offset
+
+            offset, rtt = estimate_offset(
+                begin_sent, ack["recv_at"],
+                ack.get("sent_at", ack["recv_at"]), time.time(),
             )
         for i in range(0, len(block_ids), chunk_blocks):
             if faults.fire("transfer_conn_drop"):
@@ -517,8 +549,9 @@ async def migrate_request(
         writer.close()
         raise
     # committed: the peer owns the request now. Stamp the hop where
-    # /debug/requests/{id} will show it, then relay.
-    er.ctx.add_stage("migration")
+    # /debug/requests/{id} will show it, then relay — the peer's half of
+    # the timeline (migration.resume onward) arrives with mig_end.
+    er.ctx.add_stage("migration.relay")
     flight_recorder().record(
         "recovery.migrate", request_id=er.request_id,
         trace_id=er.ctx.trace_id, peer=f"{host}:{port}",
@@ -526,12 +559,14 @@ async def migrate_request(
         generated=int(state.generated),
     )
     return asyncio.get_running_loop().create_task(
-        _relay(reader, writer, er), name=f"mig-relay-{er.request_id[:8]}"
+        _relay(reader, writer, er, offset, rtt),
+        name=f"mig-relay-{er.request_id[:8]}"
     )
 
 
 async def _relay(reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter, er) -> None:
+                 writer: asyncio.StreamWriter, er,
+                 offset: float = 0.0, rtt: float = 0.0) -> None:
     """Forward the peer's resumed outputs into the original out_queue —
     the client's stream continues without a break. A client disconnect
     propagates to the peer by closing the connection."""
@@ -553,6 +588,14 @@ async def _relay(reader: asyncio.StreamReader,
                     EngineOutput.from_wire(header.get("payload") or {})
                 )
             elif mtype == "mig_end":
+                if header.get("spans"):
+                    er.ctx.add_remote_spans({
+                        "source": "migration_peer",
+                        "spans": header["spans"],
+                        "offset_s": round(offset, 6),
+                        "rtt_s": round(rtt, 6),
+                        "children": header.get("children") or [],
+                    })
                 er.out_queue.put_nowait(None)
                 ended = True
                 return

@@ -140,6 +140,11 @@ class Client(AsyncEngine):
         if trace_id:
             header["trace_id"] = trace_id
         two_part = {"header": header, "payload": payload}
+        # request-send wall time, for the per-hop clock-offset estimate
+        # when the worker's end frame ships its spans back
+        import time as _time
+
+        receiver.req_sent_at = _time.time()
         await drt.messaging.publish(
             self.endpoint.subject(target), msgpack.packb(two_part, use_bin_type=True)
         )
@@ -170,9 +175,44 @@ class Client(AsyncEngine):
         finally:
             relay.cancel()
             if not exhausted and not request.context.is_stopped:
-                # caller abandoned the stream (early break / GC) — tell the
-                # worker to stop instead of generating into a dead queue
-                receiver.kill()
+                # caller stopped consuming early. For detokenizing
+                # consumers (llm/backend.py) this is the NORMAL end of
+                # every stream — they break at the finish chunk, and the
+                # worker's end frame (carrying the span export for the
+                # stitched trace) is right behind it on the wire. Give
+                # the frame pump one bounded beat to deliver it before
+                # killing; a genuinely abandoned mid-generation stream
+                # just pays 50 ms of extra cancellation latency.
+                try:
+                    if (receiver.remote_spans is None
+                            and receiver._pump_task is not None):
+                        try:
+                            await asyncio.wait_for(
+                                asyncio.shield(receiver._pump_task), 0.05
+                            )
+                        # dynlint: allow(silent-except) - best-effort grace for the end frame; the finally's kill() is the real cleanup
+                        except Exception:
+                            pass
+                finally:
+                    # kill UNCONDITIONALLY — a cancellation escaping the
+                    # grace wait (CancelledError is not an Exception)
+                    # must not leave the worker generating into a dead
+                    # queue; the caller abandoned the stream
+                    receiver.kill()
+            rs = receiver.remote_spans
+            if rs is not None:
+                # fold the worker's exported spans into this request's
+                # trace with an NTP-style offset estimated from the
+                # send/receive wall pairs — the stitched-timeline hop
+                from ..telemetry.stitch import remote_span_set
+
+                request.context.add_remote_spans(remote_span_set(
+                    rs.get("source", "worker"), rs.get("spans") or [],
+                    rs.get("recv_at", 0.0), rs.get("resp_sent_at", 0.0),
+                    getattr(receiver, "req_sent_at", 0.0),
+                    receiver.resp_recv_at,
+                    children=rs.get("children") or [],
+                ))
 
     async def direct(self, payload: Any, instance_id: str) -> ResponseReceiver:
         receiver = await self.open_stream(payload, instance_id)

@@ -185,6 +185,7 @@ class Endpoint:
         static: bool = False,
         metadata: Optional[dict] = None,
         stats_handler: Optional[Callable[[], dict]] = None,
+        span_source: str = "worker",
     ) -> "ServingEndpoint":
         """Register this endpoint and start consuming requests.
 
@@ -203,7 +204,8 @@ class Endpoint:
         subject = self.subject(instance_id)
         sub = await drt.messaging.service_subscribe(subject, queue_group=subject)
 
-        serving = ServingEndpoint(self, instance_id, subject, sub, handler, stats_handler)
+        serving = ServingEndpoint(self, instance_id, subject, sub, handler,
+                                  stats_handler, span_source=span_source)
         serving.task = drt.runtime.spawn(serving._consume())
 
         # stats RPC subject (metrics scraping; reference scrapes NATS $SRV.STATS)
@@ -234,13 +236,18 @@ class Endpoint:
 class ServingEndpoint:
     """A live endpoint consuming its subject; tracks in-flight requests."""
 
-    def __init__(self, endpoint, instance_id, subject, subscription, handler, stats_handler=None):
+    def __init__(self, endpoint, instance_id, subject, subscription, handler,
+                 stats_handler=None, span_source: str = "worker"):
         self.endpoint = endpoint
         self.instance_id = instance_id
         self.subject = subject
         self.subscription = subscription
         self.handler = handler
         self.stats_handler = stats_handler
+        # how this process names itself in cluster-stitched traces
+        # (telemetry/stitch.py): "decode_engine" for token-level engine
+        # workers, "processor" for the router hop, "worker" otherwise
+        self.span_source = span_source
         self.task: Optional[asyncio.Task] = None
         self.stats_task: Optional[asyncio.Task] = None
         self.inflight = 0
@@ -276,6 +283,7 @@ class ServingEndpoint:
                 header["conn"], stream_fn,
                 header.get("req_id", "?"),
                 trace_id=header.get("trace_id"),
+                span_source=self.span_source,
             )
         finally:
             self.inflight -= 1

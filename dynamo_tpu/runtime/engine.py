@@ -42,6 +42,16 @@ class AsyncEngineContext:
         self.id: str = request_id or uuid.uuid4().hex
         self.trace_id: str = trace_id or self.id
         self.stages: list = []  # [(stage_name, time.monotonic())]
+        # wall anchor for span EXPORT: monotonic stamps are process-local,
+        # so spans that cross a process boundary (the cluster-stitched
+        # trace, telemetry/stitch.py) ship as wall-clock times derived
+        # from this one (mono, wall) pair
+        self._anchor = (time.monotonic(), time.time())
+        # span sets collected from downstream processes (dial-back end
+        # frames, remote-prefill commits, migration end frames), each a
+        # stitch.remote_span_set dict with offsets relative to THIS
+        # process's clock
+        self.remote_spans: list = []
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
 
@@ -49,6 +59,21 @@ class AsyncEngineContext:
         """Record a processing span mark (reference:
         pipeline/context.rs:125 add_stage)."""
         self.stages.append((name, time.monotonic()))
+
+    def wall(self, t_monotonic: float) -> float:
+        """Monotonic stamp → this process's wall clock (span export)."""
+        return self._anchor[1] + (t_monotonic - self._anchor[0])
+
+    def export_spans(self) -> list:
+        """Span marks as ``[name, wall_time]`` pairs — the shape that
+        piggybacks on response/commit frames for cross-process
+        stitching (telemetry/stitch.py)."""
+        return [[name, self.wall(t)] for name, t in self.stages]
+
+    def add_remote_spans(self, span_set: dict) -> None:
+        """Attach one downstream hop's folded span set (a
+        stitch.remote_span_set dict) to this request's trace."""
+        self.remote_spans.append(span_set)
 
     def merge_stages_from(self, children: list) -> None:
         """Fold per-choice child-context spans into this trace (the n>1 /
@@ -60,6 +85,9 @@ class AsyncEngineContext:
             self.stages.extend(
                 (f"{name}#{i}", t) for name, t in child.stages
             )
+            # a choice served by a remote worker collected that worker's
+            # span set — it belongs to the parent trace like the stages
+            self.remote_spans.extend(child.remote_spans)
         self.stages.sort(key=lambda s: s[1])
 
     def stop_generating(self) -> None:

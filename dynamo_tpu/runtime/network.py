@@ -119,14 +119,23 @@ async def respond_to(
     stream_fn: Callable[[AsyncEngineContext], AsyncIterator[Any]],
     request_id: str,
     trace_id: Optional[str] = None,
+    span_source: str = "worker",
 ) -> None:
     """Worker side: dial back and pump ``stream_fn``'s output to the requester.
 
     Control frames from the requester (stop/kill) are applied to the
     engine context while streaming. ``trace_id`` is the ingress-assigned
     correlation id riding the message header; ``request_id`` (the per-hop
-    wire id) keys worker-side engine state.
+    wire id) keys worker-side engine state. ``span_source`` names this
+    process in the cluster-stitched trace: the ``end`` frame piggybacks
+    the context's span marks (plus any remote sets it collected from
+    planes further downstream) back to the requester, stamped with the
+    request-receipt and response-send wall times the requester needs for
+    clock-offset estimation (telemetry/stitch.py).
     """
+    import time as _time
+
+    recv_at = _time.time()  # request receipt on THIS process's clock
     ctx = AsyncEngineContext(request_id, trace_id=trace_id)
     scheme = conn_info.get("scheme")
     if scheme == "local":
@@ -147,7 +156,7 @@ async def respond_to(
 
         ctrl_task = asyncio.create_task(control_loop())
         try:
-            await _pump(stream_fn, ctx, send)
+            await _pump(stream_fn, ctx, send, span_source, recv_at)
         finally:
             ctrl_task.cancel()
         return
@@ -179,7 +188,7 @@ async def respond_to(
             await writer.drain()
 
         try:
-            await _pump(stream_fn, ctx, send)
+            await _pump(stream_fn, ctx, send, span_source, recv_at)
         except (ConnectionResetError, BrokenPipeError):
             ctx.kill()
         finally:
@@ -202,6 +211,8 @@ async def _pump(
     stream_fn: Callable[[AsyncEngineContext], AsyncIterator[Any]],
     ctx: AsyncEngineContext,
     send,
+    span_source: str = "worker",
+    recv_at: float = 0.0,
 ) -> None:
     # Prime the first item BEFORE the prologue: async generators don't run
     # their body until first iteration, so engine-creation errors (EngineError)
@@ -227,7 +238,23 @@ async def _pump(
                 if ctx.is_killed:
                     break
                 await send({"t": "data", "payload": item})
-        await send({"t": "end"})
+        # span export piggybacks on the end frame (no extra round trip):
+        # this process's marks plus every remote set IT collected from
+        # planes further downstream (remote prefill commit, a nested
+        # worker hop) — the requester folds them with an offset estimate
+        # from (its send time, recv_at, resp_sent_at, its receive time)
+        end: dict = {"t": "end"}
+        if ctx.stages or ctx.remote_spans:
+            import time as _time
+
+            end.update({
+                "source": span_source,
+                "spans": ctx.export_spans(),
+                "children": list(ctx.remote_spans),
+                "recv_at": recv_at,
+                "resp_sent_at": _time.time(),
+            })
+        await send(end)
     except Exception as e:  # stream died mid-flight: tell the requester
         logger.exception("response stream %s failed", ctx.id)
         await send({"t": "err", "error": f"{type(e).__name__}: {e}"})
@@ -240,6 +267,11 @@ class ResponseReceiver:
         self.context = context
         self._queue: asyncio.Queue = asyncio.Queue()
         self._send_control: Optional[Callable[[dict], None]] = None
+        # span export off the end frame: the worker's marks + the wall
+        # times the offset estimate needs; resp_recv_at is stamped HERE
+        # (this process's clock) when the end frame lands
+        self.remote_spans: Optional[dict] = None
+        self.resp_recv_at: float = 0.0
         self._prologue: asyncio.Future = asyncio.get_event_loop().create_future()
         # strong ref to the frame-pump task; bare create_task results can be
         # garbage-collected mid-stream, silently freezing the receiver
@@ -280,6 +312,11 @@ class ResponseReceiver:
             self._queue.put_nowait(("data", frame["payload"]))
             return True
         if t == "end":
+            if frame.get("spans") or frame.get("children"):
+                import time as _time
+
+                self.remote_spans = frame
+                self.resp_recv_at = _time.time()
             self._queue.put_nowait(("end", None))
             return False
         if t == "err":

@@ -1,0 +1,197 @@
+"""Live device-time accounting + serving-time roofline attribution.
+
+``bench.py`` computes a roofline fraction OFFLINE (measured decode
+tokens/s over the HBM-bandwidth bound for the same model/batch) and
+banks it in BENCH_*.json; in serving, the engine was blind. This module
+is the live mirror: the scheduler already observes every compiled
+program's completion — the sync path's executor host-sync, the
+dispatch-ahead pipeline's reconciliation, the persistent loop's
+``is_ready`` row drain — so each observation feeds a
+:class:`DeviceTimeTracker` that derives, with **zero added host syncs
+on the hot path**:
+
+- ``dynamo_engine_device_time_seconds{program,phase}`` — per-burst
+  device-busy durations (histogram: the ``_sum`` is cumulative busy
+  time, the buckets its distribution);
+- ``dynamo_engine_device_busy_ratio{phase}`` — busy vs. bubble over a
+  rolling window (1.0 = the device never waited for the host);
+- ``dynamo_engine_roofline_fraction`` — achieved HBM bytes/s over the
+  chip's peak for the decode phase: every decode step must stream the
+  weights once plus each live row's KV context, so
+  ``bytes = steps × (param_bytes + Σ ctx_i × kv_bytes_per_token)`` and
+  ``fraction = (bytes / busy_s) / peak`` — the exact serving-time twin
+  of bench.py's ``vs_baseline``.
+
+Busy time uses a serialized-interval estimator: the device executes its
+queue in order, so for observations arriving in completion order the
+busy contribution of one program is ``ready − max(dispatch,
+previous_ready)`` and the gap ``dispatch − previous_ready`` (when
+positive) is a bubble — the device genuinely ran dry. Under chained
+dispatch the intervals overlap and the estimator correctly collapses
+them instead of double-counting.
+
+Measurement points are the host's EXISTING synchronization seams; the
+only approximation is that a ready time is observed when the host
+reconciles (is_ready probe or executor sync), which can trail the true
+device completion by the drain lag. That skews busy UP and bubbles DOWN
+— conservative in the direction that matters (a reported bubble is
+always real).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Callable, Deque, Optional, Tuple
+
+# single-chip HBM bandwidth bound used for the roofline denominator.
+# v5e ≈ 819 GB/s (the same constant bench.py uses); override with
+# DYN_HBM_GBPS for other chip generations.
+HBM_GBPS_ENV = "DYN_HBM_GBPS"
+DEFAULT_HBM_GBPS = 819.0
+
+# device-time histogram ladder: bursts are sub-millisecond to ~seconds
+DEVICE_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+)
+
+
+class DeviceTimeTracker:
+    """Per-program device-busy accounting + live roofline fraction.
+
+    ``observe()`` is called at host reconciliation seams only — it does
+    pure float arithmetic and registry updates, never a device sync.
+    """
+
+    def __init__(
+        self,
+        param_bytes: float = 0.0,
+        kv_bytes_per_token: float = 0.0,
+        hbm_gbps: Optional[float] = None,
+        window_s: float = 60.0,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        from .registry import MetricsRegistry
+
+        self.param_bytes = float(param_bytes)
+        self.kv_bytes_per_token = float(kv_bytes_per_token)
+        if hbm_gbps is None:
+            try:
+                hbm_gbps = float(os.environ.get(HBM_GBPS_ENV, "")
+                                 or DEFAULT_HBM_GBPS)
+            except ValueError:
+                hbm_gbps = DEFAULT_HBM_GBPS
+        self.peak_bytes_per_s = float(hbm_gbps) * 1e9
+        self.window_s = window_s
+        self.clock = clock
+        self._last_ready_t: Optional[float] = None
+        # rolling (t, phase, busy_s, bubble_s, bytes) samples for the
+        # live gauges; lifetime totals back them up when traffic pauses
+        self._window: Deque[Tuple[float, str, float, float, float]] = (
+            collections.deque(maxlen=4096)
+        )
+        self.busy_s: dict = {}      # phase → lifetime busy seconds
+        self.bubble_s: dict = {}    # phase → lifetime bubble seconds
+        self.decode_bytes = 0.0     # lifetime decode HBM-read bytes
+        self.decode_tokens = 0
+        self.observations = 0
+
+        # private registry by default; the scheduler attaches it so the
+        # series render in the engine's scrape (CompileTracker idiom)
+        self.registry = registry or MetricsRegistry()
+        self._time_hist = self.registry.histogram(
+            "dynamo_engine_device_time_seconds",
+            "Per-dispatch device-busy duration at the host's "
+            "reconciliation seams, labelled program= and phase="
+            "prefill|decode (the _sum series is cumulative device time)",
+            buckets=DEVICE_TIME_BUCKETS,
+        )
+        self.registry.callback_gauge(
+            "dynamo_engine_device_busy_ratio",
+            "Device busy / (busy + bubble) per phase over the rolling "
+            "window — 1.0 means the device never waited for the host",
+            self._busy_ratios,
+        )
+        self.registry.callback_gauge(
+            "dynamo_engine_roofline_fraction",
+            "Achieved decode HBM bytes/s over the chip's peak bandwidth "
+            "(weights once + live rows' KV per step) — the serving-time "
+            "mirror of bench.py's vs_baseline",
+            self._roofline,
+        )
+
+    # ---------- observations (host reconciliation seams) ----------
+
+    def decode_read_bytes(self, k_steps: int,
+                          context_tokens: int) -> float:
+        """HBM bytes one K-step decode burst must stream: the weights
+        once per step plus the live rows' KV contexts
+        (``context_tokens`` = Σ context lengths across the rows)."""
+        return float(k_steps) * (
+            self.param_bytes + context_tokens * self.kv_bytes_per_token
+        )
+
+    def observe(self, program: str, phase: str, dispatch_t: float,
+                ready_t: float, read_bytes: float = 0.0,
+                tokens: int = 0) -> float:
+        """One program completion: dispatch and host-observed ready
+        times (monotonic). Returns the busy seconds attributed."""
+        last = self._last_ready_t
+        start = dispatch_t if last is None else max(dispatch_t, last)
+        busy = max(0.0, ready_t - start)
+        bubble = max(0.0, start - last) if last is not None else 0.0
+        self._last_ready_t = max(ready_t, last or ready_t)
+        self.observations += 1
+        self.busy_s[phase] = self.busy_s.get(phase, 0.0) + busy
+        if bubble:
+            self.bubble_s[phase] = self.bubble_s.get(phase, 0.0) + bubble
+        if phase == "decode":
+            self.decode_bytes += read_bytes
+            self.decode_tokens += tokens
+        self._time_hist.observe(busy, program=program, phase=phase)
+        self._window.append((self.clock(), phase, busy, bubble,
+                             read_bytes if phase == "decode" else 0.0))
+        return busy
+
+    def idle(self) -> None:
+        """The device ran out of work entirely (request-starved idle):
+        reset the serialization point so the wait for the NEXT request
+        is never charged as a bubble — matching the scheduler's own
+        bubble-clock reset when it sleeps."""
+        self._last_ready_t = None
+
+    # ---------- live gauges ----------
+
+    def _samples(self):
+        cutoff = self.clock() - self.window_s
+        return [s for s in self._window if s[0] >= cutoff]
+
+    def _busy_ratios(self):
+        samples = self._samples()
+        agg: dict = {}
+        for _, phase, busy, bubble, _b in samples:
+            b, g = agg.get(phase, (0.0, 0.0))
+            agg[phase] = (b + busy, g + bubble)
+        out = []
+        for phase, (busy, bubble) in sorted(agg.items()):
+            if busy + bubble > 0:
+                out.append(({"phase": phase}, busy / (busy + bubble)))
+        return out
+
+    def _roofline(self):
+        if not self.peak_bytes_per_s:
+            return []
+        samples = [s for s in self._samples() if s[1] == "decode"]
+        busy = sum(s[2] for s in samples)
+        read = sum(s[4] for s in samples)
+        if busy <= 0 or read <= 0:
+            # no decode inside the window: fall back to lifetime totals
+            # so a scrape just after a burst of traffic isn't blind
+            busy = self.busy_s.get("decode", 0.0)
+            read = self.decode_bytes
+        if busy <= 0 or read <= 0:
+            return []
+        return [({}, (read / busy) / self.peak_bytes_per_s)]

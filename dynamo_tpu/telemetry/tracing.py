@@ -22,11 +22,24 @@ import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 TRACE_JSONL_ENV = "DYN_TRACE_JSONL"
+TRACE_TTL_ENV = "DYN_TRACE_TTL_S"
+TRACE_CAPACITY_ENV = "DYN_TRACE_CAPACITY"
+DEFAULT_TTL_S = 600.0
+DEFAULT_CAPACITY = 512
+
+# live recorders, for the flight artifact's traces section (watchdog.
+# build_flight_artifact) — weak so a torn-down service never pins one
+_RECORDERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def recorders() -> List["TraceRecorder"]:
+    return list(_RECORDERS)
 
 
 def span_breakdown(stages: List[Tuple[str, float]],
@@ -57,12 +70,49 @@ def span_breakdown(stages: List[Tuple[str, float]],
 
 
 class TraceRecorder:
-    """Bounded ring of completed request traces (+ optional JSONL sink)."""
+    """Bounded ring of completed request traces (+ optional JSONL sink).
 
-    def __init__(self, capacity: int = 512,
+    Retention is bounded TWO ways so million-user traffic cannot grow
+    trace memory without limit: ``capacity`` is a max-entries LRU bound
+    (oldest completed trace evicted first) and ``ttl_s`` expires traces
+    by age regardless of traffic (0 disables). Both are knobs
+    (``--trace-capacity`` / ``--trace-ttl-s``, or the DYN_TRACE_* env
+    vars) and every eviction counts on
+    ``dynamo_trace_evicted_total{reason=capacity|ttl}``.
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
                  jsonl_path: Optional[str] = None,
-                 jsonl_queue_size: int = 1024):
-        self.capacity = capacity
+                 jsonl_queue_size: int = 1024,
+                 ttl_s: Optional[float] = None,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(TRACE_CAPACITY_ENV, "")
+                               or DEFAULT_CAPACITY)
+            except ValueError:
+                capacity = DEFAULT_CAPACITY
+        if ttl_s is None:
+            try:
+                ttl_s = float(os.environ.get(TRACE_TTL_ENV, "")
+                              or DEFAULT_TTL_S)
+            except ValueError:
+                ttl_s = DEFAULT_TTL_S
+        self.capacity = max(1, capacity)
+        self.ttl_s = max(0.0, ttl_s)
+        self.clock = clock
+        self._ingest_t: Dict[str, float] = {}  # request id → ingest time
+        # store mutations lock: record() runs on the event loop, but
+        # get()/recent() prune too and are called from watchdog/executor
+        # threads (flight-artifact assembly) — an unlocked prune racing
+        # a record could evict a just-written trace or KeyError mid-pop
+        self._store_lock = threading.Lock()
+        self.evicted = 0  # lifetime evictions (both reasons)
+        self._evicted_c = None
+        if registry is not None:
+            self.register_into(registry)
+        _RECORDERS.add(self)
         self.jsonl_path = (
             jsonl_path if jsonl_path is not None
             else os.environ.get(TRACE_JSONL_ENV) or None
@@ -80,6 +130,46 @@ class TraceRecorder:
         self._abandoned = False  # close() gave up: the writer owns the sink
         self.dropped = 0  # traces not written because the queue was full
         self._traces: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+
+    def register_into(self, registry) -> None:
+        """Register the eviction counter + store gauge into a
+        MetricsRegistry (the HTTP service attaches its own)."""
+        self._evicted_c = registry.counter(
+            "dynamo_trace_evicted_total",
+            "Completed traces evicted from the debug store, by reason="
+            "capacity (max-entries LRU) | ttl (age bound)",
+        )
+        registry.callback_gauge(
+            "dynamo_trace_store_requests",
+            "Completed traces currently held in the debug store",
+            lambda: len(self._traces),
+        )
+
+    def _evict(self, reason: str, n: int = 1) -> None:
+        self.evicted += n
+        if self._evicted_c is not None:
+            self._evicted_c.inc(n, reason=reason)
+
+    def _prune(self, now: Optional[float] = None) -> None:
+        """TTL + capacity enforcement (lazy: on record and on reads).
+        Callers hold ``_store_lock``."""
+        now = self.clock() if now is None else now
+        if self.ttl_s:
+            cutoff = now - self.ttl_s
+            expired = 0
+            # insertion order == recency order: stop at the first fresh
+            for rid in list(self._traces):
+                if self._ingest_t.get(rid, now) > cutoff:
+                    break
+                self._traces.pop(rid, None)
+                self._ingest_t.pop(rid, None)
+                expired += 1
+            if expired:
+                self._evict("ttl", expired)
+        while len(self._traces) > self.capacity:
+            rid, _ = self._traces.popitem(last=False)
+            self._ingest_t.pop(rid, None)
+            self._evict("capacity")
 
     def _sink_write(self, line: str) -> None:
         try:
@@ -118,7 +208,13 @@ class TraceRecorder:
         status: str,
         stages: List[Tuple[str, float]],
         end: Optional[float] = None,
+        ctx=None,
     ) -> dict:
+        """Record one completed request. ``ctx`` (the request's
+        AsyncEngineContext, optional) contributes the cross-process
+        pieces: the wall anchor of the first mark (``t0_wall``) and any
+        remote span sets collected from downstream hops — what
+        ``GET /debug/trace/{id}`` stitches into one timeline."""
         end = end if end is not None else time.monotonic()
         spans = span_breakdown(stages, end)
         trace = {
@@ -129,10 +225,15 @@ class TraceRecorder:
             "total_s": round(end - stages[0][1], 6) if stages else 0.0,
             "spans": spans,
         }
-        self._traces[request_id] = trace  # a reused id replaces its trace
-        self._traces.move_to_end(request_id)
-        while len(self._traces) > self.capacity:
-            self._traces.popitem(last=False)
+        if ctx is not None and stages:
+            trace["t0_wall"] = ctx.wall(stages[0][1])
+            if ctx.remote_spans:
+                trace["remote"] = list(ctx.remote_spans)
+        with self._store_lock:
+            self._traces[request_id] = trace  # a reused id replaces its trace
+            self._traces.move_to_end(request_id)
+            self._ingest_t[request_id] = self.clock()
+            self._prune()
         if self.jsonl_path and not self._stop.is_set():  # no sink after close()
             if self._writer is None:
                 self._writer = threading.Thread(
@@ -184,10 +285,14 @@ class TraceRecorder:
             self._sink = None
 
     def get(self, request_id: str) -> Optional[dict]:
-        return self._traces.get(request_id)
+        with self._store_lock:
+            self._prune()
+            return self._traces.get(request_id)
 
     def recent(self, n: int = 50) -> List[dict]:
-        return list(self._traces.values())[-n:]
+        with self._store_lock:
+            self._prune()
+            return list(self._traces.values())[-n:]
 
     def __len__(self) -> int:
         return len(self._traces)

@@ -116,6 +116,17 @@ def build_flight_artifact(reason: str = "on_demand",
                            wd.name, e)
             entry["error"] = repr(e)
         sources.append(entry)
+    # recent completed request traces (incl. their stitched remote span
+    # sets): lets scripts/flightdump.py --trace render a request X-ray
+    # offline from the artifact alone
+    from . import tracing
+
+    traces: List[dict] = []
+    for rec in tracing.recorders():
+        try:
+            traces.extend(rec.recent(32))
+        except Exception:
+            logger.debug("trace recorder snapshot failed", exc_info=True)
     return {
         "version": 1,
         "reason": reason,
@@ -126,6 +137,7 @@ def build_flight_artifact(reason: str = "on_demand",
         "dropped_events": dropped,
         "threads": _thread_stacks(),
         "sources": sources,
+        "traces": traces,
     }
 
 

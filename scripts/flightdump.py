@@ -11,17 +11,27 @@ supporting sections.
 Usage:
     python scripts/flightdump.py <artifact.json> [--request <id>]
         [--last N] [--no-stacks] [--no-requests] [--metrics]
+    python scripts/flightdump.py <artifact.json | traces.jsonl> --trace <id>
 
 ``--request <id>`` filters the event table (and request tables) to one
 request/trace id — the "what happened to MY request" view. ``--last N``
 keeps only the most recent N events. ``--metrics`` additionally prints
 the (long) metrics snapshot of each source.
+
+``--trace <id>`` renders the request X-RAY instead: the cluster-
+stitched span timeline the live server serves at
+``GET /debug/trace/{id}``, reconstructed offline from either a flight
+artifact's ``traces`` section or a ``DYN_TRACE_JSONL`` sink (one trace
+object per line) — the post-mortem view when the server is gone. Shows
+each hop's clock offset/rtt, every span on the trace-origin axis, and
+the unattributed gaps. Exits 2 when the id is not in the file.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import List, Optional
@@ -108,6 +118,69 @@ def render_stacks(threads: List[dict]) -> List[str]:
     return lines
 
 
+def _iter_traces(path: str):
+    """Traces from either input shape: a flight artifact (its "traces"
+    section) or a DYN_TRACE_JSONL sink (one trace object per line)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "spans" in doc:
+        return [doc]  # a single-trace JSONL file parses as one object
+    if isinstance(doc, dict):
+        return list(doc.get("traces") or [])
+    traces = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "spans" in obj:
+            traces.append(obj)
+    return traces
+
+
+def render_trace(trace: dict) -> str:
+    """One stitched timeline, mirroring GET /debug/trace/{id}: per-hop
+    offset table, span rows on the trace-origin axis, gap attribution."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from dynamo_tpu.telemetry.stitch import stitched_timeline, timeline_gaps
+
+    stitched = stitched_timeline(trace)
+    out = [
+        f"trace {trace.get('request_id')}: model={trace.get('model')} "
+        f"status={trace.get('status')} total={trace.get('total_s', 0):.4f}s",
+        "",
+        f"{'SOURCE':<18} {'CLOCK OFFSET':>13} {'RTT':>9}",
+    ]
+    for src in stitched["sources"]:
+        out.append(
+            f"{src['source']:<18} {src['offset_s']:>+12.6f}s "
+            f"{src['rtt_s']:>8.4f}s"
+        )
+    out += ["", f"{'START':>10} {'DUR':>9} {'SOURCE':<18} SPAN"]
+    for row in stitched["timeline"]:
+        out.append(
+            f"{row['start_s']:>+9.4f}s {row['duration_s']:>8.4f}s "
+            f"{row['source']:<18} {row['name']}"
+        )
+    gaps = timeline_gaps(stitched["timeline"], min_gap_s=0.0005)
+    if gaps:
+        out += ["", "unattributed gaps (no span of any source):"]
+        for g in gaps:
+            out.append(
+                f"{g['start_s']:>+9.4f}s {g['duration_s']:>8.4f}s "
+                f"  between {g['after']} and {g['before']}"
+            )
+    return "\n".join(out)
+
+
 def render(artifact: dict, request: Optional[str] = None,
            last: Optional[int] = None, stacks: bool = True,
            requests: bool = True, metrics: bool = False) -> str:
@@ -157,6 +230,11 @@ def main(argv: List[str]) -> int:
     ap.add_argument("--request", default=None,
                     help="filter events/request tables to one request or "
                          "trace id")
+    ap.add_argument("--trace", default=None,
+                    help="render the stitched span timeline of one "
+                         "request id (from the artifact's traces section "
+                         "or a DYN_TRACE_JSONL file) instead of the "
+                         "event table; exit 2 on unknown id")
     ap.add_argument("--last", type=int, default=None,
                     help="only the most recent N events")
     ap.add_argument("--no-stacks", action="store_true",
@@ -166,6 +244,21 @@ def main(argv: List[str]) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="also print each source's metrics snapshot")
     args = ap.parse_args(argv[1:])
+    if args.trace:
+        try:
+            traces = _iter_traces(args.artifact)
+        except OSError as e:
+            print(f"flightdump: cannot read {args.artifact}: {e}",
+                  file=sys.stderr)
+            return 2
+        match = [t for t in traces if t.get("request_id") == args.trace]
+        if not match:
+            print(f"flightdump: no trace {args.trace!r} in "
+                  f"{args.artifact} ({len(traces)} trace(s) present)",
+                  file=sys.stderr)
+            return 2
+        print(render_trace(match[-1]))  # newest wins for a reused id
+        return 0
     try:
         with open(args.artifact) as f:
             artifact = json.load(f)

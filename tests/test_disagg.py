@@ -97,7 +97,8 @@ async def test_transfer_server_roundtrip(hf_model_dir):
     commits = []
     server = KvTransferServer(
         scatter=lambda rid, ids, k, v: runner_b.scatter_blocks(ids, k, v),
-        on_commit=lambda rid, tok, lp, top=None: commits.append((rid, tok, lp)),
+        on_commit=lambda rid, tok, lp, top=None, spans=None:
+            commits.append((rid, tok, lp)),
     )
     await server.start()
     try:

@@ -1051,3 +1051,47 @@ def test_task_leak_flags_migration_relay_shaped_discarded_task():
         "task-leak",
     )
     assert [f.rule for f in out] == ["task-leak"]
+
+
+# --------------------------------------------------------------------------
+# request X-ray: the cross-process trace/SLO/device-time modules
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_xray_telemetry_modules_pass_async_blocking_and_task_leak():
+    """The X-ray modules sit on every request's exit path (trace record,
+    SLO verdict) and on the scheduler's reconciliation seams (device
+    time), so their own discipline is load-bearing: span folding and SLO
+    accounting are pure arithmetic that must never block the event loop,
+    and nothing here may spawn an unheld task. Pin the whole vertical
+    ZERO-finding, not baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "telemetry", "tracing.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "stitch.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "device_time.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "slo.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "x-ray telemetry discipline regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_span_export_write_on_loop_shape():
+    """TP fixture shaped like a careless span exporter: serializing the
+    stitched trace to disk directly on the event loop — exactly the
+    stall the trace JSONL sink's writer thread (and the flight
+    artifact's run_in_executor write) exist to avoid."""
+    out = findings(
+        """
+        import json
+
+        async def export_stitched_trace(trace, path):
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+    assert "open" in out[0].message

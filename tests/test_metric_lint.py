@@ -77,10 +77,21 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_recovery_drain_duration_seconds",
         "dynamo_engine_restarts_total",
         "dynamo_kv_router_draining_worker_skips_total",
+        # request X-ray: device-time/roofline attribution
+        # (telemetry/device_time.py), SLO goodput (telemetry/slo.py),
+        # bounded trace store (telemetry/tracing.py)
+        "dynamo_engine_device_time_seconds",
+        "dynamo_engine_device_busy_ratio",
+        "dynamo_engine_roofline_fraction",
+        "dynamo_slo_attainment_total",
+        "dynamo_slo_goodput_tokens_total",
+        "dynamo_slo_target_seconds",
+        "dynamo_trace_evicted_total",
+        "dynamo_trace_store_requests",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 53
+    assert len(names) >= 61
 
 
 def _metric(name, kind):
@@ -104,3 +115,8 @@ def test_rules_accept_good_names():
     assert not check_name(_metric("dynamo_scheduler_step_duration_seconds", "histogram"))
     assert not check_name(_metric("dynamo_kv_block_usage_ratio", "gauge"))
     assert not check_name(_metric("dynamo_scheduler_active_slots", "gauge"))
+    # "fraction" joined the unit vocabulary with the live roofline gauge
+    # (achieved-over-physical-bound, vs "ratio"'s part-of-whole share)
+    assert not check_name(_metric("dynamo_engine_roofline_fraction", "gauge"))
+    # it names a bound comparison, not a base unit a histogram measures
+    assert check_name(_metric("dynamo_engine_roofline_fraction", "histogram"))

@@ -21,7 +21,15 @@ from dynamo_tpu.disagg.prefill_worker import PrefillWorker
 from dynamo_tpu.disagg.protocols import RemotePrefillRequest
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngineContext
 from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+
+def _dequeue_ctx(rpr):
+    # serve_one's contract: ctx arrives with prefill.dequeue stamped
+    ctx = AsyncEngineContext(trace_id=rpr.trace_id or rpr.request_id)
+    ctx.add_stage("prefill.dequeue")
+    return ctx
 
 
 def _config(**kw):
@@ -77,12 +85,14 @@ class _SlowClient:
         self.events = events
         self.wire_delay = wire_delay
 
-    async def send_blocks(self, request_id, block_ids, k, v, chunk_blocks=16):
+    async def send_blocks(self, request_id, block_ids, k, v, chunk_blocks=16,
+                          trace_id=None):
         self.events.append(("send_start", tuple(block_ids)))
         await asyncio.sleep(self.wire_delay)
         self.events.append(("send_done", tuple(block_ids)))
 
-    async def send_commit(self, request_id, token, logprob, top=None):
+    async def send_commit(self, request_id, token, logprob, top=None,
+                          spans=None):
         self.events.append(("commit",))
         return True
 
@@ -103,7 +113,7 @@ async def _run_worker(depth, n_tokens=24):
         block_ids=list(range(40, 40 + blocks)), num_cached=0, seed=0,
     )
     try:
-        await asyncio.wait_for(worker._handle(rpr), timeout=30)
+        await asyncio.wait_for(worker._handle(rpr, _dequeue_ctx(rpr)), timeout=30)
     finally:
         await drt.close()
     return events, worker
@@ -150,7 +160,7 @@ async def test_frame_failure_leaves_item_for_redelivery():
 
     class _DyingClient(_SlowClient):
         async def send_blocks(self, request_id, block_ids, k, v,
-                              chunk_blocks=16):
+                              chunk_blocks=16, trace_id=None):
             self.events.append(("send_start", tuple(block_ids)))
             raise ConnectionResetError("wire died")
 
@@ -164,7 +174,7 @@ async def test_frame_failure_leaves_item_for_redelivery():
     )
     try:
         with pytest.raises(ConnectionResetError):
-            await asyncio.wait_for(worker._handle(rpr), timeout=30)
+            await asyncio.wait_for(worker._handle(rpr, _dequeue_ctx(rpr)), timeout=30)
     finally:
         await drt.close()
     assert ("commit",) not in events
@@ -199,7 +209,7 @@ async def test_compute_failure_with_healthy_pump_does_not_wedge():
         with pytest.raises(RuntimeError, match="device fault"):
             # wait_for is the regression oracle: the pre-fix behavior
             # deadlocked in pipe.shutdown() and timed out here
-            await asyncio.wait_for(worker._handle(rpr), timeout=10)
+            await asyncio.wait_for(worker._handle(rpr, _dequeue_ctx(rpr)), timeout=10)
     finally:
         await drt.close()
     assert ("commit",) not in events

@@ -469,6 +469,9 @@ def _drive_migration(wedge: bool, max_tokens=48, conn_drop=False):
             out["respawned"] = bool(respawned)
         out["hooks"] = hooks
         out["stages"] = [s for s, _ in er.ctx.stages]
+        # cluster-stitched trace material: the peer's span export rode
+        # the mig_end frame back into the source context
+        out["remote"] = list(er.ctx.remote_spans)
         out["src_used"] = src.allocator.used
         out["src_metrics"] = src.metrics()
         out["dst_steps"] = dst.steps
@@ -503,10 +506,18 @@ def test_admin_drain_migrates_hot_stream_byte_identical():
     assert out["dst_steps"] > 0, "the peer never decoded"
     assert 'mode="hot",outcome="committed"' in out["migrations"] \
         or 'outcome="committed",mode="hot"' in out["migrations"]
-    # zero leaks on either side, and the hop is traceable
+    # zero leaks on either side, and the hop is traceable from BOTH
+    # ends: the source stamps migration.relay at commit, the peer's
+    # migration.resume (and its decode tail) ships back on mig_end
     assert out["src_used"] == 0
     assert out["dst_used"] == 0
-    assert "migration" in out["stages"]
+    assert "migration.relay" in out["stages"]
+    peer_sets = [rs for rs in out["remote"]
+                 if rs["source"] == "migration_peer"]
+    assert peer_sets, "peer span export never arrived on mig_end"
+    peer_names = [n for n, _ in peer_sets[0]["spans"]]
+    assert "migration.resume" in peer_names
+    assert "completion" in peer_names
     assert "deregister" in out["hooks"]
 
 
@@ -548,8 +559,13 @@ def test_wedge_trips_drain_migrate_respawn():
         request_total_slots=4, kv_total_blocks=64))
     for _ in range(10):
         assert ks.schedule(16, OverlapScores()).worker_id == "dst"
-    # the hop shows up in the request's trace
-    assert "migration" in out["stages"]
+    # the hop shows up in the request's stitched trace from both ends:
+    # relay on the source, resume (cold re-prefill + decode) on the peer
+    assert "migration.relay" in out["stages"]
+    peer_sets = [rs for rs in out["remote"]
+                 if rs["source"] == "migration_peer"]
+    assert peer_sets, "peer span export never arrived on mig_end"
+    assert "migration.resume" in [n for n, _ in peer_sets[0]["spans"]]
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,629 @@
+"""Request X-ray: cluster-stitched traces, device-time/roofline
+attribution, SLO goodput — unit coverage for telemetry/{stitch,
+device_time,slo}.py and the trace-store bounds, plus the cross-process
+e2e (frontend → decode worker → prefill worker on one timeline)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.telemetry.device_time import DeviceTimeTracker
+from dynamo_tpu.telemetry.registry import MetricsRegistry
+from dynamo_tpu.telemetry.slo import SloTracker
+from dynamo_tpu.telemetry.stitch import (
+    estimate_offset,
+    estimate_offset_return_leg,
+    remote_span_set,
+    stitched_timeline,
+    timeline_gaps,
+)
+from dynamo_tpu.telemetry.tracing import TraceRecorder
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# clock-offset estimation (injected skew)
+# --------------------------------------------------------------------------
+
+
+def test_offset_recovered_under_symmetric_legs():
+    """Remote clock 500 s ahead, symmetric 10 ms legs, a LONG remote
+    hold (37 s): the offset estimate is exact — remote processing time
+    drops out of the NTP formula entirely."""
+    skew = 500.0
+    sent = 1000.0
+    recv_remote = sent + 0.010 + skew          # after the forward leg
+    resp_sent_remote = recv_remote + 37.0      # remote held it 37 s
+    resp_recv_local = sent + 0.010 + 37.0 + 0.010
+    offset, rtt = estimate_offset(
+        sent, recv_remote, resp_sent_remote, resp_recv_local)
+    assert offset == pytest.approx(skew, abs=1e-9)
+    assert rtt == pytest.approx(0.020, abs=1e-9)
+
+
+def test_offset_error_bounded_by_half_rtt():
+    """Fully asymmetric legs (all 80 ms on the forward leg): the error
+    is exactly rtt/2 — the documented confidence bound."""
+    skew = -123.0
+    sent = 50.0
+    recv_remote = sent + 0.080 + skew
+    resp_sent_remote = recv_remote + 1.0
+    resp_recv_local = sent + 0.080 + 1.0  # return leg instantaneous
+    offset, rtt = estimate_offset(
+        sent, recv_remote, resp_sent_remote, resp_recv_local)
+    assert rtt == pytest.approx(0.080, abs=1e-9)
+    assert abs(offset - skew) == pytest.approx(rtt / 2, abs=1e-9)
+
+
+def test_negative_apparent_rtt_clamps_to_zero():
+    # skewed stamps can make the apparent rtt negative; never propagate it
+    _, rtt = estimate_offset(10.0, 5.0, 6.0, 10.5)
+    assert rtt == 0.0
+
+
+def test_queued_forward_offset_immune_to_queue_wait():
+    """The remote-prefill hop's forward "leg" is a queue submit: a 4 s
+    backlog must NOT skew the offset by ~2 s (the symmetric formula
+    would). queued_forward estimates from the commit return leg alone —
+    error bounded by the one-way commit transit, not the queue wait."""
+    skew = 77.0
+    submit = 1000.0
+    dequeue_remote = submit + 4.0 + skew        # 4 s queue backlog
+    commit_sent_remote = dequeue_remote + 2.5   # prefill compute
+    commit_recv_local = submit + 4.0 + 2.5 + 0.004  # 4 ms commit transit
+    # symmetric formula: half the queue wait lands in the estimate
+    sym, _ = estimate_offset(
+        submit, dequeue_remote, commit_sent_remote, commit_recv_local)
+    assert abs(sym - skew) > 1.9
+    # return-leg-only: error is exactly the one-way commit transit
+    one_way = estimate_offset_return_leg(
+        commit_sent_remote, commit_recv_local)
+    assert one_way == pytest.approx(skew - 0.004, abs=1e-9)
+    # remote_span_set(queued_forward=True) folds with the good estimate
+    rs = remote_span_set(
+        "prefill_worker", [["prefill.dequeue", dequeue_remote]],
+        recv_at=dequeue_remote, resp_sent_at=commit_sent_remote,
+        sent_local=submit, resp_recv_local=commit_recv_local,
+        queued_forward=True,
+    )
+    assert rs["offset_s"] == pytest.approx(skew - 0.004, abs=1e-6)
+    # the dequeue span renders at its TRUE local-axis position (~4 s in)
+    tl = stitched_timeline({
+        "request_id": "r", "t0_wall": submit, "spans": [], "remote": [rs],
+    })
+    (row,) = tl["timeline"]
+    assert row["start_s"] == pytest.approx(4.0, abs=0.01)
+
+
+# --------------------------------------------------------------------------
+# stitched timeline (skewed sources, nested hops, gaps)
+# --------------------------------------------------------------------------
+
+
+def _trace_with_remote(skew_worker=1000.0, skew_prefill=2000.0):
+    """A synthetic frontend trace + a decode-worker hop (clock +1000 s)
+    that itself holds a prefill-worker hop (clock +2000 s vs frontend,
+    i.e. +1000 s vs the worker). True frontend-axis times: worker spans
+    at 0.10/0.20, prefill spans at 0.12/0.18."""
+    t0 = 10_000.0
+    worker = remote_span_set(
+        "decode_engine",
+        [["admission", t0 + 0.10 + skew_worker],
+         ["completion", t0 + 0.20 + skew_worker]],
+        recv_at=t0 + 0.05 + skew_worker,
+        resp_sent_at=t0 + 0.21 + skew_worker,
+        sent_local=t0 + 0.05, resp_recv_local=t0 + 0.21,
+        children=[remote_span_set(
+            "prefill_worker",
+            [["prefill.dequeue", t0 + 0.12 + skew_prefill],
+             ["prefill.compute", t0 + 0.18 + skew_prefill]],
+            recv_at=t0 + 0.11 + skew_prefill,
+            resp_sent_at=t0 + 0.19 + skew_prefill,
+            # the worker folded this child against ITS clock
+            sent_local=t0 + 0.11 + skew_worker,
+            resp_recv_local=t0 + 0.19 + skew_worker,
+        )],
+    )
+    return {
+        "request_id": "r1", "model": "m", "status": "success",
+        "total_s": 0.25, "t0_wall": t0,
+        "spans": [
+            {"name": "http", "offset_s": 0.0, "duration_s": 0.0},
+            {"name": "first_token", "offset_s": 0.0, "duration_s": 0.22},
+            {"name": "egress", "offset_s": 0.22, "duration_s": 0.03},
+        ],
+        "remote": [worker],
+    }
+
+
+def test_stitched_timeline_renders_skewed_sources_on_one_axis():
+    stitched = stitched_timeline(_trace_with_remote())
+    by_source = {}
+    for row in stitched["timeline"]:
+        by_source.setdefault(row["source"], []).append(row)
+    assert set(by_source) == {"frontend", "decode_engine", "prefill_worker"}
+    # the worker's completion span: starts at its admission mark (0.10
+    # on the frontend axis, the 1000 s skew fully corrected) and runs
+    # to 0.20
+    comp = [r for r in by_source["decode_engine"]
+            if r["name"] == "completion"][0]
+    assert comp["start_s"] == pytest.approx(0.10, abs=1e-3)
+    assert comp["duration_s"] == pytest.approx(0.10, abs=1e-3)
+    # the nested prefill hop composes BOTH offsets (frontend→worker→
+    # prefill): its compute span sits at 0.12..0.18 on the same axis
+    pcomp = [r for r in by_source["prefill_worker"]
+             if r["name"] == "prefill.compute"][0]
+    assert pcomp["start_s"] == pytest.approx(0.12, abs=1e-3)
+    assert pcomp["duration_s"] == pytest.approx(0.06, abs=1e-3)
+    # per-hop confidence metadata is present for every source
+    assert {s["source"] for s in stitched["sources"]} == {
+        "frontend", "decode_engine", "prefill_worker"}
+
+
+def test_timeline_gaps_attribute_uncovered_time():
+    timeline = [
+        {"source": "frontend", "name": "http", "start_s": 0.0,
+         "duration_s": 0.01},
+        {"source": "decode_engine", "name": "prefill", "start_s": 0.5,
+         "duration_s": 0.1},
+    ]
+    gaps = timeline_gaps(timeline, min_gap_s=0.001)
+    assert len(gaps) == 1
+    assert gaps[0]["start_s"] == pytest.approx(0.01)
+    assert gaps[0]["duration_s"] == pytest.approx(0.49)
+    assert gaps[0]["after"] == "frontend:http"
+    assert gaps[0]["before"] == "decode_engine:prefill"
+
+
+def test_stitch_depth_is_bounded():
+    """A hostile/buggy frame cannot recurse the stitcher to death."""
+    inner = {"source": "w", "spans": [["x", 1.0]], "offset_s": 0.0,
+             "rtt_s": 0.0, "children": []}
+    for _ in range(40):
+        inner = {"source": "w", "spans": [], "offset_s": 0.0,
+                 "rtt_s": 0.0, "children": [inner]}
+    trace = {"t0_wall": 0.0, "spans": [], "remote": [inner]}
+    stitched = stitched_timeline(trace)  # must terminate
+    assert all(r["name"] != "x" for r in stitched["timeline"])
+
+
+# --------------------------------------------------------------------------
+# trace store bounds: TTL + max-entries LRU, evictions counted
+# --------------------------------------------------------------------------
+
+
+def _recorder(**kw):
+    reg = MetricsRegistry()
+    clock = {"t": 0.0}
+    rec = TraceRecorder(registry=reg, clock=lambda: clock["t"], **kw)
+    return rec, reg, clock
+
+
+def test_trace_store_capacity_lru_evicts_and_counts():
+    rec, reg, _ = _recorder(capacity=3, ttl_s=0)
+    for i in range(5):
+        rec.record(f"r{i}", "m", "success", [("http", float(i))], end=float(i))
+    assert len(rec) == 3
+    assert rec.get("r0") is None and rec.get("r1") is None
+    assert rec.get("r4") is not None
+    assert rec.evicted == 2
+    assert 'dynamo_trace_evicted_total{reason="capacity"} 2.0' in reg.render()
+
+
+def test_trace_store_ttl_expires_and_counts():
+    rec, reg, clock = _recorder(capacity=100, ttl_s=10.0)
+    rec.record("old", "m", "success", [("http", 0.0)], end=0.0)
+    clock["t"] = 5.0
+    rec.record("mid", "m", "success", [("http", 0.0)], end=0.0)
+    clock["t"] = 11.0
+    # "old" is 11 s stale → expired on the next touch; "mid" survives
+    assert rec.get("old") is None
+    assert rec.get("mid") is not None
+    assert rec.evicted == 1
+    assert 'dynamo_trace_evicted_total{reason="ttl"} 1.0' in reg.render()
+    # the store gauge renders the live count
+    assert "dynamo_trace_store_requests 1" in reg.render()
+
+
+def test_trace_store_ttl_zero_disables_age_eviction():
+    rec, _, clock = _recorder(capacity=100, ttl_s=0)
+    rec.record("r", "m", "success", [("http", 0.0)], end=0.0)
+    clock["t"] = 1e9
+    assert rec.get("r") is not None
+
+
+# --------------------------------------------------------------------------
+# device-time tracker: serialized intervals, bubbles, roofline
+# --------------------------------------------------------------------------
+
+
+def _tracker(**kw):
+    clock = {"t": 0.0}
+    kw.setdefault("param_bytes", 1e9)
+    kw.setdefault("kv_bytes_per_token", 1e3)
+    kw.setdefault("hbm_gbps", 100.0)  # peak = 1e11 B/s
+    t = DeviceTimeTracker(clock=lambda: clock["t"], **kw)
+    return t, clock
+
+
+def test_overlapping_chained_observations_serialize_not_double_count():
+    """Three chained bursts dispatched back-to-back at t=0.00/0.01/0.02,
+    each 0.1 s of device time, reconciled late: busy must total 0.3 s
+    (the device ran them serially), not 3 × (ready − dispatch)."""
+    t, _ = _tracker()
+    t.observe("decode_burst_df", "decode", 0.00, 0.10)
+    t.observe("decode_burst_df", "decode", 0.01, 0.20)
+    t.observe("decode_burst_df", "decode", 0.02, 0.30)
+    assert t.busy_s["decode"] == pytest.approx(0.30, abs=1e-9)
+    assert t.bubble_s.get("decode", 0.0) == 0.0
+
+
+def test_bubble_between_sync_bursts_is_charged():
+    t, _ = _tracker()
+    t.observe("decode", "decode", 0.0, 0.1)
+    # next dispatch 50 ms after the previous ready: the device ran dry
+    t.observe("decode", "decode", 0.15, 0.25)
+    assert t.busy_s["decode"] == pytest.approx(0.2, abs=1e-9)
+    assert t.bubble_s["decode"] == pytest.approx(0.05, abs=1e-9)
+    ratios = dict(
+        (labels["phase"], v) for labels, v in t._busy_ratios()
+    )
+    assert ratios["decode"] == pytest.approx(0.2 / 0.25, abs=1e-6)
+
+
+def test_idle_reset_never_charges_request_starved_wait():
+    t, _ = _tracker()
+    t.observe("decode", "decode", 0.0, 0.1)
+    t.idle()  # queue drained; next request arrives much later
+    t.observe("decode", "decode", 100.0, 100.1)
+    assert t.bubble_s.get("decode", 0.0) == 0.0
+
+
+def test_roofline_fraction_matches_byte_model():
+    t, _ = _tracker()
+    # one 8-step burst over 4 rows, 100-token contexts: bytes =
+    # 8 * (1e9 + 4*100*1e3) = 8.0032e9 over 0.1 s busy → 8.0032e10 B/s
+    # over the 1e11 peak = 0.80032
+    rb = t.decode_read_bytes(8, 400)
+    t.observe("decode_burst", "decode", 0.0, 0.1, read_bytes=rb, tokens=32)
+    ((_, frac),) = t._roofline()
+    assert frac == pytest.approx(0.80032, rel=1e-6)
+    # and it renders on the registry as the gauge
+    assert "dynamo_engine_roofline_fraction" in t.registry.render()
+
+
+def test_prefill_busy_never_feeds_the_roofline():
+    t, _ = _tracker()
+    t.observe("prefill", "prefill", 0.0, 1.0, read_bytes=5e9)
+    assert t._roofline() == []  # no decode bytes/busy yet
+    assert t.busy_s["prefill"] == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# SLO attainment + goodput
+# --------------------------------------------------------------------------
+
+
+def test_slo_verdicts_and_goodput_counters():
+    clock = {"t": 0.0}
+    slo = SloTracker(ttft_s=0.5, itl_s=0.1, clock=lambda: clock["t"])
+    assert slo.observe(0.2, 0.05, tokens=10) is True     # both met
+    assert slo.observe(0.9, 0.05, tokens=10) is False    # ttft miss
+    assert slo.observe(0.2, 0.5, tokens=10) is False     # worst-gap miss
+    assert slo.observe(0.2, None, tokens=1) is True      # single token
+    text = slo.registry.render()
+    assert 'dynamo_slo_attainment_total{met="true",slo="ttft"} 3.0' in text
+    assert 'dynamo_slo_attainment_total{met="false",slo="ttft"} 1.0' in text
+    assert 'dynamo_slo_attainment_total{met="false",slo="itl"} 1.0' in text
+    assert "dynamo_slo_goodput_tokens_total 11.0" in text
+    assert 'dynamo_slo_target_seconds{slo="ttft"} 0.5' in text
+    snap = slo.snapshot()
+    assert snap["slo.attainment"] == pytest.approx(0.5)
+    assert snap["slo.ttft_attainment"] == pytest.approx(0.75)
+    assert snap["slo.goodput_tokens_per_s"] > 0
+
+
+def test_slo_snapshot_goes_blind_outside_window():
+    clock = {"t": 0.0}
+    slo = SloTracker(ttft_s=0.5, window_s=10.0, clock=lambda: clock["t"])
+    slo.observe(0.1, None, tokens=5)
+    clock["t"] = 60.0
+    assert slo.snapshot() == {}  # the policy skips, never acts on stale
+
+
+def test_slo_goodput_rate_survives_capacity_truncation():
+    """Above ~68 completed req/s the verdict deque (maxlen 4096) evicts
+    in-window rows; the goodput rate must divide by the span the
+    RETAINED rows cover, not the full window — otherwise a sustained
+    200 req/s reads 3x low into the planner."""
+    clock = {"t": 0.0}
+    slo = SloTracker(ttft_s=10.0, window_s=60.0, clock=lambda: clock["t"])
+    rate, tokens = 200.0, 50
+    # 90 s of sustained traffic: the deque retains only the newest
+    # 4096 verdicts (~20.5 s of it)
+    n = int(90 * rate)
+    for i in range(n):
+        clock["t"] = i / rate
+        slo.observe(0.1, None, tokens=tokens)
+    snap = slo.snapshot()
+    true_rate = rate * tokens
+    assert snap["slo.goodput_tokens_per_s"] == pytest.approx(
+        true_rate, rel=0.05)
+    # attainment fractions are ratios over the same rows — unaffected
+    assert snap["slo.attainment"] == 1.0
+
+
+def test_policy_sheds_on_slo_attainment_floor():
+    """The control loop acts on user-visible latency: attainment below
+    the floor reads as saturation and steps the shed ladder."""
+    from dynamo_tpu.planner.policy import (
+        SIG_SLO_ATTAINMENT,
+        PolicyConfig,
+        SlaPolicy,
+    )
+    from dynamo_tpu.planner.signals import SignalStore
+
+    clock = {"t": 0.0}
+    signals = SignalStore(clock=lambda: clock["t"])
+    policy = SlaPolicy(PolicyConfig(slo_attainment_floor=0.9),
+                       clock=lambda: clock["t"])
+    signals.observe(SIG_SLO_ATTAINMENT, 0.4)
+    actions = policy.decide(signals, {})
+    shed = [a for a in actions if getattr(a, "shed_level", 0) == 1]
+    assert shed and "slo attainment" in shed[0].reason
+    # healthy attainment does NOT trip it
+    policy2 = SlaPolicy(PolicyConfig(slo_attainment_floor=0.9),
+                        clock=lambda: clock["t"])
+    signals2 = SignalStore(clock=lambda: clock["t"])
+    signals2.observe(SIG_SLO_ATTAINMENT, 0.99)
+    assert not policy2.decide(signals2, {})
+
+
+# --------------------------------------------------------------------------
+# flightdump --trace: the offline X-ray
+# --------------------------------------------------------------------------
+
+
+def _run_flightdump(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "flightdump.py"),
+         *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_flightdump_trace_from_artifact(tmp_path):
+    artifact = {"version": 1, "reason": "test", "events": [],
+                "traces": [_trace_with_remote()]}
+    path = tmp_path / "flight.json"
+    path.write_text(json.dumps(artifact))
+    res = _run_flightdump(str(path), "--trace", "r1")
+    assert res.returncode == 0, res.stderr
+    assert "decode_engine" in res.stdout
+    assert "prefill_worker" in res.stdout
+    assert "CLOCK OFFSET" in res.stdout
+    # the +1000 s skew was corrected, not rendered as a span position
+    assert "+1000" not in res.stdout.split("CLOCK OFFSET")[0]
+
+
+def test_flightdump_trace_from_jsonl_sink(tmp_path):
+    path = tmp_path / "traces.jsonl"
+    path.write_text(json.dumps(_trace_with_remote()) + "\n")
+    res = _run_flightdump(str(path), "--trace", "r1")
+    assert res.returncode == 0, res.stderr
+    assert "prefill_worker" in res.stdout
+
+
+def test_flightdump_trace_unknown_id_exits_2(tmp_path):
+    path = tmp_path / "flight.json"
+    path.write_text(json.dumps({"traces": [_trace_with_remote()]}))
+    res = _run_flightdump(str(path), "--trace", "nope")
+    assert res.returncode == 2
+    assert "no trace" in res.stderr
+
+
+# --------------------------------------------------------------------------
+# cross-process hop over the runtime plane: spans ride the end frame
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_worker_spans_fold_into_requester_context():
+    from dynamo_tpu.runtime.client import Client
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import AsyncEngineContext, Context
+    from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    ep = drt.namespace("t").component("w").endpoint("gen")
+
+    async def handler(payload, ctx):
+        ctx.add_stage("admission")
+        await asyncio.sleep(0.01)
+        ctx.add_stage("completion")
+        yield {"ok": True, "trace": ctx.trace_id}
+
+    serving = await ep.serve(handler, span_source="decode_engine")
+    client = await Client(ep).start()
+    await client.wait_for_instances(1)
+    ctx = Context({"x": 1}, AsyncEngineContext(trace_id="xray-hop"))
+    items = [item async for item in client.generate(ctx)]
+    assert items[0]["trace"] == "xray-hop"  # trace context crossed
+    assert len(ctx.context.remote_spans) == 1
+    rs = ctx.context.remote_spans[0]
+    assert rs["source"] == "decode_engine"
+    assert [n for n, _ in rs["spans"]] == ["admission", "completion"]
+    # same process, same clock: the estimated offset is ~0 and the span
+    # durations survive the fold (completion ≈ 10 ms after admission)
+    assert abs(rs["offset_s"]) < 0.05
+    assert rs["spans"][1][1] - rs["spans"][0][1] == pytest.approx(
+        0.01, abs=0.05)
+    await serving.stop()
+    await client.close()
+    await drt.close()
+
+
+# --------------------------------------------------------------------------
+# the X-ray e2e: frontend → decode engine → prefill worker, one timeline
+# --------------------------------------------------------------------------
+
+
+from test_jax_engine import hf_model_dir, TINY  # noqa: F401,E402
+
+
+async def test_stitched_disagg_request_spans_three_processes(hf_model_dir):
+    """A remote-prefilled request served over the runtime plane returns
+    ONE stitched timeline containing frontend, decode-engine, and
+    prefill-worker spans (incl. the transfer span) on a single
+    clock-adjusted axis — and the stream stays byte-identical to pure
+    local generation."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.disagg import (
+        DisaggRouter,
+        PrefillWorker,
+        RemotePrefillCoordinator,
+    )
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.scheduler import EngineRequest, Scheduler
+    from dynamo_tpu.models.loader import load_llama_params
+    from dynamo_tpu.protocols.common import (
+        EngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.client import Client
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.engine import AsyncEngineContext, Context
+    from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+    def make_runner():
+        cfg = ModelConfig.from_model_dir(hf_model_dir)
+        econfig = EngineConfig(
+            model=cfg, max_batch_size=4, max_model_len=128, kv_block_size=8,
+            num_kv_blocks=64, dtype="float32",
+        )
+        params = load_llama_params(hf_model_dir, cfg, jnp.float32)
+        return ModelRunner(econfig, params=params), econfig
+
+    prompt = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21]
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling_options=SamplingOptions(temperature=0.0),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+    )
+
+    # baseline: local-only engine
+    runner_l, econfig = make_runner()
+    sched_l = Scheduler(runner_l, econfig)
+    sched_l.start()
+    er = EngineRequest(request_id="base", prompt=list(prompt), req=req,
+                       ctx=Context(req).context, out_queue=asyncio.Queue())
+    sched_l.add_request(er)
+    baseline = []
+    while True:
+        out = await asyncio.wait_for(er.out_queue.get(), timeout=60)
+        if out is None:
+            break
+        baseline.extend(out.token_ids)
+    await sched_l.stop()
+    assert len(baseline) == 8
+
+    # decode "process": scheduler + disagg coordinator behind an endpoint
+    hub = MemoryHub()
+    drt_w = DistributedRuntime.in_process(hub)
+    runner_d, dconfig = make_runner()
+    coord = RemotePrefillCoordinator(
+        drt_w, runner_d,
+        router=DisaggRouter(max_local_prefill_length=0,
+                            max_prefill_queue_size=100),
+        depth_refresh_s=0.05,
+    )
+    await coord.start()
+    sched = Scheduler(runner_d, dconfig, disagg=coord)
+    sched.start()
+    ep = drt_w.namespace("public").component("backend").endpoint("generate")
+
+    async def handler(payload, ctx):
+        r = PreprocessedRequest.from_wire(payload)
+        e = EngineRequest(request_id=ctx.id, prompt=list(r.token_ids),
+                          req=r, ctx=ctx, out_queue=asyncio.Queue())
+        sched.add_request(e)
+        while True:
+            out = await e.out_queue.get()
+            if out is None:
+                return
+            yield out.to_wire()
+
+    serving = await ep.serve(handler, span_source="decode_engine")
+
+    # prefill "process"
+    drt_p = DistributedRuntime.in_process(hub)
+    runner_p, pconfig = make_runner()
+    worker = PrefillWorker(drt_p, runner_p, pconfig)
+    worker_task = asyncio.create_task(worker.run())
+
+    # frontend "process"
+    drt_f = DistributedRuntime.in_process(hub)
+    client = await Client(
+        drt_f.namespace("public").component("backend").endpoint("generate")
+    ).start()
+    await client.wait_for_instances(1)
+    try:
+        fctx = Context(req.to_wire(),
+                       AsyncEngineContext(trace_id="xray-e2e"))
+        fctx.add_stage("http")
+        toks = []
+        async for item in client.generate(fctx):
+            toks.extend(EngineOutput.from_wire(item).token_ids)
+        assert toks == baseline  # streams unchanged, byte-identical
+        assert coord.remote_completed == 1
+
+        # the decode worker's spans (and, nested, the prefill worker's)
+        # folded into the frontend context off the end frame
+        rec = TraceRecorder(capacity=8, ttl_s=0)
+        trace = rec.record("xray-e2e", "tiny", "success", fctx.stages,
+                           ctx=fctx.context)
+        stitched = stitched_timeline(trace)
+        sources = {s["source"] for s in stitched["sources"]}
+        assert {"frontend", "decode_engine", "prefill_worker"} <= sources
+        names = {(r["source"], r["name"]) for r in stitched["timeline"]}
+        # the decode engine's side of the hop, incl. the transfer span
+        assert ("decode_engine", "admission") in names
+        assert ("decode_engine", "kv_transfer") in names
+        assert ("decode_engine", "first_token") in names
+        assert ("decode_engine", "completion") in names
+        # the prefill worker's side: dequeue → compute → transfer
+        assert ("prefill_worker", "prefill.compute") in names
+        assert ("prefill_worker", "prefill.transfer") in names
+        # one consistent axis: in-process clocks agree, so every span
+        # must land inside the request's own wall window (generous slop
+        # for the offset estimators' queue-transit error)
+        total = trace["total_s"]
+        for row in stitched["timeline"]:
+            assert -0.5 <= row["start_s"] <= total + 0.5, row
+        # chronology across sources: remote prefill compute completes
+        # before the decode engine's remote_prefill install mark
+        pc = [r for r in stitched["timeline"]
+              if (r["source"], r["name"]) == ("prefill_worker",
+                                              "prefill.compute")][0]
+        ft = [r for r in stitched["timeline"]
+              if (r["source"], r["name"]) == ("decode_engine",
+                                              "completion")][0]
+        assert pc["start_s"] < ft["start_s"] + ft["duration_s"]
+    finally:
+        worker_task.cancel()
+        await worker.close()
+        await client.close()
+        await serving.stop()
+        await sched.stop()
+        await drt_f.close()
+        await drt_p.close()
+        await drt_w.close()
