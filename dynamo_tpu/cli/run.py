@@ -237,6 +237,43 @@ def build_parser() -> argparse.ArgumentParser:
                    help="inter-token-latency SLO in ms, judged on each "
                         "request's WORST token gap at the edge (0 = "
                         "unjudged)")
+    # fleet telemetry hub (telemetry/hub.py): cluster-wide /metrics
+    # scrape → history rings → /fleet/metrics + /fleet/workers rollups
+    p.add_argument("--hub", action="store_true",
+                   help="run a fleet telemetry hub in this process "
+                        "(in=http or in=planner): scrape every "
+                        "--hub-target and discovery-registered metrics "
+                        "sidecar into history rings and serve "
+                        "/fleet/metrics + /fleet/workers (dynamotop's "
+                        "data source); hub rollups also feed the "
+                        "planner's fleet-level saturation signals")
+    p.add_argument("--hub-interval-s", type=float, default=2.0,
+                   help="hub scrape cadence")
+    p.add_argument("--hub-target", action="append", default=None,
+                   metavar="ROLE=URL",
+                   help="static scrape target (repeatable): "
+                        "decode=http://host:9090 — /metrics is appended "
+                        "when missing; discovery-registered sidecars "
+                        "are scraped in addition")
+    # incident recorder (telemetry/incidents.py): trigger-driven capture
+    # bundles (flight artifact + metric history + affected traces +
+    # optional profiler window) at trip time
+    p.add_argument("--incident-dir", default="",
+                   help="capture incident bundles into this directory "
+                        "on watchdog trips, recovery-ladder engagement, "
+                        "SLO-floor breaches, and late-compile bursts; "
+                        "also settable via DYN_INCIDENT_DIR; bundles "
+                        "are listed at GET /debug/incidents and "
+                        "rendered by scripts/flightdump.py --incident")
+    p.add_argument("--incident-cooldown-s", type=float, default=60.0,
+                   help="per-reason incident capture cooldown (one "
+                        "wedge produces one bundle, not one per trip "
+                        "edge)")
+    p.add_argument("--incident-profile-s", type=float, default=0.0,
+                   help="opt-in: include a jax.profiler capture window "
+                        "of this many seconds in each incident bundle "
+                        "(0 = off; skipped cleanly when a manual "
+                        "/debug/profile capture is in flight)")
     # per-request trace store bounds (telemetry/tracing.py)
     p.add_argument("--trace-ttl-s", type=float, default=None,
                    help="evict completed /debug/requests traces older "
@@ -558,6 +595,63 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
     return controller, server
 
 
+def _build_hub(flags):
+    """--hub → a FleetHub over the static --hub-target list (discovery
+    targets attach later, once a DistributedRuntime exists)."""
+    if not getattr(flags, "hub", False):
+        return None
+    from ..telemetry.hub import FleetHub, parse_target_flag
+
+    return FleetHub(
+        targets=[parse_target_flag(s) for s in (flags.hub_target or [])],
+        interval_s=flags.hub_interval_s,
+    )
+
+
+async def _setup_incidents(flags, registry=None, watchdog=None,
+                           recovery=None, slo=None, compiles=None):
+    """DYN_INCIDENT_DIR / --incident-dir → an IncidentRecorder wired to
+    every degradation edge this process emits, plus a local history
+    sampler so bundles carry the metric curve INTO the incident.
+
+    Returns (recorder, sampler) — both None when no dir is configured.
+    """
+    from ..telemetry.incidents import (
+        IncidentConfig,
+        IncidentRecorder,
+        incident_dir,
+        late_compile_probe,
+        slo_probe,
+    )
+
+    if not incident_dir():
+        return None, None
+    from ..telemetry.history import LocalHistorySampler, MetricHistory
+
+    recorder = IncidentRecorder(
+        IncidentConfig(
+            cooldown_s=flags.incident_cooldown_s,
+            profile_s=flags.incident_profile_s,
+        ),
+        history=MetricHistory(window_s=600.0),
+    )
+    if watchdog is not None:
+        recorder.watch_watchdog(watchdog)
+    if recovery is not None:
+        recorder.watch_recovery(recovery)
+    if slo is not None:
+        recorder.add_probe(slo_probe(slo))
+    if compiles is not None:
+        recorder.add_probe(late_compile_probe(compiles))
+    sampler = None
+    if registry is not None:
+        sampler = LocalHistorySampler(
+            registry, history=recorder.history, interval_s=5.0
+        ).start()
+    recorder.start()
+    return recorder, sampler
+
+
 async def run_http(flags, engine, mdc) -> None:
     from ..http.service import HttpService, ModelManager, ModelWatcher
 
@@ -589,6 +683,7 @@ async def run_http(flags, engine, mdc) -> None:
             ttft_s=flags.slo_ttft_ms / 1e3 if flags.slo_ttft_ms > 0 else None,
             itl_s=flags.slo_itl_ms / 1e3 if flags.slo_itl_ms > 0 else None,
         )
+    hub = _build_hub(flags)
     service = HttpService(
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
@@ -596,7 +691,13 @@ async def run_http(flags, engine, mdc) -> None:
         slo=slo,
         trace_ttl_s=flags.trace_ttl_s,
         trace_capacity=flags.trace_capacity,
+        hub=hub,
     )
+    if hub is not None:
+        # the frontend scrapes ITSELF (engine registries attach into the
+        # service registry below, so one local scrape covers every layer
+        # of this process) alongside the remote targets
+        hub.add_local("frontend", "frontend", service.metrics.registry)
     if getattr(engine, "telemetry_registry", None) is not None:
         # in-process engine: one registry, one exposition — HTTP,
         # scheduler, KV allocator, and disagg instruments in one scrape
@@ -656,8 +757,25 @@ async def run_http(flags, engine, mdc) -> None:
             planner.add_source(slo_source(slo))
         if engine is not None and hasattr(engine, "engine_metrics"):
             planner.add_source(engine_metrics_source(engine.engine_metrics))
+        if hub is not None:
+            # fleet-level saturation: the policy consults the scraped
+            # POOL's busy/KV/SLO rollups, not just this process's view
+            planner.add_source(hub.signal_source())
         service.metrics.attach_registry(planner.registry)
         planner.start()
+
+    # incident recorder: wired to every degradation edge this process
+    # emits (engine watchdog, recovery ladder, SLO floor, late compiles)
+    core = getattr(engine, "core_engine", engine) if engine is not None else None
+    incidents, inc_sampler = await _setup_incidents(
+        flags, registry=service.metrics.registry,
+        watchdog=getattr(core, "watchdog", None),
+        recovery=recovery, slo=slo,
+        compiles=getattr(getattr(core, "runner", None), "compiles", None),
+    )
+    if incidents is not None:
+        service.incidents = incidents
+        service.metrics.attach_registry(incidents.registry)
 
     watcher = None
     if flags.store_port is not None:
@@ -665,10 +783,18 @@ async def run_http(flags, engine, mdc) -> None:
         from ..runtime.client import RouterMode
 
         drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
+        if hub is not None:
+            # distributed frontend: scrape every sidecar workers
+            # registered in the discovery plane, on top of the statics
+            from ..telemetry.hub import discovery_targets
+
+            hub.discover = discovery_targets(drt, flags.namespace)
         watcher = ModelWatcher(
             drt, manager, flags.namespace, RouterMode(flags.router_mode)
         )
         await watcher.start()
+    if hub is not None:
+        hub.start()
 
     await service.start()
     print(f"listening on http://{flags.http_host}:{service.port}", flush=True)
@@ -707,6 +833,12 @@ async def run_http(flags, engine, mdc) -> None:
     finally:
         if planner is not None:
             planner.stop()
+        if hub is not None:
+            await hub.stop()
+        if inc_sampler is not None:
+            await inc_sampler.stop()
+        if incidents is not None:
+            await incidents.stop()
         if recovery is not None:
             await recovery.close()
         if migserver is not None:
@@ -744,6 +876,27 @@ async def run_text(flags, engine, mdc, interactive: bool = True) -> None:
         print()
 
 
+async def advertise_sidecar(drt, flags, mserver, role: str,
+                            instance: str) -> None:
+    """Register a process's /metrics sidecar in discovery so a fleet hub
+    (in=hub / --hub) finds it without static config; the lease-scoped
+    key vanishes with the worker. Shared by every sidecar-running role
+    (run_worker's three shapes, run_prefill)."""
+    if mserver is None:
+        return
+    from ..telemetry.hub import register_metrics_endpoint
+
+    try:
+        await register_metrics_endpoint(
+            drt, flags.namespace, role, instance,
+            f"http://{flags.advertise_host}:{mserver.port}/metrics",
+        )
+    except Exception:
+        logger.warning("metrics-sidecar discovery registration "
+                       "failed; hub scrapes need --hub-target",
+                       exc_info=True)
+
+
 async def run_worker(flags, engine_spec: str, path: str) -> None:
     """Distributed worker roles (in=dyn://ns.comp.ep):
 
@@ -767,6 +920,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
     drt = await DistributedRuntime.connect(flags.store_host, flags.store_port)
     endpoint = drt.namespace(ns_name).component(comp).endpoint(ep_name)
     mserver = None  # sidecar /metrics exposition (--metrics-port)
+    incidents = inc_sampler = None
 
     def make_openai_handler(engine):
         async def handler(payload, ctx):
@@ -817,6 +971,9 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             mserver = await maybe_start_metrics_server(
                 router.registry, flags.metrics_port
             )
+            await advertise_sidecar(
+                drt, flags, mserver, "processor",
+                f"processor-{uuid.uuid4().hex[:12]}")
         print(f"processor serving {path} (model={name} → {flags.worker_endpoint})", flush=True)
 
     elif flags.token_level:
@@ -841,6 +998,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
             span_source="decode_engine",
         )
+        recovery = None
         if flags.self_heal:
             # watchdog trips drain this worker, migrate its in-flight
             # requests to peer workers discovered under the component's
@@ -853,11 +1011,26 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
                 reg = getattr(core, "registry", None)
                 if reg is not None:
                     reg.attach(recovery.registry)
+        # incident bundles at trip time: the engine worker is where the
+        # wedges actually happen — a decode_stall here must leave its
+        # evidence on disk even after recovery respawns the engine
+        incidents, inc_sampler = await _setup_incidents(
+            flags, registry=getattr(core, "registry", None),
+            watchdog=getattr(core, "watchdog", None),
+            recovery=recovery,
+            compiles=getattr(getattr(core, "runner", None), "compiles", None),
+        )
+        if incidents is not None:
+            reg = getattr(core, "registry", None)
+            if reg is not None:
+                reg.attach(incidents.registry)
         # in-process jax engines carry the full scheduler/KV registry;
         # workers with no registry (echo, BYO) just skip the sidecar
         mserver = await maybe_start_metrics_server(
             getattr(core, "registry", None), flags.metrics_port
         )
+        await advertise_sidecar(drt, flags, mserver, "decode_engine",
+                                instance_id)
         print(f"token-level worker {instance_id} serving {path}", flush=True)
 
     else:
@@ -872,11 +1045,17 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         mserver = await maybe_start_metrics_server(
             getattr(engine, "telemetry_registry", None), flags.metrics_port
         )
+        await advertise_sidecar(
+            drt, flags, mserver, "worker", f"worker-{uuid.uuid4().hex[:12]}")
         print(f"worker serving {path} (model={name})", flush=True)
 
     try:
         await asyncio.Event().wait()
     finally:
+        if inc_sampler is not None:
+            await inc_sampler.stop()
+        if incidents is not None:
+            await incidents.stop()
         if mserver is not None:
             await mserver.stop()
         await serving.stop()
@@ -912,6 +1091,11 @@ async def run_prefill(flags) -> None:
     mserver = await maybe_start_metrics_server(
         worker.registry, flags.metrics_port
     )
+    import uuid
+
+    await advertise_sidecar(
+        drt, flags, mserver, "prefill_worker",
+        f"prefill-{uuid.uuid4().hex[:12]}")
     print(f"prefill worker consuming {worker.queue.name}", flush=True)
     try:
         await worker.run()
@@ -920,6 +1104,71 @@ async def run_prefill(flags) -> None:
             await mserver.stop()
         await worker.close()
         await drt.close()
+
+
+async def run_hub(flags) -> None:
+    """Standalone fleet-telemetry-hub role (in=hub): scrape every
+    --hub-target and discovery-registered metrics sidecar into history
+    rings and serve /metrics (the hub's own instruments + rollup
+    gauges), /fleet/metrics, /fleet/workers, and /debug/incidents on
+    ``--http-port`` — the process ``scripts/dynamotop.py`` points at."""
+    from ..runtime.component import DistributedRuntime
+    from ..telemetry.hub import FleetHub, discovery_targets, parse_target_flag
+    from ..telemetry.incidents import IncidentRecorder, incident_dir
+    from ..telemetry.server import MetricsServer
+
+    targets = [parse_target_flag(s) for s in (flags.hub_target or [])]
+    discover = None
+    drt = None
+    if flags.store_port is not None:
+        drt = await DistributedRuntime.connect(
+            flags.store_host, flags.store_port)
+        discover = discovery_targets(drt, flags.namespace)
+    if not targets and discover is None:
+        raise SystemExit(
+            "in=hub needs scrape targets: --hub-target role=url and/or "
+            "--store-port for discovery-registered sidecars"
+        )
+    hub = FleetHub(targets=targets, discover=discover,
+                   interval_s=flags.hub_interval_s)
+    routes = [
+        ("GET", "/fleet/metrics", hub.handle_fleet_metrics),
+        ("GET", "/fleet/workers", hub.handle_fleet_workers),
+    ]
+    incidents = None
+    if incident_dir():
+        # listing/fetch surface only — triggers live in the engine
+        # processes that own the evidence
+        incidents = IncidentRecorder()
+        routes.append(("GET", "/debug/incidents",
+                       incidents.handle_debug_incidents))
+    else:
+        # same 501-with-hint contract as the frontend: an operator must
+        # learn the flag, not guess at a bare 404
+        async def _incidents_off(request):
+            from aiohttp import web
+
+            return web.json_response(
+                {"error": "no incident recorder attached (set "
+                          "DYN_INCIDENT_DIR or --incident-dir)"},
+                status=501,
+            )
+
+        routes.append(("GET", "/debug/incidents", _incidents_off))
+    server = await MetricsServer(
+        hub.registry, flags.http_host, flags.http_port, routes=routes
+    ).start()
+    hub.start()
+    print(f"fleet hub on http://{flags.http_host}:{server.port} "
+          f"({len(targets)} static target(s)"
+          f"{', discovery-driven' if discover else ''})", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await hub.stop()
+        await server.stop()
+        if drt is not None:
+            await drt.close()
 
 
 async def run_planner(flags) -> None:
@@ -1013,17 +1262,37 @@ async def run_planner(flags) -> None:
             "scale actions will be decided and logged but not actuated"
         )
 
+    hub = _build_hub(flags)
+    routes = None
+    if hub is not None:
+        # fleet hub riding the planner: scrape the discovery-registered
+        # sidecars, feed fleet-level saturation into the policy, and
+        # serve /fleet/* next to the planner's own exposition
+        from ..telemetry.hub import discovery_targets
+
+        hub.discover = discovery_targets(drt, flags.namespace)
+        planner.add_source(hub.signal_source())
+        planner.registry.attach(hub.registry)
+        routes = [
+            ("GET", "/fleet/metrics", hub.handle_fleet_metrics),
+            ("GET", "/fleet/workers", hub.handle_fleet_workers),
+        ]
+        hub.start(spawn=drt.runtime.spawn)
+
     mserver = await maybe_start_metrics_server(
-        planner.registry, flags.metrics_port
+        planner.registry, flags.metrics_port, routes=routes
     )
     planner.start(spawn=drt.runtime.spawn)
     print(f"planner observing {flags.worker_endpoint} "
-          f"every {flags.planner_interval_s:.1f}s", flush=True)
+          f"every {flags.planner_interval_s:.1f}s"
+          f"{' + fleet hub' if hub else ''}", flush=True)
     try:
         await asyncio.Event().wait()
     finally:
         planner.stop()
         depth_task.cancel()
+        if hub is not None:
+            await hub.stop()
         if mserver is not None:
             await mserver.stop()
         aggregator.stop()
@@ -1043,6 +1312,11 @@ async def amain(argv: List[str]) -> None:
         import os
 
         os.environ["DYN_FLIGHT_DIR"] = flags.flight_dir
+    if flags.incident_dir:
+        # same single-source-of-truth pattern for incident bundles
+        import os
+
+        os.environ["DYN_INCIDENT_DIR"] = flags.incident_dir
     # SIGUSR2 → flight artifact, on EVERY role (frontend, worker,
     # prefill): the zero-downtime way to ask "what is this process
     # doing" — works even when the event loop is wedged
@@ -1073,6 +1347,9 @@ async def amain(argv: List[str]) -> None:
         return
     if src == "planner":
         await run_planner(flags)
+        return
+    if src == "hub":
+        await run_hub(flags)
         return
     if src.startswith("dyn://"):
         await run_worker(flags, engine_spec, src)

@@ -62,6 +62,10 @@ ROLE_ARGS = {
     # per-role replica counts
     "planner": ["in=planner", "out=none",
                 "--worker-endpoint", "dyn://{ns}.backend.generate"],
+    # the fleet telemetry hub pod: scrapes every discovery-registered
+    # /metrics sidecar into history rings, serves /fleet/metrics +
+    # /fleet/workers (dynamotop's data source) + /debug/incidents
+    "hub": ["in=hub", "out=none"],
 }
 
 DYNSTORE_PORT = 4871

@@ -527,6 +527,13 @@ class Scheduler:
             lambda: len(self.waiting) + len(self.pending_remote),
         )
         reg.callback_gauge(
+            "dynamo_scheduler_draining_info",
+            "1 while this engine is gated for drain/recovery (admission "
+            "refused, routers skip it) — the fleet hub's per-worker "
+            "drain-state column reads this",
+            lambda: 1.0 if self.draining else 0.0,
+        )
+        reg.callback_gauge(
             "dynamo_kv_prefix_hit_ratio",
             "Prompt tokens served from the prefix cache / all prompt tokens",
             lambda: (

@@ -92,6 +92,8 @@ class HttpService:
         slo=None,        # telemetry.slo.SloTracker
         trace_ttl_s: Optional[float] = None,
         trace_capacity: Optional[int] = None,
+        hub=None,        # telemetry.hub.FleetHub
+        incidents=None,  # telemetry.incidents.IncidentRecorder
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -132,6 +134,19 @@ class HttpService:
         # when --self-heal builds a RecoveryController; 501 otherwise.
         self.drainer = None  # async (mode, respawn) -> summary dict
         self.app.router.add_post("/admin/drain", self.handle_admin_drain)
+        # fleet telemetry hub + incident recorder (telemetry/hub.py,
+        # telemetry/incidents.py): wired by the CLI (--hub /
+        # DYN_INCIDENT_DIR); the routes answer 501 when the subsystem is
+        # off so an operator learns the flag instead of guessing at 404s
+        self.hub = hub
+        self.incidents = incidents
+        if hub is not None:
+            self.metrics.attach_registry(hub.registry)
+        if incidents is not None:
+            self.metrics.attach_registry(incidents.registry)
+        self.app.router.add_get("/fleet/metrics", self.handle_fleet_metrics)
+        self.app.router.add_get("/fleet/workers", self.handle_fleet_workers)
+        self.app.router.add_get("/debug/incidents", self.handle_incidents)
         if profile_dir:
             # opt-in only: trace capture costs device time and writes disk
             self.app.router.add_get("/debug/profile", self.handle_profile)
@@ -494,10 +509,40 @@ class HttpService:
         summary = await self.drainer(mode=mode, respawn=respawn)
         return web.json_response(summary)
 
+    async def handle_fleet_metrics(self, request: web.Request) -> web.Response:
+        """GET /fleet/metrics — cluster rollups (sum/max/avg by role,
+        counter rates) from the fleet hub's scraped histories."""
+        if self.hub is None:
+            return web.json_response(
+                {"error": "no fleet hub attached (serve with --hub)"},
+                status=501,
+            )
+        return await self.hub.handle_fleet_metrics(request)
+
+    async def handle_fleet_workers(self, request: web.Request) -> web.Response:
+        """GET /fleet/workers — per-worker KV/busy/roofline/SLO/drain
+        rows; what scripts/dynamotop.py renders live."""
+        if self.hub is None:
+            return web.json_response(
+                {"error": "no fleet hub attached (serve with --hub)"},
+                status=501,
+            )
+        return await self.hub.handle_fleet_workers(request)
+
+    async def handle_incidents(self, request: web.Request) -> web.Response:
+        """GET /debug/incidents[?id=] — list / fetch incident bundles."""
+        if self.incidents is None:
+            return web.json_response(
+                {"error": "no incident recorder attached (set "
+                          "DYN_INCIDENT_DIR or --incident-dir)"},
+                status=501,
+            )
+        return await self.incidents.handle_debug_incidents(request)
+
     async def handle_profile(self, request: web.Request) -> web.Response:
         """GET /debug/profile?seconds=N — capture an XLA profiler trace of
         live traffic (enabled only with a configured profile dir)."""
-        from ..utils.profiling import capture_trace_async
+        from ..utils.profiling import CaptureBusyError, capture_trace_async
 
         try:
             seconds = float(request.query.get("seconds", "2"))
@@ -513,7 +558,14 @@ class HttpService:
                 {"error": "a capture is already in flight"}, status=409
             )
         async with self._profile_lock:
-            trace_dir = await capture_trace_async(self.profile_dir, seconds)
+            try:
+                trace_dir = await capture_trace_async(
+                    self.profile_dir, seconds)
+            except CaptureBusyError as e:
+                # the PROCESS-wide profiler lock is held by a capture that
+                # didn't come through this endpoint (an incident bundle's
+                # profile window) — same clean 409, never a crash
+                return web.json_response({"error": str(e)}, status=409)
         return web.json_response({"trace_dir": trace_dir, "seconds": seconds})
 
 

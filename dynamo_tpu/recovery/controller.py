@@ -108,6 +108,10 @@ class RecoveryController:
         )
         self._recover_task: Optional[asyncio.Task] = None
         self._relays: set = set()
+        # drain subscribers (telemetry/incidents.py): called with the
+        # drain info dict when the ladder engages, BEFORE any state is
+        # torn down — evidence capture must see the pre-drain world
+        self._drain_listeners: List[Callable[[dict], None]] = []
         # drains currently executing (the admin path runs OUTSIDE
         # _recover_task): a respawn's own kill must not read as a fresh
         # child-death and re-trigger the ladder
@@ -121,6 +125,12 @@ class RecoveryController:
         if self.watchdog is not None:
             self.watchdog.add_trip_listener(self.on_trip)
         return self
+
+    def add_drain_listener(self, fn: Callable[[dict], None]) -> None:
+        """Subscribe to drain-ladder engagements (sync callback with
+        ``{engine, reason, hard}``; called for BOTH automated recoveries
+        and admin drains — filter on ``reason`` as needed)."""
+        self._drain_listeners.append(fn)
 
     def on_trip(self, info: dict) -> None:
         """Watchdog trip listener (sync — called from the watchdog's
@@ -183,6 +193,15 @@ class RecoveryController:
             "recovery.drain", engine=self.engine_id, reason=reason,
             hard=hard,
         )
+        drain_info = {"engine": self.engine_id, "reason": reason,
+                      "hard": hard}
+        for fn in list(self._drain_listeners):
+            try:
+                fn(drain_info)
+            except Exception:
+                # evidence capture must never take recovery down with it
+                # (and one broken listener must not starve the rest)
+                logger.exception("recovery drain listener failed")
         sched = self.scheduler
         # 1. gate: no new work here, no new routing decisions toward here
         if sched is not None:

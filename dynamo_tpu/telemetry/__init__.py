@@ -11,6 +11,9 @@ queryable at ``GET /debug/requests/{id}``.
 """
 
 from .flight import CompileTracker, FlightRecorder, flight_recorder
+from .history import LocalHistorySampler, MetricHistory
+from .hub import FleetHub
+from .incidents import IncidentConfig, IncidentRecorder
 from .registry import (
     DEFAULT_BUCKETS,
     CallbackGauge,
@@ -29,9 +32,14 @@ __all__ = [
     "CallbackGauge",
     "CompileTracker",
     "Counter",
+    "FleetHub",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "IncidentConfig",
+    "IncidentRecorder",
+    "LocalHistorySampler",
+    "MetricHistory",
     "MetricsRegistry",
     "StallWatchdog",
     "TraceRecorder",

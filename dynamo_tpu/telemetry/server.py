@@ -23,12 +23,17 @@ class MetricsServer:
     """Minimal aiohttp app: GET /metrics → registry exposition."""
 
     def __init__(self, registry: MetricsRegistry,
-                 host: str = "0.0.0.0", port: int = 9090):
+                 host: str = "0.0.0.0", port: int = 9090,
+                 routes=None):
         self.registry = registry
         self.host = host
         self.port = port
         self.app = web.Application()
         self.app.router.add_get("/metrics", self.handle_metrics)
+        # extra (method, path, handler) routes: the hub/planner sidecar
+        # serves /fleet/* next to its exposition without a full frontend
+        for method, path, handler in routes or []:
+            self.app.router.add_route(method, path, handler)
         self._runner: Optional[web.AppRunner] = None
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -55,9 +60,10 @@ class MetricsServer:
 
 async def maybe_start_metrics_server(
     registry: Optional[MetricsRegistry], port: int, host: str = "0.0.0.0",
+    routes=None,
 ) -> Optional[MetricsServer]:
     """Start a sidecar exposition iff a registry exists and a port was
     requested — dyn:// roles call this unconditionally."""
     if registry is None or not port:
         return None
-    return await MetricsServer(registry, host, port).start()
+    return await MetricsServer(registry, host, port, routes=routes).start()

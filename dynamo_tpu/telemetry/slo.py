@@ -100,11 +100,20 @@ class SloTracker:
             or itl_max_s is None          # single-token: no gaps to judge
             or itl_max_s <= self.itl_s
         )
+        judged = False
         if self.ttft_s is not None:
             self._attain.inc(slo="ttft", met="true" if ttft_ok else "false")
+            judged = True
         if self.itl_s is not None and itl_max_s is not None:
             self._attain.inc(slo="itl", met="true" if itl_ok else "false")
+            judged = True
         met = ttft_ok and itl_ok
+        if judged:
+            # the per-request conjunction, scrapeable: a remote consumer
+            # (the fleet hub) can't recover "met EVERY configured SLO"
+            # from the per-dimension series — blending dimensions
+            # overstates attainment exactly when one dimension misses
+            self._attain.inc(slo="request", met="true" if met else "false")
         self.requests += 1
         if met:
             self.met_requests += 1
@@ -112,6 +121,13 @@ class SloTracker:
             self._goodput.inc(tokens)
         self._window.append((self.clock(), ttft_ok, itl_ok, met, tokens))
         return met
+
+    def window_count(self) -> int:
+        """Completed-request verdicts currently inside the window (the
+        incident recorder's SLO probe gates on this so a 1-request blip
+        can't read as a fleet incident)."""
+        cutoff = self.clock() - self.window_s
+        return sum(1 for r in self._window if r[0] >= cutoff)
 
     # ---------- planner signal source ----------
 

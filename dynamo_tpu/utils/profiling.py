@@ -23,12 +23,25 @@ import asyncio
 import itertools
 import logging
 import os
+import threading
 import time
 import uuid
 
 logger = logging.getLogger(__name__)
 
 _server_started = False
+
+# jax.profiler.trace is NOT reentrant: a second trace starting while one
+# is active crashes mid-capture (and can corrupt the first capture's
+# output). Every capture path — GET /debug/profile, an incident bundle's
+# --incident-profile-s window, bench harnesses — funnels through this
+# process-wide lock; a loser gets CaptureBusyError (→ a clean 409 /
+# "skipped" note) instead of a crash.
+_capture_lock = threading.Lock()
+
+
+class CaptureBusyError(RuntimeError):
+    """Another profiler capture is already in flight in this process."""
 
 # per-process capture counter: two captures in the same SECOND used to
 # collide (strftime has second resolution) and exist_ok=True silently
@@ -64,16 +77,24 @@ def capture_trace(out_dir: str, seconds: float) -> str:
 
     Blocking — run it in an executor from async code. Each capture lands
     in a timestamped subdirectory so consecutive captures never collide.
+    Raises :class:`CaptureBusyError` when another capture holds the
+    process-wide profiler lock (jax allows ONE active trace per process).
     """
     import jax
 
-    trace_dir = os.path.join(out_dir, trace_dir_name())
-    # exist_ok=False on purpose: a collision must fail loudly instead of
-    # silently merging two captures into one directory
-    os.makedirs(trace_dir)
-    with jax.profiler.trace(trace_dir):
-        time.sleep(seconds)
-    return trace_dir
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusyError(
+            "a profiler capture is already in flight in this process")
+    try:
+        trace_dir = os.path.join(out_dir, trace_dir_name())
+        # exist_ok=False on purpose: a collision must fail loudly instead
+        # of silently merging two captures into one directory
+        os.makedirs(trace_dir)
+        with jax.profiler.trace(trace_dir):
+            time.sleep(seconds)
+        return trace_dir
+    finally:
+        _capture_lock.release()
 
 
 async def capture_trace_async(out_dir: str, seconds: float) -> str:

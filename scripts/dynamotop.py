@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Terminal top-view of a dynamo-tpu fleet, live from the telemetry hub.
+
+Points at a process serving the fleet endpoints (``in=hub``, or an
+``in=http``/``in=planner`` role started with ``--hub``), polls
+``GET /fleet/workers`` + ``GET /fleet/metrics``, and renders a
+per-worker table — role, liveness, busy/KV/roofline, SLO attainment,
+drain state, watchdog trips — plus a fleet summary line. The terminal
+sibling of grafana panels 24-25, for when the incident is NOW and the
+browser is far away.
+
+Usage:
+    python scripts/dynamotop.py [--hub http://host:port]
+        [--interval 2] [--once] [--no-clear]
+
+``--once`` prints a single frame and exits (scripts/CI); the default
+loops until interrupted, redrawing in place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_json(url: str, timeout: float = 3.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _pct(v: Optional[float]) -> str:
+    if v is None:
+        return "    -"
+    return f"{100 * v:4.0f}%"
+
+
+def _num(v: Optional[float]) -> str:
+    if v is None:
+        return "   -"
+    if float(v).is_integer():
+        return f"{int(v):4d}"
+    return f"{v:4.1f}"
+
+
+def _state(w: dict) -> str:
+    if not w.get("up"):
+        return "DOWN"
+    if w.get("draining"):
+        return "DRAIN"
+    return "up"
+
+
+def render_workers(workers: List[dict]) -> List[str]:
+    lines = [
+        f"{'WORKER':<26} {'ROLE':<14} {'STATE':<6} {'BUSY':>5} "
+        f"{'KV':>5} {'WAIT':>4} {'ROOF':>5} {'SLO':>5} {'TRIP':>4} "
+        f"{'REQ/S':>6} {'AGE':>5}"
+    ]
+    for w in workers:
+        age = w.get("scrape_age_s")
+        lines.append(
+            f"{str(w.get('name', '?')):<26.26} "
+            f"{str(w.get('role', '?')):<14.14} "
+            f"{_state(w):<6} "
+            f"{_pct(w.get('busy_ratio')):>5} "
+            f"{_pct(w.get('kv_usage_ratio')):>5} "
+            f"{_num(w.get('waiting')):>4} "
+            f"{_pct(w.get('roofline_fraction')):>5} "
+            f"{_pct(w.get('slo_attainment')):>5} "
+            f"{_num(w.get('watchdog_trips')):>4} "
+            f"{w.get('requests_per_s') if w.get('requests_per_s') is not None else '     -':>6} "
+            f"{f'{age:.1f}s' if age is not None else '    -':>5}"
+        )
+    return lines
+
+
+def render_summary(workers: List[dict], metrics: Optional[dict]) -> List[str]:
+    up = [w for w in workers if w.get("up")]
+    draining = sum(1 for w in workers if w.get("draining"))
+    busy = [w["busy_ratio"] for w in up if w.get("busy_ratio") is not None]
+    kv = [w["kv_usage_ratio"] for w in up
+          if w.get("kv_usage_ratio") is not None]
+    parts = [
+        f"workers {len(up)}/{len(workers)} up",
+        f"{draining} draining",
+    ]
+    if busy:
+        parts.append(f"busy avg {100 * sum(busy) / len(busy):.0f}%")
+    if kv:
+        parts.append(f"kv avg {100 * sum(kv) / len(kv):.0f}%")
+    fams = (metrics or {}).get("families") or {}
+    inc = fams.get("dynamo_incidents_total")
+    if inc:
+        total = sum(e["sum"] for e in inc["roles"].values())
+        parts.append(f"incidents {total:.0f}")
+    trips = fams.get("dynamo_watchdog_trips_total")
+    if trips:
+        total = sum(e["sum"] for e in trips["roles"].values())
+        parts.append(f"trips {total:.0f}")
+    return [" | ".join(parts)]
+
+
+def render(fleet_workers: dict, fleet_metrics: Optional[dict] = None,
+           hub_url: str = "") -> str:
+    workers = fleet_workers.get("workers") or []
+    out = [
+        f"dynamotop — {hub_url}  "
+        f"{time.strftime('%H:%M:%S')}  ({len(workers)} worker(s))",
+        "",
+    ]
+    out += render_summary(workers, fleet_metrics)
+    out.append("")
+    out += render_workers(workers)
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynamotop", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("--hub", default="http://127.0.0.1:8080",
+                    help="base URL of the process serving /fleet/*")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of redrawing in place")
+    args = ap.parse_args(argv[1:])
+    base = args.hub.rstrip("/")
+    while True:
+        try:
+            workers = fetch_json(f"{base}/fleet/workers")
+            try:
+                metrics = fetch_json(f"{base}/fleet/metrics")
+            except (urllib.error.URLError, OSError, ValueError):
+                metrics = None
+            frame = render(workers, metrics, hub_url=base)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            frame = f"dynamotop: cannot reach {base}/fleet/workers: {e}"
+            if args.once:
+                print(frame, file=sys.stderr)
+                return 2
+        if not args.once and not args.no_clear:
+            sys.stdout.write(CLEAR)
+        print(frame, flush=True)
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -12,6 +12,7 @@ Usage:
     python scripts/flightdump.py <artifact.json> [--request <id>]
         [--last N] [--no-stacks] [--no-requests] [--metrics]
     python scripts/flightdump.py <artifact.json | traces.jsonl> --trace <id>
+    python scripts/flightdump.py --incident <bundle-dir>
 
 ``--request <id>`` filters the event table (and request tables) to one
 request/trace id — the "what happened to MY request" view. ``--last N``
@@ -25,6 +26,13 @@ artifact's ``traces`` section or a ``DYN_TRACE_JSONL`` sink (one trace
 object per line) — the post-mortem view when the server is gone. Shows
 each hop's clock offset/rtt, every span on the trace-origin axis, and
 the unattributed gaps. Exits 2 when the id is not in the file.
+
+``--incident <dir>`` renders a capture bundle end to end (telemetry/
+incidents.py — written to DYN_INCIDENT_DIR at trip time): the trigger
+header (reason, request, trip info), the bundled flight artifact's
+event table, metric-history sparklines over the bundle window, and the
+stitched trace timeline of every affected request. Exits 2 when the
+directory is not a readable bundle.
 """
 
 from __future__ import annotations
@@ -181,6 +189,115 @@ def render_trace(trace: dict) -> str:
     return "\n".join(out)
 
 
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 40) -> str:
+    """Min-max-normalized unicode sparkline, resampled to ``width``."""
+    if not values:
+        return ""
+    if len(values) > width:
+        # bucket-mean resample so a long window still fits one line
+        step = len(values) / width
+        buckets = []
+        for i in range(width):
+            lo = int(i * step)
+            hi = max(lo + 1, int((i + 1) * step))
+            chunk = values[lo:hi]
+            buckets.append(sum(chunk) / len(chunk))
+        values = buckets
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return SPARK_BLOCKS[0] * len(values)
+    return "".join(
+        SPARK_BLOCKS[min(len(SPARK_BLOCKS) - 1,
+                         int((v - lo) / span * (len(SPARK_BLOCKS) - 1)))]
+        for v in values
+    )
+
+
+def render_history(history: Optional[dict], max_series: int = 24) -> List[str]:
+    """Metric-history sparklines: counters as per-sample deltas (the
+    rate shape), gauges raw; busiest series first, capped."""
+    series = (history or {}).get("series") or []
+    if not series:
+        return []
+    rows = []
+    for s in series:
+        pts = [p[2] for p in s.get("points") or []]
+        if s.get("kind") == "counter":
+            pts = [b - a for a, b in zip(pts, pts[1:])]
+        if not pts:
+            continue
+        lo, hi = min(pts), max(pts)
+        label = s["name"]
+        labels = {k: v for k, v in (s.get("labels") or {}).items()}
+        if labels:
+            label += "{" + ",".join(f"{k}={v}"
+                                    for k, v in sorted(labels.items())) + "}"
+        rows.append((hi - lo, label, lo, hi, sparkline(pts)))
+    # variance first: flat series are rarely what an incident is about
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    shown = rows[:max_series]
+    lines = [f"--- metric history ({len(series)} series, window "
+             f"{history.get('window_s', '?')}s"
+             + (f", showing {len(shown)} of {len(rows)}"
+                if len(rows) > len(shown) else "") + ") ---"]
+    for _, label, lo, hi, spark in shown:
+        lines.append(f"  {label:<58.58} [{lo:>10.4g} .. {hi:>10.4g}] {spark}")
+    return lines
+
+
+def render_incident(bundle: dict) -> str:
+    """One capture bundle end to end: trigger header, flight event
+    table, history sparklines, stitched trace timelines."""
+    manifest = bundle.get("manifest") or {}
+    out = [
+        f"incident bundle: reason={manifest.get('reason')} "
+        f"time={_fmt_wall(manifest.get('time'))} "
+        f"pid={manifest.get('pid')} "
+        f"request={manifest.get('request_id') or '-'}",
+    ]
+    info = manifest.get("info") or {}
+    if info:
+        out.append("  trigger: " + " ".join(f"{k}={v}"
+                                            for k, v in sorted(info.items())))
+    profile = manifest.get("profile")
+    if profile:
+        out.append("  profile: " + " ".join(f"{k}={v}"
+                                            for k, v in sorted(profile.items())))
+    flight = bundle.get("flight")
+    if flight:
+        out.append("")
+        out.append(
+            f"--- flight artifact ({len(flight.get('events') or [])} "
+            f"events, +{flight.get('dropped_events', 0)} dropped) ---"
+        )
+        out += render_events(flight.get("events") or [],
+                             flight.get("monotonic"))
+        probes = render_probes(flight.get("sources") or [])
+        if probes:
+            out.append("")
+            out += probes
+        table = render_requests(flight.get("sources") or [], None)
+        if table:
+            out.append("")
+            out += table
+    hist = render_history(bundle.get("history"))
+    if hist:
+        out.append("")
+        out += hist
+    for trace in bundle.get("traces") or []:
+        out.append("")
+        out.append(f"--- stitched trace {trace.get('request_id')} ---")
+        try:
+            out.append(render_trace(trace))
+        except Exception as e:  # dynlint: allow(silent-except) - error is surfaced in the rendered output; one malformed trace must not make the whole bundle unreadable
+            out.append(f"  (trace render failed: {e})")
+    return "\n".join(out)
+
+
 def render(artifact: dict, request: Optional[str] = None,
            last: Optional[int] = None, stacks: bool = True,
            requests: bool = True, metrics: bool = False) -> str:
@@ -226,7 +343,13 @@ def main(argv: List[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="flightdump", description=__doc__.splitlines()[0]
     )
-    ap.add_argument("artifact", help="flight artifact JSON path")
+    ap.add_argument("artifact", nargs="?", default=None,
+                    help="flight artifact JSON path (omit with --incident)")
+    ap.add_argument("--incident", default=None, metavar="DIR",
+                    help="render an incident capture bundle directory "
+                         "(manifest + flight + history + traces) instead "
+                         "of a single artifact; exit 2 on unreadable "
+                         "bundle")
     ap.add_argument("--request", default=None,
                     help="filter events/request tables to one request or "
                          "trace id")
@@ -244,6 +367,21 @@ def main(argv: List[str]) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="also print each source's metrics snapshot")
     args = ap.parse_args(argv[1:])
+    if args.incident:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from dynamo_tpu.telemetry.incidents import load_bundle_dir
+
+        bundle = load_bundle_dir(args.incident)
+        if bundle is None:
+            print(f"flightdump: {args.incident} is not a readable "
+                  f"incident bundle (missing/corrupt manifest.json)",
+                  file=sys.stderr)
+            return 2
+        print(render_incident(bundle))
+        return 0
+    if args.artifact is None:
+        ap.error("an artifact path is required (or use --incident <dir>)")
     if args.trace:
         try:
             traces = _iter_traces(args.artifact)

@@ -1095,3 +1095,81 @@ def test_async_blocking_flags_span_export_write_on_loop_shape():
     )
     assert [f.rule for f in out] == ["async-blocking"]
     assert "open" in out[0].message
+
+
+# --------------------------------------------------------------------------
+# fleet hub + incident recorder: the modules that run WHILE things break
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_fleet_observability_modules_pass_async_blocking_and_task_leak():
+    """The hub's scrape loop shares the frontend's event loop and the
+    incident recorder runs at the exact moment the process is already
+    ailing — a bundle write or profiler capture on the loop would extend
+    the very stall it is documenting, and a dropped capture/scrape task
+    would silently lose the evidence. Pin all three modules ZERO-finding,
+    not baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "telemetry", "hub.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "history.py"),
+        os.path.join(PACKAGE_ROOT, "telemetry", "incidents.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "fleet observability discipline regressed:\n" + \
+        "\n".join(f.render() for f in found)
+
+
+def test_async_blocking_flags_bundle_write_on_loop_shape():
+    """TP fixture shaped like a careless incident capture: serializing
+    the bundle to disk directly on the event loop, right when the
+    watchdog just reported that loop as the problem."""
+    out = findings(
+        """
+        import json
+
+        async def capture_bundle(manifest, artifact, path):
+            with open(path, "w") as f:
+                json.dump({"manifest": manifest, "flight": artifact}, f)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+    assert "open" in out[0].message
+
+
+def test_async_blocking_flags_profiler_capture_sleeping_on_loop():
+    """TP fixture shaped like a careless incident profile window: the
+    jax.profiler capture holds the trace open with time.sleep ON the
+    loop — utils/profiling.capture_trace is executor-only for a reason."""
+    out = findings(
+        """
+        import time
+
+        async def profile_window(trace, seconds):
+            with trace:
+                time.sleep(seconds)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+def test_task_leak_flags_discarded_capture_task_shape():
+    """TP fixture shaped like a careless trigger: the capture task is
+    dropped on the floor — stop() can never await it and a failing
+    capture's exception (the evidence loss!) is silently swallowed."""
+    out = findings(
+        """
+        import asyncio
+
+        class Recorder:
+            def trigger(self, reason):
+                asyncio.get_running_loop().create_task(self._capture(reason))
+
+            async def _capture(self, reason):
+                await asyncio.sleep(1.0)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]

@@ -1,0 +1,485 @@
+"""Fleet telemetry hub (telemetry/hub.py) + metric history rings
+(telemetry/history.py).
+
+The acceptance bar (ISSUE 10): a hub scraping a live multi-worker stack
+over real HTTP shows every worker on ``GET /fleet/workers`` with
+correct busy/KV/drain rollups, ``GET /fleet/metrics`` aggregates
+sum/max/avg by role, history rings survive counter resets with sane
+rates, and ``scripts/dynamotop.py`` renders the fleet from those
+endpoints.
+"""
+
+import asyncio
+import os
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.telemetry.exposition import parse_exposition
+from dynamo_tpu.telemetry.flight import FlightRecorder
+from dynamo_tpu.telemetry.history import (
+    LocalHistorySampler,
+    MetricHistory,
+)
+from dynamo_tpu.telemetry.hub import FleetHub, parse_target_flag
+from dynamo_tpu.telemetry.registry import MetricsRegistry
+from dynamo_tpu.telemetry.server import MetricsServer
+
+from test_decode_pipeline import FakeRunner
+
+
+# --------------------------------------------------------------------------
+# history rings
+# --------------------------------------------------------------------------
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_history_gauge_latest_and_window():
+    clk = Clock()
+    h = MetricHistory(window_s=10.0, clock=clk)
+    for i in range(5):
+        h.observe("g", {}, float(i), t=clk.t + i)
+    clk.t += 4
+    assert h.latest("g") == 4.0
+    pts = h.window("g")
+    assert [v for _, v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # age the window out: a dead series goes blind, not stale
+    clk.t += 100
+    assert h.latest("g") is None
+    assert h.window("g") == []
+
+
+def test_history_counter_reset_detection():
+    """A scraped counter going backward = remote restart: rate/delta
+    must stay non-negative and the reset must be counted."""
+    clk = Clock()
+    h = MetricHistory(window_s=100.0, clock=clk)
+    feed = [(0, 10.0), (1, 20.0), (2, 30.0), (3, 2.0), (4, 6.0)]
+    for dt, v in feed:
+        h.observe("c", {}, v, t=clk.t + dt, kind="counter")
+    clk.t += 4
+    assert h.resets("c") == 1
+    # adjusted: 10,20,30,32,36 → delta 26 over 4s
+    assert h.delta("c") == pytest.approx(26.0)
+    assert h.rate("c") == pytest.approx(26.0 / 4.0)
+    # latest() reports the adjusted (monotonic) total, not the raw 6
+    assert h.latest("c") == pytest.approx(36.0)
+
+
+def test_history_bounds_max_series_and_samples():
+    clk = Clock()
+    h = MetricHistory(window_s=1e9, max_samples=4, max_series=2, clock=clk)
+    h.observe("a", {}, 1.0)
+    h.observe("b", {}, 1.0)
+    h.observe("c", {}, 1.0)  # over the series bound: dropped, counted
+    assert h.series_count() == 2
+    assert h.dropped_series == 1
+    for i in range(10):
+        h.observe("a", {}, float(i), t=clk.t + i)
+    assert len(h.window("a", window_s=1e9)) == 4  # ring bound
+
+
+def test_history_label_matching_sums_families():
+    h = MetricHistory(clock=Clock())
+    h.observe("t", {"reason": "a"}, 3.0, kind="counter")
+    h.observe("t", {"reason": "b"}, 4.0, kind="counter")
+    assert h.latest("t") == 7.0  # family total
+    assert h.latest("t", {"reason": "a"}) == 3.0
+    assert h.latest("t", {"reason": "missing"}) is None
+
+
+def test_history_ingests_exposition_skipping_buckets():
+    reg = MetricsRegistry()
+    reg.gauge("dynamo_test_gauge_ratio", "g").set(0.5)
+    reg.counter("dynamo_test_events_total", "c").inc(7, kind="x")
+    hist_metric = reg.histogram("dynamo_test_latency_seconds", "h")
+    hist_metric.observe(0.2)
+    hist_metric.observe(0.4)
+    clk = Clock()
+    h = MetricHistory(clock=clk)
+    h.ingest(parse_exposition(reg.render()))
+    assert h.latest("dynamo_test_gauge_ratio") == 0.5
+    assert h.latest("dynamo_test_events_total") == 7.0
+    assert h.latest("dynamo_test_latency_seconds_count") == 2.0
+    assert h.latest("dynamo_test_latency_seconds_sum") == pytest.approx(0.6)
+    # per-le bucket series are the cardinality explosion the bounds
+    # exist to prevent — never ingested
+    assert not any(n.endswith("_bucket") for n in h.names())
+
+
+def test_history_snapshot_shape():
+    clk = Clock()
+    h = MetricHistory(window_s=100.0, clock=clk)
+    h.observe("s", {"l": "v"}, 1.0, t=clk.t - 1)
+    snap = h.snapshot(window_s=50.0)
+    assert snap["window_s"] == 50.0
+    assert len(snap["series"]) == 1
+    s = snap["series"][0]
+    assert s["name"] == "s" and s["labels"] == {"l": "v"}
+    # points carry [t_rel, wall_estimate, value]
+    assert s["points"][0][0] == pytest.approx(-1.0)
+    assert s["points"][0][2] == 1.0
+
+
+async def test_local_history_sampler_fills_rings():
+    reg = MetricsRegistry()
+    g = reg.gauge("dynamo_test_sampled_ratio", "g")
+    sampler = LocalHistorySampler(reg, interval_s=0.02)
+    sampler.start()
+    try:
+        for i in range(3):
+            g.set(i / 10)
+            await asyncio.sleep(0.05)
+        pts = sampler.history.window("dynamo_test_sampled_ratio")
+        assert len(pts) >= 2
+        assert pts[-1][1] == pytest.approx(0.2)
+    finally:
+        await sampler.stop()
+
+
+# --------------------------------------------------------------------------
+# hub units
+# --------------------------------------------------------------------------
+
+
+def test_parse_target_flag():
+    t = parse_target_flag("decode=http://h:9090")
+    assert t == {"url": "http://h:9090/metrics", "role": "decode",
+                 "name": "h:9090"}
+    assert parse_target_flag("h:1/metrics")["role"] == "worker"
+    assert parse_target_flag("prefill=h:2")["url"] == "http://h:2/metrics"
+
+
+def _worker_registry(busy=0.5, kv=0.25, waiting=2.0, draining=0.0,
+                     trips=0):
+    reg = MetricsRegistry()
+    reg.gauge("dynamo_scheduler_slot_occupancy_ratio", "b").set(busy)
+    reg.gauge("dynamo_kv_block_usage_ratio", "k").set(kv)
+    reg.gauge("dynamo_scheduler_waiting_requests", "w").set(waiting)
+    reg.gauge("dynamo_scheduler_draining_info", "d").set(draining)
+    c = reg.counter("dynamo_watchdog_trips_total", "t")
+    if trips:
+        c.inc(trips, reason="decode_stall")
+    return reg
+
+
+async def test_hub_local_scrape_rollups_and_signals():
+    hub = FleetHub(interval_s=0.05)
+    hub.add_local("w1", "decode", _worker_registry(busy=0.8, waiting=3))
+    hub.add_local("w2", "decode", _worker_registry(busy=0.2, kv=0.75,
+                                                   trips=2))
+    hub.add_local("fe", "frontend", MetricsRegistry())
+    try:
+        await hub.scrape_once()
+        workers = hub.fleet_workers()["workers"]
+        assert {w["name"] for w in workers} == {"w1", "w2", "fe"}
+        w1 = next(w for w in workers if w["name"] == "w1")
+        assert w1["up"] and w1["busy_ratio"] == 0.8
+        assert w1["draining"] is False
+        # rollups: sum/max/avg by role
+        fams = hub.fleet_metrics()["families"]
+        busy = fams["dynamo_scheduler_slot_occupancy_ratio"]["roles"]["decode"]
+        assert busy["workers"] == 2
+        assert busy["sum"] == pytest.approx(1.0)
+        assert busy["max"] == pytest.approx(0.8)
+        assert busy["avg"] == pytest.approx(0.5)
+        # planner signals ride the existing policy vocabulary
+        sig = hub.signal_source()()
+        assert sig["decode.slot_busy_ratio"] == pytest.approx(0.5)
+        assert sig["decode.waiting"] == pytest.approx(5.0)
+        assert sig["kv.usage_ratio"] == pytest.approx(0.5)
+        assert sig["watchdog.trips"] == pytest.approx(2.0)
+        # the hub's own rollup gauges render (grafana panel 25 sanity)
+        text = hub.registry.render()
+        assert 'dynamo_hub_fleet_busy_ratio{role="decode"} 0.5' in text
+        assert "dynamo_hub_history_series_depth" in text
+    finally:
+        await hub.stop()
+
+
+async def test_fleet_rates_gate_on_counter_kind_and_report_flatlines():
+    """Review pins: (1) fleet_metrics reports rate_per_s only for
+    cumulative series — a gauge's slope under the same key would read
+    as an event rate; (2) a flatlined counter is 0.0, not None — a
+    wedged frontend at 0 req/s must not render like a worker that never
+    exported HTTP metrics at all."""
+    clk = Clock()
+    hub = FleetHub(interval_s=0.05, clock=clk)
+    reg = MetricsRegistry()
+    reg.gauge("dynamo_kv_block_usage_ratio", "k").set(0.5)
+    reg.counter("dynamo_http_service_requests_total", "r").inc(5)
+    hub.add_local("fe", "frontend", reg)
+    hub.add_local("bare", "prefill", MetricsRegistry())
+    try:
+        await hub.scrape_once()
+        clk.t += 30.0
+        await hub.scrape_once()
+        fams = hub.fleet_metrics()["families"]
+        gauge_entry = fams["dynamo_kv_block_usage_ratio"]["roles"]["frontend"]
+        assert "rate_per_s" not in gauge_entry
+        counter_entry = \
+            fams["dynamo_http_service_requests_total"]["roles"]["frontend"]
+        assert counter_entry["rate_per_s"] == 0.0
+        workers = {w["name"]: w for w in hub.fleet_workers()["workers"]}
+        assert workers["fe"]["requests_per_s"] == 0.0  # flatline, visible
+        assert workers["bare"]["requests_per_s"] is None  # no HTTP metrics
+    finally:
+        await hub.stop()
+
+
+async def test_fleet_rollups_exclude_down_workers():
+    """Review pin: a wedged worker's LAST scrape stays visible in its
+    /fleet/workers row (marked down) but must not keep steering the
+    fleet averages and /fleet/metrics for up to history_window_s —
+    rollups follow the same _up staleness rule signal_source uses."""
+    clk = Clock()
+    hub = FleetHub(interval_s=0.05, clock=clk)
+    hub.add_local("w1", "decode", _worker_registry(busy=0.8))
+    hub.add_local("w2", "decode", _worker_registry(busy=0.2))
+    try:
+        await hub.scrape_once()
+        # w2 stops answering; the clock sails past the up-threshold
+        del hub._locals["w2"]
+        clk.t += 10.0
+        await hub.scrape_once()
+        workers = {w["name"]: w for w in hub.fleet_workers()["workers"]}
+        assert workers["w2"]["up"] is False
+        assert workers["w2"]["busy_ratio"] == 0.2  # last-known, marked down
+        fams = hub.fleet_metrics()["families"]
+        busy = fams["dynamo_scheduler_slot_occupancy_ratio"]["roles"]["decode"]
+        assert busy["workers"] == 1
+        assert busy["sum"] == pytest.approx(0.8)
+        assert 'dynamo_hub_fleet_busy_ratio{role="decode"} 0.8' in \
+            hub.registry.render()
+        assert hub.signal_source()()["decode.slot_busy_ratio"] == \
+            pytest.approx(0.8)
+    finally:
+        await hub.stop()
+
+
+async def test_fleet_slo_attainment_is_per_request_not_blended():
+    """Review pin: the hub consumes the slo="request" conjunction
+    series. Blending the ttft/itl dimension counts overstates
+    attainment exactly when requests miss one dimension — here the
+    blend reads 0.9 (at the SlaPolicy floor) while per-request truth
+    is 0.8 (the planner must shed)."""
+    clk = Clock()
+    hub = FleetHub(interval_s=0.05, clock=clk)
+    reg = MetricsRegistry()
+    c = reg.counter("dynamo_slo_attainment_total", "v")
+
+    def ten_requests_two_missing_one_dimension():
+        c.inc(10, slo="ttft", met="true")
+        c.inc(8, slo="itl", met="true")
+        c.inc(2, slo="itl", met="false")
+        c.inc(8, slo="request", met="true")
+        c.inc(2, slo="request", met="false")
+
+    ten_requests_two_missing_one_dimension()
+    hub.add_local("fe", "frontend", reg)
+    try:
+        await hub.scrape_once()
+        clk.t += 30.0
+        ten_requests_two_missing_one_dimension()
+        await hub.scrape_once()
+        workers = {w["name"]: w for w in hub.fleet_workers()["workers"]}
+        assert workers["fe"]["slo_attainment"] == pytest.approx(0.8)
+        assert hub.signal_source()()["slo.attainment"] == pytest.approx(0.8)
+    finally:
+        await hub.stop()
+
+
+def test_fleet_reads_survive_concurrent_scrape_writes():
+    """Review pin: /fleet handlers ride the executor and registry.render
+    (invoking the hub's callback gauges) runs executor-side in the
+    sidecar server AND the hub's local scrape — all while the scrape
+    loop inserts/expires workers and appends series on the event loop.
+    Readers must snapshot, never raise 'dict/deque changed size during
+    iteration', and never mutate the rings."""
+    import threading
+
+    hub = FleetHub(interval_s=0.05)
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                hub.registry.render()   # callback gauges over _workers
+                hub.fleet_workers()
+                hub.fleet_metrics()
+                hub.signal_source()()
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
+    t = threading.Thread(target=reader, name="fleet-reader")
+    t.start()
+    try:
+        # the writer side of a scrape cycle, churned hard: new workers,
+        # expired workers, fresh series, appended points
+        for i in range(4000):
+            w = hub._worker_for(f"w{i % 7}", "decode", None)
+            w.history.observe("dynamo_scheduler_slot_occupancy_ratio",
+                              {"shard": str(i % 97)}, (i % 10) / 10)
+            w.history.observe(f"dynamo_test_churn_{i % 211}_total", {},
+                              float(i), kind="counter")
+            if i % 11 == 0:
+                hub._workers.pop(f"w{(i + 3) % 7}", None)
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+
+
+async def test_hub_scrape_failure_is_counted_not_fatal():
+    hub = FleetHub(
+        targets=[{"url": "http://127.0.0.1:1/metrics", "role": "decode",
+                  "name": "dead"}],
+        interval_s=0.05, timeout_s=0.2,
+    )
+    hub.add_local("fe", "frontend", _worker_registry())
+    try:
+        await hub.scrape_once()
+        workers = {w["name"]: w for w in hub.fleet_workers()["workers"]}
+        assert workers["dead"]["up"] is False
+        assert workers["dead"]["error"]
+        assert workers["fe"]["up"] is True
+        text = hub.registry.render()
+        assert 'outcome="error"' in text and 'outcome="ok"' in text
+    finally:
+        await hub.stop()
+
+
+# --------------------------------------------------------------------------
+# multi-process e2e: two workers behind real HTTP sidecars + a frontend
+# --------------------------------------------------------------------------
+
+
+def _engine_config(**kw):
+    kw.setdefault("num_kv_blocks", 64)
+    kw.setdefault("max_model_len", 256)
+    kw.setdefault("multi_step_decode", 4)
+    return EngineConfig(
+        model=ModelConfig(vocab_size=512, hidden_size=32,
+                          intermediate_size=64, num_layers=1, num_heads=2,
+                          num_kv_heads=1),
+        max_batch_size=4, kv_block_size=8, dtype="float32",
+        enable_prefix_caching=False, **kw,
+    )
+
+
+@pytest.mark.asyncio
+async def test_fleet_e2e_two_workers_and_frontend(tmp_path):
+    """The satellite e2e: a hub inside a frontend scrapes two REAL
+    scheduler registries over real HTTP sidecars; /fleet/workers shows
+    both with correct drain state, and dynamotop renders the table."""
+    config = _engine_config()
+    s1 = Scheduler(FakeRunner(config), config, flight=FlightRecorder())
+    s2 = Scheduler(FakeRunner(config), config, flight=FlightRecorder())
+    s2.set_draining(True)  # worker 2 mid-recovery: the pane must show it
+    side1 = await MetricsServer(s1.registry, "127.0.0.1", 0).start()
+    side2 = await MetricsServer(s2.registry, "127.0.0.1", 0).start()
+    hub = FleetHub(
+        targets=[
+            {"url": f"http://127.0.0.1:{side1.port}/metrics",
+             "role": "decode_engine", "name": "w1"},
+            {"url": f"http://127.0.0.1:{side2.port}/metrics",
+             "role": "decode_engine", "name": "w2"},
+        ],
+        interval_s=0.05,
+    )
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0, hub=hub)
+    hub.add_local("frontend", "frontend", service.metrics.registry)
+    await service.start()
+    try:
+        await hub.scrape_once()
+        await hub.scrape_once()  # two samples → rates are derivable
+        async with aiohttp.ClientSession() as s:
+            base = f"http://127.0.0.1:{service.port}"
+            async with s.get(f"{base}/fleet/workers") as r:
+                assert r.status == 200
+                body = await r.json()
+            workers = {w["name"]: w for w in body["workers"]}
+            assert set(workers) == {"w1", "w2", "frontend"}
+            assert workers["w1"]["up"] and workers["w2"]["up"]
+            assert workers["w1"]["draining"] is False
+            assert workers["w2"]["draining"] is True
+            assert workers["w1"]["busy_ratio"] == 0.0
+            assert workers["w1"]["kv_usage_ratio"] is not None
+            async with s.get(f"{base}/fleet/metrics") as r:
+                assert r.status == 200
+                fams = (await r.json())["families"]
+            drain = fams["dynamo_scheduler_draining_info"]["roles"]
+            assert drain["decode_engine"]["sum"] == 1.0
+            assert drain["decode_engine"]["workers"] == 2
+            # the hub's scrape instruments render in the frontend scrape
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert "dynamo_hub_scrapes_total" in text
+            # dynamotop renders the live fleet body
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts"))
+            import dynamotop
+
+            frame = dynamotop.render(body, {"families": fams},
+                                     hub_url=base)
+            assert "w1" in frame and "w2" in frame
+            assert "DRAIN" in frame  # w2's drain state in the table
+    finally:
+        await service.stop()
+        await hub.stop()
+        await side1.stop()
+        await side2.stop()
+
+
+@pytest.mark.asyncio
+async def test_fleet_endpoints_501_without_hub():
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            for path in ("/fleet/workers", "/fleet/metrics",
+                         "/debug/incidents"):
+                async with s.get(
+                        f"http://127.0.0.1:{service.port}{path}") as r:
+                    assert r.status == 501, path
+    finally:
+        await service.stop()
+
+
+async def test_hub_feeds_planner_policy_fleet_saturation():
+    """SlaPolicy consults FLEET-level saturation through the hub source:
+    one idle worker next to a saturated one must not mask the pool."""
+    from dynamo_tpu.planner.policy import PolicyConfig, SlaPolicy
+    from dynamo_tpu.planner.signals import SignalStore
+
+    hub = FleetHub(interval_s=0.05)
+    hub.add_local("w1", "decode", _worker_registry(
+        busy=1.0, kv=0.99, waiting=20.0))
+    hub.add_local("w2", "decode", _worker_registry(
+        busy=0.95, kv=0.97, waiting=10.0))
+    try:
+        await hub.scrape_once()
+        clk = Clock()
+        store = SignalStore(clock=clk)
+        store.observe_many(hub.signal_source()(), t=clk.t)
+        policy = SlaPolicy(PolicyConfig(), clock=clk)
+        actions = policy.decide(store, {"decode": 1})
+        kinds = {type(a).__name__ for a in actions}
+        # fleet KV ≥ bound → admission shed; fleet busy → decode scale-up
+        assert "AdmissionAction" in kinds
+        assert "ScaleAction" in kinds
+    finally:
+        await hub.stop()

@@ -1,0 +1,715 @@
+"""Incident recorder (telemetry/incidents.py): trigger-driven capture
+bundles — rate-limit units, bundle anatomy, listener fan-out hardening,
+the profiler-lock satellite, flightdump --incident, and the chaos e2e.
+
+The acceptance bar (ISSUE 10): an injected ``DYN_FAULT=decode_burst_
+hang`` wedge auto-produces EXACTLY ONE bundle (cooldown pinned) whose
+flight artifact, metric-history window, and stitched trace all reference
+the wedged request — while PR 8's recovery still drains, migrates, and
+respawns the engine underneath it.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.recovery import (
+    MigrationServer,
+    MigrationSink,
+    RecoveryConfig,
+    RecoveryController,
+)
+from dynamo_tpu.telemetry.flight import FlightRecorder
+from dynamo_tpu.telemetry.history import LocalHistorySampler, MetricHistory
+from dynamo_tpu.telemetry.incidents import (
+    IncidentConfig,
+    IncidentRecorder,
+    late_compile_probe,
+    load_bundle_dir,
+    slo_probe,
+)
+from dynamo_tpu.telemetry.tracing import TraceRecorder
+from dynamo_tpu.telemetry.watchdog import StallWatchdog
+from dynamo_tpu.utils import faults
+
+from test_recovery import MigRunner, _baseline, _collect, _config, _request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _recorder(tmp_path=None, clk=None, history=None, **cfg):
+    """An IncidentRecorder with a PRIVATE flight ring (the global one is
+    shared across the whole test process) and settle_s=0 by default."""
+    cfg.setdefault("settle_s", 0.0)
+    if tmp_path is not None:
+        cfg.setdefault("out_dir", str(tmp_path))
+    return IncidentRecorder(
+        IncidentConfig(**cfg),
+        history=history,
+        flight=FlightRecorder(capacity=64),
+        clock=clk or Clock(),
+    )
+
+
+# --------------------------------------------------------------------------
+# trigger rate limiting: cooldown, global min interval, (reason, request)
+# dedup — one wedge, one bundle
+# --------------------------------------------------------------------------
+
+
+async def test_trigger_cooldown_min_interval_and_dedup():
+    clk = Clock()
+    rec = _recorder(clk=clk, cooldown_s=10.0, min_interval_s=5.0,
+                    dedup_s=100.0)
+    try:
+        assert rec.trigger("decode_stall") is True
+        # same reason inside the cooldown: suppressed
+        assert rec.trigger("decode_stall") is False
+        # DIFFERENT reason inside the global min interval: the same
+        # wedge trips the watchdog AND engages recovery within seconds —
+        # that must fold into ONE bundle
+        clk.t += 2.0
+        assert rec.trigger("recovery_drain") is False
+        # past the global floor, a different reason fires
+        clk.t += 4.0
+        assert rec.trigger("recovery_drain") is True
+        # per-reason cooldown outlives the global floor
+        clk.t += 3.0  # 9s after the first decode_stall: still cooling
+        assert rec.trigger("decode_stall") is False
+        clk.t += 6.0
+        assert rec.trigger("decode_stall") is True
+        # (reason, request) dedup outlives the per-reason cooldown
+        clk.t += 20.0
+        assert rec.trigger("slo_floor", request_id="req-1") is True
+        clk.t += 15.0  # > cooldown_s, < dedup_s
+        assert rec.trigger("slo_floor", request_id="req-1") is False
+        assert rec.trigger("slo_floor", request_id="req-2") is True
+    finally:
+        await rec.stop()
+    assert rec.captures == 5
+    assert rec.suppressed == 4
+    text = rec.registry.render()
+    assert 'dynamo_incidents_total{reason="decode_stall"} 2' in text
+    assert 'dynamo_incidents_suppressed_total{reason="decode_stall"} 2' in text
+    # every suppression is visible in the flight ring too
+    kinds = [e["kind"] for e in rec.flight.snapshot()]
+    assert kinds.count("incident.suppressed") == 4
+    assert kinds.count("incident.captured") == 5
+
+
+# --------------------------------------------------------------------------
+# bundle anatomy: manifest + flight + history + traces on disk
+# --------------------------------------------------------------------------
+
+
+async def _write_one_bundle_async(tmp_path, request_id="req-x"):
+    """Capture one bundle with every payload populated; returns the
+    recorder and the bundle's manifest (with the on-disk path)."""
+    hist = MetricHistory(window_s=600.0)
+    for i in range(5):
+        hist.observe("dynamo_kv_block_usage_ratio", {}, i / 10)
+        hist.observe("dynamo_watchdog_trips_total", {"reason": "x"},
+                     float(i), kind="counter")
+    rec = _recorder(tmp_path, history=hist)
+    rec.flight.record("scheduler.admission", request_id=request_id, slot=0)
+    rec.flight.record("scheduler.burst_dispatch", rows=1,
+                      requests=[request_id])
+    tr = TraceRecorder(capacity=8)
+    tr.record(request_id, "m", "completed",
+              [("ingress", 100.0), ("first_token", 100.1)], end=100.3)
+    assert rec.trigger("manual_test", request_id=request_id,
+                       stalled_for_s=1.25) is True
+    await rec.stop()
+    del tr  # recorder registry holds weak refs; keep it alive until here
+    assert rec.captures == 1
+    return rec, rec.bundles[0]
+
+
+def _write_one_bundle(tmp_path, request_id="req-x"):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(
+            _write_one_bundle_async(tmp_path, request_id))
+    finally:
+        loop.close()
+
+
+def test_bundle_anatomy_on_disk(tmp_path):
+    rec, manifest = _write_one_bundle(tmp_path)
+    path = manifest["path"]
+    assert path and os.path.isdir(path)
+    assert sorted(os.listdir(path)) == [
+        "flight.json", "history.json", "manifest.json", "traces.json"]
+    assert manifest["reason"] == "manual_test"
+    assert manifest["request_id"] == "req-x"
+    assert manifest["info"] == {"stalled_for_s": 1.25}
+    assert manifest["pid"] == os.getpid()
+    bundle = load_bundle_dir(path)
+    # flight: the private ring's events, request-correlated
+    rids = {e.get("request_id") for e in bundle["flight"]["events"]}
+    assert "req-x" in rids
+    # history: the curve INTO the incident, counters marked as such
+    series = {s["name"]: s for s in bundle["history"]["series"]}
+    assert len(series["dynamo_kv_block_usage_ratio"]["points"]) == 5
+    assert series["dynamo_watchdog_trips_total"]["kind"] == "counter"
+    # traces: the affected request's stitched trace rode along
+    assert [t["request_id"] for t in bundle["traces"]] == ["req-x"]
+    # listing surfaces the complete bundle
+    listed = rec.list_bundles()
+    assert [b["bundle"] for b in listed] == [manifest["bundle"]]
+    assert rec.load_bundle(manifest["bundle"])["manifest"]["reason"] == \
+        "manual_test"
+
+
+async def test_bundle_prune_keeps_newest_max_bundles(tmp_path):
+    clk = Clock()
+    rec = _recorder(tmp_path, clk=clk, cooldown_s=0.0, min_interval_s=0.0,
+                    max_bundles=2)
+    try:
+        for i in range(4):
+            clk.t += 1.0
+            assert rec.trigger(f"reason_{i}") is True
+            # captures write on the executor: let each land so the
+            # prune sees a stable, ordered bundle set
+            await asyncio.gather(*list(rec._tasks))
+    finally:
+        await rec.stop()
+    assert rec.captures == 4
+    dirs = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("incident-"))
+    assert len(dirs) == 2
+    # completion time orders bundles: the NEWEST two survive
+    reasons = {load_bundle_dir(os.path.join(tmp_path, d))["manifest"]["reason"]
+               for d in dirs}
+    assert reasons == {"reason_2", "reason_3"}
+
+
+def test_bundle_prune_orders_by_time_not_name(tmp_path):
+    """Review pin: bundle names embed a pid, so a lexicographic sort
+    compares pid digits first — with processes sharing DYN_INCIDENT_DIR
+    it would prune a worker's JUST-captured wedge evidence while keeping
+    a frontend's stale bundles. Prune and listing must order by
+    completion time (manifest mtime), never by name."""
+    stale_name = "incident-3041-999999-frontend_stale"
+    fresh_name = "incident-29876-111-worker_fresh"  # sorts FIRST by name
+    for name, age_s in ((stale_name, 3600), (fresh_name, 0)):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "manifest.json").write_text(json.dumps(
+            {"reason": name.rsplit("-", 1)[-1], "bundle": name}))
+        mtime = os.path.getmtime(d / "manifest.json") - age_s
+        os.utime(d / "manifest.json", (mtime, mtime))
+        os.utime(d, (mtime, mtime))
+    rec = _recorder(tmp_path, max_bundles=1)
+    rec._prune_bundles(str(tmp_path))
+    survivors = [d for d in os.listdir(tmp_path) if d.startswith("incident-")]
+    assert survivors == [fresh_name]
+    # listing shares the chronological ordering (oldest first)
+    (tmp_path / stale_name).mkdir()
+    (tmp_path / stale_name / "manifest.json").write_text(json.dumps(
+        {"reason": "frontend_stale", "bundle": stale_name}))
+    old = os.path.getmtime(tmp_path / stale_name / "manifest.json") - 3600
+    os.utime(tmp_path / stale_name / "manifest.json", (old, old))
+    assert [b["bundle"] for b in rec.list_bundles()] == \
+        [stale_name, fresh_name]
+
+
+# --------------------------------------------------------------------------
+# listener fan-out hardening: one throwing subscriber must not starve
+# the rest — in EITHER direction (satellite)
+# --------------------------------------------------------------------------
+
+
+def _watchdog():
+    return StallWatchdog(probe=lambda: {"queue_depth": 0, "active": 0},
+                         flight=FlightRecorder(), interval_s=0.02,
+                         stall_s=0.15)
+
+
+async def test_watchdog_trip_fanout_survives_throwing_listener():
+    """Incident capture must still fire when an earlier trip listener
+    (e.g. the RecoveryController's handler) throws — and a later one
+    must survive the incident listener throwing. Pin both orders."""
+    for bad_first in (True, False):
+        wd = _watchdog()
+        seen = []
+
+        def bad(info):
+            raise RuntimeError("recovery handler exploded")
+
+        def good(info):
+            seen.append(info["reason"])
+
+        if bad_first:
+            wd.add_trip_listener(bad)
+            wd.add_trip_listener(good)
+        else:
+            wd.add_trip_listener(good)
+            wd.add_trip_listener(bad)
+        await wd.trip("decode_stall", {"queue_depth": 1}, 1.0)
+        assert seen == ["decode_stall"], f"bad_first={bad_first}"
+
+
+async def test_recovery_drain_fanout_survives_throwing_listener():
+    """A throwing drain listener must not prevent the remaining
+    listeners NOR the drain itself (recovery > evidence)."""
+    config = _config()
+    sched = Scheduler(MigRunner(config), config, flight=FlightRecorder())
+    sched.start()
+    seen = []
+    controller = RecoveryController(
+        engine_id="e", scheduler=sched, runner=None, watchdog=None,
+        peers=lambda: [], config=RecoveryConfig(drain_grace_s=0.01),
+        flight=sched.flight,
+    )
+    controller.add_drain_listener(
+        lambda info: (_ for _ in ()).throw(RuntimeError("boom")))
+    controller.add_drain_listener(lambda info: seen.append(info))
+    try:
+        summary = await controller.drain(hard=True, reason="unit_fault")
+        assert seen and seen[0]["reason"] == "unit_fault"
+        assert seen[0]["hard"] is True
+        assert summary["migrated"] == 0 and summary["failed"] == 0
+    finally:
+        await controller.close()
+        await sched.stop()
+
+
+async def test_watch_recovery_ignores_admin_drains():
+    """Rolling updates are operator-intended: the admin drain edge must
+    not produce an incident bundle."""
+    rec = _recorder()
+
+    class FakeController:
+        def add_drain_listener(self, fn):
+            self.fn = fn
+
+    ctl = FakeController()
+    rec.watch_recovery(ctl)
+    try:
+        ctl.fn({"engine": "e", "reason": "admin", "hard": False})
+        assert rec.captures == 0 and not rec._tasks
+        ctl.fn({"engine": "e", "reason": "decode_stall", "hard": True})
+        await rec.stop()
+        assert rec.captures == 1
+        assert rec.bundles[0]["reason"] == "recovery_drain"
+        assert rec.bundles[0]["info"]["reason_detail"] == "decode_stall"
+    finally:
+        await rec.stop()
+
+
+# --------------------------------------------------------------------------
+# edge probes: SLO floor + late-compile burst
+# --------------------------------------------------------------------------
+
+
+class FakeSlo:
+    def __init__(self, attainment, n):
+        self.attainment, self.n = attainment, n
+
+    def snapshot(self):
+        return ({"slo.attainment": self.attainment}
+                if self.attainment is not None else {})
+
+    def window_count(self):
+        return self.n
+
+
+def test_slo_probe_gates_on_floor_and_window_size():
+    tracker = FakeSlo(0.5, 10)
+    probe = slo_probe(tracker, floor=0.9, min_requests=5)
+    fired = probe()
+    assert fired["reason"] == "slo_floor"
+    assert fired["attainment"] == 0.5
+    assert fired["window_requests"] == 10
+    # a 1-request blip breaching the floor is noise, not an incident
+    tracker.n = 2
+    assert probe() is None
+    tracker.n, tracker.attainment = 10, 0.95
+    assert probe() is None
+    tracker.attainment = None  # blind window (no judged requests)
+    assert probe() is None
+
+
+def test_late_compile_probe_needs_burst_within_window():
+    clk = Clock()
+
+    class FakeCompiles:
+        late_compiles = 0
+
+    compiles = FakeCompiles()
+    probe = late_compile_probe(compiles, burst=3, window_s=60.0, clock=clk)
+    assert probe() is None
+    compiles.late_compiles = 2  # two late compiles: below the burst bar
+    assert probe() is None
+    clk.t += 10
+    compiles.late_compiles = 3
+    fired = probe()
+    assert fired["reason"] == "late_compile_burst"
+    assert fired["late_compiles_in_window"] == 3
+    # the window slides: old marks expire and the probe re-arms
+    clk.t += 120
+    assert probe() is None
+
+
+async def test_probe_loop_is_edge_triggered_and_rearms():
+    clk = Clock()
+    rec = _recorder(clk=clk, cooldown_s=1.0, min_interval_s=0.0)
+    state = {"degraded": False}
+    rec.add_probe(lambda: ({"reason": "slo_floor", "attainment": 0.4}
+                           if state["degraded"] else None))
+    rec.start(probe_interval_s=0.02)
+    try:
+        state["degraded"] = True
+        for _ in range(100):
+            if rec.captures >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert rec.captures == 1
+        # STILL degraded: level-hold must not re-fire (edge, not level)
+        await asyncio.sleep(0.1)
+        assert rec.captures == 1
+        # clear → re-arm → next breach fires again (past the cooldown)
+        state["degraded"] = False
+        await asyncio.sleep(0.1)
+        clk.t += 5.0
+        state["degraded"] = True
+        for _ in range(100):
+            if rec.captures >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert rec.captures == 2
+    finally:
+        await rec.stop()
+
+
+# --------------------------------------------------------------------------
+# satellite: jax.profiler.trace is not reentrant — the process-wide
+# capture lock turns a concurrent capture into a clean refusal
+# --------------------------------------------------------------------------
+
+
+def test_capture_trace_refuses_while_lock_held(tmp_path):
+    from dynamo_tpu.utils import profiling
+
+    assert profiling._capture_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(profiling.CaptureBusyError):
+            profiling.capture_trace(str(tmp_path), 0.0)
+    finally:
+        profiling._capture_lock.release()
+    # the loser must not have leaked the lock state: a fresh capture
+    # works immediately after the holder releases
+    made = profiling.capture_trace(str(tmp_path), 0.0)
+    assert os.path.isdir(made)
+
+
+async def test_debug_profile_409_when_incident_capture_holds_lock(tmp_path):
+    """The HTTP endpoint's asyncio lock only serializes ITS callers; a
+    capture from another path (an incident bundle's profile window) holds
+    the process-wide lock — the endpoint must 409, not crash."""
+    from dynamo_tpu.utils import profiling
+
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0,
+                          profile_dir=str(tmp_path))
+    await service.start()
+    assert profiling._capture_lock.acquire(blocking=False)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{service.port}/debug/profile"
+                    f"?seconds=0.01") as r:
+                assert r.status == 409
+                body = await r.json()
+        assert "capture" in body["error"]
+    finally:
+        profiling._capture_lock.release()
+        await service.stop()
+
+
+async def test_incident_profile_lands_inside_bundle(tmp_path):
+    """Review pin: the profiler window must capture INTO the bundle's
+    profile/ dir (docs: bundle anatomy) — not as an unpruned sibling in
+    the incident dir that outlives every bundle and eats the volume."""
+    rec = _recorder(tmp_path, profile_s=0.01)
+    assert rec.trigger("manual_test") is True
+    await rec.stop()
+    assert rec.captures == 1
+    manifest = rec.bundles[0]
+    bundle = manifest["path"]
+    trace_dir = manifest["profile"]["trace_dir"]
+    assert os.path.isdir(trace_dir)
+    assert os.path.dirname(trace_dir) == os.path.join(bundle, "profile")
+    assert "profile/" in manifest["files"]
+    # nothing leaked beside the bundle in the incident dir, and pruning
+    # the bundle takes the capture with it
+    assert [d for d in os.listdir(tmp_path)
+            if not d.startswith("incident-")] == []
+    rec.config.max_bundles = 0
+    rec._prune_bundles(str(tmp_path))
+    assert os.listdir(tmp_path) == []
+
+
+async def test_incident_profile_skips_cleanly_when_capture_in_flight(
+        tmp_path):
+    from dynamo_tpu.utils import profiling
+
+    rec = _recorder(tmp_path, profile_s=0.1)
+    assert profiling._capture_lock.acquire(blocking=False)
+    try:
+        assert rec.trigger("manual_test") is True
+        await rec.stop()
+    finally:
+        profiling._capture_lock.release()
+    assert rec.captures == 1  # the bundle still landed, minus the profile
+    assert rec.bundles[0]["profile"] == {
+        "skipped": "another profiler capture is in flight"}
+
+
+# --------------------------------------------------------------------------
+# GET /debug/incidents: list + fetch
+# --------------------------------------------------------------------------
+
+
+async def test_debug_incidents_endpoint_lists_and_fetches(tmp_path):
+    rec, manifest = await _write_one_bundle_async(tmp_path)
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0,
+                          incidents=rec)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            base = f"http://127.0.0.1:{service.port}"
+            async with s.get(f"{base}/debug/incidents") as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["dir"] == str(tmp_path)
+            assert [b["bundle"] for b in body["bundles"]] == \
+                [manifest["bundle"]]
+            async with s.get(f"{base}/debug/incidents"
+                             f"?id={manifest['bundle']}") as r:
+                assert r.status == 200
+                bundle = await r.json()
+            assert bundle["manifest"]["reason"] == "manual_test"
+            assert bundle["traces"][0]["request_id"] == "req-x"
+            async with s.get(f"{base}/debug/incidents?id=nope") as r:
+                assert r.status == 404
+    finally:
+        await service.stop()
+
+
+# --------------------------------------------------------------------------
+# satellite: scripts/flightdump.py --incident renders a bundle end to end
+# --------------------------------------------------------------------------
+
+
+def test_flightdump_incident_renders_bundle(tmp_path, capsys):
+    _, manifest = _write_one_bundle(tmp_path)
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import flightdump
+
+    rc = flightdump.main(["flightdump", "--incident", manifest["path"]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # trigger header + flight event table + history sparklines + trace
+    assert "reason=manual_test" in out
+    assert "request=req-x" in out
+    assert "stalled_for_s=1.25" in out
+    assert "scheduler.burst_dispatch" in out
+    assert "--- metric history" in out
+    assert "dynamo_kv_block_usage_ratio" in out
+    assert any(c in out for c in flightdump.SPARK_BLOCKS)
+    assert "--- stitched trace req-x ---" in out
+
+
+def test_flightdump_incident_exit_2_on_unreadable(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import flightdump
+
+    assert flightdump.main(
+        ["flightdump", "--incident", str(tmp_path / "nope")]) == 2
+    # a dir with a corrupt manifest is unreadable too
+    bad = tmp_path / "incident-1-2-bad"
+    bad.mkdir()
+    (bad / "manifest.json").write_text("{not json")
+    assert flightdump.main(["flightdump", "--incident", str(bad)]) == 2
+    assert "not a readable" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# the chaos e2e: DYN_FAULT wedge → ONE bundle, evidence intact, recovery
+# still drains/migrates/respawns underneath
+# --------------------------------------------------------------------------
+
+
+def test_wedge_autoproduces_one_bundle_with_evidence(tmp_path):
+    config = _config()
+    prompt = [1, 17, 43]
+    max_tokens = 48
+    out = {}
+
+    async def go():
+        src_runner = MigRunner(config, sync_delay=0.02)
+        dst_runner = MigRunner(config)
+        src = Scheduler(src_runner, config, flight=FlightRecorder())
+        dst = Scheduler(dst_runner, config, flight=FlightRecorder())
+        src.start()
+        dst.start()
+        server = await MigrationServer(
+            MigrationSink(dst, dst_runner)).start()
+        wd = StallWatchdog(
+            probe=src.watchdog_probe, requests=src.request_table,
+            registry=src.registry,  # trips land in the sampled registry
+            flight=src.flight, interval_s=0.02, stall_s=0.15,
+        ).start()
+        controller = RecoveryController(
+            engine_id="src", scheduler=src, runner=src_runner,
+            watchdog=wd,
+            peers=lambda: [{"host": server.host, "port": server.port,
+                            "engine_id": "dst"}],
+            config=RecoveryConfig(drain_grace_s=0.05,
+                                  respawn_backoff_s=0.01),
+            flight=src.flight,
+        ).attach()
+        # the incident autopilot, wired exactly as cli/run.py does it:
+        # watchdog trips + recovery drains + a local history sampler
+        # feeding the bundle's metric window (settle_s holds the capture
+        # open long enough for the drain outcome and the migrated
+        # request's just-completed trace to land in the bundle). No
+        # flight= override: the capture merges the global ring (where
+        # fault.injected lands) with the engine's private ring via the
+        # registered watchdog — exactly the production artifact
+        recorder = IncidentRecorder(
+            IncidentConfig(out_dir=str(tmp_path), settle_s=1.5),
+            history=MetricHistory(window_s=600.0),
+        )
+        recorder.watch_watchdog(wd)
+        recorder.watch_recovery(controller)
+        sampler = LocalHistorySampler(
+            src.registry, history=recorder.history, interval_s=0.03,
+        ).start()
+        tracer = TraceRecorder(capacity=32)
+
+        er = _request(prompt, max_tokens)
+        src.add_request(er)
+        toks, finish = await _collect(er, limit=6)
+        assert finish is None, "finished before the wedge"
+        faults.arm("decode_burst_hang", "once")
+        rest, finish = await _collect(er)
+        out["stream"] = (toks + rest, finish)
+        # the stream completed on the peer: record its trace the way the
+        # edge does, so the settling capture bundles it
+        tracer.record(er.request_id, "m", "completed",
+                      list(er.ctx.stages), ctx=er.ctx)
+        for _ in range(200):  # capture lands after settle_s
+            if recorder.captures:
+                break
+            await asyncio.sleep(0.05)
+        out["captures"] = recorder.captures
+        out["suppressed"] = recorder.suppressed
+        out["bundles"] = list(recorder.bundles)
+        out["trips"] = [t["reason"] for t in wd.trips]
+        for _ in range(100):
+            if controller.recoveries:
+                break
+            await asyncio.sleep(0.02)
+        out["recovery"] = controller.recoveries[0]
+        out["request_id"] = er.request_id
+        faults.release()
+        await sampler.stop()
+        await recorder.stop()
+        await wd.stop()
+        await controller.close()
+        await server.close()
+        await dst.stop()
+        await src.stop()
+        out["src_used"] = src.allocator.used
+        out["dst_used"] = dst.allocator.used
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+    # recovery is untouched by the autopilot riding along: automated
+    # drain + cold migration + byte-identical continuation + respawn
+    assert out["trips"] == ["decode_stall"]
+    assert out["recovery"]["reason"] == "decode_stall"
+    assert out["recovery"]["migrated"] == 1
+    assert out["stream"] == _baseline(prompt, max_tokens)
+    assert out["src_used"] == 0 and out["dst_used"] == 0
+
+    # EXACTLY one bundle: the watchdog trip captured; the recovery-drain
+    # edge (same wedge, moments later) folded into it by the global floor
+    assert out["captures"] == 1
+    assert out["suppressed"] >= 1
+    dirs = [d for d in os.listdir(tmp_path) if d.startswith("incident-")]
+    assert len(dirs) == 1
+    bundle = load_bundle_dir(os.path.join(tmp_path, dirs[0]))
+    manifest = bundle["manifest"]
+    assert manifest["reason"] == "decode_stall"
+    assert manifest["info"]["stalled_for_s"] >= 0.15
+
+    rid = out["request_id"]
+    # flight artifact: the wedged request's lifecycle is in the ring,
+    # from admission through the wedge to the recovery ladder
+    events = bundle["flight"]["events"]
+    kinds_for_req = {e["kind"] for e in events
+                     if e.get("request_id") == rid
+                     or rid in ((e.get("data") or {}).get("requests") or [])}
+    assert "scheduler.admission" in kinds_for_req
+    assert "scheduler.burst_dispatch" in kinds_for_req
+    kinds = {e["kind"] for e in events}
+    assert "watchdog.trip" in kinds
+    assert "recovery.drain" in kinds
+    assert "fault.injected" in kinds
+
+    # metric history: rings cover the window INTO the trip — scheduler
+    # gauges sampled from before the wedge through the drain
+    series = {s["name"] for s in bundle["history"]["series"]}
+    assert "dynamo_scheduler_active_slots" in series
+    assert "dynamo_watchdog_trips_total" in series
+    slots = next(s for s in bundle["history"]["series"]
+                 if s["name"] == "dynamo_scheduler_active_slots")
+    assert len(slots["points"]) >= 2, "history ring holds a curve, not a point"
+    # at least one sample predates the trip (t_rel is negative seconds
+    # relative to capture; the trip happened >= settle_s before it)
+    assert slots["points"][0][0] < -1.0
+
+    # stitched trace: the wedged request's end-to-end timeline — with
+    # the migration relay stamped — rode into the bundle
+    traces = {t["request_id"]: t for t in bundle["traces"]}
+    assert rid in traces
+    span_names = [s["name"] for s in traces[rid]["spans"]]
+    assert "migration.relay" in span_names
+
+    # and flightdump renders the whole thing offline
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import flightdump
+
+    text = flightdump.render_incident(bundle)
+    assert "reason=decode_stall" in text
+    assert "watchdog.trip" in text
+    assert "dynamo_scheduler_active_slots" in text
+    assert f"stitched trace {rid}" in text

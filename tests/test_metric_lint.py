@@ -88,10 +88,21 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_slo_target_seconds",
         "dynamo_trace_evicted_total",
         "dynamo_trace_store_requests",
+        # fleet telemetry hub + incident recorder (telemetry/hub.py,
+        # telemetry/incidents.py, engine/scheduler.py drain gauge)
+        "dynamo_hub_scrapes_total",
+        "dynamo_hub_scrape_duration_seconds",
+        "dynamo_hub_fleet_workers_replicas",
+        "dynamo_hub_fleet_busy_ratio",
+        "dynamo_hub_fleet_kv_usage_ratio",
+        "dynamo_hub_history_series_depth",
+        "dynamo_incidents_total",
+        "dynamo_incidents_suppressed_total",
+        "dynamo_scheduler_draining_info",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 61
+    assert len(names) >= 82
 
 
 def _metric(name, kind):

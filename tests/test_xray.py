@@ -320,6 +320,11 @@ def test_slo_verdicts_and_goodput_counters():
     assert 'dynamo_slo_attainment_total{met="true",slo="ttft"} 3.0' in text
     assert 'dynamo_slo_attainment_total{met="false",slo="ttft"} 1.0' in text
     assert 'dynamo_slo_attainment_total{met="false",slo="itl"} 1.0' in text
+    # the per-request conjunction rides the same counter — the fleet
+    # hub's attainment rollup consumes this (the dimension series blend
+    # would overstate attainment when a dimension misses)
+    assert 'dynamo_slo_attainment_total{met="true",slo="request"} 2.0' in text
+    assert 'dynamo_slo_attainment_total{met="false",slo="request"} 2.0' in text
     assert "dynamo_slo_goodput_tokens_total 11.0" in text
     assert 'dynamo_slo_target_seconds{slo="ttft"} 0.5' in text
     snap = slo.snapshot()
