@@ -197,6 +197,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="port for this worker's inbound-migration "
                         "receiver (0 = ephemeral; started only with "
                         "--self-heal on a native engine)")
+    # cluster KV fabric (kv/fabric.py, docs/kv_fabric.md): cross-worker
+    # prefix pull + content-addressed cold tier
+    p.add_argument("--prefix-pull", action="store_true",
+                   help="cluster KV fabric: on a router-detected remote "
+                        "prefix hit, PULL the owning worker's committed "
+                        "KV blocks over the transfer plane instead of "
+                        "recomputing them (peers + ownership discovered "
+                        "through the component's KV event stream; pull "
+                        "failure falls back to local recompute "
+                        "byte-identically)")
+    p.add_argument("--prefix-pull-min-blocks", type=int, default=2,
+                   help="minimum remote/cold extension (blocks past the "
+                        "local hit) worth a pull")
+    p.add_argument("--prefix-pull-timeout-s", type=float, default=30.0,
+                   help="per-pull deadline before the local-recompute "
+                        "fallback takes over")
+    p.add_argument("--cold-tier-dir", default="",
+                   help="content-addressed cold KV tier: spill host-"
+                        "tier-evicted blocks to checksummed files in "
+                        "this directory (shared mount → any worker, "
+                        "including a respawned one, rehydrates them); "
+                        "requires --host-kv-blocks > 0")
+    p.add_argument("--cold-tier-blocks", type=int, default=0,
+                   help="cold-tier capacity in blocks (0 = off; set "
+                        "together with --cold-tier-dir)")
     # closed-loop SLA planner + HTTP-edge admission control (planner/)
     p.add_argument("--admission-limit", type=int, default=0,
                    help="HTTP-edge admission control: max concurrently "
@@ -481,7 +506,8 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
 
 
 async def _setup_self_healing(flags, core, admission=None, drt=None,
-                              component: str = "backend"):
+                              component: str = "backend",
+                              peer_ranker=None, instance_id: str = ""):
     """--self-heal wiring: a RecoveryController per engine plus (native
     engines) a migration receiver for peers draining TOWARD this worker.
 
@@ -541,8 +567,14 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
     deregister = register = None
     if drt is not None:
         key = migration_key(flags.namespace, component, engine_id)
+        # worker_id: the KV-event id this worker publishes under — the
+        # join key peer fabrics use to rank migration targets by prefix
+        # overlap (their ownership view is keyed by KV-event ids, not
+        # migration engine ids)
         desc = _msgpack.packb(
-            dict(server.descriptor, engine_id=engine_id), use_bin_type=True
+            dict(server.descriptor, engine_id=engine_id,
+                 **({"worker_id": instance_id} if instance_id else {})),
+            use_bin_type=True,
         )
         lease = await drt.discovery.primary_lease()
         await drt.discovery.kv_put(key, desc, lease_id=lease.id)
@@ -591,8 +623,122 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
         register=register,
         admission=admission,
         config=config,
+        peer_ranker=peer_ranker,
     )
     return controller, server
+
+
+async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
+                           endpoint=None, instance_id: str = ""):
+    """Cluster-KV-fabric wiring for a token-level worker.
+
+    The engine already built its fabric half (Scheduler.fabric — cold
+    tier + pull machinery) from the EngineConfig knobs; this attaches
+    the cluster half: the pull SERVER (advertised in discovery under
+    ``fabric_key`` so peers can pull from this worker), the peer
+    descriptor cache (refreshed on a cadence), and the ownership view
+    (the component's KV event stream — the same events the router
+    indexes). Returns the fabric or None.
+    """
+    import msgpack as _msgpack
+
+    from ..kv.fabric import fabric_key
+    from ..kv_router.protocols import KV_EVENT_SUBJECT, RouterEvent
+
+    scheduler = getattr(core, "scheduler", None)
+    fabric = getattr(scheduler, "fabric", None) if scheduler else None
+    if fabric is None:
+        return None
+    if instance_id:
+        # the ownership view keys workers by the SAME id the KV event
+        # publisher stamps, so self-events are skippable and peer scores
+        # map onto descriptors
+        fabric.engine_id = instance_id
+    if fabric.cold is not None:
+        # respawn-warm: prime the cold index off-loop so the first
+        # request after a recovery respawn sees the spilled prefixes
+        n = await asyncio.get_running_loop().run_in_executor(
+            None, fabric.cold.refresh
+        )
+        if n:
+            logger.info("cold tier primed: %d resident blocks", n)
+    if not fabric.peer_pull:
+        # cold-tier-only configuration: local disk spill was the opt-in,
+        # not cross-worker networking — no pull server, no peer view
+        return fabric
+    server = await fabric.serve(host=flags.advertise_host)
+    if drt is None or endpoint is None:
+        return fabric
+    key = fabric_key(flags.namespace, component, fabric.engine_id)
+    desc = _msgpack.packb(
+        {"host": flags.advertise_host, "port": server.port,
+         "engine_id": fabric.engine_id},
+        use_bin_type=True,
+    )
+    lease = await drt.discovery.primary_lease()
+    await drt.discovery.kv_put(key, desc, lease_id=lease.id)
+
+    peer_cache: dict = {}
+
+    async def refresh_peers():
+        prefix = fabric_key(flags.namespace, component, "")
+        kvs = await drt.discovery.kv_get_prefix(prefix)
+        peers = {}
+        for v in kvs.values():
+            d = _msgpack.unpackb(v, raw=False)
+            wid = d.get("engine_id")
+            if wid and wid != fabric.engine_id:
+                peers[wid] = d
+        peer_cache.clear()
+        peer_cache.update(peers)
+        # prune dead workers from the ownership view: respawn churn
+        # mints a fresh id per incarnation, so without this the indexer
+        # accumulates dead workers' hash runs forever (and keeps the
+        # admission gate open with nothing pullable). Liveness comes
+        # from the lease-scoped ENDPOINT registry (keyed by the same
+        # instance id KV events carry), not the pull-server descriptors
+        # — workers without a pull server (cold-tier-only, plain
+        # KV-routed) still publish events and still die.
+        eps = await drt.discovery.kv_get_prefix(
+            endpoint.component.etcd_prefix())
+        live = {k.rsplit(":", 1)[-1] for k in eps}
+        for wid in list(fabric.indexer.worker_ids):
+            if wid != fabric.engine_id and wid not in live:
+                fabric.remove_worker(wid)
+
+    async def refresh_loop():
+        while True:
+            try:
+                await refresh_peers()
+            except Exception:
+                # discovery hiccup: keep the last known pool — a pull
+                # to a dead descriptor just falls back to recompute
+                logger.debug("fabric peer refresh failed", exc_info=True)
+            await asyncio.sleep(5.0)
+
+    try:
+        await refresh_peers()
+    except Exception:
+        logger.warning("initial fabric peer discovery failed; starting "
+                       "with no peers", exc_info=True)
+    fabric.peers = (lambda: peer_cache)
+    fabric.hold_task(drt.runtime.spawn(refresh_loop()))
+
+    # the ownership view rides the SAME event subject the KV router
+    # consumes; apply_event skips this engine's own events
+    sub = await endpoint.component.subscribe_event(KV_EVENT_SUBJECT)
+
+    async def consume_events():
+        async for msg in sub:
+            try:
+                fabric.apply_event(RouterEvent.from_wire(
+                    _msgpack.unpackb(msg.payload, raw=False)
+                ))
+            except Exception:
+                logger.exception("bad kv event on the fabric feed")
+
+    fabric.hold_task(drt.runtime.spawn(consume_events()))
+    return fabric
 
 
 def _build_hub(flags):
@@ -998,13 +1144,24 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
             span_source="decode_engine",
         )
+        # cluster KV fabric: pull server + peer/ownership view, keyed by
+        # the same instance id the KV event publisher stamps
+        fabric = await _setup_kv_fabric(
+            flags, core, drt=drt, component=comp, endpoint=endpoint,
+            instance_id=instance_id,
+        )
         recovery = None
         if flags.self_heal:
             # watchdog trips drain this worker, migrate its in-flight
             # requests to peer workers discovered under the component's
-            # migration prefix, and respawn (docs/self_healing.md)
+            # migration prefix, and respawn (docs/self_healing.md);
+            # migration targets rank by the fabric's ownership view
+            # (prefix overlap) when one exists
             recovery, _migserver = await _setup_self_healing(
                 flags, core, drt=drt, component=comp,
+                peer_ranker=fabric.rank_peers if fabric is not None
+                else None,
+                instance_id=instance_id,
             )
             if recovery is not None:
                 recovery.attach()

@@ -18,6 +18,14 @@ Wire format, length-prefixed msgpack header + raw payloads:
   <k raw bytes> <v raw bytes>
   {type: "commit", request_id, first_token, logprob, generated, spans?}
 
+Read-only block serve (the cluster KV fabric, kv/fabric.py) rides the
+same framing in the other direction — a peer asks for a sequence-hash
+chain and this engine streams whatever prefix run it still holds::
+
+  → {type: "pull", hashes, chunk_blocks, trace_id?}
+  ← {type: "pull_blocks", shape, dtype, k_bytes, v_bytes} <k> <v>  (per chunk)
+  ← {type: "pull_end", served}
+
 ``spans`` is the prefill worker's span export for the cluster-stitched
 trace (telemetry/stitch.py): its wall-clock span marks plus the
 request-receipt/commit-send timestamps the decode side folds into a
@@ -87,6 +95,7 @@ class KvTransferServer:
         ici_recv: Optional[Callable[[int], tuple]] = None,
         ici_rank: Optional[int] = None,
         ici_recv_timeout_s: float = 120.0,
+        pull_source=None,  # Optional[Callable[[List[int]], PullGrant]]
     ):
         # scatter(request_id, block_ids, k, v) — may return an awaitable; an
         # async scatter MUST re-validate the request id after any await (the
@@ -107,6 +116,13 @@ class KvTransferServer:
         # pairs with this engine.
         self.ici_recv = ici_recv
         self.ici_rank = ici_rank
+        # read-only block serve (the cluster KV fabric, kv/fabric.py):
+        # pull_source(hashes) resolves + PINS the longest locally-held
+        # run of a sequence-hash chain and hands back a grant whose
+        # gather_frame packs wire frames off-loop; release() unpins and
+        # MUST run exactly once — the handler's finally owns it, so a
+        # connection dying mid-serve can never leave blocks fenced
+        self.pull_source = pull_source
         # generous default: the first recv compiles the collective program
         self.ici_recv_timeout_s = ici_recv_timeout_s
         # collective entries are strictly ordered — serialize receives
@@ -180,6 +196,8 @@ class KvTransferServer:
         # — sending an ici frame to a tcp-only server would strand the
         # sender inside a collective that never pairs
         modes = ["tcp"] + (["ici"] if self.ici_recv is not None else [])
+        if self.pull_source is not None:
+            modes.append("pull")
         desc = {"host": self.host, "port": self.port, "modes": modes}
         if self.ici_rank is not None:
             desc["ici_rank"] = self.ici_rank
@@ -292,6 +310,11 @@ class KvTransferServer:
                     result = self.scatter(header["request_id"], ids, k, v)
                     if inspect.isawaitable(result):
                         await result
+                elif mtype == "pull":
+                    # read-only block serve (cluster KV fabric): stream
+                    # the longest locally-resident run of the requested
+                    # hash chain back over THIS connection
+                    await self._serve_pull(header, writer)
                 elif mtype == "commit":
                     rid = header["request_id"]
                     streaming.discard(rid)
@@ -335,6 +358,60 @@ class KvTransferServer:
                 )
                 self._mark_dropped(rid)
             writer.close()
+
+    async def _serve_pull(self, header: dict,
+                          writer: asyncio.StreamWriter) -> None:
+        """Serve one ``pull`` frame: resolve the longest locally-held
+        run of the requested sequence-hash chain and stream it back as
+        ``pull_blocks`` frames + a ``pull_end`` trailer.
+
+        Strictly read-only: blocks are pinned for the duration (the
+        grant), gathered and byte-packed off-loop, and unpinned in the
+        ``finally`` — a puller that vanishes mid-stream costs this
+        engine nothing but the frames already sent.
+        """
+        from ..telemetry.flight import flight_recorder
+        from ..utils import faults
+
+        hashes = [int(h) for h in header.get("hashes") or []]
+        chunk = max(1, int(header.get("chunk_blocks", 16)))
+        grant = self.pull_source(hashes) if self.pull_source else None
+        flight_recorder().record(
+            "kv_fabric.serve", trace_id=header.get("trace_id"),
+            asked=len(hashes), served=len(grant) if grant else 0,
+        )
+        if grant is None:
+            hdr = msgpack.packb({"type": "pull_end", "served": 0},
+                                use_bin_type=True)
+            writer.write(struct.pack(">I", len(hdr)) + hdr)
+            await writer.drain()
+            return
+        try:
+            n = len(grant)
+            for lo in range(0, n, chunk):
+                if faults.fire("transfer_conn_drop"):
+                    # chaos site: the serving side dies mid-stream — the
+                    # puller must fall back to local recompute with its
+                    # reservation freed and nothing registered
+                    writer.close()
+                    return
+                kb, vb, shape, dtype = await grant.gather_frame(
+                    lo, min(lo + chunk, n)
+                )
+                hdr = msgpack.packb({
+                    "type": "pull_blocks", "shape": shape, "dtype": dtype,
+                    "k_bytes": len(kb), "v_bytes": len(vb),
+                }, use_bin_type=True)
+                writer.write(struct.pack(">I", len(hdr)) + hdr)
+                writer.write(kb)
+                writer.write(vb)
+                await writer.drain()
+            hdr = msgpack.packb({"type": "pull_end", "served": n},
+                                use_bin_type=True)
+            writer.write(struct.pack(">I", len(hdr)) + hdr)
+            await writer.drain()
+        finally:
+            grant.release()
 
     async def close(self) -> None:
         if self._server is not None:

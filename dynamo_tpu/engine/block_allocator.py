@@ -37,10 +37,18 @@ from ..tokens import compute_block_hashes
 
 @dataclasses.dataclass
 class KvEventSink:
-    """Engine-side KV event hooks (no-op by default)."""
+    """Engine-side KV event hooks (no-op by default).
+
+    The ``_cold`` pair announces cold-tier residency (kv/cold_tier.py
+    spills/evictions) so the router can score a rehydratable prefix —
+    discounted vs a warm hit (kv_router/scheduler.py cold_discount)."""
 
     on_stored: Callable[[List[int], Optional[int]], None] = lambda hashes, parent: None
     on_removed: Callable[[List[int]], None] = lambda hashes: None
+    on_stored_cold: Callable[[List[int], Optional[int]], None] = (
+        lambda hashes, parent: None
+    )
+    on_removed_cold: Callable[[List[int]], None] = lambda hashes: None
 
 
 class _ReusePool:

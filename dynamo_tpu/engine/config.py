@@ -367,6 +367,30 @@ class EngineConfig:
     # host-RAM KV offload tier: evicted HBM blocks are copied out and can be
     # restored on later prefix hits instead of recomputed. 0 disables.
     host_kv_blocks: int = 0
+    # cluster KV fabric (kv/fabric.py, docs/kv_fabric.md): cross-worker
+    # prefix PULL — when the fabric's ownership view says a peer holds a
+    # longer prefix of an incoming prompt than every local tier, the
+    # scheduler pulls those committed KV blocks over the transfer plane
+    # instead of recomputing them (pull failure/timeout falls back to
+    # local recompute, byte-identically). The peer view itself (KV event
+    # feed + pull-server descriptors) is wired by the CLI/discovery
+    # layer; this flag builds the engine-side machinery.
+    prefix_pull: bool = False
+    # minimum remote/cold extension (in blocks past the local hit) worth
+    # a pull — below this the transfer round trip loses to recompute
+    prefix_pull_min_blocks: int = 2
+    # per-pull deadline: a dead/stalled source must never hold a request
+    # past this before the local-recompute fallback takes over
+    prefix_pull_timeout_s: float = 30.0
+    # content-addressed cold tier (kv/cold_tier.py): host-tier-evicted
+    # blocks spill to checksummed files keyed by sequence hash in this
+    # directory, so cold-but-hot-again prefixes (system prompts, RAG
+    # documents) survive RAM eviction and ANY worker sharing the
+    # directory — including a freshly respawned one — can rehydrate
+    # them. Requires host_kv_blocks > 0 (the spill source is host-tier
+    # eviction). Both knobs must be set together.
+    cold_tier_dir: str = ""
+    cold_tier_blocks: int = 0
     # stall watchdog (telemetry/watchdog.py): trip when work is pending
     # but the scheduler loop's heartbeat (or its dispatch counter) has
     # been stale for this long — a wedged Mosaic compile or dead host
@@ -442,6 +466,19 @@ class EngineConfig:
         self.watchdog_interval_s = max(0.05, self.watchdog_interval_s)
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
+        self.prefix_pull_min_blocks = max(1, self.prefix_pull_min_blocks)
+        self.prefix_pull_timeout_s = max(0.1, self.prefix_pull_timeout_s)
+        if bool(self.cold_tier_dir) != (self.cold_tier_blocks > 0):
+            raise ValueError(
+                "cold_tier_dir and cold_tier_blocks must be set together "
+                f"(got dir={self.cold_tier_dir!r}, "
+                f"blocks={self.cold_tier_blocks})"
+            )
+        if self.cold_tier_blocks > 0 and self.host_kv_blocks <= 0:
+            raise ValueError(
+                "the cold tier spills from the host tier: "
+                "cold_tier_blocks > 0 requires host_kv_blocks > 0"
+            )
         if self.spec_draft_tokens and not self.spec_draft_model:
             raise ValueError(
                 "spec_draft_tokens set without spec_draft_model — "

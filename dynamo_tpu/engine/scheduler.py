@@ -170,6 +170,22 @@ class EngineRequest:
     remote_future: Optional[asyncio.Future] = None
     remote_deadline: float = 0.0
     remote_attempted: bool = False
+    # cluster-KV-fabric prefix pull (kv/fabric.py): the in-flight pull
+    # (a _PendingPull while queued in scheduler.pending_pull), whether a
+    # pull was already tried (one attempt per request — the fallback
+    # must not loop), and whether a committed pull pre-allocated this
+    # request's blocks (``_start_prefill`` then skips allocation)
+    pull: Optional[object] = None
+    pull_attempted: bool = False
+    pull_ready: bool = False
+    # monotonic deadline before which the pull plan is not re-run for
+    # this request (a no-plan outcome is sticky on the ~1 ms loop
+    # cadence — the ownership view only changes on peer-event cadence)
+    pull_backoff_until: float = 0.0
+    # held out of LOCAL admission while another request's in-flight
+    # pull fetches (part of) this prompt's prefix — cleared early by
+    # that pull's commit/fallback, bounded by its deadline
+    pull_hold_until: float = 0.0
     # monotonic deadline before which the remote-eligibility probe is not
     # re-run (set when a prefix-hit rejection made it pointless for a while;
     # time-based — the scheduler loop can spin every ~1 ms)
@@ -305,6 +321,23 @@ class _HostBatchState:
 
 
 @dataclasses.dataclass
+class _PendingPull:
+    """One in-flight prefix pull (scheduler.pending_pull entry).
+
+    The request already holds its full prompt allocation; ``targets``
+    (the pull destination blocks) are PINNED for the duration so
+    nothing reclaims a slot with a scatter in flight. The scheduler
+    owns both ends: pin at submit, unpin at reap — commit, fallback,
+    cancel, and drain all funnel through the reap path."""
+
+    plan: object                    # kv.fabric.PullPlan
+    task: asyncio.Task              # the fabric.pull coroutine
+    targets: List[int]              # destination block ids (pinned)
+    hashes: List[int]               # the prompt's full hash chain
+    deadline: float                 # monotonic fallback deadline
+
+
+@dataclasses.dataclass
 class _InflightBurst:
     """One dispatched-but-unreconciled decode burst (pipeline depth 2).
 
@@ -353,6 +386,11 @@ class Scheduler:
         # target's block ids — every prefill chunk replays on the draft,
         # and the decode loop proposes with the draft's K-step burst
         self.draft = draft_runner
+        # shared metrics registry: the scheduler's, the allocator's, and
+        # (attached below) the disagg coordinator's instruments all render
+        # in the frontend's single /metrics exposition
+        self.registry = registry or MetricsRegistry()
+        sink = events or KvEventSink()
         tier2 = None
         if config.host_kv_blocks > 0:
             from ..kv import KvHostTier
@@ -363,15 +401,47 @@ class Scheduler:
                 runner.gather_blocks_device, runner.scatter_blocks,
                 config.host_kv_blocks,
             )
-        # shared metrics registry: the scheduler's, the allocator's, and
-        # (attached below) the disagg coordinator's instruments all render
-        # in the frontend's single /metrics exposition
-        self.registry = registry or MetricsRegistry()
+        cold = None
+        if config.cold_tier_blocks > 0:
+            from ..kv import KvColdTier
+
+            # content-addressed spill tier: host-tier-evicted blocks
+            # survive to disk; residency is advertised through the cold
+            # event hooks so routers can score rehydratable prefixes
+            cold = KvColdTier(
+                config.cold_tier_dir, config.cold_tier_blocks,
+                registry=self.registry,
+                on_stored=lambda hashes, parent: sink.on_stored_cold(
+                    hashes, parent),
+                on_removed=lambda hashes: sink.on_removed_cold(hashes),
+            )
+            tier2.on_evict = cold.offer
         self.allocator = BlockAllocator(
             config.num_kv_blocks, config.kv_block_size,
-            config.enable_prefix_caching, events, tier2=tier2,
+            config.enable_prefix_caching, sink, tier2=tier2,
             registry=self.registry, flight=self.flight,
         )
+        # cluster KV fabric (kv/fabric.py): cross-worker prefix pull +
+        # cold-tier rehydration. Built whenever either capability is
+        # configured; the CLI/discovery layer attaches the peer view
+        # (event feed + pull-server descriptors) onto scheduler.fabric.
+        self.fabric = None
+        if (config.prefix_pull or cold is not None) \
+                and config.enable_prefix_caching:
+            from ..kv import KvFabric
+
+            self.fabric = KvFabric(
+                runner, self.allocator,
+                engine_id=f"eng-{id(self):x}",
+                block_size=config.kv_block_size,
+                cold=cold,
+                peer_pull=config.prefix_pull,
+                min_pull_blocks=config.prefix_pull_min_blocks,
+                pull_timeout_s=config.prefix_pull_timeout_s,
+                registry=self.registry,
+                flight=self.flight,
+            )
+        self.pending_pull: List[EngineRequest] = []
         self.waiting: deque = deque()
         # persistent decode-step host arrays (see _HostBatchState)
         self._host = _HostBatchState(config)
@@ -523,8 +593,10 @@ class Scheduler:
         )
         reg.callback_gauge(
             "dynamo_scheduler_waiting_requests",
-            "Admission queue depth (local waiting + pending remote prefill)",
-            lambda: len(self.waiting) + len(self.pending_remote),
+            "Admission queue depth (local waiting + pending remote "
+            "prefill + pending prefix pulls)",
+            lambda: (len(self.waiting) + len(self.pending_remote)
+                     + len(self.pending_pull)),
         )
         reg.callback_gauge(
             "dynamo_scheduler_draining_info",
@@ -555,6 +627,16 @@ class Scheduler:
             compiles = getattr(r, "compiles", None)
             if compiles is not None:
                 compiles.mark_serving_started()
+        if self.fabric is not None and self.fabric.cold is not None:
+            # restart-warm on EVERY embedding (single-process serve,
+            # tests, distributed workers): prime the cold index off-loop
+            # so spilled prefixes survive a process restart. refresh()
+            # is idempotent — the CLI's distributed wiring also primes.
+            self.fabric.hold_task(
+                asyncio.get_running_loop().run_in_executor(
+                    None, self.fabric.cold.refresh
+                )
+            )
         self._task = asyncio.create_task(self._run())
 
     async def stop(self) -> None:
@@ -567,6 +649,12 @@ class Scheduler:
                 self.disagg.cancel(er.request_id)
             self._finish(er, FinishReason.CANCELLED)
         self.pending_remote.clear()
+        for er in self.pending_pull:
+            self._release_pull(er)
+            self._finish(er, FinishReason.CANCELLED)
+        self.pending_pull.clear()
+        if self.fabric is not None:
+            await self.fabric.close()
         if self.disagg is not None:
             await self.disagg.close()
 
@@ -677,6 +765,12 @@ class Scheduler:
             er.remote_future = None
             out.append(er)
         self.pending_remote.clear()
+        for er in self.pending_pull:
+            # in-flight pulls abort; the request migrates cold (its
+            # blocks hold no registered KV — packaging frees them)
+            self._release_pull(er)
+            out.append(er)
+        self.pending_pull.clear()
         for er in out:
             self.flight.record(
                 "scheduler.extract", request_id=er.request_id,
@@ -757,7 +851,10 @@ class Scheduler:
             "request_total_slots": self.config.max_batch_size,
             "kv_active_blocks": self.allocator.used,
             "kv_total_blocks": self.allocator.num_blocks,
-            "num_requests_waiting": len(self.waiting) + len(self.pending_remote),
+            "num_requests_waiting": (
+                len(self.waiting) + len(self.pending_remote)
+                + len(self.pending_pull)
+            ),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": (
                 self.prefix_hit_tokens / self.prefix_total_tokens
@@ -779,6 +876,8 @@ class Scheduler:
             )
         if self.allocator.tier2 is not None:
             out.update(self.allocator.tier2.metrics())
+        if self.fabric is not None and self.fabric.cold is not None:
+            out.update(self.fabric.cold.metrics())
         if self.disagg is not None:
             out.update(self.disagg.metrics())
         return out
@@ -795,6 +894,10 @@ class Scheduler:
             "steps": self.steps,
             "queue_depth": len(self.waiting),
             "pending_remote": len(self.pending_remote),
+            # pull waits own their deadline (fallback → local), so the
+            # watchdog must not read them as starvation — same contract
+            # as remote waits
+            "pending_pull": len(self.pending_pull),
             "active": sum(1 for s in self.slots if s is not None),
             # a draining engine's gated queue must not read as
             # starvation — recovery owns it now, not the watchdog
@@ -820,7 +923,8 @@ class Scheduler:
                 "guided": er.guided is not None,
             })
         for state, ers in (("waiting", list(self.waiting)),
-                           ("pending_remote", self.pending_remote)):
+                           ("pending_remote", self.pending_remote),
+                           ("pending_pull", self.pending_pull)):
             out.extend({
                 "state": state,
                 "request_id": er.request_id,
@@ -951,12 +1055,24 @@ class Scheduler:
             if self.pending_remote:
                 progressed |= self._reap_remote()
 
-            # admission, remote first: a remote-prefill submit is only a
-            # queue push + block reservation (no local compute), so it
-            # proceeds even while a local chunked prefill occupies the
-            # runner; the pending window bounds block reservations
+            # prefix-pull completions / fallbacks / timeouts
+            if self.pending_pull:
+                progressed |= self._reap_pulls()
+
+            # admission, pulls first: a prefix pull is only a block
+            # reservation + a transfer (no local compute), and a pulled
+            # prefix shrinks the suffix every later decision (remote
+            # prefill, local chunking) sees
             t_adm = time.monotonic()
             admitted = False
+            if (self.fabric is not None and not self.draining
+                    and self.fabric.may_hold_any()):
+                for er in list(self.waiting):
+                    if len(self.pending_pull) >= self.config.max_batch_size:
+                        break
+                    if self._try_submit_pull(er):
+                        self.waiting.remove(er)
+                        progressed = admitted = True
             if self.disagg is not None and not self.draining:
                 for er in list(self.waiting):
                     if (len(self.pending_remote)
@@ -967,17 +1083,25 @@ class Scheduler:
                         progressed = admitted = True
 
             # local admission: claim a slot + blocks, join the prefill
-            # batch (up to max_prefill_batch prompts prefill together)
+            # batch (up to max_prefill_batch prompts prefill together).
+            # Requests held for an overlapping in-flight prefix pull
+            # (pull_hold_until) are skipped, not admitted to recompute
+            # what the pull is about to install; everyone else keeps
+            # FIFO order.
             while (self.waiting
                    and not self.draining
                    and len(self.prefilling) < self.config.max_prefill_batch
                    and self._free_slot() is not None):
-                er = self.waiting[0]
+                now_h = time.monotonic()
+                er = next((e for e in self.waiting
+                           if e.pull_hold_until <= now_h), None)
+                if er is None:
+                    break  # everyone waiting is held on a pull
                 try:
                     self._start_prefill(er)
                 except MemoryError:
                     break  # no memory — wait for a sequence to finish
-                self.waiting.popleft()
+                self.waiting.remove(er)
                 progressed = admitted = True
             if admitted:
                 self._phase_hist.observe(
@@ -1087,8 +1211,10 @@ class Scheduler:
                 if self.device_time is not None:
                     self.device_time.idle()
                 if not self.waiting and not any(self.slots):
-                    if self.pending_remote:
-                        # sleep but wake on remote completion or timeout check
+                    if self.pending_remote or self.pending_pull:
+                        # sleep but wake on remote/pull completion — the
+                        # bounded wait keeps deadline checks live even
+                        # if a stalled pull never completes its future
                         try:
                             await asyncio.wait_for(self.wake.wait(), timeout=0.5)
                         except asyncio.TimeoutError:
@@ -1582,6 +1708,202 @@ class Scheduler:
         self._chain_dispatched = 0
         self._chain_pos0 = {}
 
+    # ---------- cluster KV fabric: prefix pull (kv/fabric.py) ----------
+
+    def _try_submit_pull(self, er: EngineRequest) -> bool:
+        """Start a prefix pull for this waiting request?
+
+        Engages when the fabric's ownership view (peer KV events, cold
+        tier index) holds a longer prefix run than every local tier.
+        The request reserves its FULL prompt allocation now (exactly
+        like a remote-prefill submit), pins the pull targets, and waits
+        in ``pending_pull`` while the transfer streams — the scheduler
+        keeps serving everyone else. One attempt per request: any
+        failure falls back to plain local prefill, byte-identically.
+        """
+        if (er.pull_attempted or er.resume_tokens
+                or (er.want_prompt_lps and not er.prompt_lps_emitted)):
+            # resumed streams re-prefill prompt+resume (no pullable
+            # chain for the generated tail); prompt-logprob requests
+            # must run every position through the model anyway
+            return False
+        if time.monotonic() < er.pull_backoff_until:
+            return False
+        probe = self.allocator.probe_prefix(er.prompt)
+        hashes, local_blocks, host_hashes = probe
+        n_local = len(local_blocks) + len(host_hashes)
+        plan = self.fabric.plan(hashes, n_local, len(er.prompt))
+        if plan is None:
+            # nothing worth pulling right now: don't re-hash the whole
+            # prompt on every loop pass while the request queues
+            er.pull_backoff_until = time.monotonic() + 0.25
+            return False
+        planned = set(plan.hashes)
+        for other in self.pending_pull:
+            if (other.pull is not None
+                    and not planned.isdisjoint(other.pull.plan.hashes)):
+                # a pull already in flight fetches (part of) this run —
+                # its commit registers the prefix for everyone, so HOLD
+                # this request out of local admission until the pull
+                # resolves instead of transferring (or recomputing) the
+                # same blocks N× (the shared-system-prompt burst on a
+                # cold worker). Commit/fallback clear the hold early;
+                # the pull's own deadline bounds it.
+                er.pull_backoff_until = time.monotonic() + 0.05
+                er.pull_hold_until = other.pull.deadline
+                return False
+        try:
+            er.block_ids, er.num_cached = self.allocator.allocate_prompt(
+                er.prompt, probe=probe
+            )
+        except MemoryError:
+            # transient — the pull stays worth trying once memory frees
+            # (only an actual transfer attempt burns the one shot)
+            er.pull_backoff_until = time.monotonic() + 0.25
+            return False
+        bs = self.config.kv_block_size
+        if er.num_cached // bs != plan.start_block:
+            # the local hit shrank inside allocate_prompt (host-tier
+            # capacity eviction raced the probe): the planned run no
+            # longer abuts the cached prefix — abandon the pull (a
+            # re-plan against the new local state may still pull)
+            self.allocator.free_blocks(er.block_ids)
+            er.block_ids = []
+            er.num_cached = 0
+            er.pull_backoff_until = time.monotonic() + 0.25
+            return False
+        er.pull_attempted = True
+        targets = er.block_ids[
+            plan.start_block:plan.start_block + plan.blocks
+        ]
+        self.allocator.pin_blocks(targets)
+        task = asyncio.get_running_loop().create_task(
+            self.fabric.pull(
+                plan, targets, request_id=er.request_id,
+                trace_id=er.ctx.trace_id,
+            ),
+            name=f"kv-pull-{er.request_id[:8]}",
+        )
+        task.add_done_callback(lambda _f: self.wake.set())
+        er.pull = _PendingPull(
+            plan=plan, task=task, targets=targets, hashes=hashes,
+            deadline=time.monotonic() + self.fabric.pull_timeout_s,
+        )
+        self.flight.record(
+            "scheduler.pull_submit", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, source=plan.source,
+            worker=plan.worker_id, blocks=plan.blocks,
+        )
+        self.pending_pull.append(er)
+        return True
+
+    def _reap_pulls(self) -> bool:
+        """Commit finished pulls, fall back on failures and deadlines."""
+        progressed = False
+        now = time.monotonic()
+        for er in list(self.pending_pull):
+            pp: _PendingPull = er.pull
+            if er.ctx.is_stopped:
+                self.pending_pull.remove(er)
+                self._release_pull(er)
+                self._finish(er, FinishReason.CANCELLED)
+                # requests held on THIS pull must not wait out its
+                # stale deadline after a client disconnect
+                self._clear_pull_holds()
+                progressed = True
+            elif pp.task.done():
+                self.pending_pull.remove(er)
+                served, reason = 0, "empty"
+                if not pp.task.cancelled():
+                    try:
+                        served = pp.task.result()
+                    except Exception as e:
+                        reason = "error"
+                        logger.warning(
+                            "prefix pull failed for %s (%s); local "
+                            "recompute fallback", er.request_id, e,
+                        )
+                if served > 0:
+                    self._commit_pull(er, served)
+                else:
+                    self._fallback_pull(er, reason)
+                progressed = True
+            elif now > pp.deadline:
+                # a dead/stalled source must never hold the request:
+                # cancel the transfer and recompute locally
+                pp.task.cancel()
+                self.pending_pull.remove(er)
+                self._fallback_pull(er, "timeout")
+                progressed = True
+        return progressed
+
+    def _release_pull(self, er: EngineRequest) -> None:
+        """Unwind a pull's reservation state (task + pins). Blocks stay
+        with the request — commit registers them, fallback/finish frees
+        them."""
+        pp: _PendingPull = er.pull
+        er.pull = None
+        if not pp.task.done():
+            pp.task.cancel()
+        self.allocator.unpin_blocks(pp.targets)
+
+    def _commit_pull(self, er: EngineRequest, served: int) -> None:
+        """A pull landed ``served`` blocks: register the content-
+        addressed prefix (matchable + KV events, exactly as if this
+        engine had computed it) and re-queue for the tail prefill."""
+        pp: _PendingPull = er.pull
+        self._release_pull(er)
+        bs = self.config.kv_block_size
+        for i in range(served):
+            idx = pp.plan.start_block + i
+            parent = pp.hashes[idx - 1] if idx > 0 else None
+            self.allocator.register_complete(
+                pp.targets[i], pp.hashes[idx], parent
+            )
+        er.num_cached += served * bs
+        er.pull_ready = True
+        # closing-mark semantics: the wait-and-transfer span since the
+        # queued mark is the fabric's — the tail prefill's own span
+        # follows under "prefill"
+        er.ctx.add_stage("kv_fabric")
+        self.flight.record(
+            "scheduler.pull_commit", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, source=pp.plan.source,
+            blocks=served, cached_tokens=er.num_cached,
+        )
+        self.waiting.appendleft(er)
+        self._clear_pull_holds()
+        self.wake.set()
+
+    def _clear_pull_holds(self) -> None:
+        """A pull resolved (commit or fallback): release every waiting
+        request held for it — their next pass re-probes against the
+        new local state (commit → the prefix is now a local hit)."""
+        for w in self.waiting:
+            w.pull_hold_until = 0.0
+            w.pull_backoff_until = 0.0
+
+    def _fallback_pull(self, er: EngineRequest, reason: str) -> None:
+        """Pull failed/expired/served nothing: release everything and
+        recompute locally. The stream is byte-identical to the
+        no-fabric run — nothing was registered, so the allocator state
+        matches a fresh admission exactly."""
+        self._release_pull(er)
+        self.allocator.free_blocks(er.block_ids)
+        er.block_ids = []
+        er.num_cached = 0
+        # marker span (the "preempted"/"remote_fallback" idiom): the
+        # pull wait is attributable, and the second "queued" epoch in
+        # the trace is a fallback re-admission, not a bug
+        er.ctx.add_stage("pull_fallback")
+        self.flight.record(
+            "kv_fabric.local_fallback", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, reason=reason,
+        )
+        self.waiting.appendleft(er)
+        self._clear_pull_holds()
+        self.wake.set()
+
     # ---------- disaggregated prefill (decode side) ----------
 
     async def _try_submit_remote(self, er: EngineRequest) -> bool:
@@ -1593,6 +1915,10 @@ class Scheduler:
         """
         if er.remote_attempted:
             return False  # already tried remote once — prefill locally
+        if er.pull_ready:
+            # a committed prefix pull pre-allocated this request's
+            # blocks; the (now small) tail prefills locally
+            return False
         if time.monotonic() < er.remote_backoff_until:
             return False
         if er.resume_tokens:
@@ -1758,7 +2084,12 @@ class Scheduler:
             prompt_tokens=len(er.prompt), resumed=bool(er.resume_tokens),
         )
         tokens_all = er.prompt + er.resume_tokens
-        if er.want_prompt_lps and not er.prompt_lps_emitted:
+        if er.pull_ready and er.block_ids:
+            # a committed prefix pull already allocated the blocks,
+            # scattered the pulled run, and registered it (num_cached
+            # covers local + pulled) — only the tail prefills below
+            er.pull_ready = False
+        elif er.want_prompt_lps and not er.prompt_lps_emitted:
             # every prompt position must run through the model — a prefix
             # cache hit would skip its logits. Blank the probe's hits so
             # allocation proceeds with zero cached tokens. (A resumed

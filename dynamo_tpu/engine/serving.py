@@ -92,6 +92,15 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         spec_draft_tokens=getattr(flags, "spec_draft_tokens", 0) or 0,
         allow_random_weights=getattr(flags, "allow_random_weights", False),
         kv_cache_dtype=getattr(flags, "kv_cache_dtype", "auto") or "auto",
+        # cluster KV fabric (kv/fabric.py): cross-worker prefix pull +
+        # the content-addressed cold tier
+        prefix_pull=getattr(flags, "prefix_pull", False),
+        prefix_pull_min_blocks=getattr(
+            flags, "prefix_pull_min_blocks", 2) or 2,
+        prefix_pull_timeout_s=getattr(
+            flags, "prefix_pull_timeout_s", 30.0) or 30.0,
+        cold_tier_dir=getattr(flags, "cold_tier_dir", "") or "",
+        cold_tier_blocks=getattr(flags, "cold_tier_blocks", 0) or 0,
     ))
 
 

@@ -1,5 +1,15 @@
-"""Multi-tier KV cache management (HBM + host RAM offload tier)."""
+"""Multi-tier KV cache management and the cluster KV fabric.
 
+Tiers: HBM (engine/block_allocator.py) → host RAM (host_tier.py) →
+content-addressed disk (cold_tier.py). The fabric (fabric.py) stitches
+every worker's tiers into one datacenter-wide prefix cache: remote
+prefix hits PULL committed blocks over the transfer plane instead of
+recomputing, and cold-but-hot-again prefixes rehydrate from spill files
+any worker (including a freshly respawned one) can read.
+"""
+
+from .cold_tier import KvColdTier
+from .fabric import KvFabric, PullPlan, fabric_key
 from .host_tier import KvHostTier
 
-__all__ = ["KvHostTier"]
+__all__ = ["KvColdTier", "KvFabric", "KvHostTier", "PullPlan", "fabric_key"]

@@ -1,0 +1,397 @@
+"""Content-addressed cold KV tier: disk spill that survives process death.
+
+The third tier of the KV hierarchy (HBM → host RAM → here). Blocks are
+keyed by their *chained sequence hash* (tokens.py) and stored one file
+per block, so identical token prefixes written by ANY worker are
+rehydratable by any other worker sharing the directory — including a
+freshly respawned one after a recovery drain, whose HBM and host tiers
+start empty. This is the reference's object-store KV tier (PAPER.md §1
+layer 3 multi-tier block manager) grounded in a filesystem: a shared
+mount or a FUSE'd object store both work, because every read is
+checksum-verified and every write is atomic (tmp + rename).
+
+File layout (``<dir>/<sequence_hash:016x>.kvb``)::
+
+    [4-byte header len][msgpack header][k raw bytes][v raw bytes]
+
+The header carries the sequence hash again (a renamed/misplaced file
+must not serve under the wrong prefix), the array shape/dtype, and an
+xxh64 checksum over the payload. A failed verification — wrong magic,
+hash mismatch, short payload, checksum mismatch — is a MISS, never an
+install: the corrupt file is quarantined (deleted) and counted.
+
+Threading discipline: ``offer`` (the host-tier eviction hook) schedules
+the file write on the event loop's executor and HOLDS the future (spill
+I/O must never ride the loop — dynlint async-blocking / task-leak pins
+this module); ``get``/``put``/``refresh`` are sync and belong on an
+executor thread — the fabric's pull task is the only production caller.
+``has``/``match_extension`` consult only the in-memory index (no disk
+touch) so the scheduler's sync planning path stays cheap; the index can
+be stale against other writers of a shared directory, which is safe
+because the read path re-verifies and treats absence as a miss.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import msgpack
+import numpy as np
+import xxhash
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = "dynkv1"
+_SUFFIX = ".kvb"
+_MAX_HEADER = 1 << 20
+
+
+def _fname(sequence_hash: int) -> str:
+    return f"{sequence_hash & (2**64 - 1):016x}{_SUFFIX}"
+
+
+def _checksum(k_raw: bytes, v_raw: bytes) -> int:
+    h = xxhash.xxh64()
+    h.update(k_raw)
+    h.update(v_raw)
+    return h.intdigest()
+
+
+class KvColdTier:
+    """Disk store of KV blocks keyed by sequence hash.
+
+    ``capacity_blocks`` bounds the number of resident block files this
+    process enforces, least-recently-accessed first (in-memory order;
+    refresh() seeds it from mtimes, which get() also touches so other
+    workers sharing the directory see accesses too).
+    ``on_stored``/``on_removed`` (optional) mirror
+    the allocator's KV event hooks so the router can learn cold-tier
+    ownership (discounted scoring, kv_router/scheduler.py).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        capacity_blocks: int,
+        registry=None,
+        on_stored=None,   # (hashes: List[int], parent: Optional[int]) -> None
+        on_removed=None,  # (hashes: List[int]) -> None
+    ):
+        self.dir = directory
+        self.capacity_blocks = capacity_blocks
+        self.on_stored = on_stored or (lambda hashes, parent: None)
+        self.on_removed = on_removed or (lambda hashes: None)
+        os.makedirs(self.dir, exist_ok=True)
+        # in-memory view of the directory: hash → payload bytes, in
+        # access (LRU) order — capacity eviction pops the front without
+        # re-statting the directory. Kept by this process's puts/
+        # refreshes; the disk is the truth and the read path re-verifies.
+        self._index: "OrderedDict[int, int]" = OrderedDict()
+        # resident payload bytes, kept as a plain int beside the index:
+        # the metrics gauge reads it from the loop while executor-side
+        # put/refresh mutate the dict — summing the dict's values
+        # mid-insert could raise, an int read can't
+        self._bytes = 0
+        # serializes executor-side mutation (put/get/refresh each run on
+        # whatever executor thread their future landed on — a host-tier
+        # drain schedules many offers at once): without it, concurrent
+        # puts race the bytes read-modify-write and double-run capacity
+        # enforcement. Loop-side reads (has/match_extension/_bytes) stay
+        # lock-free — single-op dict/int reads are GIL-atomic.
+        self._mutate = threading.Lock()
+        # the serving event loop, captured at construction / the first
+        # loop-side call (offer): the ownership hooks (on_stored/
+        # on_removed → KV event publisher) are loop-bound, but put/get/
+        # refresh run on executor threads — _emit marshals hook calls
+        # back onto the loop
+        try:
+            self._loop: Optional[asyncio.AbstractEventLoop] = (
+                asyncio.get_running_loop()
+            )
+        except RuntimeError:
+            self._loop = None
+        # spill writes in flight (offer); held so close() can drain them
+        # and a failed write is logged instead of vanishing
+        self._writes: set = set()
+        if registry is None:
+            from ..telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._hits = registry.counter(
+            "dynamo_kv_fabric_cold_tier_hits_total",
+            "Cold-tier block reads that verified and rehydrated",
+        )
+        self._misses = registry.counter(
+            "dynamo_kv_fabric_cold_tier_misses_total",
+            "Cold-tier block reads that failed, labelled reason="
+            "absent|corrupt (corrupt files are quarantined, never "
+            "installed)",
+        )
+        self._evictions = registry.counter(
+            "dynamo_kv_fabric_cold_tier_evictions_total",
+            "Cold-tier block files evicted by the capacity bound "
+            "(oldest access first)",
+        )
+        registry.callback_gauge(
+            "dynamo_kv_fabric_cold_tier_bytes",
+            "Payload bytes resident in this process's cold-tier index",
+            lambda: float(self._bytes),
+        )
+
+    # ---------- sync index surface (scheduler planning path) ----------
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def has(self, sequence_hash: int) -> bool:
+        return sequence_hash in self._index
+
+    def match_extension(self, hashes: Sequence[int], start: int) -> List[int]:
+        """Longest index-resident run of ``hashes`` starting at ``start``
+        (same contract as KvHostTier.match_extension)."""
+        out: List[int] = []
+        for h in hashes[start:]:
+            if h not in self._index:
+                break
+            out.append(h)
+        return out
+
+    # ---------- executor-side I/O ----------
+
+    def refresh(self) -> int:
+        """Rescan the directory into the index (sync; executor-bound).
+
+        The respawn-warm path: a fresh worker opening a populated shared
+        directory learns every resident prefix here — and ADVERTISES the
+        delta through the ownership hooks, so routers and peer fabrics
+        score the rehydratable inventory (without this, a respawned
+        worker's cold tier is invisible to the cluster). Returns the
+        number of indexed blocks."""
+        found = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            logger.exception("cold tier dir unreadable: %s", self.dir)
+            names = []
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            try:
+                h = int(name[: -len(_SUFFIX)], 16)
+                path = os.path.join(self.dir, name)
+                found.append((os.path.getmtime(path), h,
+                              os.path.getsize(path)))
+            except (ValueError, OSError):
+                continue  # foreign file; the read path would reject it too
+        found.sort()  # oldest-access first = front of the LRU order
+        index = OrderedDict((h, size) for _m, h, size in found)
+        with self._mutate:
+            prev = set(self._index)
+            # keep entries this process wrote while the scan ran (a
+            # put() landing between listdir and here must not be
+            # dropped-and-retracted); entries whose files truly
+            # vanished self-correct on read (FileNotFoundError → miss
+            # + removal event)
+            for h, size in self._index.items():
+                if h not in index:
+                    index[h] = size
+            self._index = index
+            self._bytes = sum(index.values())
+        added = [int(h) for h in index if h not in prev]
+        if added:
+            self._emit(self.on_stored, added, None)
+        return len(index)
+
+    def put(self, sequence_hash: int, k: np.ndarray, v: np.ndarray,
+            parent_hash: Optional[int] = None) -> None:
+        """Write one block atomically (sync; executor-bound)."""
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        k_raw, v_raw = k.tobytes(), v.tobytes()
+        header = msgpack.packb({
+            "magic": _MAGIC,
+            "sequence_hash": int(sequence_hash),
+            "parent_hash": None if parent_hash is None else int(parent_hash),
+            "shape": list(k.shape),
+            "dtype": k.dtype.name,
+            "k_bytes": len(k_raw),
+            "v_bytes": len(v_raw),
+            "checksum": _checksum(k_raw, v_raw),
+        }, use_bin_type=True)
+        path = os.path.join(self.dir, _fname(sequence_hash))
+        # file I/O OUTSIDE the lock (a spill write on a shared mount can
+        # take tens of ms — the rehydrate path's LRU touch must not
+        # queue behind it); the tmp name is thread-unique because
+        # concurrent executor threads may spill concurrently. A same-
+        # hash race is benign: content addressing makes both payloads
+        # identical, and the accounting below is serialized.
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack(">I", len(header)))
+            f.write(header)
+            f.write(k_raw)
+            f.write(v_raw)
+        os.replace(tmp, path)  # atomic: readers see whole files or none
+        with self._mutate:
+            size = len(k_raw) + len(v_raw)
+            self._bytes += size - (self._index.get(sequence_hash) or 0)
+            self._index[sequence_hash] = size
+            self._index.move_to_end(sequence_hash)  # newest = LRU back
+            self._emit(self.on_stored, [int(sequence_hash)], parent_hash)
+            self._enforce_capacity()
+
+    def get(self, sequence_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Read + verify one block (sync; executor-bound).
+
+        Any verification failure is a miss: corrupt/truncated files are
+        quarantined (deleted) and counted, NEVER installed."""
+        path = os.path.join(self.dir, _fname(sequence_hash))
+        try:
+            with open(path, "rb") as f:
+                raw_len = f.read(4)
+                if len(raw_len) < 4:
+                    raise ValueError("truncated header length")
+                (hlen,) = struct.unpack(">I", raw_len)
+                if hlen > _MAX_HEADER:
+                    raise ValueError(f"header too large: {hlen}")
+                header = msgpack.unpackb(f.read(hlen), raw=False)
+                if (header.get("magic") != _MAGIC
+                        or int(header.get("sequence_hash", -1))
+                        != int(sequence_hash)):
+                    raise ValueError("magic/hash mismatch")
+                k_raw = f.read(header["k_bytes"])
+                v_raw = f.read(header["v_bytes"])
+                if (len(k_raw) != header["k_bytes"]
+                        or len(v_raw) != header["v_bytes"]):
+                    raise ValueError("truncated payload")
+                if _checksum(k_raw, v_raw) != header["checksum"]:
+                    raise ValueError("checksum mismatch")
+                from ..disagg.transfer import _np_dtype
+
+                shape = tuple(header["shape"])
+                dtype = _np_dtype(header["dtype"])
+                k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
+                v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
+        except FileNotFoundError:
+            # another worker sharing the directory evicted it: retract
+            # the ownership advertisement too, or routers keep discount-
+            # routing toward a hit that always misses
+            with self._mutate:
+                self._forget(sequence_hash)
+            self._emit(self.on_removed, [int(sequence_hash)])
+            self._misses.inc(reason="absent")
+            return None
+        except (ValueError, KeyError, TypeError, OSError,
+                msgpack.exceptions.UnpackException) as e:
+            logger.warning(
+                "cold tier: quarantining corrupt block %s: %s",
+                _fname(sequence_hash), e,
+            )
+            with self._mutate:
+                self._drop(sequence_hash)
+            self._misses.inc(reason="corrupt")
+            return None
+        with self._mutate:
+            if sequence_hash in self._index:
+                self._index.move_to_end(sequence_hash)  # LRU touch
+        try:
+            # mtime touch too: other workers sharing the directory (and
+            # this process's next refresh) see the access order
+            os.utime(path)
+        except OSError:
+            pass  # dynlint: allow(silent-except) - best-effort LRU stamp; eviction order degrades gracefully
+        self._hits.inc()
+        return k, v
+
+    # ---------- host-tier eviction hook (loop-side) ----------
+
+    def offer(self, sequence_hash: int, k: np.ndarray, v: np.ndarray,
+              parent_hash: Optional[int] = None) -> None:
+        """Spill one host-tier-evicted block.
+
+        Called from the host tier's drain() on the event loop: the write
+        rides the executor and the future is held (logged on failure,
+        drained by close()). Without a running loop (sync unit tests,
+        offline tools) the write happens inline."""
+        if sequence_hash in self._index:
+            return
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.put(sequence_hash, k, v, parent_hash)
+            return
+        self._loop = loop
+        fut = loop.run_in_executor(
+            None, self.put, sequence_hash, k, v, parent_hash
+        )
+        self._writes.add(fut)
+
+        def _done(f) -> None:
+            self._writes.discard(f)
+            if not f.cancelled() and f.exception() is not None:
+                logger.warning("cold tier spill failed: %s", f.exception())
+
+        fut.add_done_callback(_done)
+
+    async def close(self) -> None:
+        """Drain in-flight spill writes."""
+        writes = list(self._writes)
+        if writes:
+            await asyncio.gather(*writes, return_exceptions=True)
+
+    # ---------- internals ----------
+
+    def _emit(self, fn, *args) -> None:
+        """Run an ownership hook (on_stored/on_removed) on the serving
+        loop. put/get/_drop execute on executor threads, but the hooks
+        feed loop-bound machinery (the KV event publisher's queue);
+        loop-side and loopless (sync tests, offline tools) callers
+        invoke directly."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            loop = self._loop
+            if loop is not None and loop.is_running():
+                loop.call_soon_threadsafe(fn, *args)
+                return
+        fn(*args)
+
+    def _forget(self, sequence_hash: int) -> None:
+        # caller holds _mutate
+        size = self._index.pop(sequence_hash, None)
+        if size:
+            self._bytes -= size
+
+    def _drop(self, sequence_hash: int) -> None:
+        # caller holds _mutate (threading.Lock is not reentrant)
+        self._forget(sequence_hash)
+        try:
+            os.unlink(os.path.join(self.dir, _fname(sequence_hash)))
+        except OSError:
+            pass  # dynlint: allow(silent-except) - another worker may have evicted the same file first
+        self._emit(self.on_removed, [int(sequence_hash)])
+
+    def _enforce_capacity(self) -> None:
+        # caller holds _mutate. O(evicted), not O(capacity): the index
+        # keeps access order in memory, so the victim is the front —
+        # no per-put directory rescan (each stat can be a network round
+        # trip on the shared/object-store mounts this tier targets)
+        while len(self._index) > self.capacity_blocks:
+            self._drop(next(iter(self._index)))
+            self._evictions.inc()
+
+    def metrics(self) -> dict:
+        return {
+            "cold_kv_blocks": len(self._index),
+            "cold_kv_capacity": self.capacity_blocks,
+            "cold_kv_bytes": self._bytes,
+        }

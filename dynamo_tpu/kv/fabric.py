@@ -1,0 +1,523 @@
+"""Cluster KV fabric: cross-worker prefix pull + cold-tier rehydration.
+
+Per-process prefix caching becomes a datacenter-wide cache: when the
+ownership view says another worker already computed a longer prefix of
+this prompt than any local tier holds, the scheduler PULLS those
+committed KV blocks over the transfer plane (a read-only cousin of the
+migration plane's reserve→install) instead of recomputing them; when
+the cold tier (kv/cold_tier.py) holds the extension, the pull reads
+checksummed spill files instead of the wire. Either way the un-matched
+tail still prefills locally, and any failure — peer dead, timeout,
+checksum miss, chaos injection — falls back to local recompute with a
+byte-identical stream (the fallback never registered anything, so the
+allocator state is exactly the no-fabric state).
+
+Components:
+
+- ``KvFabric`` — one per engine. Owns the *ownership view* (a
+  ``KvIndexer`` fed with other workers' KV events — the same event
+  stream the KV router indexes), the peer descriptor map, the cold
+  tier, and the pull client/server halves.
+- The serve half plugs into ``KvTransferServer(pull_source=...)``
+  (disagg/transfer.py): a peer's ``pull`` frame resolves the longest
+  locally-resident run of the requested hash chain (HBM blocks pinned
+  for the duration, host-tier entries read from RAM) and streams it
+  back chunk-by-chunk — gathers dispatch on the loop (they must
+  serialize with the engine's own step programs), host syncs and byte
+  packing ride the executor, mirroring the streamed-prefill discipline.
+- The pull half (``KvFabric.pull``) scatters arriving frames into
+  blocks the scheduler reserved, overlapping the device copy of frame
+  i with the network read of frame i+1.
+
+Fault sites: ``transfer_conn_drop`` (the serving side dies mid-stream)
+and ``prefix_pull_stall`` (the pulling side stalls until the
+scheduler's deadline cancels it) — both must end in the byte-identical
+local fallback with zero leaked blocks (tests/test_kv_fabric.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from ..telemetry.flight import flight_recorder
+from ..utils import faults
+
+logger = logging.getLogger(__name__)
+
+# blocks per pull frame: bounds both sides' host buffers the same way
+# the streamed-prefill and migration planes bound theirs
+PULL_CHUNK_BLOCKS = 16
+
+
+def fabric_key(namespace: str, component: str, engine_id: str) -> str:
+    """Discovery-plane key a worker's pull server registers under
+    (lease-scoped, like the KV transfer and migration descriptors)."""
+    return f"{namespace}/components/{component}/kv_fabric/{engine_id}"
+
+
+@dataclass
+class PullPlan:
+    """One planned prefix pull: the hash run to fetch and its source."""
+
+    source: str                      # "peer" | "cold"
+    hashes: List[int]                # sequence hashes, a run of the chain
+    start_block: int                 # chain index of hashes[0]
+    worker_id: Optional[str] = None  # peer pulls: the owning worker
+    host: Optional[str] = None
+    port: Optional[int] = None
+
+    @property
+    def blocks(self) -> int:
+        return len(self.hashes)
+
+
+@dataclass
+class _GrantEntry:
+    sequence_hash: int
+    kind: str                        # "hbm" | "host"
+    block_id: Optional[int] = None   # hbm
+    arrays: Optional[Tuple[np.ndarray, np.ndarray]] = None  # host
+
+
+class PullGrant:
+    """Server-side lease over the blocks one pull serves.
+
+    HBM blocks are pinned at resolution (the allocator will neither
+    evict nor reuse them mid-gather); ``release`` unpins — it MUST run
+    exactly once, connection death included (the transfer server's
+    ``finally`` owns that).
+    """
+
+    def __init__(self, fabric: "KvFabric", entries: List[_GrantEntry]):
+        self._fabric = fabric
+        self.entries = entries
+        self._released = False
+
+    @property
+    def hashes(self) -> List[int]:
+        return [e.sequence_hash for e in self.entries]
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    async def gather_frame(self, lo: int, hi: int):
+        """Materialize entries [lo, hi) as one wire frame:
+        ``(k_bytes, v_bytes, shape, dtype_name)`` over [L, n, bs, KVH, D].
+
+        The device gather dispatches on the loop (it must serialize with
+        the engine's own step dispatches over the shared cache buffers);
+        the host sync, segment assembly, and byte packing ride the
+        executor — the streamed-prefill pump's discipline.
+        """
+        chunk = self.entries[lo:hi]
+        hbm_ids = [e.block_id for e in chunk if e.kind == "hbm"]
+        runner = self._fabric.runner
+        k_dev = v_dev = None
+        if hbm_ids:
+            k_dev, v_dev = runner.gather_blocks_device(hbm_ids)
+
+        def _assemble():
+            hbm_k = hbm_v = None
+            if hbm_ids:
+                hbm_k, hbm_v = runner.blocks_to_host(k_dev, v_dev)
+            ks, vs, j = [], [], 0
+            for e in chunk:
+                if e.kind == "hbm":
+                    ks.append(hbm_k[:, j:j + 1])
+                    vs.append(hbm_v[:, j:j + 1])
+                    j += 1
+                else:
+                    ks.append(e.arrays[0])
+                    vs.append(e.arrays[1])
+            k = np.ascontiguousarray(np.concatenate(ks, axis=1))
+            v = np.ascontiguousarray(np.concatenate(vs, axis=1))
+            return k.tobytes(), v.tobytes(), list(k.shape), k.dtype.name
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, _assemble)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        hbm = [e.block_id for e in self.entries if e.kind == "hbm"]
+        if hbm:
+            self._fabric.allocator.unpin_blocks(hbm)
+
+
+class KvFabric:
+    """One per engine: ownership view + cold tier + pull client/server."""
+
+    def __init__(
+        self,
+        runner,
+        allocator,
+        engine_id: str,
+        block_size: int = 16,
+        cold=None,                   # Optional[KvColdTier]
+        peers: Optional[Callable[[], Dict[str, dict]]] = None,
+        peer_pull: bool = True,
+        min_pull_blocks: int = 1,
+        pull_timeout_s: float = 30.0,
+        chunk_blocks: int = PULL_CHUNK_BLOCKS,
+        registry=None,
+        flight=None,
+    ):
+        from ..kv_router.indexer import KvIndexer
+
+        self.runner = runner
+        self.allocator = allocator
+        self.engine_id = engine_id
+        self.block_size = block_size
+        self.cold = cold
+        # worker_id → {"host", "port"} descriptors of peer pull servers
+        self.peers = peers or (lambda: {})
+        # the cross-worker half is opt-in (--prefix-pull): a cold-tier-
+        # only configuration plans cold rehydrates but never reaches
+        # over the network (and the CLI wiring starts no pull server)
+        self.peer_pull = peer_pull
+        self.min_pull_blocks = max(1, min_pull_blocks)
+        self.pull_timeout_s = pull_timeout_s
+        self.chunk_blocks = max(1, chunk_blocks)
+        self.flight = flight if flight is not None else flight_recorder()
+        # the ownership view: remote workers' KV events, same stream the
+        # router indexes (events for THIS engine are skipped — local
+        # tiers already answer faster than any pull)
+        self.indexer = KvIndexer(block_size)
+        self.server = None           # KvTransferServer started by serve()
+        # wiring-owned background tasks (event feed, peer refresh) held
+        # here so close() cancels them — never fire-and-forget
+        self._tasks: List[asyncio.Task] = []
+        if registry is None:
+            from ..telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        if cold is not None and cold.registry is not registry:
+            registry.attach(cold.registry)
+        self._pulls = registry.counter(
+            "dynamo_kv_fabric_prefix_pull_total",
+            "Prefix pulls, labelled source=peer|cold and "
+            "outcome=committed|failed|empty (failed/empty fall back to "
+            "local recompute, byte-identically)",
+        )
+        self._pull_bytes = registry.counter(
+            "dynamo_kv_fabric_prefix_pull_bytes_total",
+            "KV payload bytes installed by committed prefix pulls",
+        )
+        self._pull_hist = registry.histogram(
+            "dynamo_kv_fabric_prefix_pull_duration_seconds",
+            "One prefix pull end to end: plan dispatch → last block "
+            "scattered (failed pulls observe too — the fallback's cost "
+            "starts where this ends)",
+        )
+
+    # ---------- ownership view ----------
+
+    def apply_event(self, event) -> None:
+        """Feed one RouterEvent (kv_router/protocols.py) into the
+        ownership view. Events from this engine are ignored."""
+        if event.worker_id == self.engine_id:
+            return
+        self.indexer.apply_event(event)
+
+    def remove_worker(self, worker_id: str) -> None:
+        self.indexer.remove_worker(worker_id)
+
+    # ---------- planning (sync; scheduler admission path) ----------
+
+    def may_hold_any(self) -> bool:
+        """Cheap admission gate: is there ANY ownership to plan
+        against? The scheduler loop runs every ~1 ms — with an empty
+        peer view and an empty cold index (the common single-worker
+        case) the per-request probe/plan work must cost nothing."""
+        return ((self.peer_pull and len(self.indexer.tree) > 0)
+                or (self.cold is not None and len(self.cold) > 0))
+
+    def plan(self, hashes: List[int], local_blocks: int,
+             prompt_len: int) -> Optional[PullPlan]:
+        """Best pull extending a ``local_blocks``-block local hit.
+
+        At least one prompt token must stay un-cached (the engine needs
+        logits to sample from), so the pull run is capped at
+        ``(prompt_len - 1) // block_size`` total cached blocks. Returns
+        None when no source beats the local tiers by
+        ``min_pull_blocks``.
+        """
+        max_cached = max(0, (prompt_len - 1) // self.block_size)
+        budget = max_cached - local_blocks
+        if budget < self.min_pull_blocks:
+            return None
+        cold_run: List[int] = []
+        if self.cold is not None:
+            cold_run = self.cold.match_extension(hashes, local_blocks)[:budget]
+        peer_plan = (self._best_peer_run(hashes, local_blocks, budget)
+                     if self.peer_pull else None)
+        # longer run wins; ties go to the cold tier (local disk beats a
+        # network round trip at equal coverage)
+        if (len(cold_run) >= self.min_pull_blocks
+                and (peer_plan is None
+                     or len(cold_run) >= peer_plan.blocks)):
+            return PullPlan(
+                source="cold",
+                hashes=list(cold_run),
+                start_block=local_blocks,
+            )
+        return peer_plan
+
+    def _best_peer_run(self, hashes: List[int], local_blocks: int,
+                       budget: int) -> Optional[PullPlan]:
+        if len(self.indexer.tree) == 0:
+            return None
+        overlap = self.indexer.find_matches(hashes)
+        peers = self.peers() or {}
+        best: Optional[Tuple[int, str]] = None
+        for wid, score in overlap.scores.items():
+            if wid == self.engine_id or wid not in peers:
+                continue
+            run = min(score, local_blocks + budget) - local_blocks
+            if run < self.min_pull_blocks:
+                continue
+            if best is None or run > best[0]:
+                best = (run, wid)
+        if best is None:
+            return None
+        run, wid = best
+        desc = peers[wid]
+        return PullPlan(
+            source="peer",
+            hashes=list(hashes[local_blocks:local_blocks + run]),
+            start_block=local_blocks,
+            worker_id=wid,
+            host=desc.get("host"),
+            port=desc.get("port"),
+        )
+
+    def rank_peers(self, peers: List[dict],
+                   token_ids: List[int]) -> List[dict]:
+        """Order peer descriptors by prefix overlap with ``token_ids``
+        (descending; ties keep the input order) — the router-quality
+        selection the recovery controller uses for migration targets.
+
+        The ownership view is keyed by KV-event worker ids, which are a
+        different namespace than the migration plane's engine ids — the
+        descriptor's ``worker_id`` (stamped by the CLI wiring) is the
+        join key; a descriptor without one scores 0."""
+        from ..tokens import compute_block_hashes
+
+        if not peers or len(self.indexer.tree) == 0:
+            return list(peers)
+        overlap = self.indexer.find_matches(
+            compute_block_hashes(token_ids, self.block_size)
+        )
+        return sorted(
+            peers,
+            key=lambda p: -overlap.scores.get(
+                p.get("worker_id") or p.get("engine_id", ""), 0),
+        )
+
+    # ---------- serve half (KvTransferServer pull_source) ----------
+
+    def grant(self, hashes: List[int]) -> Optional[PullGrant]:
+        """Resolve + pin the longest locally-resident run of ``hashes``.
+
+        HBM blocks (allocator.by_hash) are pinned; host-tier entries are
+        copied out of RAM by reference. Staged (not-yet-drained) host
+        offloads are skipped — serving them would need a loop-side
+        drain. Returns None when not even the first hash is resident.
+        """
+        entries: List[_GrantEntry] = []
+        pinned: List[int] = []
+        tier2 = self.allocator.tier2
+        for h in hashes:
+            bid = self.allocator.by_hash.get(h)
+            if bid is not None:
+                entries.append(_GrantEntry(h, "hbm", block_id=bid))
+                pinned.append(bid)
+                continue
+            arrays = tier2.store.get(h) if tier2 is not None else None
+            if arrays is not None:
+                entries.append(_GrantEntry(h, "host", arrays=arrays))
+                continue
+            break
+        if not entries:
+            return None
+        if pinned:
+            self.allocator.pin_blocks(pinned)
+        return PullGrant(self, entries)
+
+    async def serve(self, host: str = "127.0.0.1"):
+        """Start this fabric's pull server (a read-only KvTransferServer)
+        and return it; its descriptor registers in discovery under
+        ``fabric_key``."""
+        from ..disagg.transfer import KvTransferServer
+
+        self.server = await KvTransferServer(
+            scatter=lambda *a: None,
+            on_commit=lambda *a: None,
+            pull_source=self.grant,
+            host=host,
+        ).start()
+        return self.server
+
+    def hold_task(self, task: asyncio.Task) -> None:
+        """Adopt a wiring-layer task (event consumer, peer refresh) into
+        this fabric's lifecycle."""
+        self._tasks.append(task)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        if self.server is not None:
+            await self.server.close()
+        if self.cold is not None:
+            await self.cold.close()
+
+    # ---------- pull half (scheduler-owned task) ----------
+
+    async def pull(self, plan: PullPlan, block_ids: List[int],
+                   request_id: str = "", trace_id: Optional[str] = None,
+                   ) -> int:
+        """Execute one pull into reserved ``block_ids``.
+
+        Returns the number of blocks actually installed — always a
+        PREFIX of ``plan.hashes`` (the caller registers exactly that
+        run and recomputes the rest). Raises on transport failure; the
+        caller falls back to local recompute and frees the reservation.
+        Nothing here registers blocks: a partially-scattered reservation
+        is anonymous and dies with the fallback's free.
+        """
+        assert len(block_ids) >= len(plan.hashes)
+        t0 = time.monotonic()
+        outcome = "failed"
+        served = 0
+        try:
+            if plan.source == "cold":
+                served = await self._pull_cold(plan, block_ids)
+            else:
+                served = await self._pull_peer(plan, block_ids, trace_id)
+            outcome = "committed" if served else "empty"
+            return served
+        finally:
+            self._pulls.inc(source=plan.source, outcome=outcome)
+            self._pull_hist.observe(time.monotonic() - t0)
+            self.flight.record(
+                "kv_fabric.pull", request_id=request_id, trace_id=trace_id,
+                source=plan.source, worker=plan.worker_id,
+                asked=plan.blocks, served=served, outcome=outcome,
+            )
+
+    async def _maybe_stall(self) -> None:
+        # chaos site: the pull stalls mid-flight; the scheduler's
+        # deadline must cancel it and fall back byte-identically
+        if faults.fire("prefix_pull_stall"):
+            await asyncio.sleep(3600.0)
+
+    async def _pull_cold(self, plan: PullPlan,
+                         block_ids: List[int]) -> int:
+        loop = asyncio.get_running_loop()
+        served = 0
+        for lo in range(0, len(plan.hashes), self.chunk_blocks):
+            await self._maybe_stall()
+            chunk = plan.hashes[lo:lo + self.chunk_blocks]
+
+            def _read(chunk=chunk):
+                ks, vs = [], []
+                for h in chunk:
+                    got = self.cold.get(h)
+                    if got is None:
+                        break  # absent/corrupt → the run ends here
+                    ks.append(got[0])
+                    vs.append(got[1])
+                if not ks:
+                    return None
+                k = np.ascontiguousarray(np.concatenate(ks, axis=1))
+                v = np.ascontiguousarray(np.concatenate(vs, axis=1))
+                import jax
+
+                return jax.device_put(k), jax.device_put(v), len(ks)
+
+            staged = await loop.run_in_executor(None, _read)
+            if staged is None:
+                break
+            k_dev, v_dev, n = staged
+            # cache-mutating scatter on the loop: serializes with the
+            # scheduler's own dispatches over the shared cache buffers
+            self.runner.scatter_blocks(
+                block_ids[served:served + n], k_dev, v_dev
+            )
+            self._pull_bytes.inc(k_dev.nbytes + v_dev.nbytes)
+            served += n
+            if n < len(chunk):
+                break
+        return served
+
+    async def _pull_peer(self, plan: PullPlan, block_ids: List[int],
+                         trace_id: Optional[str]) -> int:
+        from ..disagg.transfer import MAX_HEADER, _np_dtype, _read_exact
+
+        loop = asyncio.get_running_loop()
+        reader, writer = await asyncio.open_connection(plan.host, plan.port)
+        try:
+            header = msgpack.packb({
+                "type": "pull",
+                "hashes": [int(h) for h in plan.hashes],
+                "chunk_blocks": self.chunk_blocks,
+                "trace_id": trace_id,
+            }, use_bin_type=True)
+            writer.write(struct.pack(">I", len(header)) + header)
+            await writer.drain()
+            served = 0
+            while True:
+                await self._maybe_stall()
+                (hlen,) = struct.unpack(">I", await _read_exact(reader, 4))
+                if hlen > MAX_HEADER:
+                    raise ValueError(f"pull header too large: {hlen}")
+                frame = msgpack.unpackb(
+                    await _read_exact(reader, hlen), raw=False
+                )
+                ftype = frame.get("type")
+                if ftype == "pull_blocks":
+                    k_raw = await _read_exact(reader, frame["k_bytes"])
+                    v_raw = await _read_exact(reader, frame["v_bytes"])
+                    dtype = _np_dtype(frame["dtype"])
+                    shape = tuple(frame["shape"])
+                    n = shape[1]
+                    if served + n > len(plan.hashes):
+                        raise ValueError("peer served past the asked run")
+                    k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
+                    v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
+                    # stage the H2D copy off-loop; scatter on the loop
+                    # (coordinator._scatter's discipline) — the next
+                    # frame's network read overlaps this device copy
+                    k_dev, v_dev = await loop.run_in_executor(
+                        None, self._device_put, k, v
+                    )
+                    self.runner.scatter_blocks(
+                        block_ids[served:served + n], k_dev, v_dev
+                    )
+                    self._pull_bytes.inc(len(k_raw) + len(v_raw))
+                    served += n
+                elif ftype == "pull_end":
+                    return min(served, int(frame.get("served", served)))
+                else:
+                    raise ValueError(f"unknown pull frame {ftype!r}")
+        finally:
+            writer.close()
+
+    @staticmethod
+    def _device_put(k: np.ndarray, v: np.ndarray):
+        import jax
+
+        return jax.device_put(k), jax.device_put(v)

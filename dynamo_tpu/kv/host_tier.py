@@ -48,10 +48,16 @@ class KvHostTier:
         gather_fn: Callable[[Sequence[int]], Tuple[np.ndarray, np.ndarray]],
         scatter_fn: Callable[[Sequence[int], np.ndarray, np.ndarray], None],
         capacity_blocks: int,
+        on_evict: Optional[Callable[[int, np.ndarray, np.ndarray], None]] = None,
     ):
         self.gather_fn = gather_fn
         self.scatter_fn = scatter_fn
         self.capacity_blocks = capacity_blocks
+        # capacity-eviction hook (the cold tier's spill entry,
+        # kv/cold_tier.py KvColdTier.offer): called with
+        # (sequence_hash, k, v) at the moment an entry leaves host RAM —
+        # the last chance to keep the prefix rehydratable anywhere
+        self.on_evict = on_evict
         # sequence_hash → (k [L,1,bs,KVH,D], v) host arrays; LRU order
         self.store: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
         # dispatched-but-unmaterialized gathers: (hashes, k_arr, v_arr)
@@ -121,8 +127,13 @@ class KvHostTier:
                     np.ascontiguousarray(v[:, i : i + 1]),
                 )
         while len(self.store) > self.capacity_blocks:
-            self.store.popitem(last=False)
+            h, (ek, ev) = self.store.popitem(last=False)
             self.evicted_total += 1
+            if self.on_evict is not None:
+                # spill to the cold tier BEFORE the arrays go away —
+                # the hook is loop-safe (the cold tier's write rides
+                # the executor; these host arrays are immutable)
+                self.on_evict(h, ek, ev)
 
     def restore(self, hashes: Sequence[int], block_ids: Sequence[int]) -> None:
         """Write host-resident blocks back into freshly allocated HBM slots."""

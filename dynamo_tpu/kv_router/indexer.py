@@ -30,10 +30,17 @@ class OverlapScores:
     scores: Dict[str, int] = dataclasses.field(default_factory=dict)
     # block hash → how many workers hold it (frequency info for policies)
     frequencies: List[int] = dataclasses.field(default_factory=list)
+    # worker → ADDITIONAL consecutive blocks past its warm run that the
+    # worker can rehydrate from its cold tier (kv/cold_tier.py spill
+    # advertisements, RouterEvent tier="cold"); scored discounted vs a
+    # warm hit by KvScheduler.cold_discount
+    cold_scores: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def merge(self, other: "OverlapScores") -> None:
         for w, s in other.scores.items():
             self.scores[w] = max(self.scores.get(w, 0), s)
+        for w, s in other.cold_scores.items():
+            self.cold_scores[w] = max(self.cold_scores.get(w, 0), s)
         # frequencies are per-depth holder counts — sum element-wise
         if len(other.frequencies) > len(self.frequencies):
             self.frequencies.extend([0] * (len(other.frequencies) - len(self.frequencies)))
@@ -233,14 +240,57 @@ class KvIndexer:
         self.tree = _make_tree(expiration_s, use_native)
         self.events_applied = 0
         self.worker_ids: set = set()  # every worker ever seen in events
+        # cold-tier ownership (RouterEvent tier="cold"), kept BESIDE the
+        # warm tree (both tree implementations stay tier-blind): hash →
+        # workers that can rehydrate the block from their cold tier
+        self._cold: Dict[int, Set[str]] = {}
 
     def apply_event(self, event: RouterEvent) -> None:
-        self.tree.apply_event(event)
+        if getattr(event, "tier", "hbm") == "cold":
+            self._apply_cold(event)
+        else:
+            self.tree.apply_event(event)
         self.worker_ids.add(event.worker_id)
         self.events_applied += 1
 
+    def _apply_cold(self, event: RouterEvent) -> None:
+        wid = event.worker_id
+        if event.stored is not None:
+            for h in event.stored.block_hashes:
+                self._cold.setdefault(h, set()).add(wid)
+        if event.removed is not None:
+            for h in event.removed.block_hashes:
+                holders = self._cold.get(h)
+                if holders is not None:
+                    holders.discard(wid)
+                    if not holders:
+                        del self._cold[h]
+
     def find_matches(self, block_hashes: List[int]) -> OverlapScores:
-        return self.tree.find_matches(block_hashes)
+        out = self.tree.find_matches(block_hashes)
+        if self._cold:
+            self._extend_cold(out, block_hashes)
+        return out
+
+    def _extend_cold(self, out: OverlapScores,
+                     block_hashes: List[int]) -> None:
+        """Per-worker cold extension: how many consecutive blocks PAST a
+        worker's warm run it can still rehydrate from cold spill files.
+        Cold blocks also bridge from position 0 for workers with no warm
+        hit at all (the respawned-worker case)."""
+        candidates: Set[str] = set(out.scores)
+        for h in block_hashes:
+            holders = self._cold.get(h)
+            if holders:
+                candidates.update(holders)
+        for w in candidates:
+            warm = out.scores.get(w, 0)
+            i = warm
+            while i < len(block_hashes) and w in self._cold.get(
+                    block_hashes[i], ()):
+                i += 1
+            if i > warm:
+                out.cold_scores[w] = i - warm
 
     def find_matches_for_request(self, token_ids: List[int]) -> OverlapScores:
         from ..tokens import compute_block_hashes
@@ -250,6 +300,11 @@ class KvIndexer:
     def remove_worker(self, worker_id: str) -> None:
         self.tree.remove_worker(worker_id)
         self.worker_ids.discard(worker_id)
+        for h in list(self._cold):
+            holders = self._cold[h]
+            holders.discard(worker_id)
+            if not holders:
+                del self._cold[h]
 
 
 class ShardedKvIndexer:

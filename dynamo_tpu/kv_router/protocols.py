@@ -27,9 +27,16 @@ class RouterEvent:
     stored: Optional[KvCacheStored] = None
     removed: Optional[KvCacheRemoved] = None
     event_id: int = 0
+    # which tier holds the blocks: "hbm" (warm — the default, and the
+    # only value before the KV fabric) or "cold" (content-addressed
+    # spill files the worker can rehydrate; routers score it discounted
+    # vs a warm hit — kv_router/scheduler.py cold_discount)
+    tier: str = "hbm"
 
     def to_wire(self) -> dict:
         d: dict = {"worker_id": self.worker_id, "event_id": self.event_id}
+        if self.tier != "hbm":
+            d["tier"] = self.tier
         if self.stored is not None:
             d["stored"] = {
                 "block_hashes": self.stored.block_hashes,
@@ -55,6 +62,7 @@ class RouterEvent:
             if removed
             else None,
             event_id=d.get("event_id", 0),
+            tier=d.get("tier", "hbm"),
         )
 
 

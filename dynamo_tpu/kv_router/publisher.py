@@ -40,29 +40,40 @@ class KvEventPublisher:
             except Exception:
                 logger.exception("kv event publish failed")
 
-    def publish_stored(self, block_hashes: List[int], parent_hash: Optional[int]) -> None:
+    def publish_stored(self, block_hashes: List[int],
+                       parent_hash: Optional[int],
+                       tier: str = "hbm") -> None:
         self._queue.put_nowait(
             RouterEvent(
                 worker_id=self.worker_id,
                 stored=KvCacheStored(block_hashes=list(block_hashes), parent_hash=parent_hash),
                 event_id=next(self._ids),
+                tier=tier,
             )
         )
 
-    def publish_removed(self, block_hashes: List[int]) -> None:
+    def publish_removed(self, block_hashes: List[int],
+                        tier: str = "hbm") -> None:
         self._queue.put_nowait(
             RouterEvent(
                 worker_id=self.worker_id,
                 removed=KvCacheRemoved(block_hashes=list(block_hashes)),
                 event_id=next(self._ids),
+                tier=tier,
             )
         )
 
     def as_sink(self) -> KvEventSink:
-        """Adapter plugged into the engine's BlockAllocator."""
+        """Adapter plugged into the engine's BlockAllocator. The cold
+        hooks advertise cold-tier residency (kv/cold_tier.py spills and
+        evictions) so routers score rehydratable prefixes discounted."""
         return KvEventSink(
             on_stored=self.publish_stored,
             on_removed=self.publish_removed,
+            on_stored_cold=lambda hashes, parent: self.publish_stored(
+                hashes, parent, tier="cold"),
+            on_removed_cold=lambda hashes: self.publish_removed(
+                hashes, tier="cold"),
         )
 
     def stop(self) -> None:

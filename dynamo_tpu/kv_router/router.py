@@ -127,6 +127,14 @@ class KvRouter:
             worker=str(decision.worker_id),
             isl_blocks=-(-len(token_ids) // self.block_size),
             overlap_blocks=decision.matched_blocks,
+            cold_blocks=decision.cold_blocks,
+            # the pull hint: where the longest warm+cold prefix lives —
+            # when it differs from the chosen worker, the pick's cost
+            # was a fabric pull away from a full hit (the chosen
+            # worker's own ownership view drives the actual pull)
+            best_prefix_worker=(str(decision.best_prefix_worker)
+                                if decision.best_prefix_worker else None),
+            best_prefix_blocks=decision.best_prefix_blocks,
         )
         try:
             await self.component.namespace.publish_event(

@@ -56,9 +56,15 @@ class WorkerState:
 class KvScheduler:
     def __init__(self, block_size: int = 16, require_free_slot: bool = False,
                  staleness_bound_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 cold_discount: float = 0.5):
         self.block_size = block_size
         self.require_free_slot = require_free_slot
+        # cold-tier blocks (rehydratable spill files, kv/cold_tier.py)
+        # count toward a worker's overlap at this fraction of a warm
+        # block: a rehydrate pays disk + H2D instead of recompute, so it
+        # is worth routing toward — but never as much as hot KV
+        self.cold_discount = max(0.0, min(1.0, cold_discount))
         # snapshots older than this are not trusted by the cost function
         # (None/0 = off). A worker whose scrape stopped (wedged engine,
         # partitioned host) keeps its LAST load forever — typically a
@@ -141,8 +147,13 @@ class KvScheduler:
             ):
                 continue
             matched = overlap.scores.get(wid, 0)
+            cold = overlap.cold_scores.get(wid, 0)
+            # cold blocks count discounted: rehydration beats recompute
+            # but loses to hot KV at equal coverage
+            effective = matched + self.cold_discount * cold
             overlap_ratio = (
-                matched * self.block_size / isl_tokens if isl_tokens else 0.0
+                effective * self.block_size / isl_tokens
+                if isl_tokens else 0.0
             )
             logit = (
                 2.0 * overlap_ratio
@@ -158,16 +169,36 @@ class KvScheduler:
             raise AllWorkersBusy("all workers at slot capacity")
         chosen = random.choice(best)
         matched = overlap.scores.get(chosen, 0)
-        # predicted-state update (process_worker_selection analog)
+        # predicted-state update (process_worker_selection analog): cold
+        # blocks still allocate fresh HBM on rehydrate, so only the warm
+        # match reduces the predicted block demand
         state = self.workers[chosen]
         state.predicted_active += 1
         state.predicted_blocks += max(0, total_blocks_needed - matched)
         logger.debug("kv schedule: %s logit=%.3f matched=%d", chosen, best_logit, matched)
+        # the pull hint: the worker holding the LONGEST warm+cold prefix
+        # overall, even when load steered the request elsewhere — the
+        # chosen worker's fabric can pull the difference from it
+        # (kv/fabric.py) instead of recomputing
+        best_owner, best_owned, best_key = None, 0, (0.0, 0)
+        for wid in set(overlap.scores) | set(overlap.cold_scores):
+            warm_b = overlap.scores.get(wid, 0)
+            cold_b = overlap.cold_scores.get(wid, 0)
+            # rank with the same discount the cost function uses (a
+            # rehydrate is cheaper than recompute but dearer than hot
+            # KV); warm coverage breaks effective-score ties
+            key = (warm_b + self.cold_discount * cold_b, warm_b)
+            if key > best_key:
+                best_owner, best_owned = wid, warm_b + cold_b
+                best_key = key
         return SchedulingDecision(
             worker_id=chosen,
             matched_blocks=matched,
             prefix_hit_tokens=matched * self.block_size,
             isl_tokens=isl_tokens,
+            cold_blocks=overlap.cold_scores.get(chosen, 0),
+            best_prefix_worker=best_owner,
+            best_prefix_blocks=best_owned,
         )
 
 
@@ -177,6 +208,13 @@ class SchedulingDecision:
     matched_blocks: int
     prefix_hit_tokens: int
     isl_tokens: int
+    # cold-tier blocks the chosen worker can rehydrate (discount-scored)
+    cold_blocks: int = 0
+    # the pull hint: the worker holding the longest warm+cold prefix of
+    # this prompt, even if load routed the request elsewhere — the
+    # chosen worker's KV fabric pulls the difference from it
+    best_prefix_worker: Optional[str] = None
+    best_prefix_blocks: int = 0
 
     @property
     def overlap_ratio(self) -> float:

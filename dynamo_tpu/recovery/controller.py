@@ -78,6 +78,14 @@ class RecoveryController:
         config: Optional[RecoveryConfig] = None,
         registry: Optional[MetricsRegistry] = None,
         flight: Optional[FlightRecorder] = None,
+        # router-quality peer selection: (peers, token_ids) → peers
+        # reordered best-first. Wired to KvFabric.rank_peers when the
+        # worker runs a KV fabric, so a migration target is picked by
+        # prefix overlap (the same ownership view the router scores)
+        # instead of discovery order — the peer that already holds the
+        # request's prefix resumes it with the least recompute.
+        peer_ranker: Optional[Callable[[List[dict], List[int]],
+                                       List[dict]]] = None,
     ):
         self.engine_id = engine_id
         self.scheduler = scheduler
@@ -88,6 +96,7 @@ class RecoveryController:
         self.deregister = deregister
         self.register = register
         self.admission = admission
+        self.peer_ranker = peer_ranker
         self.config = config or RecoveryConfig()
         self.flight = flight if flight is not None else flight_recorder()
         self.registry = registry or MetricsRegistry()
@@ -269,7 +278,7 @@ class RecoveryController:
             allow_hot=allow_hot and self.runner is not None,
         )
         mode = "hot" if state.hot else "cold"
-        for peer in self._candidate_peers():
+        for peer in self._candidate_peers(er):
             try:
                 relay = await migrate_request(
                     peer["host"], peer["port"], er, state,
@@ -300,7 +309,7 @@ class RecoveryController:
         self._fail(er, "no healthy peer accepted the migration")
         return "failed"
 
-    def _candidate_peers(self) -> List[dict]:
+    def _candidate_peers(self, er=None) -> List[dict]:
         if self.peers is None:
             return []
         try:
@@ -308,9 +317,19 @@ class RecoveryController:
         except Exception:
             logger.exception("peer discovery failed")
             return []
-        return [
+        peers = [
             p for p in peers if p.get("engine_id") != self.engine_id
         ]
+        if self.peer_ranker is not None and er is not None and peers:
+            # router-quality selection: order by prefix overlap with
+            # this request so the peer that already holds its KV is
+            # tried first (ties keep discovery order)
+            try:
+                peers = list(self.peer_ranker(peers, list(er.prompt)))
+            except Exception:
+                logger.exception("peer ranking failed; keeping "
+                                 "discovery order")
+        return peers
 
     def _fail(self, er, msg: str) -> None:
         logger.error("failing in-flight request %s: %s", er.request_id, msg)

@@ -445,6 +445,30 @@ class FleetHub:
                 "waiting": hist.latest("dynamo_scheduler_waiting_requests"),
                 "roofline_fraction": hist.latest(
                     "dynamo_engine_roofline_fraction"),
+                # prefix-hit view, fabric-aware: the local two-tier hit
+                # ratio PLUS the datacenter-cache activity — committed
+                # remote pulls and cold-tier rehydrates count tokens the
+                # fleet never recomputed even though no local tier held
+                # them (None = the worker runs no fabric)
+                "prefix_hit_ratio": hist.latest(
+                    "dynamo_kv_prefix_hit_ratio"),
+                "prefix_pulls_per_s": (
+                    round(hist.rate(
+                        "dynamo_kv_fabric_prefix_pull_total",
+                        {"outcome": "committed"},
+                        window_s=slo_window_s), 3)
+                    if hist.latest(
+                        "dynamo_kv_fabric_prefix_pull_total") is not None
+                    else None
+                ),
+                "cold_hits_per_s": (
+                    round(hist.rate(
+                        "dynamo_kv_fabric_cold_tier_hits_total",
+                        window_s=slo_window_s), 3)
+                    if hist.latest(
+                        "dynamo_kv_fabric_cold_tier_hits_total")
+                    is not None else None
+                ),
                 "slo_attainment": (
                     attained / judged if judged else None
                 ),

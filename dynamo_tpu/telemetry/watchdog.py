@@ -311,12 +311,14 @@ class StallWatchdog:
         heartbeat = now if hb is None else float(hb)
         depth = int(snap.get("queue_depth") or 0)
         active = int(snap.get("active") or 0)
-        # remote-prefill waits carry their own deadline + local-fallback
-        # machinery, so they count toward "the loop must be alive"
-        # (decode_stall) but NOT toward "the loop must be dispatching"
-        # (no_throughput) — a slow-but-healthy prefill worker is not a
-        # starvation
-        remote = int(snap.get("pending_remote") or 0)
+        # remote-prefill and prefix-pull waits carry their own deadline
+        # + local-fallback machinery, so they count toward "the loop
+        # must be alive" (decode_stall — a wedged loop can't run either
+        # fallback) but NOT toward "the loop must be dispatching"
+        # (no_throughput) — a slow-but-healthy prefill worker or KV
+        # transfer is not a starvation
+        remote = (int(snap.get("pending_remote") or 0)
+                  + int(snap.get("pending_pull") or 0))
         steps = snap.get("steps")
 
         # no_throughput bookkeeping: when did `steps` last advance? The

@@ -21,7 +21,13 @@ Sites currently wired (each documented in docs/self_healing.md):
   Mosaic compile or a dead device, and the wedge the stall watchdog's
   ``decode_stall`` trip exists to catch.
 - ``transfer_conn_drop`` — a KV transfer / migration client connection
-  dies mid-stream, exercising the receiver's poison-the-commit path.
+  dies mid-stream (and, for the KV fabric, the pull-SERVING side dies
+  mid-serve), exercising the receiver's poison-the-commit path and the
+  puller's local-recompute fallback.
+- ``prefix_pull_stall`` — a cluster-KV-fabric prefix pull
+  (kv/fabric.py) stalls mid-flight instead of dying: the scheduler's
+  pull deadline must cancel it, fall back to local recompute with a
+  byte-identical stream, and leak zero blocks on either side.
 - ``child_exit`` — a supervised engine child (subprocess_host) exits
   hard mid-serve, exercising the respawn ladder.
 

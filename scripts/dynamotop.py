@@ -60,11 +60,17 @@ def _state(w: dict) -> str:
 def render_workers(workers: List[dict]) -> List[str]:
     lines = [
         f"{'WORKER':<26} {'ROLE':<14} {'STATE':<6} {'BUSY':>5} "
-        f"{'KV':>5} {'WAIT':>4} {'ROOF':>5} {'SLO':>5} {'TRIP':>4} "
-        f"{'REQ/S':>6} {'AGE':>5}"
+        f"{'KV':>5} {'WAIT':>4} {'ROOF':>5} {'HIT':>5} {'PULL':>5} "
+        f"{'SLO':>5} {'TRIP':>4} {'REQ/S':>6} {'AGE':>5}"
     ]
     for w in workers:
         age = w.get("scrape_age_s")
+        # fabric-aware prefix columns: HIT is the local two-tier hit
+        # ratio; PULL is committed prefix pulls per second (peer + cold
+        # sources — cold per-block hit rates stay in the hub JSON, they
+        # are a different unit)
+        pulls = w.get("prefix_pulls_per_s")
+        pull_s = f"{pulls:.1f}" if pulls is not None else "-"
         lines.append(
             f"{str(w.get('name', '?')):<26.26} "
             f"{str(w.get('role', '?')):<14.14} "
@@ -73,6 +79,8 @@ def render_workers(workers: List[dict]) -> List[str]:
             f"{_pct(w.get('kv_usage_ratio')):>5} "
             f"{_num(w.get('waiting')):>4} "
             f"{_pct(w.get('roofline_fraction')):>5} "
+            f"{_pct(w.get('prefix_hit_ratio')):>5} "
+            f"{pull_s:>5} "
             f"{_pct(w.get('slo_attainment')):>5} "
             f"{_num(w.get('watchdog_trips')):>4} "
             f"{w.get('requests_per_s') if w.get('requests_per_s') is not None else '     -':>6} "

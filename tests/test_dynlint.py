@@ -1173,3 +1173,47 @@ def test_task_leak_flags_discarded_capture_task_shape():
         "task-leak",
     )
     assert [f.rule for f in out] == ["task-leak"]
+
+
+# --------------------------------------------------------------------------
+# cluster KV fabric: spill I/O must ride the executor
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_kv_fabric_modules_pass_async_blocking_and_task_leak():
+    """The fabric's pull pump shares the scheduler's event loop and the
+    cold tier's spill writes fire from the host tier's drain (also
+    loop-side): a blocking file read/write or a dropped spill future
+    there stalls decode for every request. Pin both modules with ZERO
+    findings (not baseline-covered ones) on the two rules that police
+    exactly that — all disk I/O rides the executor with its future
+    held (kv/cold_tier.py offer/close discipline)."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "kv", "fabric.py"),
+        os.path.join(PACKAGE_ROOT, "kv", "cold_tier.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "KV fabric hot path regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_cold_spill_write_on_loop():
+    """TP fixture shaped like the tempting-but-wrong cold-tier spill:
+    writing the block file synchronously inside the async eviction hook
+    blocks the scheduler loop for a disk round-trip per evicted block."""
+    out = findings(
+        """
+        import os
+
+        async def on_evict(path, sequence_hash, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+    assert "open" in out[0].message

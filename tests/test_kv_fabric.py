@@ -1,0 +1,521 @@
+"""Cluster KV fabric: content-addressed cold tier, cross-worker prefix
+pull, chaos fallbacks, router cold scoring, and recovery peer ranking.
+
+The differential contract everywhere: a pulled/rehydrated prefix must
+produce a BYTE-IDENTICAL stream to a full local recompute, and every
+failure path (dead peer, mid-stream drop, stall past the deadline,
+corrupt spill file) must fall back to local recompute with zero leaked
+blocks on both sides.
+"""
+
+import asyncio
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.block_allocator import KvEventSink
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.kv import KvColdTier, KvHostTier
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheRemoved,
+    KvCacheStored,
+    RouterEvent,
+)
+from dynamo_tpu.kv_router.scheduler import KvScheduler
+from dynamo_tpu.models.loader import load_llama_params
+from dynamo_tpu.telemetry.flight import FlightRecorder
+from dynamo_tpu.tokens import compute_block_hashes
+from dynamo_tpu.utils import faults
+
+import jax.numpy as jnp
+
+from test_disagg import _collect, _greedy_request
+from test_jax_engine import hf_model_dir, hf_logits, TINY  # noqa: F401
+
+
+# ---------------------------------------------------------------- cold tier
+
+
+def _blk(seed, shape=(2, 1, 4, 2, 3)):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_cold_tier_roundtrip_is_content_addressed(tmp_path):
+    """Worker A writes, worker B (a fresh instance over the same dir —
+    the respawn/shared-mount case) rehydrates by sequence hash."""
+    a = KvColdTier(str(tmp_path), capacity_blocks=8)
+    k1, v1 = _blk(0), _blk(1)
+    k2, v2 = _blk(2), _blk(3)
+    a.put(101, k1, v1, parent_hash=None)
+    a.put(202, k2, v2, parent_hash=101)
+    assert a.has(101) and a.has(202)
+    assert a.match_extension([101, 202, 999], 0) == [101, 202]
+
+    primed = []
+    b = KvColdTier(str(tmp_path), capacity_blocks=8,
+                   on_stored=lambda hs, parent: primed.extend(hs))
+    assert not b.has(101)  # fresh index until primed
+    assert b.refresh() == 2
+    assert b.has(101) and b.has(202)
+    # the respawn-warm priming ADVERTISES the inventory (tier="cold"
+    # events) so routers/peers can score the rehydratable prefixes
+    assert sorted(primed) == [101, 202]
+    gk, gv = b.get(101)
+    np.testing.assert_array_equal(gk, k1)
+    np.testing.assert_array_equal(gv, v1)
+    gk2, _ = b.get(202)
+    np.testing.assert_array_equal(gk2, k2)
+
+
+def test_cold_tier_corrupt_and_truncated_are_misses(tmp_path):
+    """A failed verification is a MISS, never an install: corrupt files
+    are quarantined and counted."""
+    tier = KvColdTier(str(tmp_path), capacity_blocks=8)
+    tier.put(111, _blk(0), _blk(1))
+    tier.put(222, _blk(2), _blk(3))
+    tier.put(333, _blk(4), _blk(5))
+
+    # flip a payload byte → checksum mismatch
+    p1 = os.path.join(str(tmp_path), f"{111:016x}.kvb")
+    raw = bytearray(open(p1, "rb").read())
+    raw[-3] ^= 0xFF
+    open(p1, "wb").write(bytes(raw))
+    assert tier.get(111) is None
+    assert not os.path.exists(p1)  # quarantined
+    assert not tier.has(111)
+
+    # truncate mid-payload
+    p2 = os.path.join(str(tmp_path), f"{222:016x}.kvb")
+    raw = open(p2, "rb").read()
+    open(p2, "wb").write(raw[: len(raw) // 2])
+    assert tier.get(222) is None
+    assert not os.path.exists(p2)
+
+    # a renamed (mis-addressed) file must not serve under the new hash
+    p3 = os.path.join(str(tmp_path), f"{333:016x}.kvb")
+    p4 = os.path.join(str(tmp_path), f"{444:016x}.kvb")
+    os.rename(p3, p4)
+    fresh = KvColdTier(str(tmp_path), capacity_blocks=8)
+    fresh.refresh()
+    assert fresh.get(444) is None  # header hash mismatch → corrupt miss
+
+
+def test_cold_tier_capacity_evicts_oldest(tmp_path):
+    tier = KvColdTier(str(tmp_path), capacity_blocks=2)
+    for i, h in enumerate([11, 22, 33]):
+        tier.put(h, _blk(i), _blk(i + 10))
+        # distinct mtimes on coarse-granularity filesystems
+        os.utime(os.path.join(str(tmp_path), f"{h:016x}.kvb"),
+                 (1000 + i, 1000 + i))
+        tier._enforce_capacity()
+    assert not tier.has(11) and tier.has(22) and tier.has(33)
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), f"{11:016x}.kvb"))
+
+
+def test_host_tier_spills_to_cold_on_eviction(tmp_path):
+    """The host tier's capacity eviction is the cold tier's spill
+    source — and the spill announces cold ownership via the event
+    hooks."""
+    stored_cold = []
+    cold = KvColdTier(str(tmp_path), capacity_blocks=8,
+                      on_stored=lambda hs, parent: stored_cold.extend(hs))
+    data = {}
+
+    def gather(ids):
+        k = np.stack([data[i] for i in ids])[None]
+        return k, k.copy()
+
+    tier = KvHostTier(gather, lambda ids, k, v: None, capacity_blocks=1,
+                      on_evict=cold.offer)
+    for bid, h in [(0, 100), (1, 101)]:
+        data[bid] = np.full(4, bid, np.float32)
+        tier.offload(h, bid)
+    tier.drain()  # capacity 1 → hash 100 evicted → spilled to cold
+    assert not tier.has(100) and tier.has(101)
+    assert cold.has(100)
+    assert stored_cold == [100]
+    gk, _ = cold.get(100)
+    np.testing.assert_array_equal(gk, np.full(4, 0, np.float32)[None][None])
+
+
+async def test_cold_event_hooks_marshal_onto_the_loop(tmp_path):
+    """The ownership hooks feed loop-bound machinery (the KV event
+    publisher's asyncio queue), but spill writes run on the executor —
+    the hook must come back on the event loop thread, not fire from
+    the worker thread."""
+    import threading
+
+    loop_thread = threading.current_thread()
+    seen = []
+
+    def on_stored(hashes, parent):
+        seen.append((threading.current_thread() is loop_thread,
+                     list(hashes)))
+
+    cold = KvColdTier(str(tmp_path), capacity_blocks=8,
+                      on_stored=on_stored)
+    cold.offer(7, _blk(0), _blk(1))
+    await cold.close()  # the write itself has landed...
+    for _ in range(50):  # ...now let call_soon_threadsafe deliver
+        if seen:
+            break
+        await asyncio.sleep(0.01)
+    assert seen == [(True, [7])]
+    assert cold.has(7)
+
+
+# ------------------------------------------------------------ router scoring
+
+
+def _stored(worker, hashes, parent=None, tier="hbm"):
+    return RouterEvent(worker_id=worker,
+                       stored=KvCacheStored(hashes, parent), tier=tier)
+
+
+def test_indexer_scores_cold_ownership_separately():
+    idx = KvIndexer(block_size=4)
+    chain = [1, 2, 3, 4]
+    idx.apply_event(_stored("w1", chain[:2]))             # warm 2
+    idx.apply_event(_stored("w1", chain[2:], 2, "cold"))  # +2 cold
+    idx.apply_event(_stored("w2", chain, tier="cold"))    # 4 cold only
+    out = idx.find_matches(chain)
+    assert out.scores == {"w1": 2}
+    assert out.cold_scores == {"w1": 2, "w2": 4}
+    # cold removal shrinks the run
+    idx.apply_event(RouterEvent(worker_id="w2",
+                                removed=KvCacheRemoved([2]), tier="cold"))
+    out = idx.find_matches(chain)
+    assert out.cold_scores["w2"] == 1
+    idx.remove_worker("w1")
+    out = idx.find_matches(chain)
+    assert "w1" not in out.scores and "w1" not in out.cold_scores
+
+
+def test_kv_scheduler_discounts_cold_hits_and_reports_pull_hint():
+    sched = KvScheduler(block_size=4, cold_discount=0.5)
+    m = ForwardPassMetrics(request_active_slots=0, request_total_slots=8,
+                           kv_active_blocks=0, kv_total_blocks=100)
+    sched.update_metrics("warm", m)
+    sched.update_metrics("cold", m)
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+
+    # equal coverage: 4 warm blocks beat 4 cold blocks
+    overlap = OverlapScores(scores={"warm": 4},
+                            cold_scores={"cold": 4})
+    d = sched.schedule(16, overlap)
+    assert d.worker_id == "warm"
+    assert d.best_prefix_worker == "warm"
+
+    # an 8-block cold owner out-scores a 2-block warm one at 0.5 discount
+    sched2 = KvScheduler(block_size=4, cold_discount=0.5)
+    sched2.update_metrics("warm", m)
+    sched2.update_metrics("cold", m)
+    overlap = OverlapScores(scores={"warm": 2},
+                            cold_scores={"cold": 8})
+    d = sched2.schedule(40, overlap)
+    assert d.worker_id == "cold"
+    assert d.cold_blocks == 8
+    assert d.best_prefix_worker == "cold"
+    assert d.best_prefix_blocks == 8
+
+
+def test_recovery_peer_ranking_prefers_prefix_owner():
+    """The PR 8 carry-over: migration targets rank by the fabric's
+    ownership view instead of discovery order — joined through the
+    descriptor's ``worker_id`` (KV-event id namespace), NOT the
+    migration plane's engine_id, which is a different uuid."""
+    from dynamo_tpu.kv import KvFabric
+    from dynamo_tpu.recovery.controller import RecoveryController
+
+    fab = KvFabric(runner=None, allocator=None, engine_id="self-w",
+                   block_size=4)
+    prompt = list(range(1, 13))
+    chain = compute_block_hashes(prompt, 4)
+    fab.apply_event(_stored("w-b", chain))  # KV events key by worker id
+    peers = [
+        {"engine_id": "eng-a", "worker_id": "w-a", "host": "h", "port": 1},
+        {"engine_id": "eng-b", "worker_id": "w-b", "host": "h", "port": 2},
+        {"engine_id": "self", "host": "h", "port": 3},
+    ]
+    ctl = RecoveryController(engine_id="self", peers=lambda: peers,
+                             peer_ranker=fab.rank_peers)
+
+    er = type("_Er", (), {"prompt": prompt})()
+    ranked = ctl._candidate_peers(er)
+    assert [p["engine_id"] for p in ranked] == ["eng-b", "eng-a"]
+    # without a request, discovery order is preserved (self still excluded)
+    assert [p["engine_id"] for p in ctl._candidate_peers()] == [
+        "eng-a", "eng-b"]
+
+
+# ------------------------------------------------------------------ e2e rigs
+
+
+def _fabric_config(hf_model_dir, **overrides):
+    cfg = ModelConfig.from_model_dir(hf_model_dir)
+    kw = dict(
+        max_batch_size=4, max_model_len=128, kv_block_size=8,
+        num_kv_blocks=64, dtype="float32", prefix_pull=True,
+        prefix_pull_min_blocks=2, prefix_pull_timeout_s=10.0,
+    )
+    kw.update(overrides)
+    return cfg, EngineConfig(model=cfg, **kw)
+
+
+def _engine(hf_model_dir, events=None, **overrides):
+    cfg, econfig = _fabric_config(hf_model_dir, **overrides)
+    params = load_llama_params(hf_model_dir, cfg, jnp.float32)
+    runner = ModelRunner(econfig, params=params)
+    # private flight ring per engine: the rigs run several engines in
+    # one process, and the assertions below must not see each other's
+    # (or earlier tests') events through the process-global recorder
+    sched = Scheduler(runner, econfig, events=events,
+                      flight=FlightRecorder())
+    return sched
+
+
+def _events(sched, kind):
+    """This engine's flight events of one ``kind``, with the recorded
+    keyword payload flattened out of the nested ``data`` dict."""
+    return [{**e.get("data", {}), **e}
+            for e in sched.flight.snapshot() if e.get("kind") == kind]
+
+
+SHARED_PREFIX = [1, 17, 43, 99, 7, 3, 250, 12, 5, 77, 8, 21,
+                 33, 44, 55, 66, 9, 2, 120, 14, 71, 88, 19, 4]  # 3 blocks
+
+
+async def _run_one(sched, prompt, rid, max_tokens=6):
+    er = _greedy_request(rid, prompt, max_tokens=max_tokens)
+    sched.add_request(er)
+    return await _collect(er)
+
+
+def _assert_no_leaks(sched):
+    assert not sched.allocator.pinned, "leaked pins"
+    assert not sched.allocator.refcount, "leaked block refs"
+
+
+def _wire_a_to_b(sched_b, worker_id="worker-a"):
+    """KV event sink for engine A that feeds B's fabric ownership view
+    (the same RouterEvent stream the router would relay)."""
+    def on_stored(hashes, parent):
+        sched_b.fabric.apply_event(_stored(worker_id, list(hashes), parent))
+
+    def on_removed(hashes):
+        sched_b.fabric.apply_event(RouterEvent(
+            worker_id=worker_id, removed=KvCacheRemoved(list(hashes))))
+
+    return KvEventSink(on_stored=on_stored, on_removed=on_removed)
+
+
+async def _two_engine_rig(hf_model_dir):
+    """B's fabric sees A's KV events and pulls from A's serve half."""
+    sched_b = _engine(hf_model_dir)
+    sched_a = _engine(hf_model_dir, events=_wire_a_to_b(sched_b))
+    server_a = await sched_a.fabric.serve()
+    sched_b.fabric.peers = (
+        lambda: {"worker-a": {"host": "127.0.0.1", "port": server_a.port}}
+    )
+    sched_a.start()
+    sched_b.start()
+    return sched_a, sched_b
+
+
+async def test_prefix_pull_from_peer_byte_identical(hf_model_dir):
+    """The headline differential: worker A computed a shared prefix;
+    worker B pulls it instead of recomputing, streams byte-identically,
+    and prefills only the un-matched tail."""
+    prompt_a = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    prompt_b = SHARED_PREFIX + [40, 41, 42, 43, 44, 45]
+
+    # recompute baseline for prompt_b on a fresh engine
+    sched_base = _engine(hf_model_dir)
+    sched_base.start()
+    baseline = await _run_one(sched_base, prompt_b, "base")
+    await sched_base.stop()
+
+    sched_a, sched_b = await _two_engine_rig(hf_model_dir)
+    try:
+        await _run_one(sched_a, prompt_a, "warm")  # A now owns the prefix
+
+        # spy B's prefill work: positions actually computed per step
+        real_step = sched_b.runner.step
+        prefill_positions = []
+
+        def spy_step(tokens, positions, btab, slot_map, *a, **kw):
+            if tokens.shape[1] > 1:  # prefill-shaped (decode is S=1)
+                prefill_positions.append(int((slot_map >= 0).sum()))
+            return real_step(tokens, positions, btab, slot_map, *a, **kw)
+
+        sched_b.runner.step = spy_step
+        out = await _run_one(sched_b, prompt_b, "pulled")
+        assert out == baseline, "pulled prefix diverged from recompute"
+
+        # the pull committed: 3 shared blocks = 24 tokens never recomputed
+        assert sched_b.prefix_hit_tokens == 24
+        assert sched_b.prefix_total_tokens == len(prompt_b)
+        # B's prefill covered ONLY the 6-token tail
+        assert sum(prefill_positions) == len(prompt_b) - 24
+        pulls = _events(sched_b, "scheduler.pull_commit")
+        assert pulls and pulls[-1]["blocks"] == 3
+        assert pulls[-1]["source"] == "peer"
+        _assert_no_leaks(sched_b)
+    finally:
+        await sched_a.stop()
+        await sched_b.stop()
+    _assert_no_leaks(sched_a)
+
+
+async def test_prefix_pull_conn_drop_falls_back_byte_identical(hf_model_dir):
+    """Chaos: the serving side dies mid-pull → local recompute, byte-
+    identical, zero leaked blocks on BOTH sides."""
+    prompt_a = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    prompt_b = SHARED_PREFIX + [40, 41, 42, 43, 44, 45]
+    sched_base = _engine(hf_model_dir)
+    sched_base.start()
+    baseline = await _run_one(sched_base, prompt_b, "base")
+    await sched_base.stop()
+
+    sched_a, sched_b = await _two_engine_rig(hf_model_dir)
+    try:
+        await _run_one(sched_a, prompt_a, "warm")
+        faults.arm("transfer_conn_drop", "once")
+        out = await _run_one(sched_b, prompt_b, "dropped")
+        assert out == baseline
+        falls = _events(sched_b, "kv_fabric.local_fallback")
+        assert falls, "expected a local fallback after the drop"
+        _assert_no_leaks(sched_b)
+    finally:
+        faults.reset()
+        await sched_a.stop()
+        await sched_b.stop()
+    _assert_no_leaks(sched_a)
+
+
+async def test_prefix_pull_stall_times_out_and_falls_back(hf_model_dir):
+    """Chaos: a stalled pull must never hold the request — the deadline
+    cancels it and the stream still matches the recompute baseline."""
+    prompt_a = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    prompt_b = SHARED_PREFIX + [40, 41, 42, 43, 44, 45]
+    sched_base = _engine(hf_model_dir)
+    sched_base.start()
+    baseline = await _run_one(sched_base, prompt_b, "base")
+    await sched_base.stop()
+
+    sched_b = _engine(hf_model_dir, prefix_pull_timeout_s=0.5)
+    sched_a = _engine(hf_model_dir, events=_wire_a_to_b(sched_b))
+    server_a = await sched_a.fabric.serve()
+    sched_b.fabric.peers = (
+        lambda: {"worker-a": {"host": "127.0.0.1", "port": server_a.port}}
+    )
+    sched_a.start()
+    sched_b.start()
+    try:
+        await _run_one(sched_a, prompt_a, "warm")
+        faults.arm("prefix_pull_stall", "once")
+        out = await _run_one(sched_b, prompt_b, "stalled")
+        assert out == baseline
+        falls = _events(sched_b, "kv_fabric.local_fallback")
+        assert falls and falls[-1]["reason"] == "timeout"
+        _assert_no_leaks(sched_b)
+    finally:
+        faults.reset()
+        await sched_a.stop()
+        await sched_b.stop()
+    _assert_no_leaks(sched_a)
+
+
+async def test_cold_tier_rehydrates_after_respawn(hf_model_dir, tmp_path):
+    """The respawn-warm acceptance path: spill a prefix through host-
+    tier eviction, kill the engine, and a fresh engine over the same
+    cold directory rehydrates instead of fully recomputing."""
+    cold_dir = str(tmp_path / "cold")
+    prompt = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    # 34 fresh tokens against 6 HBM blocks: allocating the evictor must
+    # evict the first prompt's cached blocks → host tier (capacity 1)
+    # → overflow spills the prefix to the cold tier
+    evictor = [2] + list(range(90, 123))
+
+    def mk(**kw):
+        return _engine(
+            hf_model_dir, num_kv_blocks=6, max_model_len=64,
+            host_kv_blocks=1, cold_tier_dir=cold_dir, cold_tier_blocks=32,
+            prefix_pull_min_blocks=1, **kw,
+        )
+
+    sched1 = mk()
+    sched1.start()
+    baseline = await _run_one(sched1, prompt, "first")
+    # a second prompt evicts the first one's HBM blocks → host tier
+    # (capacity 1) → overflow spills to the cold tier
+    await _run_one(sched1, evictor, "evictor")
+    await sched1.stop()  # drains spill writes (fabric.close → cold.close)
+    assert len(os.listdir(cold_dir)) >= 2
+
+    # "respawn": a fresh engine over the same directory, nothing in HBM
+    # or host RAM
+    sched2 = mk()
+    assert sched2.fabric.cold.refresh() >= 2  # the cli wiring's priming
+    sched2.start()
+    try:
+        out = await _run_one(sched2, prompt, "rehydrated")
+        assert out == baseline
+        pulls = _events(sched2, "scheduler.pull_commit")
+        assert pulls and pulls[-1]["source"] == "cold"
+        assert sched2.prefix_hit_tokens == pulls[-1]["blocks"] * 8
+        _assert_no_leaks(sched2)
+    finally:
+        await sched2.stop()
+
+
+async def test_corrupt_cold_block_is_a_miss_never_installed(
+        hf_model_dir, tmp_path):
+    """A corrupted spill file mid-run: the pull commits only the verified
+    prefix and the stream still matches the recompute baseline."""
+    cold_dir = str(tmp_path / "cold")
+    prompt = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    evictor = [2] + list(range(90, 123))  # see the rehydrate rig above
+
+    def mk():
+        return _engine(
+            hf_model_dir, num_kv_blocks=6, max_model_len=64,
+            host_kv_blocks=1, cold_tier_dir=cold_dir, cold_tier_blocks=32,
+            prefix_pull_min_blocks=1,
+        )
+
+    sched1 = mk()
+    sched1.start()
+    baseline = await _run_one(sched1, prompt, "first")
+    await _run_one(sched1, evictor, "evictor")
+    await sched1.stop()
+
+    # corrupt the LAST spilled prefix block's payload
+    chain = compute_block_hashes(prompt, 8)
+    spilled = [h for h in chain
+               if os.path.exists(os.path.join(cold_dir, f"{h:016x}.kvb"))]
+    assert len(spilled) >= 2
+    victim = os.path.join(cold_dir, f"{spilled[-1]:016x}.kvb")
+    raw = bytearray(open(victim, "rb").read())
+    raw[-1] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+
+    sched2 = mk()
+    sched2.fabric.cold.refresh()
+    sched2.start()
+    try:
+        out = await _run_one(sched2, prompt, "partial")
+        assert out == baseline
+        pulls = _events(sched2, "scheduler.pull_commit")
+        # only the verified run installed; the corrupt block recomputed
+        assert pulls and pulls[-1]["blocks"] == len(spilled) - 1
+        _assert_no_leaks(sched2)
+    finally:
+        await sched2.stop()

@@ -99,10 +99,19 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_incidents_total",
         "dynamo_incidents_suppressed_total",
         "dynamo_scheduler_draining_info",
+        # cluster KV fabric: cross-worker prefix pull (kv/fabric.py)
+        # + content-addressed cold tier (kv/cold_tier.py)
+        "dynamo_kv_fabric_prefix_pull_total",
+        "dynamo_kv_fabric_prefix_pull_bytes_total",
+        "dynamo_kv_fabric_prefix_pull_duration_seconds",
+        "dynamo_kv_fabric_cold_tier_hits_total",
+        "dynamo_kv_fabric_cold_tier_misses_total",
+        "dynamo_kv_fabric_cold_tier_evictions_total",
+        "dynamo_kv_fabric_cold_tier_bytes",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 82
+    assert len(names) >= 89
 
 
 def _metric(name, kind):
