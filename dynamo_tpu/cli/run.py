@@ -307,6 +307,45 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-capacity", type=int, default=None,
                    help="max completed traces held for /debug/requests "
                         "and /debug/trace (LRU beyond it; default 512)")
+    # multi-model multi-tenant fleet (registry/, docs/multi_model.md)
+    p.add_argument("--served-alias", action="append", default=None,
+                   metavar="ALIAS",
+                   help="extra name this model answers to (repeatable); "
+                        "rides the model card workers publish at "
+                        "startup, resolved by registry-aware frontends")
+    p.add_argument("--model-tenants", default=None,
+                   help="comma-separated tenant allow list for this "
+                        "model's card (unset = public; tenant-scoped "
+                        "models are invisible — 404 — to other tenants)")
+    p.add_argument("--tenant-rps", type=float, default=0.0,
+                   help="per-tenant requests/s token bucket (X-Tenant "
+                        "header; unknown/garbage degrades to the "
+                        "'default' tenant; 0 = unlimited). Exceeding "
+                        "tenants are shed with 429 + Retry-After while "
+                        "other tenants are untouched")
+    p.add_argument("--tenant-tps", type=float, default=0.0,
+                   help="per-tenant streamed-tokens/s token bucket "
+                        "(charged by actual streamed tokens; overdraft "
+                        "delays the tenant's next admission; 0 = "
+                        "unlimited)")
+    p.add_argument("--tenant-burst-s", type=float, default=2.0,
+                   help="token-bucket capacity in seconds of rate")
+    p.add_argument("--tenant-quotas", default=None, metavar="FILE.json",
+                   help="per-tenant overrides: {tenant: {requests_per_s,"
+                        " tokens_per_s, burst_s}}")
+    p.add_argument("--pool-scale-to-zero-idle-s", type=float, default=0.0,
+                   help="drain a model's pool to zero replicas after "
+                        "this long without a request (0 = off); the "
+                        "next request for the cold model triggers a "
+                        "cold-start respawn with that model's card")
+    p.add_argument("--pool-cold-start-deadline-s", type=float,
+                   default=30.0,
+                   help="how long a request for a cold model waits for "
+                        "a worker to join the pool before shedding "
+                        "with 503 + Retry-After")
+    p.add_argument("--pool-cooldown-s", type=float, default=30.0,
+                   help="per-model pool action pacing (scale-to-zero / "
+                        "cold-start decisions)")
     p.add_argument("--router-staleness-bound-s", type=float, default=0.0,
                    help="KV router: skip workers whose scraped load "
                         "snapshot is older than this many seconds "
@@ -741,6 +780,92 @@ async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
     return fabric
 
 
+def _model_card(flags, mdc, endpoint_path: str, model_type: str = "both"):
+    """The fleet card a worker publishes at startup (registry/cards.py):
+    name + pool endpoint + family/context from the deployment card,
+    aliases and tenant visibility from the flags."""
+    from ..registry.cards import card_from_mdc
+
+    tenants = None
+    if flags.model_tenants is not None:
+        tenants = [t.strip() for t in flags.model_tenants.split(",")
+                   if t.strip()]
+    return card_from_mdc(
+        mdc, endpoint_path,
+        name=flags.model_name or mdc.display_name,
+        model_type=model_type,
+        aliases=flags.served_alias or [],
+        tenants=tenants,
+    )
+
+
+def _advertise_model(registry, name: Optional[str]) -> None:
+    """Stamp the model this process serves on its metrics registry —
+    the fleet hub reads the label into /fleet/workers' MODEL column."""
+    if registry is None or not name:
+        return
+    registry.gauge(
+        "dynamo_registry_model_info",
+        "1 for the model= this worker currently serves",
+    ).set(1.0, model=name)
+
+
+def _build_quotas(flags, admissions_registry=None):
+    """--tenant-* → a TenantQuotas gate for the HTTP edge, or None.
+    ``admissions_registry`` shares the admission controller's counter
+    family so outcome="quota" rides the same instrument."""
+    if (flags.tenant_rps <= 0 and flags.tenant_tps <= 0
+            and not flags.tenant_quotas):
+        return None
+    from ..registry.tenants import TenantQuotas
+
+    quotas = TenantQuotas.from_flags(
+        flags.tenant_rps, flags.tenant_tps,
+        overrides_path=flags.tenant_quotas,
+        burst_s=flags.tenant_burst_s,
+    )
+    if admissions_registry is not None:
+        quotas.bind_admissions(admissions_registry)
+    return quotas
+
+
+def _build_pools(flags, manager, watcher):
+    """Pool manager for the multi-model frontend: scale-to-zero for
+    idle model pools and cold-start gating for requests that find
+    their pool empty. Replica actuation rides the api-store record
+    when --api-store-url/--planner-deployment are set (the operator
+    reconciles the patch, like the standalone planner); without a
+    backend, cold requests just wait out the deadline for an
+    externally-started worker."""
+    from ..registry import (
+        PoolConfig,
+        PoolManager,
+        PoolPolicy,
+        PoolPolicyConfig,
+        StorePoolBackend,
+    )
+
+    backend = None
+    if flags.api_store_url and flags.planner_deployment:
+        from ..deploy.store_source import ApiStoreClient
+
+        backend = StorePoolBackend(
+            ApiStoreClient(flags.api_store_url), flags.planner_deployment)
+    if backend is None and flags.pool_scale_to_zero_idle_s <= 0:
+        return None
+    return PoolManager(
+        manager.registry, watcher.pool_size,
+        spawner=backend.spawn if backend is not None else None,
+        drainer=backend.drain if backend is not None else None,
+        config=PoolConfig(
+            cold_start_deadline_s=flags.pool_cold_start_deadline_s),
+        policy=PoolPolicy(PoolPolicyConfig(
+            idle_to_zero_s=flags.pool_scale_to_zero_idle_s,
+            cooldown_s=flags.pool_cooldown_s,
+        )),
+    )
+
+
 def _build_hub(flags):
     """--hub → a FleetHub over the static --hub-target list (discovery
     targets attach later, once a DistributedRuntime exists)."""
@@ -830,6 +955,8 @@ async def run_http(flags, engine, mdc) -> None:
             itl_s=flags.slo_itl_ms / 1e3 if flags.slo_itl_ms > 0 else None,
         )
     hub = _build_hub(flags)
+    quotas = _build_quotas(
+        flags, admission.registry if admission is not None else None)
     service = HttpService(
         manager, flags.http_host, flags.http_port,
         profile_dir=flags.profile_dir or None,
@@ -838,7 +965,14 @@ async def run_http(flags, engine, mdc) -> None:
         trace_ttl_s=flags.trace_ttl_s,
         trace_capacity=flags.trace_capacity,
         hub=hub,
+        quotas=quotas,
     )
+    if engine is not None:
+        # the model this frontend serves locally, for the fleet hub's
+        # MODEL column (the distributed shape advertises per worker)
+        _advertise_model(
+            service.metrics.registry,
+            flags.model_name or (mdc.display_name if mdc else "echo"))
     if hub is not None:
         # the frontend scrapes ITSELF (engine registries attach into the
         # service registry below, so one local scrape covers every layer
@@ -924,7 +1058,9 @@ async def run_http(flags, engine, mdc) -> None:
         service.metrics.attach_registry(incidents.registry)
 
     watcher = None
+    pools = None
     if flags.store_port is not None:
+        from ..registry.registry import RegistryAdmin
         from ..runtime.component import DistributedRuntime
         from ..runtime.client import RouterMode
 
@@ -939,6 +1075,14 @@ async def run_http(flags, engine, mdc) -> None:
             drt, manager, flags.namespace, RouterMode(flags.router_mode)
         )
         await watcher.start()
+        # dynamic model management (POST/DELETE /admin/models,
+        # dynamoctl): writes the same discovery records workers publish
+        service.registry_admin = RegistryAdmin(drt, flags.namespace)
+        # per-model pool elasticity: scale-to-zero + cold-start gating
+        pools = _build_pools(flags, manager, watcher)
+        if pools is not None:
+            service.attach_pools(pools)
+            pools.start(spawn=drt.runtime.spawn)
     if hub is not None:
         hub.start()
 
@@ -979,6 +1123,8 @@ async def run_http(flags, engine, mdc) -> None:
     finally:
         if planner is not None:
             planner.stop()
+        if pools is not None:
+            await pools.stop()
         if hub is not None:
             await hub.stop()
         if inc_sampler is not None:
@@ -1106,14 +1252,17 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         else:
             await client.start()
         engine = build_processor_pipeline(mdc, client, router)
-        serving = await endpoint.serve(make_openai_handler(engine),
-                                       span_source="processor")
         name = flags.model_name or mdc.display_name
+        serving = await endpoint.serve(make_openai_handler(engine),
+                                       span_source="processor",
+                                       metadata={"model": name})
         await register_model(drt, flags.namespace, name, path, model_type="both",
-                             mdc={"context_length": mdc.context_length})
+                             mdc={"context_length": mdc.context_length},
+                             card=_model_card(flags, mdc, path))
         if router is not None:
             # the router's own observability surface: per-worker scraped
             # load + routing decisions, previously internal-only
+            _advertise_model(router.registry, name)
             mserver = await maybe_start_metrics_server(
                 router.registry, flags.metrics_port
             )
@@ -1138,12 +1287,18 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
                 yield out
 
         metrics_fn = core.metrics if hasattr(core, "metrics") else dict
+        model_name = flags.model_name or mdc.display_name
         serving = await endpoint.serve(
             handler,
             instance_id=instance_id,
             stats_handler=KvMetricsPublisher(metrics_fn).stats_handler,
             span_source="decode_engine",
+            # pool membership rides the lease-scoped endpoint record:
+            # per-model clients and the KV router partition instances
+            # of a shared component by this metadata
+            metadata={"model": model_name},
         )
+        _advertise_model(getattr(core, "registry", None), model_name)
         # cluster KV fabric: pull server + peer/ownership view, keyed by
         # the same instance id the KV event publisher stamps
         fabric = await _setup_kv_fabric(
@@ -1192,13 +1347,18 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
 
     else:
         engine, mdc = await build_engine(engine_spec, flags, drt=drt)
-        serving = await endpoint.serve(make_openai_handler(engine))
         name = flags.model_name or (mdc.display_name if mdc else "echo")
+        serving = await endpoint.serve(make_openai_handler(engine),
+                                       metadata={"model": name})
         model_type = "both" if mdc is not None else "chat"
         await register_model(
             drt, flags.namespace, name, path, model_type=model_type,
             mdc={"context_length": mdc.context_length} if mdc else None,
+            card=_model_card(flags, mdc, path, model_type)
+            if mdc is not None else None,
         )
+        _advertise_model(
+            getattr(engine, "telemetry_registry", None), name)
         mserver = await maybe_start_metrics_server(
             getattr(engine, "telemetry_registry", None), flags.metrics_port
         )
@@ -1245,6 +1405,8 @@ async def run_prefill(flags) -> None:
     # same sidecar the decode workers run: prefill throughput, transfer
     # bytes, queue wait, and the transfer-overlap histograms land in a
     # scrapeable /metrics instead of only the ad-hoc metrics() dict
+    _advertise_model(worker.registry,
+                     flags.model_name or mdc.display_name)
     mserver = await maybe_start_metrics_server(
         worker.registry, flags.metrics_port
     )

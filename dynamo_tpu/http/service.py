@@ -53,17 +53,27 @@ MODEL_REGISTRY_PREFIX = "models/"  # under the http namespace
 
 
 class ModelManager:
-    """name → engine maps for chat and completion models."""
+    """name → engine maps for chat and completion models, as a live view
+    over the model registry (registry/registry.py): served aliases and
+    tenant visibility resolve through the registered cards; engines
+    without cards (local single-model serving, BYO) stay public under
+    their exact name."""
 
-    def __init__(self) -> None:
+    def __init__(self, registry=None) -> None:
+        from ..registry.registry import ModelRegistry
+
         self.chat_engines: Dict[str, AsyncEngine] = {}
         self.completion_engines: Dict[str, AsyncEngine] = {}
         self.metadata: Dict[str, dict] = {}  # name → /v1/models extras
+        self.registry = registry or ModelRegistry()
 
     def set_metadata(self, name: str, **meta) -> None:
         self.metadata.setdefault(name, {}).update(
             {k: v for k, v in meta.items() if v is not None}
         )
+
+    def set_card(self, card) -> None:
+        self.registry.put(card)
 
     def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
         self.chat_engines[name] = engine
@@ -75,9 +85,35 @@ class ModelManager:
         self.chat_engines.pop(name, None)
         self.completion_engines.pop(name, None)
         self.metadata.pop(name, None)  # a re-registration starts clean
+        self.registry.remove(name)
 
-    def model_names(self) -> list:
+    def resolve(self, model: str, tenant: Optional[str] = None
+                ) -> Optional[str]:
+        """Requested name/alias → canonical pool name, or None (unknown
+        OR invisible to the tenant — the same answer, so tenants cannot
+        probe each other's catalogs). Card-less engine names resolve to
+        themselves and are public."""
+        if self.registry.lookup(model) is not None:
+            return self.registry.resolve(model, tenant)
+        if model in self.chat_engines or model in self.completion_engines:
+            return model
+        return None
+
+    def served_names(self) -> list:
+        """Every model with an engine, visibility-blind — the operator
+        surface (/health), never a tenant-facing catalog."""
         return sorted(set(self.chat_engines) | set(self.completion_engines))
+
+    def model_names(self, tenant: Optional[str] = None) -> list:
+        names = set(self.chat_engines) | set(self.completion_engines)
+        if not self.registry.cards:
+            return sorted(names)
+        visible = []
+        for name in names:
+            card = self.registry.card(name)
+            if card is None or card.visible_to(tenant):
+                visible.append(name)
+        return sorted(visible)
 
 
 class HttpService:
@@ -94,6 +130,8 @@ class HttpService:
         trace_capacity: Optional[int] = None,
         hub=None,        # telemetry.hub.FleetHub
         incidents=None,  # telemetry.incidents.IncidentRecorder
+        quotas=None,     # registry.tenants.TenantQuotas
+        pools=None,      # registry.pools.PoolManager
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -104,6 +142,18 @@ class HttpService:
         self.admission = admission
         if admission is not None:
             self.metrics.attach_registry(admission.registry)
+        # multi-tenant quota layer (registry/tenants.py): X-Tenant →
+        # per-tenant token buckets, checked BEFORE the priority queues
+        # so one tenant's spike sheds that tenant at the door
+        self.quotas = quotas
+        if quotas is not None:
+            self.metrics.attach_registry(quotas.registry)
+        # per-model pool manager (registry/pools.py): cold-start gate +
+        # scale-to-zero loop; None = models must be warm to serve
+        self.pools = None
+        if pools is not None:
+            self.attach_pools(pools)
+        self.metrics.attach_registry(self.manager.registry.registry)
         # optional SLO attainment + goodput accounting: per-request
         # TTFT / worst-ITL verdicts at the edge (telemetry/slo.py)
         if slo is not None:
@@ -134,6 +184,16 @@ class HttpService:
         # when --self-heal builds a RecoveryController; 501 otherwise.
         self.drainer = None  # async (mode, respawn) -> summary dict
         self.app.router.add_post("/admin/drain", self.handle_admin_drain)
+        # dynamic model management (registry/registry.py RegistryAdmin,
+        # wired by the CLI when a discovery plane exists; 501 otherwise)
+        # — the llmctl/dynamoctl surface over HTTP
+        self.registry_admin = None
+        self.app.router.add_get("/admin/models", self.handle_admin_models)
+        self.app.router.add_post("/admin/models",
+                                 self.handle_admin_model_add)
+        self.app.router.add_delete("/admin/models/{name}",
+                                   self.handle_admin_model_remove)
+        self.app.router.add_get("/admin/pools", self.handle_admin_pools)
         # fleet telemetry hub + incident recorder (telemetry/hub.py,
         # telemetry/incidents.py): wired by the CLI (--hub /
         # DYN_INCIDENT_DIR); the routes answer 501 when the subsystem is
@@ -153,6 +213,13 @@ class HttpService:
             self._profile_lock = asyncio.Lock()
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
+
+    def attach_pools(self, pools) -> None:
+        """Attach a PoolManager after construction (the CLI builds it
+        once the model watcher exists) — gates requests AND merges its
+        instruments into this service's exposition."""
+        self.pools = pools
+        self.metrics.attach_registry(pools.registry)
 
     # ---------- lifecycle ----------
 
@@ -189,9 +256,36 @@ class HttpService:
             status=status,
         )
 
+    @staticmethod
+    def _model_not_found(model: str):
+        """The OpenAI 404 body — also the answer for a model another
+        tenant CAN see (existence must not leak across tenants)."""
+        return web.json_response(
+            {"error": {
+                "message": f"The model '{model}' does not exist or you "
+                           "do not have access to it.",
+                "type": "invalid_request_error",
+                "param": "model",
+                "code": "model_not_found",
+            }},
+            status=404,
+        )
+
+    def _resolve_tenant(self, request: web.Request) -> str:
+        """X-Tenant → tenant id (absent/garbage degrades to default —
+        the X-Priority parsing contract). Tenant IDENTITY always parses
+        — card visibility must work on a quota-less frontend too; the
+        quota gate additionally counts garbage headers."""
+        from ..registry.tenants import TENANT_HEADER, parse_tenant
+
+        header = request.headers.get(TENANT_HEADER)
+        if self.quotas is not None:
+            return self.quotas.resolve(header)
+        return parse_tenant(header)
+
     async def _handle_inference(
         self, request: web.Request, request_cls, engines: Dict[str, AsyncEngine],
-        chunk_cls, aggregate,
+        chunk_cls, aggregate, kind: str = "chat",
     ) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -199,9 +293,74 @@ class HttpService:
         except (json.JSONDecodeError, ValueError) as e:
             return self._error(400, f"invalid request: {e}")
 
-        engine = engines.get(api_req.model)
+        tenant = self._resolve_tenant(request)
+        # registry resolution: alias → canonical pool name, tenant
+        # visibility enforced (unknown and invisible answer identically)
+        name = self.manager.resolve(api_req.model, tenant)
+        if name is None:
+            return self._model_not_found(api_req.model)
+        card = self.manager.registry.card(name)
+        if card is not None and card.model_type not in (kind, "both"):
+            # registered for the OTHER endpoint kind: for this API the
+            # model does not exist — a 404, never a forever-retry 503
+            return self._model_not_found(api_req.model)
+        if name != api_req.model:
+            # canonicalize the OUTBOUND model: downstream hops (the
+            # processor's pool partition, worker metadata, per-model
+            # metrics) key on the canonical pool name — an alias must
+            # not leak past the edge (responses echo the resolved
+            # model, the OpenAI alias convention)
+            api_req.model = name
+        rid = (request.headers.get("X-Request-Id") or "").strip()[:128]
+        if self.quotas is not None:
+            # tenant token buckets BEFORE the priority queues: a tenant
+            # over its requests/s or tokens/s budget is shed at the door
+            # (429 + Retry-After), other tenants untouched
+            from ..planner.admission import AdmissionRejected
+
+            try:
+                self.quotas.admit(tenant, request_id=rid)
+            except AdmissionRejected as e:
+                return web.json_response(
+                    {"error": {"message": str(e), "type": "overloaded",
+                               "code": 429}},
+                    status=429,
+                    headers={"Retry-After": e.retry_after_header},
+                )
+        if self.pools is not None:
+            self.pools.note_request(name)
+            if card is not None:
+                # cold-start gate: a warm pool passes through in one
+                # dict lookup; a registered-but-cold model (scale-to-
+                # zero drained its pool, or the record exists with no
+                # client yet) kicks a spawn with the model's card and
+                # holds the request, bounded — past the deadline it
+                # sheds with 503 + Retry-After
+                from ..registry.pools import ColdStartTimeout
+
+                try:
+                    await self.pools.await_capacity(name)
+                except ColdStartTimeout as e:
+                    return web.json_response(
+                        {"error": {"message": str(e),
+                                   "type": "service_unavailable",
+                                   "code": 503}},
+                        status=503,
+                        headers={"Retry-After":
+                                 str(max(1, int(e.retry_after_s)))},
+                    )
+        engine = engines.get(name)
         if engine is None:
-            return self._error(404, f"model '{api_req.model}' not found", "model_not_found")
+            if card is not None:
+                # the card exists but no worker serves the pool and no
+                # cold-start path is configured: transient, retryable
+                return web.json_response(
+                    {"error": {"message": f"model '{api_req.model}' has "
+                               "no live workers",
+                               "type": "service_unavailable", "code": 503}},
+                    status=503, headers={"Retry-After": "5"},
+                )
+            return self._model_not_found(api_req.model)
 
         admitted = False
         if self.admission is not None:
@@ -212,7 +371,6 @@ class HttpService:
             from ..planner.admission import AdmissionRejected, parse_priority
 
             priority = parse_priority(request.headers.get("X-Priority"))
-            rid = (request.headers.get("X-Request-Id") or "").strip()[:128]
             try:
                 await self.admission.acquire(priority, request_id=rid)
                 admitted = True
@@ -224,16 +382,26 @@ class HttpService:
                     headers={"Retry-After": e.retry_after_header},
                 )
 
-        timer = self.metrics.track(api_req.model)
+        # per-model accounting keys on the CANONICAL pool name, so an
+        # alias's traffic lands on its model's series
+        timer = self.metrics.track(name)
         status = "error"
+        # token-bucket accounting by ACTUAL streamed tokens — the charge
+        # rides the same sites the SLO goodput counter does
+        if self.quotas is not None:
+            quotas, q_tenant = self.quotas, tenant
+
+            def charge(n: int) -> None:
+                quotas.charge_tokens(q_tenant, n)
+        else:
+            charge = None
         # ingress-assigned trace id: honor the client's X-Request-Id so
         # callers can correlate their logs with /debug/requests/{id} and
         # every downstream hop (scheduler spans, remote prefill) by id.
         # It is correlation-only: the engine-side request id stays a fresh
         # UUID (AsyncEngineContext.id), so a reused/duplicate client id
         # cannot collide in scheduler or disagg-coordinator state.
-        trace_id = (request.headers.get("X-Request-Id") or "").strip()[:128]
-        ctx = Context(api_req, AsyncEngineContext(trace_id=trace_id or None))
+        ctx = Context(api_req, AsyncEngineContext(trace_id=rid or None))
         ctx.add_stage("http")
         try:
             stream = engine.generate(ctx).__aiter__()
@@ -245,7 +413,8 @@ class HttpService:
             except StopAsyncIteration:
                 first = None
             if api_req.stream:
-                resp, status = await self._stream_sse(request, ctx, first, stream, timer)
+                resp, status = await self._stream_sse(
+                    request, ctx, first, stream, timer, charge=charge)
                 return resp
             def _check_annotated(chunk):
                 """None for data chunks; the envelope for annotations.
@@ -265,7 +434,10 @@ class HttpService:
                     continue  # annotations are stream-only side channel
                 d = _as_dict(chunk)
                 if _has_payload(d):
-                    timer.token(_payload_tokens(d))
+                    n = _payload_tokens(d)
+                    timer.token(n)
+                    if charge is not None:
+                        charge(n)
                 chunks.append(chunk_cls.model_validate(d))
             status = "success"
             return web.json_response(
@@ -283,7 +455,13 @@ class HttpService:
         except (EngineError, ValueError) as e:
             return self._error(400, str(e))
         except NoInstancesError as e:
-            return self._error(503, str(e), "service_unavailable")
+            # an empty pool is transient by design (workers churn,
+            # scale-to-zero drains) — tell the client when to come back
+            return web.json_response(
+                {"error": {"message": str(e), "type": "service_unavailable",
+                           "code": 503}},
+                status=503, headers={"Retry-After": "5"},
+            )
         except (ResponseStreamError, asyncio.TimeoutError) as e:
             return self._error(502, str(e), "engine_error")
         except _StreamDisconnect:
@@ -298,7 +476,7 @@ class HttpService:
                 self.admission.release()
             ctx.context.stop_generating()
             timer.finish(status)
-            self.traces.record(ctx.trace_id, api_req.model, status,
+            self.traces.record(ctx.trace_id, name, status,
                                ctx.stages, ctx=ctx.context)
             if ctx.stages and logger.isEnabledFor(logging.DEBUG):
                 logger.debug(
@@ -315,6 +493,7 @@ class HttpService:
         first: Any,
         chunks: AsyncIterator[Any],
         timer,
+        charge=None,  # tenant token-bucket accounting (registry/tenants.py)
     ):
         resp = web.StreamResponse(
             headers={
@@ -347,7 +526,10 @@ class HttpService:
                 return False
             d = _as_dict(chunk)
             if _has_payload(d):
-                timer.token(_payload_tokens(d))
+                n = _payload_tokens(d)
+                timer.token(n)
+                if charge is not None:
+                    charge(n)
             await resp.write(sse.encode_event(d))
             return False
 
@@ -380,30 +562,47 @@ class HttpService:
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_inference(
             request, ChatCompletionRequest, self.manager.chat_engines,
-            ChatCompletionChunk, aggregate_chat_stream,
+            ChatCompletionChunk, aggregate_chat_stream, kind="chat",
         )
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_inference(
             request, CompletionRequest, self.manager.completion_engines,
             CompletionResponse, aggregate_completion_stream,
+            kind="completions",
         )
 
     async def handle_models(self, request: web.Request) -> web.Response:
+        """GET /v1/models — card-enriched (family, context length,
+        aliases, owned_by) and filtered by the caller's tenant
+        visibility; card-less engines keep their metadata-only rows."""
+        tenant = self._resolve_tenant(request)
+        data = []
+        for name in self.manager.model_names(tenant):
+            meta = dict(self.manager.metadata.get(name, {}))
+            card = self.manager.registry.card(name)
+            if card is not None:
+                meta.setdefault("model_type", card.model_type)
+                if card.context_length:
+                    meta.setdefault("max_model_len", card.context_length)
+                data.append(ModelInfo(
+                    id=name, owned_by=card.owned_by, family=card.family,
+                    aliases=card.aliases or None, **meta,
+                ))
+            else:
+                data.append(ModelInfo(id=name, **meta))
         return web.json_response(
-            ModelList(
-                data=[
-                    ModelInfo(id=name, **self.manager.metadata.get(name, {}))
-                    for name in self.manager.model_names()
-                ]
-            ).model_dump(exclude_none=True)
+            ModelList(data=data).model_dump(exclude_none=True)
         )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(), content_type="text/plain")
 
     async def handle_health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok", "models": self.manager.model_names()})
+        # operator surface: every served model, visibility-blind — a
+        # readiness probe must see tenant-scoped models too
+        return web.json_response(
+            {"status": "ok", "models": self.manager.served_names()})
 
     async def handle_debug_requests(self, request: web.Request) -> web.Response:
         """GET /debug/requests?limit=N — the most recent completed traces
@@ -508,6 +707,68 @@ class HttpService:
         respawn = request.query.get("respawn") in ("1", "true", "yes")
         summary = await self.drainer(mode=mode, respawn=respawn)
         return web.json_response(summary)
+
+    async def handle_admin_models(self, request: web.Request) -> web.Response:
+        """GET /admin/models — every registered card, unfiltered (this
+        is the operator surface, not the tenant-scoped /v1/models)."""
+        return web.json_response({
+            "models": [card.to_wire() for _, card in
+                       sorted(self.manager.registry.cards.items())],
+        })
+
+    async def handle_admin_model_add(self, request: web.Request
+                                     ) -> web.Response:
+        """POST /admin/models — register a model card dynamically (the
+        ``llmctl http add`` / ``dynamoctl models add`` analogue). The
+        frontend's watcher picks the record up and binds the route; no
+        restart. Body: a ModelCard wire dict (name + endpoint required)."""
+        if self.registry_admin is None:
+            return web.json_response(
+                {"error": "no registry admin attached (serve with a "
+                          "discovery plane: --store-port)"},
+                status=501,
+            )
+        from ..registry.cards import ModelCard
+
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object (a card)")
+            card = ModelCard.from_wire(body)
+            if not card.name or not card.endpoint:
+                raise ValueError("name and endpoint are required")
+            await self.registry_admin.add(card)
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            return self._error(400, f"invalid model card: {e}")
+        return web.json_response({"registered": card.name})
+
+    async def handle_admin_model_remove(self, request: web.Request
+                                        ) -> web.Response:
+        """DELETE /admin/models/{name} — unregister; routes unbind as
+        the watcher sees the delete."""
+        if self.registry_admin is None:
+            return web.json_response(
+                {"error": "no registry admin attached (serve with a "
+                          "discovery plane: --store-port)"},
+                status=501,
+            )
+        name = request.match_info["name"]
+        card = self.manager.registry.card(name)
+        await self.registry_admin.remove(
+            name, card.model_type if card is not None else None)
+        return web.json_response({"removed": name})
+
+    async def handle_admin_pools(self, request: web.Request) -> web.Response:
+        """GET /admin/pools — per-model pool rows: live workers, idle
+        age, cold-start state (what the scale-to-zero policy sees)."""
+        if self.pools is None:
+            return web.json_response(
+                {"error": "no pool manager attached (serve with "
+                          "--pool-scale-to-zero-idle-s or a cold-start "
+                          "backend)"},
+                status=501,
+            )
+        return web.json_response({"pools": self.pools.snapshot()})
 
     async def handle_fleet_metrics(self, request: web.Request) -> web.Response:
         """GET /fleet/metrics — cluster rollups (sum/max/avg by role,
@@ -615,16 +876,21 @@ async def register_model(
     model_type: str = "chat",
     mdc: Optional[dict] = None,
     lease_scoped: bool = True,
+    card=None,  # registry.cards.ModelCard: the fleet card riding along
 ) -> None:
     """Register a served model in the discovery plane (llmctl analog).
 
     ``endpoint_path`` is a dyn://ns.comp.ep address whose workers accept
     OpenAI-level requests (preprocessing is worker-side, as in the
-    reference's v0.1.1 layout).
+    reference's v0.1.1 layout). With ``card`` the record carries the
+    full fleet card (family, aliases, tenant visibility, cold-start
+    material) the registry-aware frontend serves and pools by.
     """
     entry = {"name": name, "endpoint": endpoint_path, "model_type": model_type}
     if mdc:
         entry["mdc"] = mdc
+    if card is not None:
+        entry["card"] = card.to_wire()
     lease = await drt.discovery.primary_lease() if lease_scoped else None
     await drt.discovery.kv_put(
         model_registry_key(namespace, model_type, name),
@@ -698,7 +964,24 @@ class ModelWatcher:
         name = entry["name"]
         ns, comp, ep = parse_endpoint_path(entry["endpoint"])
         endpoint = self.drt.namespace(ns).component(comp).endpoint(ep)
-        client = await Client(endpoint, self.router_mode).start()
+        card = None
+        if entry.get("card"):
+            from ..registry.cards import ModelCard
+
+            try:
+                card = ModelCard.from_wire(entry["card"])
+            except (TypeError, ValueError):
+                logger.warning("malformed model card for %s ignored "
+                               "(serving by entry fields only)", name,
+                               exc_info=True)
+        # per-model pool: when a card names the pool, the client only
+        # routes to endpoint instances whose registration metadata says
+        # they serve THIS model (several pools can share one component);
+        # card-less registrations keep the whole-endpoint behavior
+        client = await Client(
+            endpoint, self.router_mode,
+            model=card.name if card is not None else None,
+        ).start()
         previous = self._clients.pop(name, None)
         if previous is not None:
             # re-registration PUT: release the old client's watch task
@@ -714,11 +997,21 @@ class ModelWatcher:
             model_type=model_type,
             max_model_len=(entry.get("mdc") or {}).get("context_length"),
         )
+        if card is not None:
+            self.manager.set_card(card)
         if model_type in ("chat", "both"):
             self.manager.add_chat_model(name, client)
         if model_type in ("completions", "both"):
             self.manager.add_completion_model(name, client)
         logger.info("model %s → %s registered (%s)", name, entry["endpoint"], model_type)
+
+    def pool_size(self, name: str) -> int:
+        """Live workers in one model's pool — what the pool manager's
+        cold-start gate and scale-to-zero policy consult."""
+        client = self._clients.get(name)
+        if client is None:
+            return 0
+        return len(client.eligible_ids())
 
     def _handle_delete(self, key: str) -> None:
         name = key.rsplit("/", 1)[-1]

@@ -105,14 +105,25 @@ class KvRouter:
             except Exception:
                 logger.exception("bad kv event")
 
-    async def schedule(self, token_ids,
-                       trace_id: Optional[str] = None) -> SchedulingDecision:
+    def model_pool(self, model: Optional[str]) -> Optional[set]:
+        """Instance ids registered as serving ``model`` (per-model pool
+        partition). None = no filtering (no model named). Delegates to
+        the client's eligibility predicate so routing and fallback
+        picking can never diverge on wildcard semantics."""
+        if model is None:
+            return None
+        return set(self.client.eligible_ids(model))
+
+    async def schedule(self, token_ids, trace_id: Optional[str] = None,
+                       model: Optional[str] = None) -> SchedulingDecision:
         """token ids → chosen worker instance id (+hit telemetry).
         ``trace_id`` rides the flight event so the pick is attributable
-        in a request's cluster-stitched X-ray."""
+        in a request's cluster-stitched X-ray; ``model`` selects the
+        per-model pool before prefix scoring."""
         hashes = compute_block_hashes(token_ids, self.block_size)
         overlap = self.indexer.find_matches(hashes)
-        decision = self.scheduler.schedule(len(token_ids), overlap)
+        decision = self.scheduler.schedule(
+            len(token_ids), overlap, pool=self.model_pool(model))
         # federation pattern: the scheduler counts exclusions; the series
         # mirrors its monotonic total (set_sample, not inc)
         self._stale_skips.set_sample(float(self.scheduler.stale_skips))

@@ -92,27 +92,43 @@ class KvScheduler:
         self.workers.pop(worker_id, None)
 
     def schedule(
-        self, isl_tokens: int, overlap: OverlapScores
+        self, isl_tokens: int, overlap: OverlapScores,
+        pool: Optional[set] = None,
     ) -> "SchedulingDecision":
-        """Pick a worker for a request with ``isl_tokens`` prompt tokens."""
+        """Pick a worker for a request with ``isl_tokens`` prompt tokens.
+
+        ``pool`` restricts the decision to one model's workers (the
+        per-model partition, registry/): ``model=`` selects the pool
+        BEFORE prefix scoring, and overlap credit outside the pool is
+        ignored — block hashes are token-based, so a same-prompt hit on
+        a different model's worker is a different model's KV."""
         if not self.workers:
             raise AllWorkersBusy("no workers with metrics")
         total_blocks_needed = math.ceil(isl_tokens / self.block_size)
 
+        # pool partition FIRST: workers outside the model's pool are a
+        # structural exclusion, not a drain/staleness event — they must
+        # not inflate those counters on every multi-pool decision
+        in_pool = self.workers
+        if pool is not None:
+            in_pool = {wid: s for wid, s in self.workers.items()
+                       if wid in pool}
+            if not in_pool:
+                raise AllWorkersBusy("no workers in the model's pool")
         # draining workers (recovery drain / rolling update) are out of
         # the pool outright — unlike staleness there is no fallback: a
         # drain is an explicit "send me nothing", and routing there
         # would hand the request straight to a migration
         candidates = {
-            wid: s for wid, s in self.workers.items()
+            wid: s for wid, s in in_pool.items()
             if not getattr(s.metrics, "draining", False)
         }
-        if len(candidates) < len(self.workers):
-            self.draining_skips += len(self.workers) - len(candidates)
+        if len(candidates) < len(in_pool):
+            self.draining_skips += len(in_pool) - len(candidates)
             logger.debug(
                 "kv schedule: skipping %d draining worker(s): %s",
-                len(self.workers) - len(candidates),
-                sorted(set(self.workers) - set(candidates)),
+                len(in_pool) - len(candidates),
+                sorted(set(in_pool) - set(candidates)),
             )
         if not candidates:
             raise AllWorkersBusy("all workers are draining")
@@ -182,6 +198,10 @@ class KvScheduler:
         # (kv/fabric.py) instead of recomputing
         best_owner, best_owned, best_key = None, 0, (0.0, 0)
         for wid in set(overlap.scores) | set(overlap.cold_scores):
+            if pool is not None and wid not in pool:
+                # another model's worker: its "overlap" is a token-hash
+                # coincidence, not pullable KV for this model
+                continue
             warm_b = overlap.scores.get(wid, 0)
             cold_b = overlap.cold_scores.get(wid, 0)
             # rank with the same discount the cost function uses (a

@@ -138,7 +138,15 @@ class Backend(Operator):
         # synchronously at the break.
         from contextlib import aclosing
 
-        engine_stream = next_engine.generate(request)
+        # re-bind across live migrations: a `migrated` control frame
+        # (recovery/migration.py) makes the wrapper attach directly to
+        # the peer so the draining source worker can exit instead of
+        # relaying this stream to its end; byte-identity is the
+        # migration plane's contract either way
+        from ..recovery.migration import follow_migrated_stream
+
+        engine_stream = follow_migrated_stream(
+            next_engine.generate(request), ctx=request.context)
         async with aclosing(engine_stream):
             async for out in engine_stream:
                 if isinstance(out, dict):  # off the wire

@@ -395,10 +395,38 @@ class SubprocessEngine(AsyncEngine):
         next request to pay the spawn."""
         self._down_listeners.append(fn)
 
-    async def respawn(self, reason: str = "manual") -> None:
+    async def respawn(self, reason: str = "manual", card=None) -> None:
         """Kill the current child (failing its streams) and bring a
         fresh one up NOW — the supervised-child half of a recovery
-        respawn or a rolling engine restart."""
+        respawn or a rolling engine restart.
+
+        ``card`` (a registry ModelCard or its wire dict) swaps the
+        model the child serves: the flag-driven "@jax" child re-reads
+        model_path/model_name on spawn, so a respawn with a different
+        card IS the multi-model cold start (registry/pools.py) —
+        hundreds of logical models per chip, one at a time."""
+        if card is not None:
+            flags = self.engine_args.get("flags")
+            if not isinstance(flags, dict):
+                from ...runtime.engine import EngineError
+
+                raise EngineError(
+                    "this engine host cannot swap model cards (no "
+                    "flag-driven child; serve out=jax --isolate-engine)"
+                )
+            wire = card.to_wire() if hasattr(card, "to_wire") else dict(card)
+            if not wire.get("model_path"):
+                from ...runtime.engine import EngineError
+
+                raise EngineError(
+                    f"model card {wire.get('name')!r} carries no "
+                    "model_path — cannot cold-start from it"
+                )
+            flags["model_path"] = wire["model_path"]
+            flags["model_name"] = wire.get("name") or flags.get("model_name")
+            if wire.get("kv_block_size"):
+                flags["kv_block_size"] = int(wire["kv_block_size"])
+            reason = f"{reason} (card={wire.get('name')})"
         await self._on_child_down(f"manual respawn: {reason}",
                                   kind="manual")
         await self._ensure_running()

@@ -45,10 +45,17 @@ class KvRoutedClient(AsyncEngine):
         token_ids = (
             req.token_ids if isinstance(req, PreprocessedRequest) else req["token_ids"]
         )
+        model = (req.model if isinstance(req, PreprocessedRequest)
+                 else req.get("model"))
+        if model is not None:
+            # per-model pool partition (registry/): the KV router scopes
+            # prefix scoring to the model's pool, and the client's
+            # fallback/round-robin pick stays inside it too
+            request.baggage["model_pool"] = model
         if self.router is not None:
             try:
                 decision = await self.router.schedule(
-                    token_ids, trace_id=request.trace_id
+                    token_ids, trace_id=request.trace_id, model=model
                 )
                 request.baggage["instance_id"] = decision.worker_id
                 request.baggage["prefix_hit_tokens"] = decision.prefix_hit_tokens

@@ -173,6 +173,11 @@ class EngineOutput:
     prompt_logprobs: Optional[List[Optional[float]]] = None
     # KV/scheduling telemetry piggybacked on outputs (optional)
     kv_transfer_params: Optional[dict] = None
+    # migration control frame (recovery/migration.py): the request now
+    # lives on a peer — ``{host, port, resume_id}`` lets the consumer
+    # re-bind its stream directly to the peer so the source worker can
+    # exit instead of staying up to relay. Carries no client payload.
+    migrated: Optional[dict] = None
 
     def to_wire(self) -> dict:
         d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
@@ -198,6 +203,8 @@ class EngineOutput:
             ]
         if self.kv_transfer_params is not None:
             d["kv_transfer_params"] = self.kv_transfer_params
+        if self.migrated is not None:
+            d["migrated"] = self.migrated
         return d
 
     @classmethod
@@ -220,6 +227,7 @@ class EngineOutput:
             else None,
             prompt_logprobs=d.get("prompt_logprobs"),
             kv_transfer_params=d.get("kv_transfer_params"),
+            migrated=d.get("migrated"),
         )
 
 

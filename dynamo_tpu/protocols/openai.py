@@ -244,9 +244,12 @@ class ModelInfo(BaseModel):
     object: Literal["model"] = "model"
     created: int = Field(default_factory=lambda: int(time.time()))
     owned_by: str = "dynamo-tpu"
-    # dynamo extensions (reference http/service/openai.rs model metadata)
+    # dynamo extensions (reference http/service/openai.rs model metadata;
+    # family/aliases come from the model registry's cards)
     max_model_len: Optional[int] = None
     model_type: Optional[str] = None
+    family: Optional[str] = None
+    aliases: Optional[List[str]] = None
 
 
 class ModelList(BaseModel):

@@ -127,6 +127,9 @@ class RecoveryController:
         self._drains_inflight = 0
         self.consecutive_respawn_failures = 0
         self.recoveries: List[dict] = []  # public record for tests
+        # respawn-with-a-different-card (registry/pools.py cold start):
+        # set by respawn_with_card for the duration of one respawn
+        self._pending_card = None
 
     # ---------- subscriptions ----------
 
@@ -348,6 +351,35 @@ class RecoveryController:
         er.out_queue.put_nowait(None)
         self._actions.inc(action="migrate", outcome="failed")
 
+    async def respawn_with_card(self, card) -> bool:
+        """Model-swap / scale-from-zero respawn: drain whatever this
+        engine is serving (migrating its requests away) and rebuild it
+        with a DIFFERENT model card — the one new recovery capability
+        the multi-model pool plane needs (registry/pools.py cold start).
+        The respawner must accept a ``card`` keyword (SubprocessEngine
+        .respawn does; a factory that cannot swap cards fails loudly).
+
+        Scope: the SINGLE-ENGINE serving shapes (in=http with a local
+        supervised engine), where the frontend's own ModelManager is
+        the routing truth. A dyn:// worker registered in discovery with
+        ``metadata={"model": ...}`` must NOT be card-swapped in place —
+        its endpoint metadata, model-registry record, and model gauge
+        all still name the old model, so per-model clients and the KV
+        router would route the old model's traffic to the new one.
+        Fleet pools swap models by spawning fresh workers with the new
+        card's flags (KubePoolBackend / StorePoolBackend) instead."""
+        self._drains_inflight += 1
+        try:
+            self._pending_card = card
+            summary = await self._drain(
+                hard=False, migrate=True, respawn=True,
+                reason=f"model_swap:{getattr(card, 'name', card)}",
+            )
+            return bool(summary.get("respawned"))
+        finally:
+            self._pending_card = None
+            self._drains_inflight -= 1
+
     async def _respawn(self, reason: str) -> bool:
         delay = self.config.respawn_backoff_s
         while True:
@@ -360,7 +392,10 @@ class RecoveryController:
                 self._actions.inc(action="respawn", outcome="gave_up")
                 return False
             try:
-                result = await self.respawner()
+                if self._pending_card is not None:
+                    result = await self.respawner(card=self._pending_card)
+                else:
+                    result = await self.respawner()
             except Exception as e:
                 self.consecutive_respawn_failures += 1
                 self._actions.inc(action="respawn", outcome="failed")

@@ -26,6 +26,18 @@ them into the original request's output queue. The hop is recorded as a
 reservation on the receiver (blocks freed, nothing installed); a death
 after commit cancels the resumed request (its relay target is gone).
 
+**Stream re-bind** — relaying forever would pin the source worker up
+just to forward a peer's bytes, defeating the drain. So the commit ack
+carries a ``resume_id``, the source emits a ``migrated`` control frame
+(an :class:`EngineOutput` with no payload) into the client stream, and
+a re-bind-aware consumer (llm/backend.py via
+:func:`follow_migrated_stream`) attaches DIRECTLY to the peer
+(``mig_attach``). The peer's pump switches to the new connection —
+sending ``mig_handoff`` on the old one in order, so no frame is lost
+or duplicated — the source's relay ends, and the source worker can
+exit. A consumer that never attaches (raw token-level readers) gets
+the full relayed stream exactly as before.
+
 Wire format (4-byte length-prefixed msgpack headers + raw payloads, the
 transfer plane's framing), one migration per connection::
 
@@ -33,9 +45,16 @@ transfer plane's framing), one migration per connection::
     ← {type:"mig_ack", ok, reason?, recv_at, sent_at}
     → {type:"mig_blocks", offset, shape, dtype, k_bytes, v_bytes} <k> <v>
     → {type:"mig_commit"}
-    ← {type:"mig_ack", ok, reason?}
+    ← {type:"mig_ack", ok, reason?, resume_id}
     ← {type:"mig_data", payload: EngineOutput wire} ...
+    ← {type:"mig_handoff"}                     (re-bind: relay duty ends)
     ← {type:"mig_end", spans?, children?} | {type:"mig_error", error}
+
+and, on a re-bind connection::
+
+    → {type:"mig_attach", resume_id, sent_at}
+    ← {type:"mig_ack", ok, reason?, recv_at, sent_at}
+    ← {type:"mig_data", ...} ... {type:"mig_end", ...}
 
 The ``sent_at``/``recv_at`` wall-clock pair on the begin/ack exchange is
 the hop's clock-offset estimate (telemetry/stitch.py); ``mig_end`` then
@@ -341,6 +360,30 @@ class MigrationSink:
             )
 
 
+_STREAM_END = object()  # sentinel: the out_queue terminal None, popped
+
+
+class _Resume:
+    """One installed migrated request and its pump-handoff state."""
+
+    __slots__ = ("er", "attach_writer", "attach_evt", "released",
+                 "pending_get", "pending_out", "done")
+
+    def __init__(self, er):
+        self.er = er
+        self.attach_writer = None      # set by a mig_attach connection
+        self.attach_evt = asyncio.Event()
+        self.released = asyncio.Event()  # original pump gave the stream up
+        # an out_queue.get in flight across the handoff: the popped-but-
+        # unwritten output must reach the NEW connection, not vanish
+        self.pending_get: Optional[asyncio.Task] = None
+        # a popped output whose WRITE never completed (handoff, or the
+        # relay connection dying mid-frame): the successor pump re-sends
+        # it — exactly-once framing, byte-identity preserved
+        self.pending_out = None        # EngineOutput | _STREAM_END | None
+        self.done = False              # stream ended (mig_end DELIVERED)
+
+
 class MigrationServer:
     """TCP receiver for inbound migrations, one migration per connection.
 
@@ -348,7 +391,10 @@ class MigrationServer:
     request's outputs ride back as ``mig_data`` frames until the stream
     ends. A connection death before commit aborts the reservation (the
     transfer plane's poison discipline); after commit it cancels the
-    resumed request — its relay target is gone."""
+    resumed request — its relay target is gone. A ``mig_attach``
+    connection re-binds the stream to a direct consumer: the pump
+    switches writers atomically (``mig_handoff`` closes the old
+    connection's duty in order) so the source worker can exit."""
 
     def __init__(self, sink: MigrationSink, host: str = "127.0.0.1",
                  port: int = 0):
@@ -356,6 +402,7 @@ class MigrationServer:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
+        self._resumes: Dict[str, _Resume] = {}
 
     async def start(self) -> "MigrationServer":
         self._server = await asyncio.start_server(
@@ -416,9 +463,35 @@ class MigrationServer:
                         await writer.drain()
                         return
                     mig_id = None  # installed: no reservation to abort
-                    _pack(writer, {"type": "mig_ack", "ok": True})
+                    resume_id = uuid.uuid4().hex
+                    resume = _Resume(er)
+                    self._resumes[resume_id] = resume
+                    _pack(writer, {"type": "mig_ack", "ok": True,
+                                   "resume_id": resume_id})
                     await writer.drain()
-                    await self._pump(er, writer)
+                    handed_off = False
+                    try:
+                        handed_off = await self._pump(
+                            resume, writer, accept_attach=True)
+                    finally:
+                        if (not handed_off
+                                and resume.attach_writer is not None
+                                and not resume.done):
+                            # the relay connection died RACING an attach
+                            # (the draining source exiting is exactly
+                            # when consumers attach): the attached
+                            # consumer owns the live stream — its pump
+                            # proceeds off resume.released
+                            handed_off = True
+                        if not handed_off:
+                            self._resumes.pop(resume_id, None)
+                        if handed_off:
+                            # this connection's death must NOT cancel
+                            # the request: a direct consumer has it
+                            er = None
+                    return
+                elif mtype == "mig_attach":
+                    await self._handle_attach(header, writer)
                     return
                 else:
                     logger.error("unknown migration frame %r", mtype)
@@ -442,24 +515,99 @@ class MigrationServer:
                 er.ctx.stop_generating()
             writer.close()
 
-    async def _pump(self, er, writer: asyncio.StreamWriter) -> None:
-        """Stream the resumed request's outputs back to the sender."""
-        while True:
-            out = await er.out_queue.get()
-            if out is None:
-                # span export rides the stream-end frame: the peer's
-                # migration.resume → decode → completion marks (and any
-                # remote sets the peer itself collected) land in the
-                # source's stitched trace instead of a silent gap
-                _pack(writer, {
-                    "type": "mig_end",
-                    "spans": er.ctx.export_spans(),
-                    "children": list(er.ctx.remote_spans),
-                })
-                await writer.drain()
-                return
-            _pack(writer, {"type": "mig_data", "payload": out.to_wire()})
+    async def _handle_attach(self, header: dict,
+                             writer: asyncio.StreamWriter) -> None:
+        """A consumer re-binding a migrated stream to itself."""
+        resume_id = header.get("resume_id") or ""
+        resume = self._resumes.get(resume_id)
+        if resume is None or resume.attach_writer is not None:
+            _pack(writer, {"type": "mig_ack", "ok": False,
+                           "reason": f"unknown or already-attached "
+                                     f"resume id {resume_id!r}"})
             await writer.drain()
+            return
+        recv_at = time.time()
+        _pack(writer, {"type": "mig_ack", "ok": True,
+                       "recv_at": recv_at, "sent_at": time.time()})
+        await writer.drain()
+        resume.attach_writer = writer
+        resume.attach_evt.set()
+        # wait for the original pump to hand the stream off (it sends
+        # mig_handoff on its own connection first, preserving order)
+        await resume.released.wait()
+        er = resume.er
+        try:
+            if not resume.done:
+                await self._pump(resume, writer, accept_attach=False)
+        finally:
+            self._resumes.pop(resume_id, None)
+            if er.finish is None and not resume.done:
+                # the attached consumer died mid-stream: stop the
+                # resumed request — nobody is listening anymore
+                er.ctx.stop_generating()
+
+    async def _pump(self, resume: _Resume, writer: asyncio.StreamWriter,
+                    accept_attach: bool) -> bool:
+        """Stream the resumed request's outputs to ``writer``; returns
+        True when the stream was handed off to an attach connection."""
+        er = resume.er
+        try:
+            while True:
+                if (accept_attach and resume.attach_writer is not None
+                        and writer is not resume.attach_writer):
+                    # a direct consumer attached: frames written so far
+                    # precede the handoff marker on this connection, all
+                    # later ones go to the new connection — exactly-once
+                    _pack(writer, {"type": "mig_handoff"})
+                    await writer.drain()
+                    return True
+                out = resume.pending_out
+                if out is None:
+                    get_task = resume.pending_get
+                    if get_task is None:
+                        get_task = asyncio.ensure_future(
+                            er.out_queue.get())
+                        resume.pending_get = get_task
+                    if accept_attach:
+                        attach_task = asyncio.ensure_future(
+                            resume.attach_evt.wait())
+                        try:
+                            await asyncio.wait(
+                                {get_task, attach_task},
+                                return_when=asyncio.FIRST_COMPLETED,
+                            )
+                        finally:
+                            attach_task.cancel()
+                        if not get_task.done():
+                            continue  # woken by the attach — see above
+                    fetched = await get_task
+                    resume.pending_get = None
+                    out = _STREAM_END if fetched is None else fetched
+                    resume.pending_out = out
+                if out is _STREAM_END:
+                    # span export rides the stream-end frame: the peer's
+                    # migration.resume → decode → completion marks (and
+                    # any remote sets the peer itself collected) land in
+                    # the consumer's stitched trace, not a silent gap
+                    _pack(writer, {
+                        "type": "mig_end",
+                        "spans": er.ctx.export_spans(),
+                        "children": list(er.ctx.remote_spans),
+                    })
+                    await writer.drain()
+                    # done only once DELIVERED: a relay death mid-end
+                    # leaves it pending for the attach pump to re-send
+                    resume.done = True
+                    resume.pending_out = None
+                    return False
+                _pack(writer, {"type": "mig_data",
+                               "payload": out.to_wire()})
+                await writer.drain()
+                resume.pending_out = None
+        finally:
+            # whatever ended this pump (handoff, stream end, conn death),
+            # a waiting attach handler must not hang on released
+            resume.released.set()
 
     async def close(self) -> None:
         if self._server is not None:
@@ -551,6 +699,14 @@ async def migrate_request(
     # committed: the peer owns the request now. Stamp the hop where
     # /debug/requests/{id} will show it, then relay — the peer's half of
     # the timeline (migration.resume onward) arrives with mig_end.
+    resume_id = ack.get("resume_id")
+    if resume_id:
+        # the re-bind offer: a follow_migrated_stream consumer attaches
+        # directly to the peer and this worker's relay duty ends at the
+        # handoff; consumers that ignore it get the full relay as before
+        er.out_queue.put_nowait(EngineOutput(migrated={
+            "host": host, "port": port, "resume_id": resume_id,
+        }))
     er.ctx.add_stage("migration.relay")
     flight_recorder().record(
         "recovery.migrate", request_id=er.request_id,
@@ -599,6 +755,18 @@ async def _relay(reader: asyncio.StreamReader,
                 er.out_queue.put_nowait(None)
                 ended = True
                 return
+            elif mtype == "mig_handoff":
+                # a downstream consumer attached directly to the peer:
+                # relay duty ends, the source stream closes cleanly (no
+                # finish — the consumer continues on its own conn), and
+                # this worker is free to exit
+                flight_recorder().record(
+                    "recovery.migrate_handoff", request_id=er.request_id,
+                    trace_id=er.ctx.trace_id,
+                )
+                er.out_queue.put_nowait(None)
+                ended = True
+                return
             elif mtype == "mig_error":
                 logger.error("migrated request %s failed remotely: %s",
                              er.request_id, header.get("error"))
@@ -617,3 +785,170 @@ async def _relay(reader: asyncio.StreamReader,
                              finish_reason=FinishReason.ERROR)
             )
             er.out_queue.put_nowait(None)
+
+
+# ---------------------------------------------------------------------------
+# stream re-bind (consumer side)
+# ---------------------------------------------------------------------------
+
+
+async def _fold_end_spans(reader, ctx, offset: float, rtt: float,
+                          timeout_s: float = 0.25) -> None:
+    """Bounded read-ahead for the ``mig_end`` behind a finish frame;
+    folds the peer's span export into ``ctx``. Best-effort: a peer that
+    never sends it costs ``timeout_s``, nothing else."""
+    try:
+        end = await asyncio.wait_for(_read_header(reader), timeout_s)
+    except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+            ConnectionResetError, OSError):
+        return
+    if (end and end.get("type") == "mig_end" and end.get("spans")
+            and ctx is not None):
+        ctx.add_remote_spans({
+            "source": "migration_peer",
+            "spans": end["spans"],
+            "offset_s": round(offset, 6),
+            "rtt_s": round(rtt, 6),
+            "children": end.get("children") or [],
+        })
+
+
+async def _open_attach(info: dict, connect_timeout_s: float = 5.0):
+    """Dial the peer and bind to a migrated request's resumed stream.
+    Returns ``(reader, writer, offset, rtt)`` after the attach ack —
+    the wall pair is the hop's clock-offset estimate for the span fold."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(info["host"], info["port"]),
+        connect_timeout_s,
+    )
+    try:
+        sent_at = time.time()
+        _pack(writer, {"type": "mig_attach",
+                       "resume_id": info["resume_id"],
+                       "sent_at": sent_at})
+        await writer.drain()
+        ack = await _read_header(reader)
+        if ack is None or not ack.get("ok"):
+            raise MigrationRejected(
+                (ack or {}).get("reason", "peer closed during attach")
+            )
+        offset = rtt = 0.0
+        if ack.get("recv_at"):
+            from ..telemetry.stitch import estimate_offset
+
+            offset, rtt = estimate_offset(
+                sent_at, ack["recv_at"],
+                ack.get("sent_at", ack["recv_at"]), time.time(),
+            )
+        return reader, writer, offset, rtt
+    except BaseException:
+        writer.close()
+        raise
+
+
+async def follow_migrated_stream(stream, ctx=None):
+    """Wrap an engine's output stream, transparently re-binding across
+    migrations.
+
+    Yields :class:`EngineOutput` objects (wire dicts are decoded). On a
+    ``migrated`` control frame the attach handshake starts IMMEDIATELY
+    and concurrently with the source's relay — the peer switches its
+    pump on receipt, the source stream ends at the handoff, and this
+    generator continues byte-identically from the direct connection.
+    The source worker is then free to exit. If the attach fails the
+    relay keeps carrying the stream exactly as before.
+
+    ``ctx`` (an AsyncEngineContext) receives the peer's span export
+    from ``mig_end`` so the stitched trace shows the resumed half.
+    """
+    from contextlib import aclosing
+
+    rebind: Optional[dict] = None
+    attach_task: Optional[asyncio.Task] = None
+    try:
+        async with aclosing(stream) as s:
+            async for out in s:
+                if isinstance(out, dict):
+                    out = EngineOutput.from_wire(out)
+                if out.migrated:
+                    rebind = dict(out.migrated)
+                    attach_task = asyncio.get_running_loop().create_task(
+                        _open_attach(rebind),
+                        name=f"mig-attach-{rebind.get('resume_id', '?')[:8]}",
+                    )
+                    continue  # control frame: never client payload
+                yield out
+                if out.finish_reason is not None:
+                    return
+        # the source stream ended without a finish: a handoff (we
+        # attached) — continue on the direct connection — or a genuine
+        # cancellation (nothing to attach to)
+        while attach_task is not None:
+            try:
+                reader, writer, offset, rtt = await attach_task
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning(
+                    "stream re-bind to %s:%s failed (%s); the stream "
+                    "ends with the source's relay",
+                    rebind.get("host"), rebind.get("port"), e,
+                )
+                return
+            attach_task = None
+            try:
+                while True:
+                    header = await _read_header(reader)
+                    if header is None:
+                        yield EngineOutput(token_ids=[],
+                                           finish_reason=FinishReason.ERROR)
+                        return
+                    mtype = header.get("type")
+                    if mtype == "mig_data":
+                        out = EngineOutput.from_wire(
+                            header.get("payload") or {})
+                        if out.migrated:
+                            # chained migration: the peer itself drained
+                            rebind = dict(out.migrated)
+                            attach_task = (
+                                asyncio.get_running_loop().create_task(
+                                    _open_attach(rebind)))
+                            continue
+                        if out.finish_reason is not None:
+                            # mig_end (the span export) is right behind
+                            # the finish frame — read it BEFORE yielding,
+                            # because a detokenizing consumer breaks (and
+                            # acloses us) at the finish chunk
+                            await _fold_end_spans(reader, ctx, offset, rtt)
+                            yield out
+                            return
+                        yield out
+                    elif mtype == "mig_end":
+                        if header.get("spans") and ctx is not None:
+                            ctx.add_remote_spans({
+                                "source": "migration_peer",
+                                "spans": header["spans"],
+                                "offset_s": round(offset, 6),
+                                "rtt_s": round(rtt, 6),
+                                "children": header.get("children") or [],
+                            })
+                        break  # an attach_task from a chained migration continues
+                    elif mtype == "mig_error":
+                        yield EngineOutput(token_ids=[],
+                                           finish_reason=FinishReason.ERROR)
+                        return
+                    else:
+                        logger.error("unknown attach frame %r", mtype)
+                        return
+            finally:
+                writer.close()
+    finally:
+        if attach_task is not None:
+            if (attach_task.done() and not attach_task.cancelled()
+                    and attach_task.exception() is None):
+                # the handshake completed but the stream ended through
+                # the relay first — cancel() would be a no-op on the
+                # done task, leaking the opened connection
+                attach_task.result()[1].close()
+            else:
+                attach_task.cancel()

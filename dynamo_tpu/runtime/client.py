@@ -41,9 +41,16 @@ class NoInstancesError(ConnectionError):
 class Client(AsyncEngine):
     """Streaming client for one endpoint."""
 
-    def __init__(self, endpoint: Endpoint, mode: RouterMode = RouterMode.ROUND_ROBIN):
+    def __init__(self, endpoint: Endpoint, mode: RouterMode = RouterMode.ROUND_ROBIN,
+                 model: Optional[str] = None):
         self.endpoint = endpoint
         self.mode = mode
+        # per-model pool filter (registry/): several model pools can
+        # share one component endpoint — each instance's registration
+        # metadata names the model it serves, and a model-bound client
+        # only routes within its pool. Instances registered WITHOUT a
+        # model are wildcard-eligible (legacy single-model workers).
+        self.model = model
         self.instances: Dict[str, dict] = {}
         self._rr = itertools.count()
         self._watch_task: Optional[asyncio.Task] = None
@@ -88,9 +95,21 @@ class Client(AsyncEngine):
     def instance_ids(self) -> list:
         return sorted(self.instances)
 
+    def eligible_ids(self, model: Optional[str] = None) -> list:
+        """Instance ids in the routing pool: all of them for an
+        unfiltered client, otherwise those whose registration metadata
+        matches the model (missing metadata = wildcard)."""
+        model = model if model is not None else self.model
+        if model is None:
+            return sorted(self.instances)
+        return sorted(
+            iid for iid, info in self.instances.items()
+            if info.get("model") in (None, model)
+        )
+
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
         async def _wait():
-            while len(self.instances) < n:
+            while len(self.eligible_ids()) < n:
                 self._instances_changed.clear()
                 await self._instances_changed.wait()
 
@@ -98,7 +117,8 @@ class Client(AsyncEngine):
 
     # --- routing ---
 
-    def _pick(self, instance_id: Optional[str]) -> str:
+    def _pick(self, instance_id: Optional[str],
+              model: Optional[str] = None) -> str:
         if self.mode == RouterMode.STATIC:
             return "static"
         if instance_id is not None:
@@ -107,16 +127,19 @@ class Client(AsyncEngine):
                     f"instance {instance_id} not found for {self.endpoint.path()}"
                 )
             return instance_id
-        ids = self.instance_ids()
+        ids = self.eligible_ids(model)
         if not ids:
-            raise NoInstancesError(f"no instances for {self.endpoint.path()}")
+            model = model if model is not None else self.model
+            pool = f" serving model {model!r}" if model else ""
+            raise NoInstancesError(
+                f"no instances{pool} for {self.endpoint.path()}")
         if self.mode == RouterMode.RANDOM:
             return random.choice(ids)
         return ids[next(self._rr) % len(ids)]
 
     async def open_stream(
         self, payload: Any, instance_id: Optional[str] = None,
-        trace_id: Optional[str] = None,
+        trace_id: Optional[str] = None, model: Optional[str] = None,
     ) -> ResponseReceiver:
         """Route, push the request, return the dialed-back response stream.
 
@@ -126,7 +149,7 @@ class Client(AsyncEngine):
         """
         if not self._started:
             await self.start()
-        target = self._pick(instance_id)
+        target = self._pick(instance_id, model)
         drt = self.endpoint.drt
         conn, receiver = await open_response_stream(drt.stream_server, drt.local)
         req_id = uuid.uuid4().hex
@@ -154,7 +177,11 @@ class Client(AsyncEngine):
         """AsyncEngine over the network: request context controls propagate."""
         instance_id = request.baggage.get("instance_id")
         receiver = await self.open_stream(
-            request.payload, instance_id, trace_id=request.trace_id
+            request.payload, instance_id, trace_id=request.trace_id,
+            # the processor stamps the request's model so a shared-
+            # endpoint fallback pick (router down / non-KV modes) still
+            # lands inside the right pool
+            model=request.baggage.get("model_pool"),
         )
         await receiver.wait_prologue()
 

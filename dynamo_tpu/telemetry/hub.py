@@ -427,9 +427,17 @@ class FleetHub:
             judged = hist.rate("dynamo_slo_attainment_total",
                                {"slo": "request"}, window_s=slo_window_s)
             draining = hist.latest("dynamo_scheduler_draining_info")
+            # the model this worker serves (multi-model fleet): workers
+            # stamp dynamo_registry_model_info{model=} on their registry
+            model = None
+            for labels, _v in hist.samples("dynamo_registry_model_info"):
+                if labels.get("model"):
+                    model = labels["model"]
+                    break
             row = {
                 "name": w.name,
                 "role": w.role,
+                "model": model,
                 "url": w.url,
                 "up": self._up(w),
                 "scrape_age_s": (

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""dynamoctl: manage the multi-model fleet through a frontend's admin API.
+
+The ``llmctl`` analogue for the registry plane (docs/multi_model.md):
+where ``cli/llmctl.py`` writes discovery records directly (and so needs
+a dynstore address), dynamoctl speaks HTTP to any running frontend —
+``POST/DELETE /admin/models`` + the read surfaces — so an operator can
+drive the fleet from anywhere the frontend is reachable.
+
+    dynamoctl --frontend http://host:8080 models list
+    dynamoctl models add m8b dyn://public.backend.generate \
+        --family llama --context-length 8192 --alias m8b-fast \
+        --tenants acme,globex
+    dynamoctl models remove m8b
+    dynamoctl models catalog --tenant acme      # the tenant's /v1/models
+    dynamoctl pools                             # pool sizes + cold state
+
+Exit codes: 0 ok, 1 server-side refusal (4xx/5xx), 2 usage/unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Optional, Tuple
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamoctl")
+    p.add_argument("--frontend", default="http://127.0.0.1:8080",
+                   help="frontend base URL (the HTTP service with the "
+                        "admin API)")
+    sub = p.add_subparsers(dest="plane", required=True)
+
+    models = sub.add_parser("models", help="manage registered model cards")
+    msub = models.add_subparsers(dest="action", required=True)
+
+    add = msub.add_parser("add", help="register a model card dynamically")
+    add.add_argument("name")
+    add.add_argument("endpoint", help="dyn://ns.comp.ep of the pool")
+    add.add_argument("--model-type", default="both",
+                     choices=["chat", "completions", "both"])
+    add.add_argument("--family", default=None)
+    add.add_argument("--context-length", type=int, default=None)
+    add.add_argument("--alias", action="append", default=None,
+                     help="served alias (repeatable)")
+    add.add_argument("--tenants", default=None,
+                     help="comma-separated tenant allow list "
+                          "(unset = public)")
+    add.add_argument("--owned-by", default="dynamo")
+    add.add_argument("--model-path", default=None,
+                     help="checkpoint dir for cold-start respawns")
+
+    rm = msub.add_parser("remove", help="unregister a model")
+    rm.add_argument("name")
+
+    msub.add_parser("list", help="registered cards (admin view)")
+    cat = msub.add_parser("catalog",
+                          help="the OpenAI /v1/models view, optionally "
+                               "as one tenant")
+    cat.add_argument("--tenant", default=None)
+
+    sub.add_parser("pools", help="per-model pool state "
+                                 "(workers, idle age, cold starts)")
+    return p
+
+
+def _call(method: str, url: str, body: Optional[dict] = None,
+          headers: Optional[dict] = None) -> Tuple[int, dict]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read().decode() or "{}")
+        except json.JSONDecodeError:
+            payload = {"error": str(e)}
+        return e.code, payload
+    except (urllib.error.URLError, OSError) as e:
+        print(f"frontend unreachable at {url}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    base = args.frontend.rstrip("/")
+
+    if args.plane == "pools":
+        status, body = _call("GET", f"{base}/admin/pools")
+        if status != 200:
+            print(body.get("error", body), file=sys.stderr)
+            return 1
+        pools = body.get("pools", [])
+        if not pools:
+            print("(no pools)")
+        for row in pools:
+            cold = " COLD-STARTING" if row.get("cold_starting") else ""
+            print(f"{row['model']:30s} workers={row['workers']:<3d} "
+                  f"idle={row['idle_s']:>8.1f}s "
+                  f"requests={row['requests_total']}{cold}")
+        return 0
+
+    if args.action == "add":
+        card = {
+            "name": args.name,
+            "endpoint": args.endpoint,
+            "model_type": args.model_type,
+            "family": args.family,
+            "context_length": args.context_length,
+            "aliases": args.alias or [],
+            "owned_by": args.owned_by,
+            "model_path": args.model_path,
+        }
+        if args.tenants is not None:
+            card["tenants"] = [t.strip() for t in args.tenants.split(",")
+                               if t.strip()]
+        status, body = _call("POST", f"{base}/admin/models", body=card)
+        if status != 200:
+            print(body.get("error", body), file=sys.stderr)
+            return 1
+        print(f"registered {body.get('registered', args.name)} -> "
+              f"{args.endpoint}")
+        return 0
+
+    if args.action == "remove":
+        status, body = _call("DELETE", f"{base}/admin/models/{args.name}")
+        if status != 200:
+            print(body.get("error", body), file=sys.stderr)
+            return 1
+        print(f"removed {body.get('removed', args.name)}")
+        return 0
+
+    if args.action == "list":
+        status, body = _call("GET", f"{base}/admin/models")
+        if status != 200:
+            print(body.get("error", body), file=sys.stderr)
+            return 1
+        cards = body.get("models", [])
+        if not cards:
+            print("(no models registered)")
+        for c in cards:
+            vis = ("public" if c.get("tenants") is None
+                   else ",".join(c["tenants"]) or "admin-only")
+            aliases = f" aliases={','.join(c['aliases'])}" \
+                if c.get("aliases") else ""
+            print(f"{c.get('model_type', '?'):12s} {c['name']:26s} "
+                  f"{c.get('endpoint', '?'):40s} "
+                  f"family={c.get('family') or '-':10s} "
+                  f"tenants={vis}{aliases}")
+        return 0
+
+    if args.action == "catalog":
+        headers = {"X-Tenant": args.tenant} if args.tenant else None
+        status, body = _call("GET", f"{base}/v1/models", headers=headers)
+        if status != 200:
+            print(body.get("error", body), file=sys.stderr)
+            return 1
+        for m in body.get("data", []):
+            extras = []
+            if m.get("family"):
+                extras.append(f"family={m['family']}")
+            if m.get("max_model_len"):
+                extras.append(f"ctx={m['max_model_len']}")
+            if m.get("aliases"):
+                extras.append(f"aliases={','.join(m['aliases'])}")
+            print(f"{m['id']:30s} owned_by={m.get('owned_by', '?'):12s} "
+                  + " ".join(extras))
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

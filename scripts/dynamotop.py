@@ -59,7 +59,8 @@ def _state(w: dict) -> str:
 
 def render_workers(workers: List[dict]) -> List[str]:
     lines = [
-        f"{'WORKER':<26} {'ROLE':<14} {'STATE':<6} {'BUSY':>5} "
+        f"{'WORKER':<26} {'ROLE':<14} {'MODEL':<14} {'STATE':<6} "
+        f"{'BUSY':>5} "
         f"{'KV':>5} {'WAIT':>4} {'ROOF':>5} {'HIT':>5} {'PULL':>5} "
         f"{'SLO':>5} {'TRIP':>4} {'REQ/S':>6} {'AGE':>5}"
     ]
@@ -74,6 +75,7 @@ def render_workers(workers: List[dict]) -> List[str]:
         lines.append(
             f"{str(w.get('name', '?')):<26.26} "
             f"{str(w.get('role', '?')):<14.14} "
+            f"{str(w.get('model') or '-'):<14.14} "
             f"{_state(w):<6} "
             f"{_pct(w.get('busy_ratio')):>5} "
             f"{_pct(w.get('kv_usage_ratio')):>5} "

@@ -1217,3 +1217,52 @@ def test_async_blocking_flags_cold_spill_write_on_loop():
     )
     assert [f.rule for f in out] == ["async-blocking"]
     assert "open" in out[0].message
+
+
+# --------------------------------------------------------------------------
+# multi-model registry plane: watch/pool loops share the serving loop
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_registry_modules_pass_async_blocking_and_task_leak():
+    """The registry's pool-policy loop, cold-start tasks, and quota
+    buckets all run ON the frontend's serving loop (single-loop
+    discipline like the admission controller): a blocking call stalls
+    every request, and a dropped cold-start or policy-loop task is a
+    spawn nobody can cancel or observe failing. Pin the whole package
+    with ZERO findings (not baseline-covered ones) on the two rules
+    that police exactly that."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "registry", "cards.py"),
+        os.path.join(PACKAGE_ROOT, "registry", "registry.py"),
+        os.path.join(PACKAGE_ROOT, "registry", "pools.py"),
+        os.path.join(PACKAGE_ROOT, "registry", "policy.py"),
+        os.path.join(PACKAGE_ROOT, "registry", "tenants.py"),
+    ]
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "registry plane regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_task_leak_flags_discarded_registry_watch_task():
+    """TP fixture shaped like the tempting-but-wrong registry watcher:
+    spawning the watch loop without holding the task means a worker
+    churn event after GC silently stops rebinding routes — models keep
+    serving stale pools and nobody sees the exception."""
+    out = findings(
+        """
+        import asyncio
+
+        class RegistryWatcher:
+            async def start(self, watcher):
+                asyncio.create_task(self._watch_loop(watcher))
+
+            async def _watch_loop(self, watcher):
+                async for ev in watcher:
+                    self.apply(ev)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]

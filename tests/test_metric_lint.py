@@ -108,10 +108,22 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_kv_fabric_cold_tier_misses_total",
         "dynamo_kv_fabric_cold_tier_evictions_total",
         "dynamo_kv_fabric_cold_tier_bytes",
+        # multi-model multi-tenant fleet (registry/: registry.py cards
+        # view, pools.py scale-to-zero + cold start, tenants.py token
+        # buckets; cli/run.py worker model advertisement)
+        "dynamo_registry_models_info",
+        "dynamo_registry_model_info",
+        "dynamo_registry_pool_workers_replicas",
+        "dynamo_registry_cold_starts_total",
+        "dynamo_registry_scale_to_zero_total",
+        "dynamo_registry_cold_start_wait_seconds",
+        "dynamo_registry_tenant_sheds_total",
+        "dynamo_registry_tenant_fallbacks_total",
+        "dynamo_registry_tenant_tokens_total",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 89
+    assert len(names) >= 98
 
 
 def _metric(name, kind):

@@ -706,3 +706,127 @@ async def test_receiver_nacks_oversized_migration():
         sink.reserve(state3, config.blocks_per_seq + 1)
     assert dst.allocator.used == 0
     assert all(s is None for s in dst.slots)
+
+
+# --------------------------------------------------------------------------
+# stream re-bind: the source relay exits at the handoff (ISSUE 12
+# satellite; the PR 8 carry-over)
+# --------------------------------------------------------------------------
+
+
+async def test_stream_rebind_lets_source_relay_exit():
+    """A follow_migrated_stream consumer sees the `migrated` control
+    frame, attaches directly to the peer, the peer's pump hands off
+    (mig_handoff → the source's relay ends while the peer is STILL
+    generating), and the continued stream is byte-identical."""
+    from dynamo_tpu.recovery.migration import follow_migrated_stream
+    from dynamo_tpu.telemetry.flight import flight_recorder
+
+    config = _config()
+    prompt = [1, 17, 43]
+    max_tokens = 48
+    src_runner = MigRunner(config, sync_delay=0.02)
+    # the peer decodes slowly too, so the attach handshake (and the
+    # handoff) reliably lands mid-stream, not after it ended
+    dst_runner = MigRunner(config, sync_delay=0.02)
+    src = Scheduler(src_runner, config, flight=FlightRecorder())
+    dst = Scheduler(dst_runner, config, flight=FlightRecorder())
+    src.start()
+    dst.start()
+    server = await MigrationServer(MigrationSink(dst, dst_runner)).start()
+    controller = RecoveryController(
+        engine_id="src", scheduler=src, runner=src_runner,
+        peers=lambda: [{"host": server.host, "port": server.port,
+                        "engine_id": "dst"}],
+        config=RecoveryConfig(drain_grace_s=0.05),
+        flight=src.flight,
+    )
+    er = _request(prompt, max_tokens)
+    src.add_request(er)
+
+    async def queue_stream():
+        while True:
+            out = await er.out_queue.get()
+            if out is None:
+                return
+            yield out
+
+    toks = []
+    finish = None
+
+    async def consume():
+        nonlocal finish
+        stream = follow_migrated_stream(queue_stream(), ctx=er.ctx)
+        async for out in stream:
+            assert out.migrated is None, "control frame leaked"
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                finish = out.finish_reason
+
+    async def watch_relay():
+        # how many tokens the CLIENT had when the source's relay duty
+        # ended — the handoff must land mid-stream, not at its end
+        while not controller._relays:
+            await asyncio.sleep(0.002)
+        relay = next(iter(controller._relays))
+        await asyncio.wait({relay})
+        return len(toks)
+
+    loop = asyncio.get_running_loop()
+    task = loop.create_task(consume())
+    watcher = loop.create_task(watch_relay())
+    while len(toks) < 6:  # the stream is live on the source
+        await asyncio.sleep(0.01)
+    summary = await controller.drain(hard=False, reason="admin")
+    assert summary["migrated"] == 1 and summary["failed"] == 0
+    relay_done_at_token = await asyncio.wait_for(watcher, timeout=60)
+    await asyncio.wait_for(task, timeout=60)
+
+    # _baseline drives its own event loop — run it in a thread
+    want = await asyncio.to_thread(_baseline, prompt, max_tokens)
+    assert (toks, finish) == want
+    # the handoff actually happened: the source's relay duty ended
+    # while the peer was still generating (the source could exit here)
+    kinds = [e["kind"] for e in flight_recorder().snapshot()]
+    assert "recovery.migrate_handoff" in kinds
+    assert relay_done_at_token is not None
+    assert relay_done_at_token < len(want[0]), (
+        "relay only ended at stream end — no handoff happened")
+    # the peer's span export arrived over the ATTACHED connection
+    peer_sets = [rs for rs in er.ctx.remote_spans
+                 if rs["source"] == "migration_peer"]
+    assert peer_sets and "migration.resume" in [
+        n for n, _ in peer_sets[0]["spans"]]
+    # zero leaks on either side
+    assert src.allocator.used == 0
+    await controller.close()
+    await server.close()
+    await dst.stop()
+    await src.stop()
+    assert dst.allocator.used == 0
+
+
+async def test_rebind_attach_failure_falls_back_to_relay():
+    """If the consumer cannot reach the peer (e.g. a NATed client), the
+    relay keeps carrying the stream to its end — byte-identical, no
+    error surfaced."""
+    from dynamo_tpu.protocols.common import EngineOutput, FinishReason
+    from dynamo_tpu.recovery.migration import follow_migrated_stream
+
+    async def fake_stream():
+        # a source stream whose migrated frame points at a dead port,
+        # then relays the full stream itself (what the source does
+        # when nobody attaches)
+        yield EngineOutput(token_ids=[1])
+        yield EngineOutput(migrated={"host": "127.0.0.1", "port": 9,
+                                     "resume_id": "x"})
+        yield EngineOutput(token_ids=[2])
+        yield EngineOutput(token_ids=[3],
+                           finish_reason=FinishReason.LENGTH)
+
+    toks, finish = [], None
+    async for out in follow_migrated_stream(fake_stream()):
+        toks.extend(out.token_ids)
+        finish = out.finish_reason or finish
+    assert toks == [1, 2, 3]
+    assert finish == FinishReason.LENGTH

@@ -1,0 +1,1068 @@
+"""Multi-model multi-tenant fleet (registry/): units + e2e.
+
+The acceptance bar (ISSUE 12): one frontend routes ``model=`` across
+per-model worker pools sharing one endpoint (streams byte-identical to
+single-model runs), an idle model drains to zero and cold-starts back
+on first request within the deadline, and a tenant exceeding its token
+bucket gets 429 + Retry-After while a second tenant's concurrent
+requests are untouched.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.http.service import (
+    HttpService,
+    ModelManager,
+    ModelWatcher,
+    register_model,
+)
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.kv_router.scheduler import AllWorkersBusy, KvScheduler
+from dynamo_tpu.planner.admission import AdmissionRejected
+from dynamo_tpu.registry import (
+    ColdStartTimeout,
+    KubePoolBackend,
+    ModelCard,
+    ModelRegistry,
+    PoolConfig,
+    PoolDemand,
+    PoolManager,
+    PoolPolicy,
+    PoolPolicyConfig,
+    RegistryAdmin,
+    TenantQuota,
+    TenantQuotas,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# cards + registry view
+# --------------------------------------------------------------------------
+
+
+def test_model_card_wire_roundtrip_and_visibility():
+    card = ModelCard(
+        name="m8b", endpoint="dyn://public.backend.generate",
+        model_type="both", family="llama", context_length=8192,
+        aliases=["m8b-fast", "default"], tenants=["acme", "globex"],
+        model_path="/models/m8b",
+    )
+    again = ModelCard.from_wire(json.loads(json.dumps(card.to_wire())))
+    assert again == card
+    assert card.visible_to("acme") and card.visible_to("globex")
+    assert not card.visible_to("rivals") and not card.visible_to(None)
+    public = ModelCard(name="pub", endpoint="dyn://a.b.c")
+    assert public.visible_to(None) and public.visible_to("anyone")
+    admin_only = ModelCard(name="hidden", endpoint="dyn://a.b.c",
+                           tenants=[])
+    assert not admin_only.visible_to("acme")
+    with pytest.raises(ValueError):
+        ModelCard(name="x", model_type="bogus")
+
+
+def test_registry_resolves_aliases_and_tenant_visibility():
+    reg = ModelRegistry()
+    reg.put(ModelCard(name="m8b", endpoint="dyn://a.b.c",
+                      aliases=["fast"]))
+    reg.put(ModelCard(name="acme-ft", endpoint="dyn://a.b.c",
+                      tenants=["acme"]))
+    assert reg.resolve("m8b") == "m8b"
+    assert reg.resolve("fast") == "m8b"          # alias → canonical
+    assert reg.resolve("nope") is None
+    # tenant scoping: invisible answers exactly like unknown
+    assert reg.resolve("acme-ft", "acme") == "acme-ft"
+    assert reg.resolve("acme-ft", "rivals") is None
+    assert reg.resolve("acme-ft", None) is None
+    assert reg.visible("acme") == ["acme-ft", "m8b"]
+    assert reg.visible("rivals") == ["m8b"]
+    # alias release on removal
+    reg.remove("m8b")
+    assert reg.resolve("fast") is None
+    # alias collision: first owner keeps it
+    reg.put(ModelCard(name="a", endpoint="dyn://a.b.c", aliases=["x"]))
+    reg.put(ModelCard(name="b", endpoint="dyn://a.b.c", aliases=["x"]))
+    assert reg.resolve("x") == "a"
+
+
+def test_registry_listeners_fire_and_survive_failures():
+    reg = ModelRegistry()
+    seen = []
+    reg.add_listener(lambda n, c: (_ for _ in ()).throw(RuntimeError()))
+    reg.add_listener(lambda n, c: seen.append((n, c is not None)))
+    reg.put(ModelCard(name="m", endpoint="dyn://a.b.c"))
+    reg.remove("m")
+    assert seen == [("m", True), ("m", False)]
+
+
+# --------------------------------------------------------------------------
+# tenant token buckets
+# --------------------------------------------------------------------------
+
+
+def test_tenant_parse_contract_garbage_degrades_to_default():
+    q = TenantQuotas()
+    assert q.resolve(None) == "default"
+    assert q.resolve("") == "default"
+    assert q.resolve("acme") == "acme"
+    assert q.resolve("  acme  ") == "acme"
+    # garbage: counted fallback, never an error
+    for bad in ("sp ace", "a" * 65, "…", "-leading", 'x"y'):
+        assert q.resolve(bad) == "default"
+    text = q.registry.render()
+    assert "dynamo_registry_tenant_fallbacks_total 5" in text
+
+
+def test_request_bucket_depletes_and_refills():
+    clock = Clock()
+    q = TenantQuotas(default=TenantQuota(requests_per_s=2.0, burst_s=1.0),
+                     clock=clock)
+    q.admit("acme")
+    q.admit("acme")  # burst capacity = 2
+    with pytest.raises(AdmissionRejected) as e:
+        q.admit("acme")
+    assert e.value.outcome == "quota"
+    assert int(e.value.retry_after_header) >= 1
+    # isolation: a different tenant has its own bucket
+    q.admit("globex")
+    # refill: half a second buys one request back
+    clock.advance(0.5)
+    q.admit("acme")
+    with pytest.raises(AdmissionRejected):
+        q.admit("acme")
+    text = q.registry.render()
+    assert 'dynamo_registry_tenant_sheds_total{bucket="requests",tenant="acme"} 2' in text
+    assert 'outcome="quota"' in text
+
+
+def test_token_bucket_overdraft_delays_next_admission():
+    clock = Clock()
+    q = TenantQuotas(default=TenantQuota(tokens_per_s=10.0, burst_s=1.0),
+                     clock=clock)
+    q.admit("acme")
+    # the stream actually used 25 tokens: 10 capacity - 25 = -15
+    q.charge_tokens("acme", 25)
+    with pytest.raises(AdmissionRejected) as e:
+        q.admit("acme")
+    assert e.value.outcome == "quota"
+    # refill must pay the overdraft back past zero: 15/10 = 1.5s + 1 token
+    clock.advance(1.0)
+    with pytest.raises(AdmissionRejected):
+        q.admit("acme")
+    clock.advance(0.7)
+    q.admit("acme")
+    assert 'dynamo_registry_tenant_tokens_total{tenant="acme"} 25' \
+        in q.registry.render()
+
+
+def test_tenant_table_is_bounded_with_idle_eviction():
+    clock = Clock()
+    q = TenantQuotas(default=TenantQuota(requests_per_s=1.0), clock=clock,
+                     max_tracked=3)
+    for i in range(3):
+        q.admit(f"t{i}")
+        clock.advance(1.0)
+    q.admit("t-new")  # evicts the longest-idle (t0)
+    assert len(q._tenants) == 3 and "t0" not in q._tenants
+
+
+def test_quota_outcome_rides_a_shared_admissions_counter():
+    from dynamo_tpu.telemetry.registry import MetricsRegistry
+
+    shared = MetricsRegistry()
+    q = TenantQuotas(default=TenantQuota(requests_per_s=1.0, burst_s=1.0))
+    q.bind_admissions(shared)
+    q.admit("acme")
+    with pytest.raises(AdmissionRejected):
+        q.admit("acme")
+    text = shared.render()
+    assert 'outcome="quota",tenant="acme"' in text \
+        or 'tenant="acme",outcome="quota"' in text
+    # the quota family must NOT also render on the quotas' own registry
+    assert "dynamo_planner_admissions_total" not in q.registry.render()
+
+
+# --------------------------------------------------------------------------
+# pool policy + manager
+# --------------------------------------------------------------------------
+
+
+def test_pool_policy_scale_to_zero_with_cooldown():
+    clock = Clock()
+    policy = PoolPolicy(PoolPolicyConfig(idle_to_zero_s=60.0,
+                                         cooldown_s=30.0), clock=clock)
+    demand = {"m": PoolDemand(workers=2, idle_s=120.0)}
+    acts = policy.decide(demand)
+    assert [(a.model, a.kind) for a in acts] == [("m", "scale_to_zero")]
+    # pacing: the same decision inside the cooldown is withheld
+    assert policy.decide(demand) == []
+    clock.advance(31.0)
+    assert len(policy.decide(demand)) == 1
+    # a busy pool never drains
+    assert policy.decide({"m": PoolDemand(workers=2, idle_s=5.0)}) == []
+    # an empty pool has nothing to drain
+    assert policy.decide({"m": PoolDemand(workers=0, idle_s=999.0)}) == []
+
+
+def test_pool_policy_cold_start_beats_idle_and_cooldown():
+    clock = Clock()
+    policy = PoolPolicy(PoolPolicyConfig(idle_to_zero_s=60.0), clock=clock)
+    acts = policy.decide(
+        {"m": PoolDemand(workers=0, idle_s=999.0, cold_pending=True)})
+    assert [(a.model, a.kind) for a in acts] == [("m", "cold_start")]
+
+
+async def test_pool_manager_cold_start_shares_one_spawn_and_completes():
+    reg = ModelRegistry()
+    reg.put(ModelCard(name="m", endpoint="dyn://a.b.c"))
+    size = {"m": 0}
+    spawns = []
+
+    async def spawner(card):
+        spawns.append(card.name)
+        await asyncio.sleep(0.05)
+        size["m"] = 1
+
+    pm = PoolManager(reg, lambda m: size[m], spawner=spawner,
+                     config=PoolConfig(cold_start_deadline_s=5.0,
+                                       poll_s=0.01))
+    # concurrent cold requests share ONE spawn
+    await asyncio.gather(*(pm.await_capacity("m") for _ in range(4)))
+    assert spawns == ["m"]
+    text = pm.registry.render()
+    assert ('dynamo_registry_cold_starts_total{model="m",'
+            'outcome="started"} 1') in text
+    assert 'outcome="completed"} 4' in text
+    await pm.stop()
+
+
+async def test_pool_manager_cold_start_timeout_and_no_spawner():
+    reg = ModelRegistry()
+    reg.put(ModelCard(name="m", endpoint="dyn://a.b.c"))
+
+    async def dead_spawner(card):
+        pass  # nothing ever joins
+
+    pm = PoolManager(reg, lambda m: 0, spawner=dead_spawner,
+                     config=PoolConfig(cold_start_deadline_s=0.1,
+                                       poll_s=0.01, retry_after_s=7.0))
+    with pytest.raises(ColdStartTimeout) as e:
+        await pm.await_capacity("m")
+    assert e.value.retry_after_s == 7.0
+    # no spawner at all: same bounded wait, counted distinctly
+    pm2 = PoolManager(reg, lambda m: 0,
+                      config=PoolConfig(cold_start_deadline_s=0.05,
+                                        poll_s=0.01))
+    with pytest.raises(ColdStartTimeout):
+        await pm2.await_capacity("m")
+    assert 'outcome="no_spawner"} 1' in pm2.registry.render()
+    await pm.stop()
+    await pm2.stop()
+
+
+async def test_pool_manager_step_drains_idle_pool():
+    clock = Clock()
+    reg = ModelRegistry()
+    reg.put(ModelCard(name="idle-m", endpoint="dyn://a.b.c"))
+    reg.put(ModelCard(name="busy-m", endpoint="dyn://a.b.c"))
+    size = {"idle-m": 2, "busy-m": 2}
+    drained = []
+
+    async def drainer(model):
+        drained.append(model)
+        size[model] = 0
+
+    pm = PoolManager(
+        reg, lambda m: size[m], drainer=drainer, clock=clock,
+        policy=PoolPolicy(PoolPolicyConfig(idle_to_zero_s=60.0),
+                          clock=clock),
+    )
+    pm.note_request("busy-m")
+    clock.advance(120.0)
+    pm.note_request("busy-m")  # stays warm
+    applied = await pm.step()
+    assert drained == ["idle-m"]
+    assert [(a.model, a.kind) for a in applied] == [("idle-m",
+                                                     "scale_to_zero")]
+    assert 'dynamo_registry_scale_to_zero_total{model="idle-m"} 1' \
+        in pm.registry.render()
+    await pm.stop()
+
+
+async def test_kube_pool_backend_patches_replicas_0_and_1():
+    from dynamo_tpu.deploy import InMemoryKube, Reconciler
+
+    kube = InMemoryKube()
+    cr = {
+        "apiVersion": "dynamo.example.com/v1alpha1",
+        "kind": "DynamoDeployment",
+        "metadata": {"name": "fleet", "namespace": "serving"},
+        "spec": {"image": "dynamo-tpu:test", "namespace": "public",
+                 "services": {}},
+    }
+    backend = KubePoolBackend(Reconciler(kube), cr)
+    await backend.spawn(ModelCard(name="m8b", endpoint="dyn://a.b.c"))
+    dep = kube.objects["Deployment/serving/fleet-pool-m8b"]
+    assert dep["spec"]["replicas"] == 1
+    await backend.drain("m8b")
+    dep = kube.objects["Deployment/serving/fleet-pool-m8b"]
+    assert dep["spec"]["replicas"] == 0
+
+
+def test_recovery_respawn_with_card_passes_the_card_through():
+    """respawn-with-a-different-card: the one new recovery capability
+    the pool plane needs — the controller routes the card into the
+    respawner keyword."""
+    from dynamo_tpu.recovery import RecoveryConfig, RecoveryController
+
+    got = []
+
+    async def respawner(card=None):
+        got.append(card)
+
+    controller = RecoveryController(
+        engine_id="e", respawner=respawner,
+        config=RecoveryConfig(respawn_backoff_s=0.01),
+    )
+    card = ModelCard(name="swap-in", endpoint="dyn://a.b.c")
+
+    async def go():
+        assert await controller.respawn_with_card(card) is True
+        # a plain respawn afterwards carries no card
+        await controller._respawn("plain")
+
+    asyncio.run(go())
+    assert got == [card, None]
+
+
+# --------------------------------------------------------------------------
+# per-model pool partition in the KV scheduler
+# --------------------------------------------------------------------------
+
+
+def test_kv_scheduler_pool_filter_selects_within_the_model_pool():
+    ks = KvScheduler(block_size=16)
+    # w-b is far less loaded AND holds the prefix — but serves model b
+    ks.update_metrics("w-a", ForwardPassMetrics(
+        request_active_slots=3, request_total_slots=4,
+        kv_active_blocks=50, kv_total_blocks=64))
+    ks.update_metrics("w-b", ForwardPassMetrics(
+        request_total_slots=4, kv_total_blocks=64))
+    overlap = OverlapScores(scores={"w-b": 4})
+    for _ in range(8):
+        d = ks.schedule(64, overlap, pool={"w-a"})
+        assert d.worker_id == "w-a"
+        # the pull hint must not point across pools either: w-b's
+        # "overlap" is another model's KV
+        assert d.best_prefix_worker is None
+    with pytest.raises(AllWorkersBusy):
+        ks.schedule(64, OverlapScores(), pool=set())
+    # no pool = the old whole-endpoint behavior
+    assert ks.schedule(64, overlap).worker_id in ("w-a", "w-b")
+
+
+# --------------------------------------------------------------------------
+# HTTP edge: 404 body, /v1/models enrichment, tenant isolation
+# --------------------------------------------------------------------------
+
+
+async def test_unknown_model_404_body_shape():
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "ghost",
+                      "messages": [{"role": "user", "content": "hi"}]},
+            ) as r:
+                assert r.status == 404
+                body = await r.json()
+    finally:
+        await service.stop()
+    err = body["error"]
+    assert err["code"] == "model_not_found"
+    assert err["type"] == "invalid_request_error"
+    assert err["param"] == "model"
+    assert "'ghost'" in err["message"]
+
+
+class _FixedEngine:
+    """Deterministic OpenAI-level engine: fixed ids, tagged content —
+    byte-identical bodies across runs by construction."""
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    def generate(self, ctx):
+        async def gen():
+            req = ctx.payload
+            text = req.messages[-1].text_content() if hasattr(
+                req, "messages") else ""
+            base = {"id": f"cmpl-{self.tag}", "object":
+                    "chat.completion.chunk", "created": 0,
+                    "model": getattr(req, "model", "?")}
+            yield {**base, "choices": [{"index": 0, "delta":
+                   {"role": "assistant"}, "finish_reason": None}]}
+            yield {**base, "choices": [{"index": 0, "delta":
+                   {"content": f"{self.tag}:{text}"},
+                   "finish_reason": None}]}
+            yield {**base, "choices": [{"index": 0, "delta": {},
+                   "finish_reason": "stop"}]}
+
+        return gen()
+
+
+async def test_v1_models_enrichment_and_tenant_filter():
+    manager = ModelManager()
+    manager.add_chat_model("m8b", _FixedEngine("a"))
+    manager.set_card(ModelCard(
+        name="m8b", endpoint="dyn://a.b.c", family="llama",
+        context_length=8192, aliases=["fast"], owned_by="fleet-team"))
+    manager.add_chat_model("acme-ft", _FixedEngine("b"))
+    manager.set_card(ModelCard(
+        name="acme-ft", endpoint="dyn://a.b.c", tenants=["acme"]))
+    quotas = TenantQuotas()  # quota-less but tenant-aware
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          quotas=quotas)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/models"
+            async with s.get(url) as r:
+                body = await r.json()
+            rows = {m["id"]: m for m in body["data"]}
+            # anonymous callers see only public models, enriched
+            assert set(rows) == {"m8b"}
+            assert rows["m8b"]["family"] == "llama"
+            assert rows["m8b"]["max_model_len"] == 8192
+            assert rows["m8b"]["aliases"] == ["fast"]
+            assert rows["m8b"]["owned_by"] == "fleet-team"
+            async with s.get(url, headers={"X-Tenant": "acme"}) as r:
+                body = await r.json()
+            assert {m["id"] for m in body["data"]} == {"m8b", "acme-ft"}
+            # the scoped model 404s for the wrong tenant — and by alias
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "acme-ft",
+                      "messages": [{"role": "user", "content": "x"}]},
+                headers={"X-Tenant": "rivals"},
+            ) as r:
+                assert r.status == 404
+                assert (await r.json())["error"]["code"] == "model_not_found"
+            # the right tenant gets through
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "acme-ft",
+                      "messages": [{"role": "user", "content": "x"}]},
+                headers={"X-Tenant": "acme"},
+            ) as r:
+                assert r.status == 200
+            # alias routing: "fast" resolves to m8b and serves
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "fast",
+                      "messages": [{"role": "user", "content": "y"}]},
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["choices"][0]["message"]["content"] == "a:y"
+    finally:
+        await service.stop()
+
+
+async def test_tenant_spike_sheds_spiker_only_e2e():
+    """The acceptance e2e: tenant A blows through its bucket → 429 +
+    Retry-After; tenant B's concurrent requests all succeed; garbage
+    X-Tenant degrades to default with a counter, never a 500."""
+    manager = ModelManager()
+    manager.add_chat_model("m", _FixedEngine("m"))
+    quotas = TenantQuotas(
+        default=TenantQuota(requests_per_s=1000.0),
+        overrides={"spiky": TenantQuota(requests_per_s=1.0, burst_s=3.0)},
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          quotas=quotas)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+
+            async def one(tenant):
+                async with s.post(
+                    url,
+                    json={"model": "m", "messages":
+                          [{"role": "user", "content": "hi"}]},
+                    headers={"X-Tenant": tenant},
+                ) as r:
+                    return r.status, r.headers.get("Retry-After"), \
+                        await r.json()
+
+            results = await asyncio.gather(
+                *(one("spiky") for _ in range(8)),
+                *(one("calm") for _ in range(8)),
+            )
+            spiky, calm = results[:8], results[8:]
+        # the spiker: 3 admitted (burst), the rest shed with Retry-After
+        ok = [r for r in spiky if r[0] == 200]
+        shed = [r for r in spiky if r[0] == 429]
+        assert len(ok) == 3 and len(shed) == 5
+        for status, retry_after, body in shed:
+            assert retry_after is not None and int(retry_after) >= 1
+            assert body["error"]["type"] == "overloaded"
+        # the calm tenant is untouched
+        assert all(r[0] == 200 for r in calm)
+        text = service.metrics.render()
+        assert 'dynamo_registry_tenant_sheds_total{bucket="requests",tenant="spiky"} 5' in text
+        assert 'outcome="quota",tenant="spiky"' in text \
+            or 'tenant="spiky",outcome="quota"' in text
+
+        # garbage header: default tenant, 200, counted
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "m",
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"X-Tenant": "not a tenant !!"},
+            ) as r:
+                assert r.status == 200
+        assert ("dynamo_registry_tenant_fallbacks_total 1"
+                in service.metrics.render())
+    finally:
+        await service.stop()
+
+
+# --------------------------------------------------------------------------
+# two-model two-pool e2e over one shared endpoint
+# --------------------------------------------------------------------------
+
+
+def _pool_handler(tag):
+    async def handler(payload, ctx):
+        from dynamo_tpu.protocols.openai import ChatCompletionRequest
+        from dynamo_tpu.runtime.engine import Context
+
+        req = ChatCompletionRequest.model_validate(payload)
+        async for chunk in _FixedEngine(tag).generate(Context(req)):
+            yield chunk
+
+    return handler
+
+
+async def _sse_body(port, model, content="route me"):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json={"model": model, "stream": True,
+                  "messages": [{"role": "user", "content": content}]},
+        ) as r:
+            assert r.status == 200, await r.text()
+            return await r.read()
+
+
+async def _two_pool_frontend(hub, models):
+    """Frontend + watcher over ``hub`` with cards for ``models``
+    (name → endpoint path)."""
+    front_drt = DistributedRuntime.in_process(hub)
+    manager = ModelManager()
+    watcher = ModelWatcher(front_drt, manager, namespace="public")
+    await watcher.start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return front_drt, manager, watcher, service
+
+
+async def test_two_model_pools_share_one_endpoint_byte_identical():
+    hub = MemoryHub()
+    path = "dyn://prod.pool.generate"
+
+    async def worker(model_tag):
+        drt = DistributedRuntime.in_process(hub)
+        ep = drt.namespace("prod").component("pool").endpoint("generate")
+        serving = await ep.serve(_pool_handler(model_tag),
+                                 metadata={"model": model_tag})
+        await register_model(
+            drt, "public", model_tag, path, model_type="both",
+            card=ModelCard(name=model_tag, endpoint=path,
+                           model_type="both"),
+        )
+        return drt, serving
+
+    # single-model baseline: only m-a serving
+    drt_a, serving_a = await worker("m-a")
+    _, manager, watcher, service = await _two_pool_frontend(hub, None)
+    await asyncio.sleep(0.05)
+    baseline_a = await _sse_body(service.port, "m-a")
+    await service.stop()
+    await watcher.stop()
+
+    # full fleet: both pools behind the SAME component endpoint
+    drt_b, serving_b = await worker("m-b")
+    _, manager, watcher, service = await _two_pool_frontend(hub, None)
+    await asyncio.sleep(0.05)
+    try:
+        assert manager.model_names() == ["m-a", "m-b"]
+        assert watcher.pool_size("m-a") == 1
+        assert watcher.pool_size("m-b") == 1
+        body_a = await _sse_body(service.port, "m-a")
+        body_b = await _sse_body(service.port, "m-b")
+        # model= routed into the right pool, and the stream is byte-
+        # identical to the single-model run
+        assert body_a == baseline_a
+        assert b"m-a:route me" in body_a and b"m-b:" not in body_a
+        assert b"m-b:route me" in body_b
+        # repeat under interleaving: never a cross-pool pick
+        for _ in range(5):
+            assert (await _sse_body(service.port, "m-a")) == baseline_a
+
+        # rebind without restart: worker A leaves → pool empties → 503
+        # (card still registered), a fresh worker joins → routes again
+        await serving_a.stop()
+        await asyncio.sleep(0.05)
+        assert watcher.pool_size("m-a") == 0
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "m-a",
+                      "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 503
+                assert r.headers.get("Retry-After") is not None
+        ep_a2 = drt_a.namespace("prod").component("pool").endpoint(
+            "generate")
+        serving_a2 = await ep_a2.serve(_pool_handler("m-a"),
+                                       metadata={"model": "m-a"})
+        await asyncio.sleep(0.05)
+        assert (await _sse_body(service.port, "m-a")) == baseline_a
+        await serving_a2.stop()
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await serving_b.stop()
+
+
+async def test_admin_add_remove_rebinds_routes():
+    hub = MemoryHub()
+    path = "dyn://prod.pool.generate"
+    worker_drt = DistributedRuntime.in_process(hub)
+    ep = worker_drt.namespace("prod").component("pool").endpoint("generate")
+    serving = await ep.serve(_pool_handler("dyn-m"),
+                             metadata={"model": "dyn-m"})
+
+    front_drt, manager, watcher, service = await _two_pool_frontend(
+        hub, None)
+    service.registry_admin = RegistryAdmin(front_drt, "public")
+    try:
+        url = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            # not registered yet: proper 404 body
+            async with s.post(
+                f"{url}/v1/chat/completions",
+                json={"model": "dyn-m",
+                      "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 404
+            # dynamic add through the admin API (dynamoctl's wire)
+            async with s.post(f"{url}/admin/models", json={
+                "name": "dyn-m", "endpoint": path, "model_type": "both",
+                "family": "llama", "aliases": ["dyn-alias"],
+            }) as r:
+                assert r.status == 200, await r.text()
+            await asyncio.sleep(0.05)
+            body = await _sse_body(service.port, "dyn-m", "added live")
+            assert b"dyn-m:added live" in body
+            # the alias resolves too
+            assert b"dyn-m:added live" in await _sse_body(
+                service.port, "dyn-alias", "added live")
+            # admin view lists the card
+            async with s.get(f"{url}/admin/models") as r:
+                cards = (await r.json())["models"]
+            assert [c["name"] for c in cards] == ["dyn-m"]
+            # malformed endpoint rejects at the door
+            async with s.post(f"{url}/admin/models", json={
+                "name": "bad", "endpoint": "not-an-endpoint"
+            }) as r:
+                assert r.status == 400
+            # dynamic remove unbinds the route
+            async with s.delete(f"{url}/admin/models/dyn-m") as r:
+                assert r.status == 200
+            await asyncio.sleep(0.05)
+            async with s.post(
+                f"{url}/v1/chat/completions",
+                json={"model": "dyn-m",
+                      "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 404
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await serving.stop()
+
+
+# --------------------------------------------------------------------------
+# scale-to-zero → cold-start respawn e2e
+# --------------------------------------------------------------------------
+
+
+async def test_scale_to_zero_and_cold_start_respawn_e2e():
+    """The elasticity e2e: an idle model's pool drains to zero, the
+    next request cold-starts a worker with that model's card, and the
+    queued request completes within the deadline."""
+    hub = MemoryHub()
+    path = "dyn://prod.pool.generate"
+    worker_drt = DistributedRuntime.in_process(hub)
+    ep = worker_drt.namespace("prod").component("pool").endpoint("generate")
+    state = {"serving": None, "spawned": 0}
+
+    async def spawn_worker(card):
+        state["spawned"] += 1
+        state["serving"] = await ep.serve(
+            _pool_handler(card.name), metadata={"model": card.name})
+
+    async def drain_pool(model):
+        if state["serving"] is not None:
+            await state["serving"].stop()
+            state["serving"] = None
+
+    front_drt, manager, watcher, service = await _two_pool_frontend(
+        hub, None)
+    # durable (admin) card: scale-to-zero needs the registration to
+    # outlive the workers
+    admin = RegistryAdmin(front_drt, "public")
+    await admin.add(ModelCard(name="elastic-m", endpoint=path,
+                              model_type="both"))
+    await asyncio.sleep(0.05)
+
+    clock = Clock()
+    pools = PoolManager(
+        manager.registry, watcher.pool_size,
+        spawner=spawn_worker, drainer=drain_pool, clock=clock,
+        config=PoolConfig(cold_start_deadline_s=5.0, poll_s=0.01),
+        policy=PoolPolicy(PoolPolicyConfig(idle_to_zero_s=60.0),
+                          clock=clock),
+    )
+    service.attach_pools(pools)
+    try:
+        # first request finds the pool cold → cold start #1
+        body = await _sse_body(service.port, "elastic-m", "wake up")
+        assert b"elastic-m:wake up" in body
+        assert state["spawned"] == 1
+        assert watcher.pool_size("elastic-m") == 1
+
+        # idle long enough → the policy drains the pool to zero
+        clock.advance(120.0)
+        applied = await pools.step()
+        assert [(a.model, a.kind) for a in applied] == [
+            ("elastic-m", "scale_to_zero")]
+        await asyncio.sleep(0.05)
+        assert watcher.pool_size("elastic-m") == 0
+
+        # next request cold-starts again and completes in-deadline —
+        # the full scale-to-zero → respawn → serve cycle
+        body = await _sse_body(service.port, "elastic-m", "wake again")
+        assert b"elastic-m:wake again" in body
+        assert state["spawned"] == 2
+        text = service.metrics.render()
+        assert 'dynamo_registry_scale_to_zero_total{model="elastic-m"} 1' \
+            in text
+        assert ('dynamo_registry_cold_starts_total{model="elastic-m",'
+                'outcome="completed"} 2') in text
+        # /admin/pools reflects the live pool
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{service.port}/admin/pools") as r:
+                rows = (await r.json())["pools"]
+        row = next(p for p in rows if p["model"] == "elastic-m")
+        assert row["workers"] == 1 and row["requests_total"] == 2
+    finally:
+        await pools.stop()
+        await service.stop()
+        await watcher.stop()
+        if state["serving"] is not None:
+            await state["serving"].stop()
+
+
+# --------------------------------------------------------------------------
+# fleet hub: MODEL column
+# --------------------------------------------------------------------------
+
+
+async def test_hub_fleet_workers_shows_model_column():
+    from dynamo_tpu.telemetry.hub import FleetHub
+    from dynamo_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("dynamo_registry_model_info", "model served").set(
+        1.0, model="m8b")
+    hub = FleetHub()
+    hub.add_local("w1", "decode_engine", reg)
+    await hub.scrape_once()
+    rows = hub.fleet_workers()["workers"]
+    assert rows[0]["model"] == "m8b"
+    # dynamotop renders it
+    import importlib
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    dynamotop = importlib.import_module("dynamotop")
+    text = "\n".join(dynamotop.render_workers(rows))
+    assert "MODEL" in text and "m8b" in text
+    await hub.stop()
+
+
+# --------------------------------------------------------------------------
+# dynamoctl: the llmctl analogue over the admin API
+# --------------------------------------------------------------------------
+
+
+async def test_dynamoctl_drives_the_admin_api(capsys):
+    import importlib
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    dynamoctl = importlib.import_module("dynamoctl")
+
+    hub = MemoryHub()
+    path = "dyn://prod.pool.generate"
+    worker_drt = DistributedRuntime.in_process(hub)
+    ep = worker_drt.namespace("prod").component("pool").endpoint("generate")
+    serving = await ep.serve(_pool_handler("ctl-m"),
+                             metadata={"model": "ctl-m"})
+    front_drt, manager, watcher, service = await _two_pool_frontend(
+        hub, None)
+    service.registry_admin = RegistryAdmin(front_drt, "public")
+    pools = PoolManager(manager.registry, watcher.pool_size)
+    service.attach_pools(pools)
+    base = ["--frontend", f"http://127.0.0.1:{service.port}"]
+
+    def run(*argv):
+        # urllib is sync — keep it off this loop
+        return dynamoctl.main([*base, *argv])
+
+    try:
+        assert await asyncio.to_thread(
+            run, "models", "add", "ctl-m", path,
+            "--family", "llama", "--alias", "ctl-alias") == 0
+        await asyncio.sleep(0.05)
+        assert await asyncio.to_thread(run, "models", "list") == 0
+        out = capsys.readouterr().out
+        assert "ctl-m" in out and "ctl-alias" in out
+        assert await asyncio.to_thread(run, "models", "catalog") == 0
+        assert "family=llama" in capsys.readouterr().out
+        # a request so the pool shows demand, then the pools view
+        await _sse_body(service.port, "ctl-m", "via ctl")
+        assert await asyncio.to_thread(run, "pools") == 0
+        out = capsys.readouterr().out
+        assert "ctl-m" in out and "workers=1" in out
+        assert await asyncio.to_thread(run, "models", "remove",
+                                       "ctl-m") == 0
+        await asyncio.sleep(0.05)
+        assert "ctl-m" not in manager.model_names()
+        # malformed endpoint: server-side 400 → exit 1
+        assert await asyncio.to_thread(
+            run, "models", "add", "bad", "not-an-endpoint") == 1
+    finally:
+        await pools.stop()
+        await service.stop()
+        await watcher.stop()
+        await serving.stop()
+
+
+# --------------------------------------------------------------------------
+# review-hardening regressions
+# --------------------------------------------------------------------------
+
+
+async def test_wrong_endpoint_kind_is_404_not_retryable_503():
+    """A chat-only card must 404 on /v1/completions (the model does not
+    exist for that API) — not a forever-retry 503."""
+    manager = ModelManager()
+    manager.add_chat_model("chat-only", _FixedEngine("c"))
+    manager.set_card(ModelCard(name="chat-only", endpoint="dyn://a.b.c",
+                               model_type="chat"))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/completions",
+                json={"model": "chat-only", "prompt": "x"},
+            ) as r:
+                assert r.status == 404
+                assert (await r.json())["error"]["code"] == "model_not_found"
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "chat-only",
+                      "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 200
+    finally:
+        await service.stop()
+
+
+async def test_tenant_visibility_works_without_quotas():
+    """Tenant IDENTITY must parse on a quota-less frontend: a scoped
+    model serves its tenant and hides from others even when no
+    --tenant-* enforcement is configured."""
+    manager = ModelManager()
+    manager.add_chat_model("acme-ft", _FixedEngine("a"))
+    manager.set_card(ModelCard(name="acme-ft", endpoint="dyn://a.b.c",
+                               tenants=["acme"]))
+    service = HttpService(manager, host="127.0.0.1", port=0)  # no quotas
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}"
+            body = {"model": "acme-ft",
+                    "messages": [{"role": "user", "content": "x"}]}
+            async with s.post(f"{url}/v1/chat/completions", json=body,
+                              headers={"X-Tenant": "acme"}) as r:
+                assert r.status == 200
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 404
+            async with s.get(f"{url}/v1/models",
+                             headers={"X-Tenant": "acme"}) as r:
+                assert [m["id"] for m in (await r.json())["data"]] \
+                    == ["acme-ft"]
+            async with s.get(f"{url}/v1/models") as r:
+                assert (await r.json())["data"] == []
+    finally:
+        await service.stop()
+
+
+def test_pool_filter_does_not_inflate_draining_skips():
+    """Structural pool exclusions are not drain events: multi-pool
+    scheduling must leave the draining-skip counter untouched."""
+    ks = KvScheduler(block_size=16)
+    ks.update_metrics("w-a", ForwardPassMetrics(request_total_slots=4,
+                                                kv_total_blocks=64))
+    ks.update_metrics("w-b", ForwardPassMetrics(request_total_slots=4,
+                                                kv_total_blocks=64))
+    for _ in range(5):
+        ks.schedule(64, OverlapScores(), pool={"w-a"})
+    assert ks.draining_skips == 0
+    # a REAL drain inside the pool still counts
+    ks.update_metrics("w-c", ForwardPassMetrics(
+        request_total_slots=4, kv_total_blocks=64, draining=True))
+    ks.schedule(64, OverlapScores(), pool={"w-a", "w-c"})
+    assert ks.draining_skips == 1
+
+
+async def test_health_lists_scoped_models_and_admin_rejects_bad_body():
+    """/health is the operator surface — visibility-blind; a non-object
+    admin body is a 400, never a 500."""
+    manager = ModelManager()
+    manager.add_chat_model("acme-ft", _FixedEngine("a"))
+    manager.set_card(ModelCard(name="acme-ft", endpoint="dyn://a.b.c",
+                               tenants=["acme"]))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    front_drt = DistributedRuntime.in_process(MemoryHub())
+    service.registry_admin = RegistryAdmin(front_drt, "public")
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}"
+            async with s.get(f"{url}/health") as r:
+                assert (await r.json())["models"] == ["acme-ft"]
+            for bad in ([], "x", 7):
+                async with s.post(f"{url}/admin/models", json=bad) as r:
+                    assert r.status == 400, await r.text()
+    finally:
+        await service.stop()
+
+
+async def test_cold_start_retries_a_failed_spawn_within_the_deadline():
+    """One crashing spawn attempt must not burn every waiter's budget:
+    the wait re-kicks (paced) and completes on the retry."""
+    reg = ModelRegistry()
+    reg.put(ModelCard(name="m", endpoint="dyn://a.b.c"))
+    size = {"m": 0}
+    attempts = []
+
+    async def flaky_spawner(card):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("transient spawn failure")
+        size["m"] = 1
+
+    pm = PoolManager(reg, lambda m: size[m], spawner=flaky_spawner,
+                     config=PoolConfig(cold_start_deadline_s=5.0,
+                                       poll_s=0.01, retry_kick_s=0.05))
+    await pm.await_capacity("m")
+    assert len(attempts) == 2
+    assert 'outcome="completed"} 1' in pm.registry.render()
+    await pm.stop()
+
+
+def test_note_request_ignores_cardless_models():
+    """Card-less engines are not pool citizens — scale-to-zero must
+    never synthesize pool services for them."""
+    reg = ModelRegistry()
+    pm = PoolManager(reg, lambda m: 0)
+    pm.note_request("local-only")
+    assert pm.snapshot() == []
+
+
+def test_min_workers_floor_disables_scale_to_zero():
+    """The only drain the policy emits is to-zero, so a nonzero floor
+    must mean 'never drain' — not 'drain past the floor anyway'."""
+    clock = Clock()
+    policy = PoolPolicy(PoolPolicyConfig(idle_to_zero_s=60.0,
+                                         min_workers=1), clock=clock)
+    assert policy.decide({"m": PoolDemand(workers=2, idle_s=999.0)}) == []
+
+
+async def test_alias_requests_reach_a_metadata_partitioned_pool():
+    """An alias must canonicalize at the edge: downstream pool
+    partitioning (worker metadata, processor routing) keys on the
+    canonical name, which the alias string can never match."""
+    hub = MemoryHub()
+    path = "dyn://prod.pool.generate"
+    worker_drt = DistributedRuntime.in_process(hub)
+    ep = worker_drt.namespace("prod").component("pool").endpoint("generate")
+    seen_models = []
+
+    async def handler(payload, ctx):
+        seen_models.append(payload.get("model"))
+        async for chunk in _pool_handler("al-m")(payload, ctx):
+            yield chunk
+
+    serving = await ep.serve(handler, metadata={"model": "al-m"})
+    front_drt, manager, watcher, service = await _two_pool_frontend(
+        hub, None)
+    service.registry_admin = RegistryAdmin(front_drt, "public")
+    await service.registry_admin.add(ModelCard(
+        name="al-m", endpoint=path, model_type="both",
+        aliases=["al-alias"]))
+    await asyncio.sleep(0.05)
+    try:
+        body = await _sse_body(service.port, "al-alias", "via alias")
+        assert b"al-m:via alias" in body
+        # the worker received the CANONICAL name, not the alias
+        assert seen_models == ["al-m"]
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await serving.stop()
