@@ -47,7 +47,8 @@ METRIC = "decode_tokens_per_sec_per_chip_1b_bf16_b8_ctx512"
 
 
 def run_once(attention_impl: str, burst: int = 1,
-             pipeline: bool = False, persistent: bool = False) -> dict:
+             pipeline: bool = False, persistent: bool = False,
+             spec: bool = False, guided: bool = False) -> dict:
     import os
 
     import jax
@@ -107,35 +108,95 @@ def run_once(attention_impl: str, burst: int = 1,
     slot_mapping = (block_tables[:, ctx // bs] * bs + ctx % bs)[:, None]
     context_lens = jnp.full((b,), ctx + 1, jnp.int32)
 
-    if burst > 1 and persistent:
+    if burst > 1 and persistent and spec:
+        # the engine's chained propose-verify round (decode_burst_spec):
+        # each dispatch runs ONE S = burst-position forward (pending
+        # token + proposals), takes the per-position argmax as the
+        # verify, and folds acceptance + the done-mask freeze into the
+        # device carry — the serving scheduler's shape for speculative
+        # traffic under --device-finish. The measured number is verified
+        # positions/s (the full-acceptance ceiling; real acceptance
+        # scales it by (a+1)/S — the live
+        # dynamo_engine_spec_accept_length histogram is the serving-time
+        # scaler).
+        stop_ids = jnp.full((b, 8), mcfg.vocab_size + 1, jnp.int32)
+        S = burst
+        spec_positions = positions + jnp.arange(S)[None, :]
+        spec_slots = jnp.tile(slot_mapping, (1, S))
+
+        def spec_round(params, k_cache, v_cache, tok0, done0):
+            row_toks = jnp.tile(tok0[:, None], (1, S))
+            logits, (k_cache, v_cache) = llama.forward(
+                params, mcfg, row_toks, spec_positions, (k_cache, v_cache),
+                block_tables, spec_slots, context_lens + S,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = greedy[:, :-1] == row_toks[:, 1:]
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            nt = jnp.take_along_axis(greedy, acc[:, None], axis=1)[:, 0]
+            nt = jnp.where(done0, tok0, nt)
+            done = done0 | (nt[:, None] == stop_ids).any(axis=1)
+            return nt, done, k_cache, v_cache
+
+        step = jax.jit(spec_round, donate_argnums=(1, 2))
+        done0 = jnp.zeros((b,), jnp.bool_)
+
+        def dispatch(out, k, v):
+            nt, _done, k, v = step(params, k, v, out, done0)
+            return nt, k, v
+    elif burst > 1 and persistent:
         # the engine's persistent decode loop (device_finish): the fused
         # K-step burst additionally carries a per-row done mask and runs
         # the stop-token membership check each step — the on-device
         # finish detection the serving scheduler uses to chain bursts
         # without a per-burst host barrier. The stop set here is chosen
         # never to hit (token ids are < vocab), so the chain runs full
-        # length while paying the real per-step check cost.
+        # length while paying the real per-step check cost. With
+        # ``guided`` the carry additionally holds a per-row grammar
+        # state advanced through a device transition table whose row
+        # masks the logits each step (the serving scheduler's shape for
+        # in-bound guided traffic under --device-finish) — transitions
+        # never reject, so the chain runs full length while paying the
+        # real mask-compute + table-lookup cost.
         stop_ids = jnp.full((b, 8), mcfg.vocab_size + 1, jnp.int32)
+        n_states = 64
+        gtable = (
+            jnp.asarray(
+                np.random.default_rng(0).integers(
+                    1, n_states, size=(n_states, mcfg.vocab_size)
+                ), jnp.int32,
+            ) if guided else None
+        )
 
-        def decode_burst_df(params, k_cache, v_cache, tok0, done0):
+        def decode_burst_df(params, k_cache, v_cache, tok0, done0, gst0):
             def one(carry, _):
-                k_cache, v_cache, toks, done = carry
-                nt, k_cache, v_cache = decode_step(
-                    params, k_cache, v_cache, toks[:, None], positions,
-                    slot_mapping, context_lens,
+                k_cache, v_cache, toks, done, gst = carry
+                logits, (k_cache, v_cache) = llama.forward(
+                    params, mcfg, toks[:, None], positions,
+                    (k_cache, v_cache), block_tables, slot_mapping,
+                    context_lens,
                 )
+                last = logits[:, -1]
+                if gtable is not None:
+                    last = last + jnp.where(gtable[gst] < 0, -1e9, 0.0)
+                nt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 nt = jnp.where(done, toks, nt)  # frozen rows hold
                 done = done | (nt[:, None] == stop_ids).any(axis=1)
-                return (k_cache, v_cache, nt, done), None
-            (k_cache, v_cache, nt, done), _ = jax.lax.scan(
-                one, (k_cache, v_cache, tok0, done0), None, length=burst
+                if gtable is not None:
+                    gst = gtable[gst, nt]
+                return (k_cache, v_cache, nt, done, gst), None
+            (k_cache, v_cache, nt, done, gst), _ = jax.lax.scan(
+                one, (k_cache, v_cache, tok0, done0, gst0), None,
+                length=burst
             )
-            return nt, done, k_cache, v_cache
+            return nt, done, gst, k_cache, v_cache
+
         step = jax.jit(decode_burst_df, donate_argnums=(1, 2))
         done0 = jnp.zeros((b,), jnp.bool_)
+        gst0 = jnp.zeros((b,), jnp.int32)
 
         def dispatch(out, k, v):
-            nt, _done, k, v = step(params, k, v, out, done0)
+            nt, _done, _gst, k, v = step(params, k, v, out, done0, gst0)
             return nt, k, v
     elif burst > 1:
         # the engine's multi_step_decode path: K steps fused into one
@@ -300,6 +361,7 @@ def _relay_probe(timeout_s: float = 45.0) -> str:
 
 def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1,
                          pipeline: bool = False, persistent: bool = False,
+                         spec: bool = False, guided: bool = False,
                          label: str = ""):
     """Run one bench attempt in a child process with a hard timeout.
 
@@ -317,11 +379,12 @@ def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1,
         "import json; from bench import run_once; "
         "print('BENCH_RESULT ' + json.dumps("
         f"run_once({impl!r}, {burst}, pipeline={pipeline}, "
-        f"persistent={persistent})))"
+        f"persistent={persistent}, spec={spec}, guided={guided})))"
     )
     t0 = time.monotonic()
     rec = {"label": label, "impl": impl, "burst": burst,
            "pipeline": pipeline, "persistent": persistent,
+           "spec": spec, "guided": guided,
            "timeout_s": round(timeout_s, 1)}
     try:
         proc = subprocess.run(
@@ -519,6 +582,34 @@ def main() -> None:
         if persist is not None and (best is None
                                     or persist["value"] > best["value"]):
             best = persist
+
+    # the unrestricted-chain levers (ISSUE 13): the chained propose-
+    # verify round (spec) and the device-guided-table chain (guided) —
+    # the serving scheduler's shapes for the traffic classes that used
+    # to force the per-burst host-sync path. Neither replaces the
+    # headline (spec measures verified positions/s — a full-acceptance
+    # ceiling; guided adds mask work the plain chain doesn't pay), so
+    # they are logged per attempt, compared on the lever table, and only
+    # the guided number may win the headline (it IS a decode
+    # tokens/s measurement).
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 360 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        persist_spec = _run_impl_subprocess(
+            "xla", timeout_s=min(300.0, remaining - 240), burst=8,
+            persistent=True, spec=True, label="xla:k8:persistent-spec",
+        )
+        note("xla:k8:persistent-spec", persist_spec)
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 360 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        persist_guided = _run_impl_subprocess(
+            "xla", timeout_s=min(300.0, remaining - 240), burst=8,
+            persistent=True, guided=True,
+            label="xla:k8:persistent-guided",
+        )
+        note("xla:k8:persistent-guided", persist_guided)
+        if persist_guided is not None and (
+                best is None or persist_guided["value"] > best["value"]):
+            best = persist_guided
 
     remaining = total_budget - (_time.monotonic() - t0)
     if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):

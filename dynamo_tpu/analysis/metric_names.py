@@ -45,7 +45,12 @@ UNIT_SUFFIXES = (
     "requests", "slots", "ratio", "info", "depth", "replicas", "length",
     "fraction",
 )
-BASE_UNITS = ("seconds", "bytes", "tokens")  # what a histogram may measure
+# what a histogram may measure. "length" admitted deliberately with the
+# speculative acceptance-length histogram (dynamo_engine_spec_accept_
+# length): a per-round accepted-token count is a measured quantity like
+# tokens, but "tokens" would misread as throughput volume — the length
+# distribution (p50/p99 via quantile_over_time) is the signal.
+BASE_UNITS = ("seconds", "bytes", "tokens", "length")
 
 # registration call sites: registry/metrics-module methods and the raw
 # instrument constructors

@@ -326,11 +326,31 @@ class EngineConfig:
     # of ending the burst, so the scheduler dispatches bursts
     # back-to-back off the device-resident carry and drains completed
     # rows asynchronously, compacting batch membership only at natural
-    # barriers (admission, preemption, KV-OOM, drain). Rows needing
-    # host-side finish semantics (stop strings, guided decoding,
-    # speculative decoding, n>1) keep the per-burst sync path. "auto"
-    # engages with decode_pipeline_depth >= 2; "on" requires it.
+    # barriers (admission, preemption, KV-OOM, drain). The carry also
+    # holds speculative state (trailing-token ring), bounded guided
+    # grammar state (guided_device_table below), and the stop-string
+    # suffix-hash ring (device_stop_strings below), so spec / guided /
+    # stop-string / n>1 traffic chains too; the remaining sync-path
+    # fallbacks are counted per pass in
+    # dynamo_engine_sync_fallback_total{reason}. "auto" engages with
+    # decode_pipeline_depth >= 2; "on" requires it.
     device_finish: str = "auto"
+    # guided decoding inside the chain: compile TrieConstraint /
+    # in-bound JsonGrammar cursors to a dense device transition table
+    # (state x token -> next state) so the per-token mask is computed
+    # on device and the grammar cursor advances in the burst carry.
+    # Grammars whose reachable state set exceeds the bound keep the
+    # host sync path explicitly (fallback reason "guided_table_bound").
+    guided_device_table: bool = True
+    guided_table_max_states: int = 256
+    # stop STRINGS inside the chain: device-approximate detection via a
+    # rolling suffix-hash over the burst carry's trailing-token ring
+    # against the stop strings' canonical tokenizations
+    # (StopConditions.stop_token_seqs); candidate rows freeze on device,
+    # the host confirms exactly on drain, and hash-collision false
+    # positives resume byte-identically. Off -> stop-string rows keep
+    # the per-burst sync pipeline.
+    device_stop_strings: bool = True
     # n-gram (prompt-lookup) speculative decoding: propose up to K tokens
     # per decode step by matching the context's trailing n-gram against
     # its own history, then VERIFY all K+1 positions in one forward.
@@ -444,19 +464,10 @@ class EngineConfig:
                 "device_finish='on' requires decode_pipeline_depth >= 2 "
                 "(the persistent loop rides the dispatch-ahead pipeline)"
             )
-        if self.device_finish == "on" and (
-                self.spec_ngram_tokens or self.spec_draft_model):
-            # same rationale as the depth check: speculation is
-            # engine-static and unconditionally disables the chain
-            # (Scheduler._chain_ok), so an explicit "on" would silently
-            # never engage — per-request conditions (stop strings,
-            # guided, n>1) degrade at dispatch instead, as designed
-            raise ValueError(
-                "device_finish='on' is incompatible with speculative "
-                "decoding (spec_ngram_tokens / spec_draft_model): the "
-                "chained dispatch never engages while speculation is "
-                "configured — use device_finish='auto'"
-            )
+        # (speculation + device_finish used to be mutually exclusive —
+        # the chain now runs propose-verify rounds off the same device
+        # carry, so spec engines chain too)
+        self.guided_table_max_states = max(2, self.guided_table_max_states)
         # one frame in flight is the serial floor; beyond two buys nothing
         # (the wire is busy continuously at 2) and unbounds host buffers
         self.disagg_stream_depth = max(1, min(self.disagg_stream_depth, 2))

@@ -58,15 +58,22 @@ def build_choice_trie(choice_ids: Sequence[Sequence[int]]) -> dict:
 
 
 class TrieConstraint:
-    """Cursor over a choice trie (one per request)."""
+    """Cursor over a choice trie (one per request).
+
+    ``path`` records the tokens consumed so far: the trie nodes are
+    plain dicts without stable identities across rebuilds, so the path
+    is the canonical cursor state the device-table compiler
+    (``compile_device_table``) keys its state map on."""
 
     def __init__(self, choice_ids: Sequence[Sequence[int]]):
         self._choice_ids = choice_ids
         self.node: Optional[dict] = build_choice_trie(choice_ids)
+        self.path: Tuple[int, ...] = ()
 
     def reset(self) -> None:
         """Back to the start (preemption-resume re-walks from scratch)."""
         self.node = build_choice_trie(self._choice_ids)
+        self.path = ()
 
     def state_key(self):
         """Hashable signature of the cursor position — two equal keys
@@ -83,6 +90,7 @@ class TrieConstraint:
         if node is None:
             return "derail"
         self.node = node
+        self.path = self.path + (int(token_id),)
         if not any(t != GUIDED_END for t in node):
             return "done"  # choice complete, no longer continuation
         return "ok"
@@ -604,8 +612,167 @@ class JsonConstraint:
 
 
 # ---------------------------------------------------------------------------
-# vocab piece table
+# device transition tables (the guided mask inside the burst carry)
 # ---------------------------------------------------------------------------
+#
+# The persistent decode chain (scheduler._decode_chained) cannot pay a
+# host mask edit per token, so a BOUNDED constraint compiles to a dense
+# device table ``state × token → next state`` (-1 = reject): the burst
+# program computes the additive mask from the current state's row and
+# advances the per-row grammar-state carry on the sampled token, all
+# inside the scan. State 0 is the reserved DONE terminal — transitioning
+# into it means the constraint completed (the host's ``advance`` verdict
+# "done"), and eos ids map to it at every legal-end state. Grammars
+# whose reachable state set exceeds the bound (free-form guided_json,
+# deep schemas) return None and keep the host sync path EXPLICITLY —
+# the scheduler counts the fallback, never silently downgrades.
+
+
+class DeviceGuidedTable:
+    """Compiled device transition table + the host-state → id map."""
+
+    DONE = 0  # reserved terminal state id
+
+    def __init__(self, table, state_ids, kind: str):
+        import numpy as _np
+
+        self.table = _np.asarray(table, _np.int32)  # [S, V]
+        self.state_ids = state_ids                  # host key → state id
+        self.kind = kind                            # "trie" | "json"
+        self.n_states = self.table.shape[0]
+        self._dev = {}                              # bucket → device array
+
+    def state_id(self, constraint) -> Optional[int]:
+        """Table id of a live cursor's CURRENT state (None = unmapped —
+        the cursor wandered somewhere the BFS never reached, which only
+        a bug can produce; the scheduler falls back loudly)."""
+        key = (constraint.path if isinstance(constraint, TrieConstraint)
+               else constraint.state)
+        return self.state_ids.get(key)
+
+    def device(self, bucket: int):
+        """The table as a device array padded to ``bucket`` states
+        (rows of -1) — padding buckets bound the number of compiled
+        burst programs. Cached per bucket: the H2D upload happens once
+        per chain, not per dispatch."""
+        dev = self._dev.get(bucket)
+        if dev is None:
+            import jax.numpy as jnp
+            import numpy as _np
+
+            padded = _np.full((bucket, self.table.shape[1]), -1, _np.int32)
+            padded[: self.n_states] = self.table
+            dev = jnp.asarray(padded)
+            self._dev[bucket] = dev
+        return dev
+
+
+def compile_device_table(
+    constraint,
+    vocab_size: int,
+    eos_ids: Sequence[int] = (),
+    max_states: int = 256,
+    budget_s: float = 2.0,
+) -> Optional[DeviceGuidedTable]:
+    """BFS the constraint's reachable states into a device table.
+
+    Works on a FRESH walk of the constraint's definition (the live
+    cursor is never touched). Returns None when the state set exceeds
+    ``max_states`` or the sweep exceeds ``budget_s`` — the caller keeps
+    the request on the host sync path and names the reason. Runs on an
+    executor thread (the per-state vocab sweep is the same O(vocab)
+    work JsonGrammar.allowed_tokens amortizes; dynlint pins the
+    scheduler against running it on the event loop).
+    """
+    import time as _time
+
+    import numpy as np
+
+    eos = [int(e) for e in (eos_ids or []) if 0 <= int(e) < vocab_size]
+    deadline = _time.monotonic() + budget_s
+
+    if isinstance(constraint, TrieConstraint):
+        root = build_choice_trie(constraint._choice_ids)
+
+        def key_of(path):
+            return tuple(path)
+
+        def node_at(path):
+            node = root
+            for t in path:
+                node = node[t]
+            return node
+
+        def expand(path):
+            node = node_at(path)
+            ids = [t for t in node if t != GUIDED_END and 0 <= t < vocab_size]
+            at_end = GUIDED_END in node
+            out = []
+            for t in ids:
+                child = node[t]
+                done = not any(k != GUIDED_END for k in child)
+                out.append((t, None if done else path + (t,)))
+            return out, at_end
+
+        start_key = ()
+    elif isinstance(constraint, JsonConstraint):
+        grammar = constraint.grammar
+
+        def key_of(state):
+            return state
+
+        def expand(state):
+            ids = [t for t in grammar.allowed_tokens(state)
+                   if 0 <= t < vocab_size]
+            at_end = grammar.at_end(state)
+            out = []
+            for t in ids:
+                nxt = grammar.run_piece(state, grammar.pieces[t])
+                done = nxt[0][1][0] == "end"
+                out.append((t, None if done else nxt))
+            return out, at_end
+
+        start_key = grammar.initial()
+    else:
+        return None
+
+    # state 0 = DONE; real states from 1
+    state_ids: Dict[tuple, int] = {start_key: 1}
+    rows: List[Optional[List[Tuple[int, Optional[tuple]]]]] = [None, None]
+    at_ends: List[bool] = [False, False]
+    queue = [start_key]
+    while queue:
+        if _time.monotonic() > deadline:
+            return None
+        key = queue.pop(0)
+        sid = state_ids[key]
+        trans, at_end = expand(key)
+        rows[sid] = trans
+        at_ends[sid] = at_end
+        for _t, nxt_key in trans:
+            if nxt_key is None or nxt_key in state_ids:
+                continue
+            if len(state_ids) + 1 > max_states:
+                return None
+            state_ids[nxt_key] = len(state_ids) + 1
+            rows.append(None)
+            at_ends.append(False)
+            queue.append(nxt_key)
+
+    n = len(state_ids) + 1
+    table = np.full((n, vocab_size), -1, np.int32)
+    for key, sid in state_ids.items():
+        for t, nxt_key in rows[sid]:
+            table[sid, t] = (
+                DeviceGuidedTable.DONE if nxt_key is None
+                else state_ids[nxt_key]
+            )
+        if at_ends[sid]:
+            for e in eos:
+                table[sid, e] = DeviceGuidedTable.DONE
+    return DeviceGuidedTable(table, dict(state_ids), (
+        "trie" if isinstance(constraint, TrieConstraint) else "json"
+    ))
 
 
 def build_piece_table(tokenizer, vocab_size: int) -> List[Optional[str]]:

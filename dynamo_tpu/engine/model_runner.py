@@ -66,18 +66,25 @@ CACHE_SPEC = P(None, None, None, "tp", None)  # [L, N, bs, KVH, D] — KV heads 
 
 
 def _sample_and_logprobs(cfg, last_logits, samp, counts, seen, bias,
-                         sample_slots, commit, want_top):
+                         sample_slots, commit, want_top, extra_bias=None):
     """The per-token tail shared by the single step and every scan
     iteration of the fused burst: penalty-aware sampling, the sampled
     token's logprob, gated top-K alternatives, and the committed-count
     update. One implementation ⇒ the burst's bit-identical-stream
-    guarantee can't drift from the single-step program."""
+    guarantee can't drift from the single-step program.
+
+    ``extra_bias`` is an additive [B, V] term computed in-program (the
+    chained burst's device-guided mask); the sync path expresses the
+    same mask through the persistent ``bias`` buffer instead, so adding
+    it here keeps the two paths' logits — and logprobs — bit-equal."""
     from .sampling import top_k_width
 
     b = last_logits.shape[0]
     row_counts = counts[sample_slots]
     row_seen = seen[sample_slots]
     row_bias = bias[sample_slots]
+    if extra_bias is not None:
+        row_bias = row_bias + extra_bias
     next_tokens = sample(last_logits, samp, row_counts, row_seen,
                          bias=row_bias)
     logp = jax.nn.log_softmax(
@@ -101,6 +108,30 @@ def _sample_and_logprobs(cfg, last_logits, samp, counts, seen, bias,
         commit.astype(jnp.int32)
     )
     return next_tokens, lps, top_vals, top_ids, counts
+
+
+def _ngram_props(ring: jax.Array, match: int, k: int) -> jax.Array:
+    """In-program prompt-lookup proposal from the carry's trailing-token
+    ring: find the latest earlier occurrence of the trailing ``match``-
+    gram whose ``k``-token continuation is fully inside the ring and
+    return it ([B, k], -1 where nothing matches). The device analog of
+    scheduler.ngram_propose bounded to the ring window — proposals only
+    affect acceptance length, never stream content (the verify emits the
+    target's own greedy tokens), so the narrower window is free."""
+    b, w = ring.shape
+    tail = ring[:, w - match:]                       # [B, m]
+    n_starts = w - match                             # excludes the tail itself
+    s_idx = jnp.arange(n_starts)
+    win_idx = s_idx[:, None] + jnp.arange(match)[None, :]   # [S0, m]
+    wins = ring[:, win_idx]                          # [B, S0, m]
+    hit = (wins == tail[:, None, :]).all(-1) & (wins >= 0).all(-1)
+    full = (s_idx + match + k) <= w                  # continuation in-ring
+    cand = hit & full[None, :]
+    s_best = jnp.max(jnp.where(cand, s_idx[None, :], -1), axis=1)  # latest
+    has = s_best >= 0
+    cont_idx = jnp.clip(s_best, 0)[:, None] + match + jnp.arange(k)[None, :]
+    props = jnp.take_along_axis(ring, cont_idx, axis=1)
+    return jnp.where(has[:, None] & (props >= 0), props, -1)
 
 
 class ModelRunner:
@@ -309,6 +340,7 @@ class ModelRunner:
 
         self._build_step()
         self._build_burst()
+        self._build_spec_burst()
         self._build_block_ops()
         self._build_sample_row()
 
@@ -548,20 +580,37 @@ class ModelRunner:
         # position/token/counter carries stop advancing, and its output
         # lane emits -1 pads. The burst itself never ends early, so the
         # scheduler can chain dispatches off the returned device carry
-        # (tokens/positions/gen/done) without any host round-trip.
-        from .sampling import device_finish_mask
+        # without any host round-trip.
+        #
+        # The carry additionally holds the UNRESTRICTED-traffic state:
+        # ``ring`` — the row's trailing SUFFIX_RING_W emitted tokens,
+        # hashed each step against the stop strings' canonical-
+        # tokenization hashes (sampling.stop_candidate_mask; a match
+        # freezes the row as a *candidate* the host confirms exactly on
+        # drain) — and ``gstate``, the guided-grammar cursor advanced
+        # through a bounded device transition table (``gtable``:
+        # state × token → next state, -1 reject, state 0 = DONE;
+        # engine/guided.compile_device_table). Rows with gstate < 0 are
+        # unguided and never consult the table.
+        from .sampling import (
+            device_finish_mask,
+            ring_push,
+            stop_candidate_mask,
+        )
 
         max_len = self.config.max_model_len
 
         def burst_df(params, k_cache, v_cache, counts, seen, bias,
-                     tokens0, positions0, gen0, done0, block_tables,
-                     samp, sample_slots, commit, want_top, stop_ids,
-                     min_new, max_new):
+                     tokens0, positions0, gen0, done0, ring0, gstate0,
+                     block_tables, samp, sample_slots, commit, want_top,
+                     stop_ids, min_new, max_new, stop_hash, stop_hlen,
+                     gtable):
             b = tokens0.shape[0]
             rows = jnp.arange(b)
 
             def one(carry, _step_i):
-                k_cache, v_cache, counts, toks, pos, gen, done = carry
+                (k_cache, v_cache, counts, toks, pos, gen, done, ring,
+                 gstate) = carry
                 live = jnp.logical_and(commit, jnp.logical_not(done))
                 slot = block_tables[rows, pos // bs] * bs + pos % bs
                 slot = jnp.where(live, slot, -1)
@@ -573,14 +622,36 @@ class ModelRunner:
                 # a frozen row's counter stops with it and a live row's
                 # matches the single-step path exactly
                 samp_i = _dc.replace(samp, counters=gen)
+                # guided mask from the device table: the sync path bakes
+                # the same mask into the persistent bias buffer, so
+                # adding it here keeps logits (and logprobs) bit-equal
+                guided = gstate >= 0
+                sel = jnp.where(guided, gstate, 0)
+                grow = gtable[sel]                       # [B, V]
+                gmask = jnp.where(
+                    guided[:, None] & (grow < 0), -1e9, 0.0
+                ).astype(jnp.float32)
                 nt, lp, tv, ti, counts = _sample_and_logprobs(
                     cfg, head(hidden[:, 0], params), samp_i, counts, seen,
-                    bias, sample_slots, live, want_top,
+                    bias, sample_slots, live, want_top, extra_bias=gmask,
                 )
                 gen_n = gen + live.astype(jnp.int32)
-                newly = live & device_finish_mask(
+                ring_n = ring_push(ring, nt, live)
+                hard = device_finish_mask(
                     nt, gen_n, pos, stop_ids, min_new, max_new, max_len
                 )
+                cand = stop_candidate_mask(
+                    ring_n, gen_n, min_new, stop_hash, stop_hlen
+                )
+                # grammar advance on the sampled token: DONE (state 0)
+                # completes the constraint; a reject (< 0) is
+                # unreachable through the mask but freezes defensively —
+                # the host names either verdict on drain. A hard finish
+                # (eos at a legal end) wins, mirroring the host's
+                # _check_finish-before-guided-advance order.
+                gnext = gtable[sel, nt]
+                gdone = guided & jnp.logical_not(hard) & (gnext <= 0)
+                newly = live & (hard | cand | gdone)
                 done_n = done | newly
                 # the finishing token still emits (the host streams it);
                 # later steps of a frozen row emit -1 pads
@@ -589,16 +660,20 @@ class ModelRunner:
                 adv = live & jnp.logical_not(newly)
                 toks_n = jnp.where(adv, nt, toks)
                 pos_n = jnp.where(adv, pos + 1, pos)
+                gstate_n = jnp.where(adv & guided, gnext, gstate)
                 return ((k_cache, v_cache, counts, toks_n, pos_n, gen_n,
-                         done_n), (out_tok, out_lp, tv, ti))
+                         done_n, ring_n, gstate_n),
+                        (out_tok, out_lp, tv, ti))
 
             init = (k_cache, v_cache, counts, tokens0, positions0, gen0,
-                    done0)
-            ((k_cache, v_cache, counts, tok_c, pos_c, gen_c, done_c),
+                    done0, ring0, gstate0)
+            ((k_cache, v_cache, counts, tok_c, pos_c, gen_c, done_c,
+              ring_c, gstate_c),
              (toks, lps, tvs, tis)) = jax.lax.scan(
                 one, init, jnp.arange(K)
             )
             return (toks, lps, tvs, tis, tok_c, pos_c, gen_c, done_c,
+                    ring_c, gstate_c,
                     k_cache, v_cache, counts, seen, bias)
 
         self._burst_df = jax.jit(
@@ -612,6 +687,8 @@ class ModelRunner:
                 batch_spec,                  # positions0 [B]
                 batch_spec,                  # gen0 [B]
                 batch_spec,                  # done0 [B]
+                batch2_spec,                 # ring0 [B, RING_W]
+                batch_spec,                  # gstate0 [B]
                 batch2_spec,                 # block_tables [B, W]
                 samp_spec,
                 batch_spec,                  # sample_slots
@@ -620,13 +697,233 @@ class ModelRunner:
                 batch2_spec,                 # stop_ids [B, E]
                 batch_spec,                  # min_new [B]
                 batch_spec,                  # max_new [B]
+                batch2_spec,                 # stop_hash [B, NS]
+                batch2_spec,                 # stop_hlen [B, NS]
+                repl,                        # gtable [S, V]
             ),
             out_shardings=(steps_spec, steps_spec, steps3_spec, steps3_spec,
                            batch_spec, batch_spec, batch_spec, batch_spec,
+                           batch2_spec, batch_spec,
                            self.cache_sharding, self.cache_sharding,
                            self.state_sharding, self.state_sharding,
                            self.state_sharding),
         )
+
+    def _build_spec_burst(self):
+        """Propose-verify rounds chained off the SAME device carry as
+        the device-finish burst — the in-carry half of speculative
+        decoding (ISSUE 13 / ROADMAP item 2).
+
+        One dispatch = one round: S = K+1 positions run through one
+        forward (the pending token + up to K proposals), the full head's
+        per-position argmax is the verify, the accepted prefix + the
+        correction token commit with the SAME freeze semantics as the
+        plain chained burst (finish mask + suffix-hash stop candidates
+        per emitted token), and the carry feeds the next round without a
+        host barrier. Two jit variants share the traced round body:
+        ``_spec_ngram`` derives proposals from the carry's trailing-token
+        ring in-program; ``_spec_verify`` takes them as a device array —
+        the draft model's chained burst output — so draft/target rounds
+        interleave with no host sync between them. Spec-eligible rows
+        are greedy and penalty-free (scheduler._spec_eligible), so the
+        round needs no sampling params and never touches the
+        counts/seen/bias buffers — exactly like the sync verify's
+        commit=False dispatch.
+        """
+        self._spec_ngram = None
+        self._spec_verify = None
+        cfg_e = self.config
+        K = (cfg_e.spec_draft_tokens if cfg_e.spec_draft_model
+             else cfg_e.spec_ngram_tokens)
+        if K <= 0 or not cfg_e.device_finish_enabled:
+            return
+        cfg = self.config.model
+        mesh = self.mesh
+        bs = self.config.kv_block_size
+        batch_spec = NamedSharding(mesh, P("dp"))
+        batch2_spec = NamedSharding(mesh, P(None, "dp"))
+        batchrow_spec = NamedSharding(mesh, P("dp", None))
+        repl = NamedSharding(mesh, P())
+        forward, head = self._make_forward()
+        from .sampling import (
+            device_finish_mask,
+            ring_push,
+            stop_candidate_mask,
+        )
+
+        S = K + 1
+        max_len = self.config.max_model_len
+        match = self.config.spec_ngram_match
+
+        def spec_round(params, k_cache, v_cache, tokens0, positions0,
+                       gen0, done0, ring0, gstate0, block_tables, commit,
+                       stop_ids, min_new, max_new, stop_hash, stop_hlen,
+                       props):
+            b = tokens0.shape[0]
+            rows = jnp.arange(b)
+            live0 = jnp.logical_and(commit, jnp.logical_not(done0))
+            valid = props >= 0                               # [B, K]
+            row_toks = jnp.concatenate(
+                [tokens0[:, None], jnp.where(valid, props, 0)], axis=1
+            )                                                # [B, S]
+            poss = positions0[:, None] + jnp.arange(S)[None, :]
+            slots = block_tables[rows[:, None], poss // bs] * bs + poss % bs
+            slots = jnp.where(live0[:, None], slots, -1)
+            hidden, (k_cache, v_cache) = forward(
+                params, (k_cache, v_cache), row_toks, poss, block_tables,
+                slots, positions0 + S,
+            )
+            greedy = jnp.argmax(
+                head(hidden, params), axis=-1
+            ).astype(jnp.int32)                              # [B, S]
+            m = valid & (greedy[:, :K] == props)
+            acc = jnp.cumprod(m.astype(jnp.int32), axis=1).sum(axis=1)
+            nprop = jnp.where(live0, valid.astype(jnp.int32).sum(axis=1), 0)
+
+            # acceptance accounting matches the sync verify: proposals
+            # that VERIFIED, even if a finish truncates the emit below
+            # (the freeze-fold decides what streams, not what counted)
+            nacc = jnp.where(live0, acc, 0)
+
+            # fold the emitted positions in order, re-running the exact
+            # per-token finish/freeze logic of the plain chained burst
+            outs = []
+            toks_c, pos_c, gen_c = tokens0, positions0, gen0
+            done_c, ring_c = done0, ring0
+            for j in range(S):
+                t_j = greedy[:, j]
+                emit = live0 & jnp.logical_not(done_c) & (j <= acc)
+                gen_c = gen_c + emit.astype(jnp.int32)
+                ring_c = ring_push(ring_c, t_j, emit)
+                hard = device_finish_mask(
+                    t_j, gen_c, pos_c, stop_ids, min_new, max_new, max_len
+                )
+                cand = stop_candidate_mask(
+                    ring_c, gen_c, min_new, stop_hash, stop_hlen
+                )
+                newly = emit & (hard | cand)
+                outs.append(jnp.where(emit, t_j, -1))
+                adv = emit & jnp.logical_not(newly)
+                toks_c = jnp.where(adv, t_j, toks_c)
+                pos_c = jnp.where(adv, pos_c + 1, pos_c)
+                done_c = done_c | newly
+            return (jnp.stack(outs, axis=0), nprop, nacc, toks_c, pos_c,
+                    gen_c, done_c, ring_c, gstate0, k_cache, v_cache)
+
+        common_in = (
+            self.param_shardings,
+            self.cache_sharding, self.cache_sharding,
+            batch_spec,      # tokens0
+            batch_spec,      # positions0
+            batch_spec,      # gen0
+            batch_spec,      # done0
+            batchrow_spec,   # ring0
+            batch_spec,      # gstate0
+            batchrow_spec,   # block_tables
+            batch_spec,      # commit
+            batchrow_spec,   # stop_ids
+            batch_spec,      # min_new
+            batch_spec,      # max_new
+            batchrow_spec,   # stop_hash
+            batchrow_spec,   # stop_hlen
+        )
+        common_out = (
+            batch2_spec,     # toks [S, B]
+            batch_spec,      # nprop
+            batch_spec,      # nacc
+            batch_spec, batch_spec, batch_spec, batch_spec,  # tok/pos/gen/done
+            batchrow_spec,   # ring
+            batch_spec,      # gstate
+            self.cache_sharding, self.cache_sharding,
+        )
+
+        def spec_ngram(params, k_cache, v_cache, tokens0, positions0,
+                       gen0, done0, ring0, gstate0, block_tables, commit,
+                       stop_ids, min_new, max_new, stop_hash, stop_hlen):
+            props = _ngram_props(ring0, match, K)
+            return spec_round(
+                params, k_cache, v_cache, tokens0, positions0, gen0,
+                done0, ring0, gstate0, block_tables, commit, stop_ids,
+                min_new, max_new, stop_hash, stop_hlen, props,
+            )
+
+        if cfg_e.spec_draft_model:
+            self._spec_verify = jax.jit(
+                spec_round,
+                donate_argnums=(1, 2),
+                in_shardings=common_in + (batchrow_spec,),  # props [B, K]
+                out_shardings=common_out,
+            )
+        else:
+            self._spec_ngram = jax.jit(
+                spec_ngram,
+                donate_argnums=(1, 2),
+                in_shardings=common_in,
+                out_shardings=common_out,
+            )
+        self._spec_k = K
+
+    @property
+    def spec_burst_ready(self) -> bool:
+        """Are the chained propose-verify programs built? (The scheduler
+        gates the spec chain on this; test doubles may just define
+        decode_burst_spec.)"""
+        return (getattr(self, "_spec_ngram", None) is not None
+                or getattr(self, "_spec_verify", None) is not None)
+
+    def decode_burst_spec(
+        self,
+        tokens0,                   # [B] np (chain start) or device carry
+        positions0,
+        gen0,
+        done0,
+        ring0,                     # [B, SUFFIX_RING_W]
+        gstate0,                   # [B] (passthrough; spec rows unguided)
+        block_tables: np.ndarray,  # [B, W]
+        *,
+        commit,                    # [B] bool (host np or device)
+        stop_ids: np.ndarray,
+        min_new: np.ndarray,
+        max_new: np.ndarray,
+        stop_hash: np.ndarray,
+        stop_hlen: np.ndarray,
+        proposals=None,            # [B, K] device array (draft) or None (ngram)
+    ):
+        """One chained propose-verify round; returns ``(toks [S, B],
+        nprop [B], nacc [B], carry)`` with -1 pads past each row's
+        acceptance/freeze and the same carry tuple as
+        ``decode_burst_chained``."""
+        b = block_tables.shape[0]
+        args = (
+            self.params, self.kv_cache[0], self.kv_cache[1],
+            jnp.asarray(tokens0, jnp.int32),
+            jnp.asarray(positions0, jnp.int32),
+            jnp.asarray(gen0, jnp.int32),
+            jnp.asarray(done0, jnp.bool_),
+            jnp.asarray(ring0, jnp.int32),
+            jnp.asarray(gstate0, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(commit, jnp.bool_),
+            jnp.asarray(stop_ids, jnp.int32),
+            jnp.asarray(min_new, jnp.int32),
+            jnp.asarray(max_new, jnp.int32),
+            jnp.asarray(stop_hash, jnp.uint32),
+            jnp.asarray(stop_hlen, jnp.int32),
+        )
+        with self.compiles.track(
+            "decode_burst_spec", f"b{b}_w{block_tables.shape[1]}"
+        ):
+            if proposals is None:
+                out = self._spec_ngram(*args)
+            else:
+                out = self._spec_verify(
+                    *args, jnp.asarray(proposals, jnp.int32)
+                )
+        (toks, nprop, nacc, tok_c, pos_c, gen_c, done_c, ring_c,
+         gstate_c, k, v) = out
+        self.kv_cache = (k, v)
+        return toks, nprop, nacc, (tok_c, pos_c, gen_c, done_c, ring_c,
+                                   gstate_c)
 
     def decode_burst(
         self,
@@ -678,6 +975,25 @@ class ModelRunner:
         self.sample_state = (counts, seen, bias)
         return toks, lps, tvs, tis
 
+    # guided device tables pad their state dim to this ladder so each
+    # bucket is one compiled burst program, not one per grammar
+    GUIDED_STATE_BUCKETS = (1, 64, 256, 1024)
+
+    def guided_state_bucket(self, n_states: int) -> int:
+        for s in self.GUIDED_STATE_BUCKETS:
+            if n_states <= s:
+                return s
+        return self.GUIDED_STATE_BUCKETS[-1]
+
+    def _dummy_guided_table(self):
+        """The shared [1, V] all-reject table for unguided dispatches —
+        rows with gstate < 0 never consult it."""
+        if getattr(self, "_dummy_gtable", None) is None:
+            self._dummy_gtable = jnp.full(
+                (1, self.config.model.vocab_size), -1, jnp.int32
+            )
+        return self._dummy_gtable
+
     def decode_burst_chained(
         self,
         tokens0,                   # [B] np (chain start) or device carry
@@ -698,16 +1014,23 @@ class ModelRunner:
         stop_ids: np.ndarray,      # [B, STOP_ID_WIDTH] -1-padded stop set
         min_new: np.ndarray,       # [B] i32
         max_new: np.ndarray,       # [B] i32
+        ring0=None,                # [B, SUFFIX_RING_W] trailing tokens
+        gstate0=None,              # [B] guided table state (-1 unguided)
+        stop_hash=None,            # [B, STOP_SEQ_WIDTH] uint32 targets
+        stop_hlen=None,            # [B, STOP_SEQ_WIDTH] i32 lengths
+        gtable=None,               # [S, V] device table (None = dummy)
         want_top: bool = False,
     ):
         """Run one K-step burst with device-resident finish detection.
 
         Returns ``(toks, lps, tvs, tis, carry)`` with [K, B]-leading
         output arrays (-1 pads past each row's finish) and ``carry`` the
-        next dispatch's device-resident ``(tokens, positions, gen,
-        done)`` — feed it straight back as the first four arguments to
-        chain bursts without a host round-trip.
+        next dispatch's device-resident ``(tokens, positions, gen, done,
+        ring, gstate)`` — feed it straight back as the leading carry
+        arguments to chain bursts without a host round-trip.
         """
+        from .sampling import STOP_SEQ_WIDTH, SUFFIX_RING_W
+
         b = block_tables.shape[0]
         samp = SamplingParams(
             temperature=jnp.asarray(temperature, jnp.float32),
@@ -720,11 +1043,22 @@ class ModelRunner:
             keys=jnp.asarray(seed_keys, jnp.uint32),
             counters=jnp.asarray(gen0, jnp.int32),  # carried in-scan
         )
+        if ring0 is None:
+            ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
+        if gstate0 is None:
+            gstate0 = np.full(b, -1, np.int32)
+        if stop_hash is None:
+            stop_hash = np.zeros((b, STOP_SEQ_WIDTH), np.uint32)
+        if stop_hlen is None:
+            stop_hlen = np.zeros((b, STOP_SEQ_WIDTH), np.int32)
+        if gtable is None:
+            gtable = self._dummy_guided_table()
         with self.compiles.track(
-            "decode_burst_df", f"b{b}_w{block_tables.shape[1]}"
+            "decode_burst_df",
+            f"b{b}_w{block_tables.shape[1]}_g{gtable.shape[0]}",
         ):
-            (toks, lps, tvs, tis, tok_c, pos_c, gen_c, done_c,
-             k, v, counts, seen, bias) = self._burst_df(
+            (toks, lps, tvs, tis, tok_c, pos_c, gen_c, done_c, ring_c,
+             gstate_c, k, v, counts, seen, bias) = self._burst_df(
                 self.params, self.kv_cache[0], self.kv_cache[1],
                 self.sample_state[0], self.sample_state[1],
                 self.sample_state[2],
@@ -732,6 +1066,8 @@ class ModelRunner:
                 jnp.asarray(positions0, jnp.int32),
                 jnp.asarray(gen0, jnp.int32),
                 jnp.asarray(done0, jnp.bool_),
+                jnp.asarray(ring0, jnp.int32),
+                jnp.asarray(gstate0, jnp.int32),
                 jnp.asarray(block_tables, jnp.int32),
                 samp,
                 jnp.arange(b, dtype=jnp.int32),
@@ -740,10 +1076,14 @@ class ModelRunner:
                 jnp.asarray(stop_ids, jnp.int32),
                 jnp.asarray(min_new, jnp.int32),
                 jnp.asarray(max_new, jnp.int32),
+                jnp.asarray(stop_hash, jnp.uint32),
+                jnp.asarray(stop_hlen, jnp.int32),
+                jnp.asarray(gtable, jnp.int32),
             )
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
-        return toks, lps, tvs, tis, (tok_c, pos_c, gen_c, done_c)
+        return toks, lps, tvs, tis, (tok_c, pos_c, gen_c, done_c, ring_c,
+                                     gstate_c)
 
     def step(
         self,
@@ -1179,6 +1519,8 @@ class ModelRunner:
                 softcap=bool(cfg.attn_logit_softcap),
                 fp8_kv=self.config.kv_cache_dtype == "fp8",
                 sinks=cfg.model_family == "gptoss",
+                verify=bool(self.config.spec_ngram_tokens
+                            or self.config.spec_draft_model),
                 timeout_s=timeout_s,
             ):
                 if cfg.attention_impl != "auto":
@@ -1196,6 +1538,7 @@ class ModelRunner:
                 cfg.attention_impl = "xla"
                 self._build_step()
                 self._build_burst()
+                self._build_spec_burst()
                 self.compiles.reset_seen()  # rebuilt programs recompile
         if (cfg.attn_logit_softcap or cfg.sliding_window) and \
                 resolve_attention_impl(cfg.attention_impl) == "pallas":
@@ -1225,6 +1568,7 @@ class ModelRunner:
             cfg.attention_impl = "xla"
             self._build_step()
             self._build_burst()
+            self._build_spec_burst()
             self._reinit_device_state()
             self.compiles.reset_seen()  # rebuilt programs recompile
             self._warmup_once(decode_batch)
@@ -1311,6 +1655,33 @@ class ModelRunner:
                     stop_ids=np.full((b, STOP_ID_WIDTH), -1, np.int32),
                     min_new=z1, max_new=np.full(b, 1, np.int32),
                     want_top=False,
+                )
+        # the chained propose-verify round (spec state in the burst
+        # carry) over the same ladder; inert like the burst warmups
+        if self._spec_ngram is not None or self._spec_verify is not None:
+            from .sampling import (
+                STOP_ID_WIDTH,
+                STOP_SEQ_WIDTH,
+                SUFFIX_RING_W,
+            )
+
+            z1 = np.zeros(b, np.int32)
+            K = self._spec_k
+            for w in self.config.kv_width_buckets():
+                self.decode_burst_spec(
+                    z1, z1, z1, np.zeros(b, bool),
+                    np.full((b, SUFFIX_RING_W), -1, np.int32),
+                    np.full(b, -1, np.int32),
+                    np.zeros((b, w), np.int32),
+                    commit=np.zeros(b, bool),
+                    stop_ids=np.full((b, STOP_ID_WIDTH), -1, np.int32),
+                    min_new=z1, max_new=np.full(b, 1, np.int32),
+                    stop_hash=np.zeros((b, STOP_SEQ_WIDTH), np.uint32),
+                    stop_hlen=np.zeros((b, STOP_SEQ_WIDTH), np.int32),
+                    proposals=(
+                        None if self._spec_verify is None
+                        else np.full((b, K), -1, np.int32)
+                    ),
                 )
         # the ngram-speculative verify shape (S = K+1 on decode-width
         # tables) over the same ladder

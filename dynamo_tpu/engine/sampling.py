@@ -165,9 +165,14 @@ def sample(
 # ending the burst (model_runner._build_burst's device-finish variant).
 # The per-row stop-token set rides as a fixed-width id matrix; requests
 # whose set overflows the width stay on the host sync path (the
-# scheduler's admission-time "device-checkable" classification).
+# scheduler's admission-time "device-checkable" classification) and are
+# COUNTED there (dynamo_engine_sync_fallback_total{reason}) instead of
+# silently downgrading.
 
-STOP_ID_WIDTH = 8  # ids per row: eos ids + hidden stop ids, -1 padded
+# ids per row: eos ids + hidden stop ids, -1 padded. Widened 8 → 16
+# (two rows' worth of the original matrix packed into one): requests
+# with 9-16 stop/eos ids used to fall out of the chain silently.
+STOP_ID_WIDTH = 16
 
 
 def stop_id_row(eos_ids, hidden_ids, ignore_eos: bool) -> Optional[np.ndarray]:
@@ -202,6 +207,114 @@ def device_finish_mask(
     stop = (gen >= min_new) & hit
     length = (gen >= max_new) | (pos + 2 >= max_model_len)
     return stop | length
+
+
+# ---- device-approximate stop strings (suffix ring + rolling hash) ----
+#
+# Stop STRINGS are a text-level condition the engine cannot evaluate
+# exactly (it holds no tokenizer), so chained rows use an APPROXIMATION:
+# the preprocessor ships each stop string's canonical tokenization
+# (StopConditions.stop_token_seqs), the burst program carries a ring of
+# the last SUFFIX_RING_W emitted tokens per row, and each step compares
+# rolling polynomial hashes of the ring's suffixes against the
+# precomputed per-sequence target hashes. A match FREEZES the row as a
+# stop *candidate*; the host confirms on drain with an exact token-
+# suffix compare (Scheduler._check_finish runs the same check on every
+# emitted token, so a true candidate already carries its STOP verdict)
+# and a hash collision resumes the row byte-identically. Non-canonical
+# tokenizations of a stop string are still caught by the backend
+# detokenizer jail, exactly as on the sync path.
+
+SUFFIX_RING_W = 32   # trailing tokens carried per row (also feeds ngram)
+STOP_SEQ_WIDTH = 4   # stop sequences per row the device can watch
+STOP_SEQ_MAX_LEN = 8 # tokens per watched sequence
+
+_HASH_P = np.uint32(1000003)
+
+
+def stop_seq_hash(seq) -> int:
+    """Polynomial hash of one token sequence (uint32, wrapping) — the
+    host mirror of the in-program rolling suffix hash."""
+    h = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for t in seq:
+            h = np.uint32(h * _HASH_P + np.uint32(int(t) + 1))
+    return int(h)
+
+
+def stop_seq_rows(seqs):
+    """Pack one request's stop token sequences into the device rows:
+    ``(hashes [STOP_SEQ_WIDTH] uint32, lens [STOP_SEQ_WIDTH] int32)``.
+    Returns None when the set overflows the width/length bounds — the
+    request is not device-checkable (counted, never silent)."""
+    seqs = [tuple(int(t) for t in s) for s in (seqs or []) if s]
+    if not seqs or len(seqs) > STOP_SEQ_WIDTH:
+        return None
+    if any(len(s) > STOP_SEQ_MAX_LEN for s in seqs):
+        return None
+    hashes = np.zeros(STOP_SEQ_WIDTH, np.uint32)
+    lens = np.zeros(STOP_SEQ_WIDTH, np.int32)
+    for i, s in enumerate(seqs):
+        hashes[i] = stop_seq_hash(s)
+        lens[i] = len(s)
+    return hashes, lens
+
+
+def ring_init(tokens, width: int = SUFFIX_RING_W) -> np.ndarray:
+    """Host-side ring fill: the last ``width`` tokens of the emitted
+    history (prompt + generated, ending with the pending token), -1
+    padded on the left. The chain-fill input for the burst carry."""
+    row = np.full(width, -1, np.int32)
+    tail = list(tokens)[-width:]
+    if tail:
+        row[-len(tail):] = tail
+    return row
+
+
+def ring_push(ring: jax.Array, tokens: jax.Array,
+              live: jax.Array) -> jax.Array:
+    """Shift each LIVE row's ring left and append its new token."""
+    shifted = jnp.concatenate(
+        [ring[:, 1:], tokens[:, None].astype(ring.dtype)], axis=1
+    )
+    return jnp.where(live[:, None], shifted, ring)
+
+
+def suffix_hashes(ring: jax.Array) -> jax.Array:
+    """[B, STOP_SEQ_MAX_LEN + 1] rolling hashes of the ring's trailing
+    suffixes: column L is the hash of the last L tokens (column 0 = 0).
+    Unrolled over the (small, static) max length — pure vector ops."""
+    b, w = ring.shape
+    toks = (ring.astype(jnp.uint32) + jnp.uint32(1))
+    cols = [jnp.zeros((b,), jnp.uint32)]
+    p_pow = jnp.uint32(1)
+    for ell in range(1, STOP_SEQ_MAX_LEN + 1):
+        cols.append(cols[-1] + toks[:, w - ell] * p_pow)
+        p_pow = p_pow * _HASH_P
+    return jnp.stack(cols, axis=1)
+
+
+def stop_candidate_mask(
+    ring: jax.Array,       # [B, W] trailing tokens INCLUDING this step's
+    gen: jax.Array,        # [B] generated count including this token
+    min_new: jax.Array,    # [B] min_tokens (suppresses stops below)
+    stop_hash: jax.Array,  # [B, STOP_SEQ_WIDTH] uint32 target hashes
+    stop_len: jax.Array,   # [B, STOP_SEQ_WIDTH] i32 lengths (0 = unused)
+) -> jax.Array:
+    """Per-row stop-STRING candidate verdict for one step: any watched
+    sequence whose length-L suffix hash matches, gated so the whole
+    suffix is generated output (gen >= L) and min_tokens is satisfied."""
+    hs = suffix_hashes(ring)                              # [B, L+1]
+    sel = jnp.take_along_axis(
+        hs, jnp.clip(stop_len, 0, STOP_SEQ_MAX_LEN), axis=1
+    )                                                     # [B, NS]
+    cand = (
+        (stop_len > 0)
+        & (gen[:, None] >= stop_len)
+        & (gen[:, None] >= min_new[:, None])
+        & (sel == stop_hash)
+    )
+    return cand.any(axis=1)
 
 
 # alternatives returned with every step — covers OpenAI's top_logprobs

@@ -36,14 +36,28 @@ from ..utils import faults
 from .block_allocator import BlockAllocator, KvEventSink
 from .config import EngineConfig
 from .model_runner import ModelRunner
-from .sampling import STOP_ID_WIDTH, host_row, seed_to_key, stop_id_row
+from .sampling import (
+    STOP_ID_WIDTH,
+    STOP_SEQ_WIDTH,
+    SUFFIX_RING_W,
+    host_row,
+    ring_init,
+    seed_to_key,
+    stop_id_row,
+    stop_seq_rows,
+)
 
 logger = logging.getLogger(__name__)
 
 
 # constrained decoding lives in engine/guided.py; re-exported here for
 # callers/tests that import the trie primitives from the scheduler
-from .guided import GUIDED_END, TrieConstraint, build_choice_trie  # noqa: F401,E402
+from .guided import (  # noqa: F401,E402
+    GUIDED_END,
+    TrieConstraint,
+    build_choice_trie,
+    compile_device_table,
+)
 
 
 def ngram_propose(history: List[int], match: int, k: int) -> List[int]:
@@ -202,17 +216,42 @@ class EngineRequest:
     # (hoisted out of the per-token hot path — _check_finish consults
     # these precomputed sets instead of re-deriving eos/stop lists every
     # token) plus the packed device stop-id row for the chained burst.
-    # ``device_checkable`` means every stop condition is expressible
-    # on device: pure eos/hidden-stop/max-tokens, no stop STRINGS, no
-    # n>1 fan-out, stop set within STOP_ID_WIDTH. Guided decoding is
-    # checked live at dispatch (the constraint attaches after admission).
+    # ``device_checkable`` means every finish condition is expressible
+    # on device: eos/hidden-stop/max-tokens within STOP_ID_WIDTH, and
+    # stop STRINGS only via their canonical token sequences within the
+    # suffix-ring bounds (the device-approximate path). ``chain_fallback``
+    # names WHY a request is not checkable so the scheduler's
+    # sync-fallback counter attributes every sync pass. Guided decoding
+    # is checked live at dispatch (the constraint attaches after
+    # admission and its device table compiles in an executor).
     device_checkable: bool = False
+    chain_fallback: Optional[str] = None
     device_frozen: bool = False  # finish came from the device mask
     fin_eos: frozenset = dataclasses.field(default_factory=frozenset)
     fin_stop: frozenset = dataclasses.field(default_factory=frozenset)
     fin_min_new: int = 0
     fin_max_new: int = 16384
     fin_stop_row: Optional[np.ndarray] = None
+    # canonical stop-string token sequences (host-exact check in
+    # _check_finish on EVERY path) + their packed device hash rows
+    fin_stop_seqs: tuple = ()
+    fin_stop_hash: Optional[np.ndarray] = None
+    fin_stop_hlen: Optional[np.ndarray] = None
+    # trailing emitted tokens (prompt + generated, ending with the
+    # pending token): the host mirror of the burst carry's suffix ring —
+    # feeds the exact stop-seq check and the chain-fill ring
+    ring_tail: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=SUFFIX_RING_W)
+    )
+    # chain-transient flags: the guided bias row was reset to
+    # logit_bias-only for a device-table chain (reinstalled at barrier),
+    # and the row froze on a suffix-hash FALSE positive (resumes at the
+    # barrier; gates the drain's pad handling meanwhile)
+    chain_bias_reset: bool = False
+    chain_fp: bool = False
+    # memoized guided-table cache key (the trie key is a tuple over
+    # every choice's token ids — too heavy to rebuild twice per pass)
+    guided_key: Optional[tuple] = None
 
     def __post_init__(self):
         self.classify_finish()
@@ -235,11 +274,38 @@ class EngineRequest:
         )
         n = so.n
         self.fin_stop_row = row
-        self.device_checkable = (
-            row is not None             # stop set fits the device width
-            and not sc.stop             # stop strings post-check on host
-            and (n is None or n <= 1)
-        )
+        self.fin_stop_seqs = ()
+        self.fin_stop_hash = None
+        self.fin_stop_hlen = None
+        reason = None
+        if row is None:
+            reason = "stop_ids_overflow"
+        elif n is not None and n > 1:
+            # serving fans n>1 into independent n=1 children; a direct
+            # multi-choice request stays on the host path defensively
+            reason = "n_gt_1"
+        if sc.stop:
+            seqs = [
+                tuple(int(t) for t in s)
+                for s in (getattr(sc, "stop_token_seqs", None) or [])
+                if s
+            ]
+            if seqs and len(seqs) == len(sc.stop):
+                # host-exact stop-seq finish applies on EVERY path (sync
+                # and chained stay byte-identical); the packed hash rows
+                # are the device approximation's inputs
+                self.fin_stop_seqs = tuple(seqs)
+                packed = stop_seq_rows(seqs)
+                if packed is not None:
+                    self.fin_stop_hash, self.fin_stop_hlen = packed
+                elif reason is None:
+                    reason = "stop_seqs_overflow"
+            elif reason is None:
+                # no canonical tokenizations shipped (direct engine API
+                # callers): text-level stops stay a host/backend concern
+                reason = "stop_seqs_unavailable"
+        self.chain_fallback = reason
+        self.device_checkable = reason is None
 
     @property
     def max_new(self) -> int:
@@ -281,10 +347,13 @@ class _HostBatchState:
         # blocks of each row already mirrored into ``btab``
         self.synced_blocks = np.zeros(b, np.int32)
         # device-finish state (membership-static, consumed by the chained
-        # burst): packed stop-token ids and the min/max token bounds
+        # burst): packed stop-token ids, the min/max token bounds, and
+        # the stop-string suffix-hash targets
         self.stop_ids = np.full((b, STOP_ID_WIDTH), -1, np.int32)
         self.min_new = np.zeros(b, np.int32)
         self.max_new = np.full(b, np.iinfo(np.int32).max, np.int32)
+        self.stop_hash = np.zeros((b, STOP_SEQ_WIDTH), np.uint32)
+        self.stop_hlen = np.zeros((b, STOP_SEQ_WIDTH), np.int32)
 
     def install(self, er: "EngineRequest") -> None:
         """(Re)write one slot's rows at admission / membership change."""
@@ -300,6 +369,12 @@ class _HostBatchState:
         self.max_new[i] = min(er.fin_max_new, np.iinfo(np.int32).max)
         self.stop_ids[i] = (
             er.fin_stop_row if er.fin_stop_row is not None else -1
+        )
+        self.stop_hash[i] = (
+            er.fin_stop_hash if er.fin_stop_hash is not None else 0
+        )
+        self.stop_hlen[i] = (
+            er.fin_stop_hlen if er.fin_stop_hlen is not None else 0
         )
         n = len(er.block_ids)
         self.btab[i, :n] = er.block_ids
@@ -363,6 +438,12 @@ class _InflightBurst:
     # burst must stream and the tokens it samples, fixed at dispatch
     read_bytes: float = 0.0
     tokens: int = 0
+    # chained propose-verify round (scheduler._decode_chained_spec):
+    # [S, B] outputs with -1 pads past acceptance, plus the per-row
+    # proposed/accepted counts for the acceptance-length histogram
+    spec: bool = False
+    nprop: object = None           # device [B] proposal counts
+    nacc: object = None            # device [B] accepted-token counts
 
 
 class Scheduler:
@@ -479,10 +560,25 @@ class Scheduler:
         # chain barrier (admission, preemption, KV-OOM, drain, stop).
         self._chain: deque = deque()   # _InflightBurst FIFO awaiting drain
         self._chain_members: List[EngineRequest] = []
-        self._chain_carry = None       # device (tokens, pos, gen, done)
+        # device (tokens, pos, gen, done, ring, gstate)
+        self._chain_carry = None
         self._chain_dispatched = 0     # bursts since the chain started
         self._chain_pos0: Dict[int, int] = {}  # slot → context at start
         self._last_chain_len = 0
+        # which program family the open chain runs: None (closed),
+        # "plain" (decode_burst_chained) or "spec" (propose-verify
+        # rounds) — switching kinds forces the barrier first
+        self._chain_kind: Optional[str] = None
+        # a suffix-hash stop candidate the host could not confirm (hash
+        # collision): the chain closes at the next pass and the row
+        # resumes byte-identically
+        self._chain_fp = False
+        # compiled guided device tables, shared across requests with the
+        # same grammar: key → DeviceGuidedTable (None = exceeded the
+        # state bound; sync path keeps the request, counted). In-flight
+        # executor compiles in _guided_table_futs.
+        self._guided_tables: Dict[tuple, object] = {}
+        self._guided_table_futs: Dict[tuple, object] = {}
         # watchdog heartbeat: stamped at the top of EVERY loop pass, so a
         # loop wedged INSIDE a pass (hung compile, dead device sync) goes
         # stale while a healthy-but-waiting loop stays fresh
@@ -560,6 +656,19 @@ class Scheduler:
             "chain's length (>1 means the host barrier is no longer "
             "per burst)",
             lambda: self._chain_dispatched or self._last_chain_len,
+        )
+        self._sync_fallback_ctr = reg.counter(
+            "dynamo_engine_sync_fallback_total",
+            "Decode passes that fell back to the per-burst host-sync "
+            "path while the persistent chain was enabled, labelled "
+            "reason= with the constraint that forced it (the shrunken "
+            "fallback ladder: every remaining sync pass is attributed)",
+        )
+        self._spec_accept_hist = reg.histogram(
+            "dynamo_engine_spec_accept_length",
+            "Accepted speculative tokens per propose-verify round "
+            "(chained in-carry rounds; proposals that verify on-chip)",
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
         )
         self._preemptions = reg.counter(
             "dynamo_scheduler_preemptions_total",
@@ -742,6 +851,8 @@ class Scheduler:
         self._chain_carry = None
         self._chain_dispatched = 0
         self._chain_pos0 = {}
+        self._chain_kind = None
+        self._chain_fp = False
 
     def extract_requests(self) -> List[EngineRequest]:
         """Detach every live request (slots, prefill batch, waiting
@@ -833,6 +944,12 @@ class Scheduler:
         gen = list(committed_tokens[len(er.prompt):])
         if er.pending_token >= 0:
             gen = gen + [er.pending_token]
+        er.ring_tail.clear()
+        er.ring_tail.extend(
+            (list(committed_tokens)
+             + ([er.pending_token] if er.pending_token >= 0 else [])
+             )[-SUFFIX_RING_W:]
+        )
         self.runner.set_sample_row(
             slot, er.prompt, gen,
             logit_bias=er.req.sampling_options.logit_bias,
@@ -1001,6 +1118,10 @@ class Scheduler:
         self._register_completed_blocks(er)
         er.pending_token = token
         er.generated += 1
+        # the ring tail mirrors the burst carry's suffix ring (ends with
+        # the pending token) — _check_finish's stop-seq compare and the
+        # next chain fill both read it
+        er.ring_tail.append(token)
         er.finish = self._check_finish(er, token)
 
     def _ensure_block_for(self, er: EngineRequest, position: int) -> bool:
@@ -1146,39 +1267,64 @@ class Scheduler:
                 )
                 spec_now = (speculating and runner_idle
                             and all(self._spec_eligible(er) for er in active))
-                if not spec_now and self._chain_ok(active, runner_idle):
+                chain_on = (self.config.device_finish_enabled
+                            and self.config.decode_pipeline_depth >= 2)
+                spec_reason = (
+                    self._spec_chain_reason(active, runner_idle)
+                    if (spec_now and chain_on) else None
+                )
+                if spec_now and chain_on and spec_reason is None:
+                    # persistent loop, speculative: chain propose-verify
+                    # rounds off the device-resident carry — no host
+                    # barrier between draft/target rounds
+                    await self._decode_chained_spec(loop, active)
+                elif not spec_now and self._chain_ok(active, runner_idle):
                     # persistent loop: chain the next burst off the
                     # device-resident carry; finished rows freeze on
                     # device and drain asynchronously
                     await self._decode_chained(loop, active)
-                elif not spec_now and self._pipeline_ok(active, runner_idle):
-                    # dispatch-ahead: burst k+1 goes to the device before
-                    # burst k's tokens are synced/emitted on the host
-                    await self._chain_barrier(loop)
-                    active = [er for er in active if er.finish is None]
-                    if active:
-                        await self._decode_pipelined(loop, active)
                 else:
-                    await self._chain_barrier(loop)
-                    active = [er for er in active if er.finish is None]
-                    if self._inflight is not None:
-                        # sync barrier: reconcile the in-flight burst
-                        # before any non-pipelined dispatch (membership,
-                        # masks, or the program shape is changing)
-                        await self._drain_pipeline(loop)
+                    # the chain did not engage this pass: attribute the
+                    # sync fallback to its reason (acceptance criterion:
+                    # every remaining sync pass is named)
+                    if chain_on:
+                        reason = (
+                            spec_reason if spec_now
+                            else self._chain_block_reason(
+                                active, runner_idle)
+                        )
+                        if reason:
+                            self._note_sync_fallback(reason)
+                    if not spec_now and self._pipeline_ok(
+                            active, runner_idle):
+                        # dispatch-ahead: burst k+1 goes to the device
+                        # before burst k's tokens are synced on the host
+                        await self._chain_barrier(loop)
                         active = [er for er in active if er.finish is None]
-                    if not active:
-                        pass
-                    elif spec_now:
-                        # speculative verify (ngram or draft-model
-                        # proposals): greedy penalty-free batches only;
-                        # anything else falls through
-                        await self._decode_spec(loop, active)
+                        if active:
+                            await self._decode_pipelined(loop, active)
                     else:
-                        k_steps = self.config.multi_step_decode
-                        if k_steps > 1 and not runner_idle:
-                            k_steps = 1
-                        await self._decode(loop, active, k_steps)
+                        await self._chain_barrier(loop)
+                        active = [er for er in active if er.finish is None]
+                        if self._inflight is not None:
+                            # sync barrier: reconcile the in-flight burst
+                            # before any non-pipelined dispatch
+                            # (membership, masks, or the program shape
+                            # is changing)
+                            await self._drain_pipeline(loop)
+                            active = [er for er in active
+                                      if er.finish is None]
+                        if not active:
+                            pass
+                        elif spec_now:
+                            # speculative verify (ngram or draft-model
+                            # proposals) on the host sync path
+                            await self._decode_spec(loop, active)
+                        else:
+                            k_steps = self.config.multi_step_decode
+                            if k_steps > 1 and not runner_idle:
+                                k_steps = 1
+                            await self._decode(loop, active, k_steps)
                 self._phase_hist.observe(
                     max(0.0, time.monotonic() - t_dec - self._host_sync_s),
                     phase="decode",
@@ -1395,10 +1541,16 @@ class Scheduler:
             # — the exact executor-side shape of a hung Mosaic compile
             # or a dead device mid-sync (utils/faults.py)
             faults.maybe_hang("decode_burst_hang")
+            if infl.spec:
+                # spec rounds carry no logprob outputs (spec-eligible
+                # rows want none) but do carry acceptance accounting
+                return (np.asarray(infl.toks), None, None, None,
+                        np.asarray(infl.nprop), np.asarray(infl.nacc))
             return (np.asarray(infl.toks), np.asarray(infl.lps),
-                    np.asarray(infl.tv), np.asarray(infl.ti))
+                    np.asarray(infl.tv), np.asarray(infl.ti), None, None)
 
-        toks, lpn, tv, ti = await loop.run_in_executor(None, _sync_burst)
+        toks, lpn, tv, ti, nprop, nacc = await loop.run_in_executor(
+            None, _sync_burst)
         self._observe_host_sync(time.monotonic() - t_sync)
         self._last_burst_done_t = time.monotonic()
         if self.device_time is not None and infl.dispatch_t:
@@ -1415,6 +1567,33 @@ class Scheduler:
                     continue  # finished/cancelled: over-decode discarded
                 token = int(toks[j, er.slot])
                 if infl.device_finish and token < 0:
+                    if er.chain_fp:
+                        continue  # already flagged: resumes at barrier
+                    if infl.spec and j > 0:
+                        # spec rounds pad past the acceptance length —
+                        # every LIVE row still emits its correction at
+                        # j=0, so only a j=0 pad means a frozen row
+                        continue
+                    if (er.fin_stop_hash is not None
+                            and er.finish is None):
+                        # the device's suffix-hash stop candidate froze
+                        # this row, but the host's EXACT token-suffix
+                        # check (_check_finish, ran on every emitted
+                        # token above) never fired: a hash collision.
+                        # Flag it — the chain closes at the next pass
+                        # and the row resumes byte-identically from its
+                        # committed state (no tokens were lost: frozen
+                        # rows never over-decode).
+                        er.chain_fp = True
+                        self._chain_fp = True
+                        self._note_sync_fallback("stop_false_positive")
+                        self.flight.record(
+                            "scheduler.stop_false_positive",
+                            request_id=er.request_id,
+                            trace_id=er.ctx.trace_id,
+                            generated=er.generated,
+                        )
+                        continue
                     # -1 pad: the device froze this row at an earlier
                     # step, whose application above set er.finish. A pad
                     # with NO host verdict means the device mask and the
@@ -1432,11 +1611,18 @@ class Scheduler:
                     self._finish_pipelined(er, emit=True)
                     continue
                 self._advance_row(er, token)
+                if infl.device_finish and er.guided is not None:
+                    # chained guided rows: advance the host cursor
+                    # (verdicts only — the device computed the mask; the
+                    # barrier reinstalls the host mask if needed)
+                    self._guided_after_token(er, edit=False)
                 er.pipeline_span_open = True
                 self._emit(
                     er, token,
-                    float(lpn[j, er.slot]) if er.want_logprobs else None,
-                    self._top_row(er, tv[j], ti[j], er.slot),
+                    (float(lpn[j, er.slot])
+                     if (lpn is not None and er.want_logprobs) else None),
+                    (self._top_row(er, tv[j], ti[j], er.slot)
+                     if tv is not None else None),
                 )
                 if er.finish is not None:
                     if infl.device_finish:
@@ -1446,6 +1632,17 @@ class Scheduler:
                         er.device_frozen = True
                         self._device_finished_ctr.inc()
                     self._finish_pipelined(er)
+        if infl.spec and nprop is not None:
+            for er in infl.active:
+                p = int(nprop[er.slot])
+                if p <= 0:
+                    continue  # frozen rows propose nothing this round
+                a = int(nacc[er.slot])
+                self.spec_proposed += p
+                self.spec_accepted += min(a, p)
+                self._spec_proposed_ctr.inc(p)
+                self._spec_accepted_ctr.inc(min(a, p))
+                self._spec_accept_hist.observe(float(a))
 
     def _finish_pipelined(self, er: EngineRequest, emit: bool = False) -> None:
         """A pipelined row finished (possibly one burst late): truncate
@@ -1505,34 +1702,187 @@ class Scheduler:
     # per-burst device output buffers stay bounded
     CHAIN_MAX_INFLIGHT = 4
 
+    def _note_sync_fallback(self, reason: str) -> None:
+        self._sync_fallback_ctr.inc(reason=reason)
+
     def _chain_ok(self, active: List[EngineRequest],
                   runner_idle: bool) -> bool:
-        """May this pass chain a burst off the device-resident carry?
+        return self._chain_block_reason(active, runner_idle) is None
 
-        Requires device-resident finish detection for EVERY active row:
-        the admission-time ``device_checkable`` classification (pure
-        eos/hidden-stop/max-tokens, no stop strings, no n>1) plus the
-        live guided check (the constraint attaches after admission).
-        Speculative decoding and non-idle passes fall back exactly like
-        the PR 3 pipeline. With a chain open, any row NOT already a
-        member (a membership surprise) forces the barrier.
-        """
+    def _chain_block_reason(self, active: List[EngineRequest],
+                            runner_idle: bool) -> Optional[str]:
+        """Why can this pass NOT chain a plain burst off the device
+        carry? None = it can. The shrunken fallback ladder: stop-string
+        rows chain via the suffix-hash approximation, guided rows via a
+        compiled device table, n>1 arrives as independent n=1 children —
+        what remains is named here and counted per sync pass
+        (dynamo_engine_sync_fallback_total{reason})."""
         cfg = self.config
         if not (cfg.device_finish_enabled
-                and cfg.decode_pipeline_depth >= 2 and runner_idle):
-            return False
-        if self.draft is not None or cfg.spec_ngram_tokens > 0:
-            return False
+                and cfg.decode_pipeline_depth >= 2):
+            return "disabled"
+        if not runner_idle:
+            return "not_idle"
         if not active:
-            return False
+            return "no_rows"
+        if self._chain_fp:
+            # a suffix-hash false positive froze a row the host must
+            # resume: close the chain first (the barrier clears this)
+            return "stop_false_positive"
+        if self.draft is not None:
+            # plain (non-spec) chaining would starve the draft's mirror
+            # cache for these rows; draft engines chain through the
+            # propose-verify rounds instead
+            return "draft_mirror"
+        if self._chain_members and self._chain_kind not in (None, "plain"):
+            return "chain_kind"
+        tables = set()
         for er in active:
-            if er.guided is not None or not er.device_checkable:
-                return False
+            if not er.device_checkable:
+                return er.chain_fallback or "not_checkable"
+            if er.fin_stop_seqs and not cfg.device_stop_strings:
+                return "stop_strings_disabled"
+            if er.guided is not None:
+                r = self._guided_chain_reason(er)
+                if r:
+                    return r
+                tables.add(id(self._guided_tables[
+                    self._guided_table_key(er)]))
+        if len(tables) > 1:
+            # the burst program takes ONE transition table; requests
+            # sharing a grammar share a table (the common case), mixed
+            # grammars wait for membership to separate them
+            return "guided_multi_grammar"
         if self._chain_members:
             member_ids = {id(m) for m in self._chain_members}
             if any(id(er) not in member_ids for er in active):
-                return False
-        return True
+                return "membership"
+        return None
+
+    def _spec_chain_reason(self, active: List[EngineRequest],
+                           runner_idle: bool) -> Optional[str]:
+        """Why can this pass NOT chain propose-verify rounds? (Callers
+        established spec_now: speculation configured, runner idle, every
+        row spec-eligible — greedy, penalty-free, unguided.)"""
+        cfg = self.config
+        if not (cfg.device_finish_enabled
+                and cfg.decode_pipeline_depth >= 2):
+            return "disabled"
+        if not runner_idle:
+            return "not_idle"
+        if self._chain_fp:
+            return "stop_false_positive"
+        if not getattr(self.runner, "spec_burst_ready",
+                       hasattr(self.runner, "decode_burst_spec")):
+            return "spec_program"
+        P = (cfg.spec_draft_tokens if self.draft is not None
+             else cfg.spec_ngram_tokens)
+        n = self._chain_dispatched
+        for er in active:
+            if not er.device_checkable:
+                return er.chain_fallback or "not_checkable"
+            if er.fin_stop_seqs and not cfg.device_stop_strings:
+                return "stop_strings_disabled"
+            # conservative horizon guard: the host's committed context
+            # lags the drain queue, so bound by the chain's own dispatch
+            # count — the round's S-position forward must stay inside
+            # the model-len horizon (the sync verify makes the same
+            # per-pass check)
+            pos0 = self._chain_pos0.get(er.slot, er.context_len)
+            if pos0 + (n + 1) * (P + 1) + 1 > cfg.max_model_len:
+                return "spec_near_horizon"
+        if self._chain_members:
+            if self._chain_kind not in (None, "spec"):
+                return "chain_kind"
+            member_ids = {id(m) for m in self._chain_members}
+            if any(id(er) not in member_ids for er in active):
+                return "membership"
+        return None
+
+    # ---------- guided device tables (engine/guided.py) ----------
+
+    # compiled tables kept at most this many distinct grammars: each is
+    # a dense [states, vocab] int32 (tens of MB at real vocab sizes), so
+    # adversarial per-request unique choice lists must not grow memory
+    # without bound. LRU; eviction is safe mid-chain because every
+    # chained pass re-checks presence (_guided_chain_reason) BEFORE the
+    # dispatch reads the cache — a missing table just recompiles.
+    GUIDED_TABLE_CACHE = 16
+
+    def _guided_table_key(self, er: EngineRequest) -> tuple:
+        if er.guided_key is not None:
+            return er.guided_key
+        eos = tuple(sorted(int(t) for t in (er.req.eos_token_ids or [])))
+        g = er.guided
+        if isinstance(g, TrieConstraint):
+            key = ("trie",
+                   tuple(tuple(int(t) for t in c) for c in g._choice_ids),
+                   eos)
+        else:
+            # JsonConstraint: the grammar object is shared across
+            # requests with the same spec (serving's cache), so its
+            # identity keys
+            key = ("json", id(g.grammar), eos)
+        er.guided_key = key
+        return key
+
+    def _compile_guided_table(self, er: EngineRequest):
+        """Executor-side table compile (also called directly by tests).
+        Returns the DeviceGuidedTable or None (bound exceeded)."""
+        return compile_device_table(
+            er.guided, self.config.model.vocab_size,
+            er.req.eos_token_ids or [],
+            max_states=self.config.guided_table_max_states,
+        )
+
+    def _guided_chain_reason(self, er: EngineRequest) -> Optional[str]:
+        """Is this guided row chainable right now? Kicks the (executor)
+        table compile on first sight; the row serves on the sync path
+        until the table lands."""
+        if not self.config.guided_device_table:
+            return "guided_disabled"
+        key = self._guided_table_key(er)
+        if key in self._guided_tables:
+            table = self._guided_tables[key]
+            # LRU touch + cap: evict the coldest grammar's table when a
+            # new one would exceed the bound (re-checked every pass, so
+            # an evicted-then-needed table simply recompiles)
+            self._guided_tables.pop(key)
+            self._guided_tables[key] = table
+            while len(self._guided_tables) > self.GUIDED_TABLE_CACHE:
+                self._guided_tables.pop(
+                    next(iter(self._guided_tables)))
+            if table is None:
+                return "guided_table_bound"
+            if table.state_id(er.guided) is None:
+                # the cursor is in a state the BFS never reached — only
+                # a bug can produce this; stay on the sync path loudly
+                logger.warning(
+                    "guided cursor state unmapped in the device table "
+                    "for %s; keeping the sync path", er.request_id,
+                )
+                return "guided_state_unmapped"
+            return None
+        if key not in self._guided_table_futs:
+            # the per-state vocab sweep must never run on the event
+            # loop — compile in an executor, chain once it lands
+            loop = asyncio.get_running_loop()
+            fut = loop.run_in_executor(
+                None, self._compile_guided_table, er
+            )
+
+            def _done(f, key=key):
+                try:
+                    self._guided_tables[key] = f.result()
+                except Exception:
+                    logger.exception("guided device-table compile failed")
+                    self._guided_tables[key] = None
+                self._guided_table_futs.pop(key, None)
+                self.wake.set()
+
+            fut.add_done_callback(_done)
+            self._guided_table_futs[key] = fut
+        return "guided_table_pending"
 
     def _chain_ready(self, infl: _InflightBurst) -> bool:
         """Non-blocking: are this burst's outputs already materialized?
@@ -1576,8 +1926,15 @@ class Scheduler:
             active = [er for er in active if er.finish is None]
             if not active:
                 return
+        if self._chain_members and self._chain_kind not in (None, "plain"):
+            # a spec chain is open: switch program families at a barrier
+            await self._chain_barrier(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return
         if not self._chain_members:
             self._chain_members = list(active)
+            self._chain_kind = "plain"
             self._chain_carry = None
             self._chain_dispatched = 0
             self._chain_pos0 = {er.slot: er.context_len for er in active}
@@ -1598,6 +1955,7 @@ class Scheduler:
                 # KV OOM: preemption needs fully-committed host state —
                 # barrier, then let the sync path preempt/decode
                 self.allocator.flush_offload()
+                self._note_sync_fallback("kv_oom")
                 await self._chain_barrier(loop)
                 live = [er for er in active if er.finish is None]
                 if live:
@@ -1613,18 +1971,40 @@ class Scheduler:
         w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
         btab = hs.btab[:, :w].copy()
         want_top = any(er.logprobs_n > 0 for er in members)
+        # guided members ride the device transition table: ONE table per
+        # chain (_chain_block_reason enforced it), their bias rows reset
+        # to logit_bias-only so the in-program mask is not double-applied
+        # (the barrier reinstalls the host mask)
+        gtable_dev = None
+        guided_live = [er for er in live if er.guided is not None]
+        if guided_live:
+            table = self._guided_tables[
+                self._guided_table_key(guided_live[0])]
+            bucket = self.runner.guided_state_bucket(table.n_states)
+            gtable_dev = table.device(bucket)
+            for er in guided_live:
+                if not er.chain_bias_reset:
+                    self._set_plain_bias(er)
+                    er.chain_bias_reset = True
         if self._chain_carry is None:
             # chain fill: the carry comes from committed host state
             tokens0 = np.zeros(b, np.int32)
             positions0 = np.zeros(b, np.int32)
             gen0 = np.zeros(b, np.int32)
             done0 = np.zeros(b, bool)
+            ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
+            gstate0 = np.full(b, -1, np.int32)
             for er in live:
                 tokens0[er.slot] = er.pending_token
                 positions0[er.slot] = er.context_len
                 gen0[er.slot] = er.generated
+                ring0[er.slot] = ring_init(er.ring_tail)
+                if er.guided is not None:
+                    gstate0[er.slot] = self._guided_tables[
+                        self._guided_table_key(er)].state_id(er.guided)
         else:
-            tokens0, positions0, gen0, done0 = self._chain_carry
+            (tokens0, positions0, gen0, done0, ring0,
+             gstate0) = self._chain_carry
 
         # device-idle bookkeeping (same approximation as the pipelined
         # path): a carry already materialized at dispatch time means the
@@ -1646,7 +2026,10 @@ class Scheduler:
             min_p=hs.min_p, presence_penalty=hs.pres,
             frequency_penalty=hs.freq, repetition_penalty=hs.rep,
             seed_keys=hs.keys, commit=commit, stop_ids=hs.stop_ids,
-            min_new=hs.min_new, max_new=hs.max_new, want_top=want_top,
+            min_new=hs.min_new, max_new=hs.max_new,
+            ring0=ring0, gstate0=gstate0,
+            stop_hash=hs.stop_hash, stop_hlen=hs.stop_hlen,
+            gtable=gtable_dev, want_top=want_top,
         )
         self._chain_carry = carry
         self._chain_dispatched += 1
@@ -1682,11 +2065,183 @@ class Scheduler:
             # is frozen over-decode — close the chain now
             await self._chain_barrier(loop)
 
+    async def _decode_chained_spec(self, loop,
+                                   active: List[EngineRequest]) -> None:
+        """One chained propose-verify pass: ONE spec round dispatched
+        straight off the device-resident carry — proposals from the
+        carry's trailing-token ring (ngram) or from the draft model's
+        chained burst on the SAME carry (draft), verified by one
+        S = K+1-position forward whose accepted prefix + correction
+        commit with the plain chain's freeze semantics. No host barrier
+        between rounds: the draft consumes the target's device carry
+        directly, acceptance folds into the carry on device, and the
+        async row drain reconciles rounds as their outputs materialize
+        (per-row acceptance lengths ride back for the
+        dynamo_engine_spec_accept_length histogram).
+        """
+        cfg = self.config
+        b = cfg.max_batch_size
+        P = (cfg.spec_draft_tokens if self.draft is not None
+             else cfg.spec_ngram_tokens)
+        S = P + 1
+        if self._inflight is not None:
+            await self._drain_pipeline(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return
+        if self._chain_members and self._chain_kind not in (None, "spec"):
+            await self._chain_barrier(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return
+        if not self._chain_members:
+            self._chain_members = list(active)
+            self._chain_kind = "spec"
+            self._chain_carry = None
+            self._chain_dispatched = 0
+            self._chain_pos0 = {er.slot: er.context_len for er in active}
+        members = self._chain_members
+        live = [er for er in members if er.finish is None]
+        if not live:
+            await self._chain_barrier(loop)
+            return
+        # headroom: a round advances a never-frozen row by at most S
+        # positions (accepted prefix + correction), so the chain's n-th
+        # round runs through chain_pos0 + (n+1)*S; near-horizon rounds
+        # never dispatch (_spec_chain_reason barriers them first)
+        n = self._chain_dispatched
+        for er in live:
+            limit = min(self._chain_pos0[er.slot] + (n + 1) * S,
+                        cfg.max_model_len - 1)
+            if not self._ensure_block_for(er, limit):
+                self.allocator.flush_offload()
+                self._note_sync_fallback("kv_oom")
+                await self._chain_barrier(loop)
+                live = [er for er in active if er.finish is None]
+                if live:
+                    await self._decode(loop, live, 1)
+                return
+            self._host.sync_blocks(er)
+        self.allocator.flush_offload()
+
+        hs = self._host
+        commit = np.zeros(b, bool)
+        for er in members:
+            commit[er.slot] = er.finish is None
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
+        btab = hs.btab[:, :w].copy()
+        if self._chain_carry is None:
+            tokens0 = np.zeros(b, np.int32)
+            positions0 = np.zeros(b, np.int32)
+            gen0 = np.zeros(b, np.int32)
+            done0 = np.zeros(b, bool)
+            ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
+            gstate0 = np.full(b, -1, np.int32)
+            for er in live:
+                tokens0[er.slot] = er.pending_token
+                positions0[er.slot] = er.context_len
+                gen0[er.slot] = er.generated
+                ring0[er.slot] = ring_init(er.ring_tail)
+        else:
+            (tokens0, positions0, gen0, done0, ring0,
+             gstate0) = self._chain_carry
+
+        props = None
+        if self.draft is not None:
+            # draft round chained off the SAME carry: its burst consumes
+            # the target's device-resident tokens/positions and its
+            # commit mask is gated by the device done carry — no host
+            # barrier anywhere in the draft → verify round trip
+            import jax.numpy as jnp
+
+            commit_dev = jnp.logical_and(
+                jnp.asarray(commit),
+                jnp.logical_not(jnp.asarray(done0, jnp.bool_)),
+            )
+            dtemp, dtop_k, dtop_p, dkw = self._inert_sampling(b)
+            dtoks, *_ = self.draft.decode_burst(
+                tokens0, positions0, btab, dtemp, dtop_k, dtop_p,
+                commit=commit_dev, want_top=False, **dkw,
+            )
+            props = jnp.transpose(dtoks[:P])  # [B, P] device proposals
+            self.steps += 1
+
+        # device-idle bookkeeping (same approximation as the plain chain)
+        now = time.monotonic()
+        if self._last_burst_done_t is not None:
+            if self._chain_carry is None:
+                self._bubble_hist.observe(now - self._last_burst_done_t)
+            else:
+                ready = getattr(tokens0, "is_ready", lambda: True)()
+                self._bubble_hist.observe(
+                    now - self._last_burst_done_t if ready else 0.0
+                )
+        self._last_burst_done_t = None
+
+        toks, nprop, nacc, carry = self.runner.decode_burst_spec(
+            tokens0, positions0, gen0, done0, ring0, gstate0, btab,
+            commit=commit, stop_ids=hs.stop_ids, min_new=hs.min_new,
+            max_new=hs.max_new, stop_hash=hs.stop_hash,
+            stop_hlen=hs.stop_hlen, proposals=props,
+        )
+        self._chain_carry = carry
+        self._chain_dispatched += 1
+        self.steps += 1
+        self.pipeline_bursts += 1
+        self.flight.record(
+            "scheduler.burst_dispatch", k_steps=S, rows=len(live),
+            pipelined=True, chained=True, spec=True,
+            chain_len=self._chain_dispatched,
+            requests=[er.request_id for er in live[:8]],
+        )
+        dt = self.device_time
+        self._chain.append(_InflightBurst(
+            active=list(live), toks=toks, lps=None, tv=None, ti=None,
+            k_steps=S, last_tokens=None,
+            dispatch_t=time.monotonic(), device_finish=True,
+            spec=True, nprop=nprop, nacc=nacc,
+            read_bytes=dt.decode_read_bytes(
+                1,
+                sum(min(self._chain_pos0[er.slot] + n * S + S,
+                        cfg.max_model_len) for er in live),
+            ) if dt is not None else 0.0,
+            tokens=len(live),
+        ))
+        while self._chain and self._chain_ready(self._chain[0]):
+            await self._apply_chain_head(loop)
+        while len(self._chain) >= self.CHAIN_MAX_INFLIGHT:
+            await self._apply_chain_head(loop)
+        if all(er.finish is not None for er in members):
+            await self._chain_barrier(loop)
+
+    def _set_plain_bias(self, er: EngineRequest) -> None:
+        """Reset one slot's bias row to the request's logit_bias alone —
+        a device-table chain computes the guided mask in-program, so the
+        host-installed mask must not double-apply."""
+        v = self.config.model.vocab_size
+        row = np.zeros(v, np.float32)
+        for tid, bv in (er.req.sampling_options.logit_bias or {}).items():
+            tid = int(tid)
+            if 0 <= tid < v:
+                row[tid] += float(bv)
+        self.runner.set_bias_row(er.slot, row)
+
+    def _reinstall_guided_mask(self, er: EngineRequest) -> None:
+        """Back to host-masked guided decoding (chain closed): rebuild
+        the dense mask from the CURRENT cursor state — the drain
+        advanced the host cursor token-by-token, so it is exact."""
+        mask = self._guided_mask(er)
+        for tid, bv in (er.req.sampling_options.logit_bias or {}).items():
+            tid = int(tid)
+            if 0 <= tid < len(mask):
+                mask[tid] += float(bv)
+        self.runner.set_bias_row(er.slot, mask)
+
     async def _chain_barrier(self, loop) -> None:
         """Host barrier: reconcile every queued chained burst and close
         the chain — the ONLY place chain membership compacts. Runs before
-        admission-driven sync passes, preemption, spec/guided dispatch,
-        and shutdown."""
+        admission-driven sync passes, preemption, program-family
+        switches, and shutdown."""
         if not self._chain and not self._chain_members:
             return
         bursts = self._chain_dispatched
@@ -1698,6 +2253,11 @@ class Scheduler:
                 rows=len(self._chain_members),
             )
             for er in self._chain_members:
+                er.chain_fp = False
+                if er.chain_bias_reset:
+                    er.chain_bias_reset = False
+                    if er.finish is None and er.guided is not None:
+                        self._reinstall_guided_mask(er)
                 if er.finish is None and er.pipeline_span_open:
                     er.ctx.add_stage("decode_pipeline")
                     er.pipeline_span_open = False
@@ -1707,6 +2267,8 @@ class Scheduler:
         self._chain_carry = None
         self._chain_dispatched = 0
         self._chain_pos0 = {}
+        self._chain_kind = None
+        self._chain_fp = False
 
     # ---------- cluster KV fabric: prefix pull (kv/fabric.py) ----------
 
@@ -2060,6 +2622,9 @@ class Scheduler:
         )
         er.seq = TokenSequence(er.prompt, block_size=self.config.kv_block_size)
         self._register_completed_blocks(er)
+        er.ring_tail.clear()
+        er.ring_tail.extend(er.prompt[-SUFFIX_RING_W:])
+        er.ring_tail.append(token)
         er.finish = self._check_finish(er, token)
         if top and er.logprobs_n > 0:
             top = dict(list(top.items())[: er.logprobs_n])
@@ -2084,6 +2649,11 @@ class Scheduler:
             prompt_tokens=len(er.prompt), resumed=bool(er.resume_tokens),
         )
         tokens_all = er.prompt + er.resume_tokens
+        # ring tail mirrors the emitted history (a resumed request's
+        # replayed tail included) so stop-seq checks and chain fills
+        # continue exactly where the stream left off
+        er.ring_tail.clear()
+        er.ring_tail.extend(tokens_all[-SUFFIX_RING_W:])
         if er.pull_ready and er.block_ids:
             # a committed prefix pull already allocated the blocks,
             # scattered the pulled run, and registered it (num_cached
@@ -2316,6 +2886,7 @@ class Scheduler:
             token = int(toks[i])
             er.pending_token = token
             er.generated += 1  # += not =: resumed requests keep their count
+            er.ring_tail.append(token)
             er.finish = self._check_finish(er, token)
             self._guided_after_token(er)
             self._emit(er, token, float(lpn[i]) if er.want_logprobs else None,
@@ -2361,11 +2932,17 @@ class Scheduler:
         mask[er.guided_allowed] = 0.0
         return mask
 
-    def _guided_after_token(self, er: EngineRequest) -> None:
+    def _guided_after_token(self, er: EngineRequest,
+                            edit: bool = True) -> None:
         """Advance the constraint past the just-sampled token; install
         the next mask, or finish when the constraint completes. Runs
         between _check_finish and _emit so the completing token still
-        streams."""
+        streams.
+
+        ``edit=False`` (the chained drain): advance the cursor and judge
+        verdicts only — the device computed this token's mask from the
+        transition table, and the barrier reinstalls the host mask if
+        the row ever returns to the sync path."""
         if er.guided is None or er.finish is not None:
             return
         key_before = er.guided.state_key()
@@ -2375,6 +2952,8 @@ class Scheduler:
             # token). "derail": eos at a legal end point (eos is never
             # in the constraint's own alphabet) or a defensive fallback.
             er.finish = FinishReason.STOP
+            return
+        if not edit:
             return
         if er.guided.state_key() == key_before:
             # same machine state → identical allowed set (e.g. JSON
@@ -2811,7 +3390,10 @@ class Scheduler:
         precomputed frozensets instead of re-deriving eos/stop lists
         from the request every token — this runs for EVERY emitted token
         of every request (incl. the async drain's hot path). Must stay
-        the exact host mirror of sampling.device_finish_mask."""
+        the exact host mirror of sampling.device_finish_mask (+ the
+        suffix-hash stop approximation: the exact token-suffix compare
+        below is what the device's hash candidate approximates, and it
+        runs on BOTH paths so chain and sync streams stay identical)."""
         if er.generated >= er.fin_min_new:
             # eos/stops suppressed below min_tokens; ignore_eos already
             # emptied fin_eos at classification
@@ -2819,6 +3401,18 @@ class Scheduler:
                 return FinishReason.EOS
             if token in er.fin_stop:
                 return FinishReason.STOP
+            if er.fin_stop_seqs:
+                # canonical-tokenization stop strings: the ring tail
+                # ends with this token (callers note it first); only
+                # generated output may match (gen >= L). Non-canonical
+                # tokenizations remain the backend jail's concern.
+                tail = tuple(er.ring_tail)
+                for seq in er.fin_stop_seqs:
+                    length = len(seq)
+                    if (er.generated >= length
+                            and len(tail) >= length
+                            and tail[-length:] == seq):
+                        return FinishReason.STOP
         if er.generated >= er.fin_max_new:
             return FinishReason.LENGTH
         if er.context_len + 1 >= self.config.max_model_len:

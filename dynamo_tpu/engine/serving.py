@@ -82,6 +82,14 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         ),
         spec_ngram_tokens=getattr(flags, "spec_ngram_tokens", 0) or 0,
         spec_ngram_match=getattr(flags, "spec_ngram_match", 3) or 3,
+        # unrestricted chain (docs/performance.md): guided device
+        # tables + device-approximate stop strings
+        guided_device_table=not getattr(
+            flags, "no_guided_device_table", False),
+        guided_table_max_states=getattr(
+            flags, "guided_table_max_states", 256) or 256,
+        device_stop_strings=not getattr(
+            flags, "no_device_stop_strings", False),
         # no `or` fallback: an explicit 0 must DISABLE the watchdog, not
         # silently restore the default deadline
         watchdog_stall_s=(
@@ -302,10 +310,18 @@ class JaxServingEngine(AsyncEngine):
             )
         n = req.sampling_options.n
         if n is not None and n > 1:
-            # reject rather than silently sample one choice (parity:
-            # reference SamplingOptions carries n/best_of to engines that
-            # implement them — lib/llm/src/protocols/common.rs:248-316)
-            raise EngineError("n > 1 is not supported by this engine")
+            # engine-level n>1 fan-out: each choice becomes an
+            # INDEPENDENT scheduler request (n=1, seed offset by choice
+            # index — the preprocessor's _child_request convention), so
+            # every choice is an ordinary device-checkable row the
+            # persistent chain serves like any other; the choice-fold
+            # happens here at drain, each delta tagged with its
+            # EngineOutput.choice index.
+            if n > 20:  # OpenAI's cap; also bounds the fan-out
+                raise EngineError("n must be <= 20")
+            async for out in self._generate_fanout(request, req, n):
+                yield out
+            return
         if (req.stop_conditions.max_tokens == 0
                 and req.output_options.prompt_logprobs is None):
             # an empty completion: nothing to schedule, finish immediately
@@ -343,6 +359,84 @@ class JaxServingEngine(AsyncEngine):
         finally:
             # consumer went away (stop/kill/break) — scheduler will reap it
             request.context.stop_generating()
+
+    async def _generate_fanout(self, request: Context[Any],
+                               req: PreprocessedRequest, n: int):
+        """n>1 as n independent n=1 scheduler requests sharing the
+        caller's cancellation context; deltas interleave in completion
+        order, each stamped with its choice index, and the stream ends
+        when every choice's sentinel arrived."""
+        import dataclasses as _dc
+
+        from ..runtime.engine import AsyncEngineContext
+
+        base_seed = req.sampling_options.seed
+        # per-choice child contexts (the preprocessor fan-out's
+        # convention): cancellation isolation per choice, spans folded
+        # back into the parent trace with #<choice> suffixes
+        child_ctxs = [
+            AsyncEngineContext(trace_id=request.context.trace_id)
+            for _ in range(n)
+        ]
+
+        async def relay_stop() -> None:
+            await request.context.wait_stopped()
+            for c in child_ctxs:
+                c.stop_generating()
+
+        relay = asyncio.ensure_future(relay_stop())
+        children = []
+        base_id = request.id or uuid.uuid4().hex
+        for i in range(n):
+            child_req = _dc.replace(
+                req,
+                sampling_options=_dc.replace(
+                    req.sampling_options, n=1,
+                    seed=(base_seed + i) if base_seed is not None else None,
+                ),
+            )
+            er = EngineRequest(
+                request_id=f"{base_id}#{i}",
+                prompt=list(req.token_ids),
+                req=child_req,
+                ctx=child_ctxs[i],
+                out_queue=asyncio.Queue(),
+                guided=(
+                    await self._json_constraint(
+                        req.sampling_options.guided_json)
+                    if req.sampling_options.guided_json else None
+                ),
+            )
+            children.append(er)
+        merged: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int, er: EngineRequest):
+            while True:
+                out = await er.out_queue.get()
+                await merged.put((i, out))
+                if out is None:
+                    return
+
+        tasks = [asyncio.ensure_future(pump(i, er))
+                 for i, er in enumerate(children)]
+        for er in children:
+            self.scheduler.add_request(er)
+        open_choices = n
+        try:
+            while open_choices:
+                i, out = await merged.get()
+                if out is None:
+                    open_choices -= 1
+                    continue
+                out.choice = i
+                yield out.to_wire()
+        finally:
+            for t in tasks:
+                t.cancel()
+            relay.cancel()
+            for c in child_ctxs:
+                c.stop_generating()
+            request.context.merge_stages_from(child_ctxs)
 
     async def _json_constraint(self, spec: dict):
         """Per-request cursor over the (cached) compiled grammar. The

@@ -280,6 +280,32 @@ class OpenAIPreprocessor(Operator):
             for c in choices
         ]
 
+    def _stop_token_seqs(
+        self, stop_list: Optional[List[str]]
+    ) -> Optional[List[List[int]]]:
+        """Canonical tokenization of each stop string — the engine's
+        device-approximate stop check (the persistent chain's suffix
+        ring) matches these token sequences; the backend detokenizer
+        jail still catches every OTHER tokenization of the same text,
+        so a missing/empty entry only loses the chain fast-path. Best
+        effort: a tokenizer-less preprocessor ships None."""
+        if not stop_list or self.tokenizer is None:
+            return None
+        seqs = []
+        for s in stop_list:
+            try:
+                seqs.append(list(
+                    self.tokenizer.encode(s, add_special_tokens=False)
+                ))
+            except Exception:
+                # partial coverage reads as unavailable (the request
+                # keeps the backend jail; the engine only loses the
+                # chain fast-path) — worth a line, not a failure
+                logger.debug("stop string %r not tokenizable; engine "
+                             "stop-seq fast-path disabled", s)
+                return None
+        return seqs if all(seqs) else None
+
     def _build(
         self,
         req: Union[ChatCompletionRequest, CompletionRequest],
@@ -307,6 +333,7 @@ class OpenAIPreprocessor(Operator):
                 "guided_choice and guided JSON (response_format/"
                 "guided_json) are mutually exclusive"
             )
+        stop_list = req.stop_list() or None
         out = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(
@@ -317,8 +344,9 @@ class OpenAIPreprocessor(Operator):
                     else budget
                 ),
                 min_tokens=req.min_tokens,
-                stop=req.stop_list() or None,
+                stop=stop_list,
                 ignore_eos=ignore_eos,
+                stop_token_seqs=self._stop_token_seqs(stop_list),
             ),
             sampling_options=SamplingOptions(
                 n=req.n,

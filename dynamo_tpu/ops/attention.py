@@ -248,7 +248,11 @@ def attention(
                                sinks=sinks)[..., :d]
 
     from .pallas_attention import paged_flash_attention
-    from .pallas_decode import paged_decode_attention
+    from .pallas_decode import (
+        VERIFY_MAX_S,
+        paged_decode_attention,
+        paged_verify_attention,
+    )
 
     import os
 
@@ -268,7 +272,32 @@ def attention(
     decode = q.shape[1] == 1
     has_sinks = sinks is not None
     sink_args = (sinks,) if has_sinks else ()
-    if decode:
+    # small-S tails (the speculative verify's K+1 positions; follows the
+    # flash kernel's affine base_pos contract, so small custom prefill
+    # buckets mask correctly too) take the fused verify kernel: ONE page
+    # walk for all S queries instead of the flash kernel's per-query-
+    # block passes over the table capacity. Sinks/softcap models and
+    # fp8 caches fall through to the flash path — extra Mosaic
+    # specializations per exotic config are not worth a spec-round
+    # shape, and ONLY the probed base pair may compile in-process
+    # (ops/probe.py "verify" probes the bf16 non-softcap kernel).
+    verify = (1 < q.shape[1] <= VERIFY_MAX_S and not has_sinks
+              and not softcap
+              and k_cache.dtype != jnp.float8_e4m3fn)
+    if verify:
+        fn = functools.partial(
+            paged_verify_attention, scale=scale, interpret=interpret,
+            softcap=softcap,
+        )
+        vbase = positions[:, 0].astype(jnp.int32)
+        args = (q, k_cache, v_cache, block_tables, vbase, context_lens,
+                li, win)
+
+        def call(q, k_cache, v_cache, block_tables, vbase, context_lens,
+                 li, win, *sk):
+            return fn(q, k_cache, v_cache, block_tables, vbase,
+                      context_lens, li, window=win)
+    elif decode:
         fn = functools.partial(
             paged_decode_attention, scale=scale, interpret=interpret,
             softcap=softcap,
@@ -306,7 +335,7 @@ def attention(
             P(dp, None),                       # block_tables
         ]
         if not decode:
-            in_specs.append(P(dp))             # base_pos
+            in_specs.append(P(dp))             # base_pos (flash + verify)
         in_specs.extend([P(dp), P(), P()])     # context_lens, layer_idx, win
         if has_sinks:
             in_specs.append(P("tp"))           # sinks follow the head shard

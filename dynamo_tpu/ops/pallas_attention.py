@@ -30,7 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -1e30
 
-from .pallas_decode import _out_vma  # noqa: E402  (shared vma-union helper)
+from .pallas_decode import (  # noqa: E402  (shared kernel-compat helpers)
+    _compiler_params,
+    _out_struct,
+)
 
 
 def _kernel(
@@ -254,11 +257,10 @@ def paged_flash_attention(
             has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (b * num_chunks, sc, kvh, g, d), q.dtype,
-            vma=_out_vma(q, k_cache),
+        out_shape=_out_struct(
+            (b * num_chunks, sc, kvh, g, d), q.dtype, q, k_cache,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -44,9 +44,32 @@ def _out_vma(*arrays):
     varies over every manual mesh axis any input varies over. Needed so
     the kernels compose with ``check_vma=True`` shard_maps (the
     partial-manual pipeline in parallel/pipeline.py); None outside
-    shard_map tracing, preserving plain-jit behavior."""
-    vma = frozenset().union(*(jax.typeof(a).vma for a in arrays))
+    shard_map tracing, preserving plain-jit behavior. Older jax builds
+    without ``jax.typeof`` get the plain-jit behavior unconditionally
+    (no vma annotation — shard_map callers there run check_vma=False)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    vma = frozenset().union(*(typeof(a).vma for a in arrays))
     return vma or None
+
+
+# CompilerParams was TPUCompilerParams on older jax builds (the same
+# vintage that lacks jax.typeof); resolve once so every kernel compiles
+# on either
+_compiler_params = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
+def _out_struct(shape, dtype, *arrays) -> jax.ShapeDtypeStruct:
+    """out_shape with the vma annotation when the jax build supports it
+    (newer jax; required for check_vma=True shard_maps) and a plain
+    struct otherwise — older builds reject the ``vma`` kwarg outright,
+    and there the annotation has nothing to annotate anyway."""
+    vma = _out_vma(*arrays)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
 def _decode_kernel(
@@ -358,9 +381,8 @@ def mla_paged_decode_attention(
             pages_per_chunk=pages_per_chunk,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, r), q_lat.dtype,
-                                       vma=_out_vma(q_lat, c_cache)),
-        compiler_params=pltpu.CompilerParams(
+        out_shape=_out_struct((b, h, r), q_lat.dtype, q_lat, c_cache),
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
@@ -374,6 +396,229 @@ def mla_paged_decode_attention(
         kr_cache,
     )
     return out.reshape(b, 1, h, r)
+
+
+def _verify_kernel(
+    bt_ref,    # scalar prefetch: block tables [B, W] (SMEM)
+    ctx_ref,   # scalar prefetch: context lens [B] (incl. all S new slots)
+    base_ref,  # scalar prefetch: base query position [B] (q[:, 0]'s pos)
+    li_ref,    # scalar prefetch: layer index [1]
+    win_ref,   # scalar prefetch: sliding window [1] (>= ctx disables)
+    q_ref,     # [1, S, KVH, G, D] VMEM block
+    k_hbm,     # [L, N, page, KVH, D] in HBM (ANY)
+    v_hbm,
+    o_ref,     # [1, S, KVH, G, D]
+    k_buf,
+    v_buf,
+    sem,
+    *,
+    scale: float,
+    block_size: int,
+    pages_per_chunk: int,
+    softcap: float,
+    s_q: int,
+):
+    """Multi-token verify attention: S query tokens per row over the
+    SAME single page walk — the speculative propose-verify step's
+    attention reads each KV page once instead of the flash-prefill
+    kernel's per-query-block passes over the table capacity.
+
+    Same double-buffered HBM→VMEM page pipeline as ``_decode_kernel``;
+    the q rows flatten (s, kvh, g) → rows and the mask adds the causal
+    tail: query s sits at absolute position base + s (the flash
+    kernel's affine contract — base rides as its own prefetch operand,
+    so a right-padded chunk behaves exactly like flash: pad rows score
+    against the bounded valid range and the caller discards them), and
+    key j is visible iff j <= base + s AND j < ctx (and inside the
+    sliding window).
+    """
+    b = pl.program_id(0)
+    ctx = ctx_ref[b]
+    base = base_ref[b]
+    li = li_ref[0]
+    npages = pl.cdiv(ctx, block_size)
+    nchunks = pl.cdiv(npages, pages_per_chunk)
+    # the earliest key ANY query can see (query 0's window lower bound)
+    win_start = jnp.maximum(base + 1 - win_ref[0], 0)
+
+    _, s, kvh, g, d = q_ref.shape
+    rows = s * kvh * g
+    chunk_t = pages_per_chunk * block_size
+    cols = chunk_t * kvh
+
+    def page_copy(chunk, slot, i, hbm, buf):
+        p = jnp.minimum(chunk * pages_per_chunk + i, npages - 1)
+        return pltpu.make_async_copy(
+            hbm.at[li, bt_ref[b, p]], buf.at[slot, i], sem.at[slot]
+        )
+
+    def start(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, k_hbm, k_buf).start()
+            page_copy(chunk, slot, i, v_hbm, v_buf).start()
+
+    def wait(chunk, slot):
+        for i in range(pages_per_chunk):
+            page_copy(chunk, slot, i, k_hbm, k_buf).wait()
+            page_copy(chunk, slot, i, v_hbm, v_buf).wait()
+
+    first_chunk = win_start // chunk_t
+    start(first_chunk, jax.lax.rem(first_chunk, 2))
+    q = q_ref[0].reshape(rows, d)  # rows ordered (s, head, group)
+
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) % kvh
+    row_flat = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+    row_head = (row_flat % (kvh * g)) // g
+    row_s = row_flat // (kvh * g)
+    head_match = col_head == row_head                    # loop-invariant
+    col_tok = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) // kvh
+    # per-row absolute query position (affine from the base operand)
+    q_pos = base + row_s
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nchunks)
+        def _prefetch():
+            start(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+        k = k_buf[slot].reshape(cols, d).astype(q.dtype)
+        v = v_buf[slot].reshape(cols, d).astype(q.dtype)
+
+        key_pos = c * chunk_t + col_tok
+        mask = (head_match
+                & (key_pos <= q_pos)
+                & (key_pos < ctx)
+                & (key_pos > q_pos - win_ref[0]))
+
+        s_log = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if softcap:
+            s_log = softcap * jnp.tanh(s_log / softcap)
+        s_log = jnp.where(mask, s_log, MASK_VALUE)
+
+        m_cur = jnp.max(s_log, -1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p_unn = jnp.exp(s_log - m_new[:, 0:1])
+        l_new = alpha * l + jnp.sum(p_unn, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_unn.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha[:, 0:1] + pv
+
+    m0 = jnp.full((rows, 128), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((rows, 128), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(first_chunk, nchunks, body, (m0, l0, acc0))
+    l1 = l[:, 0:1]
+    l1 = jnp.where(l1 == 0.0, 1.0, l1)
+    o_ref[0] = (acc / l1).astype(o_ref.dtype).reshape(s, kvh, g, d)
+
+
+# largest tail the verify kernel serves: beyond it the flash-prefill
+# kernel's blocked pipeline wins anyway (spec rounds are K+1 <= 17)
+VERIFY_MAX_S = 32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "pages_per_chunk", "interpret", "softcap"),
+)
+def paged_verify_attention(
+    q: jax.Array,            # [B, S, H, D] (post-RoPE), S small
+    k_cache: jax.Array,      # [L, N, page, KVH, D] stacked (or 4-D)
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, W] int32
+    base_pos: jax.Array,     # [B] int32 — absolute position of q[:, 0]
+    context_lens: jax.Array, # [B] int32 (valid keys; may be < base + S)
+    layer_idx: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+    softcap: float = 0.0,
+    window=None,
+) -> jax.Array:
+    """S-token verify attention over the paged cache; returns
+    [B, S, H, D]. The flash kernel's affine contract: query s of row b
+    sits at ``base_pos[b] + s``; rows past ``context_lens`` (a padded
+    chunk) produce garbage the caller discards."""
+    b, s, h, d = q.shape
+    assert 1 < s <= VERIFY_MAX_S, "verify kernel serves small S tails"
+    if k_cache.ndim == 4:
+        k_cache, v_cache = k_cache[None], v_cache[None]
+    _, _, block_size, kvh, _ = k_cache.shape
+    g = h // kvh
+    if scale is None:
+        scale = d ** -0.5
+    li = (
+        jnp.zeros((1,), jnp.int32)
+        if layer_idx is None
+        else jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    )
+    win = (
+        jnp.full((1,), jnp.int32(2**30))
+        if window is None
+        else jnp.asarray(window, jnp.int32).reshape(1)
+    )
+    pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
+    qs = q.reshape(b, s, kvh, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, s, kvh, g, d), lambda i, *_: (i, 0, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, s, kvh, g, d), lambda i, *_: (i, 0, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, kvh, d), k_cache.dtype
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, kvh, d), v_cache.dtype
+            ),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel,
+            scale=scale,
+            block_size=block_size,
+            pages_per_chunk=pages_per_chunk,
+            softcap=softcap,
+            s_q=s,
+        ),
+        grid_spec=grid_spec,
+        out_shape=_out_struct((b, s, kvh, g, d), q.dtype, q, k_cache),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        base_pos.astype(jnp.int32),
+        li,
+        win,
+        qs,
+        k_cache,
+        v_cache,
+    )
+    return out.reshape(b, s, h, d)
 
 
 @functools.partial(
@@ -474,9 +719,8 @@ def paged_decode_attention(
             has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype,
-                                       vma=_out_vma(q, k_cache)),
-        compiler_params=pltpu.CompilerParams(
+        out_shape=_out_struct((b, kvh, g, d), q.dtype, q, k_cache),
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,

@@ -87,6 +87,23 @@ def probe_decode_windowed():
     ))
 
 
+def probe_verify():
+    # the S-token verify kernel (speculative propose-verify rounds):
+    # its own Mosaic specialization — one page walk for all S queries
+    from dynamo_tpu.ops.pallas_decode import paged_verify_attention
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 2, 4, 4
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    ctx = jnp.asarray([17, 33], jnp.int32)
+    base = ctx - s
+    np.asarray(paged_verify_attention(
+        q, k, v, bt, base, ctx, jnp.asarray(1, jnp.int32)
+    ))
+
+
 def probe_prefill():
     from dynamo_tpu.ops.pallas_attention import paged_flash_attention
 
@@ -262,6 +279,7 @@ PROBES = {
     "prefill_sinks_fp8": lambda: _probe_prefill_sinks("float8_e4m3fn"),
     "mla_decode": probe_mla_decode,
     "mla_decode_fp8": probe_mla_decode_fp8,
+    "verify": probe_verify,
 }
 for kind in sys.argv[1:]:
     PROBES[kind]()
@@ -352,7 +370,7 @@ def probe_kernel(
 
 def probe_serving_kernels(
     mla: bool = False, softcap: bool = False, fp8_kv: bool = False,
-    sinks: bool = False, timeout_s: float = 180.0,
+    sinks: bool = False, verify: bool = False, timeout_s: float = 180.0,
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
     would compile — the dense engines' decode + flash-prefill kernels
@@ -383,6 +401,12 @@ def probe_serving_kernels(
             kinds = [f"decode_windowed{sfx}", f"prefill_windowed{sfx}"]
         else:
             kinds = [f"decode{sfx}", f"prefill{sfx}"]
+        if verify and not fp8_kv and not sinks and not softcap:
+            # speculative engines also compile the S-token verify
+            # kernel (its own Mosaic specialization); the specialized
+            # cache/softcap/sinks configs fall back to flash for verify
+            # shapes, so only the base pair adds the probe
+            kinds.append("verify")
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

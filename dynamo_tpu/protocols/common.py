@@ -38,6 +38,13 @@ class StopConditions:
     stop: Optional[List[str]] = None                 # visible stop strings
     stop_token_ids_hidden: Optional[List[int]] = None  # never surfaced in text
     ignore_eos: bool = False
+    # canonical tokenization of each ``stop`` string (preprocessor-
+    # filled, aligned 1:1 with ``stop``): lets a token-level engine
+    # detect stop strings without a tokenizer — the persistent decode
+    # chain's device-approximate stop check hashes these. Text-level
+    # matching across OTHER tokenizations stays the backend
+    # detokenizer jail's job.
+    stop_token_seqs: Optional[List[List[int]]] = None
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
@@ -178,11 +185,17 @@ class EngineOutput:
     # re-bind its stream directly to the peer so the source worker can
     # exit instead of staying up to relay. Carries no client payload.
     migrated: Optional[dict] = None
+    # n>1 fan-out (engine/serving.py): which choice this delta belongs
+    # to. None for single-choice requests — the overwhelmingly common
+    # case pays no wire bytes.
+    choice: Optional[int] = None
 
     def to_wire(self) -> dict:
         d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
         if self.finish_reason is not None:
             d["finish_reason"] = self.finish_reason.value
+        if self.choice is not None:
+            d["choice"] = self.choice
         if self.text is not None:
             d["text"] = self.text
         if self.prompt_logprobs is not None:
@@ -228,6 +241,7 @@ class EngineOutput:
             prompt_logprobs=d.get("prompt_logprobs"),
             kv_transfer_params=d.get("kv_transfer_params"),
             migrated=d.get("migrated"),
+            choice=d.get("choice"),
         )
 
 

@@ -29,11 +29,18 @@ from dynamo_tpu.runtime.engine import AsyncEngineContext
 class FakeRunner:
     """Deterministic stand-in for ModelRunner.
 
-    Token rule: the token after ``prev`` (sitting at ``pos``) is
-    ``(prev * 7 + pos * 13 + 1) % vocab`` — a pure function of the carry,
-    so any scheduling (per-token, fused burst, dispatch-ahead, preempt +
-    re-prefill resume) must reproduce the same stream.
+    Token rule: the token after ``prev`` (sitting at ``pos``) is the
+    bias-row argmax of ``-(|id - target|)`` with ``target = (prev * 7 +
+    pos * 13 + 1) % vocab`` — a pure function of the carry and the
+    slot's installed mask, so any scheduling (per-token, fused burst,
+    dispatch-ahead, chained, guided via bias rows OR via the device
+    transition table, preempt + re-prefill resume) must reproduce the
+    same stream. With a zero bias row the argmax IS ``target`` (the
+    original rule); a guided mask steers it to the nearest allowed id
+    identically on the host-mask path and the device-table path.
     """
+
+    spec_burst_ready = True
 
     def __init__(self, config: EngineConfig):
         self.config = config
@@ -41,22 +48,64 @@ class FakeRunner:
         self.step_calls = 0
         self.burst_calls = 0
         self.chained_calls = 0
+        self.spec_calls = 0
+        self.bias = np.zeros((config.max_batch_size, self.v), np.float32)
+        # test hook: force a stop-string suffix-hash candidate (the
+        # device false-positive injection) — fn(slot, gen) -> bool
+        self.force_stop_candidate = None
 
     def _advance(self, prev, pos):
         return (prev * 7 + pos * 13 + 1) % self.v
 
-    # sampling-state writes are host bookkeeping the fake doesn't need
-    def set_sample_row(self, *a, **kw):
-        pass
+    def _tok(self, prev, pos, slot=None, extra_mask=None):
+        """One sampled token: bias-aware argmax (mirrors sample())."""
+        target = int((int(prev) * 7 + int(pos) * 13 + 1) % self.v)
+        row = self.bias[slot] if slot is not None else None
+        if (row is None or not row.any()) and extra_mask is None:
+            return target
+        logits = -np.abs(
+            np.arange(self.v) - target
+        ).astype(np.float64)
+        if row is not None:
+            logits = logits + row
+        if extra_mask is not None:
+            logits = logits + extra_mask
+        return int(np.argmax(logits))
 
-    def set_bias_row(self, *a, **kw):
-        pass
+    # sampling-state writes mirror only the bias row (guided masks +
+    # logit_bias); counts/seen are penalty bookkeeping the fake's
+    # deterministic rule never consults
+    def set_sample_row(self, slot, prompt_ids, generated_ids=(),
+                       logit_bias=None, guided_mask=None):
+        row = (
+            np.asarray(guided_mask, np.float32).copy()
+            if guided_mask is not None
+            else np.zeros(self.v, np.float32)
+        )
+        for tid, b in (logit_bias or {}).items():
+            tid = int(tid)
+            if 0 <= tid < self.v:
+                row[tid] += float(b)
+        self.bias[slot] = row
 
-    def edit_bias_entries(self, *a, **kw):
+    GUIDED_STATE_BUCKETS = (1, 64, 256, 1024)
+
+    def guided_state_bucket(self, n_states):
+        for s in self.GUIDED_STATE_BUCKETS:
+            if n_states <= s:
+                return s
+        return self.GUIDED_STATE_BUCKETS[-1]
+
+    def set_bias_row(self, slot, row):
+        self.bias[slot] = np.asarray(row, np.float32).copy()
+
+    def edit_bias_entries(self, slot, ids, vals):
+        for t, val in zip(ids, vals):
+            self.bias[slot][int(t)] = float(val)
         return True
 
     def step(self, tokens, positions, btab, slot_map, ctx_lens, last_idx,
-             *args, **kw):
+             *args, sample_slots=None, want_greedy=False, **kw):
         self.step_calls += 1
         tokens = np.asarray(tokens)
         b = tokens.shape[0]
@@ -64,12 +113,24 @@ class FakeRunner:
         last_idx = np.asarray(last_idx)
         prev = tokens[rows, last_idx]
         pos = np.asarray(positions)[rows, last_idx]
-        nt = self._advance(prev, pos).astype(np.int32)
+        slots = (np.asarray(sample_slots) if sample_slots is not None
+                 else rows)
+        nt = np.asarray([
+            self._tok(prev[i], pos[i], slot=int(slots[i]))
+            for i in range(b)
+        ], np.int32)
         lps = (-(nt % 7) / 10.0).astype(np.float32)
         tv = np.zeros((b, 8), np.float32)
         ti = np.zeros((b, 8), np.int32)
         plps = np.zeros(tokens.shape, np.float32)
-        greedy = np.zeros(tokens.shape, np.int32)
+        # spec verify: per-position raw argmax (no bias — the real
+        # verify reads raw logits), position-wise f(token_j, pos_j)
+        if want_greedy:
+            greedy = self._advance(
+                tokens.astype(np.int64), np.asarray(positions)
+            ).astype(np.int32)
+        else:
+            greedy = np.zeros(tokens.shape, np.int32)
         return nt, lps, tv, ti, plps, greedy
 
     def decode_burst(self, tokens0, positions0, btab, *args,
@@ -82,7 +143,9 @@ class FakeRunner:
         toks = np.zeros((K, b), np.int32)
         lps = np.zeros((K, b), np.float32)
         for s in range(K):
-            prev = self._advance(prev, pos)
+            prev = np.asarray([
+                self._tok(prev[i], pos[i], slot=i) for i in range(b)
+            ], np.int64)
             toks[s] = prev
             lps[s] = -(toks[s] % 7) / 10.0
             pos += 1
@@ -90,13 +153,32 @@ class FakeRunner:
         ti = np.zeros((K, b, 8), np.int32)
         return toks, lps, tv, ti
 
+    # -- chained-path mirrors -------------------------------------------
+
+    def _stop_candidate(self, ring_row, gen, min_new, hashes, lens, slot):
+        from dynamo_tpu.engine.sampling import stop_seq_hash
+
+        if self.force_stop_candidate is not None and \
+                self.force_stop_candidate(slot, int(gen)):
+            return True
+        for h, ell in zip(hashes, lens):
+            ell = int(ell)
+            if ell > 0 and gen >= ell and gen >= min_new:
+                if stop_seq_hash(ring_row[-ell:]) == int(h):
+                    return True
+        return False
+
     def decode_burst_chained(self, tokens0, positions0, gen0, done0, btab,
                              *args, commit=None, stop_ids=None,
-                             min_new=None, max_new=None, want_top=False,
-                             **kw):
+                             min_new=None, max_new=None, ring0=None,
+                             gstate0=None, stop_hash=None, stop_hlen=None,
+                             gtable=None, want_top=False, **kw):
         """Host mirror of the device-finish burst: same token rule, plus
         the freeze semantics — finished rows stop advancing and emit -1
-        pads; the carry (tokens/pos/gen/done) feeds the next call."""
+        pads; the carry (tokens/pos/gen/done/ring/gstate) feeds the next
+        call. Guided rows mask through the transition table, stop-string
+        rows through the rolling suffix hash, exactly like the device
+        program."""
         self.chained_calls += 1
         K = max(1, self.config.multi_step_decode)
         prev = np.asarray(tokens0).astype(np.int64).copy()
@@ -105,29 +187,146 @@ class FakeRunner:
         done = np.asarray(done0).astype(bool).copy()
         commit = np.asarray(commit).astype(bool)
         b = prev.shape[0]
+        from dynamo_tpu.engine.sampling import SUFFIX_RING_W
+
+        ring = (np.asarray(ring0, np.int64).copy() if ring0 is not None
+                else np.full((b, SUFFIX_RING_W), -1, np.int64))
+        gstate = (np.asarray(gstate0, np.int64).copy()
+                  if gstate0 is not None else np.full(b, -1, np.int64))
+        gtab = np.asarray(gtable) if gtable is not None else None
+        hashes = (np.asarray(stop_hash) if stop_hash is not None
+                  else np.zeros((b, 4), np.uint32))
+        hlens = (np.asarray(stop_hlen) if stop_hlen is not None
+                 else np.zeros((b, 4), np.int32))
         toks = np.full((K, b), -1, np.int32)
         lps = np.zeros((K, b), np.float32)
         max_len = self.config.max_model_len
         for s in range(K):
             live = commit & ~done
-            nt = self._advance(prev, pos)
+            nt = np.zeros(b, np.int64)
+            for i in range(b):
+                extra = None
+                if gstate[i] >= 0 and gtab is not None:
+                    extra = np.where(gtab[int(gstate[i])] < 0, -1e9, 0.0)
+                nt[i] = self._tok(prev[i], pos[i], slot=i,
+                                  extra_mask=extra)
             gen = gen + live.astype(np.int64)
+            ring_n = np.concatenate([ring[:, 1:], nt[:, None]], axis=1)
+            ring = np.where(live[:, None], ring_n, ring)
             hit = (nt[:, None] == np.asarray(stop_ids)).any(axis=1)
-            newly = live & (
+            hard = (
                 ((gen >= min_new) & hit)
                 | (gen >= max_new) | (pos + 2 >= max_len)
             )
+            cand = np.asarray([
+                live[i] and self._stop_candidate(
+                    ring[i], gen[i], int(np.asarray(min_new)[i]),
+                    hashes[i], hlens[i], i)
+                for i in range(b)
+            ], bool)
+            gdone = np.zeros(b, bool)
+            gnext = np.full(b, -1, np.int64)
+            for i in range(b):
+                if gstate[i] >= 0 and gtab is not None:
+                    gnext[i] = int(gtab[int(gstate[i]), int(nt[i])])
+                    gdone[i] = (not hard[i]) and gnext[i] <= 0
+            newly = live & (hard | cand | gdone)
             toks[s] = np.where(live, nt, -1)
             lps[s] = np.where(live, -(nt % 7) / 10.0, 0.0)
             adv = live & ~newly
             prev = np.where(adv, nt, prev)
             pos = np.where(adv, pos + 1, pos)
+            gstate = np.where(adv & (gstate >= 0), gnext, gstate)
             done = done | newly
         tv = np.zeros((K, b, 8), np.float32)
         ti = np.zeros((K, b, 8), np.int32)
         return toks, lps, tv, ti, (
             prev.astype(np.int32), pos.astype(np.int32),
-            gen.astype(np.int32), done,
+            gen.astype(np.int32), done, ring.astype(np.int32),
+            gstate.astype(np.int32),
+        )
+
+    def _ngram_from_ring(self, ring, m, k):
+        w = len(ring)
+        tail = ring[-m:]
+        best = -1
+        for s in range(w - m):
+            win = ring[s:s + m]
+            if (win == tail).all() and (win >= 0).all() \
+                    and s + m + k <= w:
+                best = s
+        if best < 0:
+            return [-1] * k
+        return [int(t) if t >= 0 else -1
+                for t in ring[best + m:best + m + k]]
+
+    def decode_burst_spec(self, tokens0, positions0, gen0, done0, ring0,
+                          gstate0, btab, *, commit, stop_ids, min_new,
+                          max_new, stop_hash, stop_hlen, proposals=None):
+        """Host mirror of the chained propose-verify round: ngram
+        proposals from the ring, one-forward greedy verify, accepted
+        prefix + correction committed with freeze semantics."""
+        self.spec_calls += 1
+        P = self.config.spec_ngram_tokens
+        S = P + 1
+        prev = np.asarray(tokens0).astype(np.int64).copy()
+        pos = np.asarray(positions0).astype(np.int64).copy()
+        gen = np.asarray(gen0).astype(np.int64).copy()
+        done = np.asarray(done0).astype(bool).copy()
+        ring = np.asarray(ring0, np.int64).copy()
+        commit = np.asarray(commit).astype(bool)
+        hashes = np.asarray(stop_hash)
+        hlens = np.asarray(stop_hlen)
+        b = prev.shape[0]
+        max_len = self.config.max_model_len
+        toks = np.full((S, b), -1, np.int32)
+        nprop = np.zeros(b, np.int32)
+        nacc = np.zeros(b, np.int32)
+        for i in range(b):
+            if not commit[i] or done[i]:
+                continue
+            props = (
+                [int(t) for t in np.asarray(proposals)[i]]
+                if proposals is not None
+                else self._ngram_from_ring(
+                    ring[i], self.config.spec_ngram_match, P)
+            )
+            nprop[i] = sum(1 for t in props if t >= 0)
+            row = [int(prev[i])] + [t if t >= 0 else 0 for t in props]
+            greedy = [
+                int(self._advance(np.int64(row[j]), pos[i] + j))
+                for j in range(S)
+            ]
+            acc = 0
+            while acc < P and props[acc] >= 0 \
+                    and greedy[acc] == props[acc]:
+                acc += 1
+            nacc[i] = acc  # raw verified proposals (sync-path semantics)
+            for j in range(S):
+                if done[i] or j > acc:
+                    break
+                t = greedy[j]
+                gen[i] += 1
+                ring[i] = np.concatenate([ring[i][1:], [t]])
+                hit = t in set(int(x) for x in np.asarray(stop_ids)[i])
+                hard = (
+                    (gen[i] >= np.asarray(min_new)[i] and hit)
+                    or gen[i] >= np.asarray(max_new)[i]
+                    or pos[i] + 2 >= max_len
+                )
+                cand = self._stop_candidate(
+                    ring[i], gen[i], int(np.asarray(min_new)[i]),
+                    hashes[i], hlens[i], i)
+                toks[j, i] = t
+                if hard or cand:
+                    done[i] = True
+                else:
+                    prev[i] = t
+                    pos[i] += 1
+        return toks, nprop, nacc, (
+            prev.astype(np.int32), pos.astype(np.int32),
+            gen.astype(np.int32), done, ring.astype(np.int32),
+            np.asarray(gstate0, np.int32).copy(),
         )
 
 
@@ -293,8 +492,11 @@ def _pipeline_stays_cold(config, reqs):
     return out
 
 
-def test_guided_requests_force_sync_path():
-    config = _config(2)
+def test_guided_requests_force_sync_path_when_table_disabled():
+    """With guided_device_table off, guided rows keep the per-token host
+    mask path (no chain, no pipeline burst) and the fallback counter
+    names the reason."""
+    config = _config(2, guided_device_table=False)
     sampling = SamplingOptions(
         temperature=0.0,
         guided_choice_token_ids=[[3, 4, 5], [3, 7]],
@@ -304,10 +506,51 @@ def test_guided_requests_force_sync_path():
     assert out[0][1] is not None  # the request still completes
 
 
-def test_spec_decode_forces_sync_path():
-    config = _config(2, spec_ngram_tokens=2, spec_ngram_match=2)
-    reqs = [_request([1, 2, 1, 2, 1, 2], 8)]
-    _pipeline_stays_cold(config, reqs)
+def _spec_config(depth, vocab=8, **kw):
+    """Tiny-vocab spec config: an 8-token vocab makes the deterministic
+    stream repetitive enough for ngram lookups to actually hit, so the
+    acceptance path (not just the no-proposal round) is exercised."""
+    kw.setdefault("num_kv_blocks", 64)
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("spec_ngram_tokens", 2)
+    kw.setdefault("spec_ngram_match", 2)
+    return EngineConfig(
+        model=ModelConfig(vocab_size=vocab, hidden_size=32,
+                          intermediate_size=64, num_layers=1, num_heads=2,
+                          num_kv_heads=1),
+        max_batch_size=4, kv_block_size=8,
+        dtype="float32", multi_step_decode=4, decode_pipeline_depth=depth,
+        enable_prefix_caching=False, **kw,
+    )
+
+
+def test_spec_decode_chains_and_streams_identical():
+    """Ngram speculation now runs INSIDE the chain (propose-verify
+    rounds off the device carry): streams must match the sync spec path
+    byte-for-byte (which itself matches plain greedy decode — proposals
+    affect acceptance, never content), the spec program must actually
+    run, chain length must exceed 1 (no per-round host barrier), and
+    the round's acceptance accounting must ride back."""
+    reqs = lambda: [_request([1, 2, 1, 2, 1, 2], 24)]  # noqa: E731
+    want = _run(_spec_config(1), reqs())
+    plain = _run(_spec_config(1, spec_ngram_tokens=0), reqs())
+    assert want == plain  # greedy spec never changes content
+    box = {}
+
+    def grab(s):
+        box["sched"] = s
+
+    got = _run(_spec_config(2), reqs(), hooks=grab)
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.spec_calls > 1, "spec chain never engaged"
+    assert sched._last_chain_len > 1, "host barrier still per round"
+    assert sched.allocator.used == 0
+    # acceptance accounting rode back from the device: proposals were
+    # made and at least one round accepted speculative tokens
+    assert sum(sched._spec_accept_hist.totals.values()) > 0
+    assert sched.spec_proposed > 0
+    assert sched.spec_accepted > 0
 
 
 def test_n_gt_1_forces_sync_path():
@@ -648,3 +891,307 @@ def test_device_time_chained_adds_no_host_syncs_and_attributes_bursts():
 def box_chained_calls(tracker):
     # decode tokens accumulated via the burst token accounting
     return tracker.decode_tokens
+
+
+# --------------------------------------------------------------------------
+# unrestricted persistent decode (ISSUE 13): guided / stop-string / n>1 /
+# spec traffic inside the chain, with the sync-fallback ladder counted
+# --------------------------------------------------------------------------
+
+
+def _fallback_reasons(sched):
+    return {dict(k).get("reason") for k in
+            sched._sync_fallback_ctr.values}
+
+
+def _guided_request(prompt, max_tokens, choice_ids):
+    return _request(prompt, max_tokens, sampling=SamplingOptions(
+        temperature=0.0, guided_choice_token_ids=choice_ids,
+    ))
+
+
+# two long choices sharing a 4-token prefix so the chain runs >1 burst
+CHOICES = [
+    [7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47],
+    [7, 11, 13, 17, 100, 101, 102, 103, 104, 105, 106, 107],
+]
+
+
+def _precompile_guided_tables(sched):
+    """Deterministic table availability for chain-engagement asserts:
+    compile synchronously (the production path compiles in an executor
+    and serves sync passes until the table lands)."""
+    orig_reason = sched._guided_chain_reason
+
+    def eager(er):
+        key = sched._guided_table_key(er)
+        if key not in sched._guided_tables:
+            sched._guided_tables[key] = sched._compile_guided_table(er)
+        return orig_reason(er)
+
+    sched._guided_chain_reason = eager
+
+
+def test_guided_choice_chains_byte_identical():
+    """guided_choice rows now chain through the device transition
+    table: streams byte-identical to the host-mask sync path, chain
+    length > 1, the guided finish detected on device, zero leaked
+    blocks."""
+    config_sync = _config(1, k=2)
+    want = _run(config_sync, [_guided_request([1, 2], 16, CHOICES)])
+    assert want[0][1] == "stop" and list(want[0][0]) in CHOICES
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        _precompile_guided_tables(s)
+
+    got = _run(_config(2, k=2), [_guided_request([1, 2], 16, CHOICES)],
+               hooks=hooks)
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 1, "guided chain never engaged"
+    assert sched._last_chain_len > 1
+    assert sum(sched._device_finished_ctr.values.values()) == 1
+    assert sched.allocator.used == 0
+
+
+def test_guided_json_in_bound_chains_byte_identical():
+    """An in-bound guided_json grammar (tiny enum schema over a toy
+    piece table) chains through its compiled table and the stream
+    matches the sync path byte-for-byte."""
+    from dynamo_tpu.engine.guided import JsonConstraint, JsonGrammar
+
+    v = 512
+    pieces = [None] * v
+    for i, ch in enumerate('"abcdefgh'):
+        pieces[50 + i] = ch
+    grammar = JsonGrammar(
+        pieces, {"enum": ["abca", "abda", "aeee", "gh"]}
+    )
+
+    def reqs():
+        er = _request([1, 2], 16)
+        er.guided = JsonConstraint(grammar)
+        return [er]
+
+    want = _run(_config(1, k=2), reqs())
+    assert want[0][1] == "stop" and len(want[0][0]) >= 4
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        _precompile_guided_tables(s)
+
+    got = _run(_config(2, k=2), reqs(), hooks=hooks)
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 1, "guided-json chain never engaged"
+    assert sched.allocator.used == 0
+
+
+def test_guided_table_bound_falls_back_named():
+    """A grammar whose reachable states exceed the bound keeps the sync
+    path with reason guided_table_bound — never a silent downgrade."""
+    config = _config(2, k=2, guided_table_max_states=2)
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        _precompile_guided_tables(s)
+
+    out = _run(config, [_guided_request([1, 2], 16, CHOICES)],
+               hooks=hooks)
+    sched = box["sched"]
+    assert out[0][1] == "stop"
+    assert sched.runner.chained_calls == 0
+    assert "guided_table_bound" in _fallback_reasons(sched)
+
+
+def _stop_seq_request(prompt, max_tokens, seqs, stop=None):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(
+            max_tokens=max_tokens, ignore_eos=True,
+            stop=stop or ["x"] * len(seqs),
+            stop_token_seqs=[list(s) for s in seqs],
+        ),
+        sampling_options=SamplingOptions(temperature=0.0),
+        eos_token_ids=[],
+    )
+    return EngineRequest(
+        request_id=uuid.uuid4().hex, prompt=list(prompt), req=req,
+        ctx=AsyncEngineContext(), out_queue=asyncio.Queue(),
+    )
+
+
+def test_stop_string_token_seq_chains_byte_identical():
+    """Stop-string rows with canonical token seqs chain via the
+    suffix-hash approximation: the device freezes the row at the
+    matching token, the host's exact check names the STOP, and the
+    stream matches the sync path (which runs the same exact check)."""
+    plain = _streams(1, max_tokens=24)
+    seq = [plain[0][0][3], plain[0][0][4]]  # tokens 4-5 of the stream
+
+    def reqs():
+        return [_stop_seq_request(PROMPTS[0], 24, [seq])]
+
+    rs = reqs()
+    assert all(er.device_checkable for er in rs)
+    want = _run(_config(1), rs)
+    assert want[0][1] == "stop" and len(want[0][0]) == 5
+    box = {}
+
+    def grab(s):
+        box["sched"] = s
+
+    got = _run(_config(2), reqs(), hooks=grab)
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 0, "stop-seq row never chained"
+    assert sum(sched._device_finished_ctr.values.values()) == 1
+    assert sched.allocator.used == 0
+
+
+def test_stop_string_false_positive_resumes_byte_identical():
+    """A suffix-hash collision (injected via the fake's candidate hook)
+    freezes a row the host cannot confirm: the scheduler must flag the
+    false positive, close the chain, and resume the row so the stream
+    is STILL byte-identical to the sync path — with zero leaked blocks
+    and the fallback counter naming stop_false_positive."""
+    never = [499, 498]  # a seq the stream never produces
+
+    def reqs():
+        return [_stop_seq_request(p, 21, [never]) for p in PROMPTS]
+
+    want = _run(_config(1), reqs())
+    assert all(f == "length" for _, f in want)
+    box = {}
+    fired = []
+
+    def hooks(s):
+        box["sched"] = s
+
+        def force(slot, gen):
+            if slot == 0 and gen == 6 and not fired:
+                fired.append((slot, gen))
+                return True
+            return False
+
+        s.runner.force_stop_candidate = force
+
+    got = _run(_config(2), reqs(), hooks=hooks)
+    assert fired, "test is vacuous: the candidate hook never fired"
+    assert got == want
+    sched = box["sched"]
+    assert sched.runner.chained_calls > 1
+    assert "stop_false_positive" in _fallback_reasons(sched)
+    assert sched.allocator.used == 0, "false-positive path leaked blocks"
+
+
+def test_stop_ids_width_16_chains_and_overflow_is_named():
+    """9-16 stop/eos ids chain now (the old width-8 cliff); >16 fall
+    back with reason stop_ids_overflow instead of silently."""
+    plain = _streams(1, max_tokens=24)
+    eos16 = [plain[0][0][5]] + list(range(400, 415))  # 16 ids, one hits
+    assert len(eos16) == 16
+    want = _streams(1, max_tokens=24, eos=eos16)
+    assert want[0][1] == "eos"
+    box = {}
+    got = _streams(2, max_tokens=24, eos=eos16, sched_out=box)
+    assert got == want
+    assert box["sched"].runner.chained_calls > 0, "16-id row never chained"
+
+    eos17 = list(range(400, 417))
+    rs = [_request(PROMPTS[0], 8, eos=eos17)]
+    assert not rs[0].device_checkable
+    assert rs[0].chain_fallback == "stop_ids_overflow"
+    box2 = {}
+
+    def grab(s):
+        box2["sched"] = s
+
+    _run(_config(2), rs, hooks=grab)
+    assert "stop_ids_overflow" in _fallback_reasons(box2["sched"])
+
+
+def test_n_gt_1_fans_out_into_chain_members():
+    """serving-level n>1 fan-out: each choice is an independent n=1
+    chain member; deltas fold at drain tagged with their choice index,
+    per-choice streams match n separate single-choice runs, and the
+    chain engages (depth 2)."""
+    from dynamo_tpu.engine.serving import JaxServingEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    def fan_run(depth):
+        config = _config(depth)
+
+        async def go():
+            runner = FakeRunner(config)
+            sched = Scheduler(runner, config)
+            engine = JaxServingEngine(runner, sched, config)
+            sched.start()
+            req = PreprocessedRequest(
+                token_ids=[1, 17, 43],
+                stop_conditions=StopConditions(max_tokens=9,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0, n=3),
+                eos_token_ids=[],
+            )
+            per_choice = {i: [] for i in range(3)}
+            finishes = {}
+            async for out in engine.generate(Context(req)):
+                c = out.get("choice")
+                per_choice[c].extend(out.get("token_ids", []))
+                if out.get("finish_reason"):
+                    finishes[c] = out["finish_reason"]
+            chained = sched.runner.chained_calls
+            await engine.close()
+            return per_choice, finishes, chained
+
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(go())
+        finally:
+            loop.close()
+
+    single = _run(_config(1), [_request([1, 17, 43], 9)])
+    sync_c, sync_f, _ = fan_run(1)
+    chain_c, chain_f, chained = fan_run(2)
+    assert chain_c == sync_c
+    assert chain_f == sync_f == {0: "length", 1: "length", 2: "length"}
+    # greedy choices are identical streams, each equal to a lone run
+    for i in range(3):
+        assert chain_c[i] == single[0][0]
+    assert chained > 1, "n>1 children never chained"
+
+
+def test_mixed_workload_chains_with_attributed_fallbacks():
+    """The acceptance shape: a mixed batch (plain + guided + stop-seq)
+    runs with chain length p50 > 1 and every sync pass attributed to a
+    named reason in dynamo_engine_sync_fallback_total."""
+    plain = _streams(1, max_tokens=20)
+    seq = [plain[1][0][4], plain[1][0][5]]
+
+    def reqs():
+        return [
+            _request(PROMPTS[0], 20),
+            _stop_seq_request(PROMPTS[1], 20, [seq]),
+            _guided_request(PROMPTS[2], 20, CHOICES),
+        ]
+
+    want = _run(_config(1, k=2), reqs())
+    box = {}
+
+    def hooks(s):
+        box["sched"] = s
+        _precompile_guided_tables(s)
+
+    got = _run(_config(2, k=2), reqs(), hooks=hooks)
+    assert got == want
+    sched = box["sched"]
+    assert sched._last_chain_len > 1 or sched._chain_dispatched > 1
+    assert sched.runner.chained_calls > 1
+    # every counted fallback reason is named (no empty labels)
+    assert all(r for r in _fallback_reasons(sched))
+    assert sched.allocator.used == 0

@@ -1266,3 +1266,49 @@ def test_task_leak_flags_discarded_registry_watch_task():
         "task-leak",
     )
     assert [f.rule for f in out] == ["task-leak"]
+
+
+# --------------------------------------------------------------------------
+# unrestricted persistent decode (ISSUE 13): the in-carry spec/guided/
+# stop-string machinery's purity contract
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_unrestricted_chain_modules_pass_jit_impure_and_async_blocking():
+    """The reworked sampling module (suffix ring + stop hashes inside
+    the traced burst) and the guided device-table builder must stay
+    clean on the two rules that police the chain's purity: no host
+    syncs under trace (jit-impure) and no blocking work on the event
+    loop (async-blocking — the table compile's per-state vocab sweep
+    rides an executor; scheduler._guided_chain_reason). Pin ZERO
+    findings, not baseline-covered ones."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "engine", "sampling.py"),
+        os.path.join(PACKAGE_ROOT, "engine", "guided.py"),
+    ]
+    found = lint_paths(modules, get_rules(["jit-impure", "async-blocking"]))
+    assert found == [], "unrestricted-chain module regressed:\n" + "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_async_blocking_flags_grammar_table_compile_on_loop_shape():
+    """TP fixture shaped like a careless guided-chain admission: the
+    grammar's device-table compile busy-polls (and reads the piece
+    table) ON the scheduler loop instead of riding an executor — the
+    per-state vocab sweep is seconds of CPU for a real tokenizer, which
+    would starve every live stream's drain."""
+    out = findings(
+        """
+        import time
+        async def admit_guided(sched, er, compile_table):
+            table = compile_table(er.guided)   # O(states x vocab) sweep
+            while table is None:
+                time.sleep(0.01)               # "wait for the compile"
+                table = compile_table(er.guided)
+            sched.install_table(er, table)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]

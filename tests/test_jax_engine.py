@@ -604,10 +604,24 @@ async def test_sampling_penalties_and_seed_isolation(hf_model_dir):
     m = await one([1, 5, 9], temperature=1.0, min_p=1.0, seed=5)
     assert m == g
 
-    # 4. n > 1 is rejected loudly, not silently dropped
+    # 4. n > 1 fans out into independent seeded choices at the engine:
+    # deltas come back tagged with their choice index, greedy choices
+    # are identical to the single-choice stream, and the fold covers
+    # every choice (ISSUE 13: n>1 rows are ordinary chain members)
+    req = PreprocessedRequest(
+        token_ids=[1, 5, 9],
+        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0, n=2),
+    )
+    per_choice = {0: [], 1: []}
+    async for out in engine.generate(Context(req)):
+        per_choice[out["choice"]].extend(out.get("token_ids", []))
+    single = await one([1, 5, 9], max_tokens=6, temperature=0.0)
+    assert per_choice[0] == per_choice[1] == single
+    # n beyond the OpenAI cap still rejects loudly
     from dynamo_tpu.runtime.engine import EngineError
     with pytest.raises(EngineError):
-        await one([1, 5, 9], n=2)
+        await one([1, 5, 9], n=21)
     await engine.close()
 
 

@@ -120,10 +120,15 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_registry_tenant_sheds_total",
         "dynamo_registry_tenant_fallbacks_total",
         "dynamo_registry_tenant_tokens_total",
+        # unrestricted persistent decode (engine/scheduler.py): the
+        # sync-path fallback ladder attribution + the in-carry
+        # propose-verify acceptance-length histogram
+        "dynamo_engine_sync_fallback_total",
+        "dynamo_engine_spec_accept_length",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 98
+    assert len(names) >= 100
 
 
 def _metric(name, kind):

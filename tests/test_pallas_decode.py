@@ -492,3 +492,119 @@ def test_attention_dispatch_sinks_rides_pallas_incl_mesh():
         mesh=mesh, interpret=True, layer_idx=jnp.int32(1), sinks=sinks,
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# the fused S-token verify kernel (speculative propose-verify rounds)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ppc", [8, 2, 1])
+def test_verify_matches_xla_reference(ppc):
+    """paged_verify_attention vs the gather/softmax reference: S query
+    tokens at affine positions (ctx - S + s), one page walk per row —
+    chunked prefetch exercised at ppc < live pages."""
+    from dynamo_tpu.ops.pallas_decode import paged_verify_attention
+
+    rng = np.random.default_rng(7)
+    layers, b, h, kvh, d, bs, w, s = 2, 3, 8, 4, 64, 16, 8, 5
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    _, k_cache, v_cache, bt = make_stacked_case(
+        rng, layers, b, h, kvh, d, bs, w
+    )
+    ctx = jnp.asarray([s + 1, 37, 101], jnp.int32)  # incl. the S tail
+    positions = (ctx - s)[:, None] + jnp.arange(s)[None, :]
+
+    for li in range(layers):
+        ref = paged_attention(
+            q, k_cache[li], v_cache[li], bt, positions, ctx
+        )
+        out = paged_verify_attention(
+            q, k_cache, v_cache, bt, ctx - s, ctx,
+            layer_idx=jnp.int32(li), pages_per_chunk=ppc, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"layer {li}",
+        )
+
+
+def test_verify_windowed_matches_xla_reference():
+    """Sliding window on the verify tail: each query's own lower bound
+    applies (key > q_pos - window)."""
+    from dynamo_tpu.ops.pallas_decode import paged_verify_attention
+
+    rng = np.random.default_rng(8)
+    layers, b, h, kvh, d, bs, w, s = 2, 2, 4, 2, 32, 8, 8, 4
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    _, k_cache, v_cache, bt = make_stacked_case(
+        rng, layers, b, h, kvh, d, bs, w
+    )
+    ctx = jnp.asarray([29, 53], jnp.int32)
+    positions = (ctx - s)[:, None] + jnp.arange(s)[None, :]
+    ref = paged_attention(
+        q, k_cache[0], v_cache[0], bt, positions, ctx,
+        sliding_window=16,
+    )
+    out = paged_verify_attention(
+        q, k_cache, v_cache, bt, ctx - s, ctx,
+        layer_idx=jnp.int32(0), interpret=True,
+        window=jnp.asarray(16, jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_attention_dispatch_small_s_rides_verify_kernel():
+    """attention() routes 1 < S <= VERIFY_MAX_S through the verify
+    kernel (affine verify layout) and matches the XLA reference."""
+    rng = np.random.default_rng(9)
+    layers, b, h, kvh, d, bs, w, s = 2, 2, 8, 4, 64, 16, 8, 3
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    _, k_cache, v_cache, bt = make_stacked_case(
+        rng, layers, b, h, kvh, d, bs, w
+    )
+    ctx = jnp.asarray([s, 64], jnp.int32)
+    positions = (ctx - s)[:, None] + jnp.arange(s)[None, :]
+    ref = attention(
+        q, k_cache, v_cache, bt, positions, ctx,
+        impl="xla", layer_idx=jnp.int32(1),
+    )
+    out = attention(
+        q, k_cache, v_cache, bt, positions, ctx,
+        impl="pallas", interpret=True, layer_idx=jnp.int32(1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_verify_padded_chunk_valid_rows_match_flash_contract():
+    """A right-padded small chunk (ctx < base + S — the shape a custom
+    sub-32 prefill bucket would produce): valid rows must match the XLA
+    reference exactly; pad rows are garbage the caller discards (the
+    flash kernel's contract)."""
+    from dynamo_tpu.ops.pallas_decode import paged_verify_attention
+
+    rng = np.random.default_rng(11)
+    layers, b, h, kvh, d, bs, w, s = 2, 2, 4, 2, 32, 8, 8, 6
+    valid = 4  # last 2 query rows are padding
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    _, k_cache, v_cache, bt = make_stacked_case(
+        rng, layers, b, h, kvh, d, bs, w
+    )
+    base = jnp.asarray([10, 3], jnp.int32)
+    ctx = base + valid
+    positions = base[:, None] + jnp.arange(s)[None, :]
+    ref = paged_attention(
+        q, k_cache[0], v_cache[0], bt, positions, ctx
+    )
+    out = paged_verify_attention(
+        q, k_cache, v_cache, bt, base, ctx,
+        layer_idx=jnp.int32(0), interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :valid], np.asarray(ref)[:, :valid],
+        rtol=2e-5, atol=2e-5,
+    )

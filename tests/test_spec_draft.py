@@ -74,12 +74,14 @@ def draft_dir(tmp_path_factory):
     )
 
 
-async def _serve(model_dir, prompts, draft=None, k=4, max_tokens=12):
+async def _serve(model_dir, prompts, draft=None, k=4, max_tokens=12,
+                 chain_len_out=None, **econfig_kw):
     econfig = EngineConfig(
         model=ModelConfig.from_model_dir(model_dir),
         max_batch_size=2, max_model_len=128, kv_block_size=8,
         num_kv_blocks=64, dtype="float32", prefill_buckets=[32],
         spec_draft_model=draft, spec_draft_tokens=k if draft else 0,
+        **econfig_kw,
     )
     mdc = ModelDeploymentCard.from_local_path(model_dir)
     engine = await JaxServingEngine.create(
@@ -99,6 +101,11 @@ async def _serve(model_dir, prompts, draft=None, k=4, max_tokens=12):
     stats = engine.scheduler.metrics() if hasattr(engine, "scheduler") else {}
     proposed = engine.scheduler.spec_proposed
     accepted = engine.scheduler.spec_accepted
+    if chain_len_out is not None:
+        chain_len_out["chain_len"] = engine.scheduler._last_chain_len
+        chain_len_out["spec_rounds"] = sum(
+            engine.scheduler._spec_accept_hist.totals.values()
+        )
     await engine.close()
     del stats
     return outs, proposed, accepted
@@ -116,6 +123,38 @@ def test_draft_stream_identical_to_plain_greedy(target_dir, draft_dir):
     assert got == ref
     assert proposed > 0  # speculation actually engaged
     assert 0 <= accepted <= proposed
+
+
+def test_draft_chained_rounds_stream_identical(target_dir, draft_dir):
+    """ISSUE 13: with device finish + dispatch-ahead, draft/target
+    rounds interleave off the SAME device carry (no host barrier
+    between rounds) — the stream must still equal plain greedy, the
+    chain must actually run (>1 round between host barriers), and
+    proposals must flow through the chained verify program."""
+    ref, _, _ = asyncio.run(_serve(target_dir, PROMPTS, max_tokens=16))
+    box = {}
+    got, proposed, accepted = asyncio.run(_serve(
+        target_dir, PROMPTS, draft=draft_dir, max_tokens=16,
+        decode_pipeline_depth=2, chain_len_out=box,
+    ))
+    assert got == ref
+    assert proposed > 0
+    assert 0 <= accepted <= proposed
+    assert box["spec_rounds"] > 0, "chained verify never ran"
+    assert box["chain_len"] > 1, "host barrier still per round"
+
+
+def test_self_draft_chained_accepts_everything(target_dir):
+    """Draft == target under the chained rounds: every proposal
+    verifies, so acceptance stays 100% through the carry-folded
+    accept path too."""
+    ref, _, _ = asyncio.run(_serve(target_dir, PROMPTS[:1]))
+    got, proposed, accepted = asyncio.run(_serve(
+        target_dir, PROMPTS[:1], draft=target_dir,
+        decode_pipeline_depth=2,
+    ))
+    assert got == ref
+    assert proposed > 0 and accepted == proposed
 
 
 def test_self_draft_accepts_everything(target_dir):
