@@ -295,6 +295,122 @@ def run_once(attention_impl: str, burst: int = 1,
     }
 
 
+def run_sp_prefill(ctx: int) -> dict:
+    """The long-context prefill lever (xla:k8:sp-prefill): prefill
+    tokens/s of the sequence-parallel chunk ladder across the mesh vs
+    the single-chip dense chunk ladder, at one context length.
+
+    Runs the REAL serving programs (ModelRunner.sp_prefill_chunk and
+    ModelRunner.step over the scheduler's shared bucket ladder), so the
+    number includes every cost the engine pays: chunk padding, paged
+    prefix gathers, the ring rotation, and the final sampling tail.
+    CPU smoke (BENCH_SMOKE=1) forces an 8-device virtual host platform
+    so the mesh logic is exercised creds-free.
+    """
+    import os
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if smoke and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+    import numpy as _np
+
+    from __graft_entry__ import FLAGSHIP
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.scheduler import (
+        build_prefill_arrays,
+        prefill_bucket_cap,
+    )
+
+    n_dev = len(jax.devices())
+    sp = 8 if n_dev >= 8 else max(1, n_dev)
+    mdims = dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+    ) if smoke else dict(FLAGSHIP)
+    mdims["max_position_embeddings"] = max(
+        mdims.get("max_position_embeddings", 4096), ctx + 64)
+    mcfg = ModelConfig(**mdims, attention_impl="xla")
+    bs = 16
+    blocks = ctx // bs + 8
+
+    def build(sp_size):
+        cfg = EngineConfig(
+            model=mcfg, max_batch_size=1, max_model_len=ctx + 64,
+            kv_block_size=bs, num_kv_blocks=blocks,
+            dtype="float32" if smoke else "bfloat16",
+            sp_size=sp_size,
+            max_prefill_tokens_per_step=64 if smoke else 8192,
+        )
+        return cfg, ModelRunner(cfg, model_dir=None)
+
+    prompt = [int(t) for t in _np.random.default_rng(0).integers(
+        1, mcfg.vocab_size, ctx)]
+    block_ids = list(range(ctx // bs + 1))
+    zeros1 = _np.zeros(1, _np.float32)
+
+    def dense_ladder(cfg, runner):
+        cap = prefill_bucket_cap(cfg) or cfg.prefill_buckets[0]
+        pos, outs, chunks = 0, None, 0
+        t0 = time.perf_counter()
+        while pos < ctx:
+            end = min(pos + cap, ctx)
+            arrays = build_prefill_arrays(cfg, prompt[:end], pos, block_ids)
+            outs = runner.step(
+                *arrays, zeros1, _np.zeros(1, _np.int32),
+                _np.ones(1, _np.float32),
+                seed_keys=_np.zeros((1, 2), _np.uint32),
+                counters=_np.zeros(1, _np.int32),
+                sample_slots=_np.zeros(1, _np.int32),
+                commit=_np.asarray([end >= ctx]), want_top=False,
+            )
+            pos, chunks = end, chunks + 1
+        _np.asarray(outs[0])  # drain
+        return time.perf_counter() - t0, chunks
+
+    def sp_ladder(cfg, runner):
+        cap = runner.sp_chunk_tokens
+        pos, outs, chunks = 0, None, 0
+        t0 = time.perf_counter()
+        while pos < ctx:
+            end = min(pos + cap, ctx)
+            outs = runner.sp_prefill_chunk(
+                prompt[:end], pos, block_ids, commit=end >= ctx,
+            )
+            pos, chunks = end, chunks + 1
+        _np.asarray(outs[0])  # drain
+        return time.perf_counter() - t0, chunks
+
+    # dense single-chip ladder first (compile + measure), then free it
+    # before the SP runner claims HBM
+    cfg_d, runner_d = build(1)
+    dense_ladder(cfg_d, runner_d)  # compile pass
+    dense_s, dense_chunks = dense_ladder(cfg_d, runner_d)
+    del runner_d
+
+    cfg_sp, runner_sp = build(sp)
+    sp_ladder(cfg_sp, runner_sp)  # compile pass
+    sp_s, sp_chunks = sp_ladder(cfg_sp, runner_sp)
+
+    return {
+        "metric": f"prefill_tokens_per_sec_1b_ctx{ctx}",
+        "value": round(ctx / sp_s, 1),
+        "unit": "tokens/s",
+        "dense_tokens_per_s": round(ctx / dense_s, 1),
+        "speedup_vs_single_chip": round(dense_s / sp_s, 3),
+        "sp_axis": sp,
+        "sp_chunks": sp_chunks,
+        "dense_chunks": dense_chunks,
+        "ctx": ctx,
+        "smoke": smoke,
+    }
+
+
 # one JSON line per attempt/probe outcome, appended as they happen: the
 # driver's BENCH_r*.json keeps only the winning line, so when a round
 # goes sideways (wedged relay, timeouts) this sidecar is the record of
@@ -405,6 +521,44 @@ def _run_impl_subprocess(impl: str, timeout_s: float, burst: int = 1,
                               result=result))
             return result
     sys.stderr.write(proc.stderr[-4000:])
+    print(f"bench[{label}] failed (rc={proc.returncode})", flush=True)
+    _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                      error=(proc.stderr[-500:] or "no result line")))
+    return None
+
+
+def _run_sp_subprocess(ctx: int, timeout_s: float):
+    """One sp-prefill lever attempt in a child with a hard timeout —
+    the same discipline as every other attempt; per-ctx rows land in
+    the attempts sidecar."""
+    import subprocess
+    import sys
+
+    label = f"xla:k8:sp-prefill:ctx{ctx}"
+    code = (
+        "import json; from bench import run_sp_prefill; "
+        f"print('BENCH_RESULT ' + json.dumps(run_sp_prefill({ctx})))"
+    )
+    t0 = time.monotonic()
+    rec = {"label": label, "ctx": ctx, "timeout_s": round(timeout_s, 1)}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            cwd=_os.path.dirname(_os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench[{label}] timed out after {timeout_s:.0f}s", flush=True)
+        _log_attempt(dict(rec, rc=124, wall_s=round(
+            time.monotonic() - t0, 1), error="timeout"))
+        return None
+    wall = round(time.monotonic() - t0, 1)
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            result = json.loads(line[len("BENCH_RESULT "):])
+            _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                              result=result))
+            return result
     print(f"bench[{label}] failed (rc={proc.returncode})", flush=True)
     _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
                       error=(proc.stderr[-500:] or "no result line")))
@@ -610,6 +764,22 @@ def main() -> None:
         if persist_guided is not None and (
                 best is None or persist_guided["value"] > best["value"]):
             best = persist_guided
+
+    # the long-context sequence-parallel prefill lever (xla:k8:sp-prefill;
+    # docs/long_context.md): prefill tokens/s across the mesh vs the
+    # single-chip ladder, one child per context length so a wedge at
+    # 128k cannot eat the 32k number. A different metric family — the
+    # per-ctx rows ride the attempt sidecar and the lever table, never
+    # the decode headline.
+    sp_ctxs = ((512, 1024) if os.environ.get("BENCH_SMOKE")
+               else (32768, 131072))
+    for sp_ctx in sp_ctxs:
+        remaining = total_budget - (_time.monotonic() - t0)
+        if remaining <= 300 or os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+            break
+        sp_res = _run_sp_subprocess(
+            sp_ctx, timeout_s=min(420.0, remaining - 180))
+        note(f"xla:k8:sp-prefill:ctx{sp_ctx}", sp_res)
 
     remaining = total_budget - (_time.monotonic() - t0)
     if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):

@@ -53,6 +53,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="experts shard over the ep mesh axis (MoE)")
     p.add_argument("--data-parallel-size", type=int, default=1,
                    help="batch shards over the dp mesh axis")
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="sequence-parallel axis for long-context prefill: "
+                        "one oversized prompt's tokens shard across this "
+                        "many devices (ring attention + chunk-streamed KV "
+                        "commit; docs/long_context.md). Decode is "
+                        "unaffected. Llama-family GQA dense models only.")
+    p.add_argument("--long-prefill-threshold-tokens", type=int, default=0,
+                   help="admission class: prompts whose uncached suffix is "
+                        "at least this long take the sequence-parallel "
+                        "prefill program (or, in disagg mode, prefer the "
+                        "prefill-worker pool). 0 = default to the per-step "
+                        "prefill budget when --sequence-parallel-size > 1, "
+                        "else disabled.")
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="dense trunk stages over the pp mesh axis "
                         "(collective GPipe; reference analog: "
@@ -514,7 +527,13 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
         # pure frontend: models come exclusively from the discovery watcher
         return None, None
     if engine_spec == "echo_full":
-        return EchoEngineFull(), None
+        from ..llm.embeddings import EchoEmbedder
+
+        engine = EchoEngineFull()
+        # the echo stack serves /v1/embeddings too (deterministic
+        # hash-seeded vectors) so the endpoint is drivable creds-free
+        engine.embedder = EchoEmbedder()
+        return engine, None
     if engine_spec.startswith("pystr:"):
         # bring-your-own OpenAI-level engine (reference: out=pystr:<file>)
         engine = await _load_python_engine(
@@ -553,6 +572,23 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
             # step/phase histograms, KV counters, disagg RTT) merges into
             # the frontend's exposition instead of the dict-gauge fallback
             pipe.telemetry_registry = core.registry
+        if getattr(core, "embed_ready", False) and hasattr(core, "embed"):
+            # /v1/embeddings rides the batched-prefill path of THIS
+            # engine (llm/embeddings.py; prefill-only, no decode slot)
+            from ..llm.embeddings import Embedder
+
+            vocab = None
+            cfg_e = getattr(core, "config", None)
+            if cfg_e is not None:
+                vocab = cfg_e.model.vocab_size
+            pipe.embedder = Embedder(
+                tokenizer, core,
+                max_model_len=(
+                    cfg_e.max_model_len if cfg_e is not None
+                    else mdc.context_length
+                ),
+                vocab_size=vocab,
+            )
         return pipe, mdc
 
     raise SystemExit(f"unknown engine {engine_spec!r}")

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.compat import shard_map
+
 logger = logging.getLogger(__name__)
 
 
@@ -160,7 +162,7 @@ class IciKvTransfer:
         kb = self._local_shape(self.k_shape, eff)
         vb = self._local_shape(self.v_shape, eff)
         prog = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step, mesh=self.mesh,
                 in_specs=(P("peer", "pair"), P("peer", "pair"),
                           P("peer", "pair")),

@@ -308,12 +308,26 @@ class PrefillWorker:
                 sample_slots=np.zeros(1, np.int32),
             )
 
+            # long-context admission class (docs/long_context.md): when
+            # this worker carries a sequence-parallel mesh and the
+            # uncached suffix crosses the threshold, the SAME chunk
+            # ladder runs through the SP program — each chunk is
+            # mesh-wide (sp × the dense budget) and the streaming plane
+            # below is untouched: the SP program scatters into the same
+            # paged cache the frame gathers read
+            use_sp = (
+                getattr(self.runner, "sp_ready", False)
+                and cfg.long_prefill_threshold_tokens > 0
+                and len(prompt) - num_cached
+                >= cfg.long_prefill_threshold_tokens
+            )
             # stream plan: the decode side already holds blocks below
             # first_block; everything from there ships as bounded frames,
             # each as soon as its last position's KV is scheduled
             first_block = rpr.num_cached // bs
             limit = len(block_ids)
-            cap = self._chunk_cap()
+            cap = self.runner.sp_chunk_tokens if use_sp \
+                else self._chunk_cap()
             frame_blocks = (
                 self.ici.buckets[-1] if use_ici else max(1, cap // bs)
             )
@@ -332,16 +346,30 @@ class PrefillWorker:
             while True:
                 end = min(pos + cap, total)
                 final = end >= total
-                arrays = build_prefill_arrays(cfg, prompt[:end], pos, block_ids)
-                # dispatch-only: JAX queues the step; the one host sync
-                # happens once, on the final chunk's sampled outputs
-                outs = self.runner.step(
-                    *arrays, *samp_args, **samp_kw,
-                    # alternatives only when the request asked for
-                    # top_logprobs, and only on the chunk that samples
-                    # (same gate as the decode scheduler)
-                    want_top=final and rpr.logprobs_n > 0,
-                )
+                # dispatch-only either way: JAX queues the chunk; the one
+                # host sync happens once, on the final chunk's outputs
+                if use_sp:
+                    outs = self.runner.sp_prefill_chunk(
+                        prompt[:end], pos, block_ids,
+                        temperature=rpr.temperature, top_k=rpr.top_k,
+                        top_p=rpr.top_p, min_p=rpr.min_p,
+                        presence_penalty=rpr.presence_penalty,
+                        frequency_penalty=rpr.frequency_penalty,
+                        repetition_penalty=rpr.repetition_penalty,
+                        seed_keys=samp_kw["seed_keys"][0],
+                        counters=0, sample_slot=0, commit=final,
+                        want_top=final and rpr.logprobs_n > 0,
+                    )
+                else:
+                    arrays = build_prefill_arrays(
+                        cfg, prompt[:end], pos, block_ids)
+                    outs = self.runner.step(
+                        *arrays, *samp_args, **samp_kw,
+                        # alternatives only when the request asked for
+                        # top_logprobs, and only on the chunk that
+                        # samples (same gate as the decode scheduler)
+                        want_top=final and rpr.logprobs_n > 0,
+                    )
                 ready = limit if final else min(end // bs, limit)
                 if ready > shipped:
                     await self._ship(pipe, rpr, block_ids, shipped, ready)

@@ -282,6 +282,23 @@ class EngineConfig:
     dp_size: int = 1
     ep_size: int = 1
     tp_size: int = 1
+    # sequence parallelism for long-context prefill (parallel/sequence.py,
+    # docs/long_context.md): sp_size > 1 adds an ``sp`` mesh axis and
+    # compiles a sequence-parallel prefill program (``prefill_sp``) that
+    # shards ONE oversized prompt's tokens across the axis — ring
+    # attention over the chunk + the committed paged prefix — so a 128k
+    # prompt prefills across the slice instead of monopolizing one chip.
+    # Decode programs ignore the axis (their specs never name it), so an
+    # sp engine decodes exactly as before. Llama-family GQA dense trunks
+    # only (the ring kernel has no MLA/MoE/sliding-window variant yet).
+    sp_size: int = 1
+    # the admission class: prompts whose uncached suffix is at least this
+    # long route to the sequence-parallel prefill program (local mesh) or,
+    # in disagg mode, bias toward the prefill-worker pool whose workers
+    # run the same SP chunk ladder. 0 with sp_size > 1 defaults to
+    # max_prefill_tokens_per_step (one dense chunk budget); 0 with
+    # sp_size == 1 disables the class entirely.
+    long_prefill_threshold_tokens: int = 0
     seed: int = 0
     # serve random-init weights when model_dir has no checkpoint (tests,
     # topology dry runs); off by default so a misnamed checkpoint dir
@@ -477,6 +494,30 @@ class EngineConfig:
         self.watchdog_interval_s = max(0.05, self.watchdog_interval_s)
         self.spec_ngram_tokens = max(0, min(self.spec_ngram_tokens, 16))
         self.spec_ngram_match = max(1, self.spec_ngram_match)
+        self.sp_size = max(1, self.sp_size)
+        self.long_prefill_threshold_tokens = max(
+            0, self.long_prefill_threshold_tokens)
+        if self.sp_size > 1:
+            if self.prefill_buckets[0] % self.sp_size:
+                # every SP chunk pads to a bucket sharded over the axis;
+                # the smallest bucket bounds the divisibility requirement
+                raise ValueError(
+                    f"sp_size {self.sp_size} must divide the smallest "
+                    f"prefill bucket {self.prefill_buckets[0]}"
+                )
+            if self.pp_size > 1:
+                raise ValueError(
+                    "sp_size > 1 does not compose with pipeline "
+                    "parallelism (the SP program assumes an unstaged "
+                    "cache)"
+                )
+            if self.long_prefill_threshold_tokens == 0:
+                # default: anything past one dense chunk budget is
+                # "long" — it would already take multiple ladder passes
+                self.long_prefill_threshold_tokens = (
+                    self.max_prefill_tokens_per_step
+                    or self.prefill_buckets[-1]
+                )
         self.prefix_pull_min_blocks = max(1, self.prefix_pull_min_blocks)
         self.prefix_pull_timeout_s = max(0.1, self.prefix_pull_timeout_s)
         if bool(self.cold_tier_dir) != (self.cold_tier_blocks > 0):
@@ -547,6 +588,22 @@ class EngineConfig:
             if n <= r:
                 return r
         return self.PREFILL_ROW_BUCKETS[-1]
+
+    def sp_prefill_bucket(self) -> int:
+        """The ONE chunk length the sequence-parallel prefill program
+        compiles at: the largest prefill bucket whose PER-DEVICE token
+        share (bucket / sp) stays within the per-step budget — the same
+        ITL bound the dense ladder honors, scaled by the axis. A fixed
+        bucket (short/final chunks pad into it) keeps ``prefill_sp`` at
+        exactly one compiled shape."""
+        budget = self.max_prefill_tokens_per_step
+        if not budget:
+            return self.prefill_buckets[-1]
+        allowed = [
+            b for b in self.prefill_buckets
+            if b <= self.sp_size * budget and b % self.sp_size == 0
+        ]
+        return allowed[-1] if allowed else self.prefill_buckets[0]
 
     def kv_width_buckets(self) -> List[int]:
         """The decode block-table width ladder: powers of two from 8 up to

@@ -32,12 +32,15 @@ from .sampling import SamplingParams, sample, top_logprobs_for
 logger = logging.getLogger(__name__)
 
 
-def build_mesh(dp: int, tp: int, devices=None, ep: int = 1, pp: int = 1) -> Mesh:
-    """(pp, dp, ep, tp) mesh; tp innermost so its collectives ride the
-    fastest ICI, pp outermost so stage hops cross the slowest links
+def build_mesh(dp: int, tp: int, devices=None, ep: int = 1, pp: int = 1,
+               sp: int = 1) -> Mesh:
+    """(pp, dp, sp, ep, tp) mesh; tp innermost so its collectives ride
+    the fastest ICI, pp outermost so stage hops cross the slowest links
     (stages communicate once per microbatch tick, tp all-reduces twice
-    per layer). ep=1/pp=1 keep those axes present (specs may name them)
-    but trivial.
+    per layer), sp between dp and ep — the ring rotation's per-hop
+    payload is one K/V shard, heavier than an ep dispatch but far
+    lighter than tp's twice-per-layer all-reduces. ep=1/pp=1/sp=1 keep
+    those axes present (specs may name them) but trivial.
 
     Device pick: LOCAL devices when they suffice — in a multi-process
     world (disagg workers sharing a jax.distributed group for the ICI
@@ -45,16 +48,17 @@ def build_mesh(dp: int, tp: int, devices=None, ep: int = 1, pp: int = 1) -> Mesh
     not claim the peer's devices. A mesh larger than the local count is
     the single-engine multi-host case and takes the global list.
     """
-    n = pp * dp * ep * tp
+    n = pp * dp * sp * ep * tp
     if devices is None:
         local = jax.local_devices()
         devices = local if n <= len(local) else jax.devices()
     if n > len(devices):
         raise ValueError(
-            f"mesh {pp}x{dp}x{ep}x{tp} needs {n} devices, have {len(devices)}"
+            f"mesh {pp}x{dp}x{sp}x{ep}x{tp} needs {n} devices, "
+            f"have {len(devices)}"
         )
-    arr = np.asarray(devices[:n]).reshape(pp, dp, ep, tp)
-    return Mesh(arr, ("pp", "dp", "ep", "tp"))
+    arr = np.asarray(devices[:n]).reshape(pp, dp, sp, ep, tp)
+    return Mesh(arr, ("pp", "dp", "sp", "ep", "tp"))
 
 
 def param_specs(params) -> Dict:
@@ -168,7 +172,7 @@ class ModelRunner:
         )
         self.mesh = mesh or build_mesh(
             config.dp_size, config.tp_size, ep=config.ep_size,
-            pp=config.pp_size,
+            pp=config.pp_size, sp=config.sp_size,
         )
         # mixed dense+MoE MLA trunk under pp: the dense prefix stays
         # replicated (params, cache, and compute) while the MoE trunk
@@ -341,8 +345,12 @@ class ModelRunner:
         self._build_step()
         self._build_burst()
         self._build_spec_burst()
+        self._build_sp_prefill()
         self._build_block_ops()
         self._build_sample_row()
+        # batched cacheless embedding programs, compiled per (rows,
+        # bucket) on first use (the /v1/embeddings workload)
+        self._embed_progs: Dict[Tuple[int, int], Any] = {}
 
     # ---------- the unified step program ----------
 
@@ -489,10 +497,13 @@ class ModelRunner:
         K = self.config.multi_step_decode
         self._burst = None
         self._burst_df = None
-        if K <= 1 and self.config.decode_pipeline_depth < 2:
+        if (K <= 1 and self.config.decode_pipeline_depth < 2
+                and self.config.sp_size <= 1):
             # the dispatch-ahead pipeline always runs through the burst
             # program (its carry keeps sampled tokens device-resident),
             # so pipelining with multi_step_decode=1 compiles a K=1 scan
+            # — and so does the SP engine's early decode handoff, which
+            # chains the first burst off the final chunk's device token
             return
         cfg = self.config.model
         mesh = self.mesh
@@ -863,6 +874,190 @@ class ModelRunner:
             )
         self._spec_k = K
 
+    def _build_sp_prefill(self):
+        """The sequence-parallel long-context prefill program.
+
+        One compiled shape: [1, S] chunk tokens sharded over the mesh's
+        ``sp`` axis (S = config.sp_prefill_bucket(); short/final chunks
+        pad into it), fresh K/V scattered into the paged cache exactly
+        like the dense ladder, attention = one ring pass over the chunk
+        plus the gathered committed prefix (parallel/sequence.py
+        sp_chunk_attention), and the dense step's sampling tail on the
+        last valid position so the final chunk's sampled token — and its
+        logprobs — are bit-identical to what the dense ladder would have
+        produced. Non-final chunks dispatch with commit=False and
+        nothing reads their outputs.
+        """
+        self._sp_prefill = None
+        cfg_e = self.config
+        if cfg_e.sp_size <= 1:
+            return
+        if "sp" not in self.mesh.axis_names or self.mesh.shape["sp"] <= 1:
+            raise ValueError(
+                f"sp_size {cfg_e.sp_size} needs an 'sp' mesh axis of that "
+                f"size (got mesh {dict(self.mesh.shape)})"
+            )
+        cfg = self.config.model
+        if (self.arch is not llama or cfg.sliding_window
+                or cfg.attn_logit_softcap or cfg.num_experts
+                or cfg.kv_lora_rank):
+            raise ValueError(
+                "sequence-parallel prefill currently serves llama-family "
+                "GQA dense trunks without sliding windows (the ring "
+                "kernel has no MLA/MoE/windowed variant yet)"
+            )
+        mesh = self.mesh
+        sp = cfg_e.sp_size
+        head_axis = "tp" if cfg_e.tp_size > 1 else None
+        S = cfg_e.sp_prefill_bucket()
+        bs = cfg_e.kv_block_size
+        # block-table width padded so the gathered prefix (W*bs keys)
+        # shards evenly over the axis alongside the chunk's S
+        w = cfg_e.blocks_per_seq
+        while (w * bs) % sp:
+            w += 1
+        self._sp_bucket = S
+        self._sp_width = w
+        repl = NamedSharding(mesh, P())
+        seq_spec = NamedSharding(mesh, P(None, "sp"))
+        forward, head = self._make_forward()
+        del forward  # the SP trunk has its own
+
+        def sp_step(params, k_cache, v_cache, counts, seen, bias, tokens,
+                    positions, block_tables, slot_mapping, context_lens,
+                    chunk_start, last_idx, samp, sample_slots, commit,
+                    want_top):
+            hidden, (k_cache, v_cache) = llama.sp_decoder_forward(
+                params, cfg, tokens, positions, (k_cache, v_cache),
+                block_tables, slot_mapping, context_lens, chunk_start,
+                mesh, sp_axis="sp", head_axis=head_axis,
+            )
+            b = tokens.shape[0]
+            last_logits = head(hidden[jnp.arange(b), last_idx], params)
+            next_tokens, lps, top_vals, top_ids, counts = (
+                _sample_and_logprobs(
+                    cfg, last_logits, samp, counts, seen, bias,
+                    sample_slots, commit, want_top,
+                )
+            )
+            return (next_tokens, lps, top_vals, top_ids, k_cache, v_cache,
+                    counts, seen, bias)
+
+        samp_spec = SamplingParams(
+            temperature=repl, top_k=repl, top_p=repl, min_p=repl,
+            presence_penalty=repl, frequency_penalty=repl,
+            repetition_penalty=repl, keys=repl, counters=repl,
+        )
+        self._sp_prefill = jax.jit(
+            sp_step,
+            donate_argnums=(1, 2, 3, 4, 5),
+            in_shardings=(
+                self.param_shardings,
+                self.cache_sharding, self.cache_sharding,
+                self.state_sharding, self.state_sharding,
+                self.state_sharding,
+                seq_spec,                    # tokens [1, S]
+                seq_spec,                    # positions [1, S]
+                repl,                        # block_tables [1, W]
+                seq_spec,                    # slot_mapping [1, S]
+                repl,                        # context_lens [1]
+                repl,                        # chunk_start scalar
+                repl,                        # last_idx [1]
+                samp_spec,
+                repl,                        # sample_slots [1]
+                repl,                        # commit [1]
+                repl,                        # want_top
+            ),
+            out_shardings=(repl, repl, repl, repl,
+                           self.cache_sharding, self.cache_sharding,
+                           self.state_sharding, self.state_sharding,
+                           self.state_sharding),
+        )
+
+    @property
+    def sp_ready(self) -> bool:
+        """Is the sequence-parallel prefill program built? (The scheduler
+        and the disagg prefill worker gate the SP ladder on this.)"""
+        return getattr(self, "_sp_prefill", None) is not None
+
+    @property
+    def sp_chunk_tokens(self) -> int:
+        """Tokens one SP chunk advances (the fixed compiled bucket)."""
+        return self._sp_bucket
+
+    def sp_prefill_chunk(
+        self,
+        prompt,                    # full token list UP TO the chunk end
+        start: int,                # chunk's first position (KV before it
+        block_ids,                 #   is already committed)
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        min_p: float = 0.0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+        repetition_penalty: float = 1.0,
+        seed_keys=None,            # [2] u32 per-request key
+        counters: int = 0,
+        sample_slot: int = 0,
+        commit: bool = False,      # final chunk samples/commits
+        want_top: bool = False,
+    ):
+        """Dispatch ONE sequence-parallel prefill chunk ([start,
+        len(prompt)) of the prompt, ≤ sp_chunk_tokens tokens). Returns
+        the step-tail device arrays ``(next_tokens, lps, top_vals,
+        top_ids)`` — meaningful only on the committing (final) chunk.
+        Dispatch-only: no host sync happens here."""
+        S = self._sp_bucket
+        w = self._sp_width
+        bs = self.config.kv_block_size
+        suffix = prompt[start:]
+        take = len(suffix)
+        assert 0 < take <= S, (take, S)
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :take] = suffix
+        positions = np.full((1, S), len(prompt) - 1, np.int32)
+        positions[0, :take] = np.arange(start, len(prompt))
+        slot_map = np.full((1, S), -1, np.int32)
+        for i, pos in enumerate(range(start, len(prompt))):
+            slot_map[0, i] = block_ids[pos // bs] * bs + pos % bs
+        btab = np.zeros((1, w), np.int32)
+        btab[0, : len(block_ids)] = block_ids
+        if seed_keys is None:
+            seed_keys = np.zeros(2, np.uint32)
+        samp = SamplingParams(
+            temperature=jnp.asarray([temperature], jnp.float32),
+            top_k=jnp.asarray([top_k], jnp.int32),
+            top_p=jnp.asarray([top_p], jnp.float32),
+            min_p=jnp.asarray([min_p], jnp.float32),
+            presence_penalty=jnp.asarray([presence_penalty], jnp.float32),
+            frequency_penalty=jnp.asarray([frequency_penalty], jnp.float32),
+            repetition_penalty=jnp.asarray([repetition_penalty],
+                                           jnp.float32),
+            keys=jnp.asarray(np.asarray(seed_keys, np.uint32)[None, :]),
+            counters=jnp.asarray([counters], jnp.int32),
+        )
+        with self.compiles.track("prefill_sp", f"s{S}_w{w}"):
+            (next_tokens, lps, top_vals, top_ids, k, v, counts, seen,
+             bias) = self._sp_prefill(
+                self.params, self.kv_cache[0], self.kv_cache[1],
+                self.sample_state[0], self.sample_state[1],
+                self.sample_state[2],
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(btab), jnp.asarray(slot_map),
+                jnp.asarray([len(prompt)], jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray([take - 1], jnp.int32),
+                samp,
+                jnp.asarray([sample_slot], jnp.int32),
+                jnp.asarray([commit], jnp.bool_),
+                jnp.asarray(bool(want_top), jnp.bool_),
+            )
+        self.kv_cache = (k, v)
+        self.sample_state = (counts, seen, bias)
+        return next_tokens, lps, top_vals, top_ids
+
     @property
     def spec_burst_ready(self) -> bool:
         """Are the chained propose-verify programs built? (The scheduler
@@ -1177,6 +1372,72 @@ class ModelRunner:
         self.kv_cache = (k, v)
         self.sample_state = (counts, seen, bias)
         return next_tokens, lps, top_vals, top_ids, prompt_lps, greedy_all
+
+    @property
+    def embed_ready(self) -> bool:
+        """Can this runner serve the embeddings workload? Llama-family
+        GQA dense trunks without sliding windows (embed_forward runs the
+        cacheless dense-attention trunk)."""
+        cfg = self.config.model
+        return (self.arch is llama and not cfg.sliding_window
+                and not cfg.num_experts and not cfg.kv_lora_rank
+                and self.config.pp_size == 1)
+
+    def embed_prompts(self, prompts) -> np.ndarray:
+        """Batched prefill-only embeddings: prompts → [n, D] float32.
+
+        Rides the batched-prefill shape discipline — rows pad to the
+        PREFILL_ROW_BUCKETS ladder, lengths to the prefill bucket ladder
+        (one compiled program per (rows, bucket), built on first use) —
+        but through the CACHELESS trunk (models/llama.embed_forward): no
+        block allocation, no KV writes, no decode slot. Blocking (host
+        sync inside); callers on an event loop run it in an executor.
+        """
+        if not self.embed_ready:
+            raise ValueError(
+                "embeddings are served by llama-family GQA dense trunks "
+                "only (no MoE/MLA/sliding-window embed path yet)"
+            )
+        cfg = self.config
+        out = np.zeros((len(prompts), cfg.model.hidden_size), np.float32)
+        i = 0
+        while i < len(prompts):
+            batch = prompts[i : i + cfg.PREFILL_ROW_BUCKETS[-1]]
+            rows = cfg.prefill_row_bucket(len(batch))
+            bucket = cfg.bucket_for(max(len(p) for p in batch))
+            tokens = np.zeros((rows, bucket), np.int32)
+            positions = np.zeros((rows, bucket), np.int32)
+            valid = np.ones(rows, np.int32)
+            for j, p in enumerate(batch):
+                tokens[j, : len(p)] = p
+                positions[j, : len(p)] = np.arange(len(p))
+                positions[j, len(p):] = len(p) - 1
+                valid[j] = len(p)
+            prog = self._embed_progs.get((rows, bucket))
+            if prog is None:
+                mesh = self.mesh
+                arch = self.arch
+
+                def embed_fn(params, t, pos, vl):
+                    return arch.embed_forward(
+                        params, self.config.model, t, pos, vl
+                    )
+
+                repl = NamedSharding(mesh, P())
+                prog = jax.jit(
+                    embed_fn,
+                    in_shardings=(self.param_shardings, repl, repl, repl),
+                    out_shardings=repl,
+                )
+                self._embed_progs[(rows, bucket)] = prog
+            with self.compiles.track("embed", f"r{rows}_s{bucket}"):
+                vecs = prog(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(valid),
+                )
+            out[i : i + len(batch)] = np.asarray(vecs)[: len(batch)]
+            i += len(batch)
+        return out
 
     def set_sample_row(
         self, slot: int, prompt_ids, generated_ids=(), logit_bias=None,
@@ -1697,6 +1958,43 @@ class ModelRunner:
                     np.ones(b, np.float32),
                     jax.random.PRNGKey(0), want_greedy=True,
                 )
+        # the sequence-parallel prefill program (ONE compiled shape):
+        # inert dispatch — every slot is the drop sentinel, commit is
+        # False — so the long-context admission class never pays its
+        # multi-second compile on the first real 128k prompt
+        if getattr(self, "_sp_prefill", None) is not None:
+            S_sp, w_sp = self._sp_bucket, self._sp_width
+            repl_tok = np.zeros((1, S_sp), np.int32)
+            with self.compiles.track("prefill_sp", f"s{S_sp}_w{w_sp}"):
+                outs_sp = self._sp_prefill(
+                    self.params, self.kv_cache[0], self.kv_cache[1],
+                    self.sample_state[0], self.sample_state[1],
+                    self.sample_state[2],
+                    jnp.asarray(repl_tok), jnp.asarray(repl_tok),
+                    jnp.asarray(np.zeros((1, w_sp), np.int32)),
+                    jnp.asarray(np.full((1, S_sp), -1, np.int32)),
+                    jnp.asarray([1], jnp.int32), jnp.asarray(0, jnp.int32),
+                    jnp.asarray([0], jnp.int32),
+                    SamplingParams(
+                        temperature=jnp.zeros(1, jnp.float32),
+                        top_k=jnp.zeros(1, jnp.int32),
+                        top_p=jnp.ones(1, jnp.float32),
+                        min_p=jnp.zeros(1, jnp.float32),
+                        presence_penalty=jnp.zeros(1, jnp.float32),
+                        frequency_penalty=jnp.zeros(1, jnp.float32),
+                        repetition_penalty=jnp.ones(1, jnp.float32),
+                        keys=jnp.zeros((1, 2), jnp.uint32),
+                        counters=jnp.zeros(1, jnp.int32),
+                    ),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray([False], jnp.bool_),
+                    jnp.asarray(False, jnp.bool_),
+                )
+            # the inert dispatch consumed the donated cache/state buffers
+            # — adopt the returned ones (values unchanged: drop-sentinel
+            # slots wrote nothing, commit=False counted nothing)
+            self.kv_cache = (outs_sp[4], outs_sp[5])
+            self.sample_state = (outs_sp[6], outs_sp[7], outs_sp[8])
         # prefill-shaped programs (largest bucket, full table width) over
         # the batched-prefill row ladder, so the flash-prefill kernel's
         # compiles also happen — and fail — here rather than on the first

@@ -396,6 +396,20 @@ class _HostBatchState:
 
 
 @dataclasses.dataclass
+class _SpPrefill:
+    """The in-flight sequence-parallel prefill: one oversized prompt
+    advancing a mesh-wide chunk per scheduler pass. Chunks are
+    dispatch-only (no host sync); the final chunk's outputs — and the
+    early decode burst chained off its device-resident sampled token —
+    reconcile together in ``_sp_finish``."""
+
+    er: EngineRequest
+    t0: float                       # ladder start (monotonic)
+    chunks: int = 0
+    final_dispatch_t: float = 0.0
+
+
+@dataclasses.dataclass
 class _PendingPull:
     """One in-flight prefix pull (scheduler.pending_pull entry).
 
@@ -523,6 +537,13 @@ class Scheduler:
                 flight=self.flight,
             )
         self.pending_pull: List[EngineRequest] = []
+        # sequence-parallel long-context prefill (config.sp_size > 1,
+        # docs/long_context.md): oversized prompts admitted past the
+        # long_prefill_threshold_tokens class queue here and advance one
+        # SP chunk per loop pass — one prompt owns the mesh at a time
+        # (the program is batch-of-1 by construction)
+        self.sp_queue: List[EngineRequest] = []
+        self.sp_active: Optional[_SpPrefill] = None
         self.waiting: deque = deque()
         # persistent decode-step host arrays (see _HostBatchState)
         self._host = _HostBatchState(config)
@@ -673,6 +694,33 @@ class Scheduler:
         self._preemptions = reg.counter(
             "dynamo_scheduler_preemptions_total",
             "Requests evicted back to the waiting queue on KV OOM",
+        )
+        # sequence-parallel long-context prefill (docs/long_context.md)
+        self._sp_chunks_c = reg.counter(
+            "dynamo_engine_prefill_sp_chunks_total",
+            "Mesh-wide sequence-parallel prefill chunks dispatched "
+            "(each advances sp_prefill_bucket() tokens of one oversized "
+            "prompt across the sp axis)",
+        )
+        self._sp_tokens_c = reg.counter(
+            "dynamo_engine_prefill_sp_tokens_total",
+            "Prompt tokens prefilled through the sequence-parallel "
+            "program (suffix tokens only; prefix-cache hits excluded)",
+        )
+        reg.callback_gauge(
+            "dynamo_engine_prefill_sp_axis_depth",
+            "Size of the mesh's sequence-parallel axis (1 = the SP "
+            "program is not built; long prompts take the dense ladder)",
+            lambda: self.config.sp_size,
+        )
+        self._sp_exposed_h = reg.histogram(
+            "dynamo_engine_prefill_sp_exposed_seconds",
+            "Handoff exposure of one SP prefill: time after the final "
+            "chunk's dispatch during which NO decode work for the "
+            "request was in flight — ~0 when the early decode burst "
+            "chained off the device-resident first token, else the "
+            "whole final-chunk drain",
+            buckets=STEP_BUCKETS,
         )
         self._spec_proposed_ctr = reg.counter(
             "dynamo_scheduler_spec_proposed_tokens_total",
@@ -868,6 +916,10 @@ class Scheduler:
             er.slot = -1
             out.append(er)
         self.prefilling.clear()
+        # SP-mid-prefill requests migrate cold (partial KV is never
+        # packaged); any dispatched chunk work is abandoned with them
+        self.sp_queue.clear()
+        self.sp_active = None
         while self.waiting:
             out.append(self.waiting.popleft())
         for er in self.pending_remote:
@@ -1029,7 +1081,11 @@ class Scheduler:
             if er is None:
                 continue
             out.append({
-                "state": "prefilling" if er in self.prefilling else "decoding",
+                "state": (
+                    "prefilling" if er in self.prefilling
+                    else "sp_prefilling" if self._is_sp(er)
+                    else "decoding"
+                ),
                 "slot": i,
                 "request_id": er.request_id,
                 "trace_id": er.ctx.trace_id,
@@ -1170,6 +1226,7 @@ class Scheduler:
                 if er.ctx.is_stopped:
                     if er in self.prefilling:
                         self.prefilling.remove(er)
+                    self._sp_drop(er)
                     self._finish(er, FinishReason.CANCELLED)
 
             # remote prefill completions / cancellations / timeouts
@@ -1209,9 +1266,18 @@ class Scheduler:
             # (pull_hold_until) are skipped, not admitted to recompute
             # what the pull is about to install; everyone else keeps
             # FIFO order.
+            # both ladders honor the prefill-batch cap: SP-routed
+            # admissions pre-allocate their WHOLE prompt's blocks while
+            # the single-owner ladder serves one prompt at a time, so an
+            # unbounded sp_queue would pin the block pool idle and
+            # preempt-thrash live decode streams — oversize backlogs
+            # wait block-free in `waiting`, exactly like the dense path
             while (self.waiting
                    and not self.draining
                    and len(self.prefilling) < self.config.max_prefill_batch
+                   and (len(self.sp_queue)
+                        + (1 if self.sp_active is not None else 0)
+                        < self.config.max_prefill_batch)
                    and self._free_slot() is not None):
                 now_h = time.monotonic()
                 er = next((e for e in self.waiting
@@ -1248,6 +1314,22 @@ class Scheduler:
                 )
                 progressed = True
 
+            # sequence-parallel long-context ladder: one mesh-wide chunk
+            # per pass (dispatch-only until the final chunk), so decode
+            # ITL stays bounded while a 128k prompt prefills across the
+            # slice
+            if self.sp_active is not None or self.sp_queue:
+                t_sp = time.monotonic()
+                self._host_sync_s = 0.0
+                self._last_burst_done_t = None
+                if await self._sp_advance(loop):
+                    self._phase_hist.observe(
+                        max(0.0,
+                            time.monotonic() - t_sp - self._host_sync_s),
+                        phase="prefill",
+                    )
+                    progressed = True
+
             # decode every active slot: one token, or a fused K-step
             # burst (multi_step_decode) when nothing is waiting on the
             # runner — prefill work pins K to 1 so chunked-prefill
@@ -1255,12 +1337,15 @@ class Scheduler:
             active = [
                 s for s in self.slots
                 if s is not None and s not in self.prefilling
+                and not self._is_sp(s)
             ]
             if active:
                 t_dec = time.monotonic()
                 self._host_sync_s = 0.0
                 runner_idle = not (self.prefilling or self.waiting
-                                   or self.pending_remote)
+                                   or self.pending_remote
+                                   or self.sp_active is not None
+                                   or self.sp_queue)
                 speculating = (
                     self.config.spec_ngram_tokens > 0
                     or self.draft is not None
@@ -2500,17 +2585,36 @@ class Scheduler:
             # engine's guided mask — constrained requests (choice trie
             # OR json grammar) prefill locally
             return False
+        # the long-prefill admission class (docs/long_context.md): in
+        # disagg mode, prompts past the threshold PREFER the prefill
+        # pool regardless of the router's length/queue heuristics — the
+        # pool's workers run the SP chunk ladder, and a 128k prompt on
+        # this engine's dense ladder would head-of-line-block decode far
+        # longer than any queue wait (the in-flight cap in _run still
+        # bounds the submit count). Engines with their own SP mesh keep
+        # the router's verdict: the local ladder is just as parallel.
+        force_long = (
+            self.config.long_prefill_threshold_tokens > 0
+            and not getattr(self.runner, "sp_ready", False)
+            and len(er.prompt) >= self.config.long_prefill_threshold_tokens
+        )
         # cheap pre-check before the (hash-the-whole-prompt) prefix probe:
         # a larger prefix hit can only make the uncached suffix smaller,
         # so a prompt that doesn't qualify with hit=0 never qualifies —
         # and this loop runs for EVERY waiting request EVERY pass
-        if not self.disagg.decide(len(er.prompt), 0):
+        if not force_long and not self.disagg.decide(len(er.prompt), 0):
             return False
         probe = self.allocator.probe_prefix(er.prompt)
         # host-tier blocks count as hit: restoring them locally is far
         # cheaper than a remote prefill round-trip
         prefix_hit = self.allocator.cached_tokens(probe)
-        if not self.disagg.decide(len(er.prompt), prefix_hit):
+        # a big local prefix hit can shrink the suffix back under the
+        # threshold — then the class no longer applies
+        if force_long and len(er.prompt) - prefix_hit < \
+                self.config.long_prefill_threshold_tokens:
+            force_long = False
+        if not force_long and not self.disagg.decide(len(er.prompt),
+                                                     prefix_hit):
             # rejected on the hit term. NOT permanent: cached prefixes can
             # be evicted and the router threshold is live-tunable — back
             # off instead, so the (whole-prompt) probe doesn't re-run on
@@ -2714,7 +2818,234 @@ class Scheduler:
                 self._guided_mask(er) if er.guided is not None else None
             ),
         )
-        self.prefilling.append(er)
+        if self._sp_eligible(er):
+            # long-context admission class: the whole mesh prefills this
+            # one prompt, a sequence-sharded chunk per pass
+            self.sp_queue.append(er)
+        else:
+            self.prefilling.append(er)
+
+    # ---------- sequence-parallel long-context prefill ----------
+
+    def _sp_eligible(self, er: EngineRequest) -> bool:
+        """Route this admission to the sequence-parallel ladder?
+
+        The SP program exists (sp_size > 1, supported trunk), the
+        uncached suffix crosses the admission threshold, and nothing in
+        the request needs the dense ladder's full-S head (prompt
+        logprobs) or a mirrored draft cache (the draft has no SP
+        program — its chunk replay would go stale)."""
+        cfg = self.config
+        if not (getattr(self.runner, "sp_ready", False)
+                and cfg.long_prefill_threshold_tokens > 0):
+            return False
+        suffix = len(er.prefill_tokens) - er.num_cached
+        if suffix < cfg.long_prefill_threshold_tokens:
+            return False
+        if er.want_prompt_lps and not er.prompt_lps_emitted:
+            return False
+        return self.draft is None
+
+    def _is_sp(self, er: EngineRequest) -> bool:
+        return (self.sp_active is not None and self.sp_active.er is er) \
+            or er in self.sp_queue
+
+    def _sp_drop(self, er: EngineRequest) -> None:
+        """Remove a cancelled/finished request from the SP ladder. Any
+        already-dispatched chunk work is pure over-compute into the
+        request's own blocks — freed with the request, nothing leaks."""
+        if self.sp_active is not None and self.sp_active.er is er:
+            self.sp_active = None
+        if er in self.sp_queue:
+            self.sp_queue.remove(er)
+
+    async def _sp_advance(self, loop) -> bool:
+        """One pass of the SP ladder: dispatch the active request's next
+        mesh-wide chunk (dispatch-only — the device runs ahead while the
+        loop serves decode), register the previously completed chunk's
+        blocks into the prefix cache, and on the final chunk run the
+        early decode handoff + drain."""
+        st = self.sp_active
+        while st is None and self.sp_queue:
+            er = self.sp_queue.pop(0)
+            if er.finish is not None or er.ctx.is_stopped:
+                continue
+            st = self.sp_active = _SpPrefill(er=er, t0=time.monotonic())
+        if st is None:
+            return False
+        er = st.er
+        if er.finish is not None or er.ctx.is_stopped:
+            self.sp_active = None
+            if er.finish is None:
+                self._finish(er, FinishReason.CANCELLED)
+            return True
+        total = len(er.prefill_tokens)
+        start = er.prefill_pos
+        end = min(start + self.runner.sp_chunk_tokens, total)
+        final = end >= total
+        t_disp = time.monotonic()
+        outs = self.runner.sp_prefill_chunk(
+            er.prefill_tokens[:end], start, er.block_ids,
+            temperature=er.temperature, top_k=er.top_k, top_p=er.top_p,
+            min_p=er.min_p, presence_penalty=er.presence_penalty,
+            frequency_penalty=er.frequency_penalty,
+            repetition_penalty=er.repetition_penalty,
+            seed_keys=er.base_key, counters=er.generated,
+            sample_slot=er.slot, commit=final,
+            want_top=final and er.logprobs_n > 0,
+        )
+        self.steps += 1
+        st.chunks += 1
+        self._sp_chunks_c.inc()
+        self._sp_tokens_c.inc(end - start)
+        er.prefill_pos = end
+        er.context_len = end
+        # chunk-commit seam: the chunk's blocks become matchable (and KV
+        # events publish, feeding fabric ownership) as soon as the write
+        # is SCHEDULED — device dispatch order guarantees it lands
+        # before any later program reads it, the same contract the dense
+        # ladder and the disagg streamed transfer rely on
+        self._register_completed_blocks(er)
+        self.flight.record(
+            "scheduler.sp_chunk", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, start=start, end=end, final=final,
+            chunk=st.chunks,
+        )
+        if not final:
+            return True
+        st.final_dispatch_t = t_disp
+        try:
+            await self._sp_finish(loop, st, outs)
+        finally:
+            self.sp_active = None
+        return True
+
+    async def _sp_finish(self, loop, st: _SpPrefill, outs) -> None:
+        """Early decode handoff + drain for a finished SP ladder.
+
+        The final chunk's sampled token is still device-resident; when
+        the request can take a plain decode burst, dispatch one
+        IMMEDIATELY with that token composed into the batch row on
+        device — the first decode burst is then executing before any
+        host sync of the prefill outputs happens (the overlap the tests
+        pin). One executor sync drains both; emission runs the exact
+        dense-path discipline (tokens past a finish are discarded with
+        the request's own blocks)."""
+        er = st.er
+        cfg = self.config
+        next_tokens, lps, top_vals, top_ids = outs
+        hs = self._host
+        b = cfg.max_batch_size
+        bs = cfg.kv_block_size
+        ctx0 = er.context_len  # the first sampled token's position
+        k_steps = cfg.multi_step_decode
+        burst = None
+        can_burst = (
+            self.runner._burst is not None
+            and er.guided is None
+            and er.max_new > 1
+            and ctx0 + k_steps + 1 <= cfg.max_model_len
+            and all(self._ensure_block_for(er, ctx0 + j)
+                    for j in range(k_steps))
+        )
+        # allocator contract (same as every dense dispatch site): any
+        # host-offload gathers the block growth above deferred must
+        # materialize BEFORE the burst overwrites the evicted slots
+        self.allocator.flush_offload()
+        if can_burst:
+            hs.sync_blocks(er)
+            w = cfg.kv_width_bucket(len(er.block_ids))
+            btab = hs.btab[:, :w].copy()
+            import jax.numpy as jnp
+            tok0 = jnp.zeros(b, jnp.int32).at[er.slot].set(next_tokens[0])
+            pos0 = np.zeros(b, np.int32)
+            pos0[er.slot] = ctx0
+            ctrs = np.zeros(b, np.int32)
+            ctrs[er.slot] = er.generated + 1  # after the prefill token
+            commit = np.zeros(b, bool)
+            commit[er.slot] = True
+            t_burst = time.monotonic()
+            burst = self.runner.decode_burst(
+                tok0, pos0, btab, hs.temp, hs.top_k, hs.top_p,
+                min_p=hs.min_p, presence_penalty=hs.pres,
+                frequency_penalty=hs.freq, repetition_penalty=hs.rep,
+                seed_keys=hs.keys, counters=ctrs, commit=commit,
+                want_top=er.logprobs_n > 0,
+            )
+            self.steps += 1
+            self._sp_exposed_h.observe(t_burst - st.final_dispatch_t)
+            self.flight.record(
+                "scheduler.sp_handoff", request_id=er.request_id,
+                trace_id=er.ctx.trace_id, k_steps=k_steps,
+            )
+
+        def _sync():
+            out = [np.asarray(next_tokens), np.asarray(lps),
+                   np.asarray(top_vals), np.asarray(top_ids)]
+            if burst is not None:
+                out.extend(np.asarray(x) for x in burst)
+            return out
+
+        t_sync = time.monotonic()
+        synced = await loop.run_in_executor(None, _sync)
+        t_done = time.monotonic()
+        self._observe_host_sync(t_done - t_sync)
+        if burst is None:
+            self._sp_exposed_h.observe(t_done - st.final_dispatch_t)
+        if self.device_time is not None:
+            self.device_time.observe(
+                "prefill_sp", "prefill", st.final_dispatch_t, t_done,
+                read_bytes=self.device_time.sp_prefill_read_bytes(
+                    st.chunks, er.context_len,
+                ),
+            )
+            if burst is not None:
+                self.device_time.observe(
+                    "decode_burst", "decode", t_burst, t_done,
+                    read_bytes=self.device_time.decode_read_bytes(
+                        k_steps, er.context_len,
+                    ),
+                    tokens=k_steps,
+                )
+        self.flight.record(
+            "scheduler.sp_drain", request_id=er.request_id,
+            trace_id=er.ctx.trace_id, chunks=st.chunks,
+            handoff=burst is not None,
+        )
+        toks_pf, lps_pf, tv_pf, ti_pf = synced[:4]
+        er.ctx.add_stage("prefill")
+        token = int(toks_pf[0])
+        er.pending_token = token
+        er.generated += 1
+        er.ring_tail.append(token)
+        er.finish = self._check_finish(er, token)
+        self._guided_after_token(er)
+        self._emit(
+            er, token,
+            float(lps_pf[0]) if er.want_logprobs else None,
+            self._top_row(er, tv_pf, ti_pf, 0),
+        )
+        if er.finish is not None:
+            # trailing burst tokens (if any) are pure over-decode into
+            # the request's own blocks — freed with the request
+            self._finish(er, er.finish, emit=False)
+            return
+        if burst is None:
+            return
+        toks_b, lps_b, tv_b, ti_b = synced[4:]
+        for j in range(k_steps):
+            if er.finish is not None or er.ctx.is_stopped:
+                break
+            tok_j = int(toks_b[j, er.slot])
+            self._advance_row(er, tok_j)
+            self._guided_after_token(er)
+            self._emit(
+                er, tok_j,
+                float(lps_b[j, er.slot]) if er.want_logprobs else None,
+                self._top_row(er, tv_b[j], ti_b[j], er.slot),
+            )
+            if er.finish is not None:
+                self._finish(er, er.finish, emit=False)
 
     async def _prefill_chunk(self, loop, ers: List[EngineRequest]) -> None:
         """ONE batched prefill step: every prefilling request advances a

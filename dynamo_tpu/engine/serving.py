@@ -69,6 +69,10 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         ep_size=getattr(flags, "expert_parallel_size", 1),
         dp_size=getattr(flags, "data_parallel_size", 1),
         pp_size=getattr(flags, "pipeline_parallel_size", 1),
+        # sequence-parallel long-context prefill (docs/long_context.md)
+        sp_size=getattr(flags, "sequence_parallel_size", 1) or 1,
+        long_prefill_threshold_tokens=getattr(
+            flags, "long_prefill_threshold_tokens", 0) or 0,
         host_kv_blocks=getattr(flags, "host_kv_blocks", 0) or 0,
         num_kv_blocks=getattr(flags, "num_kv_blocks", None) or 2048,
         multi_step_decode=getattr(flags, "multi_step_decode", 1) or 1,
@@ -518,6 +522,21 @@ class JaxServingEngine(AsyncEngine):
             self._json_grammars.pop(key)
             self._json_grammars[key] = grammar  # LRU touch
         return JsonConstraint(grammar)
+
+    @property
+    def embed_ready(self) -> bool:
+        return getattr(self.runner, "embed_ready", False)
+
+    async def embed(self, prompts):
+        """Batched prefill-only embeddings (the /v1/embeddings engine
+        half): [n] token-id lists → [n, D] float32. The cacheless embed
+        program reads params only — no donated buffers — so the device
+        round trip can ride an executor thread beside the scheduler
+        loop's own dispatches."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self.runner.embed_prompts, prompts
+        )
 
     def metrics(self) -> dict:
         return self.scheduler.metrics()

@@ -172,6 +172,7 @@ class HttpService:
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
+        self.app.router.add_post("/v1/embeddings", self.handle_embeddings)
         self.app.router.add_get("/v1/models", self.handle_models)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/health", self.handle_health)
@@ -571,6 +572,72 @@ class HttpService:
             CompletionResponse, aggregate_completion_stream,
             kind="completions",
         )
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """POST /v1/embeddings — the prefill-only workload riding the
+        batched-prefill path (llm/embeddings.py): OpenAI-shaped request
+        (input: str | [str] | [ids] | [[ids]]) and response (data rows
+        + usage counts). Served when the resolved engine carries an
+        ``embedder``; engines without one (echo chat, remote pools whose
+        frontend sits on the decode tier) answer 501 with a routing
+        hint."""
+        import base64 as _b64
+
+        from ..llm.embeddings import EmbeddingError
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return self._error(400, f"invalid request: {e}")
+        if not isinstance(body, dict):
+            return self._error(400, "request body must be a JSON object")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            return self._error(400, "missing model")
+        if "input" not in body:
+            return self._error(400, "missing input")
+        fmt = body.get("encoding_format", "float")
+        if fmt not in ("float", "base64"):
+            return self._error(
+                400, "encoding_format must be 'float' or 'base64'")
+        tenant = self._resolve_tenant(request)
+        name = self.manager.resolve(model, tenant)
+        if name is None:
+            return self._model_not_found(model)
+        engine = (self.manager.chat_engines.get(name)
+                  or self.manager.completion_engines.get(name))
+        embedder = getattr(engine, "embedder", None)
+        if embedder is None:
+            return self._error(
+                501,
+                f"model '{model}' does not serve embeddings on this "
+                "frontend (embeddings ride the prefill path — route to "
+                "a prefill-pool frontend; docs/long_context.md)",
+                err_type="not_implemented",
+            )
+        try:
+            vectors, ntok = await embedder.embed(body["input"])
+        except EmbeddingError as e:
+            return self._error(400, str(e))
+        data = []
+        for i, vec in enumerate(vectors):
+            if fmt == "base64":
+                import numpy as _np
+
+                emb = _b64.b64encode(
+                    _np.asarray(vec, _np.float32).tobytes()
+                ).decode("ascii")
+            else:
+                emb = vec
+            data.append(
+                {"object": "embedding", "index": i, "embedding": emb}
+            )
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": model,
+            "usage": {"prompt_tokens": ntok, "total_tokens": ntok},
+        })
 
     async def handle_models(self, request: web.Request) -> web.Response:
         """GET /v1/models — card-enriched (family, context length,

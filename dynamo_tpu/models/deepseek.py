@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
 from ..ops.attention import lane_pad, scatter_kv_stacked
+from ..ops.compat import shard_map
 from .llama import (
     _swiglu_mlp,
     apply_rope,
@@ -261,7 +262,7 @@ def mla_attention(
         li_arr = jnp.asarray(li, jnp.int32)
         if mesh is not None and mesh.size > 1:
             dp = "dp" if q_lat.shape[0] % mesh.shape.get("dp", 1) == 0 else None
-            fn = jax.shard_map(
+            fn = shard_map(
                 fn,
                 mesh=mesh,
                 in_specs=(

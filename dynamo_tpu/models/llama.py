@@ -334,6 +334,33 @@ def gather_kv_writes(k, v, slot_mapping, axis):
     )
 
 
+def qkv_prologue(cfg, x, layer_params, b, s, positions, seq_basis):
+    """The per-layer QKV head: projections (+ Qwen2 biases), head
+    reshape, Qwen3 per-head norms, RoPE. ONE implementation shared by
+    the dense paged path, the sequence-parallel chunk path, and the
+    cacheless embeddings trunk — the SP path's bit-identical-KV
+    contract depends on these never drifting."""
+    h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = dense(x, layer_params["wq"])
+    k = dense(x, layer_params["wk"])
+    v = dense(x, layer_params["wv"])
+    if "bq" in layer_params:  # Qwen2-family qkv biases, pre-rope
+        q = q + layer_params["bq"]
+        k = k + layer_params["bk"]
+        v = v + layer_params["bv"]
+    q = q.reshape(b, s, h_heads, hd)
+    k = k.reshape(b, s, kvh, hd)
+    v = v.reshape(b, s, kvh, hd)
+    if "q_norm" in layer_params:  # Qwen3-family per-head norms, pre-rope
+        q = rms_norm(q, layer_params["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer_params["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling,
+                   seq_basis=seq_basis)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling,
+                   seq_basis=seq_basis)
+    return q, k, v
+
+
 def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
                      context_lens, mesh, kv_gather_axis=None,
                      layer_offset=0, tp_axis=None):
@@ -354,26 +381,11 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     Gemma-2's window alternation is the consumer."""
     del layer_offset  # no global-layer-index semantics in this family
     del tp_axis  # qkv biases are tp-sharded; no replicated additive terms
-    h_heads, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    h_heads, hd = cfg.num_heads, cfg.head_dim
 
     def attn_fn(x, layer_params, k_all, v_all, li):
-        q = dense(x, layer_params["wq"])
-        k = dense(x, layer_params["wk"])
-        v = dense(x, layer_params["wv"])
-        if "bq" in layer_params:  # Qwen2-family qkv biases, pre-rope
-            q = q + layer_params["bq"]
-            k = k + layer_params["bk"]
-            v = v + layer_params["bv"]
-        q = q.reshape(b, s, h_heads, hd)
-        k = k.reshape(b, s, kvh, hd)
-        v = v.reshape(b, s, kvh, hd)
-        if "q_norm" in layer_params:  # Qwen3-family per-head norms, pre-rope
-            q = rms_norm(q, layer_params["q_norm"], cfg.rms_norm_eps)
-            k = rms_norm(k, layer_params["k_norm"], cfg.rms_norm_eps)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling,
-                       seq_basis=context_lens)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling,
-                       seq_basis=context_lens)
+        q, k, v = qkv_prologue(cfg, x, layer_params, b, s, positions,
+                               context_lens)
 
         # in-place scatter into the stacked cache + layer-indexed kernels:
         # no per-layer cache slice is ever materialized inside the scan
@@ -394,6 +406,71 @@ def make_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         return delta, k_all, v_all
 
     return attn_fn
+
+
+def make_sp_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
+                        context_lens, chunk_start, mesh, sp_axis="sp",
+                        head_axis=None):
+    """Sequence-parallel sibling of make_gqa_attn_fn for long-context
+    prefill (parallel/sequence.py): the chunk's tokens are sharded over
+    the mesh's ``sp_axis``; QKV projections / RoPE / MLP are position-
+    local and partition for free, attention runs as one ring pass over
+    the chunk's fresh K/V plus the gathered committed prefix, and the
+    fresh K/V scatter into the paged cache exactly as the dense path
+    does (GSPMD collects the sequence shards at the scatter). B is 1 by
+    construction — one oversized prompt owns the whole mesh."""
+    from ..parallel.sequence import sp_chunk_attention
+
+    h_heads, hd = cfg.num_heads, cfg.head_dim
+
+    def attn_fn(x, layer_params, k_all, v_all, li):
+        q, k, v = qkv_prologue(cfg, x, layer_params, b, s, positions,
+                               context_lens)
+        # the prefix gather reads the INCOMING cache (pre-scatter): the
+        # chunk's own positions are masked there anyway, and gathering
+        # before the scatter lets XLA overlap the two instead of
+        # serializing on the donated buffer
+        attn = sp_chunk_attention(
+            q, k, v, k_all, v_all, block_tables, chunk_start,
+            context_lens[0], li, mesh, axis=sp_axis, head_axis=head_axis,
+        )
+        k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
+        delta = dense(attn.reshape(b, s, h_heads * hd), layer_params["wo"])
+        return delta, k_all, v_all
+
+    return attn_fn
+
+
+def sp_decoder_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [1, S] one chunk, S sharded over sp
+    positions: jax.Array,     # [1, S] absolute positions (pad → repeat last)
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [1, W]
+    slot_mapping: jax.Array,  # [1, S] flat cache slot per token; -1 drops
+    context_lens: jax.Array,  # [1] valid tokens incl. this chunk
+    chunk_start,              # traced scalar: chunk's first absolute position
+    mesh,
+    sp_axis: str = "sp",
+    head_axis=None,
+    mlp_fn=_swiglu_mlp,
+) -> Tuple[jax.Array, KVCache]:
+    """One sequence-parallel prefill chunk through the GQA trunk.
+
+    Returns (pre-final-norm hidden [1, S, D], updated kv_cache) — the
+    engine samples from the last valid position via logits_from_hidden,
+    exactly like the dense step program's return_hidden path."""
+    b, s = tokens.shape
+    hidden = params["embed"][tokens]
+    attn_fn = make_sp_gqa_attn_fn(
+        cfg, b, s, positions, slot_mapping, block_tables, context_lens,
+        chunk_start, mesh, sp_axis=sp_axis, head_axis=head_axis,
+    )
+    hidden, kv_cache, _ = run_layers(
+        hidden, kv_cache, params["layers"], cfg, attn_fn, mlp_fn
+    )
+    return hidden, kv_cache
 
 
 def run_layers(
@@ -476,6 +553,41 @@ def decoder_forward(
     if return_hidden:
         return hidden, kv_cache
     return lm_logits(hidden, params, cfg), kv_cache
+
+
+def embed_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,      # [R, S] right-padded prompt rows
+    positions: jax.Array,   # [R, S] (pad → repeat last)
+    valid_lens: jax.Array,  # [R] real tokens per row
+) -> jax.Array:
+    """Prefill-only trunk for the embeddings workload: dense causal
+    self-attention with NO cache reads or writes (the whole context is
+    the prompt; nothing decodes afterwards, so paged-KV state would be
+    pure waste), final norm, and the LAST valid position's hidden state
+    as the sequence embedding — the standard decoder-LM pooling. The
+    engine L2-normalizes at the edge. Returns [R, D] float32."""
+    from ..ops.attention import prefill_attention
+
+    b, s = tokens.shape
+    h_heads, hd = cfg.num_heads, cfg.head_dim
+    hidden = params["embed"][tokens]
+
+    def attn_fn(x, layer_params, k_all, v_all, li):
+        q, k, v = qkv_prologue(cfg, x, layer_params, b, s, positions,
+                               valid_lens)
+        attn = prefill_attention(q, k, v, valid_lens)
+        delta = dense(attn.reshape(b, s, h_heads * hd), layer_params["wo"])
+        return delta, k_all, v_all
+
+    dummy = jnp.zeros((), jnp.float32)
+    hidden, _, _ = run_layers(
+        hidden, (dummy, dummy), params["layers"], cfg, attn_fn, _swiglu_mlp
+    )
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    rows = jnp.arange(b)
+    return hidden[rows, valid_lens - 1].astype(jnp.float32)
 
 
 def forward(

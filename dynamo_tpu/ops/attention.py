@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .compat import shard_map
+
 LANE = 128  # TPU vector lane width — HBM layouts tile the minor dim to this
 
 
@@ -339,7 +341,7 @@ def attention(
         in_specs.extend([P(dp), P(), P()])     # context_lens, layer_idx, win
         if has_sinks:
             in_specs.append(P("tp"))           # sinks follow the head shard
-        call = jax.shard_map(
+        call = shard_map(
             call,
             mesh=mesh,
             in_specs=tuple(in_specs),

@@ -1,0 +1,45 @@
+"""jax version shims for the mesh-dependent import seams.
+
+``jax.shard_map`` graduated from ``jax.experimental.shard_map`` (and its
+``check_rep`` knob was renamed ``check_vma``) across the jax versions
+this repo must run on. Importing ``jax.shard_map`` at module scope made
+every mesh-dependent module — parallel/, the MLA decode dispatch, the
+ICI transfer plane — fail at COLLECTION on older builds, which is how
+the long-standing tier-1 ``AttributeError: module 'jax' has no
+attribute 'shard_map'`` class was born. This module is the one seam
+(mirroring ops/pallas_decode.py's ``_out_struct``/``_compiler_params``
+shims for the Pallas API drift): resolve once, translate the kwarg, and
+every caller imports ``shard_map`` from here.
+
+Lives under ops/ (whose package __init__ is empty) rather than
+parallel/ so ops/attention.py can import it without the
+ops → parallel → pipeline → models → ops cycle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:  # pre-axis_size builds: the classic psum(1) idiom (constant-folded)
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-graduation builds: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            # functools.partial(shard_map, mesh=..., ...) decorator form
+            return functools.partial(shard_map, **kwargs)
+        return _legacy_shard_map(f, **kwargs)

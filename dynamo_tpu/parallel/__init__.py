@@ -9,7 +9,7 @@ pipeline.py for the collective GPipe schedule over the pp axis.
 from .mesh import AXES, MultiHostConfig, initialize_multihost, make_mesh, mesh_shape
 from .pipeline import pipeline_forward, stage_cache, stage_params, unstage_cache
 from .ring_attention import dense_reference, ring_attention, ulysses_attention
-from .sequence import choose_strategy, sp_prefill_attention
+from .sequence import choose_strategy, sp_chunk_attention, sp_prefill_attention
 
 __all__ = [
     "pipeline_forward",
@@ -25,5 +25,6 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "choose_strategy",
+    "sp_chunk_attention",
     "sp_prefill_attention",
 ]

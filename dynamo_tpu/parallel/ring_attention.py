@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from ..ops.compat import shard_map
 
 _NEG = -0.5 * jnp.finfo(jnp.float32).max
 
@@ -86,23 +86,28 @@ def _ring_kernel(q, k, v, q_pos, k_pos, *, axis: str, scale: float):
 
 
 def ring_attention(
-    q: jax.Array,       # [B, S, H, D]
-    k: jax.Array,       # [B, S, KVH, D]
-    v: jax.Array,       # [B, S, KVH, D]
-    q_positions: jax.Array,   # [B, S] global positions (-1 = pad)
-    kv_positions: jax.Array,  # [B, S]
+    q: jax.Array,       # [B, Sq, H, D]
+    k: jax.Array,       # [B, Sk, KVH, D]
+    v: jax.Array,       # [B, Sk, KVH, D]
+    q_positions: jax.Array,   # [B, Sq] global positions (-1 = pad)
+    kv_positions: jax.Array,  # [B, Sk]
     mesh: Mesh,
     axis: str = "sp",
     scale: Optional[float] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Causal GQA attention with the sequence dim sharded over ``axis``.
 
-    S must be divisible by the axis size. Returns [B, S, H, D] sharded the
-    same way as q.
+    Sq and Sk must each be divisible by the axis size (they need not be
+    equal: the serving chunk path concatenates the chunk's fresh K/V
+    with the gathered committed prefix, so Sk > Sq). ``head_axis``
+    optionally shards the head dim too (tensor parallelism composes:
+    heads over tp, sequence over sp — the ring rotates within each tp
+    shard's heads). Returns [B, Sq, H, D] sharded the same way as q.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    seq = P(None, axis, None, None)
+    seq = P(None, axis, head_axis, None)
     pos = P(None, axis)
     kernel = functools.partial(_ring_kernel, axis=axis, scale=scale)
     return shard_map(

@@ -14,7 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ring_attention import ring_attention, ulysses_attention
 
@@ -65,3 +65,82 @@ def sp_prefill_attention(
     else:
         raise ValueError(f"unknown sp strategy {strategy!r}; use auto|ring|ulysses")
     return out[:, :s]
+
+
+def sp_chunk_attention(
+    q: jax.Array,            # [1, S, H, D] post-RoPE chunk queries
+    k: jax.Array,            # [1, S, KVH, D] the chunk's fresh keys
+    v: jax.Array,            # [1, S, KVH, D]
+    k_cache: jax.Array,      # [L, N, bs, KVH, Dpad] stacked paged cache
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [1, W] this sequence's block ids
+    chunk_start,             # traced scalar: first absolute position
+    context_len,             # traced scalar: chunk end (valid tokens incl.)
+    layer_idx,               # traced scalar: layer into the stacked cache
+    mesh: Mesh,
+    axis: str = "sp",
+    head_axis: Optional[str] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention for ONE sequence-sharded prefill chunk of a long prompt.
+
+    The serving half of sequence parallelism (engine/model_runner.py
+    ``prefill_sp``): the chunk's queries and fresh K/V are sharded over
+    the mesh's ``axis``; earlier chunks' KV already live in the paged
+    cache. Both sources fold into ONE ring pass — the committed prefix
+    is gathered from the cache for this layer, sharded over the same
+    axis (per-device key memory stays O((S + W·bs)/sp)), concatenated
+    behind the chunk's K/V, and rotated around the ring with global
+    position ids doing all masking:
+
+    - chunk keys carry their global positions (causal intra-chunk),
+    - prefix keys carry positions ``< chunk_start`` (everything the
+      chunk may attend), later cache slots masked to -1 — so the
+      chunk's own just-scattered slots are never double-counted, and a
+      prefix-cache hit's reused blocks are covered for free.
+
+    Ring (not Ulysses) deliberately: arbitrary head counts, and the
+    rotation overlaps the interconnect with compute at exactly the long
+    sequence lengths this path exists for.
+    """
+    b, s, _h, d = q.shape
+    l, n_blocks = k_cache.shape[:2]
+    # layer indexing through the gather (ops/attention.py idiom): block
+    # n of layer li is flat row li*N + n — no full-layer copy
+    kc = k_cache.reshape((l * n_blocks,) + k_cache.shape[2:])
+    vc = v_cache.reshape((l * n_blocks,) + v_cache.shape[2:])
+    rows = block_tables + layer_idx * n_blocks               # [1, W]
+    w = block_tables.shape[1]
+    bs_sz = k_cache.shape[2]
+    pk = kc[rows].reshape(b, w * bs_sz, kc.shape[-2], kc.shape[-1])
+    pv = vc[rows].reshape(b, w * bs_sz, vc.shape[-2], vc.shape[-1])
+    # slice lane padding away and upcast fp8 storage to the compute dtype
+    pk = pk[..., :d].astype(q.dtype)
+    pv = pv[..., :d].astype(q.dtype)
+    # distribute the gathered prefix over the sequence axis BEFORE the
+    # ring, so no device ever holds the whole context
+    kv_spec = NamedSharding(mesh, P(None, axis, head_axis, None))
+    pk = jax.lax.with_sharding_constraint(pk, kv_spec)
+    pv = jax.lax.with_sharding_constraint(pv, kv_spec)
+
+    idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    take = context_len - chunk_start
+    qpos = jnp.where(idx < take, chunk_start + idx, -1)      # [1, S]
+    cpos = jnp.arange(w * bs_sz, dtype=jnp.int32)[None, :]
+    # prefix keys: strictly before the chunk (committed KV only); the
+    # chunk's own slots and any pad/garbage blocks mask to -1
+    ppos = jnp.where(cpos < chunk_start, cpos, -1)
+
+    kk = jnp.concatenate([k, pk], axis=1)
+    vv = jnp.concatenate([v, pv], axis=1)
+    kpos = jnp.concatenate([qpos, ppos], axis=1)
+    sp = mesh.shape[axis]
+    if (s % sp) or (kk.shape[1] % sp):
+        raise ValueError(
+            f"sp chunk shapes must divide the {axis!r} axis: "
+            f"S={s}, S+W*bs={kk.shape[1]}, sp={sp}"
+        )
+    return ring_attention(
+        q, kk, vv, qpos, kpos, mesh, axis=axis, scale=scale,
+        head_axis=head_axis,
+    )

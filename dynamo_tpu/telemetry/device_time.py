@@ -97,6 +97,10 @@ class DeviceTimeTracker:
         self.bubble_s: dict = {}    # phase → lifetime bubble seconds
         self.decode_bytes = 0.0     # lifetime decode HBM-read bytes
         self.decode_tokens = 0
+        # lifetime byte-carrying prefill observations (the SP ladder's
+        # byte model) — folded into the roofline beside decode bytes
+        self.prefill_bytes = 0.0
+        self.prefill_byte_busy_s = 0.0
         self.observations = 0
 
         # private registry by default; the scheduler attaches it so the
@@ -134,6 +138,19 @@ class DeviceTimeTracker:
             self.param_bytes + context_tokens * self.kv_bytes_per_token
         )
 
+    def sp_prefill_read_bytes(self, chunks: int,
+                              context_tokens: int) -> float:
+        """HBM bytes one sequence-parallel prefill LADDER must stream
+        (the scheduler observes the whole ladder at its single drain
+        seam, whose busy window covers every queued chunk): the weights
+        once per chunk, each chunk's gathered committed prefix
+        (triangular sum ≈ ctx·(chunks−1)/2 tokens), and the full
+        context's KV written once."""
+        return float(chunks) * self.param_bytes + (
+            self.kv_bytes_per_token
+            * (context_tokens * max(0, chunks - 1) / 2.0 + context_tokens)
+        )
+
     def observe(self, program: str, phase: str, dispatch_t: float,
                 ready_t: float, read_bytes: float = 0.0,
                 tokens: int = 0) -> float:
@@ -151,9 +168,20 @@ class DeviceTimeTracker:
         if phase == "decode":
             self.decode_bytes += read_bytes
             self.decode_tokens += tokens
+        elif program == "prefill_sp" and read_bytes:
+            # the SP ladder's modelled bytes feed the roofline beside
+            # decode — real HBM traffic either way. Other prefill
+            # observations stay out even if a caller passes bytes: only
+            # programs with an explicit byte model may shape the gauge.
+            self.prefill_bytes += read_bytes
+            self.prefill_byte_busy_s += busy
         self._time_hist.observe(busy, program=program, phase=phase)
+        byte_sample = (
+            read_bytes
+            if (phase == "decode" or program == "prefill_sp") else 0.0
+        )
         self._window.append((self.clock(), phase, busy, bubble,
-                             read_bytes if phase == "decode" else 0.0))
+                             byte_sample))
         return busy
 
     def idle(self) -> None:
@@ -184,14 +212,21 @@ class DeviceTimeTracker:
     def _roofline(self):
         if not self.peak_bytes_per_s:
             return []
-        samples = [s for s in self._samples() if s[1] == "decode"]
+        # every byte-carrying observation counts: decode steps always
+        # model their reads; prefill observations carry bytes only when
+        # the SP ladder modelled them (dense-ladder prefill stays out —
+        # its bytes are unmodelled, so counting its busy time would
+        # deflate the fraction)
+        samples = [s for s in self._samples()
+                   if s[1] == "decode" or s[4] > 0]
         busy = sum(s[2] for s in samples)
         read = sum(s[4] for s in samples)
         if busy <= 0 or read <= 0:
-            # no decode inside the window: fall back to lifetime totals
+            # nothing inside the window: fall back to lifetime totals
             # so a scrape just after a burst of traffic isn't blind
-            busy = self.busy_s.get("decode", 0.0)
-            read = self.decode_bytes
+            busy = (self.busy_s.get("decode", 0.0)
+                    + self.prefill_byte_busy_s)
+            read = self.decode_bytes + self.prefill_bytes
         if busy <= 0 or read <= 0:
             return []
         return [({}, (read / busy) / self.peak_bytes_per_s)]

@@ -1312,3 +1312,24 @@ def test_async_blocking_flags_grammar_table_compile_on_loop_shape():
         "async-blocking",
     )
     assert [f.rule for f in out] == ["async-blocking"]
+
+
+@pytest.mark.dynlint
+def test_sp_prefill_modules_pass_jit_impure_and_async_blocking():
+    """The sequence-parallel prefill seam (docs/long_context.md): the
+    SP chunk ladder dispatches on the scheduler loop and must stay
+    dispatch-only (no host syncs outside the executor), and the
+    parallel attention modules trace under jit (no impurity). Pin the
+    whole vertical ZERO-finding, not baseline-covered."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "parallel", "sequence.py"),
+        os.path.join(PACKAGE_ROOT, "parallel", "ring_attention.py"),
+        os.path.join(PACKAGE_ROOT, "ops", "compat.py"),
+        os.path.join(PACKAGE_ROOT, "llm", "embeddings.py"),
+        os.path.join(PACKAGE_ROOT, "engine", "scheduler.py"),
+    ]
+    found = lint_paths(
+        modules, get_rules(["jit-impure", "async-blocking"]))
+    assert found == [], "sp prefill seam regressed:\n" + "\n".join(
+        f.render() for f in found
+    )

@@ -400,3 +400,98 @@ def test_metrics_callback_gauges_render():
     m2 = ServiceMetrics("dynamo")
     m2.register_callback_gauges("dynamo_engine", lambda: 1 / 0)
     assert m2.render()  # endpoint survives a broken engine callback
+
+
+# --------------------------------------------------------------------------
+# /v1/embeddings — the prefill-only workload (llm/embeddings.py)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_embeddings_endpoint_openai_shape():
+    from dynamo_tpu.llm.embeddings import EchoEmbedder
+
+    manager = ModelManager()
+    engine = EchoEngineFull()
+    engine.embedder = EchoEmbedder(dim=8)
+    manager.add_chat_model("echo", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/embeddings"
+            # single string
+            async with s.post(url, json={"model": "echo",
+                                         "input": "hello world"}) as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["object"] == "list"
+                assert body["model"] == "echo"
+                row = body["data"][0]
+                assert row["object"] == "embedding" and row["index"] == 0
+                assert len(row["embedding"]) == 8
+                assert body["usage"]["prompt_tokens"] == 2
+                assert body["usage"]["total_tokens"] == 2
+                first = row["embedding"]
+            # batch of strings: per-row indexes, deterministic vectors
+            async with s.post(url, json={
+                "model": "echo", "input": ["hello world", "other"],
+            }) as r:
+                body = await r.json()
+                assert [d["index"] for d in body["data"]] == [0, 1]
+                assert body["data"][0]["embedding"] == first
+                assert body["data"][1]["embedding"] != first
+            # token-id input shapes
+            async with s.post(url, json={"model": "echo",
+                                         "input": [1, 2, 3]}) as r:
+                body = await r.json()
+                assert r.status == 200
+                assert body["usage"]["prompt_tokens"] == 3
+            async with s.post(url, json={
+                "model": "echo", "input": [[1, 2], [3, 4, 5]],
+            }) as r:
+                body = await r.json()
+                assert len(body["data"]) == 2
+                assert body["usage"]["prompt_tokens"] == 5
+            # base64 encoding round-trips to the float rows
+            async with s.post(url, json={
+                "model": "echo", "input": "hello world",
+                "encoding_format": "base64",
+            }) as r:
+                import base64
+
+                import numpy as np
+
+                body = await r.json()
+                dec = np.frombuffer(
+                    base64.b64decode(body["data"][0]["embedding"]),
+                    np.float32,
+                )
+                assert np.allclose(dec, np.asarray(first, np.float32))
+            # error shapes
+            async with s.post(url, json={"model": "echo"}) as r:
+                assert r.status == 400
+            async with s.post(url, json={"model": "echo",
+                                         "input": {"bad": 1}}) as r:
+                assert r.status == 400
+            async with s.post(url, json={"model": "nope",
+                                         "input": "x"}) as r:
+                assert r.status == 404
+                assert (await r.json())["error"]["code"] == "model_not_found"
+    finally:
+        await service.stop()
+
+
+@pytest.mark.asyncio
+async def test_embeddings_501_without_embedder():
+    service = await start_echo_service()  # plain engine, no embedder
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/embeddings",
+                json={"model": "echo", "input": "x"},
+            ) as r:
+                assert r.status == 501
+                assert "prefill" in (await r.json())["error"]["message"]
+    finally:
+        await service.stop()

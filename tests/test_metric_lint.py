@@ -125,10 +125,16 @@ def test_lint_sees_the_real_instrument_catalog():
         # propose-verify acceptance-length histogram
         "dynamo_engine_sync_fallback_total",
         "dynamo_engine_spec_accept_length",
+        # sequence-parallel long-context prefill (engine/scheduler.py;
+        # docs/long_context.md)
+        "dynamo_engine_prefill_sp_chunks_total",
+        "dynamo_engine_prefill_sp_tokens_total",
+        "dynamo_engine_prefill_sp_axis_depth",
+        "dynamo_engine_prefill_sp_exposed_seconds",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 100
+    assert len(names) >= 104
 
 
 def _metric(name, kind):

@@ -219,3 +219,66 @@ async def test_compute_failure_with_healthy_pump_does_not_wedge():
 def test_disagg_stream_depth_clamped():
     assert _config(disagg_stream_depth=0).disagg_stream_depth == 1
     assert _config(disagg_stream_depth=7).disagg_stream_depth == 2
+
+
+# --------------------------------------------------------------------------
+# sequence-parallel chunk ladder on the worker (docs/long_context.md)
+# --------------------------------------------------------------------------
+
+
+class _FakeSpRunner(_FakeRunner):
+    """SP-capable fake: advertises the SP program and records which
+    ladder each chunk ran through."""
+
+    sp_ready = True
+    sp_chunk_tokens = 16  # mesh-wide chunk = 2x the dense 8-token cap
+
+    def sp_prefill_chunk(self, prompt, start, block_ids, *, commit=False,
+                         want_top=False, **kw):
+        self.events.append(("sp_chunk", start, len(prompt)))
+        return (np.full(1, 7, np.int32), np.zeros(1, np.float32),
+                np.zeros((1, 8), np.float32), np.zeros((1, 8), np.int32))
+
+
+async def _run_sp_worker(threshold, n_tokens=32):
+    events = []
+    config = _config(disagg_stream_depth=2,
+                     long_prefill_threshold_tokens=threshold)
+    drt = DistributedRuntime.in_process(MemoryHub())
+    worker = PrefillWorker(drt, _FakeSpRunner(config, events), config)
+    worker._clients["e1"] = _SlowClient(events)
+    blocks = -(-n_tokens // config.kv_block_size)
+    rpr = RemotePrefillRequest(
+        request_id="r1", engine_id="e1",
+        token_ids=[1 + i % 200 for i in range(n_tokens)],
+        block_ids=list(range(40, 40 + blocks)), num_cached=0, seed=0,
+    )
+    try:
+        await asyncio.wait_for(worker._handle(rpr, _dequeue_ctx(rpr)),
+                               timeout=30)
+    finally:
+        await drt.close()
+    return events
+
+
+@pytest.mark.asyncio
+async def test_worker_long_prompt_takes_the_sp_ladder():
+    """Past the threshold, chunks run through the SP program at its
+    mesh-wide cap; frames still stream between chunks, the commit still
+    comes last."""
+    events = await _run_sp_worker(threshold=24, n_tokens=32)
+    sp = [e for e in events if e[0] == "sp_chunk"]
+    assert [e[1] for e in sp] == [0, 16]        # two 16-token chunks
+    assert not [e for e in events if e[0] == "step"]
+    assert events[-1] == ("commit",)
+    assert [e for e in events if e[0] == "send_start"]
+
+
+@pytest.mark.asyncio
+async def test_worker_short_prompt_keeps_the_dense_ladder():
+    """Below the threshold the dense 8-token ladder runs even though
+    the SP program exists."""
+    events = await _run_sp_worker(threshold=64, n_tokens=32)
+    assert not [e for e in events if e[0] == "sp_chunk"]
+    assert [e[1] for e in events if e[0] == "step"] == [0, 8, 16, 24]
+    assert events[-1] == ("commit",)

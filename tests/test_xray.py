@@ -304,6 +304,24 @@ def test_prefill_busy_never_feeds_the_roofline():
     assert t.busy_s["prefill"] == pytest.approx(1.0)
 
 
+def test_sp_prefill_bytes_feed_the_roofline():
+    """The sequence-parallel ladder's modelled bytes DO shape the gauge
+    (docs/long_context.md) — program-gated, so the plain dense ladder
+    above stays excluded."""
+    t, _ = _tracker()
+    t.observe("prefill_sp", "prefill", 0.0, 1.0, read_bytes=5e9)
+    (labels, frac), = t._roofline()
+    assert labels == {}
+    assert frac == pytest.approx(5e9 / t.peak_bytes_per_s)
+    # byte model sanity: one chunk = weights once + ctx KV written once
+    one = t.sp_prefill_read_bytes(1, 100)
+    assert one == pytest.approx(t.param_bytes
+                                + 100 * t.kv_bytes_per_token)
+    # more chunks add a triangular prefix re-read
+    three = t.sp_prefill_read_bytes(3, 300)
+    assert three > 3 * t.param_bytes + 300 * t.kv_bytes_per_token
+
+
 # --------------------------------------------------------------------------
 # SLO attainment + goodput
 # --------------------------------------------------------------------------
