@@ -717,8 +717,44 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
     return controller, server
 
 
+def _pool_scope_peers(peers: dict, endpoint_records: dict,
+                      model: str = "") -> tuple:
+    """Filter a fabric peer-descriptor map to this worker's model pool.
+
+    Several model pools can share one component (per-model clients and
+    the KV router partition a shared component's instances by the
+    ``model`` metadata on their lease-scoped endpoint records), but the
+    fabric descriptor prefix is component-wide — so without this filter
+    a pull could splice another model's KV blocks into this pool's
+    cache. Peers with no endpoint record yet (descriptor published
+    before the registration landed) or no model metadata (single-pool
+    deployments) are kept: missing metadata is a wildcard, same as the
+    client-side partition rule. Returns ``(scoped, live)`` where
+    ``live`` is every instance id holding an endpoint record — the
+    indexer-prune set, which stays pool-agnostic because liveness is a
+    property of the lease, not the pool.
+    """
+    import msgpack as _msgpack
+
+    pool_of: dict = {}
+    for key, raw in endpoint_records.items():
+        wid = key.rsplit(":", 1)[-1]
+        try:
+            pool_of[wid] = _msgpack.unpackb(raw, raw=False).get("model")
+        except Exception:
+            logger.debug("unreadable endpoint record for %s; treating "
+                         "its pool as wildcard", wid, exc_info=True)
+            pool_of[wid] = None
+    scoped = {
+        wid: desc for wid, desc in peers.items()
+        if not model or pool_of.get(wid) in (None, model)
+    }
+    return scoped, set(pool_of)
+
+
 async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
-                           endpoint=None, instance_id: str = ""):
+                           endpoint=None, instance_id: str = "",
+                           model: str = ""):
     """Cluster-KV-fabric wiring for a token-level worker.
 
     The engine already built its fabric half (Scheduler.fabric — cold
@@ -778,8 +814,6 @@ async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
             wid = d.get("engine_id")
             if wid and wid != fabric.engine_id:
                 peers[wid] = d
-        peer_cache.clear()
-        peer_cache.update(peers)
         # prune dead workers from the ownership view: respawn churn
         # mints a fresh id per incarnation, so without this the indexer
         # accumulates dead workers' hash runs forever (and keeps the
@@ -787,10 +821,13 @@ async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
         # from the lease-scoped ENDPOINT registry (keyed by the same
         # instance id KV events carry), not the pull-server descriptors
         # — workers without a pull server (cold-tier-only, plain
-        # KV-routed) still publish events and still die.
+        # KV-routed) still publish events and still die. The same
+        # records carry pool membership, scoping pulls to this model.
         eps = await drt.discovery.kv_get_prefix(
             endpoint.component.etcd_prefix())
-        live = {k.rsplit(":", 1)[-1] for k in eps}
+        peers, live = _pool_scope_peers(peers, eps, model)
+        peer_cache.clear()
+        peer_cache.update(peers)
         for wid in list(fabric.indexer.worker_ids):
             if wid != fabric.engine_id and wid not in live:
                 fabric.remove_worker(wid)
@@ -1353,7 +1390,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
         # the same instance id the KV event publisher stamps
         fabric = await _setup_kv_fabric(
             flags, core, drt=drt, component=comp, endpoint=endpoint,
-            instance_id=instance_id,
+            instance_id=instance_id, model=model_name,
         )
         recovery = None
         if flags.self_heal:

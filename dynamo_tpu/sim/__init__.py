@@ -1,0 +1,28 @@
+"""Trace-driven fleet simulator.
+
+A discrete-event harness that drives the REAL control plane — SlaPolicy,
+AdmissionController, PoolManager, RecoveryController, KvScheduler — on a
+virtual clock against simulated workers parameterized by the measured
+device-time byte model (telemetry/device_time.py). No decision logic is
+forked or mocked; the sim only substitutes time and the data plane.
+
+Entry points:
+
+- ``scripts/fleetsim.py`` — CLI: scenario -> capacity-curve report
+- :func:`dynamo_tpu.sim.scenarios.run_scenario` — programmatic runs
+- :mod:`dynamo_tpu.sim.workload` — synthetic generators + trace replay
+
+See docs/simulator.md for the scenario vocabulary and report anatomy.
+"""
+
+from dynamo_tpu.sim.clock import VirtualClock, run_virtual
+from dynamo_tpu.sim.scenarios import SCENARIOS, run_scenario
+from dynamo_tpu.sim.workload import Request
+
+__all__ = [
+    "VirtualClock",
+    "run_virtual",
+    "SCENARIOS",
+    "run_scenario",
+    "Request",
+]

@@ -1,0 +1,160 @@
+"""Virtual time for the fleet simulator.
+
+The control plane already takes an injectable ``clock`` everywhere, but
+its waiting primitives are asyncio timers (``asyncio.sleep`` in the pool
+poll loop and respawn backoff, ``asyncio.wait_for`` on the admission
+queue). To run those at 1000x real time without forking any logic, the
+sim installs an event loop whose ``time()`` is a :class:`VirtualClock`
+and whose selector advances that clock by the pending-timer deadline
+whenever no I/O is ready — the textbook discrete-event skip. Real file
+descriptors still work (they are polled with a zero timeout), so the
+loop degrades gracefully if a scenario ever touches sockets.
+
+Nothing in this module (or anywhere under ``sim/``) reads the wall
+clock; determinism tests pin that.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import selectors
+from typing import Any, Awaitable, Callable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+# When the loop blocks with no timers at all (timeout=None) the virtual
+# clock cannot know how far to skip; advance in coarse fixed steps so a
+# stray wait still terminates instead of spinning at +0.
+_IDLE_STEP_S = 1.0
+
+# Hard ceiling on total virtual seconds a single run may advance; a
+# scenario that sleeps past this is wedged, not slow.
+MAX_VIRTUAL_S = 10_000_000.0
+
+
+class VirtualClock:
+    """A monotonically advancing virtual timebase.
+
+    Instances are callables returning virtual seconds, matching the
+    ``clock: Callable[[], float]`` parameter every control-plane class
+    accepts (``SlaPolicy``, ``AdmissionController``, ``PoolManager``,
+    ``KvScheduler``, ``SloTracker``, ``TenantQuotas``...).
+    """
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        if dt > 0.0:
+            self._now += dt
+        if self._now > MAX_VIRTUAL_S:
+            raise RuntimeError(
+                f"virtual clock ran past {MAX_VIRTUAL_S:.0f}s — "
+                "scenario is not terminating"
+            )
+
+
+class _TimeWarpSelector:
+    """Selector wrapper: poll real FDs without blocking, then convert the
+    requested wait into a virtual-clock jump."""
+
+    def __init__(self, clock: VirtualClock) -> None:
+        self._real = selectors.DefaultSelector()
+        self._clock = clock
+        self._spins = 0
+
+    # -- plain delegation -------------------------------------------------
+    def register(self, fileobj: Any, events: int, data: Any = None):
+        return self._real.register(fileobj, events, data)
+
+    def unregister(self, fileobj: Any):
+        return self._real.unregister(fileobj)
+
+    def modify(self, fileobj: Any, events: int, data: Any = None):
+        return self._real.modify(fileobj, events, data)
+
+    def get_key(self, fileobj: Any):
+        return self._real.get_key(fileobj)
+
+    def get_map(self):
+        return self._real.get_map()
+
+    def close(self) -> None:
+        self._real.close()
+
+    # -- the time warp ----------------------------------------------------
+    def select(self, timeout: Optional[float] = None):
+        # Real FDs only matter for signal wakeups and the rare scenario
+        # that touches sockets; an OS poll per iteration costs more than
+        # the virtual hop itself. Poll on a decimated cadence — and on
+        # every iteration while the loop is otherwise idle, so an FD
+        # wait still terminates promptly.
+        self._spins += 1
+        if timeout is None or self._spins >= 16:
+            self._spins = 0
+            events = self._real.select(0)
+            if events:
+                return events
+        self._clock.advance(_IDLE_STEP_S if timeout is None else timeout)
+        return []
+
+
+class VirtualTimeEventLoop(asyncio.SelectorEventLoop):
+    """A selector event loop whose ``time()`` is virtual.
+
+    ``call_later`` / ``asyncio.sleep`` / ``asyncio.wait_for`` schedule
+    against :meth:`time`, and the warped selector advances the clock to
+    the earliest deadline whenever nothing else is runnable, so timer
+    waits complete in microseconds of wall time regardless of their
+    virtual duration.
+    """
+
+    def __init__(self, clock: VirtualClock) -> None:
+        super().__init__(selector=_TimeWarpSelector(clock))
+        self.virtual_clock = clock
+
+    def time(self) -> float:
+        return self.virtual_clock()
+
+
+def run_virtual(
+    main: Callable[[], Awaitable[T]],
+    clock: Optional[VirtualClock] = None,
+) -> T:
+    """Run ``main()`` to completion on a fresh virtual-time loop.
+
+    Mirrors ``asyncio.run``: owns the loop for the duration, cancels
+    leftover tasks, and closes the loop. Returns the coroutine result.
+    """
+    clock = clock if clock is not None else VirtualClock()
+    loop = VirtualTimeEventLoop(clock)
+    try:
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(main())
+    finally:
+        try:
+            _cancel_pending(loop)
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()
+
+
+def _cancel_pending(loop: asyncio.AbstractEventLoop) -> None:
+    pending: List[asyncio.Task] = [
+        t for t in asyncio.all_tasks(loop) if not t.done()
+    ]
+    for task in pending:
+        task.cancel()
+    if pending:
+        loop.run_until_complete(
+            asyncio.gather(*pending, return_exceptions=True)
+        )

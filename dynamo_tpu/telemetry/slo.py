@@ -120,6 +120,12 @@ class SloTracker:
             self.goodput_tokens += tokens
             self._goodput.inc(tokens)
         self._window.append((self.clock(), ttft_ok, itl_ok, met, tokens))
+        # drop verdicts that have aged out of the window now, while the
+        # deque head is cheap to test — snapshot()/window_count() scans
+        # then touch only live rows instead of up to 4096 stale ones
+        cutoff = self.clock() - self.window_s
+        while self._window and self._window[0][0] < cutoff:
+            self._window.popleft()
         return met
 
     def window_count(self) -> int:

@@ -11,10 +11,13 @@ browser is far away.
 
 Usage:
     python scripts/dynamotop.py [--hub http://host:port]
-        [--interval 2] [--once] [--no-clear]
+        [--interval 2] [--once] [--no-clear] [--json]
 
 ``--once`` prints a single frame and exits (scripts/CI); the default
-loops until interrupted, redrawing in place.
+loops until interrupted, redrawing in place. ``--json`` implies
+``--once`` and emits a machine-readable fleet snapshot (per-worker
+rows + the summary rollup) instead of the table — for runbooks and
+cron probes that today scrape the human frame.
 """
 
 from __future__ import annotations
@@ -117,6 +120,40 @@ def render_summary(workers: List[dict], metrics: Optional[dict]) -> List[str]:
     return [" | ".join(parts)]
 
 
+def snapshot(fleet_workers: dict, fleet_metrics: Optional[dict] = None,
+             hub_url: str = "") -> dict:
+    """One-shot machine-readable fleet snapshot (the ``--json`` body):
+    the raw per-worker rows as served by ``/fleet/workers`` plus the
+    same rollup the human summary line renders, as numbers."""
+    workers = fleet_workers.get("workers") or []
+    up = [w for w in workers if w.get("up")]
+    busy = [w["busy_ratio"] for w in up if w.get("busy_ratio") is not None]
+    kv = [w["kv_usage_ratio"] for w in up
+          if w.get("kv_usage_ratio") is not None]
+    fams = (fleet_metrics or {}).get("families") or {}
+
+    def _family_sum(name):
+        fam = fams.get(name)
+        if not fam:
+            return None
+        return sum(e["sum"] for e in fam["roles"].values())
+
+    return {
+        "hub": hub_url,
+        "summary": {
+            "workers_total": len(workers),
+            "workers_up": len(up),
+            "draining": sum(1 for w in workers if w.get("draining")),
+            "busy_avg": sum(busy) / len(busy) if busy else None,
+            "kv_usage_avg": sum(kv) / len(kv) if kv else None,
+            "incidents_total": _family_sum("dynamo_incidents_total"),
+            "watchdog_trips_total":
+                _family_sum("dynamo_watchdog_trips_total"),
+        },
+        "workers": workers,
+    }
+
+
 def render(fleet_workers: dict, fleet_metrics: Optional[dict] = None,
            hub_url: str = "") -> str:
     workers = fleet_workers.get("workers") or []
@@ -142,7 +179,11 @@ def main(argv: List[str]) -> int:
                     help="print one frame and exit")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of redrawing in place")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable snapshot and exit")
     args = ap.parse_args(argv[1:])
+    if args.json:
+        args.once = True
     base = args.hub.rstrip("/")
     while True:
         try:
@@ -151,6 +192,10 @@ def main(argv: List[str]) -> int:
                 metrics = fetch_json(f"{base}/fleet/metrics")
             except (urllib.error.URLError, OSError, ValueError):
                 metrics = None
+            if args.json:
+                print(json.dumps(snapshot(workers, metrics, hub_url=base),
+                                 sort_keys=True, indent=1))
+                return 0
             frame = render(workers, metrics, hub_url=base)
         except (urllib.error.URLError, OSError, ValueError) as e:
             frame = f"dynamotop: cannot reach {base}/fleet/workers: {e}"

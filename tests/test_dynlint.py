@@ -1333,3 +1333,69 @@ def test_sp_prefill_modules_pass_jit_impure_and_async_blocking():
     assert found == [], "sp prefill seam regressed:\n" + "\n".join(
         f.render() for f in found
     )
+
+
+# --------------------------------------------------------------------------
+# fleet simulator: virtual-time discipline under sim/
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_sim_modules_pass_async_blocking_and_task_leak():
+    """The simulator's 1000x claim rests on the virtual loop never
+    blocking for real: one time.sleep or sync file read inside a sim
+    coroutine burns WALL time per virtual tick (the speedup gate in
+    scripts/fleetsim.py would quietly decay to 1x), and a dropped
+    worker/chaos/scrape task would outlive the run and corrupt the
+    next scenario's determinism. Pin the whole package ZERO-finding,
+    not baseline-covered."""
+    sim = os.path.join(PACKAGE_ROOT, "sim")
+    modules = [os.path.join(sim, name)
+               for name in sorted(os.listdir(sim))
+               if name.endswith(".py")]
+    assert len(modules) >= 7  # the scan must actually see the package
+    found = lint_paths(modules, get_rules(["async-blocking", "task-leak"]))
+    assert found == [], "sim virtual-time discipline regressed:\n" + \
+        "\n".join(f.render() for f in found)
+
+
+def test_async_blocking_flags_sim_loop_sleeping_for_real():
+    """TP fixture shaped like the tempting-but-wrong sim pacing: the
+    arrival dispatcher waits out inter-arrival gaps with time.sleep —
+    real seconds on the virtual loop, exactly the bug that turns a
+    1000x replay back into real time."""
+    out = findings(
+        """
+        import time
+
+        async def dispatch_arrivals(requests, serve):
+            last = 0.0
+            for req in requests:
+                time.sleep(req.arrival_s - last)   # real seconds!
+                last = req.arrival_s
+                serve(req)
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+def test_task_leak_flags_sim_serve_shaped_discarded_task():
+    """TP fixture shaped like a careless request dispatcher: per-request
+    serve tasks spawned without holding the handle can never be awaited
+    at teardown, so a late completion leaks into the NEXT scenario's
+    virtual clock and breaks byte-identical replay."""
+    out = findings(
+        """
+        import asyncio
+
+        class Fleet:
+            def dispatch(self, req):
+                asyncio.create_task(self._serve(req))
+
+            async def _serve(self, req):
+                await asyncio.sleep(1.0)
+        """,
+        "task-leak",
+    )
+    assert [f.rule for f in out] == ["task-leak"]

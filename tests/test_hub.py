@@ -10,6 +10,7 @@ endpoints.
 """
 
 import asyncio
+import json
 import os
 import sys
 
@@ -437,11 +438,39 @@ async def test_fleet_e2e_two_workers_and_frontend(tmp_path):
                                      hub_url=base)
             assert "w1" in frame and "w2" in frame
             assert "DRAIN" in frame  # w2's drain state in the table
+            # --json: the same fleet as a machine-readable snapshot,
+            # fetched by the real CLI path (urllib off-loop)
+            rc = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: dynamotop.main(
+                    ["dynamotop", "--hub", base, "--json"]))
+            assert rc == 0
+            snap = dynamotop.snapshot(body, {"families": fams},
+                                      hub_url=base)
+            snap2 = json.loads(json.dumps(snap))  # JSON-serializable
+            assert snap2["summary"]["workers_total"] == 3
+            assert snap2["summary"]["workers_up"] == 3
+            assert snap2["summary"]["draining"] == 1
+            rows = {w["name"]: w for w in snap2["workers"]}
+            assert rows["w2"]["draining"] is True
+            assert rows["w1"]["kv_usage_ratio"] is not None
     finally:
         await service.stop()
         await hub.stop()
         await side1.stop()
         await side2.stop()
+
+
+def test_dynamotop_json_unreachable_hub_exits_nonzero(capsys):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import dynamotop
+
+    rc = dynamotop.main(
+        ["dynamotop", "--hub", "http://127.0.0.1:1", "--json"])
+    assert rc == 2
+    assert capsys.readouterr().out == ""  # nothing parseable on stdout
 
 
 @pytest.mark.asyncio

@@ -519,3 +519,125 @@ async def test_corrupt_cold_block_is_a_miss_never_installed(
         _assert_no_leaks(sched2)
     finally:
         await sched2.stop()
+
+
+# ------------------------------------------------- pool-scoped discovery
+
+
+def test_pool_scope_peers_filters_by_model_metadata():
+    """Two model pools share one component: the peer filter keeps the
+    same-pool peer, drops the other pool's, and treats a missing record
+    or missing metadata as a wildcard (single-pool deployments)."""
+    import msgpack
+
+    from dynamo_tpu.cli.run import _pool_scope_peers
+
+    def rec(wid, model=None):
+        info = {"instance_id": wid, "subject": "s", "worker_id": wid}
+        if model is not None:
+            info["model"] = model
+        return msgpack.packb(info, use_bin_type=True)
+
+    eps = {
+        "ns/components/backend/endpoints/generate:w-a2": rec("w-a2", "modelA"),
+        "ns/components/backend/endpoints/generate:w-b1": rec("w-b1", "modelB"),
+        "ns/components/backend/endpoints/generate:w-any": rec("w-any"),
+        "ns/components/backend/endpoints/generate:w-junk": b"\x00not-msgpack",
+    }
+    peers = {w: {"engine_id": w, "host": "h", "port": 1}
+             for w in ("w-a2", "w-b1", "w-any", "w-junk", "w-norec")}
+
+    scoped, live = _pool_scope_peers(peers, eps, "modelA")
+    # same pool + wildcards survive; the other pool is invisible
+    assert set(scoped) == {"w-a2", "w-any", "w-junk", "w-norec"}
+    # liveness stays pool-agnostic: every registered id counts
+    assert live == {"w-a2", "w-b1", "w-any", "w-junk"}
+
+    # no model (pre-pool deployments): the filter is a no-op
+    unscoped, _ = _pool_scope_peers(peers, eps, "")
+    assert set(unscoped) == set(peers)
+
+
+async def test_fabric_peer_refresh_is_pool_scoped():
+    """End-to-end through _setup_kv_fabric against an in-process
+    discovery plane: two pools registered on ONE shared component; this
+    worker's peer cache must only ever hold its own pool (plus
+    wildcards), while dead-id pruning still spans the component."""
+    import types
+
+    import msgpack
+
+    from dynamo_tpu.cli.run import _setup_kv_fabric
+    from dynamo_tpu.kv.fabric import fabric_key
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.transports.memory import MemoryHub
+
+    class _StubServer:
+        port = 7
+
+    class _StubFabric:
+        # just the surface _setup_kv_fabric wires; the filter under
+        # test runs against the real discovery records
+        peer_pull = True
+        cold = None
+
+        def __init__(self, engine_id):
+            self.engine_id = engine_id
+            self.indexer = types.SimpleNamespace(worker_ids=[])
+            self.removed = []
+            self.held = []
+            self.peers = lambda: {}
+
+        async def serve(self, host=""):
+            return _StubServer()
+
+        def remove_worker(self, wid):
+            self.removed.append(wid)
+            if wid in self.indexer.worker_ids:
+                self.indexer.worker_ids.remove(wid)
+
+        def hold_task(self, task):
+            self.held.append(task)
+
+        def apply_event(self, ev):
+            pass
+
+    drt = DistributedRuntime.in_process(MemoryHub())
+    endpoint = drt.namespace("ns").component("backend").endpoint("generate")
+    lease = await drt.discovery.primary_lease()
+
+    async def register(wid, model):
+        await drt.discovery.kv_create(
+            endpoint.etcd_key(wid),
+            msgpack.packb({"instance_id": wid, "subject": "s",
+                           "worker_id": wid, "model": model},
+                          use_bin_type=True),
+            lease_id=lease.id)
+        await drt.discovery.kv_put(
+            fabric_key("ns", "backend", wid),
+            msgpack.packb({"host": "h", "port": 1, "engine_id": wid},
+                          use_bin_type=True),
+            lease_id=lease.id)
+
+    await register("w-a1", "modelA")      # self
+    await register("w-a2", "modelA")      # same pool → visible peer
+    await register("w-b1", "modelB")      # other pool → filtered
+    fab = _StubFabric("w-a1")
+    # a dead incarnation's hash runs linger in the ownership view
+    fab.indexer.worker_ids.extend(["w-dead", "w-b1"])
+    core = types.SimpleNamespace(
+        scheduler=types.SimpleNamespace(fabric=fab))
+    flags = types.SimpleNamespace(namespace="ns", advertise_host="127.0.0.1")
+
+    out = await _setup_kv_fabric(
+        flags, core, drt=drt, component="backend", endpoint=endpoint,
+        instance_id="w-a1", model="modelA")
+    try:
+        assert out is fab
+        assert set(fab.peers()) == {"w-a2"}          # not self, not modelB
+        assert "w-dead" in fab.removed               # lease-based prune
+        assert "w-b1" not in fab.removed             # alive, just scoped out
+    finally:
+        for task in fab.held:
+            task.cancel()
+        await asyncio.gather(*fab.held, return_exceptions=True)

@@ -131,10 +131,23 @@ def test_lint_sees_the_real_instrument_catalog():
         "dynamo_engine_prefill_sp_tokens_total",
         "dynamo_engine_prefill_sp_axis_depth",
         "dynamo_engine_prefill_sp_exposed_seconds",
+        # trace-driven fleet simulator (sim/metrics.py): run counters
+        # and gauges published through the standard /metrics plumbing
+        "dynamo_sim_requests_total",
+        "dynamo_sim_tokens_total",
+        "dynamo_sim_scale_actions_total",
+        "dynamo_sim_chaos_injections_total",
+        "dynamo_sim_recoveries_total",
+        "dynamo_sim_watchdog_trips_total",
+        "dynamo_sim_resubmits_total",
+        "dynamo_sim_slo_attainment_ratio",
+        "dynamo_sim_kv_usage_ratio",
+        "dynamo_sim_virtual_time_seconds",
+        "dynamo_sim_workers_replicas",
     }
     missing = expected - names
     assert not missing, f"lint no longer sees: {sorted(missing)}"
-    assert len(names) >= 104
+    assert len(names) >= 115
 
 
 def _metric(name, kind):
