@@ -13,7 +13,13 @@ codebase actually depends on:
   (``jit-impure`` — the static twin of the runtime ``host_sync``
   phase histogram);
 - registered metric names must follow the house convention
-  (``metric-name`` — shared with scripts/check_metric_names.py).
+  (``metric-name`` — shared with scripts/check_metric_names.py);
+- the fleet simulator must never read the wall clock
+  (``wallclock-in-sim`` — byte-identical reports per (scenario, seed));
+- loop-owned serving-plane state must not cross thread domains without
+  a marshalling idiom (``cross-domain-race`` — interprocedural
+  thread-domain inference over the whole package; see ``domains.py``
+  and the ``# dynrace: domain(...)`` annotation vocabulary).
 
 Entry points: ``scripts/dynlint.py`` (CLI, baseline-aware) and
 ``tests/test_dynlint.py`` (tier-1 enforcement). Suppress a finding
@@ -24,13 +30,24 @@ flagged line or the line above; record pre-existing debt in
 """
 
 from .baseline import diff_against_baseline, load_baseline, write_baseline
-from .core import Finding, Rule, SourceModule, lint_paths, lint_source
+from .core import (
+    Finding,
+    ProjectRule,
+    Rule,
+    SourceModule,
+    lint_paths,
+    lint_source,
+)
+from .domains import DomainAnalysis, infer_domains
 from .rules import all_rules, get_rules
 
 __all__ = [
+    "DomainAnalysis",
     "Finding",
+    "ProjectRule",
     "Rule",
     "SourceModule",
+    "infer_domains",
     "all_rules",
     "get_rules",
     "lint_paths",

@@ -21,6 +21,7 @@ from typing import Dict, Iterable, Iterator, List, NamedTuple, Optional, Sequenc
 
 __all__ = [
     "Finding",
+    "ProjectRule",
     "Rule",
     "SourceModule",
     "dotted_name",
@@ -194,6 +195,26 @@ class Rule:
         return f"<rule {self.name}>"
 
 
+class ProjectRule(Rule):
+    """A check over the WHOLE parsed module set at once.
+
+    Per-module rules see one file; an interprocedural pass (thread-domain
+    inference, call-graph reachability) needs every module of the scan to
+    resolve cross-module calls. ``lint_paths`` collects all modules first
+    and hands them here in one call; ``lint_source`` (the fixture entry
+    point) falls back to a single-module project, so fixtures exercise a
+    ProjectRule exactly like any other rule.
+    """
+
+    def check_project(
+        self, mods: Sequence[SourceModule]
+    ) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        return self.check_project([mod])
+
+
 # --------------------------------------------------------------------------
 # runner
 # --------------------------------------------------------------------------
@@ -212,14 +233,18 @@ def iter_python_files(root: str) -> Iterator[str]:
                 yield os.path.join(dirpath, fn)
 
 
+def _suppressed(mod: SourceModule, rule_name: str, finding: Finding) -> bool:
+    allowed = mod.allowed_rules_at(finding.line)
+    return rule_name in allowed or "all" in allowed
+
+
 def _run_rules(
     mod: SourceModule, rules: Sequence[Rule]
 ) -> List[Finding]:
     out: List[Finding] = []
     for rule in rules:
         for finding in rule.check(mod):
-            allowed = mod.allowed_rules_at(finding.line)
-            if rule.name in allowed or "all" in allowed:
+            if _suppressed(mod, rule.name, finding):
                 continue
             out.append(finding)
     return out
@@ -257,15 +282,30 @@ def report_rel(path: str) -> str:
 
 
 def lint_paths(
-    paths: Iterable[str], rules: Sequence[Rule]
+    paths: Iterable[str], rules: Sequence[Rule],
+    only_files: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
     """Lint every ``.py`` under each path. Each file is keyed by its
     package-relative path regardless of how the scan was scoped (see
     ``report_rel``); overlapping path arguments are deduplicated so a
     file is never counted twice against the baseline ratchet. A path
     that does not exist raises — an empty scan must never read as a
-    clean one."""
+    clean one.
+
+    ``only_files`` (report-relative paths, e.g. from ``--changed``)
+    restricts which files produce findings WITHOUT shrinking the scan:
+    per-module rules skip the others, but every module under ``paths``
+    is still parsed and handed to ProjectRules as call-graph context —
+    an interprocedural verdict about a changed file must not flip just
+    because its callers didn't change.
+    """
+    only = None if only_files is None else {
+        f.replace(os.sep, "/") for f in only_files
+    }
     findings: List[Finding] = []
+    module_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    mods: List[SourceModule] = []
     seen: set = set()
     for root in paths:
         if not os.path.exists(root):
@@ -280,11 +320,24 @@ def lint_paths(
             try:
                 mod = SourceModule(rel, source)
             except SyntaxError as e:
-                findings.append(
-                    Finding("parse-error", rel, e.lineno or 0,
-                            f"could not parse: {e.msg}")
-                )
+                if only is None or rel in only:
+                    findings.append(
+                        Finding("parse-error", rel, e.lineno or 0,
+                                f"could not parse: {e.msg}")
+                    )
                 continue
-            findings.extend(_run_rules(mod, rules))
+            mods.append(mod)
+            if only is None or rel in only:
+                findings.extend(_run_rules(mod, module_rules))
+    if project_rules and mods:
+        by_rel = {m.rel: m for m in mods}
+        for rule in project_rules:
+            for finding in rule.check_project(mods):
+                if only is not None and finding.file not in only:
+                    continue
+                mod = by_rel.get(finding.file)
+                if mod is not None and _suppressed(mod, rule.name, finding):
+                    continue
+                findings.append(finding)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

@@ -1,0 +1,665 @@
+"""dynrace: interprocedural thread-domain inference + cross-domain races.
+
+The serving plane's load-bearing concurrency discipline is a convention,
+not a type: loop-owned state is mutated only on the event loop, while
+executor/thread code reads GIL-atomic snapshots or marshals back via
+``call_soon_threadsafe``. The reference Dynamo gets this from Rust's
+ownership model; Python gets nothing, and review kept finding the same
+violation classes after the fact (the off-loop ``/fleet`` reads, the
+trace-writer close-under-write race). This pass makes the convention
+checkable.
+
+Three stages over the whole parsed module set (a :class:`ProjectRule` —
+per-module rules can't see who calls whom):
+
+1. **Call graph.** Every ``def``/``async def``/``lambda`` becomes a
+   node. Edges come from direct sync calls resolved through the same
+   import-alias machinery the per-module rules use (``core.dotted_name``),
+   extended with relative imports, plus ``self.method`` and nested-
+   function references.
+
+2. **Thread domains.** Each function is inferred to run in one or more
+   *domains*:
+
+   - ``loop``  — ``async def``s, and callables handed to ``call_soon``/
+     ``call_later``/``call_at``/``call_soon_threadsafe``/
+     ``add_done_callback`` (asyncio futures invoke these on the loop);
+   - ``executor`` — callables handed to ``run_in_executor`` /
+     ``asyncio.to_thread``;
+   - ``thread``  — ``threading.Thread(target=...)`` targets (the FIFO
+     writer threads).
+
+   Seeds propagate caller→callee to fixpoint: a sync helper called from
+   an ``async def`` runs on the loop; called *also* from a thread
+   target, it runs in both (which is exactly what makes its writes
+   dangerous). Dynamic dispatch the graph can't resolve (registry
+   callbacks, stored function pointers) is covered by an annotation
+   vocabulary — ``# dynrace: domain(loop|executor|thread|any)`` on the
+   ``def`` line or the line above pins the function (``any`` excludes
+   it). Unannotated functions the graph never reaches stay
+   domain-unknown and produce no findings: the pass is deliberately
+   no-false-positive-biased.
+
+3. **Per-class attribute audit.** For every ``self.<attr>`` of every
+   class, each touch is recorded with its function's domains, the
+   ``with self.<lock>:`` locks held around it, and its *kind*:
+
+   - ``rebind``  — ``self.x = fresh`` (an atomic pointer publish);
+   - ``rmw``     — ``self.x += 1`` (read-modify-write);
+   - ``inplace`` — mutation of the object behind the attribute:
+     subscript stores/deletes and mutator method calls (``append``,
+     ``update``, ``pop``, ``move_to_end``, ``write``, ``close``, …);
+   - reads, split into GIL-atomic forms (subscript/``get``/membership/
+     truthiness/reference grabs, and materialized snapshots —
+     ``list(self.x)``, ``len(self.x)``, ``sorted``, …) versus unsafe
+     forms (direct iteration of the live container, unknown method
+     calls on it).
+
+   A finding fires when (a) the attribute is **written from two
+   different domains** with no common lock (write/write race — lost
+   updates, close-under-write), or (b) it is **mutated in place in one
+   domain and unsafely read in another** with no common lock (the
+   iterate-while-the-loop-mutates ``RuntimeError`` class).
+
+   Everything the repo sanctions comes out clean by construction:
+   init-only assignment (``__init__`` runs before concurrency),
+   snapshot publishes (rebind + any read), ``list()`` snapshot reads,
+   a ``threading.Lock`` held on both sides, ``queue.Queue``/
+   ``asyncio.Queue``/``deque``/``Event`` attributes (their methods ARE
+   the handoff idiom), and ``call_soon_threadsafe`` marshals (the
+   callback is inferred ``loop``, so the touch lands in the right
+   domain).
+
+Entry points: :class:`CrossDomainRaceRule` (the ``cross-domain-race``
+rule in the catalog) and :func:`infer_domains` (fixture introspection).
+See docs/static_analysis.md "Thread domains".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ProjectRule, SourceModule, dotted_name
+
+__all__ = [
+    "CrossDomainRaceRule",
+    "DomainAnalysis",
+    "infer_domains",
+]
+
+LOOP = "loop"
+EXECUTOR = "executor"
+THREAD = "thread"
+ANY = "any"
+
+_DOMAIN_RE = re.compile(r"#\s*dynrace:\s*domain\((loop|executor|thread|any)\)")
+
+# attribute method names that mutate the receiver in place (builtin
+# containers, files, OrderedDict). ``get`` is deliberately absent: on the
+# non-queue attributes this audit covers it is the dict read.
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort", "reverse", "move_to_end", "write", "writelines",
+    "truncate", "close", "flush",
+})
+
+# attr.<accessor>() views that still expose the LIVE container — reading
+# through them inherits the consumer's safety (list(x.values()) is a
+# snapshot; for ... in x.values() is not)
+_VIEW_METHODS = frozenset({"values", "items", "keys", "copy"})
+
+# builtins that consume an iterable whole without running bytecode
+# mid-iteration: the C call holds the GIL, so a concurrent loop-side
+# mutation cannot interleave — the sanctioned snapshot-read spelling
+_MATERIALIZERS = frozenset({
+    "list", "tuple", "set", "frozenset", "dict", "sorted", "len", "sum",
+    "min", "max", "any", "all", "bool", "str", "repr",
+})
+
+# self.<attr> = <ctor>() types that ARE a marshalling idiom: their whole
+# contract is cross-thread use, so touches are exempt from the audit.
+# collections.deque is deliberately NOT here: append/pop/[-1]/len are
+# the sanctioned GIL-atomic ops (classified individually), but iterating
+# a live deque while another domain appends raises RuntimeError — the
+# audit must see that.
+_EXEMPT_TYPES = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "asyncio.Queue",
+    "asyncio.LifoQueue", "asyncio.PriorityQueue", "threading.Event",
+    "asyncio.Event", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "asyncio.Lock", "asyncio.Condition",
+    "asyncio.Semaphore", "concurrent.futures.ThreadPoolExecutor",
+})
+
+# the subset that counts as a lock for `with self.<attr>:` coverage
+_LOCK_TYPES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+})
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+
+_WRITE_KINDS = frozenset({"rebind", "rmw", "inplace"})
+_UNSAFE_READ_KINDS = frozenset({"read_iter", "read_call"})
+
+
+def _fmt(domains: FrozenSet[str]) -> str:
+    return "+".join(sorted(domains))
+
+
+class _Fn:
+    """One function/lambda node with its inference state."""
+
+    __slots__ = ("node", "mod", "qual", "name", "cls", "parent", "is_async",
+                 "domains", "pinned", "seeded")
+
+    def __init__(self, node, mod: SourceModule, qual: str, name: str,
+                 cls: Optional[str], parent: Optional["_Fn"], is_async: bool):
+        self.node = node
+        self.mod = mod
+        self.qual = qual          # "ClassName.method" / "fn.<locals>.inner"
+        self.name = name          # display name ("method", "<lambda>")
+        self.cls = cls            # innermost enclosing class, if a method
+        self.parent = parent      # lexically enclosing function
+        self.is_async = is_async
+        self.domains: Set[str] = {LOOP} if is_async else set()
+        self.pinned = is_async    # async defs always run on a loop
+        self.seeded = is_async    # got a domain from structure, not a caller
+
+
+class _Touch(NamedTuple):
+    kind: str                  # rebind|rmw|inplace|read_atomic|read_iter|read_call
+    domains: FrozenSet[str]
+    locks: FrozenSet[str]
+    line: int
+    fn: str                    # display qual for messages
+    in_init: bool
+
+
+def _module_dotted(rel: str) -> str:
+    """``dynamo_tpu/telemetry/hub.py`` → ``dynamo_tpu.telemetry.hub``."""
+    p = rel[:-3] if rel.endswith(".py") else rel
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+def _rich_aliases(mod: SourceModule) -> Dict[str, str]:
+    """The module's alias map PLUS relative imports resolved against its
+    package path (core's map skips ``from .x import y`` — fine for
+    stdlib-name rules, fatal for an intra-package call graph)."""
+    amap = dict(mod.aliases)
+    dotted = _module_dotted(mod.rel)
+    pkg_parts = dotted.split(".")
+    if not mod.rel.endswith("/__init__.py") and "/" in mod.rel:
+        pkg_parts = pkg_parts[:-1]
+    elif not mod.rel.endswith("/__init__.py"):
+        pkg_parts = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.level:
+            base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            if not base:
+                continue
+            prefix = ".".join(base + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                amap.setdefault(a.asname or a.name, f"{prefix}.{a.name}")
+    return amap
+
+
+class DomainAnalysis:
+    """The whole-package pass: build once, query findings/domains."""
+
+    def __init__(self, mods: Sequence[SourceModule]):
+        self.mods = list(mods)
+        self.fns: Dict[int, _Fn] = {}            # id(node) → _Fn
+        self.module_fns: Dict[Tuple[str, str], _Fn] = {}
+        self.method_fns: Dict[Tuple[str, str, str], _Fn] = {}
+        self.dotted_fns: Dict[str, _Fn] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        # (mod.rel, cls) → attr → ctor dotted names seen for it
+        self.attr_types: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+        self.edges: List[Tuple[_Fn, _Fn]] = []
+        self.touches: Dict[Tuple[str, str, str], List[_Touch]] = {}
+        for mod in self.mods:
+            self.aliases[mod.rel] = _rich_aliases(mod)
+        for mod in self.mods:
+            self._collect_functions(mod)
+        for mod in self.mods:
+            self._collect_usage(mod)
+        self._fixpoint()
+        self._collect_touches()
+
+    # ------------------------------------------------------------------
+    # pass 1: function inventory (+ annotations, + attribute ctor types)
+    # ------------------------------------------------------------------
+
+    def _annotation(self, mod: SourceModule, node) -> Optional[str]:
+        line = getattr(node, "lineno", 0)
+        for idx in (line - 1, line - 2):
+            if 0 <= idx < len(mod.lines):
+                m = _DOMAIN_RE.search(mod.lines[idx])
+                if m:
+                    return m.group(1)
+        return None
+
+    def _collect_functions(self, mod: SourceModule) -> None:
+        dotted_mod = _module_dotted(mod.rel)
+
+        def visit(node, cls: Optional[str], cls_qual: Optional[str],
+                  fn: Optional[_Fn], qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    cq = f"{cls_qual}.{child.name}" if cls_qual else child.name
+                    visit(child, child.name, cq, fn, cq)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                        ast.Lambda)):
+                    name = getattr(child, "name", "<lambda>")
+                    q = f"{qual}.{name}" if qual else name
+                    info = _Fn(child, mod, q, name,
+                               cls if fn is None or fn.cls == cls else fn.cls,
+                               fn, isinstance(child, ast.AsyncFunctionDef))
+                    # nested functions keep the enclosing method's class
+                    # (they close over the same ``self``)
+                    if fn is not None:
+                        info.cls = fn.cls
+                    ann = self._annotation(mod, child)
+                    if ann is not None:
+                        info.pinned = True
+                        info.seeded = True
+                        info.domains = set() if ann == ANY else {ann}
+                    self.fns[id(child)] = info
+                    if fn is None and cls is None:
+                        self.module_fns[(mod.rel, name)] = info
+                        self.dotted_fns[f"{dotted_mod}.{name}"] = info
+                    elif fn is None and cls is not None:
+                        self.method_fns[(mod.rel, cls_qual, name)] = info
+                    visit(child, cls, cls_qual, info, q)
+                else:
+                    visit(child, cls, cls_qual, fn, qual)
+
+        visit(mod.tree, None, None, None, "")
+
+        # attribute ctor types: self.X = <call>() anywhere in a class
+        amap = self.aliases[mod.rel]
+
+        def scan_types(node, cls_qual: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                cq = cls_qual
+                if isinstance(child, ast.ClassDef):
+                    cq = f"{cls_qual}.{child.name}" if cls_qual else child.name
+                if cls_qual is not None and isinstance(child, ast.Assign) \
+                        and isinstance(child.value, ast.Call):
+                    ctor = dotted_name(child.value.func, amap)
+                    if ctor:
+                        for tgt in child.targets:
+                            if isinstance(tgt, ast.Attribute) and \
+                                    isinstance(tgt.value, ast.Name) and \
+                                    tgt.value.id == "self":
+                                self.attr_types.setdefault(
+                                    (mod.rel, cls_qual), {}
+                                ).setdefault(tgt.attr, set()).add(ctor)
+                scan_types(child, cq)
+
+        scan_types(mod.tree, None)
+
+    # ------------------------------------------------------------------
+    # pass 2: seeds + call edges
+    # ------------------------------------------------------------------
+
+    def _own_nodes(self, root) -> Iterator[ast.AST]:
+        """The function's body without nested function bodies (those are
+        their own nodes in the graph). The defs/lambdas themselves are
+        yielded so dispatch sites can seed them."""
+        body = root.body if not isinstance(root, ast.Lambda) else [root.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _resolve_callable(self, expr, mod: SourceModule,
+                          fn: Optional[_Fn]) -> Optional[_Fn]:
+        """A callable-valued expression → its _Fn, through locals,
+        methods, module functions, and import aliases."""
+        if expr is None:
+            return None
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return self.fns.get(id(expr))
+        # functools.partial(f, ...) → f
+        if isinstance(expr, ast.Call):
+            target = dotted_name(expr.func, self.aliases[mod.rel])
+            if target == "functools.partial" and expr.args:
+                return self._resolve_callable(expr.args[0], mod, fn)
+            return None
+        if isinstance(expr, ast.Name):
+            cur = fn
+            while cur is not None:
+                for cand_id, cand in self.fns.items():
+                    if cand.parent is cur and cand.name == expr.id:
+                        return cand
+                cur = cur.parent
+            hit = self.module_fns.get((mod.rel, expr.id))
+            if hit is not None:
+                return hit
+            dotted = self.aliases[mod.rel].get(expr.id)
+            return self.dotted_fns.get(dotted) if dotted else None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and fn is not None and fn.cls is not None:
+                return self.method_fns.get((mod.rel, fn.cls, expr.attr))
+            dotted = dotted_name(expr, self.aliases[mod.rel])
+            return self.dotted_fns.get(dotted) if dotted else None
+        return None
+
+    def _seed(self, target, mod: SourceModule, fn: Optional[_Fn],
+              domain: str) -> None:
+        info = self._resolve_callable(target, mod, fn)
+        if info is None or info.pinned:
+            return
+        info.domains.add(domain)
+        info.seeded = True
+
+    def _collect_usage(self, mod: SourceModule) -> None:
+        roots: List[Optional[_Fn]] = [None]
+        roots.extend(f for f in self.fns.values() if f.mod is mod)
+        for fn in roots:
+            nodes = (self._own_nodes(fn.node) if fn is not None
+                     else self._module_level_nodes(mod))
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                # dispatch seeds --------------------------------------
+                if isinstance(func, ast.Attribute):
+                    attr = func.attr
+                    if attr == "run_in_executor" and len(node.args) >= 2:
+                        self._seed(node.args[1], mod, fn, EXECUTOR)
+                    elif attr in ("call_soon", "call_soon_threadsafe",
+                                  "add_done_callback") and node.args:
+                        self._seed(node.args[0], mod, fn, LOOP)
+                    elif attr in ("call_later", "call_at") and \
+                            len(node.args) >= 2:
+                        self._seed(node.args[1], mod, fn, LOOP)
+                dotted = dotted_name(func, self.aliases[mod.rel])
+                if dotted == "threading.Thread":
+                    target = None
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                    if target is None and len(node.args) >= 2:
+                        target = node.args[1]
+                    self._seed(target, mod, fn, THREAD)
+                elif dotted == "asyncio.to_thread" and node.args:
+                    self._seed(node.args[0], mod, fn, EXECUTOR)
+                # call edges ------------------------------------------
+                if fn is not None:
+                    callee = self._resolve_callable(func, mod, fn)
+                    if callee is not None and not callee.is_async:
+                        self.edges.append((fn, callee))
+        # nested functions with no structural seed run where their
+        # enclosing function runs (defined and called inline)
+        for info in self.fns.values():
+            if info.mod is mod and info.parent is not None \
+                    and not info.seeded:
+                self.edges.append((info.parent, info))
+
+    def _module_level_nodes(self, mod: SourceModule) -> Iterator[ast.AST]:
+        stack = list(ast.iter_child_nodes(mod.tree))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ------------------------------------------------------------------
+    # pass 3: fixpoint
+    # ------------------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee in self.edges:
+                if callee.pinned:
+                    continue
+                add = caller.domains - callee.domains
+                if add:
+                    callee.domains |= add
+                    changed = True
+
+    # ------------------------------------------------------------------
+    # pass 4: attribute touches
+    # ------------------------------------------------------------------
+
+    def _lock_attrs(self, mod: SourceModule, cls: str) -> Set[str]:
+        types = self.attr_types.get((mod.rel, cls), {})
+        return {a for a, ctors in types.items() if ctors & _LOCK_TYPES}
+
+    def _exempt_attrs(self, mod: SourceModule, cls: str) -> Set[str]:
+        types = self.attr_types.get((mod.rel, cls), {})
+        return {a for a, ctors in types.items() if ctors & _EXEMPT_TYPES}
+
+    def _collect_touches(self) -> None:
+        for info in self.fns.values():
+            if info.cls is None:
+                continue
+            locks = self._lock_attrs(info.mod, info.cls)
+            self._walk_touches(info, locks)
+
+    def _walk_touches(self, info: _Fn, lock_attrs: Set[str]) -> None:
+        mod, cls = info.mod, info.cls
+        in_init = info.name in _INIT_METHODS and info.parent is None
+        domains = frozenset(info.domains)
+        key_base = (mod.rel, cls)
+
+        # parent map over the function's own subtree
+        parents: Dict[int, ast.AST] = {}
+        body = info.node.body if not isinstance(info.node, ast.Lambda) \
+            else [info.node.body]
+        stack: List[ast.AST] = list(body)
+        own: List[ast.AST] = []
+        while stack:
+            node = stack.pop()
+            own.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+                stack.append(child)
+
+        def held_locks(node) -> FrozenSet[str]:
+            held: Set[str] = set()
+            cur = parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Attribute) and \
+                                isinstance(ce.value, ast.Name) and \
+                                ce.value.id == "self" and \
+                                ce.attr in lock_attrs:
+                            held.add(ce.attr)
+                cur = parents.get(id(cur))
+            return frozenset(held)
+
+        def classify_load(node) -> str:
+            """A Load of self.<attr> → read kind, by its consumer."""
+            p = parents.get(id(node))
+            consumer = node
+            # look through live views: self.x.values() etc.
+            if isinstance(p, ast.Attribute) and p.value is node:
+                gp = parents.get(id(p))
+                if isinstance(gp, ast.Call) and gp.func is p:
+                    if p.attr in _MUTATOR_METHODS:
+                        return "inplace"
+                    if p.attr in _VIEW_METHODS:
+                        consumer, p = gp, parents.get(id(gp))
+                    elif p.attr == "get":
+                        return "read_atomic"
+                    else:
+                        # unknown method on the live object: the audit
+                        # can't see inside it — assume it iterates
+                        return "read_call"
+                else:
+                    # plain sub-attribute read (self.x.y): atomic
+                    return "read_atomic"
+            if isinstance(p, ast.Subscript) and p.value is consumer:
+                if isinstance(p.ctx, (ast.Store, ast.Del)):
+                    return "inplace"
+                return "read_atomic"
+            if isinstance(p, ast.Call):
+                if consumer in p.args or any(
+                        kw.value is consumer for kw in p.keywords):
+                    fname = p.func.id if isinstance(p.func, ast.Name) else None
+                    if fname in _MATERIALIZERS:
+                        return "read_atomic"
+                    if fname in ("iter", "enumerate", "map", "filter",
+                                 "zip", "reversed"):
+                        return "read_iter"
+                    # passed by reference — the grab itself is atomic
+                    return "read_atomic"
+                if p.func is consumer:
+                    return "read_atomic"  # calling a stored callable
+            if isinstance(p, (ast.For, ast.AsyncFor)) and p.iter is consumer:
+                return "read_iter"
+            if isinstance(p, ast.comprehension) and p.iter is consumer:
+                return "read_iter"
+            return "read_atomic"
+
+        for node in own:
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            if isinstance(node.ctx, ast.Store):
+                p = parents.get(id(node))
+                kind = "rmw" if isinstance(p, ast.AugAssign) else "rebind"
+            elif isinstance(node.ctx, ast.Del):
+                kind = "inplace"
+            else:
+                kind = classify_load(node)
+            self.touches.setdefault(
+                key_base + (node.attr,), []
+            ).append(_Touch(kind, domains, held_locks(node),
+                            getattr(node, "lineno", 0), info.qual, in_init))
+
+    # ------------------------------------------------------------------
+    # findings
+    # ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        rel_to_mod = {m.rel: m for m in self.mods}
+        for (rel, cls, attr), touches in sorted(self.touches.items()):
+            if attr in self._exempt_attrs(rel_to_mod[rel], cls):
+                continue
+            active = [t for t in touches if not t.in_init and t.domains]
+            writes = [t for t in active if t.kind in _WRITE_KINDS]
+            if not writes:
+                continue
+            emitted: Set[Tuple[int, str]] = set()
+
+            def emit(line: int, msg: str) -> None:
+                key = (line, msg)
+                if key not in emitted:
+                    emitted.add(key)
+                    out.append(Finding("cross-domain-race", rel, line, msg))
+
+            def crosses(a: FrozenSet[str], b: FrozenSet[str]) -> bool:
+                return any(d1 != d2 for d1 in a for d2 in b)
+
+            # (a) write/write across domains without a common lock
+            for i, w1 in enumerate(writes):
+                peers = [
+                    w2 for j, w2 in enumerate(writes)
+                    if i != j and crosses(w1.domains, w2.domains)
+                    and not (w1.locks & w2.locks)
+                ]
+                if peers:
+                    peer_doms = frozenset().union(
+                        *(p.domains for p in peers)) - w1.domains or \
+                        frozenset().union(*(p.domains for p in peers))
+                    peer_fns = sorted({p.fn for p in peers if p.fn != w1.fn}) \
+                        or [w1.fn]
+                    emit(
+                        w1.line,
+                        f"self.{attr} of {cls} written on the "
+                        f"{_fmt(w1.domains)} domain ({w1.fn}) and "
+                        f"concurrently on {_fmt(peer_doms)} "
+                        f"({', '.join(peer_fns)}) — hold one lock on every "
+                        "side or marshal all writes onto a single domain",
+                    )
+                elif len(w1.domains) >= 2 and not w1.locks:
+                    # one function, reachable from two domains: it races
+                    # with concurrent invocations of itself
+                    emit(
+                        w1.line,
+                        f"self.{attr} of {cls} written by {w1.fn}, which "
+                        f"is reachable from multiple domains "
+                        f"({_fmt(w1.domains)}) — concurrent invocations "
+                        "race; pin it with # dynrace: domain(...) or lock "
+                        "the write",
+                    )
+
+            # (b) in-place mutation vs unsafe cross-domain read
+            inplace = [w for w in writes if w.kind == "inplace"]
+            if not inplace:
+                continue
+            wdoms = frozenset().union(*(w.domains for w in inplace))
+            wfns = sorted({w.fn for w in inplace})
+            for t in active:
+                if t.kind not in _UNSAFE_READ_KINDS:
+                    continue
+                racing = [w for w in inplace
+                          if crosses(w.domains, t.domains)
+                          and not (w.locks & t.locks)]
+                if not racing:
+                    continue
+                emit(
+                    t.line,
+                    f"self.{attr} of {cls} read on the {_fmt(t.domains)} "
+                    f"domain ({t.fn}) while mutated in place on "
+                    f"{_fmt(wdoms)} ({', '.join(wfns)}) — iterate a "
+                    "list()/dict() snapshot, hold the writer's lock, or "
+                    "marshal via call_soon_threadsafe",
+                )
+        out.sort(key=lambda f: (f.file, f.line))
+        return out
+
+    def domains_of(self) -> Dict[str, Set[str]]:
+        """``"<rel>:<qual>" → domains`` — fixture introspection."""
+        return {f"{f.mod.rel}:{f.qual}": set(f.domains)
+                for f in self.fns.values()}
+
+
+def infer_domains(mods: Sequence[SourceModule]) -> Dict[str, Set[str]]:
+    return DomainAnalysis(mods).domains_of()
+
+
+class CrossDomainRaceRule(ProjectRule):
+    name = "cross-domain-race"
+    description = (
+        "self.<attr> state written in one thread domain "
+        "(loop/executor/thread) and touched in another without a "
+        "recognized marshalling idiom (lock both sides, queue handoff, "
+        "snapshot publish/read, call_soon_threadsafe)"
+    )
+
+    def check_project(
+        self, mods: Sequence[SourceModule]
+    ) -> Iterator[Finding]:
+        return iter(DomainAnalysis(mods).findings())

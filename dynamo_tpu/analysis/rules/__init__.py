@@ -6,12 +6,14 @@ from __future__ import annotations
 from typing import Dict, List, Sequence
 
 from ..core import Rule
+from ..domains import CrossDomainRaceRule
 from .async_blocking import AsyncBlockingRule
 from .jit_impure import JitImpureRule
 from .lock_across_await import LockAcrossAwaitRule
 from .metric_name import MetricNameRule
 from .silent_except import SilentExceptRule
 from .task_leak import TaskLeakRule
+from .wallclock_sim import WallclockInSimRule
 
 _RULE_CLASSES = (
     AsyncBlockingRule,
@@ -20,6 +22,8 @@ _RULE_CLASSES = (
     JitImpureRule,
     SilentExceptRule,
     MetricNameRule,
+    WallclockInSimRule,
+    CrossDomainRaceRule,
 )
 
 

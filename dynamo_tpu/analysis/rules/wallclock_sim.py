@@ -1,0 +1,75 @@
+"""wallclock-in-sim: the fleet simulator must never read the wall clock.
+
+The simulator's whole contract (PR 16) is byte-identical reports for a
+given (scenario, seed): every timestamp comes from the virtual
+``SimClock``, and the 1000x speedup exists precisely because nothing
+sleeps. One ``time.time()`` in a sim model silently breaks both — the
+report diverges between runs and the regression gate starts flaking.
+This started life as a regex scan inside ``tests/test_fleetsim.py``;
+promoted to a dynlint rule so it gets suppressions, the baseline
+ratchet, ``--format=github`` CI annotations, and per-line precision
+instead of a per-file assert.
+
+Scoped to ``dynamo_tpu/sim/`` only — the rest of the codebase reads the
+wall clock legitimately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, Rule, SourceModule
+
+__all__ = ["WallclockInSimRule"]
+
+_BANNED_CALLS = {
+    "time.time": "use the scenario's SimClock, not the wall clock",
+    "time.time_ns": "use the scenario's SimClock, not the wall clock",
+    "time.monotonic": "use the scenario's SimClock, not the wall clock",
+    "time.monotonic_ns": "use the scenario's SimClock, not the wall clock",
+    "time.perf_counter": "use the scenario's SimClock, not the wall clock",
+    "time.perf_counter_ns": "use the scenario's SimClock, not the wall clock",
+    "time.sleep": "advance virtual time via the event heap, never sleep",
+    "datetime.datetime.now": "derive timestamps from virtual time",
+    "datetime.datetime.utcnow": "derive timestamps from virtual time",
+    "datetime.date.today": "derive dates from virtual time",
+}
+
+
+class WallclockInSimRule(Rule):
+    name = "wallclock-in-sim"
+    description = (
+        "wall-clock read (time.time/monotonic/perf_counter/sleep, "
+        "datetime.now, loop.time) inside dynamo_tpu/sim/ — the simulator "
+        "must run on virtual time only"
+    )
+
+    def check(self, mod: SourceModule) -> Iterator[Finding]:
+        if not mod.rel.startswith("dynamo_tpu/sim/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.resolve_call(node.func)
+            if dotted in _BANNED_CALLS:
+                yield mod.finding(
+                    self.name, node,
+                    f"{dotted}() in the simulator — {_BANNED_CALLS[dotted]}",
+                )
+                continue
+            # loop.time(): the running asyncio loop's clock is wall-time
+            # derived too; match <name containing "loop">.time()
+            func = node.func
+            if (
+                dotted is None
+                and isinstance(func, ast.Attribute)
+                and func.attr == "time"
+                and isinstance(func.value, ast.Name)
+                and "loop" in func.value.id
+            ):
+                yield mod.finding(
+                    self.name, node,
+                    f"{func.value.id}.time() in the simulator — the event "
+                    "loop clock is wall-clock derived; use virtual time",
+                )

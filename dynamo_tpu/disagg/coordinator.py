@@ -88,11 +88,13 @@ class RemotePrefillCoordinator:
         self.registry.callback_gauge(
             "dynamo_disagg_pending_requests",
             "Remote prefills submitted and not yet committed",
+            # dynrace: domain(executor)
             lambda: len(self._pending),
         )
         self.registry.callback_gauge(
             "dynamo_disagg_queue_depth_requests",
             "Prefill work-queue depth (cached; refreshed periodically)",
+            # dynrace: domain(executor)
             lambda: self._queue_depth,
         )
 

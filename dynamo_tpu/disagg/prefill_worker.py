@@ -184,6 +184,7 @@ class PrefillWorker:
         self.registry.callback_gauge(
             "dynamo_prefill_worker_kv_active_blocks",
             "KV blocks held by in-flight prefills + this worker's prefix cache",
+            # dynrace: domain(executor)
             lambda: self.allocator.used,
         )
         self.registry.callback_gauge(
@@ -191,6 +192,7 @@ class PrefillWorker:
             "Prompt tokens skipped via this worker's own prefix cache / "
             "total prompt tokens (mirror of the scheduler's "
             "dynamo_kv_prefix_hit_ratio)",
+            # dynrace: domain(executor)
             lambda: (
                 self.prefix_hit_tokens / self.prefix_total_tokens
                 if self.prefix_total_tokens else 0.0

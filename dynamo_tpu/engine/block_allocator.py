@@ -155,14 +155,17 @@ class BlockAllocator:
         )
         registry.callback_gauge(
             "dynamo_kv_active_blocks", "KV blocks in use",
+            # dynrace: domain(executor)
             lambda: self.used,
         )
         registry.callback_gauge(
             "dynamo_kv_total_blocks", "KV cache capacity in blocks",
+            # dynrace: domain(executor)
             lambda: self.num_blocks,
         )
         registry.callback_gauge(
             "dynamo_kv_block_usage_ratio", "used / total KV blocks",
+            # dynrace: domain(executor)
             lambda: self.usage(),
         )
 

@@ -655,6 +655,7 @@ class Scheduler:
             "dynamo_engine_decode_pipeline_depth",
             "Decode dispatch depth in effect: 2 while a burst is in "
             "flight ahead of host reconciliation, else 1",
+            # dynrace: domain(executor)
             lambda: 2 if (self._inflight is not None or self._chain) else 1,
         )
         self._device_finished_ctr = reg.counter(
@@ -676,6 +677,7 @@ class Scheduler:
             "open chain's running count, else the last completed "
             "chain's length (>1 means the host barrier is no longer "
             "per burst)",
+            # dynrace: domain(executor)
             lambda: self._chain_dispatched or self._last_chain_len,
         )
         self._sync_fallback_ctr = reg.counter(
@@ -711,6 +713,7 @@ class Scheduler:
             "dynamo_engine_prefill_sp_axis_depth",
             "Size of the mesh's sequence-parallel axis (1 = the SP "
             "program is not built; long prompts take the dense ladder)",
+            # dynrace: domain(executor)
             lambda: self.config.sp_size,
         )
         self._sp_exposed_h = reg.histogram(
@@ -733,18 +736,23 @@ class Scheduler:
         reg.callback_gauge(
             "dynamo_scheduler_active_slots",
             "Batch slots currently decoding or prefilling",
-            lambda: sum(1 for s in self.slots if s is not None),
+            # off-loop render vs loop-side slot assignment: count over a
+            # list() snapshot, never the live slot table
+            # dynrace: domain(executor)
+            lambda: sum(1 for s in list(self.slots) if s is not None),
         )
         reg.callback_gauge(
             "dynamo_scheduler_total_slots",
             "Configured max_batch_size",
+            # dynrace: domain(executor)
             lambda: self.config.max_batch_size,
         )
         reg.callback_gauge(
             "dynamo_scheduler_slot_occupancy_ratio",
             "active_slots / total_slots",
+            # dynrace: domain(executor)
             lambda: (
-                sum(1 for s in self.slots if s is not None)
+                sum(1 for s in list(self.slots) if s is not None)
                 / self.config.max_batch_size
             ),
         )
@@ -752,6 +760,7 @@ class Scheduler:
             "dynamo_scheduler_waiting_requests",
             "Admission queue depth (local waiting + pending remote "
             "prefill + pending prefix pulls)",
+            # dynrace: domain(executor)
             lambda: (len(self.waiting) + len(self.pending_remote)
                      + len(self.pending_pull)),
         )
@@ -760,11 +769,13 @@ class Scheduler:
             "1 while this engine is gated for drain/recovery (admission "
             "refused, routers skip it) — the fleet hub's per-worker "
             "drain-state column reads this",
+            # dynrace: domain(executor)
             lambda: 1.0 if self.draining else 0.0,
         )
         reg.callback_gauge(
             "dynamo_kv_prefix_hit_ratio",
             "Prompt tokens served from the prefix cache / all prompt tokens",
+            # dynrace: domain(executor)
             lambda: (
                 self.prefix_hit_tokens / self.prefix_total_tokens
                 if self.prefix_total_tokens else 0.0

@@ -142,6 +142,7 @@ class KvColdTier:
         registry.callback_gauge(
             "dynamo_kv_fabric_cold_tier_bytes",
             "Payload bytes resident in this process's cold-tier index",
+            # dynrace: domain(executor)
             lambda: float(self._bytes),
         )
 

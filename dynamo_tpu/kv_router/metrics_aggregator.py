@@ -83,10 +83,15 @@ class KvMetricsAggregator:
         MetricsRegistry (the router-side /metrics surface)."""
 
         def per_worker(field: str) -> Callable[[], List[Tuple[dict, float]]]:
+            # renders off-loop while the poll loop inserts/expires
+            # workers — iterate a snapshot, or a scrape racing a sync
+            # raises "dictionary changed size during iteration" and the
+            # gauge silently vanishes from /metrics
+            # dynrace: domain(executor)
             def collect():
                 return [
                     ({"instance": iid}, float(getattr(m, field)))
-                    for iid, m in self.endpoints.items()
+                    for iid, m in list(self.endpoints.items())
                 ]
             return collect
 
@@ -118,9 +123,10 @@ class KvMetricsAggregator:
         registry.callback_gauge(
             f"{prefix}_kv_router_worker_staleness_seconds",
             "Age of the worker's last successful stats scrape",
+            # dynrace: domain(executor)
             lambda: [
                 ({"instance": iid}, time.monotonic() - t)
-                for iid, t in self.last_update.items()
+                for iid, t in list(self.last_update.items())
             ],
         )
 

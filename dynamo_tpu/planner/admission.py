@@ -132,6 +132,7 @@ class AdmissionController:
         self.registry.callback_gauge(
             "dynamo_planner_admission_queue_depth_requests",
             "Requests waiting in the admission queue, by priority=",
+            # dynrace: domain(executor)
             lambda: [
                 ({"priority": PRIORITY_CLASSES[level]}, self.queue_depth(level))
                 for level in self._queues
@@ -140,16 +141,19 @@ class AdmissionController:
         self.registry.callback_gauge(
             "dynamo_planner_inflight_requests",
             "Requests admitted and not yet released",
+            # dynrace: domain(executor)
             lambda: self._inflight,
         )
         self.registry.callback_gauge(
             "dynamo_planner_admission_limit_requests",
             "Current admission concurrency limit (0 = unbounded)",
+            # dynrace: domain(executor)
             lambda: self.limit,
         )
         self.registry.callback_gauge(
             "dynamo_planner_shedding_info",
             "1 when the priority= class is currently being shed",
+            # dynrace: domain(executor)
             lambda: [
                 ({"priority": PRIORITY_CLASSES[level]},
                  1 if level < self.shed_level else 0)

@@ -93,11 +93,13 @@ class Planner:
         self.registry.callback_gauge(
             "dynamo_planner_shed_level_depth",
             "Priority classes currently shed from the bottom (policy)",
+            # dynrace: domain(executor)
             lambda: self.policy.shed_level,
         )
         self.registry.callback_gauge(
             "dynamo_planner_local_prefill_threshold_tokens",
             "Policy's current disagg local/remote prefill threshold",
+            # dynrace: domain(executor)
             lambda: self.policy.local_prefill_length,
         )
 

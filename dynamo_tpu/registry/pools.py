@@ -113,6 +113,7 @@ class PoolManager:
         self.registry.callback_gauge(
             "dynamo_registry_pool_workers_replicas",
             "Live workers per model pool, labelled model=",
+            # dynrace: domain(executor)
             lambda: [
                 ({"model": name}, self.pool_size(name))
                 for name in sorted(self._pools)

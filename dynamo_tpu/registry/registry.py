@@ -39,6 +39,7 @@ class ModelRegistry:
         self.registry.callback_gauge(
             "dynamo_registry_models_info",
             "1 per registered model card, labelled model= and family=",
+            # dynrace: domain(executor)
             lambda: [
                 ({"model": name, "family": card.family or "unknown"}, 1)
                 for name, card in sorted(self.cards.items())

@@ -195,8 +195,13 @@ class DeviceTimeTracker:
 
     def _samples(self):
         cutoff = self.clock() - self.window_s
-        return [s for s in self._window if s[0] >= cutoff]
+        # list() first: this renders off-loop while the reconciliation
+        # seams append — iterating the live deque during an append
+        # raises "deque mutated during iteration"
+        return [s for s in list(self._window) if s[0] >= cutoff]
 
+    # registry render callbacks — run wherever /metrics renders
+    # dynrace: domain(executor)
     def _busy_ratios(self):
         samples = self._samples()
         agg: dict = {}
@@ -209,6 +214,7 @@ class DeviceTimeTracker:
                 out.append(({"phase": phase}, busy / (busy + bubble)))
         return out
 
+    # dynrace: domain(executor)
     def _roofline(self):
         if not self.peak_bytes_per_s:
             return []

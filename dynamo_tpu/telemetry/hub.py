@@ -177,16 +177,19 @@ class FleetHub:
             "Fleet mean decode slot occupancy, by role= (the hub-side "
             "rollup a Prometheus avg() should agree with — grafana "
             "panel 25 plots both)",
+            # dynrace: domain(executor)
             lambda: self._rollup_gauge("dynamo_scheduler_slot_occupancy_ratio"),
         )
         self.registry.callback_gauge(
             "dynamo_hub_fleet_kv_usage_ratio",
             "Fleet mean KV block usage, by role=",
+            # dynrace: domain(executor)
             lambda: self._rollup_gauge("dynamo_kv_block_usage_ratio"),
         )
         self.registry.callback_gauge(
             "dynamo_hub_history_series_depth",
             "History-ring series held across all tracked workers",
+            # dynrace: domain(executor)
             lambda: sum(w.history.series_count()
                         for w in list(self._workers.values())),
         )
@@ -350,6 +353,10 @@ class FleetHub:
         return (self.clock() - w.last_ok_t) <= max(
             3 * self.interval_s, self.timeout_s)
 
+    # registry render callback: runs wherever /metrics renders (loop
+    # handler, hub executor offload, flight-dump thread) — reads must be
+    # snapshot-safe
+    # dynrace: domain(executor)
     def _worker_counts(self):
         counts: Dict[tuple, int] = {}
         for w in list(self._workers.values()):
@@ -358,6 +365,7 @@ class FleetHub:
         return [({"role": role, "up": up}, n)
                 for (role, up), n in sorted(counts.items())]
 
+    # dynrace: domain(executor)
     def _rollup_gauge(self, name: str):
         by_role: Dict[str, List[float]] = {}
         for w in list(self._workers.values()):
@@ -504,6 +512,8 @@ class FleetHub:
         (planner/policy.py SIG_*): the planner consults the POOL, not
         whichever single scrape it happens to sit next to."""
 
+        # the planner polls this from its own loop/executor context
+        # dynrace: domain(executor)
         def snapshot() -> Dict[str, float]:
             busy: List[float] = []
             kv: List[float] = []

@@ -142,6 +142,7 @@ class TraceRecorder:
         registry.callback_gauge(
             "dynamo_trace_store_requests",
             "Completed traces currently held in the debug store",
+            # dynrace: domain(executor)
             lambda: len(self._traces),
         )
 

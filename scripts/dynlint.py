@@ -12,6 +12,13 @@ Usage:
         Emit ::error workflow commands for CI annotations.
     python scripts/dynlint.py --list-rules
         Print the rule catalog.
+    python scripts/dynlint.py --changed[=<git-ref>]
+        Report findings only for files differing from <git-ref>
+        (default HEAD) plus untracked files — the pre-commit fast
+        path. The whole package is still PARSED (interprocedural
+        rules need the full call graph for context); only the
+        reporting is scoped, so a verdict about a changed file never
+        flips because its callers didn't change.
 
 Options:
     --baseline PATH   baseline file (default scripts/dynlint_baseline.json)
@@ -27,8 +34,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List
+from typing import List, Optional, Set
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
@@ -44,6 +52,31 @@ from dynamo_tpu.analysis import (  # noqa: E402
 )
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "scripts", "dynlint_baseline.json")
+
+
+def changed_files(ref: str) -> Set[str]:
+    """Report-relative keys of .py files differing from ``ref`` (plus
+    untracked ones), for ``--changed`` scoping. Raises CalledProcessError
+    on a bad ref — a typo'd ref must not read as "nothing changed"."""
+    from dynamo_tpu.analysis.core import report_rel
+
+    diffed = subprocess.run(
+        ["git", "-C", REPO_ROOT, "diff", "--name-only", ref, "--"],
+        check=True, capture_output=True, text=True,
+    ).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "-C", REPO_ROOT, "ls-files", "--others",
+         "--exclude-standard"],
+        check=True, capture_output=True, text=True,
+    ).stdout.splitlines()
+    out: Set[str] = set()
+    for rel in diffed + untracked:
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(REPO_ROOT, rel)
+        if os.path.exists(path):  # deleted files have no findings
+            out.add(report_rel(path))
+    return out
 
 
 def main(argv: List[str]) -> int:
@@ -63,6 +96,10 @@ def main(argv: List[str]) -> int:
     parser.add_argument("--rules", default=None,
                         help="comma-separated subset of rules to run")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                        metavar="GIT_REF",
+                        help="report findings only for files differing "
+                             "from GIT_REF (default HEAD) or untracked")
     try:
         args = parser.parse_args(argv[1:])
     except SystemExit as e:
@@ -81,8 +118,21 @@ def main(argv: List[str]) -> int:
             print(f"dynlint: {e.args[0]}", file=sys.stderr)
             return 2
 
+    only: Optional[Set[str]] = None
+    if args.changed is not None:
+        try:
+            only = changed_files(args.changed)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            print(f"dynlint: --changed failed: {detail.strip()}",
+                  file=sys.stderr)
+            return 2
+        if not only:
+            print(f"dynlint clean: no .py files changed vs {args.changed}")
+            return 0
+
     try:
-        findings = lint_paths(args.paths, rules)
+        findings = lint_paths(args.paths, rules, only_files=only)
     except FileNotFoundError as e:
         print(f"dynlint: {e}", file=sys.stderr)
         return 2
@@ -91,15 +141,16 @@ def main(argv: List[str]) -> int:
         # the baseline is rewritten WHOLE from this run's findings: a
         # narrowed scope would silently delete every entry outside it
         default_scope = [os.path.join(REPO_ROOT, "dynamo_tpu")]
-        narrowed = args.rules or (
+        narrowed = args.rules or args.changed is not None or (
             [os.path.abspath(p) for p in args.paths]
             != [os.path.abspath(p) for p in default_scope]
         )
         if narrowed and args.baseline == DEFAULT_BASELINE:
-            print("dynlint: refusing --update-baseline with --rules or a "
-                  "narrowed path scope — it would drop every out-of-scope "
-                  "entry from the shared baseline. Run it bare, or point "
-                  "--baseline at a different file.", file=sys.stderr)
+            print("dynlint: refusing --update-baseline with --rules, "
+                  "--changed, or a narrowed path scope — it would drop "
+                  "every out-of-scope entry from the shared baseline. Run "
+                  "it bare, or point --baseline at a different file.",
+                  file=sys.stderr)
             return 2
         entries = write_baseline(args.baseline, findings)
         print(f"baseline written: {len(entries)} unique finding(s) "
@@ -107,6 +158,11 @@ def main(argv: List[str]) -> int:
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    if only is not None:
+        # keep only the changed files' debt: an unchanged file's baseline
+        # entry must not read as stale just because it wasn't scanned
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split(":", 1)[0] in only}
     diff = diff_against_baseline(findings, baseline)
 
     render = (lambda f: f.render_github()) if args.format == "github" \

@@ -14,7 +14,6 @@ nothing under sim/ reads the wall clock.
 import asyncio
 import json
 import os
-import re
 
 import pytest
 
@@ -242,20 +241,20 @@ def test_report_differs_across_seeds():
 
 
 def test_no_wall_clock_reads_in_sim_package():
-    """Determinism depends on virtual time only: nothing under sim/
-    may consult the wall clock (or salted hash randomness)."""
+    """Determinism depends on virtual time only: nothing under sim/ may
+    consult the wall clock. Enforced by the dynlint ``wallclock-in-sim``
+    rule (which replaced this test's original regex scan — the rule
+    resolves import aliases, knows call sites from strings in comments,
+    and supports per-line suppressions); this pin keeps the sim package
+    at ZERO findings so new wall-clock reads fail here, not just in the
+    lint step."""
+    from dynamo_tpu.analysis.core import lint_paths
+    from dynamo_tpu.analysis.rules import get_rules
+
     sim_dir = os.path.join(
         os.path.dirname(__file__), "..", "dynamo_tpu", "sim")
-    banned = re.compile(
-        r"time\.time\(|time\.monotonic\(|time\.perf_counter\(|"
-        r"datetime\.now|utcnow|time\.sleep\(")
-    for name in sorted(os.listdir(sim_dir)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(sim_dir, name), encoding="utf-8") as f:
-            src = f.read()
-        hits = banned.findall(src)
-        assert not hits, f"sim/{name} reads the wall clock: {hits}"
+    findings = lint_paths([sim_dir], get_rules(["wallclock-in-sim"]))
+    assert findings == [], [f.render() for f in findings]
 
 
 # ---------------------------------------------------------------------------
