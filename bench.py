@@ -411,6 +411,113 @@ def run_sp_prefill(ctx: int) -> dict:
     }
 
 
+def run_ici_pull(nblocks: int = 0, chunk: int = 16) -> dict:
+    """The unified-transfer-plane payload lever (xla:k8:ici-pull): KV
+    block throughput of the ici (device-to-device collective) payload
+    path vs the tcp fallback, through the REAL plane seams — the tcp
+    side pays the full framing bill (executor byte-pack, socket frames,
+    decode, host→device install), the ici side enters the collective
+    plane with device arrays and the host touches only headers.
+
+    On hardware the collective rides the actual interconnect; CPU smoke
+    (BENCH_SMOKE=1) runs the loopback plane (transfer/ici.py), so the
+    framing, one-in-flight pairing, and seq cross-check are exercised
+    creds-free — there the RATIO is the logic check, not a perf claim.
+    """
+    import asyncio
+    import os
+
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from dynamo_tpu.transfer import (
+        IciBackend,
+        LoopbackIciTransfer,
+        TcpBackend,
+        pack_frame,
+        read_header,
+    )
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if not nblocks:
+        nblocks = 128 if smoke else 2048
+    bs, heads, hd = (16, 2, 32) if smoke else (16, 8, 128)
+    frames = [
+        (jnp.asarray(_np.random.default_rng(i).standard_normal(
+            (1, chunk, bs, heads, hd), dtype=_np.float32)),) * 2
+        for i in range(nblocks // chunk)
+    ]
+    frame_bytes = 2 * int(frames[0][0].nbytes)
+
+    async def tcp_pass() -> float:
+        done = asyncio.Event()
+
+        async def handle(reader, writer):
+            while True:
+                header = await read_header(reader, "bench")
+                if header is None or header.get("type") == "end":
+                    break
+                k, v = await TcpBackend.recv_blocks(reader, header)
+                # the install cost a real pull pays before scatter
+                jnp.asarray(k).block_until_ready()
+                jnp.asarray(v).block_until_ready()
+            done.set()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        t0 = time.perf_counter()
+        for i, (k, v) in enumerate(frames):
+            await TcpBackend.send_blocks(
+                writer, {"type": "blocks", "offset": i * chunk}, k, v)
+        pack_frame(writer, {"type": "end"})
+        await writer.drain()
+        await done.wait()
+        wall = time.perf_counter() - t0
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return wall
+
+    async def ici_pass() -> float:
+        lb = LoopbackIciTransfer(buckets=(chunk,))
+        tx, rx = IciBackend(lb), IciBackend(lb)
+
+        async def pull():
+            for _ in frames:
+                k, v, _seq = await rx.recv(chunk)
+                k.block_until_ready()
+                v.block_until_ready()
+
+        t0 = time.perf_counter()
+        task = asyncio.ensure_future(pull())
+        for k, v in frames:
+            await tx.send(k, v, tx.next_seq(), chunk)
+        await task
+        return time.perf_counter() - t0
+
+    loop = asyncio.new_event_loop()
+    try:
+        tcp_s = loop.run_until_complete(tcp_pass())  # warm executor/socket
+        tcp_s = min(tcp_s, loop.run_until_complete(tcp_pass()))
+        ici_s = loop.run_until_complete(ici_pass())
+        ici_s = min(ici_s, loop.run_until_complete(ici_pass()))
+    finally:
+        loop.close()
+    return {
+        "metric": "kv_pull_blocks_per_sec_ici",
+        "value": round(nblocks / ici_s, 1),
+        "unit": "blocks/s",
+        "tcp_blocks_per_s": round(nblocks / tcp_s, 1),
+        "speedup_vs_tcp": round(tcp_s / ici_s, 3),
+        "nblocks": nblocks,
+        "chunk_blocks": chunk,
+        "frame_bytes": frame_bytes,
+        "smoke": smoke,
+    }
+
+
 # one JSON line per attempt/probe outcome, appended as they happen: the
 # driver's BENCH_r*.json keeps only the winning line, so when a round
 # goes sideways (wedged relay, timeouts) this sidecar is the record of
@@ -541,6 +648,42 @@ def _run_sp_subprocess(ctx: int, timeout_s: float):
     )
     t0 = time.monotonic()
     rec = {"label": label, "ctx": ctx, "timeout_s": round(timeout_s, 1)}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            cwd=_os.path.dirname(_os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench[{label}] timed out after {timeout_s:.0f}s", flush=True)
+        _log_attempt(dict(rec, rc=124, wall_s=round(
+            time.monotonic() - t0, 1), error="timeout"))
+        return None
+    wall = round(time.monotonic() - t0, 1)
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            result = json.loads(line[len("BENCH_RESULT "):])
+            _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                              result=result))
+            return result
+    print(f"bench[{label}] failed (rc={proc.returncode})", flush=True)
+    _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                      error=(proc.stderr[-500:] or "no result line")))
+    return None
+
+
+def _run_ici_pull_subprocess(timeout_s: float):
+    """One ici-pull lever attempt in a child with a hard timeout."""
+    import subprocess
+    import sys
+
+    label = "xla:k8:ici-pull"
+    code = (
+        "import json; from bench import run_ici_pull; "
+        "print('BENCH_RESULT ' + json.dumps(run_ici_pull()))"
+    )
+    t0 = time.monotonic()
+    rec = {"label": label, "timeout_s": round(timeout_s, 1)}
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -780,6 +923,17 @@ def main() -> None:
         sp_res = _run_sp_subprocess(
             sp_ctx, timeout_s=min(420.0, remaining - 180))
         note(f"xla:k8:sp-prefill:ctx{sp_ctx}", sp_res)
+
+    # the unified-transfer-plane payload lever (xla:k8:ici-pull;
+    # docs/transfer_plane.md): KV block throughput of the ici
+    # device-to-device path vs the tcp framing fallback. A different
+    # metric family — it rides the attempt sidecar and the lever table,
+    # never the decode headline.
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 150 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        pull_res = _run_ici_pull_subprocess(
+            timeout_s=min(240.0, remaining - 90))
+        note("xla:k8:ici-pull", pull_res)
 
     remaining = total_budget - (_time.monotonic() - t0)
     if remaining > 240 and not os.environ.get("BENCH_XLA_ONLY"):

@@ -44,6 +44,11 @@ UNIT_SUFFIXES = (
     "total", "seconds", "bytes", "tokens", "blocks",
     "requests", "slots", "ratio", "info", "depth", "replicas", "length",
     "fraction",
+    # "channels" admitted deliberately with the unified transfer plane's
+    # live-channel gauge (dynamo_transfer_channels): a count of open
+    # plane connections per {plane,backend} pair — "requests" would
+    # misread channels as workload volume
+    "channels",
 )
 # what a histogram may measure. "length" admitted deliberately with the
 # speculative acceptance-length histogram (dynamo_engine_spec_accept_

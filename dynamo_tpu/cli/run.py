@@ -596,7 +596,8 @@ async def build_engine(engine_spec: str, flags, drt=None, events=None):
 
 async def _setup_self_healing(flags, core, admission=None, drt=None,
                               component: str = "backend",
-                              peer_ranker=None, instance_id: str = ""):
+                              peer_ranker=None, instance_id: str = "",
+                              ici=None):
     """--self-heal wiring: a RecoveryController per engine plus (native
     engines) a migration receiver for peers draining TOWARD this worker.
 
@@ -644,7 +645,10 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
     engine_id = f"eng-{_uuid.uuid4().hex[:12]}"
     sink = MigrationSink(scheduler, core.runner)
     server = await MigrationServer(
-        sink, host=flags.advertise_host, port=flags.migrate_port
+        sink, host=flags.advertise_host, port=flags.migrate_port,
+        ici=ici,
+        ici_rank=None if ici is None else getattr(ici, "receiver_rank",
+                                                  None),
     ).start()
 
     static_peers = [
@@ -713,6 +717,7 @@ async def _setup_self_healing(flags, core, admission=None, drt=None,
         admission=admission,
         config=config,
         peer_ranker=peer_ranker,
+        ici=ici,
     )
     return controller, server
 
@@ -754,7 +759,7 @@ def _pool_scope_peers(peers: dict, endpoint_records: dict,
 
 async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
                            endpoint=None, instance_id: str = "",
-                           model: str = ""):
+                           model: str = "", ici=None):
     """Cluster-KV-fabric wiring for a token-level worker.
 
     The engine already built its fabric half (Scheduler.fabric — cold
@@ -791,13 +796,21 @@ async def _setup_kv_fabric(flags, core, drt=None, component: str = "backend",
         # cold-tier-only configuration: local disk spill was the opt-in,
         # not cross-worker networking — no pull server, no peer view
         return fabric
+    if ici is not None:
+        # intra-pod peers negotiate device-to-device pulls off this
+        # plane; the descriptor below advertises it
+        fabric.set_ici(ici)
     server = await fabric.serve(host=flags.advertise_host)
     if drt is None or endpoint is None:
         return fabric
     key = fabric_key(flags.namespace, component, fabric.engine_id)
+    # the pull server's descriptor carries modes (+ ici_rank) so peers
+    # can negotiate the transfer backend per pair — TCP stays the
+    # universal fallback
     desc = _msgpack.packb(
-        {"host": flags.advertise_host, "port": server.port,
-         "engine_id": fabric.engine_id},
+        dict(getattr(server, "descriptor", None)
+             or {"host": flags.advertise_host, "port": server.port},
+             engine_id=fabric.engine_id),
         use_bin_type=True,
     )
     lease = await drt.discovery.primary_lease()
@@ -1386,11 +1399,21 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
             metadata={"model": model_name},
         )
         _advertise_model(getattr(core, "registry", None), model_name)
+        # one ICI plane per worker, shared by the fabric pull path and
+        # hot migration — a single collective-ordering lock means the
+        # two planes can never interleave (mis-pair) their collectives
+        ici = None
+        if getattr(core, "runner", None) is not None:
+            raw_ici = _make_ici(flags, core.runner)
+            if raw_ici is not None:
+                from ..transfer.ici import IciBackend
+
+                ici = IciBackend(raw_ici)
         # cluster KV fabric: pull server + peer/ownership view, keyed by
         # the same instance id the KV event publisher stamps
         fabric = await _setup_kv_fabric(
             flags, core, drt=drt, component=comp, endpoint=endpoint,
-            instance_id=instance_id, model=model_name,
+            instance_id=instance_id, model=model_name, ici=ici,
         )
         recovery = None
         if flags.self_heal:
@@ -1403,7 +1426,7 @@ async def run_worker(flags, engine_spec: str, path: str) -> None:
                 flags, core, drt=drt, component=comp,
                 peer_ranker=fabric.rank_peers if fabric is not None
                 else None,
-                instance_id=instance_id,
+                instance_id=instance_id, ici=ici,
             )
             if recovery is not None:
                 recovery.attach()

@@ -55,66 +55,17 @@ from ..engine.scheduler import build_prefill_arrays, prefill_bucket_cap
 from ..telemetry.flight import flight_recorder
 from ..telemetry.registry import MetricsRegistry
 from ..tokens import compute_block_hashes
+from ..transfer.ici import settle_collective_send
+from ..transfer.plane import (
+    FramePipe,
+    TransferMetrics,
+    negotiate_backend,
+    record_open,
+)
 from .protocols import PrefillQueue, RemotePrefillRequest
 from .transfer import KvTransferClient, transfer_key
 
 logger = logging.getLogger(__name__)
-
-
-class _FramePipe:
-    """Bounded conveyor between the chunk loop and one transfer pump.
-
-    The producer (``_handle``'s chunk loop) dispatches device gathers and
-    enqueues (k_dev, v_dev, dst_ids) frames; the pump coroutine drains
-    them to the wire. ``maxsize=1`` plus the pump's one-frame lookahead
-    bounds live buffers: at most two chunk-sized frames exist in host
-    memory at any point (one being packed, one on the wire), regardless
-    of prompt length.
-    """
-
-    def __init__(self, depth: int, frame_blocks: int):
-        self.depth = depth  # 1 = strictly serial frames, 2 = double-buffered
-        self.frame_blocks = frame_blocks  # max KV blocks per frame
-        self.q: asyncio.Queue = asyncio.Queue(maxsize=1)
-        self.closed = False  # pump consumed the end-of-stream sentinel
-        self.error: Optional[BaseException] = None
-        self.nbytes = 0
-        self.frames = 0
-        self.first_frame_t: Optional[float] = None
-        self.live_host_frames = 0
-        self.max_live_host_frames = 0
-        self.task: Optional[asyncio.Task] = None
-
-    async def put(self, frame) -> None:
-        if self.error is not None:
-            raise self.error
-        if self.first_frame_t is None:
-            self.first_frame_t = time.monotonic()
-        await self.q.put(frame)
-        # the pump may have failed while we were blocked on the queue
-        if self.error is not None:
-            raise self.error
-
-    async def drain(self) -> int:
-        """Flush: every enqueued frame is on the wire (or the pump's
-        failure is re-raised). Must be awaited before the commit frame."""
-        await self.q.put(None)
-        await self.task
-        if self.error is not None:
-            raise self.error
-        return self.nbytes
-
-    async def shutdown(self) -> None:
-        """Abnormal-exit cleanup: the happy path already joined the pump
-        via drain(); anything else is an error/cancel path where the
-        connection is being torn down anyway — cancel outright."""
-        if self.task is not None and not self.task.done():
-            self.task.cancel()
-            try:
-                await self.task
-            # dynlint: allow(silent-except) - cancel-join of an abandoned pump; the originating error already propagated via pipe.error
-            except BaseException:
-                pass
 
 
 class PrefillWorker:
@@ -163,24 +114,15 @@ class PrefillWorker:
             "dynamo_prefill_worker_prefill_tokens_total",
             "Prompt tokens actually computed (prefix-cache hits excluded)",
         )
-        self._transfer_bytes_c = self.registry.counter(
-            "dynamo_prefill_worker_transfer_bytes_total",
-            "KV payload bytes shipped to decode engines (both planes)",
-        )
         self._queue_wait_h = self.registry.histogram(
             "dynamo_prefill_worker_queue_wait_seconds",
             "Queue latency: decode-side enqueue → this worker's pop",
         )
-        self._transfer_h = self.registry.histogram(
-            "dynamo_disagg_transfer_duration_seconds",
-            "KV transfer wall time: first frame enqueued → commit acked",
-        )
-        self._exposed_h = self.registry.histogram(
-            "dynamo_disagg_transfer_exposed_seconds",
-            "Non-overlapped transfer tail: time spent shipping KV (and the "
-            "commit RTT) AFTER the last prefill chunk's compute finished — "
-            "0 means the transfer fully hid behind compute",
-        )
+        # the unified dynamo_transfer_* family (docs/transfer_plane.md),
+        # labelled {plane=disagg, backend=tcp|ici} — replaces the retired
+        # dynamo_prefill_worker_transfer_bytes_total and
+        # dynamo_disagg_transfer_{duration,exposed}_seconds instruments
+        self._xfer = TransferMetrics(self.registry, plane="disagg")
         self.registry.callback_gauge(
             "dynamo_prefill_worker_kv_active_blocks",
             "KV blocks held by in-flight prefills + this worker's prefix cache",
@@ -250,6 +192,8 @@ class PrefillWorker:
                              rpr.trace_id or rpr.request_id)
             stale = self._clients.pop(rpr.engine_id, None)
             if stale is not None:
+                self._xfer.channel_closed(
+                    getattr(stale, "plane_backend", "tcp"))
                 await stale.close()
             return True
         ack()
@@ -277,10 +221,11 @@ class PrefillWorker:
         loop = asyncio.get_running_loop()
 
         block_ids, num_cached = self.allocator.allocate_prompt(prompt)
-        pipe: Optional[_FramePipe] = None
+        pipe: Optional[FramePipe] = None
         try:
             client = await self._client(rpr.engine_id)
             use_ici = self.ici is not None and self._ici_usable(client)
+            backend = "ici" if use_ici else "tcp"
 
             if rpr.seed is not None:
                 # same key derivation as the decode scheduler's local path:
@@ -409,7 +354,7 @@ class PrefillWorker:
             nbytes = await pipe.drain()
             # every frame is on the wire: the transfer tail that did NOT
             # hide behind compute closes here (the stitched-trace twin of
-            # dynamo_disagg_transfer_exposed_seconds)
+            # dynamo_transfer_exposed_seconds{plane="disagg"})
             ctx.add_stage("prefill.transfer")
             committed = await client.send_commit(
                 rpr.request_id, token, lp if rpr.want_logprobs else None,
@@ -425,8 +370,10 @@ class PrefillWorker:
             )
             t_done = time.monotonic()
             if pipe.first_frame_t is not None:
-                self._transfer_h.observe(t_done - pipe.first_frame_t)
-                self._exposed_h.observe(max(0.0, t_done - t_compute_done))
+                self._xfer.observe_duration(
+                    t_done - pipe.first_frame_t, backend)
+                self._xfer.observe_exposed(
+                    max(0.0, t_done - t_compute_done), backend)
             if not committed:
                 # the receiver dropped a payload frame and nacked — the
                 # decode side re-prefills locally after its timeout. Work
@@ -452,7 +399,7 @@ class PrefillWorker:
             )
             self._prefills_c.inc()
             self._prefill_tokens_c.inc(len(prompt) - num_cached)
-            self._transfer_bytes_c.inc(nbytes)
+            self._xfer.add_bytes(nbytes, backend)
         finally:
             if pipe is not None:
                 await pipe.shutdown()
@@ -461,8 +408,8 @@ class PrefillWorker:
     # ---------- the frame stream ----------
 
     def _start_pump(self, client, rpr, use_ici: bool,
-                    frame_blocks: int) -> _FramePipe:
-        pipe = _FramePipe(
+                    frame_blocks: int) -> FramePipe:
+        pipe = FramePipe(
             depth=getattr(self.config, "disagg_stream_depth", 2),
             frame_blocks=frame_blocks,
         )
@@ -470,7 +417,7 @@ class PrefillWorker:
         pipe.task = asyncio.ensure_future(self._run_pump(pipe, pump, client, rpr))
         return pipe
 
-    async def _run_pump(self, pipe: _FramePipe, pump, client, rpr) -> None:
+    async def _run_pump(self, pipe: FramePipe, pump, client, rpr) -> None:
         try:
             await pump(pipe, client, rpr)
         except asyncio.CancelledError:
@@ -489,7 +436,7 @@ class PrefillWorker:
                 if await pipe.q.get() is None:
                     pipe.closed = True
 
-    async def _ship(self, pipe: _FramePipe, rpr, block_ids,
+    async def _ship(self, pipe: FramePipe, rpr, block_ids,
                     lo: int, hi: int) -> None:
         """Dispatch the device gather for blocks [lo, hi) and enqueue the
         frames. Runs on the event loop by design: the gather must
@@ -503,7 +450,7 @@ class PrefillWorker:
             k_dev, v_dev = self.runner.gather_blocks_device(src)
             await pipe.put((k_dev, v_dev, dst))
 
-    async def _tcp_pump(self, pipe: _FramePipe, client, rpr) -> None:
+    async def _tcp_pump(self, pipe: FramePipe, client, rpr) -> None:
         """TCP plane: per frame, host-sync the gathered blocks in an
         executor, then write the frame; with depth 2 the next frame's
         host copy proceeds while the previous frame's bytes drain."""
@@ -556,7 +503,7 @@ class PrefillWorker:
                 except BaseException:
                     pass
 
-    async def _ici_pump(self, pipe: _FramePipe, client, rpr) -> None:
+    async def _ici_pump(self, pipe: FramePipe, client, rpr) -> None:
         """Collective plane: ids over TCP (ordering), bytes HBM→HBM.
 
         Pipelined but discipline-preserving: at most ONE collective is in
@@ -628,63 +575,32 @@ class PrefillWorker:
                 except BaseException:
                     pass
 
-    async def _finish_ici_send(self, loop, pipe: _FramePipe, prev) -> None:
-        from .ici_transfer import IciSendError
-
+    async def _finish_ici_send(self, loop, pipe: FramePipe, prev) -> None:
+        # the pairing discipline (pre-entry → balance and keep; entered/
+        # unknowable → abandon) lives in the unified transfer plane; the
+        # plane object here stays the raw IciKvTransfer and abandonment
+        # keeps its ici=None convention (negotiation then yields tcp)
         fut, ndst, nbytes = prev
-        try:
-            await fut
-        except IciSendError as e:
-            if not e.entered:
-                # receiver holds an unpaired entry for this header — pair
-                # it with a poison payload (seq -1 never matches) so the
-                # plane stays 1:1 and REMAINS usable for the redelivery
-                try:
-                    await loop.run_in_executor(
-                        None, lambda n=ndst: self.ici.send_balancing_entry(n)
-                    )
-                    logger.warning(
-                        "ici send failed before entering the collective; "
-                        "balanced the plane and keeping it"
-                    )
-                except BaseException:
-                    logger.exception(
-                        "balancing entry failed; abandoning the collective "
-                        "plane (tcp fallback)"
-                    )
-                    self.ici = None
-            else:
-                # the collective itself failed — both sides' entries
-                # unwound, but the distributed runtime is now suspect
-                logger.exception(
-                    "ici collective failed; abandoning the plane "
-                    "(tcp fallback)"
-                )
-                self.ici = None
-            raise
+        plane = self.ici
+
+        def abandon():
+            self.ici = None
+
+        await settle_collective_send(loop, plane, fut, ndst, abandon)
         pipe.nbytes += nbytes
 
     def _ici_usable(self, client) -> bool:
         """The collective plane applies only when the TARGET engine is this
         plane's configured receiver — another ici-enabled engine would
-        enter a DIFFERENT mesh and both sides would hang unpaired."""
-        modes = getattr(client, "modes", ("tcp",))
-        if "ici" not in modes:
-            logger.warning(
-                "transfer server has no ici mode; using tcp for this engine"
-            )
-            return False
-        rank = getattr(client, "ici_rank", None)
-        # rank None = descriptor predates rank advertisement — trust the
-        # mode flag (matches pre-rank behavior; a genuine mismatch is only
-        # detectable when the receiver says who it is)
-        if rank is not None and rank != self.ici.receiver_rank:
-            logger.warning(
-                "engine's ici receiver rank %s != configured %s; using tcp",
-                rank, self.ici.receiver_rank,
-            )
-            return False
-        return True
+        enter a DIFFERENT mesh and both sides would hang unpaired.
+        Delegates to the unified plane's per-peer negotiation."""
+        return negotiate_backend(
+            {
+                "modes": getattr(client, "modes", ("tcp",)),
+                "ici_rank": getattr(client, "ici_rank", None),
+            },
+            self.ici, peer_role="receiver",
+        ) == "ici"
 
     async def _client(self, engine_id: str) -> KvTransferClient:
         client = self._clients.get(engine_id)
@@ -700,6 +616,15 @@ class PrefillWorker:
         # payload paths BOTH ends support (older descriptors: tcp only)
         client.modes = tuple(desc.get("modes", ("tcp",)))
         client.ici_rank = desc.get("ici_rank")
+        # channel lifecycle with backend attribution: the negotiated
+        # payload path at dial time (abandonment later just reroutes
+        # transfers to tcp on the same channel)
+        client.plane_backend = (
+            "ici" if self.ici is not None and self._ici_usable(client)
+            else "tcp"
+        )
+        record_open("disagg", client.plane_backend, peer=engine_id)
+        self._xfer.channel_opened(client.plane_backend)
         self._clients[engine_id] = client
         return client
 
@@ -715,5 +640,7 @@ class PrefillWorker:
     async def close(self) -> None:
         self.stop()
         for client in self._clients.values():
+            self._xfer.channel_closed(
+                getattr(client, "plane_backend", "tcp"))
             await client.close()
         self._clients.clear()

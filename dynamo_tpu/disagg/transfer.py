@@ -1,4 +1,4 @@
-"""KV transfer plane: push paged KV blocks into a remote engine's cache.
+"""KV transfer endpoints: push paged KV blocks into a remote engine's cache.
 
 The TPU-native stand-in for NIXL RDMA writes (reference:
 docs/disagg_serving.md:60-100, examples/llm/utils/nixl.py:59-109 — prefill
@@ -12,18 +12,23 @@ reference's CUDA copy kernel, block_copy.cu:40-758); frames are chunked so
 the receive side overlaps scatter with the next frame's network read —
 mirroring CopyStream::trigger_layer per-layer overlap semantics.
 
-Wire format, length-prefixed msgpack header + raw payloads:
+Framing, payload backends (tcp inline vs ici collective), pipelining,
+and the poison discipline live in the unified transfer plane
+(``dynamo_tpu/transfer/``, docs/transfer_plane.md); this module is the
+disagg plane's protocol on top of it:
 
   {type: "blocks", request_id, trace_id?, block_ids, shape, dtype, k_bytes, v_bytes}
   <k raw bytes> <v raw bytes>
+  {type: "ici_blocks", request_id, block_ids, seq}        (payload rides ICI)
   {type: "commit", request_id, first_token, logprob, generated, spans?}
 
 Read-only block serve (the cluster KV fabric, kv/fabric.py) rides the
 same framing in the other direction — a peer asks for a sequence-hash
 chain and this engine streams whatever prefix run it still holds::
 
-  → {type: "pull", hashes, chunk_blocks, trace_id?}
-  ← {type: "pull_blocks", shape, dtype, k_bytes, v_bytes} <k> <v>  (per chunk)
+  → {type: "pull", hashes, chunk_blocks, backend?, trace_id?}
+  ← {type: "pull_blocks", shape, dtype, k_bytes, v_bytes} <k> <v>  (tcp chunk)
+  ← {type: "pull_ici_blocks", nblocks, seq}           (ici chunk, header-only)
   ← {type: "pull_end", served}
 
 ``spans`` is the prefill worker's span export for the cluster-stitched
@@ -41,44 +46,29 @@ back to local prefill via the coordinator's prefill_timeout_s).
 from __future__ import annotations
 
 import asyncio
-import concurrent.futures
 import inspect
 import logging
 import struct
-import threading
-import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
-import msgpack
 import numpy as np
 
+from ..transfer.framing import (
+    MAX_HEADER,
+    np_dtype,
+    pack_frame,
+    read_exact,
+    read_header,
+)
+from ..transfer.ici import IciBackend, bounded_collective_recv
+from ..transfer.plane import PoisonSet, maybe_drop_connection
+from ..transfer.tcp import TcpBackend
+
 logger = logging.getLogger(__name__)
-
-MAX_HEADER = 1 << 20
-# dropped-payload bookkeeping: ids are removed when their commit is
-# nacked; requests that never commit would otherwise accumulate forever.
-# TTL >> any sane commit delay (the decode side's prefill timeout is
-# 120 s), so expiry never un-poisons a commit that could still arrive;
-# the count cap is a last-resort bound and LOGS what it evicts.
-MAX_DROPPED = 4096
-DROPPED_TTL_S = 600.0
-
-
-def _np_dtype(name: str):
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
 
 
 def transfer_key(namespace: str, component: str, engine_id: str) -> str:
     return f"{namespace}/components/{component}/kv_transfer/{engine_id}"
-
-
-async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
-    return await reader.readexactly(n)
 
 
 class KvTransferServer:
@@ -96,6 +86,7 @@ class KvTransferServer:
         ici_rank: Optional[int] = None,
         ici_recv_timeout_s: float = 120.0,
         pull_source=None,  # Optional[Callable[[List[int]], PullGrant]]
+        ici_send=None,     # collective SENDER endpoint for ici pull serving
     ):
         # scatter(request_id, block_ids, k, v) — may return an awaitable; an
         # async scatter MUST re-validate the request id after any await (the
@@ -123,6 +114,13 @@ class KvTransferServer:
         # MUST run exactly once — the handler's finally owns it, so a
         # connection dying mid-serve can never leave blocks fenced
         self.pull_source = pull_source
+        # the fabric's ici serve half: a collective sender endpoint so a
+        # negotiated pull moves blocks device-to-device (host touches
+        # only headers); wrapped in the backend that owns the pairing/
+        # abandonment discipline
+        if ici_send is not None and not isinstance(ici_send, IciBackend):
+            ici_send = IciBackend(ici_send)
+        self.ici_send: Optional[IciBackend] = ici_send
         # generous default: the first recv compiles the collective program
         self.ici_recv_timeout_s = ici_recv_timeout_s
         # collective entries are strictly ordered — serialize receives
@@ -131,59 +129,16 @@ class KvTransferServer:
         # request ids with a dropped payload frame (seq mismatch, revoked
         # authorization, recv timeout): their commit must be NACKED — the
         # decode side would otherwise resume over blocks that were never
-        # scattered, silently corrupting the stream. id -> monotonic time
-        # of the drop (insertion-ordered; TTL + logged-cap pruning).
-        self._dropped: Dict[str, float] = {}
+        # scattered, silently corrupting the stream.
+        self._poison = PoisonSet("disagg")
         self.port: Optional[int] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _mark_dropped(self, request_id: str,
-                      trace_id: Optional[str] = None) -> None:
-        from ..telemetry.flight import flight_recorder
-
-        now = time.monotonic()
-        flight_recorder().record(
-            "disagg.poison", request_id=request_id, trace_id=trace_id,
-        )
-        self._dropped.pop(request_id, None)
-        self._dropped[request_id] = now
-        # TTL expiry (insertion order == time order): anything this old
-        # can no longer see a commit — the decode side gave up on the
-        # request minutes ago
-        for rid, t in list(self._dropped.items()):
-            if now - t <= DROPPED_TTL_S:
-                break
-            del self._dropped[rid]
-        while len(self._dropped) > MAX_DROPPED:
-            rid, _ = next(iter(self._dropped.items()))
-            del self._dropped[rid]
-            # un-poisoning is the corruption this set exists to prevent —
-            # if this ever fires under real load, raise the cap
-            logger.error(
-                "dropped-payload set over cap (%d); evicting %s — a late "
-                "commit for it would now be accepted", MAX_DROPPED, rid,
-            )
-
-    @staticmethod
-    def _call_in_daemon_thread(fn, *args) -> "concurrent.futures.Future":
-        """Run fn on a fresh DAEMON thread. A stranded collective recv
-        blocks its thread forever; ThreadPoolExecutor workers are
-        non-daemon and joined by an atexit hook, so a wedged one would
-        hang interpreter shutdown — daemon threads don't."""
-        fut: concurrent.futures.Future = concurrent.futures.Future()
-
-        def work():
-            try:
-                result = fn(*args)
-            except BaseException as e:
-                if not fut.cancelled():
-                    fut.set_exception(e)
-            else:
-                if not fut.cancelled():
-                    fut.set_result(result)
-
-        threading.Thread(target=work, daemon=True, name="ici-recv").start()
-        return fut
+                      trace_id: Optional[str] = None,
+                      backend: str = "tcp", reason: str = "") -> None:
+        self._poison.mark(request_id, trace_id=trace_id, backend=backend,
+                          reason=reason)
 
     async def start(self) -> "KvTransferServer":
         self._server = await asyncio.start_server(self._handle, self.host, 0)
@@ -195,7 +150,9 @@ class KvTransferServer:
         # modes let the prefill side pick a payload path BOTH ends support
         # — sending an ici frame to a tcp-only server would strand the
         # sender inside a collective that never pairs
-        modes = ["tcp"] + (["ici"] if self.ici_recv is not None else [])
+        ici_ok = (self.ici_recv is not None
+                  or (self.ici_send is not None and self.ici_send.alive))
+        modes = ["tcp"] + (["ici"] if ici_ok else [])
         if self.pull_source is not None:
             modes.append("pull")
         desc = {"host": self.host, "port": self.port, "modes": modes}
@@ -216,14 +173,12 @@ class KvTransferServer:
         try:
             while True:
                 try:
-                    raw_len = await _read_exact(reader, 4)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    header = await read_header(reader, "transfer")
+                except ValueError as e:
+                    logger.error("%s", e)
                     return
-                (hlen,) = struct.unpack(">I", raw_len)
-                if hlen > MAX_HEADER:
-                    logger.error("transfer header too large: %d", hlen)
+                if header is None:
                     return
-                header = msgpack.unpackb(await _read_exact(reader, hlen), raw=False)
                 mtype = header.get("type")
                 if mtype in ("blocks", "ici_blocks"):
                     # mark BEFORE the payload read: dying mid-payload is
@@ -231,18 +186,14 @@ class KvTransferServer:
                     # frames
                     streaming.add(header["request_id"])
                 if mtype == "blocks":
-                    k_raw = await _read_exact(reader, header["k_bytes"])
-                    v_raw = await _read_exact(reader, header["v_bytes"])
+                    k, v = await TcpBackend.recv_blocks(reader, header)
                     if not self.authorize(header["request_id"], header["block_ids"]):
                         # request gone — drop the frame; a later commit for
                         # this id must be nacked, not resumed-on
                         self._mark_dropped(header["request_id"],
-                                           header.get("trace_id"))
+                                           header.get("trace_id"),
+                                           reason="unauthorized")
                         continue
-                    dtype = _np_dtype(header["dtype"])
-                    shape = tuple(header["shape"])
-                    k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
-                    v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
                     # scatter may be a coroutine that stages the host→device
                     # copy off-loop so decode streaming isn't stalled
                     result = self.scatter(header["request_id"], header["block_ids"], k, v)
@@ -263,13 +214,9 @@ class KvTransferServer:
                     # thread) forever.
                     try:
                         async with self._ici_lock:
-                            k, v, seq = await asyncio.wait_for(
-                                asyncio.wrap_future(
-                                    self._call_in_daemon_thread(
-                                        self.ici_recv, len(ids)
-                                    )
-                                ),
-                                timeout=self.ici_recv_timeout_s,
+                            k, v, seq = await bounded_collective_recv(
+                                self.ici_recv, len(ids),
+                                self.ici_recv_timeout_s,
                             )
                     except asyncio.TimeoutError:
                         # receiver-side plane abandonment: the stranded
@@ -287,7 +234,9 @@ class KvTransferServer:
                         )
                         self.ici_recv = None
                         self._mark_dropped(header["request_id"],
-                                           header.get("trace_id"))
+                                           header.get("trace_id"),
+                                           backend="ici",
+                                           reason="recv_timeout")
                         continue
                     if seq != header.get("seq", 0):
                         # a sender died between header and collective and
@@ -301,11 +250,15 @@ class KvTransferServer:
                             header.get("seq"), seq,
                         )
                         self._mark_dropped(header["request_id"],
-                                           header.get("trace_id"))
+                                           header.get("trace_id"),
+                                           backend="ici",
+                                           reason="seq_mismatch")
                         continue
                     if not self.authorize(header["request_id"], ids):
                         self._mark_dropped(header["request_id"],
-                                           header.get("trace_id"))
+                                           header.get("trace_id"),
+                                           backend="ici",
+                                           reason="unauthorized")
                         continue  # request gone — drop the received blocks
                     result = self.scatter(header["request_id"], ids, k, v)
                     if inspect.isawaitable(result):
@@ -318,14 +271,13 @@ class KvTransferServer:
                 elif mtype == "commit":
                     rid = header["request_id"]
                     streaming.discard(rid)
-                    if rid in self._dropped:
+                    if self._poison.pop(rid):
                         # a payload frame for this request was dropped —
                         # its KV blocks were never (fully) scattered, so
                         # committing would resume decode over garbage.
                         # Nack: the sender releases its side, the decode
                         # side's pending future times out and the request
                         # re-prefills locally.
-                        del self._dropped[rid]
                         logger.warning(
                             "nacking commit for %s: an earlier payload "
                             "frame was dropped", rid,
@@ -356,59 +308,70 @@ class KvTransferServer:
                     "poisoning its commit (decode will fall back to "
                     "local prefill)", rid,
                 )
-                self._mark_dropped(rid)
+                self._mark_dropped(rid, reason="conn_death")
             writer.close()
 
     async def _serve_pull(self, header: dict,
                           writer: asyncio.StreamWriter) -> None:
         """Serve one ``pull`` frame: resolve the longest locally-held
         run of the requested sequence-hash chain and stream it back as
-        ``pull_blocks`` frames + a ``pull_end`` trailer.
+        chunk frames + a ``pull_end`` trailer.
 
         Strictly read-only: blocks are pinned for the duration (the
-        grant), gathered and byte-packed off-loop, and unpinned in the
-        ``finally`` — a puller that vanishes mid-stream costs this
-        engine nothing but the frames already sent.
+        grant), and unpinned in the ``finally`` — a puller that
+        vanishes mid-stream costs this engine nothing but the frames
+        already sent. The tcp path gathers and byte-packs off-loop; a
+        negotiated ici pull keeps payloads on device — per chunk, a
+        ``pull_ici_blocks`` control frame precedes one collective
+        entry, and the next header is written only after that entry
+        resolved (the one-in-flight pairing discipline).
         """
         from ..telemetry.flight import flight_recorder
-        from ..utils import faults
 
         hashes = [int(h) for h in header.get("hashes") or []]
         chunk = max(1, int(header.get("chunk_blocks", 16)))
+        use_ici = (header.get("backend") == "ici"
+                   and self.ici_send is not None and self.ici_send.alive)
         grant = self.pull_source(hashes) if self.pull_source else None
         flight_recorder().record(
             "kv_fabric.serve", trace_id=header.get("trace_id"),
             asked=len(hashes), served=len(grant) if grant else 0,
+            backend="ici" if use_ici else "tcp",
         )
         if grant is None:
-            hdr = msgpack.packb({"type": "pull_end", "served": 0},
-                                use_bin_type=True)
-            writer.write(struct.pack(">I", len(hdr)) + hdr)
+            pack_frame(writer, {"type": "pull_end", "served": 0})
             await writer.drain()
             return
         try:
             n = len(grant)
             for lo in range(0, n, chunk):
-                if faults.fire("transfer_conn_drop"):
+                if maybe_drop_connection("fabric"):
                     # chaos site: the serving side dies mid-stream — the
                     # puller must fall back to local recompute with its
                     # reservation freed and nothing registered
                     writer.close()
                     return
-                kb, vb, shape, dtype = await grant.gather_frame(
-                    lo, min(lo + chunk, n)
-                )
-                hdr = msgpack.packb({
-                    "type": "pull_blocks", "shape": shape, "dtype": dtype,
-                    "k_bytes": len(kb), "v_bytes": len(vb),
-                }, use_bin_type=True)
-                writer.write(struct.pack(">I", len(hdr)) + hdr)
-                writer.write(kb)
-                writer.write(vb)
-                await writer.drain()
-            hdr = msgpack.packb({"type": "pull_end", "served": n},
-                                use_bin_type=True)
-            writer.write(struct.pack(">I", len(hdr)) + hdr)
+                hi = min(lo + chunk, n)
+                if use_ici:
+                    k_dev, v_dev = await grant.gather_frame_device(lo, hi)
+                    seq = self.ici_send.next_seq()
+                    pack_frame(writer, {"type": "pull_ici_blocks",
+                                        "nblocks": hi - lo, "seq": seq})
+                    await writer.drain()
+                    # one collective in flight; a failure classifies
+                    # against the header just written (balance or
+                    # abandon), and the closed connection tells the
+                    # puller to fall back
+                    await self.ici_send.send(k_dev, v_dev, seq, hi - lo)
+                else:
+                    kb, vb, shape, dtype = await grant.gather_frame(lo, hi)
+                    pack_frame(writer, {
+                        "type": "pull_blocks", "shape": shape,
+                        "dtype": dtype,
+                        "k_bytes": len(kb), "v_bytes": len(vb),
+                    }, kb, vb)
+                    await writer.drain()
+            pack_frame(writer, {"type": "pull_end", "served": n})
             await writer.drain()
         finally:
             grant.release()
@@ -433,8 +396,7 @@ class KvTransferClient:
         return self
 
     def _send_header(self, header: dict) -> None:
-        data = msgpack.packb(header, use_bin_type=True)
-        self.writer.write(struct.pack(">I", len(data)) + data)
+        pack_frame(self.writer, header)
 
     async def send_blocks(
         self,
@@ -446,12 +408,10 @@ class KvTransferClient:
         trace_id: Optional[str] = None,
     ) -> None:
         """Stream blocks in chunks so the receiver overlaps scatter w/ reads."""
-        from ..utils import faults
-
         n = len(block_ids)
         assert k_blocks.shape[1] == n
         for i in range(0, n, chunk_blocks):
-            if faults.fire("transfer_conn_drop"):
+            if maybe_drop_connection("disagg"):
                 # chaos site: the sender dies mid-stream — the receiver
                 # must poison this request's commit (utils/faults.py)
                 self.writer.close()
@@ -459,24 +419,18 @@ class KvTransferClient:
                     "fault injected: transfer_conn_drop"
                 )
             ids = block_ids[i : i + chunk_blocks]
-            k = np.ascontiguousarray(k_blocks[:, i : i + len(ids)])
-            v = np.ascontiguousarray(v_blocks[:, i : i + len(ids)])
-            kb, vb = k.tobytes(), v.tobytes()
             header = {
                 "type": "blocks",
                 "request_id": request_id,
                 "block_ids": list(map(int, ids)),
-                "shape": list(k.shape),
-                "dtype": k.dtype.name,
-                "k_bytes": len(kb),
-                "v_bytes": len(vb),
             }
             if trace_id:
                 header["trace_id"] = trace_id
-            self._send_header(header)
-            self.writer.write(kb)
-            self.writer.write(vb)
-            await self.writer.drain()
+            await TcpBackend.send_blocks(
+                self.writer, header,
+                k_blocks[:, i : i + len(ids)],
+                v_blocks[:, i : i + len(ids)],
+            )
 
     async def send_ici_blocks(
         self, request_id: str, block_ids: List[int], seq: int = 0,
@@ -517,7 +471,7 @@ class KvTransferClient:
         })
         await self.writer.drain()
         # wait for the receiver's ack — after this the decode side owns the KV
-        ack = await _read_exact(self.reader, 5)
+        ack = await read_exact(self.reader, 5)
         return ack[-1:] == b"\x01"
 
     async def close(self) -> None:
@@ -528,3 +482,13 @@ class KvTransferClient:
             # dynlint: allow(silent-except) - best-effort close of a possibly-dead peer
             except Exception:
                 pass
+
+
+# retained import surface for callers predating the unified plane
+# (kv/cold_tier.py dtype resolution); the implementations live in
+# dynamo_tpu/transfer/framing.py now
+_np_dtype = np_dtype
+_read_exact = read_exact
+__all__ = [
+    "KvTransferClient", "KvTransferServer", "transfer_key", "MAX_HEADER",
+]

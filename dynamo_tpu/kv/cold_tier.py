@@ -274,10 +274,10 @@ class KvColdTier:
                     raise ValueError("truncated payload")
                 if _checksum(k_raw, v_raw) != header["checksum"]:
                     raise ValueError("checksum mismatch")
-                from ..disagg.transfer import _np_dtype
+                from ..transfer.framing import np_dtype
 
                 shape = tuple(header["shape"])
-                dtype = _np_dtype(header["dtype"])
+                dtype = np_dtype(header["dtype"])
                 k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
                 v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
         except FileNotFoundError:

@@ -39,15 +39,17 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import struct
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-import msgpack
 import numpy as np
 
 from ..telemetry.flight import flight_recorder
+from ..transfer.framing import pack_frame, read_header
+from ..transfer.ici import IciBackend
+from ..transfer.plane import TransferMetrics, negotiate_backend, record_open
+from ..transfer.tcp import TcpBackend
 from ..utils import faults
 
 logger = logging.getLogger(__name__)
@@ -73,6 +75,10 @@ class PullPlan:
     worker_id: Optional[str] = None  # peer pulls: the owning worker
     host: Optional[str] = None
     port: Optional[int] = None
+    # payload path negotiated against the peer's discovery descriptor
+    # at plan time (transfer/plane.py negotiate_backend); tcp is the
+    # cross-pod/DCN fallback every pair supports
+    backend: str = "tcp"
 
     @property
     def blocks(self) -> int:
@@ -144,6 +150,46 @@ class PullGrant:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, _assemble)
 
+    async def gather_frame_device(self, lo: int, hi: int):
+        """Materialize entries [lo, hi) as DEVICE arrays for an ici
+        chunk: the payload enters the collective straight from HBM, the
+        host never sees block bytes. All-HBM runs (the common case for
+        hot prefixes) are a single jitted gather; mixed runs device_put
+        each host-tier entry off-loop and concatenate on device — still
+        never a whole-frame host buffer."""
+        chunk = self.entries[lo:hi]
+        runner = self._fabric.runner
+        hbm_ids = [e.block_id for e in chunk if e.kind == "hbm"]
+        if len(hbm_ids) == len(chunk):
+            return runner.gather_blocks_device(hbm_ids)
+        k_dev = v_dev = None
+        if hbm_ids:
+            k_dev, v_dev = runner.gather_blocks_device(hbm_ids)
+
+        def _stage():
+            import jax
+
+            return {
+                i: (jax.device_put(e.arrays[0]), jax.device_put(e.arrays[1]))
+                for i, e in enumerate(chunk) if e.kind == "host"
+            }
+
+        loop = asyncio.get_running_loop()
+        staged = await loop.run_in_executor(None, _stage)
+        import jax.numpy as jnp
+
+        ks, vs, j = [], [], 0
+        for i, e in enumerate(chunk):
+            if e.kind == "hbm":
+                ks.append(k_dev[:, j:j + 1])
+                vs.append(v_dev[:, j:j + 1])
+                j += 1
+            else:
+                ks.append(staged[i][0])
+                vs.append(staged[i][1])
+        # dispatch-only device concat (the loop never blocks on it)
+        return jnp.concatenate(ks, axis=1), jnp.concatenate(vs, axis=1)
+
     def release(self) -> None:
         if self._released:
             return
@@ -170,6 +216,7 @@ class KvFabric:
         chunk_blocks: int = PULL_CHUNK_BLOCKS,
         registry=None,
         flight=None,
+        ici=None,                    # local collective plane (both halves)
     ):
         from ..kv_router.indexer import KvIndexer
 
@@ -209,16 +256,28 @@ class KvFabric:
             "outcome=committed|failed|empty (failed/empty fall back to "
             "local recompute, byte-identically)",
         )
-        self._pull_bytes = registry.counter(
-            "dynamo_kv_fabric_prefix_pull_bytes_total",
-            "KV payload bytes installed by committed prefix pulls",
-        )
-        self._pull_hist = registry.histogram(
-            "dynamo_kv_fabric_prefix_pull_duration_seconds",
-            "One prefix pull end to end: plan dispatch → last block "
-            "scattered (failed pulls observe too — the fallback's cost "
-            "starts where this ends)",
-        )
+        # the unified dynamo_transfer_* family (docs/transfer_plane.md),
+        # labelled {plane=fabric, backend=tcp|ici|local} — replaces the
+        # retired dynamo_kv_fabric_prefix_pull_{bytes_total,
+        # duration_seconds} instruments; cold-tier rehydrates report
+        # backend=local (bytes move without a wire)
+        self._xfer = TransferMetrics(registry, plane="fabric")
+        # the local collective plane, shared by both halves: the pull
+        # half receives on it, the serve half sends on it. Wrapped in
+        # the backend that owns bounded-recv + abandonment (an abandoned
+        # plane negotiates tcp from then on).
+        self.ici: Optional[IciBackend] = None
+        if ici is not None:
+            self.set_ici(ici)
+
+    def set_ici(self, plane) -> None:
+        """Attach the local collective plane (CLI wiring runs this before
+        ``serve``): peer pulls then negotiate ici per peer pair, and this
+        worker's serve half answers ici pulls device-to-device."""
+        if plane is None or isinstance(plane, IciBackend):
+            self.ici = plane
+        else:
+            self.ici = IciBackend(plane)
 
     # ---------- ownership view ----------
 
@@ -299,6 +358,8 @@ class KvFabric:
             worker_id=wid,
             host=desc.get("host"),
             port=desc.get("port"),
+            # peer plays the SENDER on the collective plane when we pull
+            backend=negotiate_backend(desc, self.ici, peer_role="sender"),
         )
 
     def rank_peers(self, peers: List[dict],
@@ -365,6 +426,12 @@ class KvFabric:
             on_commit=lambda *a: None,
             pull_source=self.grant,
             host=host,
+            # serve half of the collective plane: negotiated ici pulls
+            # stream device-to-device; the descriptor advertises the
+            # rank this worker sends from so pullers only pick ici when
+            # their plane pairs with it
+            ici_send=self.ici,
+            ici_rank=None if self.ici is None else self.ici.sender_rank,
         ).start()
         return self.server
 
@@ -402,6 +469,7 @@ class KvFabric:
         t0 = time.monotonic()
         outcome = "failed"
         served = 0
+        backend = "local" if plan.source == "cold" else plan.backend
         try:
             if plan.source == "cold":
                 served = await self._pull_cold(plan, block_ids)
@@ -411,10 +479,11 @@ class KvFabric:
             return served
         finally:
             self._pulls.inc(source=plan.source, outcome=outcome)
-            self._pull_hist.observe(time.monotonic() - t0)
+            self._xfer.observe_duration(time.monotonic() - t0, backend)
             self.flight.record(
                 "kv_fabric.pull", request_id=request_id, trace_id=trace_id,
                 source=plan.source, worker=plan.worker_id,
+                backend=backend,
                 asked=plan.blocks, served=served, outcome=outcome,
             )
 
@@ -457,7 +526,7 @@ class KvFabric:
             self.runner.scatter_blocks(
                 block_ids[served:served + n], k_dev, v_dev
             )
-            self._pull_bytes.inc(k_dev.nbytes + v_dev.nbytes)
+            self._xfer.add_bytes(k_dev.nbytes + v_dev.nbytes, "local")
             served += n
             if n < len(chunk):
                 break
@@ -465,39 +534,40 @@ class KvFabric:
 
     async def _pull_peer(self, plan: PullPlan, block_ids: List[int],
                          trace_id: Optional[str]) -> int:
-        from ..disagg.transfer import MAX_HEADER, _np_dtype, _read_exact
-
         loop = asyncio.get_running_loop()
+        backend = plan.backend
+        if backend == "ici" and (self.ici is None or not self.ici.alive):
+            # plane abandoned between plan and pull — tcp still works
+            backend = "tcp"
         reader, writer = await asyncio.open_connection(plan.host, plan.port)
+        record_open("fabric", backend, peer=plan.worker_id or "",
+                    trace_id=trace_id)
+        self._xfer.channel_opened(backend)
         try:
-            header = msgpack.packb({
+            pack_frame(writer, {
                 "type": "pull",
                 "hashes": [int(h) for h in plan.hashes],
                 "chunk_blocks": self.chunk_blocks,
                 "trace_id": trace_id,
-            }, use_bin_type=True)
-            writer.write(struct.pack(">I", len(header)) + header)
+                "backend": backend,
+            })
             await writer.drain()
             served = 0
             while True:
                 await self._maybe_stall()
-                (hlen,) = struct.unpack(">I", await _read_exact(reader, 4))
-                if hlen > MAX_HEADER:
-                    raise ValueError(f"pull header too large: {hlen}")
-                frame = msgpack.unpackb(
-                    await _read_exact(reader, hlen), raw=False
-                )
+                frame = await read_header(reader, "pull")
+                if frame is None:
+                    # serving side died mid-stream — the pull fails and
+                    # the caller recomputes locally; nothing registered
+                    raise ConnectionResetError(
+                        "pull connection closed mid-stream"
+                    )
                 ftype = frame.get("type")
                 if ftype == "pull_blocks":
-                    k_raw = await _read_exact(reader, frame["k_bytes"])
-                    v_raw = await _read_exact(reader, frame["v_bytes"])
-                    dtype = _np_dtype(frame["dtype"])
-                    shape = tuple(frame["shape"])
-                    n = shape[1]
+                    k, v = await TcpBackend.recv_blocks(reader, frame)
+                    n = k.shape[1]
                     if served + n > len(plan.hashes):
                         raise ValueError("peer served past the asked run")
-                    k = np.frombuffer(k_raw, dtype=dtype).reshape(shape)
-                    v = np.frombuffer(v_raw, dtype=dtype).reshape(shape)
                     # stage the H2D copy off-loop; scatter on the loop
                     # (coordinator._scatter's discipline) — the next
                     # frame's network read overlaps this device copy
@@ -507,13 +577,41 @@ class KvFabric:
                     self.runner.scatter_blocks(
                         block_ids[served:served + n], k_dev, v_dev
                     )
-                    self._pull_bytes.inc(len(k_raw) + len(v_raw))
+                    self._xfer.add_bytes(k.nbytes + v.nbytes, "tcp")
+                    served += n
+                elif ftype == "pull_ici_blocks":
+                    # control-only header: the payload rides the
+                    # collective, device-to-device — bounded, serialized
+                    # receive with the seq cross-check (a mismatch means
+                    # a mis-paired entry; the pull aborts and falls back
+                    # rather than scatter bytes of unknown provenance)
+                    if self.ici is None:
+                        raise ValueError(
+                            "peer sent an ici frame but this worker has "
+                            "no collective plane"
+                        )
+                    n = int(frame["nblocks"])
+                    if served + n > len(plan.hashes):
+                        raise ValueError("peer served past the asked run")
+                    k_dev, v_dev, seq = await self.ici.recv(n)
+                    if seq != frame.get("seq", 0):
+                        raise ValueError(
+                            f"ici pull seq mismatch (header "
+                            f"{frame.get('seq')}, payload {seq})"
+                        )
+                    self.runner.scatter_blocks(
+                        block_ids[served:served + n], k_dev, v_dev
+                    )
+                    self._xfer.add_bytes(
+                        int(k_dev.nbytes) + int(v_dev.nbytes), "ici"
+                    )
                     served += n
                 elif ftype == "pull_end":
                     return min(served, int(frame.get("served", served)))
                 else:
                     raise ValueError(f"unknown pull frame {ftype!r}")
         finally:
+            self._xfer.channel_closed(backend)
             writer.close()
 
     @staticmethod

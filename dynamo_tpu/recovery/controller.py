@@ -39,6 +39,8 @@ from typing import Awaitable, Callable, List, Optional
 from ..protocols.common import EngineOutput, FinishReason
 from ..telemetry.flight import FlightRecorder, flight_recorder
 from ..telemetry.registry import MetricsRegistry
+from ..transfer.ici import IciBackend
+from ..transfer.plane import TransferMetrics, negotiate_backend
 from .migration import migrate_request, migration_class, package_request
 
 logger = logging.getLogger(__name__)
@@ -86,6 +88,10 @@ class RecoveryController:
         # request's prefix resumes it with the least recompute.
         peer_ranker: Optional[Callable[[List[dict], List[int]],
                                        List[dict]]] = None,
+        # ICI send plane toward migration peers: when a candidate peer
+        # advertises a matching ICI receive rank, hot KV frames move
+        # device-to-device instead of through host TCP buffers
+        ici=None,
     ):
         self.engine_id = engine_id
         self.scheduler = scheduler
@@ -97,9 +103,13 @@ class RecoveryController:
         self.register = register
         self.admission = admission
         self.peer_ranker = peer_ranker
+        if ici is not None and not isinstance(ici, IciBackend):
+            ici = IciBackend(ici)
+        self.ici: Optional[IciBackend] = ici
         self.config = config or RecoveryConfig()
         self.flight = flight if flight is not None else flight_recorder()
         self.registry = registry or MetricsRegistry()
+        self._xfer = TransferMetrics(self.registry, plane="migration")
         self._actions = self.registry.counter(
             "dynamo_recovery_actions_total",
             "Recovery-ladder steps executed, labelled action="
@@ -282,10 +292,22 @@ class RecoveryController:
         )
         mode = "hot" if state.hot else "cold"
         for peer in self._candidate_peers(er):
+            # per-peer backend negotiation from discovery metadata: a
+            # peer on the same ICI mesh (matching receive rank) takes hot
+            # KV device-to-device; anyone else gets the TCP fallback
+            backend = negotiate_backend(peer, self.ici,
+                                        peer_role="receiver")
+            gather_device = getattr(self.runner, "gather_blocks_device",
+                                    None)
+            use_ici = (backend == "ici" and state.hot
+                       and gather_device is not None)
             try:
                 relay = await migrate_request(
                     peer["host"], peer["port"], er, state,
                     gather=self.runner.gather_blocks if state.hot else None,
+                    ici=self.ici if use_ici else None,
+                    gather_device=gather_device if use_ici else None,
+                    metrics=self._xfer,
                 )
             except asyncio.CancelledError:
                 raise

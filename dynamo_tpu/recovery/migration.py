@@ -69,19 +69,19 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
-import struct
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-import msgpack
 import numpy as np
 
-from ..disagg.transfer import MAX_HEADER, _np_dtype, _read_exact
 from ..protocols.common import EngineOutput, FinishReason, PreprocessedRequest
 from ..runtime.engine import AsyncEngineContext
 from ..telemetry.flight import flight_recorder
-from ..utils import faults
+from ..transfer.framing import pack_frame, read_header
+from ..transfer.ici import IciBackend
+from ..transfer.plane import maybe_drop_connection, record_open
+from ..transfer.tcp import TcpBackend
 
 logger = logging.getLogger(__name__)
 
@@ -204,32 +204,11 @@ def package_request(er, allocator, kv_block_size: int,
 
 
 # ---------------------------------------------------------------------------
-# framing
-# ---------------------------------------------------------------------------
-
-
-def _pack(writer: asyncio.StreamWriter, header: dict,
-          *payloads: bytes) -> None:
-    data = msgpack.packb(header, use_bin_type=True)
-    writer.write(struct.pack(">I", len(data)) + data)
-    for p in payloads:
-        writer.write(p)
-
-
-async def _read_header(reader: asyncio.StreamReader) -> Optional[dict]:
-    try:
-        raw_len = await _read_exact(reader, 4)
-    except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
-    (hlen,) = struct.unpack(">I", raw_len)
-    if hlen > MAX_HEADER:
-        raise ValueError(f"migration header too large: {hlen}")
-    return msgpack.unpackb(await _read_exact(reader, hlen), raw=False)
-
-
-# ---------------------------------------------------------------------------
 # receiver
 # ---------------------------------------------------------------------------
+# Framing lives in the unified transfer plane (dynamo_tpu/transfer/,
+# docs/transfer_plane.md): 4-byte length-prefixed msgpack headers + raw
+# payloads, identical across the disagg, fabric, and migration planes.
 
 
 class MigrationSink:
@@ -239,10 +218,15 @@ class MigrationSink:
     def __init__(self, scheduler, runner):
         self.scheduler = scheduler
         self.runner = runner
-        # mig id → (state, block_ids) reserved but not yet committed
+        # attempt key → (state, block_ids) reserved but not yet
+        # committed. Keys are per-ATTEMPT, not per-request: a sender
+        # failing over to the same receiver (ici attempt dies, tcp retry
+        # follows) has two live connections for one request id, and the
+        # stale attempt's connection-death abort must free ITS
+        # reservation, never the retry's.
         self._pending: Dict[str, Tuple[MigrationState, List[int]]] = {}
 
-    def reserve(self, state: MigrationState, nblocks: int) -> List[int]:
+    def reserve(self, state: MigrationState, nblocks: int) -> str:
         sched = self.scheduler
         cfg = sched.config
         if sched.draining:
@@ -278,11 +262,12 @@ class MigrationSink:
                 raise MigrationRejected(f"no KV memory: {e}") from None
         else:
             block_ids = []
-        self._pending[state.request_id] = (state, block_ids)
-        return block_ids
+        mig_id = f"{state.request_id}#{uuid.uuid4().hex[:8]}"
+        self._pending[mig_id] = (state, block_ids)
+        return mig_id
 
     async def scatter(self, mig_id: str, offset: int,
-                      k: np.ndarray, v: np.ndarray) -> None:
+                      k, v) -> None:
         entry = self._pending.get(mig_id)
         if entry is None:
             raise MigrationRejected(f"unknown migration {mig_id}")
@@ -293,15 +278,20 @@ class MigrationSink:
                 f"block frame [{offset}:{offset + n}) outside reservation "
                 f"of {len(block_ids)}"
             )
-        import jax
+        if isinstance(k, np.ndarray):
+            import jax
 
-        loop = asyncio.get_running_loop()
-        # stage the host→device copy off-loop (coordinator._scatter's
-        # discipline); the cache-mutating scatter stays on the loop so it
-        # serializes with the scheduler's own dispatches
-        k_dev, v_dev = await loop.run_in_executor(
-            None, lambda: (jax.device_put(k), jax.device_put(v))
-        )
+            loop = asyncio.get_running_loop()
+            # stage the host→device copy off-loop (coordinator._scatter's
+            # discipline); the cache-mutating scatter stays on the loop so
+            # it serializes with the scheduler's own dispatches
+            k_dev, v_dev = await loop.run_in_executor(
+                None, lambda: (jax.device_put(k), jax.device_put(v))
+            )
+        else:
+            # ICI path: the frame arrived as device arrays — the host
+            # never touched the payload, only the header
+            k_dev, v_dev = k, v
         # the migration may have been aborted during the await
         if mig_id not in self._pending:
             logger.info("dropping late migration KV frame for %s", mig_id)
@@ -350,13 +340,16 @@ class MigrationSink:
             raise MigrationRejected("no free slot at commit")
         return er
 
-    def abort(self, mig_id: str) -> None:
+    def abort(self, mig_id: str, backend: str = "tcp",
+              reason: str = "") -> None:
         entry = self._pending.pop(mig_id, None)
         if entry is not None:
             _state, block_ids = entry
             self.scheduler.allocator.free_blocks(block_ids)
             flight_recorder().record(
-                "recovery.migrate_poison", request_id=_state.request_id,
+                "transfer.poison", plane="migration", backend=backend,
+                request_id=_state.request_id, trace_id=_state.trace_id,
+                reason=reason or "connection died before commit",
             )
 
 
@@ -397,10 +390,17 @@ class MigrationServer:
     connection's duty in order) so the source worker can exit."""
 
     def __init__(self, sink: MigrationSink, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, ici=None, ici_rank: Optional[int] = None):
         self.sink = sink
         self.host = host
         self.port = port
+        # device-to-device receive plane: a sender on the same ICI mesh
+        # streams KV frames as collectives; the TCP connection carries
+        # only headers (``mig_ici_blocks``)
+        if ici is not None and not isinstance(ici, IciBackend):
+            ici = IciBackend(ici)
+        self.ici: Optional[IciBackend] = ici
+        self.ici_rank = ici_rank
         self._server: Optional[asyncio.AbstractServer] = None
         self._resumes: Dict[str, _Resume] = {}
 
@@ -413,52 +413,72 @@ class MigrationServer:
 
     @property
     def descriptor(self) -> dict:
-        return {"host": self.host, "port": self.port}
+        d = {"host": self.host, "port": self.port,
+             "modes": ["tcp"] + (["ici"] if self.ici is not None else [])}
+        if self.ici_rank is not None:
+            d["ici_rank"] = self.ici_rank
+        return d
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         mig_id: Optional[str] = None
         er = None
+        backend = "tcp"
         try:
             while True:
-                header = await _read_header(reader)
+                header = await read_header(reader, "migration")
                 if header is None:
                     return
                 mtype = header.get("type")
                 if mtype == "mig_begin":
                     recv_at = time.time()
+                    backend = header.get("backend") or "tcp"
                     state = MigrationState.from_wire(header["state"])
                     try:
-                        self.sink.reserve(
+                        mig_id = self.sink.reserve(
                             state, int(header.get("nblocks", 0))
                         )
                     except MigrationRejected as e:
-                        _pack(writer, {"type": "mig_ack", "ok": False,
+                        pack_frame(writer, {"type": "mig_ack", "ok": False,
                                        "reason": str(e)})
                         await writer.drain()
                         return
-                    mig_id = state.request_id
                     # begin/ack is the offset-estimation pair: the sender
                     # holds its own send/receive walls, we supply ours
-                    _pack(writer, {"type": "mig_ack", "ok": True,
+                    pack_frame(writer, {"type": "mig_ack", "ok": True,
                                    "recv_at": recv_at,
                                    "sent_at": time.time()})
                     await writer.drain()
                 elif mtype == "mig_blocks":
-                    k_raw = await _read_exact(reader, header["k_bytes"])
-                    v_raw = await _read_exact(reader, header["v_bytes"])
-                    dtype = _np_dtype(header["dtype"])
-                    shape = tuple(header["shape"])
+                    k, v = await TcpBackend.recv_blocks(reader, header)
                     await self.sink.scatter(
-                        mig_id, int(header["offset"]),
-                        np.frombuffer(k_raw, dtype=dtype).reshape(shape),
-                        np.frombuffer(v_raw, dtype=dtype).reshape(shape),
+                        mig_id, int(header["offset"]), k, v
+                    )
+                elif mtype == "mig_ici_blocks":
+                    if self.ici is None or not self.ici.alive:
+                        raise MigrationRejected(
+                            "peer sent an ICI frame but this receiver "
+                            "has no live ICI plane"
+                        )
+                    n = int(header["nblocks"])
+                    k_dev, v_dev, seq = await self.ici.recv(n)
+                    if seq != header.get("seq"):
+                        # the payload's embedded seq disagrees with the
+                        # header: a stale/foreign collective — scattering
+                        # it would corrupt the reservation. Abort (the
+                        # poison discipline), never mis-scatter.
+                        raise MigrationRejected(
+                            f"ICI seq mismatch: header "
+                            f"{header.get('seq')} vs payload {seq}"
+                        )
+                    await self.sink.scatter(
+                        mig_id, int(header["offset"]), k_dev, v_dev
                     )
                 elif mtype == "mig_commit":
                     try:
                         er = self.sink.commit(mig_id)
                     except MigrationRejected as e:
-                        _pack(writer, {"type": "mig_ack", "ok": False,
+                        pack_frame(writer, {"type": "mig_ack", "ok": False,
                                        "reason": str(e)})
                         await writer.drain()
                         return
@@ -466,7 +486,7 @@ class MigrationServer:
                     resume_id = uuid.uuid4().hex
                     resume = _Resume(er)
                     self._resumes[resume_id] = resume
-                    _pack(writer, {"type": "mig_ack", "ok": True,
+                    pack_frame(writer, {"type": "mig_ack", "ok": True,
                                    "resume_id": resume_id})
                     await writer.drain()
                     handed_off = False
@@ -508,7 +528,7 @@ class MigrationServer:
                 # died before commit: nothing installed — free the
                 # reservation (poison: a partial KV stream must never
                 # become a live request)
-                self.sink.abort(mig_id)
+                self.sink.abort(mig_id, backend=backend)
             if er is not None and er.finish is None:
                 # died after commit: the relay (and so the client) is
                 # gone — stop the resumed request
@@ -521,13 +541,13 @@ class MigrationServer:
         resume_id = header.get("resume_id") or ""
         resume = self._resumes.get(resume_id)
         if resume is None or resume.attach_writer is not None:
-            _pack(writer, {"type": "mig_ack", "ok": False,
+            pack_frame(writer, {"type": "mig_ack", "ok": False,
                            "reason": f"unknown or already-attached "
                                      f"resume id {resume_id!r}"})
             await writer.drain()
             return
         recv_at = time.time()
-        _pack(writer, {"type": "mig_ack", "ok": True,
+        pack_frame(writer, {"type": "mig_ack", "ok": True,
                        "recv_at": recv_at, "sent_at": time.time()})
         await writer.drain()
         resume.attach_writer = writer
@@ -558,7 +578,7 @@ class MigrationServer:
                     # a direct consumer attached: frames written so far
                     # precede the handoff marker on this connection, all
                     # later ones go to the new connection — exactly-once
-                    _pack(writer, {"type": "mig_handoff"})
+                    pack_frame(writer, {"type": "mig_handoff"})
                     await writer.drain()
                     return True
                 out = resume.pending_out
@@ -589,7 +609,7 @@ class MigrationServer:
                     # migration.resume → decode → completion marks (and
                     # any remote sets the peer itself collected) land in
                     # the consumer's stitched trace, not a silent gap
-                    _pack(writer, {
+                    pack_frame(writer, {
                         "type": "mig_end",
                         "spans": er.ctx.export_spans(),
                         "children": list(er.ctx.remote_spans),
@@ -600,7 +620,7 @@ class MigrationServer:
                     resume.done = True
                     resume.pending_out = None
                     return False
-                _pack(writer, {"type": "mig_data",
+                pack_frame(writer, {"type": "mig_data",
                                "payload": out.to_wire()})
                 await writer.drain()
                 resume.pending_out = None
@@ -628,6 +648,9 @@ async def migrate_request(
     gather=None,                  # (block_ids) -> (k, v) host arrays; hot only
     chunk_blocks: int = MIGRATE_CHUNK_BLOCKS,
     connect_timeout_s: float = 5.0,
+    ici=None,                     # IciBackend toward this peer (hot only)
+    gather_device=None,           # (block_ids) -> (k_dev, v_dev); ICI path
+    metrics=None,                 # TransferMetrics(plane="migration")
 ) -> asyncio.Task:
     """Ship one request to a peer and return the spawned relay task.
 
@@ -637,21 +660,35 @@ async def migrate_request(
     On success the request's blocks are the caller's to free; the
     returned task relays the peer's outputs into ``er.out_queue`` until
     the stream ends (the caller holds it and cancels on shutdown).
+
+    With ``ici`` + ``gather_device``, hot KV frames ride the ICI plane:
+    the TCP connection carries only ``mig_ici_blocks`` headers while the
+    payload moves device-to-device as one collective per frame — no
+    whole-sequence host buffer ever materializes on either side.
     """
     block_ids = list(er.block_ids) if state.hot else []
+    use_ici = (ici is not None and getattr(ici, "alive", True)
+               and gather_device is not None and state.hot)
+    backend = "ici" if use_ici else "tcp"
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host, port), connect_timeout_s
     )
     loop = asyncio.get_running_loop()
     offset = rtt = 0.0
+    t0 = time.monotonic()
+    record_open("migration", backend, peer=f"{host}:{port}",
+                trace_id=state.trace_id)
+    if metrics is not None:
+        metrics.channel_opened(backend)
     try:
         begin_sent = time.time()
-        _pack(writer, {
+        pack_frame(writer, {
             "type": "mig_begin", "state": state.to_wire(),
             "nblocks": len(block_ids), "sent_at": begin_sent,
+            "backend": backend,
         })
         await writer.drain()
-        ack = await _read_header(reader)
+        ack = await read_header(reader, "migration")
         if ack is None or not ack.get("ok"):
             raise MigrationRejected(
                 (ack or {}).get("reason", "peer closed during begin")
@@ -666,34 +703,47 @@ async def migrate_request(
                 ack.get("sent_at", ack["recv_at"]), time.time(),
             )
         for i in range(0, len(block_ids), chunk_blocks):
-            if faults.fire("transfer_conn_drop"):
+            if maybe_drop_connection("migration"):
                 writer.close()
                 raise ConnectionResetError(
                     "fault injected: transfer_conn_drop"
                 )
             ids = block_ids[i:i + chunk_blocks]
-            # the gather host-syncs device memory — off the loop, chunked
-            # so host buffers stay bounded at one frame
-            k, v = await loop.run_in_executor(
-                None, lambda ids=ids: gather(ids)
-            )
-            k = np.ascontiguousarray(k)
-            v = np.ascontiguousarray(v)
-            kb, vb = k.tobytes(), v.tobytes()
-            _pack(writer, {
-                "type": "mig_blocks", "offset": i,
-                "shape": list(k.shape), "dtype": k.dtype.name,
-                "k_bytes": len(kb), "v_bytes": len(vb),
-            }, kb, vb)
-            await writer.drain()
-        _pack(writer, {"type": "mig_commit"})
+            if use_ici:
+                # device gather stays on the loop (async dispatch, no
+                # host sync); only the header crosses TCP — the payload
+                # rides the collective, one in flight at a time
+                k_dev, v_dev = gather_device(ids)
+                seq = ici.next_seq()
+                pack_frame(writer, {
+                    "type": "mig_ici_blocks", "offset": i,
+                    "nblocks": len(ids), "seq": seq,
+                })
+                await writer.drain()
+                nbytes = await ici.send(k_dev, v_dev, seq, len(ids))
+            else:
+                # the gather host-syncs device memory — off the loop,
+                # chunked so host buffers stay bounded at one frame
+                k, v = await loop.run_in_executor(
+                    None, lambda ids=ids: gather(ids)
+                )
+                nbytes = await TcpBackend.send_blocks(
+                    writer, {"type": "mig_blocks", "offset": i}, k, v
+                )
+            if metrics is not None:
+                metrics.add_bytes(nbytes, backend)
+        pack_frame(writer, {"type": "mig_commit"})
         await writer.drain()
-        ack = await _read_header(reader)
+        ack = await read_header(reader, "migration")
         if ack is None or not ack.get("ok"):
             raise MigrationRejected(
                 (ack or {}).get("reason", "peer closed during commit")
             )
+        if metrics is not None:
+            metrics.observe_duration(time.monotonic() - t0, backend)
     except BaseException:
+        if metrics is not None:
+            metrics.channel_closed(backend)
         writer.close()
         raise
     # committed: the peer owns the request now. Stamp the hop where
@@ -715,14 +765,16 @@ async def migrate_request(
         generated=int(state.generated),
     )
     return asyncio.get_running_loop().create_task(
-        _relay(reader, writer, er, offset, rtt),
+        _relay(reader, writer, er, offset, rtt,
+               metrics=metrics, backend=backend),
         name=f"mig-relay-{er.request_id[:8]}"
     )
 
 
 async def _relay(reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter, er,
-                 offset: float = 0.0, rtt: float = 0.0) -> None:
+                 offset: float = 0.0, rtt: float = 0.0,
+                 metrics=None, backend: str = "tcp") -> None:
     """Forward the peer's resumed outputs into the original out_queue —
     the client's stream continues without a break. A client disconnect
     propagates to the peer by closing the connection."""
@@ -735,7 +787,7 @@ async def _relay(reader: asyncio.StreamReader,
     cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
     try:
         while True:
-            header = await _read_header(reader)
+            header = await read_header(reader, "migration")
             if header is None:
                 break  # peer died mid-stream
             mtype = header.get("type")
@@ -777,6 +829,8 @@ async def _relay(reader: asyncio.StreamReader,
     finally:
         cancel_task.cancel()
         writer.close()
+        if metrics is not None:
+            metrics.channel_closed(backend)
         if not ended and not er.ctx.is_stopped:
             # the peer (or its connection) died mid-stream: the client
             # must see a terminal frame, not silence
@@ -798,7 +852,7 @@ async def _fold_end_spans(reader, ctx, offset: float, rtt: float,
     folds the peer's span export into ``ctx``. Best-effort: a peer that
     never sends it costs ``timeout_s``, nothing else."""
     try:
-        end = await asyncio.wait_for(_read_header(reader), timeout_s)
+        end = await asyncio.wait_for(read_header(reader, "migration"), timeout_s)
     except (asyncio.TimeoutError, asyncio.IncompleteReadError,
             ConnectionResetError, OSError):
         return
@@ -823,11 +877,11 @@ async def _open_attach(info: dict, connect_timeout_s: float = 5.0):
     )
     try:
         sent_at = time.time()
-        _pack(writer, {"type": "mig_attach",
+        pack_frame(writer, {"type": "mig_attach",
                        "resume_id": info["resume_id"],
                        "sent_at": sent_at})
         await writer.drain()
-        ack = await _read_header(reader)
+        ack = await read_header(reader, "migration")
         if ack is None or not ack.get("ok"):
             raise MigrationRejected(
                 (ack or {}).get("reason", "peer closed during attach")
@@ -898,7 +952,7 @@ async def follow_migrated_stream(stream, ctx=None):
             attach_task = None
             try:
                 while True:
-                    header = await _read_header(reader)
+                    header = await read_header(reader, "migration")
                     if header is None:
                         yield EngineOutput(token_ids=[],
                                            finish_reason=FinishReason.ERROR)

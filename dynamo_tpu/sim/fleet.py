@@ -250,9 +250,14 @@ class SimFleet:
 
     def _spawn_worker(self, model: str,
                       with_recovery: bool = True) -> SimWorker:
-        wid = f"{model}-w{next(self._worker_seq)}"
+        seq = next(self._worker_seq)
+        wid = f"{model}-w{seq}"
+        # consecutively spawned workers share an ICI domain; a respawn
+        # or scale-up lands in whatever pod its spawn index falls into
+        pod = (f"pod-{seq // self.cfg.spec.pod_size}"
+               if self.cfg.spec.pod_size > 0 else None)
         w = SimWorker(wid, model, self.cfg.spec, self.clock,
-                      self.cold_store)
+                      self.cold_store, pod=pod)
         self.workers[wid] = w
         w.start()
         self.ks.update_metrics(wid, w.metrics())
@@ -425,6 +430,14 @@ class SimFleet:
                 continue
             sr = SimRequest(req, arrival_t=self.clock())
             worker.enqueue(sr, decision)
+            if sr.pulled_blocks:
+                # negotiate the pull's payload backend the way the real
+                # transfer plane does (docs/transfer_plane.md): same
+                # pod → the collective plane; anything else → tcp/DCN
+                src = self.workers.get(decision.best_prefix_worker)
+                if (src is not None and worker.pod is not None
+                        and src.pod == worker.pod):
+                    sr.pull_backend = "ici"
             await sr.done.wait()
             if sr.outcome == "completed":
                 rec.update(
@@ -436,6 +449,9 @@ class SimFleet:
                     tokens=req.osl,
                     prefix_hit_tokens=sr.prefix_hit_tokens,
                     pulled_blocks=sr.pulled_blocks,
+                    pull_backend=(sr.pull_backend
+                                  if sr.pulled_blocks else None),
+                    pull_transfer_s=sr.pull_transfer_s,
                     cold_blocks=sr.cold_blocks,
                     slo_met=self.slo.observe(
                         sr.ttft_s, sr.itl_max_s, req.osl),

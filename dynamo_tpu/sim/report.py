@@ -124,6 +124,17 @@ def build_report(scenario: str, seed: int, fleet, slo_floor: float,
                 r.get("prefix_hit_tokens", 0) for r in completed),
             "pulled_blocks": sum(
                 r.get("pulled_blocks", 0) for r in completed),
+            # backend split of the peer pulls (docs/transfer_plane.md):
+            # intra-pod pulls ride ici, cross-pod pulls pay the DCN rate
+            "pulled_blocks_ici": sum(
+                r.get("pulled_blocks", 0) for r in completed
+                if r.get("pull_backend") == "ici"),
+            "pull_transfer_s_ici": _r(sum(
+                r.get("pull_transfer_s", 0.0) for r in completed
+                if r.get("pull_backend") == "ici")),
+            "pull_transfer_s_tcp": _r(sum(
+                r.get("pull_transfer_s", 0.0) for r in completed
+                if r.get("pull_backend") == "tcp")),
             "cold_blocks": sum(
                 r.get("cold_blocks", 0) for r in completed),
         },

@@ -90,6 +90,21 @@ def _rag_fleet() -> FleetConfig:
     )
 
 
+def _rag_pod_fleet() -> FleetConfig:
+    # the same RAG fleet, but every worker shares one ICI domain: peer
+    # pulls negotiate the collective backend and the per-block transfer
+    # cost collapses by ici_pull_gbps / peer_pull_gbps (the fleet-scale
+    # twin of the unified transfer plane's backend negotiation —
+    # docs/transfer_plane.md)
+    cfg = _rag_fleet()
+    cfg.spec = dataclasses.replace(cfg.spec, pod_size=8)
+    return cfg
+
+
+def _rag_workload(rng: random.Random, duration_s: float) -> List[Request]:
+    return GENERATORS["rag"](rng, duration_s=duration_s)
+
+
 def _long_context_fleet() -> FleetConfig:
     # 128k prompts need headroom: 131072/16 = 8192 blocks just for one
     # prompt's KV, so provision deep pools and SP-friendly thresholds
@@ -171,6 +186,15 @@ SCENARIOS: Dict[str, Scenario] = {
         slo_floor=0.7,
         duration_s=900.0,
         fleet=_rag_fleet,
+    ),
+    "rag_pod": Scenario(
+        name="rag_pod",
+        description="the rag scenario inside one ICI pod: peer pulls "
+                    "ride the collective plane instead of DCN",
+        slo_floor=0.7,
+        duration_s=900.0,
+        fleet=_rag_pod_fleet,
+        workload=_rag_workload,
     ),
     "long_context": Scenario(
         name="long_context",

@@ -51,8 +51,15 @@ class WorkerSpec:
     sp_chunk_tokens: int = 8192
     sp_threshold_tokens: int = 16384
     # KV fabric modeling: pulling a peer's committed prefix vs cold-tier
-    # rehydration, in GB/s of transfer bandwidth
+    # rehydration, in GB/s of transfer bandwidth. The backend split
+    # mirrors the unified transfer plane (docs/transfer_plane.md):
+    # peer_pull_gbps is the tcp/DCN rate every pair supports,
+    # ici_pull_gbps the device-to-device collective rate a pull rides
+    # when both workers share a pod. pod_size groups consecutively
+    # spawned workers into ICI domains (0 = no pods, everything DCN).
     peer_pull_gbps: float = 40.0
+    ici_pull_gbps: float = 400.0
+    pod_size: int = 0
     cold_pull_gbps: float = 10.0
     provision_delay_s: float = 20.0       # scale-up / respawn lead time
 
@@ -113,6 +120,11 @@ class SimRequest:
         self.prefix_hit_tokens = 0
         self.pulled_blocks = 0
         self.cold_blocks = 0
+        # negotiated payload path for the peer pull (the fleet flips
+        # this to "ici" when puller and source share a pod) + the
+        # transfer seconds the plan actually charged it
+        self.pull_backend = "tcp"
+        self.pull_transfer_s = 0.0
         self.enqueue_t: Optional[float] = None
 
     def fail(self, reason: str) -> None:
@@ -138,10 +150,12 @@ class SimWorker:
         spec: WorkerSpec,
         clock,
         cold_store: Optional[set] = None,
+        pod: Optional[str] = None,
     ) -> None:
         self.worker_id = worker_id
         self.model = model
         self.spec = spec
+        self.pod = pod
         self.clock = clock
         self.cold_store = cold_store if cold_store is not None else set()
         self.tracker = DeviceTimeTracker(
@@ -368,8 +382,11 @@ class SimWorker:
         transfer_s = 0.0
         block_bytes = spec.block_size * spec.kv_bytes_per_token
         if sr.pulled_blocks:
-            transfer_s += (sr.pulled_blocks * block_bytes
-                           / (spec.peer_pull_gbps * 1e9))
+            gbps = (spec.ici_pull_gbps if sr.pull_backend == "ici"
+                    else spec.peer_pull_gbps)
+            sr.pull_transfer_s = (sr.pulled_blocks * block_bytes
+                                  / (gbps * 1e9))
+            transfer_s += sr.pull_transfer_s
         if sr.cold_blocks:
             transfer_s += (sr.cold_blocks * block_bytes
                            / (spec.cold_pull_gbps * 1e9))

@@ -1,0 +1,51 @@
+"""Unified zero-copy KV transfer plane.
+
+One framing, one pipelining discipline, one poison vocabulary for every
+byte of KV that crosses a worker boundary — the TPU-native analog of
+the reference's single NIXL/RDMA data plane. Three *planes* ride it:
+
+- ``disagg``    — streamed remote prefill (disagg/prefill_worker.py →
+  disagg/transfer.py), prefill KV pushed into a decode engine's cache.
+- ``fabric``    — cluster-KV-fabric prefix pulls (kv/fabric.py), a
+  peer's committed prefix pulled into a reserved run of blocks.
+- ``migration`` — live request migration (recovery/migration.py), a
+  draining engine's committed KV shipped to a healthy peer.
+
+and two *backends* move the payload bytes:
+
+- ``tcp`` (transfer/tcp.py) — length-prefixed msgpack headers with the
+  raw k/v bytes inline; packing and host syncs ride the executor.
+- ``ici`` (transfer/ici.py) — headers still ride the TCP control
+  connection (ordering + ids), but payloads move device-to-device over
+  the collective interconnect: the host touches headers only, one
+  collective in flight, sequence numbers cross-checked header-vs-
+  payload so a died-mid-stream sender can never mis-scatter.
+
+The backend is negotiated per peer pair from discovery metadata
+(``negotiate_backend``): same-pod pairs whose collective planes line up
+use ici; everything else (cross-pod, DCN, version skew) falls back to
+tcp. See docs/transfer_plane.md.
+"""
+
+from .framing import (  # noqa: F401
+    MAX_HEADER,
+    np_dtype,
+    pack_frame,
+    read_exact,
+    read_header,
+)
+from .plane import (  # noqa: F401
+    FramePipe,
+    PoisonSet,
+    TransferMetrics,
+    maybe_drop_connection,
+    negotiate_backend,
+    record_open,
+)
+from .tcp import TcpBackend  # noqa: F401
+from .ici import (  # noqa: F401
+    IciBackend,
+    LoopbackIciTransfer,
+    bounded_collective_recv,
+    call_in_daemon_thread,
+)

@@ -1,0 +1,85 @@
+"""Shared wire framing for every KV transfer plane.
+
+4-byte big-endian length-prefixed msgpack header, then raw payload
+bytes announced by the header (``k_bytes``/``v_bytes``). This module is
+the single home of the framing that used to be triplicated across
+disagg/transfer.py, kv/fabric.py, and recovery/migration.py — the
+header cap, the exact-read helper, the dtype resolution (ml_dtypes for
+the fp8/bf16 names numpy doesn't know), and the block-payload
+encode/decode pair.
+
+Headers are small (ids, shapes, trace ids) and may be packed on the
+event loop; block payloads are NOT — ``encode_blocks`` host-syncs and
+copies, so callers run it in an executor (the pack-vs-wire discipline
+dynlint's async-blocking rule guards).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional, Tuple
+
+import msgpack
+import numpy as np
+
+MAX_HEADER = 1 << 20
+
+
+def np_dtype(name: str):
+    """Resolve a wire dtype name, falling back to ml_dtypes for the
+    accelerator dtypes (bfloat16, float8_*) numpy itself rejects."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+async def read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    return await reader.readexactly(n)
+
+
+def pack_frame(writer: asyncio.StreamWriter, header: dict,
+               *payloads: bytes) -> None:
+    """Write one frame: length-prefixed msgpack header + raw payloads.
+    The caller drains; payload bytes must already be packed (executor)."""
+    data = msgpack.packb(header, use_bin_type=True)
+    writer.write(struct.pack(">I", len(data)) + data)
+    for p in payloads:
+        writer.write(p)
+
+
+async def read_header(reader: asyncio.StreamReader,
+                      what: str = "transfer") -> Optional[dict]:
+    """Read one frame header. Returns None on a clean connection end
+    (EOF/reset between frames); raises ValueError on an oversized
+    header — a corrupt or hostile peer, never recoverable in-stream."""
+    try:
+        raw_len = await read_exact(reader, 4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (hlen,) = struct.unpack(">I", raw_len)
+    if hlen > MAX_HEADER:
+        raise ValueError(f"{what} header too large: {hlen}")
+    return msgpack.unpackb(await read_exact(reader, hlen), raw=False)
+
+
+def encode_blocks(k: np.ndarray, v: np.ndarray,
+                  ) -> Tuple[bytes, bytes, list, str]:
+    """Host-side payload pack: ``(k_bytes, v_bytes, shape, dtype_name)``
+    over contiguous copies. Host-syncs — run in an executor."""
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    return k.tobytes(), v.tobytes(), list(k.shape), k.dtype.name
+
+
+def decode_blocks(k_raw: bytes, v_raw: bytes, shape, dtype_name: str,
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`encode_blocks` (zero-copy views over the
+    received buffers)."""
+    dtype = np_dtype(dtype_name)
+    shape = tuple(shape)
+    return (np.frombuffer(k_raw, dtype=dtype).reshape(shape),
+            np.frombuffer(v_raw, dtype=dtype).reshape(shape))

@@ -1,0 +1,268 @@
+"""TransferPlane core: lifecycle, pipelining, poison, unified telemetry.
+
+Everything here is plane- and backend-agnostic; the per-plane handlers
+(disagg/transfer.py, kv/fabric.py, recovery/migration.py) compose these
+pieces instead of each keeping a private copy:
+
+- :class:`PoisonSet` — the dropped-payload discipline. A request with a
+  lost/mis-paired/unauthorized payload frame must have its commit
+  NACKED (disagg), its reservation aborted (migration), or its pull
+  abandoned (fabric) — resuming over blocks that were never scattered
+  silently corrupts the stream. TTL + logged-cap pruning bound it.
+- :class:`FramePipe` — the ≤2-frames-in-flight conveyor between a
+  chunk/gather producer and one wire pump: ``maxsize=1`` plus the
+  pump's one-frame lookahead bounds live host buffers at two
+  chunk-sized frames regardless of sequence length.
+- :class:`TransferMetrics` — the unified ``dynamo_transfer_*`` family,
+  labelled ``{plane, backend}``; replaces the per-plane ad-hoc names
+  (retired: dynamo_disagg_transfer_*, dynamo_prefill_worker_transfer_
+  bytes_total, dynamo_kv_fabric_prefix_pull_{bytes,duration}_*).
+- ``negotiate_backend`` — per-peer-pair payload path selection from
+  discovery metadata; tcp is always the safe cross-pod/DCN fallback.
+- ``transfer.open`` / ``transfer.poison`` flight events with backend
+  attribution.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+PLANES = ("disagg", "fabric", "migration")
+
+# dropped-payload bookkeeping: ids are removed when their commit is
+# nacked; requests that never commit would otherwise accumulate forever.
+# TTL >> any sane commit delay (the decode side's prefill timeout is
+# 120 s), so expiry never un-poisons a commit that could still arrive;
+# the count cap is a last-resort bound and LOGS what it evicts.
+MAX_DROPPED = 4096
+DROPPED_TTL_S = 600.0
+
+# the chaos site every plane's client (and the fabric's serve side)
+# consults between chunk frames — one seam, one env knob
+CONN_DROP_FAULT = "transfer_conn_drop"
+
+
+def record_open(plane: str, backend: str, peer: str = "",
+                trace_id: Optional[str] = None) -> None:
+    """``transfer.open`` flight event: one channel dialled (or adopted)
+    with the negotiated payload backend — the attribution that makes a
+    'why was this pull slow' triage a one-ring read."""
+    from ..telemetry.flight import flight_recorder
+
+    flight_recorder().record(
+        "transfer.open", plane=plane, backend=backend, peer=peer or None,
+        trace_id=trace_id,
+    )
+
+
+def maybe_drop_connection(plane: str) -> bool:
+    """The ``transfer_conn_drop`` chaos seam, shared by every plane's
+    chunk loop: returns True when the armed fault fires — the caller
+    closes its writer and raises, exercising the receiver's poison
+    path. One call site per chunk keeps the drop mid-stream-able."""
+    from ..utils import faults
+
+    return faults.fire(CONN_DROP_FAULT)
+
+
+class PoisonSet:
+    """Request ids whose payload stream can no longer be trusted.
+
+    Insertion-ordered (``dict``) so TTL expiry is a prefix scan; the
+    cap eviction LOGS — un-poisoning is the corruption this set exists
+    to prevent, so silent eviction would be worse than the memory.
+    """
+
+    def __init__(self, plane: str):
+        self.plane = plane
+        self._dropped: Dict[str, float] = {}
+
+    def __len__(self) -> int:
+        return len(self._dropped)
+
+    def __contains__(self, request_id: str) -> bool:
+        return request_id in self._dropped
+
+    def mark(self, request_id: str, trace_id: Optional[str] = None,
+             backend: str = "tcp", reason: str = "") -> None:
+        from ..telemetry.flight import flight_recorder
+
+        now = time.monotonic()
+        flight_recorder().record(
+            "transfer.poison", plane=self.plane, backend=backend,
+            request_id=request_id, trace_id=trace_id,
+            reason=reason or None,
+        )
+        self._dropped.pop(request_id, None)
+        self._dropped[request_id] = now
+        # TTL expiry (insertion order == time order): anything this old
+        # can no longer see a commit — the other side gave up on the
+        # request minutes ago
+        for rid, t in list(self._dropped.items()):
+            if now - t <= DROPPED_TTL_S:
+                break
+            del self._dropped[rid]
+        while len(self._dropped) > MAX_DROPPED:
+            rid, _ = next(iter(self._dropped.items()))
+            del self._dropped[rid]
+            logger.error(
+                "dropped-payload set over cap (%d); evicting %s — a late "
+                "commit for it would now be accepted", MAX_DROPPED, rid,
+            )
+
+    def pop(self, request_id: str) -> bool:
+        """Consume a poison mark at commit time: True → nack."""
+        return self._dropped.pop(request_id, None) is not None
+
+
+class FramePipe:
+    """Bounded conveyor between the chunk loop and one transfer pump.
+
+    The producer dispatches device gathers and enqueues
+    (k_dev, v_dev, dst_ids) frames; the pump coroutine drains them to
+    the wire. ``maxsize=1`` plus the pump's one-frame lookahead bounds
+    live buffers: at most two chunk-sized frames exist in host memory
+    at any point (one being packed, one on the wire), regardless of
+    sequence length. On the ici backend payloads never reach the host
+    at all — the pipe then bounds in-flight *device* frames the same
+    way (one collective in flight, one gather dispatched behind it).
+    """
+
+    def __init__(self, depth: int, frame_blocks: int):
+        self.depth = depth  # 1 = strictly serial frames, 2 = double-buffered
+        self.frame_blocks = frame_blocks  # max KV blocks per frame
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self.closed = False  # pump consumed the end-of-stream sentinel
+        self.error: Optional[BaseException] = None
+        self.nbytes = 0
+        self.frames = 0
+        self.first_frame_t: Optional[float] = None
+        self.live_host_frames = 0
+        self.max_live_host_frames = 0
+        self.task: Optional[asyncio.Task] = None
+
+    async def put(self, frame) -> None:
+        if self.error is not None:
+            raise self.error
+        if self.first_frame_t is None:
+            self.first_frame_t = time.monotonic()
+        await self.q.put(frame)
+        # the pump may have failed while we were blocked on the queue
+        if self.error is not None:
+            raise self.error
+
+    async def drain(self) -> int:
+        """Flush: every enqueued frame is on the wire (or the pump's
+        failure is re-raised). Must be awaited before the commit frame."""
+        await self.q.put(None)
+        await self.task
+        if self.error is not None:
+            raise self.error
+        return self.nbytes
+
+    async def shutdown(self) -> None:
+        """Abnormal-exit cleanup: the happy path already joined the pump
+        via drain(); anything else is an error/cancel path where the
+        connection is being torn down anyway — cancel outright."""
+        if self.task is not None and not self.task.done():
+            self.task.cancel()
+            try:
+                await self.task
+            # dynlint: allow(silent-except) - cancel-join of an abandoned pump; the originating error already propagated via pipe.error
+            except BaseException:
+                pass
+
+
+class TransferMetrics:
+    """The unified ``dynamo_transfer_*`` instrument family.
+
+    One instance per component registry; every sample carries
+    ``plane`` (disagg|fabric|migration) and ``backend`` (tcp|ici —
+    plus ``local`` for the fabric's cold-tier rehydrates, which move
+    bytes without a wire). Separate component processes each register
+    the family into their own exposition; label sets disambiguate."""
+
+    def __init__(self, registry, plane: Optional[str] = None):
+        self.plane = plane
+        self._bytes = registry.counter(
+            "dynamo_transfer_bytes_total",
+            "KV payload bytes moved across the unified transfer plane, "
+            "labelled plane=disagg|fabric|migration and backend=tcp|ici|"
+            "local",
+        )
+        self._duration = registry.histogram(
+            "dynamo_transfer_duration_seconds",
+            "One transfer end to end (first frame enqueued/dialled → "
+            "commit acked or last block installed), labelled "
+            "{plane, backend}",
+        )
+        self._exposed = registry.histogram(
+            "dynamo_transfer_exposed_seconds",
+            "Non-overlapped transfer tail: wire time AFTER the covering "
+            "compute finished (commit RTT included; 0 = fully hidden "
+            "behind compute), labelled {plane, backend}",
+        )
+        self._channels = registry.gauge(
+            "dynamo_transfer_channels",
+            "Open transfer channels (control connections), labelled "
+            "{plane, backend}",
+        )
+
+    def _labels(self, backend: str, plane: Optional[str]) -> dict:
+        return {"plane": plane or self.plane or "?", "backend": backend}
+
+    def add_bytes(self, n: int, backend: str,
+                  plane: Optional[str] = None) -> None:
+        self._bytes.inc(n, **self._labels(backend, plane))
+
+    def observe_duration(self, seconds: float, backend: str,
+                         plane: Optional[str] = None) -> None:
+        self._duration.observe(seconds, **self._labels(backend, plane))
+
+    def observe_exposed(self, seconds: float, backend: str,
+                        plane: Optional[str] = None) -> None:
+        self._exposed.observe(seconds, **self._labels(backend, plane))
+
+    def channel_opened(self, backend: str,
+                       plane: Optional[str] = None) -> None:
+        self._channels.inc(1, **self._labels(backend, plane))
+
+    def channel_closed(self, backend: str,
+                       plane: Optional[str] = None) -> None:
+        self._channels.dec(1, **self._labels(backend, plane))
+
+
+def negotiate_backend(descriptor: Optional[dict], ici,
+                      peer_role: str = "receiver") -> str:
+    """Pick the payload backend for one peer pair.
+
+    ``descriptor`` is the peer's discovery record ({modes, ici_rank});
+    ``ici`` the LOCAL collective plane (None, or abandoned → tcp);
+    ``peer_role`` names the role the PEER plays on that plane
+    ("receiver" when we send — disagg push, migration; "sender" when we
+    pull — fabric). ici applies only when the peer advertises the mode
+    AND its rank matches the local plane's configured opposite role —
+    an ici-enabled peer on a different mesh would enter a collective
+    that never pairs, stranding both sides. A descriptor without a rank
+    predates rank advertisement: trust the mode flag (matches pre-rank
+    behavior; a genuine mismatch is only detectable when the peer says
+    who it is)."""
+    if ici is None or not getattr(ici, "alive", True):
+        return "tcp"
+    modes = (descriptor or {}).get("modes") or ("tcp",)
+    if "ici" not in modes:
+        return "tcp"
+    rank = (descriptor or {}).get("ici_rank")
+    want = getattr(ici, f"{peer_role}_rank", None)
+    if rank is not None and want is not None and rank != want:
+        logger.warning(
+            "peer's ici %s rank %s != configured %s; using tcp",
+            peer_role, rank, want,
+        )
+        return "tcp"
+    return "ici"

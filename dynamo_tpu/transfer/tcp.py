@@ -1,0 +1,54 @@
+"""TcpBackend: block payloads inline on the control connection.
+
+The cross-pod/DCN fallback every peer pair supports. Frames are the
+shared framing (transfer/framing.py); the byte-pack host-syncs device
+gathers, so it runs in an executor — headers alone ride the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .framing import decode_blocks, encode_blocks, pack_frame, read_exact
+
+
+class TcpBackend:
+    """Payload path: raw k/v bytes framed behind the header."""
+
+    name = "tcp"
+
+    @staticmethod
+    async def send_blocks(writer: asyncio.StreamWriter, header: dict,
+                          k: np.ndarray, v: np.ndarray,
+                          packed: Optional[Tuple] = None) -> int:
+        """Write one block frame; returns payload bytes. ``packed`` lets
+        a pump that already encoded off-loop skip the executor hop."""
+        if packed is None:
+            loop = asyncio.get_running_loop()
+            packed = await loop.run_in_executor(None, encode_blocks, k, v)
+        kb, vb, shape, dtype_name = packed
+        header = dict(header)
+        header.update(shape=shape, dtype=dtype_name,
+                      k_bytes=len(kb), v_bytes=len(vb))
+        pack_frame(writer, header, kb, vb)
+        await writer.drain()
+        return len(kb) + len(vb)
+
+    @staticmethod
+    async def recv_blocks(reader: asyncio.StreamReader,
+                          header: dict) -> Tuple[np.ndarray, np.ndarray]:
+        """Read the payload a block-frame header announced."""
+        k_raw = await read_exact(reader, header["k_bytes"])
+        v_raw = await read_exact(reader, header["v_bytes"])
+        return decode_blocks(k_raw, v_raw, header["shape"], header["dtype"])
+
+
+def payload_nbytes(header: dict) -> int:
+    return int(header.get("k_bytes", 0)) + int(header.get("v_bytes", 0))
+
+
+def block_ids_of(header: dict) -> List[int]:
+    return list(map(int, header.get("block_ids") or []))

@@ -1932,6 +1932,58 @@ def test_race_cross_module_domain_propagation_via_relative_import():
 
 
 # --------------------------------------------------------------------------
+# unified transfer plane (dynamo_tpu/transfer/)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.dynlint
+def test_transfer_plane_modules_pass_three_rule_screen():
+    """Every KV byte in the system rides this package (disagg push,
+    fabric pull, hot migration), so its discipline failures multiply:
+    a blocking encode on the loop stalls all three planes at once, a
+    dropped pump task strands a half-sent stream, and a cross-domain
+    write on the shared poison/pipe state corrupts commit semantics
+    under the executor offloads the framing itself performs. Pin the
+    whole package ZERO-finding — not baseline-covered — on all three
+    rules."""
+    modules = [
+        os.path.join(PACKAGE_ROOT, "transfer", "__init__.py"),
+        os.path.join(PACKAGE_ROOT, "transfer", "framing.py"),
+        os.path.join(PACKAGE_ROOT, "transfer", "plane.py"),
+        os.path.join(PACKAGE_ROOT, "transfer", "tcp.py"),
+        os.path.join(PACKAGE_ROOT, "transfer", "ici.py"),
+    ]
+    found = lint_paths(
+        modules,
+        get_rules(["async-blocking", "task-leak", "cross-domain-race"]),
+    )
+    assert found == [], "transfer-plane discipline regressed:\n" + \
+        "\n".join(f.render() for f in found)
+
+
+def test_async_blocking_flags_pack_on_loop_shape():
+    """TP fixture shaped like a careless transfer backend: the frame
+    encode spills through a blocking file write on the event loop —
+    every other channel's pipelining stalls behind one sender's disk.
+    (The real backends push encode_blocks through run_in_executor and
+    only pack the small header inline.)"""
+    out = findings(
+        """
+        import numpy as np
+
+        async def send_frame(writer, k, v, spool_path):
+            kb = np.ascontiguousarray(k).tobytes()
+            with open(spool_path, "wb") as fh:
+                fh.write(kb)
+            writer.write(kb)
+            await writer.drain()
+        """,
+        "async-blocking",
+    )
+    assert [f.rule for f in out] == ["async-blocking"]
+
+
+# --------------------------------------------------------------------------
 # dynrace: enforcement pins for the triaged serving-plane modules
 # --------------------------------------------------------------------------
 

@@ -111,6 +111,49 @@ def test_rag_exercises_prefix_reuse_and_cold_tier():
     assert t["cold_blocks"] > 0, "no cold-tier rehydration modeled"
 
 
+def test_prefill_plan_costs_pull_by_backend():
+    """The byte model charges a peer pull at the negotiated backend's
+    bandwidth (docs/transfer_plane.md): the same pull rides ici at
+    ici_pull_gbps / peer_pull_gbps of the DCN cost."""
+    from dynamo_tpu.sim.worker import SimRequest, SimWorker, WorkerSpec
+    from dynamo_tpu.sim.workload import Request
+
+    spec = WorkerSpec(peer_pull_gbps=40.0, ici_pull_gbps=400.0)
+    w = SimWorker("w0", "sim-model", spec, clock=lambda: 0.0)
+    sr = SimRequest(Request(arrival_s=0.0, request_id="r0", isl=4096),
+                    arrival_t=0.0)
+    sr.pulled_blocks = 64
+    tcp_s = w._prefill_plan(sr)[0]
+    sr.pull_backend = "ici"
+    ici_s = w._prefill_plan(sr)[0]
+    assert tcp_s > 0.0
+    assert ici_s == pytest.approx(tcp_s / 10.0)
+    assert sr.pull_transfer_s == pytest.approx(ici_s)
+
+
+def test_rag_pod_pull_cost_collapses_intra_pod():
+    """Same RAG traffic, two fleet shapes: without pods every peer pull
+    pays the DCN rate; inside one ICI pod the pulls negotiate the
+    collective backend and the per-block transfer cost collapses by the
+    bandwidth ratio."""
+    dcn = run_scenario("rag", seed=0, duration_s=420.0)
+    pod = run_scenario("rag_pod", seed=0, duration_s=420.0)
+    _report_shape_ok(pod)
+    # no pods → no ici pulls, every pulled block paid the tcp rate
+    assert dcn["totals"]["pulled_blocks_ici"] == 0
+    assert dcn["totals"]["pulled_blocks"] > 0
+    assert dcn["totals"]["pull_transfer_s_tcp"] > 0.0
+    # one pod covers the whole fleet → every pull rides the collective
+    t = pod["totals"]
+    assert t["pulled_blocks"] > 0
+    assert t["pulled_blocks_ici"] == t["pulled_blocks"]
+    assert t["pull_transfer_s_tcp"] == 0.0
+    dcn_per_block = (dcn["totals"]["pull_transfer_s_tcp"]
+                     / dcn["totals"]["pulled_blocks"])
+    pod_per_block = t["pull_transfer_s_ici"] / t["pulled_blocks"]
+    assert pod_per_block < dcn_per_block / 5.0
+
+
 def test_long_context_routes_sp_prefills():
     rep = run_scenario("long_context", seed=0, duration_s=420.0)
     _report_shape_ok(rep)

@@ -40,11 +40,15 @@ def test_lint_sees_the_real_instrument_catalog():
         # streamed remote prefill (disagg/prefill_worker.py)
         "dynamo_prefill_worker_prefills_total",
         "dynamo_prefill_worker_prefill_tokens_total",
-        "dynamo_prefill_worker_transfer_bytes_total",
         "dynamo_prefill_worker_queue_wait_seconds",
         "dynamo_prefill_worker_prefix_hit_ratio",
-        "dynamo_disagg_transfer_duration_seconds",
-        "dynamo_disagg_transfer_exposed_seconds",
+        # unified transfer plane (transfer/plane.py): one
+        # {plane,backend}-labelled family replaces the per-plane
+        # transfer instruments the disagg/fabric planes used to register
+        "dynamo_transfer_bytes_total",
+        "dynamo_transfer_duration_seconds",
+        "dynamo_transfer_exposed_seconds",
+        "dynamo_transfer_channels",
         # flight recorder / watchdog / XLA compile observability
         # (telemetry/flight.py, telemetry/watchdog.py)
         "dynamo_engine_xla_compiles_total",
@@ -102,8 +106,6 @@ def test_lint_sees_the_real_instrument_catalog():
         # cluster KV fabric: cross-worker prefix pull (kv/fabric.py)
         # + content-addressed cold tier (kv/cold_tier.py)
         "dynamo_kv_fabric_prefix_pull_total",
-        "dynamo_kv_fabric_prefix_pull_bytes_total",
-        "dynamo_kv_fabric_prefix_pull_duration_seconds",
         "dynamo_kv_fabric_cold_tier_hits_total",
         "dynamo_kv_fabric_cold_tier_misses_total",
         "dynamo_kv_fabric_cold_tier_evictions_total",

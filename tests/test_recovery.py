@@ -40,7 +40,7 @@ from dynamo_tpu.recovery import (
     RecoveryController,
     migration_class,
 )
-from dynamo_tpu.recovery.migration import _pack, _read_header
+from dynamo_tpu.transfer.framing import pack_frame, read_header
 from dynamo_tpu.runtime.engine import AsyncEngineContext
 from dynamo_tpu.telemetry.flight import FlightRecorder
 from dynamo_tpu.telemetry.watchdog import StallWatchdog
@@ -369,10 +369,10 @@ async def test_receiver_poisons_partial_migration():
         )
         reader, writer = await asyncio.open_connection(
             server.host, server.port)
-        _pack(writer, {"type": "mig_begin", "state": state.to_wire(),
+        pack_frame(writer, {"type": "mig_begin", "state": state.to_wire(),
                        "nblocks": 2})
         await writer.drain()
-        ack = await _read_header(reader)
+        ack = await read_header(reader, "migration")
         assert ack["ok"]
         assert dst.allocator.used == 2  # reservation held
         writer.close()  # sender dies before commit

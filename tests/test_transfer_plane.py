@@ -1,0 +1,593 @@
+"""Unified transfer plane (dynamo_tpu/transfer/): primitives + loopback
+ICI differentials.
+
+The acceptance contract: every plane (disagg push, fabric prefix pull,
+hot migration) rides the same framing/poison/pipelining core, the ici
+backend produces BYTE-IDENTICAL streams to tcp with zero leaked blocks
+or pins on either side, a backend dying mid-stream degrades (balancing
+or abandonment per the pairing discipline) without corrupting anything,
+and ``DYN_FAULT=transfer_conn_drop`` drops connections through the one
+shared chaos seam.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.recovery import (
+    MigrationServer,
+    MigrationSink,
+    RecoveryConfig,
+    RecoveryController,
+)
+from dynamo_tpu.telemetry.flight import FlightRecorder, flight_recorder
+from dynamo_tpu.telemetry.registry import MetricsRegistry
+from dynamo_tpu.transfer import (
+    MAX_HEADER,
+    FramePipe,
+    IciBackend,
+    LoopbackIciTransfer,
+    PoisonSet,
+    TcpBackend,
+    maybe_drop_connection,
+    negotiate_backend,
+    pack_frame,
+    read_exact,
+    read_header,
+)
+from dynamo_tpu.transfer.framing import decode_blocks, encode_blocks
+from dynamo_tpu.transfer.plane import TransferMetrics
+from dynamo_tpu.utils import faults
+
+from test_jax_engine import hf_model_dir, TINY  # noqa: F401
+from test_kv_fabric import (
+    SHARED_PREFIX,
+    _assert_no_leaks,
+    _engine,
+    _events,
+    _run_one,
+    _wire_a_to_b,
+)
+from test_recovery import (
+    MigRunner,
+    _baseline,
+    _collect,
+    _config,
+    _request,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------------------
+# framing: the one wire format all three planes share
+# --------------------------------------------------------------------------
+
+
+class _BufWriter:
+    def __init__(self):
+        self.chunks = []
+
+    def write(self, b):
+        self.chunks.append(bytes(b))
+
+    def bytes(self):
+        return b"".join(self.chunks)
+
+
+def _reader_over(raw: bytes) -> asyncio.StreamReader:
+    r = asyncio.StreamReader()
+    r.feed_data(raw)
+    r.feed_eof()
+    return r
+
+
+async def test_framing_roundtrip_and_clean_eof():
+    w = _BufWriter()
+    pack_frame(w, {"type": "blocks", "seq": 3}, b"abcde", b"xy")
+    pack_frame(w, {"type": "commit"})
+    r = _reader_over(w.bytes())
+    h = await read_header(r, "t")
+    assert h == {"type": "blocks", "seq": 3}
+    assert await read_exact(r, 5) == b"abcde"
+    assert await read_exact(r, 2) == b"xy"
+    assert (await read_header(r, "t")) == {"type": "commit"}
+    # a clean EOF at a frame boundary is None, not an exception — the
+    # callers that need failure semantics raise on None explicitly
+    assert await read_header(r, "t") is None
+
+
+async def test_framing_rejects_oversized_header():
+    r = _reader_over(struct.pack(">I", MAX_HEADER + 1) + b"\x00" * 8)
+    with pytest.raises(ValueError):
+        await read_header(r, "t")
+
+
+def test_encode_decode_blocks_roundtrip_incl_bfloat16():
+    import ml_dtypes
+
+    for dtype in (np.float32, ml_dtypes.bfloat16):
+        k = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 1, 4)
+        k = k.astype(dtype)
+        v = (k + 1).astype(dtype)
+        kb, vb, shape, dname = encode_blocks(k, v)
+        k2, v2 = decode_blocks(kb, vb, shape, dname)
+        np.testing.assert_array_equal(np.asarray(k2), np.asarray(k))
+        np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
+        assert k2.shape == k.shape and k2.dtype == k.dtype
+
+
+# --------------------------------------------------------------------------
+# plane primitives: poison, pipelining bound, negotiation, chaos seam
+# --------------------------------------------------------------------------
+
+
+def test_poison_set_marks_nacks_once_and_expires(monkeypatch):
+    from dynamo_tpu.transfer import plane as plane_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(plane_mod.time, "monotonic", lambda: now[0])
+    ps = PoisonSet("disagg")
+    ps.mark("r1", backend="ici", reason="seq_mismatch")
+    assert "r1" in ps
+    # one commit consumes the mark (nack-once): a retried request id
+    # must not stay poisoned forever
+    assert ps.pop("r1") is True
+    assert ps.pop("r1") is False
+    # TTL expiry: a mark older than DROPPED_TTL_S is pruned on the next
+    # insert — no commit can still arrive for it
+    ps.mark("old")
+    now[0] += plane_mod.DROPPED_TTL_S + 1
+    ps.mark("new")
+    assert "old" not in ps and "new" in ps
+
+
+async def test_frame_pipe_bounds_live_frames_at_two():
+    """The pipelining acceptance: maxsize=1 + one-frame pump lookahead
+    means at most TWO frames exist between producer and wire at any
+    instant, regardless of how many chunks the sequence has."""
+    pipe = FramePipe(depth=2, frame_blocks=4)
+    drained = []
+
+    async def pump():
+        while True:
+            f = await pipe.q.get()
+            if f is None:
+                return
+            await asyncio.sleep(0.005)  # slow wire: producer must block
+            drained.append(f)
+            pipe.nbytes += 1
+
+    pipe.task = asyncio.ensure_future(pump())
+    max_outstanding = 0
+    for i in range(6):
+        await pipe.put(i)
+        max_outstanding = max(max_outstanding, (i + 1) - len(drained))
+    assert await pipe.drain() == 6
+    assert drained == list(range(6)), "frames lost or reordered"
+    assert max_outstanding <= 2, \
+        f"{max_outstanding} frames in flight — pipelining bound broken"
+
+
+async def test_frame_pipe_surfaces_pump_error_on_put():
+    pipe = FramePipe(depth=2, frame_blocks=4)
+
+    async def pump():
+        await pipe.q.get()
+        pipe.error = ConnectionResetError("wire died")
+        # drain the queue so a blocked producer wakes to see the error
+        while not pipe.q.empty():
+            pipe.q.get_nowait()
+
+    pipe.task = asyncio.ensure_future(pump())
+    await pipe.put(0)
+    await pipe.task
+    with pytest.raises(ConnectionResetError):
+        await pipe.put(1)
+    await pipe.shutdown()
+
+
+def test_negotiate_backend_matrix():
+    ici = IciBackend(LoopbackIciTransfer(sender_rank=0, receiver_rank=1))
+    # no local plane, or an abandoned one → tcp always
+    assert negotiate_backend({"modes": ["tcp", "ici"]}, None) == "tcp"
+    dead = IciBackend(LoopbackIciTransfer())
+    dead.abandon()
+    assert negotiate_backend({"modes": ["tcp", "ici"]}, dead) == "tcp"
+    # peer doesn't advertise ici (or predates modes) → tcp
+    assert negotiate_backend({"modes": ["tcp"]}, ici) == "tcp"
+    assert negotiate_backend({}, ici) == "tcp"
+    assert negotiate_backend(None, ici) == "tcp"
+    # rank mismatch = a different mesh: entering would strand both sides
+    assert negotiate_backend(
+        {"modes": ["tcp", "ici"], "ici_rank": 7}, ici,
+        peer_role="receiver") == "tcp"
+    # matching rank per role
+    assert negotiate_backend(
+        {"modes": ["tcp", "ici"], "ici_rank": 1}, ici,
+        peer_role="receiver") == "ici"
+    assert negotiate_backend(
+        {"modes": ["tcp", "ici"], "ici_rank": 0}, ici,
+        peer_role="sender") == "ici"
+    # no rank advertised → trust the mode flag (pre-rank descriptors)
+    assert negotiate_backend({"modes": ["tcp", "ici"]}, ici) == "ici"
+
+
+def test_conn_drop_fault_fires_through_the_shared_seam():
+    """DYN_FAULT=transfer_conn_drop is rewired to the one chaos seam
+    every plane's chunk loop consults."""
+    assert maybe_drop_connection("disagg") is False
+    faults.arm("transfer_conn_drop", "once")
+    assert maybe_drop_connection("fabric") is True
+    assert maybe_drop_connection("migration") is False  # one-shot
+
+
+def _global_flight_watermark():
+    """record_open/PoisonSet record into the process-global flight ring
+    (planes outlive any one scheduler); return a seq watermark so a test
+    only reads its own events."""
+    events = flight_recorder().snapshot()
+    return events[-1]["seq"] if events else -1
+
+
+def _global_flight_since(seq0, kind):
+    return [{**e.get("data", {}), **e}
+            for e in flight_recorder().snapshot()
+            if e["seq"] > seq0 and e.get("kind") == kind]
+
+
+def test_transfer_metrics_single_family_with_plane_backend_labels():
+    reg = MetricsRegistry()
+    m = TransferMetrics(reg, plane="fabric")
+    m.add_bytes(64, "ici")
+    m.add_bytes(32, "tcp", plane="migration")
+    m.observe_duration(0.5, "ici")
+    m.observe_exposed(0.1, "ici")
+    m.channel_opened("ici")
+    m.channel_closed("ici")
+    out = reg.render()
+    assert "dynamo_transfer_bytes_total" in out
+    assert 'plane="fabric"' in out and 'backend="ici"' in out
+    assert 'plane="migration"' in out and 'backend="tcp"' in out
+    assert "dynamo_transfer_duration_seconds" in out
+    assert "dynamo_transfer_exposed_seconds" in out
+    assert "dynamo_transfer_channels" in out
+    # the retired per-plane families must NOT be re-registered anywhere
+    for retired in ("dynamo_disagg_transfer_duration_seconds",
+                    "dynamo_kv_fabric_prefix_pull_bytes_total",
+                    "dynamo_prefill_worker_transfer_bytes_total"):
+        assert retired not in out
+
+
+# --------------------------------------------------------------------------
+# ici backend discipline (loopback: full pairing semantics, no mesh)
+# --------------------------------------------------------------------------
+
+
+async def test_loopback_ici_send_recv_pairs_and_crosschecks_seq():
+    lb = LoopbackIciTransfer()
+    tx, rx = IciBackend(lb), IciBackend(lb, recv_timeout_s=5.0)
+    k = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 1, 2)
+    v = k + 10
+    seq = tx.next_seq()
+    sent_task = asyncio.ensure_future(tx.send(k, v, seq, 2))
+    rk, rv, rseq = await rx.recv(2)
+    assert await sent_task == k.nbytes + v.nbytes
+    assert rseq == seq
+    np.testing.assert_array_equal(rk, k)
+    np.testing.assert_array_equal(rv, v)
+
+
+async def test_pre_entry_send_failure_balances_and_keeps_plane():
+    """A failure BEFORE entering the collective leaves the receiver an
+    unpaired entry: the sender pairs it with a poison payload (seq -1)
+    and the plane REMAINS usable for the retry."""
+    lb = LoopbackIciTransfer()
+    tx, rx = IciBackend(lb), IciBackend(lb, recv_timeout_s=5.0)
+    lb.fail_next_send = "pre"
+    k = np.zeros((1, 2, 2, 1, 2), np.float32)
+    with pytest.raises(Exception):
+        await tx.send(k, k, tx.next_seq(), 2)
+    assert tx.alive, "pre-entry failure must not abandon the plane"
+    assert lb.balanced == 1
+    _, _, seq = await rx.recv(2)
+    assert seq == -1, "poison payload must never match a real header"
+    # the retry pairs cleanly
+    seq2 = tx.next_seq()
+    sent = asyncio.ensure_future(tx.send(k, k, seq2, 2))
+    assert (await rx.recv(2))[2] == seq2
+    await sent
+
+
+async def test_post_entry_send_failure_abandons_plane():
+    lb = LoopbackIciTransfer()
+    tx = IciBackend(lb)
+    lb.fail_next_send = "post"
+    k = np.zeros((1, 2, 2, 1, 2), np.float32)
+    with pytest.raises(Exception):
+        await tx.send(k, k, tx.next_seq(), 2)
+    assert not tx.alive, "entered-collective failure must abandon"
+    assert negotiate_backend({"modes": ["tcp", "ici"]}, tx) == "tcp"
+
+
+async def test_recv_timeout_abandons_plane_receiver_side():
+    rx = IciBackend(LoopbackIciTransfer(), recv_timeout_s=0.05)
+    with pytest.raises(asyncio.TimeoutError):
+        await rx.recv(2)  # nothing was ever sent
+    assert not rx.alive
+
+
+# --------------------------------------------------------------------------
+# fabric prefix pull over loopback ici: the byte-identity differential
+# --------------------------------------------------------------------------
+
+
+async def _two_engine_ici_rig(hf_model_dir, recv_timeout_s=10.0):
+    """test_kv_fabric's two-engine rig with a shared loopback collective
+    plane: A serves pulls with its sender half, B receives with the
+    receiver half, and the peer descriptor advertises the mode + rank
+    so negotiation picks ici."""
+    lb = LoopbackIciTransfer(sender_rank=0, receiver_rank=1)
+    sched_b = _engine(hf_model_dir)
+    sched_a = _engine(hf_model_dir, events=_wire_a_to_b(sched_b))
+    sched_a.fabric.set_ici(IciBackend(lb))
+    sched_b.fabric.set_ici(IciBackend(lb, recv_timeout_s=recv_timeout_s))
+    server_a = await sched_a.fabric.serve()
+    desc = dict(server_a.descriptor)
+    assert "ici" in desc["modes"], "serve half must advertise the plane"
+    sched_b.fabric.peers = (lambda: {"worker-a": desc})
+    sched_a.start()
+    sched_b.start()
+    return sched_a, sched_b, lb
+
+
+def _spy_tcp_payloads(monkeypatch):
+    """Count TcpBackend payload moves — the ici differential must show
+    ZERO (headers ride tcp, payloads never do)."""
+    calls = {"send": 0, "recv": 0}
+    real_send, real_recv = TcpBackend.send_blocks, TcpBackend.recv_blocks
+
+    async def spy_send(*a, **kw):
+        calls["send"] += 1
+        return await real_send(*a, **kw)
+
+    async def spy_recv(*a, **kw):
+        calls["recv"] += 1
+        return await real_recv(*a, **kw)
+
+    monkeypatch.setattr(TcpBackend, "send_blocks", spy_send)
+    monkeypatch.setattr(TcpBackend, "recv_blocks", spy_recv)
+    return calls
+
+
+async def test_fabric_pull_over_ici_byte_identical(hf_model_dir,
+                                                   monkeypatch):
+    """The headline fabric differential: the same pull that commits over
+    tcp commits over loopback ici with a BYTE-IDENTICAL stream, zero
+    leaked blocks/pins on both sides, and the payload never touching
+    the host (no TcpBackend block move, device arrays scattered)."""
+    prompt_a = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    prompt_b = SHARED_PREFIX + [40, 41, 42, 43, 44, 45]
+
+    sched_base = _engine(hf_model_dir)
+    sched_base.start()
+    baseline = await _run_one(sched_base, prompt_b, "base")
+    await sched_base.stop()
+
+    tcp_calls = _spy_tcp_payloads(monkeypatch)
+    seq0 = _global_flight_watermark()
+    sched_a, sched_b, lb = await _two_engine_ici_rig(hf_model_dir)
+    scattered_types = []
+    real_scatter = sched_b.runner.scatter_blocks
+
+    def spy_scatter(ids, k, v):
+        scattered_types.append(type(k))
+        return real_scatter(ids, k, v)
+
+    sched_b.runner.scatter_blocks = spy_scatter
+    try:
+        await _run_one(sched_a, prompt_a, "warm")
+        out = await _run_one(sched_b, prompt_b, "pulled")
+        assert out == baseline, "ici pull diverged from recompute"
+        pulls = _events(sched_b, "kv_fabric.pull")
+        assert pulls and pulls[-1]["backend"] == "ici"
+        assert pulls[-1]["outcome"] == "committed"
+        opens = [e for e in _global_flight_since(seq0, "transfer.open")
+                 if e["plane"] == "fabric"]
+        assert opens and opens[-1]["backend"] == "ici"
+        assert lb.sent >= 1, "no collective ever entered"
+        # zero-copy contract: payload frames never rode tcp, and what
+        # reached the cache was device arrays, not host ndarrays
+        assert tcp_calls == {"send": 0, "recv": 0}
+        assert scattered_types and all(
+            t is not np.ndarray for t in scattered_types)
+        _assert_no_leaks(sched_b)
+    finally:
+        await sched_a.stop()
+        await sched_b.stop()
+    _assert_no_leaks(sched_a)
+
+
+async def test_fabric_pull_ici_death_falls_back_byte_identical(
+        hf_model_dir):
+    """Mid-stream backend death: the serving side's collective fails
+    pre-entry — the balancing poison entry mis-matches the header seq on
+    the puller, the pull aborts (never scattering unknown bytes), and
+    the request falls back to local recompute byte-identically with
+    zero leaks. The plane survives (pre-entry discipline)."""
+    prompt_a = SHARED_PREFIX + [30, 31, 32, 33, 34, 35]
+    prompt_b = SHARED_PREFIX + [40, 41, 42, 43, 44, 45]
+
+    sched_base = _engine(hf_model_dir)
+    sched_base.start()
+    baseline = await _run_one(sched_base, prompt_b, "base")
+    await sched_base.stop()
+
+    sched_a, sched_b, lb = await _two_engine_ici_rig(hf_model_dir)
+    try:
+        await _run_one(sched_a, prompt_a, "warm")
+        lb.fail_next_send = "pre"
+        out = await _run_one(sched_b, prompt_b, "dropped")
+        assert out == baseline
+        assert _events(sched_b, "kv_fabric.local_fallback"), \
+            "expected a local fallback after the collective death"
+        assert sched_a.fabric.ici.alive, \
+            "pre-entry failure must keep the plane (balancing, not " \
+            "abandonment)"
+        _assert_no_leaks(sched_b)
+    finally:
+        await sched_a.stop()
+        await sched_b.stop()
+    _assert_no_leaks(sched_a)
+
+
+# --------------------------------------------------------------------------
+# hot migration over loopback ici
+# --------------------------------------------------------------------------
+
+
+class IciMigRunner(MigRunner):
+    """MigRunner + the device-gather surface the ici path negotiates
+    on. Returns jax device arrays — the loopback passes them by
+    reference, so a host ndarray anywhere downstream means the
+    zero-copy contract broke."""
+
+    def gather_blocks_device(self, block_ids):
+        import jax.numpy as jnp
+
+        bs = self.config.kv_block_size
+        shape = (1, len(block_ids), bs, 1, 4)
+        return jnp.zeros(shape, jnp.float16), jnp.zeros(shape, jnp.float16)
+
+
+def _drive_ici_migration(chaos=None, max_tokens=48):
+    """Admin-drain a live request across two engines with the migration
+    plane negotiated onto loopback ici. ``chaos``: None | "pre" (first
+    collective fails before pairing → balancing + peer failover) |
+    "post" (fails after entering → plane abandoned → tcp failover)."""
+    config = _config()
+    prompt = [1, 17, 43]
+    out = {}
+    seq0 = _global_flight_watermark()
+
+    async def go():
+        lb = LoopbackIciTransfer(sender_rank=0, receiver_rank=1)
+        src_ici = IciBackend(lb)
+        src_runner = IciMigRunner(config, sync_delay=0.02)
+        dst_runner = MigRunner(config)
+        src = Scheduler(src_runner, config, flight=FlightRecorder())
+        dst = Scheduler(dst_runner, config, flight=FlightRecorder())
+        src.start()
+        dst.start()
+        server = await MigrationServer(
+            MigrationSink(dst, dst_runner),
+            ici=IciBackend(lb, recv_timeout_s=5.0), ici_rank=1,
+        ).start()
+        desc = dict(server.descriptor, engine_id="dst")
+        assert "ici" in desc["modes"] and desc["ici_rank"] == 1
+        peers = [desc, desc] if chaos else [desc]
+        controller = RecoveryController(
+            engine_id="src", scheduler=src, runner=src_runner,
+            peers=lambda: peers,
+            config=RecoveryConfig(drain_grace_s=0.05),
+            flight=src.flight, ici=src_ici,
+        )
+        er = _request(prompt, max_tokens)
+        src.add_request(er)
+        toks, finish = await _collect(er, limit=6)
+        assert finish is None, "request finished before the drain"
+        if chaos:
+            lb.fail_next_send = chaos
+        out["summary"] = await controller.drain(hard=False, reason="admin")
+        rest, finish = await _collect(er)
+        out["toks"], out["finish"] = toks + rest, finish
+        out["sent"] = lb.sent
+        out["balanced"] = lb.balanced
+        out["src_ici_alive"] = src_ici.alive
+        out["src_used"] = src.allocator.used
+        out["dst_scattered"] = list(dst_runner.scattered)
+        out["metrics"] = controller.registry.render()
+        await controller.close()
+        await server.close()
+        await dst.stop()
+        await src.stop()
+        # the abort path frees asynchronously with the connection close
+        for _ in range(50):
+            if dst.allocator.used == 0:
+                break
+            await asyncio.sleep(0.02)
+        out["dst_used"] = dst.allocator.used
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+    out["opens"] = [e.get("data", {})
+                    for e in flight_recorder().snapshot()
+                    if e["seq"] > seq0 and e.get("kind") == "transfer.open"
+                    and e.get("data", {}).get("plane") == "migration"]
+    out["want"] = _baseline(prompt, max_tokens)
+    return out
+
+
+def test_hot_migration_over_ici_byte_identical(monkeypatch):
+    """The headline migration differential: a hot drain whose KV rides
+    the collective plane continues the stream byte-identically, with
+    the payload never moving over tcp and zero leaks on either side."""
+    calls = {"n": 0}
+    real = TcpBackend.send_blocks
+
+    async def spy(*a, **kw):
+        calls["n"] += 1
+        return await real(*a, **kw)
+
+    monkeypatch.setattr(TcpBackend, "send_blocks", spy)
+    out = _drive_ici_migration()
+    assert out["summary"]["migrated"] == 1
+    assert out["summary"]["failed"] == 0
+    assert (out["toks"], out["finish"]) == out["want"]
+    assert out["dst_scattered"], "no KV reached the peer's cache"
+    assert out["sent"] >= 1, "no collective ever entered"
+    assert calls["n"] == 0, "payload rode tcp on the ici backend"
+    assert out["src_used"] == 0 and out["dst_used"] == 0
+    assert out["opens"] and out["opens"][-1]["backend"] == "ici"
+    # unified metrics carry the attribution
+    assert 'plane="migration"' in out["metrics"]
+    assert 'backend="ici"' in out["metrics"]
+
+
+def test_migration_ici_pre_entry_death_balances_and_fails_over():
+    """Mid-stream collective death BEFORE pairing: the receiver's
+    reservation is poisoned (freed, nothing installed), the plane is
+    balanced and kept, and the controller's failover commits on the
+    next attempt — byte-identical."""
+    out = _drive_ici_migration(chaos="pre")
+    assert out["summary"]["migrated"] == 1
+    assert (out["toks"], out["finish"]) == out["want"]
+    assert out["balanced"] == 1, "unpaired entry was never balanced"
+    assert out["src_ici_alive"], "pre-entry failure must keep the plane"
+    assert out["src_used"] == 0 and out["dst_used"] == 0, \
+        "poisoned reservation leaked blocks"
+
+
+def test_migration_ici_post_entry_death_abandons_to_tcp():
+    """Mid-stream collective death AFTER entering: the pairing state is
+    suspect, the sender abandons the plane, and the retry negotiates
+    tcp — byte-identical, zero leaks, with the transfer.open trail
+    showing the ici attempt and the tcp failover."""
+    out = _drive_ici_migration(chaos="post")
+    assert out["summary"]["migrated"] == 1
+    assert (out["toks"], out["finish"]) == out["want"]
+    assert not out["src_ici_alive"], "entered failure must abandon"
+    assert out["src_used"] == 0 and out["dst_used"] == 0
+    assert [o["backend"] for o in out["opens"]] == ["ici", "tcp"], \
+        f"expected ici attempt then tcp failover, got {out['opens']}"
