@@ -411,6 +411,184 @@ def run_sp_prefill(ctx: int) -> dict:
     }
 
 
+def run_sp_kernel(ctx: int) -> dict:
+    """The paged SP ring-prefill kernel lever (xla:k8:sp-kernel):
+    prefill tokens/s of the sequence-parallel ladder with the Pallas
+    page-walk prefix kernel (ops/pallas_sp.py — the committed prefix is
+    read page-by-page from the cache via double-buffered DMA) vs the
+    XLA gather path (which materializes the whole [1, W*bs] prefix per
+    layer). Both runs go through the REAL SP serving program; only the
+    attention route differs. CPU smoke (BENCH_SMOKE=1) runs the kernel
+    in interpret mode over an 8-device virtual host platform, proving
+    the route end-to-end creds-free (the number is then a smoke
+    artifact, not a perf claim).
+    """
+    import os
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if smoke:
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ["DYN_PALLAS_INTERPRET"] = "1"
+    import jax
+    import numpy as _np
+
+    from __graft_entry__ import FLAGSHIP
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.model_runner import ModelRunner
+
+    n_dev = len(jax.devices())
+    sp = 8 if n_dev >= 8 else max(1, n_dev)
+    mdims = dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+    ) if smoke else dict(FLAGSHIP)
+    mdims["max_position_embeddings"] = max(
+        mdims.get("max_position_embeddings", 4096), ctx + 64)
+    bs = 16
+    blocks = ctx // bs + 8
+
+    def build(impl):
+        mcfg = ModelConfig(**mdims, attention_impl=impl)
+        cfg = EngineConfig(
+            model=mcfg, max_batch_size=1, max_model_len=ctx + 64,
+            kv_block_size=bs, num_kv_blocks=blocks,
+            dtype="float32" if smoke else "bfloat16",
+            sp_size=sp,
+            max_prefill_tokens_per_step=64 if smoke else 8192,
+        )
+        return cfg, ModelRunner(cfg, model_dir=None)
+
+    prompt = [int(t) for t in _np.random.default_rng(0).integers(
+        1, mdims["vocab_size"], ctx)]
+    block_ids = list(range(ctx // bs + 1))
+
+    def sp_ladder(runner):
+        cap = runner.sp_chunk_tokens
+        pos, outs, chunks = 0, None, 0
+        t0 = time.perf_counter()
+        while pos < ctx:
+            end = min(pos + cap, ctx)
+            outs = runner.sp_prefill_chunk(
+                prompt[:end], pos, block_ids, commit=end >= ctx,
+            )
+            pos, chunks = end, chunks + 1
+        _np.asarray(outs[0])  # drain
+        return time.perf_counter() - t0, chunks
+
+    cfg_x, runner_x = build("xla")
+    sp_ladder(runner_x)  # compile pass
+    gather_s, chunks = sp_ladder(runner_x)
+    del runner_x
+
+    cfg_k, runner_k = build("pallas")
+    sp_ladder(runner_k)  # compile pass
+    kernel_s, _ = sp_ladder(runner_k)
+
+    return {
+        "metric": f"sp_kernel_prefill_tokens_per_sec_ctx{ctx}",
+        "value": round(ctx / kernel_s, 1),
+        "unit": "tokens/s",
+        "gather_tokens_per_s": round(ctx / gather_s, 1),
+        "speedup_vs_gather": round(gather_s / kernel_s, 3),
+        "sp_axis": sp,
+        "chunks": chunks,
+        "ctx": ctx,
+        "smoke": smoke,
+    }
+
+
+def run_fused_epilogue(iters: int = 200) -> dict:
+    """The fused sampling-epilogue lever (xla:k8:fused-epilogue):
+    per-step latency of the decode tail — penalties, top-k/top-p/min-p
+    sampling, count commit, finish verdict + stop-suffix hash — as the
+    ONE-dispatch Pallas kernel (ops/pallas_epilogue.py) vs the unfused
+    [B, V] XLA op ladder. Drives the REAL shared tail
+    (model_runner._sample_and_logprobs with fused on/off), so the two
+    timings cover exactly what the chained burst pays per token. CPU
+    smoke runs the kernel in interpret mode (route proof, not perf).
+    """
+    import functools
+    import os
+    import types
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if smoke:
+        os.environ["DYN_PALLAS_INTERPRET"] = "1"
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from dynamo_tpu.engine.model_runner import _sample_and_logprobs
+    from dynamo_tpu.engine.sampling import SamplingParams
+
+    b, v, ns = (8, 2048, 8) if smoke else (64, 32768, 64)
+    iters = 20 if smoke else iters
+    rng = _np.random.default_rng(0)
+    cfg = types.SimpleNamespace(vocab_size=v)
+    logits = jnp.asarray(rng.normal(size=(b, v)), jnp.float32)
+    counts = jnp.zeros((ns, v), jnp.int32)
+    seen = jnp.zeros((ns, v), jnp.bool_)
+    bias = jnp.zeros((ns, v), jnp.float32)
+    slots = jnp.arange(b, dtype=jnp.int32)
+    commit = jnp.ones((b,), jnp.bool_)
+    samp = SamplingParams.zeros(b)
+    samp = _dataclasses_replace_samp(samp, b)
+    want_top = jnp.asarray(False)
+
+    def tail(fused):
+        @jax.jit
+        def run(logits, samp, counts, seen, bias):
+            return _sample_and_logprobs(
+                cfg, logits, samp, counts, seen, bias, slots, commit,
+                want_top, fused=fused,
+            )[:3]
+        return run
+
+    results = {}
+    for name, fused in (("xla", False), ("fused", True)):
+        fn = tail(fused)
+        jax.block_until_ready(fn(logits, samp, counts, seen, bias))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(logits, samp, counts, seen, bias)
+        jax.block_until_ready(out)
+        results[name] = (time.perf_counter() - t0) / iters
+
+    return {
+        "metric": "fused_epilogue_tail_us_per_step",
+        "value": round(results["fused"] * 1e6, 2),
+        "unit": "us",
+        "xla_tail_us": round(results["xla"] * 1e6, 2),
+        "speedup_vs_xla": round(results["xla"] / results["fused"], 3),
+        "batch": b,
+        "vocab": v,
+        "iters": iters,
+        "smoke": smoke,
+    }
+
+
+def _dataclasses_replace_samp(samp, b):
+    """Non-trivial sampling params so the lever times the whole ladder
+    (temperature + top-k + top-p + penalties), not constant-folded
+    no-ops."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    return dataclasses.replace(
+        samp,
+        temperature=jnp.full((b,), 0.8, jnp.float32),
+        top_k=jnp.full((b,), 40, jnp.int32),
+        top_p=jnp.full((b,), 0.95, jnp.float32),
+        repetition_penalty=jnp.full((b,), 1.1, jnp.float32),
+    )
+
+
 def run_ici_pull(nblocks: int = 0, chunk: int = 16) -> dict:
     """The unified-transfer-plane payload lever (xla:k8:ici-pull): KV
     block throughput of the ici (device-to-device collective) payload
@@ -648,6 +826,44 @@ def _run_sp_subprocess(ctx: int, timeout_s: float):
     )
     t0 = time.monotonic()
     rec = {"label": label, "ctx": ctx, "timeout_s": round(timeout_s, 1)}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            cwd=_os.path.dirname(_os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench[{label}] timed out after {timeout_s:.0f}s", flush=True)
+        _log_attempt(dict(rec, rc=124, wall_s=round(
+            time.monotonic() - t0, 1), error="timeout"))
+        return None
+    wall = round(time.monotonic() - t0, 1)
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            result = json.loads(line[len("BENCH_RESULT "):])
+            _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                              result=result))
+            return result
+    print(f"bench[{label}] failed (rc={proc.returncode})", flush=True)
+    _log_attempt(dict(rec, rc=proc.returncode, wall_s=wall,
+                      error=(proc.stderr[-500:] or "no result line")))
+    return None
+
+
+def _run_kernel_lever_subprocess(label: str, fn_name: str, call: str,
+                                 timeout_s: float, **rec_extra):
+    """One kernel-campaign lever attempt (sp-kernel / fused-epilogue)
+    in a child with a hard timeout — the same discipline as every
+    other attempt; rows land in the attempts sidecar."""
+    import subprocess
+    import sys
+
+    code = (
+        f"import json; from bench import {fn_name}; "
+        f"print('BENCH_RESULT ' + json.dumps({call}))"
+    )
+    t0 = time.monotonic()
+    rec = {"label": label, "timeout_s": round(timeout_s, 1), **rec_extra}
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -923,6 +1139,32 @@ def main() -> None:
         sp_res = _run_sp_subprocess(
             sp_ctx, timeout_s=min(420.0, remaining - 180))
         note(f"xla:k8:sp-prefill:ctx{sp_ctx}", sp_res)
+
+    # the paged SP ring-prefill KERNEL lever (xla:k8:sp-kernel;
+    # docs/performance.md "Kernel campaign"): SP prefill tokens/s with
+    # the Pallas page-walk prefix kernel vs the XLA gather route, one
+    # child at one context. Rides the attempt sidecar and the lever
+    # table, never the decode headline.
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 300 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        sk_ctx = 512 if os.environ.get("BENCH_SMOKE") else 32768
+        sk_res = _run_kernel_lever_subprocess(
+            "xla:k8:sp-kernel", "run_sp_kernel",
+            f"run_sp_kernel({sk_ctx})",
+            timeout_s=min(420.0, remaining - 180), ctx=sk_ctx,
+        )
+        note("xla:k8:sp-kernel", sk_res)
+
+    # the fused sampling-epilogue lever (xla:k8:fused-epilogue): the
+    # decode tail as one Pallas dispatch vs the unfused XLA op ladder.
+    remaining = total_budget - (_time.monotonic() - t0)
+    if remaining > 150 and not os.environ.get("BENCH_SINGLE_STEP_ONLY"):
+        fe_res = _run_kernel_lever_subprocess(
+            "xla:k8:fused-epilogue", "run_fused_epilogue",
+            "run_fused_epilogue()",
+            timeout_s=min(240.0, remaining - 90),
+        )
+        note("xla:k8:fused-epilogue", fe_res)
 
     # the unified-transfer-plane payload lever (xla:k8:ici-pull;
     # docs/transfer_plane.md): KV block throughput of the ici

@@ -109,6 +109,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "freeze), so bursts chain back-to-back and "
                         "completed rows drain asynchronously. auto = "
                         "follow --decode-pipeline-depth >= 2")
+    p.add_argument("--fused-epilogue", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused sampling epilogue: the per-burst sampling "
+                        "tail (penalties, top-k/p/min-p, count commit, "
+                        "finish mask, stop-suffix hash) runs as ONE "
+                        "Pallas dispatch; bit-identical stream. auto = "
+                        "ride the Pallas attention route")
     p.add_argument("--guided-table-max-states", type=int, default=256,
                    help="unrestricted chain: state bound for compiling "
                         "guided grammars to device transition tables "

@@ -352,6 +352,17 @@ class EngineConfig:
     # dynamo_engine_sync_fallback_total{reason}. "auto" engages with
     # decode_pipeline_depth >= 2; "on" requires it.
     device_finish: str = "auto"
+    # the fused Pallas sampling epilogue (ops/pallas_epilogue.py): run
+    # the whole per-step decode tail — penalties, top-k/top-p/min-p
+    # sampling, count commit, and (in the chained burst) the
+    # device-finish verdict + stop-suffix rolling hash — as ONE kernel
+    # dispatch instead of a string of small [B, V] XLA ops. Sampling is
+    # bit-identical to the unfused ladder by construction. "auto"
+    # follows the attention route: it engages exactly when the Pallas
+    # serving kernels do (warmup probe passes), so the probe/warmup XLA
+    # fallback drops it automatically. "on" forces it (CPU tests use
+    # DYN_PALLAS_INTERPRET=1); "off" keeps the XLA tail.
+    fused_epilogue: str = "auto"
     # guided decoding inside the chain: compile TrieConstraint /
     # in-bound JsonGrammar cursors to a dense device transition table
     # (state x token -> next state) so the per-token mask is computed
@@ -471,6 +482,11 @@ class EngineConfig:
         if self.device_finish not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown device_finish {self.device_finish!r} "
+                "(auto | on | off)"
+            )
+        if self.fused_epilogue not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown fused_epilogue {self.fused_epilogue!r} "
                 "(auto | on | off)"
             )
         if self.device_finish == "on" and self.decode_pipeline_depth < 2:

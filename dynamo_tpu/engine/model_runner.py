@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -70,7 +71,9 @@ CACHE_SPEC = P(None, None, None, "tp", None)  # [L, N, bs, KVH, D] — KV heads 
 
 
 def _sample_and_logprobs(cfg, last_logits, samp, counts, seen, bias,
-                         sample_slots, commit, want_top, extra_bias=None):
+                         sample_slots, commit, want_top, extra_bias=None,
+                         fused=False, unique_slots=True, finish=None,
+                         max_model_len=0):
     """The per-token tail shared by the single step and every scan
     iteration of the fused burst: penalty-aware sampling, the sampled
     token's logprob, gated top-K alternatives, and the committed-count
@@ -80,10 +83,60 @@ def _sample_and_logprobs(cfg, last_logits, samp, counts, seen, bias,
     ``extra_bias`` is an additive [B, V] term computed in-program (the
     chained burst's device-guided mask); the sync path expresses the
     same mask through the persistent ``bias`` buffer instead, so adding
-    it here keeps the two paths' logits — and logprobs — bit-equal."""
+    it here keeps the two paths' logits — and logprobs — bit-equal.
+
+    ``fused=True`` routes the whole tail through the single-dispatch
+    Pallas epilogue (ops/pallas_epilogue.py) — bit-identical by
+    construction, gated by the ``epilogue`` compile probe. With
+    ``finish`` (the chained burst's per-row carry tuple) the kernel also
+    returns the step's (hard, cand, ring_new) finish verdicts, appended
+    to the return. ``unique_slots=False`` marks call sites whose pad
+    rows may share a live row's sample slot (the batched prefill step):
+    the count commit then stays a scatter-add outside the kernel."""
     from .sampling import top_k_width
 
     b = last_logits.shape[0]
+    if fused:
+        from ..ops.pallas_epilogue import fused_sampling_epilogue
+        from .sampling import _row_keys
+
+        v = last_logits.shape[1]
+        row_keys = _row_keys(samp)
+        gum = jax.vmap(
+            lambda kk: jax.random.gumbel(kk, (v,), jnp.float32)
+        )(row_keys)
+        scalars = (samp.temperature, samp.top_k, samp.top_p, samp.min_p,
+                   samp.presence_penalty, samp.frequency_penalty,
+                   samp.repetition_penalty)
+        outs = fused_sampling_epilogue(
+            last_logits, gum, scalars, counts, seen, bias, sample_slots,
+            commit, extra_bias=extra_bias, finish=finish,
+            max_model_len=max_model_len, alias_counts=unique_slots,
+            interpret=bool(os.environ.get("DYN_PALLAS_INTERPRET")),
+        )
+        next_tokens, lps, counts = outs[:3]
+        kw = top_k_width(cfg.vocab_size)
+
+        def _top(_):
+            row_bias = bias[sample_slots]
+            if extra_bias is not None:
+                row_bias = row_bias + extra_bias
+            logp = jax.nn.log_softmax(
+                (last_logits + row_bias).astype(jnp.float32), axis=-1
+            )
+            return top_logprobs_for(last_logits, logp)
+
+        top_vals, top_ids = jax.lax.cond(
+            want_top,
+            _top,
+            lambda _: (jnp.zeros((b, kw), jnp.float32),
+                       jnp.zeros((b, kw), jnp.int32)),
+            0,
+        )
+        return (next_tokens, lps, top_vals, top_ids, counts) + tuple(
+            outs[3:]
+        )
+    assert finish is None, "finish fusion requires fused=True"
     row_counts = counts[sample_slots]
     row_seen = seen[sample_slots]
     row_bias = bias[sample_slots]
@@ -317,6 +370,18 @@ class ModelRunner:
         # / prefill worker attach compiles.registry into the engine's
         # scrape and flip the serving flag when they start.
         self.compiles = CompileTracker()
+        # attention-route observability: the dispatch seams in
+        # ops/attention.py / parallel/sequence.py record which kernel
+        # served each trace; the tracked dispatch supplies the program
+        # label, and the singleton counter renders in this runner's
+        # compile registry (attached to the engine scrape)
+        from ..ops import attention as _attn_ops
+
+        self.compiles.dispatch_cm = _attn_ops.route_program
+        if (_attn_ops.ATTENTION_ROUTE_COUNTER.name
+                not in self.compiles.registry.names()):
+            self.compiles.registry.register(
+                _attn_ops.ATTENTION_ROUTE_COUNTER)
 
         # live device-time + roofline accounting (telemetry/device_time.py):
         # the byte model mirrors bench.py's — per decode step the device
@@ -384,9 +449,27 @@ class ModelRunner:
 
         return forward, head
 
+    def _fused_epilogue_enabled(self) -> bool:
+        """Resolve config.fused_epilogue at program-BUILD time: "auto"
+        follows the attention route (Pallas serving kernels proven by
+        the warmup probe ⇒ the epilogue kernel is proven by the same
+        probe pass), so the existing probe/warmup fallback — which
+        flips ``attention_impl`` to "xla" and rebuilds the programs —
+        drops the fused tail with no extra rebuild plumbing."""
+        mode = self.config.fused_epilogue
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        from ..ops.attention import resolve_attention_impl
+
+        return resolve_attention_impl(
+            self.config.model.attention_impl) == "pallas"
+
     def _build_step(self):
         cfg = self.config.model
         mesh = self.mesh
+        fused = self._fused_epilogue_enabled()
         batch_spec = NamedSharding(mesh, P("dp"))
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
@@ -437,9 +520,12 @@ class ModelRunner:
             last_logits = head(
                 hidden[jnp.arange(b), last_idx], params
             )  # [B, V]
+            # pad rows of a partial batch default to sample slot 0 and
+            # may alias a live row's slot — the fused kernel keeps its
+            # commit outside (unique_slots=False)
             next_tokens, lps, top_vals, top_ids, counts = _sample_and_logprobs(
                 cfg, last_logits, samp, counts, seen, bias, sample_slots,
-                commit, want_top,
+                commit, want_top, fused=fused, unique_slots=False,
             )
             return (next_tokens, lps, top_vals, top_ids, prompt_lps,
                     greedy_all, k_cache, v_cache, counts, seen, bias)
@@ -508,6 +594,7 @@ class ModelRunner:
         cfg = self.config.model
         mesh = self.mesh
         bs = self.config.kv_block_size
+        fused = self._fused_epilogue_enabled()
         batch_spec = NamedSharding(mesh, P("dp"))
         batch2_spec = NamedSharding(mesh, P("dp", None))
         repl = NamedSharding(mesh, P())
@@ -537,7 +624,7 @@ class ModelRunner:
                 samp_i = _dc.replace(samp, counters=samp.counters + step_i)
                 nt, lp, tv, ti, counts = _sample_and_logprobs(
                     cfg, head(hidden[:, 0], params), samp_i, counts, seen,
-                    bias, sample_slots, commit, want_top,
+                    bias, sample_slots, commit, want_top, fused=fused,
                 )
                 return (k_cache, v_cache, counts, nt, pos + 1), (nt, lp, tv, ti)
 
@@ -642,18 +729,34 @@ class ModelRunner:
                 gmask = jnp.where(
                     guided[:, None] & (grow < 0), -1e9, 0.0
                 ).astype(jnp.float32)
-                nt, lp, tv, ti, counts = _sample_and_logprobs(
-                    cfg, head(hidden[:, 0], params), samp_i, counts, seen,
-                    bias, sample_slots, live, want_top, extra_bias=gmask,
-                )
-                gen_n = gen + live.astype(jnp.int32)
-                ring_n = ring_push(ring, nt, live)
-                hard = device_finish_mask(
-                    nt, gen_n, pos, stop_ids, min_new, max_new, max_len
-                )
-                cand = stop_candidate_mask(
-                    ring_n, gen_n, min_new, stop_hash, stop_hlen
-                )
+                if fused:
+                    # the finish checks ride INSIDE the epilogue kernel:
+                    # the whole per-step tail is one dispatch
+                    nt, lp, tv, ti, counts, hard, cand, ring_n = (
+                        _sample_and_logprobs(
+                            cfg, head(hidden[:, 0], params), samp_i,
+                            counts, seen, bias, sample_slots, live,
+                            want_top, extra_bias=gmask, fused=True,
+                            finish=(gen, pos, min_new, max_new, stop_ids,
+                                    ring, stop_hash, stop_hlen),
+                            max_model_len=max_len,
+                        )
+                    )
+                    gen_n = gen + live.astype(jnp.int32)
+                else:
+                    nt, lp, tv, ti, counts = _sample_and_logprobs(
+                        cfg, head(hidden[:, 0], params), samp_i, counts,
+                        seen, bias, sample_slots, live, want_top,
+                        extra_bias=gmask,
+                    )
+                    gen_n = gen + live.astype(jnp.int32)
+                    ring_n = ring_push(ring, nt, live)
+                    hard = device_finish_mask(
+                        nt, gen_n, pos, stop_ids, min_new, max_new, max_len
+                    )
+                    cand = stop_candidate_mask(
+                        ring_n, gen_n, min_new, stop_hash, stop_hlen
+                    )
                 # grammar advance on the sampled token: DONE (state 0)
                 # completes the constraint; a reject (< 0) is
                 # unreachable through the mask but freezes defensively —
@@ -918,6 +1021,7 @@ class ModelRunner:
             w += 1
         self._sp_bucket = S
         self._sp_width = w
+        fused = self._fused_epilogue_enabled()
         repl = NamedSharding(mesh, P())
         seq_spec = NamedSharding(mesh, P(None, "sp"))
         forward, head = self._make_forward()
@@ -937,7 +1041,7 @@ class ModelRunner:
             next_tokens, lps, top_vals, top_ids, counts = (
                 _sample_and_logprobs(
                     cfg, last_logits, samp, counts, seen, bias,
-                    sample_slots, commit, want_top,
+                    sample_slots, commit, want_top, fused=fused,
                 )
             )
             return (next_tokens, lps, top_vals, top_ids, k_cache, v_cache,
@@ -1782,6 +1886,8 @@ class ModelRunner:
                 sinks=cfg.model_family == "gptoss",
                 verify=bool(self.config.spec_ngram_tokens
                             or self.config.spec_draft_model),
+                sp_prefill=self.config.sp_size > 1,
+                epilogue=self.config.fused_epilogue != "off",
                 timeout_s=timeout_s,
             ):
                 if cfg.attention_impl != "auto":
@@ -1800,6 +1906,9 @@ class ModelRunner:
                 self._build_step()
                 self._build_burst()
                 self._build_spec_burst()
+                # the SP prefill routes attention (ring-kernel vs
+                # gather) and its sampling tail off the same impl
+                self._build_sp_prefill()
                 self.compiles.reset_seen()  # rebuilt programs recompile
         if (cfg.attn_logit_softcap or cfg.sliding_window) and \
                 resolve_attention_impl(cfg.attention_impl) == "pallas":
@@ -1830,6 +1939,7 @@ class ModelRunner:
             self._build_step()
             self._build_burst()
             self._build_spec_burst()
+            self._build_sp_prefill()
             self._reinit_device_state()
             self.compiles.reset_seen()  # rebuilt programs recompile
             self._warmup_once(decode_batch)

@@ -1995,6 +1995,123 @@ class Scheduler:
         await self._apply_burst(loop, infl, ready_hint=ready_hint)
         self._drain_lag_hist.observe(time.monotonic() - infl.dispatch_t)
 
+    async def _chain_prologue(self, loop, active, kind):
+        """The shared open/validate ladder of a chained pass: reconcile
+        a predating plain dispatch-ahead burst, barrier on a chain-KIND
+        switch (plain ↔ spec program families), open the chain if none
+        is, and resolve the live member list. Returns ``(active, live,
+        members)`` or None — None means every fallback already ran and
+        the caller just returns."""
+        if self._inflight is not None:
+            # a plain dispatch-ahead burst predates this chain: reconcile
+            # it first so the chain starts from fully-committed state
+            await self._drain_pipeline(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return None
+        if self._chain_members and self._chain_kind not in (None, kind):
+            await self._chain_barrier(loop)
+            active = [er for er in active if er.finish is None]
+            if not active:
+                return None
+        if not self._chain_members:
+            self._chain_members = list(active)
+            self._chain_kind = kind
+            self._chain_carry = None
+            self._chain_dispatched = 0
+            self._chain_pos0 = {er.slot: er.context_len for er in active}
+        members = self._chain_members
+        live = [er for er in members if er.finish is None]
+        if not live:
+            await self._chain_barrier(loop)
+            return None
+        return active, live, members
+
+    async def _chain_reserve(self, loop, active, live, advance,
+                             sync_steps) -> bool:
+        """Block headroom for the chain's next dispatch: positions a
+        never-frozen row runs through ``chain_pos0 + (n+1)*advance - 1``
+        — reserve one past that (the carry slot), capped at the
+        model-len horizon (the device freezes rows there; blocks past it
+        are never touched). False ⇒ KV OOM: preemption needs fully-
+        committed host state, so the chain closed at a barrier and the
+        pass already fell back to one sync decode."""
+        cfg = self.config
+        n = self._chain_dispatched
+        for er in live:
+            limit = min(self._chain_pos0[er.slot] + (n + 1) * advance,
+                        cfg.max_model_len - 1)
+            if not self._ensure_block_for(er, limit):
+                self.allocator.flush_offload()
+                self._note_sync_fallback("kv_oom")
+                await self._chain_barrier(loop)
+                rest = [er for er in active if er.finish is None]
+                if rest:
+                    await self._decode(loop, rest, sync_steps)
+                return False
+            self._host.sync_blocks(er)
+        self.allocator.flush_offload()
+        return True
+
+    def _chain_masks(self, members, live):
+        """(commit mask, block-table slice) for one chained dispatch."""
+        cfg = self.config
+        commit = np.zeros(cfg.max_batch_size, bool)
+        for er in members:
+            commit[er.slot] = er.finish is None
+        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
+        return commit, self._host.btab[:, :w].copy()
+
+    def _chain_fill(self, live, with_guided):
+        """The chain-fill carry from committed host state (first
+        dispatch of a chain). Spec chains carry no guided cursors
+        (spec-eligible rows are unguided by admission)."""
+        b = self.config.max_batch_size
+        tokens0 = np.zeros(b, np.int32)
+        positions0 = np.zeros(b, np.int32)
+        gen0 = np.zeros(b, np.int32)
+        done0 = np.zeros(b, bool)
+        ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
+        gstate0 = np.full(b, -1, np.int32)
+        for er in live:
+            tokens0[er.slot] = er.pending_token
+            positions0[er.slot] = er.context_len
+            gen0[er.slot] = er.generated
+            ring0[er.slot] = ring_init(er.ring_tail)
+            if with_guided and er.guided is not None:
+                gstate0[er.slot] = self._guided_tables[
+                    self._guided_table_key(er)].state_id(er.guided)
+        return tokens0, positions0, gen0, done0, ring0, gstate0
+
+    def _chain_observe_bubble(self, tokens0) -> None:
+        """Device-idle bookkeeping (same approximation as the pipelined
+        path): a carry already materialized at dispatch time means the
+        device ran dry since the last reconciliation. Must run BEFORE
+        the dispatch consumes ``self._chain_carry``."""
+        now = time.monotonic()
+        if self._last_burst_done_t is not None:
+            if self._chain_carry is None:
+                self._bubble_hist.observe(now - self._last_burst_done_t)
+            else:
+                ready = getattr(tokens0, "is_ready", lambda: True)()
+                self._bubble_hist.observe(
+                    now - self._last_burst_done_t if ready else 0.0
+                )
+        self._last_burst_done_t = None
+
+    async def _chain_drain(self, loop, members) -> None:
+        """Asynchronous row drain after a chained dispatch: reconcile
+        every burst whose outputs already materialized (never gating the
+        dispatch), enforce the in-flight bound, and close the chain when
+        every member finished (anything still queued is frozen
+        over-decode)."""
+        while self._chain and self._chain_ready(self._chain[0]):
+            await self._apply_chain_head(loop)
+        while len(self._chain) >= self.CHAIN_MAX_INFLIGHT:
+            await self._apply_chain_head(loop)
+        if all(er.finish is not None for er in members):
+            await self._chain_barrier(loop)
+
     async def _decode_chained(self, loop,
                               active: List[EngineRequest]) -> None:
         """One persistent-loop pass: dispatch the next burst straight off
@@ -2013,59 +2130,18 @@ class Scheduler:
         so near-horizon rows stay chained instead of forcing sync.
         """
         cfg = self.config
-        b = cfg.max_batch_size
         k_steps = max(1, cfg.multi_step_decode)
-        if self._inflight is not None:
-            # a plain dispatch-ahead burst predates this chain: reconcile
-            # it first so the chain starts from fully-committed state
-            await self._drain_pipeline(loop)
-            active = [er for er in active if er.finish is None]
-            if not active:
-                return
-        if self._chain_members and self._chain_kind not in (None, "plain"):
-            # a spec chain is open: switch program families at a barrier
-            await self._chain_barrier(loop)
-            active = [er for er in active if er.finish is None]
-            if not active:
-                return
-        if not self._chain_members:
-            self._chain_members = list(active)
-            self._chain_kind = "plain"
-            self._chain_carry = None
-            self._chain_dispatched = 0
-            self._chain_pos0 = {er.slot: er.context_len for er in active}
-        members = self._chain_members
-        live = [er for er in members if er.finish is None]
-        if not live:
-            await self._chain_barrier(loop)
+        opened = await self._chain_prologue(loop, active, "plain")
+        if opened is None:
             return
-        # headroom: positions this burst writes for a never-frozen row
-        # run through chain_pos0 + (n+1)*K - 1; reserve one position past
-        # that (the carry slot) and cap at the model-len horizon (the
-        # device freezes rows there — blocks past it are never touched)
+        active, live, members = opened
         n = self._chain_dispatched
-        for er in live:
-            limit = min(self._chain_pos0[er.slot] + (n + 1) * k_steps,
-                        cfg.max_model_len - 1)
-            if not self._ensure_block_for(er, limit):
-                # KV OOM: preemption needs fully-committed host state —
-                # barrier, then let the sync path preempt/decode
-                self.allocator.flush_offload()
-                self._note_sync_fallback("kv_oom")
-                await self._chain_barrier(loop)
-                live = [er for er in active if er.finish is None]
-                if live:
-                    await self._decode(loop, live, k_steps)
-                return
-            self._host.sync_blocks(er)
-        self.allocator.flush_offload()
+        if not await self._chain_reserve(loop, active, live, k_steps,
+                                         k_steps):
+            return
 
         hs = self._host
-        commit = np.zeros(b, bool)
-        for er in members:
-            commit[er.slot] = er.finish is None
-        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
-        btab = hs.btab[:, :w].copy()
+        commit, btab = self._chain_masks(members, live)
         want_top = any(er.logprobs_n > 0 for er in members)
         # guided members ride the device transition table: ONE table per
         # chain (_chain_block_reason enforced it), their bias rows reset
@@ -2083,38 +2159,13 @@ class Scheduler:
                     self._set_plain_bias(er)
                     er.chain_bias_reset = True
         if self._chain_carry is None:
-            # chain fill: the carry comes from committed host state
-            tokens0 = np.zeros(b, np.int32)
-            positions0 = np.zeros(b, np.int32)
-            gen0 = np.zeros(b, np.int32)
-            done0 = np.zeros(b, bool)
-            ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
-            gstate0 = np.full(b, -1, np.int32)
-            for er in live:
-                tokens0[er.slot] = er.pending_token
-                positions0[er.slot] = er.context_len
-                gen0[er.slot] = er.generated
-                ring0[er.slot] = ring_init(er.ring_tail)
-                if er.guided is not None:
-                    gstate0[er.slot] = self._guided_tables[
-                        self._guided_table_key(er)].state_id(er.guided)
+            (tokens0, positions0, gen0, done0, ring0,
+             gstate0) = self._chain_fill(live, with_guided=True)
         else:
             (tokens0, positions0, gen0, done0, ring0,
              gstate0) = self._chain_carry
 
-        # device-idle bookkeeping (same approximation as the pipelined
-        # path): a carry already materialized at dispatch time means the
-        # device ran dry since the last reconciliation
-        now = time.monotonic()
-        if self._last_burst_done_t is not None:
-            if self._chain_carry is None:
-                self._bubble_hist.observe(now - self._last_burst_done_t)
-            else:
-                ready = getattr(tokens0, "is_ready", lambda: True)()
-                self._bubble_hist.observe(
-                    now - self._last_burst_done_t if ready else 0.0
-                )
-        self._last_burst_done_t = None
+        self._chain_observe_bubble(tokens0)
 
         toks, lps, tv, ti, carry = self.runner.decode_burst_chained(
             tokens0, positions0, gen0, done0, btab,
@@ -2149,17 +2200,7 @@ class Scheduler:
             ) if dt is not None else 0.0,
             tokens=k_steps * len(live),
         ))
-        # asynchronous row drain: reconcile every burst whose outputs
-        # already materialized (never gating the dispatch above), then
-        # enforce the in-flight bound
-        while self._chain and self._chain_ready(self._chain[0]):
-            await self._apply_chain_head(loop)
-        while len(self._chain) >= self.CHAIN_MAX_INFLIGHT:
-            await self._apply_chain_head(loop)
-        if all(er.finish is not None for er in members):
-            # every member finished: anything still queued or dispatched
-            # is frozen over-decode — close the chain now
-            await self._chain_barrier(loop)
+        await self._chain_drain(loop, members)
 
     async def _decode_chained_spec(self, loop,
                                    active: List[EngineRequest]) -> None:
@@ -2180,64 +2221,22 @@ class Scheduler:
         P = (cfg.spec_draft_tokens if self.draft is not None
              else cfg.spec_ngram_tokens)
         S = P + 1
-        if self._inflight is not None:
-            await self._drain_pipeline(loop)
-            active = [er for er in active if er.finish is None]
-            if not active:
-                return
-        if self._chain_members and self._chain_kind not in (None, "spec"):
-            await self._chain_barrier(loop)
-            active = [er for er in active if er.finish is None]
-            if not active:
-                return
-        if not self._chain_members:
-            self._chain_members = list(active)
-            self._chain_kind = "spec"
-            self._chain_carry = None
-            self._chain_dispatched = 0
-            self._chain_pos0 = {er.slot: er.context_len for er in active}
-        members = self._chain_members
-        live = [er for er in members if er.finish is None]
-        if not live:
-            await self._chain_barrier(loop)
+        opened = await self._chain_prologue(loop, active, "spec")
+        if opened is None:
             return
+        active, live, members = opened
         # headroom: a round advances a never-frozen row by at most S
-        # positions (accepted prefix + correction), so the chain's n-th
-        # round runs through chain_pos0 + (n+1)*S; near-horizon rounds
+        # positions (accepted prefix + correction); near-horizon rounds
         # never dispatch (_spec_chain_reason barriers them first)
         n = self._chain_dispatched
-        for er in live:
-            limit = min(self._chain_pos0[er.slot] + (n + 1) * S,
-                        cfg.max_model_len - 1)
-            if not self._ensure_block_for(er, limit):
-                self.allocator.flush_offload()
-                self._note_sync_fallback("kv_oom")
-                await self._chain_barrier(loop)
-                live = [er for er in active if er.finish is None]
-                if live:
-                    await self._decode(loop, live, 1)
-                return
-            self._host.sync_blocks(er)
-        self.allocator.flush_offload()
+        if not await self._chain_reserve(loop, active, live, S, 1):
+            return
 
         hs = self._host
-        commit = np.zeros(b, bool)
-        for er in members:
-            commit[er.slot] = er.finish is None
-        w = cfg.kv_width_bucket(max(len(er.block_ids) for er in live))
-        btab = hs.btab[:, :w].copy()
+        commit, btab = self._chain_masks(members, live)
         if self._chain_carry is None:
-            tokens0 = np.zeros(b, np.int32)
-            positions0 = np.zeros(b, np.int32)
-            gen0 = np.zeros(b, np.int32)
-            done0 = np.zeros(b, bool)
-            ring0 = np.full((b, SUFFIX_RING_W), -1, np.int32)
-            gstate0 = np.full(b, -1, np.int32)
-            for er in live:
-                tokens0[er.slot] = er.pending_token
-                positions0[er.slot] = er.context_len
-                gen0[er.slot] = er.generated
-                ring0[er.slot] = ring_init(er.ring_tail)
+            (tokens0, positions0, gen0, done0, ring0,
+             gstate0) = self._chain_fill(live, with_guided=False)
         else:
             (tokens0, positions0, gen0, done0, ring0,
              gstate0) = self._chain_carry
@@ -2262,17 +2261,7 @@ class Scheduler:
             props = jnp.transpose(dtoks[:P])  # [B, P] device proposals
             self.steps += 1
 
-        # device-idle bookkeeping (same approximation as the plain chain)
-        now = time.monotonic()
-        if self._last_burst_done_t is not None:
-            if self._chain_carry is None:
-                self._bubble_hist.observe(now - self._last_burst_done_t)
-            else:
-                ready = getattr(tokens0, "is_ready", lambda: True)()
-                self._bubble_hist.observe(
-                    now - self._last_burst_done_t if ready else 0.0
-                )
-        self._last_burst_done_t = None
+        self._chain_observe_bubble(tokens0)
 
         toks, nprop, nacc, carry = self.runner.decode_burst_spec(
             tokens0, positions0, gen0, done0, ring0, gstate0, btab,
@@ -2303,12 +2292,7 @@ class Scheduler:
             ) if dt is not None else 0.0,
             tokens=len(live),
         ))
-        while self._chain and self._chain_ready(self._chain[0]):
-            await self._apply_chain_head(loop)
-        while len(self._chain) >= self.CHAIN_MAX_INFLIGHT:
-            await self._apply_chain_head(loop)
-        if all(er.finish is not None for er in members):
-            await self._chain_barrier(loop)
+        await self._chain_drain(loop, members)
 
     def _set_plain_bias(self, er: EngineRequest) -> None:
         """Reset one slot's bias row to the request's logit_bias alone —
@@ -2861,6 +2845,16 @@ class Scheduler:
         return (self.sp_active is not None and self.sp_active.er is er) \
             or er in self.sp_queue
 
+    def _sp_kernel_route(self) -> bool:
+        """Did the SP ladder's chunk attention take the paged-DMA
+        kernel route (parallel/sequence.sp_chunk_attention)? Drives the
+        device-time byte model: the kernel streams the committed prefix
+        once; the XLA gather pays a materialize write + re-read."""
+        from ..ops.attention import resolve_attention_impl
+
+        return resolve_attention_impl(
+            self.config.model.attention_impl) == "pallas"
+
     def _sp_drop(self, er: EngineRequest) -> None:
         """Remove a cancelled/finished request from the SP ladder. Any
         already-dispatched chunk work is pure over-compute into the
@@ -3008,6 +3002,7 @@ class Scheduler:
                 "prefill_sp", "prefill", st.final_dispatch_t, t_done,
                 read_bytes=self.device_time.sp_prefill_read_bytes(
                     st.chunks, er.context_len,
+                    kernel=self._sp_kernel_route(),
                 ),
             )
             if burst is not None:

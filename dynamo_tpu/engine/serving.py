@@ -78,6 +78,7 @@ def engine_config_from_mdc(mdc, flags=None, extra=None) -> EngineConfig:
         multi_step_decode=getattr(flags, "multi_step_decode", 1) or 1,
         decode_pipeline_depth=getattr(flags, "decode_pipeline_depth", 1) or 1,
         device_finish=getattr(flags, "device_finish", "auto") or "auto",
+        fused_epilogue=getattr(flags, "fused_epilogue", "auto") or "auto",
         # no `or 2` fallback: an explicit 0 must clamp to 1 (serial), not
         # silently flip back to double-buffered
         disagg_stream_depth=(

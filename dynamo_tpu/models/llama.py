@@ -415,7 +415,9 @@ def make_sp_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
     prefill (parallel/sequence.py): the chunk's tokens are sharded over
     the mesh's ``sp_axis``; QKV projections / RoPE / MLP are position-
     local and partition for free, attention runs as one ring pass over
-    the chunk's fresh K/V plus the gathered committed prefix, and the
+    the chunk's fresh K/V merged with the committed paged prefix (read
+    in place by the Pallas page-walk kernel, or gathered on the XLA
+    fallback — parallel/sequence.sp_chunk_attention), and the
     fresh K/V scatter into the paged cache exactly as the dense path
     does (GSPMD collects the sequence shards at the scatter). B is 1 by
     construction — one oversized prompt owns the whole mesh."""
@@ -433,6 +435,7 @@ def make_sp_gqa_attn_fn(cfg, b, s, positions, slot_mapping, block_tables,
         attn = sp_chunk_attention(
             q, k, v, k_all, v_all, block_tables, chunk_start,
             context_lens[0], li, mesh, axis=sp_axis, head_axis=head_axis,
+            impl=cfg.attention_impl,
         )
         k_all, v_all = scatter_kv_stacked(k_all, v_all, k, v, slot_mapping, li)
         delta = dense(attn.reshape(b, s, h_heads * hd), layer_params["wo"])

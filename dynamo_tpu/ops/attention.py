@@ -13,6 +13,7 @@ reference delegated to vLLM; SURVEY.md §7 "hard parts" #1).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Tuple
 
@@ -20,9 +21,49 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..telemetry.registry import Counter
 from .compat import shard_map
 
 LANE = 128  # TPU vector lane width — HBM layouts tile the minor dim to this
+
+# ---------- route observability ----------
+#
+# Which kernel served each program: the dispatch decision below is made
+# at TRACE time (it is static per compiled specialization), so the
+# counter increments once per (program, shape-bucket) compile — the
+# fleet-level signal is which route each program's traces took, not a
+# per-step rate. The engine registers this singleton into the runner's
+# compile registry (rendered in the scheduler's scrape) and installs
+# ``route_program`` as the CompileTracker's dispatch hook so records
+# carry the program label.
+ATTENTION_ROUTE_COUNTER = Counter(
+    "dynamo_engine_attention_route_total",
+    "Attention kernel route chosen at trace time per compiled program "
+    "specialization, labelled program= (the engine program tracing) and "
+    "route=xla|decode|verify|flash|sp_ring_kernel|sp_ring_gather",
+)
+
+_route_program = "unknown"
+
+
+@contextlib.contextmanager
+def route_program(name: str):
+    """Label route records with the engine program being dispatched
+    (installed as CompileTracker.dispatch_cm — active only while a
+    tracked dispatch, and therefore its trace, is on the stack)."""
+    global _route_program
+    prev = _route_program
+    _route_program = name
+    try:
+        yield
+    finally:
+        _route_program = prev
+
+
+def record_route(route: str) -> None:
+    """Stamp one route decision (called from the dispatch seams here
+    and in parallel/sequence.py — trace-time Python, never traced)."""
+    ATTENTION_ROUTE_COUNTER.inc(program=_route_program, route=route)
 
 
 def lane_pad(d: int) -> int:
@@ -244,6 +285,7 @@ def attention(
             k_cache = k_cache.reshape((l * n_blocks,) + k_cache.shape[2:])
             v_cache = v_cache.reshape((l * n_blocks,) + v_cache.shape[2:])
             block_tables = block_tables + li * n_blocks
+        record_route("xla")
         return paged_attention(q, k_cache, v_cache, block_tables, positions,
                                context_lens, scale=scale, softcap=softcap,
                                sliding_window=sliding_window,
@@ -278,28 +320,29 @@ def attention(
     # flash kernel's affine base_pos contract, so small custom prefill
     # buckets mask correctly too) take the fused verify kernel: ONE page
     # walk for all S queries instead of the flash kernel's per-query-
-    # block passes over the table capacity. Sinks/softcap models and
-    # fp8 caches fall through to the flash path — extra Mosaic
-    # specializations per exotic config are not worth a spec-round
-    # shape, and ONLY the probed base pair may compile in-process
-    # (ops/probe.py "verify" probes the bf16 non-softcap kernel).
-    verify = (1 < q.shape[1] <= VERIFY_MAX_S and not has_sinks
-              and not softcap
-              and k_cache.dtype != jnp.float8_e4m3fn)
+    # block passes over the table capacity. Softcap, sinks and fp8
+    # caches are kernel specializations exactly like the bf16 base —
+    # warmup probes the matching variant kind (ops/probe.py "verify_*")
+    # before any of them may compile in-process, so a probe failure
+    # falls the whole engine back to XLA rather than landing here.
+    verify = 1 < q.shape[1] <= VERIFY_MAX_S
     if verify:
+        record_route("verify")
         fn = functools.partial(
             paged_verify_attention, scale=scale, interpret=interpret,
             softcap=softcap,
         )
         vbase = positions[:, 0].astype(jnp.int32)
         args = (q, k_cache, v_cache, block_tables, vbase, context_lens,
-                li, win)
+                li, win) + sink_args
 
         def call(q, k_cache, v_cache, block_tables, vbase, context_lens,
                  li, win, *sk):
             return fn(q, k_cache, v_cache, block_tables, vbase,
-                      context_lens, li, window=win)
+                      context_lens, li, window=win,
+                      sinks=sk[0] if sk else None)
     elif decode:
+        record_route("decode")
         fn = functools.partial(
             paged_decode_attention, scale=scale, interpret=interpret,
             softcap=softcap,
@@ -312,6 +355,7 @@ def attention(
             return fn(q, k_cache, v_cache, block_tables, context_lens, li,
                       window=win, sinks=sk[0] if sk else None)
     else:
+        record_route("flash")
         fn = functools.partial(
             paged_flash_attention, scale=scale, interpret=interpret,
             softcap=softcap,

@@ -407,16 +407,13 @@ def _verify_kernel(
     q_ref,     # [1, S, KVH, G, D] VMEM block
     k_hbm,     # [L, N, page, KVH, D] in HBM (ANY)
     v_hbm,
-    o_ref,     # [1, S, KVH, G, D]
-    k_buf,
-    v_buf,
-    sem,
-    *,
+    *rest,     # ([sinks_ref [1, KVH*G] when has_sinks], o_ref, scratch...)
     scale: float,
     block_size: int,
     pages_per_chunk: int,
     softcap: float,
     s_q: int,
+    has_sinks: bool = False,
 ):
     """Multi-token verify attention: S query tokens per row over the
     SAME single page walk — the speculative propose-verify step's
@@ -431,7 +428,15 @@ def _verify_kernel(
     against the bounded valid range and the caller discards them), and
     key j is visible iff j <= base + s AND j < ctx (and inside the
     sliding window).
+
+    ``has_sinks`` (GPT-OSS): the per-head sink logit joins EVERY query
+    position's softmax as a denominator-only virtual key — the [1,
+    KVH*G] operand tiles across the S query rows at finalize.
     """
+    if has_sinks:
+        sinks_ref, o_ref, k_buf, v_buf, sem = rest
+    else:
+        o_ref, k_buf, v_buf, sem = rest
     b = pl.program_id(0)
     ctx = ctx_ref[b]
     base = base_ref[b]
@@ -519,6 +524,16 @@ def _verify_kernel(
     acc0 = jnp.zeros((rows, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(first_chunk, nchunks, body, (m0, l0, acc0))
     l1 = l[:, 0:1]
+    if has_sinks:
+        # denominator-only virtual key, per (kvh, g) head, identical for
+        # every query position: tile the [KVH*G] sink row across the S
+        # query rows so row (s, kvh, g) sees sink[kvh*g] (see
+        # _decode_kernel — any shared shift cancels, so the keys-only
+        # running max m serves without a combined-max pass)
+        sink_rows = jnp.broadcast_to(
+            sinks_ref[0][None, :], (s, kvh * g)
+        ).reshape(rows, 1)
+        l1 = l1 + jnp.exp(sink_rows.astype(jnp.float32) - m[:, 0:1])
     l1 = jnp.where(l1 == 0.0, 1.0, l1)
     o_ref[0] = (acc / l1).astype(o_ref.dtype).reshape(s, kvh, g, d)
 
@@ -545,6 +560,7 @@ def paged_verify_attention(
     interpret: bool = False,
     softcap: float = 0.0,
     window=None,
+    sinks=None,              # [H] per-head sink logits (GPT-OSS); None = off
 ) -> jax.Array:
     """S-token verify attention over the paged cache; returns
     [B, S, H, D]. The flash kernel's affine contract: query s of row b
@@ -570,15 +586,22 @@ def paged_verify_attention(
     )
     pages_per_chunk = min(pages_per_chunk, block_tables.shape[1])
     qs = q.reshape(b, s, kvh, g, d)
+    has_sinks = sinks is not None
+
+    in_specs = [
+        pl.BlockSpec((1, s, kvh, g, d), lambda i, *_: (i, 0, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    if has_sinks:
+        # [1, KVH*G] replicated to every grid step; the kernel tiles it
+        # across the S query rows itself
+        in_specs.append(pl.BlockSpec((1, kvh * g), lambda i, *_: (0, 0)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, s, kvh, g, d), lambda i, *_: (i, 0, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, s, kvh, g, d), lambda i, *_: (i, 0, 0, 0, 0)
         ),
@@ -593,22 +616,7 @@ def paged_verify_attention(
         ],
     )
 
-    out = pl.pallas_call(
-        functools.partial(
-            _verify_kernel,
-            scale=scale,
-            block_size=block_size,
-            pages_per_chunk=pages_per_chunk,
-            softcap=softcap,
-            s_q=s,
-        ),
-        grid_spec=grid_spec,
-        out_shape=_out_struct((b, s, kvh, g, d), q.dtype, q, k_cache),
-        compiler_params=_compiler_params(
-            dimension_semantics=("parallel",),
-        ),
-        interpret=interpret,
-    )(
+    operands = [
         block_tables.astype(jnp.int32),
         context_lens.astype(jnp.int32),
         base_pos.astype(jnp.int32),
@@ -617,7 +625,29 @@ def paged_verify_attention(
         qs,
         k_cache,
         v_cache,
-    )
+    ]
+    if has_sinks:
+        operands.append(
+            jnp.asarray(sinks, jnp.float32).reshape(1, kvh * g)
+        )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel,
+            scale=scale,
+            block_size=block_size,
+            pages_per_chunk=pages_per_chunk,
+            softcap=softcap,
+            s_q=s,
+            has_sinks=has_sinks,
+        ),
+        grid_spec=grid_spec,
+        out_shape=_out_struct((b, s, kvh, g, d), q.dtype, q, k_cache),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(*operands)
     return out.reshape(b, s, h, d)
 
 

@@ -87,20 +87,29 @@ def probe_decode_windowed():
     ))
 
 
-def probe_verify():
+def _probe_verify(dtype_name, softcap=False, sinks=False):
     # the S-token verify kernel (speculative propose-verify rounds):
-    # its own Mosaic specialization — one page walk for all S queries
+    # its own Mosaic specialization — one page walk for all S queries.
+    # softcap / sinks / fp8 are further static specializations, probed
+    # only for the configs that select them (mirrors decode/prefill)
     from dynamo_tpu.ops.pallas_decode import paged_verify_attention
 
     l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 2, 4, 4
-    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
-    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    dt = getattr(jnp, dtype_name)
+    k = jnp.zeros((l, n, page, kvh, d), dt)
+    v = jnp.zeros((l, n, page, kvh, d), dt)
     q = jnp.ones((b, s, 4, d), jnp.bfloat16)
     bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
     ctx = jnp.asarray([17, 33], jnp.int32)
     base = ctx - s
+    kw = {}
+    if softcap:
+        kw["softcap"] = 50.0
+    if sinks:
+        kw["sinks"] = jnp.ones((4,), jnp.float32)
+        kw["window"] = jnp.asarray(16, jnp.int32)
     np.asarray(paged_verify_attention(
-        q, k, v, bt, base, ctx, jnp.asarray(1, jnp.int32)
+        q, k, v, bt, base, ctx, jnp.asarray(1, jnp.int32), **kw
     ))
 
 
@@ -264,6 +273,67 @@ def _probe_prefill_sinks(dtype_name):
     ))
 
 
+def probe_sp_prefill():
+    # the SP ring-prefill's paged prefix walk (ops/pallas_sp.py): reads
+    # the committed prefix page-by-page from the HBM-resident cache via
+    # double-buffered DMA — its own Mosaic specialization
+    from dynamo_tpu.ops.pallas_sp import paged_prefix_attention_partials
+
+    l, n, page, kvh, d, b, w, s = 2, 16, 16, 2, 128, 1, 4, 128
+    k = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    v = jnp.zeros((l, n, page, kvh, d), jnp.bfloat16)
+    q = jnp.ones((b, s, 4, d), jnp.bfloat16)
+    bt = jnp.asarray(np.arange(b * w).reshape(b, w) % n, jnp.int32)
+    acc, m, lse = paged_prefix_attention_partials(
+        q, k, v, bt, jnp.asarray(40, jnp.int32), jnp.asarray(1, jnp.int32)
+    )
+    np.asarray(acc), np.asarray(m), np.asarray(lse)
+
+
+def probe_epilogue():
+    # the fused sampling epilogue (ops/pallas_epilogue.py): compile the
+    # static variants the serving programs use — the plain tail with the
+    # aliased in-kernel count commit (bursts), the unaliased form (the
+    # batched prefill step), and the finish-fused chained-burst tail
+    from dynamo_tpu.engine.sampling import (
+        STOP_ID_WIDTH, STOP_SEQ_WIDTH, SUFFIX_RING_W,
+    )
+    from dynamo_tpu.ops.pallas_epilogue import fused_sampling_epilogue
+
+    b, v, ns = 2, 256, 4
+    logits = jnp.ones((b, v), jnp.float32)
+    gum = jnp.zeros((b, v), jnp.float32)
+    scalars = (
+        jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+        jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.float32), jnp.zeros((b,), jnp.float32),
+        jnp.ones((b,), jnp.float32),
+    )
+    counts = jnp.zeros((ns, v), jnp.int32)
+    seen = jnp.zeros((ns, v), jnp.bool_)
+    bias = jnp.zeros((ns, v), jnp.float32)
+    slots = jnp.arange(b, dtype=jnp.int32)
+    commit = jnp.ones((b,), jnp.bool_)
+    for alias in (True, False):
+        np.asarray(fused_sampling_epilogue(
+            logits, gum, scalars, counts, seen, bias, slots, commit,
+            max_model_len=64, alias_counts=alias,
+        )[0])
+    fin = (
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.full((b,), 32, jnp.int32),
+        jnp.full((b, STOP_ID_WIDTH), -1, jnp.int32),
+        jnp.full((b, SUFFIX_RING_W), -1, jnp.int32),
+        jnp.zeros((b, STOP_SEQ_WIDTH), jnp.uint32),
+        jnp.zeros((b, STOP_SEQ_WIDTH), jnp.int32),
+    )
+    np.asarray(fused_sampling_epilogue(
+        logits, gum, scalars, counts, seen, bias, slots, commit,
+        extra_bias=jnp.zeros((b, v), jnp.float32), finish=fin,
+        max_model_len=64,
+    )[0])
+
+
 PROBES = {
     "decode": probe_decode,
     "decode_windowed": probe_decode_windowed,
@@ -279,7 +349,16 @@ PROBES = {
     "prefill_sinks_fp8": lambda: _probe_prefill_sinks("float8_e4m3fn"),
     "mla_decode": probe_mla_decode,
     "mla_decode_fp8": probe_mla_decode_fp8,
-    "verify": probe_verify,
+    "verify": lambda: _probe_verify("bfloat16"),
+    "verify_fp8": lambda: _probe_verify("float8_e4m3fn"),
+    "verify_softcap": lambda: _probe_verify("bfloat16", softcap=True),
+    "verify_softcap_fp8": lambda: _probe_verify(
+        "float8_e4m3fn", softcap=True),
+    "verify_sinks": lambda: _probe_verify("bfloat16", sinks=True),
+    "verify_sinks_fp8": lambda: _probe_verify(
+        "float8_e4m3fn", sinks=True),
+    "sp_prefill": probe_sp_prefill,
+    "epilogue": probe_epilogue,
 }
 for kind in sys.argv[1:]:
     PROBES[kind]()
@@ -370,13 +449,17 @@ def probe_kernel(
 
 def probe_serving_kernels(
     mla: bool = False, softcap: bool = False, fp8_kv: bool = False,
-    sinks: bool = False, verify: bool = False, timeout_s: float = 180.0,
+    sinks: bool = False, verify: bool = False, sp_prefill: bool = False,
+    epilogue: bool = False, timeout_s: float = 180.0,
 ) -> bool:
     """Probe every kernel a serving engine under ``attention_impl=auto``
     would compile — the dense engines' decode + flash-prefill kernels
     in the one specialization the model config selects, or ONLY the MLA
     decode kernel for MLA models (MLA prefill always runs the dense XLA
-    formulation; models/deepseek.py).
+    formulation; models/deepseek.py). ``sp_prefill`` adds the
+    sequence-parallel paged prefix-walk kernel (ops/pallas_sp.py) and
+    ``epilogue`` the fused sampling tail (ops/pallas_epilogue.py) —
+    both engage exactly when the engine config would compile them.
 
     True → let auto resolve to pallas. Any hard failure/timeout → False.
     Inconclusive (exclusive-device host) → True with a warning: a child
@@ -401,12 +484,21 @@ def probe_serving_kernels(
             kinds = [f"decode_windowed{sfx}", f"prefill_windowed{sfx}"]
         else:
             kinds = [f"decode{sfx}", f"prefill{sfx}"]
-        if verify and not fp8_kv and not sinks and not softcap:
+        if verify:
             # speculative engines also compile the S-token verify
-            # kernel (its own Mosaic specialization); the specialized
-            # cache/softcap/sinks configs fall back to flash for verify
-            # shapes, so only the base pair adds the probe
-            kinds.append("verify")
+            # kernel in the model's OWN specialization — the verify
+            # kernel now carries softcap / sinks / fp8-KV variants, so
+            # each config probes exactly the one it would serve with
+            if sinks:
+                kinds.append(f"verify_sinks{sfx}")
+            elif softcap:
+                kinds.append(f"verify_softcap{sfx}")
+            else:
+                kinds.append(f"verify{sfx}")
+        if sp_prefill:
+            kinds.append("sp_prefill")
+    if epilogue:
+        kinds.append("epilogue")
     results = probe_kernels(kinds, timeout_s=timeout_s)
     if any(v is False for v in results.values()):
         return False

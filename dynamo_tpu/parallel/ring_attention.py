@@ -49,8 +49,13 @@ def _causal_mask(q_pos, k_pos):
     return valid & causal
 
 
-def _ring_kernel(q, k, v, q_pos, k_pos, *, axis: str, scale: float):
-    """Per-device body under shard_map: seq dim sharded over ``axis``."""
+def _ring_partials(q, k, v, q_pos, k_pos, *, axis: str, scale: float):
+    """Per-device online-softmax partials under shard_map: the full ring
+    rotation WITHOUT the final normalization. Returns the unnormalized
+    accumulator ``o`` [B,KVH,G,Sq,D] f32 plus the running max ``m`` and
+    sum ``l`` [B,KVH,G,Sq] f32 — so callers can merge further key
+    sources (the paged-prefix kernel in parallel/sequence.py) before
+    dividing. Fully-masked rows keep m == _NEG and l == 0."""
     n = lax.psum(1, axis)
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -81,6 +86,13 @@ def _ring_kernel(q, k, v, q_pos, k_pos, *, axis: str, scale: float):
         return o, m_new, l, k, v, k_pos
 
     o, m, l, _, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v, k_pos))
+    return o, m, l
+
+
+def _ring_kernel(q, k, v, q_pos, k_pos, *, axis: str, scale: float):
+    """Per-device body under shard_map: seq dim sharded over ``axis``."""
+    b, sq, h, d = q.shape
+    o, _m, l = _ring_partials(q, k, v, q_pos, k_pos, axis=axis, scale=scale)
     out = o / jnp.maximum(l, 1e-30)[..., None]               # fully-masked rows -> 0
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, d).astype(q.dtype)
 

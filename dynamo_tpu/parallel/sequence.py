@@ -10,13 +10,16 @@ all-to-all when heads divide nicely).
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .ring_attention import ring_attention, ulysses_attention
+from ..ops.compat import shard_map
+from .ring_attention import _NEG, _ring_partials, ring_attention, ulysses_attention
 
 
 def choose_strategy(seq_len: int, num_kv_heads: int, sp: int) -> str:
@@ -81,29 +84,68 @@ def sp_chunk_attention(
     axis: str = "sp",
     head_axis: Optional[str] = None,
     scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """Attention for ONE sequence-sharded prefill chunk of a long prompt.
 
     The serving half of sequence parallelism (engine/model_runner.py
     ``prefill_sp``): the chunk's queries and fresh K/V are sharded over
     the mesh's ``axis``; earlier chunks' KV already live in the paged
-    cache. Both sources fold into ONE ring pass — the committed prefix
-    is gathered from the cache for this layer, sharded over the same
-    axis (per-device key memory stays O((S + W·bs)/sp)), concatenated
-    behind the chunk's K/V, and rotated around the ring with global
-    position ids doing all masking:
+    cache. Both sources fold into ONE online softmax. Two routes:
 
-    - chunk keys carry their global positions (causal intra-chunk),
-    - prefix keys carry positions ``< chunk_start`` (everything the
-      chunk may attend), later cache slots masked to -1 — so the
-      chunk's own just-scattered slots are never double-counted, and a
-      prefix-cache hit's reused blocks are covered for free.
+    - **Pallas kernel route** (``impl`` resolves to pallas): one ring
+      pass over the chunk's fresh K/V only
+      (ring_attention._ring_partials), while each device reads the
+      committed prefix straight out of its local paged cache with the
+      double-buffered page-DMA kernel
+      (ops/pallas_sp.paged_prefix_attention_partials) — the cache is
+      replicated over ``axis`` (only ``head_axis`` shards it), so no
+      gather, no concat, and per-device prefix memory is O(pages in
+      flight). The two partial sets merge exp-weighted and normalize
+      once, bit-compatible row-for-row with one joint softmax.
+
+    - **XLA gather route** (fallback): the committed prefix is gathered
+      from the cache for this layer, sharded over the same axis,
+      concatenated behind the chunk's K/V, and rotated around the ring
+      with global position ids doing all masking — per-device key
+      memory O((S + W·bs)/sp), but the gather itself materializes the
+      full [1, W·bs, KVH, D] prefix before the sharding constraint can
+      split it.
+
+    Both routes: chunk keys carry their global positions (causal
+    intra-chunk); prefix keys are exactly the cache slots
+    ``< chunk_start`` (committed KV only — the chunk's own
+    just-scattered slots are never double-counted, and a prefix-cache
+    hit's reused blocks are covered for free).
 
     Ring (not Ulysses) deliberately: arbitrary head counts, and the
     rotation overlaps the interconnect with compute at exactly the long
     sequence lengths this path exists for.
     """
+    from ..ops.attention import record_route, resolve_attention_impl
+
     b, s, _h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    sp = mesh.shape[axis]
+    interpret = interpret or bool(os.environ.get("DYN_PALLAS_INTERPRET"))
+    if resolve_attention_impl(impl) == "pallas":
+        if s % sp:
+            raise ValueError(
+                f"sp chunk S must divide the {axis!r} axis: S={s}, sp={sp}"
+            )
+        record_route("sp_ring_kernel")
+        return _sp_chunk_kernel_route(
+            q, k, v, k_cache, v_cache,
+            block_tables.astype(jnp.int32),
+            jnp.asarray(chunk_start, jnp.int32).reshape(1),
+            jnp.asarray(context_len, jnp.int32).reshape(1),
+            jnp.asarray(layer_idx, jnp.int32).reshape(1),
+            mesh=mesh, axis=axis, head_axis=head_axis, scale=scale,
+            interpret=interpret,
+        )
+    record_route("sp_ring_gather")
     l, n_blocks = k_cache.shape[:2]
     # layer indexing through the gather (ops/attention.py idiom): block
     # n of layer li is flat row li*N + n — no full-layer copy
@@ -134,7 +176,6 @@ def sp_chunk_attention(
     kk = jnp.concatenate([k, pk], axis=1)
     vv = jnp.concatenate([v, pv], axis=1)
     kpos = jnp.concatenate([qpos, ppos], axis=1)
-    sp = mesh.shape[axis]
     if (s % sp) or (kk.shape[1] % sp):
         raise ValueError(
             f"sp chunk shapes must divide the {axis!r} axis: "
@@ -144,3 +185,86 @@ def sp_chunk_attention(
         q, kk, vv, qpos, kpos, mesh, axis=axis, scale=scale,
         head_axis=head_axis,
     )
+
+
+def _sp_chunk_kernel_route(
+    q, k, v, k_cache, v_cache, block_tables, chunk_start, context_len,
+    layer_idx, *, mesh, axis, head_axis, scale, interpret,
+):
+    """Kernelized chunk attention: ring partials over the fresh chunk K/V
+    merged with paged-prefix partials read in place from the cache.
+
+    One shard_map: queries/chunk-KV sharded [None, axis, head_axis,
+    None]; the cache enters sharded ONLY over ``head_axis`` (replicated
+    across ``axis`` — exactly the engine's CACHE_SPEC), so each sp
+    device walks its local pages for its own query shard and the full
+    [W·bs] prefix is never materialized anywhere.
+
+    The merge is the standard two-source online-softmax combine: with
+    per-row (m_r, l_r, o_r) from the ring and (m_p, l_p, acc_p) from
+    the prefix kernel, ``m = max(m_r, m_p)``, each side scales by
+    ``exp(m_x − m)``, sums add, and one divide normalizes. Pad query
+    rows (position -1) have empty ring partials already; their prefix
+    partials are masked to empty here so the row stays exactly 0.
+    """
+    b, s, h, d = q.shape
+    kernel = functools.partial(
+        _sp_chunk_body, axis=axis, scale=scale, interpret=interpret,
+    )
+    seq = P(None, axis, head_axis, None)
+    pos = P(None, axis)
+    cache = P(None, None, None, head_axis, None)
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(seq, seq, seq, pos, P(None, None), cache, cache,
+                  P(None), P(None)),
+        out_specs=seq,
+        check_vma=False,
+    )(
+        q, k, v,
+        _chunk_qpos(s, chunk_start, context_len),
+        block_tables, k_cache, v_cache, chunk_start, layer_idx,
+    )
+
+
+def _chunk_qpos(s, chunk_start, context_len):
+    """Global query positions for one chunk; rows past the valid tail
+    (the last chunk's padding) get -1 and mask out everywhere."""
+    idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    return jnp.where(idx < context_len - chunk_start,
+                     chunk_start + idx, -1)
+
+
+def _sp_chunk_body(q, k, v, qpos, btab, kc, vc, pfx, li, *,
+                   axis, scale, interpret):
+    from ..ops.pallas_sp import paged_prefix_attention_partials
+
+    b, sq, h, d = q.shape
+    # ring over the chunk's fresh K/V only: kpos == qpos (the chunk IS
+    # the newest keys; causality intra-chunk via global positions)
+    o_r, m_r, l_r = _ring_partials(
+        q, k, v, qpos, qpos, axis=axis, scale=scale
+    )                                            # [B,KVH,G,Sq(,D)] f32
+    acc_p, m_p, l_p = paged_prefix_attention_partials(
+        q, kc, vc, btab, pfx[0], li[0],
+        scale=scale, interpret=interpret,
+    )                                            # [B,Sq,KVH,G(,D)] f32
+    acc_p = acc_p.transpose(0, 2, 3, 1, 4)
+    m_p = m_p.transpose(0, 2, 3, 1)
+    l_p = l_p.transpose(0, 2, 3, 1)
+    # pad query rows attended the whole prefix inside the kernel (it has
+    # no notion of query validity); empty their partials so the merged
+    # row is exactly 0 like the gather route's
+    padded = (qpos < 0)[:, None, None, :]
+    m_p = jnp.where(padded, _NEG, m_p)
+    l_p = jnp.where(padded, 0.0, l_p)
+    acc_p = jnp.where(padded[..., None], 0.0, acc_p)
+
+    m = jnp.maximum(m_r, m_p)
+    a_r = jnp.exp(m_r - m)
+    a_p = jnp.exp(m_p - m)
+    l_tot = a_r * l_r + a_p * l_p
+    o = (o_r * a_r[..., None] + acc_p * a_p[..., None]) / jnp.where(
+        l_tot == 0.0, 1.0, l_tot
+    )[..., None]
+    return o.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, d).astype(q.dtype)

@@ -138,17 +138,22 @@ class DeviceTimeTracker:
             self.param_bytes + context_tokens * self.kv_bytes_per_token
         )
 
-    def sp_prefill_read_bytes(self, chunks: int,
-                              context_tokens: int) -> float:
+    def sp_prefill_read_bytes(self, chunks: int, context_tokens: int,
+                              kernel: bool = False) -> float:
         """HBM bytes one sequence-parallel prefill LADDER must stream
         (the scheduler observes the whole ladder at its single drain
         seam, whose busy window covers every queued chunk): the weights
-        once per chunk, each chunk's gathered committed prefix
-        (triangular sum ≈ ctx·(chunks−1)/2 tokens), and the full
-        context's KV written once."""
+        once per chunk, each chunk's committed prefix (triangular sum
+        ≈ ctx·(chunks−1)/2 tokens), and the full context's KV written
+        once. ``kernel`` selects the paged-DMA route's prefix traffic
+        (ops/pallas_sp.py streams cache pages straight into the online
+        softmax — one pass per prefix token); the XLA gather route
+        (default) pays three: the cache read, the materialized
+        [W·bs]-token gather write, and its re-read by attention."""
+        prefix = context_tokens * max(0, chunks - 1) / 2.0
+        passes = 1.0 if kernel else 3.0
         return float(chunks) * self.param_bytes + (
-            self.kv_bytes_per_token
-            * (context_tokens * max(0, chunks - 1) / 2.0 + context_tokens)
+            self.kv_bytes_per_token * (passes * prefix + context_tokens)
         )
 
     def observe(self, program: str, phase: str, dispatch_t: float,

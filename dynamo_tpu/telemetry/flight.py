@@ -34,7 +34,7 @@ import logging
 import os
 import threading
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import List, Optional
 
 logger = logging.getLogger(__name__)
@@ -164,6 +164,10 @@ class CompileTracker:
         self._serving = False
         self.records: List[dict] = []  # every compile, for tests/debug
         self.late_compiles = 0
+        # optional per-dispatch context hook (program name → context
+        # manager): the engine installs ops.attention.route_program so
+        # trace-time route records carry the program label
+        self.dispatch_cm = None
 
     def mark_serving_started(self) -> None:
         """Compiles from now on are ``late`` — the engine is serving, so
@@ -185,13 +189,18 @@ class CompileTracker:
     def track(self, program: str, key: str):
         """Wrap ONE dispatch of ``program`` at shape-bucket ``key``;
         records a compile iff this (program, key) was never dispatched."""
-        with self._lock:
-            first = (program, key) not in self._seen
-            if first:
-                self._seen.add((program, key))
-        if not first:
-            yield False
-            return
+        hook = self.dispatch_cm
+        with hook(program) if hook is not None else nullcontext():
+            with self._lock:
+                first = (program, key) not in self._seen
+                if first:
+                    self._seen.add((program, key))
+            if not first:
+                yield False
+                return
+            yield from self._track_first(program, key)
+
+    def _track_first(self, program: str, key: str):
         t0 = time.monotonic()
         try:
             yield True

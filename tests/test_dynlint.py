@@ -625,6 +625,21 @@ def test_dynamo_tpu_lints_clean_modulo_baseline():
     )
 
 
+def test_kernel_campaign_ops_modules_are_jit_impure_clean():
+    """The kernel-campaign modules — the SP paged prefix walk, the
+    fused sampling epilogue, and the decode kernels they share helpers
+    with — must carry ZERO jit-impure findings, with no baseline
+    allowance: host-effect Python inside these traced bodies would fire
+    once per Mosaic specialization compile and skew every differential."""
+    mods = [
+        os.path.join(PACKAGE_ROOT, "ops", "pallas_sp.py"),
+        os.path.join(PACKAGE_ROOT, "ops", "pallas_epilogue.py"),
+        os.path.join(PACKAGE_ROOT, "ops", "pallas_decode.py"),
+    ]
+    found = lint_paths(mods, get_rules(["jit-impure"]))
+    assert not found, "\n".join(f.render() for f in found)
+
+
 def test_overlapping_paths_do_not_double_count():
     """dynlint dynamo_tpu dynamo_tpu/engine must not lint guided.py twice
     — duplicate counts would trip the baseline ratchet with phantoms."""

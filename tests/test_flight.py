@@ -112,6 +112,44 @@ def test_compile_tracker_reset_seen_recounts():
     assert len(tracker.records) == 2
 
 
+def test_attention_route_counter_rides_the_dispatch_hook():
+    """record_route() must attribute routes to the program whose tracked
+    dispatch is on the stack (ops.attention.route_program installed as
+    CompileTracker.dispatch_cm) — and, routes being TRACE-time facts,
+    count once per compiled specialization, not once per step."""
+    from dynamo_tpu.ops import attention as attn
+
+    def count(program, route):
+        key = (("program", program), ("route", route))
+        return attn.ATTENTION_ROUTE_COUNTER.values.get(key, 0.0)
+
+    tracker = CompileTracker(flight=FlightRecorder())
+    tracker.dispatch_cm = attn.route_program
+
+    base = count("decode", "sp_ring_kernel")
+    for _ in range(3):  # repeat dispatches: only the first one traces
+        with tracker.track("decode", "b2_s1") as first:
+            if first:  # the dispatch seams record inside the trace
+                attn.record_route("sp_ring_kernel")
+    assert count("decode", "sp_ring_kernel") == base + 1
+    # the tracked dispatches themselves stay startup-phase compiles
+    assert all(r["phase"] == "startup" for r in tracker.records)
+    # the hook restores its previous label on exit
+    base_u = count("unknown", "xla")
+    attn.record_route("xla")
+    assert count("unknown", "xla") == base_u + 1
+
+    # engine wiring: every runner installs the hook and registers the
+    # singleton into its compile registry (the engine scrape), once
+    runner, _ = _tiny_runner()
+    assert runner.compiles.dispatch_cm is attn.route_program
+    assert (attn.ATTENTION_ROUTE_COUNTER.name
+            in runner.compiles.registry.names())
+    runner2, _ = _tiny_runner()  # re-registration must not duplicate
+    assert runner2.compiles.registry.names().count(
+        attn.ATTENTION_ROUTE_COUNTER.name) <= 1
+
+
 # --------------------------------------------------------------------------
 # the compile-storm acceptance test: real runner, real compiles
 # --------------------------------------------------------------------------

@@ -127,6 +127,10 @@ def test_lint_sees_the_real_instrument_catalog():
         # propose-verify acceptance-length histogram
         "dynamo_engine_sync_fallback_total",
         "dynamo_engine_spec_accept_length",
+        # attention route attribution (ops/attention.py): which kernel
+        # each compiled program's attention resolved to, counted once
+        # per trace via the CompileTracker dispatch hook
+        "dynamo_engine_attention_route_total",
         # sequence-parallel long-context prefill (engine/scheduler.py;
         # docs/long_context.md)
         "dynamo_engine_prefill_sp_chunks_total",

@@ -1,0 +1,362 @@
+"""Interpret-mode differentials for the kernel campaign.
+
+Three kernels, each checked against the engine's pre-existing XLA
+formulation (the same strategy as tests/test_pallas_decode.py):
+
+- the sequence-parallel ring-prefill's paged prefix walk
+  (ops/pallas_sp.py via parallel/sequence.sp_chunk_attention) vs the
+  XLA gather route, plus a jaxpr audit that the kernel route never
+  materializes the gathered [1, W·bs, KVH, D] prefix;
+- the verify kernel's softcap / sinks / fp8-KV specializations
+  (ops/pallas_decode.paged_verify_attention) vs the gather/softmax
+  reference;
+- the fused sampling epilogue (ops/pallas_epilogue.py) vs the dense
+  ladder in engine/sampling.py — BIT-identical, not allclose: the
+  kernel replicates the ladder's exact op sequence so the Pallas and
+  XLA engines emit the same tokens from the same seeds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import sampling as S
+from dynamo_tpu.ops.attention import paged_attention
+from dynamo_tpu.ops.pallas_decode import paged_verify_attention
+from dynamo_tpu.ops.pallas_epilogue import fused_sampling_epilogue
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.parallel.sequence import sp_chunk_attention
+
+
+# --------------------------------------------------------------------------
+# SP ring-prefill: paged prefix-walk kernel vs the XLA gather route
+# --------------------------------------------------------------------------
+
+_SP_DIMS = dict(b=1, s=16, h=4, kvh=2, d=16, L=2, N=8, bs=8, W=8)
+
+
+def _sp_case(seed=0):
+    rng = np.random.default_rng(seed)
+    c = _SP_DIMS
+    q = jnp.asarray(rng.normal(size=(c["b"], c["s"], c["h"], c["d"])),
+                    jnp.float32)
+    k = jnp.asarray(rng.normal(size=(c["b"], c["s"], c["kvh"], c["d"])),
+                    jnp.float32)
+    v = jnp.asarray(rng.normal(size=(c["b"], c["s"], c["kvh"], c["d"])),
+                    jnp.float32)
+    kc = jnp.asarray(
+        rng.normal(size=(c["L"], c["N"], c["bs"], c["kvh"], c["d"])),
+        jnp.float32)
+    vc = jnp.asarray(
+        rng.normal(size=(c["L"], c["N"], c["bs"], c["kvh"], c["d"])),
+        jnp.float32)
+    btab = jnp.asarray(rng.permutation(c["N"])[: c["W"]], jnp.int32)[None, :]
+    return q, k, v, kc, vc, btab
+
+
+@pytest.mark.parametrize(
+    "chunk_start,context_len",
+    [
+        (24, 37),   # multi-page committed prefix ending mid-page
+        (0, 13),    # first chunk: empty prefix, ring pass only
+        (19, 35),   # prefix boundary mid-page (partial last page DMA)
+    ],
+)
+def test_sp_kernel_matches_gather_route(chunk_start, context_len):
+    """The kernel route (ring partials over fresh K/V + the paged
+    prefix walk, exp-weighted merge) must match the gather route's one
+    joint softmax row-for-row."""
+    q, k, v, kc, vc, btab = _sp_case()
+    mesh = make_mesh({"sp": 4})
+    ref = sp_chunk_attention(
+        q, k, v, kc, vc, btab, chunk_start, context_len, 1, mesh,
+        impl="xla",
+    )
+    out = sp_chunk_attention(
+        q, k, v, kc, vc, btab, chunk_start, context_len, 1, mesh,
+        impl="pallas", interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            items = val if isinstance(val, (list, tuple)) else [val]
+            for item in items:
+                inner = getattr(item, "jaxpr", item)
+                if hasattr(inner, "eqns"):
+                    yield from _iter_eqns(inner)
+
+
+def _materializes_prefix(fn, *args):
+    """Does any intermediate in fn's jaxpr carry the full gathered
+    prefix — a [*, W·bs, ...] array (every cache slot widthwise)?"""
+    full = _SP_DIMS["W"] * _SP_DIMS["bs"]
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    for eqn in _iter_eqns(jaxpr.jaxpr):
+        for var in eqn.outvars:
+            shape = getattr(getattr(var, "aval", None), "shape", ())
+            if len(shape) >= 4 and full in shape:
+                return True
+    return False
+
+
+def test_sp_kernel_route_never_materializes_the_prefix():
+    """The point of the page-walk kernel: the committed prefix streams
+    page-by-page through the DMA scratch and NEVER exists as a
+    [1, W·bs, KVH, D] array. The gather route is the positive control —
+    its jaxpr must show the materialized prefix this audit looks for."""
+    q, k, v, kc, vc, btab = _sp_case()
+    mesh = make_mesh({"sp": 4})
+
+    def route(impl):
+        return lambda *a: sp_chunk_attention(
+            *a, 24, 37, 1, mesh, impl=impl, interpret=(impl == "pallas"),
+        )
+
+    assert _materializes_prefix(route("xla"), q, k, v, kc, vc, btab)
+    assert not _materializes_prefix(route("pallas"), q, k, v, kc, vc, btab)
+
+
+# --------------------------------------------------------------------------
+# verify kernel specializations: softcap / sinks / fp8 KV
+# --------------------------------------------------------------------------
+
+
+def _verify_case(seed, layers=2, b=2, h=4, kvh=2, d=32, bs=8, w=8, s=4):
+    rng = np.random.default_rng(seed)
+    n_blocks = b * w + 3
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(
+        rng.standard_normal((layers, n_blocks, bs, kvh, d)), jnp.float32)
+    bt = jnp.asarray(
+        rng.permutation(n_blocks)[: b * w].reshape(b, w), jnp.int32)
+    ctx = jnp.asarray([29, 53], jnp.int32)
+    positions = (ctx - s)[:, None] + jnp.arange(s)[None, :]
+    return q, k_cache, v_cache, bt, ctx, positions, s
+
+
+def test_verify_softcap_matches_xla_reference():
+    """Gemma-2-class verify: logit soft-capping is a static Mosaic
+    specialization of the verify kernel, checked against the gather
+    reference's cap·tanh(logits/cap)."""
+    q, kc, vc, bt, ctx, positions, s = _verify_case(21)
+    ref = paged_attention(q, kc[1], vc[1], bt, positions, ctx, softcap=30.0)
+    out = paged_verify_attention(
+        q, kc, vc, bt, ctx - s, ctx,
+        layer_idx=jnp.int32(1), interpret=True, softcap=30.0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_verify_sinks_matches_xla_reference():
+    """GPT-OSS-class verify: per-head sink logits join each query's
+    softmax denominator (no value contribution), alongside the runtime
+    sliding window the family alternates."""
+    rng = np.random.default_rng(22)
+    q, kc, vc, bt, ctx, positions, s = _verify_case(22)
+    sinks = jnp.asarray(rng.standard_normal(q.shape[2]), jnp.float32)
+    ref = paged_attention(
+        q, kc[0], vc[0], bt, positions, ctx,
+        sliding_window=16, sinks=sinks,
+    )
+    out = paged_verify_attention(
+        q, kc, vc, bt, ctx - s, ctx,
+        layer_idx=jnp.int32(0), interpret=True,
+        window=jnp.asarray(16, jnp.int32), sinks=sinks,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("variant", ["plain", "softcap", "sinks"])
+def test_verify_fp8_kv_matches_xla_reference(variant):
+    """fp8 KV serving x verify: the cache stores e4m3 and the kernel
+    upcasts after the DMA — compared against the gather reference over
+    the SAME stored values (upcast at the gather), so the check is
+    exact, not a quantization-error bound."""
+    rng = np.random.default_rng(23)
+    q, kc, vc, bt, ctx, positions, s = _verify_case(23)
+    kf8 = kc.astype(jnp.float8_e4m3fn)
+    vf8 = vc.astype(jnp.float8_e4m3fn)
+    k32 = kf8.astype(jnp.float32)
+    v32 = vf8.astype(jnp.float32)
+    ref_kw, kern_kw = {}, {}
+    if variant == "softcap":
+        ref_kw["softcap"] = kern_kw["softcap"] = 30.0
+    elif variant == "sinks":
+        sinks = jnp.asarray(rng.standard_normal(q.shape[2]), jnp.float32)
+        ref_kw = dict(sliding_window=16, sinks=sinks)
+        kern_kw = dict(window=jnp.asarray(16, jnp.int32), sinks=sinks)
+    ref = paged_attention(q, k32[1], v32[1], bt, positions, ctx, **ref_kw)
+    out = paged_verify_attention(
+        q, kf8, vf8, bt, ctx - s, ctx,
+        layer_idx=jnp.int32(1), interpret=True, **kern_kw,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
+
+
+# --------------------------------------------------------------------------
+# fused sampling epilogue: bit-identical to the dense ladder
+# --------------------------------------------------------------------------
+
+_B, _V, _NS = 6, 64, 8
+_MAX_LEN = 512
+
+
+def _epilogue_case():
+    rng = np.random.default_rng(1)
+    last_logits = jnp.asarray(rng.normal(size=(_B, _V)) * 4, jnp.float32)
+    counts = jnp.asarray(rng.integers(0, 3, size=(_NS, _V)), jnp.int32)
+    seen = jnp.asarray(rng.integers(0, 2, size=(_NS, _V)), jnp.bool_)
+    bias = jnp.asarray(rng.normal(size=(_NS, _V)) * 0.5, jnp.float32)
+    # one row per regime: greedy, top-k, top-p, min-p + penalties,
+    # top-k + repetition, greedy again
+    params = S.SamplingParams(
+        temperature=jnp.asarray([0.0, 0.7, 1.0, 1.3, 0.9, 0.0], jnp.float32),
+        top_k=jnp.asarray([0, 5, 0, 0, 3, 0], jnp.int32),
+        top_p=jnp.asarray([1.0, 1.0, 0.9, 1.0, 0.8, 1.0], jnp.float32),
+        min_p=jnp.asarray([0.0, 0.0, 0.0, 0.2, 0.05, 0.0], jnp.float32),
+        presence_penalty=jnp.asarray(
+            [0.0, 0.5, 0.0, 1.1, 0.0, 0.0], jnp.float32),
+        frequency_penalty=jnp.asarray(
+            [0.0, 0.0, 0.3, 0.2, 0.0, 0.0], jnp.float32),
+        repetition_penalty=jnp.asarray(
+            [1.0, 1.2, 1.0, 1.05, 1.3, 1.0], jnp.float32),
+        keys=jnp.asarray(rng.integers(0, 2**32, size=(_B, 2)), jnp.uint32),
+        counters=jnp.asarray(rng.integers(0, 100, size=(_B,)), jnp.int32),
+    )
+    scalars = (
+        params.temperature, params.top_k, params.top_p, params.min_p,
+        params.presence_penalty, params.frequency_penalty,
+        params.repetition_penalty,
+    )
+    # the engine precomputes the gumbel field outside the kernel —
+    # argmax(gumbel + logits) IS jax.random.categorical's sampler, so
+    # sharing row keys keeps the token stream identical to the ladder
+    row_keys = S._row_keys(params)
+    gum = jax.vmap(
+        lambda kk: jax.random.gumbel(kk, (_V,), jnp.float32))(row_keys)
+    return rng, last_logits, counts, seen, bias, params, scalars, gum
+
+
+def _epilogue_reference(case, slots, commit, extra=None, finish=None):
+    _, last_logits, counts, seen, bias, params, _, _ = case
+    row_bias = bias[slots]
+    if extra is not None:
+        row_bias = row_bias + extra
+    nt = S.sample(last_logits, params, counts[slots], seen[slots], row_bias)
+    logp = jax.nn.log_softmax((last_logits + row_bias).astype(jnp.float32))
+    lps = logp[jnp.arange(_B), nt]
+    cnt_out = counts.at[slots, nt].add(commit.astype(jnp.int32))
+    if finish is None:
+        return nt, lps, cnt_out
+    gen, pos, min_new, max_new, stop_ids, ring, sh, sl = finish
+    gen_n = gen + commit.astype(jnp.int32)
+    hard = S.device_finish_mask(
+        nt, gen_n, pos, stop_ids, min_new, max_new, _MAX_LEN)
+    ring_n = S.ring_push(ring, nt, commit)
+    cand = S.stop_candidate_mask(ring_n, gen_n, min_new, sh, sl)
+    return nt, lps, cnt_out, hard, cand, ring_n
+
+
+def _assert_bit_identical(got, ref):
+    assert len(got) == len(ref)
+    for i, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"output {i}")
+
+
+def test_epilogue_bit_identical_plain_and_guided():
+    """Mixed sampling regimes in one batch, aliased in-kernel count
+    commit; then the guided-decoding extra-bias operand on top."""
+    case = _epilogue_case()
+    rng, last_logits, counts, seen, bias, _, scalars, gum = case
+    slots = jnp.asarray([3, 0, 5, 1, 7, 2], jnp.int32)  # unique
+    commit = jnp.asarray([1, 1, 0, 1, 1, 0], jnp.bool_)
+
+    got = fused_sampling_epilogue(
+        last_logits, gum, scalars, counts, seen, bias, slots, commit,
+        max_model_len=_MAX_LEN, interpret=True,
+    )
+    _assert_bit_identical(got, _epilogue_reference(case, slots, commit))
+
+    extra = jnp.where(
+        jnp.asarray(rng.integers(0, 4, size=(_B, _V))) == 0, -1e9, 0.0,
+    ).astype(jnp.float32)
+    got = fused_sampling_epilogue(
+        last_logits, gum, scalars, counts, seen, bias, slots, commit,
+        extra_bias=extra, max_model_len=_MAX_LEN, interpret=True,
+    )
+    _assert_bit_identical(
+        got, _epilogue_reference(case, slots, commit, extra=extra))
+
+
+def test_epilogue_bit_identical_finish_fusion():
+    """The chained-burst tail: device_finish_mask, the suffix-ring push
+    and the rolling-hash stop-sequence candidate mask all fused behind
+    sampling — against the unfused engine/sampling.py ops."""
+    case = _epilogue_case()
+    rng, last_logits, counts, seen, bias, _, scalars, gum = case
+    slots = jnp.asarray([3, 0, 5, 1, 7, 2], jnp.int32)
+    commit = jnp.asarray([1, 1, 0, 1, 1, 0], jnp.bool_)
+
+    gen = jnp.asarray(rng.integers(0, 40, size=(_B,)), jnp.int32)
+    pos = jnp.asarray(rng.integers(0, 500, size=(_B,)), jnp.int32)
+    min_new = jnp.asarray([0, 0, 5, 0, 60, 0], jnp.int32)
+    max_new = jnp.asarray([39, 100, 100, 2, 100, 100], jnp.int32)
+    stop_ids = jnp.full((_B, S.STOP_ID_WIDTH), -1, jnp.int32)
+    stop_ids = stop_ids.at[:, 0].set(7)  # token 7 is an eos everywhere
+    ring = jnp.asarray(
+        np.stack([
+            S.ring_init(rng.integers(0, _V, size=20).tolist())
+            for _ in range(_B)
+        ]),
+        jnp.int32,
+    )
+    # per-row watched suffixes whose hash prefix matches the live ring
+    # tail, so a sampled continuation CAN complete them
+    sh = np.zeros((_B, S.STOP_SEQ_WIDTH), np.uint32)
+    sl = np.zeros((_B, S.STOP_SEQ_WIDTH), np.int32)
+    for r in range(_B):
+        sh[r, 0] = S.stop_seq_hash([int(ring[r, -1]), 11])
+        sl[r, 0] = 2
+        sh[r, 1] = S.stop_seq_hash([int(t) for t in ring[r, -3:]])
+        sl[r, 1] = 3
+    fin = (gen, pos, min_new, max_new, stop_ids, ring,
+           jnp.asarray(sh), jnp.asarray(sl))
+
+    got = fused_sampling_epilogue(
+        last_logits, gum, scalars, counts, seen, bias, slots, commit,
+        finish=fin, max_model_len=_MAX_LEN, interpret=True,
+    )
+    _assert_bit_identical(
+        got, _epilogue_reference(case, slots, commit, finish=fin))
+
+
+def test_epilogue_bit_identical_duplicate_slots():
+    """The batched-prefill step's pad rows share slot 0 — the aliased
+    in-kernel commit would double-count them, so that path runs
+    alias_counts=False (the commit scatters outside the kernel) and
+    must still be bit-identical."""
+    case = _epilogue_case()
+    _, last_logits, counts, seen, bias, _, scalars, gum = case
+    slots = jnp.asarray([0, 2, 0, 0, 4, 0], jnp.int32)
+    commit = jnp.asarray([1, 1, 0, 0, 1, 0], jnp.bool_)
+    got = fused_sampling_epilogue(
+        last_logits, gum, scalars, counts, seen, bias, slots, commit,
+        alias_counts=False, max_model_len=_MAX_LEN, interpret=True,
+    )
+    _assert_bit_identical(got, _epilogue_reference(case, slots, commit))

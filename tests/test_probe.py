@@ -229,6 +229,29 @@ def test_probe_matrix_matches_engine_compilations(monkeypatch):
          ["decode_sinks", "prefill_sinks"]),  # sinks specialization
         (dict(mla=True), ["mla_decode"]),
         (dict(mla=True, fp8_kv=True), ["mla_decode_fp8"]),
+        # the verify kernel's softcap / sinks / fp8-KV specializations:
+        # a speculative engine probes EXACTLY the variant its model
+        # config serves with, never the base kind plus a variant
+        (dict(verify=True), ["decode", "prefill", "verify"]),
+        (dict(verify=True, fp8_kv=True),
+         ["decode_fp8", "prefill_fp8", "verify_fp8"]),
+        (dict(verify=True, softcap=True),
+         ["decode_windowed", "prefill_windowed", "verify_softcap"]),
+        (dict(verify=True, softcap=True, fp8_kv=True),
+         ["decode_windowed_fp8", "prefill_windowed_fp8",
+          "verify_softcap_fp8"]),
+        (dict(verify=True, sinks=True),
+         ["decode_sinks", "prefill_sinks", "verify_sinks"]),
+        (dict(verify=True, sinks=True, fp8_kv=True),
+         ["decode_sinks_fp8", "prefill_sinks_fp8", "verify_sinks_fp8"]),
+        # the SP ring-prefill page-walk kernel and the fused sampling
+        # epilogue ride the same warmup probe pass as the attention
+        # kernels — engaged exactly when the engine config compiles them
+        (dict(sp_prefill=True), ["decode", "prefill", "sp_prefill"]),
+        (dict(epilogue=True), ["decode", "prefill", "epilogue"]),
+        (dict(mla=True, epilogue=True), ["mla_decode", "epilogue"]),
+        (dict(verify=True, sp_prefill=True, epilogue=True),
+         ["decode", "prefill", "verify", "sp_prefill", "epilogue"]),
     ]
     for kwargs, want in cases:
         assert probe_mod.probe_serving_kernels(**kwargs), kwargs

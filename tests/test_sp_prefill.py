@@ -143,6 +143,56 @@ def test_sp_stream_matches_dense_ladder(model_dir):
     assert len(spres[0][0]) == 16
 
 
+def test_sp_pallas_kernel_route_stream_matches_xla(model_dir, monkeypatch):
+    """The whole-engine kernel-campaign differential: an SP engine
+    serving on the Pallas route (interpret mode on CPU — the paged
+    prefix-walk kernel inside sp_chunk_attention AND the fused sampling
+    epilogue, which fused_epilogue=auto engages with it) must emit the
+    same decode stream as the XLA-route engine, greedy and seeded."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard as MDC
+    from dynamo_tpu.ops import attention as attn
+
+    def routed(route):
+        return sum(
+            v for k, v in attn.ATTENTION_ROUTE_COUNTER.values.items()
+            if dict(k).get("route") == route
+        )
+
+    async def go(impl):
+        mdc = MDC.from_local_path(model_dir)
+        cfg = _config(model_dir, sp=8)
+        cfg.model.attention_impl = impl
+        engine = await JaxServingEngine.create(
+            mdc, engine_config=cfg, warmup=False,
+        )
+        long_p = _prompt(200)
+        res = [
+            await _collect(engine, long_p,
+                           SamplingOptions(temperature=0.0), max_tokens=8),
+            await _collect(engine, long_p,
+                           SamplingOptions(temperature=0.8, seed=11),
+                           max_tokens=8),
+        ]
+        chunks = sum(engine.scheduler._sp_chunks_c.values.values())
+        fused = engine.scheduler.runner._fused_epilogue_enabled()
+        used = engine.scheduler.allocator.used
+        await engine.close()
+        return res, chunks, fused, used
+
+    base_kernel = routed("sp_ring_kernel")
+    xla_res, x_chunks, x_fused, x_used = asyncio.run(go("xla"))
+    assert not x_fused  # auto keeps the XLA tail with the XLA kernels
+    assert routed("sp_ring_kernel") == base_kernel
+    pal_res, p_chunks, p_fused, p_used = asyncio.run(go("pallas"))
+    assert p_fused     # ...and fuses the tail on the Pallas route
+    assert routed("sp_ring_kernel") > base_kernel
+    assert xla_res == pal_res
+    assert x_chunks >= 2 and p_chunks >= 2
+    assert x_used == 0 and p_used == 0
+    assert len(pal_res[0][0]) == 8
+
+
 def test_sp_early_handoff_overlaps_final_drain(model_dir):
     """The early decode handoff: the first decode burst dispatches off
     the DEVICE-resident first token, before the final SP chunk's

@@ -320,6 +320,16 @@ def test_sp_prefill_bytes_feed_the_roofline():
     # more chunks add a triangular prefix re-read
     three = t.sp_prefill_read_bytes(3, 300)
     assert three > 3 * t.param_bytes + 300 * t.kv_bytes_per_token
+    # route-parameterized prefix traffic: the XLA gather pays three
+    # passes per prefix token (cache read + materialized gather write +
+    # its re-read), the paged-DMA kernel streams it once — weights and
+    # the KV write are route-independent. Triangular prefix for
+    # chunks=3, ctx=300 is 300 tokens, so the routes differ by exactly
+    # two extra passes over it.
+    kern = t.sp_prefill_read_bytes(3, 300, kernel=True)
+    assert three - kern == pytest.approx(2 * 300 * t.kv_bytes_per_token)
+    # one chunk has no committed prefix: the routes cost the same
+    assert t.sp_prefill_read_bytes(1, 100, kernel=True) == pytest.approx(one)
 
 
 # --------------------------------------------------------------------------
